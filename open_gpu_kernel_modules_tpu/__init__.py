@@ -1,0 +1,25 @@
+"""open_gpu_kernel_modules_tpu — a TPU-native device-memory framework.
+
+A brand-new framework with the capability surface of the reference
+(CXLMemUring/open-gpu-kernel-modules, NVIDIA open GPU kernel modules + CXL
+P2P fork), re-designed TPU-first:
+
+- ``runtime``  — RM-style client/device/subdevice object model, NVOS ioctl ABI,
+  channel/pushbuffer DMA submission (reference: src/nvidia/src/kernel/rmapi/,
+  src/nvidia/src/libraries/resserv/, kernel-open/nvidia/).  Backed by a native
+  C core (``native/``) bound via ctypes.
+- ``uvm``      — managed-memory engine: VA blocks, residency, fault-driven
+  migration, PMM with eviction, oversubscription of TPU HBM against host and
+  CXL tiers (reference: kernel-open/nvidia-uvm/).
+- ``ops``      — Pallas TPU kernels (paged attention over tiered KV pages,
+  flash attention, bandwidth/copy kernels).
+- ``models``   — model families served on top of the tiered-memory engine
+  (Llama family; BASELINE configs #4/#5).
+- ``parallel`` — device meshes, shardings, ICI topology, ring attention /
+  sequence parallelism over ``shard_map`` (reference substrate: nvlink/
+  nvswitch/peermem, SURVEY.md §2.7).
+- ``utils``    — registry (config KV), journal ring, lock-order validation,
+  tools event queues (reference: diagnostics/, nv-reg.h, uvm_lock.h).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+"""Model families served by the framework (BASELINE configs #4/#5)."""
+
+from .llama import (
+    LlamaConfig,
+    init_params,
+    forward,
+    forward_with_cache,
+    init_kv_cache,
+    loss_fn,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "forward",
+    "forward_with_cache",
+    "init_kv_cache",
+    "loss_fn",
+]

@@ -1,0 +1,239 @@
+"""Llama-family decoder in pure JAX (functional pytree params).
+
+The reference is a device driver, not a model zoo; models enter through the
+BASELINE workloads (configs #4/#5: "CXL.mem-tiered KV-cache, Llama-3-8B
+inference"; "v5p-8 ICI peer-mapped HBM pool, Llama-3-70B UVM multi-chip").
+This module is the flagship workload the tiered-memory engine serves.
+
+TPU-first design decisions:
+- bfloat16 params/activations by default (MXU-native).
+- Static shapes everywhere; decode uses a fixed-capacity KV cache with a
+  position index, so the whole step stays inside one ``jit``.
+- GQA (grouped-query attention) as in Llama-3.
+- Attention/MLP are plain ``jnp`` (XLA fuses them onto the MXU); the paged /
+  tiered-KV attention variants live in ``ops.paged_attention`` and are wired
+  in by the inference engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                           num_layers=80, num_heads=64, num_kv_heads=8)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256, max_seq_len: int = 128) -> "LlamaConfig":
+        """Test-sized config: same topology, toy dims."""
+        return LlamaConfig(vocab_size=vocab_size, hidden_size=64,
+                           intermediate_size=128, num_layers=2, num_heads=4,
+                           num_kv_heads=2, head_dim=16, max_seq_len=max_seq_len,
+                           rope_theta=10000.0)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Initialize a parameter pytree.
+
+    Layout: dict of stacked per-layer arrays (leading ``num_layers`` axis) so
+    the decoder runs as one ``lax.scan`` over layers — fewer XLA instructions,
+    faster compiles, and natural pipeline-parallel sharding along axis 0.
+    """
+    h, ffn = cfg.hidden_size, cfg.intermediate_size
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    L = cfg.num_layers
+    k = iter(jax.random.split(key, 16))
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / jnp.sqrt(fan_in))).astype(cfg.dtype)
+
+    return {
+        "embed": w(next(k), (cfg.vocab_size, h), h),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), cfg.dtype),
+            "wq": w(next(k), (L, h, nh * hd), h),
+            "wk": w(next(k), (L, h, nkv * hd), h),
+            "wv": w(next(k), (L, h, nkv * hd), h),
+            "wo": w(next(k), (L, nh * hd, h), nh * hd),
+            "mlp_norm": jnp.ones((L, h), cfg.dtype),
+            "w_gate": w(next(k), (L, h, ffn), h),
+            "w_up": w(next(k), (L, h, ffn), h),
+            "w_down": w(next(k), (L, ffn, h), ffn),
+        },
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "lm_head": w(next(k), (h, cfg.vocab_size), h),
+    }
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_table(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions [..., seq]."""
+    d = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., seq, d/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, D]; cos/sin: [B, S, D/2] (or broadcastable)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, KV, D] -> [B, S, KV*n_rep, D] (GQA head expansion)."""
+    if n_rep == 1:
+        return x
+    b, s, kv, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, d)).reshape(
+        b, s, kv * n_rep, d)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              mask: Optional[jax.Array]) -> jax.Array:
+    """Reference jnp attention. q,k,v: [B, S, H, D]; mask broadcast to
+    [B, H, Sq, Sk] with -inf at masked positions."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
+           cos: jax.Array, sin: jax.Array, mask: Optional[jax.Array],
+           kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
+           cache_pos: Optional[jax.Array] = None):
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = (attn_in @ lp["wq"]).reshape(b, s, nh, hd)
+    k = (attn_in @ lp["wk"]).reshape(b, s, nkv, hd)
+    v = (attn_in @ lp["wv"]).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_cache = None
+    if kv_cache is not None:
+        ck, cv = kv_cache  # [B, Smax, KV, D]
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_pos, 0, 0))
+        k, v = ck, cv
+        new_cache = (ck, cv)
+
+    k = repeat_kv(k, nh // nkv)
+    v = repeat_kv(v, nh // nkv)
+    out = attention(q, k, v, mask).reshape(b, s, nh * hd)
+    x = x + out @ lp["wo"]
+
+    mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    gate = jax.nn.silu((mlp_in @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    x = x + ((gate * (mlp_in @ lp["w_up"])) @ lp["w_down"])
+    return x, new_cache
+
+
+def causal_mask(sq: int, sk: int, offset: int = 0) -> jax.Array:
+    """[1, 1, Sq, Sk] additive mask; query i attends keys <= i+offset."""
+    qi = jnp.arange(sq)[:, None] + offset
+    ki = jnp.arange(sk)[None, :]
+    return jnp.where(ki <= qi, 0.0, -jnp.inf)[None, None].astype(jnp.float32)
+
+
+def forward(cfg: LlamaConfig, params: Dict[str, Any],
+            tokens: jax.Array) -> jax.Array:
+    """Full-sequence forward → logits [B, S, V].  Layers run as lax.scan."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    cos, sin = rope_table(cfg, positions)
+    mask = causal_mask(s, s)
+
+    def body(x, lp):
+        x, _ = _layer(cfg, x, lp, cos, sin, mask)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int) -> Tuple[jax.Array, jax.Array]:
+    """Stacked per-layer KV cache [L, B, Smax, KV, D]."""
+    shape = (cfg.num_layers, batch, cfg.max_seq_len, cfg.num_kv_heads,
+             cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def forward_with_cache(cfg: LlamaConfig, params: Dict[str, Any],
+                       tokens: jax.Array, kv: Tuple[jax.Array, jax.Array],
+                       pos: jax.Array):
+    """Decode/prefill step writing into a fixed KV cache at ``pos``.
+
+    tokens: [B, S] chunk; pos: scalar start position. Returns
+    (logits [B, S, V], new_kv).
+    """
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s)) + pos
+    cos, sin = rope_table(cfg, positions)
+    # Mask over full cache: key j visible iff j <= pos + i (and j < pos + s
+    # entries beyond current fill are masked because cache is causal-filled).
+    qi = jnp.arange(s)[:, None] + pos
+    kj = jnp.arange(cfg.max_seq_len)[None, :]
+    mask = jnp.where(kj <= qi, 0.0, -jnp.inf)[None, None].astype(jnp.float32)
+
+    def body(x, carry):
+        lp, (ck, cv) = carry
+        x, new_cache = _layer(cfg, x, lp, cos, sin, mask, (ck, cv), pos)
+        return x, new_cache
+
+    x, new_kv = jax.lax.scan(body, x, (params["layers"], kv))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32), new_kv
+
+
+def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
+            targets: jax.Array) -> jax.Array:
+    """Next-token cross-entropy (training objective for the dryrun path)."""
+    logits = forward(cfg, params, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,34 @@
+"""Utility subsystems shared by the whole framework.
+
+TPU-native re-designs of the reference's auxiliary subsystems (SURVEY.md §5):
+
+- :mod:`.registry`  — the RM registry: string key/value config DB populated
+  from env vars and programmatic overrides (reference:
+  kernel-open/nvidia/nv-reg.h, arch/nvalloc/unix/src/registry.c).
+- :mod:`.journal`   — error/event journal ring (reference:
+  src/nvidia/src/kernel/diagnostics/journal.c, nvlog.c).
+- :mod:`.locking`   — documented global lock order enforced by runtime
+  assertions (reference: kernel-open/nvidia-uvm/uvm_lock.h:31+,
+  uvm_thread_context.c).
+- :mod:`.events`    — tools event queues: lock-free ring buffers consumed by
+  profiling tools (reference: kernel-open/nvidia-uvm/uvm_tools.c:54-70).
+"""
+
+from .registry import Registry, registry
+from .journal import Journal, JournalRecord
+from .locking import LockOrder, OrderedLock, LockOrderError
+from .events import EventQueue, EventRecord, EventType, Counters
+
+__all__ = [
+    "Registry",
+    "registry",
+    "Journal",
+    "JournalRecord",
+    "LockOrder",
+    "OrderedLock",
+    "LockOrderError",
+    "EventQueue",
+    "EventRecord",
+    "EventType",
+    "Counters",
+]

@@ -1,0 +1,134 @@
+"""Tools event queues and counters: the framework's profiling surface.
+
+Re-design of UVM tools (reference: kernel-open/nvidia-uvm/uvm_tools.c — per
+open-file event trackers with user-mmap'd lock-free queues, queue struct at
+uvm_tools.c:54-70; event types and UVM_TOOLS_* ioctls at uvm_ioctl.h:822-948).
+
+The TPU build keeps the shape: a fixed-capacity single-producer ring per
+tracker, per-event-type enablement masks, notification thresholds, and a
+counters block.  Producers (fault loop, migration engine, DMA channels) call
+``emit``; consumers drain with ``get_entries``.  No locks on the producer
+fast path beyond a sequence counter — entries are published by monotonically
+advancing ``put`` exactly like the reference's control.put/get protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any, Dict, List, Optional
+
+
+class EventType(IntEnum):
+    """Subset of the reference's 60+ UvmEventType values that apply to TPU.
+
+    Numbering is ours (TPU-native), names track uvm_ioctl.h semantics.
+    """
+
+    FAULT = 1                 # device access missed residency → fault serviced
+    FAULT_BATCH = 2           # one pass of the batched service loop
+    MIGRATION = 3             # block migration between tiers
+    EVICTION = 4              # PMM eviction forced by oversubscription
+    PREFETCH = 5              # heuristic-initiated migration
+    THRASHING = 6             # thrashing detected on a block
+    THROTTLE = 7              # fault servicing throttled
+    MAP_REMOTE = 8            # serviced by remote mapping instead of migration
+    CHANNEL_PUSH = 9          # DMA push submitted
+    CHANNEL_COMPLETE = 10     # DMA push completed
+    READ_DUPLICATE = 11
+    ACCESS_COUNTER = 12       # hotness sample crossed threshold
+
+
+@dataclass
+class EventRecord:
+    event: EventType
+    timestamp: float
+    payload: Dict[str, Any] = field(default_factory=dict)
+
+
+class Counters:
+    """Monotonic named counters (reference: tools counters + procfs)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {}
+
+    def add(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + delta
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+
+class EventQueue:
+    """Fixed-capacity event ring with per-type enable mask.
+
+    capacity must be a power of two (reference requires the same for its
+    mmap'd queues so put/get wrap with a mask).
+    """
+
+    def __init__(self, capacity: int = 1 << 14) -> None:
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        self._mask = capacity - 1
+        self._ring: List[Optional[EventRecord]] = [None] * capacity
+        self._put = 0          # next slot to write (producer-owned)
+        self._get = 0          # next slot to read (consumer-owned)
+        self._enabled = set()  # enabled EventTypes
+        self._lock = threading.Lock()
+        self.notification_threshold = capacity // 2
+        self.dropped = 0
+
+    def enable(self, *events: EventType) -> None:
+        with self._lock:
+            self._enabled.update(events)
+
+    def disable(self, *events: EventType) -> None:
+        with self._lock:
+            self._enabled.difference_update(events)
+
+    def is_enabled(self, event: EventType) -> bool:
+        return event in self._enabled
+
+    def emit(self, event: EventType, timestamp: float = 0.0, **payload: Any) -> bool:
+        """Publish one record; drops (and counts) when the ring is full,
+        matching the reference's drop-and-count behavior rather than blocking
+        a fault handler."""
+        if event not in self._enabled:
+            return False
+        with self._lock:
+            if self._put - self._get > self._mask:
+                self.dropped += 1
+                return False
+            self._ring[self._put & self._mask] = EventRecord(
+                event=event, timestamp=timestamp, payload=payload)
+            self._put += 1
+        return True
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._put - self._get
+
+    def should_notify(self) -> bool:
+        return self.pending() >= self.notification_threshold
+
+    def get_entries(self, max_entries: int = 0) -> List[EventRecord]:
+        out: List[EventRecord] = []
+        with self._lock:
+            n = self._put - self._get
+            if max_entries:
+                n = min(n, max_entries)
+            for _ in range(n):
+                rec = self._ring[self._get & self._mask]
+                assert rec is not None
+                out.append(rec)
+                self._ring[self._get & self._mask] = None  # drop payload ref
+                self._get += 1
+        return out

@@ -1,0 +1,79 @@
+"""Journal ring: bounded in-memory record of errors and notable events.
+
+Re-design of the reference's RC error journal + NvLog binary logger
+(reference: src/nvidia/src/kernel/diagnostics/journal.c — RCDB record ring;
+nvlog.c — leveled binary ring logger).  One ring per subsystem or a shared
+process ring; records carry a monotonic sequence number, coarse timestamp,
+level, subsystem tag, and free-form payload.  The ring never allocates on the
+hot path after construction and overwrites the oldest record when full —
+exactly the property that makes the reference's journal usable from fault
+handlers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from enum import IntEnum
+from typing import Any, List, Optional
+
+
+class Level(IntEnum):
+    DEBUG = 0
+    INFO = 1
+    WARN = 2
+    ERROR = 3
+    FATAL = 4
+
+
+@dataclass
+class JournalRecord:
+    seq: int
+    timestamp: float
+    level: Level
+    subsystem: str
+    message: str
+    data: Any = None
+
+
+class Journal:
+    """Fixed-capacity overwrite-oldest record ring (journal.c analog)."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._capacity = capacity
+        self._ring: List[Optional[JournalRecord]] = [None] * capacity
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def record(self, level: Level, subsystem: str, message: str,
+               data: Any = None) -> int:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self._ring[seq % self._capacity] = JournalRecord(
+                seq=seq, timestamp=time.monotonic(), level=level,
+                subsystem=subsystem, message=message, data=data)
+            return seq
+
+    def error(self, subsystem: str, message: str, data: Any = None) -> int:
+        return self.record(Level.ERROR, subsystem, message, data)
+
+    def info(self, subsystem: str, message: str, data: Any = None) -> int:
+        return self.record(Level.INFO, subsystem, message, data)
+
+    def tail(self, n: int = 64, min_level: Level = Level.DEBUG) -> List[JournalRecord]:
+        """Most recent n records at or above min_level, oldest first."""
+        with self._lock:
+            recs = [r for r in self._ring if r is not None and r.level >= min_level]
+        recs.sort(key=lambda r: r.seq)
+        return recs[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._seq, self._capacity)
+
+
+#: Shared process journal (the reference keeps one RCDB per GPU; we keep one
+#: per process plus per-device rings created by the runtime).
+journal = Journal()

@@ -1,0 +1,142 @@
+"""RM-style registry: a typed string key/value configuration database.
+
+The reference drives every tunable through a single registry populated from
+module parameters and per-device overrides (reference: kernel-open/nvidia/
+nv-reg.h — 1,021 lines of NV_REG_* keys; arch/nvalloc/unix/src/registry.c;
+os-registry.c).  The TPU build keeps that single-source-of-config property:
+one process-wide :class:`Registry`, populated from
+
+1. built-in defaults declared by subsystems via :meth:`Registry.define`,
+2. environment variables (``TPUMEM_<KEY>``; the module-param analog),
+3. programmatic ``set`` calls (the per-device override analog).
+
+Keys are declared with a type and documentation so ``dump()`` doubles as the
+procfs-style listing (reference: /proc/driver/nvidia/params).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+_ENV_PREFIX = "TPUMEM_"
+
+
+@dataclass
+class _Key:
+    name: str
+    default: Any
+    type: Callable[[str], Any]
+    doc: str
+    value: Any = None
+    source: str = "default"  # default | env | set
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class Registry:
+    """Process-wide typed KV store with env-var override.
+
+    Mirrors the reference's three config layers (SURVEY.md §5 "Config/flag
+    system") collapsed into one: defaults (compile-time), env (module param),
+    set() (registry override).
+    """
+
+    def __init__(self) -> None:
+        self._keys: Dict[str, _Key] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _parser_for(default: Any) -> Callable[[str], Any]:
+        if isinstance(default, bool):
+            return _parse_bool
+        if isinstance(default, int):
+            return lambda s: int(s, 0)  # accepts 0x.. like the reference registry
+        if isinstance(default, float):
+            return float
+        return str
+
+    def define(self, name: str, default: Any, doc: str = "") -> None:
+        """Declare a key with its default; idempotent for identical defaults."""
+        ty = self._parser_for(default)
+        with self._lock:
+            if name in self._keys:
+                return
+            key = _Key(name=name, default=default, type=ty, doc=doc)
+            env = os.environ.get(_ENV_PREFIX + name.upper())
+            if env is not None:
+                key.value = ty(env)
+                key.source = "env"
+            else:
+                key.value = default
+            self._keys[name] = key
+
+    def get(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            key = self._keys.get(name)
+            if key is None:
+                return default
+            return key.value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            key = self._keys.get(name)
+            if key is None:
+                # Implicit define: the set value becomes the default, with a
+                # proper string parser so env re-parse on reset() works.
+                self._keys[name] = _Key(
+                    name=name, default=value, type=self._parser_for(value),
+                    doc="", value=value, source="set")
+            else:
+                key.value = value
+                key.source = "set"
+
+    def dump(self) -> str:
+        """procfs-style listing of every key, its value, and provenance."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._keys):
+                k = self._keys[name]
+                lines.append(f"{name}: {k.value!r} [{k.source}] {k.doc}")
+            return "\n".join(lines)
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is not None and name not in self._keys:
+                return
+            keys = [self._keys[name]] if name else list(self._keys.values())
+            for k in keys:
+                env = os.environ.get(_ENV_PREFIX + k.name.upper())
+                if env is not None:
+                    k.value = k.type(env)
+                    k.source = "env"
+                else:
+                    k.value = k.default
+                    k.source = "default"
+
+
+#: The process-wide registry instance (the reference has exactly one RM
+#: registry per driver instance).
+registry = Registry()
+
+# Core framework knobs, mirroring reference module params.
+registry.define("uvm_block_size", 2 * 1024 * 1024,
+                "VA block granularity in bytes (reference: uvm_pmm_gpu.h:60-85, 2 MB)")
+registry.define("channel_num_gpfifo_entries", 1024,
+                "DMA channel ring depth (reference: uvm_channel.h:49-51)")
+registry.define("perf_fault_max_batches_per_service", 20,
+                "Max fault batches serviced per ISR pass (reference: uvm_gpu_replayable_faults.c)")
+registry.define("perf_fault_batch_count", 256,
+                "Fault-buffer entries fetched per batch (reference: uvm_perf_fault_batch_count)")
+registry.define("cxl_max_buffers", 256,
+                "Max registered CXL buffers (reference: p2p_cxl.c:140)")
+registry.define("cxl_max_buffer_bytes", 1 << 40,
+                "Max bytes per registered CXL buffer (reference: p2p_cxl.c:137)")
+registry.define("ce_copy_clamp_bytes", 0xFFFFF000,
+                "Single DMA copy clamp (reference: p2p_cxl.c:617-621)")
+registry.define("enable_debug_procfs", False,
+                "Expose debug counters in status dumps (reference: uvm_procfs.c:36-49)")

@@ -1,0 +1,156 @@
+"""Tests for the auxiliary subsystems (registry, journal, locking, events).
+
+Modeled on the reference's in-kernel infra tests (uvm_lock_test.c
+UVM_TEST_LOCK_SANITY, uvm_kvmalloc_test.c) — SURVEY.md §4 tier 2.
+"""
+
+import threading
+
+import pytest
+
+from open_gpu_kernel_modules_tpu.utils import (
+    Counters,
+    EventQueue,
+    EventType,
+    Journal,
+    LockOrder,
+    LockOrderError,
+    OrderedLock,
+    Registry,
+)
+from open_gpu_kernel_modules_tpu.utils.journal import Level
+
+
+class TestRegistry:
+    def test_defaults_and_set(self):
+        r = Registry()
+        r.define("k_int", 42, "doc")
+        assert r.get("k_int") == 42
+        r.set("k_int", 7)
+        assert r.get("k_int") == 7
+        r.reset("k_int")
+        assert r.get("k_int") == 42
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("TPUMEM_K_HEX", "0x20")
+        r = Registry()
+        r.define("k_hex", 1)
+        assert r.get("k_hex") == 32
+
+    def test_builtin_reference_constants(self):
+        # The process registry must carry the reference's limits
+        # (p2p_cxl.c:137,140; uvm_channel.h:49-51; uvm_pmm_gpu.h:60-85).
+        from open_gpu_kernel_modules_tpu.utils.registry import registry
+        assert registry.get("cxl_max_buffers") == 256
+        assert registry.get("cxl_max_buffer_bytes") == 1 << 40
+        assert registry.get("channel_num_gpfifo_entries") == 1024
+        assert registry.get("uvm_block_size") == 2 * 1024 * 1024
+
+    def test_dump_lists_keys(self):
+        r = Registry()
+        r.define("alpha", 1, "first")
+        assert "alpha" in r.dump()
+
+
+class TestJournal:
+    def test_ring_overwrite(self):
+        j = Journal(capacity=8)
+        for i in range(20):
+            j.record(Level.INFO, "test", f"msg{i}")
+        tail = j.tail(100)
+        assert len(tail) == 8
+        assert tail[-1].message == "msg19"
+        assert tail[0].message == "msg12"
+
+    def test_level_filter(self):
+        j = Journal(capacity=16)
+        j.info("s", "a")
+        j.error("s", "b")
+        assert [r.message for r in j.tail(10, min_level=Level.ERROR)] == ["b"]
+
+
+class TestLockOrder:
+    def test_in_order_ok(self):
+        a = OrderedLock(LockOrder.VA_SPACE, "va_space")
+        b = OrderedLock(LockOrder.VA_BLOCK, "block")
+        with a, b:
+            assert len(OrderedLock.held_by_current_thread()) == 2
+        OrderedLock.assert_nothing_held()
+
+    def test_out_of_order_raises(self):
+        a = OrderedLock(LockOrder.VA_SPACE, "va_space")
+        b = OrderedLock(LockOrder.VA_BLOCK, "block")
+        with b:
+            with pytest.raises(LockOrderError):
+                a.acquire()
+
+    def test_same_order_needs_flag(self):
+        a = OrderedLock(LockOrder.VA_BLOCK, "block_a")
+        b = OrderedLock(LockOrder.VA_BLOCK, "block_b")
+        with a:
+            with pytest.raises(LockOrderError):
+                b.acquire()
+        c = OrderedLock(LockOrder.VA_BLOCK, "block_c", allow_same_order=True)
+        with a:
+            with c:
+                pass
+
+    def test_per_thread_isolation(self):
+        a = OrderedLock(LockOrder.PMM, "pmm")
+        errs = []
+
+        def other():
+            try:
+                OrderedLock.assert_nothing_held()
+            except LockOrderError as e:  # pragma: no cover
+                errs.append(e)
+
+        with a:
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert not errs
+
+    def test_entry_assertion(self):
+        a = OrderedLock(LockOrder.GLOBAL, "g")
+        a.acquire()
+        with pytest.raises(LockOrderError):
+            OrderedLock.assert_nothing_held()
+        a.release()
+
+
+class TestEvents:
+    def test_enable_emit_drain(self):
+        q = EventQueue(capacity=8)
+        q.enable(EventType.MIGRATION)
+        assert not q.emit(EventType.FAULT)          # disabled type
+        assert q.emit(EventType.MIGRATION, bytes=4096)
+        assert q.pending() == 1
+        recs = q.get_entries()
+        assert recs[0].event == EventType.MIGRATION
+        assert recs[0].payload["bytes"] == 4096
+        assert q.pending() == 0
+
+    def test_drop_when_full(self):
+        q = EventQueue(capacity=4)
+        q.enable(EventType.FAULT)
+        for _ in range(6):
+            q.emit(EventType.FAULT)
+        assert q.pending() == 4
+        assert q.dropped == 2
+
+    def test_notification_threshold(self):
+        q = EventQueue(capacity=8)
+        q.enable(EventType.FAULT)
+        q.notification_threshold = 2
+        q.emit(EventType.FAULT)
+        assert not q.should_notify()
+        q.emit(EventType.FAULT)
+        assert q.should_notify()
+
+    def test_counters(self):
+        c = Counters()
+        c.add("faults", 3)
+        c.add("faults")
+        assert c.get("faults") == 4
+        assert c.snapshot() == {"faults": 4}
