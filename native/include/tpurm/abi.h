@@ -1,0 +1,185 @@
+/*
+ * tpurm — wire ABI: ioctl numbers, object classes, control commands, and
+ * parameter struct layouts.
+ *
+ * This is the *stable userspace ABI* the reference exposes and which this
+ * framework preserves bit-exactly so reference userspace runs unchanged
+ * (north star, BASELINE.json).  Layout facts verified against:
+ *   - ioctl escapes:      reference tests/cxl_p2p_test.c:28-31,
+ *                         kernel-open/common/inc/nv-ioctl-numbers.h
+ *   - NVOS21/54/00:       reference tests/cxl_p2p_test.c:70-95 (8-byte
+ *                         alignment traps noted at :147-149)
+ *   - CXL control cmds:   src/common/sdk/nvidia/inc/ctrl/ctrl2080/
+ *                         ctrl2080bus.h:1430-1549 (cmds 0x20801833-36)
+ *   - class ids:          NV01_ROOT/NV01_DEVICE_0/NV20_SUBDEVICE_0
+ *
+ * Everything else in tpurm is TPU-native design; only this header is
+ * ABI-constrained.
+ */
+#ifndef TPURM_ABI_H
+#define TPURM_ABI_H
+
+#include <stdint.h>
+#include <sys/ioctl.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---------------------------------------------------------------- escapes */
+
+#define TPU_IOCTL_MAGIC        'F'
+#define TPU_ESC_RM_FREE        0x29
+#define TPU_ESC_RM_CONTROL     0x2a
+#define TPU_ESC_RM_ALLOC       0x2b
+
+/* ----------------------------------------------------------- object model */
+
+#define TPU_CLASS_ROOT         0x00000000u  /* NV01_ROOT: client           */
+#define TPU_CLASS_DEVICE       0x00000080u  /* NV01_DEVICE_0               */
+#define TPU_CLASS_SUBDEVICE    0x00002080u  /* NV20_SUBDEVICE_0            */
+
+/* ------------------------------------------------------ NVOS param blocks */
+
+/* NV_ESC_RM_ALLOC payload (NVOS21_PARAMETERS layout). */
+typedef struct {
+    uint32_t hRoot;
+    uint32_t hObjectParent;
+    uint32_t hObjectNew;
+    uint32_t hClass;
+    uint64_t pAllocParms;       /* user pointer to class-specific params */
+    uint32_t paramsSize;
+    uint32_t status;
+} TpuRmAllocParams;
+
+/* NV_ESC_RM_CONTROL payload (NVOS54_PARAMETERS layout). */
+typedef struct {
+    uint32_t hClient;
+    uint32_t hObject;
+    uint32_t cmd;
+    uint32_t flags;
+    uint64_t params;            /* user pointer, 8-byte aligned slot */
+    uint32_t paramsSize;
+    uint32_t status;
+} TpuRmControlParams;
+
+/* NV_ESC_RM_FREE payload (NVOS00_PARAMETERS layout). */
+typedef struct {
+    uint32_t hRoot;
+    uint32_t hObjectParent;
+    uint32_t hObjectOld;
+    uint32_t status;
+} TpuRmFreeParams;
+
+#define TPU_ESC_RM_FREE_IOCTL    _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_FREE,    TpuRmFreeParams)
+#define TPU_ESC_RM_CONTROL_IOCTL _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_CONTROL, TpuRmControlParams)
+#define TPU_ESC_RM_ALLOC_IOCTL   _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_ALLOC,   TpuRmAllocParams)
+
+/* -------------------------------------------- class-specific alloc params */
+
+/* NV01_DEVICE_0 alloc params (NV0080_ALLOC_PARAMETERS layout; the aligned(8)
+ * attributes reproduce the reference's explicit alignment). */
+typedef struct {
+    uint32_t deviceId;
+    uint32_t hClientShare;
+    uint32_t hTargetClient;
+    uint32_t hTargetDevice;
+    uint32_t flags;
+    uint64_t vaSpaceSize      __attribute__((aligned(8)));
+    uint64_t vaStartInternal  __attribute__((aligned(8)));
+    uint64_t vaLimitInternal  __attribute__((aligned(8)));
+    uint32_t vaMode;
+} TpuDeviceAllocParams;
+
+/* NV20_SUBDEVICE_0 alloc params. */
+typedef struct {
+    uint32_t subDeviceId;
+} TpuSubdeviceAllocParams;
+
+/* ----------------------------------------------- NV0000 (client) controls */
+
+#define TPU_CTRL_CMD_SYSTEM_GET_P2P_CAPS_V2   0x00000127u
+#define TPU_CTRL_CMD_GPU_GET_ATTACHED_IDS     0x00000201u
+#define TPU_CTRL_CMD_GPU_GET_PROBED_IDS       0x00000214u
+#define TPU_CTRL_CMD_GPU_ATTACH_IDS           0x00000215u
+
+#define TPU_CTRL_MAX_PROBED_DEVICES   32
+#define TPU_CTRL_MAX_ATTACHED_DEVICES 32
+#define TPU_CTRL_ATTACH_ALL_PROBED    0x0000ffffu
+#define TPU_CTRL_INVALID_DEVICE_ID    0xffffffffu
+
+typedef struct {
+    uint32_t gpuIds[TPU_CTRL_MAX_PROBED_DEVICES];
+    uint32_t excludedGpuIds[TPU_CTRL_MAX_PROBED_DEVICES];
+} TpuCtrlGetProbedIdsParams;
+
+typedef struct {
+    uint32_t gpuIds[TPU_CTRL_MAX_PROBED_DEVICES];
+    uint32_t failedId;
+} TpuCtrlAttachIdsParams;
+
+typedef struct {
+    uint32_t gpuIds[TPU_CTRL_MAX_ATTACHED_DEVICES];
+} TpuCtrlGetAttachedIdsParams;
+
+/* -------------------------------------- NV2080 (subdevice) CXL controls
+ * The four fork-added commands (ctrl2080bus.h:1430-1549). */
+
+#define TPU_CTRL_CMD_BUS_GET_CXL_INFO           0x20801833u
+#define TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST    0x20801834u
+#define TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER    0x20801835u
+#define TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER  0x20801836u
+
+typedef struct {
+    uint8_t  bIsLinkUp;
+    uint8_t  bMemoryExpander;
+    uint32_t nrLinks;
+    uint32_t maxNrLinks;
+    uint32_t linkMask;
+    uint32_t perLinkBwMBps;
+    uint32_t cxlVersion;
+    uint32_t remoteType;
+} TpuCtrlGetCxlInfoParams;
+
+#define TPU_CXL_REMOTE_TYPE_CPU 1
+
+typedef struct {
+    uint64_t baseAddress;
+    uint64_t size;
+    uint32_t cxlVersion;
+    uint64_t bufferHandle;      /* out */
+} TpuCtrlRegisterCxlBufferParams;
+
+typedef struct {
+    uint64_t bufferHandle;
+} TpuCtrlUnregisterCxlBufferParams;
+
+typedef struct {
+    uint64_t cxlBufferHandle;
+    uint64_t gpuOffset;
+    uint64_t cxlOffset;
+    uint64_t size;
+    uint32_t flags;
+    uint32_t transferId;        /* out */
+} TpuCtrlCxlP2pDmaRequestParams;
+
+/* DMA flags: bit 0 = direction (0: device->CXL, 1: CXL->device), bit 1 =
+ * async (ctrl2080bus.h DRF _DIRECTION 0:0, _ASYNC 1:1). */
+#define TPU_CXL_DMA_FLAG_DEV_TO_CXL 0x0u
+#define TPU_CXL_DMA_FLAG_CXL_TO_DEV 0x1u
+#define TPU_CXL_DMA_FLAG_ASYNC      0x2u
+
+/* Limits (reference: p2p_cxl.c:137,140; nv-p2p.c:1173). */
+#define TPU_CXL_MAX_BUFFER_BYTES    (1ull << 40)
+#define TPU_CXL_MAX_BUFFERS         256
+#define TPU_CXL_MAX_PIN_PAGES       (1u << 28)
+#define TPU_CXL_PAGE_SIZE_4K        4096ull
+#define TPU_CXL_PAGE_SIZE_2M        (2ull * 1024 * 1024)
+/* Single-copy clamp (reference: p2p_cxl.c:617-621). */
+#define TPU_CE_COPY_CLAMP           0xFFFFF000ull
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_ABI_H */

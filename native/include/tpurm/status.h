@@ -1,0 +1,38 @@
+/*
+ * tpurm — TPU resource-manager runtime: status codes.
+ *
+ * Values are the stable NV_STATUS ABI (reference:
+ * src/common/sdk/nvidia/inc/nvstatuscodes.h) so that reference userspace
+ * (tests/cxl_p2p_test.c) sees the error codes it expects.  Only the subset
+ * the TPU build uses is defined.
+ */
+#ifndef TPURM_STATUS_H
+#define TPURM_STATUS_H
+
+#include <stdint.h>
+
+typedef uint32_t TpuStatus;
+
+#define TPU_OK                            0x00000000u
+#define TPU_ERR_GPU_IS_LOST               0x0000000Fu
+#define TPU_ERR_INSERT_DUPLICATE_NAME     0x00000019u
+#define TPU_ERR_INSUFFICIENT_RESOURCES    0x0000001Au
+#define TPU_ERR_INVALID_ARGUMENT          0x0000001Fu
+#define TPU_ERR_INVALID_CLASS             0x00000022u
+#define TPU_ERR_INVALID_CLIENT            0x00000023u
+#define TPU_ERR_INVALID_COMMAND           0x00000024u
+#define TPU_ERR_INVALID_DEVICE            0x00000026u
+#define TPU_ERR_INVALID_LIMIT             0x0000002Eu
+#define TPU_ERR_INVALID_OBJECT_HANDLE     0x00000033u
+#define TPU_ERR_INVALID_OBJECT_PARENT     0x00000036u
+#define TPU_ERR_INVALID_PARAM_STRUCT      0x0000003Au
+#define TPU_ERR_INVALID_STATE             0x00000040u
+#define TPU_ERR_NO_MEMORY                 0x00000051u
+#define TPU_ERR_NOT_SUPPORTED             0x00000056u
+#define TPU_ERR_OBJECT_NOT_FOUND          0x00000057u
+#define TPU_ERR_OPERATING_SYSTEM          0x00000059u
+#define TPU_ERR_STATE_IN_USE              0x00000063u
+
+const char *tpuStatusToString(TpuStatus status);
+
+#endif /* TPURM_STATUS_H */

@@ -1,0 +1,105 @@
+/*
+ * tpurm — public API of the TPU resource-manager runtime.
+ *
+ * TPU-native re-design of the reference's RM stack (SURVEY.md §1): where the
+ * reference is a kernel driver reached through /dev/nvidiactl ioctls, the TPU
+ * runtime is a user-level library (TPU devices are driven from userspace via
+ * libtpu/vfio), exposing
+ *
+ *   1. the same escape ABI (tpurm_open/tpurm_ioctl emulate the char-dev
+ *      surface; an LD_PRELOAD shim maps real open()/ioctl() onto these so
+ *      reference binaries run unchanged),
+ *   2. a direct C API for in-process clients (the Python runtime binds this
+ *      via ctypes),
+ *   3. the DMA-channel engine (channel/pushbuffer/tracker trio, reference:
+ *      kernel-open/nvidia-uvm/uvm_channel.h:33-47, uvm_pushbuffer.h:33-90)
+ *      used by the CXL path here and the UVM migration engine on top.
+ *
+ * Device model: enumerated TPU devices each own an HBM arena.  With no real
+ * TPU attached the arena is host memory (the fake-device backend SURVEY.md §4
+ * calls for); with a real TPU the arena is a window registered by the Python
+ * runtime (JAX owns the true HBM allocator).
+ */
+#ifndef TPURM_TPURM_H
+#define TPURM_TPURM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "abi.h"
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ------------------------------------------------------- escape surface */
+
+/* Returns a pseudo-fd (>= 0) or -1 with errno set.  Recognized paths:
+ * "/dev/nvidiactl", "/dev/tpuctl" (control node); "/dev/nvidia0",
+ * "/dev/accel/tpu0" etc (per-device nodes). */
+int tpurm_open(const char *path);
+int tpurm_close(int pfd);
+/* Emulates ioctl(2) on a pseudo-fd: returns 0 on success (RM status is in
+ * the param block), -1 with errno on transport errors. */
+int tpurm_ioctl(int pfd, unsigned long request, void *argp);
+
+/* ------------------------------------------------------- direct C API */
+
+TpuStatus tpurmAlloc(TpuRmAllocParams *p);
+TpuStatus tpurmControl(TpuRmControlParams *p);
+TpuStatus tpurmFree(TpuRmFreeParams *p);
+
+/* --------------------------------------------------------- device model */
+
+typedef struct TpurmDevice TpurmDevice;
+
+uint32_t      tpurmDeviceCount(void);
+TpurmDevice  *tpurmDeviceGet(uint32_t inst);
+/* The device's HBM arena (fake-device backend: host memory). */
+void         *tpurmDeviceHbmBase(TpurmDevice *dev);
+uint64_t      tpurmDeviceHbmSize(TpurmDevice *dev);
+/* Mark the device lost (error-injection surface; reference:
+ * PDB_PROP_GPU_IS_LOST checked in p2p_cxl.c:594). */
+void          tpurmDeviceSetLost(TpurmDevice *dev, int lost);
+
+/* -------------------------------------------------------- DMA channels */
+
+typedef struct TpurmChannel TpurmChannel;
+
+/* Copy-engine type tags (channel pools per CE type in the reference). */
+typedef enum {
+    TPURM_CE_HOST_TO_DEV = 0,
+    TPURM_CE_DEV_TO_HOST = 1,
+    TPURM_CE_DEV_TO_DEV  = 2,
+    TPURM_CE_ANY         = 3,
+} TpurmCeType;
+
+TpurmChannel *tpurmChannelCreate(TpurmDevice *dev, TpurmCeType ce,
+                                 uint32_t ring_entries /* 0 = registry */);
+void          tpurmChannelDestroy(TpurmChannel *ch);
+
+/* Submit an async copy; returns the tracker value that completes it, or 0
+ * on failure (ring full is back-pressured internally, not an error). */
+uint64_t      tpurmChannelPushCopy(TpurmChannel *ch, void *dst,
+                                   const void *src, uint64_t bytes);
+/* Tracker semantics (reference: uvm_tracker.c): wait until the channel's
+ * completed value >= value. */
+TpuStatus     tpurmChannelWait(TpurmChannel *ch, uint64_t value);
+uint64_t      tpurmChannelCompletedValue(TpurmChannel *ch);
+/* Fault injection: force the next push to fail (reference: UVM error
+ * injection ioctls, uvm_test.c:286,308). */
+void          tpurmChannelInjectError(TpurmChannel *ch);
+
+/* --------------------------------------------------------- diagnostics */
+
+/* Journal ring dump into caller buffer; returns bytes written. */
+size_t tpurmJournalDump(char *buf, size_t bufSize);
+/* Monotonic named counter read (pinned bytes, pushes, copies...). */
+uint64_t tpurmCounterGet(const char *name);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_TPURM_H */

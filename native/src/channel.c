@@ -1,0 +1,254 @@
+/*
+ * DMA channels: the submission/completion engine.
+ *
+ * Re-design of the reference's UVM channel/pushbuffer/tracker trio
+ * (reference: kernel-open/nvidia-uvm/uvm_channel.c — GPFIFO ring + tracking
+ * semaphore per channel, uvm_channel.h:33-49 with 1,024-entry default;
+ * uvm_push.c; uvm_tracker.c).  TPU-native shape: the "copy engine" behind a
+ * channel is a worker thread doing memcpy for the fake-device/host tiers —
+ * real HBM traffic is submitted by the Python runtime through XLA, which
+ * plays the role the GSP-owned CE plays in the reference (SURVEY.md §1
+ * layer map: libtpu/XLA ≈ firmware).
+ *
+ * Semantics preserved from the reference:
+ *   - fixed-depth ring with blocking back-pressure when full,
+ *   - a monotonically increasing tracker value per channel; a push's
+ *     completion is "completed value >= push value" (uvm_gpu_semaphore.c),
+ *   - channel error latches and fails subsequent waits (robust-channel
+ *     recovery surface, SURVEY.md §5),
+ *   - error injection for tests (uvm_test.c error-injection ioctls).
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    void *dst;
+    const void *src;
+    uint64_t bytes;
+    uint64_t trackerValue;
+    bool injectError;
+} PushEntry;
+
+struct TpurmChannel {
+    TpurmDevice *dev;
+    TpurmCeType ce;
+    uint32_t entries;
+    PushEntry *ring;
+    uint64_t put;              /* producer index (monotonic) */
+    uint64_t get;              /* consumer index (monotonic) */
+    uint64_t submittedValue;   /* last tracker value handed out */
+    uint64_t completedValue;   /* tracker semaphore */
+    bool stop;
+    bool injectNext;
+    bool error;                /* latched channel error */
+    pthread_mutex_t lock;
+    pthread_cond_t cond;       /* any state change */
+    pthread_t worker;
+};
+
+static void *channel_worker(void *arg)
+{
+    TpurmChannel *ch = arg;
+
+    pthread_mutex_lock(&ch->lock);
+    for (;;) {
+        while (!ch->stop && ch->get == ch->put)
+            pthread_cond_wait(&ch->cond, &ch->lock);
+        if (ch->stop)
+            break;
+
+        PushEntry entry = ch->ring[ch->get % ch->entries];
+        pthread_mutex_unlock(&ch->lock);
+
+        bool failed = entry.injectError;
+        if (!failed && entry.bytes > 0)
+            memmove(entry.dst, entry.src, entry.bytes);
+
+        pthread_mutex_lock(&ch->lock);
+        ch->get++;
+        ch->completedValue = entry.trackerValue;
+        if (failed) {
+            ch->error = true;
+            tpuLog(TPU_LOG_ERROR, "channel",
+                   "injected CE fault at tracker value %llu",
+                   (unsigned long long)entry.trackerValue);
+        }
+        tpuCounterAdd("channel_copies_completed", 1);
+        tpuCounterAdd("channel_bytes_copied", failed ? 0 : entry.bytes);
+        pthread_cond_broadcast(&ch->cond);
+    }
+    pthread_mutex_unlock(&ch->lock);
+    return NULL;
+}
+
+TpurmChannel *tpurmChannelCreate(TpurmDevice *dev, TpurmCeType ce,
+                                 uint32_t ring_entries)
+{
+    if (ring_entries == 0)
+        ring_entries = (uint32_t)tpuRegistryGet("channel_num_gpfifo_entries",
+                                                1024);
+    /* Reference bounds: min 32, max 1M (uvm_channel.h:49-51). */
+    if (ring_entries < 32)
+        ring_entries = 32;
+    if (ring_entries > (1u << 20))
+        ring_entries = 1u << 20;
+
+    TpurmChannel *ch = calloc(1, sizeof(*ch));
+    if (!ch)
+        return NULL;
+    ch->ring = calloc(ring_entries, sizeof(PushEntry));
+    if (!ch->ring) {
+        free(ch);
+        return NULL;
+    }
+    ch->dev = dev;
+    ch->ce = ce;
+    ch->entries = ring_entries;
+    pthread_mutex_init(&ch->lock, NULL);
+    pthread_cond_init(&ch->cond, NULL);
+    if (pthread_create(&ch->worker, NULL, channel_worker, ch) != 0) {
+        free(ch->ring);
+        free(ch);
+        return NULL;
+    }
+    return ch;
+}
+
+void tpurmChannelDestroy(TpurmChannel *ch)
+{
+    if (!ch)
+        return;
+    pthread_mutex_lock(&ch->lock);
+    ch->stop = true;
+    pthread_cond_broadcast(&ch->cond);
+    pthread_mutex_unlock(&ch->lock);
+    pthread_join(ch->worker, NULL);
+    pthread_cond_destroy(&ch->cond);
+    pthread_mutex_destroy(&ch->lock);
+    free(ch->ring);
+    free(ch);
+}
+
+uint64_t tpurmChannelPushCopy(TpurmChannel *ch, void *dst, const void *src,
+                              uint64_t bytes)
+{
+    if (!ch || (!dst && bytes) || (!src && bytes))
+        return 0;
+
+    pthread_mutex_lock(&ch->lock);
+    tpuLockTrackAcquire(TPU_LOCK_CHANNEL, "channel");
+    /* Back-pressure: block while the GPFIFO ring is full (the reference
+     * spins/waits for ring space in uvm_channel_reserve). */
+    while (!ch->stop && ch->put - ch->get >= ch->entries)
+        pthread_cond_wait(&ch->cond, &ch->lock);
+    if (ch->stop) {
+        tpuLockTrackRelease(TPU_LOCK_CHANNEL, "channel");
+        pthread_mutex_unlock(&ch->lock);
+        return 0;
+    }
+
+    PushEntry *entry = &ch->ring[ch->put % ch->entries];
+    entry->dst = dst;
+    entry->src = src;
+    entry->bytes = bytes;
+    entry->trackerValue = ++ch->submittedValue;
+    entry->injectError = ch->injectNext;
+    ch->injectNext = false;
+    ch->put++;
+    uint64_t value = entry->trackerValue;
+    tpuCounterAdd("channel_pushes", 1);
+    pthread_cond_broadcast(&ch->cond);
+    tpuLockTrackRelease(TPU_LOCK_CHANNEL, "channel");
+    pthread_mutex_unlock(&ch->lock);
+    return value;
+}
+
+TpuStatus tpurmChannelWait(TpurmChannel *ch, uint64_t value)
+{
+    if (!ch)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&ch->lock);
+    while (!ch->stop && ch->completedValue < value && !ch->error)
+        pthread_cond_wait(&ch->cond, &ch->lock);
+    TpuStatus st = TPU_OK;
+    if (ch->error)
+        st = TPU_ERR_INVALID_STATE;
+    else if (ch->stop && ch->completedValue < value)
+        st = TPU_ERR_INVALID_STATE;
+    pthread_mutex_unlock(&ch->lock);
+    return st;
+}
+
+uint64_t tpurmChannelCompletedValue(TpurmChannel *ch)
+{
+    if (!ch)
+        return 0;
+    pthread_mutex_lock(&ch->lock);
+    uint64_t v = ch->completedValue;
+    pthread_mutex_unlock(&ch->lock);
+    return v;
+}
+
+void tpurmChannelInjectError(TpurmChannel *ch)
+{
+    if (!ch)
+        return;
+    pthread_mutex_lock(&ch->lock);
+    ch->injectNext = true;
+    pthread_mutex_unlock(&ch->lock);
+}
+
+/* ------------------------------------------------------- transfer engine */
+
+TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
+                     TpuMemDesc *src, uint64_t srcOff, uint64_t size,
+                     bool async, uint64_t *outTrackerValue)
+{
+    if (!dev || !dst || !src || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (dstOff + size > dst->size || srcOff + size > src->size)
+        return TPU_ERR_INVALID_LIMIT;
+    if (dev->lost)
+        return TPU_ERR_GPU_IS_LOST;
+
+    TpurmChannel *ch = dev->ce;
+    uint64_t clamp = tpuRegistryGet("ce_copy_clamp_bytes", TPU_CE_COPY_CLAMP);
+    uint64_t remaining = size;
+    uint64_t lastValue = 0;
+
+    /* Contiguity-split loop (reference: ce_utils.c:646-661): each push
+     * covers the largest run contiguous in BOTH surfaces, clamped. */
+    while (remaining > 0) {
+        void *dptr, *sptr;
+        uint64_t drun, srun;
+        TpuStatus st = tpuMemdescResolve(dst, dev, dstOff, &dptr, &drun);
+        if (st != TPU_OK)
+            return st;
+        st = tpuMemdescResolve(src, dev, srcOff, &sptr, &srun);
+        if (st != TPU_OK)
+            return st;
+        uint64_t len = remaining;
+        if (len > drun)
+            len = drun;
+        if (len > srun)
+            len = srun;
+        if (len > clamp)
+            len = clamp;
+        uint64_t value = tpurmChannelPushCopy(ch, dptr, sptr, len);
+        if (value == 0)
+            return TPU_ERR_INVALID_STATE;
+        lastValue = value;
+        dstOff += len;
+        srcOff += len;
+        remaining -= len;
+    }
+
+    if (outTrackerValue)
+        *outTrackerValue = lastValue;
+    if (async)
+        return TPU_OK;
+    return tpurmChannelWait(ch, lastValue);
+}

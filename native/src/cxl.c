@@ -1,0 +1,361 @@
+/*
+ * CXL.mem tier: device enumeration, buffer registration/pinning, P2P DMA.
+ *
+ * Re-design of the fork's CXL stack (SURVEY.md §2.1):
+ *   - enumeration by PCI class 0x0502 + link-speed version heuristic
+ *     (reference: kernel-open/nvidia/nv-p2p.c:1556-1609),
+ *   - buffer registry with 256-buffer/1 TB limits, pinned-bytes accounting
+ *     under its own lock (reference: p2p_cxl.c:137,140; nv-p2p.c
+ *     cxl_check_pin_limits:1102, cxl_track_pin:1114),
+ *   - 2 MB huge-page path when base+size are 2 MB aligned, else 4 K
+ *     (reference: p2p_cxl.c:150,283-335),
+ *   - persistent memdesc built on first DMA use (_cxlP2PCreateMemDesc:167),
+ *   - DMA request = throwaway HBM memdesc at the device offset + transfer
+ *     engine copy with the 4 GB clamp (p2p_cxl.c:517-678).
+ *
+ * Userspace pinning: the kernel reference pins with pin_user_pages; the
+ * user-level TPU runtime pins with mlock(2) — best-effort (RLIMIT_MEMLOCK
+ * may cap it), tracked identically.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+
+#include <dirent.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+
+struct TpuCxlBuffer {
+    bool registered;
+    uint16_t generation;
+    uint64_t baseAddress;
+    uint64_t size;
+    uint32_t cxlVersion;
+    uint64_t pageSize;
+    bool hugePages;
+    bool mlocked;
+    TpuMemDesc *memdesc;       /* persistent, built on first DMA */
+    uint32_t activeDma;        /* in-flight synchronous DMA sections */
+    uint64_t pendingTracker;   /* max async tracker value submitted */
+    TpurmDevice *pendingDev;   /* device owning pendingTracker's channel */
+};
+
+static struct {
+    pthread_mutex_t lock;
+    struct TpuCxlBuffer buffers[TPU_CXL_MAX_BUFFERS];
+    uint32_t count;
+    uint64_t pinnedBytes;
+} g_cxl = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+/* Handle encoding: (generation << 16) | (slot + 1), kept in the low 32 bits
+ * so truncating userspace still round-trips.  Never 0. */
+static uint64_t handle_make(uint32_t slot, uint16_t gen)
+{
+    return ((uint64_t)gen << 16) | (slot + 1);
+}
+
+static struct TpuCxlBuffer *handle_lookup(uint64_t handle, uint32_t *outSlot)
+{
+    uint32_t slot = (uint32_t)(handle & 0xffff);
+    uint16_t gen = (uint16_t)((handle >> 16) & 0xffff);
+    if (slot == 0 || slot > TPU_CXL_MAX_BUFFERS)
+        return NULL;
+    struct TpuCxlBuffer *buf = &g_cxl.buffers[slot - 1];
+    if (!buf->registered || buf->generation != gen)
+        return NULL;
+    if (outSlot)
+        *outSlot = slot - 1;
+    return buf;
+}
+
+/* ------------------------------------------------------------ enumeration */
+
+/* PCI class scan for CXL devices (class 0x0502: CXL memory device).
+ * Reference heuristic: PCIe Gen5 link -> CXL 2.0, Gen4 -> CXL 1.x
+ * (nv-p2p.c:1592-1597). */
+TpuStatus tpuCxlSystemInfo(uint32_t *numDevices, uint32_t *numMemDevices,
+                           bool *linkUp, uint32_t *cxlVersion)
+{
+    uint32_t devices = 0, memDevices = 0, version = 2;
+
+    uint64_t fake = tpuRegistryGet("fake_cxl_devices", 0);
+    if (fake > 0) {
+        devices = memDevices = (uint32_t)fake;
+        version = (uint32_t)tpuRegistryGet("fake_cxl_version", 2);
+    } else {
+        DIR *dir = opendir("/sys/bus/pci/devices");
+        if (dir) {
+            struct dirent *de;
+            while ((de = readdir(dir)) != NULL) {
+                if (de->d_name[0] == '.')
+                    continue;
+                char path[300];
+                snprintf(path, sizeof(path),
+                         "/sys/bus/pci/devices/%s/class", de->d_name);
+                FILE *f = fopen(path, "r");
+                if (!f)
+                    continue;
+                unsigned int cls = 0;
+                if (fscanf(f, "%x", &cls) == 1 && (cls >> 8) == 0x0502) {
+                    devices++;
+                    memDevices++;
+                    snprintf(path, sizeof(path),
+                             "/sys/bus/pci/devices/%s/current_link_speed",
+                             de->d_name);
+                    FILE *ls = fopen(path, "r");
+                    if (ls) {
+                        float gts = 0;
+                        if (fscanf(ls, "%f", &gts) == 1)
+                            version = gts >= 32.0f ? 2 : 1;
+                        fclose(ls);
+                    }
+                }
+                fclose(f);
+            }
+            closedir(dir);
+        }
+    }
+
+    if (numDevices)
+        *numDevices = devices;
+    if (numMemDevices)
+        *numMemDevices = memDevices;
+    if (linkUp)
+        *linkUp = devices > 0;
+    if (cxlVersion)
+        *cxlVersion = version;
+    return TPU_OK;
+}
+
+/* ------------------------------------------------------------- register */
+
+static bool can_use_huge_pages(uint64_t base, uint64_t size)
+{
+    return (base & (TPU_CXL_PAGE_SIZE_2M - 1)) == 0 &&
+           (size & (TPU_CXL_PAGE_SIZE_2M - 1)) == 0 &&
+           size >= TPU_CXL_PAGE_SIZE_2M;
+}
+
+TpuStatus tpuCxlRegister(uint64_t baseAddress, uint64_t size,
+                         uint32_t cxlVersion, uint64_t *outHandle)
+{
+    if (baseAddress == 0 || size == 0 || outHandle == NULL ||
+        cxlVersion < 1 || cxlVersion > 3)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (size > TPU_CXL_MAX_BUFFER_BYTES)
+        return TPU_ERR_INVALID_LIMIT;
+
+    uint64_t pageSize = can_use_huge_pages(baseAddress, size)
+                            ? TPU_CXL_PAGE_SIZE_2M : TPU_CXL_PAGE_SIZE_4K;
+    uint64_t pageCount = (size + pageSize - 1) / pageSize;
+    if (pageCount > TPU_CXL_MAX_PIN_PAGES)
+        return TPU_ERR_INVALID_LIMIT;
+
+    pthread_mutex_lock(&g_cxl.lock);
+    tpuLockTrackAcquire(TPU_LOCK_CXL, "cxl");
+
+    if (g_cxl.count >= TPU_CXL_MAX_BUFFERS) {
+        tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
+        pthread_mutex_unlock(&g_cxl.lock);
+        return TPU_ERR_INSUFFICIENT_RESOURCES;
+    }
+    uint64_t pinLimit = tpuRegistryGet("pin_limit_mb", 1ull << 30) << 20;
+    if (g_cxl.pinnedBytes + size > pinLimit) {
+        tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
+        pthread_mutex_unlock(&g_cxl.lock);
+        tpuLog(TPU_LOG_ERROR, "cxl",
+               "pin limit exceeded: %llu + %llu > %llu",
+               (unsigned long long)g_cxl.pinnedBytes,
+               (unsigned long long)size, (unsigned long long)pinLimit);
+        return TPU_ERR_INSUFFICIENT_RESOURCES;
+    }
+
+    uint32_t slot;
+    for (slot = 0; slot < TPU_CXL_MAX_BUFFERS; slot++)
+        if (!g_cxl.buffers[slot].registered)
+            break;
+
+    struct TpuCxlBuffer *buf = &g_cxl.buffers[slot];
+    buf->registered = true;
+    buf->generation++;
+    buf->baseAddress = baseAddress;
+    buf->size = size;
+    buf->cxlVersion = cxlVersion;
+    buf->pageSize = pageSize;
+    buf->hugePages = pageSize == TPU_CXL_PAGE_SIZE_2M;
+    buf->memdesc = NULL;
+    /* Pin: mlock is best-effort in userspace (RLIMIT_MEMLOCK); failure is
+     * logged, accounting proceeds — matching the reference test's tolerant
+     * mlock handling, while kernel-grade pinning stays a deploy concern. */
+    buf->mlocked = mlock((void *)(uintptr_t)baseAddress, size) == 0;
+    if (!buf->mlocked)
+        tpuLog(TPU_LOG_WARN, "cxl", "mlock failed for %llu bytes (RLIMIT?)",
+               (unsigned long long)size);
+    g_cxl.count++;
+    g_cxl.pinnedBytes += size;
+    tpuCounterAdd("cxl_buffers_registered", 1);
+
+    *outHandle = handle_make(slot, buf->generation);
+    tpuLog(TPU_LOG_INFO, "cxl",
+           "registered buffer slot=%u base=0x%llx size=0x%llx pages=%s",
+           slot, (unsigned long long)baseAddress, (unsigned long long)size,
+           buf->hugePages ? "2M" : "4K");
+
+    tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
+    pthread_mutex_unlock(&g_cxl.lock);
+    return TPU_OK;
+}
+
+TpuStatus tpuCxlUnregister(uint64_t handle)
+{
+    if (handle == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_cxl.lock);
+    tpuLockTrackAcquire(TPU_LOCK_CXL, "cxl");
+    struct TpuCxlBuffer *buf = handle_lookup(handle, NULL);
+    if (!buf) {
+        tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
+        pthread_mutex_unlock(&g_cxl.lock);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    if (buf->activeDma > 0) {
+        /* A DMA section holds a reference outside the lock; refuse rather
+         * than free under it (reference frees are likewise refused while
+         * mappings are live). */
+        tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
+        pthread_mutex_unlock(&g_cxl.lock);
+        return TPU_ERR_STATE_IN_USE;
+    }
+    if (buf->pendingTracker && buf->pendingDev && buf->pendingDev->ce) {
+        /* Quiesce async submissions before teardown: the channel is FIFO,
+         * so completion of the max tracker value retires every copy that
+         * still reads/writes this buffer. */
+        tpurmChannelWait(buf->pendingDev->ce, buf->pendingTracker);
+        buf->pendingTracker = 0;
+    }
+    if (buf->mlocked)
+        munlock((void *)(uintptr_t)buf->baseAddress, buf->size);
+    tpuMemdescDestroy(buf->memdesc);
+    buf->memdesc = NULL;
+    buf->registered = false;
+    g_cxl.count--;
+    g_cxl.pinnedBytes -= buf->size;
+    tpuCounterAdd("cxl_buffers_unregistered", 1);
+    tpuLog(TPU_LOG_INFO, "cxl", "unregistered buffer handle=0x%llx",
+           (unsigned long long)handle);
+    tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
+    pthread_mutex_unlock(&g_cxl.lock);
+    return TPU_OK;
+}
+
+uint32_t tpuCxlRegisteredCount(void)
+{
+    pthread_mutex_lock(&g_cxl.lock);
+    uint32_t n = g_cxl.count;
+    pthread_mutex_unlock(&g_cxl.lock);
+    return n;
+}
+
+uint64_t tpuCxlPinnedBytes(void)
+{
+    pthread_mutex_lock(&g_cxl.lock);
+    uint64_t n = g_cxl.pinnedBytes;
+    pthread_mutex_unlock(&g_cxl.lock);
+    return n;
+}
+
+/* ---------------------------------------------------------------- DMA */
+
+TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
+                           uint64_t gpuOffset, uint64_t cxlOffset,
+                           uint64_t size, uint32_t flags,
+                           uint32_t *outTransferId)
+{
+    if (!dev)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (handle == 0 || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (dev->lost)
+        return TPU_ERR_GPU_IS_LOST;
+
+    bool cxlToDev = (flags & TPU_CXL_DMA_FLAG_CXL_TO_DEV) != 0;
+    bool async = (flags & TPU_CXL_DMA_FLAG_ASYNC) != 0;
+
+    pthread_mutex_lock(&g_cxl.lock);
+    tpuLockTrackAcquire(TPU_LOCK_CXL, "cxl");
+    struct TpuCxlBuffer *buf = handle_lookup(handle, NULL);
+    TpuStatus st = TPU_OK;
+    TpuMemDesc *cxlMd = NULL;
+
+    if (!buf) {
+        st = TPU_ERR_OBJECT_NOT_FOUND;
+    } else if (cxlOffset > buf->size || size > buf->size - cxlOffset) {
+        st = TPU_ERR_INVALID_ARGUMENT;  /* OOB (p2p_cxl.c:563) */
+    } else {
+        if (buf->memdesc == NULL) {
+            /* Persistent memdesc on first use (_cxlP2PCreateMemDesc). */
+            st = tpuMemdescCreateContig(&buf->memdesc, TPU_APERTURE_CXL,
+                                        buf->baseAddress, buf->size,
+                                        buf->pageSize);
+        }
+        cxlMd = buf->memdesc;
+        if (st == TPU_OK)
+            buf->activeDma++;   /* blocks unregister while we copy */
+    }
+    tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
+    pthread_mutex_unlock(&g_cxl.lock);
+    if (st != TPU_OK)
+        return st;
+
+    /* Clamp single request to 4 GB (p2p_cxl.c:617-621). */
+    uint64_t transferSize = size;
+    if (transferSize > 0xFFFFFFFFull) {
+        tpuLog(TPU_LOG_WARN, "cxl", "clamping transfer 0x%llx -> 4GB",
+               (unsigned long long)transferSize);
+        transferSize = TPU_CE_COPY_CLAMP;
+    }
+
+    uint64_t hbmSize = tpurmDeviceHbmSize(dev);
+    uint64_t tracker = 0;
+    TpuMemDesc *devMd = NULL;
+    /* Overflow-safe bounds check (a wrapped gpuOffset must not pass). */
+    if (transferSize > hbmSize || gpuOffset > hbmSize - transferSize) {
+        st = TPU_ERR_INVALID_LIMIT;
+    } else {
+        /* Throwaway device-side memdesc describing HBM at gpuOffset
+         * (memdescCreate+memdescDescribe analog). */
+        st = tpuMemdescCreateContig(&devMd, TPU_APERTURE_HBM, gpuOffset,
+                                    transferSize, 0);
+    }
+    if (st == TPU_OK) {
+        if (cxlToDev)
+            st = tpuMemCopy(dev, devMd, 0, cxlMd, cxlOffset, transferSize,
+                            async, &tracker);
+        else
+            st = tpuMemCopy(dev, cxlMd, cxlOffset, devMd, 0, transferSize,
+                            async, &tracker);
+        tpuMemdescDestroy(devMd);
+    }
+
+    /* Drop the DMA reference; async submissions leave a pending tracker so
+     * unregister can quiesce the channel before teardown. */
+    pthread_mutex_lock(&g_cxl.lock);
+    buf->activeDma--;
+    if (st == TPU_OK && async && tracker > buf->pendingTracker) {
+        buf->pendingTracker = tracker;
+        buf->pendingDev = dev;
+    }
+    pthread_mutex_unlock(&g_cxl.lock);
+
+    if (st != TPU_OK) {
+        tpuLog(TPU_LOG_ERROR, "cxl", "DMA %s failed: %s",
+               cxlToDev ? "CXL->DEV" : "DEV->CXL", tpuStatusToString(st));
+        return st;
+    }
+    tpuCounterAdd("cxl_dma_requests", 1);
+    tpuCounterAdd("cxl_dma_bytes", transferSize);
+    if (outTransferId)
+        *outTransferId = async ? (uint32_t)(tracker & 0x7fffffff) | 1u : 1;
+    return TPU_OK;
+}

@@ -1,0 +1,110 @@
+/*
+ * TPU device model.
+ *
+ * The reference enumerates GPUs by PCI probe (kernel-open/nvidia/nv-pci.c)
+ * and each GPU owns its video memory via PMA.  The TPU build has one device
+ * backend: real chips are owned by libtpu/XLA (the Python runtime registers
+ * their HBM windows), and with no chip attached each device carries a host-
+ * memory HBM arena — the fake-device backend SURVEY.md §4 calls for, which
+ * keeps every code path testable host-side.
+ *
+ * Registry knobs: TPUMEM_FAKE_TPU_COUNT (default 1),
+ * TPUMEM_FAKE_HBM_MB (default 128).
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+
+#define MAX_DEVICES 16
+
+/* Probed wire ids: arbitrary stable non-zero values (the reference's GPU ids
+ * are opaque probe cookies; userspace only round-trips them). */
+#define DEV_ID_BASE 0x100u
+
+static struct {
+    pthread_once_t once;
+    TpurmDevice devs[MAX_DEVICES];
+    uint32_t count;
+} g_devices = { .once = PTHREAD_ONCE_INIT };
+
+static void device_init_once(void)
+{
+    uint32_t count = (uint32_t)tpuRegistryGet("fake_tpu_count", 1);
+    if (count > MAX_DEVICES)
+        count = MAX_DEVICES;
+    uint64_t hbmBytes = tpuRegistryGet("fake_hbm_mb", 128) * 1024 * 1024;
+
+    for (uint32_t i = 0; i < count; i++) {
+        TpurmDevice *dev = &g_devices.devs[i];
+        dev->inst = i;
+        dev->devId = DEV_ID_BASE + i;
+        dev->attached = false;
+        dev->lost = false;
+        dev->hbmSize = hbmBytes;
+        dev->hbmBase = mmap(NULL, hbmBytes, PROT_READ | PROT_WRITE,
+                            MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+        if (dev->hbmBase == MAP_FAILED) {
+            tpuLog(TPU_LOG_ERROR, "device",
+                   "HBM arena mmap failed for dev %u (%llu bytes)", i,
+                   (unsigned long long)hbmBytes);
+            dev->hbmBase = NULL;
+            dev->hbmSize = 0;
+        }
+        dev->ce = tpurmChannelCreate(dev, TPURM_CE_ANY, 0);
+        if (!dev->ce)
+            tpuLog(TPU_LOG_ERROR, "device", "CE channel create failed dev %u", i);
+    }
+    g_devices.count = count;
+    tpuLog(TPU_LOG_INFO, "device", "enumerated %u TPU device(s), %llu MB arena",
+           count, (unsigned long long)(hbmBytes >> 20));
+}
+
+void tpuDeviceGlobalInit(void)
+{
+    pthread_once(&g_devices.once, device_init_once);
+}
+
+uint32_t tpurmDeviceCount(void)
+{
+    tpuDeviceGlobalInit();
+    return g_devices.count;
+}
+
+TpurmDevice *tpurmDeviceGet(uint32_t inst)
+{
+    tpuDeviceGlobalInit();
+    if (inst >= g_devices.count)
+        return NULL;
+    return &g_devices.devs[inst];
+}
+
+TpurmDevice *tpuDeviceByDevId(uint32_t devId)
+{
+    tpuDeviceGlobalInit();
+    for (uint32_t i = 0; i < g_devices.count; i++)
+        if (g_devices.devs[i].devId == devId)
+            return &g_devices.devs[i];
+    return NULL;
+}
+
+void *tpurmDeviceHbmBase(TpurmDevice *dev)
+{
+    return dev ? dev->hbmBase : NULL;
+}
+
+uint64_t tpurmDeviceHbmSize(TpurmDevice *dev)
+{
+    return dev ? dev->hbmSize : 0;
+}
+
+void tpurmDeviceSetLost(TpurmDevice *dev, int lost)
+{
+    if (dev) {
+        dev->lost = (lost != 0);
+        tpuLog(lost ? TPU_LOG_WARN : TPU_LOG_INFO, "device",
+               "device %u marked %s", dev->inst, lost ? "LOST" : "present");
+    }
+}

@@ -1,0 +1,220 @@
+/*
+ * Diagnostics: journal ring, counters, env registry, debug lock-order
+ * tracking.
+ *
+ * TPU-native re-design of the reference's diagnostics layer:
+ *   - journal ring:  src/nvidia/src/kernel/diagnostics/journal.c, nvlog.c
+ *   - counters:      uvm_tools.c counters + /proc/driver/nvidia
+ *   - registry:      arch/nvalloc/unix/src/registry.c, nv-reg.h
+ *   - lock tracking: uvm_thread_context.c per-thread lock bitmaps
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+
+#include <errno.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+/* ---------------------------------------------------------------- journal */
+
+#define JOURNAL_CAP 1024
+#define JOURNAL_MSG 192
+
+typedef struct {
+    uint64_t seq;
+    uint64_t ns;
+    TpuLogLevel level;
+    char subsys[16];
+    char msg[JOURNAL_MSG];
+} JournalRec;
+
+static struct {
+    pthread_mutex_t lock;
+    JournalRec ring[JOURNAL_CAP];
+    uint64_t seq;
+} g_journal = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+static uint64_t now_ns(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
+{
+    va_list ap;
+    char msg[JOURNAL_MSG];
+    JournalRec *rec;
+
+    /* Format outside the lock into a stack buffer; the ring slot may be
+     * rewritten by another producer the moment the lock drops. */
+    va_start(ap, fmt);
+    vsnprintf(msg, sizeof(msg), fmt, ap);
+    va_end(ap);
+
+    pthread_mutex_lock(&g_journal.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "journal");
+    rec = &g_journal.ring[g_journal.seq % JOURNAL_CAP];
+    rec->seq = g_journal.seq++;
+    rec->ns = now_ns();
+    rec->level = level;
+    snprintf(rec->subsys, sizeof(rec->subsys), "%s", subsys);
+    memcpy(rec->msg, msg, sizeof(rec->msg));
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "journal");
+    pthread_mutex_unlock(&g_journal.lock);
+
+    if (level >= TPU_LOG_WARN ||
+        tpuRegistryGet("native_log_stderr", 0) != 0) {
+        static const char *names[] = { "DEBUG", "INFO", "WARN", "ERROR" };
+        fprintf(stderr, "tpurm[%s] %s: %s\n", names[level], subsys, msg);
+    }
+}
+
+size_t tpurmJournalDump(char *buf, size_t bufSize)
+{
+    size_t off = 0;
+    pthread_mutex_lock(&g_journal.lock);
+    uint64_t start = g_journal.seq > JOURNAL_CAP ? g_journal.seq - JOURNAL_CAP : 0;
+    for (uint64_t s = start; s < g_journal.seq && off + 1 < bufSize; s++) {
+        JournalRec *rec = &g_journal.ring[s % JOURNAL_CAP];
+        static const char *names[] = { "DEBUG", "INFO", "WARN", "ERROR" };
+        int n = snprintf(buf + off, bufSize - off, "%llu %s %s: %s\n",
+                         (unsigned long long)rec->seq, names[rec->level],
+                         rec->subsys, rec->msg);
+        if (n < 0)
+            break;
+        off += ((size_t)n < bufSize - off) ? (size_t)n : bufSize - off - 1;
+    }
+    pthread_mutex_unlock(&g_journal.lock);
+    if (bufSize)
+        buf[off < bufSize ? off : bufSize - 1] = '\0';
+    return off;
+}
+
+/* --------------------------------------------------------------- counters */
+
+#define MAX_COUNTERS 64
+
+static struct {
+    pthread_mutex_t lock;
+    struct { char name[48]; uint64_t value; } c[MAX_COUNTERS];
+    int n;
+} g_counters = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+void tpuCounterAdd(const char *name, uint64_t delta)
+{
+    pthread_mutex_lock(&g_counters.lock);
+    for (int i = 0; i < g_counters.n; i++) {
+        if (strcmp(g_counters.c[i].name, name) == 0) {
+            g_counters.c[i].value += delta;
+            pthread_mutex_unlock(&g_counters.lock);
+            return;
+        }
+    }
+    if (g_counters.n < MAX_COUNTERS) {
+        snprintf(g_counters.c[g_counters.n].name,
+                 sizeof(g_counters.c[0].name), "%s", name);
+        g_counters.c[g_counters.n].value = delta;
+        g_counters.n++;
+    }
+    pthread_mutex_unlock(&g_counters.lock);
+}
+
+uint64_t tpurmCounterGet(const char *name)
+{
+    uint64_t v = 0;
+    pthread_mutex_lock(&g_counters.lock);
+    for (int i = 0; i < g_counters.n; i++) {
+        if (strcmp(g_counters.c[i].name, name) == 0) {
+            v = g_counters.c[i].value;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_counters.lock);
+    return v;
+}
+
+/* --------------------------------------------------------------- registry */
+
+uint64_t tpuRegistryGet(const char *key, uint64_t defval)
+{
+    char envName[96] = "TPUMEM_";
+    size_t j = strlen(envName);
+    for (size_t i = 0; key[i] && j + 1 < sizeof(envName); i++, j++) {
+        char ch = key[i];
+        envName[j] = (ch >= 'a' && ch <= 'z') ? (char)(ch - 'a' + 'A') : ch;
+    }
+    envName[j] = '\0';
+
+    const char *val = getenv(envName);
+    if (!val || !*val)
+        return defval;
+    errno = 0;
+    char *end = NULL;
+    uint64_t parsed = strtoull(val, &end, 0);
+    if (errno != 0 || end == val)
+        return defval;
+    return parsed;
+}
+
+/* ----------------------------------------------------- lock-order tracker */
+
+#ifdef TPURM_DEBUG_LOCKS
+static __thread struct { int order; const char *name; } t_held[16];
+static __thread int t_depth;
+
+void tpuLockTrackAcquire(int order, const char *name)
+{
+    if (t_depth > 0 && t_held[t_depth - 1].order > order) {
+        fprintf(stderr,
+                "tpurm FATAL: lock order violation: %s(%d) after %s(%d)\n",
+                name, order, t_held[t_depth - 1].name,
+                t_held[t_depth - 1].order);
+        abort();
+    }
+    if (t_depth < (int)(sizeof(t_held) / sizeof(t_held[0]))) {
+        t_held[t_depth].order = order;
+        t_held[t_depth].name = name;
+        t_depth++;
+    }
+}
+
+void tpuLockTrackRelease(int order, const char *name)
+{
+    (void)order;
+    (void)name;
+    if (t_depth > 0)
+        t_depth--;
+}
+#else
+void tpuLockTrackAcquire(int order, const char *name) { (void)order; (void)name; }
+void tpuLockTrackRelease(int order, const char *name) { (void)order; (void)name; }
+#endif
+
+const char *tpuStatusToString(TpuStatus status)
+{
+    switch (status) {
+    case TPU_OK:                         return "OK";
+    case TPU_ERR_GPU_IS_LOST:            return "DEVICE_LOST";
+    case TPU_ERR_INSERT_DUPLICATE_NAME:  return "DUPLICATE_HANDLE";
+    case TPU_ERR_INSUFFICIENT_RESOURCES: return "INSUFFICIENT_RESOURCES";
+    case TPU_ERR_INVALID_ARGUMENT:       return "INVALID_ARGUMENT";
+    case TPU_ERR_INVALID_CLIENT:         return "INVALID_CLIENT";
+    case TPU_ERR_INVALID_COMMAND:        return "INVALID_COMMAND";
+    case TPU_ERR_INVALID_DEVICE:         return "INVALID_DEVICE";
+    case TPU_ERR_INVALID_LIMIT:          return "INVALID_LIMIT";
+    case TPU_ERR_INVALID_OBJECT_HANDLE:  return "INVALID_OBJECT_HANDLE";
+    case TPU_ERR_INVALID_OBJECT_PARENT:  return "INVALID_OBJECT_PARENT";
+    case TPU_ERR_INVALID_STATE:          return "INVALID_STATE";
+    case TPU_ERR_NO_MEMORY:              return "NO_MEMORY";
+    case TPU_ERR_NOT_SUPPORTED:          return "NOT_SUPPORTED";
+    case TPU_ERR_OBJECT_NOT_FOUND:       return "OBJECT_NOT_FOUND";
+    case TPU_ERR_OPERATING_SYSTEM:       return "OPERATING_SYSTEM";
+    case TPU_ERR_STATE_IN_USE:           return "STATE_IN_USE";
+    default:                             return "UNKNOWN";
+    }
+}

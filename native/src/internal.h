@@ -1,0 +1,129 @@
+/*
+ * tpurm internals.  Not installed; the public surface is include/tpurm/.
+ *
+ * Locking order (reference pattern: uvm_lock.h:31+ — order documented as
+ * data, asserted at runtime in debug builds via tpuLockTrack*):
+ *   1. g_rm.lock        (object model / attach state)
+ *   2. cxl table lock
+ *   3. pin accounting lock
+ *   4. per-channel lock
+ *   5. journal/counters
+ */
+#ifndef TPURM_INTERNAL_H
+#define TPURM_INTERNAL_H
+
+#include <pthread.h>
+#include <stdbool.h>
+#include <stdint.h>
+
+#include "tpurm/abi.h"
+#include "tpurm/status.h"
+#include "tpurm/tpurm.h"
+
+/* ------------------------------------------------------------- lock order */
+
+enum tpu_lock_order {
+    TPU_LOCK_RM = 1,
+    TPU_LOCK_CXL = 2,
+    TPU_LOCK_PIN = 3,
+    TPU_LOCK_CHANNEL = 4,
+    TPU_LOCK_DIAG = 5,
+};
+
+/* Debug lock-order tracker (no-ops in release builds). */
+void tpuLockTrackAcquire(int order, const char *name);
+void tpuLockTrackRelease(int order, const char *name);
+
+/* ---------------------------------------------------------------- journal */
+
+typedef enum {
+    TPU_LOG_DEBUG = 0,
+    TPU_LOG_INFO = 1,
+    TPU_LOG_WARN = 2,
+    TPU_LOG_ERROR = 3,
+} TpuLogLevel;
+
+void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
+    __attribute__((format(printf, 3, 4)));
+void tpuCounterAdd(const char *name, uint64_t delta);
+
+/* --------------------------------------------------------------- registry */
+
+/* Env-backed config: TPUMEM_<KEY> (decimal or 0x hex), else default. */
+uint64_t tpuRegistryGet(const char *key, uint64_t defval);
+
+/* ---------------------------------------------------------------- memdesc */
+
+typedef enum {
+    TPU_APERTURE_SYSMEM = 0,   /* host memory                        */
+    TPU_APERTURE_HBM = 1,      /* device HBM arena                   */
+    TPU_APERTURE_CXL = 2,      /* pinned CXL-tier memory             */
+} TpuAperture;
+
+/* Physical-layout descriptor (reference: MEMORY_DESCRIPTOR, mem_desc.c).
+ * Pages are (addr,len)-coalesced extents so the copy loop iterates extents
+ * exactly like ce_utils.c:646-661 iterates contiguous runs. */
+typedef struct TpuMemDesc {
+    TpuAperture aperture;
+    uint64_t size;
+    uint64_t pageSize;         /* 4K or 2M */
+    uint32_t extentCount;
+    struct { uint64_t base; uint64_t len; } *extents;
+    bool contiguous;
+} TpuMemDesc;
+
+TpuStatus tpuMemdescCreateContig(TpuMemDesc **out, TpuAperture ap,
+                                 uint64_t base, uint64_t size,
+                                 uint64_t pageSize);
+TpuStatus tpuMemdescCreatePages(TpuMemDesc **out, TpuAperture ap,
+                                const uint64_t *pageAddrs, uint32_t pageCount,
+                                uint64_t pageSize);
+void      tpuMemdescDestroy(TpuMemDesc *md);
+/* Resolve an offset into (host pointer, run length) given the device whose
+ * HBM arena backs TPU_APERTURE_HBM. */
+TpuStatus tpuMemdescResolve(const TpuMemDesc *md, TpurmDevice *dev,
+                            uint64_t offset, void **ptr, uint64_t *runLen);
+
+/* ----------------------------------------------------------------- device */
+
+struct TpurmDevice {
+    uint32_t inst;             /* device instance (0..n-1)      */
+    uint32_t devId;            /* probed id on the wire         */
+    bool attached;
+    bool lost;
+    void *hbmBase;
+    uint64_t hbmSize;
+    TpurmChannel *ce;          /* shared copy engine channel    */
+};
+
+void tpuDeviceGlobalInit(void);     /* idempotent */
+TpurmDevice *tpuDeviceByDevId(uint32_t devId);
+
+/* -------------------------------------------------------------------- cxl */
+
+typedef struct TpuCxlBuffer TpuCxlBuffer;
+
+TpuStatus tpuCxlSystemInfo(uint32_t *numDevices, uint32_t *numMemDevices,
+                           bool *linkUp, uint32_t *cxlVersion);
+TpuStatus tpuCxlRegister(uint64_t baseAddress, uint64_t size,
+                         uint32_t cxlVersion, uint64_t *outHandle);
+TpuStatus tpuCxlUnregister(uint64_t handle);
+TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
+                           uint64_t gpuOffset, uint64_t cxlOffset,
+                           uint64_t size, uint32_t flags,
+                           uint32_t *outTransferId);
+/* Test/introspection surface. */
+uint32_t  tpuCxlRegisteredCount(void);
+uint64_t  tpuCxlPinnedBytes(void);
+
+/* -------------------------------------------------------------- transfer  */
+
+/* memmgrMemCopy analog: copy between two memdescs through the device's CE
+ * channel, splitting per contiguous extent and clamping each submission
+ * (reference: mem_utils.c:567, ce_utils.c:571,646-661; clamp
+ * p2p_cxl.c:617-621). */
+TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
+                     TpuMemDesc *src, uint64_t srcOff, uint64_t size,
+                     bool async, uint64_t *outTrackerValue);
+
+#endif /* TPURM_INTERNAL_H */

@@ -1,0 +1,113 @@
+/*
+ * Memory descriptors: physical-layout objects the transfer engine consumes.
+ *
+ * Re-design of the reference's MEMORY_DESCRIPTOR (reference: src/nvidia/src/
+ * kernel/gpu/mem_mgr/mem_desc.c — memdescCreate/memdescDescribe/
+ * memdescFillPages).  Page arrays are coalesced into contiguous extents at
+ * creation so the copy engine's split loop (ce_utils.c:646-661 analog in
+ * tpuMemCopy) walks extents, not pages.
+ */
+#include "internal.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+TpuStatus tpuMemdescCreateContig(TpuMemDesc **out, TpuAperture ap,
+                                 uint64_t base, uint64_t size,
+                                 uint64_t pageSize)
+{
+    if (!out || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuMemDesc *md = calloc(1, sizeof(*md));
+    if (!md)
+        return TPU_ERR_NO_MEMORY;
+    md->aperture = ap;
+    md->size = size;
+    md->pageSize = pageSize ? pageSize : TPU_CXL_PAGE_SIZE_4K;
+    md->extents = malloc(sizeof(md->extents[0]));
+    if (!md->extents) {
+        free(md);
+        return TPU_ERR_NO_MEMORY;
+    }
+    md->extents[0].base = base;
+    md->extents[0].len = size;
+    md->extentCount = 1;
+    md->contiguous = true;
+    *out = md;
+    return TPU_OK;
+}
+
+TpuStatus tpuMemdescCreatePages(TpuMemDesc **out, TpuAperture ap,
+                                const uint64_t *pageAddrs, uint32_t pageCount,
+                                uint64_t pageSize)
+{
+    if (!out || !pageAddrs || pageCount == 0 || pageSize == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuMemDesc *md = calloc(1, sizeof(*md));
+    if (!md)
+        return TPU_ERR_NO_MEMORY;
+    md->aperture = ap;
+    md->size = (uint64_t)pageCount * pageSize;
+    md->pageSize = pageSize;
+    md->extents = malloc((size_t)pageCount * sizeof(md->extents[0]));
+    if (!md->extents) {
+        free(md);
+        return TPU_ERR_NO_MEMORY;
+    }
+    /* Coalesce physically-adjacent pages into extents. */
+    uint32_t n = 0;
+    for (uint32_t i = 0; i < pageCount; i++) {
+        if (n > 0 &&
+            md->extents[n - 1].base + md->extents[n - 1].len == pageAddrs[i]) {
+            md->extents[n - 1].len += pageSize;
+        } else {
+            md->extents[n].base = pageAddrs[i];
+            md->extents[n].len = pageSize;
+            n++;
+        }
+    }
+    md->extentCount = n;
+    md->contiguous = (n == 1);
+    *out = md;
+    return TPU_OK;
+}
+
+void tpuMemdescDestroy(TpuMemDesc *md)
+{
+    if (!md)
+        return;
+    free(md->extents);
+    free(md);
+}
+
+TpuStatus tpuMemdescResolve(const TpuMemDesc *md, TpurmDevice *dev,
+                            uint64_t offset, void **ptr, uint64_t *runLen)
+{
+    if (!md || !ptr || !runLen || offset >= md->size)
+        return TPU_ERR_INVALID_ARGUMENT;
+
+    uint64_t remaining = offset;
+    for (uint32_t i = 0; i < md->extentCount; i++) {
+        if (remaining < md->extents[i].len) {
+            uint64_t addr = md->extents[i].base + remaining;
+            *runLen = md->extents[i].len - remaining;
+            if (md->aperture == TPU_APERTURE_HBM) {
+                if (!dev)
+                    return TPU_ERR_INVALID_DEVICE;
+                uint64_t hbm = tpurmDeviceHbmSize(dev);
+                /* Overflow-safe: reject past-the-end, truncate overlap. */
+                if (addr >= hbm)
+                    return TPU_ERR_INVALID_LIMIT;
+                if (*runLen > hbm - addr)
+                    *runLen = hbm - addr;
+                *ptr = (char *)tpurmDeviceHbmBase(dev) + addr;
+            } else {
+                /* SYSMEM/CXL extents hold host addresses directly. */
+                *ptr = (void *)(uintptr_t)addr;
+            }
+            return TPU_OK;
+        }
+        remaining -= md->extents[i].len;
+    }
+    return TPU_ERR_INVALID_LIMIT;
+}

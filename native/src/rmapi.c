@@ -1,0 +1,481 @@
+/*
+ * RM API: handle-tree object model + escape (ioctl) dispatch.
+ *
+ * Re-design of the reference's resserv/rmapi stack (SURVEY.md §2.4):
+ * clients → devices → subdevices as a parented handle tree
+ * (src/libraries/resserv/src/rs_server.c, rs_client.c), the
+ * NV_ESC_RM_{ALLOC,CONTROL,FREE} escapes (arch/nvalloc/unix/src/
+ * escape.c:288,376,711), and a flat control-command dispatch in place of
+ * NVOC's 566 kLoC of generated vtables (SURVEY.md §7 step 1: "flat table +
+ * parent links — skip NVOC").
+ *
+ * Control-command semantics follow the reference handlers:
+ *   - NV0000 GPU probe/attach: client_resource.c behavior — probed ids are
+ *     opaque cookies, ATTACH_ALL supported, unknown id reports failedId.
+ *   - NV2080 CXL commands: kern_bus_ctrl.c:745-930 behavior (validation
+ *     order, status codes, output population).
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+
+#include <errno.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define MAX_CLIENTS 64
+#define MAX_PSEUDO_FDS 256
+
+typedef struct RmObject {
+    uint32_t handle;
+    uint32_t hClass;
+    uint32_t hParent;          /* client handle for devices, device handle
+                                * for subdevices, self for the client root */
+    TpurmDevice *dev;          /* resolved device for DEVICE/SUBDEVICE */
+    struct RmObject *next;
+} RmObject;
+
+typedef struct {
+    bool used;
+    uint32_t hClient;
+    RmObject *objects;         /* excludes the root; root is implicit */
+} RmClient;
+
+static struct {
+    pthread_mutex_t lock;
+    RmClient clients[MAX_CLIENTS];
+} g_rm = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+/* ------------------------------------------------------------ pseudo fds */
+
+typedef struct {
+    bool used;
+    bool isControl;
+    uint32_t devInst;
+} PseudoFd;
+
+static struct {
+    pthread_mutex_t lock;
+    PseudoFd fds[MAX_PSEUDO_FDS];
+} g_fds = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+/* Pseudo-fds live far above real fd space so the LD_PRELOAD shim can tell
+ * them apart from kernel fds. */
+#define PSEUDO_FD_BASE 0x40000000
+
+int tpurm_open(const char *path)
+{
+    bool isControl = false;
+    uint32_t devInst = 0;
+
+    if (!path) {
+        errno = EINVAL;
+        return -1;
+    }
+    tpuDeviceGlobalInit();
+
+    if (strcmp(path, "/dev/nvidiactl") == 0 || strcmp(path, "/dev/tpuctl") == 0) {
+        isControl = true;
+    } else if (strncmp(path, "/dev/nvidia", 11) == 0 && path[11] >= '0' &&
+               path[11] <= '9') {
+        devInst = (uint32_t)strtoul(path + 11, NULL, 10);
+    } else if (strncmp(path, "/dev/accel/tpu", 14) == 0) {
+        devInst = (uint32_t)strtoul(path + 14, NULL, 10);
+    } else {
+        errno = ENOENT;
+        return -1;
+    }
+    if (!isControl && tpurmDeviceGet(devInst) == NULL) {
+        errno = ENODEV;
+        return -1;
+    }
+
+    pthread_mutex_lock(&g_fds.lock);
+    for (int i = 0; i < MAX_PSEUDO_FDS; i++) {
+        if (!g_fds.fds[i].used) {
+            g_fds.fds[i].used = true;
+            g_fds.fds[i].isControl = isControl;
+            g_fds.fds[i].devInst = devInst;
+            pthread_mutex_unlock(&g_fds.lock);
+            return PSEUDO_FD_BASE + i;
+        }
+    }
+    pthread_mutex_unlock(&g_fds.lock);
+    errno = EMFILE;
+    return -1;
+}
+
+int tpurm_close(int pfd)
+{
+    int idx = pfd - PSEUDO_FD_BASE;
+    if (idx < 0 || idx >= MAX_PSEUDO_FDS) {
+        errno = EBADF;
+        return -1;
+    }
+    pthread_mutex_lock(&g_fds.lock);
+    bool was = g_fds.fds[idx].used;
+    g_fds.fds[idx].used = false;
+    pthread_mutex_unlock(&g_fds.lock);
+    if (!was) {
+        errno = EBADF;
+        return -1;
+    }
+    return 0;
+}
+
+/* --------------------------------------------------------- handle lookups */
+
+static RmClient *client_find(uint32_t hClient)
+{
+    for (int i = 0; i < MAX_CLIENTS; i++)
+        if (g_rm.clients[i].used && g_rm.clients[i].hClient == hClient)
+            return &g_rm.clients[i];
+    return NULL;
+}
+
+static RmObject *object_find(RmClient *client, uint32_t handle)
+{
+    for (RmObject *o = client->objects; o; o = o->next)
+        if (o->handle == handle)
+            return o;
+    return NULL;
+}
+
+/* Free an object and (recursively) every object parented under it
+ * (resserv frees subtrees on parent free). */
+static void object_free_subtree(RmClient *client, uint32_t handle)
+{
+    RmObject **pp = &client->objects;
+    while (*pp) {
+        RmObject *o = *pp;
+        if (o->hParent == handle && o->handle != handle) {
+            pp = &client->objects;  /* restart: children first */
+            object_free_subtree(client, o->handle);
+            continue;
+        }
+        pp = &o->next;
+    }
+    pp = &client->objects;
+    while (*pp) {
+        if ((*pp)->handle == handle) {
+            RmObject *dead = *pp;
+            *pp = dead->next;
+            free(dead);
+            return;
+        }
+        pp = &(*pp)->next;
+    }
+}
+
+/* ------------------------------------------------------------------ alloc */
+
+static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
+{
+    void *allocParams = (void *)(uintptr_t)p->pAllocParms;
+
+    if (p->hClass == TPU_CLASS_ROOT) {
+        /* Client allocation: hRoot == hObjectParent == hObjectNew. */
+        uint32_t h = p->hObjectNew ? p->hObjectNew : p->hRoot;
+        if (h == 0)
+            return TPU_ERR_INVALID_ARGUMENT;
+        if (client_find(h))
+            return TPU_ERR_INSERT_DUPLICATE_NAME;
+        for (int i = 0; i < MAX_CLIENTS; i++) {
+            if (!g_rm.clients[i].used) {
+                g_rm.clients[i].used = true;
+                g_rm.clients[i].hClient = h;
+                g_rm.clients[i].objects = NULL;
+                tpuLog(TPU_LOG_INFO, "rmapi", "client 0x%x allocated", h);
+                return TPU_OK;
+            }
+        }
+        return TPU_ERR_INSUFFICIENT_RESOURCES;
+    }
+
+    RmClient *client = client_find(p->hRoot);
+    if (!client)
+        return TPU_ERR_INVALID_CLIENT;
+    if (p->hObjectNew == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (object_find(client, p->hObjectNew) ||
+        p->hObjectNew == client->hClient)
+        return TPU_ERR_INSERT_DUPLICATE_NAME;
+
+    TpurmDevice *dev = NULL;
+    if (p->hClass == TPU_CLASS_DEVICE) {
+        if (p->hObjectParent != client->hClient)
+            return TPU_ERR_INVALID_OBJECT_PARENT;
+        if (p->paramsSize != sizeof(TpuDeviceAllocParams) || !allocParams)
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuDeviceAllocParams *dp = allocParams;
+        dev = tpurmDeviceGet(dp->deviceId);
+        if (!dev)
+            return TPU_ERR_INVALID_DEVICE;
+        if (!dev->attached)
+            return TPU_ERR_INVALID_STATE;
+    } else if (p->hClass == TPU_CLASS_SUBDEVICE) {
+        RmObject *parent = object_find(client, p->hObjectParent);
+        if (!parent || parent->hClass != TPU_CLASS_DEVICE)
+            return TPU_ERR_INVALID_OBJECT_PARENT;
+        if (p->paramsSize != sizeof(TpuSubdeviceAllocParams) || !allocParams)
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuSubdeviceAllocParams *sp = allocParams;
+        if (sp->subDeviceId != 0)
+            return TPU_ERR_INVALID_ARGUMENT;
+        dev = parent->dev;
+    } else {
+        return TPU_ERR_INVALID_CLASS;
+    }
+
+    RmObject *obj = calloc(1, sizeof(*obj));
+    if (!obj)
+        return TPU_ERR_NO_MEMORY;
+    obj->handle = p->hObjectNew;
+    obj->hClass = p->hClass;
+    obj->hParent = p->hObjectParent;
+    obj->dev = dev;
+    obj->next = client->objects;
+    client->objects = obj;
+    tpuLog(TPU_LOG_INFO, "rmapi", "object 0x%x class 0x%x under 0x%x",
+           obj->handle, obj->hClass, obj->hParent);
+    return TPU_OK;
+}
+
+TpuStatus tpurmAlloc(TpuRmAllocParams *p)
+{
+    if (!p)
+        return TPU_ERR_INVALID_ARGUMENT;
+    tpuDeviceGlobalInit();
+    pthread_mutex_lock(&g_rm.lock);
+    tpuLockTrackAcquire(TPU_LOCK_RM, "rm");
+    TpuStatus st = rm_alloc_locked(p);
+    tpuLockTrackRelease(TPU_LOCK_RM, "rm");
+    pthread_mutex_unlock(&g_rm.lock);
+    p->status = st;
+    return st;
+}
+
+/* ------------------------------------------------------------------- free */
+
+TpuStatus tpurmFree(TpuRmFreeParams *p)
+{
+    if (!p)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_rm.lock);
+    tpuLockTrackAcquire(TPU_LOCK_RM, "rm");
+    TpuStatus st = TPU_OK;
+    RmClient *client = client_find(p->hRoot);
+    if (!client) {
+        st = TPU_ERR_INVALID_CLIENT;
+    } else if (p->hObjectOld == client->hClient) {
+        /* Freeing the root frees the whole client. */
+        while (client->objects) {
+            RmObject *o = client->objects;
+            client->objects = o->next;
+            free(o);
+        }
+        client->used = false;
+        tpuLog(TPU_LOG_INFO, "rmapi", "client 0x%x freed", p->hRoot);
+    } else if (!object_find(client, p->hObjectOld)) {
+        st = TPU_ERR_OBJECT_NOT_FOUND;
+    } else {
+        object_free_subtree(client, p->hObjectOld);
+    }
+    tpuLockTrackRelease(TPU_LOCK_RM, "rm");
+    pthread_mutex_unlock(&g_rm.lock);
+    p->status = st;
+    return st;
+}
+
+/* ---------------------------------------------------------------- control */
+
+static TpuStatus ctrl_client(RmClient *client, TpuRmControlParams *p,
+                             void *params)
+{
+    (void)client;
+    switch (p->cmd) {
+    case TPU_CTRL_CMD_GPU_GET_PROBED_IDS: {
+        if (p->paramsSize != sizeof(TpuCtrlGetProbedIdsParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlGetProbedIdsParams *out = params;
+        uint32_t n = tpurmDeviceCount();
+        for (uint32_t i = 0; i < TPU_CTRL_MAX_PROBED_DEVICES; i++) {
+            out->gpuIds[i] = i < n ? tpurmDeviceGet(i)->devId
+                                   : TPU_CTRL_INVALID_DEVICE_ID;
+            out->excludedGpuIds[i] = TPU_CTRL_INVALID_DEVICE_ID;
+        }
+        return TPU_OK;
+    }
+    case TPU_CTRL_CMD_GPU_ATTACH_IDS: {
+        if (p->paramsSize != sizeof(TpuCtrlAttachIdsParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlAttachIdsParams *in = params;
+        in->failedId = TPU_CTRL_INVALID_DEVICE_ID;
+        if (in->gpuIds[0] == TPU_CTRL_ATTACH_ALL_PROBED) {
+            for (uint32_t i = 0; i < tpurmDeviceCount(); i++)
+                tpurmDeviceGet(i)->attached = true;
+            return TPU_OK;
+        }
+        for (uint32_t i = 0; i < TPU_CTRL_MAX_PROBED_DEVICES; i++) {
+            if (in->gpuIds[i] == TPU_CTRL_INVALID_DEVICE_ID)
+                break;
+            TpurmDevice *dev = tpuDeviceByDevId(in->gpuIds[i]);
+            if (!dev) {
+                in->failedId = in->gpuIds[i];
+                return TPU_ERR_INVALID_DEVICE;
+            }
+            dev->attached = true;
+        }
+        return TPU_OK;
+    }
+    case TPU_CTRL_CMD_GPU_GET_ATTACHED_IDS: {
+        if (p->paramsSize != sizeof(TpuCtrlGetAttachedIdsParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlGetAttachedIdsParams *out = params;
+        uint32_t j = 0;
+        for (uint32_t i = 0; i < tpurmDeviceCount() &&
+                             j < TPU_CTRL_MAX_ATTACHED_DEVICES; i++)
+            if (tpurmDeviceGet(i)->attached)
+                out->gpuIds[j++] = tpurmDeviceGet(i)->devId;
+        for (; j < TPU_CTRL_MAX_ATTACHED_DEVICES; j++)
+            out->gpuIds[j] = TPU_CTRL_INVALID_DEVICE_ID;
+        return TPU_OK;
+    }
+    case TPU_CTRL_CMD_SYSTEM_GET_P2P_CAPS_V2:
+        /* ICI peer caps land with the peer-mapped HBM pool milestone. */
+        return TPU_ERR_NOT_SUPPORTED;
+    default:
+        return TPU_ERR_NOT_SUPPORTED;
+    }
+}
+
+static TpuStatus ctrl_subdevice(RmObject *subdev, TpuRmControlParams *p,
+                                void *params)
+{
+    TpurmDevice *dev = subdev->dev;
+
+    switch (p->cmd) {
+    case TPU_CTRL_CMD_BUS_GET_CXL_INFO: {
+        if (p->paramsSize != sizeof(TpuCtrlGetCxlInfoParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlGetCxlInfoParams *out = params;
+        uint32_t nDev = 0, nMem = 0, ver = 2;
+        bool up = false;
+        memset(out, 0, sizeof(*out));
+        tpuCxlSystemInfo(&nDev, &nMem, &up, &ver);
+        if (nMem > 4)
+            nMem = 4;          /* clamp to spec max before mask math */
+        out->bIsLinkUp = up ? 1 : 0;
+        out->bMemoryExpander = nMem > 0 ? 1 : 0;
+        out->nrLinks = nMem;
+        out->maxNrLinks = 4;   /* max per CXL spec (kern_bus_ctrl.c:770) */
+        out->linkMask = nMem > 0 ? ((1u << nMem) - 1) : 0;
+        out->perLinkBwMBps = nMem > 0 ? 3900 : 0;  /* kern_bus_ctrl.c:772-775 */
+        out->cxlVersion = ver;
+        out->remoteType = TPU_CXL_REMOTE_TYPE_CPU;
+        return TPU_OK;
+    }
+    case TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER: {
+        if (p->paramsSize != sizeof(TpuCtrlRegisterCxlBufferParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlRegisterCxlBufferParams *rp = params;
+        if (rp->baseAddress == 0 || rp->size == 0)
+            return TPU_ERR_INVALID_ARGUMENT;
+        uint64_t handle = 0;
+        TpuStatus st = tpuCxlRegister(rp->baseAddress, rp->size,
+                                      rp->cxlVersion, &handle);
+        rp->bufferHandle = (st == TPU_OK) ? handle : 0;
+        return st;
+    }
+    case TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER: {
+        if (p->paramsSize != sizeof(TpuCtrlUnregisterCxlBufferParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlUnregisterCxlBufferParams *up = params;
+        if (up->bufferHandle == 0)
+            return TPU_ERR_INVALID_ARGUMENT;
+        return tpuCxlUnregister(up->bufferHandle);
+    }
+    case TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST: {
+        if (p->paramsSize != sizeof(TpuCtrlCxlP2pDmaRequestParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlCxlP2pDmaRequestParams *dp = params;
+        if (dp->cxlBufferHandle == 0 || dp->size == 0)
+            return TPU_ERR_INVALID_ARGUMENT;
+        uint32_t transferId = 0;
+        TpuStatus st = tpuCxlDmaRequest(dev, dp->cxlBufferHandle,
+                                        dp->gpuOffset, dp->cxlOffset,
+                                        dp->size, dp->flags, &transferId);
+        dp->transferId = (st == TPU_OK) ? transferId : 0;
+        return st;
+    }
+    default:
+        return TPU_ERR_NOT_SUPPORTED;
+    }
+}
+
+TpuStatus tpurmControl(TpuRmControlParams *p)
+{
+    if (!p)
+        return TPU_ERR_INVALID_ARGUMENT;
+    tpuDeviceGlobalInit();
+    void *params = (void *)(uintptr_t)p->params;
+    if (p->paramsSize > 0 && !params) {
+        p->status = TPU_ERR_INVALID_ARGUMENT;
+        return p->status;
+    }
+
+    pthread_mutex_lock(&g_rm.lock);
+    tpuLockTrackAcquire(TPU_LOCK_RM, "rm");
+    TpuStatus st;
+    RmClient *client = client_find(p->hClient);
+    if (!client) {
+        st = TPU_ERR_INVALID_CLIENT;
+    } else if (p->hObject == client->hClient) {
+        st = ctrl_client(client, p, params);
+    } else {
+        RmObject *obj = object_find(client, p->hObject);
+        if (!obj)
+            st = TPU_ERR_INVALID_OBJECT_HANDLE;
+        else if (obj->hClass == TPU_CLASS_SUBDEVICE)
+            st = ctrl_subdevice(obj, p, params);
+        else
+            st = TPU_ERR_NOT_SUPPORTED;
+    }
+    tpuLockTrackRelease(TPU_LOCK_RM, "rm");
+    pthread_mutex_unlock(&g_rm.lock);
+    p->status = st;
+    return st;
+}
+
+/* ------------------------------------------------------------- ioctl glue */
+
+int tpurm_ioctl(int pfd, unsigned long request, void *argp)
+{
+    int idx = pfd - PSEUDO_FD_BASE;
+    if (idx < 0 || idx >= MAX_PSEUDO_FDS || !g_fds.fds[idx].used) {
+        errno = EBADF;
+        return -1;
+    }
+    if (_IOC_TYPE(request) != TPU_IOCTL_MAGIC) {
+        errno = ENOTTY;
+        return -1;
+    }
+    if (!argp) {
+        errno = EFAULT;
+        return -1;
+    }
+
+    switch (_IOC_NR(request)) {
+    case TPU_ESC_RM_ALLOC:
+        tpurmAlloc((TpuRmAllocParams *)argp);
+        return 0;
+    case TPU_ESC_RM_CONTROL:
+        tpurmControl((TpuRmControlParams *)argp);
+        return 0;
+    case TPU_ESC_RM_FREE:
+        tpurmFree((TpuRmFreeParams *)argp);
+        return 0;
+    default:
+        errno = ENOTTY;
+        return -1;
+    }
+}

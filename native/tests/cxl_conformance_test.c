@@ -1,0 +1,300 @@
+/*
+ * CXL P2P conformance walker — the native end-to-end test.
+ *
+ * Follows the same 9-step flow as the reference's userspace smoke test
+ * (reference: tests/cxl_p2p_test.c — open control node, raw-ioctl RM object
+ * lifecycle, CXL info/register/DMA/unregister), but with hard assertions on
+ * data movement through the device HBM arena plus negative/error-path
+ * coverage the reference leaves to in-kernel tests.  Written against the
+ * ABI spec in include/tpurm/abi.h; no reference code is reused.
+ */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+
+#include "tpurm/tpurm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+#define BUF_SIZE (4u * 1024 * 1024)
+
+static int g_fd = -1;
+static uint32_t g_hClient;
+
+static TpuStatus rm_control(uint32_t hObject, uint32_t cmd, void *params,
+                            uint32_t size)
+{
+    TpuRmControlParams p;
+    memset(&p, 0, sizeof(p));
+    p.hClient = g_hClient;
+    p.hObject = hObject;
+    p.cmd = cmd;
+    p.params = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    if (tpurm_ioctl(g_fd, TPU_ESC_RM_CONTROL_IOCTL, &p) != 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    return p.status;
+}
+
+static TpuStatus rm_alloc(uint32_t hParent, uint32_t hNew, uint32_t hClass,
+                          void *params, uint32_t size)
+{
+    TpuRmAllocParams p;
+    memset(&p, 0, sizeof(p));
+    if (hClass == TPU_CLASS_ROOT) {
+        p.hRoot = p.hObjectParent = p.hObjectNew = hNew;
+    } else {
+        p.hRoot = g_hClient;
+        p.hObjectParent = hParent;
+        p.hObjectNew = hNew;
+    }
+    p.hClass = hClass;
+    p.pAllocParms = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    if (tpurm_ioctl(g_fd, TPU_ESC_RM_ALLOC_IOCTL, &p) != 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    return p.status;
+}
+
+static void fill_pattern(uint8_t *p, size_t size, uint8_t seed)
+{
+    for (size_t i = 0; i < size; i++)
+        p[i] = (uint8_t)((i + seed) & 0xFF);
+}
+
+static int count_pattern_errors(const uint8_t *p, size_t size, uint8_t seed)
+{
+    int errors = 0;
+    for (size_t i = 0; i < size; i++)
+        if (p[i] != (uint8_t)((i + seed) & 0xFF))
+            errors++;
+    return errors;
+}
+
+int main(void)
+{
+    const uint32_t hDevice = 0xcab00002, hSubdev = 0xcab00003;
+    g_hClient = 0xcab00001;
+
+    /* Step 1: open control node. */
+    g_fd = tpurm_open("/dev/nvidiactl");
+    CHECK(g_fd >= 0);
+
+    /* Step 2: RM client/device/subdevice lifecycle via raw escapes. */
+    CHECK(rm_alloc(0, g_hClient, TPU_CLASS_ROOT, NULL, 0) == TPU_OK);
+
+    TpuCtrlGetProbedIdsParams probed;
+    memset(&probed, 0, sizeof(probed));
+    CHECK(rm_control(g_hClient, TPU_CTRL_CMD_GPU_GET_PROBED_IDS, &probed,
+                     sizeof(probed)) == TPU_OK);
+    CHECK(probed.gpuIds[0] != TPU_CTRL_INVALID_DEVICE_ID);
+
+    TpuCtrlAttachIdsParams attach;
+    memset(&attach, 0, sizeof(attach));
+    attach.gpuIds[0] = TPU_CTRL_ATTACH_ALL_PROBED;
+    CHECK(rm_control(g_hClient, TPU_CTRL_CMD_GPU_ATTACH_IDS, &attach,
+                     sizeof(attach)) == TPU_OK);
+
+    int dev_fd = tpurm_open("/dev/accel/tpu0");
+    CHECK(dev_fd >= 0);
+
+    TpuDeviceAllocParams devParams;
+    memset(&devParams, 0, sizeof(devParams));
+    devParams.deviceId = 0;
+    CHECK(rm_alloc(g_hClient, hDevice, TPU_CLASS_DEVICE, &devParams,
+                   sizeof(devParams)) == TPU_OK);
+    TpuSubdeviceAllocParams subParams = { .subDeviceId = 0 };
+    CHECK(rm_alloc(hDevice, hSubdev, TPU_CLASS_SUBDEVICE, &subParams,
+                   sizeof(subParams)) == TPU_OK);
+
+    /* Step 3: CXL info. */
+    TpuCtrlGetCxlInfoParams info;
+    memset(&info, 0, sizeof(info));
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_GET_CXL_INFO, &info,
+                     sizeof(info)) == TPU_OK);
+    CHECK(info.maxNrLinks == 4);
+    CHECK(info.cxlVersion >= 1 && info.cxlVersion <= 3);
+    if (info.bMemoryExpander)
+        CHECK(info.perLinkBwMBps == 3900);
+
+    /* Step 4+5: allocate and pattern the CXL-tier buffer. */
+    uint8_t *buf = mmap(NULL, BUF_SIZE, PROT_READ | PROT_WRITE,
+                        MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    CHECK(buf != MAP_FAILED);
+    fill_pattern(buf, BUF_SIZE, 0xAB);
+    CHECK(count_pattern_errors(buf, BUF_SIZE, 0xAB) == 0);
+
+    /* Step 6: register. */
+    TpuCtrlRegisterCxlBufferParams reg;
+    memset(&reg, 0, sizeof(reg));
+    reg.baseAddress = (uint64_t)(uintptr_t)buf;
+    reg.size = BUF_SIZE;
+    reg.cxlVersion = info.cxlVersion;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &reg,
+                     sizeof(reg)) == TPU_OK);
+    CHECK(reg.bufferHandle != 0);
+
+    /* Step 7: CXL -> device, then verify device side by copying back
+     * through a different device offset. */
+    TpuCtrlCxlP2pDmaRequestParams dma;
+    memset(&dma, 0, sizeof(dma));
+    dma.cxlBufferHandle = reg.bufferHandle;
+    dma.gpuOffset = 0;
+    dma.cxlOffset = 0;
+    dma.size = BUF_SIZE;
+    dma.flags = TPU_CXL_DMA_FLAG_CXL_TO_DEV;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+    CHECK(dma.transferId == 1);
+
+    /* Clobber the buffer, then read back device -> CXL. */
+    memset(buf, 0, BUF_SIZE);
+    dma.flags = TPU_CXL_DMA_FLAG_DEV_TO_CXL;
+    dma.transferId = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+
+    /* Step 8/9: pattern must have round-tripped via the HBM arena. */
+    CHECK(count_pattern_errors(buf, BUF_SIZE, 0xAB) == 0);
+
+    /* Offset transfers: move half the buffer to a different device offset
+     * and back into the second half. */
+    fill_pattern(buf, BUF_SIZE / 2, 0x17);
+    dma.gpuOffset = 8 * 1024 * 1024;
+    dma.cxlOffset = 0;
+    dma.size = BUF_SIZE / 2;
+    dma.flags = TPU_CXL_DMA_FLAG_CXL_TO_DEV;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+    dma.cxlOffset = BUF_SIZE / 2;
+    dma.flags = TPU_CXL_DMA_FLAG_DEV_TO_CXL;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+    CHECK(count_pattern_errors(buf + BUF_SIZE / 2, BUF_SIZE / 2, 0x17) == 0);
+
+    /* Async flag returns a nonzero transfer id; FIFO ordering makes the
+     * following sync transfer a completion barrier. */
+    dma.cxlOffset = 0;
+    dma.flags = TPU_CXL_DMA_FLAG_CXL_TO_DEV | TPU_CXL_DMA_FLAG_ASYNC;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+    CHECK(dma.transferId != 0);
+    dma.flags = TPU_CXL_DMA_FLAG_CXL_TO_DEV;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+
+    /* Negative: OOB CXL offset (reference: p2p_cxl.c:563). */
+    dma.cxlOffset = BUF_SIZE;
+    dma.size = 4096;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_INVALID_ARGUMENT);
+    /* Negative: device offset past HBM. */
+    dma.cxlOffset = 0;
+    dma.gpuOffset = ~0ull / 2;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_INVALID_LIMIT);
+    /* Negative: wrapped device offset must not bypass the bounds check. */
+    dma.cxlOffset = 0;
+    dma.gpuOffset = ~0ull - 255;
+    dma.size = 4096;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_INVALID_LIMIT);
+    /* Negative: zero size / zero handle. */
+    dma.gpuOffset = 0;
+    dma.size = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_INVALID_ARGUMENT);
+    dma.size = 4096;
+    dma.cxlBufferHandle = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_INVALID_ARGUMENT);
+
+    /* Negative: register with bad version / zero base. */
+    TpuCtrlRegisterCxlBufferParams badreg = reg;
+    badreg.cxlVersion = 9;
+    badreg.bufferHandle = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &badreg,
+                     sizeof(badreg)) == TPU_ERR_INVALID_ARGUMENT);
+    badreg = reg;
+    badreg.baseAddress = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &badreg,
+                     sizeof(badreg)) == TPU_ERR_INVALID_ARGUMENT);
+
+    /* Device-lost error path (reference: PDB_PROP_GPU_IS_LOST in
+     * p2p_cxl.c:594). */
+    tpurmDeviceSetLost(tpurmDeviceGet(0), 1);
+    dma.cxlBufferHandle = reg.bufferHandle;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_GPU_IS_LOST);
+    tpurmDeviceSetLost(tpurmDeviceGet(0), 0);
+
+    /* Unregister + stale handle reuse. */
+    TpuCtrlUnregisterCxlBufferParams unreg = { .bufferHandle = reg.bufferHandle };
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER, &unreg,
+                     sizeof(unreg)) == TPU_OK);
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER, &unreg,
+                     sizeof(unreg)) == TPU_ERR_OBJECT_NOT_FOUND);
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_OBJECT_NOT_FOUND);
+
+    /* Generation guard: a fresh registration in the same slot must not
+     * validate the stale handle. */
+    TpuCtrlRegisterCxlBufferParams reg2 = reg;
+    reg2.bufferHandle = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &reg2,
+                     sizeof(reg2)) == TPU_OK);
+    CHECK(reg2.bufferHandle != reg.bufferHandle);
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_ERR_OBJECT_NOT_FOUND);
+    unreg.bufferHandle = reg2.bufferHandle;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER, &unreg,
+                     sizeof(unreg)) == TPU_OK);
+
+    /* Async DMA immediately followed by unregister: teardown must quiesce
+     * the channel (wait for the pending tracker) so the worker never touches
+     * freed state; the data must still land. */
+    TpuCtrlRegisterCxlBufferParams rega = reg;
+    rega.bufferHandle = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &rega,
+                     sizeof(rega)) == TPU_OK);
+    fill_pattern(buf, 4096, 0x33);
+    TpuCtrlCxlP2pDmaRequestParams adma;
+    memset(&adma, 0, sizeof(adma));
+    adma.cxlBufferHandle = rega.bufferHandle;
+    adma.size = 4096;
+    adma.flags = TPU_CXL_DMA_FLAG_CXL_TO_DEV | TPU_CXL_DMA_FLAG_ASYNC;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &adma,
+                     sizeof(adma)) == TPU_OK);
+    TpuCtrlUnregisterCxlBufferParams unrega = { .bufferHandle = rega.bufferHandle };
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER, &unrega,
+                     sizeof(unrega)) == TPU_OK);
+
+    /* Pin-limit enforcement (reference: cxl_check_pin_limits,
+     * nv-p2p.c:1102). */
+    setenv("TPUMEM_PIN_LIMIT_MB", "1", 1);
+    TpuCtrlRegisterCxlBufferParams reg3 = reg;
+    reg3.bufferHandle = 0;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &reg3,
+                     sizeof(reg3)) == TPU_ERR_INSUFFICIENT_RESOURCES);
+    unsetenv("TPUMEM_PIN_LIMIT_MB");
+
+    /* Teardown. */
+    munmap(buf, BUF_SIZE);
+    TpuRmFreeParams fr;
+    memset(&fr, 0, sizeof(fr));
+    fr.hRoot = g_hClient;
+    fr.hObjectOld = g_hClient;
+    CHECK(tpurm_ioctl(g_fd, TPU_ESC_RM_FREE_IOCTL, &fr) == 0);
+    CHECK(fr.status == TPU_OK);
+    CHECK(tpurm_close(dev_fd) == 0);
+    CHECK(tpurm_close(g_fd) == 0);
+
+    printf("cxl_conformance_test OK\n");
+    return 0;
+}

@@ -1,0 +1,158 @@
+/*
+ * Object-model unit test: handle tree lifecycle, validation, error codes.
+ *
+ * Native tier-2 analog of the reference's in-kernel data-structure tests
+ * (SURVEY.md §4: uvm_range_tree_test.c et al run via UVM_RUN_TEST; here the
+ * tests are plain processes because the runtime itself is userspace).
+ */
+#include <assert.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "tpurm/tpurm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+static TpuStatus do_alloc(uint32_t hRoot, uint32_t hParent, uint32_t hNew,
+                          uint32_t hClass, void *params, uint32_t size)
+{
+    TpuRmAllocParams p;
+    memset(&p, 0, sizeof(p));
+    p.hRoot = hClass == TPU_CLASS_ROOT ? hNew : hRoot;
+    p.hObjectParent = hClass == TPU_CLASS_ROOT ? hNew : hParent;
+    p.hObjectNew = hNew;
+    p.hClass = hClass;
+    p.pAllocParms = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    return tpurmAlloc(&p);
+}
+
+static TpuStatus do_free(uint32_t hRoot, uint32_t hParent, uint32_t hOld)
+{
+    TpuRmFreeParams p;
+    memset(&p, 0, sizeof(p));
+    p.hRoot = hRoot;
+    p.hObjectParent = hParent;
+    p.hObjectOld = hOld;
+    return tpurmFree(&p);
+}
+
+static TpuStatus do_control(uint32_t hClient, uint32_t hObject, uint32_t cmd,
+                            void *params, uint32_t size)
+{
+    TpuRmControlParams p;
+    memset(&p, 0, sizeof(p));
+    p.hClient = hClient;
+    p.hObject = hObject;
+    p.cmd = cmd;
+    p.params = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    return tpurmControl(&p);
+}
+
+int main(void)
+{
+    const uint32_t hClient = 0xcaf20001, hDevice = 0xcaf20002,
+                   hSubdev = 0xcaf20003;
+
+    /* Client lifecycle. */
+    CHECK(do_alloc(0, 0, hClient, TPU_CLASS_ROOT, NULL, 0) == TPU_OK);
+    CHECK(do_alloc(0, 0, hClient, TPU_CLASS_ROOT, NULL, 0) ==
+          TPU_ERR_INSERT_DUPLICATE_NAME);
+
+    /* Probe + attach. */
+    TpuCtrlGetProbedIdsParams probed;
+    memset(&probed, 0, sizeof(probed));
+    CHECK(do_control(hClient, hClient, TPU_CTRL_CMD_GPU_GET_PROBED_IDS,
+                     &probed, sizeof(probed)) == TPU_OK);
+    CHECK(probed.gpuIds[0] != TPU_CTRL_INVALID_DEVICE_ID);
+    CHECK(probed.gpuIds[31] == TPU_CTRL_INVALID_DEVICE_ID);
+
+    /* Device alloc before attach must fail. */
+    TpuDeviceAllocParams devParams;
+    memset(&devParams, 0, sizeof(devParams));
+    CHECK(do_alloc(hClient, hClient, hDevice, TPU_CLASS_DEVICE, &devParams,
+                   sizeof(devParams)) == TPU_ERR_INVALID_STATE);
+
+    TpuCtrlAttachIdsParams attach;
+    memset(&attach, 0, sizeof(attach));
+    attach.gpuIds[0] = TPU_CTRL_ATTACH_ALL_PROBED;
+    CHECK(do_control(hClient, hClient, TPU_CTRL_CMD_GPU_ATTACH_IDS, &attach,
+                     sizeof(attach)) == TPU_OK);
+
+    TpuCtrlGetAttachedIdsParams attached;
+    memset(&attached, 0, sizeof(attached));
+    CHECK(do_control(hClient, hClient, TPU_CTRL_CMD_GPU_GET_ATTACHED_IDS,
+                     &attached, sizeof(attached)) == TPU_OK);
+    CHECK(attached.gpuIds[0] == probed.gpuIds[0]);
+
+    /* Device + subdevice alloc. */
+    CHECK(do_alloc(hClient, hClient, hDevice, TPU_CLASS_DEVICE, &devParams,
+                   sizeof(devParams)) == TPU_OK);
+    /* Wrong param size -> INVALID_PARAM_STRUCT. */
+    TpuSubdeviceAllocParams subParams = { .subDeviceId = 0 };
+    CHECK(do_alloc(hClient, hDevice, hSubdev, TPU_CLASS_SUBDEVICE, &subParams,
+                   2) == TPU_ERR_INVALID_PARAM_STRUCT);
+    /* Subdevice under client (wrong parent class). */
+    CHECK(do_alloc(hClient, hClient, hSubdev, TPU_CLASS_SUBDEVICE, &subParams,
+                   sizeof(subParams)) == TPU_ERR_INVALID_OBJECT_PARENT);
+    CHECK(do_alloc(hClient, hDevice, hSubdev, TPU_CLASS_SUBDEVICE, &subParams,
+                   sizeof(subParams)) == TPU_OK);
+    /* Unknown class. */
+    CHECK(do_alloc(hClient, hDevice, 0xcaf2beef, 0xdead, NULL, 0) ==
+          TPU_ERR_INVALID_CLASS);
+
+    /* Controls on bad handles. */
+    CHECK(do_control(0xbad, 0xbad, TPU_CTRL_CMD_GPU_GET_PROBED_IDS, &probed,
+                     sizeof(probed)) == TPU_ERR_INVALID_CLIENT);
+    CHECK(do_control(hClient, 0xbad, TPU_CTRL_CMD_BUS_GET_CXL_INFO, NULL,
+                     0) == TPU_ERR_INVALID_OBJECT_HANDLE);
+    /* CXL control on the device object (not subdevice) is unsupported. */
+    TpuCtrlGetCxlInfoParams info;
+    CHECK(do_control(hClient, hDevice, TPU_CTRL_CMD_BUS_GET_CXL_INFO, &info,
+                     sizeof(info)) == TPU_ERR_NOT_SUPPORTED);
+    CHECK(do_control(hClient, hSubdev, TPU_CTRL_CMD_BUS_GET_CXL_INFO, &info,
+                     sizeof(info)) == TPU_OK);
+    CHECK(info.maxNrLinks == 4);
+    CHECK(info.remoteType == TPU_CXL_REMOTE_TYPE_CPU);
+
+    /* Unknown control degrades to NOT_SUPPORTED (conformance-walker
+     * property the reference test relies on). */
+    CHECK(do_control(hClient, hSubdev, 0x20801899, NULL, 0) ==
+          TPU_ERR_NOT_SUPPORTED);
+
+    /* Freeing the device frees the subdevice subtree. */
+    CHECK(do_free(hClient, hClient, hDevice) == TPU_OK);
+    CHECK(do_control(hClient, hSubdev, TPU_CTRL_CMD_BUS_GET_CXL_INFO, &info,
+                     sizeof(info)) == TPU_ERR_INVALID_OBJECT_HANDLE);
+
+    /* Free root, everything dies. */
+    CHECK(do_free(hClient, 0, hClient) == TPU_OK);
+    CHECK(do_control(hClient, hClient, TPU_CTRL_CMD_GPU_GET_PROBED_IDS,
+                     &probed, sizeof(probed)) == TPU_ERR_INVALID_CLIENT);
+
+    /* Pseudo-fd surface. */
+    int fd = tpurm_open("/dev/nvidiactl");
+    CHECK(fd >= 0);
+    int fd2 = tpurm_open("/dev/accel/tpu0");
+    CHECK(fd2 >= 0);
+    CHECK(tpurm_open("/dev/accel/tpu99") == -1);
+    CHECK(tpurm_open("/dev/random") == -1);
+    CHECK(tpurm_close(fd2) == 0);
+    CHECK(tpurm_close(fd2) == -1);
+
+    TpuRmAllocParams ap;
+    memset(&ap, 0, sizeof(ap));
+    ap.hRoot = ap.hObjectParent = ap.hObjectNew = 0xcaf20009;
+    ap.hClass = TPU_CLASS_ROOT;
+    CHECK(tpurm_ioctl(fd, TPU_ESC_RM_ALLOC_IOCTL, &ap) == 0);
+    CHECK(ap.status == TPU_OK);
+    CHECK(tpurm_close(fd) == 0);
+
+    printf("rm_objmodel_test OK\n");
+    return 0;
+}

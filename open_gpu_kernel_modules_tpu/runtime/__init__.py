@@ -1,1 +1,9 @@
-"""Placeholder — populated in subsequent milestones."""
+"""RM runtime: native core bindings (object model, CXL tier, DMA channels).
+
+See native/ for the C implementation and runtime/native.py for the ctypes
+client layer.
+"""
+
+from . import native
+
+__all__ = ["native"]

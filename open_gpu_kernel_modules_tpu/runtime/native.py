@@ -1,0 +1,348 @@
+"""ctypes bindings to the native tpurm core (native/libtpurm.so).
+
+The Python runtime is a *client* of the native RM — exactly the relationship
+reference userspace has to /dev/nvidiactl (SURVEY.md §3.1), except in-process:
+the escape surface (tpurm_open/tpurm_ioctl) and the param-block ABI
+(native/include/tpurm/abi.h) are identical, so everything exercised here is
+the same code path a reference binary hits through the LD_PRELOAD shim.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libtpurm.so")
+
+# ---------------------------------------------------------------- constants
+
+TPU_OK = 0x0
+TPU_ERR_GPU_IS_LOST = 0x0F
+TPU_ERR_INSERT_DUPLICATE_NAME = 0x19
+TPU_ERR_INVALID_ARGUMENT = 0x1F
+TPU_ERR_INVALID_CLIENT = 0x23
+TPU_ERR_INVALID_DEVICE = 0x26
+TPU_ERR_INVALID_LIMIT = 0x2E
+TPU_ERR_INVALID_OBJECT_HANDLE = 0x33
+TPU_ERR_INVALID_STATE = 0x40
+TPU_ERR_NOT_SUPPORTED = 0x56
+TPU_ERR_OBJECT_NOT_FOUND = 0x57
+TPU_ERR_INSUFFICIENT_RESOURCES = 0x1A
+
+CLASS_ROOT = 0x0
+CLASS_DEVICE = 0x80
+CLASS_SUBDEVICE = 0x2080
+
+CTRL_GPU_GET_PROBED_IDS = 0x214
+CTRL_GPU_ATTACH_IDS = 0x215
+CTRL_GPU_GET_ATTACHED_IDS = 0x201
+CTRL_BUS_GET_CXL_INFO = 0x20801833
+CTRL_BUS_CXL_P2P_DMA_REQUEST = 0x20801834
+CTRL_BUS_REGISTER_CXL_BUFFER = 0x20801835
+CTRL_BUS_UNREGISTER_CXL_BUFFER = 0x20801836
+
+ATTACH_ALL_PROBED = 0xFFFF
+INVALID_DEVICE_ID = 0xFFFFFFFF
+
+DMA_FLAG_DEV_TO_CXL = 0x0
+DMA_FLAG_CXL_TO_DEV = 0x1
+DMA_FLAG_ASYNC = 0x2
+
+
+# ------------------------------------------------------------- ABI structs
+
+class RmAllocParams(ctypes.Structure):
+    _fields_ = [
+        ("hRoot", ctypes.c_uint32),
+        ("hObjectParent", ctypes.c_uint32),
+        ("hObjectNew", ctypes.c_uint32),
+        ("hClass", ctypes.c_uint32),
+        ("pAllocParms", ctypes.c_uint64),
+        ("paramsSize", ctypes.c_uint32),
+        ("status", ctypes.c_uint32),
+    ]
+
+
+class RmControlParams(ctypes.Structure):
+    _fields_ = [
+        ("hClient", ctypes.c_uint32),
+        ("hObject", ctypes.c_uint32),
+        ("cmd", ctypes.c_uint32),
+        ("flags", ctypes.c_uint32),
+        ("params", ctypes.c_uint64),
+        ("paramsSize", ctypes.c_uint32),
+        ("status", ctypes.c_uint32),
+    ]
+
+
+class RmFreeParams(ctypes.Structure):
+    _fields_ = [
+        ("hRoot", ctypes.c_uint32),
+        ("hObjectParent", ctypes.c_uint32),
+        ("hObjectOld", ctypes.c_uint32),
+        ("status", ctypes.c_uint32),
+    ]
+
+
+class DeviceAllocParams(ctypes.Structure):
+    _fields_ = [
+        ("deviceId", ctypes.c_uint32),
+        ("hClientShare", ctypes.c_uint32),
+        ("hTargetClient", ctypes.c_uint32),
+        ("hTargetDevice", ctypes.c_uint32),
+        ("flags", ctypes.c_uint32),
+        ("vaSpaceSize", ctypes.c_uint64),
+        ("vaStartInternal", ctypes.c_uint64),
+        ("vaLimitInternal", ctypes.c_uint64),
+        ("vaMode", ctypes.c_uint32),
+    ]
+
+
+class SubdeviceAllocParams(ctypes.Structure):
+    _fields_ = [("subDeviceId", ctypes.c_uint32)]
+
+
+class GetProbedIdsParams(ctypes.Structure):
+    _fields_ = [
+        ("gpuIds", ctypes.c_uint32 * 32),
+        ("excludedGpuIds", ctypes.c_uint32 * 32),
+    ]
+
+
+class AttachIdsParams(ctypes.Structure):
+    _fields_ = [
+        ("gpuIds", ctypes.c_uint32 * 32),
+        ("failedId", ctypes.c_uint32),
+    ]
+
+
+class GetCxlInfoParams(ctypes.Structure):
+    _fields_ = [
+        ("bIsLinkUp", ctypes.c_uint8),
+        ("bMemoryExpander", ctypes.c_uint8),
+        ("nrLinks", ctypes.c_uint32),
+        ("maxNrLinks", ctypes.c_uint32),
+        ("linkMask", ctypes.c_uint32),
+        ("perLinkBwMBps", ctypes.c_uint32),
+        ("cxlVersion", ctypes.c_uint32),
+        ("remoteType", ctypes.c_uint32),
+    ]
+
+
+class RegisterCxlBufferParams(ctypes.Structure):
+    _fields_ = [
+        ("baseAddress", ctypes.c_uint64),
+        ("size", ctypes.c_uint64),
+        ("cxlVersion", ctypes.c_uint32),
+        ("bufferHandle", ctypes.c_uint64),
+    ]
+
+
+class UnregisterCxlBufferParams(ctypes.Structure):
+    _fields_ = [("bufferHandle", ctypes.c_uint64)]
+
+
+class CxlP2pDmaRequestParams(ctypes.Structure):
+    _fields_ = [
+        ("cxlBufferHandle", ctypes.c_uint64),
+        ("gpuOffset", ctypes.c_uint64),
+        ("cxlOffset", ctypes.c_uint64),
+        ("size", ctypes.c_uint64),
+        ("flags", ctypes.c_uint32),
+        ("transferId", ctypes.c_uint32),
+    ]
+
+
+# --------------------------------------------------------------- lib loader
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_native(force: bool = False) -> str:
+    """Build libtpurm.so if missing (make -C native)."""
+    if force or not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _NATIVE_DIR, "all"], check=True,
+                       capture_output=True)
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_native()
+    lib = ctypes.CDLL(_LIB_PATH)
+
+    lib.tpurm_open.argtypes = [ctypes.c_char_p]
+    lib.tpurm_open.restype = ctypes.c_int
+    lib.tpurm_close.argtypes = [ctypes.c_int]
+    lib.tpurm_close.restype = ctypes.c_int
+    lib.tpurmAlloc.argtypes = [ctypes.POINTER(RmAllocParams)]
+    lib.tpurmAlloc.restype = ctypes.c_uint32
+    lib.tpurmControl.argtypes = [ctypes.POINTER(RmControlParams)]
+    lib.tpurmControl.restype = ctypes.c_uint32
+    lib.tpurmFree.argtypes = [ctypes.POINTER(RmFreeParams)]
+    lib.tpurmFree.restype = ctypes.c_uint32
+    lib.tpurmDeviceCount.restype = ctypes.c_uint32
+    lib.tpurmDeviceGet.argtypes = [ctypes.c_uint32]
+    lib.tpurmDeviceGet.restype = ctypes.c_void_p
+    lib.tpurmDeviceHbmBase.argtypes = [ctypes.c_void_p]
+    lib.tpurmDeviceHbmBase.restype = ctypes.c_void_p
+    lib.tpurmDeviceHbmSize.argtypes = [ctypes.c_void_p]
+    lib.tpurmDeviceHbmSize.restype = ctypes.c_uint64
+    lib.tpurmDeviceSetLost.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tpurmChannelCreate.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_uint32]
+    lib.tpurmChannelCreate.restype = ctypes.c_void_p
+    lib.tpurmChannelDestroy.argtypes = [ctypes.c_void_p]
+    lib.tpurmChannelPushCopy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_void_p, ctypes.c_uint64]
+    lib.tpurmChannelPushCopy.restype = ctypes.c_uint64
+    lib.tpurmChannelWait.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.tpurmChannelWait.restype = ctypes.c_uint32
+    lib.tpurmChannelCompletedValue.argtypes = [ctypes.c_void_p]
+    lib.tpurmChannelCompletedValue.restype = ctypes.c_uint64
+    lib.tpurmChannelInjectError.argtypes = [ctypes.c_void_p]
+    lib.tpurmCounterGet.argtypes = [ctypes.c_char_p]
+    lib.tpurmCounterGet.restype = ctypes.c_uint64
+    lib.tpurmJournalDump.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.tpurmJournalDump.restype = ctypes.c_size_t
+
+    _lib = lib
+    return lib
+
+
+# ------------------------------------------------------------ friendly API
+
+class RmError(RuntimeError):
+    def __init__(self, status: int, what: str):
+        super().__init__(f"{what}: status=0x{status:x}")
+        self.status = status
+
+
+import threading as _threading
+
+
+class RmClient:
+    """RM client session over the native core (cxl_p2p_test.c rm_init flow)."""
+
+    _next_handle = 0xC0DE0000
+    _handle_lock = _threading.Lock()
+
+    def __init__(self) -> None:
+        self.lib = load()
+        with RmClient._handle_lock:
+            RmClient._next_handle += 0x10
+            base = RmClient._next_handle
+        self.h_client = base + 1
+        self.h_device = base + 2
+        self.h_subdevice = base + 3
+        self._closed = False
+
+        self._alloc(0, self.h_client, CLASS_ROOT, None)
+        try:
+            probed = GetProbedIdsParams()
+            self.control(self.h_client, CTRL_GPU_GET_PROBED_IDS, probed)
+            attach = AttachIdsParams()
+            attach.gpuIds[0] = ATTACH_ALL_PROBED
+            self.control(self.h_client, CTRL_GPU_ATTACH_IDS, attach)
+            dev = DeviceAllocParams()
+            dev.deviceId = 0
+            self._alloc(self.h_client, self.h_device, CLASS_DEVICE, dev)
+            sub = SubdeviceAllocParams()
+            self._alloc(self.h_device, self.h_subdevice, CLASS_SUBDEVICE, sub)
+        except Exception:
+            # Don't leak the root client slot (MAX_CLIENTS is finite).
+            self.close()
+            raise
+
+    def _alloc(self, parent: int, handle: int, klass: int, params) -> None:
+        p = RmAllocParams()
+        if klass == CLASS_ROOT:
+            p.hRoot = p.hObjectParent = p.hObjectNew = handle
+        else:
+            p.hRoot = self.h_client
+            p.hObjectParent = parent
+            p.hObjectNew = handle
+        p.hClass = klass
+        if params is not None:
+            p.pAllocParms = ctypes.cast(ctypes.byref(params),
+                                        ctypes.c_void_p).value
+            p.paramsSize = ctypes.sizeof(params)
+        st = self.lib.tpurmAlloc(ctypes.byref(p))
+        if st != TPU_OK:
+            raise RmError(st, f"alloc class=0x{klass:x}")
+
+    def control(self, h_object: int, cmd: int, params=None,
+                expect_ok: bool = True) -> int:
+        p = RmControlParams()
+        p.hClient = self.h_client
+        p.hObject = h_object
+        p.cmd = cmd
+        if params is not None:
+            p.params = ctypes.cast(ctypes.byref(params), ctypes.c_void_p).value
+            p.paramsSize = ctypes.sizeof(params)
+        st = self.lib.tpurmControl(ctypes.byref(p))
+        if expect_ok and st != TPU_OK:
+            raise RmError(st, f"control cmd=0x{cmd:x}")
+        return st
+
+    def cxl_info(self) -> GetCxlInfoParams:
+        info = GetCxlInfoParams()
+        self.control(self.h_subdevice, CTRL_BUS_GET_CXL_INFO, info)
+        return info
+
+    def register_cxl_buffer(self, addr: int, size: int,
+                            cxl_version: int = 2) -> int:
+        p = RegisterCxlBufferParams()
+        p.baseAddress = addr
+        p.size = size
+        p.cxlVersion = cxl_version
+        self.control(self.h_subdevice, CTRL_BUS_REGISTER_CXL_BUFFER, p)
+        return p.bufferHandle
+
+    def unregister_cxl_buffer(self, handle: int) -> None:
+        p = UnregisterCxlBufferParams()
+        p.bufferHandle = handle
+        self.control(self.h_subdevice, CTRL_BUS_UNREGISTER_CXL_BUFFER, p)
+
+    def cxl_dma(self, handle: int, gpu_offset: int, cxl_offset: int,
+                size: int, to_device: bool, async_: bool = False) -> int:
+        p = CxlP2pDmaRequestParams()
+        p.cxlBufferHandle = handle
+        p.gpuOffset = gpu_offset
+        p.cxlOffset = cxl_offset
+        p.size = size
+        p.flags = (DMA_FLAG_CXL_TO_DEV if to_device else DMA_FLAG_DEV_TO_CXL)
+        if async_:
+            p.flags |= DMA_FLAG_ASYNC
+        self.control(self.h_subdevice, CTRL_BUS_CXL_P2P_DMA_REQUEST, p)
+        return p.transferId
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        p = RmFreeParams()
+        p.hRoot = p.hObjectOld = self.h_client
+        self.lib.tpurmFree(ctypes.byref(p))
+        self._closed = True
+
+    def __enter__(self) -> "RmClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def hbm_view(dev_inst: int = 0) -> Tuple[int, int]:
+    """(base address, size) of a device's HBM arena for test introspection."""
+    lib = load()
+    dev = lib.tpurmDeviceGet(dev_inst)
+    if not dev:
+        raise ValueError(f"no device {dev_inst}")
+    return lib.tpurmDeviceHbmBase(dev), lib.tpurmDeviceHbmSize(dev)

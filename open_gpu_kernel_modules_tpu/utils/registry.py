@@ -49,6 +49,7 @@ class Registry:
     def __init__(self) -> None:
         self._keys: Dict[str, _Key] = {}
         self._lock = threading.Lock()
+        self._exported: set = set()  # env names this registry wrote
 
     @staticmethod
     def _parser_for(default: Any) -> Callable[[str], Any]:
@@ -94,6 +95,15 @@ class Registry:
             else:
                 key.value = value
                 key.source = "set"
+            # Export to the environment so the native core — which reads
+            # TPUMEM_* at call time (native/src/diag.c tpuRegistryGet) —
+            # observes the same override: one logical registry, two readers.
+            env_name = _ENV_PREFIX + name.upper()
+            if isinstance(value, bool):
+                os.environ[env_name] = "1" if value else "0"
+            else:
+                os.environ[env_name] = str(value)
+            self._exported.add(env_name)
 
     def dump(self) -> str:
         """procfs-style listing of every key, its value, and provenance."""
@@ -110,7 +120,13 @@ class Registry:
                 return
             keys = [self._keys[name]] if name else list(self._keys.values())
             for k in keys:
-                env = os.environ.get(_ENV_PREFIX + k.name.upper())
+                env_name = _ENV_PREFIX + k.name.upper()
+                # Drop any env export this registry made, so reset restores
+                # the pre-set() world for the native core too.
+                if env_name in self._exported:
+                    os.environ.pop(env_name, None)
+                    self._exported.discard(env_name)
+                env = os.environ.get(env_name)
                 if env is not None:
                     k.value = k.type(env)
                     k.source = "env"

@@ -1,0 +1,120 @@
+"""Python-side tests of the native RM core through the ctypes client.
+
+Covers the same surface the reference's userspace test walks (SURVEY.md §4
+tier 1) plus the native test binaries (tier 2 analog), driven from pytest so
+the whole suite gates on them.
+"""
+
+import ctypes
+import mmap
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from open_gpu_kernel_modules_tpu.runtime import native
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return native.load()
+
+
+class TestNativeBinaries:
+    """Run the compiled native suite (conformance walker + unit tests)."""
+
+    def test_make_test(self):
+        res = subprocess.run(["make", "-C", NATIVE_DIR, "test"],
+                             capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "native tests OK" in res.stdout
+
+
+class TestRmClient:
+    def test_lifecycle_and_cxl_info(self, lib):
+        with native.RmClient() as rm:
+            info = rm.cxl_info()
+            assert info.maxNrLinks == 4
+            assert 1 <= info.cxlVersion <= 3
+
+    def test_register_dma_roundtrip(self, lib):
+        size = 1 << 20
+        buf = mmap.mmap(-1, size)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        pattern = np.arange(size, dtype=np.uint8)
+        buf[:] = pattern.tobytes()
+
+        with native.RmClient() as rm:
+            handle = rm.register_cxl_buffer(addr, size)
+            assert handle != 0
+            # CXL -> device, clobber, device -> CXL, verify round trip.
+            assert rm.cxl_dma(handle, 0, 0, size, to_device=True) == 1
+            buf[:] = b"\x00" * size
+            rm.cxl_dma(handle, 0, 0, size, to_device=False)
+            assert np.array_equal(
+                np.frombuffer(buf, dtype=np.uint8), pattern)
+            rm.unregister_cxl_buffer(handle)
+        del buf
+
+    def test_dma_errors(self, lib):
+        size = 1 << 16
+        buf = mmap.mmap(-1, size)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        with native.RmClient() as rm:
+            handle = rm.register_cxl_buffer(addr, size)
+            st = rm.control(rm.h_subdevice,
+                            native.CTRL_BUS_CXL_P2P_DMA_REQUEST,
+                            _dma_params(handle, cxl_offset=size, size=4096),
+                            expect_ok=False)
+            assert st == native.TPU_ERR_INVALID_ARGUMENT
+            rm.unregister_cxl_buffer(handle)
+            st = rm.control(rm.h_subdevice,
+                            native.CTRL_BUS_CXL_P2P_DMA_REQUEST,
+                            _dma_params(handle, size=4096), expect_ok=False)
+            assert st == native.TPU_ERR_OBJECT_NOT_FOUND
+        del buf
+
+    def test_duplicate_client_handle_rejected(self, lib):
+        p = native.RmAllocParams()
+        p.hRoot = p.hObjectParent = p.hObjectNew = 0xDDD00001
+        p.hClass = native.CLASS_ROOT
+        assert lib.tpurmAlloc(ctypes.byref(p)) == native.TPU_OK
+        assert lib.tpurmAlloc(ctypes.byref(p)) == \
+            native.TPU_ERR_INSERT_DUPLICATE_NAME
+        fr = native.RmFreeParams()
+        fr.hRoot = fr.hObjectOld = 0xDDD00001
+        assert lib.tpurmFree(ctypes.byref(fr)) == native.TPU_OK
+
+    def test_channel_api(self, lib):
+        dev = lib.tpurmDeviceGet(0)
+        ch = lib.tpurmChannelCreate(dev, 3, 64)
+        assert ch
+        src = (ctypes.c_uint8 * 4096)(*([7] * 4096))
+        dst = (ctypes.c_uint8 * 4096)()
+        v = lib.tpurmChannelPushCopy(ch, dst, src, 4096)
+        assert v > 0
+        assert lib.tpurmChannelWait(ch, v) == native.TPU_OK
+        assert bytes(dst[:8]) == b"\x07" * 8
+        lib.tpurmChannelDestroy(ch)
+
+    def test_counters_and_journal(self, lib):
+        assert lib.tpurmCounterGet(b"channel_pushes") > 0
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = lib.tpurmJournalDump(buf, len(buf))
+        assert n > 0
+        assert b"rmapi" in buf.value or b"cxl" in buf.value
+
+
+def _dma_params(handle, gpu_offset=0, cxl_offset=0, size=0,
+                flags=native.DMA_FLAG_CXL_TO_DEV):
+    p = native.CxlP2pDmaRequestParams()
+    p.cxlBufferHandle = handle
+    p.gpuOffset = gpu_offset
+    p.cxlOffset = cxl_offset
+    p.size = size
+    p.flags = flags
+    return p
