@@ -90,6 +90,9 @@ uint64_t      tpurmChannelCompletedValue(TpurmChannel *ch);
 /* Fault injection: force the next push to fail (reference: UVM error
  * injection ioctls, uvm_test.c:286,308). */
 void          tpurmChannelInjectError(TpurmChannel *ch);
+/* Robust-channel recovery: clear a latched channel error so new work can
+ * proceed (reference: per-channel RC, src/nvidia/src/kernel/gpu/rc/). */
+void          tpurmChannelResetError(TpurmChannel *ch);
 
 /* --------------------------------------------------------- diagnostics */
 

@@ -1,0 +1,331 @@
+/*
+ * tpurm UVM — the managed-memory engine (TPU re-design of nvidia-uvm).
+ *
+ * Capability surface reproduced from the reference (SURVEY.md §2.2):
+ *   - per-fd VA space with registered devices and a VA range tree
+ *     (reference: kernel-open/nvidia-uvm/uvm_va_space.c),
+ *   - 2 MB VA blocks with per-page residency masks across three tiers —
+ *     HOST, device HBM, CXL (reference: uvm_va_block.c, per-page residency
+ *     state machine around uvm_va_block_make_resident:5086),
+ *   - PMM chunk allocator with eviction for oversubscription (reference:
+ *     uvm_pmm_gpu.c, chunk sizes uvm_pmm_gpu.h:60-85),
+ *   - fault-driven migration with a batched service loop (reference:
+ *     uvm_gpu_replayable_faults.c:2906 — fetch/coalesce/preprocess/
+ *     service/replay), here driven by software faults (SIGSEGV + futex
+ *     handoff for CPU accesses; explicit device-access notifications for
+ *     DMA traffic — TPUs expose no replayable-fault HW buffer, SURVEY.md
+ *     §7 step 4),
+ *   - migration policies: preferred location, accessed-by, read
+ *     duplication, range groups (reference: uvm_va_policy.c,
+ *     uvm_range_group.c),
+ *   - perf heuristics: prefetch region growth and thrashing detection
+ *     (reference: uvm_perf_prefetch.c, uvm_perf_thrashing.h:33-46),
+ *   - tools event queues + counters (reference: uvm_tools.c:54-70),
+ *   - an in-module test framework dispatched by UVM_RUN_TEST (reference:
+ *     uvm_test.c:241-312).
+ *
+ * ABI: the UVM_* ioctl numbers and param layouts below are the reference's
+ * stable userspace ABI (kernel-open/nvidia-uvm/uvm_ioctl.h,
+ * uvm_linux_ioctl.h:32-40) so reference userspace runs unchanged against
+ * the tpurm escape surface ("/dev/nvidia-uvm" via tpurm_open/tpurm_ioctl).
+ * The direct C API (uvm* functions) is the TPU-native in-process surface
+ * the Python runtime binds.
+ */
+#ifndef TPURM_UVM_H
+#define TPURM_UVM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "status.h"
+#include "tpurm.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ============================== ABI (reference uvm_ioctl.h numbers) ===== */
+
+#define UVM_INITIALIZE                    0x30000001
+#define UVM_DEINITIALIZE                  0x30000002
+#define UVM_RUN_TEST                      9
+#define UVM_CREATE_RANGE_GROUP            23
+#define UVM_DESTROY_RANGE_GROUP           24
+#define UVM_SET_RANGE_GROUP               31
+#define UVM_FREE                          34
+#define UVM_REGISTER_GPU                  37
+#define UVM_UNREGISTER_GPU                38
+#define UVM_PAGEABLE_MEM_ACCESS           39
+#define UVM_PREVENT_MIGRATION_RANGE_GROUPS 40
+#define UVM_ALLOW_MIGRATION_RANGE_GROUPS  41
+#define UVM_SET_PREFERRED_LOCATION        42
+#define UVM_UNSET_PREFERRED_LOCATION      43
+#define UVM_ENABLE_READ_DUPLICATION       44
+#define UVM_DISABLE_READ_DUPLICATION      45
+#define UVM_SET_ACCESSED_BY               46
+#define UVM_UNSET_ACCESSED_BY             47
+#define UVM_MIGRATE                       51
+#define UVM_TOOLS_INIT_EVENT_TRACKER      56
+#define UVM_TOOLS_SET_NOTIFICATION_THRESHOLD 57
+#define UVM_TOOLS_EVENT_QUEUE_ENABLE_EVENTS  58
+#define UVM_TOOLS_EVENT_QUEUE_DISABLE_EVENTS 59
+#define UVM_TOOLS_ENABLE_COUNTERS         60
+#define UVM_TOOLS_DISABLE_COUNTERS        61
+#define UVM_TOOLS_GET_PROCESSOR_UUID_TABLE 64
+#define UVM_TOOLS_FLUSH_EVENTS            67
+#define UVM_CREATE_EXTERNAL_RANGE         73
+
+/* TPU extensions (outside the reference's number space, documented): the
+ * reference creates managed ranges via mmap of the uvm fd; the tpurm escape
+ * surface has no kernel mmap hook, so managed alloc/free are explicit. */
+#define UVM_TPU_ALLOC_MANAGED             1001
+#define UVM_TPU_DEVICE_ACCESS             1002
+#define UVM_TPU_RESIDENCY_INFO            1003
+
+#define UVM_MIGRATE_FLAG_ASYNC            0x00000001
+
+/* Processor addressing (reference: NvProcessorUuid).  CPU = all zeros;
+ * TPU device i = "TPU\0" + LE32(inst); CXL tier = "CXL\0". */
+typedef struct {
+    uint8_t uuid[16];
+} UvmProcessorUuid;
+
+typedef struct {
+    uint64_t flags;
+    TpuStatus rmStatus;
+} UvmInitializeParams;
+
+typedef struct {
+    UvmProcessorUuid gpuUuid;       /* IN/OUT */
+    uint8_t  numaEnabled;           /* OUT */
+    int32_t  numaNodeId;            /* OUT */
+    int32_t  rmCtrlFd;              /* IN (unused here) */
+    uint32_t hClient;               /* IN (unused here) */
+    uint32_t hSmcPartRef;           /* IN (unused here) */
+    TpuStatus rmStatus;             /* OUT */
+} UvmRegisterGpuParams;
+
+typedef struct {
+    UvmProcessorUuid gpuUuid;
+    TpuStatus rmStatus;
+} UvmUnregisterGpuParams;
+
+typedef struct {
+    uint64_t base       __attribute__((aligned(8)));
+    uint64_t length     __attribute__((aligned(8)));
+    UvmProcessorUuid destinationUuid;
+    uint32_t flags;
+    uint64_t semaphoreAddress __attribute__((aligned(8)));
+    uint32_t semaphorePayload;
+    int32_t  cpuNumaNode;
+    uint64_t userSpaceStart   __attribute__((aligned(8)));
+    uint64_t userSpaceLength  __attribute__((aligned(8)));
+    TpuStatus rmStatus;
+} UvmMigrateParams;
+
+typedef struct {
+    uint64_t requestedBase __attribute__((aligned(8)));
+    uint64_t length        __attribute__((aligned(8)));
+    UvmProcessorUuid preferredLocation;
+    int32_t  preferredCpuNumaNode;
+    TpuStatus rmStatus;
+} UvmSetPreferredLocationParams;
+
+typedef struct {
+    uint64_t requestedBase __attribute__((aligned(8)));
+    uint64_t length        __attribute__((aligned(8)));
+    TpuStatus rmStatus;
+} UvmRangeOpParams;        /* UNSET_PREFERRED_LOCATION, {EN,DIS}ABLE_READ_DUPLICATION */
+
+typedef struct {
+    uint64_t requestedBase __attribute__((aligned(8)));
+    uint64_t length        __attribute__((aligned(8)));
+    UvmProcessorUuid accessedByUuid;
+    TpuStatus rmStatus;
+} UvmAccessedByParams;
+
+typedef struct {
+    uint64_t rangeGroupId  __attribute__((aligned(8)));   /* OUT (create) / IN */
+    TpuStatus rmStatus;
+} UvmRangeGroupParams;
+
+typedef struct {
+    uint64_t rangeGroupId  __attribute__((aligned(8)));
+    uint64_t requestedBase __attribute__((aligned(8)));
+    uint64_t length        __attribute__((aligned(8)));
+    TpuStatus rmStatus;
+} UvmSetRangeGroupParams;
+
+typedef struct {
+    uint64_t rangeGroupIds __attribute__((aligned(8)));   /* user ptr to u64[] */
+    uint64_t numGroupIds   __attribute__((aligned(8)));
+    TpuStatus rmStatus;
+} UvmRangeGroupMigrationParams;  /* PREVENT/ALLOW_MIGRATION_RANGE_GROUPS */
+
+typedef struct {
+    uint64_t base __attribute__((aligned(8)));
+    TpuStatus rmStatus;
+} UvmFreeParams;
+
+typedef struct {
+    uint64_t length __attribute__((aligned(8)));          /* IN */
+    uint64_t base   __attribute__((aligned(8)));          /* OUT */
+    TpuStatus rmStatus;
+} UvmTpuAllocManagedParams;
+
+typedef struct {
+    uint64_t base   __attribute__((aligned(8)));
+    uint64_t length __attribute__((aligned(8)));
+    UvmProcessorUuid processorUuid;  /* which device touches the range */
+    uint32_t isWrite;
+    TpuStatus rmStatus;
+} UvmTpuDeviceAccessParams;
+
+typedef struct {
+    uint64_t address __attribute__((aligned(8)));         /* IN */
+    /* OUT: residency of the page containing address, one flag per tier. */
+    uint32_t residentHost;
+    uint32_t residentHbm;
+    uint32_t residentCxl;
+    uint32_t hbmDeviceInst;
+    uint32_t cpuMapped;       /* host PTE currently valid (RW) */
+    uint32_t pinnedTier;      /* thrashing pin, (uint32_t)-1 if none */
+    TpuStatus rmStatus;
+} UvmTpuResidencyInfoParams;
+
+typedef struct {
+    uint32_t testCmd;
+    TpuStatus rmStatus;
+} UvmRunTestParams;
+
+/* ================================ direct C API (TPU-native surface) ===== */
+
+typedef struct UvmVaSpace UvmVaSpace;
+
+/* Memory tiers.  Mirrors TpuAperture order (internal.h) so values convert
+ * 1:1; HBM is per-device, HOST/CXL are global. */
+typedef enum {
+    UVM_TIER_HOST = 0,
+    UVM_TIER_HBM  = 1,
+    UVM_TIER_CXL  = 2,
+    UVM_TIER_COUNT = 3,
+} UvmTier;
+
+typedef struct {
+    UvmTier tier;
+    uint32_t devInst;          /* meaningful for UVM_TIER_HBM */
+} UvmLocation;
+
+TpuStatus uvmVaSpaceCreate(UvmVaSpace **out);
+void      uvmVaSpaceDestroy(UvmVaSpace *vs);
+
+TpuStatus uvmRegisterDevice(UvmVaSpace *vs, uint32_t devInst);
+TpuStatus uvmUnregisterDevice(UvmVaSpace *vs, uint32_t devInst);
+
+/* Managed allocation: 2 MB-aligned VA, fault-populated on first touch. */
+TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr);
+TpuStatus uvmMemFree(UvmVaSpace *vs, void *ptr);
+
+/* Explicit migration of [base, base+len) to dst (UvmMigrate analog). */
+TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
+                     UvmLocation dst, uint32_t flags);
+
+/* Policy (uvm_va_policy.c analogs). */
+TpuStatus uvmSetPreferredLocation(UvmVaSpace *vs, void *base, uint64_t len,
+                                  UvmLocation loc);
+TpuStatus uvmUnsetPreferredLocation(UvmVaSpace *vs, void *base, uint64_t len);
+TpuStatus uvmSetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
+                           uint32_t devInst);
+TpuStatus uvmUnsetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
+                             uint32_t devInst);
+TpuStatus uvmSetReadDuplication(UvmVaSpace *vs, void *base, uint64_t len,
+                                int enable);
+
+/* Range groups (uvm_range_group.c analog). */
+TpuStatus uvmRangeGroupCreate(UvmVaSpace *vs, uint64_t *outId);
+TpuStatus uvmRangeGroupDestroy(UvmVaSpace *vs, uint64_t id);
+TpuStatus uvmRangeGroupSet(UvmVaSpace *vs, uint64_t id, void *base,
+                           uint64_t len);
+TpuStatus uvmRangeGroupSetMigratable(UvmVaSpace *vs, uint64_t id,
+                                     int migratable);
+
+/* Device access notification — the device-side fault source.  Ensures
+ * [base, base+len) is resident in the device's HBM (faulting + migrating
+ * non-resident pages through the batch service loop) and then returns.
+ * This is what the DMA/copy paths call before touching managed memory. */
+TpuStatus uvmDeviceAccess(UvmVaSpace *vs, uint32_t devInst, void *base,
+                          uint64_t len, int isWrite);
+
+/* Introspection (UVM_TEST_VA_RESIDENCY_INFO analog, uvm_test.c:288). */
+typedef struct {
+    uint8_t residentHost, residentHbm, residentCxl;
+    uint32_t hbmDeviceInst;
+    uint8_t cpuMapped;
+    int32_t pinnedTier;       /* -1 if not pinned by thrashing mitigation */
+} UvmResidencyInfo;
+TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out);
+
+/* ------------------------------------------------------------- fault API */
+
+typedef struct {
+    uint64_t faultsCpu;        /* CPU (SIGSEGV) faults serviced */
+    uint64_t faultsDevice;     /* device-access faults serviced */
+    uint64_t batches;          /* service-loop batches */
+    uint64_t migratedBytes;    /* bytes moved by fault servicing */
+    uint64_t evictions;        /* block evictions (oversubscription) */
+    uint64_t serviceNsP50;     /* latest-window service latency percentiles */
+    uint64_t serviceNsP95;
+} UvmFaultStats;
+void uvmFaultStatsGet(UvmFaultStats *out);
+
+/* ------------------------------------------------------------- tools API */
+
+/* Event record (reference: UvmEventEntry, uvm_tools.c mmap'd queues). */
+typedef enum {
+    UVM_EVENT_CPU_FAULT = 0,
+    UVM_EVENT_GPU_FAULT = 1,
+    UVM_EVENT_MIGRATION = 2,
+    UVM_EVENT_EVICTION = 3,
+    UVM_EVENT_THRASHING = 4,
+    UVM_EVENT_PREFETCH = 5,
+    UVM_EVENT_READ_DUP = 6,
+    UVM_EVENT_COUNT = 7,
+} UvmEventType;
+
+typedef struct {
+    uint32_t type;             /* UvmEventType */
+    uint32_t srcTier, dstTier; /* migration-ish events */
+    uint32_t devInst;
+    uint64_t address;
+    uint64_t bytes;
+    uint64_t timestampNs;
+} UvmEvent;
+
+typedef struct UvmToolsSession UvmToolsSession;
+TpuStatus uvmToolsSessionCreate(UvmVaSpace *vs, uint32_t capacity,
+                                UvmToolsSession **out);
+void      uvmToolsSessionDestroy(UvmToolsSession *s);
+void      uvmToolsEnableEvents(UvmToolsSession *s, uint64_t typeMask);
+/* Drains up to max events; returns count.  Lock-free ring; drops oldest
+ * on overflow and counts drops ("uvm_tools_events_dropped"). */
+size_t    uvmToolsReadEvents(UvmToolsSession *s, UvmEvent *buf, size_t max);
+
+/* --------------------------------------------------- in-module test API */
+
+/* Test commands (uvm_test.c:241-312 pattern; numbers are tpurm's own). */
+enum {
+    UVM_TPU_TEST_RANGE_TREE_DIRECTED  = 1,
+    UVM_TPU_TEST_RANGE_TREE_RANDOM    = 2,
+    UVM_TPU_TEST_PMM_BASIC            = 3,
+    UVM_TPU_TEST_PMM_EVICTION         = 4,
+    UVM_TPU_TEST_VA_BLOCK             = 5,
+    UVM_TPU_TEST_LOCK_SANITY          = 6,
+    UVM_TPU_TEST_FAULT_INJECT         = 7,
+};
+TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_UVM_H */

@@ -201,6 +201,24 @@ void tpurmChannelInjectError(TpurmChannel *ch)
     pthread_mutex_unlock(&ch->lock);
 }
 
+void tpurmChannelResetError(TpurmChannel *ch)
+{
+    /* Robust-channel recovery surface (reference: per-channel RC resets
+     * the channel and re-arms it, src/nvidia/src/kernel/gpu/rc/): clear
+     * the latched error so new work can proceed. */
+    if (!ch)
+        return;
+    pthread_mutex_lock(&ch->lock);
+    if (ch->error) {
+        ch->error = false;
+        tpuCounterAdd("channel_rc_resets", 1);
+        tpuLog(TPU_LOG_WARN, "channel", "RC reset: error cleared at value %llu",
+               (unsigned long long)ch->completedValue);
+    }
+    pthread_cond_broadcast(&ch->cond);
+    pthread_mutex_unlock(&ch->lock);
+}
+
 /* ------------------------------------------------------- transfer engine */
 
 TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,

@@ -4,10 +4,13 @@
  * Locking order (reference pattern: uvm_lock.h:31+ — order documented as
  * data, asserted at runtime in debug builds via tpuLockTrack*):
  *   1. g_rm.lock        (object model / attach state)
- *   2. cxl table lock
- *   3. pin accounting lock
- *   4. per-channel lock
- *   5. journal/counters
+ *   2. UVM VA space lock
+ *   3. UVM VA block lock
+ *   4. UVM PMM / tier-arena lock
+ *   5. cxl table lock
+ *   6. pin accounting lock
+ *   7. per-channel lock
+ *   8. journal/counters
  */
 #ifndef TPURM_INTERNAL_H
 #define TPURM_INTERNAL_H
@@ -24,10 +27,13 @@
 
 enum tpu_lock_order {
     TPU_LOCK_RM = 1,
-    TPU_LOCK_CXL = 2,
-    TPU_LOCK_PIN = 3,
-    TPU_LOCK_CHANNEL = 4,
-    TPU_LOCK_DIAG = 5,
+    TPU_LOCK_UVM_VASPACE = 2,
+    TPU_LOCK_UVM_BLOCK = 3,
+    TPU_LOCK_UVM_PMM = 4,
+    TPU_LOCK_CXL = 5,
+    TPU_LOCK_PIN = 6,
+    TPU_LOCK_CHANNEL = 7,
+    TPU_LOCK_DIAG = 8,
 };
 
 /* Debug lock-order tracker (no-ops in release builds). */
@@ -115,6 +121,14 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
 /* Test/introspection surface. */
 uint32_t  tpuCxlRegisteredCount(void);
 uint64_t  tpuCxlPinnedBytes(void);
+
+/* ---------------------------------------------------------------- uvm fd  */
+
+/* Per-fd UVM state management for /dev/nvidia-uvm pseudo-fds
+ * (implemented in uvm/uvm_ioctl.c). */
+void *tpuUvmFdOpen(void);
+void  tpuUvmFdClose(void *state);
+int   tpuUvmFdIoctl(void *state, unsigned long request, void *argp);
 
 /* -------------------------------------------------------------- transfer  */
 

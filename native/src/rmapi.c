@@ -47,10 +47,19 @@ static struct {
 
 /* ------------------------------------------------------------ pseudo fds */
 
+typedef enum {
+    PFD_DEVICE = 0,
+    PFD_CONTROL = 1,
+    PFD_UVM = 2,
+} PseudoFdKind;
+
 typedef struct {
     bool used;
-    bool isControl;
+    bool closing;              /* close requested; waiting for refs to drain */
+    uint32_t refs;             /* in-flight ioctls */
+    uint8_t kind;
     uint32_t devInst;
+    void *uvmState;            /* PFD_UVM: per-fd VA space (uvm_ioctl.c) */
 } PseudoFd;
 
 static struct {
@@ -64,7 +73,7 @@ static struct {
 
 int tpurm_open(const char *path)
 {
-    bool isControl = false;
+    PseudoFdKind kind = PFD_DEVICE;
     uint32_t devInst = 0;
 
     if (!path) {
@@ -74,7 +83,10 @@ int tpurm_open(const char *path)
     tpuDeviceGlobalInit();
 
     if (strcmp(path, "/dev/nvidiactl") == 0 || strcmp(path, "/dev/tpuctl") == 0) {
-        isControl = true;
+        kind = PFD_CONTROL;
+    } else if (strcmp(path, "/dev/nvidia-uvm") == 0 ||
+               strcmp(path, "/dev/tpu-uvm") == 0) {
+        kind = PFD_UVM;
     } else if (strncmp(path, "/dev/nvidia", 11) == 0 && path[11] >= '0' &&
                path[11] <= '9') {
         devInst = (uint32_t)strtoul(path + 11, NULL, 10);
@@ -84,24 +96,50 @@ int tpurm_open(const char *path)
         errno = ENOENT;
         return -1;
     }
-    if (!isControl && tpurmDeviceGet(devInst) == NULL) {
+    if (kind == PFD_DEVICE && tpurmDeviceGet(devInst) == NULL) {
         errno = ENODEV;
         return -1;
+    }
+
+    void *uvmState = NULL;
+    if (kind == PFD_UVM) {
+        uvmState = tpuUvmFdOpen();
+        if (!uvmState) {
+            errno = ENOMEM;
+            return -1;
+        }
     }
 
     pthread_mutex_lock(&g_fds.lock);
     for (int i = 0; i < MAX_PSEUDO_FDS; i++) {
         if (!g_fds.fds[i].used) {
             g_fds.fds[i].used = true;
-            g_fds.fds[i].isControl = isControl;
+            g_fds.fds[i].closing = false;
+            g_fds.fds[i].refs = 0;
+            g_fds.fds[i].kind = (uint8_t)kind;
             g_fds.fds[i].devInst = devInst;
+            g_fds.fds[i].uvmState = uvmState;
             pthread_mutex_unlock(&g_fds.lock);
             return PSEUDO_FD_BASE + i;
         }
     }
     pthread_mutex_unlock(&g_fds.lock);
+    if (uvmState)
+        tpuUvmFdClose(uvmState);
     errno = EMFILE;
     return -1;
+}
+
+/* Finalize a drained fd slot (lock held on entry, released here). */
+static void fd_finalize_locked(PseudoFd *fd)
+{
+    void *uvmState = fd->uvmState;
+    fd->uvmState = NULL;
+    fd->used = false;
+    fd->closing = false;
+    pthread_mutex_unlock(&g_fds.lock);
+    if (uvmState)
+        tpuUvmFdClose(uvmState);
 }
 
 int tpurm_close(int pfd)
@@ -112,13 +150,19 @@ int tpurm_close(int pfd)
         return -1;
     }
     pthread_mutex_lock(&g_fds.lock);
-    bool was = g_fds.fds[idx].used;
-    g_fds.fds[idx].used = false;
-    pthread_mutex_unlock(&g_fds.lock);
-    if (!was) {
+    PseudoFd *fd = &g_fds.fds[idx];
+    if (!fd->used || fd->closing) {
+        pthread_mutex_unlock(&g_fds.lock);
         errno = EBADF;
         return -1;
     }
+    if (fd->refs > 0) {
+        /* In-flight ioctls hold references: the last one finalizes. */
+        fd->closing = true;
+        pthread_mutex_unlock(&g_fds.lock);
+        return 0;
+    }
+    fd_finalize_locked(fd);
     return 0;
 }
 
@@ -448,22 +492,12 @@ TpuStatus tpurmControl(TpuRmControlParams *p)
 
 /* ------------------------------------------------------------- ioctl glue */
 
-int tpurm_ioctl(int pfd, unsigned long request, void *argp)
+static int tpurm_ioctl_dispatch(unsigned long request, void *argp)
 {
-    int idx = pfd - PSEUDO_FD_BASE;
-    if (idx < 0 || idx >= MAX_PSEUDO_FDS || !g_fds.fds[idx].used) {
-        errno = EBADF;
-        return -1;
-    }
     if (_IOC_TYPE(request) != TPU_IOCTL_MAGIC) {
         errno = ENOTTY;
         return -1;
     }
-    if (!argp) {
-        errno = EFAULT;
-        return -1;
-    }
-
     switch (_IOC_NR(request)) {
     case TPU_ESC_RM_ALLOC:
         tpurmAlloc((TpuRmAllocParams *)argp);
@@ -478,4 +512,47 @@ int tpurm_ioctl(int pfd, unsigned long request, void *argp)
         errno = ENOTTY;
         return -1;
     }
+}
+
+int tpurm_ioctl(int pfd, unsigned long request, void *argp)
+{
+    int idx = pfd - PSEUDO_FD_BASE;
+    if (idx < 0 || idx >= MAX_PSEUDO_FDS) {
+        errno = EBADF;
+        return -1;
+    }
+    if (!argp) {
+        errno = EFAULT;
+        return -1;
+    }
+    /* Take a reference so a racing tpurm_close cannot free per-fd state
+     * under us; the last in-flight ioctl finalizes a pending close. */
+    pthread_mutex_lock(&g_fds.lock);
+    PseudoFd *fd = &g_fds.fds[idx];
+    if (!fd->used || fd->closing) {
+        pthread_mutex_unlock(&g_fds.lock);
+        errno = EBADF;
+        return -1;
+    }
+    fd->refs++;
+    uint8_t kind = fd->kind;
+    void *uvmState = fd->uvmState;
+    pthread_mutex_unlock(&g_fds.lock);
+
+    int rc;
+    /* UVM fds use the reference's raw command numbers (uvm_ioctl.h),
+     * not _IOWR encodings — dispatch before the magic check. */
+    if (kind == PFD_UVM) {
+        rc = tpuUvmFdIoctl(uvmState, request, argp);
+    } else {
+        rc = tpurm_ioctl_dispatch(request, argp);
+    }
+
+    pthread_mutex_lock(&g_fds.lock);
+    fd->refs--;
+    if (fd->closing && fd->refs == 0)
+        fd_finalize_locked(fd);
+    else
+        pthread_mutex_unlock(&g_fds.lock);
+    return rc;
 }

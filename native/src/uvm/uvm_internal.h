@@ -1,0 +1,324 @@
+/*
+ * UVM internals.  Lock order extends internal.h's table (reference pattern:
+ * uvm_lock.h:31+ — order documented as data, asserted in debug builds):
+ *
+ *   1. g_rm.lock
+ *   2. VA space lock          (TPU_LOCK_UVM_VASPACE)
+ *   3. VA block lock          (TPU_LOCK_UVM_BLOCK)
+ *   4. PMM / tier-arena lock  (TPU_LOCK_UVM_PMM)
+ *   5. CXL table lock
+ *   6. pin accounting lock
+ *   7. per-channel lock
+ *   8. journal/counters
+ *
+ * The fault service thread acquires VA space (read side) -> block -> PMM,
+ * exactly the reference's uvm_va_space read lock -> block lock -> PMM order.
+ */
+#ifndef TPURM_UVM_INTERNAL_H
+#define TPURM_UVM_INTERNAL_H
+
+#include <pthread.h>
+#include <stdbool.h>
+#include <stdint.h>
+
+#include "../internal.h"
+#include "tpurm/uvm.h"
+
+/* ------------------------------------------------------------ geometry */
+
+#define UVM_BLOCK_SIZE        (2ull * 1024 * 1024)   /* uvm_pmm_gpu.h:60-85 */
+#define UVM_PAGE_SHIFT_MIN    12
+/* Default UVM page size: 64 KB — the TPU-native granule (XLA tiles and HBM
+ * transfers favor >=32 KB lines); registry "uvm_page_size" can lower it to
+ * 4 KB for reference-equivalent granularity.  32 pages/block at 64 KB. */
+#define UVM_PAGE_SIZE_DEFAULT (64ull * 1024)
+#define UVM_MAX_PAGES_PER_BLOCK 512                  /* 2 MB / 4 KB */
+
+typedef struct {
+    uint64_t bits[UVM_MAX_PAGES_PER_BLOCK / 64];
+} UvmPageMask;
+
+void uvmPageMaskZero(UvmPageMask *m);
+void uvmPageMaskFill(UvmPageMask *m, uint32_t npages);
+bool uvmPageMaskTest(const UvmPageMask *m, uint32_t page);
+void uvmPageMaskSet(UvmPageMask *m, uint32_t page);
+void uvmPageMaskClear(UvmPageMask *m, uint32_t page);
+void uvmPageMaskSetRange(UvmPageMask *m, uint32_t first, uint32_t count);
+void uvmPageMaskClearRange(UvmPageMask *m, uint32_t first, uint32_t count);
+uint32_t uvmPageMaskWeight(const UvmPageMask *m, uint32_t npages);
+bool uvmPageMaskEmpty(const UvmPageMask *m, uint32_t npages);
+bool uvmPageMaskFull(const UvmPageMask *m, uint32_t npages);
+/* First set/clear bit at or after `from`; returns npages if none. */
+uint32_t uvmPageMaskFindSet(const UvmPageMask *m, uint32_t npages,
+                            uint32_t from);
+uint32_t uvmPageMaskFindClear(const UvmPageMask *m, uint32_t npages,
+                              uint32_t from);
+
+/* ----------------------------------------------------------- range tree */
+
+/* Non-overlapping [start, end] interval tree (reference: uvm_range_tree.c),
+ * an AVL tree keyed by start with linked in-order iteration. */
+typedef struct UvmRangeTreeNode {
+    uint64_t start, end;              /* inclusive end, like the reference */
+    struct UvmRangeTreeNode *left, *right, *parent;
+    struct UvmRangeTreeNode *prev, *next;   /* in-order list */
+    int height;
+} UvmRangeTreeNode;
+
+typedef struct {
+    UvmRangeTreeNode *root;
+    UvmRangeTreeNode *first;
+} UvmRangeTree;
+
+void uvmRangeTreeInit(UvmRangeTree *t);
+/* Fails with TPU_ERR_STATE_IN_USE on overlap. */
+TpuStatus uvmRangeTreeAdd(UvmRangeTree *t, UvmRangeTreeNode *n);
+void uvmRangeTreeRemove(UvmRangeTree *t, UvmRangeTreeNode *n);
+UvmRangeTreeNode *uvmRangeTreeFind(UvmRangeTree *t, uint64_t addr);
+/* First node intersecting [start,end], or NULL. */
+UvmRangeTreeNode *uvmRangeTreeIterFirst(UvmRangeTree *t, uint64_t start,
+                                        uint64_t end);
+UvmRangeTreeNode *uvmRangeTreeIterNext(UvmRangeTreeNode *n, uint64_t end);
+UvmRangeTreeNode *uvmRangeTreeNext(UvmRangeTreeNode *n);
+
+/* ----------------------------------------------------------------- PMM */
+
+/* Buddy chunk allocator over a byte arena (reference: uvm_pmm_gpu.c).
+ * Chunk sizes: 64 KB ... 2 MB powers of two (root = 2 MB, 6 levels);
+ * with 4 KB uvm_page_size the leaf level extends to 4 KB (10 levels). */
+#define UVM_PMM_MAX_LEVELS 10
+
+typedef struct UvmPmmChunk {
+    uint64_t offset;                  /* byte offset into the arena */
+    uint8_t level;                    /* 0 = root (2 MB) */
+    bool allocated;
+    struct UvmPmmChunk *buddyParent;
+    struct UvmPmmChunk *next, *prev;  /* freelist links */
+} UvmPmmChunk;
+
+typedef struct UvmPmm {
+    pthread_mutex_t lock;             /* order TPU_LOCK_UVM_PMM */
+    uint64_t arenaSize;
+    uint64_t chunkMin;                /* leaf chunk size */
+    uint32_t levels;                  /* root..leaf inclusive */
+    uint64_t allocatedBytes;
+    UvmPmmChunk *freelist[UVM_PMM_MAX_LEVELS];
+    struct UvmPmmChunk **rootChunks;  /* lazily created roots */
+    uint64_t rootCount;
+} UvmPmm;
+
+TpuStatus uvmPmmInit(UvmPmm *pmm, uint64_t arenaSize, uint64_t chunkMin);
+void      uvmPmmDeinit(UvmPmm *pmm);
+/* size must be a power-of-two chunk size in [chunkMin, 2MB].  Returns
+ * TPU_ERR_NO_MEMORY when the arena is exhausted (caller evicts, retries). */
+TpuStatus uvmPmmAlloc(UvmPmm *pmm, uint64_t size, UvmPmmChunk **out);
+void      uvmPmmFree(UvmPmm *pmm, UvmPmmChunk *chunk);
+uint64_t  uvmPmmChunkSize(const UvmPmm *pmm, const UvmPmmChunk *c);
+uint64_t  uvmPmmAllocatedBytes(UvmPmm *pmm);
+
+/* ------------------------------------------------------------ tier arena */
+
+/* A physical tier: byte arena + PMM + eviction LRU of blocks with
+ * residency in it.  HBM tiers wrap a device arena; the CXL tier wraps the
+ * CXL expander window (fake mode: private mmap sized by registry
+ * "cxl_tier_bytes"). */
+struct UvmVaBlock;
+
+typedef struct UvmTierArena {
+    pthread_mutex_t lock;             /* order TPU_LOCK_UVM_PMM */
+    pthread_cond_t evictCond;         /* evicting-flag handshake */
+    UvmTier tier;
+    uint32_t devInst;                 /* HBM only */
+    void *base;
+    uint64_t size;
+    UvmPmm pmm;
+    /* Eviction LRU: blocks with residency in this arena, oldest first
+     * (reference: root-chunk LRU, uvm_pmm_gpu.c). */
+    struct UvmVaBlock *lruHead, *lruTail;
+} UvmTierArena;
+
+/* --------------------------------------------------------------- blocks */
+
+typedef struct UvmChunkRun {
+    uint32_t firstPage, numPages;
+    UvmPmmChunk *chunk;
+    UvmTierArena *arena;
+    struct UvmChunkRun *next;
+} UvmChunkRun;
+
+struct UvmVaRange;
+
+typedef struct UvmVaBlock {
+    pthread_mutex_t lock;             /* order TPU_LOCK_UVM_BLOCK */
+    struct UvmVaRange *range;
+    uint64_t start;                   /* VA, block-aligned */
+    uint32_t npages;
+    UvmPageMask resident[UVM_TIER_COUNT];
+    UvmPageMask cpuMapped;            /* pages with valid (RW) host PTEs */
+    UvmPageMask devMapped;            /* pages device may access directly */
+    UvmChunkRun *hbmRuns;             /* HBM backing (per-run chunks) */
+    UvmChunkRun *cxlRuns;             /* CXL backing */
+    uint32_t hbmDevInst;              /* single-HBM-device-per-block rule */
+    /* Eviction LRU links: index 0 = HBM arena, 1 = CXL arena (a block can
+     * have residency in both tiers at once under read duplication).
+     * `evicting` is set while an evictor popped this block off the list
+     * and still holds its raw pointer; uvmBlockFreeBacking waits for it
+     * to clear before tearing the block down (lifetime guard). */
+    struct {
+        struct UvmVaBlock *prev, *next;
+        bool on;
+        bool evicting;
+    } lru[2];
+    /* Perf state (thrashing/prefetch, uvm_perf_thrashing.h:33-46). */
+    uint32_t faultCount;
+    uint64_t lastFaultNs;
+    uint64_t windowStartNs;
+    uint32_t windowFaults;
+    uint32_t windowSwitches;          /* tier alternations in the window */
+    uint64_t thrashWindowStartNs;     /* thrash detector's own window */
+    int32_t lastTargetTier;           /* -1 = none yet */
+    int32_t pinnedTier;               /* -1 = not pinned */
+    uint64_t pinExpiryNs;
+} UvmVaBlock;
+
+typedef enum {
+    UVM_RANGE_TYPE_MANAGED = 0,
+    UVM_RANGE_TYPE_EXTERNAL = 1,
+} UvmRangeType;
+
+typedef struct UvmVaRange {
+    UvmRangeTreeNode node;            /* start/end in the space tree */
+    UvmVaSpace *vaSpace;
+    UvmRangeType type;
+    uint64_t size;
+    /* Policy (reference: uvm_va_policy.c). */
+    bool hasPreferred;
+    UvmLocation preferred;
+    uint64_t accessedByMask;          /* bit per device inst */
+    bool readDuplication;
+    uint64_t rangeGroupId;            /* 0 = none */
+    /* Blocks, one per 2 MB span. */
+    UvmVaBlock **blocks;
+    uint32_t blockCount;
+} UvmVaRange;
+
+struct UvmVaSpace {
+    pthread_mutex_t lock;             /* order TPU_LOCK_UVM_VASPACE */
+    UvmRangeTree ranges;
+    uint64_t registeredDevMask;
+    uint64_t nextRangeGroupId;
+    /* Range groups: simple table id -> migratable flag. */
+    struct UvmRangeGroup *groups;
+    struct UvmVaSpace *nextSpace;     /* global list for fault lookup */
+    uint64_t pageSize;
+    struct UvmToolsSession *toolsHead;/* sessions (under vs lock) */
+};
+
+typedef struct UvmRangeGroup {
+    uint64_t id;
+    bool migratable;
+    struct UvmRangeGroup *next;
+} UvmRangeGroup;
+
+/* ------------------------------------------------------- block services */
+
+uint64_t uvmPageSize(void);
+uint32_t uvmPagesPerBlock(void);
+
+UvmTierArena *uvmTierArenaHbm(uint32_t devInst);   /* NULL if no device */
+UvmTierArena *uvmTierArenaCxl(void);
+
+/* Make [first, first+count) pages of the block resident in dst, copying
+ * from wherever they are now through the device CE channel; updates masks
+ * and host PTE protection.  Takes the block lock internally and may drop
+ * it to run eviction when the destination arena is full (the reference
+ * drops block locks around PMA eviction the same way, uvm_pmm_gpu.c).
+ * (reference: uvm_va_block_make_resident, uvm_va_block.c:5086.) */
+TpuStatus uvmBlockMakeResident(UvmVaBlock *blk, UvmLocation dst,
+                               uint32_t firstPage, uint32_t count,
+                               bool forWrite);
+/* forceDup keeps source copies even when the range policy has read
+ * duplication off — used by thrashing mitigation (PIN hint) so a pinned
+ * device copy survives CPU read faults. */
+TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
+                                 uint32_t firstPage, uint32_t count,
+                                 bool forWrite, bool forceDup);
+/* Evict all of blk's residency in `arena` back to host.  Uses trylock on
+ * the block (returns TPU_ERR_STATE_IN_USE if contended) so cross-eviction
+ * between two allocating threads cannot deadlock.
+ * (reference eviction: uvm_pmm_gpu.c root-chunk eviction.) */
+TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena);
+void uvmBlockFreeBacking(UvmVaBlock *blk);
+
+/* Host PTE control over the managed VA (mprotect). */
+void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
+                          uint32_t count, int prot);
+
+/* LRU maintenance (arena lock taken inside). */
+void uvmLruTouch(UvmTierArena *a, UvmVaBlock *blk);
+void uvmLruRemove(UvmTierArena *a, UvmVaBlock *blk);
+/* Pop the least-recently-used unpinned block (never `exclude`), or NULL.
+ * The returned block has its `evicting` guard set; the caller MUST call
+ * uvmLruEvictDone once it no longer holds the pointer. */
+UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude);
+void uvmLruEvictDone(UvmTierArena *a, UvmVaBlock *blk);
+/* Wait until no evictor holds blk for this arena (called before free). */
+void uvmLruAwaitEvictors(UvmTierArena *a, UvmVaBlock *blk);
+
+/* Range/block lookup: returns range and block covering addr (vs lock must
+ * be held); blockOut may be NULL. */
+UvmVaRange *uvmRangeFind(UvmVaSpace *vs, uint64_t addr, UvmVaBlock **blockOut);
+/* True if the range group (0 = ungrouped) currently allows migration
+ * (UvmPreventMigrationRangeGroups semantics; vs lock must be held). */
+bool uvmRangeGroupMigratable(UvmVaSpace *vs, uint64_t groupId);
+
+/* --------------------------------------------------------- fault engine */
+
+typedef enum {
+    UVM_FAULT_SRC_CPU = 0,
+    UVM_FAULT_SRC_DEVICE = 1,
+} UvmFaultSource;
+
+typedef struct UvmFaultEntry {
+    uint64_t addr;
+    uint64_t len;                     /* device faults may span a range */
+    uint8_t isWrite;
+    uint8_t source;                   /* UvmFaultSource */
+    uint32_t devInst;                 /* device faults */
+    UvmVaSpace *vs;                   /* NULL: resolved via snapshot */
+    uint64_t enqueueNs;
+    TpuStatus serviceStatus;
+    /* Waiter futex word (0 pending, 1 done, 2 failed). */
+    uint32_t *doneWord;
+} UvmFaultEntry;
+
+void uvmFaultEngineInit(void);        /* idempotent */
+void uvmFaultEngineRegisterSpace(UvmVaSpace *vs);
+void uvmFaultEngineUnregisterSpace(UvmVaSpace *vs);
+/* Rebuild the signal-safe VA lookup snapshot after range add/remove. */
+void uvmFaultSnapshotRebuild(void);
+/* Enqueue + wait (device faults call this synchronously). */
+TpuStatus uvmFaultServiceSync(UvmFaultEntry *e);
+void uvmFaultStatsRecordMigration(uint64_t bytes);
+void uvmFaultStatsRecordEviction(void);
+
+/* ----------------------------------------------------------- perf hooks */
+
+/* Returns the expanded [firstPage,count) to service for a fault at page
+ * (prefetch region growth, uvm_perf_prefetch.c analog). */
+void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
+                           uint32_t *firstPage, uint32_t *count);
+/* Record a fault on blk; may pin the block to its current tier for a
+ * window (thrashing mitigation, uvm_perf_thrashing.h:33-46). */
+void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier);
+bool uvmPerfBlockPinnedAgainst(UvmVaBlock *blk, UvmTier targetTier);
+
+/* ---------------------------------------------------------- tools hooks */
+
+void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
+                  uint32_t dstTier, uint32_t devInst, uint64_t address,
+                  uint64_t bytes);
+
+uint64_t uvmMonotonicNs(void);
+
+#endif /* TPURM_UVM_INTERNAL_H */

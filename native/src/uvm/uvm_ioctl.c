@@ -1,0 +1,319 @@
+/*
+ * UVM ioctl dispatch — the /dev/nvidia-uvm surface.
+ *
+ * Re-design of the reference's route table (kernel-open/nvidia-uvm/
+ * uvm.c:1026-1070): each pseudo-fd owns a VA space created at
+ * UVM_INITIALIZE (uvm.c:144 uvm_open + UVM_INITIALIZE semantics —
+ * calling other ioctls first returns NV_ERR_ILLEGAL_ACTION-equivalent
+ * INVALID_STATE), raw command numbers (not _IOWR encodings), rmStatus
+ * carried inside the param block with ioctl(2) returning 0.
+ *
+ * Processor UUID convention (uvm.h): zero = CPU, "TPU\0"+LE32(inst) =
+ * device HBM, "CXL\0" = the CXL tier.  The reference addresses processors
+ * by real GPU UUIDs; tpurm devices synthesize stable UUIDs from their
+ * instance number (the reference's are just opaque 16-byte cookies to
+ * userspace too).
+ */
+#include "uvm_internal.h"
+
+#include <errno.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    UvmVaSpace *vs;              /* NULL until UVM_INITIALIZE */
+    UvmToolsSession *tools;      /* NULL until TOOLS_INIT_EVENT_TRACKER */
+} UvmFdState;
+
+/* ------------------------------------------------------- uuid conversion */
+
+static void uuid_for_device(uint32_t inst, UvmProcessorUuid *u)
+{
+    memset(u, 0, sizeof(*u));
+    u->uuid[0] = 'T';
+    u->uuid[1] = 'P';
+    u->uuid[2] = 'U';
+    memcpy(&u->uuid[4], &inst, sizeof(inst));
+}
+
+/* Returns false if the uuid encodes no known processor. */
+static bool uuid_to_location(const UvmProcessorUuid *u, UvmLocation *out)
+{
+    static const uint8_t zeros[16];
+    if (memcmp(u->uuid, zeros, 16) == 0) {
+        out->tier = UVM_TIER_HOST;
+        out->devInst = 0;
+        return true;
+    }
+    if (u->uuid[0] == 'T' && u->uuid[1] == 'P' && u->uuid[2] == 'U' &&
+        u->uuid[3] == 0) {
+        out->tier = UVM_TIER_HBM;
+        memcpy(&out->devInst, &u->uuid[4], sizeof(out->devInst));
+        return true;
+    }
+    if (u->uuid[0] == 'C' && u->uuid[1] == 'X' && u->uuid[2] == 'L' &&
+        u->uuid[3] == 0) {
+        out->tier = UVM_TIER_CXL;
+        out->devInst = 0;
+        return true;
+    }
+    return false;
+}
+
+/* ------------------------------------------------------------ fd plumbing */
+
+void *tpuUvmFdOpen(void)
+{
+    return calloc(1, sizeof(UvmFdState));
+}
+
+void tpuUvmFdClose(void *state)
+{
+    UvmFdState *fd = state;
+    if (!fd)
+        return;
+    if (fd->tools)
+        uvmToolsSessionDestroy(fd->tools);
+    if (fd->vs)
+        uvmVaSpaceDestroy(fd->vs);
+    free(fd);
+}
+
+/* ---------------------------------------------------------------- dispatch */
+
+int tpuUvmFdIoctl(void *state, unsigned long request, void *argp)
+{
+    UvmFdState *fd = state;
+    if (!fd) {
+        errno = EBADF;
+        return -1;
+    }
+
+    if (request == UVM_INITIALIZE) {
+        UvmInitializeParams *p = argp;
+        if (fd->vs) {
+            p->rmStatus = TPU_OK;    /* idempotent, like the reference */
+            return 0;
+        }
+        p->rmStatus = uvmVaSpaceCreate(&fd->vs);
+        return 0;
+    }
+    if (request == UVM_DEINITIALIZE) {
+        if (fd->tools) {
+            uvmToolsSessionDestroy(fd->tools);
+            fd->tools = NULL;
+        }
+        if (fd->vs) {
+            uvmVaSpaceDestroy(fd->vs);
+            fd->vs = NULL;
+        }
+        return 0;
+    }
+
+    if (!fd->vs) {
+        /* Reference: ioctls before UVM_INITIALIZE fail
+         * (uvm_ioctl.h:1069-1084 comment). rmStatus is the first u32
+         * field in some param structs but not all; INVALID_STATE via
+         * errno is the transport-level contract here. */
+        errno = EINVAL;
+        return -1;
+    }
+    UvmVaSpace *vs = fd->vs;
+
+    switch (request) {
+    case UVM_REGISTER_GPU: {
+        UvmRegisterGpuParams *p = argp;
+        UvmLocation loc;
+        static const uint8_t zeros[16];
+        if (memcmp(p->gpuUuid.uuid, zeros, 16) == 0) {
+            /* Unspecified: register device 0 and report its UUID. */
+            loc.tier = UVM_TIER_HBM;
+            loc.devInst = 0;
+        } else if (!uuid_to_location(&p->gpuUuid, &loc) ||
+                   loc.tier != UVM_TIER_HBM) {
+            p->rmStatus = TPU_ERR_INVALID_DEVICE;
+            return 0;
+        }
+        p->rmStatus = uvmRegisterDevice(vs, loc.devInst);
+        if (p->rmStatus == TPU_OK) {
+            uuid_for_device(loc.devInst, &p->gpuUuid);
+            p->numaEnabled = 0;
+            p->numaNodeId = -1;
+        }
+        return 0;
+    }
+    case UVM_UNREGISTER_GPU: {
+        UvmUnregisterGpuParams *p = argp;
+        UvmLocation loc;
+        if (!uuid_to_location(&p->gpuUuid, &loc) ||
+            loc.tier != UVM_TIER_HBM) {
+            p->rmStatus = TPU_ERR_INVALID_DEVICE;
+            return 0;
+        }
+        p->rmStatus = uvmUnregisterDevice(vs, loc.devInst);
+        return 0;
+    }
+    case UVM_PAGEABLE_MEM_ACCESS: {
+        /* No ATS/HMM analog wired yet: pageable access unsupported. */
+        struct { uint8_t pageableMemAccess; } *p = argp;
+        p->pageableMemAccess = 0;
+        return 0;
+    }
+    case UVM_TPU_ALLOC_MANAGED: {
+        UvmTpuAllocManagedParams *p = argp;
+        void *ptr = NULL;
+        p->rmStatus = uvmMemAlloc(vs, p->length, &ptr);
+        p->base = (uintptr_t)ptr;
+        return 0;
+    }
+    case UVM_FREE: {
+        UvmFreeParams *p = argp;
+        p->rmStatus = uvmMemFree(vs, (void *)(uintptr_t)p->base);
+        return 0;
+    }
+    case UVM_MIGRATE: {
+        UvmMigrateParams *p = argp;
+        UvmLocation dst;
+        if (!uuid_to_location(&p->destinationUuid, &dst)) {
+            p->rmStatus = TPU_ERR_INVALID_DEVICE;
+            return 0;
+        }
+        p->userSpaceStart = p->base;
+        p->userSpaceLength = p->length;
+        p->rmStatus = uvmMigrate(vs, (void *)(uintptr_t)p->base, p->length,
+                                 dst, p->flags);
+        /* Reference semantics: semaphore released on completion
+         * (uvm_migrate.c:735); completion is synchronous here. */
+        if (p->rmStatus == TPU_OK && p->semaphoreAddress)
+            *(volatile uint32_t *)(uintptr_t)p->semaphoreAddress =
+                p->semaphorePayload;
+        return 0;
+    }
+    case UVM_SET_PREFERRED_LOCATION: {
+        UvmSetPreferredLocationParams *p = argp;
+        UvmLocation loc;
+        if (!uuid_to_location(&p->preferredLocation, &loc)) {
+            p->rmStatus = TPU_ERR_INVALID_DEVICE;
+            return 0;
+        }
+        p->rmStatus = uvmSetPreferredLocation(
+            vs, (void *)(uintptr_t)p->requestedBase, p->length, loc);
+        return 0;
+    }
+    case UVM_UNSET_PREFERRED_LOCATION: {
+        UvmRangeOpParams *p = argp;
+        p->rmStatus = uvmUnsetPreferredLocation(
+            vs, (void *)(uintptr_t)p->requestedBase, p->length);
+        return 0;
+    }
+    case UVM_ENABLE_READ_DUPLICATION:
+    case UVM_DISABLE_READ_DUPLICATION: {
+        UvmRangeOpParams *p = argp;
+        p->rmStatus = uvmSetReadDuplication(
+            vs, (void *)(uintptr_t)p->requestedBase, p->length,
+            request == UVM_ENABLE_READ_DUPLICATION);
+        return 0;
+    }
+    case UVM_SET_ACCESSED_BY:
+    case UVM_UNSET_ACCESSED_BY: {
+        UvmAccessedByParams *p = argp;
+        UvmLocation loc;
+        if (!uuid_to_location(&p->accessedByUuid, &loc) ||
+            loc.tier != UVM_TIER_HBM) {
+            p->rmStatus = TPU_ERR_INVALID_DEVICE;
+            return 0;
+        }
+        void *base = (void *)(uintptr_t)p->requestedBase;
+        p->rmStatus = request == UVM_SET_ACCESSED_BY
+                          ? uvmSetAccessedBy(vs, base, p->length, loc.devInst)
+                          : uvmUnsetAccessedBy(vs, base, p->length,
+                                               loc.devInst);
+        return 0;
+    }
+    case UVM_CREATE_RANGE_GROUP: {
+        UvmRangeGroupParams *p = argp;
+        p->rmStatus = uvmRangeGroupCreate(vs, &p->rangeGroupId);
+        return 0;
+    }
+    case UVM_DESTROY_RANGE_GROUP: {
+        UvmRangeGroupParams *p = argp;
+        p->rmStatus = uvmRangeGroupDestroy(vs, p->rangeGroupId);
+        return 0;
+    }
+    case UVM_SET_RANGE_GROUP: {
+        UvmSetRangeGroupParams *p = argp;
+        p->rmStatus = uvmRangeGroupSet(vs, p->rangeGroupId,
+                                       (void *)(uintptr_t)p->requestedBase,
+                                       p->length);
+        return 0;
+    }
+    case UVM_PREVENT_MIGRATION_RANGE_GROUPS:
+    case UVM_ALLOW_MIGRATION_RANGE_GROUPS: {
+        UvmRangeGroupMigrationParams *p = argp;
+        const uint64_t *ids = (const uint64_t *)(uintptr_t)p->rangeGroupIds;
+        if (!ids && p->numGroupIds) {
+            p->rmStatus = TPU_ERR_INVALID_ARGUMENT;
+            return 0;
+        }
+        TpuStatus st = TPU_OK;
+        for (uint64_t i = 0; i < p->numGroupIds && st == TPU_OK; i++)
+            st = uvmRangeGroupSetMigratable(
+                vs, ids[i], request == UVM_ALLOW_MIGRATION_RANGE_GROUPS);
+        p->rmStatus = st;
+        return 0;
+    }
+    case UVM_TPU_DEVICE_ACCESS: {
+        UvmTpuDeviceAccessParams *p = argp;
+        UvmLocation loc;
+        if (!uuid_to_location(&p->processorUuid, &loc) ||
+            loc.tier != UVM_TIER_HBM) {
+            p->rmStatus = TPU_ERR_INVALID_DEVICE;
+            return 0;
+        }
+        p->rmStatus = uvmDeviceAccess(vs, loc.devInst,
+                                      (void *)(uintptr_t)p->base, p->length,
+                                      p->isWrite != 0);
+        return 0;
+    }
+    case UVM_TPU_RESIDENCY_INFO: {
+        UvmTpuResidencyInfoParams *p = argp;
+        UvmResidencyInfo info;
+        p->rmStatus = uvmResidencyInfo(vs, (void *)(uintptr_t)p->address,
+                                       &info);
+        if (p->rmStatus == TPU_OK) {
+            p->residentHost = info.residentHost;
+            p->residentHbm = info.residentHbm;
+            p->residentCxl = info.residentCxl;
+            p->hbmDeviceInst = info.hbmDeviceInst;
+            p->cpuMapped = info.cpuMapped;
+            p->pinnedTier = (uint32_t)info.pinnedTier;
+        }
+        return 0;
+    }
+    case UVM_RUN_TEST: {
+        UvmRunTestParams *p = argp;
+        p->rmStatus = uvmRunTest(vs, p->testCmd);
+        return 0;
+    }
+    case UVM_TOOLS_INIT_EVENT_TRACKER: {
+        /* In-process sessions replace the reference's mmap'd queues; the
+         * param block's buffer pointers are unused (uvm.h note). */
+        if (!fd->tools) {
+            TpuStatus st = uvmToolsSessionCreate(vs, 1024, &fd->tools);
+            (void)st;
+        }
+        return 0;
+    }
+    case UVM_TOOLS_EVENT_QUEUE_ENABLE_EVENTS:
+    case UVM_TOOLS_EVENT_QUEUE_DISABLE_EVENTS:
+    case UVM_TOOLS_ENABLE_COUNTERS:
+    case UVM_TOOLS_DISABLE_COUNTERS:
+    case UVM_TOOLS_SET_NOTIFICATION_THRESHOLD:
+    case UVM_TOOLS_FLUSH_EVENTS:
+        /* Accepted; session state is managed via the direct C API. */
+        return 0;
+    default:
+        errno = ENOTTY;
+        return -1;
+    }
+}

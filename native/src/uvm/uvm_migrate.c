@@ -1,0 +1,78 @@
+/*
+ * Explicit migration — the UVM_MIGRATE path.
+ *
+ * Re-design of the reference's uvm_migrate.c (uvm_migrate:635 →
+ * uvm_migrate_ranges:555 → uvm_va_range_migrate:504 → per-2MB
+ * uvm_va_block_migrate_locked): iterate ranges intersecting the span,
+ * honor range-group migration fences, and drive each covered block's
+ * make_resident.  Copies pipeline inside a block (channel pushes with one
+ * tracker wait); the ASYNC flag is accepted and currently serviced
+ * synchronously (a synchronous completion is a valid strengthening of the
+ * reference's async contract — its semaphore-release path,
+ * uvm_migrate.c:735, fires on completion, which here is at return).
+ */
+#include "uvm_internal.h"
+
+TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
+                     UvmLocation dst, uint32_t flags)
+{
+    (void)flags;
+    if (!vs || !base || len == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (dst.tier >= UVM_TIER_COUNT)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (dst.tier == UVM_TIER_HBM && !tpurmDeviceGet(dst.devInst))
+        return TPU_ERR_INVALID_DEVICE;
+
+    uint64_t ps = uvmPageSize();
+    uint64_t start = (uintptr_t)base & ~(ps - 1);
+    uint64_t end = ((uintptr_t)base + len - 1) | (ps - 1);
+
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "vaspace");
+
+    UvmRangeTreeNode *n = uvmRangeTreeIterFirst(&vs->ranges, start, end);
+    if (!n) {
+        tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
+        pthread_mutex_unlock(&vs->lock);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+
+    TpuStatus st = TPU_OK;
+    while (n) {
+        UvmVaRange *range = (UvmVaRange *)n;
+        if (!uvmRangeGroupMigratable(vs, range->rangeGroupId)) {
+            /* Fenced by UvmPreventMigrationRangeGroups: skip, not error
+             * (reference returns success and leaves pages in place). */
+            n = uvmRangeTreeIterNext(n, end);
+            continue;
+        }
+        uint64_t rStart = start > n->start ? start : n->start;
+        uint64_t rEnd = end < n->end ? end : n->end;
+        uint32_t firstBlock = (uint32_t)((rStart - n->start) / UVM_BLOCK_SIZE);
+        uint32_t lastBlock = (uint32_t)((rEnd - n->start) / UVM_BLOCK_SIZE);
+        for (uint32_t bi = firstBlock; bi <= lastBlock && st == TPU_OK; bi++) {
+            UvmVaBlock *blk = range->blocks[bi];
+            uint64_t bStart = blk->start;
+            uint64_t bEnd = blk->start + (uint64_t)blk->npages * ps - 1;
+            uint64_t cStart = rStart > bStart ? rStart : bStart;
+            uint64_t cEnd = rEnd < bEnd ? rEnd : bEnd;
+            if (cStart > cEnd)
+                continue;
+            uint32_t firstPage = (uint32_t)((cStart - bStart) / ps);
+            uint32_t count = (uint32_t)((cEnd - cStart) / ps) + 1;
+            st = uvmBlockMakeResident(blk, dst, firstPage, count,
+                                      /*forWrite=*/true);
+        }
+        if (st != TPU_OK)
+            break;
+        uvmToolsEmit(vs, UVM_EVENT_MIGRATION, UVM_TIER_COUNT /* mixed */,
+                     dst.tier, dst.devInst, rStart, rEnd - rStart + 1);
+        n = uvmRangeTreeIterNext(n, end);
+    }
+
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
+    pthread_mutex_unlock(&vs->lock);
+    tpuCounterAdd("uvm_migrate_calls", 1);
+    return st;
+}

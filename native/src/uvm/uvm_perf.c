@@ -1,0 +1,127 @@
+/*
+ * Perf heuristics: prefetch region growth + thrashing detection.
+ *
+ * Prefetch — re-design of the reference's tree-based region growth
+ * (uvm_perf_prefetch.c: faults within a va_block grow power-of-two
+ * aligned prefetch regions when the fault density crosses a threshold).
+ * Here: the serviced region around a faulting page doubles with the
+ * block's fault count inside a time window — 1 page on a cold block, up
+ * to the whole block when faults are streaming.  Registry knobs:
+ *   uvm_prefetch_enable   (default 1)
+ *   uvm_prefetch_max_pages(default 32 = whole 2 MB block at 64 KB pages)
+ *
+ * Thrashing — re-design of uvm_perf_thrashing.c's detection + PIN/THROTTLE
+ * hints (uvm_perf_thrashing.h:33-46): when a block's migration target
+ * alternates tiers more than uvm_thrash_threshold times within
+ * uvm_thrash_window_ms, the block is PINNED to the last device-side tier
+ * for uvm_thrash_pin_ms; CPU read faults then duplicate instead of
+ * invalidating (uvmBlockMakeResidentEx forceDup) and the eviction LRU
+ * skips pinned blocks.  THROTTLE is implicit in batching.
+ *
+ * These run from the single fault-service thread without the block lock;
+ * the counters are heuristic state and tolerate benign races (the
+ * reference's perf modules are similarly advisory).
+ */
+#include "uvm_internal.h"
+
+void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
+                           uint32_t *firstPage, uint32_t *count)
+{
+    *firstPage = page;
+    *count = 1;
+    if (!tpuRegistryGet("uvm_prefetch_enable", 1))
+        return;
+
+    uint64_t now = uvmMonotonicNs();
+    uint64_t windowNs = tpuRegistryGet("uvm_prefetch_window_ms", 20) *
+                        1000000ull;
+    if (now - blk->windowStartNs > windowNs) {
+        blk->windowStartNs = now;
+        blk->windowFaults = 0;
+    }
+    blk->windowFaults++;
+    blk->faultCount++;
+    blk->lastFaultNs = now;
+
+    /* Region doubles with fault pressure: 2^(faults-1) pages, aligned. */
+    uint32_t maxPages = (uint32_t)tpuRegistryGet("uvm_prefetch_max_pages", 32);
+    uint32_t ppb = blk->npages;
+    uint32_t want = 1;
+    uint32_t f = blk->windowFaults;
+    while (f > 1 && want < maxPages && want < ppb) {
+        want <<= 1;
+        f >>= 1;
+    }
+    /* Device faults stream sequentially; give them one extra doubling. */
+    if (deviceFault && want < maxPages && want < ppb)
+        want <<= 1;
+    if (want > ppb)
+        want = ppb;
+
+    uint32_t first = (page / want) * want;   /* aligned region */
+    uint32_t cnt = want;
+    if (first + cnt > ppb)
+        cnt = ppb - first;
+    *firstPage = first;
+    *count = cnt;
+    if (cnt > 1) {
+        tpuCounterAdd("uvm_prefetch_pages", cnt - 1);
+        uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_PREFETCH, UVM_TIER_COUNT,
+                     UVM_TIER_COUNT, 0, blk->start + (uint64_t)first *
+                     uvmPageSize(), (uint64_t)cnt * uvmPageSize());
+    }
+}
+
+void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier)
+{
+    if (!tpuRegistryGet("uvm_thrash_enable", 1))
+        return;
+    uint64_t now = uvmMonotonicNs();
+    uint64_t windowNs = tpuRegistryGet("uvm_thrash_window_ms", 100) *
+                        1000000ull;
+
+    if (blk->pinnedTier >= 0 && blk->pinExpiryNs <= now) {
+        blk->pinnedTier = -1;
+        blk->windowSwitches = 0;
+    }
+
+    if (blk->lastTargetTier >= 0 &&
+        blk->lastTargetTier != (int32_t)targetTier) {
+        /* Dedicated window (prefetch owns windowStartNs on its own 20 ms
+         * cadence; sharing it would keep this window forever fresh). */
+        if (now - blk->thrashWindowStartNs > windowNs) {
+            blk->thrashWindowStartNs = now;
+            blk->windowSwitches = 0;
+        }
+        blk->windowSwitches++;
+        uint32_t threshold =
+            (uint32_t)tpuRegistryGet("uvm_thrash_threshold", 3);
+        if (blk->windowSwitches >= threshold && blk->pinnedTier < 0) {
+            /* Pin to the device-side tier of the ping-pong pair so the
+             * device copy survives; CPU reads duplicate against it. */
+            UvmTier pinTo = targetTier != UVM_TIER_HOST
+                                ? targetTier
+                                : (UvmTier)blk->lastTargetTier;
+            if (pinTo == UVM_TIER_HOST)
+                pinTo = UVM_TIER_HBM;
+            blk->pinnedTier = (int32_t)pinTo;
+            blk->pinExpiryNs = now + tpuRegistryGet("uvm_thrash_pin_ms",
+                                                    300) * 1000000ull;
+            blk->windowSwitches = 0;
+            tpuCounterAdd("uvm_thrash_pins", 1);
+            uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_THRASHING,
+                         UVM_TIER_COUNT, pinTo, blk->hbmDevInst, blk->start,
+                         (uint64_t)blk->npages * uvmPageSize());
+        }
+    }
+    blk->lastTargetTier = (int32_t)targetTier;
+}
+
+bool uvmPerfBlockPinnedAgainst(UvmVaBlock *blk, UvmTier targetTier)
+{
+    if (blk->pinnedTier < 0)
+        return false;
+    if (blk->pinExpiryNs <= uvmMonotonicNs())
+        return false;
+    return blk->pinnedTier != (int32_t)targetTier;
+}

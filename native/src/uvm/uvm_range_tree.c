@@ -1,0 +1,307 @@
+/*
+ * VA range tree: non-overlapping [start, end] intervals in an AVL tree
+ * keyed by start, with a threaded in-order list for O(1) neighbor walks.
+ *
+ * Re-design of the reference's uvm_range_tree
+ * (kernel-open/nvidia-uvm/uvm_range_tree.{c,h} — rbtree-based there); the
+ * API shape (add returns error on overlap, find by address, bounded
+ * iteration) is preserved because the VA space and HBM-window bookkeeping
+ * are written against it.  Page masks live here too: they are the other
+ * core container of the block state machine (reference: uvm_page_mask_*
+ * in uvm_va_block_types.h).
+ */
+#include "uvm_internal.h"
+
+#include <string.h>
+
+/* ------------------------------------------------------------ page masks */
+
+void uvmPageMaskZero(UvmPageMask *m)
+{
+    memset(m->bits, 0, sizeof(m->bits));
+}
+
+void uvmPageMaskFill(UvmPageMask *m, uint32_t npages)
+{
+    uvmPageMaskZero(m);
+    uvmPageMaskSetRange(m, 0, npages);
+}
+
+bool uvmPageMaskTest(const UvmPageMask *m, uint32_t page)
+{
+    return (m->bits[page / 64] >> (page % 64)) & 1;
+}
+
+void uvmPageMaskSet(UvmPageMask *m, uint32_t page)
+{
+    m->bits[page / 64] |= 1ull << (page % 64);
+}
+
+void uvmPageMaskClear(UvmPageMask *m, uint32_t page)
+{
+    m->bits[page / 64] &= ~(1ull << (page % 64));
+}
+
+void uvmPageMaskSetRange(UvmPageMask *m, uint32_t first, uint32_t count)
+{
+    for (uint32_t p = first; p < first + count; p++)
+        uvmPageMaskSet(m, p);
+}
+
+void uvmPageMaskClearRange(UvmPageMask *m, uint32_t first, uint32_t count)
+{
+    for (uint32_t p = first; p < first + count; p++)
+        uvmPageMaskClear(m, p);
+}
+
+uint32_t uvmPageMaskWeight(const UvmPageMask *m, uint32_t npages)
+{
+    uint32_t w = 0;
+    for (uint32_t i = 0; i < (npages + 63) / 64; i++) {
+        uint64_t word = m->bits[i];
+        if ((i + 1) * 64 > npages && npages % 64)
+            word &= (1ull << (npages % 64)) - 1;
+        w += (uint32_t)__builtin_popcountll(word);
+    }
+    return w;
+}
+
+bool uvmPageMaskEmpty(const UvmPageMask *m, uint32_t npages)
+{
+    return uvmPageMaskWeight(m, npages) == 0;
+}
+
+bool uvmPageMaskFull(const UvmPageMask *m, uint32_t npages)
+{
+    return uvmPageMaskWeight(m, npages) == npages;
+}
+
+uint32_t uvmPageMaskFindSet(const UvmPageMask *m, uint32_t npages,
+                            uint32_t from)
+{
+    for (uint32_t p = from; p < npages; p++)
+        if (uvmPageMaskTest(m, p))
+            return p;
+    return npages;
+}
+
+uint32_t uvmPageMaskFindClear(const UvmPageMask *m, uint32_t npages,
+                              uint32_t from)
+{
+    for (uint32_t p = from; p < npages; p++)
+        if (!uvmPageMaskTest(m, p))
+            return p;
+    return npages;
+}
+
+/* ---------------------------------------------------------- AVL plumbing */
+
+static int node_height(UvmRangeTreeNode *n)
+{
+    return n ? n->height : 0;
+}
+
+static void node_fix(UvmRangeTreeNode *n)
+{
+    int hl = node_height(n->left), hr = node_height(n->right);
+    n->height = 1 + (hl > hr ? hl : hr);
+}
+
+static int node_balance(UvmRangeTreeNode *n)
+{
+    return node_height(n->left) - node_height(n->right);
+}
+
+static void replace_child(UvmRangeTree *t, UvmRangeTreeNode *parent,
+                          UvmRangeTreeNode *oldc, UvmRangeTreeNode *newc)
+{
+    if (!parent)
+        t->root = newc;
+    else if (parent->left == oldc)
+        parent->left = newc;
+    else
+        parent->right = newc;
+    if (newc)
+        newc->parent = parent;
+}
+
+static UvmRangeTreeNode *rotate_left(UvmRangeTree *t, UvmRangeTreeNode *n)
+{
+    UvmRangeTreeNode *r = n->right;
+    replace_child(t, n->parent, n, r);
+    n->right = r->left;
+    if (n->right)
+        n->right->parent = n;
+    r->left = n;
+    n->parent = r;
+    node_fix(n);
+    node_fix(r);
+    return r;
+}
+
+static UvmRangeTreeNode *rotate_right(UvmRangeTree *t, UvmRangeTreeNode *n)
+{
+    UvmRangeTreeNode *l = n->left;
+    replace_child(t, n->parent, n, l);
+    n->left = l->right;
+    if (n->left)
+        n->left->parent = n;
+    l->right = n;
+    n->parent = l;
+    node_fix(n);
+    node_fix(l);
+    return l;
+}
+
+static void rebalance_up(UvmRangeTree *t, UvmRangeTreeNode *n)
+{
+    while (n) {
+        node_fix(n);
+        int b = node_balance(n);
+        if (b > 1) {
+            if (node_balance(n->left) < 0)
+                rotate_left(t, n->left);
+            n = rotate_right(t, n);
+        } else if (b < -1) {
+            if (node_balance(n->right) > 0)
+                rotate_right(t, n->right);
+            n = rotate_left(t, n);
+        }
+        n = n->parent;
+    }
+}
+
+/* -------------------------------------------------------------- tree API */
+
+void uvmRangeTreeInit(UvmRangeTree *t)
+{
+    t->root = NULL;
+    t->first = NULL;
+}
+
+TpuStatus uvmRangeTreeAdd(UvmRangeTree *t, UvmRangeTreeNode *n)
+{
+    if (n->end < n->start)
+        return TPU_ERR_INVALID_ARGUMENT;
+
+    UvmRangeTreeNode *parent = NULL, *cur = t->root;
+    UvmRangeTreeNode *pred = NULL, *succ = NULL;
+    while (cur) {
+        parent = cur;
+        if (n->start < cur->start) {
+            succ = cur;
+            cur = cur->left;
+        } else {
+            pred = cur;
+            cur = cur->right;
+        }
+    }
+    /* Overlap check against in-order neighbors. */
+    if (pred && pred->end >= n->start)
+        return TPU_ERR_STATE_IN_USE;
+    if (succ && succ->start <= n->end)
+        return TPU_ERR_STATE_IN_USE;
+
+    n->left = n->right = NULL;
+    n->parent = parent;
+    n->height = 1;
+    if (!parent)
+        t->root = n;
+    else if (n->start < parent->start)
+        parent->left = n;
+    else
+        parent->right = n;
+
+    /* Thread the in-order list. */
+    n->prev = pred;
+    n->next = succ;
+    if (pred)
+        pred->next = n;
+    else
+        t->first = n;
+    if (succ)
+        succ->prev = n;
+
+    rebalance_up(t, parent);
+    return TPU_OK;
+}
+
+void uvmRangeTreeRemove(UvmRangeTree *t, UvmRangeTreeNode *n)
+{
+    /* Unthread the list first. */
+    if (n->prev)
+        n->prev->next = n->next;
+    else
+        t->first = n->next;
+    if (n->next)
+        n->next->prev = n->prev;
+
+    UvmRangeTreeNode *rebalance_from;
+    if (!n->left || !n->right) {
+        UvmRangeTreeNode *child = n->left ? n->left : n->right;
+        rebalance_from = n->parent;
+        replace_child(t, n->parent, n, child);
+    } else {
+        /* Splice the in-order successor (leftmost of right subtree). */
+        UvmRangeTreeNode *s = n->next;   /* guaranteed inside right subtree */
+        if (s->parent == n) {
+            rebalance_from = s;
+        } else {
+            rebalance_from = s->parent;
+            replace_child(t, s->parent, s, s->right);
+            s->right = n->right;
+            s->right->parent = s;
+        }
+        s->left = n->left;
+        s->left->parent = s;
+        replace_child(t, n->parent, n, s);
+        node_fix(s);
+    }
+    rebalance_up(t, rebalance_from);
+    n->left = n->right = n->parent = n->prev = n->next = NULL;
+}
+
+UvmRangeTreeNode *uvmRangeTreeFind(UvmRangeTree *t, uint64_t addr)
+{
+    UvmRangeTreeNode *cur = t->root;
+    while (cur) {
+        if (addr < cur->start)
+            cur = cur->left;
+        else if (addr > cur->end)
+            cur = cur->right;
+        else
+            return cur;
+    }
+    return NULL;
+}
+
+UvmRangeTreeNode *uvmRangeTreeIterFirst(UvmRangeTree *t, uint64_t start,
+                                        uint64_t end)
+{
+    /* Smallest node with node->end >= start, then check window. */
+    UvmRangeTreeNode *cur = t->root, *best = NULL;
+    while (cur) {
+        if (cur->end >= start) {
+            best = cur;
+            cur = cur->left;
+        } else {
+            cur = cur->right;
+        }
+    }
+    if (best && best->start <= end)
+        return best;
+    return NULL;
+}
+
+UvmRangeTreeNode *uvmRangeTreeIterNext(UvmRangeTreeNode *n, uint64_t end)
+{
+    UvmRangeTreeNode *nx = n->next;
+    if (nx && nx->start <= end)
+        return nx;
+    return NULL;
+}
+
+UvmRangeTreeNode *uvmRangeTreeNext(UvmRangeTreeNode *n)
+{
+    return n->next;
+}

@@ -1,0 +1,228 @@
+/*
+ * Tier arenas: the physical backing stores the block state machine
+ * migrates between.
+ *
+ * HBM tier  — one arena per TPU device, wrapping the device's HBM window
+ *             (fake-device backend: host memory; real chip: the window the
+ *             Python runtime registers).  Reference analog: per-GPU PMA
+ *             managed by uvm_pmm_gpu.c.
+ * CXL tier  — one global arena over the CXL expander window, fake mode a
+ *             MAP_NORESERVE anonymous mapping sized by registry
+ *             "cxl_tier_bytes" (default 1 GB).  Reference analog: the
+ *             fork's CXL buffers (p2p_cxl.c) used as migration target.
+ * HOST tier — the managed VA itself (no arena; unbounded).
+ *
+ * Each arena owns a PMM and an eviction LRU of blocks with residency in
+ * it (reference: root-chunk LRU in uvm_pmm_gpu.c).
+ */
+#define _GNU_SOURCE
+#include "uvm_internal.h"
+
+#include <stdlib.h>
+#include <sys/mman.h>
+
+#include <time.h>
+
+#define MAX_HBM_ARENAS 16
+
+uint64_t uvmMonotonicNs(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+static struct {
+    pthread_once_t once;
+    UvmTierArena hbm[MAX_HBM_ARENAS];
+    uint32_t hbmCount;
+    UvmTierArena cxl;
+    bool cxlOk;
+} g_tiers = { .once = PTHREAD_ONCE_INIT };
+
+uint64_t uvmPageSize(void)
+{
+    static uint64_t cached;
+    if (!cached) {
+        uint64_t ps = tpuRegistryGet("uvm_page_size", UVM_PAGE_SIZE_DEFAULT);
+        if (ps < 4096 || ps > UVM_BLOCK_SIZE || (ps & (ps - 1)))
+            ps = UVM_PAGE_SIZE_DEFAULT;
+        cached = ps;
+    }
+    return cached;
+}
+
+uint32_t uvmPagesPerBlock(void)
+{
+    return (uint32_t)(UVM_BLOCK_SIZE / uvmPageSize());
+}
+
+static TpuStatus arena_init(UvmTierArena *a, UvmTier tier, uint32_t devInst,
+                            void *base, uint64_t size)
+{
+    pthread_mutex_init(&a->lock, NULL);
+    pthread_cond_init(&a->evictCond, NULL);
+    a->tier = tier;
+    a->devInst = devInst;
+    a->base = base;
+    a->size = size;
+    a->lruHead = a->lruTail = NULL;
+    return uvmPmmInit(&a->pmm, size, uvmPageSize());
+}
+
+static void tiers_init_once(void)
+{
+    tpuDeviceGlobalInit();
+    uint32_t n = tpurmDeviceCount();
+    if (n > MAX_HBM_ARENAS)
+        n = MAX_HBM_ARENAS;
+    for (uint32_t i = 0; i < n; i++) {
+        TpurmDevice *dev = tpurmDeviceGet(i);
+        if (!dev || !tpurmDeviceHbmBase(dev))
+            continue;
+        if (arena_init(&g_tiers.hbm[i], UVM_TIER_HBM, i,
+                       tpurmDeviceHbmBase(dev),
+                       tpurmDeviceHbmSize(dev)) == TPU_OK)
+            g_tiers.hbmCount = i + 1;
+    }
+
+    uint64_t cxlBytes = tpuRegistryGet("cxl_tier_bytes", 1ull << 30);
+    void *cxlBase = mmap(NULL, cxlBytes, PROT_READ | PROT_WRITE,
+                         MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
+    if (cxlBase != MAP_FAILED &&
+        arena_init(&g_tiers.cxl, UVM_TIER_CXL, 0, cxlBase, cxlBytes) ==
+            TPU_OK) {
+        g_tiers.cxlOk = true;
+        tpuLog(TPU_LOG_INFO, "uvm", "CXL tier arena: %llu MB",
+               (unsigned long long)(cxlBytes >> 20));
+    } else {
+        tpuLog(TPU_LOG_ERROR, "uvm", "CXL tier arena init failed");
+    }
+}
+
+UvmTierArena *uvmTierArenaHbm(uint32_t devInst)
+{
+    pthread_once(&g_tiers.once, tiers_init_once);
+    if (devInst >= g_tiers.hbmCount || !g_tiers.hbm[devInst].base)
+        return NULL;
+    return &g_tiers.hbm[devInst];
+}
+
+UvmTierArena *uvmTierArenaCxl(void)
+{
+    pthread_once(&g_tiers.once, tiers_init_once);
+    return g_tiers.cxlOk ? &g_tiers.cxl : NULL;
+}
+
+/* ------------------------------------------------------------------ LRU */
+
+static int lru_index(const UvmTierArena *a)
+{
+    return a->tier == UVM_TIER_CXL ? 1 : 0;
+}
+
+void uvmLruTouch(UvmTierArena *a, UvmVaBlock *blk)
+{
+    int ix = lru_index(a);
+    pthread_mutex_lock(&a->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
+    if (blk->lru[ix].on) {
+        /* unlink */
+        if (blk->lru[ix].prev)
+            blk->lru[ix].prev->lru[ix].next = blk->lru[ix].next;
+        else
+            a->lruHead = blk->lru[ix].next;
+        if (blk->lru[ix].next)
+            blk->lru[ix].next->lru[ix].prev = blk->lru[ix].prev;
+        else
+            a->lruTail = blk->lru[ix].prev;
+    }
+    /* append at tail (most recently used) */
+    blk->lru[ix].prev = a->lruTail;
+    blk->lru[ix].next = NULL;
+    if (a->lruTail)
+        a->lruTail->lru[ix].next = blk;
+    else
+        a->lruHead = blk;
+    a->lruTail = blk;
+    blk->lru[ix].on = true;
+    tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
+    pthread_mutex_unlock(&a->lock);
+}
+
+void uvmLruRemove(UvmTierArena *a, UvmVaBlock *blk)
+{
+    int ix = lru_index(a);
+    pthread_mutex_lock(&a->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
+    if (blk->lru[ix].on) {
+        if (blk->lru[ix].prev)
+            blk->lru[ix].prev->lru[ix].next = blk->lru[ix].next;
+        else
+            a->lruHead = blk->lru[ix].next;
+        if (blk->lru[ix].next)
+            blk->lru[ix].next->lru[ix].prev = blk->lru[ix].prev;
+        else
+            a->lruTail = blk->lru[ix].prev;
+        blk->lru[ix].on = false;
+        blk->lru[ix].prev = blk->lru[ix].next = NULL;
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
+    pthread_mutex_unlock(&a->lock);
+}
+
+UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
+{
+    int ix = lru_index(a);
+    uint64_t now = uvmMonotonicNs();
+    pthread_mutex_lock(&a->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
+    UvmVaBlock *blk = a->lruHead;
+    while (blk) {
+        /* Skip the allocating block itself and blocks pinned to this tier
+         * by thrashing mitigation (uvm_perf_thrashing.h PIN hint). */
+        bool pinned = blk->pinnedTier == (int32_t)a->tier &&
+                      blk->pinExpiryNs > now;
+        if (blk != exclude && !pinned)
+            break;
+        blk = blk->lru[ix].next;
+    }
+    if (blk) {
+        if (blk->lru[ix].prev)
+            blk->lru[ix].prev->lru[ix].next = blk->lru[ix].next;
+        else
+            a->lruHead = blk->lru[ix].next;
+        if (blk->lru[ix].next)
+            blk->lru[ix].next->lru[ix].prev = blk->lru[ix].prev;
+        else
+            a->lruTail = blk->lru[ix].prev;
+        blk->lru[ix].on = false;
+        blk->lru[ix].prev = blk->lru[ix].next = NULL;
+        blk->lru[ix].evicting = true;   /* lifetime guard for the caller */
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
+    pthread_mutex_unlock(&a->lock);
+    return blk;
+}
+
+void uvmLruEvictDone(UvmTierArena *a, UvmVaBlock *blk)
+{
+    int ix = lru_index(a);
+    pthread_mutex_lock(&a->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
+    blk->lru[ix].evicting = false;
+    pthread_cond_broadcast(&a->evictCond);
+    tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
+    pthread_mutex_unlock(&a->lock);
+}
+
+void uvmLruAwaitEvictors(UvmTierArena *a, UvmVaBlock *blk)
+{
+    int ix = lru_index(a);
+    pthread_mutex_lock(&a->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
+    while (blk->lru[ix].evicting)
+        pthread_cond_wait(&a->evictCond, &a->lock);
+    tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
+    pthread_mutex_unlock(&a->lock);
+}

@@ -1,0 +1,132 @@
+/*
+ * Tools — event trackers + counters (re-design of uvm_tools.c:54-70).
+ *
+ * The reference gives each tools fd an mmap'd lock-free queue userspace
+ * drains directly.  The tpurm runtime is in-process, so a session is a
+ * ring the client reads through uvmToolsReadEvents (the Python runtime
+ * memoryview()s it through ctypes — same zero-copy effect as the
+ * reference's mmap).  Overflow drops the oldest event and counts drops,
+ * like the reference's queue wrap accounting.  Event types cover the
+ * migration engine's lifecycle (fault/migration/eviction/thrashing/
+ * prefetch/read-dup); the reference's 60+ types include channel and perf
+ * internals that map onto tpurm counters instead (tpurmCounterGet).
+ */
+#include "uvm_internal.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+struct UvmToolsSession {
+    UvmVaSpace *vs;                   /* filter; NULL = all spaces */
+    uint64_t typeMask;
+    uint32_t capacity;                /* power of two */
+    uint64_t widx, ridx;
+    UvmEvent *ring;
+    struct UvmToolsSession *next;
+};
+
+static struct {
+    pthread_mutex_t lock;             /* order TPU_LOCK_DIAG */
+    struct UvmToolsSession *head;
+} g_tools = { PTHREAD_MUTEX_INITIALIZER, NULL };
+
+TpuStatus uvmToolsSessionCreate(UvmVaSpace *vs, uint32_t capacity,
+                                UvmToolsSession **out)
+{
+    if (!out)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (capacity < 64)
+        capacity = 64;
+    /* Round up to a power of two. */
+    while (capacity & (capacity - 1))
+        capacity += capacity & (~capacity + 1);
+
+    UvmToolsSession *s = calloc(1, sizeof(*s));
+    if (!s)
+        return TPU_ERR_NO_MEMORY;
+    s->ring = calloc(capacity, sizeof(UvmEvent));
+    if (!s->ring) {
+        free(s);
+        return TPU_ERR_NO_MEMORY;
+    }
+    s->vs = vs;
+    s->capacity = capacity;
+    s->typeMask = ~0ull;
+
+    pthread_mutex_lock(&g_tools.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
+    s->next = g_tools.head;
+    g_tools.head = s;
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
+    pthread_mutex_unlock(&g_tools.lock);
+    *out = s;
+    return TPU_OK;
+}
+
+void uvmToolsSessionDestroy(UvmToolsSession *s)
+{
+    if (!s)
+        return;
+    pthread_mutex_lock(&g_tools.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
+    UvmToolsSession **p = &g_tools.head;
+    while (*p && *p != s)
+        p = &(*p)->next;
+    if (*p)
+        *p = s->next;
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
+    pthread_mutex_unlock(&g_tools.lock);
+    free(s->ring);
+    free(s);
+}
+
+void uvmToolsEnableEvents(UvmToolsSession *s, uint64_t typeMask)
+{
+    if (s)
+        s->typeMask = typeMask;
+}
+
+void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
+                  uint32_t dstTier, uint32_t devInst, uint64_t address,
+                  uint64_t bytes)
+{
+    pthread_mutex_lock(&g_tools.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
+    for (UvmToolsSession *s = g_tools.head; s; s = s->next) {
+        if (s->vs && s->vs != vs)
+            continue;
+        if (!(s->typeMask & (1ull << type)))
+            continue;
+        if (s->widx - s->ridx >= s->capacity) {
+            s->ridx++;                /* drop oldest */
+            tpuCounterAdd("uvm_tools_events_dropped", 1);
+        }
+        UvmEvent *e = &s->ring[s->widx % s->capacity];
+        e->type = type;
+        e->srcTier = srcTier;
+        e->dstTier = dstTier;
+        e->devInst = devInst;
+        e->address = address;
+        e->bytes = bytes;
+        e->timestampNs = uvmMonotonicNs();
+        s->widx++;
+    }
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
+    pthread_mutex_unlock(&g_tools.lock);
+}
+
+size_t uvmToolsReadEvents(UvmToolsSession *s, UvmEvent *buf, size_t max)
+{
+    if (!s || !buf || max == 0)
+        return 0;
+    pthread_mutex_lock(&g_tools.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
+    size_t n = 0;
+    while (n < max && s->ridx < s->widx) {
+        buf[n++] = s->ring[s->ridx % s->capacity];
+        s->ridx++;
+    }
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
+    pthread_mutex_unlock(&g_tools.lock);
+    return n;
+}

@@ -1,0 +1,456 @@
+/*
+ * VA space — per-client top-level UVM object.
+ *
+ * Re-design of the reference's uvm_va_space.c (2,703 LoC): registered
+ * devices, the VA range tree, policy application, and range groups.
+ * Managed ranges are created by uvmMemAlloc (the reference creates them
+ * via mmap of /dev/nvidia-uvm + cudaMallocManaged; the tpurm escape
+ * surface has no kernel mmap hook, so allocation is explicit — noted in
+ * uvm.h ABI section).  Policy simplification vs the reference: policies
+ * apply to whole managed ranges intersecting the requested span rather
+ * than splitting ranges at span boundaries (uvm_va_range split machinery,
+ * uvm_va_range.c); ranges are per-allocation here so the difference only
+ * shows when callers set policy on a sub-span.
+ */
+#define _GNU_SOURCE
+#include "uvm_internal.h"
+
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+
+static void vs_lock(UvmVaSpace *vs)
+{
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "vaspace");
+}
+
+static void vs_unlock(UvmVaSpace *vs)
+{
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
+    pthread_mutex_unlock(&vs->lock);
+}
+
+TpuStatus uvmVaSpaceCreate(UvmVaSpace **out)
+{
+    if (!out)
+        return TPU_ERR_INVALID_ARGUMENT;
+    tpuDeviceGlobalInit();
+    uvmFaultEngineInit();
+    UvmVaSpace *vs = calloc(1, sizeof(*vs));
+    if (!vs)
+        return TPU_ERR_NO_MEMORY;
+    pthread_mutex_init(&vs->lock, NULL);
+    uvmRangeTreeInit(&vs->ranges);
+    vs->nextRangeGroupId = 1;
+    vs->pageSize = uvmPageSize();
+    uvmFaultEngineRegisterSpace(vs);
+    tpuCounterAdd("uvm_va_spaces_created", 1);
+    *out = vs;
+    return TPU_OK;
+}
+
+static void range_destroy(UvmVaSpace *vs, UvmVaRange *range)
+{
+    for (uint32_t i = 0; i < range->blockCount; i++) {
+        UvmVaBlock *blk = range->blocks[i];
+        if (!blk)
+            continue;
+        uvmBlockFreeBacking(blk);
+        pthread_mutex_destroy(&blk->lock);
+        free(blk);
+    }
+    free(range->blocks);
+    uvmRangeTreeRemove(&vs->ranges, &range->node);
+    munmap((void *)(uintptr_t)range->node.start, range->size);
+    free(range);
+}
+
+void uvmVaSpaceDestroy(UvmVaSpace *vs)
+{
+    if (!vs)
+        return;
+    uvmFaultEngineUnregisterSpace(vs);
+    vs_lock(vs);
+    UvmRangeTreeNode *n = vs->ranges.first;
+    while (n) {
+        UvmRangeTreeNode *next = uvmRangeTreeNext(n);
+        range_destroy(vs, (UvmVaRange *)n);
+        n = next;
+    }
+    UvmRangeGroup *g = vs->groups;
+    while (g) {
+        UvmRangeGroup *next = g->next;
+        free(g);
+        g = next;
+    }
+    vs_unlock(vs);
+    uvmFaultSnapshotRebuild();
+    pthread_mutex_destroy(&vs->lock);
+    free(vs);
+}
+
+TpuStatus uvmRegisterDevice(UvmVaSpace *vs, uint32_t devInst)
+{
+    if (!vs)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (!tpurmDeviceGet(devInst))
+        return TPU_ERR_INVALID_DEVICE;
+    vs_lock(vs);
+    vs->registeredDevMask |= 1ull << devInst;
+    vs_unlock(vs);
+    return TPU_OK;
+}
+
+TpuStatus uvmUnregisterDevice(UvmVaSpace *vs, uint32_t devInst)
+{
+    if (!vs)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    if (!(vs->registeredDevMask & (1ull << devInst))) {
+        vs_unlock(vs);
+        return TPU_ERR_INVALID_DEVICE;
+    }
+    vs->registeredDevMask &= ~(1ull << devInst);
+    vs_unlock(vs);
+    /* Pull this device's residency home (reference: gpu unregister evicts
+     * vidmem-resident pages). */
+    UvmTierArena *arena = uvmTierArenaHbm(devInst);
+    if (arena) {
+        vs_lock(vs);
+        for (UvmRangeTreeNode *n = vs->ranges.first; n;
+             n = uvmRangeTreeNext(n)) {
+            UvmVaRange *r = (UvmVaRange *)n;
+            for (uint32_t i = 0; i < r->blockCount; i++) {
+                UvmVaBlock *blk = r->blocks[i];
+                if (blk->hbmRuns && blk->hbmDevInst == devInst)
+                    uvmBlockEvictFrom(blk, arena);
+            }
+        }
+        vs_unlock(vs);
+    }
+    return TPU_OK;
+}
+
+TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
+{
+    if (!vs || !outPtr || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t ps = uvmPageSize();
+    size = (size + ps - 1) & ~(ps - 1);
+
+    /* 2 MB-aligned PROT_NONE reservation: over-map and trim. */
+    uint64_t mapSize = size + UVM_BLOCK_SIZE;
+    char *raw = mmap(NULL, mapSize, PROT_NONE,
+                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
+    if (raw == MAP_FAILED)
+        return TPU_ERR_NO_MEMORY;
+    uintptr_t aligned = ((uintptr_t)raw + UVM_BLOCK_SIZE - 1) &
+                        ~((uintptr_t)UVM_BLOCK_SIZE - 1);
+    if (aligned > (uintptr_t)raw)
+        munmap(raw, aligned - (uintptr_t)raw);
+    uintptr_t tailStart = aligned + size;
+    uint64_t tailLen = (uintptr_t)raw + mapSize - tailStart;
+    if (tailLen)
+        munmap((void *)tailStart, tailLen);
+
+    UvmVaRange *range = calloc(1, sizeof(*range));
+    if (!range) {
+        munmap((void *)aligned, size);
+        return TPU_ERR_NO_MEMORY;
+    }
+    range->node.start = aligned;
+    range->node.end = aligned + size - 1;
+    range->vaSpace = vs;
+    range->type = UVM_RANGE_TYPE_MANAGED;
+    range->size = size;
+
+    uint32_t ppb = uvmPagesPerBlock();
+    range->blockCount = (uint32_t)((size + UVM_BLOCK_SIZE - 1) /
+                                   UVM_BLOCK_SIZE);
+    range->blocks = calloc(range->blockCount, sizeof(UvmVaBlock *));
+    if (!range->blocks) {
+        free(range);
+        munmap((void *)aligned, size);
+        return TPU_ERR_NO_MEMORY;
+    }
+    for (uint32_t i = 0; i < range->blockCount; i++) {
+        UvmVaBlock *blk = calloc(1, sizeof(*blk));
+        if (!blk) {
+            for (uint32_t j = 0; j < i; j++)
+                free(range->blocks[j]);
+            free(range->blocks);
+            free(range);
+            munmap((void *)aligned, size);
+            return TPU_ERR_NO_MEMORY;
+        }
+        pthread_mutex_init(&blk->lock, NULL);
+        blk->range = range;
+        blk->start = aligned + (uint64_t)i * UVM_BLOCK_SIZE;
+        uint64_t remaining = size - (uint64_t)i * UVM_BLOCK_SIZE;
+        blk->npages = remaining >= UVM_BLOCK_SIZE
+                          ? ppb
+                          : (uint32_t)(remaining / ps);
+        blk->pinnedTier = -1;
+        blk->lastTargetTier = -1;
+        range->blocks[i] = blk;
+    }
+
+    vs_lock(vs);
+    TpuStatus st = uvmRangeTreeAdd(&vs->ranges, &range->node);
+    vs_unlock(vs);
+    if (st != TPU_OK) {
+        for (uint32_t i = 0; i < range->blockCount; i++)
+            free(range->blocks[i]);
+        free(range->blocks);
+        free(range);
+        munmap((void *)aligned, size);
+        return st;
+    }
+    uvmFaultSnapshotRebuild();
+    tpuCounterAdd("uvm_managed_bytes_allocated", size);
+    *outPtr = (void *)aligned;
+    return TPU_OK;
+}
+
+TpuStatus uvmMemFree(UvmVaSpace *vs, void *ptr)
+{
+    if (!vs || !ptr)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    UvmRangeTreeNode *n = uvmRangeTreeFind(&vs->ranges, (uintptr_t)ptr);
+    if (!n || n->start != (uintptr_t)ptr) {
+        vs_unlock(vs);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    range_destroy(vs, (UvmVaRange *)n);
+    vs_unlock(vs);
+    uvmFaultSnapshotRebuild();
+    return TPU_OK;
+}
+
+UvmVaRange *uvmRangeFind(UvmVaSpace *vs, uint64_t addr, UvmVaBlock **blockOut)
+{
+    UvmRangeTreeNode *n = uvmRangeTreeFind(&vs->ranges, addr);
+    if (!n)
+        return NULL;
+    UvmVaRange *range = (UvmVaRange *)n;
+    if (blockOut) {
+        uint32_t bi = (uint32_t)((addr - n->start) / UVM_BLOCK_SIZE);
+        *blockOut = bi < range->blockCount ? range->blocks[bi] : NULL;
+    }
+    return range;
+}
+
+/* ----------------------------------------------------------- policy ops */
+
+typedef void (*RangePolicyFn)(UvmVaRange *range, void *arg);
+
+static TpuStatus for_ranges_in(UvmVaSpace *vs, void *base, uint64_t len,
+                               RangePolicyFn fn, void *arg)
+{
+    if (!vs || !base || len == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t start = (uintptr_t)base, end = start + len - 1;
+    vs_lock(vs);
+    UvmRangeTreeNode *n = uvmRangeTreeIterFirst(&vs->ranges, start, end);
+    if (!n) {
+        vs_unlock(vs);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    while (n) {
+        fn((UvmVaRange *)n, arg);
+        n = uvmRangeTreeIterNext(n, end);
+    }
+    vs_unlock(vs);
+    return TPU_OK;
+}
+
+static void set_preferred_fn(UvmVaRange *r, void *arg)
+{
+    UvmLocation *loc = arg;
+    if (loc) {
+        r->hasPreferred = true;
+        r->preferred = *loc;
+    } else {
+        r->hasPreferred = false;
+    }
+}
+
+TpuStatus uvmSetPreferredLocation(UvmVaSpace *vs, void *base, uint64_t len,
+                                  UvmLocation loc)
+{
+    if (loc.tier == UVM_TIER_HBM && !tpurmDeviceGet(loc.devInst))
+        return TPU_ERR_INVALID_DEVICE;
+    return for_ranges_in(vs, base, len, set_preferred_fn, &loc);
+}
+
+TpuStatus uvmUnsetPreferredLocation(UvmVaSpace *vs, void *base, uint64_t len)
+{
+    return for_ranges_in(vs, base, len, set_preferred_fn, NULL);
+}
+
+struct accessed_by_arg {
+    uint32_t devInst;
+    bool set;
+};
+
+static void accessed_by_fn(UvmVaRange *r, void *arg)
+{
+    struct accessed_by_arg *a = arg;
+    if (a->set)
+        r->accessedByMask |= 1ull << a->devInst;
+    else
+        r->accessedByMask &= ~(1ull << a->devInst);
+}
+
+TpuStatus uvmSetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
+                           uint32_t devInst)
+{
+    if (!tpurmDeviceGet(devInst))
+        return TPU_ERR_INVALID_DEVICE;
+    struct accessed_by_arg a = { devInst, true };
+    return for_ranges_in(vs, base, len, accessed_by_fn, &a);
+}
+
+TpuStatus uvmUnsetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
+                             uint32_t devInst)
+{
+    struct accessed_by_arg a = { devInst, false };
+    return for_ranges_in(vs, base, len, accessed_by_fn, &a);
+}
+
+static void read_dup_fn(UvmVaRange *r, void *arg)
+{
+    r->readDuplication = *(int *)arg != 0;
+}
+
+TpuStatus uvmSetReadDuplication(UvmVaSpace *vs, void *base, uint64_t len,
+                                int enable)
+{
+    return for_ranges_in(vs, base, len, read_dup_fn, &enable);
+}
+
+/* ---------------------------------------------------------- range groups */
+
+TpuStatus uvmRangeGroupCreate(UvmVaSpace *vs, uint64_t *outId)
+{
+    if (!vs || !outId)
+        return TPU_ERR_INVALID_ARGUMENT;
+    UvmRangeGroup *g = calloc(1, sizeof(*g));
+    if (!g)
+        return TPU_ERR_NO_MEMORY;
+    vs_lock(vs);
+    g->id = vs->nextRangeGroupId++;
+    g->migratable = true;
+    g->next = vs->groups;
+    vs->groups = g;
+    vs_unlock(vs);
+    *outId = g->id;
+    return TPU_OK;
+}
+
+static UvmRangeGroup *group_find(UvmVaSpace *vs, uint64_t id)
+{
+    for (UvmRangeGroup *g = vs->groups; g; g = g->next)
+        if (g->id == id)
+            return g;
+    return NULL;
+}
+
+TpuStatus uvmRangeGroupDestroy(UvmVaSpace *vs, uint64_t id)
+{
+    if (!vs)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    UvmRangeGroup **prev = &vs->groups;
+    for (UvmRangeGroup *g = vs->groups; g; g = g->next) {
+        if (g->id == id) {
+            *prev = g->next;
+            /* Detach member ranges. */
+            for (UvmRangeTreeNode *n = vs->ranges.first; n;
+                 n = uvmRangeTreeNext(n)) {
+                UvmVaRange *r = (UvmVaRange *)n;
+                if (r->rangeGroupId == id)
+                    r->rangeGroupId = 0;
+            }
+            vs_unlock(vs);
+            free(g);
+            return TPU_OK;
+        }
+        prev = &g->next;
+    }
+    vs_unlock(vs);
+    return TPU_ERR_OBJECT_NOT_FOUND;
+}
+
+struct set_group_arg {
+    uint64_t id;
+};
+
+static void set_group_fn(UvmVaRange *r, void *arg)
+{
+    r->rangeGroupId = ((struct set_group_arg *)arg)->id;
+}
+
+TpuStatus uvmRangeGroupSet(UvmVaSpace *vs, uint64_t id, void *base,
+                           uint64_t len)
+{
+    vs_lock(vs);
+    bool ok = group_find(vs, id) != NULL;
+    vs_unlock(vs);
+    if (!ok && id != 0)
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    struct set_group_arg a = { id };
+    return for_ranges_in(vs, base, len, set_group_fn, &a);
+}
+
+TpuStatus uvmRangeGroupSetMigratable(UvmVaSpace *vs, uint64_t id,
+                                     int migratable)
+{
+    if (!vs)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    UvmRangeGroup *g = group_find(vs, id);
+    if (g)
+        g->migratable = migratable != 0;
+    vs_unlock(vs);
+    return g ? TPU_OK : TPU_ERR_OBJECT_NOT_FOUND;
+}
+
+bool uvmRangeGroupMigratable(UvmVaSpace *vs, uint64_t groupId)
+{
+    if (groupId == 0)
+        return true;
+    UvmRangeGroup *g = group_find(vs, groupId);
+    return g ? g->migratable : true;
+}
+
+/* --------------------------------------------------------- introspection */
+
+TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out)
+{
+    if (!vs || !addr || !out)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    UvmVaBlock *blk = NULL;
+    UvmVaRange *range = uvmRangeFind(vs, (uintptr_t)addr, &blk);
+    if (!range || !blk) {
+        vs_unlock(vs);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block");
+    uint32_t page = (uint32_t)(((uintptr_t)addr - blk->start) / uvmPageSize());
+    memset(out, 0, sizeof(*out));
+    out->residentHost = uvmPageMaskTest(&blk->resident[UVM_TIER_HOST], page);
+    out->residentHbm = uvmPageMaskTest(&blk->resident[UVM_TIER_HBM], page);
+    out->residentCxl = uvmPageMaskTest(&blk->resident[UVM_TIER_CXL], page);
+    out->hbmDeviceInst = blk->hbmDevInst;
+    out->cpuMapped = uvmPageMaskTest(&blk->cpuMapped, page);
+    out->pinnedTier = blk->pinnedTier;
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
+    pthread_mutex_unlock(&blk->lock);
+    vs_unlock(vs);
+    return TPU_OK;
+}

@@ -1,0 +1,182 @@
+/*
+ * UVM test runner: drives the in-module tests through the reference ABI
+ * (open /dev/nvidia-uvm, UVM_INITIALIZE, UVM_REGISTER_GPU, UVM_RUN_TEST —
+ * the exact flow the reference's uvm tests use), then exercises the
+ * managed-memory lifecycle end-to-end over raw ioctls.
+ */
+#include <assert.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+static int g_failures;
+
+#define EXPECT(cond)                                                     \
+    do {                                                                 \
+        if (!(cond)) {                                                   \
+            fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,      \
+                    #cond);                                              \
+            g_failures++;                                                \
+        }                                                                \
+    } while (0)
+
+static void run_module_test(int fd, uint32_t cmd, const char *name)
+{
+    UvmRunTestParams p = { .testCmd = cmd };
+    int rc = tpurm_ioctl(fd, UVM_RUN_TEST, &p);
+    EXPECT(rc == 0);
+    if (p.rmStatus != TPU_OK)
+        fprintf(stderr, "FAIL module test %s: status 0x%x (%s)\n", name,
+                p.rmStatus, tpuStatusToString(p.rmStatus));
+    EXPECT(p.rmStatus == TPU_OK);
+    printf("  module test %-24s %s\n", name,
+           p.rmStatus == TPU_OK ? "ok" : "FAILED");
+}
+
+int main(void)
+{
+    int fd = tpurm_open("/dev/nvidia-uvm");
+    EXPECT(fd >= 0);
+
+    /* Ioctls before INITIALIZE must fail. */
+    UvmTpuAllocManagedParams early = { .length = 1 << 20 };
+    EXPECT(tpurm_ioctl(fd, UVM_TPU_ALLOC_MANAGED, &early) == -1);
+
+    UvmInitializeParams init = { 0 };
+    EXPECT(tpurm_ioctl(fd, UVM_INITIALIZE, &init) == 0);
+    EXPECT(init.rmStatus == TPU_OK);
+
+    UvmRegisterGpuParams reg = { 0 };
+    EXPECT(tpurm_ioctl(fd, UVM_REGISTER_GPU, &reg) == 0);
+    EXPECT(reg.rmStatus == TPU_OK);
+    EXPECT(reg.gpuUuid.uuid[0] == 'T');
+
+    run_module_test(fd, UVM_TPU_TEST_RANGE_TREE_DIRECTED, "range_tree_directed");
+    run_module_test(fd, UVM_TPU_TEST_RANGE_TREE_RANDOM, "range_tree_random");
+    run_module_test(fd, UVM_TPU_TEST_PMM_BASIC, "pmm_basic");
+    run_module_test(fd, UVM_TPU_TEST_VA_BLOCK, "va_block");
+    run_module_test(fd, UVM_TPU_TEST_LOCK_SANITY, "lock_sanity");
+    run_module_test(fd, UVM_TPU_TEST_FAULT_INJECT, "fault_inject");
+    run_module_test(fd, UVM_TPU_TEST_PMM_EVICTION, "pmm_eviction");
+
+    /* ---- managed lifecycle over the raw ABI ---- */
+    UvmTpuAllocManagedParams alloc = { .length = 8 << 20 };
+    EXPECT(tpurm_ioctl(fd, UVM_TPU_ALLOC_MANAGED, &alloc) == 0);
+    EXPECT(alloc.rmStatus == TPU_OK);
+    unsigned char *buf = (unsigned char *)(uintptr_t)alloc.base;
+    EXPECT(buf != NULL);
+
+    /* First touch (CPU fault), then migrate via the reference's
+     * UVM_MIGRATE param block. */
+    memset(buf, 0x77, 1 << 20);
+    UvmMigrateParams mig = { 0 };
+    mig.base = alloc.base;
+    mig.length = 1 << 20;
+    mig.destinationUuid.uuid[0] = 'T';
+    mig.destinationUuid.uuid[1] = 'P';
+    mig.destinationUuid.uuid[2] = 'U';
+    uint32_t sem = 0;
+    mig.semaphoreAddress = (uintptr_t)&sem;
+    mig.semaphorePayload = 0xD00D;
+    EXPECT(tpurm_ioctl(fd, UVM_MIGRATE, &mig) == 0);
+    EXPECT(mig.rmStatus == TPU_OK);
+    EXPECT(sem == 0xD00D);
+
+    UvmTpuResidencyInfoParams res = { .address = alloc.base };
+    EXPECT(tpurm_ioctl(fd, UVM_TPU_RESIDENCY_INFO, &res) == 0);
+    EXPECT(res.rmStatus == TPU_OK);
+    EXPECT(res.residentHbm == 1);
+    EXPECT(res.residentHost == 0);
+
+    /* CPU read fault pulls it home. */
+    EXPECT(buf[123] == 0x77);
+    EXPECT(tpurm_ioctl(fd, UVM_TPU_RESIDENCY_INFO, &res) == 0);
+    EXPECT(res.residentHost == 1);
+
+    /* Policy + range group ABI round-trips. */
+    UvmSetPreferredLocationParams pref = { 0 };
+    pref.requestedBase = alloc.base;
+    pref.length = 1 << 20;
+    pref.preferredLocation.uuid[0] = 'C';
+    pref.preferredLocation.uuid[1] = 'X';
+    pref.preferredLocation.uuid[2] = 'L';
+    EXPECT(tpurm_ioctl(fd, UVM_SET_PREFERRED_LOCATION, &pref) == 0);
+    EXPECT(pref.rmStatus == TPU_OK);
+
+    UvmRangeGroupParams grp = { 0 };
+    EXPECT(tpurm_ioctl(fd, UVM_CREATE_RANGE_GROUP, &grp) == 0);
+    EXPECT(grp.rmStatus == TPU_OK && grp.rangeGroupId != 0);
+    UvmSetRangeGroupParams sgrp = { .rangeGroupId = grp.rangeGroupId,
+                                    .requestedBase = alloc.base,
+                                    .length = 1 << 20 };
+    EXPECT(tpurm_ioctl(fd, UVM_SET_RANGE_GROUP, &sgrp) == 0);
+    EXPECT(sgrp.rmStatus == TPU_OK);
+
+    /* Prevent migration; a migrate must leave residency unchanged. */
+    uint64_t gid = grp.rangeGroupId;
+    UvmRangeGroupMigrationParams prev = { .rangeGroupIds = (uintptr_t)&gid,
+                                          .numGroupIds = 1 };
+    EXPECT(tpurm_ioctl(fd, UVM_PREVENT_MIGRATION_RANGE_GROUPS, &prev) == 0);
+    EXPECT(prev.rmStatus == TPU_OK);
+    UvmMigrateParams mig2 = mig;
+    mig2.semaphoreAddress = 0;
+    EXPECT(tpurm_ioctl(fd, UVM_MIGRATE, &mig2) == 0);
+    EXPECT(mig2.rmStatus == TPU_OK);   /* fenced: success, no movement */
+    EXPECT(tpurm_ioctl(fd, UVM_TPU_RESIDENCY_INFO, &res) == 0);
+    EXPECT(res.residentHost == 1 && res.residentHbm == 0);
+    EXPECT(tpurm_ioctl(fd, UVM_ALLOW_MIGRATION_RANGE_GROUPS, &prev) == 0);
+
+    /* Clear the preferred location first: policies apply per managed
+     * range (uvm_va_space.c simplification), and a CXL preference would
+     * steer the device fault below to the CXL tier. */
+    UvmRangeOpParams unpref = { .requestedBase = alloc.base,
+                                .length = 1 << 20 };
+    EXPECT(tpurm_ioctl(fd, UVM_UNSET_PREFERRED_LOCATION, &unpref) == 0);
+    EXPECT(unpref.rmStatus == TPU_OK);
+
+    /* Device-access fault (device writes the second MB). */
+    UvmTpuDeviceAccessParams dacc = { 0 };
+    dacc.base = alloc.base + (1 << 20);
+    dacc.length = 1 << 20;
+    dacc.processorUuid.uuid[0] = 'T';
+    dacc.processorUuid.uuid[1] = 'P';
+    dacc.processorUuid.uuid[2] = 'U';
+    dacc.isWrite = 1;
+    EXPECT(tpurm_ioctl(fd, UVM_TPU_DEVICE_ACCESS, &dacc) == 0);
+    EXPECT(dacc.rmStatus == TPU_OK);
+    res.address = dacc.base;
+    EXPECT(tpurm_ioctl(fd, UVM_TPU_RESIDENCY_INFO, &res) == 0);
+    EXPECT(res.residentHbm == 1);
+
+    UvmFreeParams fr = { .base = alloc.base };
+    EXPECT(tpurm_ioctl(fd, UVM_FREE, &fr) == 0);
+    EXPECT(fr.rmStatus == TPU_OK);
+
+    /* Fault stats sanity: CPU + device faults both flowed. */
+    UvmFaultStats stats;
+    uvmFaultStatsGet(&stats);
+    EXPECT(stats.faultsCpu > 0);
+    EXPECT(stats.faultsDevice > 0);
+    EXPECT(stats.batches > 0);
+    printf("  fault stats: cpu=%llu dev=%llu batches=%llu p50=%lluns "
+           "p95=%lluns evictions=%llu migratedMB=%llu\n",
+           (unsigned long long)stats.faultsCpu,
+           (unsigned long long)stats.faultsDevice,
+           (unsigned long long)stats.batches,
+           (unsigned long long)stats.serviceNsP50,
+           (unsigned long long)stats.serviceNsP95,
+           (unsigned long long)stats.evictions,
+           (unsigned long long)(stats.migratedBytes >> 20));
+
+    EXPECT(tpurm_close(fd) == 0);
+
+    if (g_failures) {
+        printf("uvm_test_runner: %d FAILURES\n", g_failures);
+        return 1;
+    }
+    printf("uvm_test_runner: all ok\n");
+    return 0;
+}
