@@ -1,40 +1,81 @@
 """Benchmark of record — prints ONE JSON line.
 
-Metric (BASELINE.json): HBM↔host(CXL-tier) migrate bandwidth on the
-fault-heavy oversubscription path.  vs_baseline is measured against the
+Metric (BASELINE.json): the fault-heavy oversubscription path — device
+accesses streaming managed memory into HBM at 4x oversubscription, with
+LRU eviction pushing cold blocks out, through the UVM engine's software
+fault loop (native/src/uvm/).  vs_baseline is measured against the
 reference's only in-tree bandwidth constant: the CXL link bandwidth its
 GET_CXL_INFO reports, 3,900 MB/s (reference:
 src/nvidia/src/kernel/gpu/bus/kern_bus_ctrl.c:772-775).
 
-Runs on whatever jax.devices() provides (real TPU under the driver; CPU
-locally).  Round 1: explicit migrate microbench via the tiered-memory
-engine's transfer path; later rounds add fault-driven p50 and tokens/sec.
-All units are decimal (GB = 1e9 bytes) to match the baseline's MB/s.
+Extra fields (not the metric of record, recorded for trend):
+  fault_p50_us / fault_p95_us — fault service latency (north-star: µs-scale)
+  host_to_hbm_gbps            — JAX device_put bandwidth to the real chip
+                                 (loopback relay under axon; trend only)
+
+All units decimal (GB = 1e9 bytes) to match the baseline's MB/s.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
-import jax
-
 BASELINE_CXL_LINK_BYTES_PER_S = 3900e6
+MB = 1 << 20
 
 
-def measure_migrate_bandwidth(total_mib: int = 256, block_mib: int = 8,
-                              iters: int = 5) -> float:
-    """Host→HBM migrate bandwidth in bytes/s over block-granular device_put
-    (the migration engine's transfer primitive)."""
+def measure_oversub_fault_bandwidth() -> tuple[float, dict]:
+    """4x-oversubscription device-fault streaming bandwidth (bytes/s)."""
+    from open_gpu_kernel_modules_tpu import uvm
+
+    with uvm.VaSpace() as vs:
+        from open_gpu_kernel_modules_tpu.runtime import native
+        lib = native.load()
+        dev = lib.tpurmDeviceGet(0)
+        arena = lib.tpurmDeviceHbmSize(dev)
+
+        # 4x oversubscription in 32 MB working-set slices.
+        slice_bytes = 32 * MB
+        nbufs = max(4, (4 * arena) // slice_bytes)
+        bufs = [vs.alloc(slice_bytes) for _ in range(nbufs)]
+        for b in bufs:
+            b.view()[:] = 0xA5          # populate host tier
+
+        before = uvm.fault_stats()
+        t0 = time.perf_counter()
+        # Two passes: pass 1 is cold faults, pass 2 re-faults evicted
+        # slices — the steady-state fault+evict pipeline.
+        for _ in range(2):
+            for b in bufs:
+                b.device_access(dev=0, write=False)
+        dt = time.perf_counter() - t0
+        after = uvm.fault_stats()
+
+        total = 2 * nbufs * slice_bytes
+        extra = {
+            "fault_p50_us": round(after.service_ns_p50 / 1e3, 1),
+            "fault_p95_us": round(after.service_ns_p95 / 1e3, 1),
+            "evictions": after.evictions - before.evictions,
+            "oversub_bytes": total,
+        }
+        for b in bufs:
+            b.free()
+        return total / dt, extra
+
+
+def measure_jax_transfer_gbps(total_mib: int = 128, block_mib: int = 8,
+                              iters: int = 3) -> float:
+    """Host→chip transfer bandwidth via JAX device_put (trend only)."""
     import numpy as np
+    import jax
 
     dev = jax.devices()[0]
     nblocks = total_mib // block_mib
-    block_bytes = block_mib * 1024 * 1024
+    block_bytes = block_mib * MB
     blocks = [np.ones((block_bytes // 4,), np.float32) for _ in range(nblocks)]
-    # Warm up (allocator, transfer path).
     jax.block_until_ready(jax.device_put(blocks[0], dev))
-
     best = 0.0
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -43,16 +84,22 @@ def measure_migrate_bandwidth(total_mib: int = 256, block_mib: int = 8,
         dt = time.perf_counter() - t0
         del outs
         best = max(best, nblocks * block_bytes / dt)
-    return best
+    return best / 1e9
 
 
 def main() -> None:
-    bytes_per_s = measure_migrate_bandwidth()
+    bytes_per_s, extra = measure_oversub_fault_bandwidth()
+    if os.environ.get("BENCH_SKIP_JAX") != "1":
+        try:
+            extra["host_to_hbm_gbps"] = round(measure_jax_transfer_gbps(), 3)
+        except Exception:                       # no chip: native-only bench
+            pass
     print(json.dumps({
-        "metric": "host_to_hbm_migrate_bandwidth",
+        "metric": "oversub_4x_fault_migrate_bandwidth",
         "value": round(bytes_per_s / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(bytes_per_s / BASELINE_CXL_LINK_BYTES_PER_S, 3),
+        **extra,
     }))
 
 

@@ -44,8 +44,15 @@ static void device_init_once(void)
         dev->attached = false;
         dev->lost = false;
         dev->hbmSize = hbmBytes;
+        /* MAP_POPULATE: commit the arena up front — real HBM has no
+         * demand-zero cost, and without this every first-touch write in
+         * the migration path pays kernel page clearing (~6x slowdown on
+         * the copy, measured). Registry fake_hbm_prefault=0 disables. */
+        int populate = tpuRegistryGet("fake_hbm_prefault", 1)
+                           ? MAP_POPULATE
+                           : 0;
         dev->hbmBase = mmap(NULL, hbmBytes, PROT_READ | PROT_WRITE,
-                            MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+                            MAP_PRIVATE | MAP_ANONYMOUS | populate, -1, 0);
         if (dev->hbmBase == MAP_FAILED) {
             tpuLog(TPU_LOG_ERROR, "device",
                    "HBM arena mmap failed for dev %u (%llu bytes)", i,
@@ -53,7 +60,19 @@ static void device_init_once(void)
             dev->hbmBase = NULL;
             dev->hbmSize = 0;
         }
-        dev->ce = tpurmChannelCreate(dev, TPURM_CE_ANY, 0);
+        uint32_t pool = (uint32_t)tpuRegistryGet("uvm_ce_channels", 4);
+        if (pool < 1)
+            pool = 1;
+        if (pool > TPU_CE_POOL_MAX)
+            pool = TPU_CE_POOL_MAX;
+        dev->cePoolSize = 0;
+        for (uint32_t c = 0; c < pool; c++) {
+            dev->cePool[c] = tpurmChannelCreate(dev, TPURM_CE_ANY, 0);
+            if (!dev->cePool[c])
+                break;
+            dev->cePoolSize = c + 1;
+        }
+        dev->ce = dev->cePoolSize ? dev->cePool[0] : NULL;
         if (!dev->ce)
             tpuLog(TPU_LOG_ERROR, "device", "CE channel create failed dev %u", i);
     }

@@ -92,6 +92,8 @@ TpuStatus tpuMemdescResolve(const TpuMemDesc *md, TpurmDevice *dev,
 
 /* ----------------------------------------------------------------- device */
 
+#define TPU_CE_POOL_MAX 8
+
 struct TpurmDevice {
     uint32_t inst;             /* device instance (0..n-1)      */
     uint32_t devId;            /* probed id on the wire         */
@@ -99,7 +101,12 @@ struct TpurmDevice {
     bool lost;
     void *hbmBase;
     uint64_t hbmSize;
-    TpurmChannel *ce;          /* shared copy engine channel    */
+    TpurmChannel *ce;          /* legacy shared CE channel (== cePool[0]) */
+    /* CE channel pool (reference: channel pools per CE type,
+     * uvm_channel.c): large copies stripe across the pool so the
+     * worker threads memcpy in parallel. */
+    TpurmChannel *cePool[TPU_CE_POOL_MAX];
+    uint32_t cePoolSize;
 };
 
 void tpuDeviceGlobalInit(void);     /* idempotent */

@@ -253,8 +253,13 @@ static void ring_push(UvmFaultEntry *e)
 {
     uint64_t t = atomic_fetch_add(&g_fault.widx, 1);
     RingSlot *slot = &g_fault.ring[t % FAULT_RING_SIZE];
-    while (atomic_load_explicit(&slot->seq, memory_order_acquire) != t)
+    while (atomic_load_explicit(&slot->seq, memory_order_acquire) != t) {
+#ifdef __x86_64__
         __builtin_ia32_pause();
+#else
+        __asm__ __volatile__("" ::: "memory");
+#endif
+    }
     slot->e = e;
     atomic_store_explicit(&slot->seq, t + 1, memory_order_release);
     __atomic_fetch_add(&g_fault.pending, 1, __ATOMIC_SEQ_CST);
@@ -342,6 +347,13 @@ static TpuStatus service_one(UvmFaultEntry *e)
                 dst.tier = UVM_TIER_CXL;
                 dst.devInst = 0;
             }
+            /* Device READ faults duplicate instead of invalidating: the
+             * device copy is then clean, so eviction under memory
+             * pressure drops it without a copy-back — the streaming /
+             * KV-cache read pattern pays one copy instead of two.
+             * Device writes stay exclusive (host copy invalidated). */
+            if (!e->isWrite)
+                forceDup = true;
         }
 
         /* Prefetch growth only for single-page (CPU) faults; device spans

@@ -191,6 +191,14 @@ typedef struct UvmVaRange {
     UvmVaSpace *vaSpace;
     UvmRangeType type;
     uint64_t size;
+    /* Managed host backing: a memfd mapped twice — the user VA (node
+     * start; protection-controlled, faults drive migration) and an
+     * engine alias that is always RW.  The copy engine reads/writes the
+     * alias so user-PTE protection can never race an in-flight CE copy
+     * (the reference's equivalent: the kernel touches physical pages,
+     * not user PTEs). */
+    int memfd;
+    void *alias;
     /* Policy (reference: uvm_va_policy.c). */
     bool hasPreferred;
     UvmLocation preferred;

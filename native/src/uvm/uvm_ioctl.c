@@ -21,6 +21,11 @@
 #include <string.h>
 
 typedef struct {
+    /* rwlock: INITIALIZE/DEINITIALIZE take the write side; every other
+     * ioctl holds the read side for its whole duration, so a racing
+     * DEINITIALIZE cannot free the VA space under an in-flight migrate
+     * (the rmapi fd refcount only orders against tpurm_close). */
+    pthread_rwlock_t lock;
     UvmVaSpace *vs;              /* NULL until UVM_INITIALIZE */
     UvmToolsSession *tools;      /* NULL until TOOLS_INIT_EVENT_TRACKER */
 } UvmFdState;
@@ -64,7 +69,10 @@ static bool uuid_to_location(const UvmProcessorUuid *u, UvmLocation *out)
 
 void *tpuUvmFdOpen(void)
 {
-    return calloc(1, sizeof(UvmFdState));
+    UvmFdState *fd = calloc(1, sizeof(UvmFdState));
+    if (fd)
+        pthread_rwlock_init(&fd->lock, NULL);
+    return fd;
 }
 
 void tpuUvmFdClose(void *state)
@@ -72,14 +80,22 @@ void tpuUvmFdClose(void *state)
     UvmFdState *fd = state;
     if (!fd)
         return;
+    pthread_rwlock_wrlock(&fd->lock);
     if (fd->tools)
         uvmToolsSessionDestroy(fd->tools);
     if (fd->vs)
         uvmVaSpaceDestroy(fd->vs);
+    fd->tools = NULL;
+    fd->vs = NULL;
+    pthread_rwlock_unlock(&fd->lock);
+    pthread_rwlock_destroy(&fd->lock);
     free(fd);
 }
 
 /* ---------------------------------------------------------------- dispatch */
+
+static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
+                           unsigned long request, void *argp);
 
 int tpuUvmFdIoctl(void *state, unsigned long request, void *argp)
 {
@@ -91,14 +107,16 @@ int tpuUvmFdIoctl(void *state, unsigned long request, void *argp)
 
     if (request == UVM_INITIALIZE) {
         UvmInitializeParams *p = argp;
-        if (fd->vs) {
+        pthread_rwlock_wrlock(&fd->lock);
+        if (fd->vs)
             p->rmStatus = TPU_OK;    /* idempotent, like the reference */
-            return 0;
-        }
-        p->rmStatus = uvmVaSpaceCreate(&fd->vs);
+        else
+            p->rmStatus = uvmVaSpaceCreate(&fd->vs);
+        pthread_rwlock_unlock(&fd->lock);
         return 0;
     }
     if (request == UVM_DEINITIALIZE) {
+        pthread_rwlock_wrlock(&fd->lock);
         if (fd->tools) {
             uvmToolsSessionDestroy(fd->tools);
             fd->tools = NULL;
@@ -107,10 +125,13 @@ int tpuUvmFdIoctl(void *state, unsigned long request, void *argp)
             uvmVaSpaceDestroy(fd->vs);
             fd->vs = NULL;
         }
+        pthread_rwlock_unlock(&fd->lock);
         return 0;
     }
 
+    pthread_rwlock_rdlock(&fd->lock);
     if (!fd->vs) {
+        pthread_rwlock_unlock(&fd->lock);
         /* Reference: ioctls before UVM_INITIALIZE fail
          * (uvm_ioctl.h:1069-1084 comment). rmStatus is the first u32
          * field in some param structs but not all; INVALID_STATE via
@@ -118,8 +139,15 @@ int tpuUvmFdIoctl(void *state, unsigned long request, void *argp)
         errno = EINVAL;
         return -1;
     }
-    UvmVaSpace *vs = fd->vs;
+    int rc = uvm_fd_dispatch(fd, fd->vs, request, argp);
+    pthread_rwlock_unlock(&fd->lock);
+    return rc;
+}
 
+/* Dispatch with fd->lock held (read side). */
+static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
+                           unsigned long request, void *argp)
+{
     switch (request) {
     case UVM_REGISTER_GPU: {
         UvmRegisterGpuParams *p = argp;

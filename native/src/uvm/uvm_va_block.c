@@ -43,12 +43,19 @@ static UvmChunkRun *run_find(UvmVaBlock *blk, UvmTier tier, uint32_t page)
     return NULL;
 }
 
-/* Host-addressable pointer for `page` in `tier` (NULL if no backing). */
+/* Host-addressable pointer for `page` in `tier` (NULL if no backing).
+ * For HOST this is the ENGINE ALIAS, not the user VA: the alias is
+ * always RW, so CE copies never depend on (or race with) user-PTE
+ * protection — protection changes commit strictly after the copies
+ * they order against. */
 static void *tier_page_ptr(UvmVaBlock *blk, UvmTier tier, uint32_t page)
 {
     uint64_t ps = uvmPageSize();
-    if (tier == UVM_TIER_HOST)
-        return (char *)(uintptr_t)blk->start + (uint64_t)page * ps;
+    if (tier == UVM_TIER_HOST) {
+        UvmVaRange *range = blk->range;
+        uint64_t off = blk->start - range->node.start + (uint64_t)page * ps;
+        return (char *)range->alias + off;
+    }
     UvmChunkRun *r = run_find(blk, tier, page);
     if (!r)
         return NULL;
@@ -151,13 +158,67 @@ void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
         uvmPageMaskClearRange(&blk->cpuMapped, firstPage, count);
 }
 
-/* The channel that carries this block's copies. */
-static TpurmChannel *block_channel(UvmVaBlock *blk)
+/* CE fan-out: stripes copies across the device's channel pool so the
+ * worker threads move data in parallel (reference: channel pools per CE
+ * type + pipelined pushes, uvm_channel.c / uvm_migrate.c:555). */
+typedef struct {
+    TpurmChannel *ch[TPU_CE_POOL_MAX];
+    uint64_t last[TPU_CE_POOL_MAX];
+    uint32_t n, next;
+    uint64_t stripe;
+} CeFanout;
+
+static bool fanout_init(CeFanout *f, UvmVaBlock *blk)
 {
     TpurmDevice *dev = tpurmDeviceGet(blk->hbmDevInst);
     if (!dev)
         dev = tpurmDeviceGet(0);
-    return dev ? dev->ce : NULL;
+    if (!dev || dev->cePoolSize == 0)
+        return false;
+    f->n = dev->cePoolSize;
+    for (uint32_t i = 0; i < f->n; i++) {
+        f->ch[i] = dev->cePool[i];
+        f->last[i] = 0;
+    }
+    f->next = 0;
+    f->stripe = tpuRegistryGet("uvm_ce_stripe_bytes", 512 * 1024);
+    if (f->stripe < uvmPageSize())
+        f->stripe = uvmPageSize();
+    return true;
+}
+
+static TpuStatus fanout_push(CeFanout *f, void *dst, const void *src,
+                             uint64_t len)
+{
+    uint64_t off = 0;
+    while (off < len) {
+        uint64_t piece = len - off;
+        if (piece > f->stripe)
+            piece = f->stripe;
+        uint32_t c = f->next;
+        f->next = (f->next + 1) % f->n;
+        uint64_t v = tpurmChannelPushCopy(f->ch[c], (char *)dst + off,
+                                          (const char *)src + off, piece);
+        if (v == 0)
+            return TPU_ERR_INVALID_STATE;
+        f->last[c] = v;
+        off += piece;
+    }
+    return TPU_OK;
+}
+
+static TpuStatus fanout_wait(CeFanout *f)
+{
+    TpuStatus st = TPU_OK;
+    for (uint32_t i = 0; i < f->n; i++) {
+        if (f->last[i]) {
+            TpuStatus s = tpurmChannelWait(f->ch[i], f->last[i]);
+            if (s != TPU_OK)
+                st = s;
+            f->last[i] = 0;
+        }
+    }
+    return st;
 }
 
 /* Pick the copy source tier for a page: HBM > CXL > HOST (device copies
@@ -183,9 +244,12 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                                uint32_t count, uint64_t *bytesOut)
 {
     uint64_t ps = uvmPageSize();
-    TpurmChannel *ch = block_channel(blk);
-    uint64_t lastValue = 0, bytes = 0;
+    CeFanout fan;
+    bool haveCe = fanout_init(&fan, blk);
+    uint64_t bytes = 0;
 
+    /* On any failure, drain already-issued stripes before unwinding —
+     * the caller may free the backing the workers are still writing. */
     uint32_t p = first;
     while (p < first + count) {
         if (!uvmPageMaskTest(pages, p)) {
@@ -194,8 +258,11 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         }
         int src = page_src_tier(blk, p);
         void *dstPtr = tier_page_ptr(blk, dstTier, p);
-        if (!dstPtr)
+        if (!dstPtr) {
+            if (haveCe)
+                fanout_wait(&fan);
             return TPU_ERR_INVALID_STATE;
+        }
         if (src < 0) {
             /* First touch: zero-fill.  Host backing is fresh anonymous
              * memory — already zero, and skipping the touch keeps the
@@ -207,8 +274,11 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
             continue;
         }
         void *srcPtr = tier_page_ptr(blk, (UvmTier)src, p);
-        if (!srcPtr)
+        if (!srcPtr) {
+            if (haveCe)
+                fanout_wait(&fan);
             return TPU_ERR_INVALID_STATE;
+        }
         /* Grow the span while pages are selected, same source tier, and
          * both sides stay contiguous. */
         uint32_t span = 1;
@@ -220,21 +290,20 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                tier_page_ptr(blk, (UvmTier)src, p + span) ==
                    (char *)srcPtr + (uint64_t)span * ps)
             span++;
-        if (!ch)
+        if (!haveCe)
             return TPU_ERR_INVALID_STATE;
-        uint64_t v = tpurmChannelPushCopy(ch, dstPtr, srcPtr,
-                                          (uint64_t)span * ps);
-        if (v == 0)
-            return TPU_ERR_INVALID_STATE;
-        lastValue = v;
+        TpuStatus st = fanout_push(&fan, dstPtr, srcPtr,
+                                   (uint64_t)span * ps);
+        if (st != TPU_OK) {
+            fanout_wait(&fan);
+            return st;
+        }
         bytes += (uint64_t)span * ps;
         p += span;
     }
     if (bytesOut)
         *bytesOut = bytes;
-    if (lastValue)
-        return tpurmChannelWait(ch, lastValue);
-    return TPU_OK;
+    return haveCe ? fanout_wait(&fan) : TPU_OK;
 }
 
 /* ---------------------------------------------------------- eviction */
@@ -270,8 +339,9 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
 
     if (first <= last) {
         if (!uvmPageMaskEmpty(&toHost, np)) {
-            TpurmChannel *ch = block_channel(blk);
-            uint64_t lastValue = 0, bytes = 0;
+            CeFanout fan;
+            bool haveCe = fanout_init(&fan, blk);
+            uint64_t bytes = 0;
             for (uint32_t p = first; p <= last; p++) {
                 if (!uvmPageMaskTest(&toHost, p))
                     continue;
@@ -282,34 +352,49 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                        tier_page_ptr(blk, tier, p + span) ==
                            (char *)src + (uint64_t)span * ps)
                     span++;
-                /* Host backing must be writable for the copy-back; RW
-                 * only the evicted span — pages outside toHost may have
-                 * their sole copy elsewhere and must keep faulting. */
-                uvmBlockSetCpuAccess(blk, p, span, PROT_READ | PROT_WRITE);
-                uint64_t v = tpurmChannelPushCopy(ch, dst, src,
-                                                  (uint64_t)span * ps);
-                if (v == 0) {
+                /* Copies land in the engine alias; user PTEs stay
+                 * PROT_NONE until the data is home, so racing CPU
+                 * accesses fault and queue behind this eviction rather
+                 * than reading stale bytes or losing stores. */
+                TpuStatus st = haveCe
+                                   ? fanout_push(&fan, dst, src,
+                                                 (uint64_t)span * ps)
+                                   : TPU_ERR_INVALID_STATE;
+                if (st != TPU_OK) {
+                    if (haveCe)
+                        fanout_wait(&fan);   /* drain in-flight stripes */
                     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-evict");
                     pthread_mutex_unlock(&blk->lock);
-                    return TPU_ERR_INVALID_STATE;
+                    return st;
                 }
-                lastValue = v;
                 bytes += (uint64_t)span * ps;
                 p += span - 1;
             }
-            if (lastValue) {
-                TpuStatus st = tpurmChannelWait(ch, lastValue);
+            {
+                TpuStatus st = fanout_wait(&fan);
                 if (st != TPU_OK) {
+                    /* Nothing committed: masks and user PTEs unchanged,
+                     * so the device copy stays authoritative and CPU
+                     * accesses still fault (no silent staleness). */
                     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-evict");
                     pthread_mutex_unlock(&blk->lock);
                     return st;
                 }
             }
+            /* Commit: masks first, then user PTEs (RW only toHost spans). */
             for (uint32_t p = 0; p < np; p++) {
-                if (uvmPageMaskTest(&toHost, p)) {
-                    uvmPageMaskSet(&blk->resident[UVM_TIER_HOST], p);
-                    uvmPageMaskSet(&blk->cpuMapped, p);
+                if (!uvmPageMaskTest(&toHost, p))
+                    continue;
+                uvmPageMaskSet(&blk->resident[UVM_TIER_HOST], p);
+                uvmPageMaskSet(&blk->cpuMapped, p);
+                uint32_t span = 1;
+                while (p + span < np && uvmPageMaskTest(&toHost, p + span)) {
+                    uvmPageMaskSet(&blk->resident[UVM_TIER_HOST], p + span);
+                    uvmPageMaskSet(&blk->cpuMapped, p + span);
+                    span++;
                 }
+                uvmBlockSetCpuAccess(blk, p, span, PROT_READ | PROT_WRITE);
+                p += span - 1;
             }
             uvmFaultStatsRecordMigration(bytes);
             uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_EVICTION, tier,
@@ -334,8 +419,9 @@ static TpuStatus arena_evict_some(UvmTierArena *arena, UvmVaBlock *self)
         if (!victim)
             return TPU_ERR_NO_MEMORY;
         TpuStatus st = uvmBlockEvictFrom(victim, arena);
-        if (st == TPU_ERR_STATE_IN_USE)
-            /* Contended: put it back (tail keeps it hot), try another. */
+        if (st != TPU_OK)
+            /* Contended or failed: re-link so the block's residency is
+             * never stranded off-LRU (it still holds arena memory). */
             uvmLruTouch(arena, victim);
         uvmLruEvictDone(arena, victim);   /* release the lifetime guard */
         if (st == TPU_OK)
@@ -441,13 +527,11 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             return st;
         }
 
-        /* Copying out of host requires readable host PTEs; the service
-         * path may have them PROT_NONE after an earlier migration. */
-        if (dst.tier == UVM_TIER_HOST)
-            uvmBlockSetCpuAccess(blk, firstPage, count,
-                                 PROT_READ | PROT_WRITE);
-        else if (!uvmPageMaskEmpty(&blk->resident[UVM_TIER_HOST],
-                                   blk->npages))
+        /* Copies go through the engine alias, so user PTEs need no
+         * relaxation here — protection flips only AFTER the data moves
+         * (commit below). */
+        if (dst.tier != UVM_TIER_HOST &&
+            !uvmPageMaskEmpty(&blk->resident[UVM_TIER_HOST], blk->npages))
             /* Write-protect host pages BEFORE copying device-ward so a
              * racing CPU write faults and re-services instead of being
              * silently lost (the reference unmaps before copy for the
@@ -484,6 +568,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
                  * reference maps read-dup pages RO on every processor). */
                 uvmBlockSetCpuAccess(blk, firstPage, count, PROT_READ);
             } else {
+                uvmBlockSetCpuAccess(blk, firstPage, count,
+                                     PROT_READ | PROT_WRITE);
                 uvmPageMaskSetRange(&blk->cpuMapped, firstPage, count);
                 block_gc_runs(blk, UVM_TIER_HBM);
                 block_gc_runs(blk, UVM_TIER_CXL);

@@ -15,9 +15,11 @@
 #define _GNU_SOURCE
 #include "uvm_internal.h"
 
+#include <sched.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
+#include <unistd.h>
 
 static void vs_lock(UvmVaSpace *vs)
 {
@@ -63,6 +65,10 @@ static void range_destroy(UvmVaSpace *vs, UvmVaRange *range)
     free(range->blocks);
     uvmRangeTreeRemove(&vs->ranges, &range->node);
     munmap((void *)(uintptr_t)range->node.start, range->size);
+    if (range->alias)
+        munmap(range->alias, range->size);
+    if (range->memfd >= 0)
+        close(range->memfd);
     free(range);
 }
 
@@ -114,7 +120,9 @@ TpuStatus uvmUnregisterDevice(UvmVaSpace *vs, uint32_t devInst)
     vs->registeredDevMask &= ~(1ull << devInst);
     vs_unlock(vs);
     /* Pull this device's residency home (reference: gpu unregister evicts
-     * vidmem-resident pages). */
+     * vidmem-resident pages).  Contended blocks are retried — returning
+     * success while residency silently lingers would break the contract. */
+    TpuStatus st = TPU_OK;
     UvmTierArena *arena = uvmTierArenaHbm(devInst);
     if (arena) {
         vs_lock(vs);
@@ -123,13 +131,22 @@ TpuStatus uvmUnregisterDevice(UvmVaSpace *vs, uint32_t devInst)
             UvmVaRange *r = (UvmVaRange *)n;
             for (uint32_t i = 0; i < r->blockCount; i++) {
                 UvmVaBlock *blk = r->blocks[i];
-                if (blk->hbmRuns && blk->hbmDevInst == devInst)
-                    uvmBlockEvictFrom(blk, arena);
+                if (!(blk->hbmRuns && blk->hbmDevInst == devInst))
+                    continue;
+                TpuStatus bs = TPU_ERR_STATE_IN_USE;
+                for (int attempt = 0; attempt < 64 &&
+                                      bs == TPU_ERR_STATE_IN_USE; attempt++) {
+                    bs = uvmBlockEvictFrom(blk, arena);
+                    if (bs == TPU_ERR_STATE_IN_USE)
+                        sched_yield();
+                }
+                if (bs != TPU_OK)
+                    st = bs;
             }
         }
         vs_unlock(vs);
     }
-    return TPU_OK;
+    return st;
 }
 
 TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
@@ -139,12 +156,25 @@ TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
     uint64_t ps = uvmPageSize();
     size = (size + ps - 1) & ~(ps - 1);
 
-    /* 2 MB-aligned PROT_NONE reservation: over-map and trim. */
+    /* Host backing is a memfd mapped twice (see UvmVaRange): user VA
+     * below, engine alias after. */
+    int memfd = memfd_create("tpurm-uvm", MFD_CLOEXEC);
+    if (memfd < 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    if (ftruncate(memfd, (off_t)size) != 0) {
+        close(memfd);
+        return TPU_ERR_NO_MEMORY;
+    }
+
+    /* 2 MB-aligned reservation: over-map and trim, then fix the memfd
+     * mapping over the aligned window. */
     uint64_t mapSize = size + UVM_BLOCK_SIZE;
     char *raw = mmap(NULL, mapSize, PROT_NONE,
                      MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
-    if (raw == MAP_FAILED)
+    if (raw == MAP_FAILED) {
+        close(memfd);
         return TPU_ERR_NO_MEMORY;
+    }
     uintptr_t aligned = ((uintptr_t)raw + UVM_BLOCK_SIZE - 1) &
                         ~((uintptr_t)UVM_BLOCK_SIZE - 1);
     if (aligned > (uintptr_t)raw)
@@ -153,12 +183,29 @@ TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
     uint64_t tailLen = (uintptr_t)raw + mapSize - tailStart;
     if (tailLen)
         munmap((void *)tailStart, tailLen);
+    if (mmap((void *)aligned, size, PROT_NONE, MAP_SHARED | MAP_FIXED,
+             memfd, 0) == MAP_FAILED) {
+        munmap((void *)aligned, size);
+        close(memfd);
+        return TPU_ERR_NO_MEMORY;
+    }
+    void *alias = mmap(NULL, size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                       memfd, 0);
+    if (alias == MAP_FAILED) {
+        munmap((void *)aligned, size);
+        close(memfd);
+        return TPU_ERR_NO_MEMORY;
+    }
 
     UvmVaRange *range = calloc(1, sizeof(*range));
     if (!range) {
+        munmap(alias, size);
         munmap((void *)aligned, size);
+        close(memfd);
         return TPU_ERR_NO_MEMORY;
     }
+    range->memfd = memfd;
+    range->alias = alias;
     range->node.start = aligned;
     range->node.end = aligned + size - 1;
     range->vaSpace = vs;
@@ -171,7 +218,9 @@ TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
     range->blocks = calloc(range->blockCount, sizeof(UvmVaBlock *));
     if (!range->blocks) {
         free(range);
+        munmap(alias, size);
         munmap((void *)aligned, size);
+        close(memfd);
         return TPU_ERR_NO_MEMORY;
     }
     for (uint32_t i = 0; i < range->blockCount; i++) {
@@ -181,7 +230,9 @@ TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
                 free(range->blocks[j]);
             free(range->blocks);
             free(range);
+            munmap(alias, size);
             munmap((void *)aligned, size);
+            close(memfd);
             return TPU_ERR_NO_MEMORY;
         }
         pthread_mutex_init(&blk->lock, NULL);
@@ -204,7 +255,9 @@ TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
             free(range->blocks[i]);
         free(range->blocks);
         free(range);
+        munmap(alias, size);
         munmap((void *)aligned, size);
+        close(memfd);
         return st;
     }
     uvmFaultSnapshotRebuild();

@@ -1,1 +1,25 @@
-"""Placeholder — populated in subsequent milestones."""
+"""UVM — tiered managed memory for TPU workloads.
+
+Python surface over the native UVM engine (native/src/uvm/): VA spaces,
+managed buffers that migrate between HOST / HBM / CXL tiers on demand
+(CPU touches fault through SIGSEGV -> service thread; device accesses
+fault through the DMA paths), oversubscription with LRU eviction, and
+the policy/introspection/tools APIs.
+
+Reference parity: the capability surface of nvidia-uvm's ioctls
+(kernel-open/nvidia-uvm/uvm_ioctl.h) exposed the TPU-native way — an
+in-process library the serving stack calls directly (SURVEY.md §1: TPU
+devices are driven from userspace).
+"""
+
+from .managed import (  # noqa: F401
+    Tier,
+    VaSpace,
+    ManagedBuffer,
+    ResidencyInfo,
+    FaultStats,
+    ToolsSession,
+    Event,
+    EventType,
+    fault_stats,
+)

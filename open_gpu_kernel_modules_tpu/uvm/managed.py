@@ -1,0 +1,345 @@
+"""ctypes bindings for the native UVM engine (native/include/tpurm/uvm.h).
+
+Managed buffers expose a numpy view over the managed VA; reading or
+writing the view drives the software fault path exactly like any other
+CPU access (reference flow: uvm_gpu_replayable_faults.c service loop,
+here SIGSEGV -> fault ring -> service thread -> replay).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..runtime import native
+
+
+class Tier(enum.IntEnum):
+    """Memory tiers (uvm.h UvmTier)."""
+
+    HOST = 0
+    HBM = 1
+    CXL = 2
+
+
+class EventType(enum.IntEnum):
+    """Tools event types (uvm.h UvmEventType)."""
+
+    CPU_FAULT = 0
+    GPU_FAULT = 1
+    MIGRATION = 2
+    EVICTION = 3
+    THRASHING = 4
+    PREFETCH = 5
+    READ_DUP = 6
+
+
+class _Location(ctypes.Structure):
+    _fields_ = [("tier", ctypes.c_int), ("devInst", ctypes.c_uint32)]
+
+
+class _ResidencyInfo(ctypes.Structure):
+    _fields_ = [
+        ("residentHost", ctypes.c_uint8),
+        ("residentHbm", ctypes.c_uint8),
+        ("residentCxl", ctypes.c_uint8),
+        ("hbmDeviceInst", ctypes.c_uint32),
+        ("cpuMapped", ctypes.c_uint8),
+        ("pinnedTier", ctypes.c_int32),
+    ]
+
+
+class _FaultStats(ctypes.Structure):
+    _fields_ = [
+        ("faultsCpu", ctypes.c_uint64),
+        ("faultsDevice", ctypes.c_uint64),
+        ("batches", ctypes.c_uint64),
+        ("migratedBytes", ctypes.c_uint64),
+        ("evictions", ctypes.c_uint64),
+        ("serviceNsP50", ctypes.c_uint64),
+        ("serviceNsP95", ctypes.c_uint64),
+    ]
+
+
+class _Event(ctypes.Structure):
+    _fields_ = [
+        ("type", ctypes.c_uint32),
+        ("srcTier", ctypes.c_uint32),
+        ("dstTier", ctypes.c_uint32),
+        ("devInst", ctypes.c_uint32),
+        ("address", ctypes.c_uint64),
+        ("bytes", ctypes.c_uint64),
+        ("timestampNs", ctypes.c_uint64),
+    ]
+
+
+@dataclass(frozen=True)
+class ResidencyInfo:
+    host: bool
+    hbm: bool
+    cxl: bool
+    hbm_device: int
+    cpu_mapped: bool
+    pinned_tier: Optional[Tier]
+
+
+@dataclass(frozen=True)
+class FaultStats:
+    faults_cpu: int
+    faults_device: int
+    batches: int
+    migrated_bytes: int
+    evictions: int
+    service_ns_p50: int
+    service_ns_p95: int
+
+
+@dataclass(frozen=True)
+class Event:
+    type: EventType
+    src_tier: Optional[Tier]
+    dst_tier: Optional[Tier]
+    dev_inst: int
+    address: int
+    bytes: int
+    timestamp_ns: int
+
+
+_bound = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is not None:
+        return _bound
+    lib = native.load()
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    vp = ctypes.c_void_p
+
+    lib.uvmVaSpaceCreate.argtypes = [ctypes.POINTER(vp)]
+    lib.uvmVaSpaceCreate.restype = u32
+    lib.uvmVaSpaceDestroy.argtypes = [vp]
+    lib.uvmRegisterDevice.argtypes = [vp, u32]
+    lib.uvmRegisterDevice.restype = u32
+    lib.uvmUnregisterDevice.argtypes = [vp, u32]
+    lib.uvmUnregisterDevice.restype = u32
+    lib.uvmMemAlloc.argtypes = [vp, u64, ctypes.POINTER(vp)]
+    lib.uvmMemAlloc.restype = u32
+    lib.uvmMemFree.argtypes = [vp, vp]
+    lib.uvmMemFree.restype = u32
+    lib.uvmMigrate.argtypes = [vp, vp, u64, _Location, u32]
+    lib.uvmMigrate.restype = u32
+    lib.uvmSetPreferredLocation.argtypes = [vp, vp, u64, _Location]
+    lib.uvmSetPreferredLocation.restype = u32
+    lib.uvmUnsetPreferredLocation.argtypes = [vp, vp, u64]
+    lib.uvmUnsetPreferredLocation.restype = u32
+    lib.uvmSetAccessedBy.argtypes = [vp, vp, u64, u32]
+    lib.uvmSetAccessedBy.restype = u32
+    lib.uvmUnsetAccessedBy.argtypes = [vp, vp, u64, u32]
+    lib.uvmUnsetAccessedBy.restype = u32
+    lib.uvmSetReadDuplication.argtypes = [vp, vp, u64, ctypes.c_int]
+    lib.uvmSetReadDuplication.restype = u32
+    lib.uvmRangeGroupCreate.argtypes = [vp, ctypes.POINTER(u64)]
+    lib.uvmRangeGroupCreate.restype = u32
+    lib.uvmRangeGroupDestroy.argtypes = [vp, u64]
+    lib.uvmRangeGroupDestroy.restype = u32
+    lib.uvmRangeGroupSet.argtypes = [vp, u64, vp, u64]
+    lib.uvmRangeGroupSet.restype = u32
+    lib.uvmRangeGroupSetMigratable.argtypes = [vp, u64, ctypes.c_int]
+    lib.uvmRangeGroupSetMigratable.restype = u32
+    lib.uvmDeviceAccess.argtypes = [vp, u32, vp, u64, ctypes.c_int]
+    lib.uvmDeviceAccess.restype = u32
+    lib.uvmResidencyInfo.argtypes = [vp, vp, ctypes.POINTER(_ResidencyInfo)]
+    lib.uvmResidencyInfo.restype = u32
+    lib.uvmFaultStatsGet.argtypes = [ctypes.POINTER(_FaultStats)]
+    lib.uvmRunTest.argtypes = [vp, u32]
+    lib.uvmRunTest.restype = u32
+    lib.uvmToolsSessionCreate.argtypes = [vp, u32, ctypes.POINTER(vp)]
+    lib.uvmToolsSessionCreate.restype = u32
+    lib.uvmToolsSessionDestroy.argtypes = [vp]
+    lib.uvmToolsEnableEvents.argtypes = [vp, u64]
+    lib.uvmToolsReadEvents.argtypes = [vp, ctypes.POINTER(_Event),
+                                       ctypes.c_size_t]
+    lib.uvmToolsReadEvents.restype = ctypes.c_size_t
+
+    _bound = lib
+    return lib
+
+
+def _check(status: int, what: str) -> None:
+    if status != 0:
+        raise native.RmError(status, what)
+
+
+def _tier_or_none(value: int) -> Optional[Tier]:
+    return Tier(value) if 0 <= value < len(Tier) else None
+
+
+def fault_stats() -> FaultStats:
+    """Global fault-engine statistics (uvm.h uvmFaultStatsGet)."""
+    lib = _lib()
+    raw = _FaultStats()
+    lib.uvmFaultStatsGet(ctypes.byref(raw))
+    return FaultStats(raw.faultsCpu, raw.faultsDevice, raw.batches,
+                      raw.migratedBytes, raw.evictions, raw.serviceNsP50,
+                      raw.serviceNsP95)
+
+
+class ToolsSession:
+    """Event-queue session (reference: uvm_tools.c mmap'd queues)."""
+
+    def __init__(self, vs: "VaSpace", capacity: int = 4096):
+        self._lib = _lib()
+        handle = ctypes.c_void_p()
+        _check(self._lib.uvmToolsSessionCreate(vs._handle, capacity,
+                                               ctypes.byref(handle)),
+               "uvmToolsSessionCreate")
+        self._handle = handle
+
+    def enable(self, types: Iterable[EventType]) -> None:
+        mask = 0
+        for t in types:
+            mask |= 1 << int(t)
+        self._lib.uvmToolsEnableEvents(self._handle, mask)
+
+    def read(self, max_events: int = 1024) -> List[Event]:
+        buf = (_Event * max_events)()
+        n = self._lib.uvmToolsReadEvents(self._handle, buf, max_events)
+        return [Event(EventType(e.type), _tier_or_none(e.srcTier),
+                      _tier_or_none(e.dstTier), e.devInst, e.address,
+                      e.bytes, e.timestampNs) for e in buf[:n]]
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.uvmToolsSessionDestroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ManagedBuffer:
+    """A managed allocation: migrates between tiers on demand.
+
+    `view(dtype)` returns a numpy array over the managed VA — plain CPU
+    reads/writes fault and migrate transparently.  `migrate`/`prefetch`
+    and `device_access` drive explicit and device-side movement.
+    """
+
+    def __init__(self, vs: "VaSpace", nbytes: int):
+        self._vs = vs
+        self._lib = vs._lib
+        ptr = ctypes.c_void_p()
+        _check(self._lib.uvmMemAlloc(vs._handle, nbytes, ctypes.byref(ptr)),
+               "uvmMemAlloc")
+        self.address = ptr.value
+        self.nbytes = nbytes
+
+    def view(self, dtype=np.uint8, shape=None) -> np.ndarray:
+        itemsize = np.dtype(dtype).itemsize
+        count = self.nbytes // itemsize
+        buf = (ctypes.c_char * self.nbytes).from_address(self.address)
+        arr = np.frombuffer(buf, dtype=dtype, count=count)
+        return arr.reshape(shape) if shape is not None else arr
+
+    def migrate(self, tier: Tier, dev: int = 0, offset: int = 0,
+                length: Optional[int] = None) -> None:
+        length = self.nbytes - offset if length is None else length
+        loc = _Location(int(tier), dev)
+        _check(self._lib.uvmMigrate(self._vs._handle, self.address + offset,
+                                    length, loc, 0), "uvmMigrate")
+
+    def device_access(self, dev: int = 0, offset: int = 0,
+                      length: Optional[int] = None, write: bool = False) -> None:
+        """Simulated device touch: faults the span into device residency."""
+        length = self.nbytes - offset if length is None else length
+        _check(self._lib.uvmDeviceAccess(self._vs._handle, dev,
+                                         self.address + offset, length,
+                                         1 if write else 0),
+               "uvmDeviceAccess")
+
+    def set_preferred(self, tier: Tier, dev: int = 0) -> None:
+        loc = _Location(int(tier), dev)
+        _check(self._lib.uvmSetPreferredLocation(self._vs._handle,
+                                                 self.address, self.nbytes,
+                                                 loc),
+               "uvmSetPreferredLocation")
+
+    def unset_preferred(self) -> None:
+        _check(self._lib.uvmUnsetPreferredLocation(self._vs._handle,
+                                                   self.address, self.nbytes),
+               "uvmUnsetPreferredLocation")
+
+    def set_read_duplication(self, enable: bool) -> None:
+        _check(self._lib.uvmSetReadDuplication(self._vs._handle, self.address,
+                                               self.nbytes,
+                                               1 if enable else 0),
+               "uvmSetReadDuplication")
+
+    def set_accessed_by(self, dev: int) -> None:
+        _check(self._lib.uvmSetAccessedBy(self._vs._handle, self.address,
+                                          self.nbytes, dev),
+               "uvmSetAccessedBy")
+
+    def residency(self, offset: int = 0) -> ResidencyInfo:
+        raw = _ResidencyInfo()
+        _check(self._lib.uvmResidencyInfo(self._vs._handle,
+                                          self.address + offset,
+                                          ctypes.byref(raw)),
+               "uvmResidencyInfo")
+        return ResidencyInfo(bool(raw.residentHost), bool(raw.residentHbm),
+                             bool(raw.residentCxl), raw.hbmDeviceInst,
+                             bool(raw.cpuMapped),
+                             _tier_or_none(raw.pinnedTier))
+
+    def free(self) -> None:
+        if self.address:
+            _check(self._lib.uvmMemFree(self._vs._handle, self.address),
+                   "uvmMemFree")
+            self.address = 0
+
+
+class VaSpace:
+    """Per-client UVM VA space (reference: uvm_va_space.c)."""
+
+    def __init__(self, register_devices: Sequence[int] = (0,)):
+        self._lib = _lib()
+        handle = ctypes.c_void_p()
+        _check(self._lib.uvmVaSpaceCreate(ctypes.byref(handle)),
+               "uvmVaSpaceCreate")
+        self._handle = handle
+        self._buffers: List[ManagedBuffer] = []
+        for dev in register_devices:
+            _check(self._lib.uvmRegisterDevice(self._handle, dev),
+                   "uvmRegisterDevice")
+
+    def alloc(self, nbytes: int) -> ManagedBuffer:
+        buf = ManagedBuffer(self, nbytes)
+        self._buffers.append(buf)
+        return buf
+
+    def run_test(self, test_cmd: int) -> None:
+        _check(self._lib.uvmRunTest(self._handle, test_cmd), "uvmRunTest")
+
+    def tools_session(self, capacity: int = 4096) -> ToolsSession:
+        return ToolsSession(self, capacity)
+
+    def close(self) -> None:
+        if self._handle:
+            for buf in self._buffers:
+                buf.address = 0      # freed wholesale with the space
+            self._lib.uvmVaSpaceDestroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,151 @@
+"""Tests for the UVM tiered-memory engine through the Python bindings.
+
+Covers the reference's UVM capability surface (SURVEY.md §2.2) end to
+end: fault-driven residency, explicit migration, oversubscription with
+eviction, read duplication, policies, tools events, and the in-module
+test framework.
+"""
+
+import numpy as np
+import pytest
+
+from open_gpu_kernel_modules_tpu import uvm
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier, EventType
+
+MB = 1 << 20
+
+
+@pytest.fixture(scope="module")
+def vs():
+    space = uvm.VaSpace()
+    yield space
+    space.close()
+
+
+def test_first_touch_populates_host(vs):
+    buf = vs.alloc(4 * MB)
+    arr = buf.view(np.float32)
+    arr[0] = 1.5
+    arr[-1] = 2.5
+    info = buf.residency()
+    assert info.host and info.cpu_mapped
+    assert arr[0] == 1.5 and arr[-1] == 2.5
+    buf.free()
+
+
+def test_migrate_and_fault_back(vs):
+    buf = vs.alloc(4 * MB)
+    arr = buf.view(np.uint8)
+    arr[:] = 7
+    buf.migrate(Tier.HBM)
+    info = buf.residency()
+    assert info.hbm and not info.host and not info.cpu_mapped
+    # CPU read faults the page home with data intact.
+    assert arr[123] == 7
+    assert buf.residency().host
+    # CXL round-trip.
+    buf.migrate(Tier.CXL)
+    assert buf.residency().cxl
+    assert arr[-1] == 7
+    buf.free()
+
+
+def test_device_access_faults_to_hbm(vs):
+    buf = vs.alloc(4 * MB)
+    buf.view()[:] = 3
+    buf.device_access(dev=0, write=True)
+    info = buf.residency()
+    assert info.hbm and info.hbm_device == 0
+    buf.free()
+
+
+def test_oversubscription_evicts_and_preserves_data(vs):
+    # Fake HBM arena defaults to 128 MB (TPUMEM_FAKE_HBM_MB); 8 x 32 MB
+    # migrations oversubscribe it 2x and must evict.
+    before = uvm.fault_stats()
+    bufs = [vs.alloc(32 * MB) for _ in range(8)]
+    for i, buf in enumerate(bufs):
+        buf.view()[:] = 0x40 + i
+        buf.migrate(Tier.HBM)
+    after = uvm.fault_stats()
+    assert after.evictions > before.evictions
+    for i, buf in enumerate(bufs):
+        arr = buf.view()
+        assert arr[0] == 0x40 + i
+        assert arr[-1] == 0x40 + i
+        buf.free()
+
+
+def test_read_duplication(vs):
+    buf = vs.alloc(2 * MB)
+    arr = buf.view(np.uint8)
+    arr[:] = 9
+    buf.set_read_duplication(True)
+    buf.migrate(Tier.CXL)
+    assert buf.residency().cxl
+    # Read fault duplicates instead of invalidating.
+    assert arr[0] == 9
+    info = buf.residency()
+    assert info.host and info.cxl
+    # Write invalidates the duplicate.
+    arr[0] = 10
+    info = buf.residency()
+    assert info.host and not info.cxl
+    buf.set_read_duplication(False)
+    buf.free()
+
+
+def test_preferred_location_steers_device_fault(vs):
+    buf = vs.alloc(2 * MB)
+    buf.view()[:] = 1
+    buf.set_preferred(Tier.CXL)
+    buf.device_access(dev=0, write=False)
+    info = buf.residency()
+    assert info.cxl and not info.hbm
+    buf.unset_preferred()
+    buf.free()
+
+
+def test_tools_events_flow(vs):
+    with vs.tools_session() as session:
+        session.enable([EventType.MIGRATION, EventType.CPU_FAULT,
+                        EventType.EVICTION])
+        buf = vs.alloc(2 * MB)
+        buf.view()[:] = 5          # CPU faults
+        buf.migrate(Tier.HBM)      # migration
+        _ = buf.view()[0]          # fault back
+        events = session.read()
+        kinds = {e.type for e in events}
+        assert EventType.MIGRATION in kinds
+        assert EventType.CPU_FAULT in kinds
+        buf.free()
+
+
+def test_fault_stats_progress(vs):
+    before = uvm.fault_stats()
+    buf = vs.alloc(2 * MB)
+    buf.view()[:] = 1
+    after = uvm.fault_stats()
+    assert after.faults_cpu > before.faults_cpu
+    assert after.batches > before.batches
+    # µs-scale p50 is the metric of record (BASELINE.md): enforce a
+    # generous ceiling so regressions to ms-scale fail loudly.
+    assert 0 < after.service_ns_p50 < 1_000_000
+    buf.free()
+
+
+def test_in_module_suite(vs):
+    for cmd in (1, 2, 3, 5, 6):      # range trees, pmm, va block, locks
+        vs.run_test(cmd)
+
+
+def test_numpy_compute_on_managed_memory(vs):
+    """Managed memory behaves as plain memory for numpy compute."""
+    buf = vs.alloc(8 * MB)
+    arr = buf.view(np.float32)
+    arr[:] = np.arange(arr.size, dtype=np.float32)
+    buf.migrate(Tier.CXL)
+    # Compute directly against CXL-resident data: faults stream it home.
+    total = float(np.sum(arr[:1024]))
+    assert total == float(np.sum(np.arange(1024, dtype=np.float32)))
+    buf.free()
