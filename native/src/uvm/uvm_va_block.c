@@ -585,8 +585,12 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         break;
     }
 
-    /* Write access invalidates duplicates even on the resident tier. */
-    if (forWrite && (range->readDuplication || forceDup)) {
+    /* Write access always makes the destination exclusive (MESI): clear
+     * duplicates on other tiers and restore protections — including when
+     * no copy was needed (e.g. a CPU write to a page left PROT_READ by an
+     * earlier device-read duplication; without this fix-up the store
+     * would re-fault forever because nneeded==0 skips the commit path). */
+    if (forWrite) {
         for (uint32_t p = firstPage; p < firstPage + count; p++) {
             for (int t = 0; t < UVM_TIER_COUNT; t++) {
                 if (t != (int)dst.tier)

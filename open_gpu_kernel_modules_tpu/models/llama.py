@@ -140,7 +140,8 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
            cos: jax.Array, sin: jax.Array, mask: Optional[jax.Array],
            kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
-           cache_pos: Optional[jax.Array] = None):
+           cache_pos: Optional[jax.Array] = None,
+           use_flash: bool = False):
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -161,7 +162,11 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
 
     k = repeat_kv(k, nh // nkv)
     v = repeat_kv(v, nh // nkv)
-    out = attention(q, k, v, mask).reshape(b, s, nh * hd)
+    if use_flash and kv_cache is None:
+        from ..ops import flash_attention
+        out = flash_attention(q, k, v, causal=True).reshape(b, s, nh * hd)
+    else:
+        out = attention(q, k, v, mask).reshape(b, s, nh * hd)
     x = x + out @ lp["wo"]
 
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -178,16 +183,19 @@ def causal_mask(sq: int, sk: int, offset: int = 0) -> jax.Array:
 
 
 def forward(cfg: LlamaConfig, params: Dict[str, Any],
-            tokens: jax.Array) -> jax.Array:
-    """Full-sequence forward → logits [B, S, V].  Layers run as lax.scan."""
+            tokens: jax.Array, use_flash: bool = False) -> jax.Array:
+    """Full-sequence forward → logits [B, S, V].  Layers run as lax.scan.
+
+    use_flash swaps the jnp attention for the pallas flash kernel
+    (ops.flash_attention) — the TPU prefill path."""
     b, s = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     cos, sin = rope_table(cfg, positions)
-    mask = causal_mask(s, s)
+    mask = None if use_flash else causal_mask(s, s)
 
     def body(x, lp):
-        x, _ = _layer(cfg, x, lp, cos, sin, mask)
+        x, _ = _layer(cfg, x, lp, cos, sin, mask, use_flash=use_flash)
         return x, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
@@ -214,10 +222,11 @@ def forward_with_cache(cfg: LlamaConfig, params: Dict[str, Any],
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s)) + pos
     cos, sin = rope_table(cfg, positions)
-    # Mask over full cache: key j visible iff j <= pos + i (and j < pos + s
-    # entries beyond current fill are masked because cache is causal-filled).
+    # Mask over the cache span (taken from the cache shape, so callers can
+    # pass right-sized caches): key j visible iff j <= pos + i.
+    smax = kv[0].shape[2]
     qi = jnp.arange(s)[:, None] + pos
-    kj = jnp.arange(cfg.max_seq_len)[None, :]
+    kj = jnp.arange(smax)[None, :]
     mask = jnp.where(kj <= qi, 0.0, -jnp.inf)[None, None].astype(jnp.float32)
 
     def body(x, carry):

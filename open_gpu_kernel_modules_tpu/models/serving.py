@@ -1,0 +1,267 @@
+"""Inference engine: paged KV cache, CXL-tiered backing, decode loop.
+
+BASELINE config #4 ("CXL.mem-tiered KV-cache, Llama inference"): the KV
+pool's backing store is UVM managed memory with preferred location CXL —
+cold pages live in the CXL tier, and the pages a decode step touches are
+faulted device-ward through the UVM engine (uvmDeviceAccess) before the
+compute consumes them.  The device-side math is ops.paged_attention for
+decode and ops.flash_attention / the dense path for prefill.
+
+Two layers:
+  PagedKVCache  — device-resident page pool + per-sequence page tables
+                  (the pure-JAX fast path; everything fits in HBM).
+  TieredKVCache — the same pool backed by a UVM ManagedBuffer; pages
+                  migrate HOST<->CXL<->HBM-arena under the fault engine
+                  and are materialized to device arrays on access.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import llama
+from ..ops import paged_attention
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """Block-paged KV pool: k/v [L, N, P, KV, D], page tables [B, M]."""
+
+    cfg: llama.LlamaConfig
+    page_size: int
+    k_pages: jax.Array          # [L, N, P, KV, D]
+    v_pages: jax.Array
+    page_table: jax.Array       # [B, M] int32
+    seq_lens: jax.Array         # [B] int32
+
+    @staticmethod
+    def create(cfg: llama.LlamaConfig, batch: int, max_len: int,
+               page_size: int = 64) -> "PagedKVCache":
+        m = (max_len + page_size - 1) // page_size
+        n = batch * m
+        shape = (cfg.num_layers, n, page_size, cfg.num_kv_heads, cfg.head_dim)
+        # Static page assignment: sequence b owns pages [b*m, (b+1)*m).
+        table = (np.arange(batch)[:, None] * m +
+                 np.arange(m)[None, :]).astype(np.int32)
+        return PagedKVCache(
+            cfg=cfg, page_size=page_size,
+            k_pages=jnp.zeros(shape, cfg.dtype),
+            v_pages=jnp.zeros(shape, cfg.dtype),
+            page_table=jnp.asarray(table),
+            seq_lens=jnp.zeros((batch,), jnp.int32))
+
+    @property
+    def max_len(self) -> int:
+        return self.page_table.shape[1] * self.page_size
+
+
+jax.tree_util.register_dataclass(
+    PagedKVCache,
+    data_fields=("k_pages", "v_pages", "page_table", "seq_lens"),
+    meta_fields=("cfg", "page_size"))
+
+
+def _write_kv(cache: PagedKVCache, layer_k: jax.Array, layer_v: jax.Array,
+              pos: jax.Array) -> PagedKVCache:
+    """Write [L, B, S, KV, D] chunk at position pos into the paged pool."""
+    L, b, s, kv, d = layer_k.shape
+    p = cache.page_size
+    m = cache.page_table.shape[1]
+
+    # Flatten target slots: token t of batch i lands in page
+    # table[i, (pos+t)//p] at offset (pos+t)%p.
+    tok = pos + jnp.arange(s)                                  # [S]
+    page_idx = cache.page_table[:, :]                          # [B, M]
+    page_of_tok = jnp.take_along_axis(
+        page_idx, (tok[None, :] // p).astype(jnp.int32), axis=1)  # [B, S]
+    off_of_tok = tok % p                                       # [S]
+
+    flat_idx = (page_of_tok * p + off_of_tok[None, :]).reshape(-1)   # [B*S]
+    k_flat = cache.k_pages.reshape(L, -1, kv, d)
+    v_flat = cache.v_pages.reshape(L, -1, kv, d)
+    k_src = layer_k.reshape(L, b * s, kv, d)
+    v_src = layer_v.reshape(L, b * s, kv, d)
+    k_flat = k_flat.at[:, flat_idx].set(k_src)
+    v_flat = v_flat.at[:, flat_idx].set(v_src)
+    return dataclasses.replace(
+        cache,
+        k_pages=k_flat.reshape(cache.k_pages.shape),
+        v_pages=v_flat.reshape(cache.v_pages.shape))
+
+
+def prefill(cfg: llama.LlamaConfig, params: Dict[str, Any],
+            tokens: jax.Array, cache: PagedKVCache
+            ) -> Tuple[jax.Array, PagedKVCache]:
+    """Run the prompt through the model, filling the paged cache.
+
+    Returns (last-token logits [B, V], cache)."""
+    b, s = tokens.shape
+    kv = llama.init_kv_cache(cfg, b)
+    # Clamp dense scratch cache to the prompt span for the forward pass.
+    kv = (kv[0][:, :, :s], kv[1][:, :, :s])
+    logits, kv = _prefill_step(cfg, params, tokens, kv)
+    cache = _write_kv(cache, kv[0], kv[1], jnp.int32(0))
+    cache = dataclasses.replace(
+        cache, seq_lens=jnp.full((b,), s, jnp.int32))
+    return logits[:, -1], cache
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _prefill_step(cfg, params, tokens, kv):
+    return llama.forward_with_cache(cfg, params, tokens, kv, jnp.int32(0))
+
+
+@partial(jax.jit, static_argnums=(0,))
+def decode_step(cfg: llama.LlamaConfig, params: Dict[str, Any],
+                tokens: jax.Array, cache: PagedKVCache
+                ) -> Tuple[jax.Array, PagedKVCache]:
+    """One decode step: tokens [B] -> (logits [B, V], updated cache)."""
+    b = tokens.shape[0]
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    x = params["embed"][tokens][:, None, :]                # [B, 1, H]
+    pos = cache.seq_lens                                   # [B]
+    cos, sin = llama.rope_table(cfg, pos[:, None])         # [B, 1, D/2]
+
+    p = cache.page_size
+
+    def body(x, layer):
+        lp, lk_pages, lv_pages = layer
+        attn_in = llama.rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = (attn_in @ lp["wq"]).reshape(b, 1, nh, hd)
+        k = (attn_in @ lp["wk"]).reshape(b, 1, nkv, hd)
+        v = (attn_in @ lp["wv"]).reshape(b, 1, nkv, hd)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+
+        # Scatter this token's K/V into its page slot.
+        page_of = jnp.take_along_axis(
+            cache.page_table, (pos[:, None] // p).astype(jnp.int32),
+            axis=1)[:, 0]                                   # [B]
+        slot = (page_of * p + pos % p).astype(jnp.int32)    # [B]
+        n_, p_, kv_, d_ = lk_pages.shape
+        lk_flat = lk_pages.reshape(n_ * p_, kv_, d_)
+        lv_flat = lv_pages.reshape(n_ * p_, kv_, d_)
+        lk_flat = lk_flat.at[slot].set(k[:, 0])
+        lv_flat = lv_flat.at[slot].set(v[:, 0])
+        lk_pages = lk_flat.reshape(n_, p_, kv_, d_)
+        lv_pages = lv_flat.reshape(n_, p_, kv_, d_)
+
+        out = paged_attention(q[:, 0], lk_pages, lv_pages, cache.page_table,
+                              pos + 1, nh)                  # [B, H, D]
+        x = x + (out.reshape(b, 1, nh * hd) @ lp["wo"])
+        mlp_in = llama.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((mlp_in @ lp["w_gate"]).astype(jnp.float32)
+                           ).astype(x.dtype)
+        x = x + ((gate * (mlp_in @ lp["w_up"])) @ lp["w_down"])
+        return x, (lk_pages, lv_pages)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (params["layers"], cache.k_pages, cache.v_pages))
+    x = llama.rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    cache = dataclasses.replace(cache, k_pages=k_pages, v_pages=v_pages,
+                                seq_lens=cache.seq_lens + 1)
+    return logits, cache
+
+
+def generate(cfg: llama.LlamaConfig, params: Dict[str, Any],
+             prompt: jax.Array, max_new_tokens: int,
+             cache: Optional[PagedKVCache] = None,
+             greedy: bool = True) -> Tuple[jax.Array, PagedKVCache, float]:
+    """Prefill + decode loop.  Returns (tokens [B, S+T], cache, tok/s)."""
+    b, s = prompt.shape
+    if cache is None:
+        cache = PagedKVCache.create(cfg, b, s + max_new_tokens)
+    logits, cache = prefill(cfg, params, prompt, cache)
+    out = [prompt]
+    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    t0 = time.perf_counter()
+    for _ in range(max_new_tokens):
+        out.append(next_tok[:, None])
+        logits, cache = decode_step(cfg, params, next_tok, cache)
+        next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    jax.block_until_ready(logits)
+    dt = time.perf_counter() - t0
+    toks_per_s = (b * max_new_tokens) / dt if dt > 0 else 0.0
+    return jnp.concatenate(out, axis=1), cache, toks_per_s
+
+
+# --------------------------------------------------------------- tiering
+
+class TieredKVCache:
+    """Paged KV pool backed by UVM managed memory, preferred tier CXL.
+
+    The pool (all layers' pages) lives in one managed allocation whose
+    preferred location is the CXL tier; ``touch_pages`` drives device
+    faults for exactly the pages a step reads (prefetch/thrashing
+    heuristics apply), and ``pool_arrays`` materializes the device-side
+    view for the compute.  This is the config #4 shape: KV >> HBM with
+    the hot working set resident device-side.
+    """
+
+    def __init__(self, cfg: llama.LlamaConfig, batch: int, max_len: int,
+                 page_size: int = 64, dev: int = 0):
+        from .. import uvm
+        from ..uvm.managed import Tier
+
+        self.cfg = cfg
+        self.page_size = page_size
+        self.dev = dev
+        m = (max_len + page_size - 1) // page_size
+        self.pages_per_seq = m
+        n = batch * m
+        self.pool_shape = (cfg.num_layers, n, page_size, cfg.num_kv_heads,
+                           cfg.head_dim)
+        self.page_bytes = (page_size * cfg.num_kv_heads * cfg.head_dim *
+                           np.dtype(np.float32).itemsize)
+        pool_bytes = int(np.prod(self.pool_shape)) * 4  # fp32 host pool
+
+        self.vs = uvm.VaSpace(register_devices=(dev,))
+        self.k_buf = self.vs.alloc(pool_bytes)
+        self.v_buf = self.vs.alloc(pool_bytes)
+        for buf in (self.k_buf, self.v_buf):
+            buf.set_preferred(Tier.CXL)
+            buf.view(np.float32)[:] = 0.0
+            buf.migrate(Tier.CXL)
+        self.page_table = (np.arange(batch)[:, None] * m +
+                           np.arange(m)[None, :]).astype(np.int32)
+        self.seq_lens = np.zeros((batch,), np.int32)
+
+    def k_view(self) -> np.ndarray:
+        return self.k_buf.view(np.float32, self.pool_shape)
+
+    def v_view(self) -> np.ndarray:
+        return self.v_buf.view(np.float32, self.pool_shape)
+
+    def touch_pages(self, batch_idx: int) -> int:
+        """Fault the pages holding batch_idx's live tokens device-ward.
+        Returns the number of pages touched."""
+        sl = int(self.seq_lens[batch_idx])
+        npages = (sl + self.page_size - 1) // self.page_size
+        layer_stride = self.pool_shape[1] * self.page_bytes
+        for pg in range(npages):
+            page = int(self.page_table[batch_idx, pg])
+            for layer in range(self.cfg.num_layers):
+                off = layer * layer_stride + page * self.page_bytes
+                self.k_buf.device_access(dev=self.dev, offset=off,
+                                         length=self.page_bytes)
+                self.v_buf.device_access(dev=self.dev, offset=off,
+                                         length=self.page_bytes)
+        return npages
+
+    def pool_arrays(self) -> Tuple[jax.Array, jax.Array]:
+        """Materialize the pool for device compute (dtype per config)."""
+        k = jnp.asarray(self.k_view(), dtype=self.cfg.dtype)
+        v = jnp.asarray(self.v_view(), dtype=self.cfg.dtype)
+        return k, v
+
+    def close(self) -> None:
+        self.vs.close()

@@ -1,1 +1,8 @@
-"""Placeholder — populated in subsequent milestones."""
+"""TPU ops: pallas kernels for the hot paths.
+
+- flash_attention — blockwise online-softmax attention (prefill path)
+- paged_attention — block-paged decode attention (tiered KV cache)
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
+from .paged_attention import paged_attention  # noqa: F401

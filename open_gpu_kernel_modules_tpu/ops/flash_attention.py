@@ -1,0 +1,142 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise online-softmax attention (Flash-Attention style): the grid is
+(batch*heads, q_blocks, k_blocks); TPU grids execute the trailing axis
+sequentially per core, so the running max / denominator / accumulator
+live in VMEM scratch carried across k-steps, initialized at k==0 and
+written out at the last k block.  Matmuls are MXU-shaped ([blk, d] x
+[d, blk]) in fp32 accumulation.
+
+On non-TPU backends the same kernel runs in interpret mode (tests), so
+one code path serves CPU CI and the real chip.
+
+The serving stack uses this for prefill; decode-time paged attention
+lives in ops/paged_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, blk_q: int, blk_k: int, causal: bool,
+                  kv_len: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    k_steps = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: k-blocks entirely in this q-block's future contribute
+    # nothing — skip their MXU work (roughly halves prefill FLOPs).
+    k_base = ki * blk_k
+    q_last = qi * blk_q + blk_q - 1
+    live = (k_base <= q_last) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [blk_q, d]
+        k = k_ref[0].astype(jnp.float32)          # [blk_k, d]
+        v = v_ref[0].astype(jnp.float32)          # [blk_k, d]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        # Mask: causal (global q index >= global k index) + kv-length tail.
+        k_ids = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = k_ids < kv_len
+        if causal:
+            q_ids = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                          s.shape, 0)
+            valid = jnp.logical_and(valid, k_ids <= q_ids)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]                     # [blk_q, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [blk_q, blk_k]
+        correction = jnp.exp(m_prev - m_new)       # [blk_q, 1]
+
+        l_new = correction * l_scr[:, 0:1] + jnp.sum(p, axis=-1,
+                                                     keepdims=True)
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == k_steps - 1)
+    def _finish():
+        denom = l_scr[:, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)   # fully-masked rows
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _pick_block(n: int, target: int) -> int:
+    blk = min(n, target)
+    while n % blk:
+        blk //= 2
+    return max(blk, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
+                                             "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, blk_q: int = 128, blk_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """q,k,v: [B, S, H, D] (same S; GQA expansion done by caller).
+
+    Returns [B, S, H, D] in q.dtype.  interpret=None auto-selects
+    interpret mode off-TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    blk_q = _pick_block(sq, blk_q)
+    blk_k = _pick_block(sk, blk_k)
+
+    # [B, S, H, D] -> [B*H, S, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // blk_q, sk // blk_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=1.0 / (d ** 0.5), blk_q=blk_q, blk_k=blk_k,
+        causal=causal, kv_len=sk)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

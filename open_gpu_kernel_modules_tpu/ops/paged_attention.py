@@ -1,0 +1,56 @@
+"""Paged decode attention over a block-paged KV pool.
+
+Decode-time attention where each sequence's KV cache is a list of
+fixed-size pages in a shared pool — the device-side half of the
+CXL-tiered KV cache (BASELINE config #4): the pool's backing pages live
+in UVM managed memory and migrate HBM<->CXL under the fault engine,
+while this op consumes whatever pages are device-resident.
+
+Decode is HBM-bandwidth-bound, not FLOPs-bound, so the op is expressed
+in jnp (gather + one [B,H,1,S] attention) and left to XLA to fuse — a
+hand-tiled kernel buys nothing when a single query row streams the
+whole cache once.  Prefill uses ops.flash_attention instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_heads",))
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    page_table: jax.Array, seq_lens: jax.Array,
+                    num_heads: int) -> jax.Array:
+    """Single-token decode attention.
+
+    q:          [B, H, D]      query for the next position
+    k_pages:    [N, P, KV, D]  shared page pool (N pages of P tokens)
+    v_pages:    [N, P, KV, D]
+    page_table: [B, M]         page indices per sequence (int32)
+    seq_lens:   [B]            current length per sequence
+    Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    n, p, kv, _ = k_pages.shape
+    m = page_table.shape[1]
+
+    # Gather each sequence's pages: [B, M, P, KV, D] -> [B, M*P, KV, D].
+    k = k_pages[page_table].reshape(b, m * p, kv, d)
+    v = v_pages[page_table].reshape(b, m * p, kv, d)
+
+    # GQA expansion to H heads.
+    rep = num_heads // kv
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(m * p)[None, :] < seq_lens[:, None]     # [B, S]
+    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

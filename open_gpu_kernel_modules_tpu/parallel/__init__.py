@@ -1,1 +1,14 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Parallelism: mesh construction, sharding rules, ring attention.
+
+Scaling is expressed the TPU-native way — jax.sharding.Mesh + pjit/
+shard_map with XLA collectives over ICI — not as a port of the
+reference's NVLink/NVSwitch/NCCL stack (SURVEY.md §2.7 mapping).
+"""
+
+from .mesh import (  # noqa: F401
+    make_mesh,
+    llama_param_specs,
+    shard_params,
+    data_sharding,
+)
+from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401

@@ -1,0 +1,77 @@
+"""Device-mesh construction + sharding rules for the model family.
+
+TPU-native scaling: a named ``jax.sharding.Mesh`` over dp/tp/sp axes,
+``NamedSharding`` annotations on the parameter pytree, and XLA-inserted
+collectives over ICI (SURVEY.md §2.7: the ICI substrate plays the role
+the reference's NVLink/NVSwitch stack plays; compute-parallelism on top
+is expressed the JAX way rather than via an NCCL analog).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh with axes (dp, tp, sp).  dp*tp*sp must divide the device count;
+    surplus devices are left out (useful for odd local topologies)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp * sp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, tp, sp)
+    return Mesh(arr, ("dp", "tp", "sp"))
+
+
+def llama_param_specs() -> Dict[str, P]:
+    """PartitionSpecs for the stacked-layer Llama pytree (models.llama).
+
+    Megatron-style tensor parallelism: column-parallel wq/wk/wv/w_gate/
+    w_up (shard the output feature axis over tp), row-parallel wo/w_down
+    (shard the input feature axis; XLA inserts the psum).  Embedding /
+    lm_head shard the vocab-adjacent axis.  Layer-stacked arrays keep
+    axis 0 (layers) replicated — pipeline sharding of axis 0 arrives
+    with the pp milestone.
+    """
+    return {
+        "embed": P(None, "tp"),
+        "final_norm": P(None),
+        "lm_head": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "mlp_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+    }
+
+
+def shard_params(params, mesh: Mesh):
+    """device_put the pytree with llama_param_specs over ``mesh``."""
+    specs = llama_param_specs()
+
+    def put(path_spec, value):
+        return jax.device_put(value, NamedSharding(mesh, path_spec))
+
+    return {
+        "embed": put(specs["embed"], params["embed"]),
+        "final_norm": put(specs["final_norm"], params["final_norm"]),
+        "lm_head": put(specs["lm_head"], params["lm_head"]),
+        "layers": {k: put(specs["layers"][k], v)
+                   for k, v in params["layers"].items()},
+    }
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch sharded over dp, sequence over sp (long-context inputs)."""
+    return NamedSharding(mesh, P("dp", "sp"))

@@ -1,0 +1,79 @@
+"""Serving-engine tests: paged decode vs dense reference, generation,
+and the CXL-tiered KV cache (config #4 shape)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from open_gpu_kernel_modules_tpu.models import llama, serving
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=128)
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def test_paged_decode_matches_dense(setup):
+    cfg, params = setup
+    b, s = 2, 17
+    prompt = jax.random.randint(jax.random.key(1), (b, s), 0, cfg.vocab_size)
+
+    # Dense reference: full forward over growing sequence.
+    cache = serving.PagedKVCache.create(cfg, b, 64, page_size=8)
+    logits, cache = serving.prefill(cfg, params, prompt, cache)
+    dense_logits = llama.forward(cfg, params, prompt)[:, -1]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(dense_logits),
+                               atol=2e-4)
+
+    # Two decode steps must match dense forward over the extended seq.
+    seq = prompt
+    for _ in range(2):
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        logits, cache = serving.decode_step(cfg, params, nxt, cache)
+        dense = llama.forward(cfg, params, seq)[:, -1]
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(dense),
+                                   atol=3e-4)
+
+
+def test_generate_shapes_and_throughput(setup):
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.key(2), (2, 8), 0, cfg.vocab_size)
+    tokens, cache, tps = serving.generate(cfg, params, prompt, 12)
+    assert tokens.shape == (2, 20)
+    assert int(cache.seq_lens[0]) == 20
+    assert tps > 0
+
+
+def test_tiered_kv_cache_faults_pages(setup):
+    cfg, params = setup
+    from open_gpu_kernel_modules_tpu import uvm
+
+    tiered = serving.TieredKVCache(cfg, batch=2, max_len=128, page_size=16)
+    try:
+        # Simulate a prefill writing through the host view.
+        kview = tiered.k_view()
+        kview[:, 0, :, :, :] = 1.0
+        tiered.seq_lens[:] = 40
+
+        before = uvm.fault_stats()
+        npages = tiered.touch_pages(0)
+        after = uvm.fault_stats()
+        assert npages == 3                      # ceil(40/16)
+        assert after.faults_device > before.faults_device
+
+        # Device-side arrays materialize with the written data.
+        k, v = tiered.pool_arrays()
+        assert k.shape == tiered.pool_shape
+        assert float(k[0, 0, 0, 0, 0]) == 1.0
+
+        # Residency: first page of the pool should now be device-resident
+        # (read faults duplicate, so host residency persists too).
+        info = tiered.k_buf.residency(offset=0)
+        assert info.hbm or info.cxl
+    finally:
+        tiered.close()

@@ -134,6 +134,23 @@ def test_fault_stats_progress(vs):
     buf.free()
 
 
+def test_cpu_write_after_device_read_dup(vs):
+    """Regression: a device READ fault duplicates and leaves host pages
+    read-only; the next CPU write must invalidate the duplicate and
+    restore RW (it previously livelocked re-faulting forever)."""
+    buf = vs.alloc(2 * MB)
+    arr = buf.view(np.uint8)
+    arr[:] = 4                      # host resident, RW
+    buf.device_access(dev=0, write=False)   # duplicate -> host now RO
+    info = buf.residency()
+    assert info.hbm and info.host
+    arr[0] = 9                      # CPU write: must not livelock
+    info = buf.residency()
+    assert info.host and not info.hbm
+    assert arr[0] == 9
+    buf.free()
+
+
 def test_in_module_suite(vs):
     for cmd in (1, 2, 3, 5, 6):      # range trees, pmm, va block, locks
         vs.run_test(cmd)
