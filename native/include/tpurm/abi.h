@@ -122,6 +122,26 @@ typedef struct {
     uint32_t gpuIds[TPU_CTRL_MAX_ATTACHED_DEVICES];
 } TpuCtrlGetAttachedIdsParams;
 
+/* NV0000_CTRL_CMD_SYSTEM_GET_P2P_CAPS_V2 param subset.  Caps bits mirror
+ * the reference's p2p caps (platform/p2p/p2p_caps.c), including the
+ * fork-added CXL connectivity (client_resource.c:597-616); ICI plays the
+ * NVLINK role (SURVEY.md §2.7). */
+#define TPU_P2P_CAPS_READS_SUPPORTED   0x1u
+#define TPU_P2P_CAPS_WRITES_SUPPORTED  0x2u
+#define TPU_P2P_CAPS_ICI_SUPPORTED     0x4u   /* NVLINK analog */
+#define TPU_P2P_CAPS_ATOMICS_SUPPORTED 0x8u
+#define TPU_P2P_CAPS_CXL_SUPPORTED     0x10u  /* fork delta */
+
+#define TPU_CTRL_P2P_MAX_GPUS 8
+
+typedef struct {
+    uint32_t gpuIds[TPU_CTRL_P2P_MAX_GPUS];   /* IN: wire ids */
+    uint32_t gpuCount;                        /* IN */
+    uint32_t p2pCaps;                         /* OUT: common caps mask */
+    uint32_t busPeerIds[TPU_CTRL_P2P_MAX_GPUS * TPU_CTRL_P2P_MAX_GPUS];
+                                              /* OUT: hop counts, ~0 = none */
+} TpuCtrlGetP2pCapsV2Params;
+
 /* -------------------------------------- NV2080 (subdevice) CXL controls
  * The four fork-added commands (ctrl2080bus.h:1430-1549). */
 

@@ -1,0 +1,89 @@
+/*
+ * tpurm ICI — inter-chip interconnect topology, link management, and
+ * peer HBM apertures.
+ *
+ * Re-design of the reference's NVLink/NVSwitch substrate (SURVEY.md
+ * §2.7): the nvlink core library's link state machine
+ * (src/common/nvlink/ — discovery/init/training) collapses to a small
+ * per-link DOWN->TRAINING->ACTIVE machine, and the NVSwitch fabric
+ * (src/common/nvswitch/, routing tables) collapses to a torus
+ * neighbor/route table — TPUs have point-to-point ICI with no switch
+ * ASIC, so routing is dimension-ordered over the torus.
+ *
+ * Peer apertures are the P2P substrate (reference: p2p_api.c P2P objects
+ * + UVM peer identity mappings): once links are ACTIVE, a device can map
+ * a neighbor's HBM window and DMA to/from it through its CE channels
+ * (BASELINE config #5, ICI peer-mapped HBM pool).
+ */
+#ifndef TPURM_ICI_H
+#define TPURM_ICI_H
+
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+    TPU_ICI_LINK_DOWN = 0,
+    TPU_ICI_LINK_TRAINING = 1,
+    TPU_ICI_LINK_ACTIVE = 2,
+    TPU_ICI_LINK_FAILED = 3,
+} TpuIciLinkState;
+
+typedef struct {
+    uint32_t peerInst;          /* device at the other end */
+    uint32_t state;             /* TpuIciLinkState */
+    uint64_t trainedAtNs;
+    uint64_t bytesTx, bytesRx;
+    uint32_t errorCount;
+} TpuIciLinkInfo;
+
+/* Topology init: arranges the enumerated devices in a torus.  Dims come
+ * from registry "ici_torus_x" / "ici_torus_y" (default: 1-D ring over
+ * all devices).  Idempotent; called lazily by every other entry point. */
+void tpuIciInit(void);
+
+/* Number of ICI links on a device (2 per torus dimension with >2 nodes). */
+uint32_t tpuIciLinkCount(uint32_t devInst);
+TpuStatus tpuIciLinkInfo(uint32_t devInst, uint32_t link,
+                         TpuIciLinkInfo *out);
+
+/* Train a link (DOWN -> TRAINING -> ACTIVE) or all links of a device.
+ * Reference: nvlink_lib_mgmt.c init sequences. */
+TpuStatus tpuIciTrainLinks(uint32_t devInst);
+
+/* Fault injection: fail a link; routes avoid FAILED links where the
+ * torus offers an alternative dimension. */
+TpuStatus tpuIciInjectLinkFailure(uint32_t devInst, uint32_t link);
+TpuStatus tpuIciResetLink(uint32_t devInst, uint32_t link);
+
+/* Dimension-ordered next hop from src toward dst; TPU_ERR_* when no
+ * route (e.g. partitioned by failures).  next==dst on the last hop. */
+TpuStatus tpuIciRouteNextHop(uint32_t src, uint32_t dst, uint32_t *next);
+/* Hop count src -> dst along the routed path (0 when src == dst). */
+TpuStatus tpuIciRouteHops(uint32_t src, uint32_t dst, uint32_t *hops);
+
+/* ------------------------------------------------------ peer apertures */
+
+/* Map peer HBM into src's reachable address space.  Requires every link
+ * along the route ACTIVE.  The returned aperture is the substrate for
+ * peer DMA: tpuIciPeerCopy moves bytes between devices' HBM windows,
+ * accounting traffic on the traversed links. */
+typedef struct TpuIciPeerAperture TpuIciPeerAperture;
+
+TpuStatus tpuIciPeerApertureCreate(uint32_t srcInst, uint32_t peerInst,
+                                   TpuIciPeerAperture **out);
+void      tpuIciPeerApertureDestroy(TpuIciPeerAperture *ap);
+/* Copy between local HBM offset and peer HBM offset over the aperture
+ * (direction: 0 = local->peer write, 1 = peer->local read). */
+TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
+                         uint64_t peerOff, uint64_t size, int direction);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_ICI_H */

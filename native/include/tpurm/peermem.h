@@ -1,0 +1,94 @@
+/*
+ * tpurm peermem — TPU-direct RDMA: expose device-resident managed memory
+ * to third-party DMA engines (RDMA NICs).
+ *
+ * Re-design of the reference's P2P export API + peermem module
+ * (kernel-open/nvidia/nv-p2p.c:646 nvidia_p2p_get_pages / dma_map_pages /
+ * put_pages; kernel-open/nvidia-peermem/nvidia-peermem.c acquire:198,
+ * get_pages:216, dma_map:245, free-callback revoke:134).  Flow parity:
+ *
+ *   tpuP2pGetPages     — pin a managed VA range's pages device-side
+ *                        (migrates to HBM, pins against eviction) and
+ *                        return their bus addresses,
+ *   tpuP2pDmaMapPages  — per-NIC IOVA mapping of a page table,
+ *   tpuP2pPutPages     — unpin + release,
+ *   free callback      — invoked when the underlying range is freed
+ *                        (uvmMemFree/VaSpaceDestroy) so the RDMA consumer
+ *                        revokes its MR, exactly the reference's
+ *                        invalidation contract.
+ *
+ * TPU shape: "bus addresses" are offsets into the device HBM window (the
+ * window a NIC would BAR-map); the fake-device backend resolves them to
+ * host pointers so the loopback RDMA test can actually move bytes.
+ */
+#ifndef TPURM_PEERMEM_H
+#define TPURM_PEERMEM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "status.h"
+#include "uvm.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPU_P2P_PAGE_TABLE_VERSION 0x10001
+#define TPU_P2P_PAGE_SIZE_DEFAULT  (64 * 1024)
+
+typedef struct {
+    uint64_t busAddress;        /* offset into the device HBM window */
+} TpuP2pPage;
+
+typedef struct {
+    uint32_t version;
+    uint32_t pageSize;
+    uint32_t devInst;
+    uint32_t entries;
+    TpuP2pPage *pages;
+} TpuP2pPageTable;
+
+typedef struct {
+    uint32_t version;
+    uint32_t nicId;
+    uint32_t entries;
+    uint64_t *iova;             /* per-page NIC-visible addresses */
+} TpuP2pDmaMapping;
+
+/* Invalidation callback (reference: free-callback at nv-p2p.c get_pages):
+ * called when the underlying managed range goes away. */
+typedef void (*TpuP2pFreeCallback)(void *data);
+
+/* Pin [va, va+size) of vs device-side and build a page table.  The range
+ * is migrated to the device's HBM tier and pinned against eviction until
+ * tpuP2pPutPages. */
+TpuStatus tpuP2pGetPages(UvmVaSpace *vs, uint32_t devInst, uint64_t va,
+                         uint64_t size, TpuP2pPageTable **out,
+                         TpuP2pFreeCallback cb, void *cbData);
+TpuStatus tpuP2pDmaMapPages(TpuP2pPageTable *pt, uint32_t nicId,
+                            TpuP2pDmaMapping **out);
+TpuStatus tpuP2pDmaUnmapPages(TpuP2pDmaMapping *map);
+TpuStatus tpuP2pPutPages(TpuP2pPageTable *pt);
+
+/* Fake-backend resolution for loopback tests: host pointer for a bus
+ * address (NULL when out of range). */
+void *tpuP2pBusToPtr(uint32_t devInst, uint64_t busAddress);
+
+/* ------------------------------------------------------ dma-buf analog */
+
+/* Export a device HBM window as a refcounted handle another subsystem
+ * can import (reference: nv-dmabuf.c exporting GPU memory as dma-buf). */
+typedef struct TpuDmabuf TpuDmabuf;
+
+TpuStatus  tpuDmabufExport(uint32_t devInst, uint64_t offset, uint64_t size,
+                           TpuDmabuf **out);
+TpuStatus  tpuDmabufImport(TpuDmabuf *buf, void **ptr, uint64_t *size);
+void       tpuDmabufPut(TpuDmabuf *buf);   /* drop one reference */
+TpuDmabuf *tpuDmabufGet(TpuDmabuf *buf);   /* take one reference */
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_PEERMEM_H */

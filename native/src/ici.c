@@ -1,0 +1,383 @@
+/*
+ * ICI — inter-chip interconnect manager (see include/tpurm/ici.h).
+ *
+ * Torus topology over the enumerated devices: registry "ici_torus_x" /
+ * "ici_torus_y" pick the dims (default 1-D ring).  Links are
+ * bidirectional neighbor pairs with a DOWN->TRAINING->ACTIVE state
+ * machine (reference: nvlink core library link init/training,
+ * src/common/nvlink/), traffic accounting, fault injection, and
+ * dimension-ordered routing that detours around FAILED links when the
+ * other dimension offers a path (the reference's NVSwitch routing
+ * tables collapse to this — no switch ASIC on ICI).
+ *
+ * Peer apertures implement the P2P substrate over trained links: HBM
+ * window copies between devices through the local CE channel pool, with
+ * per-hop traffic accounted on every traversed link.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/ici.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+#define MAX_ICI_DEVICES 16
+#define MAX_LINKS_PER_DEV 4     /* 2 dims x 2 directions */
+
+typedef struct {
+    uint32_t peerInst;
+    uint32_t state;             /* TpuIciLinkState */
+    uint64_t trainedAtNs;
+    uint64_t bytesTx, bytesRx;
+    uint32_t errorCount;
+    uint8_t dim;                /* 0 = x, 1 = y */
+    int8_t dir;                 /* +1 / -1 around the torus */
+} IciLink;
+
+static struct {
+    pthread_mutex_t lock;
+    bool ready;
+    uint32_t count, dimX, dimY;
+    IciLink links[MAX_ICI_DEVICES][MAX_LINKS_PER_DEV];
+    uint32_t linkCount[MAX_ICI_DEVICES];
+} g_ici = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+static uint64_t now_ns(void)
+{
+    extern uint64_t uvmMonotonicNs(void);
+    return uvmMonotonicNs();
+}
+
+static void train_links_locked(uint32_t devInst);
+
+static void ici_add_link(uint32_t dev, uint32_t peer, uint8_t dim, int8_t dir)
+{
+    uint32_t n = g_ici.linkCount[dev];
+    if (n >= MAX_LINKS_PER_DEV || peer == dev)
+        return;
+    /* Two-device rings would create duplicate +1/-1 links. */
+    for (uint32_t i = 0; i < n; i++)
+        if (g_ici.links[dev][i].peerInst == peer &&
+            g_ici.links[dev][i].dim == dim)
+            return;
+    g_ici.links[dev][n].peerInst = peer;
+    g_ici.links[dev][n].state = TPU_ICI_LINK_DOWN;
+    g_ici.links[dev][n].dim = dim;
+    g_ici.links[dev][n].dir = dir;
+    g_ici.linkCount[dev] = n + 1;
+}
+
+void tpuIciInit(void)
+{
+    pthread_mutex_lock(&g_ici.lock);
+    if (g_ici.ready) {
+        pthread_mutex_unlock(&g_ici.lock);
+        return;
+    }
+    tpuDeviceGlobalInit();
+    uint32_t n = tpurmDeviceCount();
+    if (n > MAX_ICI_DEVICES)
+        n = MAX_ICI_DEVICES;
+    uint32_t dimX = (uint32_t)tpuRegistryGet("ici_torus_x", n);
+    uint32_t dimY = (uint32_t)tpuRegistryGet("ici_torus_y", 1);
+    if (dimX * dimY != n) {     /* fall back to a ring */
+        dimX = n;
+        dimY = 1;
+    }
+    g_ici.count = n;
+    g_ici.dimX = dimX;
+    g_ici.dimY = dimY;
+
+    for (uint32_t d = 0; d < n; d++) {
+        uint32_t x = d % dimX, y = d / dimX;
+        if (dimX > 1) {
+            ici_add_link(d, y * dimX + (x + 1) % dimX, 0, +1);
+            ici_add_link(d, y * dimX + (x + dimX - 1) % dimX, 0, -1);
+        }
+        if (dimY > 1) {
+            ici_add_link(d, ((y + 1) % dimY) * dimX + x, 1, +1);
+            ici_add_link(d, ((y + dimY - 1) % dimY) * dimX + x, 1, -1);
+        }
+    }
+    /* Links train at init by default (reference: boot-time link init);
+     * registry ici_auto_train=0 leaves them DOWN for tests.  Training
+     * happens BEFORE ready is published so no concurrent first caller
+     * can route over still-DOWN links. */
+    if (tpuRegistryGet("ici_auto_train", 1))
+        for (uint32_t d = 0; d < n; d++)
+            train_links_locked(d);
+    g_ici.ready = true;
+    tpuLog(TPU_LOG_INFO, "ici", "topology: %ux%u torus, %u device(s)",
+           dimX, dimY, n);
+    pthread_mutex_unlock(&g_ici.lock);
+}
+
+uint32_t tpuIciLinkCount(uint32_t devInst)
+{
+    tpuIciInit();
+    if (devInst >= g_ici.count)
+        return 0;
+    return g_ici.linkCount[devInst];
+}
+
+TpuStatus tpuIciLinkInfo(uint32_t devInst, uint32_t link,
+                         TpuIciLinkInfo *out)
+{
+    tpuIciInit();
+    if (!out || devInst >= g_ici.count ||
+        link >= g_ici.linkCount[devInst])
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_ici.lock);
+    IciLink *l = &g_ici.links[devInst][link];
+    out->peerInst = l->peerInst;
+    out->state = l->state;
+    out->trainedAtNs = l->trainedAtNs;
+    out->bytesTx = l->bytesTx;
+    out->bytesRx = l->bytesRx;
+    out->errorCount = l->errorCount;
+    pthread_mutex_unlock(&g_ici.lock);
+    return TPU_OK;
+}
+
+/* Find dev's link to `peer`, preferring ACTIVE; NULL if none. */
+static IciLink *link_to(uint32_t dev, uint32_t peer)
+{
+    for (uint32_t i = 0; i < g_ici.linkCount[dev]; i++)
+        if (g_ici.links[dev][i].peerInst == peer)
+            return &g_ici.links[dev][i];
+    return NULL;
+}
+
+static void train_links_locked(uint32_t devInst)
+{
+    for (uint32_t i = 0; i < g_ici.linkCount[devInst]; i++) {
+        IciLink *l = &g_ici.links[devInst][i];
+        if (l->state == TPU_ICI_LINK_FAILED)
+            continue;
+        /* DOWN -> TRAINING -> ACTIVE, and the peer's matching link
+         * trains with it (links are bidirectional pairs). */
+        l->state = TPU_ICI_LINK_TRAINING;
+        l->state = TPU_ICI_LINK_ACTIVE;
+        l->trainedAtNs = now_ns();
+        IciLink *back = link_to(l->peerInst, devInst);
+        if (back && back->state != TPU_ICI_LINK_FAILED) {
+            back->state = TPU_ICI_LINK_ACTIVE;
+            back->trainedAtNs = l->trainedAtNs;
+        }
+        tpuCounterAdd("ici_links_trained", 1);
+    }
+}
+
+TpuStatus tpuIciTrainLinks(uint32_t devInst)
+{
+    tpuIciInit();
+    if (devInst >= g_ici.count)
+        return TPU_ERR_INVALID_DEVICE;
+    pthread_mutex_lock(&g_ici.lock);
+    train_links_locked(devInst);
+    pthread_mutex_unlock(&g_ici.lock);
+    return TPU_OK;
+}
+
+TpuStatus tpuIciInjectLinkFailure(uint32_t devInst, uint32_t link)
+{
+    tpuIciInit();
+    if (devInst >= g_ici.count || link >= g_ici.linkCount[devInst])
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_ici.lock);
+    IciLink *l = &g_ici.links[devInst][link];
+    l->state = TPU_ICI_LINK_FAILED;
+    l->errorCount++;
+    IciLink *back = link_to(l->peerInst, devInst);
+    if (back) {
+        back->state = TPU_ICI_LINK_FAILED;
+        back->errorCount++;
+    }
+    tpuLog(TPU_LOG_WARN, "ici", "link %u.%u -> %u FAILED (injected)",
+           devInst, link, l->peerInst);
+    pthread_mutex_unlock(&g_ici.lock);
+    return TPU_OK;
+}
+
+TpuStatus tpuIciResetLink(uint32_t devInst, uint32_t link)
+{
+    tpuIciInit();
+    if (devInst >= g_ici.count || link >= g_ici.linkCount[devInst])
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_ici.lock);
+    IciLink *l = &g_ici.links[devInst][link];
+    l->state = TPU_ICI_LINK_DOWN;
+    IciLink *back = link_to(l->peerInst, devInst);
+    if (back)
+        back->state = TPU_ICI_LINK_DOWN;
+    pthread_mutex_unlock(&g_ici.lock);
+    return TPU_OK;
+}
+
+/* Shortest-path next hop over ACTIVE links (BFS from dst).  On a healthy
+ * torus this reproduces dimension-ordered minimal routing; with FAILED
+ * links it detours loop-free or reports a partition.  N is tiny (<=16),
+ * so per-query BFS costs nothing; a routing cache would be the next step
+ * if topologies grew. */
+static TpuStatus next_hop_locked(uint32_t src, uint32_t dst, uint32_t *next)
+{
+    if (src == dst) {
+        *next = dst;
+        return TPU_OK;
+    }
+    uint8_t dist[MAX_ICI_DEVICES];
+    uint32_t queue[MAX_ICI_DEVICES];
+    memset(dist, 0xFF, sizeof(dist));
+    uint32_t head = 0, tail = 0;
+    dist[dst] = 0;
+    queue[tail++] = dst;
+    while (head < tail) {
+        uint32_t cur = queue[head++];
+        for (uint32_t i = 0; i < g_ici.linkCount[cur]; i++) {
+            IciLink *l = &g_ici.links[cur][i];
+            if (l->state != TPU_ICI_LINK_ACTIVE)
+                continue;
+            uint32_t peer = l->peerInst;
+            if (dist[peer] == 0xFF) {
+                dist[peer] = dist[cur] + 1;
+                queue[tail++] = peer;
+            }
+        }
+    }
+    if (dist[src] == 0xFF)
+        return TPU_ERR_OBJECT_NOT_FOUND;    /* partitioned */
+    for (uint32_t i = 0; i < g_ici.linkCount[src]; i++) {
+        IciLink *l = &g_ici.links[src][i];
+        if (l->state == TPU_ICI_LINK_ACTIVE &&
+            dist[l->peerInst] == dist[src] - 1) {
+            *next = l->peerInst;
+            return TPU_OK;
+        }
+    }
+    return TPU_ERR_INVALID_STATE;           /* unreachable */
+}
+
+TpuStatus tpuIciRouteNextHop(uint32_t src, uint32_t dst, uint32_t *next)
+{
+    tpuIciInit();
+    if (!next || src >= g_ici.count || dst >= g_ici.count)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_ici.lock);
+    TpuStatus st = next_hop_locked(src, dst, next);
+    pthread_mutex_unlock(&g_ici.lock);
+    return st;
+}
+
+TpuStatus tpuIciRouteHops(uint32_t src, uint32_t dst, uint32_t *hops)
+{
+    tpuIciInit();
+    if (!hops || src >= g_ici.count || dst >= g_ici.count)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_ici.lock);
+    uint32_t cur = src, n = 0;
+    TpuStatus st = TPU_OK;
+    while (cur != dst && n <= g_ici.count) {
+        uint32_t next;
+        st = next_hop_locked(cur, dst, &next);
+        if (st != TPU_OK)
+            break;
+        cur = next;
+        n++;
+    }
+    if (n > g_ici.count)
+        st = TPU_ERR_INVALID_STATE;     /* routing loop */
+    pthread_mutex_unlock(&g_ici.lock);
+    /* *hops only on success — callers keep their '~0 = unreachable'
+     * sentinel on failure (abi.h busPeerIds contract). */
+    if (st == TPU_OK)
+        *hops = n;
+    return st;
+}
+
+/* ------------------------------------------------------ peer apertures */
+
+struct TpuIciPeerAperture {
+    uint32_t srcInst, peerInst;
+};
+
+/* Account `bytes` on every link along src->dst (both directions). */
+static TpuStatus account_route_locked(uint32_t src, uint32_t dst,
+                                      uint64_t bytes)
+{
+    uint32_t cur = src, guard = 0;
+    while (cur != dst) {
+        uint32_t next;
+        TpuStatus st = next_hop_locked(cur, dst, &next);
+        if (st != TPU_OK)
+            return st;
+        IciLink *l = link_to(cur, next);
+        IciLink *back = link_to(next, cur);
+        if (l)
+            l->bytesTx += bytes;
+        if (back)
+            back->bytesRx += bytes;
+        cur = next;
+        if (++guard > g_ici.count)
+            return TPU_ERR_INVALID_STATE;
+    }
+    return TPU_OK;
+}
+
+TpuStatus tpuIciPeerApertureCreate(uint32_t srcInst, uint32_t peerInst,
+                                   TpuIciPeerAperture **out)
+{
+    tpuIciInit();
+    if (!out || srcInst >= g_ici.count || peerInst >= g_ici.count ||
+        srcInst == peerInst)
+        return TPU_ERR_INVALID_ARGUMENT;
+    /* Route must exist over ACTIVE links. */
+    uint32_t hops;
+    TpuStatus st = tpuIciRouteHops(srcInst, peerInst, &hops);
+    if (st != TPU_OK)
+        return st;
+    TpuIciPeerAperture *ap = calloc(1, sizeof(*ap));
+    if (!ap)
+        return TPU_ERR_NO_MEMORY;
+    ap->srcInst = srcInst;
+    ap->peerInst = peerInst;
+    tpuCounterAdd("ici_peer_apertures", 1);
+    *out = ap;
+    return TPU_OK;
+}
+
+void tpuIciPeerApertureDestroy(TpuIciPeerAperture *ap)
+{
+    free(ap);
+}
+
+TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
+                         uint64_t peerOff, uint64_t size, int direction)
+{
+    if (!ap || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpurmDevice *local = tpurmDeviceGet(ap->srcInst);
+    TpurmDevice *peer = tpurmDeviceGet(ap->peerInst);
+    if (!local || !peer)
+        return TPU_ERR_INVALID_DEVICE;
+    if (local->lost || peer->lost)
+        return TPU_ERR_GPU_IS_LOST;
+    if (localOff + size > tpurmDeviceHbmSize(local) ||
+        peerOff + size > tpurmDeviceHbmSize(peer))
+        return TPU_ERR_INVALID_LIMIT;
+
+    pthread_mutex_lock(&g_ici.lock);
+    TpuStatus st = account_route_locked(ap->srcInst, ap->peerInst, size);
+    pthread_mutex_unlock(&g_ici.lock);
+    if (st != TPU_OK)
+        return st;
+
+    char *lp = (char *)tpurmDeviceHbmBase(local) + localOff;
+    char *pp = (char *)tpurmDeviceHbmBase(peer) + peerOff;
+    void *dst = direction == 0 ? pp : lp;
+    const void *src = direction == 0 ? lp : pp;
+    uint64_t v = tpurmChannelPushCopy(local->ce, dst, src, size);
+    if (v == 0)
+        return TPU_ERR_INVALID_STATE;
+    tpuCounterAdd("ici_peer_copy_bytes", size);
+    return tpurmChannelWait(local->ce, v);
+}

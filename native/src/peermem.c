@@ -1,0 +1,338 @@
+/*
+ * peermem — TPU-direct RDMA export (see include/tpurm/peermem.h).
+ *
+ * Reference flow (nvidia-peermem.c + nv-p2p.c): ibv_reg_mr ->
+ * acquire -> get_pages (pins vidmem, registers free callback) ->
+ * dma_map (per-NIC sg_table) -> ... -> free callback revokes on
+ * underlying free.  Implemented here over the UVM engine: get_pages
+ * migrates the span to the device HBM tier and pins every covered
+ * block; bus addresses are the backing chunks' offsets into the device
+ * HBM window.  A global registration table drives callback revocation
+ * from the UVM range-destroy hook.
+ *
+ * The dma-buf analog (tpuDmabufExport/Import, reference nv-dmabuf.c) is
+ * a refcounted handle over an HBM window for in-process subsystem
+ * handoff.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "uvm/uvm_internal.h"
+#include "tpurm/peermem.h"
+
+#include <pthread.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct Registration {
+    TpuP2pPageTable *pt;
+    UvmVaSpace *vs;
+    uint64_t va, size;
+    UvmVaBlock **blocks;
+    uint32_t blockCount;
+    TpuP2pFreeCallback cb;
+    void *cbData;
+    bool revoked;
+    struct Registration *next;
+} Registration;
+
+static struct {
+    pthread_mutex_t lock;
+    Registration *head;
+    bool hookInstalled;
+} g_peermem = { PTHREAD_MUTEX_INITIALIZER, NULL, false };
+
+/* Range teardown: revoke every registration overlapping [start, start+size).
+ * Runs before the backing is freed; consumers must stop using bus
+ * addresses from their callback (reference invalidation contract). */
+static void peermem_range_destroy_hook(uint64_t start, uint64_t size)
+{
+    /* Mark + unpin under the lock; invoke consumer callbacks AFTER
+     * releasing it — the reference contract lets a free callback call
+     * put_pages, which takes g_peermem.lock (self-deadlock otherwise). */
+    enum { MAX_FIRE = 64 };
+    TpuP2pFreeCallback cbs[MAX_FIRE];
+    void *cbData[MAX_FIRE];
+    uint32_t nfire = 0;
+
+    pthread_mutex_lock(&g_peermem.lock);
+    for (Registration *r = g_peermem.head; r; r = r->next) {
+        if (r->revoked || r->va >= start + size || start >= r->va + r->size)
+            continue;
+        r->revoked = true;
+        /* Blocks are about to be freed wholesale; drop our pins now. */
+        for (uint32_t i = 0; i < r->blockCount; i++)
+            uvmBlockP2pUnpin(r->blocks[i]);
+        if (r->cb && nfire < MAX_FIRE) {
+            cbs[nfire] = r->cb;
+            cbData[nfire] = r->cbData;
+            nfire++;
+        }
+        tpuCounterAdd("peermem_revocations", 1);
+    }
+    pthread_mutex_unlock(&g_peermem.lock);
+
+    for (uint32_t i = 0; i < nfire; i++)
+        cbs[i](cbData[i]);
+}
+
+static void peermem_init(void)
+{
+    pthread_mutex_lock(&g_peermem.lock);
+    if (!g_peermem.hookInstalled) {
+        uvmSetRangeDestroyHook(peermem_range_destroy_hook);
+        g_peermem.hookInstalled = true;
+    }
+    pthread_mutex_unlock(&g_peermem.lock);
+}
+
+TpuStatus tpuP2pGetPages(UvmVaSpace *vs, uint32_t devInst, uint64_t va,
+                         uint64_t size, TpuP2pPageTable **out,
+                         TpuP2pFreeCallback cb, void *cbData)
+{
+    if (!vs || !out || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpurmDevice *dev = tpurmDeviceGet(devInst);
+    if (!dev)
+        return TPU_ERR_INVALID_DEVICE;
+    peermem_init();
+
+    uint64_t ps = uvmPageSize();
+    uint64_t start = va & ~(ps - 1);
+    uint64_t end = (va + size - 1) | (ps - 1);
+
+    /* Make the span device-resident (exclusive; like the reference this
+     * is vidmem being exported, not a duplicate). */
+    UvmLocation hbm = { UVM_TIER_HBM, devInst };
+    TpuStatus st = uvmMigrate(vs, (void *)(uintptr_t)start,
+                              end - start + 1, hbm, 0);
+    if (st != TPU_OK)
+        return st;
+
+    uint32_t entries = (uint32_t)((end - start + 1) / ps);
+    TpuP2pPageTable *pt = calloc(1, sizeof(*pt));
+    TpuP2pPage *pages = calloc(entries, sizeof(*pages));
+    Registration *reg = calloc(1, sizeof(*reg));
+    UvmVaBlock **blocks = calloc((entries * ps + UVM_BLOCK_SIZE - 1) /
+                                 UVM_BLOCK_SIZE + 1, sizeof(*blocks));
+    if (!pt || !pages || !reg || !blocks) {
+        free(pt);
+        free(pages);
+        free(reg);
+        free(blocks);
+        return TPU_ERR_NO_MEMORY;
+    }
+
+    /* Walk blocks: pin each one UNDER ITS LOCK while resolving its run
+     * list — a concurrent evictor takes only blk->lock, so resolving
+     * first and pinning later would race run frees (bus addresses into
+     * reallocated chunks).  Pin-then-resolve under the lock closes it;
+     * pins roll back on failure. */
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "vaspace");
+    uint32_t nblocks = 0, pageIx = 0;
+    uint64_t addr = start;
+    st = TPU_OK;
+    while (addr <= end && st == TPU_OK) {
+        UvmVaBlock *blk = NULL;
+        if (!uvmRangeFind(vs, addr, &blk) || !blk) {
+            st = TPU_ERR_OBJECT_NOT_FOUND;
+            break;
+        }
+        pthread_mutex_lock(&blk->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "peermem");
+        blk->p2pPinCount++;
+        blocks[nblocks++] = blk;
+        uint64_t blockEnd = blk->start + (uint64_t)blk->npages * ps - 1;
+        uint64_t spanEnd = end < blockEnd ? end : blockEnd;
+        for (uint64_t a = addr; a <= spanEnd && st == TPU_OK; a += ps) {
+            uint32_t page = (uint32_t)((a - blk->start) / ps);
+            void *ptr = NULL;
+            /* Resolve backing through the block's HBM runs. */
+            for (UvmChunkRun *run = blk->hbmRuns; run; run = run->next) {
+                if (page >= run->firstPage &&
+                    page < run->firstPage + run->numPages) {
+                    pages[pageIx].busAddress =
+                        run->chunk->offset +
+                        (uint64_t)(page - run->firstPage) * ps;
+                    ptr = (char *)run->arena->base;
+                    break;
+                }
+            }
+            if (!ptr)
+                st = TPU_ERR_INVALID_STATE;   /* evicted before we pinned */
+            pageIx++;
+        }
+        tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "peermem");
+        pthread_mutex_unlock(&blk->lock);
+        addr = blockEnd + 1;
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
+    pthread_mutex_unlock(&vs->lock);
+
+    if (st != TPU_OK) {
+        for (uint32_t i = 0; i < nblocks; i++)
+            uvmBlockP2pUnpin(blocks[i]);
+        free(pt);
+        free(pages);
+        free(reg);
+        free(blocks);
+        return st;
+    }
+
+    pt->version = TPU_P2P_PAGE_TABLE_VERSION;
+    pt->pageSize = (uint32_t)ps;
+    pt->devInst = devInst;
+    pt->entries = entries;
+    pt->pages = pages;
+
+    reg->pt = pt;
+    reg->vs = vs;
+    reg->va = start;
+    reg->size = end - start + 1;
+    reg->blocks = blocks;
+    reg->blockCount = nblocks;
+    reg->cb = cb;
+    reg->cbData = cbData;
+    pthread_mutex_lock(&g_peermem.lock);
+    reg->next = g_peermem.head;
+    g_peermem.head = reg;
+    pthread_mutex_unlock(&g_peermem.lock);
+
+    tpuCounterAdd("peermem_get_pages", 1);
+    *out = pt;
+    return TPU_OK;
+}
+
+TpuStatus tpuP2pPutPages(TpuP2pPageTable *pt)
+{
+    if (!pt)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_peermem.lock);
+    Registration **pp = &g_peermem.head;
+    Registration *reg = NULL;
+    while (*pp) {
+        if ((*pp)->pt == pt) {
+            reg = *pp;
+            *pp = reg->next;
+            break;
+        }
+        pp = &(*pp)->next;
+    }
+    pthread_mutex_unlock(&g_peermem.lock);
+    if (!reg)
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    if (!reg->revoked) {
+        for (uint32_t i = 0; i < reg->blockCount; i++)
+            uvmBlockP2pUnpin(reg->blocks[i]);
+    }
+    free(reg->blocks);
+    free(reg);
+    free(pt->pages);
+    free(pt);
+    tpuCounterAdd("peermem_put_pages", 1);
+    return TPU_OK;
+}
+
+TpuStatus tpuP2pDmaMapPages(TpuP2pPageTable *pt, uint32_t nicId,
+                            TpuP2pDmaMapping **out)
+{
+    if (!pt || !out)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuP2pDmaMapping *map = calloc(1, sizeof(*map));
+    if (!map)
+        return TPU_ERR_NO_MEMORY;
+    map->iova = calloc(pt->entries, sizeof(uint64_t));
+    if (!map->iova) {
+        free(map);
+        return TPU_ERR_NO_MEMORY;
+    }
+    map->version = TPU_P2P_PAGE_TABLE_VERSION;
+    map->nicId = nicId;
+    map->entries = pt->entries;
+    /* IOVA model: identity within the device window, tagged by NIC in
+     * the top byte (each NIC has its own IOMMU domain in the reference;
+     * the tag keeps mappings from different NICs distinguishable). */
+    for (uint32_t i = 0; i < pt->entries; i++)
+        map->iova[i] = ((uint64_t)nicId << 56) | pt->pages[i].busAddress;
+    tpuCounterAdd("peermem_dma_maps", 1);
+    *out = map;
+    return TPU_OK;
+}
+
+TpuStatus tpuP2pDmaUnmapPages(TpuP2pDmaMapping *map)
+{
+    if (!map)
+        return TPU_ERR_INVALID_ARGUMENT;
+    free(map->iova);
+    free(map);
+    return TPU_OK;
+}
+
+void *tpuP2pBusToPtr(uint32_t devInst, uint64_t busAddress)
+{
+    TpurmDevice *dev = tpurmDeviceGet(devInst);
+    if (!dev)
+        return NULL;
+    uint64_t size = tpurmDeviceHbmSize(dev);
+    if (busAddress >= size)
+        return NULL;
+    return (char *)tpurmDeviceHbmBase(dev) + busAddress;
+}
+
+/* ------------------------------------------------------ dma-buf analog */
+
+struct TpuDmabuf {
+    uint32_t devInst;
+    uint64_t offset, size;
+    _Atomic uint32_t refs;
+};
+
+TpuStatus tpuDmabufExport(uint32_t devInst, uint64_t offset, uint64_t size,
+                          TpuDmabuf **out)
+{
+    if (!out || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpurmDevice *dev = tpurmDeviceGet(devInst);
+    if (!dev)
+        return TPU_ERR_INVALID_DEVICE;
+    if (offset + size > tpurmDeviceHbmSize(dev))
+        return TPU_ERR_INVALID_LIMIT;
+    TpuDmabuf *buf = calloc(1, sizeof(*buf));
+    if (!buf)
+        return TPU_ERR_NO_MEMORY;
+    buf->devInst = devInst;
+    buf->offset = offset;
+    buf->size = size;
+    buf->refs = 1;
+    tpuCounterAdd("dmabuf_exports", 1);
+    *out = buf;
+    return TPU_OK;
+}
+
+TpuStatus tpuDmabufImport(TpuDmabuf *buf, void **ptr, uint64_t *size)
+{
+    if (!buf || !ptr)
+        return TPU_ERR_INVALID_ARGUMENT;
+    void *base = tpuP2pBusToPtr(buf->devInst, buf->offset);
+    if (!base)
+        return TPU_ERR_INVALID_STATE;
+    *ptr = base;
+    if (size)
+        *size = buf->size;
+    return TPU_OK;
+}
+
+TpuDmabuf *tpuDmabufGet(TpuDmabuf *buf)
+{
+    if (buf)
+        __atomic_fetch_add(&buf->refs, 1, __ATOMIC_SEQ_CST);
+    return buf;
+}
+
+void tpuDmabufPut(TpuDmabuf *buf)
+{
+    if (!buf)
+        return;
+    if (__atomic_fetch_sub(&buf->refs, 1, __ATOMIC_SEQ_CST) == 1)
+        free(buf);
+}

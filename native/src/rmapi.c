@@ -17,6 +17,8 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/ici.h"
+#include "uvm/uvm_internal.h"   /* uvmTierArenaCxl for the caps query */
 
 #include <errno.h>
 #include <stdlib.h>
@@ -384,9 +386,42 @@ static TpuStatus ctrl_client(RmClient *client, TpuRmControlParams *p,
             out->gpuIds[j] = TPU_CTRL_INVALID_DEVICE_ID;
         return TPU_OK;
     }
-    case TPU_CTRL_CMD_SYSTEM_GET_P2P_CAPS_V2:
-        /* ICI peer caps land with the peer-mapped HBM pool milestone. */
-        return TPU_ERR_NOT_SUPPORTED;
+    case TPU_CTRL_CMD_SYSTEM_GET_P2P_CAPS_V2: {
+        if (p->paramsSize != sizeof(TpuCtrlGetP2pCapsV2Params))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlGetP2pCapsV2Params *cp = params;
+        if (cp->gpuCount == 0 || cp->gpuCount > TPU_CTRL_P2P_MAX_GPUS)
+            return TPU_ERR_INVALID_ARGUMENT;
+        tpuIciInit();
+        uint32_t insts[TPU_CTRL_P2P_MAX_GPUS];
+        for (uint32_t i = 0; i < cp->gpuCount; i++) {
+            TpurmDevice *dev = tpuDeviceByDevId(cp->gpuIds[i]);
+            if (!dev)
+                return TPU_ERR_INVALID_DEVICE;
+            insts[i] = dev->inst;
+        }
+        /* Caps common to every pair: ICI reads/writes when all routes
+         * exist; CXL bit when the CXL tier is present (fork semantics:
+         * caps query reports CXL connectivity, client_resource.c:597). */
+        bool allRouted = true;
+        for (uint32_t i = 0; i < cp->gpuCount; i++) {
+            for (uint32_t j = 0; j < cp->gpuCount; j++) {
+                uint32_t hops = ~0u;
+                if (i != j &&
+                    tpuIciRouteHops(insts[i], insts[j], &hops) != TPU_OK)
+                    allRouted = false;
+                cp->busPeerIds[i * TPU_CTRL_P2P_MAX_GPUS + j] =
+                    i == j ? 0 : hops;
+            }
+        }
+        cp->p2pCaps = uvmTierArenaCxl() ? TPU_P2P_CAPS_CXL_SUPPORTED : 0;
+        if (cp->gpuCount > 1 && allRouted)
+            cp->p2pCaps |= TPU_P2P_CAPS_READS_SUPPORTED |
+                           TPU_P2P_CAPS_WRITES_SUPPORTED |
+                           TPU_P2P_CAPS_ICI_SUPPORTED |
+                           TPU_P2P_CAPS_ATOMICS_SUPPORTED;
+        return TPU_OK;
+    }
     default:
         return TPU_ERR_NOT_SUPPORTED;
     }

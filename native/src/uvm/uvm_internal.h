@@ -179,6 +179,10 @@ typedef struct UvmVaBlock {
     int32_t lastTargetTier;           /* -1 = none yet */
     int32_t pinnedTier;               /* -1 = not pinned */
     uint64_t pinExpiryNs;
+    /* P2P pins: while >0 the block's device residency is locked in place
+     * (no eviction, no migration away) — RDMA consumers hold bus
+     * addresses into it (reference: vidmem pinned by p2p get_pages). */
+    uint32_t p2pPinCount;
 } UvmVaBlock;
 
 typedef enum {
@@ -279,6 +283,17 @@ UvmVaRange *uvmRangeFind(UvmVaSpace *vs, uint64_t addr, UvmVaBlock **blockOut);
 /* True if the range group (0 = ungrouped) currently allows migration
  * (UvmPreventMigrationRangeGroups semantics; vs lock must be held). */
 bool uvmRangeGroupMigratable(UvmVaSpace *vs, uint64_t groupId);
+
+/* P2P pin management (peermem substrate). */
+void uvmBlockP2pPin(UvmVaBlock *blk);
+void uvmBlockP2pUnpin(UvmVaBlock *blk);
+
+/* Range-destroy notification: peermem registers one hook; it fires for
+ * every managed range torn down (uvmMemFree / VaSpaceDestroy) BEFORE the
+ * backing is freed, so RDMA registrations can be revoked (reference:
+ * nv_get_p2p_free_callback flow, nvidia-peermem.c:134). */
+typedef void (*UvmRangeDestroyHook)(uint64_t start, uint64_t size);
+void uvmSetRangeDestroyHook(UvmRangeDestroyHook hook);
 
 /* --------------------------------------------------------- fault engine */
 

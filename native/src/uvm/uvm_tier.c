@@ -179,10 +179,11 @@ UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
     UvmVaBlock *blk = a->lruHead;
     while (blk) {
-        /* Skip the allocating block itself and blocks pinned to this tier
-         * by thrashing mitigation (uvm_perf_thrashing.h PIN hint). */
-        bool pinned = blk->pinnedTier == (int32_t)a->tier &&
-                      blk->pinExpiryNs > now;
+        /* Skip the allocating block itself, blocks pinned to this tier
+         * by thrashing mitigation (uvm_perf_thrashing.h PIN hint), and
+         * P2P-pinned blocks (RDMA holds bus addresses into them). */
+        bool pinned = (blk->pinnedTier == (int32_t)a->tier &&
+                       blk->pinExpiryNs > now) || blk->p2pPinCount > 0;
         if (blk != exclude && !pinned)
             break;
         blk = blk->lru[ix].next;

@@ -308,11 +308,36 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
 
 /* ---------------------------------------------------------- eviction */
 
+void uvmBlockP2pPin(UvmVaBlock *blk)
+{
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block-pin");
+    blk->p2pPinCount++;
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-pin");
+    pthread_mutex_unlock(&blk->lock);
+}
+
+void uvmBlockP2pUnpin(UvmVaBlock *blk)
+{
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block-pin");
+    if (blk->p2pPinCount)
+        blk->p2pPinCount--;
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-pin");
+    pthread_mutex_unlock(&blk->lock);
+}
+
 TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
 {
     if (pthread_mutex_trylock(&blk->lock) != 0)
         return TPU_ERR_STATE_IN_USE;
     tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block-evict");
+    if (blk->p2pPinCount) {
+        /* RDMA consumers hold bus addresses into this block. */
+        tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-evict");
+        pthread_mutex_unlock(&blk->lock);
+        return TPU_ERR_STATE_IN_USE;
+    }
 
     UvmTier tier = arena->tier;
     uint32_t np = blk->npages;
@@ -456,6 +481,21 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
 
     pthread_mutex_lock(&blk->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block");
+
+    /* P2P-pinned blocks keep their device residency in place: CPU reads
+     * are served by duplication (device copy survives), anything that
+     * would move or invalidate the pinned copy is refused (reference:
+     * pinned vidmem is immovable until put_pages). */
+    if (blk->p2pPinCount &&
+        !(dst.tier == UVM_TIER_HBM && dst.devInst == blk->hbmDevInst)) {
+        if (dst.tier == UVM_TIER_HOST && !forWrite) {
+            readDup = true;
+        } else {
+            tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
+            pthread_mutex_unlock(&blk->lock);
+            return TPU_ERR_STATE_IN_USE;
+        }
+    }
 
     /* Single-HBM-device rule: migrating to a different device first pulls
      * the old device's residency home.  The eviction must actually

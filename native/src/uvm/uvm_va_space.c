@@ -52,8 +52,17 @@ TpuStatus uvmVaSpaceCreate(UvmVaSpace **out)
     return TPU_OK;
 }
 
+static UvmRangeDestroyHook g_rangeDestroyHook;
+
+void uvmSetRangeDestroyHook(UvmRangeDestroyHook hook)
+{
+    g_rangeDestroyHook = hook;
+}
+
 static void range_destroy(UvmVaSpace *vs, UvmVaRange *range)
 {
+    if (g_rangeDestroyHook)
+        g_rangeDestroyHook(range->node.start, range->size);
     for (uint32_t i = 0; i < range->blockCount; i++) {
         UvmVaBlock *blk = range->blocks[i];
         if (!blk)

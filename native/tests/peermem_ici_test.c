@@ -1,0 +1,188 @@
+/*
+ * peermem + ICI tests.
+ *
+ * Peermem: the RDMA loopback flow (BASELINE config #3) — a fake NIC
+ * registers a managed range (reference flow ibv_reg_mr -> acquire ->
+ * get_pages -> dma_map, nvidia-peermem.c), reads device-resident bytes
+ * through bus addresses, verifies pinning defeats eviction pressure,
+ * and sees its free callback fire when the range is freed.
+ *
+ * ICI: torus topology, link training, routing with failure detours, and
+ * peer HBM copies over apertures (config #5 substrate).  Runs with
+ * TPUMEM_FAKE_TPU_COUNT=4 set by the harness (Makefile).
+ */
+#include <assert.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpurm/ici.h"
+#include "tpurm/peermem.h"
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+static int g_failures;
+
+#define EXPECT(cond)                                                     \
+    do {                                                                 \
+        if (!(cond)) {                                                   \
+            fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,      \
+                    #cond);                                              \
+            g_failures++;                                                \
+        }                                                                \
+    } while (0)
+
+static int g_freeCbFired;
+
+static void free_cb(void *data)
+{
+    (void)data;
+    g_freeCbFired++;
+}
+
+static void test_peermem(void)
+{
+    UvmVaSpace *vs;
+    EXPECT(uvmVaSpaceCreate(&vs) == TPU_OK);
+    EXPECT(uvmRegisterDevice(vs, 0) == TPU_OK);
+
+    void *ptr;
+    uint64_t size = 4ull << 20;
+    EXPECT(uvmMemAlloc(vs, size, &ptr) == TPU_OK);
+    memset(ptr, 0xAB, size);
+
+    /* get_pages: migrates to HBM, pins, returns bus addresses. */
+    TpuP2pPageTable *pt = NULL;
+    EXPECT(tpuP2pGetPages(vs, 0, (uintptr_t)ptr, size, &pt, free_cb,
+                          NULL) == TPU_OK);
+    EXPECT(pt && pt->entries == size / pt->pageSize);
+
+    /* The "NIC" reads through bus addresses: data must be there. */
+    unsigned char *bus0 = tpuP2pBusToPtr(0, pt->pages[0].busAddress);
+    EXPECT(bus0 && bus0[0] == 0xAB);
+    unsigned char *busLast = tpuP2pBusToPtr(
+        0, pt->pages[pt->entries - 1].busAddress);
+    EXPECT(busLast && busLast[pt->pageSize - 1] == 0xAB);
+
+    /* DMA map: per-NIC IOVAs cover every page. */
+    TpuP2pDmaMapping *map = NULL;
+    EXPECT(tpuP2pDmaMapPages(pt, 7, &map) == TPU_OK);
+    EXPECT(map && map->entries == pt->entries);
+    EXPECT((map->iova[0] >> 56) == 7);
+
+    /* Pinning defeats eviction: oversubscribe the arena; the pinned
+     * range must keep its HBM residency. */
+    void *pressure[4];
+    UvmLocation hbm = { UVM_TIER_HBM, 0 };
+    for (int i = 0; i < 4; i++) {
+        EXPECT(uvmMemAlloc(vs, 32ull << 20, &pressure[i]) == TPU_OK);
+        memset(pressure[i], i, 32ull << 20);
+        uvmMigrate(vs, pressure[i], 32ull << 20, hbm, 0);  /* may evict */
+    }
+    UvmResidencyInfo info;
+    EXPECT(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    EXPECT(info.residentHbm);           /* still pinned in place */
+    EXPECT(bus0[0] == 0xAB);            /* bus addresses still valid */
+    for (int i = 0; i < 4; i++)
+        EXPECT(uvmMemFree(vs, pressure[i]) == TPU_OK);
+
+    /* Migration away from the pinned device is refused. */
+    UvmLocation cxl = { UVM_TIER_CXL, 0 };
+    EXPECT(uvmMigrate(vs, ptr, size, cxl, 0) == TPU_ERR_STATE_IN_USE);
+
+    EXPECT(tpuP2pDmaUnmapPages(map) == TPU_OK);
+
+    /* Free callback revocation: freeing the range fires the callback. */
+    EXPECT(g_freeCbFired == 0);
+    EXPECT(uvmMemFree(vs, ptr) == TPU_OK);
+    EXPECT(g_freeCbFired == 1);
+    EXPECT(tpuP2pPutPages(pt) == TPU_OK);
+
+    /* dma-buf analog round-trip. */
+    TpuDmabuf *buf = NULL;
+    EXPECT(tpuDmabufExport(0, 0, 1 << 20, &buf) == TPU_OK);
+    void *imp = NULL;
+    uint64_t impSize = 0;
+    EXPECT(tpuDmabufImport(buf, &imp, &impSize) == TPU_OK);
+    EXPECT(imp != NULL && impSize == 1 << 20);
+    tpuDmabufGet(buf);
+    tpuDmabufPut(buf);
+    tpuDmabufPut(buf);
+
+    uvmVaSpaceDestroy(vs);
+    printf("  peermem flows ok (revocations=%llu)\n",
+           (unsigned long long)tpurmCounterGet("peermem_revocations"));
+}
+
+static void test_ici(void)
+{
+    tpuIciInit();
+    uint32_t ndev = tpurmDeviceCount();
+    if (ndev < 4) {
+        printf("  ici: skipped (need 4 fake devices, have %u)\n", ndev);
+        return;
+    }
+
+    /* Ring of 4: each device has 2 links, all ACTIVE (auto-train). */
+    EXPECT(tpuIciLinkCount(0) == 2);
+    TpuIciLinkInfo li;
+    EXPECT(tpuIciLinkInfo(0, 0, &li) == TPU_OK);
+    EXPECT(li.state == TPU_ICI_LINK_ACTIVE);
+
+    /* Routing: 0 -> 2 on a 4-ring is 2 hops either way. */
+    uint32_t hops = 0;
+    EXPECT(tpuIciRouteHops(0, 2, &hops) == TPU_OK);
+    EXPECT(hops == 2);
+    EXPECT(tpuIciRouteHops(0, 1, &hops) == TPU_OK && hops == 1);
+
+    /* Peer aperture copy 0 -> 1 moves real bytes between HBM windows. */
+    TpurmDevice *d0 = tpurmDeviceGet(0), *d1 = tpurmDeviceGet(1);
+    memset(tpurmDeviceHbmBase(d0), 0x5C, 4096);
+    memset(tpurmDeviceHbmBase(d1), 0, 4096);
+    TpuIciPeerAperture *ap = NULL;
+    EXPECT(tpuIciPeerApertureCreate(0, 1, &ap) == TPU_OK);
+    EXPECT(tpuIciPeerCopy(ap, 0, 0, 4096, 0) == TPU_OK);   /* write */
+    EXPECT(((unsigned char *)tpurmDeviceHbmBase(d1))[100] == 0x5C);
+    /* Traffic accounted on the 0->1 link. */
+    EXPECT(tpuIciLinkInfo(0, 0, &li) == TPU_OK);
+    uint64_t seen = 0;
+    for (uint32_t l = 0; l < tpuIciLinkCount(0); l++) {
+        tpuIciLinkInfo(0, l, &li);
+        seen += li.bytesTx;
+    }
+    EXPECT(seen >= 4096);
+
+    /* Failure detour: fail the direct 0->1 link; the route flips to the
+     * long way around the ring (3 hops), and copies still work. */
+    uint32_t directLink = ~0u;
+    for (uint32_t l = 0; l < tpuIciLinkCount(0); l++) {
+        tpuIciLinkInfo(0, l, &li);
+        if (li.peerInst == 1)
+            directLink = l;
+    }
+    EXPECT(directLink != ~0u);
+    EXPECT(tpuIciInjectLinkFailure(0, directLink) == TPU_OK);
+    EXPECT(tpuIciRouteHops(0, 1, &hops) == TPU_OK);
+    EXPECT(hops == 3);
+    EXPECT(tpuIciPeerCopy(ap, 0, 4096, 4096, 0) == TPU_OK);
+
+    /* Reset + retrain restores the 1-hop route. */
+    EXPECT(tpuIciResetLink(0, directLink) == TPU_OK);
+    EXPECT(tpuIciTrainLinks(0) == TPU_OK);
+    EXPECT(tpuIciRouteHops(0, 1, &hops) == TPU_OK && hops == 1);
+
+    tpuIciPeerApertureDestroy(ap);
+    printf("  ici flows ok (%u devices)\n", ndev);
+}
+
+int main(void)
+{
+    test_peermem();
+    test_ici();
+    if (g_failures) {
+        printf("peermem_ici_test: %d FAILURES\n", g_failures);
+        return 1;
+    }
+    printf("peermem_ici_test: all ok\n");
+    return 0;
+}

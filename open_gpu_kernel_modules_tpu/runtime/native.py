@@ -41,6 +41,22 @@ CLASS_SUBDEVICE = 0x2080
 CTRL_GPU_GET_PROBED_IDS = 0x214
 CTRL_GPU_ATTACH_IDS = 0x215
 CTRL_GPU_GET_ATTACHED_IDS = 0x201
+CTRL_SYSTEM_GET_P2P_CAPS_V2 = 0x127
+
+# P2P caps bits (abi.h; ICI plays the NVLINK role, CXL is the fork delta).
+P2P_CAPS_READS = 0x1
+P2P_CAPS_WRITES = 0x2
+P2P_CAPS_ICI = 0x4
+P2P_CAPS_ATOMICS = 0x8
+P2P_CAPS_CXL = 0x10
+
+# Probed wire ids are DEV_ID_BASE + instance (device.c).
+DEV_ID_BASE = 0x100
+
+
+def lib_device_id(inst: int) -> int:
+    """Wire id for device instance ``inst`` (opaque probe cookie)."""
+    return DEV_ID_BASE + inst
 CTRL_BUS_GET_CXL_INFO = 0x20801833
 CTRL_BUS_CXL_P2P_DMA_REQUEST = 0x20801834
 CTRL_BUS_REGISTER_CXL_BUFFER = 0x20801835
@@ -118,6 +134,15 @@ class AttachIdsParams(ctypes.Structure):
     _fields_ = [
         ("gpuIds", ctypes.c_uint32 * 32),
         ("failedId", ctypes.c_uint32),
+    ]
+
+
+class GetP2pCapsV2Params(ctypes.Structure):
+    _fields_ = [
+        ("gpuIds", ctypes.c_uint32 * 8),
+        ("gpuCount", ctypes.c_uint32),
+        ("p2pCaps", ctypes.c_uint32),
+        ("busPeerIds", ctypes.c_uint32 * 64),
     ]
 
 
@@ -291,6 +316,19 @@ class RmClient:
         if expect_ok and st != TPU_OK:
             raise RmError(st, f"control cmd=0x{cmd:x}")
         return st
+
+    def p2p_caps(self, gpu_ids) -> int:
+        """NV0000 GET_P2P_CAPS_V2: common caps mask for the given wire ids
+        (ICI plays the NVLINK role; CXL bit is the fork delta)."""
+        if not 0 < len(gpu_ids) <= 8:
+            raise ValueError(f"p2p_caps takes 1..8 gpu ids, got "
+                             f"{len(gpu_ids)}")
+        p = GetP2pCapsV2Params()
+        for i, gid in enumerate(gpu_ids):
+            p.gpuIds[i] = gid
+        p.gpuCount = len(gpu_ids)
+        self.control(self.h_client, CTRL_SYSTEM_GET_P2P_CAPS_V2, p)
+        return p.p2pCaps
 
     def cxl_info(self) -> GetCxlInfoParams:
         info = GetCxlInfoParams()

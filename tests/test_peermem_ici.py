@@ -1,0 +1,75 @@
+"""ICI topology + P2P caps through the Python surface.
+
+Runs in a subprocess with TPUMEM_FAKE_TPU_COUNT=4 because the native
+device table is process-global and other tests expect one device.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import json
+import sys
+sys.path.insert(0, %(repo)r)
+
+from open_gpu_kernel_modules_tpu.runtime import ici, native
+
+out = {}
+out["link_count"] = ici.link_count(0)
+li = ici.link_info(0, 0)
+out["link0_state"] = int(li.state)
+out["hops_0_2"] = ici.route_hops(0, 2)
+
+# Peer aperture copy between device HBM windows.
+lib = native.load()
+import ctypes
+d0 = lib.tpurmDeviceGet(0); d1 = lib.tpurmDeviceGet(1)
+base0 = lib.tpurmDeviceHbmBase(d0); base1 = lib.tpurmDeviceHbmBase(d1)
+ctypes.memset(base0, 0x77, 4096)
+ctypes.memset(base1, 0, 4096)
+with ici.PeerAperture(0, 1) as ap:
+    ap.write(0, 0, 4096)
+out["peer_byte"] = ctypes.cast(base1, ctypes.POINTER(ctypes.c_ubyte))[123]
+
+# Failure detour on the 4-ring.
+direct = next(l for l in range(ici.link_count(0))
+              if ici.link_info(0, l).peer == 1)
+ici.inject_link_failure(0, direct)
+out["detour_hops"] = ici.route_hops(0, 1)
+ici.reset_link(0, direct)
+ici.train_links(0)
+out["restored_hops"] = ici.route_hops(0, 1)
+
+# P2P caps over the raw RM control path.
+client = native.RmClient()
+caps = client.p2p_caps([native.lib_device_id(i) for i in range(4)])
+out["p2p_caps"] = caps
+client.close()
+
+print(json.dumps(out))
+"""
+
+
+def test_ici_and_p2p_caps():
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    script = _SCRIPT % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["link_count"] == 2            # 4-ring: 2 links each
+    assert out["link0_state"] == 2           # ACTIVE (auto-train)
+    assert out["hops_0_2"] == 2
+    assert out["peer_byte"] == 0x77
+    assert out["detour_hops"] == 3
+    assert out["restored_hops"] == 1
+    caps = out["p2p_caps"]
+    assert caps & 0x4                        # ICI supported
+    assert caps & 0x10                       # CXL supported (fork delta)
