@@ -361,8 +361,11 @@ TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
         return TPU_ERR_INVALID_DEVICE;
     if (local->lost || peer->lost)
         return TPU_ERR_GPU_IS_LOST;
-    if (localOff + size > tpurmDeviceHbmSize(local) ||
-        peerOff + size > tpurmDeviceHbmSize(peer))
+    /* Overflow-safe form: localOff + size can wrap uint64. */
+    uint64_t lhbm = tpurmDeviceHbmSize(local);
+    uint64_t phbm = tpurmDeviceHbmSize(peer);
+    if (localOff > lhbm || size > lhbm - localOff ||
+        peerOff > phbm || size > phbm - peerOff)
         return TPU_ERR_INVALID_LIMIT;
 
     pthread_mutex_lock(&g_ici.lock);

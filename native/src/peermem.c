@@ -295,7 +295,9 @@ TpuStatus tpuDmabufExport(uint32_t devInst, uint64_t offset, uint64_t size,
     TpurmDevice *dev = tpurmDeviceGet(devInst);
     if (!dev)
         return TPU_ERR_INVALID_DEVICE;
-    if (offset + size > tpurmDeviceHbmSize(dev))
+    /* Overflow-safe form: offset + size can wrap uint64. */
+    uint64_t hbm = tpurmDeviceHbmSize(dev);
+    if (offset > hbm || size > hbm - offset)
         return TPU_ERR_INVALID_LIMIT;
     TpuDmabuf *buf = calloc(1, sizeof(*buf));
     if (!buf)

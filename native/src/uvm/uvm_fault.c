@@ -468,16 +468,28 @@ static void *fault_service_thread(void *arg)
 
 /* ------------------------------------------------------- SIGSEGV handler */
 
-static void fault_fallback(int sig)
+static void fault_fallback(int sig, siginfo_t *si, void *uctx)
 {
-    /* Not ours: fall through to the previous/default disposition by
-     * reinstalling it and returning (the instruction re-faults). */
-    if (g_fault.oldSegv.sa_handler != SIG_DFL &&
-        g_fault.oldSegv.sa_handler != SIG_IGN) {
-        sigaction(SIGSEGV, &g_fault.oldSegv, NULL);
-    } else {
-        signal(sig, SIG_DFL);
+    /* Not ours: chain to the previously-installed disposition WITHOUT
+     * uninstalling the UVM handler.  Swapping dispositions here would be
+     * (a) racy against other threads taking managed faults concurrently
+     * and (b) permanent — if the old handler absorbs the fault, all later
+     * managed faults would bypass the engine and crash.  Only when the old
+     * disposition is SIG_DFL/SIG_IGN do we reinstall default and return:
+     * the instruction re-faults and the process dies with the real fault
+     * (we are on the way down anyway). */
+    struct sigaction *old = &g_fault.oldSegv;
+    /* sa_handler/sa_sigaction share a union: screen out SIG_DFL/SIG_IGN
+     * before treating either field as a callable pointer (SIG_IGN is
+     * (void *)1 and can legally appear even with SA_SIGINFO set). */
+    if (old->sa_handler != SIG_DFL && old->sa_handler != SIG_IGN) {
+        if (old->sa_flags & SA_SIGINFO)
+            old->sa_sigaction(sig, si, uctx);
+        else
+            old->sa_handler(sig);
+        return;
     }
+    signal(sig, SIG_DFL);
 }
 
 static void segv_handler(int sig, siginfo_t *si, void *uctx)
@@ -486,12 +498,12 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
     UvmVaSpace *vs = addr ? snapshot_lookup_acquire(addr) : NULL;
     pid_t tid = (pid_t)syscall(SYS_gettid);
     if (!vs) {
-        fault_fallback(sig);
+        fault_fallback(sig, si, uctx);
         return;
     }
     if (tid == g_fault.serviceTid) {
         snapshot_release();
-        fault_fallback(sig);
+        fault_fallback(sig, si, uctx);
         return;
     }
 
@@ -524,7 +536,7 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
         if (v != 0) {
             snapshot_release();
             if (v == 2)
-                fault_fallback(sig);   /* unserviceable: crash normally */
+                fault_fallback(sig, si, uctx); /* unserviceable */
             return;
         }
         futex_call(&done, FUTEX_WAIT, 0);

@@ -98,6 +98,12 @@ static void test_peermem(void)
     EXPECT(g_freeCbFired == 1);
     EXPECT(tpuP2pPutPages(pt) == TPU_OK);
 
+    /* Overflow-safe bounds: offset + size wrapping uint64 must be
+     * rejected, not slip past the HBM-size limit. */
+    TpuDmabuf *ovf = NULL;
+    EXPECT(tpuDmabufExport(0, ~0ull - 4096, 1 << 20, &ovf) ==
+           TPU_ERR_INVALID_LIMIT);
+
     /* dma-buf analog round-trip. */
     TpuDmabuf *buf = NULL;
     EXPECT(tpuDmabufExport(0, 0, 1 << 20, &buf) == TPU_OK);
@@ -143,6 +149,9 @@ static void test_ici(void)
     EXPECT(tpuIciPeerApertureCreate(0, 1, &ap) == TPU_OK);
     EXPECT(tpuIciPeerCopy(ap, 0, 0, 4096, 0) == TPU_OK);   /* write */
     EXPECT(((unsigned char *)tpurmDeviceHbmBase(d1))[100] == 0x5C);
+    /* Wrapping localOff must be rejected (overflow-safe bounds). */
+    EXPECT(tpuIciPeerCopy(ap, ~0ull - 100, 0, 4096, 0) ==
+           TPU_ERR_INVALID_LIMIT);
     /* Traffic accounted on the 0->1 link. */
     EXPECT(tpuIciLinkInfo(0, 0, &li) == TPU_OK);
     uint64_t seen = 0;

@@ -140,16 +140,20 @@ def decode_step(cfg: llama.LlamaConfig, params: Dict[str, Any],
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
 
-        # Scatter this token's K/V into its page slot.
+        # Scatter this token's K/V into its page slot.  A sequence at
+        # max_len has no slot left: route its write to an out-of-range
+        # index and drop it, rather than letting JAX's index clamping
+        # silently overwrite the last page.
         page_of = jnp.take_along_axis(
             cache.page_table, (pos[:, None] // p).astype(jnp.int32),
             axis=1)[:, 0]                                   # [B]
         slot = (page_of * p + pos % p).astype(jnp.int32)    # [B]
         n_, p_, kv_, d_ = lk_pages.shape
+        slot = jnp.where(pos < cache.max_len, slot, n_ * p_)
         lk_flat = lk_pages.reshape(n_ * p_, kv_, d_)
         lv_flat = lv_pages.reshape(n_ * p_, kv_, d_)
-        lk_flat = lk_flat.at[slot].set(k[:, 0])
-        lv_flat = lv_flat.at[slot].set(v[:, 0])
+        lk_flat = lk_flat.at[slot].set(k[:, 0], mode="drop")
+        lv_flat = lv_flat.at[slot].set(v[:, 0], mode="drop")
         lk_pages = lk_flat.reshape(n_, p_, kv_, d_)
         lv_pages = lv_flat.reshape(n_, p_, kv_, d_)
 
@@ -166,8 +170,9 @@ def decode_step(cfg: llama.LlamaConfig, params: Dict[str, Any],
         body, x, (params["layers"], cache.k_pages, cache.v_pages))
     x = llama.rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
-    cache = dataclasses.replace(cache, k_pages=k_pages, v_pages=v_pages,
-                                seq_lens=cache.seq_lens + 1)
+    cache = dataclasses.replace(
+        cache, k_pages=k_pages, v_pages=v_pages,
+        seq_lens=jnp.minimum(cache.seq_lens + 1, cache.max_len))
     return logits, cache
 
 
@@ -179,6 +184,10 @@ def generate(cfg: llama.LlamaConfig, params: Dict[str, Any],
     b, s = prompt.shape
     if cache is None:
         cache = PagedKVCache.create(cfg, b, s + max_new_tokens)
+    if s + max_new_tokens > cache.max_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache.max_len ({cache.max_len})")
     logits, cache = prefill(cfg, params, prompt, cache)
     out = [prompt]
     next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -87,10 +87,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _pick_block(n: int, target: int) -> int:
-    blk = min(n, target)
-    while n % blk:
-        blk //= 2
-    return max(blk, 1)
+    """Block size for an n-long axis.  Never shrinks below the target to
+    chase divisibility — odd lengths are handled by padding the sequence
+    up to a block multiple (the kv_len mask covers the tail), so the MXU
+    always sees full-width tiles."""
+    return min(max(n, 1), target)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
@@ -111,19 +112,29 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     blk_q = _pick_block(sq, blk_q)
     blk_k = _pick_block(sk, blk_k)
 
-    # [B, S, H, D] -> [B*H, S, D]
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    # Pad both sequence axes up to a block multiple.  Padded K columns are
+    # masked by kv_len; padded Q rows compute garbage that is sliced off.
+    sq_p = -(-sq // blk_q) * blk_q
+    sk_p = -(-sk // blk_k) * blk_k
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0), (0, 0)))
+    if sk_p != sk:
+        k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0), (0, 0)))
 
-    grid = (b * h, sq // blk_q, sk // blk_k)
+    # [B, S, H, D] -> [B*H, S, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+
+    grid = (b * h, sq_p // blk_q, sk_p // blk_k)
     kernel = functools.partial(
         _flash_kernel, scale=1.0 / (d ** 0.5), blk_q=blk_q, blk_k=blk_k,
         causal=causal, kv_len=sk)
 
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -139,4 +150,4 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret=interpret,
     )(qf, kf, vf)
 
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)[:, :sq]

@@ -51,6 +51,9 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                         k.astype(jnp.float32)) * scale
     mask = jnp.arange(m * p)[None, :] < seq_lens[:, None]     # [B, S]
     logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1)
+    # A fully-masked row (seq_lens == 0, e.g. an inactive batch slot)
+    # would softmax to NaN; guard like flash_attention's denom guard and
+    # return zeros for such rows instead.
+    probs = jnp.where(mask[:, None, :], jax.nn.softmax(logits, axis=-1), 0.0)
     out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -41,6 +41,21 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, causal=True, blk_q=64, blk_k=64)
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
+    def test_odd_length_pads_not_shrinks(self):
+        # S=130 used to collapse the block size to 2; now the sequence is
+        # padded up to the block multiple and the tail masked.
+        q, k, v = _qkv(jax.random.key(7), 1, 130, 2, 32)
+        ref = attention(q, k, v, causal_mask(130, 130))
+        out = flash_attention(q, k, v, causal=True)
+        assert out.shape == (1, 130, 2, 32)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_prime_length_non_causal(self):
+        q, k, v = _qkv(jax.random.key(8), 1, 67, 2, 32)
+        ref = attention(q, k, v, None)
+        out = flash_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
     def test_bfloat16(self):
         q, k, v = _qkv(jax.random.key(3), 1, 64, 2, 32, jnp.bfloat16)
         ref = attention(q, k, v, causal_mask(64, 64))
@@ -77,6 +92,19 @@ class TestPagedAttention:
                             k_dense[i][None, :sl], v_dense[i][None, :sl],
                             None)[0, 0]
             np.testing.assert_allclose(out[i], ref, atol=2e-5)
+
+
+class TestPagedAttentionEdge:
+    def test_zero_length_row_yields_zeros_not_nan(self):
+        b, h, kv, d, page = 2, 4, 2, 16, 8
+        q = jax.random.normal(jax.random.key(9), (b, h, d))
+        k_pages = jax.random.normal(jax.random.key(10), (4, page, kv, d))
+        v_pages = jax.random.normal(jax.random.key(11), (4, page, kv, d))
+        table = jnp.arange(4, dtype=jnp.int32).reshape(b, 2)
+        seq_lens = jnp.array([0, 5])        # slot 0 inactive
+        out = paged_attention(q, k_pages, v_pages, table, seq_lens, h)
+        assert not np.any(np.isnan(np.asarray(out)))
+        np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
 
 
 class TestMeshAndRing:

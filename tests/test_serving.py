@@ -77,3 +77,27 @@ def test_tiered_kv_cache_faults_pages(setup):
         assert info.hbm or info.cxl
     finally:
         tiered.close()
+
+
+def test_generate_rejects_overflow(setup):
+    cfg, params = setup
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    cache = serving.PagedKVCache.create(cfg, 1, 16, page_size=8)
+    with pytest.raises(ValueError, match="exceeds"):
+        serving.generate(cfg, params, prompt, max_new_tokens=16, cache=cache)
+
+
+def test_decode_step_drops_writes_at_max_len(setup):
+    cfg, params = setup
+    b = 1
+    cache = serving.PagedKVCache.create(cfg, b, 8, page_size=8)
+    prompt = jax.random.randint(jax.random.key(2), (b, 8), 0, cfg.vocab_size)
+    _, cache = serving.prefill(cfg, params, prompt, cache)
+    assert int(cache.seq_lens[0]) == 8           # cache already full
+    before_k = np.asarray(cache.k_pages)
+    tok = jnp.zeros((b,), jnp.int32)
+    _, cache2 = serving.decode_step(cfg, params, tok, cache)
+    # The overflowing token's K/V write must be dropped, not wrap onto
+    # the last page, and seq_lens stays clamped at max_len.
+    np.testing.assert_array_equal(np.asarray(cache2.k_pages), before_k)
+    assert int(cache2.seq_lens[0]) == 8
