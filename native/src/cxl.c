@@ -308,32 +308,28 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
     if (st != TPU_OK)
         return st;
 
-    /* Clamp single request to 4 GB (p2p_cxl.c:617-621). */
-    uint64_t transferSize = size;
-    if (transferSize > 0xFFFFFFFFull) {
-        tpuLog(TPU_LOG_WARN, "cxl", "clamping transfer 0x%llx -> 4GB",
-               (unsigned long long)transferSize);
-        transferSize = TPU_CE_COPY_CLAMP;
-    }
-
+    /* The reference clamps each CE push to 4 GB but loops the request to
+     * completion (p2p_cxl.c:617-656 copies transferSize fully); here the
+     * per-push clamp lives in tpuMemCopy's contiguity-split loop, so the
+     * full size is handed down — never truncated. */
     uint64_t hbmSize = tpurmDeviceHbmSize(dev);
     uint64_t tracker = 0;
     TpuMemDesc *devMd = NULL;
     /* Overflow-safe bounds check (a wrapped gpuOffset must not pass). */
-    if (transferSize > hbmSize || gpuOffset > hbmSize - transferSize) {
+    if (size > hbmSize || gpuOffset > hbmSize - size) {
         st = TPU_ERR_INVALID_LIMIT;
     } else {
         /* Throwaway device-side memdesc describing HBM at gpuOffset
          * (memdescCreate+memdescDescribe analog). */
         st = tpuMemdescCreateContig(&devMd, TPU_APERTURE_HBM, gpuOffset,
-                                    transferSize, 0);
+                                    size, 0);
     }
     if (st == TPU_OK) {
         if (cxlToDev)
-            st = tpuMemCopy(dev, devMd, 0, cxlMd, cxlOffset, transferSize,
+            st = tpuMemCopy(dev, devMd, 0, cxlMd, cxlOffset, size,
                             async, &tracker);
         else
-            st = tpuMemCopy(dev, cxlMd, cxlOffset, devMd, 0, transferSize,
+            st = tpuMemCopy(dev, cxlMd, cxlOffset, devMd, 0, size,
                             async, &tracker);
         tpuMemdescDestroy(devMd);
     }
@@ -354,7 +350,7 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
         return st;
     }
     tpuCounterAdd("cxl_dma_requests", 1);
-    tpuCounterAdd("cxl_dma_bytes", transferSize);
+    tpuCounterAdd("cxl_dma_bytes", size);
     if (outTransferId)
         *outTransferId = async ? (uint32_t)(tracker & 0x7fffffff) | 1u : 1;
     return TPU_OK;

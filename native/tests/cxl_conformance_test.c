@@ -189,6 +189,29 @@ int main(void)
     CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
                      sizeof(dma)) == TPU_OK);
 
+    /* Clamp+tail conformance: a request larger than the per-push CE clamp
+     * must copy to COMPLETION, not truncate at the clamp (reference
+     * p2p_cxl.c:617-656 clamps per push but loops).  The clamp is scaled
+     * down via registry so the case runs at clamp + one page. */
+    setenv("TPUMEM_CE_COPY_CLAMP_BYTES", "65536", 1);
+    fill_pattern(buf, 65536 + 4096, 0xC3);
+    dma.cxlBufferHandle = reg.bufferHandle;
+    dma.gpuOffset = 0;
+    dma.cxlOffset = 0;
+    dma.size = 65536 + 4096;
+    dma.flags = TPU_CXL_DMA_FLAG_CXL_TO_DEV;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+    memset(buf, 0, 65536 + 4096);
+    dma.flags = TPU_CXL_DMA_FLAG_DEV_TO_CXL;
+    CHECK(rm_control(hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                     sizeof(dma)) == TPU_OK);
+    /* The page past the clamp boundary must have made the round trip. */
+    CHECK(count_pattern_errors(buf, 65536 + 4096, 0xC3) == 0);
+    unsetenv("TPUMEM_CE_COPY_CLAMP_BYTES");
+    fill_pattern(buf, BUF_SIZE, 0xAB);
+    dma.size = 4096;
+
     /* Negative: OOB CXL offset (reference: p2p_cxl.c:563). */
     dma.cxlOffset = BUF_SIZE;
     dma.size = 4096;
