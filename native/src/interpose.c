@@ -1,0 +1,161 @@
+/*
+ * LD_PRELOAD interposer — runs unmodified reference userspace against
+ * tpurm.
+ *
+ * The reference's conformance walker (reference tests/cxl_p2p_test.c:634)
+ * talks to the driver with nothing but open(2)/ioctl(2)/close(2) on
+ * /dev/nvidiactl + /dev/nvidia0 (reference tests/cxl_p2p_test.c:667,347).
+ * This shim maps exactly those calls onto the in-process engine:
+ *
+ *   open("/dev/nvidiactl" | "/dev/nvidia<N>" | "/dev/nvidia-uvm" |
+ *        "/dev/accel/tpu<N>" | "/dev/tpuctl" | "/dev/tpu-uvm")
+ *                               -> tpurm_open   (pseudo fd >= 0x40000000)
+ *   ioctl(pseudo_fd, ...)       -> tpurm_ioctl  (NVOS21/54/00 ABI)
+ *   close(pseudo_fd)            -> tpurm_close
+ *
+ * Everything else forwards to libc via dlsym(RTLD_NEXT).  Pseudo fds live
+ * far above the kernel fd space (rmapi.c PSEUDO_FD_BASE), so classifying
+ * an fd is a range check and no real descriptor can collide.
+ *
+ * mmap needs no interposition: the walker's buffers are MAP_ANONYMOUS
+ * (reference tests/cxl_p2p_test.c:419-430), never device mappings.
+ */
+#define _GNU_SOURCE
+#include "tpurm/tpurm.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <stdarg.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/types.h>
+
+#define PSEUDO_FD_BASE 0x40000000
+
+static int is_pseudo_fd(int fd)
+{
+    return fd >= PSEUDO_FD_BASE;
+}
+
+static int is_tpurm_path(const char *path)
+{
+    if (!path)
+        return 0;
+    if (strcmp(path, "/dev/nvidiactl") == 0 ||
+        strcmp(path, "/dev/tpuctl") == 0 ||
+        strcmp(path, "/dev/nvidia-uvm") == 0 ||
+        strcmp(path, "/dev/tpu-uvm") == 0)
+        return 1;
+    if (strncmp(path, "/dev/nvidia", 11) == 0 &&
+        path[11] >= '0' && path[11] <= '9')
+        return 1;
+    if (strncmp(path, "/dev/accel/tpu", 14) == 0)
+        return 1;
+    return 0;
+}
+
+
+/* ------------------------------------------------------------------ open */
+
+typedef int (*open_fn)(const char *, int, ...);
+typedef int (*openat_fn)(int, const char *, int, ...);
+
+/* Reading the variadic mode is UB unless the caller actually passed one;
+ * only O_CREAT/O_TMPFILE opens carry it. */
+#ifdef O_TMPFILE
+#define NEEDS_MODE(flags) (((flags) & O_CREAT) || \
+                           (((flags) & O_TMPFILE) == O_TMPFILE))
+#else
+#define NEEDS_MODE(flags) ((flags) & O_CREAT)
+#endif
+
+#define DEFINE_OPEN(name)                                                  \
+int name(const char *path, int flags, ...)                                 \
+{                                                                          \
+    if (is_tpurm_path(path))                                               \
+        return tpurm_open(path);                                           \
+    static open_fn real;                                                   \
+    if (!real)                                                             \
+        real = (open_fn)dlsym(RTLD_NEXT, #name);                           \
+    if (!real) {                                                           \
+        errno = ENOSYS;                                                    \
+        return -1;                                                         \
+    }                                                                      \
+    if (NEEDS_MODE(flags)) {                                               \
+        va_list ap;                                                        \
+        va_start(ap, flags);                                               \
+        mode_t mode = va_arg(ap, mode_t);                                  \
+        va_end(ap);                                                        \
+        return real(path, flags, mode);                                    \
+    }                                                                      \
+    return real(path, flags);                                              \
+}
+
+DEFINE_OPEN(open)
+DEFINE_OPEN(open64)
+
+#define DEFINE_OPENAT(name)                                                \
+int name(int dirfd, const char *path, int flags, ...)                      \
+{                                                                          \
+    /* Absolute device paths ignore dirfd (openat(2) semantics). */        \
+    if (path[0] == '/' && is_tpurm_path(path))                             \
+        return tpurm_open(path);                                           \
+    static openat_fn real;                                                 \
+    if (!real)                                                             \
+        real = (openat_fn)dlsym(RTLD_NEXT, #name);                         \
+    if (!real) {                                                           \
+        errno = ENOSYS;                                                    \
+        return -1;                                                         \
+    }                                                                      \
+    if (NEEDS_MODE(flags)) {                                               \
+        va_list ap;                                                        \
+        va_start(ap, flags);                                               \
+        mode_t mode = va_arg(ap, mode_t);                                  \
+        va_end(ap);                                                        \
+        return real(dirfd, path, flags, mode);                             \
+    }                                                                      \
+    return real(dirfd, path, flags);                                       \
+}
+
+DEFINE_OPENAT(openat)
+DEFINE_OPENAT(openat64)
+
+/* ----------------------------------------------------------------- ioctl */
+
+int ioctl(int fd, unsigned long request, ...)
+{
+    va_list ap;
+    va_start(ap, request);
+    void *argp = va_arg(ap, void *);
+    va_end(ap);
+
+    if (is_pseudo_fd(fd))
+        return tpurm_ioctl(fd, request, argp);
+
+    typedef int (*ioctl_fn)(int, unsigned long, ...);
+    static ioctl_fn real;
+    if (!real)
+        real = (ioctl_fn)dlsym(RTLD_NEXT, "ioctl");
+    if (!real) {
+        errno = ENOSYS;
+        return -1;
+    }
+    return real(fd, request, argp);
+}
+
+/* ----------------------------------------------------------------- close */
+
+int close(int fd)
+{
+    if (is_pseudo_fd(fd))
+        return tpurm_close(fd);
+    typedef int (*close_fn)(int);
+    static close_fn real;
+    if (!real)
+        real = (close_fn)dlsym(RTLD_NEXT, "close");
+    if (!real) {
+        errno = ENOSYS;
+        return -1;
+    }
+    return real(fd);
+}

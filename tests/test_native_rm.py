@@ -33,6 +33,24 @@ class TestNativeBinaries:
         assert res.returncode == 0, res.stdout + res.stderr
         assert "native tests OK" in res.stdout
 
+    def test_reference_walker_unmodified(self):
+        """north-star config #1: the reference's own userspace test binary,
+        compiled from its source UNTOUCHED, runs against tpurm through the
+        LD_PRELOAD interposer and completes (reference
+        tests/cxl_p2p_test.c:634)."""
+        if not os.path.exists("/root/reference/tests/cxl_p2p_test.c"):
+            pytest.skip("reference tree not mounted")
+        res = subprocess.run(
+            ["make", "-C", NATIVE_DIR, "conformance-reference"],
+            capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-4000:]
+        assert "=== Test COMPLETED ===" in res.stdout
+        # Every RM op must have succeeded, not degraded gracefully.
+        assert "OK: RM client initialized" in res.stdout
+        assert "OK: Buffer registered with kernel" in res.stdout
+        assert res.stdout.count("OK: Transfer completed") == 2
+        assert "OK: Buffer unregistered" in res.stdout
+
 
 class TestRmClient:
     def test_lifecycle_and_cxl_info(self, lib):
