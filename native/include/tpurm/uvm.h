@@ -34,6 +34,7 @@
 #ifndef TPURM_UVM_H
 #define TPURM_UVM_H
 
+#include <stdbool.h>
 #include <stddef.h>
 #include <stdint.h>
 
@@ -198,6 +199,39 @@ typedef struct {
     TpuStatus rmStatus;
 } UvmRunTestParams;
 
+/* UVM_TOOLS_* param blocks (reference shapes, uvm_ioctl.h:822-948,
+ * trimmed to the in-process session model: the reference's user-supplied
+ * mmap'd queue buffers are replaced by the session ring, so the buffer
+ * pointers are accepted but unused). */
+typedef struct {
+    uint64_t queueBuffer      __attribute__((aligned(8)));  /* unused */
+    uint64_t queueBufferSize  __attribute__((aligned(8)));
+    uint64_t controlBuffer    __attribute__((aligned(8)));  /* unused */
+    UvmProcessorUuid processor;
+    uint32_t allProcessors;
+    uint32_t uvmFd;
+    TpuStatus rmStatus;
+} UvmToolsInitEventTrackerParams;
+
+typedef struct {
+    uint32_t notificationThreshold;
+    TpuStatus rmStatus;
+} UvmToolsSetNotificationThresholdParams;
+
+typedef struct {
+    uint64_t eventTypeFlags   __attribute__((aligned(8)));  /* bit per UvmEventType */
+    TpuStatus rmStatus;
+} UvmToolsEventControlParams;
+
+typedef struct {
+    uint64_t counterTypeFlags __attribute__((aligned(8)));  /* all-or-nothing */
+    TpuStatus rmStatus;
+} UvmToolsCountersParams;
+
+typedef struct {
+    TpuStatus rmStatus;
+} UvmToolsFlushEventsParams;
+
 /* ================================ direct C API (TPU-native surface) ===== */
 
 typedef struct UvmVaSpace UvmVaSpace;
@@ -261,6 +295,7 @@ typedef struct {
     uint8_t residentHost, residentHbm, residentCxl;
     uint32_t hbmDeviceInst;
     uint8_t cpuMapped;
+    uint8_t devMapped;        /* accessed-by device mapping established */
     int32_t pinnedTier;       /* -1 if not pinned by thrashing mitigation */
 } UvmResidencyInfo;
 TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out);
@@ -306,6 +341,19 @@ TpuStatus uvmToolsSessionCreate(UvmVaSpace *vs, uint32_t capacity,
                                 UvmToolsSession **out);
 void      uvmToolsSessionDestroy(UvmToolsSession *s);
 void      uvmToolsEnableEvents(UvmToolsSession *s, uint64_t typeMask);
+/* Incremental per-type set/clear (reference ENABLE/DISABLE_EVENTS). */
+void      uvmToolsEnableEventTypes(UvmToolsSession *s, uint64_t typeMask);
+void      uvmToolsDisableEventTypes(UvmToolsSession *s, uint64_t typeMask);
+/* Counter subscription: uvmToolsCounterGet returns false until enabled. */
+void      uvmToolsSetCountersEnabled(UvmToolsSession *s, bool enabled);
+bool      uvmToolsCounterGet(UvmToolsSession *s, const char *name,
+                             uint64_t *out);
+/* Queue-depth notification threshold (0 disables); notifications counts
+ * threshold crossings since session creation. */
+void      uvmToolsSetNotificationThreshold(UvmToolsSession *s,
+                                           uint64_t threshold);
+uint64_t  uvmToolsPendingEvents(UvmToolsSession *s);
+uint64_t  uvmToolsNotificationCount(UvmToolsSession *s);
 /* Drains up to max events; returns count.  Lock-free ring; drops oldest
  * on overflow and counts drops ("uvm_tools_events_dropped"). */
 size_t    uvmToolsReadEvents(UvmToolsSession *s, UvmEvent *buf, size_t max);
@@ -321,6 +369,8 @@ enum {
     UVM_TPU_TEST_VA_BLOCK             = 5,
     UVM_TPU_TEST_LOCK_SANITY          = 6,
     UVM_TPU_TEST_FAULT_INJECT         = 7,
+    UVM_TPU_TEST_ACCESSED_BY          = 8,
+    UVM_TPU_TEST_TOOLS                = 9,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

@@ -97,8 +97,9 @@ DEFINE_OPEN(open64)
 #define DEFINE_OPENAT(name)                                                \
 int name(int dirfd, const char *path, int flags, ...)                      \
 {                                                                          \
-    /* Absolute device paths ignore dirfd (openat(2) semantics). */        \
-    if (path[0] == '/' && is_tpurm_path(path))                             \
+    /* Absolute device paths ignore dirfd (openat(2) semantics);       \
+     * is_tpurm_path is NULL-safe and only matches absolute paths. */     \
+    if (is_tpurm_path(path))                                               \
         return tpurm_open(path);                                           \
     static openat_fn real;                                                 \
     if (!real)                                                             \

@@ -364,6 +364,25 @@ static TpuStatus service_one(UvmFaultEntry *e)
 
         uvmPerfThrashingRecord(blk, dst.tier);
 
+        /* Accessed-by devices get a MAPPING to the data where it lives,
+         * not a migration (reference: service_fault_batch services
+         * accessed_by processors by map, uvm_va_policy semantics).  Falls
+         * back to migration when the span isn't resident anywhere yet. */
+        if (e->source == UVM_FAULT_SRC_DEVICE &&
+            (range->accessedByMask >> e->devInst) & 1) {
+            st = uvmBlockMapDevice(blk, firstPage, count, e->isWrite != 0);
+            if (st == TPU_OK) {
+                uvmToolsEmit(vs, UVM_EVENT_GPU_FAULT, UVM_TIER_COUNT,
+                             UVM_TIER_COUNT, e->devInst, addr,
+                             (uint64_t)count * ps);
+                addr = blockEnd + 1;
+                continue;
+            }
+            if (st != TPU_ERR_INVALID_STATE)
+                break;
+            st = TPU_OK;            /* not resident: migrate normally */
+        }
+
         st = uvmBlockMakeResidentEx(blk, dst, firstPage, count,
                                     e->isWrite != 0, forceDup);
         if (st == TPU_OK)

@@ -262,6 +262,12 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
 TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena);
 void uvmBlockFreeBacking(UvmVaBlock *blk);
 
+/* Accessed-by mapping: map pages for a device where they currently
+ * reside, without migration (fails TPU_ERR_INVALID_STATE if any page is
+ * resident nowhere).  See uvm_va_block.c. */
+TpuStatus uvmBlockMapDevice(UvmVaBlock *blk, uint32_t firstPage,
+                            uint32_t count, bool forWrite);
+
 /* Host PTE control over the managed VA (mprotect). */
 void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
                           uint32_t count, int prot);

@@ -326,20 +326,62 @@ static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
     case UVM_TOOLS_INIT_EVENT_TRACKER: {
         /* In-process sessions replace the reference's mmap'd queues; the
          * param block's buffer pointers are unused (uvm.h note). */
-        if (!fd->tools) {
-            TpuStatus st = uvmToolsSessionCreate(vs, 1024, &fd->tools);
-            (void)st;
-        }
+        UvmToolsInitEventTrackerParams *p = argp;
+        uint32_t cap = 1024;
+        if (p->queueBufferSize)
+            cap = (uint32_t)(p->queueBufferSize > 1u << 20
+                                 ? 1u << 20 : p->queueBufferSize);
+        if (fd->tools)
+            p->rmStatus = TPU_OK;          /* idempotent */
+        else
+            p->rmStatus = uvmToolsSessionCreate(vs, cap, &fd->tools);
         return 0;
     }
     case UVM_TOOLS_EVENT_QUEUE_ENABLE_EVENTS:
-    case UVM_TOOLS_EVENT_QUEUE_DISABLE_EVENTS:
-    case UVM_TOOLS_ENABLE_COUNTERS:
-    case UVM_TOOLS_DISABLE_COUNTERS:
-    case UVM_TOOLS_SET_NOTIFICATION_THRESHOLD:
-    case UVM_TOOLS_FLUSH_EVENTS:
-        /* Accepted; session state is managed via the direct C API. */
+    case UVM_TOOLS_EVENT_QUEUE_DISABLE_EVENTS: {
+        UvmToolsEventControlParams *p = argp;
+        if (!fd->tools) {
+            p->rmStatus = TPU_ERR_INVALID_STATE;   /* tracker not inited */
+            return 0;
+        }
+        if (request == UVM_TOOLS_EVENT_QUEUE_ENABLE_EVENTS)
+            uvmToolsEnableEventTypes(fd->tools, p->eventTypeFlags);
+        else
+            uvmToolsDisableEventTypes(fd->tools, p->eventTypeFlags);
+        p->rmStatus = TPU_OK;
         return 0;
+    }
+    case UVM_TOOLS_ENABLE_COUNTERS:
+    case UVM_TOOLS_DISABLE_COUNTERS: {
+        UvmToolsCountersParams *p = argp;
+        if (!fd->tools) {
+            p->rmStatus = TPU_ERR_INVALID_STATE;
+            return 0;
+        }
+        uvmToolsSetCountersEnabled(fd->tools,
+                                   request == UVM_TOOLS_ENABLE_COUNTERS);
+        p->rmStatus = TPU_OK;
+        return 0;
+    }
+    case UVM_TOOLS_SET_NOTIFICATION_THRESHOLD: {
+        UvmToolsSetNotificationThresholdParams *p = argp;
+        if (!fd->tools) {
+            p->rmStatus = TPU_ERR_INVALID_STATE;
+            return 0;
+        }
+        uvmToolsSetNotificationThreshold(fd->tools,
+                                         p->notificationThreshold);
+        p->rmStatus = TPU_OK;
+        return 0;
+    }
+    case UVM_TOOLS_FLUSH_EVENTS: {
+        /* The in-process ring has no kernel-side buffering to flush:
+         * everything emitted is already visible to uvmToolsReadEvents.
+         * Success is therefore honest, but only with a live session. */
+        UvmToolsFlushEventsParams *p = argp;
+        p->rmStatus = fd->tools ? TPU_OK : TPU_ERR_INVALID_STATE;
+        return 0;
+    }
     default:
         errno = ENOTTY;
         return -1;

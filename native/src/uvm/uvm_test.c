@@ -310,6 +310,132 @@ static TpuStatus test_fault_inject(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* ---------------------------------------------------- accessed-by map */
+
+static TpuStatus test_accessed_by(UvmVaSpace *vs)
+{
+    /* SET_ACCESSED_BY services device faults by MAPPING, not migration:
+     * data stays where it is and the device gets a mapping to it
+     * (reference: uvm_va_policy accessed_by + fault-service map path). */
+    void *ptr;
+    CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &ptr) == TPU_OK);
+    uint8_t *bytes = ptr;
+    memset(bytes, 0x42, UVM_BLOCK_SIZE);          /* host resident */
+
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.residentHost && !info.devMapped);
+
+    /* Policy set eagerly maps resident pages. */
+    CHECK(uvmSetAccessedBy(vs, ptr, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.devMapped);
+
+    /* Device read: serviced by the mapping — NO migration to HBM. */
+    CHECK(uvmDeviceAccess(vs, 0, ptr, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.residentHost && !info.residentHbm && info.devMapped);
+
+    /* Explicit migration still moves the data and stales the mapping;
+     * the next device fault re-maps to the new location. */
+    UvmLocation cxl = { UVM_TIER_CXL, 0 };
+    CHECK(uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, cxl, 0) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.residentCxl && !info.devMapped);
+    CHECK(uvmDeviceAccess(vs, 0, ptr, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.residentCxl && !info.residentHbm && info.devMapped);
+
+    /* Unset drops the policy AND the mapping; the next device access
+     * migrates to HBM like any unmapped fault. */
+    CHECK(uvmUnsetAccessedBy(vs, ptr, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(!info.devMapped);
+    CHECK(uvmDeviceAccess(vs, 0, ptr, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+
+    /* Data survived the host->CXL->HBM trip: fault back and verify. */
+    CHECK(bytes[12345] == 0x42);
+
+    /* Accessed-by WRITE on a read-duplicated page: the mapping write
+     * keeps one copy (HBM), invalidates the host duplicate, AND revokes
+     * the CPU PTE so a CPU load faults instead of reading stale data. */
+    CHECK(uvmSetReadDuplication(vs, ptr, UVM_BLOCK_SIZE, 1) == TPU_OK);
+    UvmLocation hbm0 = { UVM_TIER_HBM, 0 };
+    CHECK(uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, hbm0, 0) == TPU_OK);
+    volatile uint8_t sink = bytes[0];   /* CPU read dup -> host + HBM */
+    (void)sink;
+    UvmResidencyInfo dup;
+    CHECK(uvmResidencyInfo(vs, ptr, &dup) == TPU_OK);
+    CHECK(dup.residentHost && dup.residentHbm);
+    CHECK(uvmSetAccessedBy(vs, ptr, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmDeviceAccess(vs, 0, ptr, UVM_BLOCK_SIZE, 1) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, ptr, &dup) == TPU_OK);
+    CHECK(dup.residentHbm && !dup.residentHost && !dup.cpuMapped);
+    /* CPU load re-faults and pulls the written copy home. */
+    sink = bytes[0];
+    CHECK(uvmResidencyInfo(vs, ptr, &dup) == TPU_OK);
+    CHECK(dup.residentHost);
+
+    CHECK(uvmMemFree(vs, ptr) == TPU_OK);
+    return TPU_OK;
+}
+
+/* ------------------------------------------------------ tools control */
+
+static TpuStatus test_tools_control(UvmVaSpace *vs)
+{
+    UvmToolsSession *s = NULL;
+    CHECK(uvmToolsSessionCreate(vs, 128, &s) == TPU_OK);
+
+    /* Enable only READ_DUP + MIGRATION; other events must be filtered. */
+    uvmToolsEnableEvents(s, 0);
+    uvmToolsEnableEventTypes(s, (1ull << UVM_EVENT_READ_DUP) |
+                                (1ull << UVM_EVENT_MIGRATION));
+    uvmToolsDisableEventTypes(s, 1ull << UVM_EVENT_MIGRATION);
+
+    void *ptr;
+    CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &ptr) == TPU_OK);
+    memset(ptr, 1, UVM_BLOCK_SIZE);
+
+    /* Read-duplicated device fault emits READ_DUP (dup copy created). */
+    CHECK(uvmSetReadDuplication(vs, ptr, UVM_BLOCK_SIZE, 1) == TPU_OK);
+    CHECK(uvmDeviceAccess(vs, 0, ptr, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.residentHost && info.residentHbm);   /* duplicated */
+
+    UvmEvent evs[64];
+    size_t n = uvmToolsReadEvents(s, evs, 64);
+    CHECK(n >= 1);
+    bool sawReadDup = false;
+    for (size_t i = 0; i < n; i++) {
+        CHECK(evs[i].type == UVM_EVENT_READ_DUP);   /* filter honored */
+        sawReadDup = true;
+    }
+    CHECK(sawReadDup);
+
+    /* Counters gate on enable. */
+    uint64_t v = 0;
+    CHECK(!uvmToolsCounterGet(s, "uvm_fault_batches", &v));
+    uvmToolsSetCountersEnabled(s, true);
+    CHECK(uvmToolsCounterGet(s, "uvm_fault_batches", &v));
+    CHECK(v > 0);
+
+    /* Notification threshold counts crossings. */
+    uvmToolsSetNotificationThreshold(s, 1);
+    uvmToolsEnableEvents(s, ~0ull);
+    CHECK(uvmMigrate(vs, ptr, UVM_BLOCK_SIZE,
+                     (UvmLocation){ UVM_TIER_HOST, 0 }, 0) == TPU_OK);
+    CHECK(uvmToolsPendingEvents(s) >= 1);
+    CHECK(uvmToolsNotificationCount(s) >= 1);
+
+    CHECK(uvmMemFree(vs, ptr) == TPU_OK);
+    uvmToolsSessionDestroy(s);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -329,6 +455,10 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return test_lock_sanity();
     case UVM_TPU_TEST_FAULT_INJECT:
         return vs ? test_fault_inject(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_ACCESSED_BY:
+        return vs ? test_accessed_by(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_TOOLS:
+        return vs ? test_tools_control(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

@@ -19,6 +19,9 @@
 struct UvmToolsSession {
     UvmVaSpace *vs;                   /* filter; NULL = all spaces */
     uint64_t typeMask;
+    bool countersEnabled;
+    uint64_t notifThreshold;          /* 0 = no threshold */
+    uint64_t notifications;           /* threshold crossings */
     uint32_t capacity;                /* power of two */
     uint64_t widx, ridx;
     UvmEvent *ring;
@@ -86,6 +89,67 @@ void uvmToolsEnableEvents(UvmToolsSession *s, uint64_t typeMask)
         s->typeMask = typeMask;
 }
 
+/* Per-event-type enable/disable (reference: UVM_TOOLS_EVENT_QUEUE_
+ * ENABLE/DISABLE_EVENTS modify the set, they don't replace it). */
+void uvmToolsEnableEventTypes(UvmToolsSession *s, uint64_t typeMask)
+{
+    if (s)
+        s->typeMask |= typeMask;
+}
+
+void uvmToolsDisableEventTypes(UvmToolsSession *s, uint64_t typeMask)
+{
+    if (s)
+        s->typeMask &= ~typeMask;
+}
+
+void uvmToolsSetCountersEnabled(UvmToolsSession *s, bool enabled)
+{
+    if (s)
+        s->countersEnabled = enabled;
+}
+
+/* Counter snapshot: tpurm counters are global; a session exposes them
+ * only while its counters are enabled (reference: counters are per-fd
+ * subscriptions over shared state). */
+bool uvmToolsCounterGet(UvmToolsSession *s, const char *name, uint64_t *out)
+{
+    if (!s || !s->countersEnabled || !out)
+        return false;
+    *out = tpurmCounterGet(name);
+    return true;
+}
+
+void uvmToolsSetNotificationThreshold(UvmToolsSession *s, uint64_t threshold)
+{
+    if (s)
+        s->notifThreshold = threshold;
+}
+
+uint64_t uvmToolsPendingEvents(UvmToolsSession *s)
+{
+    if (!s)
+        return 0;
+    pthread_mutex_lock(&g_tools.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
+    uint64_t n = s->widx - s->ridx;
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
+    pthread_mutex_unlock(&g_tools.lock);
+    return n;
+}
+
+uint64_t uvmToolsNotificationCount(UvmToolsSession *s)
+{
+    if (!s)
+        return 0;
+    pthread_mutex_lock(&g_tools.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
+    uint64_t n = s->notifications;
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
+    pthread_mutex_unlock(&g_tools.lock);
+    return n;
+}
+
 void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
                   uint32_t dstTier, uint32_t devInst, uint64_t address,
                   uint64_t bytes)
@@ -110,6 +174,11 @@ void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
         e->bytes = bytes;
         e->timestampNs = uvmMonotonicNs();
         s->widx++;
+        /* Notification threshold: count the crossing (reference wakes
+         * the queue's wait_queue when pending == threshold). */
+        if (s->notifThreshold &&
+            s->widx - s->ridx == s->notifThreshold)
+            s->notifications++;
     }
     tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
     pthread_mutex_unlock(&g_tools.lock);

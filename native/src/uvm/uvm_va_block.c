@@ -426,6 +426,8 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                          UVM_TIER_HOST, blk->hbmDevInst, blk->start, bytes);
         }
         uvmPageMaskClearRange(&blk->resident[tier], first, last - first + 1);
+        /* Evicted pages lose any accessed-by device mapping into them. */
+        uvmPageMaskClearRange(&blk->devMapped, first, last - first + 1);
     }
     block_gc_runs(blk, tier);
     uvmFaultStatsRecordEviction();
@@ -588,11 +590,14 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             return st;
         }
 
-        /* Commit masks. */
+        /* Commit masks.  Residency movement stales any accessed-by device
+         * mapping to the old location; clear so the next device access
+         * re-establishes it (reference revokes mappings on migration). */
         for (uint32_t p = firstPage; p < firstPage + count; p++) {
             if (!uvmPageMaskTest(&needed, p))
                 continue;
             uvmPageMaskSet(&blk->resident[dst.tier], p);
+            uvmPageMaskClear(&blk->devMapped, p);
             if (!readDup) {
                 for (int t = 0; t < UVM_TIER_COUNT; t++) {
                     if (t == (int)dst.tier)
@@ -620,8 +625,17 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             block_gc_runs(blk, dst.tier == UVM_TIER_HBM ? UVM_TIER_CXL
                                                         : UVM_TIER_HBM);
         }
-        if (bytes)
+        if (bytes) {
             uvmFaultStatsRecordMigration(bytes);
+            if (readDup)
+                /* Source copies survived: this copy created duplicates
+                 * (reference emits UvmEventTypeReadDuplicate from the
+                 * same commit point). */
+                uvmToolsEmit(range->vaSpace, UVM_EVENT_READ_DUP,
+                             UVM_TIER_COUNT, dst.tier, dst.devInst,
+                             blk->start + (uint64_t)firstPage * uvmPageSize(),
+                             bytes);
+        }
         break;
     }
 
@@ -636,6 +650,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
                 if (t != (int)dst.tier)
                     uvmPageMaskClear(&blk->resident[t], p);
             }
+            /* Exclusive write revokes remote (accessed-by) mappings. */
+            uvmPageMaskClear(&blk->devMapped, p);
         }
         if (dst.tier != UVM_TIER_HOST) {
             uvmBlockSetCpuAccess(blk, firstPage, count, PROT_NONE);
@@ -662,6 +678,69 @@ TpuStatus uvmBlockMakeResident(UvmVaBlock *blk, UvmLocation dst,
 {
     return uvmBlockMakeResidentEx(blk, dst, firstPage, count, forWrite,
                                   false);
+}
+
+/* Accessed-by service: map [firstPage, firstPage+count) for a device
+ * WITHOUT migrating — the device reads/writes the data where it resides
+ * (reference: SetAccessedBy processors get mappings established on fault
+ * service instead of migrations, uvm_va_policy accessed_by semantics).
+ * Pages resident nowhere cannot be mapped (TPU_ERR_INVALID_STATE: the
+ * caller falls back to migration).  A write access makes the mapped
+ * location exclusive first (MESI), mirroring make-resident's rule. */
+TpuStatus uvmBlockMapDevice(UvmVaBlock *blk, uint32_t firstPage,
+                            uint32_t count, bool forWrite)
+{
+    if (firstPage + count > blk->npages)
+        return TPU_ERR_INVALID_ARGUMENT;
+
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block-map");
+
+    for (uint32_t p = firstPage; p < firstPage + count; p++) {
+        bool resident = false;
+        for (int t = 0; t < UVM_TIER_COUNT; t++)
+            if (uvmPageMaskTest(&blk->resident[t], p))
+                resident = true;
+        if (!resident) {
+            tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-map");
+            pthread_mutex_unlock(&blk->lock);
+            return TPU_ERR_INVALID_STATE;
+        }
+    }
+
+    if (forWrite) {
+        /* Keep one copy per page (priority HBM > CXL > HOST) and drop
+         * duplicates so the remote write cannot diverge from a stale
+         * duplicate; host pages the device may now write become
+         * PROT_READ so CPU stores re-fault and serialize. */
+        for (uint32_t p = firstPage; p < firstPage + count; p++) {
+            int keep = -1;
+            const int prio[] = { UVM_TIER_HBM, UVM_TIER_CXL, UVM_TIER_HOST };
+            for (int i = 0; i < 3 && keep < 0; i++)
+                if (uvmPageMaskTest(&blk->resident[prio[i]], p))
+                    keep = prio[i];
+            bool hadHost = uvmPageMaskTest(&blk->resident[UVM_TIER_HOST], p);
+            for (int t = 0; t < UVM_TIER_COUNT; t++)
+                if (t != keep)
+                    uvmPageMaskClear(&blk->resident[t], p);
+            if (keep == UVM_TIER_HOST) {
+                uvmBlockSetCpuAccess(blk, p, 1, PROT_READ);
+                uvmPageMaskClear(&blk->cpuMapped, p);
+            } else if (hadHost) {
+                /* Host copy invalidated by the remote write: CPU loads
+                 * must fault, not read the stale page (same pairing as
+                 * make-resident's exclusive-write path). */
+                uvmBlockSetCpuAccess(blk, p, 1, PROT_NONE);
+                uvmPageMaskClear(&blk->cpuMapped, p);
+            }
+        }
+    }
+    uvmPageMaskSetRange(&blk->devMapped, firstPage, count);
+
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-map");
+    pthread_mutex_unlock(&blk->lock);
+    tpuCounterAdd("uvm_accessed_by_mappings", 1);
+    return TPU_OK;
 }
 
 void uvmBlockFreeBacking(UvmVaBlock *blk)

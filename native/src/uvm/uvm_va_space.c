@@ -364,6 +364,30 @@ static void accessed_by_fn(UvmVaRange *r, void *arg)
         r->accessedByMask |= 1ull << a->devInst;
     else
         r->accessedByMask &= ~(1ull << a->devInst);
+
+    /* Mappings follow the policy immediately (reference: SetAccessedBy
+     * establishes mappings to already-resident pages eagerly; Unset
+     * revokes them).  devMapped is the union over accessed-by devices,
+     * so it clears only when the policy empties. */
+    for (uint32_t b = 0; b < r->blockCount; b++) {
+        UvmVaBlock *blk = r->blocks[b];
+        if (!blk)
+            continue;
+        pthread_mutex_lock(&blk->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block-policy");
+        if (a->set) {
+            for (uint32_t p = 0; p < blk->npages; p++)
+                for (int t = 0; t < UVM_TIER_COUNT; t++)
+                    if (uvmPageMaskTest(&blk->resident[t], p)) {
+                        uvmPageMaskSet(&blk->devMapped, p);
+                        break;
+                    }
+        } else if (r->accessedByMask == 0) {
+            uvmPageMaskZero(&blk->devMapped);
+        }
+        tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-policy");
+        pthread_mutex_unlock(&blk->lock);
+    }
 }
 
 TpuStatus uvmSetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
@@ -378,6 +402,8 @@ TpuStatus uvmSetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
 TpuStatus uvmUnsetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
                              uint32_t devInst)
 {
+    if (devInst >= 64)          /* accessedByMask is one bit per device */
+        return TPU_ERR_INVALID_DEVICE;
     struct accessed_by_arg a = { devInst, false };
     return for_ranges_in(vs, base, len, accessed_by_fn, &a);
 }
@@ -510,6 +536,7 @@ TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out)
     out->residentCxl = uvmPageMaskTest(&blk->resident[UVM_TIER_CXL], page);
     out->hbmDeviceInst = blk->hbmDevInst;
     out->cpuMapped = uvmPageMaskTest(&blk->cpuMapped, page);
+    out->devMapped = uvmPageMaskTest(&blk->devMapped, page);
     out->pinnedTier = blk->pinnedTier;
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
     pthread_mutex_unlock(&blk->lock);

@@ -61,6 +61,8 @@ int main(void)
     run_module_test(fd, UVM_TPU_TEST_LOCK_SANITY, "lock_sanity");
     run_module_test(fd, UVM_TPU_TEST_FAULT_INJECT, "fault_inject");
     run_module_test(fd, UVM_TPU_TEST_PMM_EVICTION, "pmm_eviction");
+    run_module_test(fd, UVM_TPU_TEST_ACCESSED_BY, "accessed_by");
+    run_module_test(fd, UVM_TPU_TEST_TOOLS, "tools_control");
 
     /* ---- managed lifecycle over the raw ABI ---- */
     UvmTpuAllocManagedParams alloc = { .length = 8 << 20 };
@@ -154,6 +156,30 @@ int main(void)
     UvmFreeParams fr = { .base = alloc.base };
     EXPECT(tpurm_ioctl(fd, UVM_FREE, &fr) == 0);
     EXPECT(fr.rmStatus == TPU_OK);
+
+    /* ---- tools ioctls: no silently-accepted commands ---- */
+    /* Before a tracker exists, control ioctls report INVALID_STATE. */
+    UvmToolsEventControlParams tev = { .eventTypeFlags = ~0ull };
+    EXPECT(tpurm_ioctl(fd, UVM_TOOLS_EVENT_QUEUE_ENABLE_EVENTS, &tev) == 0);
+    EXPECT(tev.rmStatus == TPU_ERR_INVALID_STATE);
+    UvmToolsFlushEventsParams tfl = { 0 };
+    EXPECT(tpurm_ioctl(fd, UVM_TOOLS_FLUSH_EVENTS, &tfl) == 0);
+    EXPECT(tfl.rmStatus == TPU_ERR_INVALID_STATE);
+
+    UvmToolsInitEventTrackerParams tinit = { .queueBufferSize = 256 };
+    EXPECT(tpurm_ioctl(fd, UVM_TOOLS_INIT_EVENT_TRACKER, &tinit) == 0);
+    EXPECT(tinit.rmStatus == TPU_OK);
+    EXPECT(tpurm_ioctl(fd, UVM_TOOLS_EVENT_QUEUE_ENABLE_EVENTS, &tev) == 0);
+    EXPECT(tev.rmStatus == TPU_OK);
+    UvmToolsCountersParams tcnt = { 0 };
+    EXPECT(tpurm_ioctl(fd, UVM_TOOLS_ENABLE_COUNTERS, &tcnt) == 0);
+    EXPECT(tcnt.rmStatus == TPU_OK);
+    UvmToolsSetNotificationThresholdParams tth =
+        { .notificationThreshold = 4 };
+    EXPECT(tpurm_ioctl(fd, UVM_TOOLS_SET_NOTIFICATION_THRESHOLD, &tth) == 0);
+    EXPECT(tth.rmStatus == TPU_OK);
+    EXPECT(tpurm_ioctl(fd, UVM_TOOLS_FLUSH_EVENTS, &tfl) == 0);
+    EXPECT(tfl.rmStatus == TPU_OK);
 
     /* Fault stats sanity: CPU + device faults both flowed. */
     UvmFaultStats stats;

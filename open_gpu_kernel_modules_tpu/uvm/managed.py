@@ -49,6 +49,7 @@ class _ResidencyInfo(ctypes.Structure):
         ("residentCxl", ctypes.c_uint8),
         ("hbmDeviceInst", ctypes.c_uint32),
         ("cpuMapped", ctypes.c_uint8),
+        ("devMapped", ctypes.c_uint8),
         ("pinnedTier", ctypes.c_int32),
     ]
 
@@ -85,6 +86,7 @@ class ResidencyInfo:
     hbm_device: int
     cpu_mapped: bool
     pinned_tier: Optional[Tier]
+    dev_mapped: bool = False
 
 
 @dataclass(frozen=True)
@@ -162,6 +164,17 @@ def _lib() -> ctypes.CDLL:
     lib.uvmToolsSessionCreate.restype = u32
     lib.uvmToolsSessionDestroy.argtypes = [vp]
     lib.uvmToolsEnableEvents.argtypes = [vp, u64]
+    lib.uvmToolsEnableEventTypes.argtypes = [vp, u64]
+    lib.uvmToolsDisableEventTypes.argtypes = [vp, u64]
+    lib.uvmToolsSetCountersEnabled.argtypes = [vp, ctypes.c_bool]
+    lib.uvmToolsCounterGet.argtypes = [vp, ctypes.c_char_p,
+                                       ctypes.POINTER(u64)]
+    lib.uvmToolsCounterGet.restype = ctypes.c_bool
+    lib.uvmToolsSetNotificationThreshold.argtypes = [vp, u64]
+    lib.uvmToolsPendingEvents.argtypes = [vp]
+    lib.uvmToolsPendingEvents.restype = u64
+    lib.uvmToolsNotificationCount.argtypes = [vp]
+    lib.uvmToolsNotificationCount.restype = u64
     lib.uvmToolsReadEvents.argtypes = [vp, ctypes.POINTER(_Event),
                                        ctypes.c_size_t]
     lib.uvmToolsReadEvents.restype = ctypes.c_size_t
@@ -205,6 +218,40 @@ class ToolsSession:
         for t in types:
             mask |= 1 << int(t)
         self._lib.uvmToolsEnableEvents(self._handle, mask)
+
+    def enable_types(self, types: Iterable[EventType]) -> None:
+        mask = 0
+        for t in types:
+            mask |= 1 << int(t)
+        self._lib.uvmToolsEnableEventTypes(self._handle, mask)
+
+    def disable_types(self, types: Iterable[EventType]) -> None:
+        mask = 0
+        for t in types:
+            mask |= 1 << int(t)
+        self._lib.uvmToolsDisableEventTypes(self._handle, mask)
+
+    def enable_counters(self, enabled: bool = True) -> None:
+        self._lib.uvmToolsSetCountersEnabled(self._handle, enabled)
+
+    def counter(self, name: str) -> Optional[int]:
+        """Counter value, or None while counters are disabled."""
+        out = ctypes.c_uint64()
+        if self._lib.uvmToolsCounterGet(self._handle, name.encode(),
+                                        ctypes.byref(out)):
+            return out.value
+        return None
+
+    def set_notification_threshold(self, threshold: int) -> None:
+        self._lib.uvmToolsSetNotificationThreshold(self._handle, threshold)
+
+    @property
+    def pending(self) -> int:
+        return self._lib.uvmToolsPendingEvents(self._handle)
+
+    @property
+    def notifications(self) -> int:
+        return self._lib.uvmToolsNotificationCount(self._handle)
 
     def read(self, max_events: int = 1024) -> List[Event]:
         buf = (_Event * max_events)()
@@ -288,6 +335,11 @@ class ManagedBuffer:
                                           self.nbytes, dev),
                "uvmSetAccessedBy")
 
+    def unset_accessed_by(self, dev: int) -> None:
+        _check(self._lib.uvmUnsetAccessedBy(self._vs._handle, self.address,
+                                            self.nbytes, dev),
+               "uvmUnsetAccessedBy")
+
     def residency(self, offset: int = 0) -> ResidencyInfo:
         raw = _ResidencyInfo()
         _check(self._lib.uvmResidencyInfo(self._vs._handle,
@@ -297,7 +349,8 @@ class ManagedBuffer:
         return ResidencyInfo(bool(raw.residentHost), bool(raw.residentHbm),
                              bool(raw.residentCxl), raw.hbmDeviceInst,
                              bool(raw.cpuMapped),
-                             _tier_or_none(raw.pinnedTier))
+                             _tier_or_none(raw.pinnedTier),
+                             bool(raw.devMapped))
 
     def free(self) -> None:
         if self.address:
