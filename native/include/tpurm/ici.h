@@ -21,6 +21,7 @@
 #include <stdint.h>
 
 #include "status.h"
+#include "tpurm.h"
 
 #ifdef __cplusplus
 extern "C" {
@@ -81,6 +82,12 @@ void      tpuIciPeerApertureDestroy(TpuIciPeerAperture *ap);
  * (direction: 0 = local->peer write, 1 = peer->local read). */
 TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
                          uint64_t peerOff, uint64_t size, int direction);
+/* Async variant: records the push in `tracker` instead of waiting, so ICI
+ * peer copies synchronize with CE and CXL work through one dependency
+ * object (reference: uvm_tracker.c).  tracker == NULL waits (sync). */
+TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
+                              uint64_t peerOff, uint64_t size, int direction,
+                              TpuTracker *tracker);
 
 #ifdef __cplusplus
 }

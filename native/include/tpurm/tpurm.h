@@ -23,6 +23,7 @@
 #ifndef TPURM_TPURM_H
 #define TPURM_TPURM_H
 
+#include <stdbool.h>
 #include <stddef.h>
 #include <stdint.h>
 
@@ -93,6 +94,60 @@ void          tpurmChannelInjectError(TpurmChannel *ch);
 /* Robust-channel recovery: clear a latched channel error so new work can
  * proceed (reference: per-channel RC, src/nvidia/src/kernel/gpu/rc/). */
 void          tpurmChannelResetError(TpurmChannel *ch);
+
+/* ------------------------------------------------------------- tracker */
+
+/* Cross-channel completion dependencies (reference: uvm_tracker.c — a
+ * set of (channel, value) entries; same-channel entries collapse to the
+ * max value; completed entries are pruned on query). */
+#define TPU_TRACKER_INLINE 8
+
+typedef struct {
+    TpurmChannel *ch;
+    uint64_t value;
+} TpuTrackerEntry;
+
+typedef struct {
+    uint32_t count, capacity;
+    TpuTrackerEntry *entries;           /* inlineEntries until it grows */
+    TpuTrackerEntry inlineEntries[TPU_TRACKER_INLINE];
+} TpuTracker;
+
+void      tpuTrackerInit(TpuTracker *t);
+void      tpuTrackerDeinit(TpuTracker *t);
+TpuStatus tpuTrackerAdd(TpuTracker *t, TpurmChannel *ch, uint64_t value);
+TpuStatus tpuTrackerAddTracker(TpuTracker *dst, const TpuTracker *src);
+/* Prunes completed entries; true when nothing is outstanding. */
+bool      tpuTrackerIsCompleted(TpuTracker *t);
+/* Waits every entry (draining failures too), clears the tracker, and
+ * returns the first failure status if any entry's channel faulted. */
+TpuStatus tpuTrackerWait(TpuTracker *t);
+
+/* ---------------------------------------------------------- pushbuffer */
+
+/* Multi-segment pushes carved from a per-channel pushbuffer ring with
+ * cpu_put/gpu_get semantics (reference: uvm_pushbuffer.h:33-90 — space
+ * is reclaimed as the consumer's get pointer passes it; reservation
+ * back-pressures when the ring is full).  A push's segments execute as
+ * one channel entry and complete under one tracker value. */
+typedef struct TpuPush {
+    TpurmChannel *ch;
+    void *segs;                         /* chunk in the pushbuffer */
+    uint32_t nsegs, maxSegs;
+    uint64_t pbEndOffset;               /* monotonic pb offset after chunk */
+} TpuPush;
+
+TpuStatus tpuPushBegin(TpurmChannel *ch, uint32_t maxSegs, TpuPush *p);
+TpuStatus tpuPushCopySeg(TpuPush *p, void *dst, const void *src,
+                         uint64_t bytes);
+/* Submit; returns the tracker value (0 on failure).  If t is non-NULL the
+ * (channel, value) pair is recorded there.  An empty push (no segments)
+ * is submitted as a no-op marker — useful as a completion fence. */
+uint64_t  tpuPushEnd(TpuPush *p, TpuTracker *t);
+/* Abandon a begun push without submitting: its pushbuffer chunk is
+ * released directly (no channel entry is created and no tracker value
+ * is produced). */
+void      tpuPushAbort(TpuPush *p);
 
 /* --------------------------------------------------------- diagnostics */
 

@@ -24,13 +24,31 @@
 #include <stdlib.h>
 #include <string.h>
 
+/* A copy method within a push (the reference encodes CE methods into
+ * pushbuffer space; here a segment IS the method). */
 typedef struct {
     void *dst;
     const void *src;
     uint64_t bytes;
+} CopySeg;
+
+typedef struct {
+    CopySeg *segs;             /* points into the pushbuffer */
+    uint32_t nsegs;
+    uint64_t pbEnd;            /* monotonic pushbuffer offset to release */
     uint64_t trackerValue;
     bool injectError;
 } PushEntry;
+
+/* Outstanding pushbuffer chunk, in allocation order.  gpu_get advances
+ * over the done-prefix only, so out-of-order submission between Begin and
+ * End never releases space still being written (the reference tracks
+ * per-chunk completion the same way, uvm_pushbuffer.c). */
+typedef struct PbChunk {
+    uint64_t end;              /* monotonic end offset (incl. leading pad) */
+    bool done;
+    struct PbChunk *next;
+} PbChunk;
 
 struct TpurmChannel {
     TpurmDevice *dev;
@@ -41,6 +59,13 @@ struct TpurmChannel {
     uint64_t get;              /* consumer index (monotonic) */
     uint64_t submittedValue;   /* last tracker value handed out */
     uint64_t completedValue;   /* tracker semaphore */
+    /* Pushbuffer ring (uvm_pushbuffer.h:33-90 semantics): cpu_put grows
+     * on reservation, gpu_get follows retired chunks. */
+    uint8_t *pbBase;
+    uint64_t pbSize;
+    uint64_t pbCpuPut, pbGpuGet;   /* monotonic byte offsets */
+    PbChunk *pbChunks, *pbChunksTail;
+    PbChunk *pbChunkFree;          /* recycled chunk nodes */
     bool stop;
     bool injectNext;
     bool error;                /* latched channel error */
@@ -48,6 +73,27 @@ struct TpurmChannel {
     pthread_cond_t cond;       /* any state change */
     pthread_t worker;
 };
+
+/* Mark the chunk ending at `end` done and advance gpu_get over the done
+ * prefix (ch->lock held). */
+static void pb_release_locked(TpurmChannel *ch, uint64_t end)
+{
+    for (PbChunk *c = ch->pbChunks; c; c = c->next) {
+        if (c->end == end) {
+            c->done = true;
+            break;
+        }
+    }
+    while (ch->pbChunks && ch->pbChunks->done) {
+        PbChunk *c = ch->pbChunks;
+        ch->pbGpuGet = c->end;
+        ch->pbChunks = c->next;
+        if (!ch->pbChunks)
+            ch->pbChunksTail = NULL;
+        c->next = ch->pbChunkFree;     /* recycle (freed at destroy) */
+        ch->pbChunkFree = c;
+    }
+}
 
 static void *channel_worker(void *arg)
 {
@@ -64,12 +110,20 @@ static void *channel_worker(void *arg)
         pthread_mutex_unlock(&ch->lock);
 
         bool failed = entry.injectError;
-        if (!failed && entry.bytes > 0)
-            memmove(entry.dst, entry.src, entry.bytes);
+        uint64_t bytes = 0;
+        if (!failed) {
+            for (uint32_t i = 0; i < entry.nsegs; i++) {
+                CopySeg *s = &entry.segs[i];
+                if (s->bytes > 0)
+                    memmove(s->dst, s->src, s->bytes);
+                bytes += s->bytes;
+            }
+        }
 
         pthread_mutex_lock(&ch->lock);
         ch->get++;
         ch->completedValue = entry.trackerValue;
+        pb_release_locked(ch, entry.pbEnd);
         if (failed) {
             ch->error = true;
             tpuLog(TPU_LOG_ERROR, "channel",
@@ -77,7 +131,7 @@ static void *channel_worker(void *arg)
                    (unsigned long long)entry.trackerValue);
         }
         tpuCounterAdd("channel_copies_completed", 1);
-        tpuCounterAdd("channel_bytes_copied", failed ? 0 : entry.bytes);
+        tpuCounterAdd("channel_bytes_copied", failed ? 0 : bytes);
         pthread_cond_broadcast(&ch->cond);
     }
     pthread_mutex_unlock(&ch->lock);
@@ -104,12 +158,23 @@ TpurmChannel *tpurmChannelCreate(TpurmDevice *dev, TpurmCeType ce,
         free(ch);
         return NULL;
     }
+    /* Pushbuffer sized by registry (reference: UVM_PUSHBUFFER_SIZE). */
+    ch->pbSize = tpuRegistryGet("pushbuffer_size_bytes", 1ull << 20);
+    if (ch->pbSize < 4096)
+        ch->pbSize = 4096;
+    ch->pbBase = malloc(ch->pbSize);
+    if (!ch->pbBase) {
+        free(ch->ring);
+        free(ch);
+        return NULL;
+    }
     ch->dev = dev;
     ch->ce = ce;
     ch->entries = ring_entries;
     pthread_mutex_init(&ch->lock, NULL);
     pthread_cond_init(&ch->cond, NULL);
     if (pthread_create(&ch->worker, NULL, channel_worker, ch) != 0) {
+        free(ch->pbBase);
         free(ch->ring);
         free(ch);
         return NULL;
@@ -128,32 +193,124 @@ void tpurmChannelDestroy(TpurmChannel *ch)
     pthread_join(ch->worker, NULL);
     pthread_cond_destroy(&ch->cond);
     pthread_mutex_destroy(&ch->lock);
+    while (ch->pbChunks) {
+        PbChunk *c = ch->pbChunks;
+        ch->pbChunks = c->next;
+        free(c);
+    }
+    while (ch->pbChunkFree) {
+        PbChunk *c = ch->pbChunkFree;
+        ch->pbChunkFree = c->next;
+        free(c);
+    }
+    free(ch->pbBase);
     free(ch->ring);
     free(ch);
 }
 
-uint64_t tpurmChannelPushCopy(TpurmChannel *ch, void *dst, const void *src,
-                              uint64_t bytes)
+/* ---------------------------------------------------------- push objects */
+
+TpuStatus tpuPushBegin(TpurmChannel *ch, uint32_t maxSegs, TpuPush *p)
 {
-    if (!ch || (!dst && bytes) || (!src && bytes))
-        return 0;
+    if (!ch || !p || maxSegs == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t need = (uint64_t)maxSegs * sizeof(CopySeg);
+    if (need > ch->pbSize)
+        return TPU_ERR_INVALID_LIMIT;
 
     pthread_mutex_lock(&ch->lock);
-    tpuLockTrackAcquire(TPU_LOCK_CHANNEL, "channel");
-    /* Back-pressure: block while the GPFIFO ring is full (the reference
-     * spins/waits for ring space in uvm_channel_reserve). */
+    tpuLockTrackAcquire(TPU_LOCK_CHANNEL, "push-begin");
+    for (;;) {
+        if (ch->stop) {
+            tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-begin");
+            pthread_mutex_unlock(&ch->lock);
+            return TPU_ERR_INVALID_STATE;
+        }
+        uint64_t pos = ch->pbCpuPut % ch->pbSize;
+        uint64_t pad = pos + need > ch->pbSize ? ch->pbSize - pos : 0;
+        /* Reservation back-pressure: wait for gpu_get to free space
+         * (reference blocks reserving pushbuffer space the same way). */
+        if (ch->pbCpuPut + pad + need - ch->pbGpuGet > ch->pbSize) {
+            pthread_cond_wait(&ch->cond, &ch->lock);
+            continue;
+        }
+        ch->pbCpuPut += pad;          /* skip unusable tail */
+        p->segs = ch->pbBase + (ch->pbCpuPut % ch->pbSize);
+        ch->pbCpuPut += need;
+        p->pbEndOffset = ch->pbCpuPut;
+        break;
+    }
+    /* Track the chunk (in allocation order) so gpu_get only advances
+     * over completed prefixes.  Nodes come from the recycle list in
+     * steady state; malloc only grows the pool (bounded by outstanding
+     * pushes, itself bounded by the GPFIFO depth). */
+    PbChunk *c = ch->pbChunkFree;
+    if (c) {
+        ch->pbChunkFree = c->next;
+    } else {
+        c = malloc(sizeof(*c));
+        if (!c) {
+            /* Roll back the reservation (lock held since we advanced). */
+            ch->pbCpuPut = p->pbEndOffset - ((uint64_t)maxSegs *
+                                             sizeof(CopySeg));
+            tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-begin");
+            pthread_mutex_unlock(&ch->lock);
+            return TPU_ERR_NO_MEMORY;
+        }
+    }
+    c->end = p->pbEndOffset;
+    c->done = false;
+    c->next = NULL;
+    if (ch->pbChunksTail)
+        ch->pbChunksTail->next = c;
+    else
+        ch->pbChunks = c;
+    ch->pbChunksTail = c;
+    tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-begin");
+    pthread_mutex_unlock(&ch->lock);
+
+    p->ch = ch;
+    p->nsegs = 0;
+    p->maxSegs = maxSegs;
+    return TPU_OK;
+}
+
+TpuStatus tpuPushCopySeg(TpuPush *p, void *dst, const void *src,
+                         uint64_t bytes)
+{
+    if (!p || !p->ch || p->nsegs >= p->maxSegs)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (bytes && (!dst || !src))
+        return TPU_ERR_INVALID_ARGUMENT;
+    CopySeg *s = &((CopySeg *)p->segs)[p->nsegs++];
+    s->dst = dst;
+    s->src = src;
+    s->bytes = bytes;
+    return TPU_OK;
+}
+
+uint64_t tpuPushEnd(TpuPush *p, TpuTracker *t)
+{
+    if (!p || !p->ch)
+        return 0;
+    TpurmChannel *ch = p->ch;
+
+    pthread_mutex_lock(&ch->lock);
+    tpuLockTrackAcquire(TPU_LOCK_CHANNEL, "push-end");
     while (!ch->stop && ch->put - ch->get >= ch->entries)
         pthread_cond_wait(&ch->cond, &ch->lock);
     if (ch->stop) {
-        tpuLockTrackRelease(TPU_LOCK_CHANNEL, "channel");
+        pb_release_locked(ch, p->pbEndOffset);
+        tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-end");
         pthread_mutex_unlock(&ch->lock);
+        p->ch = NULL;
         return 0;
     }
 
     PushEntry *entry = &ch->ring[ch->put % ch->entries];
-    entry->dst = dst;
-    entry->src = src;
-    entry->bytes = bytes;
+    entry->segs = p->segs;
+    entry->nsegs = p->nsegs;
+    entry->pbEnd = p->pbEndOffset;
     entry->trackerValue = ++ch->submittedValue;
     entry->injectError = ch->injectNext;
     ch->injectNext = false;
@@ -161,9 +318,42 @@ uint64_t tpurmChannelPushCopy(TpurmChannel *ch, void *dst, const void *src,
     uint64_t value = entry->trackerValue;
     tpuCounterAdd("channel_pushes", 1);
     pthread_cond_broadcast(&ch->cond);
-    tpuLockTrackRelease(TPU_LOCK_CHANNEL, "channel");
+    tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-end");
     pthread_mutex_unlock(&ch->lock);
+
+    p->ch = NULL;
+    if (t && tpuTrackerAdd(t, ch, value) != TPU_OK)
+        /* Dependency could not be recorded (tracker growth OOM): degrade
+         * to synchronous completion so no dependency is silently lost. */
+        tpurmChannelWait(ch, value);
     return value;
+}
+
+void tpuPushAbort(TpuPush *p)
+{
+    if (!p || !p->ch)
+        return;
+    TpurmChannel *ch = p->ch;
+    pthread_mutex_lock(&ch->lock);
+    pb_release_locked(ch, p->pbEndOffset);
+    pthread_cond_broadcast(&ch->cond);   /* space freed: wake reservers */
+    pthread_mutex_unlock(&ch->lock);
+    p->ch = NULL;
+}
+
+uint64_t tpurmChannelPushCopy(TpurmChannel *ch, void *dst, const void *src,
+                              uint64_t bytes)
+{
+    if (!ch || (!dst && bytes) || (!src && bytes))
+        return 0;
+    TpuPush p;
+    if (tpuPushBegin(ch, 1, &p) != TPU_OK)
+        return 0;
+    if (tpuPushCopySeg(&p, dst, src, bytes) != TPU_OK) {
+        tpuPushAbort(&p);
+        return 0;
+    }
+    return tpuPushEnd(&p, NULL);
 }
 
 TpuStatus tpurmChannelWait(TpurmChannel *ch, uint64_t value)
@@ -237,17 +427,24 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
     uint64_t remaining = size;
     uint64_t lastValue = 0;
 
-    /* Contiguity-split loop (reference: ce_utils.c:646-661): each push
-     * covers the largest run contiguous in BOTH surfaces, clamped. */
+    /* Contiguity-split loop (reference: ce_utils.c:646-661): each segment
+     * covers the largest run contiguous in BOTH surfaces, clamped.
+     * Segments batch into push objects (up to 64 per push) so one tracker
+     * value completes a whole request chunk. */
+    enum { SEGS_PER_PUSH = 64 };
+    TpuPush push;
+    TpuStatus st = tpuPushBegin(ch, SEGS_PER_PUSH, &push);
+    if (st != TPU_OK)
+        return st;
     while (remaining > 0) {
         void *dptr, *sptr;
         uint64_t drun, srun;
-        TpuStatus st = tpuMemdescResolve(dst, dev, dstOff, &dptr, &drun);
+        st = tpuMemdescResolve(dst, dev, dstOff, &dptr, &drun);
         if (st != TPU_OK)
-            return st;
+            goto fail;
         st = tpuMemdescResolve(src, dev, srcOff, &sptr, &srun);
         if (st != TPU_OK)
-            return st;
+            goto fail;
         uint64_t len = remaining;
         if (len > drun)
             len = drun;
@@ -255,18 +452,79 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
             len = srun;
         if (len > clamp)
             len = clamp;
-        uint64_t value = tpurmChannelPushCopy(ch, dptr, sptr, len);
-        if (value == 0)
-            return TPU_ERR_INVALID_STATE;
-        lastValue = value;
+        if (push.nsegs == SEGS_PER_PUSH) {
+            uint64_t v = tpuPushEnd(&push, NULL);
+            if (v == 0)
+                return TPU_ERR_INVALID_STATE;
+            lastValue = v;
+            st = tpuPushBegin(ch, SEGS_PER_PUSH, &push);
+            if (st != TPU_OK)
+                return st;
+        }
+        st = tpuPushCopySeg(&push, dptr, sptr, len);
+        if (st != TPU_OK)
+            goto fail;
         dstOff += len;
         srcOff += len;
         remaining -= len;
+    }
+    if (push.nsegs > 0) {
+        uint64_t v = tpuPushEnd(&push, NULL);
+        if (v == 0)
+            return TPU_ERR_INVALID_STATE;
+        lastValue = v;
+    } else {
+        tpuPushAbort(&push);
     }
 
     if (outTrackerValue)
         *outTrackerValue = lastValue;
     if (async)
         return TPU_OK;
-    return tpurmChannelWait(ch, lastValue);
+    return lastValue ? tpurmChannelWait(ch, lastValue) : TPU_OK;
+
+fail:
+    tpuPushAbort(&push);
+    /* Drain pushes already submitted: the caller may free/unpin the
+     * surfaces on error while workers are still writing them (same rule
+     * as block_copy_in's drain-before-unwind). */
+    if (lastValue)
+        tpurmChannelWait(ch, lastValue);
+    return st;
+}
+
+/* ------------------------------------------------------- CE pool striper */
+
+bool tpuCeStriperInit(TpuCeStriper *s, TpurmDevice *dev)
+{
+    if (!dev || dev->cePoolSize == 0)
+        return false;
+    s->dev = dev;
+    s->next = 0;
+    s->stripe = tpuRegistryGet("uvm_ce_stripe_bytes", 512 * 1024);
+    if (s->stripe < 4096)
+        s->stripe = 4096;
+    return true;
+}
+
+TpuStatus tpuCeStriperPush(TpuCeStriper *s, void *dst, const void *src,
+                           uint64_t len, TpuTracker *t)
+{
+    uint64_t off = 0;
+    while (off < len) {
+        uint64_t piece = len - off;
+        if (piece > s->stripe)
+            piece = s->stripe;
+        TpurmChannel *ch = s->dev->cePool[s->next];
+        s->next = (s->next + 1) % s->dev->cePoolSize;
+        uint64_t v = tpurmChannelPushCopy(ch, (char *)dst + off,
+                                          (const char *)src + off, piece);
+        if (v == 0)
+            return TPU_ERR_INVALID_STATE;
+        if (t && tpuTrackerAdd(t, ch, v) != TPU_OK)
+            /* Can't record the dep: complete it now instead of losing it. */
+            tpurmChannelWait(ch, v);
+        off += piece;
+    }
+    return TPU_OK;
 }

@@ -37,8 +37,9 @@ struct TpuCxlBuffer {
     bool mlocked;
     TpuMemDesc *memdesc;       /* persistent, built on first DMA */
     uint32_t activeDma;        /* in-flight synchronous DMA sections */
-    uint64_t pendingTracker;   /* max async tracker value submitted */
-    TpurmDevice *pendingDev;   /* device owning pendingTracker's channel */
+    /* Async submissions against this buffer, as (channel, value) deps —
+     * multiple devices' channels tracked together (uvm_tracker.c). */
+    TpuTracker pending;
 };
 
 static struct {
@@ -185,6 +186,7 @@ TpuStatus tpuCxlRegister(uint64_t baseAddress, uint64_t size,
     buf->pageSize = pageSize;
     buf->hugePages = pageSize == TPU_CXL_PAGE_SIZE_2M;
     buf->memdesc = NULL;
+    tpuTrackerInit(&buf->pending);
     /* Pin: mlock is best-effort in userspace (RLIMIT_MEMLOCK); failure is
      * logged, accounting proceeds — matching the reference test's tolerant
      * mlock handling, while kernel-grade pinning stays a deploy concern. */
@@ -227,13 +229,11 @@ TpuStatus tpuCxlUnregister(uint64_t handle)
         pthread_mutex_unlock(&g_cxl.lock);
         return TPU_ERR_STATE_IN_USE;
     }
-    if (buf->pendingTracker && buf->pendingDev && buf->pendingDev->ce) {
-        /* Quiesce async submissions before teardown: the channel is FIFO,
-         * so completion of the max tracker value retires every copy that
-         * still reads/writes this buffer. */
-        tpurmChannelWait(buf->pendingDev->ce, buf->pendingTracker);
-        buf->pendingTracker = 0;
-    }
+    /* Quiesce async submissions before teardown: waiting the tracker
+     * retires every copy (on any device's channel) that still
+     * reads/writes this buffer. */
+    tpuTrackerWait(&buf->pending);
+    tpuTrackerDeinit(&buf->pending);
     if (buf->mlocked)
         munlock((void *)(uintptr_t)buf->baseAddress, buf->size);
     tpuMemdescDestroy(buf->memdesc);
@@ -334,14 +334,12 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
         tpuMemdescDestroy(devMd);
     }
 
-    /* Drop the DMA reference; async submissions leave a pending tracker so
-     * unregister can quiesce the channel before teardown. */
+    /* Drop the DMA reference; async submissions record into the buffer's
+     * tracker so unregister can quiesce all channels before teardown. */
     pthread_mutex_lock(&g_cxl.lock);
     buf->activeDma--;
-    if (st == TPU_OK && async && tracker > buf->pendingTracker) {
-        buf->pendingTracker = tracker;
-        buf->pendingDev = dev;
-    }
+    if (st == TPU_OK && async && tracker)
+        tpuTrackerAdd(&buf->pending, dev->ce, tracker);
     pthread_mutex_unlock(&g_cxl.lock);
 
     if (st != TPU_OK) {

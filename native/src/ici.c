@@ -350,8 +350,9 @@ void tpuIciPeerApertureDestroy(TpuIciPeerAperture *ap)
     free(ap);
 }
 
-TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
-                         uint64_t peerOff, uint64_t size, int direction)
+TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
+                              uint64_t peerOff, uint64_t size, int direction,
+                              TpuTracker *tracker)
 {
     if (!ap || size == 0)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -382,5 +383,13 @@ TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
     if (v == 0)
         return TPU_ERR_INVALID_STATE;
     tpuCounterAdd("ici_peer_copy_bytes", size);
+    if (tracker)
+        return tpuTrackerAdd(tracker, local->ce, v);
     return tpurmChannelWait(local->ce, v);
+}
+
+TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
+                         uint64_t peerOff, uint64_t size, int direction)
+{
+    return tpuIciPeerCopyAsync(ap, localOff, peerOff, size, direction, NULL);
 }

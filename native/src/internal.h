@@ -147,4 +147,18 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
                      TpuMemDesc *src, uint64_t srcOff, uint64_t size,
                      bool async, uint64_t *outTrackerValue);
 
+/* CE pool striper: round-robins pieces of a copy across the device's
+ * channel pool, recording each push in a tracker (reference: channel
+ * pools per CE type + pipelined pushes + uvm_tracker.c dependencies).
+ * Replaces the old per-callsite fan-out. */
+typedef struct {
+    TpurmDevice *dev;
+    uint32_t next;
+    uint64_t stripe;
+} TpuCeStriper;
+
+bool      tpuCeStriperInit(TpuCeStriper *s, TpurmDevice *dev);
+TpuStatus tpuCeStriperPush(TpuCeStriper *s, void *dst, const void *src,
+                           uint64_t len, TpuTracker *t);
+
 #endif /* TPURM_INTERNAL_H */

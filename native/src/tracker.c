@@ -1,0 +1,107 @@
+/*
+ * Tracker — cross-channel completion dependencies.
+ *
+ * Re-design of the reference's uvm_tracker.c: a tracker is a small set of
+ * (channel, value) entries; work that depends on pushes spread across
+ * several channels records each push here and waits once.  Entries for
+ * the same channel collapse to the max value (channel tracker semaphores
+ * are monotonic, reference uvm_gpu_semaphore.c), and completed entries
+ * are pruned on query, so a long-lived tracker stays small.
+ *
+ * Used by the CE fan-out (uvm_va_block.c), ICI peer copies (ici.c), and
+ * the CXL DMA quiesce path (cxl.c) — one synchronization object for all
+ * three engines, replacing per-engine ad hoc waits.
+ */
+#include "internal.h"
+
+#include <stdlib.h>
+
+void tpuTrackerInit(TpuTracker *t)
+{
+    t->count = 0;
+    t->capacity = TPU_TRACKER_INLINE;
+    t->entries = t->inlineEntries;
+}
+
+void tpuTrackerDeinit(TpuTracker *t)
+{
+    if (t->entries != t->inlineEntries)
+        free(t->entries);
+    t->count = 0;
+    t->capacity = TPU_TRACKER_INLINE;
+    t->entries = t->inlineEntries;
+}
+
+TpuStatus tpuTrackerAdd(TpuTracker *t, TpurmChannel *ch, uint64_t value)
+{
+    if (!t || !ch || value == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    for (uint32_t i = 0; i < t->count; i++) {
+        if (t->entries[i].ch == ch) {
+            if (value > t->entries[i].value)
+                t->entries[i].value = value;
+            return TPU_OK;
+        }
+    }
+    if (t->count == t->capacity) {
+        uint32_t ncap = t->capacity * 2;
+        TpuTrackerEntry *ne = malloc(ncap * sizeof(*ne));
+        if (!ne)
+            return TPU_ERR_NO_MEMORY;
+        for (uint32_t i = 0; i < t->count; i++)
+            ne[i] = t->entries[i];
+        if (t->entries != t->inlineEntries)
+            free(t->entries);
+        t->entries = ne;
+        t->capacity = ncap;
+    }
+    t->entries[t->count].ch = ch;
+    t->entries[t->count].value = value;
+    t->count++;
+    return TPU_OK;
+}
+
+TpuStatus tpuTrackerAddTracker(TpuTracker *dst, const TpuTracker *src)
+{
+    if (!dst || !src)
+        return TPU_ERR_INVALID_ARGUMENT;
+    for (uint32_t i = 0; i < src->count; i++) {
+        TpuStatus st = tpuTrackerAdd(dst, src->entries[i].ch,
+                                     src->entries[i].value);
+        if (st != TPU_OK)
+            return st;
+    }
+    return TPU_OK;
+}
+
+bool tpuTrackerIsCompleted(TpuTracker *t)
+{
+    if (!t)
+        return true;
+    uint32_t i = 0;
+    while (i < t->count) {
+        if (tpurmChannelCompletedValue(t->entries[i].ch) >=
+            t->entries[i].value) {
+            /* Prune: swap-with-last (order is irrelevant). */
+            t->entries[i] = t->entries[--t->count];
+        } else {
+            i++;
+        }
+    }
+    return t->count == 0;
+}
+
+TpuStatus tpuTrackerWait(TpuTracker *t)
+{
+    if (!t)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuStatus st = TPU_OK;
+    for (uint32_t i = 0; i < t->count; i++) {
+        TpuStatus s = tpurmChannelWait(t->entries[i].ch,
+                                       t->entries[i].value);
+        if (s != TPU_OK)
+            st = s;      /* keep waiting the rest; report first failure */
+    }
+    t->count = 0;
+    return st;
+}

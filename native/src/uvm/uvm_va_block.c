@@ -158,67 +158,20 @@ void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
         uvmPageMaskClearRange(&blk->cpuMapped, firstPage, count);
 }
 
-/* CE fan-out: stripes copies across the device's channel pool so the
- * worker threads move data in parallel (reference: channel pools per CE
- * type + pipelined pushes, uvm_channel.c / uvm_migrate.c:555). */
-typedef struct {
-    TpurmChannel *ch[TPU_CE_POOL_MAX];
-    uint64_t last[TPU_CE_POOL_MAX];
-    uint32_t n, next;
-    uint64_t stripe;
-} CeFanout;
-
-static bool fanout_init(CeFanout *f, UvmVaBlock *blk)
+/* Block copies stripe across the device's CE pool and synchronize
+ * through a tracker — the same (channel, value) dependency object the
+ * ICI and CXL engines use (reference: uvm_tracker.c; channel pools per
+ * CE type + pipelined pushes, uvm_channel.c / uvm_migrate.c:555). */
+static bool block_striper_init(TpuCeStriper *s, UvmVaBlock *blk)
 {
     TpurmDevice *dev = tpurmDeviceGet(blk->hbmDevInst);
     if (!dev)
         dev = tpurmDeviceGet(0);
-    if (!dev || dev->cePoolSize == 0)
+    if (!tpuCeStriperInit(s, dev))
         return false;
-    f->n = dev->cePoolSize;
-    for (uint32_t i = 0; i < f->n; i++) {
-        f->ch[i] = dev->cePool[i];
-        f->last[i] = 0;
-    }
-    f->next = 0;
-    f->stripe = tpuRegistryGet("uvm_ce_stripe_bytes", 512 * 1024);
-    if (f->stripe < uvmPageSize())
-        f->stripe = uvmPageSize();
+    if (s->stripe < uvmPageSize())
+        s->stripe = uvmPageSize();
     return true;
-}
-
-static TpuStatus fanout_push(CeFanout *f, void *dst, const void *src,
-                             uint64_t len)
-{
-    uint64_t off = 0;
-    while (off < len) {
-        uint64_t piece = len - off;
-        if (piece > f->stripe)
-            piece = f->stripe;
-        uint32_t c = f->next;
-        f->next = (f->next + 1) % f->n;
-        uint64_t v = tpurmChannelPushCopy(f->ch[c], (char *)dst + off,
-                                          (const char *)src + off, piece);
-        if (v == 0)
-            return TPU_ERR_INVALID_STATE;
-        f->last[c] = v;
-        off += piece;
-    }
-    return TPU_OK;
-}
-
-static TpuStatus fanout_wait(CeFanout *f)
-{
-    TpuStatus st = TPU_OK;
-    for (uint32_t i = 0; i < f->n; i++) {
-        if (f->last[i]) {
-            TpuStatus s = tpurmChannelWait(f->ch[i], f->last[i]);
-            if (s != TPU_OK)
-                st = s;
-            f->last[i] = 0;
-        }
-    }
-    return st;
 }
 
 /* Pick the copy source tier for a page: HBM > CXL > HOST (device copies
@@ -244,8 +197,10 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                                uint32_t count, uint64_t *bytesOut)
 {
     uint64_t ps = uvmPageSize();
-    CeFanout fan;
-    bool haveCe = fanout_init(&fan, blk);
+    TpuCeStriper striper;
+    TpuTracker tracker;
+    tpuTrackerInit(&tracker);
+    bool haveCe = block_striper_init(&striper, blk);
     uint64_t bytes = 0;
 
     /* On any failure, drain already-issued stripes before unwinding —
@@ -259,8 +214,8 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         int src = page_src_tier(blk, p);
         void *dstPtr = tier_page_ptr(blk, dstTier, p);
         if (!dstPtr) {
-            if (haveCe)
-                fanout_wait(&fan);
+            tpuTrackerWait(&tracker);
+            tpuTrackerDeinit(&tracker);
             return TPU_ERR_INVALID_STATE;
         }
         if (src < 0) {
@@ -275,8 +230,8 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         }
         void *srcPtr = tier_page_ptr(blk, (UvmTier)src, p);
         if (!srcPtr) {
-            if (haveCe)
-                fanout_wait(&fan);
+            tpuTrackerWait(&tracker);
+            tpuTrackerDeinit(&tracker);
             return TPU_ERR_INVALID_STATE;
         }
         /* Grow the span while pages are selected, same source tier, and
@@ -290,12 +245,15 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                tier_page_ptr(blk, (UvmTier)src, p + span) ==
                    (char *)srcPtr + (uint64_t)span * ps)
             span++;
-        if (!haveCe)
+        if (!haveCe) {
+            tpuTrackerDeinit(&tracker);
             return TPU_ERR_INVALID_STATE;
-        TpuStatus st = fanout_push(&fan, dstPtr, srcPtr,
-                                   (uint64_t)span * ps);
+        }
+        TpuStatus st = tpuCeStriperPush(&striper, dstPtr, srcPtr,
+                                        (uint64_t)span * ps, &tracker);
         if (st != TPU_OK) {
-            fanout_wait(&fan);
+            tpuTrackerWait(&tracker);
+            tpuTrackerDeinit(&tracker);
             return st;
         }
         bytes += (uint64_t)span * ps;
@@ -303,7 +261,9 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
     }
     if (bytesOut)
         *bytesOut = bytes;
-    return haveCe ? fanout_wait(&fan) : TPU_OK;
+    TpuStatus st = tpuTrackerWait(&tracker);
+    tpuTrackerDeinit(&tracker);
+    return st;
 }
 
 /* ---------------------------------------------------------- eviction */
@@ -364,8 +324,10 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
 
     if (first <= last) {
         if (!uvmPageMaskEmpty(&toHost, np)) {
-            CeFanout fan;
-            bool haveCe = fanout_init(&fan, blk);
+            TpuCeStriper striper;
+            TpuTracker tracker;
+            tpuTrackerInit(&tracker);
+            bool haveCe = block_striper_init(&striper, blk);
             uint64_t bytes = 0;
             for (uint32_t p = first; p <= last; p++) {
                 if (!uvmPageMaskTest(&toHost, p))
@@ -382,12 +344,13 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                  * accesses fault and queue behind this eviction rather
                  * than reading stale bytes or losing stores. */
                 TpuStatus st = haveCe
-                                   ? fanout_push(&fan, dst, src,
-                                                 (uint64_t)span * ps)
+                                   ? tpuCeStriperPush(&striper, dst, src,
+                                                      (uint64_t)span * ps,
+                                                      &tracker)
                                    : TPU_ERR_INVALID_STATE;
                 if (st != TPU_OK) {
-                    if (haveCe)
-                        fanout_wait(&fan);   /* drain in-flight stripes */
+                    tpuTrackerWait(&tracker);   /* drain in-flight stripes */
+                    tpuTrackerDeinit(&tracker);
                     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-evict");
                     pthread_mutex_unlock(&blk->lock);
                     return st;
@@ -396,7 +359,8 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                 p += span - 1;
             }
             {
-                TpuStatus st = fanout_wait(&fan);
+                TpuStatus st = tpuTrackerWait(&tracker);
+                tpuTrackerDeinit(&tracker);
                 if (st != TPU_OK) {
                     /* Nothing committed: masks and user PTEs unchanged,
                      * so the device copy stays authoritative and CPU

@@ -85,6 +85,121 @@ int main(void)
         CHECK(hbm[(uint64_t)i * PG] == (char)(0x10 + i));
     tpurmChannelDestroy(ce);
 
+    /* ---- tracker: cross-channel completion dependencies ---- */
+    {
+        TpurmChannel *c1 = tpurmChannelCreate(dev, TPURM_CE_ANY, 32);
+        TpurmChannel *c2 = tpurmChannelCreate(dev, TPURM_CE_ANY, 32);
+        CHECK(c1 && c2);
+        static char t_src[PG], t_dst1[PG], t_dst2[PG];
+        memset(t_src, 0x3C, PG);
+
+        TpuTracker t;
+        tpuTrackerInit(&t);
+        uint64_t v1 = tpurmChannelPushCopy(c1, t_dst1, t_src, PG);
+        uint64_t v2 = tpurmChannelPushCopy(c2, t_dst2, t_src, PG);
+        CHECK(v1 && v2);
+        CHECK(tpuTrackerAdd(&t, c1, v1) == TPU_OK);
+        CHECK(tpuTrackerAdd(&t, c2, v2) == TPU_OK);
+        /* Same-channel entries collapse to the max value. */
+        uint64_t v1b = tpurmChannelPushCopy(c1, t_dst1, t_src, PG);
+        CHECK(tpuTrackerAdd(&t, c1, v1b) == TPU_OK);
+        CHECK(t.count == 2);
+        CHECK(tpuTrackerWait(&t) == TPU_OK);
+        CHECK(t.count == 0);
+        CHECK(t_dst1[7] == 0x3C && t_dst2[7] == 0x3C);
+
+        /* IsCompleted prunes as channels catch up. */
+        uint64_t v3 = tpurmChannelPushCopy(c1, t_dst1, t_src, PG);
+        tpuTrackerAdd(&t, c1, v3);
+        while (!tpuTrackerIsCompleted(&t))
+            ;
+        CHECK(t.count == 0);
+
+        /* A faulted channel propagates its error through the tracker,
+         * and the other channel is still drained. */
+        tpurmChannelInjectError(c1);
+        uint64_t vb = tpurmChannelPushCopy(c1, t_dst1, t_src, PG);
+        uint64_t vg = tpurmChannelPushCopy(c2, t_dst2, t_src, PG);
+        tpuTrackerAdd(&t, c1, vb);
+        tpuTrackerAdd(&t, c2, vg);
+        CHECK(tpuTrackerWait(&t) == TPU_ERR_INVALID_STATE);
+        CHECK(tpurmChannelCompletedValue(c2) >= vg);
+        tpurmChannelResetError(c1);
+
+        /* Growth past the inline capacity (dedup off: distinct channels). */
+        TpurmChannel *many[TPU_TRACKER_INLINE + 4];
+        static char many_dst[TPU_TRACKER_INLINE + 4][PG];
+        for (unsigned i = 0; i < TPU_TRACKER_INLINE + 4; i++) {
+            many[i] = tpurmChannelCreate(dev, TPURM_CE_ANY, 32);
+            CHECK(many[i]);
+            uint64_t v = tpurmChannelPushCopy(many[i], many_dst[i], t_src,
+                                              PG);
+            CHECK(tpuTrackerAdd(&t, many[i], v) == TPU_OK);
+        }
+        CHECK(t.count == TPU_TRACKER_INLINE + 4);
+        CHECK(tpuTrackerWait(&t) == TPU_OK);
+        for (unsigned i = 0; i < TPU_TRACKER_INLINE + 4; i++)
+            tpurmChannelDestroy(many[i]);
+        tpuTrackerDeinit(&t);
+        tpurmChannelDestroy(c1);
+        tpurmChannelDestroy(c2);
+    }
+
+    /* ---- pushbuffer: multi-segment pushes, wrap, back-pressure ---- */
+    {
+        /* Tiny pushbuffer forces wrap-around + reservation waits. */
+        setenv("TPUMEM_PUSHBUFFER_SIZE_BYTES", "4096", 1);
+        TpurmChannel *pc = tpurmChannelCreate(dev, TPURM_CE_ANY, 32);
+        CHECK(pc != NULL);
+        unsetenv("TPUMEM_PUSHBUFFER_SIZE_BYTES");
+
+        /* DEPTH rotating buffer sets keep pipelining without racing a
+         * worker still reading a buffer being rewritten: round r reuses
+         * set r%DEPTH only after round r-DEPTH completed. */
+        enum { ROUNDS = 512, SEGS = 16, DEPTH = 8 };
+        static char p_src[DEPTH][SEGS][64], p_dst[DEPTH][SEGS][64];
+        uint64_t lastv = 0, rvals[DEPTH] = { 0 };
+        for (int r = 0; r < ROUNDS; r++) {
+            int slot = r % DEPTH;
+            if (rvals[slot])
+                CHECK(tpurmChannelWait(pc, rvals[slot]) == TPU_OK);
+            TpuPush push;
+            CHECK(tpuPushBegin(pc, SEGS, &push) == TPU_OK);
+            for (int s = 0; s < SEGS; s++) {
+                memset(p_src[slot][s], (r + s) & 0xff, 64);
+                CHECK(tpuPushCopySeg(&push, p_dst[slot][s],
+                                     p_src[slot][s], 64) == TPU_OK);
+            }
+            uint64_t v = tpuPushEnd(&push, NULL);
+            CHECK(v == lastv + 1);      /* one value per multi-seg push */
+            lastv = v;
+            rvals[slot] = v;
+        }
+        CHECK(tpurmChannelWait(pc, lastv) == TPU_OK);
+        int lastSlot = (ROUNDS - 1) % DEPTH;
+        for (int s = 0; s < SEGS; s++)
+            CHECK(p_dst[lastSlot][s][63] == (char)((ROUNDS - 1 + s) & 0xff));
+
+        /* Abort releases reserved space (no deadlock on refill). */
+        TpuPush ab;
+        CHECK(tpuPushBegin(pc, SEGS, &ab) == TPU_OK);
+        tpuPushAbort(&ab);
+        for (int r = 0; r < 8; r++) {
+            TpuPush push;
+            CHECK(tpuPushBegin(pc, SEGS, &push) == TPU_OK);
+            CHECK(tpuPushCopySeg(&push, p_dst[0][0], p_src[0][0], 64) ==
+                  TPU_OK);
+            CHECK(tpuPushEnd(&push, NULL) != 0);
+        }
+        /* Empty push = completion fence. */
+        TpuPush fence;
+        CHECK(tpuPushBegin(pc, 1, &fence) == TPU_OK);
+        uint64_t fv = tpuPushEnd(&fence, NULL);
+        CHECK(fv != 0);
+        CHECK(tpurmChannelWait(pc, fv) == TPU_OK);
+        tpurmChannelDestroy(pc);
+    }
+
     /* Counters moved. */
     CHECK(tpurmCounterGet("channel_pushes") >= N + PAGES);
     CHECK(tpurmCounterGet("channel_bytes_copied") >= (uint64_t)N * BUF);

@@ -180,6 +180,36 @@ static void test_ici(void)
     EXPECT(tpuIciTrainLinks(0) == TPU_OK);
     EXPECT(tpuIciRouteHops(0, 1, &hops) == TPU_OK && hops == 1);
 
+    /* Cross-engine tracker: ICI peer copies to two peers plus local CE
+     * pushes, all synchronized through ONE tracker (the uvm_tracker.c
+     * dependency object the CE fan-out and CXL paths share). */
+    {
+        TpuIciPeerAperture *ap2 = NULL;
+        EXPECT(tpuIciPeerApertureCreate(0, 2, &ap2) == TPU_OK);
+        TpurmDevice *d2 = tpurmDeviceGet(2);
+        memset((char *)tpurmDeviceHbmBase(d0) + 16384, 0x7E, 8192);
+        memset(tpurmDeviceHbmBase(d2), 0, 4096);
+
+        TpuTracker t;
+        tpuTrackerInit(&t);
+        EXPECT(tpuIciPeerCopyAsync(ap, 16384, 16384, 4096, 0, &t) == TPU_OK);
+        EXPECT(tpuIciPeerCopyAsync(ap2, 16384, 0, 4096, 0, &t) == TPU_OK);
+        TpurmChannel *ce0 = tpurmChannelCreate(d0, TPURM_CE_ANY, 0);
+        EXPECT(ce0 != NULL);
+        uint64_t v = tpurmChannelPushCopy(
+            ce0, (char *)tpurmDeviceHbmBase(d0) + 32768,
+            (char *)tpurmDeviceHbmBase(d0) + 16384, 4096);
+        EXPECT(v != 0);
+        EXPECT(tpuTrackerAdd(&t, ce0, v) == TPU_OK);
+        EXPECT(tpuTrackerWait(&t) == TPU_OK);
+        tpurmChannelDestroy(ce0);
+        EXPECT(((unsigned char *)tpurmDeviceHbmBase(d1))[16384 + 9] == 0x7E);
+        EXPECT(((unsigned char *)tpurmDeviceHbmBase(d2))[9] == 0x7E);
+        EXPECT(((unsigned char *)tpurmDeviceHbmBase(d0))[32768 + 9] == 0x7E);
+        tpuTrackerDeinit(&t);
+        tpuIciPeerApertureDestroy(ap2);
+    }
+
     tpuIciPeerApertureDestroy(ap);
     printf("  ici flows ok (%u devices)\n", ndev);
 }
