@@ -324,7 +324,8 @@ typedef enum {
     UVM_EVENT_THRASHING = 4,
     UVM_EVENT_PREFETCH = 5,
     UVM_EVENT_READ_DUP = 6,
-    UVM_EVENT_COUNT = 7,
+    UVM_EVENT_ACCESS_COUNTER = 7,
+    UVM_EVENT_COUNT = 8,
 } UvmEventType;
 
 typedef struct {
@@ -371,6 +372,7 @@ enum {
     UVM_TPU_TEST_FAULT_INJECT         = 7,
     UVM_TPU_TEST_ACCESSED_BY          = 8,
     UVM_TPU_TEST_TOOLS                = 9,
+    UVM_TPU_TEST_ACCESS_COUNTERS      = 10,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

@@ -454,12 +454,19 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
             len = clamp;
         if (push.nsegs == SEGS_PER_PUSH) {
             uint64_t v = tpuPushEnd(&push, NULL);
-            if (v == 0)
-                return TPU_ERR_INVALID_STATE;
+            if (v == 0) {
+                st = TPU_ERR_INVALID_STATE;
+                if (lastValue)
+                    tpurmChannelWait(ch, lastValue);
+                return st;
+            }
             lastValue = v;
             st = tpuPushBegin(ch, SEGS_PER_PUSH, &push);
-            if (st != TPU_OK)
+            if (st != TPU_OK) {
+                /* Drain submitted work before unwinding (drain rule). */
+                tpurmChannelWait(ch, lastValue);
                 return st;
+            }
         }
         st = tpuPushCopySeg(&push, dptr, sptr, len);
         if (st != TPU_OK)
@@ -470,8 +477,11 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
     }
     if (push.nsegs > 0) {
         uint64_t v = tpuPushEnd(&push, NULL);
-        if (v == 0)
+        if (v == 0) {
+            if (lastValue)
+                tpurmChannelWait(ch, lastValue);
             return TPU_ERR_INVALID_STATE;
+        }
         lastValue = v;
     } else {
         tpuPushAbort(&push);

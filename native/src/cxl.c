@@ -338,8 +338,14 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
      * tracker so unregister can quiesce all channels before teardown. */
     pthread_mutex_lock(&g_cxl.lock);
     buf->activeDma--;
-    if (st == TPU_OK && async && tracker)
-        tpuTrackerAdd(&buf->pending, dev->ce, tracker);
+    if (st == TPU_OK && async && tracker &&
+        tpuTrackerAdd(&buf->pending, dev->ce, tracker) != TPU_OK) {
+        /* Dep could not be recorded: complete it now rather than let
+         * unregister's quiesce miss an in-flight copy. */
+        pthread_mutex_unlock(&g_cxl.lock);
+        tpurmChannelWait(dev->ce, tracker);
+        pthread_mutex_lock(&g_cxl.lock);
+    }
     pthread_mutex_unlock(&g_cxl.lock);
 
     if (st != TPU_OK) {

@@ -383,8 +383,13 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
     if (v == 0)
         return TPU_ERR_INVALID_STATE;
     tpuCounterAdd("ici_peer_copy_bytes", size);
-    if (tracker)
-        return tpuTrackerAdd(tracker, local->ce, v);
+    if (tracker) {
+        if (tpuTrackerAdd(tracker, local->ce, v) == TPU_OK)
+            return TPU_OK;
+        /* Dep could not be recorded: complete it now instead of leaving
+         * an untracked in-flight copy behind an error return. */
+        return tpurmChannelWait(local->ce, v);
+    }
     return tpurmChannelWait(local->ce, v);
 }
 

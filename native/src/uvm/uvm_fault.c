@@ -48,6 +48,13 @@ static long futex_call(uint32_t *uaddr, int op, uint32_t val)
     return syscall(SYS_futex, uaddr, op, val, NULL, NULL, 0);
 }
 
+static long futex_wait_timeout(uint32_t *uaddr, uint32_t val, uint64_t ns)
+{
+    struct timespec ts = { .tv_sec = (time_t)(ns / 1000000000ull),
+                           .tv_nsec = (long)(ns % 1000000000ull) };
+    return syscall(SYS_futex, uaddr, FUTEX_WAIT, val, &ts, NULL, 0);
+}
+
 /* ------------------------------------------------------------- snapshot */
 
 typedef struct {
@@ -281,17 +288,41 @@ static UvmFaultEntry *ring_pop(void)
     return e;
 }
 
-static void ring_wait_nonempty(void)
+/* Returns true when work is pending, false on timeout (the service loop
+ * uses timeouts to run the access-counter decay sweep while idle). */
+static bool ring_wait_nonempty(uint64_t timeoutNs)
 {
+    uint64_t deadline = uvmMonotonicNs() + timeoutNs;
     for (;;) {
         uint32_t p = __atomic_load_n(&g_fault.pending, __ATOMIC_SEQ_CST);
         if (p > 0)
-            return;
-        futex_call(&g_fault.pending, FUTEX_WAIT, 0);
+            return true;
+        uint64_t now = uvmMonotonicNs();
+        if (now >= deadline)
+            return false;
+        futex_wait_timeout(&g_fault.pending, 0, deadline - now);
     }
 }
 
 /* -------------------------------------------------------- fault service */
+
+/* Access-counter promotion: move a hot span to the accessing device's
+ * HBM (vs lock held).  Overrides accessed-by mappings and thrash pins —
+ * sustained hotness is stronger evidence than either hint. */
+static void service_promote(UvmVaSpace *vs, UvmVaBlock *blk,
+                            const UvmFaultEntry *e, uint32_t firstPage,
+                            uint32_t count, uint32_t srcTier)
+{
+    UvmLocation hot = { UVM_TIER_HBM, e->devInst };
+    if (uvmBlockMakeResidentEx(blk, hot, firstPage, count,
+                               e->isWrite != 0, false) != TPU_OK)
+        return;
+    blk->acPromoted = true;
+    uvmToolsEmit(vs, UVM_EVENT_ACCESS_COUNTER, srcTier, UVM_TIER_HBM,
+                 e->devInst,
+                 blk->start + (uint64_t)firstPage * uvmPageSize(),
+                 (uint64_t)count * uvmPageSize());
+}
 
 /* Service one fault entry: resolve range/block, pick the target tier,
  * expand via prefetch, make resident.  Mirrors
@@ -347,6 +378,15 @@ static TpuStatus service_one(UvmFaultEntry *e)
                 dst.tier = UVM_TIER_CXL;
                 dst.devInst = 0;
             }
+            /* A counter-promoted block stays in HBM: without this, the
+             * next device WRITE fault would re-target the preferred CXL
+             * tier and undo the promotion one access after it happened
+             * (reads duplicate, so only writes regress).  Promotion
+             * expires via the decay sweep, not via target selection. */
+            if (blk->acPromoted && dst.tier != UVM_TIER_HBM) {
+                dst.tier = UVM_TIER_HBM;
+                dst.devInst = e->devInst;
+            }
             /* Device READ faults duplicate instead of invalidating: the
              * device copy is then clean, so eviction under memory
              * pressure drops it without a copy-back — the streaming /
@@ -375,6 +415,18 @@ static TpuStatus service_one(UvmFaultEntry *e)
                 uvmToolsEmit(vs, UVM_EVENT_GPU_FAULT, UVM_TIER_COUNT,
                              UVM_TIER_COUNT, e->devInst, addr,
                              (uint64_t)count * ps);
+                /* Remote (mapped) access: feed the access counters; a hot
+                 * span gets promoted to the device's HBM anyway
+                 * (reference: access counters trigger migrations even for
+                 * mapped data, uvm_gpu_access_counters.c:81).  Mappings
+                 * that already resolve to HBM are local — counting them
+                 * would set acPromoted on deliberately-placed data and
+                 * invite a spurious decay demotion later. */
+                if (!uvmPageMaskTest(&blk->resident[UVM_TIER_HBM],
+                                     firstPage) &&
+                    uvmAccessCounterRecord(blk))
+                    service_promote(vs, blk, e, firstPage, count,
+                                    UVM_TIER_COUNT);
                 addr = blockEnd + 1;
                 continue;
             }
@@ -385,18 +437,56 @@ static TpuStatus service_one(UvmFaultEntry *e)
 
         st = uvmBlockMakeResidentEx(blk, dst, firstPage, count,
                                     e->isWrite != 0, forceDup);
-        if (st == TPU_OK)
+        if (st == TPU_OK) {
             uvmToolsEmit(vs, e->source == UVM_FAULT_SRC_CPU
                                  ? UVM_EVENT_CPU_FAULT
                                  : UVM_EVENT_GPU_FAULT,
                          UVM_TIER_COUNT, dst.tier, dst.devInst,
                          addr, (uint64_t)count * ps);
+            /* Device access placed off-HBM (CXL preference / thrash pin):
+             * hotness accumulates; threshold promotes to HBM. */
+            if (e->source == UVM_FAULT_SRC_DEVICE &&
+                dst.tier != UVM_TIER_HBM && uvmAccessCounterRecord(blk))
+                service_promote(vs, blk, e, firstPage, count, dst.tier);
+        }
         addr = blockEnd + 1;
     }
 
     tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
     pthread_mutex_unlock(&vs->lock);
     return st;
+}
+
+/* Decay sweep: demote counter-promoted blocks that went cold (service
+ * thread only; same spacesLock -> vs lock order as snapshot rebuild). */
+static void access_counter_sweep(void)
+{
+    static uint64_t lastSweepNs;
+    if (!tpuRegistryGet("uvm_access_counter_enable", 1))
+        return;
+    uint64_t now = uvmMonotonicNs();
+    uint64_t interval = tpuRegistryGet("uvm_access_counter_sweep_ms", 50) *
+                        1000000ull;
+    if (now - lastSweepNs < interval)
+        return;
+    lastSweepNs = now;
+
+    pthread_mutex_lock(&g_fault.spacesLock);
+    for (UvmVaSpace *vs = g_fault.spacesHead; vs; vs = vs->nextSpace) {
+        pthread_mutex_lock(&vs->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "ac-sweep");
+        for (UvmRangeTreeNode *n = vs->ranges.first; n;
+             n = uvmRangeTreeNext(n)) {
+            UvmVaRange *r = (UvmVaRange *)n;
+            for (uint32_t b = 0; b < r->blockCount; b++) {
+                if (r->blocks[b])
+                    uvmAccessCounterMaybeDemote(vs, r->blocks[b]);
+            }
+        }
+        tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "ac-sweep");
+        pthread_mutex_unlock(&vs->lock);
+    }
+    pthread_mutex_unlock(&g_fault.spacesLock);
 }
 
 static void *fault_service_thread(void *arg)
@@ -410,10 +500,16 @@ static void *fault_service_thread(void *arg)
     if (!batch)
         return NULL;
 
+    uint64_t sweepNs = tpuRegistryGet("uvm_access_counter_sweep_ms", 50) *
+                       1000000ull;
     for (;;) {
         /* fetch_fault_buffer_entries (:844): block for the first fault,
-         * then drain opportunistically up to the batch bound. */
-        ring_wait_nonempty();
+         * then drain opportunistically up to the batch bound.  Timeouts
+         * run the access-counter decay sweep while idle. */
+        if (!ring_wait_nonempty(sweepNs)) {
+            access_counter_sweep();
+            continue;
+        }
         uint32_t n = 0;
         while (n < maxBatch) {
             UvmFaultEntry *e = ring_pop();
@@ -481,6 +577,7 @@ static void *fault_service_thread(void *arg)
         }
         atomic_fetch_add(&g_fault.batches, 1);
         tpuCounterAdd("uvm_fault_batches", 1);
+        access_counter_sweep();
     }
     return NULL;
 }

@@ -183,6 +183,14 @@ typedef struct UvmVaBlock {
      * (no eviction, no migration away) — RDMA consumers hold bus
      * addresses into it (reference: vidmem pinned by p2p get_pages). */
     uint32_t p2pPinCount;
+    /* Access-counter state (reference: uvm_gpu_access_counters.c:81 —
+     * sampled hotness that triggers migrations).  acCount counts device
+     * accesses serviced WITHOUT HBM placement inside the window; crossing
+     * the threshold promotes the span to the device's HBM.  acPromoted
+     * marks counter-promoted blocks as candidates for decay demotion. */
+    uint64_t acWindowStartNs;
+    uint32_t acCount;
+    bool acPromoted;
 } UvmVaBlock;
 
 typedef enum {
@@ -341,6 +349,14 @@ void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
  * window (thrashing mitigation, uvm_perf_thrashing.h:33-46). */
 void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier);
 bool uvmPerfBlockPinnedAgainst(UvmVaBlock *blk, UvmTier targetTier);
+
+/* Access counters (uvm_gpu_access_counters.c:81 analog).  Record returns
+ * true when the block crossed the hotness threshold and should be
+ * promoted to the accessing device's HBM.  MaybeDemote (called from the
+ * sweeper with the vs lock held) demotes a counter-promoted block whose
+ * hotness decayed, returning true if it demoted. */
+bool uvmAccessCounterRecord(UvmVaBlock *blk);
+bool uvmAccessCounterMaybeDemote(UvmVaSpace *vs, UvmVaBlock *blk);
 
 /* ---------------------------------------------------------- tools hooks */
 

@@ -125,3 +125,97 @@ bool uvmPerfBlockPinnedAgainst(UvmVaBlock *blk, UvmTier targetTier)
         return false;
     return blk->pinnedTier != (int32_t)targetTier;
 }
+
+/* ------------------------------------------------------ access counters */
+
+/* Hotness sampling (re-design of uvm_gpu_access_counters.c:81: HW
+ * notifications of remote-access hotness become candidate migrations).
+ * The TPU engine sees every device access span (uvmDeviceAccess), so the
+ * "counter notification" is synthesized in the service loop: accesses
+ * serviced WITHOUT HBM placement (accessed-by mappings, CXL-preferred or
+ * thrash-pinned targets) count here; crossing the threshold inside the
+ * window promotes the block to the device's HBM.  Registry knobs:
+ *   uvm_access_counter_enable     (default 1)
+ *   uvm_access_counter_threshold  (default 8 remote accesses)
+ *   uvm_access_counter_window_ms  (default 100)
+ *   uvm_access_counter_decay_ms   (default 250 — cold promoted blocks
+ *                                  demote back to CXL/host)
+ */
+bool uvmAccessCounterRecord(UvmVaBlock *blk)
+{
+    if (!tpuRegistryGet("uvm_access_counter_enable", 1))
+        return false;
+    uint64_t now = uvmMonotonicNs();
+    uint64_t windowNs = tpuRegistryGet("uvm_access_counter_window_ms", 100) *
+                        1000000ull;
+    if (now - blk->acWindowStartNs > windowNs) {
+        blk->acWindowStartNs = now;
+        blk->acCount = 0;
+    }
+    blk->acCount++;
+    /* Multi-page device spans skip prefetch (which owns lastFaultNs for
+     * CPU faults), so refresh the decay clock here too — otherwise a
+     * device hammering a block reads as idle and the sweeper demotes
+     * still-hot data. */
+    blk->lastFaultNs = now;
+    uint32_t threshold =
+        (uint32_t)tpuRegistryGet("uvm_access_counter_threshold", 8);
+    if (blk->acCount >= threshold) {
+        blk->acCount = 0;
+        tpuCounterAdd("uvm_access_counter_promotions", 1);
+        return true;
+    }
+    return false;
+}
+
+bool uvmAccessCounterMaybeDemote(UvmVaSpace *vs, UvmVaBlock *blk)
+{
+    if (!blk->acPromoted)
+        return false;
+    uint64_t now = uvmMonotonicNs();
+    uint64_t decayNs = tpuRegistryGet("uvm_access_counter_decay_ms", 250) *
+                       1000000ull;
+    if (now - blk->lastFaultNs < decayNs)
+        return false;
+    if (uvmPageMaskEmpty(&blk->resident[UVM_TIER_HBM], blk->npages)) {
+        blk->acPromoted = false;       /* already moved elsewhere */
+        return false;
+    }
+
+    /* Demote target: the range's preferred device-side tier if it names
+     * CXL, else CXL when an arena exists, else host. */
+    UvmVaRange *range = blk->range;
+    UvmLocation dst = { UVM_TIER_CXL, 0 };
+    if (range->hasPreferred && range->preferred.tier == UVM_TIER_CXL)
+        dst.tier = UVM_TIER_CXL;
+    else if (!uvmTierArenaCxl())
+        dst.tier = UVM_TIER_HOST;
+
+    /* Move only HBM-resident runs (a whole-block make-resident would drag
+     * host-resident pages along). */
+    uint32_t p = 0;
+    bool demoted = false;
+    while (p < blk->npages) {
+        if (!uvmPageMaskTest(&blk->resident[UVM_TIER_HBM], p)) {
+            p++;
+            continue;
+        }
+        uint32_t span = 1;
+        while (p + span < blk->npages &&
+               uvmPageMaskTest(&blk->resident[UVM_TIER_HBM], p + span))
+            span++;
+        /* forWrite=true makes the demotion exclusive: a read-duplicated
+         * HBM copy must actually drop, or the demote frees nothing. */
+        if (uvmBlockMakeResident(blk, dst, p, span, true) == TPU_OK)
+            demoted = true;
+        p += span;
+    }
+    blk->acPromoted = false;
+    if (demoted) {
+        tpuCounterAdd("uvm_access_counter_demotions", 1);
+        uvmToolsEmit(vs, UVM_EVENT_ACCESS_COUNTER, UVM_TIER_HBM, dst.tier,
+                     blk->hbmDevInst, blk->start,
+                     (uint64_t)blk->npages * uvmPageSize());
+    }
+    return demoted;
+}

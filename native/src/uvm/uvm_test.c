@@ -10,6 +10,7 @@
 
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 #define CHECK(cond)                                                      \
     do {                                                                 \
@@ -436,6 +437,73 @@ static TpuStatus test_tools_control(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* ---------------------------------------------------- access counters */
+
+static TpuStatus test_access_counters(UvmVaSpace *vs)
+{
+    /* Hot CXL-preferred data promotes to HBM without explicit migrates;
+     * cold data stays put; decayed promotions demote back
+     * (uvm_gpu_access_counters.c:81 capability). */
+    setenv("TPUMEM_UVM_ACCESS_COUNTER_THRESHOLD", "4", 1);
+    setenv("TPUMEM_UVM_ACCESS_COUNTER_WINDOW_MS", "10000", 1);
+    setenv("TPUMEM_UVM_ACCESS_COUNTER_DECAY_MS", "30", 1);
+    setenv("TPUMEM_UVM_ACCESS_COUNTER_SWEEP_MS", "10", 1);
+
+    void *hot, *cold;
+    CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &hot) == TPU_OK);
+    CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &cold) == TPU_OK);
+    memset(hot, 0x11, UVM_BLOCK_SIZE);
+    memset(cold, 0x22, UVM_BLOCK_SIZE);
+    UvmLocation cxl = { UVM_TIER_CXL, 0 };
+    CHECK(uvmSetPreferredLocation(vs, hot, UVM_BLOCK_SIZE, cxl) == TPU_OK);
+    CHECK(uvmSetPreferredLocation(vs, cold, UVM_BLOCK_SIZE, cxl) == TPU_OK);
+
+    /* One access each: both land in the preferred CXL tier. */
+    CHECK(uvmDeviceAccess(vs, 0, hot, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmDeviceAccess(vs, 0, cold, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, hot, &info) == TPU_OK);
+    CHECK(info.residentCxl && !info.residentHbm);
+
+    /* Hammer the hot buffer: the counter threshold (4) promotes it to
+     * HBM with no migrate call. */
+    for (int i = 0; i < 8; i++)
+        CHECK(uvmDeviceAccess(vs, 0, hot, UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, hot, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+    CHECK(uvmResidencyInfo(vs, cold, &info) == TPU_OK);
+    CHECK(info.residentCxl && !info.residentHbm);   /* cold stayed */
+
+    /* Decay: stop touching the hot buffer; the sweeper demotes it from
+     * HBM back toward its preferred CXL tier.  Probe a mid-block page no
+     * CPU access has pulled host-side. */
+    void *probe = (char *)hot + UVM_BLOCK_SIZE / 2;
+    CHECK(uvmResidencyInfo(vs, probe, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+    for (int i = 0; i < 100; i++) {
+        struct timespec ts = { 0, 10 * 1000 * 1000 };
+        nanosleep(&ts, NULL);
+        if (uvmResidencyInfo(vs, probe, &info) == TPU_OK &&
+            !info.residentHbm)
+            break;
+    }
+    CHECK(!info.residentHbm && info.residentCxl);
+
+    /* Data integrity through promotion + demotion. */
+    CHECK(((volatile uint8_t *)hot)[999] == 0x11);
+    CHECK(((volatile uint8_t *)hot)[UVM_BLOCK_SIZE / 2 + 7] == 0x11);
+    CHECK(tpurmCounterGet("uvm_access_counter_promotions") >= 1);
+    CHECK(tpurmCounterGet("uvm_access_counter_demotions") >= 1);
+
+    unsetenv("TPUMEM_UVM_ACCESS_COUNTER_THRESHOLD");
+    unsetenv("TPUMEM_UVM_ACCESS_COUNTER_WINDOW_MS");
+    unsetenv("TPUMEM_UVM_ACCESS_COUNTER_DECAY_MS");
+    unsetenv("TPUMEM_UVM_ACCESS_COUNTER_SWEEP_MS");
+    CHECK(uvmMemFree(vs, hot) == TPU_OK);
+    CHECK(uvmMemFree(vs, cold) == TPU_OK);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -459,6 +527,8 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return vs ? test_accessed_by(vs) : TPU_ERR_INVALID_ARGUMENT;
     case UVM_TPU_TEST_TOOLS:
         return vs ? test_tools_control(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_ACCESS_COUNTERS:
+        return vs ? test_access_counters(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

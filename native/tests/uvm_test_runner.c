@@ -63,6 +63,7 @@ int main(void)
     run_module_test(fd, UVM_TPU_TEST_PMM_EVICTION, "pmm_eviction");
     run_module_test(fd, UVM_TPU_TEST_ACCESSED_BY, "accessed_by");
     run_module_test(fd, UVM_TPU_TEST_TOOLS, "tools_control");
+    run_module_test(fd, UVM_TPU_TEST_ACCESS_COUNTERS, "access_counters");
 
     /* ---- managed lifecycle over the raw ABI ---- */
     UvmTpuAllocManagedParams alloc = { .length = 8 << 20 };

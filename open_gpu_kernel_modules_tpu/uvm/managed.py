@@ -36,6 +36,7 @@ class EventType(enum.IntEnum):
     THRASHING = 4
     PREFETCH = 5
     READ_DUP = 6
+    ACCESS_COUNTER = 7
 
 
 class _Location(ctypes.Structure):

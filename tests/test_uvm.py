@@ -218,3 +218,44 @@ def test_tools_counters_and_threshold(vs):
 def test_module_accessed_by_and_tools(vs):
     vs.run_test(8)    # UVM_TPU_TEST_ACCESSED_BY
     vs.run_test(9)    # UVM_TPU_TEST_TOOLS
+
+
+def test_access_counters_hot_cold_convergence():
+    """Hot and cold working sets converge to the right tiers WITHOUT
+    explicit migrate calls (VERDICT r1 item 5; reference capability:
+    uvm_gpu_access_counters.c:81). Uses its own VaSpace + registry knobs
+    so the module-scoped fixture's timing isn't disturbed."""
+    import os
+    env = {"TPUMEM_UVM_ACCESS_COUNTER_THRESHOLD": "4",
+           "TPUMEM_UVM_ACCESS_COUNTER_WINDOW_MS": "10000"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        space = uvm.VaSpace()
+        hot = space.alloc(2 * MB)
+        cold = space.alloc(2 * MB)
+        hot.view()[:] = 1
+        cold.view()[:] = 2
+        hot.set_preferred(Tier.CXL)
+        cold.set_preferred(Tier.CXL)
+        with space.tools_session() as session:
+            session.enable([EventType.ACCESS_COUNTER])
+            # One access each lands both in the preferred CXL tier.
+            hot.device_access(dev=0, write=False)
+            cold.device_access(dev=0, write=False)
+            assert hot.residency().cxl and not hot.residency().hbm
+            # Hammering the hot buffer crosses the counter threshold and
+            # promotes it to HBM; the cold buffer stays in CXL.
+            for _ in range(8):
+                hot.device_access(dev=0, write=False)
+            assert hot.residency().hbm
+            assert cold.residency().cxl and not cold.residency().hbm
+            events = session.read()
+            assert any(e.type == EventType.ACCESS_COUNTER for e in events)
+        space.close()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
