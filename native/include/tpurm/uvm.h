@@ -296,6 +296,7 @@ typedef struct {
     uint32_t hbmDeviceInst;
     uint8_t cpuMapped;
     uint8_t devMapped;        /* accessed-by device mapping established */
+    uint8_t cancelled;        /* page detached by precise fault cancel */
     int32_t pinnedTier;       /* -1 if not pinned by thrashing mitigation */
 } UvmResidencyInfo;
 TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out);
@@ -325,7 +326,8 @@ typedef enum {
     UVM_EVENT_PREFETCH = 5,
     UVM_EVENT_READ_DUP = 6,
     UVM_EVENT_ACCESS_COUNTER = 7,
-    UVM_EVENT_COUNT = 8,
+    UVM_EVENT_FATAL_FAULT = 8,
+    UVM_EVENT_COUNT = 9,
 } UvmEventType;
 
 typedef struct {
@@ -373,6 +375,7 @@ enum {
     UVM_TPU_TEST_ACCESSED_BY          = 8,
     UVM_TPU_TEST_TOOLS                = 9,
     UVM_TPU_TEST_ACCESS_COUNTERS      = 10,
+    UVM_TPU_TEST_REPLAY_CANCEL        = 11,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

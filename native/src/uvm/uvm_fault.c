@@ -36,6 +36,7 @@
 #include <stdatomic.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <sys/syscall.h>
 #include <ucontext.h>
 #include <unistd.h>
@@ -95,6 +96,11 @@ static struct {
     pthread_t serviceThread;
     pid_t serviceTid;
     struct sigaction oldSegv;
+
+    /* ONCE replay policy: wakes deferred until the ring drains
+     * (service thread only). */
+    UvmFaultEntry *onceDeferred[FAULT_RING_SIZE];
+    uint32_t onceCount;
 
     /* Stats. */
     _Atomic uint64_t faultsCpu, faultsDevice, batches, migratedBytes,
@@ -457,6 +463,70 @@ static TpuStatus service_one(UvmFaultEntry *e)
     return st;
 }
 
+static void replay_wake(UvmFaultEntry *e, uint64_t nowNs)
+{
+    lat_record(nowNs - e->enqueueNs);
+    uint32_t doneVal = e->serviceStatus == TPU_OK ? 1 : 2;
+    __atomic_store_n(e->doneWord, doneVal, __ATOMIC_SEQ_CST);
+    futex_call(e->doneWord, FUTEX_WAKE, 1);
+}
+
+/* Fatal-fault cancellation (reference: cancel_faults_precise,
+ * uvm_gpu_replayable_faults.c:2690 — kill only the offending access,
+ * not the world).  Device faults are precise by construction: the error
+ * status returns to the uvmDeviceAccess caller alone.  CPU faults in
+ * precise mode (registry uvm_fault_cancel_mode=1, default) detach the
+ * faulting page onto an anonymous poison mapping: the offending access
+ * completes against poison (reads zeros / writes discarded from the
+ * managed image), the page is marked cancelled, and the process
+ * survives; the failure is observable via the FATAL_FAULT event, the
+ * uvm_fault_cancels counter, and residency introspection.  Mode 0
+ * (fatal) keeps the legacy behavior: the waiter re-faults with the
+ * default disposition and the process dies. */
+static void service_cancel(UvmFaultEntry *e)
+{
+    tpuCounterAdd("uvm_fault_cancels", 1);
+    UvmVaSpace *vs = e->vs;
+    uvmToolsEmit(vs, UVM_EVENT_FATAL_FAULT, UVM_TIER_COUNT, UVM_TIER_COUNT,
+                 e->devInst, e->addr, e->len ? e->len : 1);
+    tpuLog(TPU_LOG_ERROR, "uvm",
+           "fault cancel: addr=0x%llx src=%s status=%s",
+           (unsigned long long)e->addr,
+           e->source == UVM_FAULT_SRC_CPU ? "cpu" : "device",
+           tpuStatusToString(e->serviceStatus));
+    if (e->source != UVM_FAULT_SRC_CPU ||
+        tpuRegistryGet("uvm_fault_cancel_mode", 1) == 0)
+        return;
+
+    uint64_t ps = uvmPageSize();
+    uint64_t pageAddr = e->addr & ~(ps - 1);
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "cancel");
+    UvmVaBlock *blk = NULL;
+    UvmVaRange *range = uvmRangeFind(vs, pageAddr, &blk);
+    if (range && blk) {
+        pthread_mutex_lock(&blk->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "cancel");
+        void *m = mmap((void *)(uintptr_t)pageAddr, ps,
+                       PROT_READ | PROT_WRITE,
+                       MAP_FIXED | MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+        if (m != MAP_FAILED) {
+            uint32_t page = (uint32_t)((pageAddr - blk->start) / ps);
+            uvmPageMaskSet(&blk->cancelled, page);
+            blk->hasCancelled = true;
+            for (int t = 0; t < UVM_TIER_COUNT; t++)
+                uvmPageMaskClear(&blk->resident[t], page);
+            uvmPageMaskClear(&blk->cpuMapped, page);
+            uvmPageMaskClear(&blk->devMapped, page);
+            e->serviceStatus = TPU_OK;   /* waiter proceeds on poison */
+        }
+        tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "cancel");
+        pthread_mutex_unlock(&blk->lock);
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "cancel");
+    pthread_mutex_unlock(&vs->lock);
+}
+
 /* Decay sweep: demote counter-promoted blocks that went cold (service
  * thread only; same spacesLock -> vs lock order as snapshot rebuild). */
 static void access_counter_sweep(void)
@@ -507,6 +577,15 @@ static void *fault_service_thread(void *arg)
          * then drain opportunistically up to the batch bound.  Timeouts
          * run the access-counter decay sweep while idle. */
         if (!ring_wait_nonempty(sweepNs)) {
+            /* Idle: flush any ONCE-deferred wakes (covers transient
+             * pending-counter skew and a policy change away from ONCE)
+             * and run the decay sweep. */
+            if (g_fault.onceCount) {
+                uint64_t tn = uvmMonotonicNs();
+                for (uint32_t i = 0; i < g_fault.onceCount; i++)
+                    replay_wake(g_fault.onceDeferred[i], tn);
+                g_fault.onceCount = 0;
+            }
             access_counter_sweep();
             continue;
         }
@@ -546,16 +625,49 @@ static void *fault_service_thread(void *arg)
             }
         }
 
-        /* service_fault_batch (:2232). */
+        /* service_fault_batch (:2232).  Replay policy decides WHEN waiters
+         * wake (reference: 4 policies at uvm_gpu_replayable_faults.c:3053):
+         *   0 BLOCK       — wake each fault (and its coalesced dups) as
+         *                   soon as it is serviced (lowest latency),
+         *   1 BATCH       — wake after the whole batch (default),
+         *   2 BATCH_FLUSH — like BATCH, but a duplicate-heavy batch first
+         *                   drains newly-arrived entries (buffer flush)
+         *                   so the re-fault storm collapses into one pass,
+         *   3 ONCE        — defer wakes until the ring is fully drained. */
+        uint32_t policy =
+            (uint32_t)tpuRegistryGet("uvm_fault_replay_policy", 1);
+        uint32_t dups = 0;
         for (uint32_t i = 0; i < n; i++) {
             UvmFaultEntry *e = batch[i];
-            if (!e || dupOf[i] >= 0)
+            if (!e)
                 continue;
+            if (dupOf[i] >= 0) {
+                dups++;
+                continue;
+            }
             e->serviceStatus = service_one(e);
+            if (e->serviceStatus != TPU_OK)
+                service_cancel(e);
             if (e->source == UVM_FAULT_SRC_CPU)
                 atomic_fetch_add(&g_fault.faultsCpu, 1);
             else
                 atomic_fetch_add(&g_fault.faultsDevice, 1);
+            if (policy == 0) {
+                /* BLOCK: replay this fault + its dups immediately.  The
+                 * primary's entry lives on the waiter's stack and dies
+                 * the moment it wakes — propagate status to dups FIRST,
+                 * wake the primary LAST. */
+                uint64_t tb = uvmMonotonicNs();
+                for (uint32_t j = i + 1; j < n; j++) {
+                    if (batch[j] && dupOf[j] == (int32_t)i) {
+                        batch[j]->serviceStatus = e->serviceStatus;
+                        replay_wake(batch[j], tb);
+                        batch[j] = NULL;
+                    }
+                }
+                replay_wake(e, tb);
+                batch[i] = NULL;
+            }
         }
         /* Duplicates inherit their primary's outcome — including failure,
          * so a failed service propagates to every coalesced waiter. */
@@ -563,17 +675,72 @@ static void *fault_service_thread(void *arg)
             if (batch[i] && dupOf[i] >= 0)
                 batch[i]->serviceStatus = batch[dupOf[i]]->serviceStatus;
         }
-        uint64_t t1 = uvmMonotonicNs();
 
-        /* replay (:2986): wake every parked waiter. */
-        for (uint32_t i = 0; i < n; i++) {
-            UvmFaultEntry *e = batch[i];
-            if (!e)
-                continue;
-            lat_record(t1 - e->enqueueNs);
-            uint32_t doneVal = e->serviceStatus == TPU_OK ? 1 : 2;
-            __atomic_store_n(e->doneWord, doneVal, __ATOMIC_SEQ_CST);
-            futex_call(e->doneWord, FUTEX_WAKE, 1);
+        /* BATCH_FLUSH: a duplicate-heavy batch signals a re-fault storm;
+         * drain and service what arrived meanwhile before replaying. */
+        if (policy == 2 && n > 0 &&
+            dups * 100 >= n * tpuRegistryGet("uvm_fault_flush_ratio", 50)) {
+            UvmFaultEntry *extra;
+            while (n < maxBatch && (extra = ring_pop()) != NULL) {
+                /* The storm re-faults the just-serviced pages: inherit a
+                 * serviced primary's outcome instead of a second full
+                 * service pass (the reference's flush replays storms as
+                 * duplicates). */
+                bool inherited = false;
+                for (uint32_t j = 0; j < n; j++) {
+                    UvmFaultEntry *f = batch[j];
+                    if (f && dupOf[j] < 0 && f->vs == extra->vs &&
+                        f->source == extra->source &&
+                        f->devInst == extra->devInst &&
+                        (extra->addr & ~(ps - 1)) == (f->addr & ~(ps - 1)) &&
+                        extra->len <= ps && f->len <= ps &&
+                        (!extra->isWrite || f->isWrite)) {
+                        extra->serviceStatus = f->serviceStatus;
+                        inherited = true;
+                        break;
+                    }
+                }
+                if (!inherited) {
+                    extra->serviceStatus = service_one(extra);
+                    if (extra->serviceStatus != TPU_OK)
+                        service_cancel(extra);
+                }
+                if (extra->source == UVM_FAULT_SRC_CPU)
+                    atomic_fetch_add(&g_fault.faultsCpu, 1);
+                else
+                    atomic_fetch_add(&g_fault.faultsDevice, 1);
+                batch[n++] = extra;
+                tpuCounterAdd("uvm_fault_flush_serviced", 1);
+            }
+        }
+
+        uint64_t t1 = uvmMonotonicNs();
+        if (policy == 3) {
+            /* ONCE: stash wakes until the ring drains (one replay for the
+             * whole storm).  The deferred set is bounded by the ring. */
+            for (uint32_t i = 0; i < n; i++) {
+                if (!batch[i])
+                    continue;
+                if (g_fault.onceCount < FAULT_RING_SIZE)
+                    g_fault.onceDeferred[g_fault.onceCount++] = batch[i];
+                else
+                    replay_wake(batch[i], t1);   /* overflow: wake now */
+            }
+            if (__atomic_load_n(&g_fault.pending, __ATOMIC_SEQ_CST) == 0) {
+                for (uint32_t i = 0; i < g_fault.onceCount; i++)
+                    replay_wake(g_fault.onceDeferred[i], t1);
+                g_fault.onceCount = 0;
+            }
+        } else {
+            /* Policy moved off ONCE with wakes still deferred: flush. */
+            for (uint32_t i = 0; i < g_fault.onceCount; i++)
+                replay_wake(g_fault.onceDeferred[i], t1);
+            g_fault.onceCount = 0;
+            /* replay (:2986): wake every parked waiter. */
+            for (uint32_t i = 0; i < n; i++) {
+                if (batch[i])
+                    replay_wake(batch[i], t1);
+            }
         }
         atomic_fetch_add(&g_fault.batches, 1);
         tpuCounterAdd("uvm_fault_batches", 1);

@@ -191,6 +191,10 @@ typedef struct UvmVaBlock {
     uint64_t acWindowStartNs;
     uint32_t acCount;
     bool acPromoted;
+    /* Precisely-cancelled pages (fatal-fault cancel): user VA detached
+     * onto a poison mapping; excluded from residency/migration. */
+    UvmPageMask cancelled;
+    bool hasCancelled;
 } UvmVaBlock;
 
 typedef enum {

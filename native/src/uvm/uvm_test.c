@@ -504,6 +504,64 @@ static TpuStatus test_access_counters(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* --------------------------------------------- replay policies + cancel */
+
+static TpuStatus test_replay_cancel(UvmVaSpace *vs)
+{
+    /* All four replay policies service faults correctly (reference:
+     * uvm_gpu_replayable_faults.c:3053 BLOCK/BATCH/BATCH_FLUSH/ONCE). */
+    static const char *policies[] = { "0", "1", "2", "3" };
+    for (int pi = 0; pi < 4; pi++) {
+        setenv("TPUMEM_UVM_FAULT_REPLAY_POLICY", policies[pi], 1);
+        void *p;
+        CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &p) == TPU_OK);
+        volatile uint8_t *b = p;
+        b[0] = (uint8_t)(0x50 + pi);              /* CPU write fault */
+        UvmLocation hbm = { UVM_TIER_HBM, 0 };
+        CHECK(uvmMigrate(vs, p, UVM_BLOCK_SIZE, hbm, 0) == TPU_OK);
+        CHECK(b[0] == (uint8_t)(0x50 + pi));      /* CPU read fault */
+        CHECK(uvmMemFree(vs, p) == TPU_OK);
+    }
+    unsetenv("TPUMEM_UVM_FAULT_REPLAY_POLICY");
+
+    /* Precise fatal-fault cancel (reference :2690): a CPU fault whose
+     * service fails (injected CE error under it) is cancelled precisely —
+     * the faulting access detaches onto a poison page and the process
+     * SURVIVES; the failure is observable via counter + residency. */
+    uint64_t cancelsBefore = tpurmCounterGet("uvm_fault_cancels");
+    void *p;
+    CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &p) == TPU_OK);
+    memset(p, 0x6D, UVM_BLOCK_SIZE);
+    UvmLocation hbm = { UVM_TIER_HBM, 0 };
+    CHECK(uvmMigrate(vs, p, UVM_BLOCK_SIZE, hbm, 0) == TPU_OK);
+
+    /* Injected CE error makes the copy-back fail while the CPU read is
+     * being serviced. */
+    TpurmDevice *dev = tpurmDeviceGet(0);
+    CHECK(dev != NULL);
+    tpurmChannelInjectError(dev->ce);
+    volatile uint8_t *b = p;
+    uint8_t got = b[3];                    /* survives via poison page */
+    (void)got;
+    tpurmChannelResetError(dev->ce);
+
+    CHECK(tpurmCounterGet("uvm_fault_cancels") > cancelsBefore);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.cancelled);
+    /* The poison page stays writable; the rest of the block still works
+     * through the normal engine. */
+    b[5] = 0x77;
+    CHECK(b[5] == 0x77);
+    volatile uint8_t *other = (volatile uint8_t *)p + UVM_BLOCK_SIZE / 2;
+    CHECK(*other == 0x6D);                 /* normal fault path intact */
+    CHECK(uvmResidencyInfo(vs, (void *)other, &info) == TPU_OK);
+    CHECK(!info.cancelled);
+
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -529,6 +587,8 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return vs ? test_tools_control(vs) : TPU_ERR_INVALID_ARGUMENT;
     case UVM_TPU_TEST_ACCESS_COUNTERS:
         return vs ? test_access_counters(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_REPLAY_CANCEL:
+        return vs ? test_replay_cancel(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

@@ -149,10 +149,33 @@ void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
                           uint32_t count, int prot)
 {
     uint64_t ps = uvmPageSize();
-    void *addr = (char *)(uintptr_t)blk->start + (uint64_t)firstPage * ps;
-    if (mprotect(addr, (uint64_t)count * ps, prot) != 0)
-        tpuLog(TPU_LOG_ERROR, "uvm", "mprotect(%p, %u pages, %d) failed",
-               addr, count, prot);
+    if (!blk->hasCancelled) {
+        void *addr = (char *)(uintptr_t)blk->start +
+                     (uint64_t)firstPage * ps;
+        if (mprotect(addr, (uint64_t)count * ps, prot) != 0)
+            tpuLog(TPU_LOG_ERROR, "uvm", "mprotect(%p, %u pages, %d) failed",
+                   addr, count, prot);
+    } else {
+        /* Cancelled pages sit on poison mappings that must stay RW;
+         * mprotect around them per contiguous non-cancelled span. */
+        uint32_t p = firstPage;
+        while (p < firstPage + count) {
+            if (uvmPageMaskTest(&blk->cancelled, p)) {
+                p++;
+                continue;
+            }
+            uint32_t span = 1;
+            while (p + span < firstPage + count &&
+                   !uvmPageMaskTest(&blk->cancelled, p + span))
+                span++;
+            void *addr = (char *)(uintptr_t)blk->start + (uint64_t)p * ps;
+            if (mprotect(addr, (uint64_t)span * ps, prot) != 0)
+                tpuLog(TPU_LOG_ERROR, "uvm",
+                       "mprotect(%p, %u pages, %d) failed", addr, span,
+                       prot);
+            p += span;
+        }
+    }
     /* cpuMapped tracks full RW PTEs; read-only and none both fault writes. */
     if (!(prot & PROT_WRITE))
         uvmPageMaskClearRange(&blk->cpuMapped, firstPage, count);
@@ -172,6 +195,21 @@ static bool block_striper_init(TpuCeStriper *s, UvmVaBlock *blk)
     if (s->stripe < uvmPageSize())
         s->stripe = uvmPageSize();
     return true;
+}
+
+/* cpuMapped tracks live managed RW PTEs; cancelled pages sit on poison
+ * mappings and are excluded (invariant: cpuMapped implies resident[HOST]
+ * candidacy, never a cancelled page). */
+static void block_set_cpu_mapped(UvmVaBlock *blk, uint32_t first,
+                                 uint32_t count)
+{
+    if (!blk->hasCancelled) {
+        uvmPageMaskSetRange(&blk->cpuMapped, first, count);
+        return;
+    }
+    for (uint32_t p = first; p < first + count; p++)
+        if (!uvmPageMaskTest(&blk->cancelled, p))
+            uvmPageMaskSet(&blk->cpuMapped, p);
 }
 
 /* Pick the copy source tier for a page: HBM > CXL > HOST (device copies
@@ -500,7 +538,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         uvmPageMaskZero(&needed);
         uint32_t nneeded = 0;
         for (uint32_t p = firstPage; p < firstPage + count; p++) {
-            if (!uvmPageMaskTest(&blk->resident[dst.tier], p)) {
+            if (!uvmPageMaskTest(&blk->resident[dst.tier], p) &&
+                !uvmPageMaskTest(&blk->cancelled, p)) {
                 uvmPageMaskSet(&needed, p);
                 nneeded++;
             }
@@ -579,7 +618,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             } else {
                 uvmBlockSetCpuAccess(blk, firstPage, count,
                                      PROT_READ | PROT_WRITE);
-                uvmPageMaskSetRange(&blk->cpuMapped, firstPage, count);
+                block_set_cpu_mapped(blk, firstPage, count);
                 block_gc_runs(blk, UVM_TIER_HBM);
                 block_gc_runs(blk, UVM_TIER_CXL);
             }
@@ -623,7 +662,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             /* Now-exclusive host pages regain full RW mapping. */
             uvmBlockSetCpuAccess(blk, firstPage, count,
                                  PROT_READ | PROT_WRITE);
-            uvmPageMaskSetRange(&blk->cpuMapped, firstPage, count);
+            block_set_cpu_mapped(blk, firstPage, count);
         }
         block_gc_runs(blk, UVM_TIER_HBM);
         block_gc_runs(blk, UVM_TIER_CXL);

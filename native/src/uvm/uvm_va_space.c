@@ -537,6 +537,7 @@ TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out)
     out->hbmDeviceInst = blk->hbmDevInst;
     out->cpuMapped = uvmPageMaskTest(&blk->cpuMapped, page);
     out->devMapped = uvmPageMaskTest(&blk->devMapped, page);
+    out->cancelled = uvmPageMaskTest(&blk->cancelled, page);
     out->pinnedTier = blk->pinnedTier;
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
     pthread_mutex_unlock(&blk->lock);

@@ -37,6 +37,7 @@ class EventType(enum.IntEnum):
     PREFETCH = 5
     READ_DUP = 6
     ACCESS_COUNTER = 7
+    FATAL_FAULT = 8
 
 
 class _Location(ctypes.Structure):
@@ -51,6 +52,7 @@ class _ResidencyInfo(ctypes.Structure):
         ("hbmDeviceInst", ctypes.c_uint32),
         ("cpuMapped", ctypes.c_uint8),
         ("devMapped", ctypes.c_uint8),
+        ("cancelled", ctypes.c_uint8),
         ("pinnedTier", ctypes.c_int32),
     ]
 
@@ -88,6 +90,7 @@ class ResidencyInfo:
     cpu_mapped: bool
     pinned_tier: Optional[Tier]
     dev_mapped: bool = False
+    cancelled: bool = False
 
 
 @dataclass(frozen=True)
@@ -351,7 +354,7 @@ class ManagedBuffer:
                              bool(raw.residentCxl), raw.hbmDeviceInst,
                              bool(raw.cpuMapped),
                              _tier_or_none(raw.pinnedTier),
-                             bool(raw.devMapped))
+                             bool(raw.devMapped), bool(raw.cancelled))
 
     def free(self) -> None:
         if self.address:

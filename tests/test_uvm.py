@@ -259,3 +259,7 @@ def test_access_counters_hot_cold_convergence():
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def test_module_replay_policies_and_cancel(vs):
+    vs.run_test(11)   # UVM_TPU_TEST_REPLAY_CANCEL
