@@ -314,6 +314,18 @@ typedef struct {
 } UvmFaultStats;
 void uvmFaultStatsGet(UvmFaultStats *out);
 
+/* -------------------------------------------------------- suspend/resume */
+
+/* Global PM quiesce + device-arena save/restore (reference: fbsr.c FB
+ * save + uvm_suspend's global PM lock, uvm_lock.h:43-49).  uvmSuspend
+ * blocks every entry point (alloc/free/migrate/device-access), drains
+ * the fault ring, and saves all HBM/CXL residency to host — after it
+ * returns the arenas hold no live data.  uvmResume restores the saved
+ * spans (eagerly by default; registry uvm_resume_restore=0 for lazy
+ * first-fault restore) and reopens the gate. */
+TpuStatus uvmSuspend(void);
+TpuStatus uvmResume(void);
+
 /* ------------------------------------------------------------- tools API */
 
 /* Event record (reference: UvmEventEntry, uvm_tools.c mmap'd queues). */
@@ -376,6 +388,7 @@ enum {
     UVM_TPU_TEST_TOOLS                = 9,
     UVM_TPU_TEST_ACCESS_COUNTERS      = 10,
     UVM_TPU_TEST_REPLAY_CANCEL        = 11,
+    UVM_TPU_TEST_SUSPEND_RESUME       = 12,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

@@ -32,6 +32,7 @@
 
 #include <errno.h>
 #include <linux/futex.h>
+#include <sched.h>
 #include <signal.h>
 #include <stdatomic.h>
 #include <stdlib.h>
@@ -101,6 +102,9 @@ static struct {
      * (service thread only). */
     UvmFaultEntry *onceDeferred[FAULT_RING_SIZE];
     uint32_t onceCount;
+
+    /* True while a batch is being serviced (PM drain barrier). */
+    _Atomic bool servicing;
 
     /* Stats. */
     _Atomic uint64_t faultsCpu, faultsDevice, batches, migratedBytes,
@@ -580,6 +584,7 @@ static void *fault_service_thread(void *arg)
             /* Idle: flush any ONCE-deferred wakes (covers transient
              * pending-counter skew and a policy change away from ONCE)
              * and run the decay sweep. */
+            atomic_store(&g_fault.servicing, false);
             if (g_fault.onceCount) {
                 uint64_t tn = uvmMonotonicNs();
                 for (uint32_t i = 0; i < g_fault.onceCount; i++)
@@ -589,6 +594,7 @@ static void *fault_service_thread(void *arg)
             access_counter_sweep();
             continue;
         }
+        atomic_store(&g_fault.servicing, true);
         uint32_t n = 0;
         while (n < maxBatch) {
             UvmFaultEntry *e = ring_pop();
@@ -744,9 +750,49 @@ static void *fault_service_thread(void *arg)
         }
         atomic_fetch_add(&g_fault.batches, 1);
         tpuCounterAdd("uvm_fault_batches", 1);
+        atomic_store(&g_fault.servicing, false);
         access_counter_sweep();
     }
     return NULL;
+}
+
+/* PM drain barrier: returns once everything enqueued before the call has
+ * been serviced (the ring observed empty with no batch in flight).  New
+ * CPU faults may arrive afterwards; while suspended they service to the
+ * HOST tier only, which is safe with frozen device arenas. */
+void uvmFaultRingDrain(void)
+{
+    if (!g_fault.ready)
+        return;
+    for (;;) {
+        bool busy = atomic_load(&g_fault.servicing);
+        uint32_t p = __atomic_load_n(&g_fault.pending, __ATOMIC_SEQ_CST);
+        if (!busy && p == 0)
+            return;
+        sched_yield();
+    }
+}
+
+/* Iterate every block of every registered space (spacesLock -> vs lock,
+ * the snapshot-rebuild order) calling fn(vs, blk). */
+void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk))
+{
+    pthread_mutex_lock(&g_fault.spacesLock);
+    for (UvmVaSpace *vs = g_fault.spacesHead; vs; vs = vs->nextSpace) {
+        pthread_mutex_lock(&vs->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "pm-iter");
+        for (UvmRangeTreeNode *n = vs->ranges.first; n;
+             n = uvmRangeTreeNext(n)) {
+            UvmVaRange *r = (UvmVaRange *)n;
+            for (uint32_t b = 0; b < r->blockCount; b++) {
+                if (r->blocks[b])
+                    fn(vs, r->blocks[b]);
+            }
+        }
+        tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "pm-iter");
+        pthread_mutex_unlock(&vs->lock);
+    }
+    pthread_mutex_unlock(&g_fault.spacesLock);
 }
 
 /* ------------------------------------------------------- SIGSEGV handler */
@@ -893,5 +939,10 @@ TpuStatus uvmDeviceAccess(UvmVaSpace *vs, uint32_t devInst, void *base,
         .devInst = devInst,
         .vs = vs,
     };
-    return uvmFaultServiceSync(&e);
+    /* PM gate: device accesses block while suspended (uvm_lock.h:43-49
+     * global power management lock, shared side). */
+    uvmPmEnterShared();
+    TpuStatus st = uvmFaultServiceSync(&e);
+    uvmPmExitShared();
+    return st;
 }

@@ -342,6 +342,13 @@ void uvmFaultSnapshotRebuild(void);
 TpuStatus uvmFaultServiceSync(UvmFaultEntry *e);
 void uvmFaultStatsRecordMigration(uint64_t bytes);
 void uvmFaultStatsRecordEviction(void);
+/* PM drain barrier + space/block iteration (uvm_pm.c consumers). */
+void uvmFaultRingDrain(void);
+void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk));
+/* Global PM gate (reference: uvm_lock.h:43-49).  Entry points enter the
+ * shared side; uvmSuspend holds it exclusively until uvmResume. */
+void uvmPmEnterShared(void);
+void uvmPmExitShared(void);
 
 /* ----------------------------------------------------------- perf hooks */
 

@@ -28,6 +28,9 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
     uint64_t start = (uintptr_t)base & ~(ps - 1);
     uint64_t end = ((uintptr_t)base + len - 1) | (ps - 1);
 
+    /* PM gate (shared): migrations block while suspended
+     * (uvm_lock.h:43-49 global power management lock). */
+    uvmPmEnterShared();
     pthread_mutex_lock(&vs->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "vaspace");
 
@@ -35,6 +38,7 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
     if (!n) {
         tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
         pthread_mutex_unlock(&vs->lock);
+        uvmPmExitShared();
         return TPU_ERR_OBJECT_NOT_FOUND;
     }
 
@@ -73,6 +77,7 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
 
     tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
     pthread_mutex_unlock(&vs->lock);
+    uvmPmExitShared();
     tpuCounterAdd("uvm_migrate_calls", 1);
     return st;
 }

@@ -1,0 +1,166 @@
+/*
+ * Power management — suspend/resume with device-arena save/restore.
+ *
+ * Re-design of the reference's checkpoint/resume capability (SURVEY.md
+ * §5): system sleep saves framebuffer contents to sysmem and restores
+ * them on wake (src/nvidia/src/kernel/gpu/mem_mgr/fbsr.c), while UVM
+ * quiesces every entry point behind a global PM lock
+ * (kernel-open/nvidia-uvm/uvm_lock.h:43-49 uvm_suspend).
+ *
+ * tpurm shape:
+ *   uvmSuspend():
+ *     1. take the PM gate exclusively — uvmMemAlloc/Free, uvmMigrate and
+ *        uvmDeviceAccess enter through the shared side, so in-flight
+ *        operations drain and new ones block,
+ *     2. wait for the fault ring to drain (the service thread keeps
+ *        running: CPU faults target HOST only and are safe while device
+ *        arenas are frozen),
+ *     3. save: record each block's device-side residency (tier + device)
+ *        and evict it to host — the exact make_resident machinery the
+ *        migration engine uses (SURVEY.md §5: "HBM save/restore == the
+ *        same migration machinery pointed at host").
+ *   uvmResume():
+ *     4. restore: re-make-resident each saved block span on its original
+ *        tier (registry uvm_resume_restore=0 keeps restore lazy — the
+ *        first fault brings pages back),
+ *     5. release the gate.
+ *
+ * After suspend returns, the HBM/CXL arenas hold no live data: the test
+ * scrambles them wholesale and resume must still verify (fbsr semantics).
+ */
+#include "uvm_internal.h"
+
+#include <sched.h>
+#include <stdlib.h>
+
+static pthread_rwlock_t g_pmLock = PTHREAD_RWLOCK_INITIALIZER;
+static bool g_suspended;          /* under g_pmLock write side */
+
+void uvmPmEnterShared(void)
+{
+    pthread_rwlock_rdlock(&g_pmLock);
+}
+
+void uvmPmExitShared(void)
+{
+    pthread_rwlock_unlock(&g_pmLock);
+}
+
+/* Saved-residency record, one per block span that was device-resident. */
+typedef struct PmSaved {
+    UvmVaSpace *vs;
+    UvmVaBlock *blk;
+    UvmTier tier;
+    uint32_t devInst;
+    uint32_t firstPage, count;
+    struct PmSaved *next;
+} PmSaved;
+
+static PmSaved *g_saved;          /* valid only while suspended */
+
+static void pm_save_block(UvmVaSpace *vs, UvmVaBlock *blk)
+{
+    /* Record contiguous device-resident runs, then evict to host. */
+    static const UvmTier tiers[] = { UVM_TIER_HBM, UVM_TIER_CXL };
+    for (int t = 0; t < 2; t++) {
+        UvmTier tier = tiers[t];
+        uint32_t p = 0;
+        while (p < blk->npages) {
+            if (!uvmPageMaskTest(&blk->resident[tier], p)) {
+                p++;
+                continue;
+            }
+            uint32_t span = 1;
+            while (p + span < blk->npages &&
+                   uvmPageMaskTest(&blk->resident[tier], p + span))
+                span++;
+            PmSaved *s = malloc(sizeof(*s));
+            if (s) {
+                s->vs = vs;
+                s->blk = blk;
+                s->tier = tier;
+                s->devInst = tier == UVM_TIER_HBM ? blk->hbmDevInst : 0;
+                s->firstPage = p;
+                s->count = span;
+                s->next = g_saved;
+                g_saved = s;
+            }
+            p += span;
+        }
+        UvmTierArena *arena = tier == UVM_TIER_HBM
+                                  ? uvmTierArenaHbm(blk->hbmDevInst)
+                                  : uvmTierArenaCxl();
+        if (arena &&
+            !uvmPageMaskEmpty(&blk->resident[tier], blk->npages)) {
+            /* Retry contended blocks: save must be complete. */
+            TpuStatus st = TPU_ERR_STATE_IN_USE;
+            for (int i = 0; i < 256 && st == TPU_ERR_STATE_IN_USE; i++) {
+                st = uvmBlockEvictFrom(blk, arena);
+                if (st == TPU_ERR_STATE_IN_USE)
+                    sched_yield();
+            }
+            if (st != TPU_OK)
+                tpuLog(TPU_LOG_ERROR, "uvm_pm",
+                       "suspend: block 0x%llx tier %d save failed: %s",
+                       (unsigned long long)blk->start, tier,
+                       tpuStatusToString(st));
+        }
+    }
+}
+
+TpuStatus uvmSuspend(void)
+{
+    /* 1. Exclusive gate: waits for in-flight entry points to drain. */
+    pthread_rwlock_wrlock(&g_pmLock);
+    if (g_suspended) {
+        pthread_rwlock_unlock(&g_pmLock);
+        return TPU_ERR_INVALID_STATE;
+    }
+    g_suspended = true;
+
+    /* 2. Drain the fault ring (CPU faults may still trickle in; the
+     * service thread keeps consuming them — wait for quiescence). */
+    uvmFaultRingDrain();
+
+    /* 3. Save device-side residency to host. */
+    uvmFaultForEachSpace(pm_save_block);
+
+    tpuCounterAdd("uvm_suspends", 1);
+    tpuLog(TPU_LOG_INFO, "uvm_pm", "suspended (arenas saved to host)");
+    /* Gate stays held (write side) until uvmResume. */
+    return TPU_OK;
+}
+
+TpuStatus uvmResume(void)
+{
+    if (!g_suspended)
+        return TPU_ERR_INVALID_STATE;
+
+    /* 4. Restore saved spans via make_resident (eager fbsr-style restore;
+     * registry uvm_resume_restore=0 leaves it to first-fault). */
+    bool eager = tpuRegistryGet("uvm_resume_restore", 1) != 0;
+    PmSaved *s = g_saved;
+    g_saved = NULL;
+    while (s) {
+        PmSaved *next = s->next;
+        if (eager) {
+            UvmLocation dst = { s->tier, s->devInst };
+            TpuStatus st = uvmBlockMakeResident(s->blk, dst, s->firstPage,
+                                                s->count, false);
+            if (st != TPU_OK)
+                tpuLog(TPU_LOG_WARN, "uvm_pm",
+                       "resume: restore 0x%llx +%u failed: %s (lazy fault "
+                       "will recover)",
+                       (unsigned long long)s->blk->start, s->count,
+                       tpuStatusToString(st));
+        }
+        free(s);
+        s = next;
+    }
+
+    g_suspended = false;
+    tpuCounterAdd("uvm_resumes", 1);
+    tpuLog(TPU_LOG_INFO, "uvm_pm", "resumed");
+    pthread_rwlock_unlock(&g_pmLock);
+    return TPU_OK;
+}

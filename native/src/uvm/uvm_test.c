@@ -8,6 +8,8 @@
  */
 #include "uvm_internal.h"
 
+#include <pthread.h>
+#include <stdatomic.h>
 #include <stdlib.h>
 #include <string.h>
 #include <time.h>
@@ -562,6 +564,88 @@ static TpuStatus test_replay_cancel(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* ------------------------------------------------------ suspend/resume */
+
+struct pm_gate_arg {
+    UvmVaSpace *vs;
+    void *ptr;
+    TpuStatus st;
+    _Atomic int done;
+};
+
+static void *pm_gate_thread(void *argp)
+{
+    struct pm_gate_arg *a = argp;
+    UvmLocation hbm = { UVM_TIER_HBM, 0 };
+    a->st = uvmMigrate(a->vs, a->ptr, UVM_BLOCK_SIZE, hbm, 0);
+    atomic_store(&a->done, 1);
+    return NULL;
+}
+
+static TpuStatus test_suspend_resume(UvmVaSpace *vs)
+{
+    /* populate -> suspend -> scramble arenas -> resume -> verify
+     * (reference: fbsr.c FB save/restore + uvm_suspend quiesce). */
+    void *a, *b;
+    CHECK(uvmMemAlloc(vs, 2 * UVM_BLOCK_SIZE, &a) == TPU_OK);
+    CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &b) == TPU_OK);
+    memset(a, 0x5A, 2 * UVM_BLOCK_SIZE);
+    memset(b, 0xA5, UVM_BLOCK_SIZE);
+    UvmLocation hbm = { UVM_TIER_HBM, 0 };
+    UvmLocation cxl = { UVM_TIER_CXL, 0 };
+    CHECK(uvmMigrate(vs, a, 2 * UVM_BLOCK_SIZE, hbm, 0) == TPU_OK);
+    CHECK(uvmMigrate(vs, b, UVM_BLOCK_SIZE, cxl, 0) == TPU_OK);
+
+    CHECK(uvmSuspend() == TPU_OK);
+
+    /* All device-side residency was saved home. */
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, a, &info) == TPU_OK);
+    CHECK(info.residentHost && !info.residentHbm);
+    CHECK(uvmResidencyInfo(vs, b, &info) == TPU_OK);
+    CHECK(info.residentHost && !info.residentCxl);
+
+    /* Entry points are gated: a migrate from another thread must block
+     * until resume. */
+    struct pm_gate_arg ga = { vs, a, TPU_OK, 0 };
+    pthread_t th;
+    CHECK(pthread_create(&th, NULL, pm_gate_thread, &ga) == 0);
+    struct timespec ts = { 0, 50 * 1000 * 1000 };
+    nanosleep(&ts, NULL);
+    CHECK(atomic_load(&ga.done) == 0);      /* still blocked */
+
+    /* Scramble both arenas wholesale — the power-loss analog. */
+    TpurmDevice *dev = tpurmDeviceGet(0);
+    CHECK(dev != NULL);
+    memset(tpurmDeviceHbmBase(dev), 0xFF, tpurmDeviceHbmSize(dev));
+    UvmTierArena *cx = uvmTierArenaCxl();
+    if (cx)
+        memset(cx->base, 0xEE, cx->size);
+
+    CHECK(uvmResume() == TPU_OK);
+    pthread_join(th, NULL);
+    CHECK(atomic_load(&ga.done) == 1 && ga.st == TPU_OK);
+
+    /* Eager restore put the spans back on their original tiers. */
+    CHECK(uvmResidencyInfo(vs, b, &info) == TPU_OK);
+    CHECK(info.residentCxl);
+    CHECK(uvmResidencyInfo(vs, a, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+
+    /* Data survives the scramble (verify faults it back page by page). */
+    volatile uint8_t *pa = a, *pb = b;
+    CHECK(pa[123] == 0x5A);
+    CHECK(pa[UVM_BLOCK_SIZE + 4567] == 0x5A);
+    CHECK(pb[789] == 0xA5);
+
+    /* Resume without suspend is rejected. */
+    CHECK(uvmResume() == TPU_ERR_INVALID_STATE);
+
+    CHECK(uvmMemFree(vs, a) == TPU_OK);
+    CHECK(uvmMemFree(vs, b) == TPU_OK);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -589,6 +673,8 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return vs ? test_access_counters(vs) : TPU_ERR_INVALID_ARGUMENT;
     case UVM_TPU_TEST_REPLAY_CANCEL:
         return vs ? test_replay_cancel(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_SUSPEND_RESUME:
+        return vs ? test_suspend_resume(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

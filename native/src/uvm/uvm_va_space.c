@@ -158,10 +158,23 @@ TpuStatus uvmUnregisterDevice(UvmVaSpace *vs, uint32_t devInst)
     return st;
 }
 
+static TpuStatus mem_alloc_gated(UvmVaSpace *vs, uint64_t size,
+                                 void **outPtr);
+
 TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
 {
     if (!vs || !outPtr || size == 0)
         return TPU_ERR_INVALID_ARGUMENT;
+    /* PM gate (shared): allocations block while suspended. */
+    uvmPmEnterShared();
+    TpuStatus pmSt = mem_alloc_gated(vs, size, outPtr);
+    uvmPmExitShared();
+    return pmSt;
+}
+
+static TpuStatus mem_alloc_gated(UvmVaSpace *vs, uint64_t size,
+                                 void **outPtr)
+{
     uint64_t ps = uvmPageSize();
     size = (size + ps - 1) & ~(ps - 1);
 
@@ -275,7 +288,19 @@ TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
     return TPU_OK;
 }
 
+static TpuStatus mem_free_gated(UvmVaSpace *vs, void *ptr);
+
 TpuStatus uvmMemFree(UvmVaSpace *vs, void *ptr)
+{
+    /* PM gate (shared): frees block while suspended (saved-residency
+     * records must not dangle). */
+    uvmPmEnterShared();
+    TpuStatus pmSt = mem_free_gated(vs, ptr);
+    uvmPmExitShared();
+    return pmSt;
+}
+
+static TpuStatus mem_free_gated(UvmVaSpace *vs, void *ptr)
 {
     if (!vs || !ptr)
         return TPU_ERR_INVALID_ARGUMENT;

@@ -22,4 +22,6 @@ from .managed import (  # noqa: F401
     Event,
     EventType,
     fault_stats,
+    suspend,
+    resume,
 )

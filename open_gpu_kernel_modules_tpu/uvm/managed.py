@@ -182,6 +182,10 @@ def _lib() -> ctypes.CDLL:
     lib.uvmToolsReadEvents.argtypes = [vp, ctypes.POINTER(_Event),
                                        ctypes.c_size_t]
     lib.uvmToolsReadEvents.restype = ctypes.c_size_t
+    lib.uvmSuspend.argtypes = []
+    lib.uvmSuspend.restype = u32
+    lib.uvmResume.argtypes = []
+    lib.uvmResume.restype = u32
 
     _bound = lib
     return lib
@@ -194,6 +198,16 @@ def _check(status: int, what: str) -> None:
 
 def _tier_or_none(value: int) -> Optional[Tier]:
     return Tier(value) if 0 <= value < len(Tier) else None
+
+
+def suspend() -> None:
+    """Global PM quiesce + arena save-to-host (uvm.h uvmSuspend)."""
+    _check(_lib().uvmSuspend(), "uvmSuspend")
+
+
+def resume() -> None:
+    """Restore saved residency and reopen the PM gate."""
+    _check(_lib().uvmResume(), "uvmResume")
 
 
 def fault_stats() -> FaultStats:

@@ -263,3 +263,28 @@ def test_access_counters_hot_cold_convergence():
 
 def test_module_replay_policies_and_cancel(vs):
     vs.run_test(11)   # UVM_TPU_TEST_REPLAY_CANCEL
+
+
+def test_suspend_resume_saves_and_restores(vs):
+    """PM quiesce + arena save/restore (VERDICT r1 item 10; reference:
+    fbsr.c + uvm_suspend). Native populate->suspend->scramble->resume->
+    verify runs via the module test; here the Python surface round-trips
+    and residency reflects the save."""
+    buf = vs.alloc(2 * MB)
+    buf.view()[:] = 9
+    buf.migrate(Tier.HBM)
+    assert buf.residency().hbm
+    uvm.suspend()
+    try:
+        info = buf.residency()
+        assert info.host and not info.hbm      # saved home
+    finally:
+        uvm.resume()
+    info = buf.residency()
+    assert info.hbm                            # eager restore
+    assert buf.view()[100] == 9
+    buf.free()
+
+
+def test_module_suspend_resume(vs):
+    vs.run_test(12)   # UVM_TPU_TEST_SUSPEND_RESUME
