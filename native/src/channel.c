@@ -215,7 +215,11 @@ TpuStatus tpuPushBegin(TpurmChannel *ch, uint32_t maxSegs, TpuPush *p)
     if (!ch || !p || maxSegs == 0)
         return TPU_ERR_INVALID_ARGUMENT;
     uint64_t need = (uint64_t)maxSegs * sizeof(CopySeg);
-    if (need > ch->pbSize)
+    /* A reservation that wraps pads the unusable tail, so worst case it
+     * consumes pad + need < need + need bytes.  Anything over pbSize/2
+     * could deadlock the back-pressure wait on an idle channel (pad+need
+     * can exceed the whole ring with nothing left to retire). */
+    if (need * 2 > ch->pbSize)
         return TPU_ERR_INVALID_LIMIT;
 
     pthread_mutex_lock(&ch->lock);

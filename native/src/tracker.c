@@ -99,7 +99,7 @@ TpuStatus tpuTrackerWait(TpuTracker *t)
     for (uint32_t i = 0; i < t->count; i++) {
         TpuStatus s = tpurmChannelWait(t->entries[i].ch,
                                        t->entries[i].value);
-        if (s != TPU_OK)
+        if (s != TPU_OK && st == TPU_OK)
             st = s;      /* keep waiting the rest; report first failure */
     }
     t->count = 0;

@@ -715,6 +715,7 @@ static void *fault_service_thread(void *arg)
                     atomic_fetch_add(&g_fault.faultsCpu, 1);
                 else
                     atomic_fetch_add(&g_fault.faultsDevice, 1);
+                dupOf[n] = -1;       /* extras are primaries, never dups */
                 batch[n++] = extra;
                 tpuCounterAdd("uvm_fault_flush_serviced", 1);
             }

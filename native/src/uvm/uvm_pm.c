@@ -33,17 +33,32 @@
 #include <sched.h>
 #include <stdlib.h>
 
-static pthread_rwlock_t g_pmLock = PTHREAD_RWLOCK_INITIALIZER;
-static bool g_suspended;          /* under g_pmLock write side */
+/* PM gate: mutex+condvar reader-count gate rather than a rwlock, so the
+ * suspend/resume pair is NOT thread-owner-bound — POSIX makes unlocking a
+ * rwlock from a thread that doesn't hold it UB, and suspend() and
+ * resume() are free functions callable from different threads (the
+ * reference's semaphore-style PM lock is owner-agnostic the same way). */
+static pthread_mutex_t g_pmMutex = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t g_pmCond = PTHREAD_COND_INITIALIZER;
+static uint32_t g_pmReaders;      /* in-flight entry points */
+static bool g_suspended;
+static bool g_resuming;           /* resume in progress (claims g_saved) */
 
 void uvmPmEnterShared(void)
 {
-    pthread_rwlock_rdlock(&g_pmLock);
+    pthread_mutex_lock(&g_pmMutex);
+    while (g_suspended)
+        pthread_cond_wait(&g_pmCond, &g_pmMutex);
+    g_pmReaders++;
+    pthread_mutex_unlock(&g_pmMutex);
 }
 
 void uvmPmExitShared(void)
 {
-    pthread_rwlock_unlock(&g_pmLock);
+    pthread_mutex_lock(&g_pmMutex);
+    if (--g_pmReaders == 0)
+        pthread_cond_broadcast(&g_pmCond);
+    pthread_mutex_unlock(&g_pmMutex);
 }
 
 /* Saved-residency record, one per block span that was device-resident. */
@@ -110,13 +125,16 @@ static void pm_save_block(UvmVaSpace *vs, UvmVaBlock *blk)
 
 TpuStatus uvmSuspend(void)
 {
-    /* 1. Exclusive gate: waits for in-flight entry points to drain. */
-    pthread_rwlock_wrlock(&g_pmLock);
+    /* 1. Exclusive gate: block new entry points, drain in-flight ones. */
+    pthread_mutex_lock(&g_pmMutex);
     if (g_suspended) {
-        pthread_rwlock_unlock(&g_pmLock);
+        pthread_mutex_unlock(&g_pmMutex);
         return TPU_ERR_INVALID_STATE;
     }
-    g_suspended = true;
+    g_suspended = true;               /* new readers now park in Enter */
+    while (g_pmReaders > 0)
+        pthread_cond_wait(&g_pmCond, &g_pmMutex);
+    pthread_mutex_unlock(&g_pmMutex);
 
     /* 2. Drain the fault ring (CPU faults may still trickle in; the
      * service thread keeps consuming them — wait for quiescence). */
@@ -127,20 +145,27 @@ TpuStatus uvmSuspend(void)
 
     tpuCounterAdd("uvm_suspends", 1);
     tpuLog(TPU_LOG_INFO, "uvm_pm", "suspended (arenas saved to host)");
-    /* Gate stays held (write side) until uvmResume. */
+    /* Gate stays closed (g_suspended) until uvmResume — from any thread. */
     return TPU_OK;
 }
 
 TpuStatus uvmResume(void)
 {
-    if (!g_suspended)
+    /* Claim the saved list under the gate mutex so concurrent resumes
+     * (or a racing suspend) serialize correctly. */
+    pthread_mutex_lock(&g_pmMutex);
+    if (!g_suspended || g_resuming) {
+        pthread_mutex_unlock(&g_pmMutex);
         return TPU_ERR_INVALID_STATE;
+    }
+    g_resuming = true;
+    PmSaved *s = g_saved;
+    g_saved = NULL;
+    pthread_mutex_unlock(&g_pmMutex);
 
     /* 4. Restore saved spans via make_resident (eager fbsr-style restore;
      * registry uvm_resume_restore=0 leaves it to first-fault). */
     bool eager = tpuRegistryGet("uvm_resume_restore", 1) != 0;
-    PmSaved *s = g_saved;
-    g_saved = NULL;
     while (s) {
         PmSaved *next = s->next;
         if (eager) {
@@ -158,9 +183,12 @@ TpuStatus uvmResume(void)
         s = next;
     }
 
+    pthread_mutex_lock(&g_pmMutex);
     g_suspended = false;
+    g_resuming = false;
+    pthread_cond_broadcast(&g_pmCond);   /* reopen the gate */
+    pthread_mutex_unlock(&g_pmMutex);
     tpuCounterAdd("uvm_resumes", 1);
     tpuLog(TPU_LOG_INFO, "uvm_pm", "resumed");
-    pthread_rwlock_unlock(&g_pmLock);
     return TPU_OK;
 }

@@ -22,6 +22,7 @@ struct UvmToolsSession {
     bool countersEnabled;
     uint64_t notifThreshold;          /* 0 = no threshold */
     uint64_t notifications;           /* threshold crossings */
+    bool aboveThresh;                 /* latched: depth >= threshold */
     uint32_t capacity;                /* power of two */
     uint64_t widx, ridx;
     UvmEvent *ring;
@@ -120,10 +121,29 @@ bool uvmToolsCounterGet(UvmToolsSession *s, const char *name, uint64_t *out)
     return true;
 }
 
+/* Count a notification whenever pending depth transitions from below to
+ * >= threshold.  Latched (not equality-tested) so crossings are not
+ * missed when the threshold is set with events already pending, or when
+ * overflow's drop-oldest pins widx-ridx at capacity.  g_tools.lock held. */
+static void tools_notify_update_locked(UvmToolsSession *s)
+{
+    bool above = s->notifThreshold &&
+                 s->widx - s->ridx >= s->notifThreshold;
+    if (above && !s->aboveThresh)
+        s->notifications++;
+    s->aboveThresh = above;
+}
+
 void uvmToolsSetNotificationThreshold(UvmToolsSession *s, uint64_t threshold)
 {
-    if (s)
-        s->notifThreshold = threshold;
+    if (!s)
+        return;
+    pthread_mutex_lock(&g_tools.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
+    s->notifThreshold = threshold;
+    tools_notify_update_locked(s);
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
+    pthread_mutex_unlock(&g_tools.lock);
 }
 
 uint64_t uvmToolsPendingEvents(UvmToolsSession *s)
@@ -175,10 +195,8 @@ void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
         e->timestampNs = uvmMonotonicNs();
         s->widx++;
         /* Notification threshold: count the crossing (reference wakes
-         * the queue's wait_queue when pending == threshold). */
-        if (s->notifThreshold &&
-            s->widx - s->ridx == s->notifThreshold)
-            s->notifications++;
+         * the queue's wait_queue when pending reaches the threshold). */
+        tools_notify_update_locked(s);
     }
     tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
     pthread_mutex_unlock(&g_tools.lock);
@@ -195,6 +213,7 @@ size_t uvmToolsReadEvents(UvmToolsSession *s, UvmEvent *buf, size_t max)
         buf[n++] = s->ring[s->ridx % s->capacity];
         s->ridx++;
     }
+    tools_notify_update_locked(s);    /* drain may re-arm the latch */
     tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
     pthread_mutex_unlock(&g_tools.lock);
     return n;

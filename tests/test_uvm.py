@@ -288,3 +288,31 @@ def test_suspend_resume_saves_and_restores(vs):
 
 def test_module_suspend_resume(vs):
     vs.run_test(12)   # UVM_TPU_TEST_SUSPEND_RESUME
+
+
+def test_suspend_resume_cross_thread(vs):
+    """suspend() and resume() from different threads must be legal: the PM
+    gate is owner-agnostic (reference: semaphore-style PM lock), unlike a
+    rwlock whose cross-thread unlock is UB (ADVICE r2)."""
+    import threading
+
+    buf = vs.alloc(2 * MB)
+    buf.view()[:] = 7
+    buf.migrate(Tier.HBM)
+    uvm.suspend()
+    err = []
+
+    def resumer():
+        try:
+            uvm.resume()
+        except Exception as e:            # pragma: no cover
+            err.append(e)
+
+    t = threading.Thread(target=resumer)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive() and not err
+    assert buf.view()[5] == 7
+    # While resumed, an entry point must pass the gate freely.
+    buf.migrate(Tier.HOST)
+    buf.free()
