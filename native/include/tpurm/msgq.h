@@ -1,0 +1,113 @@
+/*
+ * msgq — lockless shared-memory command queue.
+ *
+ * TPU-native analog of the reference's GSP message queue
+ * (reference: src/common/uproc/ msgq library; producers submit via
+ * GspMsgQueueSendCommand -> msgqTxSubmitBuffers,
+ * src/nvidia/src/kernel/gpu/gsp/message_queue_cpu.c:446,568): commands
+ * are written into a ring, then published by a release-store of the
+ * write pointer; the consumer side polls/sleeps on the read pointer and
+ * publishes completion by a release-store of a completed sequence
+ * number.  This queue is the L1 boundary of the build — channel work is
+ * *submitted to* the runtime executor through it rather than executed
+ * inline, and in real-arena mode the HBM mirror stream to the Python/JAX
+ * runtime rides a second instance of the same structure.
+ *
+ * Concurrency model:
+ *   - single consumer always;
+ *   - single producer by default; TPU_MSGQ_MPSC serializes producers
+ *     with an internal tx mutex (the reference's command queue is also
+ *     mutex-guarded on the tx side).
+ * Blocking uses futexes directly (doorbell on submit, back-pressure on
+ * full, completion waits), so consumers never spin.
+ */
+#ifndef TPURM_MSGQ_H
+#define TPURM_MSGQ_H
+
+#include <stdbool.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct TpuMsgq TpuMsgq;
+
+/* Command opcodes. */
+enum {
+    TPU_MSGQ_NOP = 0,
+    TPU_MSGQ_HBM_MIRROR = 2,   /* shadow[hbmOff..+bytes] is dirty     */
+    TPU_MSGQ_FENCE = 3,        /* completion marker only              */
+    TPU_MSGQ_CE_PUSH = 5,      /* src = CopySeg methods in a channel
+                                * pushbuffer, bytes = method count    */
+};
+
+/* Command flags. */
+enum {
+    TPU_MSGQ_FLAG_INJECT_ERROR = 0x2, /* fault-injection (tests)      */
+};
+
+typedef struct TpuMsgqCmd {
+    uint32_t op;
+    uint32_t flags;
+    uint64_t seq;              /* assigned by tpuMsgqSubmit            */
+    uint64_t dst;              /* hbm offset (MIRROR)                  */
+    uint64_t src;              /* methods pointer (CE_PUSH)            */
+    uint64_t bytes;
+    uint32_t devInst;          /* device (MIRROR)                      */
+    uint32_t _pad;
+    uint64_t pbEnd;            /* pushbuffer chunk to retire (CE_PUSH) */
+} TpuMsgqCmd;
+
+enum {
+    TPU_MSGQ_MPSC = 0x1,       /* serialize producers with a tx mutex */
+};
+
+/* nElems is rounded up to a power of two (min 16). */
+TpuMsgq *tpuMsgqCreate(uint32_t nElems, uint32_t flags);
+void tpuMsgqDestroy(TpuMsgq *q);
+
+/* Producer: append n commands, assigning consecutive sequence numbers;
+ * returns the sequence of the LAST command via outLastSeq (optional).
+ * Blocks while the ring lacks space.  Fails only after tpuMsgqShutdown. */
+int tpuMsgqSubmit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
+                  uint64_t *outLastSeq);
+
+/* Non-blocking variant: -EAGAIN when the ring lacks space (callers that
+ * must never stall — e.g. the HBM mirror's engine-side notify — degrade
+ * to an overflow path instead of waiting). */
+int tpuMsgqTrySubmit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
+                     uint64_t *outLastSeq);
+
+/* Reopen a shut-down queue: discards any unconsumed commands (they count
+ * as retired), clears the shutdown latch, and resumes sequence
+ * allocation.  Caller must guarantee no concurrent producer/consumer. */
+void tpuMsgqReopen(TpuMsgq *q);
+
+/* Consumer: copy up to max pending commands into out.  Blocks until at
+ * least one command is available or the queue is shut down (returns 0). */
+uint32_t tpuMsgqReceive(TpuMsgq *q, TpuMsgqCmd *out, uint32_t max);
+
+/* Consumer: retire commands through sequence seq (frees ring space,
+ * publishes the completed sequence, wakes waiters). */
+void tpuMsgqComplete(TpuMsgq *q, uint64_t seq);
+
+/* Highest completed (retired) sequence. */
+uint64_t tpuMsgqCompletedSeq(TpuMsgq *q);
+
+/* Block until completedSeq >= seq (or shutdown; returns false then). */
+bool tpuMsgqWaitSeq(TpuMsgq *q, uint64_t seq);
+
+/* Unblock all producers/consumers/waiters; subsequent Submit fails and
+ * Receive returns 0.  Idempotent. */
+void tpuMsgqShutdown(TpuMsgq *q);
+
+/* Introspection (tests/metrics). */
+uint64_t tpuMsgqSubmittedSeq(TpuMsgq *q);
+uint32_t tpuMsgqDepth(TpuMsgq *q);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_MSGQ_H */

@@ -64,6 +64,32 @@ uint64_t      tpurmDeviceHbmSize(TpurmDevice *dev);
  * PDB_PROP_GPU_IS_LOST checked in p2p_cxl.c:594). */
 void          tpurmDeviceSetLost(TpurmDevice *dev, int lost);
 
+/* --------------------------------------------------- real-HBM backend */
+
+/* Switch a device's arena from fake (host-only) to REAL: the host arena
+ * becomes the coherent shadow of chip HBM and every engine write to it
+ * publishes a dirty range on the device's mirror msgq (msgq.h), which
+ * the JAX runtime's drain thread applies to a persistent on-chip buffer.
+ * Reads are always served from the shadow (fault service must never
+ * synchronously depend on the Python runtime — GIL deadlock otherwise);
+ * tpurmHbmFence/tpurmHbmWaitSeq give explicit chip-coherence points.
+ * Reference analog: the GSP message queue boundary privileged work
+ * crosses to firmware (kernel_gsp.c:372 -> message_queue_cpu.c:446). */
+TpuStatus tpurmDeviceRegisterHbm(uint32_t inst);
+void      tpurmDeviceUnregisterHbm(uint32_t inst);
+int       tpurmDeviceArenaIsReal(uint32_t inst);
+
+struct TpuMsgqCmd;         /* full layout in msgq.h */
+uint32_t  tpurmHbmMirrorReceive(uint32_t inst, struct TpuMsgqCmd *outCmds,
+                                uint32_t max);
+void      tpurmHbmMirrorComplete(uint32_t inst, uint64_t seq);
+/* Check-and-clear the overflow latch: 1 means a dirty-range notify was
+ * dropped (queue full) and the consumer must resync the WHOLE arena
+ * from the shadow before acknowledging any later fence. */
+int       tpurmHbmMirrorConsumeOverflow(uint32_t inst);
+uint64_t  tpurmHbmFence(uint32_t inst);
+TpuStatus tpurmHbmWaitSeq(uint32_t inst, uint64_t seq);
+
 /* -------------------------------------------------------- DMA channels */
 
 typedef struct TpurmChannel TpurmChannel;

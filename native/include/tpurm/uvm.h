@@ -191,6 +191,8 @@ typedef struct {
     uint32_t hbmDeviceInst;
     uint32_t cpuMapped;       /* host PTE currently valid (RW) */
     uint32_t pinnedTier;      /* thrashing pin, (uint32_t)-1 if none */
+    uint64_t hbmOffset __attribute__((aligned(8)));  /* arena offset when
+                                                      * residentHbm */
     TpuStatus rmStatus;
 } UvmTpuResidencyInfoParams;
 
@@ -298,6 +300,9 @@ typedef struct {
     uint8_t devMapped;        /* accessed-by device mapping established */
     uint8_t cancelled;        /* page detached by precise fault cancel */
     int32_t pinnedTier;       /* -1 if not pinned by thrashing mitigation */
+    /* Arena offset of the page's HBM backing (valid when residentHbm):
+     * lets real-arena clients address the same bytes on-chip. */
+    uint64_t hbmOffset;
 } UvmResidencyInfo;
 TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out);
 

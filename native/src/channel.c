@@ -4,23 +4,36 @@
  * Re-design of the reference's UVM channel/pushbuffer/tracker trio
  * (reference: kernel-open/nvidia-uvm/uvm_channel.c — GPFIFO ring + tracking
  * semaphore per channel, uvm_channel.h:33-49 with 1,024-entry default;
- * uvm_push.c; uvm_tracker.c).  TPU-native shape: the "copy engine" behind a
- * channel is a worker thread doing memcpy for the fake-device/host tiers —
- * real HBM traffic is submitted by the Python runtime through XLA, which
- * plays the role the GSP-owned CE plays in the reference (SURVEY.md §1
- * layer map: libtpu/XLA ≈ firmware).
+ * uvm_push.c; uvm_tracker.c).  Structure, faithfully mapped:
  *
- * Semantics preserved from the reference:
- *   - fixed-depth ring with blocking back-pressure when full,
- *   - a monotonically increasing tracker value per channel; a push's
- *     completion is "completed value >= push value" (uvm_gpu_semaphore.c),
- *   - channel error latches and fails subsequent waits (robust-channel
- *     recovery surface, SURVEY.md §5),
- *   - error injection for tests (uvm_test.c error-injection ioctls).
+ *   pushbuffer  — per-channel ring holding the copy "methods" (CopySeg
+ *                 arrays), reserved with cpu_put/gpu_get semantics
+ *                 (uvm_pushbuffer.h:33-90);
+ *   GPFIFO      — a lockless msgq (msgq.c, the GSP-msgq analog): each
+ *                 entry is ONE submitted push pointing at its methods in
+ *                 the pushbuffer, published with a release-store + futex
+ *                 doorbell.  The msgq's capacity IS the GPFIFO depth and
+ *                 its back-pressure is the reference's GPFIFO-full wait;
+ *   CE          — an executor thread consuming the msgq across the queue
+ *                 boundary (channel work is *submitted to* the runtime,
+ *                 never executed inline in the caller).  Fake arena: the
+ *                 executor memmoves into the host shadow.  Real arena:
+ *                 the same memmoves hit the shadow and publish dirty
+ *                 ranges to the per-device HBM mirror stream (hbm.c),
+ *                 which the JAX runtime applies to chip HBM;
+ *   tracker     — the msgq sequence doubles as the channel's monotonic
+ *                 tracker value; "completed value >= push value" is the
+ *                 completion predicate (uvm_gpu_semaphore.c).
+ *
+ * Preserved semantics: fixed-depth ring with blocking back-pressure,
+ * latched channel errors failing subsequent waits (robust-channel
+ * recovery surface), error injection for tests.
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/msgq.h"
 
+#include <stdatomic.h>
 #include <stdlib.h>
 #include <string.h>
 
@@ -31,14 +44,6 @@ typedef struct {
     const void *src;
     uint64_t bytes;
 } CopySeg;
-
-typedef struct {
-    CopySeg *segs;             /* points into the pushbuffer */
-    uint32_t nsegs;
-    uint64_t pbEnd;            /* monotonic pushbuffer offset to release */
-    uint64_t trackerValue;
-    bool injectError;
-} PushEntry;
 
 /* Outstanding pushbuffer chunk, in allocation order.  gpu_get advances
  * over the done-prefix only, so out-of-order submission between Begin and
@@ -53,12 +58,10 @@ typedef struct PbChunk {
 struct TpurmChannel {
     TpurmDevice *dev;
     TpurmCeType ce;
-    uint32_t entries;
-    PushEntry *ring;
-    uint64_t put;              /* producer index (monotonic) */
-    uint64_t get;              /* consumer index (monotonic) */
-    uint64_t submittedValue;   /* last tracker value handed out */
-    uint64_t completedValue;   /* tracker semaphore */
+    TpuMsgq *fifo;             /* the GPFIFO: one cmd per push; its
+                                * capacity is the GPFIFO depth          */
+    pthread_t executor;
+    bool executorStarted;
     /* Pushbuffer ring (uvm_pushbuffer.h:33-90 semantics): cpu_put grows
      * on reservation, gpu_get follows retired chunks. */
     uint8_t *pbBase;
@@ -68,10 +71,9 @@ struct TpurmChannel {
     PbChunk *pbChunkFree;          /* recycled chunk nodes */
     bool stop;
     bool injectNext;
-    bool error;                /* latched channel error */
-    pthread_mutex_t lock;
-    pthread_cond_t cond;       /* any state change */
-    pthread_t worker;
+    _Atomic int error;         /* latched channel error */
+    pthread_mutex_t lock;      /* pushbuffer + inject latch */
+    pthread_cond_t cond;       /* pushbuffer space freed */
 };
 
 /* Mark the chunk ending at `end` done and advance gpu_get over the done
@@ -95,46 +97,43 @@ static void pb_release_locked(TpurmChannel *ch, uint64_t end)
     }
 }
 
-static void *channel_worker(void *arg)
+/* The CE: drains GPFIFO entries, executes their methods against the
+ * shadow arena, publishes real-HBM dirty ranges, retires the push.
+ * Shutdown drains whatever is already queued, then exits. */
+static void *channel_executor(void *arg)
 {
     TpurmChannel *ch = arg;
+    TpuMsgqCmd cmd;
 
-    pthread_mutex_lock(&ch->lock);
-    for (;;) {
-        while (!ch->stop && ch->get == ch->put)
-            pthread_cond_wait(&ch->cond, &ch->lock);
-        if (ch->stop)
-            break;
-
-        PushEntry entry = ch->ring[ch->get % ch->entries];
-        pthread_mutex_unlock(&ch->lock);
-
-        bool failed = entry.injectError;
+    while (tpuMsgqReceive(ch->fifo, &cmd, 1) == 1) {
+        bool failed = (cmd.flags & TPU_MSGQ_FLAG_INJECT_ERROR) != 0;
         uint64_t bytes = 0;
-        if (!failed) {
-            for (uint32_t i = 0; i < entry.nsegs; i++) {
-                CopySeg *s = &entry.segs[i];
-                if (s->bytes > 0)
-                    memmove(s->dst, s->src, s->bytes);
-                bytes += s->bytes;
+        if (!failed && cmd.op == TPU_MSGQ_CE_PUSH) {
+            const CopySeg *segs = (const CopySeg *)(uintptr_t)cmd.src;
+            for (uint64_t i = 0; i < cmd.bytes; i++) {
+                if (segs[i].bytes > 0) {
+                    memmove(segs[i].dst, segs[i].src, segs[i].bytes);
+                    tpuHbmMirrorNotify(segs[i].dst, segs[i].bytes);
+                }
+                bytes += segs[i].bytes;
             }
         }
 
         pthread_mutex_lock(&ch->lock);
-        ch->get++;
-        ch->completedValue = entry.trackerValue;
-        pb_release_locked(ch, entry.pbEnd);
+        pb_release_locked(ch, cmd.pbEnd);
+        pthread_cond_broadcast(&ch->cond);
+        pthread_mutex_unlock(&ch->lock);
+
         if (failed) {
-            ch->error = true;
+            atomic_store_explicit(&ch->error, 1, memory_order_release);
             tpuLog(TPU_LOG_ERROR, "channel",
                    "injected CE fault at tracker value %llu",
-                   (unsigned long long)entry.trackerValue);
+                   (unsigned long long)cmd.seq);
         }
         tpuCounterAdd("channel_copies_completed", 1);
         tpuCounterAdd("channel_bytes_copied", failed ? 0 : bytes);
-        pthread_cond_broadcast(&ch->cond);
+        tpuMsgqComplete(ch->fifo, cmd.seq);
     }
-    pthread_mutex_unlock(&ch->lock);
     return NULL;
 }
 
@@ -153,8 +152,10 @@ TpurmChannel *tpurmChannelCreate(TpurmDevice *dev, TpurmCeType ce,
     TpurmChannel *ch = calloc(1, sizeof(*ch));
     if (!ch)
         return NULL;
-    ch->ring = calloc(ring_entries, sizeof(PushEntry));
-    if (!ch->ring) {
+    /* The GPFIFO: msgq capacity = ring depth; MPSC because any engine
+     * thread may submit pushes. */
+    ch->fifo = tpuMsgqCreate(ring_entries, TPU_MSGQ_MPSC);
+    if (!ch->fifo) {
         free(ch);
         return NULL;
     }
@@ -164,21 +165,21 @@ TpurmChannel *tpurmChannelCreate(TpurmDevice *dev, TpurmCeType ce,
         ch->pbSize = 4096;
     ch->pbBase = malloc(ch->pbSize);
     if (!ch->pbBase) {
-        free(ch->ring);
+        tpuMsgqDestroy(ch->fifo);
         free(ch);
         return NULL;
     }
     ch->dev = dev;
     ch->ce = ce;
-    ch->entries = ring_entries;
     pthread_mutex_init(&ch->lock, NULL);
     pthread_cond_init(&ch->cond, NULL);
-    if (pthread_create(&ch->worker, NULL, channel_worker, ch) != 0) {
+    if (pthread_create(&ch->executor, NULL, channel_executor, ch) != 0) {
+        tpuMsgqDestroy(ch->fifo);
         free(ch->pbBase);
-        free(ch->ring);
         free(ch);
         return NULL;
     }
+    ch->executorStarted = true;
     return ch;
 }
 
@@ -190,7 +191,11 @@ void tpurmChannelDestroy(TpurmChannel *ch)
     ch->stop = true;
     pthread_cond_broadcast(&ch->cond);
     pthread_mutex_unlock(&ch->lock);
-    pthread_join(ch->worker, NULL);
+    /* Shutdown lets the executor drain already-queued pushes first. */
+    tpuMsgqShutdown(ch->fifo);
+    if (ch->executorStarted)
+        pthread_join(ch->executor, NULL);
+    tpuMsgqDestroy(ch->fifo);
     pthread_cond_destroy(&ch->cond);
     pthread_mutex_destroy(&ch->lock);
     while (ch->pbChunks) {
@@ -204,7 +209,6 @@ void tpurmChannelDestroy(TpurmChannel *ch)
         free(c);
     }
     free(ch->pbBase);
-    free(ch->ring);
     free(ch);
 }
 
@@ -301,29 +305,36 @@ uint64_t tpuPushEnd(TpuPush *p, TpuTracker *t)
 
     pthread_mutex_lock(&ch->lock);
     tpuLockTrackAcquire(TPU_LOCK_CHANNEL, "push-end");
-    while (!ch->stop && ch->put - ch->get >= ch->entries)
-        pthread_cond_wait(&ch->cond, &ch->lock);
-    if (ch->stop) {
-        pb_release_locked(ch, p->pbEndOffset);
-        tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-end");
-        pthread_mutex_unlock(&ch->lock);
-        p->ch = NULL;
+    bool stopped = ch->stop;
+    bool inject = ch->injectNext;
+    ch->injectNext = false;
+    tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-end");
+    pthread_mutex_unlock(&ch->lock);
+    if (stopped) {
+        tpuPushAbort(p);
         return 0;
     }
 
-    PushEntry *entry = &ch->ring[ch->put % ch->entries];
-    entry->segs = p->segs;
-    entry->nsegs = p->nsegs;
-    entry->pbEnd = p->pbEndOffset;
-    entry->trackerValue = ++ch->submittedValue;
-    entry->injectError = ch->injectNext;
-    ch->injectNext = false;
-    ch->put++;
-    uint64_t value = entry->trackerValue;
+    /* Submit ONE GPFIFO entry pointing at the methods in the pushbuffer
+     * (the reference's GPFIFO entries likewise point at pushbuffer
+     * chunks).  The msgq assigns the monotonic sequence — the tracker
+     * value — under its tx lock, so value order == queue order.  Submit
+     * blocks while the GPFIFO is full (back-pressure); the executor
+     * retires entries without taking the msgq tx lock, so this cannot
+     * deadlock. */
+    TpuMsgqCmd cmd = {
+        .op = TPU_MSGQ_CE_PUSH,
+        .flags = inject ? TPU_MSGQ_FLAG_INJECT_ERROR : 0,
+        .src = (uint64_t)(uintptr_t)p->segs,
+        .bytes = p->nsegs,
+        .pbEnd = p->pbEndOffset,
+    };
+    uint64_t value = 0;
+    if (tpuMsgqSubmit(ch->fifo, &cmd, 1, &value) != 0) {
+        tpuPushAbort(p);
+        return 0;
+    }
     tpuCounterAdd("channel_pushes", 1);
-    pthread_cond_broadcast(&ch->cond);
-    tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-end");
-    pthread_mutex_unlock(&ch->lock);
 
     p->ch = NULL;
     if (t && tpuTrackerAdd(t, ch, value) != TPU_OK)
@@ -364,26 +375,18 @@ TpuStatus tpurmChannelWait(TpurmChannel *ch, uint64_t value)
 {
     if (!ch)
         return TPU_ERR_INVALID_ARGUMENT;
-    pthread_mutex_lock(&ch->lock);
-    while (!ch->stop && ch->completedValue < value && !ch->error)
-        pthread_cond_wait(&ch->cond, &ch->lock);
-    TpuStatus st = TPU_OK;
-    if (ch->error)
-        st = TPU_ERR_INVALID_STATE;
-    else if (ch->stop && ch->completedValue < value)
-        st = TPU_ERR_INVALID_STATE;
-    pthread_mutex_unlock(&ch->lock);
-    return st;
+    /* The executor always drains (even through shutdown), so waiting on
+     * the sequence either succeeds or the queue was shut down with the
+     * value never reached. */
+    bool reached = value == 0 || tpuMsgqWaitSeq(ch->fifo, value);
+    if (atomic_load_explicit(&ch->error, memory_order_acquire))
+        return TPU_ERR_INVALID_STATE;
+    return reached ? TPU_OK : TPU_ERR_INVALID_STATE;
 }
 
 uint64_t tpurmChannelCompletedValue(TpurmChannel *ch)
 {
-    if (!ch)
-        return 0;
-    pthread_mutex_lock(&ch->lock);
-    uint64_t v = ch->completedValue;
-    pthread_mutex_unlock(&ch->lock);
-    return v;
+    return ch ? tpuMsgqCompletedSeq(ch->fifo) : 0;
 }
 
 void tpurmChannelInjectError(TpurmChannel *ch)
@@ -402,15 +405,11 @@ void tpurmChannelResetError(TpurmChannel *ch)
      * the latched error so new work can proceed. */
     if (!ch)
         return;
-    pthread_mutex_lock(&ch->lock);
-    if (ch->error) {
-        ch->error = false;
+    if (atomic_exchange_explicit(&ch->error, 0, memory_order_acq_rel)) {
         tpuCounterAdd("channel_rc_resets", 1);
         tpuLog(TPU_LOG_WARN, "channel", "RC reset: error cleared at value %llu",
-               (unsigned long long)ch->completedValue);
+               (unsigned long long)tpuMsgqCompletedSeq(ch->fifo));
     }
-    pthread_cond_broadcast(&ch->cond);
-    pthread_mutex_unlock(&ch->lock);
 }
 
 /* ------------------------------------------------------- transfer engine */

@@ -43,6 +43,7 @@ static void device_init_once(void)
         dev->devId = DEV_ID_BASE + i;
         dev->attached = false;
         dev->lost = false;
+        pthread_mutex_init(&dev->hbmLock, NULL);
         dev->hbmSize = hbmBytes;
         /* MAP_POPULATE: commit the arena up front — real HBM has no
          * demand-zero cost, and without this every first-touch write in

@@ -1,0 +1,196 @@
+/*
+ * Real-HBM arena backend: mirror stream to the JAX runtime.
+ *
+ * The fake-device backend keeps every engine path testable host-side
+ * (device.c); this file is what connects those same paths to a real
+ * chip.  Design: the host arena stays the COHERENT SHADOW of device
+ * HBM — every engine write lands there first — and registering a device
+ * as "real" opens a per-device mirror msgq on which the engine publishes
+ * dirty shadow ranges.  The Python runtime owns the consumer side: a
+ * drain thread applies each dirty range to a persistent on-chip buffer
+ * (jax.Array), so data faulted in by the UVM engine is genuinely
+ * resident in chip HBM and directly consumable by jitted computations.
+ *
+ * Why mirror rather than read/write through the chip: CPU faults are
+ * serviced with the faulting thread stopped — often a Python thread
+ * holding the GIL — so the service path can never synchronously require
+ * the Python runtime.  That rule extends to the notify itself: it uses
+ * a NON-BLOCKING submit, and when the queue is full it latches a
+ * per-device overflow flag instead of waiting — the consumer then
+ * treats the whole arena as dirty at its next coherence point.  Writes
+ * stream to the chip asynchronously; reads are served from the shadow.
+ * tpurmHbmFence gives explicit coherence points ("everything submitted
+ * so far is on-chip").
+ *
+ * Reference analog: the GSP message queue is the boundary privileged
+ * work crosses to firmware (kernel_gsp.c:372 -> message_queue_cpu.c:446);
+ * here the XLA runtime plays firmware and the mirror msgq is that
+ * boundary for HBM contents.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/msgq.h"
+
+#include <errno.h>
+#include <string.h>
+
+TpuStatus tpurmDeviceRegisterHbm(uint32_t inst)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->hbmBase)
+        return TPU_ERR_INVALID_DEVICE;
+
+    pthread_mutex_lock(&dev->hbmLock);
+    if (atomic_load_explicit(&dev->arenaReal, memory_order_acquire)) {
+        pthread_mutex_unlock(&dev->hbmLock);
+        return TPU_OK;                    /* already registered */
+    }
+    if (dev->mirrorq) {
+        /* Re-register after unregister: reopen the queue (the object is
+         * kept across unregister so racing notifies stay safe). */
+        tpuMsgqReopen(dev->mirrorq);
+    } else {
+        /* Sized for fault storms: a 128 MB arena at 64 KB pages is 2048
+         * in-flight dirty ranges; consumer-side coalescing keeps the
+         * queue shallow in practice, and overflow degrades to a
+         * whole-arena resync rather than ever blocking the engine. */
+        dev->mirrorq = tpuMsgqCreate(
+            (uint32_t)tpuRegistryGet("hbm_mirror_queue_entries", 8192),
+            TPU_MSGQ_MPSC);
+        if (!dev->mirrorq) {
+            pthread_mutex_unlock(&dev->hbmLock);
+            return TPU_ERR_NO_MEMORY;
+        }
+    }
+    atomic_store_explicit(&dev->mirrorOverflow, 0, memory_order_release);
+    atomic_store_explicit(&dev->arenaReal, 1, memory_order_release);
+    pthread_mutex_unlock(&dev->hbmLock);
+    tpuLog(TPU_LOG_INFO, "hbm", "device %u arena registered as REAL "
+           "(mirror stream open)", inst);
+    return TPU_OK;
+}
+
+void tpurmDeviceUnregisterHbm(uint32_t inst)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev)
+        return;
+    pthread_mutex_lock(&dev->hbmLock);
+    atomic_store_explicit(&dev->arenaReal, 0, memory_order_release);
+    if (dev->mirrorq)
+        tpuMsgqShutdown(dev->mirrorq);  /* wakes the consumer; the queue
+                                         * object is kept so late
+                                         * notifies fail fast instead of
+                                         * touching freed memory */
+    pthread_mutex_unlock(&dev->hbmLock);
+    tpuLog(TPU_LOG_INFO, "hbm", "device %u arena back to FAKE", inst);
+}
+
+int tpurmDeviceArenaIsReal(uint32_t inst)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    return dev && atomic_load_explicit(&dev->arenaReal,
+                                       memory_order_acquire);
+}
+
+/* Engine-side hook: [dst, dst+bytes) was just written.  If the span
+ * intersects a real-registered device's shadow arena, publish the dirty
+ * range.  Called from executors (channel CE), and test scramblers —
+ * anywhere HBM-aperture bytes change.  NEVER blocks: queue-full latches
+ * the overflow flag. */
+void tpuHbmMirrorNotify(const void *dst, uint64_t bytes)
+{
+    if (!dst || bytes == 0)
+        return;
+    uint32_t n = tpurmDeviceCount();
+    for (uint32_t i = 0; i < n; i++) {
+        TpurmDevice *dev = tpurmDeviceGet(i);
+        if (!dev || !atomic_load_explicit(&dev->arenaReal,
+                                          memory_order_acquire))
+            continue;
+        const char *base = dev->hbmBase;
+        const char *end = base + dev->hbmSize;
+        const char *d = dst;
+        if (d >= end || d + bytes <= base)
+            continue;
+        /* Under overflow everything is already dirty; skip the submit
+         * until the consumer resyncs and clears the flag. */
+        if (atomic_load_explicit(&dev->mirrorOverflow,
+                                 memory_order_acquire))
+            continue;
+        const char *lo = d > base ? d : base;
+        const char *hi = d + bytes < end ? d + bytes : end;
+        TpuMsgqCmd cmd = {
+            .op = TPU_MSGQ_HBM_MIRROR,
+            .devInst = i,
+            .dst = (uint64_t)(lo - base),
+            .bytes = (uint64_t)(hi - lo),
+        };
+        int rc = tpuMsgqTrySubmit(dev->mirrorq, &cmd, 1, NULL);
+        if (rc == 0) {
+            tpuCounterAdd("hbm_mirror_bytes", cmd.bytes);
+        } else if (rc == -EAGAIN) {
+            atomic_store_explicit(&dev->mirrorOverflow, 1,
+                                  memory_order_release);
+            tpuCounterAdd("hbm_mirror_overflows", 1);
+        }
+    }
+}
+
+/* ------------------------------------------------- consumer-side API
+ * (bound by the Python runtime; a drain thread applies dirty ranges to
+ * the on-chip arena and acknowledges). */
+
+uint32_t tpurmHbmMirrorReceive(uint32_t inst, TpuMsgqCmd *out, uint32_t max)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->mirrorq)
+        return 0;
+    return tpuMsgqReceive(dev->mirrorq, out, max);
+}
+
+void tpurmHbmMirrorComplete(uint32_t inst, uint64_t seq)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (dev && dev->mirrorq)
+        tpuMsgqComplete(dev->mirrorq, seq);
+}
+
+/* Overflow check-and-clear: returns 1 when a notify was dropped since
+ * the last call — the consumer must then resync the WHOLE arena from
+ * the shadow before acknowledging any later fence. */
+int tpurmHbmMirrorConsumeOverflow(uint32_t inst)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev)
+        return 0;
+    return atomic_exchange_explicit(&dev->mirrorOverflow, 0,
+                                    memory_order_acq_rel);
+}
+
+/* Coherence point: returns a fence sequence; tpurmHbmWaitSeq blocks
+ * until the consumer has applied everything up to and including it.
+ * Returns 0 when the arena is fake (nothing to wait for). */
+uint64_t tpurmHbmFence(uint32_t inst)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->mirrorq ||
+        !atomic_load_explicit(&dev->arenaReal, memory_order_acquire))
+        return 0;
+    TpuMsgqCmd cmd = { .op = TPU_MSGQ_FENCE, .devInst = inst };
+    uint64_t seq = 0;
+    if (tpuMsgqSubmit(dev->mirrorq, &cmd, 1, &seq) != 0)
+        return 0;
+    return seq;
+}
+
+TpuStatus tpurmHbmWaitSeq(uint32_t inst, uint64_t seq)
+{
+    if (seq == 0)
+        return TPU_OK;
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->mirrorq)
+        return TPU_ERR_INVALID_DEVICE;
+    return tpuMsgqWaitSeq(dev->mirrorq, seq) ? TPU_OK
+                                             : TPU_ERR_INVALID_STATE;
+}

@@ -16,6 +16,7 @@
 #define TPURM_INTERNAL_H
 
 #include <pthread.h>
+#include <stdatomic.h>
 #include <stdbool.h>
 #include <stdint.h>
 
@@ -94,12 +95,14 @@ TpuStatus tpuMemdescResolve(const TpuMemDesc *md, TpurmDevice *dev,
 
 #define TPU_CE_POOL_MAX 8
 
+typedef struct TpuMsgq TpuMsgq;
+
 struct TpurmDevice {
     uint32_t inst;             /* device instance (0..n-1)      */
     uint32_t devId;            /* probed id on the wire         */
     bool attached;
     bool lost;
-    void *hbmBase;
+    void *hbmBase;             /* coherent shadow of device HBM  */
     uint64_t hbmSize;
     TpurmChannel *ce;          /* legacy shared CE channel (== cePool[0]) */
     /* CE channel pool (reference: channel pools per CE type,
@@ -107,7 +110,20 @@ struct TpurmDevice {
      * worker threads memcpy in parallel. */
     TpurmChannel *cePool[TPU_CE_POOL_MAX];
     uint32_t cePoolSize;
+    /* Real-arena backend (hbm.c): when registered, engine writes to the
+     * shadow publish dirty ranges on mirrorq for the JAX runtime. */
+    _Atomic int arenaReal;
+    /* Set when a dirty range could not be queued (mirrorq full): the
+     * consumer must treat the whole arena as dirty at its next
+     * coherence point.  Never blocks the engine. */
+    _Atomic int mirrorOverflow;
+    TpuMsgq *mirrorq;
+    pthread_mutex_t hbmLock;
 };
+
+/* hbm.c engine hook: publish [dst, dst+bytes) as dirty if it lies in a
+ * real-registered device's shadow arena. */
+void tpuHbmMirrorNotify(const void *dst, uint64_t bytes);
 
 void tpuDeviceGlobalInit(void);     /* idempotent */
 TpurmDevice *tpuDeviceByDevId(uint32_t devId);

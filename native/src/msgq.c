@@ -1,0 +1,267 @@
+/*
+ * msgq — lockless shared-memory command queue (see include/tpurm/msgq.h).
+ *
+ * Layout mirrors the reference's msgq library (src/common/uproc/): one
+ * region holding a tx header (writePtr), an rx header (readPtr +
+ * completedSeq), and a power-of-two element ring.  Pointers are
+ * monotonic u64 counters; ring index = ptr & (n-1).  Publication uses
+ * release stores, observation acquire loads — the same protocol the
+ * reference uses across the CPU/GSP shared-memory boundary
+ * (message_queue_cpu.c:446,568), here across producer/consumer threads
+ * (and, for the HBM mirror instance, across the C-engine/Python-runtime
+ * boundary).
+ */
+#define _GNU_SOURCE
+#include "tpurm/msgq.h"
+
+#include <errno.h>
+#include <limits.h>
+#include <linux/futex.h>
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+/* Futex on the low 32 bits of a monotonic counter: wake whenever the
+ * counter changes.  Wait keys re-check the predicate after every wake so
+ * ABA on the truncated value only costs a spurious retry. */
+static void futex_wake_all(_Atomic uint32_t *addr)
+{
+    syscall(SYS_futex, addr, FUTEX_WAKE_PRIVATE, INT_MAX, NULL, NULL, NULL);
+}
+
+static void futex_wait(_Atomic uint32_t *addr, uint32_t expected)
+{
+    syscall(SYS_futex, addr, FUTEX_WAIT_PRIVATE, expected, NULL, NULL, NULL);
+}
+
+struct TpuMsgq {
+    uint32_t n;                      /* ring size, power of two          */
+    uint32_t flags;
+    TpuMsgqCmd *ring;
+
+    /* tx header */
+    _Atomic uint64_t writePtr;       /* next slot to write (monotonic)   */
+    _Atomic uint32_t writeSeqLow;    /* futex doorbell for the consumer  */
+
+    /* rx header */
+    _Atomic uint64_t readPtr;        /* next slot to read (monotonic)    */
+    _Atomic uint64_t completedSeq;   /* last retired command sequence    */
+    _Atomic uint32_t completeLow;    /* futex for producers + waiters    */
+
+    _Atomic uint64_t nextSeq;        /* sequence allocator (1-based)     */
+    _Atomic int shutdown;
+
+    pthread_mutex_t txLock;          /* only used with TPU_MSGQ_MPSC     */
+};
+
+TpuMsgq *tpuMsgqCreate(uint32_t nElems, uint32_t flags)
+{
+    uint32_t n = 16;
+    while (n < nElems && n < (1u << 20))
+        n <<= 1;
+
+    TpuMsgq *q = calloc(1, sizeof(*q));
+    if (!q)
+        return NULL;
+    q->ring = calloc(n, sizeof(TpuMsgqCmd));
+    if (!q->ring) {
+        free(q);
+        return NULL;
+    }
+    q->n = n;
+    q->flags = flags;
+    pthread_mutex_init(&q->txLock, NULL);
+    return q;
+}
+
+void tpuMsgqDestroy(TpuMsgq *q)
+{
+    if (!q)
+        return;
+    tpuMsgqShutdown(q);
+    pthread_mutex_destroy(&q->txLock);
+    free(q->ring);
+    free(q);
+}
+
+void tpuMsgqShutdown(TpuMsgq *q)
+{
+    atomic_store_explicit(&q->shutdown, 1, memory_order_release);
+    futex_wake_all(&q->writeSeqLow);
+    futex_wake_all(&q->completeLow);
+}
+
+static int msgq_submit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
+                       uint64_t *outLastSeq, bool block)
+{
+    if (!q || !cmds || n == 0 || n > q->n)
+        return -EINVAL;
+    if (q->flags & TPU_MSGQ_MPSC)
+        pthread_mutex_lock(&q->txLock);
+    if (atomic_load_explicit(&q->shutdown, memory_order_acquire)) {
+        if (q->flags & TPU_MSGQ_MPSC)
+            pthread_mutex_unlock(&q->txLock);
+        return -ESHUTDOWN;
+    }
+
+    /* Back-pressure: wait for ring space.  readPtr only grows, so the
+     * check is monotonic-safe. */
+    uint64_t w = atomic_load_explicit(&q->writePtr, memory_order_relaxed);
+    for (;;) {
+        uint64_t r = atomic_load_explicit(&q->readPtr, memory_order_acquire);
+        if (w + n - r <= q->n)
+            break;
+        if (!block) {
+            if (q->flags & TPU_MSGQ_MPSC)
+                pthread_mutex_unlock(&q->txLock);
+            return -EAGAIN;
+        }
+        uint32_t c = atomic_load_explicit(&q->completeLow,
+                                          memory_order_acquire);
+        /* Re-check after loading the futex word (avoid lost wakeup). */
+        if (atomic_load_explicit(&q->readPtr, memory_order_acquire) != r)
+            continue;
+        if (atomic_load_explicit(&q->shutdown, memory_order_acquire)) {
+            if (q->flags & TPU_MSGQ_MPSC)
+                pthread_mutex_unlock(&q->txLock);
+            return -ESHUTDOWN;
+        }
+        futex_wait(&q->completeLow, c);
+    }
+
+    uint64_t last = 0;
+    for (uint32_t i = 0; i < n; i++) {
+        cmds[i].seq = atomic_fetch_add_explicit(&q->nextSeq, 1,
+                                                memory_order_relaxed) + 1;
+        last = cmds[i].seq;
+        q->ring[(w + i) & (q->n - 1)] = cmds[i];
+    }
+    /* Publish: release so the consumer's acquire load of writePtr sees
+     * the ring contents (msgqTxSubmitBuffers analog). */
+    atomic_store_explicit(&q->writePtr, w + n, memory_order_release);
+    atomic_fetch_add_explicit(&q->writeSeqLow, 1, memory_order_release);
+    futex_wake_all(&q->writeSeqLow);
+
+    if (q->flags & TPU_MSGQ_MPSC)
+        pthread_mutex_unlock(&q->txLock);
+    if (outLastSeq)
+        *outLastSeq = last;
+    return 0;
+}
+
+int tpuMsgqSubmit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
+                  uint64_t *outLastSeq)
+{
+    return msgq_submit(q, cmds, n, outLastSeq, true);
+}
+
+int tpuMsgqTrySubmit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
+                     uint64_t *outLastSeq)
+{
+    return msgq_submit(q, cmds, n, outLastSeq, false);
+}
+
+void tpuMsgqReopen(TpuMsgq *q)
+{
+    if (!q)
+        return;
+    /* Discard unconsumed commands: they count as retired so stale fence
+     * waits from the previous epoch complete rather than hang. */
+    uint64_t w = atomic_load_explicit(&q->writePtr, memory_order_acquire);
+    atomic_store_explicit(&q->readPtr, w, memory_order_release);
+    uint64_t s = atomic_load_explicit(&q->nextSeq, memory_order_acquire);
+    atomic_store_explicit(&q->completedSeq, s, memory_order_release);
+    atomic_store_explicit(&q->shutdown, 0, memory_order_release);
+    atomic_fetch_add_explicit(&q->completeLow, 1, memory_order_release);
+    futex_wake_all(&q->completeLow);
+}
+
+uint32_t tpuMsgqReceive(TpuMsgq *q, TpuMsgqCmd *out, uint32_t max)
+{
+    if (!q || !out || max == 0)
+        return 0;
+    for (;;) {
+        uint64_t r = atomic_load_explicit(&q->readPtr, memory_order_relaxed);
+        uint64_t w = atomic_load_explicit(&q->writePtr, memory_order_acquire);
+        if (w > r) {
+            uint32_t avail = (uint32_t)(w - r);
+            if (avail > max)
+                avail = max;
+            for (uint32_t i = 0; i < avail; i++)
+                out[i] = q->ring[(r + i) & (q->n - 1)];
+            /* readPtr is advanced by tpuMsgqComplete (after execution),
+             * not here: ring slots stay owned until retired, exactly as
+             * the reference frees tx space only when rx acknowledges. */
+            return avail;
+        }
+        if (atomic_load_explicit(&q->shutdown, memory_order_acquire))
+            return 0;
+        uint32_t dv = atomic_load_explicit(&q->writeSeqLow,
+                                           memory_order_acquire);
+        if (atomic_load_explicit(&q->writePtr, memory_order_acquire) != w)
+            continue;
+        futex_wait(&q->writeSeqLow, dv);
+    }
+}
+
+void tpuMsgqComplete(TpuMsgq *q, uint64_t seq)
+{
+    if (!q)
+        return;
+    /* Retire every ring slot whose command sequence is <= seq.  The
+     * consumer processes in order, so this is a prefix. */
+    uint64_t r = atomic_load_explicit(&q->readPtr, memory_order_relaxed);
+    uint64_t w = atomic_load_explicit(&q->writePtr, memory_order_acquire);
+    while (r < w && q->ring[r & (q->n - 1)].seq <= seq)
+        r++;
+    atomic_store_explicit(&q->readPtr, r, memory_order_release);
+
+    uint64_t prev = atomic_load_explicit(&q->completedSeq,
+                                         memory_order_relaxed);
+    if (seq > prev)
+        atomic_store_explicit(&q->completedSeq, seq, memory_order_release);
+    atomic_fetch_add_explicit(&q->completeLow, 1, memory_order_release);
+    futex_wake_all(&q->completeLow);
+}
+
+uint64_t tpuMsgqCompletedSeq(TpuMsgq *q)
+{
+    return q ? atomic_load_explicit(&q->completedSeq, memory_order_acquire)
+             : 0;
+}
+
+bool tpuMsgqWaitSeq(TpuMsgq *q, uint64_t seq)
+{
+    if (!q)
+        return false;
+    for (;;) {
+        if (atomic_load_explicit(&q->completedSeq, memory_order_acquire) >=
+            seq)
+            return true;
+        if (atomic_load_explicit(&q->shutdown, memory_order_acquire))
+            return false;
+        uint32_t c = atomic_load_explicit(&q->completeLow,
+                                          memory_order_acquire);
+        if (atomic_load_explicit(&q->completedSeq, memory_order_acquire) >=
+            seq)
+            return true;
+        futex_wait(&q->completeLow, c);
+    }
+}
+
+uint64_t tpuMsgqSubmittedSeq(TpuMsgq *q)
+{
+    return q ? atomic_load_explicit(&q->nextSeq, memory_order_acquire) : 0;
+}
+
+uint32_t tpuMsgqDepth(TpuMsgq *q)
+{
+    if (!q)
+        return 0;
+    uint64_t r = atomic_load_explicit(&q->readPtr, memory_order_acquire);
+    uint64_t w = atomic_load_explicit(&q->writePtr, memory_order_acquire);
+    return (uint32_t)(w - r);
+}

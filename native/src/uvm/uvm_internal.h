@@ -273,6 +273,10 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
  * (reference eviction: uvm_pmm_gpu.c root-chunk eviction.) */
 TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena);
 void uvmBlockFreeBacking(UvmVaBlock *blk);
+/* Arena offset of `page`'s HBM backing (blk->lock held); false if the
+ * page has no HBM run. */
+bool uvmBlockHbmArenaOffset(UvmVaBlock *blk, uint32_t page,
+                            uint64_t *outOffset);
 
 /* Accessed-by mapping: map pages for a device where they currently
  * reside, without migration (fails TPU_ERR_INVALID_STATE if any page is

@@ -315,6 +315,7 @@ static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
             p->hbmDeviceInst = info.hbmDeviceInst;
             p->cpuMapped = info.cpuMapped;
             p->pinnedTier = (uint32_t)info.pinnedTier;
+            p->hbmOffset = info.hbmOffset;
         }
         return 0;
     }

@@ -618,6 +618,7 @@ static TpuStatus test_suspend_resume(UvmVaSpace *vs)
     TpurmDevice *dev = tpurmDeviceGet(0);
     CHECK(dev != NULL);
     memset(tpurmDeviceHbmBase(dev), 0xFF, tpurmDeviceHbmSize(dev));
+    tpuHbmMirrorNotify(tpurmDeviceHbmBase(dev), tpurmDeviceHbmSize(dev));
     UvmTierArena *cx = uvmTierArenaCxl();
     if (cx)
         memset(cx->base, 0xEE, cx->size);

@@ -63,6 +63,17 @@ static void *tier_page_ptr(UvmVaBlock *blk, UvmTier tier, uint32_t page)
            (uint64_t)(page - r->firstPage) * ps;
 }
 
+bool uvmBlockHbmArenaOffset(UvmVaBlock *blk, uint32_t page,
+                            uint64_t *outOffset)
+{
+    UvmChunkRun *r = run_find(blk, UVM_TIER_HBM, page);
+    if (!r)
+        return false;
+    *outOffset = r->chunk->offset +
+                 (uint64_t)(page - r->firstPage) * uvmPageSize();
+    return true;
+}
+
 /* Allocate backing runs in `arena` covering every page of [first,
  * first+count) that lacks one.  Greedy largest-pow2 chunks.  Returns
  * TPU_ERR_NO_MEMORY if the arena is exhausted (caller evicts + retries). */

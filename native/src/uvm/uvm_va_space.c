@@ -564,6 +564,8 @@ TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out)
     out->devMapped = uvmPageMaskTest(&blk->devMapped, page);
     out->cancelled = uvmPageMaskTest(&blk->cancelled, page);
     out->pinnedTier = blk->pinnedTier;
+    if (out->residentHbm)
+        uvmBlockHbmArenaOffset(blk, page, &out->hbmOffset);
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
     pthread_mutex_unlock(&blk->lock);
     vs_unlock(vs);

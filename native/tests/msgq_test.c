@@ -1,0 +1,226 @@
+/*
+ * msgq + submission-boundary tests.
+ *
+ * Covers the L1-boundary queue itself (ordering, back-pressure,
+ * completion, shutdown) and the channel engine on top of it: inject an
+ * error mid-stream under load and verify the latch, RC reset, and that
+ * every other push's bytes landed (reference test strategy analog:
+ * UVM_TEST_CHANNEL_STRESS, uvm_test.c:267).
+ */
+#define _GNU_SOURCE
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpurm/msgq.h"
+#include "tpurm/tpurm.h"
+
+#define CHECK(cond)                                                     \
+    do {                                                                \
+        if (!(cond)) {                                                  \
+            fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,     \
+                    #cond);                                             \
+            exit(1);                                                    \
+        }                                                               \
+    } while (0)
+
+/* ---------------------------------------------------- raw queue tests */
+
+static void test_order_and_completion(void)
+{
+    TpuMsgq *q = tpuMsgqCreate(64, 0);
+    CHECK(q != NULL);
+
+    TpuMsgqCmd cmds[10];
+    memset(cmds, 0, sizeof(cmds));
+    for (int i = 0; i < 10; i++) {
+        cmds[i].op = TPU_MSGQ_NOP;
+        cmds[i].dst = (uint64_t)i;
+    }
+    uint64_t last = 0;
+    CHECK(tpuMsgqSubmit(q, cmds, 10, &last) == 0);
+    CHECK(last == 10);                   /* sequences are 1-based */
+    CHECK(tpuMsgqDepth(q) == 10);
+
+    TpuMsgqCmd got[16];
+    uint32_t n = tpuMsgqReceive(q, got, 16);
+    CHECK(n == 10);
+    for (uint32_t i = 0; i < n; i++) {
+        CHECK(got[i].seq == i + 1);      /* FIFO order */
+        CHECK(got[i].dst == i);
+    }
+    /* Slots stay owned until completed. */
+    CHECK(tpuMsgqDepth(q) == 10);
+    tpuMsgqComplete(q, 4);
+    CHECK(tpuMsgqDepth(q) == 6);
+    CHECK(tpuMsgqCompletedSeq(q) == 4);
+    tpuMsgqComplete(q, 10);
+    CHECK(tpuMsgqDepth(q) == 0);
+    CHECK(tpuMsgqWaitSeq(q, 10));
+
+    tpuMsgqDestroy(q);
+}
+
+/* Producer floods a tiny ring; consumer retires slowly: back-pressure
+ * must neither deadlock nor drop/reorder commands. */
+#define STRESS_CMDS 20000
+
+struct stress_arg {
+    TpuMsgq *q;
+    _Atomic uint64_t produced;
+};
+
+static void *stress_producer(void *argp)
+{
+    struct stress_arg *a = argp;
+    for (uint64_t i = 0; i < STRESS_CMDS; i++) {
+        TpuMsgqCmd c = { .op = TPU_MSGQ_NOP, .dst = i };
+        CHECK(tpuMsgqSubmit(a->q, &c, 1, NULL) == 0);
+        atomic_fetch_add(&a->produced, 1);
+    }
+    return NULL;
+}
+
+static void test_backpressure_stress(void)
+{
+    TpuMsgq *q = tpuMsgqCreate(16, TPU_MSGQ_MPSC);
+    CHECK(q != NULL);
+    struct stress_arg a = { q, 0 };
+
+    enum { PRODUCERS = 4 };
+    pthread_t threads[PRODUCERS];
+    for (int i = 0; i < PRODUCERS; i++)
+        CHECK(pthread_create(&threads[i], NULL, stress_producer, &a) == 0);
+
+    uint64_t seen = 0, sum = 0;
+    TpuMsgqCmd got[8];
+    while (seen < (uint64_t)PRODUCERS * STRESS_CMDS) {
+        uint32_t n = tpuMsgqReceive(q, got, 8);
+        CHECK(n > 0);
+        uint64_t maxSeq = 0;
+        for (uint32_t i = 0; i < n; i++) {
+            CHECK(got[i].seq == seen + i + 1);   /* dense, in order */
+            sum += got[i].dst;
+            if (got[i].seq > maxSeq)
+                maxSeq = got[i].seq;
+        }
+        seen += n;
+        tpuMsgqComplete(q, maxSeq);
+    }
+    for (int i = 0; i < PRODUCERS; i++)
+        pthread_join(threads[i], NULL);
+    /* Every command arrived exactly once. */
+    CHECK(sum == (uint64_t)PRODUCERS *
+                     ((uint64_t)STRESS_CMDS * (STRESS_CMDS - 1) / 2));
+    CHECK(tpuMsgqDepth(q) == 0);
+    tpuMsgqDestroy(q);
+}
+
+static void *shutdown_waiter(void *argp)
+{
+    TpuMsgq *q = argp;
+    /* Sequence 999 never completes; shutdown must unblock us. */
+    CHECK(!tpuMsgqWaitSeq(q, 999));
+    return NULL;
+}
+
+static void test_shutdown_unblocks(void)
+{
+    TpuMsgq *q = tpuMsgqCreate(16, 0);
+    CHECK(q != NULL);
+    pthread_t th;
+    CHECK(pthread_create(&th, NULL, shutdown_waiter, q) == 0);
+    struct timespec ts = { 0, 20 * 1000 * 1000 };
+    nanosleep(&ts, NULL);
+    tpuMsgqShutdown(q);
+    pthread_join(th, NULL);
+    TpuMsgqCmd c = { .op = TPU_MSGQ_NOP };
+    CHECK(tpuMsgqSubmit(q, &c, 1, NULL) != 0);   /* fails after shutdown */
+    tpuMsgqDestroy(q);
+}
+
+/* ------------------------------------- channel boundary: inject-error
+ * mid-stream under load (the task's stress requirement). */
+
+static void test_channel_inject_midstream(void)
+{
+    TpurmDevice *dev = tpurmDeviceGet(0);
+    CHECK(dev != NULL);
+    TpurmChannel *ch = tpurmChannelCreate(dev, TPURM_CE_ANY, 64);
+    CHECK(ch != NULL);
+
+    enum { N = 1000, FAULT_AT = 500 };
+    static uint8_t src[N], dst[N];
+    for (int i = 0; i < N; i++) {
+        src[i] = (uint8_t)(i * 7 + 1);
+        dst[i] = 0;
+    }
+
+    uint64_t values[N];
+    uint64_t faultValue = 0;
+    for (int i = 0; i < N; i++) {
+        if (i == FAULT_AT)
+            tpurmChannelInjectError(ch);
+        values[i] = tpurmChannelPushCopy(ch, &dst[i], &src[i], 1);
+        CHECK(values[i] != 0);
+        if (i == FAULT_AT)
+            faultValue = values[i];
+    }
+
+    /* The wait on the last value reports the latched mid-stream error. */
+    CHECK(tpurmChannelWait(ch, values[N - 1]) != TPU_OK);
+    /* Completed value still advanced through the whole stream. */
+    CHECK(tpurmChannelCompletedValue(ch) >= values[N - 1]);
+
+    /* RC reset clears the latch; subsequent work flows. */
+    tpurmChannelResetError(ch);
+    uint8_t extraSrc = 0xAB, extraDst = 0;
+    uint64_t v = tpurmChannelPushCopy(ch, &extraDst, &extraSrc, 1);
+    CHECK(v != 0);
+    CHECK(tpurmChannelWait(ch, v) == TPU_OK);
+    CHECK(extraDst == 0xAB);
+
+    /* Every push except the injected one executed its copy. */
+    for (int i = 0; i < N; i++) {
+        if (values[i] == faultValue)
+            CHECK(dst[i] == 0);
+        else
+            CHECK(dst[i] == (uint8_t)(i * 7 + 1));
+    }
+
+    tpurmChannelDestroy(ch);
+}
+
+/* Destroy with queued work drains it (graceful shutdown). */
+static void test_channel_destroy_drains(void)
+{
+    TpurmDevice *dev = tpurmDeviceGet(0);
+    CHECK(dev != NULL);
+    TpurmChannel *ch = tpurmChannelCreate(dev, TPURM_CE_ANY, 32);
+    CHECK(ch != NULL);
+
+    enum { N = 200 };
+    static uint8_t src2[N], dst2[N];
+    for (int i = 0; i < N; i++) {
+        src2[i] = (uint8_t)(i + 3);
+        dst2[i] = 0;
+    }
+    for (int i = 0; i < N; i++)
+        CHECK(tpurmChannelPushCopy(ch, &dst2[i], &src2[i], 1) != 0);
+    tpurmChannelDestroy(ch);
+    for (int i = 0; i < N; i++)
+        CHECK(dst2[i] == (uint8_t)(i + 3));
+}
+
+int main(void)
+{
+    test_order_and_completion();
+    test_backpressure_stress();
+    test_shutdown_unblocks();
+    test_channel_inject_midstream();
+    test_channel_destroy_drains();
+    printf("msgq_test OK\n");
+    return 0;
+}

@@ -1,0 +1,211 @@
+"""Real-HBM arena runtime: the consumer side of the native mirror stream.
+
+This is the piece that connects the native engine to the actual chip.
+The native side (native/src/hbm.c) keeps the host arena as the coherent
+shadow of device HBM and publishes dirty ranges on a per-device msgq —
+the GSP-msgq analog (reference: CPU->GSP boundary,
+src/nvidia/src/kernel/gpu/gsp/message_queue_cpu.c:446,568).  Here the
+XLA runtime plays firmware: a drain thread applies every dirty range to
+a persistent on-chip buffer, block by block, so bytes the UVM engine
+faulted into the HBM tier are genuinely resident in chip HBM and
+directly consumable by jitted computations.
+
+Coherence protocol:
+  - engine writes shadow, publishes [off, off+len) dirty;
+  - drain thread coalesces dirty ranges to block granularity and
+    uploads whole blocks from the shadow (the shadow is coherent, so
+    over-upload is always safe);
+  - a queue-full overflow latch degrades to whole-arena resync, never
+    blocking the engine (fault service must not depend on this thread);
+  - ``fence()`` blocks until everything published so far is on-chip.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from . import native
+
+
+class MsgqCmd(ctypes.Structure):
+    """Mirror of TpuMsgqCmd (native/include/tpurm/msgq.h)."""
+
+    _fields_ = [
+        ("op", ctypes.c_uint32),
+        ("flags", ctypes.c_uint32),
+        ("seq", ctypes.c_uint64),
+        ("dst", ctypes.c_uint64),
+        ("src", ctypes.c_uint64),
+        ("bytes", ctypes.c_uint64),
+        ("devInst", ctypes.c_uint32),
+        ("_pad", ctypes.c_uint32),
+        ("pbEnd", ctypes.c_uint64),
+    ]
+
+
+OP_HBM_MIRROR = 2
+OP_FENCE = 3
+
+_hbm_bound = False
+
+
+def _lib() -> ctypes.CDLL:
+    global _hbm_bound
+    lib = native.load()
+    if not _hbm_bound:
+        u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+        lib.tpurmDeviceRegisterHbm.argtypes = [u32]
+        lib.tpurmDeviceRegisterHbm.restype = u32
+        lib.tpurmDeviceUnregisterHbm.argtypes = [u32]
+        lib.tpurmDeviceArenaIsReal.argtypes = [u32]
+        lib.tpurmDeviceArenaIsReal.restype = ctypes.c_int
+        lib.tpurmHbmMirrorReceive.argtypes = [u32, ctypes.POINTER(MsgqCmd),
+                                              u32]
+        lib.tpurmHbmMirrorReceive.restype = u32
+        lib.tpurmHbmMirrorComplete.argtypes = [u32, u64]
+        lib.tpurmHbmMirrorConsumeOverflow.argtypes = [u32]
+        lib.tpurmHbmMirrorConsumeOverflow.restype = ctypes.c_int
+        lib.tpurmHbmFence.argtypes = [u32]
+        lib.tpurmHbmFence.restype = u64
+        lib.tpurmHbmWaitSeq.argtypes = [u32, u64]
+        lib.tpurmHbmWaitSeq.restype = u32
+        _hbm_bound = True
+    return lib
+
+
+class HbmRuntime:
+    """Registers a device arena as REAL and drains its mirror stream.
+
+    The on-chip arena is a list of fixed-size uint8 blocks (jax.Array);
+    whole-block upload from the coherent shadow avoids per-range
+    recompilation and keeps device_put batches large.
+    """
+
+    def __init__(self, dev: int = 0, block_bytes: int = 1 << 20,
+                 device=None):
+        import jax
+
+        self._lib = _lib()
+        self.dev = dev
+        self.block_bytes = block_bytes
+        self.device = device or jax.devices()[0]
+
+        base, size = native.hbm_view(dev)
+        self.arena_bytes = size
+        self._shadow = np.frombuffer(
+            (ctypes.c_char * size).from_address(base), dtype=np.uint8)
+        self.n_blocks = math.ceil(size / block_bytes)
+        # None = never dirtied; materialized lazily from the shadow.
+        self._blocks: List[Optional[object]] = [None] * self.n_blocks
+        self._blocks_lock = threading.Lock()
+        self.mirrored_bytes = 0
+        self.resyncs = 0
+
+        st = self._lib.tpurmDeviceRegisterHbm(dev)
+        if st != 0:
+            raise native.RmError(st, "tpurmDeviceRegisterHbm")
+        self._drain_thread = threading.Thread(
+            target=self._drain, name=f"hbm-mirror-{dev}", daemon=True)
+        self._drain_thread.start()
+
+    # ------------------------------------------------------------ drain
+
+    def _upload_blocks(self, block_ids) -> None:
+        import jax
+
+        ids = sorted(block_ids)
+        if not ids:
+            return
+        chunks = []
+        for b in ids:
+            lo = b * self.block_bytes
+            hi = min(lo + self.block_bytes, self.arena_bytes)
+            # Copy out of the shadow: device_put may be async and the
+            # engine can redirty the span behind us; the copy pins the
+            # snapshot this batch covers.
+            chunks.append(np.array(self._shadow[lo:hi]))
+        arrs = jax.device_put(chunks, self.device)
+        with self._blocks_lock:
+            for b, arr in zip(ids, arrs):
+                self._blocks[b] = arr
+        self.mirrored_bytes += sum(c.nbytes for c in chunks)
+
+    def _drain(self) -> None:
+        buf = (MsgqCmd * 256)()
+        while True:
+            n = self._lib.tpurmHbmMirrorReceive(self.dev, buf, 256)
+            if n == 0:          # queue shut down (unregister/close)
+                return
+            if self._lib.tpurmHbmMirrorConsumeOverflow(self.dev):
+                # A notify was dropped: everything is suspect.  Resync
+                # every block that has ever been materialized plus all
+                # blocks, conservatively, from the coherent shadow.
+                self.resyncs += 1
+                self._upload_blocks(range(self.n_blocks))
+            dirty = set()
+            for i in range(n):
+                cmd = buf[i]
+                if cmd.op == OP_HBM_MIRROR:
+                    first = cmd.dst // self.block_bytes
+                    last = (cmd.dst + cmd.bytes - 1) // self.block_bytes
+                    dirty.update(range(int(first), int(last) + 1))
+                # OP_FENCE carries no payload: completing the batch
+                # (below, after uploads) is what releases its waiters.
+            self._upload_blocks(dirty)
+            self._lib.tpurmHbmMirrorComplete(self.dev, buf[n - 1].seq)
+
+    # ------------------------------------------------------------- API
+
+    def fence(self) -> None:
+        """Block until every dirty range published so far is on-chip."""
+        seq = self._lib.tpurmHbmFence(self.dev)
+        st = self._lib.tpurmHbmWaitSeq(self.dev, seq)
+        if st != 0:
+            raise native.RmError(st, "tpurmHbmWaitSeq")
+
+    def block(self, idx: int):
+        """The on-chip jax.Array for arena block idx (lazy upload)."""
+        with self._blocks_lock:
+            arr = self._blocks[idx]
+        if arr is None:
+            self._upload_blocks([idx])
+            with self._blocks_lock:
+                arr = self._blocks[idx]
+        return arr
+
+    def read_arena(self, offset: int, length: int):
+        """On-chip view of arena [offset, offset+length) as uint8.
+
+        Concatenation of the covering blocks, sliced on device — the
+        bytes come from chip HBM, not the shadow."""
+        import jax.numpy as jnp
+
+        if offset < 0 or offset + length > self.arena_bytes:
+            raise ValueError("arena range out of bounds")
+        first = offset // self.block_bytes
+        last = (offset + length - 1) // self.block_bytes
+        parts = [self.block(b) for b in range(first, last + 1)]
+        whole = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        lo = offset - first * self.block_bytes
+        return whole[lo:lo + length]
+
+    @property
+    def is_real(self) -> bool:
+        return bool(self._lib.tpurmDeviceArenaIsReal(self.dev))
+
+    def close(self) -> None:
+        if self._drain_thread is not None:
+            self._lib.tpurmDeviceUnregisterHbm(self.dev)
+            self._drain_thread.join(timeout=10)
+            self._drain_thread = None
+
+    def __enter__(self) -> "HbmRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -54,6 +54,7 @@ class _ResidencyInfo(ctypes.Structure):
         ("devMapped", ctypes.c_uint8),
         ("cancelled", ctypes.c_uint8),
         ("pinnedTier", ctypes.c_int32),
+        ("hbmOffset", ctypes.c_uint64),
     ]
 
 
@@ -91,6 +92,7 @@ class ResidencyInfo:
     pinned_tier: Optional[Tier]
     dev_mapped: bool = False
     cancelled: bool = False
+    hbm_offset: int = 0       # arena offset of the HBM backing (when hbm)
 
 
 @dataclass(frozen=True)
@@ -368,7 +370,8 @@ class ManagedBuffer:
                              bool(raw.residentCxl), raw.hbmDeviceInst,
                              bool(raw.cpuMapped),
                              _tier_or_none(raw.pinnedTier),
-                             bool(raw.devMapped), bool(raw.cancelled))
+                             bool(raw.devMapped), bool(raw.cancelled),
+                             raw.hbmOffset)
 
     def free(self) -> None:
         if self.address:

@@ -1,0 +1,143 @@
+"""Real-arena backend: fault-driven pages land on-chip, jit consumes them.
+
+The round-3 flagship path (VERDICT r2 task 1): register the device arena
+as REAL, drive UVM device faults that migrate managed pages into the HBM
+tier, fence the mirror stream, and verify a JITTED computation reading
+the on-chip arena sees exactly the faulted bytes.  On the CI host the
+"chip" is the CPU backend; on hardware the same code paths place the
+bytes in TPU HBM (bench.py measures that).
+
+Reference analog for the boundary being crossed: channel work reaching
+real device memory behind the GSP msgq (message_queue_cpu.c:446,568).
+"""
+
+import ctypes
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from open_gpu_kernel_modules_tpu import uvm
+from open_gpu_kernel_modules_tpu.runtime import hbm, native
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+
+@pytest.fixture
+def hbm_rt():
+    rt = hbm.HbmRuntime(dev=0, block_bytes=1 << 20)
+    yield rt
+    rt.close()
+
+
+def test_arena_mode_flag(hbm_rt):
+    assert hbm_rt.is_real
+    lib = native.load()
+    assert lib.tpurmDeviceArenaIsReal(0) == 1
+
+
+def test_faulted_page_consumed_by_jit(hbm_rt):
+    """Write a pattern host-side, fault it into HBM, read it back from
+    the ON-CHIP arena through a jitted reduction."""
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(1 << 20)
+        view = buf.view(np.uint8)
+        pattern = (np.arange(buf.nbytes) % 251).astype(np.uint8)
+        view[:] = pattern
+
+        # Device touch: migrates the span into the HBM tier through the
+        # fault service loop (channel copies -> executor -> mirror).
+        buf.device_access(dev=0, write=False)
+        res = buf.residency()
+        assert res.hbm
+
+        # Chip-coherence point: everything published is on-chip now.
+        hbm_rt.fence()
+        assert hbm_rt.mirrored_bytes >= buf.nbytes
+
+        # Page-wise: the buffer's HBM backing need not be contiguous in
+        # the arena, so resolve each page's own arena offset.
+        page = 64 * 1024          # uvm_page_size default
+        checksum = jax.jit(lambda a: jnp.sum(a.astype(jnp.uint32)))
+        first = jax.jit(lambda a: a[0])
+        for off in range(0, buf.nbytes, page):
+            pres = buf.residency(offset=off)
+            assert pres.hbm
+            arr = hbm_rt.read_arena(pres.hbm_offset, page)
+            want = pattern[off:off + page]
+            assert int(checksum(arr)) == int(want.astype(np.uint32).sum())
+            assert int(first(arr)) == int(want[0])
+
+        buf.free()
+
+
+def test_refault_after_eviction_updates_chip(hbm_rt):
+    """Oversubscribe so eviction + refault cycle pages through the
+    arena; the chip view must track the final residency contents."""
+    lib = native.load()
+    dev = lib.tpurmDeviceGet(0)
+    arena = lib.tpurmDeviceHbmSize(dev)
+    slice_bytes = 1 << 20
+
+    with uvm.VaSpace() as vs:
+        nbufs = max(4, int(2 * arena) // slice_bytes)
+        bufs = [vs.alloc(slice_bytes) for _ in range(nbufs)]
+        for i, b in enumerate(bufs):
+            b.view()[:] = (i * 37 + 11) % 256
+
+        for b in bufs:
+            b.device_access(dev=0, write=False)
+
+        # The last buffer is certainly still HBM-resident.
+        tail = bufs[-1]
+        res = tail.residency()
+        assert res.hbm
+        hbm_rt.fence()
+        arr = hbm_rt.read_arena(res.hbm_offset, 4096)
+        expected = ((nbufs - 1) * 37 + 11) % 256
+        assert int(jax.jit(lambda a: a[0])(arr)) == expected
+        assert int(jax.jit(jnp.max)(arr)) == expected
+
+        for b in bufs:
+            b.free()
+
+
+def test_register_unregister_reregister():
+    """hbm.c regression: re-registering after unregister must reopen the
+    mirror stream, not silently leave it dead."""
+    lib = native.load()
+    rt = hbm.HbmRuntime(dev=0)
+    assert rt.is_real
+    rt.close()
+    assert lib.tpurmDeviceArenaIsReal(0) == 0
+    rt2 = hbm.HbmRuntime(dev=0)
+    try:
+        assert rt2.is_real
+        # The stream must actually flow: a fence round-trips.
+        rt2.fence()
+    finally:
+        rt2.close()
+
+
+def test_overflow_resync(hbm_rt):
+    """Force mirror-queue overflow and verify the consumer resyncs the
+    whole arena rather than dropping ranges."""
+    lib = native.load()
+    before = lib.tpurmCounterGet(b"hbm_mirror_overflows")
+    # Publish far more dirty ranges than the queue holds, bypassing the
+    # channel path: write the shadow directly and notify per page.
+    base, size = native.hbm_view(0)
+    shadow = np.frombuffer((ctypes.c_char * size).from_address(base),
+                           dtype=np.uint8)
+    shadow[:4096] = 77
+    lib.tpuHbmMirrorNotify.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    n_ranges = 3 * 8192          # > hbm_mirror_queue_entries default
+    for _ in range(n_ranges):
+        lib.tpuHbmMirrorNotify(base, 4096)
+    after = lib.tpurmCounterGet(b"hbm_mirror_overflows")
+    if after == before:
+        pytest.skip("consumer drained fast enough to never overflow")
+    hbm_rt.fence()
+    assert hbm_rt.resyncs >= 1
+    arr = hbm_rt.read_arena(0, 4096)
+    assert int(jax.jit(lambda a: a[0])(arr)) == 77
