@@ -99,8 +99,16 @@ static int msgq_submit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
 {
     if (!q || !cmds || n == 0 || n > q->n)
         return -EINVAL;
-    if (q->flags & TPU_MSGQ_MPSC)
-        pthread_mutex_lock(&q->txLock);
+    if (q->flags & TPU_MSGQ_MPSC) {
+        if (block) {
+            pthread_mutex_lock(&q->txLock);
+        } else if (pthread_mutex_trylock(&q->txLock) != 0) {
+            /* A blocking producer may hold txLock through its futex
+             * back-pressure wait; a non-blocking caller must not queue
+             * behind it (TrySubmit's contract is NEVER to stall). */
+            return -EAGAIN;
+        }
+    }
     if (atomic_load_explicit(&q->shutdown, memory_order_acquire)) {
         if (q->flags & TPU_MSGQ_MPSC)
             pthread_mutex_unlock(&q->txLock);

@@ -272,8 +272,14 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
              * memory — already zero, and skipping the touch keeps the
              * fault-service path from committing pages the caller never
              * reads (big win for prefetch-expanded regions). */
-            if (dstTier != UVM_TIER_HOST)
+            if (dstTier != UVM_TIER_HOST) {
                 memset(dstPtr, 0, ps);
+                /* Direct shadow write: publish to the real-arena mirror
+                 * (every other HBM write rides the channel executor,
+                 * which notifies; this one must do it itself or chip
+                 * blocks keep the chunk's previous tenant's bytes). */
+                tpuHbmMirrorNotify(dstPtr, ps);
+            }
             p++;
             continue;
         }

@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -176,6 +176,24 @@ def decode_step(cfg: llama.LlamaConfig, params: Dict[str, Any],
     return logits, cache
 
 
+@partial(jax.jit, static_argnums=(0, 4))
+def decode_scan(cfg: llama.LlamaConfig, params: Dict[str, Any],
+                tokens: jax.Array, cache: PagedKVCache, n: int
+                ) -> Tuple[jax.Array, PagedKVCache, jax.Array]:
+    """Greedy-decode ``n`` tokens inside ONE jit (lax.scan over the
+    decode step) — a single device dispatch for the whole span, which is
+    what keeps decode throughput off the host-dispatch critical path.
+    Returns (next token [B], cache, decoded tokens [n, B])."""
+    def body(carry, _):
+        tok, cache = carry
+        logits, cache = decode_step(cfg, params, tok, cache)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (tok, cache), tok
+
+    (tok, cache), toks = jax.lax.scan(body, (tokens, cache), None, length=n)
+    return tok, cache, toks
+
+
 def generate(cfg: llama.LlamaConfig, params: Dict[str, Any],
              prompt: jax.Array, max_new_tokens: int,
              cache: Optional[PagedKVCache] = None,
@@ -205,72 +223,288 @@ def generate(cfg: llama.LlamaConfig, params: Dict[str, Any],
 
 # --------------------------------------------------------------- tiering
 
-class TieredKVCache:
-    """Paged KV pool backed by UVM managed memory, preferred tier CXL.
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(pool: jax.Array, idx: jax.Array,
+                   chunk: jax.Array) -> jax.Array:
+    """pool[:, idx] = chunk (idx [n], chunk [L, n, P, KV, D])."""
+    return pool.at[:, idx].set(chunk)
 
-    The pool (all layers' pages) lives in one managed allocation whose
-    preferred location is the CXL tier; ``touch_pages`` drives device
-    faults for exactly the pages a step reads (prefetch/thrashing
-    heuristics apply), and ``pool_arrays`` materializes the device-side
-    view for the compute.  This is the config #4 shape: KV >> HBM with
-    the hot working set resident device-side.
+
+@jax.jit
+def _gather_pages(pool: jax.Array, idx: jax.Array) -> jax.Array:
+    return pool[:, idx]
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class TieredKVCache:
+    """Oversubscribed paged KV cache over a UVM-managed backing store.
+
+    Config #4's shape (KV >> HBM): the device-resident slot pool holds
+    only ``1/oversub`` of the logical pages; the full pool lives in one
+    managed allocation whose preferred location is the CXL tier.
+    ``activate`` pins a group of sequences device-side: every missing
+    page is faulted device-ward through the UVM engine (device_access —
+    fault accounting, prefetch and thrashing heuristics, tier residency)
+    and its bytes are uploaded into a free slot, evicting
+    least-recently-used slots back to the managed pool first.  Upload
+    and flush move ONLY the pages that changed hands, batched through
+    jitted scatter/gather with power-of-two bucketing so step shapes
+    stay compiled.
+
+    The reference analog: UVM migrates pages into vidmem on GPU fault
+    and compute then reads them through the GMMU mapping
+    (uvm_va_block_make_resident, uvm_va_block.c:5086); JAX has no device
+    aliasing, so the "mapping" step is the slot upload.
+
+    ``oversub=1`` degenerates to a fully device-resident pool (after the
+    initial faults nothing ever evicts) — the dense baseline runs the
+    same code path, which is what makes tiered-vs-dense timing honest.
     """
 
     def __init__(self, cfg: llama.LlamaConfig, batch: int, max_len: int,
-                 page_size: int = 64, dev: int = 0):
+                 page_size: int = 64, oversub: int = 4, dev: int = 0):
         from .. import uvm
         from ..uvm.managed import Tier
 
         self.cfg = cfg
         self.page_size = page_size
         self.dev = dev
+        self.batch = batch
         m = (max_len + page_size - 1) // page_size
         self.pages_per_seq = m
-        n = batch * m
-        self.pool_shape = (cfg.num_layers, n, page_size, cfg.num_kv_heads,
-                           cfg.head_dim)
-        self.page_bytes = (page_size * cfg.num_kv_heads * cfg.head_dim *
-                           np.dtype(np.float32).itemsize)
-        pool_bytes = int(np.prod(self.pool_shape)) * 4  # fp32 host pool
+        self.total_pages = batch * m
+        self.n_slots = max(m, self.total_pages // max(1, oversub))
+        self.np_dtype = np.dtype(cfg.dtype)
 
+        page_elems = page_size * cfg.num_kv_heads * cfg.head_dim
+        self.page_shape = (page_size, cfg.num_kv_heads, cfg.head_dim)
+        self.page_bytes = page_elems * self.np_dtype.itemsize
+        self.pool_shape = (cfg.num_layers, self.total_pages) + self.page_shape
+
+        # Device slot pool.
+        slot_shape = (cfg.num_layers, self.n_slots) + self.page_shape
+        self.k_slots = jnp.zeros(slot_shape, cfg.dtype)
+        self.v_slots = jnp.zeros(slot_shape, cfg.dtype)
+
+        # Managed backing pool, preferred CXL, read-duplicated (device
+        # faults must not steal pages the CPU upload path re-reads).
+        pool_bytes = int(np.prod(self.pool_shape)) * self.np_dtype.itemsize
         self.vs = uvm.VaSpace(register_devices=(dev,))
         self.k_buf = self.vs.alloc(pool_bytes)
         self.v_buf = self.vs.alloc(pool_bytes)
         for buf in (self.k_buf, self.v_buf):
             buf.set_preferred(Tier.CXL)
-            buf.view(np.float32)[:] = 0.0
+            buf.view(self.np_dtype)[:] = 0
+            buf.set_read_duplication(True)
             buf.migrate(Tier.CXL)
-        self.page_table = (np.arange(batch)[:, None] * m +
-                           np.arange(m)[None, :]).astype(np.int32)
+
+        # Bookkeeping (host-side, tiny).
+        self.slot_owner = np.full((self.n_slots,), -1, np.int64)
+        self.slot_of = np.full((self.total_pages,), -1, np.int64)
+        self._lru: List[int] = list(range(self.n_slots))  # head = coldest
+        self._active_slots: set = set()
         self.seq_lens = np.zeros((batch,), np.int32)
+        self.last_token = np.zeros((batch,), np.int32)
+        self.stats = {"uploads": 0, "flushes": 0, "upload_bytes": 0,
+                      "activations": 0}
+
+    # ------------------------------------------------------------ views
 
     def k_view(self) -> np.ndarray:
-        return self.k_buf.view(np.float32, self.pool_shape)
+        return self.k_buf.view(self.np_dtype, self.pool_shape)
 
     def v_view(self) -> np.ndarray:
-        return self.v_buf.view(np.float32, self.pool_shape)
+        return self.v_buf.view(self.np_dtype, self.pool_shape)
 
-    def touch_pages(self, batch_idx: int) -> int:
-        """Fault the pages holding batch_idx's live tokens device-ward.
-        Returns the number of pages touched."""
-        sl = int(self.seq_lens[batch_idx])
-        npages = (sl + self.page_size - 1) // self.page_size
-        layer_stride = self.pool_shape[1] * self.page_bytes
-        for pg in range(npages):
-            page = int(self.page_table[batch_idx, pg])
-            for layer in range(self.cfg.num_layers):
-                off = layer * layer_stride + page * self.page_bytes
-                self.k_buf.device_access(dev=self.dev, offset=off,
-                                         length=self.page_bytes)
-                self.v_buf.device_access(dev=self.dev, offset=off,
-                                         length=self.page_bytes)
-        return npages
+    # ----------------------------------------------------- slot machine
 
-    def pool_arrays(self) -> Tuple[jax.Array, jax.Array]:
-        """Materialize the pool for device compute (dtype per config)."""
-        k = jnp.asarray(self.k_view(), dtype=self.cfg.dtype)
-        v = jnp.asarray(self.v_view(), dtype=self.cfg.dtype)
-        return k, v
+    def _touch_lru(self, slot: int) -> None:
+        self._lru.remove(slot)
+        self._lru.append(slot)
+
+    def _flush_slots(self, slots: List[int]) -> None:
+        """Write evicted slots' pages back to the managed pool."""
+        if not slots:
+            return
+        idx = np.array(slots, np.int32)
+        pad = _pad_pow2(len(slots))
+        if pad != len(slots):
+            idx = np.concatenate([idx, np.full(pad - len(slots), idx[-1],
+                                               np.int32)])
+        k_chunks = np.asarray(_gather_pages(self.k_slots, jnp.asarray(idx)))
+        v_chunks = np.asarray(_gather_pages(self.v_slots, jnp.asarray(idx)))
+        kv_view, vv_view = self.k_view(), self.v_view()
+        for i, s in enumerate(slots):
+            page = self.slot_owner[s]
+            kv_view[:, page] = k_chunks[:, i]
+            vv_view[:, page] = v_chunks[:, i]
+            self.slot_of[page] = -1
+            self.slot_owner[s] = -1
+        self.stats["flushes"] += len(slots)
+
+    def _evict_for(self, need: int) -> List[int]:
+        """Free `need` slots (LRU, skipping active), returning them."""
+        freed: List[int] = []
+        scan = 0
+        while len(freed) < need:
+            if scan >= len(self._lru):
+                raise RuntimeError(
+                    f"slot pool exhausted: need {need}, "
+                    f"{len(self._active_slots)} pinned of {self.n_slots}")
+            s = self._lru[scan]
+            if s in self._active_slots:
+                scan += 1
+                continue
+            if self.slot_owner[s] < 0:
+                self._lru.remove(s)
+                freed.append(s)
+                continue
+            self._lru.remove(s)
+            freed.append(s)
+        # Flush the ones that still own pages.
+        self._flush_slots([s for s in freed if self.slot_owner[s] >= 0])
+        return freed
+
+    def activate(self, seq_ids: Sequence[int], new_tokens: int
+                 ) -> PagedKVCache:
+        """Fault the group's pages device-side; return a decode view.
+
+        Pages covering each sequence's current tokens plus `new_tokens`
+        of growth become slot-resident and pinned until ``sync_from``.
+        """
+        from ..uvm.managed import Tier  # noqa: F401  (documents the tier)
+
+        self.stats["activations"] += 1
+        m, P = self.pages_per_seq, self.page_size
+        needed: List[int] = []
+        # Pin the group's already-resident slots BEFORE any eviction:
+        # _evict_for skips pinned slots, so a large activation can never
+        # reclaim (and silently zero the table entry of) a page this
+        # same group still needs.
+        for b in seq_ids:
+            npages = min(m, (int(self.seq_lens[b]) + new_tokens + P - 1) // P)
+            npages = max(npages, 1)
+            base = b * m
+            for pg in range(npages):
+                page = base + pg
+                s = self.slot_of[page]
+                if s < 0:
+                    needed.append(page)
+                else:
+                    self._touch_lru(int(s))
+                    self._active_slots.add(int(s))
+
+        if needed:
+            slots = self._evict_for(len(needed))
+            # UVM: drive the fault engine over each missing page's
+            # backing span (hotness, prefetch, thrashing, residency).
+            layer_stride = self.total_pages * self.page_bytes
+            for page in needed:
+                off = page * self.page_bytes
+                for layer in range(self.cfg.num_layers):
+                    span = layer * layer_stride + off
+                    self.k_buf.device_access(dev=self.dev, offset=span,
+                                             length=self.page_bytes)
+                    self.v_buf.device_access(dev=self.dev, offset=span,
+                                             length=self.page_bytes)
+            # Upload the missing pages into their slots (bucketed).
+            kv_view, vv_view = self.k_view(), self.v_view()
+            pages_np = np.array(needed, np.int64)
+            k_chunk = kv_view[:, pages_np]          # [L, n, P, KV, D] copy
+            v_chunk = vv_view[:, pages_np]
+            idx = np.array(slots, np.int32)
+            pad = _pad_pow2(len(slots))
+            if pad != len(slots):
+                fill = pad - len(slots)
+                idx = np.concatenate([idx, np.full(fill, idx[-1], np.int32)])
+                k_chunk = np.concatenate(
+                    [k_chunk, np.repeat(k_chunk[:, -1:], fill, axis=1)],
+                    axis=1)
+                v_chunk = np.concatenate(
+                    [v_chunk, np.repeat(v_chunk[:, -1:], fill, axis=1)],
+                    axis=1)
+            jidx = jnp.asarray(idx)
+            self.k_slots = _scatter_pages(self.k_slots, jidx,
+                                          jnp.asarray(k_chunk))
+            self.v_slots = _scatter_pages(self.v_slots, jidx,
+                                          jnp.asarray(v_chunk))
+            for page, s in zip(needed, slots):
+                self.slot_of[page] = s
+                self.slot_owner[s] = page
+                self._lru.append(s)
+                self._active_slots.add(int(s))
+            self.stats["uploads"] += len(needed)
+            self.stats["upload_bytes"] += (2 * len(needed) * self.page_bytes *
+                                           self.cfg.num_layers)
+
+        # Map the group's pages onto slots (entries past the resident
+        # span are masked by seq_lens in attention).
+        table = np.zeros((len(seq_ids), m), np.int32)
+        for i, b in enumerate(seq_ids):
+            base = b * m
+            live = min(m, (int(self.seq_lens[b]) + new_tokens + P - 1) // P)
+            for pg in range(m):
+                s = self.slot_of[base + pg]
+                if s >= 0:
+                    table[i, pg] = s
+                    self._active_slots.add(int(s))
+                elif pg < live:
+                    raise RuntimeError(
+                        f"seq {b} page {pg} lost its slot during "
+                        f"activation — slot pool too small for the group")
+        return PagedKVCache(
+            cfg=self.cfg, page_size=P,
+            k_pages=self.k_slots, v_pages=self.v_slots,
+            page_table=jnp.asarray(table),
+            seq_lens=jnp.asarray(self.seq_lens[np.array(seq_ids)]))
+
+    def sync_from(self, view: PagedKVCache, seq_ids: Sequence[int],
+                  last_tokens: Optional[np.ndarray] = None) -> None:
+        """Adopt the decode view's pool + lengths; unpin the group."""
+        self.k_slots = view.k_pages
+        self.v_slots = view.v_pages
+        self.seq_lens[np.array(seq_ids)] = np.asarray(view.seq_lens)
+        if last_tokens is not None:
+            self.last_token[np.array(seq_ids)] = np.asarray(last_tokens)
+        self._active_slots.clear()
 
     def close(self) -> None:
         self.vs.close()
+
+
+def prefill_group(cfg: llama.LlamaConfig, params: Dict[str, Any],
+                  cache: TieredKVCache, seq_ids, prompt: jax.Array) -> None:
+    """Prefill a group of sequences into the tiered cache."""
+    view = cache.activate(seq_ids, new_tokens=prompt.shape[1])
+    logits, view = prefill(cfg, params, prompt, view)
+    cache.sync_from(view, seq_ids,
+                    np.asarray(jnp.argmax(logits, axis=-1), np.int32))
+
+
+def decode_rounds(cfg: llama.LlamaConfig, params: Dict[str, Any],
+                  cache: TieredKVCache, groups, tokens_per_turn: int,
+                  turns: int) -> Tuple[int, float]:
+    """Round-robin grouped decode: each turn activates one group and
+    decodes ``tokens_per_turn`` for it — the config #4 serving shape
+    (many resident sequences, an active working set cycling through the
+    device pool).  Returns (decoded tokens, seconds)."""
+    total = 0
+    t0 = time.perf_counter()
+    tok = None
+    for _ in range(turns):
+        for g in groups:
+            view = cache.activate(g, new_tokens=tokens_per_turn)
+            tok = jnp.asarray(cache.last_token[np.array(g)])
+            tok, view, _ = decode_scan(cfg, params, tok, view,
+                                       tokens_per_turn)
+            cache.sync_from(view, g, np.asarray(tok, np.int32))
+            total += len(g) * tokens_per_turn
+    if tok is not None:
+        jax.block_until_ready(tok)
+    return total, time.perf_counter() - t0

