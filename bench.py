@@ -15,11 +15,13 @@ src/nvidia/src/kernel/gpu/bus/kern_bus_ctrl.c:772-775).
 Extra fields (recorded for trend + the round-3 additions):
   arena                    — real|fake backing of the metric of record
   oversub_fake_gbps        — same bench against the host-only arena
-  chip_upload_ceiling_gbps — raw device_put bandwidth (the transport
-                             ceiling the real-arena number is bound by)
-  arena_efficiency         — value / ceiling (north-star form: fraction
-                             of achievable device bandwidth sustained
-                             by the fault+evict pipeline)
+  chip_upload_ceiling_gbps — raw device_put bandwidth measured idle (the
+                             transport ceiling the real-arena number is
+                             bound by)
+  loaded_ceiling_gbps      — the same probe measured while the workload
+                             pool is alive (this environment's relay
+                             slows with process RSS, so this is the fair
+                             ceiling for the mirror stream)
   fault_p50_us/fault_p95_us— fault service latency (north star: µs-scale)
   mfu_flash_prefill        — flash-attention prefill MFU on the chip
   flash_tflops             — achieved TFLOP/s for the same kernel

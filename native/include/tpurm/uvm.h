@@ -319,6 +319,18 @@ typedef struct {
 } UvmFaultStats;
 void uvmFaultStatsGet(UvmFaultStats *out);
 
+/* ------------------------------------------------- external HBM chunks */
+
+/* Allocate a chunk of device HBM from the tier's PMM for pools that
+ * live outside the managed-VA world (ICI peer-mapped KV pool, peermem
+ * exports) — sharing the allocator with the fault engine instead of
+ * carving arena bytes privately.  size is rounded up to a power-of-two
+ * chunk (max 2 MB).  Reference analog: PMA serving both UVM and RM
+ * (uvm_pmm_gpu.h:27-47). */
+TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
+                           uint64_t *outOffset, void **outHandle);
+TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle);
+
 /* -------------------------------------------------------- suspend/resume */
 
 /* Global PM quiesce + device-arena save/restore (reference: fbsr.c FB

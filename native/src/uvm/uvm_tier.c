@@ -114,6 +114,46 @@ UvmTierArena *uvmTierArenaCxl(void)
     return g_tiers.cxlOk ? &g_tiers.cxl : NULL;
 }
 
+/* ------------------------------------- external HBM chunk allocation
+ *
+ * Pools that live IN device HBM but outside the managed-VA world (the
+ * ICI peer-mapped KV pool, peermem exports) must share the tier's PMM
+ * with the fault engine — carving arena bytes privately would collide
+ * with fault-driven residency (the whole arena belongs to the PMM).
+ * Reference analog: PMA serves both UVM and RM allocations from one
+ * per-GPU allocator (uvm_pmm_gpu.h:27-47 external/internal types). */
+
+TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
+                           uint64_t *outOffset, void **outHandle)
+{
+    if (!outOffset || !outHandle || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    UvmTierArena *a = uvmTierArenaHbm(devInst);
+    if (!a)
+        return TPU_ERR_INVALID_DEVICE;
+    uint64_t want = uvmPageSize();
+    while (want < size)
+        want <<= 1;
+    if (want > UVM_BLOCK_SIZE)
+        return TPU_ERR_INVALID_LIMIT;
+    UvmPmmChunk *chunk = NULL;
+    TpuStatus st = uvmPmmAlloc(&a->pmm, want, &chunk);
+    if (st != TPU_OK)
+        return st;
+    *outOffset = chunk->offset;
+    *outHandle = chunk;
+    return TPU_OK;
+}
+
+TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle)
+{
+    UvmTierArena *a = uvmTierArenaHbm(devInst);
+    if (!a || !handle)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uvmPmmFree(&a->pmm, handle);
+    return TPU_OK;
+}
+
 /* ------------------------------------------------------------------ LRU */
 
 static int lru_index(const UvmTierArena *a)

@@ -1,0 +1,211 @@
+"""Multi-chip KV pool: ICI peer-mapped HBM backing for TieredKVCache.
+
+BASELINE config #5 ("ICI peer-mapped HBM pool, Llama UVM multi-chip"):
+the logical KV pool spans SEVERAL devices' HBM arenas — each page has a
+home device — and the decode runs on device 0.  Activating a sequence
+whose pages are homed on a peer chip moves them over native ICI
+(tpuIciPeerCopy: dimension-ordered torus routing, per-hop traffic
+accounting, detour around FAILED links) into device 0's staging window,
+then uploads them into the compute slot pool; evicted pages ride ICI
+back to their home arena.
+
+This is the unification of the native ICI substrate with the JAX
+serving path: the same decode (serving.decode_rounds / decode_scan)
+runs unchanged, while every page miss/evict is a native peer-DMA with
+link-level observability — kill a link mid-decode and the pool keeps
+serving over the detour, visible in per-hop byte counters.
+
+Reference analog: P2P objects + UVM peer identity mappings
+(src/nvidia/src/kernel/gpu/bus/p2p_api.c:575, uvm.c:1035) — a remote
+GPU's vidmem mapped into the local device's address space, faulted and
+migrated by UVM.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from . import llama
+from ..runtime import ici, native
+
+
+class IciPoolBacking:
+    """KV backing striped across peer devices' HBM arenas.
+
+    Every page is a fixed-size record [k(L pages), v(L pages)]
+    (``record_bytes = 2 * L * page_bytes``) allocated from its home
+    device's HBM through the UVM tier PMM (uvmHbmChunkAlloc) — the same
+    allocator the fault engine draws from, so KV records and
+    fault-driven residency coexist in one arena without aliasing
+    (reference: PMA serving both UVM and RM, uvm_pmm_gpu.h:27-47).
+    Device 0 additionally holds a PMM-allocated staging window through
+    which remote records are fetched/flushed, so a whole record moves
+    as ONE ICI copy.
+    """
+
+    def __init__(self, pool_shape: Tuple[int, ...], np_dtype: np.dtype,
+                 page_bytes: int, n_devices: int, staging_records: int = 8):
+        self.pool_shape = pool_shape
+        self.np_dtype = np_dtype
+        self.page_bytes = page_bytes
+        self.num_layers = pool_shape[0]
+        self.total_pages = pool_shape[1]
+        self.n_devices = n_devices
+        self.record_bytes = 2 * self.num_layers * page_bytes
+        self.rec_shape = (2, self.num_layers) + pool_shape[2:]
+
+        lib = self._lib = native.load()
+        if lib.tpurmDeviceCount() < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {lib.tpurmDeviceCount()} "
+                f"(set TPUMEM_FAKE_TPU_COUNT before loading the lib)")
+        u32, u64, vp = ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p
+        lib.uvmHbmChunkAlloc.argtypes = [u32, u64, ctypes.POINTER(u64),
+                                         ctypes.POINTER(vp)]
+        lib.uvmHbmChunkAlloc.restype = u32
+        lib.uvmHbmChunkFree.argtypes = [u32, vp]
+        lib.uvmHbmChunkFree.restype = u32
+
+        # Home assignment: round-robin so every group's working set
+        # spreads across the pool (reference: fabric-wide striping).
+        self.home = np.arange(self.total_pages) % n_devices
+
+        self._arena: List[np.ndarray] = []
+        for d in range(n_devices):
+            dev = lib.tpurmDeviceGet(d)
+            base = lib.tpurmDeviceHbmBase(dev)
+            size = lib.tpurmDeviceHbmSize(dev)
+            self._arena.append(np.frombuffer(
+                (ctypes.c_char * size).from_address(base), np.uint8))
+
+        ici._lib()  # bind + lazy topology init
+        self._apertures: Dict[int, ici.PeerAperture] = {}
+        self.stats = {"ici_fetch_records": 0, "ici_flush_records": 0,
+                      "ici_bytes": 0}
+
+        # PMM-allocated record per page on its home device (+ zeroed:
+        # arena chunks may hold a previous tenant's bytes).
+        self._chunks: List[Tuple[int, ctypes.c_void_p]] = []
+        self.home_offset = np.zeros(self.total_pages, np.int64)
+        try:
+            for p in range(self.total_pages):
+                d = int(self.home[p])
+                self.home_offset[p] = self._chunk_alloc(d)
+                self._rec_raw(d, int(self.home_offset[p]))[:] = 0
+            self.staging_records = staging_records
+            self._staging = [self._chunk_alloc(0)
+                             for _ in range(staging_records)]
+        except Exception:
+            self.close()
+            raise
+
+    def _chunk_alloc(self, dev: int) -> int:
+        off = ctypes.c_uint64()
+        handle = ctypes.c_void_p()
+        st = self._lib.uvmHbmChunkAlloc(dev, self.record_bytes,
+                                        ctypes.byref(off),
+                                        ctypes.byref(handle))
+        if st != 0:
+            raise RuntimeError(
+                f"uvmHbmChunkAlloc(dev={dev}, {self.record_bytes}B) "
+                f"failed: 0x{st:x} (arena too small? raise "
+                f"TPUMEM_FAKE_HBM_MB)")
+        self._chunks.append((dev, handle))
+        return off.value
+
+    def _rec_raw(self, dev: int, offset: int) -> np.ndarray:
+        return self._arena[dev][offset:offset + self.record_bytes]
+
+    def _aperture(self, peer: int) -> ici.PeerAperture:
+        ap = self._apertures.get(peer)
+        if ap is None:
+            ap = ici.PeerAperture(0, peer)
+            self._apertures[peer] = ap
+        return ap
+
+    def _rec_view(self, dev: int, offset: int) -> np.ndarray:
+        return self._rec_raw(dev, offset).view(self.np_dtype).reshape(
+            self.rec_shape)
+
+    def _home_offset(self, page: int) -> Tuple[int, int]:
+        return int(self.home[page]), int(self.home_offset[page])
+
+    # ------------------------------------------------- backing protocol
+
+    def read_pages(self, pages: List[int]
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(pages)
+        k = np.empty((self.num_layers, n) + self.pool_shape[2:],
+                     self.np_dtype)
+        v = np.empty_like(k)
+        stage = 0
+        for i, page in enumerate(pages):
+            d, off = self._home_offset(page)
+            if d == 0:
+                rec = self._rec_view(0, off)
+            else:
+                # ONE ICI copy per record: peer arena -> local staging.
+                local = self._staging[stage % self.staging_records]
+                stage += 1
+                self._aperture(d).read(local, off, self.record_bytes)
+                self.stats["ici_fetch_records"] += 1
+                self.stats["ici_bytes"] += self.record_bytes
+                rec = self._rec_view(0, local)
+            k[:, i] = rec[0]
+            v[:, i] = rec[1]
+        return k, v
+
+    def write_page(self, page: int, k_rec: np.ndarray,
+                   v_rec: np.ndarray) -> None:
+        d, off = self._home_offset(page)
+        if d == 0:
+            rec = self._rec_view(0, off)
+            rec[0] = k_rec
+            rec[1] = v_rec
+            return
+        # Assemble in staging, then ONE ICI copy local -> peer home.
+        local = self._staging[0]        # flush is synchronous: slot 0
+        rec = self._rec_view(0, local)
+        rec[0] = k_rec
+        rec[1] = v_rec
+        self._aperture(d).write(local, off, self.record_bytes)
+        self.stats["ici_flush_records"] += 1
+        self.stats["ici_bytes"] += self.record_bytes
+
+    def close(self) -> None:
+        for ap in self._apertures.values():
+            ap.close()
+        self._apertures.clear()
+        for dev, handle in self._chunks:
+            self._lib.uvmHbmChunkFree(dev, handle)
+        self._chunks.clear()
+
+    # ------------------------------------------------- introspection
+
+    def link_traffic(self) -> Dict[str, int]:
+        """Per-link byte counters across all devices (reroute evidence)."""
+        out = {}
+        for d in range(self.n_devices):
+            for li in range(ici.link_count(d)):
+                info = ici.link_info(d, li)
+                out[f"{d}->({info.peer})"] = info.bytes_tx
+        return out
+
+
+def make_multichip_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
+                         page_size: int, oversub: int, n_devices: int):
+    """TieredKVCache whose backing is the ICI peer-mapped HBM pool."""
+    from .serving import TieredKVCache
+
+    np_dtype = np.dtype(cfg.dtype)
+    m = (max_len + page_size - 1) // page_size
+    pool_shape = (cfg.num_layers, batch * m, page_size, cfg.num_kv_heads,
+                  cfg.head_dim)
+    page_bytes = (page_size * cfg.num_kv_heads * cfg.head_dim *
+                  np_dtype.itemsize)
+    backing = IciPoolBacking(pool_shape, np_dtype, page_bytes, n_devices)
+    return TieredKVCache(cfg, batch, max_len, page_size=page_size,
+                         oversub=oversub, backing=backing)

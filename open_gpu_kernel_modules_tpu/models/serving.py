@@ -242,20 +242,81 @@ def _pad_pow2(n: int) -> int:
     return p
 
 
+class ManagedKVBacking:
+    """UVM-managed backing pool for TieredKVCache (config #4).
+
+    The full logical pool lives in one managed allocation whose
+    preferred location is the CXL tier, read-duplicated (device faults
+    must not steal pages the CPU upload path re-reads).  ``read_pages``
+    drives the fault engine over each page's span (hotness, prefetch,
+    thrashing, tier residency) before handing the bytes up.
+    """
+
+    def __init__(self, pool_shape: Tuple[int, ...], np_dtype: np.dtype,
+                 page_bytes: int, dev: int):
+        from .. import uvm
+        from ..uvm.managed import Tier
+
+        self.pool_shape = pool_shape
+        self.np_dtype = np_dtype
+        self.page_bytes = page_bytes
+        self.total_pages = pool_shape[1]
+        self.num_layers = pool_shape[0]
+        self.dev = dev
+        pool_bytes = int(np.prod(pool_shape)) * np_dtype.itemsize
+        self.vs = uvm.VaSpace(register_devices=(dev,))
+        self.k_buf = self.vs.alloc(pool_bytes)
+        self.v_buf = self.vs.alloc(pool_bytes)
+        for buf in (self.k_buf, self.v_buf):
+            buf.set_preferred(Tier.CXL)
+            buf.view(np_dtype)[:] = 0
+            buf.set_read_duplication(True)
+            buf.migrate(Tier.CXL)
+
+    def k_view(self) -> np.ndarray:
+        return self.k_buf.view(self.np_dtype, self.pool_shape)
+
+    def v_view(self) -> np.ndarray:
+        return self.v_buf.view(self.np_dtype, self.pool_shape)
+
+    def read_pages(self, pages: List[int]
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fault + fetch pages; returns (k, v) chunks [L, n, P, KV, D]."""
+        layer_stride = self.total_pages * self.page_bytes
+        for page in pages:
+            off = page * self.page_bytes
+            for layer in range(self.num_layers):
+                span = layer * layer_stride + off
+                self.k_buf.device_access(dev=self.dev, offset=span,
+                                         length=self.page_bytes)
+                self.v_buf.device_access(dev=self.dev, offset=span,
+                                         length=self.page_bytes)
+        idx = np.array(pages, np.int64)
+        return self.k_view()[:, idx], self.v_view()[:, idx]
+
+    def write_page(self, page: int, k_rec: np.ndarray,
+                   v_rec: np.ndarray) -> None:
+        self.k_view()[:, page] = k_rec
+        self.v_view()[:, page] = v_rec
+
+    def close(self) -> None:
+        self.vs.close()
+
+
 class TieredKVCache:
-    """Oversubscribed paged KV cache over a UVM-managed backing store.
+    """Oversubscribed paged KV cache over a tiered backing store.
 
     Config #4's shape (KV >> HBM): the device-resident slot pool holds
-    only ``1/oversub`` of the logical pages; the full pool lives in one
-    managed allocation whose preferred location is the CXL tier.
+    only ``1/oversub`` of the logical pages; the full pool lives in the
+    backing store (default: ManagedKVBacking — UVM managed memory,
+    preferred tier CXL; models/multichip.py provides an ICI peer-pool
+    backing spanning other chips' HBM arenas for config #5).
     ``activate`` pins a group of sequences device-side: every missing
-    page is faulted device-ward through the UVM engine (device_access —
-    fault accounting, prefetch and thrashing heuristics, tier residency)
-    and its bytes are uploaded into a free slot, evicting
-    least-recently-used slots back to the managed pool first.  Upload
-    and flush move ONLY the pages that changed hands, batched through
-    jitted scatter/gather with power-of-two bucketing so step shapes
-    stay compiled.
+    page is faulted in through the backing and its bytes are uploaded
+    into a free slot, evicting least-recently-used slots back to the
+    backing first.  Upload and flush move ONLY the pages that changed
+    hands, batched through jitted scatter/gather with power-of-two
+    bucketing so step shapes stay compiled.
 
     The reference analog: UVM migrates pages into vidmem on GPU fault
     and compute then reads them through the GMMU mapping
@@ -268,10 +329,8 @@ class TieredKVCache:
     """
 
     def __init__(self, cfg: llama.LlamaConfig, batch: int, max_len: int,
-                 page_size: int = 64, oversub: int = 4, dev: int = 0):
-        from .. import uvm
-        from ..uvm.managed import Tier
-
+                 page_size: int = 64, oversub: int = 4, dev: int = 0,
+                 backing=None):
         self.cfg = cfg
         self.page_size = page_size
         self.dev = dev
@@ -292,22 +351,14 @@ class TieredKVCache:
         self.k_slots = jnp.zeros(slot_shape, cfg.dtype)
         self.v_slots = jnp.zeros(slot_shape, cfg.dtype)
 
-        # Managed backing pool, preferred CXL, read-duplicated (device
-        # faults must not steal pages the CPU upload path re-reads).
-        pool_bytes = int(np.prod(self.pool_shape)) * self.np_dtype.itemsize
-        self.vs = uvm.VaSpace(register_devices=(dev,))
-        self.k_buf = self.vs.alloc(pool_bytes)
-        self.v_buf = self.vs.alloc(pool_bytes)
-        for buf in (self.k_buf, self.v_buf):
-            buf.set_preferred(Tier.CXL)
-            buf.view(self.np_dtype)[:] = 0
-            buf.set_read_duplication(True)
-            buf.migrate(Tier.CXL)
+        self.backing = backing if backing is not None else ManagedKVBacking(
+            self.pool_shape, self.np_dtype, self.page_bytes, dev)
 
         # Bookkeeping (host-side, tiny).
         self.slot_owner = np.full((self.n_slots,), -1, np.int64)
         self.slot_of = np.full((self.total_pages,), -1, np.int64)
-        self._lru: List[int] = list(range(self.n_slots))  # head = coldest
+        # Insertion-ordered dict as an O(1) LRU: first key = coldest.
+        self._lru: Dict[int, None] = dict.fromkeys(range(self.n_slots))
         self._active_slots: set = set()
         self.seq_lens = np.zeros((batch,), np.int32)
         self.last_token = np.zeros((batch,), np.int32)
@@ -315,18 +366,28 @@ class TieredKVCache:
                       "activations": 0}
 
     # ------------------------------------------------------------ views
+    # (available only on backings that expose a host view — the managed
+    # backing does; the ICI pool is reached via read_pages/write_page)
+
+    @property
+    def k_buf(self):
+        return self.backing.k_buf
+
+    @property
+    def v_buf(self):
+        return self.backing.v_buf
 
     def k_view(self) -> np.ndarray:
-        return self.k_buf.view(self.np_dtype, self.pool_shape)
+        return self.backing.k_view()
 
     def v_view(self) -> np.ndarray:
-        return self.v_buf.view(self.np_dtype, self.pool_shape)
+        return self.backing.v_view()
 
     # ----------------------------------------------------- slot machine
 
     def _touch_lru(self, slot: int) -> None:
-        self._lru.remove(slot)
-        self._lru.append(slot)
+        self._lru.pop(slot, None)
+        self._lru[slot] = None          # reinsert at warm end
 
     def _flush_slots(self, slots: List[int]) -> None:
         """Write evicted slots' pages back to the managed pool."""
@@ -339,35 +400,28 @@ class TieredKVCache:
                                                np.int32)])
         k_chunks = np.asarray(_gather_pages(self.k_slots, jnp.asarray(idx)))
         v_chunks = np.asarray(_gather_pages(self.v_slots, jnp.asarray(idx)))
-        kv_view, vv_view = self.k_view(), self.v_view()
         for i, s in enumerate(slots):
-            page = self.slot_owner[s]
-            kv_view[:, page] = k_chunks[:, i]
-            vv_view[:, page] = v_chunks[:, i]
+            page = int(self.slot_owner[s])
+            self.backing.write_page(page, k_chunks[:, i], v_chunks[:, i])
             self.slot_of[page] = -1
             self.slot_owner[s] = -1
         self.stats["flushes"] += len(slots)
 
     def _evict_for(self, need: int) -> List[int]:
-        """Free `need` slots (LRU, skipping active), returning them."""
+        """Free `need` slots (LRU order, skipping active), returning
+        them.  Slots that still own a page are flushed to the backing."""
         freed: List[int] = []
-        scan = 0
-        while len(freed) < need:
-            if scan >= len(self._lru):
-                raise RuntimeError(
-                    f"slot pool exhausted: need {need}, "
-                    f"{len(self._active_slots)} pinned of {self.n_slots}")
-            s = self._lru[scan]
+        for s in list(self._lru):
+            if len(freed) == need:
+                break
             if s in self._active_slots:
-                scan += 1
                 continue
-            if self.slot_owner[s] < 0:
-                self._lru.remove(s)
-                freed.append(s)
-                continue
-            self._lru.remove(s)
+            del self._lru[s]
             freed.append(s)
-        # Flush the ones that still own pages.
+        if len(freed) < need:
+            raise RuntimeError(
+                f"slot pool exhausted: need {need}, "
+                f"{len(self._active_slots)} pinned of {self.n_slots}")
         self._flush_slots([s for s in freed if self.slot_owner[s] >= 0])
         return freed
 
@@ -378,8 +432,6 @@ class TieredKVCache:
         Pages covering each sequence's current tokens plus `new_tokens`
         of growth become slot-resident and pinned until ``sync_from``.
         """
-        from ..uvm.managed import Tier  # noqa: F401  (documents the tier)
-
         self.stats["activations"] += 1
         m, P = self.pages_per_seq, self.page_size
         needed: List[int] = []
@@ -402,22 +454,10 @@ class TieredKVCache:
 
         if needed:
             slots = self._evict_for(len(needed))
-            # UVM: drive the fault engine over each missing page's
-            # backing span (hotness, prefetch, thrashing, residency).
-            layer_stride = self.total_pages * self.page_bytes
-            for page in needed:
-                off = page * self.page_bytes
-                for layer in range(self.cfg.num_layers):
-                    span = layer * layer_stride + off
-                    self.k_buf.device_access(dev=self.dev, offset=span,
-                                             length=self.page_bytes)
-                    self.v_buf.device_access(dev=self.dev, offset=span,
-                                             length=self.page_bytes)
-            # Upload the missing pages into their slots (bucketed).
-            kv_view, vv_view = self.k_view(), self.v_view()
-            pages_np = np.array(needed, np.int64)
-            k_chunk = kv_view[:, pages_np]          # [L, n, P, KV, D] copy
-            v_chunk = vv_view[:, pages_np]
+            # Fault + fetch through the backing (UVM fault engine for the
+            # managed backing; ICI peer copies for the multi-chip pool),
+            # then upload into the freed slots (bucketed).
+            k_chunk, v_chunk = self.backing.read_pages(needed)
             idx = np.array(slots, np.int32)
             pad = _pad_pow2(len(slots))
             if pad != len(slots):
@@ -437,7 +477,7 @@ class TieredKVCache:
             for page, s in zip(needed, slots):
                 self.slot_of[page] = s
                 self.slot_owner[s] = page
-                self._lru.append(s)
+                self._lru[s] = None
                 self._active_slots.add(int(s))
             self.stats["uploads"] += len(needed)
             self.stats["upload_bytes"] += (2 * len(needed) * self.page_bytes *
@@ -475,7 +515,7 @@ class TieredKVCache:
         self._active_slots.clear()
 
     def close(self) -> None:
-        self.vs.close()
+        self.backing.close()
 
 
 def prefill_group(cfg: llama.LlamaConfig, params: Dict[str, Any],

@@ -1,0 +1,111 @@
+"""Config #5: KV pool spanning device arenas over native ICI, under a
+real Llama decode, surviving a link failure mid-decode via reroute.
+
+Runs in a subprocess with TPUMEM_FAKE_TPU_COUNT=4 because the native
+device table is process-global and other tests expect one device.
+
+Done-criteria from VERDICT r2 task 3: model output is correct (exact
+token match vs the single-chip dense run) and per-hop ICI traffic
+counters prove the reroute happened.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+import os
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+
+from open_gpu_kernel_modules_tpu.models import llama, serving, multichip
+from open_gpu_kernel_modules_tpu.runtime import ici
+
+cfg = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=128)
+cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+params = llama.init_params(cfg, jax.random.key(0))
+prompts = jax.random.randint(jax.random.key(7), (4, 9), 0, cfg.vocab_size)
+groups = [[0, 1], [2, 3]]
+
+def run_dense():
+    cache = serving.TieredKVCache(cfg, batch=4, max_len=64, page_size=8,
+                                  oversub=1)
+    try:
+        for g in groups:
+            serving.prefill_group(cfg, params, cache, g,
+                                  prompts[np.array(g)])
+        serving.decode_rounds(cfg, params, cache, groups, 3, 2)
+        serving.decode_rounds(cfg, params, cache, groups, 3, 2)
+        return np.array(cache.last_token)
+    finally:
+        cache.close()
+
+def run_multichip():
+    out = {}
+    cache = multichip.make_multichip_cache(cfg, batch=4, max_len=64,
+                                           page_size=8, oversub=4,
+                                           n_devices=4)
+    try:
+        for g in groups:
+            serving.prefill_group(cfg, params, cache, g,
+                                  prompts[np.array(g)])
+        serving.decode_rounds(cfg, params, cache, groups, 3, 2)
+
+        # Kill the direct 0<->1 link MID-DECODE; dimension-ordered
+        # routing must detour the ring (1 hop -> 3 hops).
+        direct = next(l for l in range(ici.link_count(0))
+                      if ici.link_info(0, l).peer == 1)
+        before = cache.backing.link_traffic()
+        ici.inject_link_failure(0, direct)
+        out["detour_hops"] = ici.route_hops(0, 1)
+
+        serving.decode_rounds(cfg, params, cache, groups, 3, 2)
+        after = cache.backing.link_traffic()
+
+        out["tokens"] = [int(t) for t in cache.last_token]
+        out["stats"] = dict(cache.backing.stats)
+        # Reroute evidence: traffic to dev-1 pages now rides the other
+        # ring direction (0->3), which must have grown.
+        out["tx_0_3_delta"] = after["0->(3)"] - before["0->(3)"]
+        out["tx_growth"] = {k: after[k] - before[k] for k in after}
+        return out
+    finally:
+        cache.close()
+
+dense_tokens = [int(t) for t in run_dense()]
+mc = run_multichip()
+mc["dense_tokens"] = dense_tokens
+print(json.dumps(mc))
+"""
+
+
+def test_multichip_decode_with_link_failure():
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    env["TPUMEM_FAKE_HBM_MB"] = "64"
+    script = _SCRIPT % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Correctness: multi-chip decode across a mid-decode link failure
+    # produced exactly the single-chip tokens.
+    assert out["tokens"] == out["dense_tokens"]
+
+    # The pool genuinely moved pages over ICI.
+    assert out["stats"]["ici_fetch_records"] > 0
+    assert out["stats"]["ici_flush_records"] > 0
+
+    # Reroute evidence: the direct link is out (3-hop detour), and the
+    # detour direction carried new traffic after the failure.
+    assert out["detour_hops"] == 3
+    assert out["tx_0_3_delta"] > 0
