@@ -181,11 +181,15 @@ def measure_flash_mfu(batch: int = 8, seq: int = 4096, heads: int = 16,
         return time.perf_counter() - t0
 
     chain(1)                                    # warm dispatch path
-    t_n = min(chain(iters) for _ in range(2))
-    t_2n = min(chain(2 * iters) for _ in range(2))
-    if t_2n <= t_n:
+    dt = None
+    for _ in range(3):
+        t_n = min(chain(iters) for _ in range(2))
+        t_2n = min(chain(2 * iters) for _ in range(2))
+        if t_2n > t_n:
+            dt = (t_2n - t_n) / iters
+            break
+    if dt is None:
         return {}           # jitter swamped the signal: report nothing
-    dt = (t_2n - t_n) / iters
 
     # Causal attention math: QK^T and PV are each 2*b*h*s^2*d MACs ->
     # 4*b*h*s^2*d FLOPs, halved by causal masking.

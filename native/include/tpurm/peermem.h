@@ -84,6 +84,8 @@ typedef struct TpuDmabuf TpuDmabuf;
 TpuStatus  tpuDmabufExport(uint32_t devInst, uint64_t offset, uint64_t size,
                            TpuDmabuf **out);
 TpuStatus  tpuDmabufImport(TpuDmabuf *buf, void **ptr, uint64_t *size);
+TpuStatus  tpuDmabufInfo(TpuDmabuf *buf, uint32_t *devInst,
+                         uint64_t *offset, uint64_t *size);
 void       tpuDmabufPut(TpuDmabuf *buf);   /* drop one reference */
 TpuDmabuf *tpuDmabufGet(TpuDmabuf *buf);   /* take one reference */
 

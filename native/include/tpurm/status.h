@@ -17,6 +17,7 @@ typedef uint32_t TpuStatus;
 #define TPU_ERR_GPU_IS_LOST               0x0000000Fu
 #define TPU_ERR_INSERT_DUPLICATE_NAME     0x00000019u
 #define TPU_ERR_INSUFFICIENT_RESOURCES    0x0000001Au
+#define TPU_ERR_INVALID_ADDRESS           0x0000001Eu
 #define TPU_ERR_INVALID_ARGUMENT          0x0000001Fu
 #define TPU_ERR_INVALID_CLASS             0x00000022u
 #define TPU_ERR_INVALID_CLIENT            0x00000023u

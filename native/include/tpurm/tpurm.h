@@ -44,6 +44,12 @@ int tpurm_close(int pfd);
 /* Emulates ioctl(2) on a pseudo-fd: returns 0 on success (RM status is in
  * the param block), -1 with errno on transport errors. */
 int tpurm_ioctl(int pfd, unsigned long request, void *argp);
+/* Emulates mmap(2) on the uvm pseudo-fd (reference uvm_mmap, uvm.c:792):
+ * allocates a managed range, returns its base or MAP_FAILED.  The
+ * companion munmap hook frees the range; it returns 1 when it consumed
+ * the call (the interposer then skips the real munmap). */
+void *tpurm_mmap(int pfd, size_t length);
+int   tpurm_munmap_hook(void *addr, size_t length);
 
 /* ------------------------------------------------------- direct C API */
 

@@ -72,7 +72,9 @@ extern "C" {
 #define UVM_TOOLS_EVENT_QUEUE_DISABLE_EVENTS 59
 #define UVM_TOOLS_ENABLE_COUNTERS         60
 #define UVM_TOOLS_DISABLE_COUNTERS        61
+#define UVM_MAP_EXTERNAL_ALLOCATION       33
 #define UVM_TOOLS_GET_PROCESSOR_UUID_TABLE 64
+#define UVM_UNMAP_EXTERNAL                66
 #define UVM_TOOLS_FLUSH_EVENTS            67
 #define UVM_CREATE_EXTERNAL_RANGE         73
 
@@ -201,6 +203,34 @@ typedef struct {
     TpuStatus rmStatus;
 } UvmRunTestParams;
 
+/* External ranges (reference: UVM_CREATE_EXTERNAL_RANGE_PARAMS,
+ * uvm_ioctl.h:1042; UVM_UNMAP_EXTERNAL_PARAMS:935 — ours omits gpuUuid
+ * because the mapped window is a CPU-visible alias, not a per-GPU VA). */
+typedef struct {
+    uint64_t base   __attribute__((aligned(8)));   /* IN */
+    uint64_t length __attribute__((aligned(8)));   /* IN */
+    TpuStatus rmStatus;                            /* OUT */
+} UvmExternalRangeParams;
+
+/* Map a dmabuf window into an external range (reference:
+ * UVM_MAP_EXTERNAL_ALLOCATION_PARAMS, uvm_ioctl.h:491 — rmCtrlFd/
+ * hClient/hMemory collapse to the dmabuf handle from tpuDmabufExport). */
+typedef struct {
+    uint64_t base         __attribute__((aligned(8)));  /* IN */
+    uint64_t length       __attribute__((aligned(8)));  /* IN */
+    uint64_t offset       __attribute__((aligned(8)));  /* IN: into buf */
+    uint64_t dmabufHandle __attribute__((aligned(8)));  /* IN */
+    TpuStatus rmStatus;                                 /* OUT */
+} UvmMapExternalAllocationParams;
+
+/* Processor UUID table (reference: uvm_ioctl.h:913): entry 0 = CPU,
+ * then one per registered-visible device, then the CXL tier. */
+typedef struct {
+    uint64_t tablePtr __attribute__((aligned(8)));  /* IN: UvmProcessorUuid[] */
+    uint64_t count    __attribute__((aligned(8)));  /* IN: capacity, OUT: n */
+    TpuStatus rmStatus;                             /* OUT */
+} UvmToolsGetProcessorUuidTableParams;
+
 /* UVM_TOOLS_* param blocks (reference shapes, uvm_ioctl.h:822-948,
  * trimmed to the in-process session model: the reference's user-supplied
  * mmap'd queue buffers are replaced by the session ring, so the buffer
@@ -319,6 +349,27 @@ typedef struct {
 } UvmFaultStats;
 void uvmFaultStatsGet(UvmFaultStats *out);
 
+/* ------------------------------------------------- external mappings */
+
+/* External VA ranges (reference: uvm_map_external.c; ioctls 73/33/66).
+ * The caller reserves VA (mmap PROT_NONE) and registers [base, base+
+ * length) as an EXTERNAL range — no managed semantics, no fault
+ * servicing.  uvmMapExternal then maps a dmabuf window (device HBM
+ * exported via tpuDmabufExport) into a span of the range: the span
+ * becomes a CPU-visible alias of the same arena bytes the channels
+ * DMA (memfd-backed arena).  Freeing the range (uvmMemFree on base)
+ * unmaps every window and restores the caller's PROT_NONE reservation.
+ * uvmExternalFlush publishes CPU writes through the alias to the
+ * real-arena mirror stream (writes through an alias bypass the channel
+ * executors that normally notify). */
+struct TpuDmabuf;
+TpuStatus uvmExternalRangeCreate(UvmVaSpace *vs, void *base,
+                                 uint64_t length);
+TpuStatus uvmMapExternal(UvmVaSpace *vs, void *base, uint64_t length,
+                         struct TpuDmabuf *buf, uint64_t bufOffset);
+TpuStatus uvmUnmapExternal(UvmVaSpace *vs, void *base, uint64_t length);
+TpuStatus uvmExternalFlush(UvmVaSpace *vs, void *base, uint64_t length);
+
 /* ------------------------------------------------- external HBM chunks */
 
 /* Allocate a chunk of device HBM from the tier's PMM for pools that
@@ -406,6 +457,7 @@ enum {
     UVM_TPU_TEST_ACCESS_COUNTERS      = 10,
     UVM_TPU_TEST_REPLAY_CANCEL        = 11,
     UVM_TPU_TEST_SUSPEND_RESUME       = 12,
+    UVM_TPU_TEST_EXTERNAL_RANGE       = 13,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

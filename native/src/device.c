@@ -17,6 +17,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
+#include <unistd.h>
 
 #define MAX_DEVICES 16
 
@@ -48,12 +49,29 @@ static void device_init_once(void)
         /* MAP_POPULATE: commit the arena up front — real HBM has no
          * demand-zero cost, and without this every first-touch write in
          * the migration path pays kernel page clearing (~6x slowdown on
-         * the copy, measured). Registry fake_hbm_prefault=0 disables. */
+         * the copy, measured). Registry fake_hbm_prefault=0 disables.
+         *
+         * The arena is memfd-backed (MAP_SHARED) so spans of it can be
+         * aliased into UVM external ranges (uvm_map_external analog:
+         * dmabuf handle -> CPU-visible window onto the same bytes);
+         * falls back to anonymous memory when memfd is unavailable
+         * (external mapping then reports NOT_SUPPORTED). */
         int populate = tpuRegistryGet("fake_hbm_prefault", 1)
                            ? MAP_POPULATE
                            : 0;
-        dev->hbmBase = mmap(NULL, hbmBytes, PROT_READ | PROT_WRITE,
-                            MAP_PRIVATE | MAP_ANONYMOUS | populate, -1, 0);
+        dev->hbmFd = memfd_create("tpurm-hbm", MFD_CLOEXEC);
+        if (dev->hbmFd >= 0 &&
+            ftruncate(dev->hbmFd, (off_t)hbmBytes) != 0) {
+            close(dev->hbmFd);
+            dev->hbmFd = -1;
+        }
+        if (dev->hbmFd >= 0)
+            dev->hbmBase = mmap(NULL, hbmBytes, PROT_READ | PROT_WRITE,
+                                MAP_SHARED | populate, dev->hbmFd, 0);
+        else
+            dev->hbmBase = mmap(NULL, hbmBytes, PROT_READ | PROT_WRITE,
+                                MAP_PRIVATE | MAP_ANONYMOUS | populate,
+                                -1, 0);
         if (dev->hbmBase == MAP_FAILED) {
             tpuLog(TPU_LOG_ERROR, "device",
                    "HBM arena mmap failed for dev %u (%llu bytes)", i,

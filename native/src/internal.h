@@ -104,6 +104,7 @@ struct TpurmDevice {
     bool lost;
     void *hbmBase;             /* coherent shadow of device HBM  */
     uint64_t hbmSize;
+    int hbmFd;                 /* memfd backing the arena (-1: anon) */
     TpurmChannel *ce;          /* legacy shared CE channel (== cePool[0]) */
     /* CE channel pool (reference: channel pools per CE type,
      * uvm_channel.c): large copies stripe across the pool so the
@@ -152,6 +153,12 @@ uint64_t  tpuCxlPinnedBytes(void);
 void *tpuUvmFdOpen(void);
 void  tpuUvmFdClose(void *state);
 int   tpuUvmFdIoctl(void *state, unsigned long request, void *argp);
+/* mmap surface (reference uvm_mmap, uvm.c:792): allocate a managed
+ * range through a uvm fd; the hook frees it on interposed munmap
+ * (returns 1 when it consumed the call). */
+int   tpuUvmFdMmap(void *state, uint64_t length, void **outBase);
+int   tpuUvmMunmapHook(void *addr, uint64_t length);
+void  uvmMmapRegistryOnRangeDestroy(uint64_t base);
 
 /* -------------------------------------------------------------- transfer  */
 

@@ -17,8 +17,9 @@
  * far above the kernel fd space (rmapi.c PSEUDO_FD_BASE), so classifying
  * an fd is a range check and no real descriptor can collide.
  *
- * mmap needs no interposition: the walker's buffers are MAP_ANONYMOUS
- * (reference tests/cxl_p2p_test.c:419-430), never device mappings.
+ * mmap on a uvm pseudo-fd creates a managed range (reference uvm_mmap,
+ * uvm.c:792) and the matching munmap frees it; all other mmap/munmap
+ * traffic forwards untouched.
  */
 #define _GNU_SOURCE
 #include "tpurm/tpurm.h"
@@ -28,6 +29,7 @@
 #include <stdarg.h>
 #include <fcntl.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <sys/types.h>
 
 #define PSEUDO_FD_BASE 0x40000000
@@ -142,6 +144,43 @@ int ioctl(int fd, unsigned long request, ...)
         return -1;
     }
     return real(fd, request, argp);
+}
+
+/* ------------------------------------------------------------ mmap/munmap */
+
+#define DEFINE_MMAP(name, off_t_type)                                      \
+void *name(void *addr, size_t length, int prot, int flags, int fd,         \
+           off_t_type offset)                                               \
+{                                                                          \
+    if (fd >= 0 && is_pseudo_fd(fd))                                       \
+        return tpurm_mmap(fd, length);                                     \
+    typedef void *(*fn)(void *, size_t, int, int, int, off_t_type);        \
+    static fn real;                                                        \
+    if (!real)                                                             \
+        real = (fn)dlsym(RTLD_NEXT, #name);                                \
+    if (!real) {                                                           \
+        errno = ENOSYS;                                                    \
+        return MAP_FAILED;                                                 \
+    }                                                                      \
+    return real(addr, length, prot, flags, fd, offset);                    \
+}
+
+DEFINE_MMAP(mmap, off_t)
+DEFINE_MMAP(mmap64, off64_t)
+
+int munmap(void *addr, size_t length)
+{
+    if (tpurm_munmap_hook(addr, length))
+        return 0;
+    typedef int (*fn)(void *, size_t);
+    static fn real;
+    if (!real)
+        real = (fn)dlsym(RTLD_NEXT, "munmap");
+    if (!real) {
+        errno = ENOSYS;
+        return -1;
+    }
+    return real(addr, length);
 }
 
 /* ----------------------------------------------------------------- close */

@@ -324,6 +324,20 @@ TpuStatus tpuDmabufImport(TpuDmabuf *buf, void **ptr, uint64_t *size)
     return TPU_OK;
 }
 
+TpuStatus tpuDmabufInfo(TpuDmabuf *buf, uint32_t *devInst, uint64_t *offset,
+                        uint64_t *size)
+{
+    if (!buf)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (devInst)
+        *devInst = buf->devInst;
+    if (offset)
+        *offset = buf->offset;
+    if (size)
+        *size = buf->size;
+    return TPU_OK;
+}
+
 TpuDmabuf *tpuDmabufGet(TpuDmabuf *buf)
 {
     if (buf)

@@ -23,6 +23,7 @@
 #include <errno.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
 
 #define MAX_CLIENTS 64
 #define MAX_PSEUDO_FDS 256
@@ -547,6 +548,46 @@ static int tpurm_ioctl_dispatch(unsigned long request, void *argp)
         errno = ENOTTY;
         return -1;
     }
+}
+
+void *tpurm_mmap(int pfd, size_t length)
+{
+    int idx = pfd - PSEUDO_FD_BASE;
+    if (idx < 0 || idx >= MAX_PSEUDO_FDS) {
+        errno = EBADF;
+        return MAP_FAILED;
+    }
+    pthread_mutex_lock(&g_fds.lock);
+    PseudoFd *fd = &g_fds.fds[idx];
+    if (!fd->used || fd->closing) {
+        pthread_mutex_unlock(&g_fds.lock);
+        errno = EBADF;
+        return MAP_FAILED;
+    }
+    if (fd->kind != PFD_UVM) {
+        pthread_mutex_unlock(&g_fds.lock);
+        errno = ENODEV;          /* only the uvm node supports mmap */
+        return MAP_FAILED;
+    }
+    fd->refs++;
+    void *uvmState = fd->uvmState;
+    pthread_mutex_unlock(&g_fds.lock);
+
+    void *base = NULL;
+    int rc = tpuUvmFdMmap(uvmState, length, &base);
+
+    pthread_mutex_lock(&g_fds.lock);
+    fd->refs--;
+    if (fd->closing && fd->refs == 0)
+        fd_finalize_locked(fd);
+    else
+        pthread_mutex_unlock(&g_fds.lock);
+    return rc == 0 ? base : MAP_FAILED;
+}
+
+int tpurm_munmap_hook(void *addr, size_t length)
+{
+    return tpuUvmMunmapHook(addr, length);
 }
 
 int tpurm_ioctl(int pfd, unsigned long request, void *argp)

@@ -212,7 +212,8 @@ void uvmFaultSnapshotRebuild(void)
         pthread_mutex_lock(&vs->lock);
         for (UvmRangeTreeNode *n = vs->ranges.first; n;
              n = uvmRangeTreeNext(n))
-            count++;
+            if (((UvmVaRange *)n)->type == UVM_RANGE_TYPE_MANAGED)
+                count++;
         pthread_mutex_unlock(&vs->lock);
     }
     Snapshot *ns = malloc(sizeof(Snapshot) + count * sizeof(SnapEntry));
@@ -225,6 +226,10 @@ void uvmFaultSnapshotRebuild(void)
         pthread_mutex_lock(&vs->lock);
         for (UvmRangeTreeNode *n = vs->ranges.first;
              n && i < count; n = uvmRangeTreeNext(n)) {
+            /* EXTERNAL ranges take no fault service: a fault on an
+             * unmapped span is a real segfault. */
+            if (((UvmVaRange *)n)->type != UVM_RANGE_TYPE_MANAGED)
+                continue;
             ns->entries[i].start = n->start;
             ns->entries[i].end = n->end;
             ns->entries[i].vs = vs;

@@ -224,7 +224,18 @@ typedef struct UvmVaRange {
     /* Blocks, one per 2 MB span. */
     UvmVaBlock **blocks;
     uint32_t blockCount;
+    /* EXTERNAL ranges: list of live dmabuf windows mapped into the
+     * range (uvm_map_external.c analog). */
+    struct UvmExtMapping *extMappings;
 } UvmVaRange;
+
+typedef struct UvmExtMapping {
+    uint64_t start, len;              /* VA span within the range */
+    struct TpuDmabuf *buf;            /* referenced while mapped */
+    uint32_t devInst;
+    uint64_t arenaOff;                /* dmabuf offset + map offset */
+    struct UvmExtMapping *next;
+} UvmExtMapping;
 
 struct UvmVaSpace {
     pthread_mutex_t lock;             /* order TPU_LOCK_UVM_VASPACE */

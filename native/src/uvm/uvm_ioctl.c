@@ -67,6 +67,8 @@ static bool uuid_to_location(const UvmProcessorUuid *u, UvmLocation *out)
 
 /* ------------------------------------------------------------ fd plumbing */
 
+static void mmap_registry_purge(UvmFdState *fd);
+
 void *tpuUvmFdOpen(void)
 {
     UvmFdState *fd = calloc(1, sizeof(UvmFdState));
@@ -80,6 +82,11 @@ void tpuUvmFdClose(void *state)
     UvmFdState *fd = state;
     if (!fd)
         return;
+    /* Purge BEFORE taking fd->lock: the munmap hook holds the registry
+     * lock across its fd->lock acquisition, so close must never hold
+     * fd->lock while waiting on the registry (lock-order: registry
+     * first, fd->lock second, everywhere). */
+    mmap_registry_purge(fd);
     pthread_rwlock_wrlock(&fd->lock);
     if (fd->tools)
         uvmToolsSessionDestroy(fd->tools);
@@ -90,6 +97,131 @@ void tpuUvmFdClose(void *state)
     pthread_rwlock_unlock(&fd->lock);
     pthread_rwlock_destroy(&fd->lock);
     free(fd);
+}
+
+/* ------------------------------------------------------------ mmap surface
+ *
+ * The reference creates managed ranges by mmap'ing /dev/nvidia-uvm
+ * (uvm_mmap, reference uvm.c:792) — the vma IS the managed range and
+ * munmap frees it via vm_ops.  Analog: mmap on a uvm pseudo-fd routes
+ * here, allocates a managed range in the fd's VA space, and records the
+ * (base -> fd) association so the interposed munmap can free it. */
+
+typedef struct MmapRangeReg {
+    uintptr_t base;
+    uint64_t len;
+    UvmFdState *fd;
+    struct MmapRangeReg *next;
+} MmapRangeReg;
+
+static pthread_mutex_t g_mmapLock = PTHREAD_MUTEX_INITIALIZER;
+static MmapRangeReg *g_mmapHead;
+
+int tpuUvmFdMmap(void *state, uint64_t length, void **outBase)
+{
+    UvmFdState *fd = state;
+    if (!fd || !outBase || length == 0) {
+        errno = EINVAL;
+        return -1;
+    }
+    MmapRangeReg *reg = calloc(1, sizeof(*reg));
+    if (!reg) {
+        errno = ENOMEM;
+        return -1;
+    }
+    pthread_rwlock_rdlock(&fd->lock);
+    if (!fd->vs) {
+        pthread_rwlock_unlock(&fd->lock);
+        free(reg);
+        errno = EINVAL;          /* mmap before UVM_INITIALIZE */
+        return -1;
+    }
+    void *base = NULL;
+    TpuStatus st = uvmMemAlloc(fd->vs, length, &base);
+    pthread_rwlock_unlock(&fd->lock);
+    if (st != TPU_OK) {
+        free(reg);
+        errno = ENOMEM;
+        return -1;
+    }
+    reg->base = (uintptr_t)base;
+    reg->len = length;
+    reg->fd = fd;
+    pthread_mutex_lock(&g_mmapLock);
+    reg->next = g_mmapHead;
+    g_mmapHead = reg;
+    pthread_mutex_unlock(&g_mmapLock);
+    *outBase = base;
+    return 0;
+}
+
+int tpuUvmMunmapHook(void *addr, uint64_t length)
+{
+    (void)length;   /* like the reference vma teardown, the whole range
+                     * goes (partial munmap of a managed range is not a
+                     * supported split operation here) */
+    /* Unlink FIRST, free with no registry lock held: range_destroy
+     * munmaps the range VA, which under the LD_PRELOAD shim re-enters
+     * this hook — the entry being already gone makes that re-entry a
+     * harmless miss instead of a self-deadlock on g_mmapLock. */
+    pthread_mutex_lock(&g_mmapLock);
+    MmapRangeReg *found = NULL;
+    for (MmapRangeReg **pp = &g_mmapHead; *pp; pp = &(*pp)->next) {
+        if ((*pp)->base == (uintptr_t)addr) {
+            found = *pp;
+            *pp = found->next;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_mmapLock);
+    if (!found)
+        return 0;
+    UvmFdState *fd = found->fd;
+    /* fd stays valid: tpuUvmFdClose purges the registry before tearing
+     * the state down, and it cannot have purged this entry (we held it
+     * until the unlink above; a racing close now simply finds the
+     * registry without it and proceeds — the rdlock below orders us
+     * against the actual VA-space destruction). */
+    pthread_rwlock_rdlock(&fd->lock);
+    if (fd->vs)
+        uvmMemFree(fd->vs, addr);
+    pthread_rwlock_unlock(&fd->lock);
+    free(found);
+    return 1;
+}
+
+/* Called by range_destroy for EVERY managed range teardown: frees done
+ * through UVM_FREE/uvmMemFree (not munmap) must still drop their
+ * registry entry, or a later munmap at a recycled address would be
+ * falsely consumed against a dangling fd. */
+void uvmMmapRegistryOnRangeDestroy(uint64_t base)
+{
+    pthread_mutex_lock(&g_mmapLock);
+    for (MmapRangeReg **pp = &g_mmapHead; *pp; pp = &(*pp)->next) {
+        if ((*pp)->base == base) {
+            MmapRangeReg *dead = *pp;
+            *pp = dead->next;
+            free(dead);
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_mmapLock);
+}
+
+static void mmap_registry_purge(UvmFdState *fd)
+{
+    pthread_mutex_lock(&g_mmapLock);
+    MmapRangeReg **pp = &g_mmapHead;
+    while (*pp) {
+        if ((*pp)->fd == fd) {
+            MmapRangeReg *dead = *pp;
+            *pp = dead->next;
+            free(dead);          /* ranges die with the VA space */
+        } else {
+            pp = &(*pp)->next;
+        }
+    }
+    pthread_mutex_unlock(&g_mmapLock);
 }
 
 /* ---------------------------------------------------------------- dispatch */
@@ -322,6 +454,54 @@ static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
     case UVM_RUN_TEST: {
         UvmRunTestParams *p = argp;
         p->rmStatus = uvmRunTest(vs, p->testCmd);
+        return 0;
+    }
+    case UVM_CREATE_EXTERNAL_RANGE: {
+        UvmExternalRangeParams *p = argp;
+        p->rmStatus = uvmExternalRangeCreate(
+            vs, (void *)(uintptr_t)p->base, p->length);
+        return 0;
+    }
+    case UVM_MAP_EXTERNAL_ALLOCATION: {
+        UvmMapExternalAllocationParams *p = argp;
+        p->rmStatus = uvmMapExternal(
+            vs, (void *)(uintptr_t)p->base, p->length,
+            (struct TpuDmabuf *)(uintptr_t)p->dmabufHandle, p->offset);
+        return 0;
+    }
+    case UVM_UNMAP_EXTERNAL: {
+        UvmExternalRangeParams *p = argp;
+        p->rmStatus = uvmUnmapExternal(
+            vs, (void *)(uintptr_t)p->base, p->length);
+        return 0;
+    }
+    case UVM_TOOLS_GET_PROCESSOR_UUID_TABLE: {
+        UvmToolsGetProcessorUuidTableParams *p = argp;
+        UvmProcessorUuid *table =
+            (UvmProcessorUuid *)(uintptr_t)p->tablePtr;
+        uint32_t ndev = tpurmDeviceCount();
+        uint64_t needed = 1 + (uint64_t)ndev + 1;  /* CPU + devs + CXL */
+        if (!table) {
+            p->rmStatus = TPU_ERR_INVALID_ARGUMENT;
+            return 0;
+        }
+        if (p->count < needed) {
+            /* No silent truncation: report the required capacity. */
+            p->count = needed;
+            p->rmStatus = TPU_ERR_INVALID_LIMIT;
+            return 0;
+        }
+        uint64_t n = 0;
+        memset(&table[n++], 0, sizeof(table[0]));        /* CPU */
+        for (uint32_t d = 0; d < ndev; d++)
+            uuid_for_device(d, &table[n++]);
+        memset(&table[n], 0, sizeof(table[0]));          /* CXL tier */
+        table[n].uuid[0] = 'C';
+        table[n].uuid[1] = 'X';
+        table[n].uuid[2] = 'L';
+        n++;
+        p->count = n;
+        p->rmStatus = TPU_OK;
         return 0;
     }
     case UVM_TOOLS_INIT_EVENT_TRACKER: {

@@ -45,6 +45,12 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
     TpuStatus st = TPU_OK;
     while (n) {
         UvmVaRange *range = (UvmVaRange *)n;
+        if (range->type != UVM_RANGE_TYPE_MANAGED) {
+            /* External ranges have no migration state (reference:
+             * uvm_migrate rejects non-managed VA with INVALID_ADDRESS). */
+            st = TPU_ERR_INVALID_ADDRESS;
+            break;
+        }
         if (!uvmRangeGroupMigratable(vs, range->rangeGroupId)) {
             /* Fenced by UvmPreventMigrationRangeGroups: skip, not error
              * (reference returns success and leaves pages in place). */

@@ -8,10 +8,13 @@
  */
 #include "uvm_internal.h"
 
+#include "tpurm/peermem.h"
+
 #include <pthread.h>
 #include <stdatomic.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <time.h>
 
 #define CHECK(cond)                                                      \
@@ -647,6 +650,75 @@ static TpuStatus test_suspend_resume(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* -------------------------------------------------- external ranges */
+
+static TpuStatus test_external_range(UvmVaSpace *vs)
+{
+    uint64_t ps = uvmPageSize();
+    uint64_t len = 4 * ps;
+
+    TpurmDevice *dev = tpurmDeviceGet(0);
+    CHECK(dev != NULL);
+    if (dev->hbmFd < 0)
+        return TPU_OK;            /* anon-arena fallback: nothing to map */
+
+    /* Caller-reserved VA, as the reference's user mmap provides. */
+    void *base = mmap(NULL, len, PROT_NONE,
+                      MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
+    CHECK(base != MAP_FAILED);
+    CHECK(uvmExternalRangeCreate(vs, base, len) == TPU_OK);
+    /* Double registration collides. */
+    CHECK(uvmExternalRangeCreate(vs, base, len) != TPU_OK);
+
+    /* Policy/migration ops reject the external type. */
+    UvmLocation cxl = { .tier = UVM_TIER_CXL, .devInst = 0 };
+    CHECK(uvmSetPreferredLocation(vs, base, len, cxl) ==
+          TPU_ERR_INVALID_ADDRESS);
+    CHECK(uvmMigrate(vs, base, len, cxl, 0) == TPU_ERR_INVALID_ADDRESS);
+
+    /* Export a device-HBM window as a dmabuf; map it into the range. */
+    uint64_t arenaOff = 16 * ps;     /* arbitrary in-arena spot */
+    TpuDmabuf *buf = NULL;
+    CHECK(tpuDmabufExport(0, arenaOff, 2 * ps, &buf) == TPU_OK);
+    CHECK(uvmMapExternal(vs, base, 2 * ps, buf, 0) == TPU_OK);
+    /* Overlapping second window is rejected. */
+    CHECK(uvmMapExternal(vs, (char *)base + ps, ps, buf, 0) ==
+          TPU_ERR_INVALID_ADDRESS);
+
+    /* The window is a live alias of the arena bytes: writes through one
+     * side are visible through the other, and the channel engine sees
+     * them (this is the property external mappings exist for). */
+    volatile uint8_t *win = base;
+    uint8_t *arena = (uint8_t *)tpurmDeviceHbmBase(dev) + arenaOff;
+    win[7] = 0xBE;
+    CHECK(arena[7] == 0xBE);
+    arena[ps + 3] = 0xEF;
+    CHECK(win[ps + 3] == 0xEF);
+    uint8_t probe = 0;
+    uint64_t v = tpurmChannelPushCopy(dev->ce, &probe,
+                                      (const void *)&win[7], 1);
+    CHECK(v != 0 && tpurmChannelWait(dev->ce, v) == TPU_OK);
+    CHECK(probe == 0xBE);
+
+    /* Flush publishes the span to the mirror without error. */
+    CHECK(uvmExternalFlush(vs, base, 2 * ps) == TPU_OK);
+
+    /* Unmap restores PROT_NONE over the window... */
+    CHECK(uvmUnmapExternal(vs, base, 2 * ps) == TPU_OK);
+    /* ...and unknown windows fail. */
+    CHECK(uvmUnmapExternal(vs, base, 2 * ps) == TPU_ERR_OBJECT_NOT_FOUND);
+
+    /* Re-map, then free the whole range: mappings die with it and the
+     * caller's reservation survives (we can still munmap it). */
+    CHECK(uvmMapExternal(vs, base, ps, buf, ps) == TPU_OK);
+    CHECK(arena[ps + 3] == 0xEF);
+    CHECK(((volatile uint8_t *)base)[3] == 0xEF);  /* bufOffset=ps view */
+    CHECK(uvmMemFree(vs, base) == TPU_OK);
+    tpuDmabufPut(buf);
+    CHECK(munmap(base, len) == 0);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -676,6 +748,8 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return vs ? test_replay_cancel(vs) : TPU_ERR_INVALID_ARGUMENT;
     case UVM_TPU_TEST_SUSPEND_RESUME:
         return vs ? test_suspend_resume(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_EXTERNAL_RANGE:
+        return vs ? test_external_range(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

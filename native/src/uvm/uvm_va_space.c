@@ -15,6 +15,8 @@
 #define _GNU_SOURCE
 #include "uvm_internal.h"
 
+#include "tpurm/peermem.h"
+
 #include <sched.h>
 #include <stdlib.h>
 #include <string.h>
@@ -59,8 +61,20 @@ void uvmSetRangeDestroyHook(UvmRangeDestroyHook hook)
     g_rangeDestroyHook = hook;
 }
 
+static void ext_unmap_span(UvmVaRange *range, UvmExtMapping *m)
+{
+    /* Restore the caller's reservation over the window. */
+    mmap((void *)(uintptr_t)m->start, m->len, PROT_NONE,
+         MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE | MAP_FIXED, -1, 0);
+    (void)range;
+    tpuDmabufPut(m->buf);
+}
+
 static void range_destroy(UvmVaSpace *vs, UvmVaRange *range)
 {
+    /* Drop any mmap-surface registry entry BEFORE the munmap below: a
+     * shim-interposed munmap re-entering the hook must miss. */
+    uvmMmapRegistryOnRangeDestroy(range->node.start);
     if (g_rangeDestroyHook)
         g_rangeDestroyHook(range->node.start, range->size);
     for (uint32_t i = 0; i < range->blockCount; i++) {
@@ -72,7 +86,19 @@ static void range_destroy(UvmVaSpace *vs, UvmVaRange *range)
         free(blk);
     }
     free(range->blocks);
+    while (range->extMappings) {
+        UvmExtMapping *m = range->extMappings;
+        range->extMappings = m->next;
+        ext_unmap_span(range, m);
+        free(m);
+    }
     uvmRangeTreeRemove(&vs->ranges, &range->node);
+    if (range->type == UVM_RANGE_TYPE_EXTERNAL) {
+        /* The VA reservation belongs to the caller (they mmap'd it);
+         * dropping the range must not yank it out from under them. */
+        free(range);
+        return;
+    }
     munmap((void *)(uintptr_t)range->node.start, range->size);
     if (range->alias)
         munmap(range->alias, range->size);
@@ -345,6 +371,16 @@ static TpuStatus for_ranges_in(UvmVaSpace *vs, void *base, uint64_t len,
         vs_unlock(vs);
         return TPU_ERR_OBJECT_NOT_FOUND;
     }
+    /* Validation pre-pass: policy is a managed-range concept, and the
+     * whole span must qualify BEFORE any range is mutated (the
+     * reference validates types up front; failing midway would leave
+     * earlier ranges silently updated under an error return). */
+    for (UvmRangeTreeNode *c = n; c; c = uvmRangeTreeIterNext(c, end)) {
+        if (((UvmVaRange *)c)->type != UVM_RANGE_TYPE_MANAGED) {
+            vs_unlock(vs);
+            return TPU_ERR_INVALID_ADDRESS;
+        }
+    }
     while (n) {
         fn((UvmVaRange *)n, arg);
         n = uvmRangeTreeIterNext(n, end);
@@ -537,6 +573,177 @@ bool uvmRangeGroupMigratable(UvmVaSpace *vs, uint64_t groupId)
         return true;
     UvmRangeGroup *g = group_find(vs, groupId);
     return g ? g->migratable : true;
+}
+
+/* ------------------------------------------------------ external ranges */
+
+TpuStatus uvmExternalRangeCreate(UvmVaSpace *vs, void *base, uint64_t length)
+{
+    if (!vs || !base || length == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    /* External mappings work at OS-page granularity (they are real
+     * mmap windows), unlike managed ranges' 64 KB UVM pages. */
+    uint64_t ps = (uint64_t)sysconf(_SC_PAGESIZE);
+    if (((uintptr_t)base & (ps - 1)) || (length & (ps - 1)))
+        return TPU_ERR_INVALID_ADDRESS;
+
+    UvmVaRange *range = calloc(1, sizeof(*range));
+    if (!range)
+        return TPU_ERR_NO_MEMORY;
+    range->node.start = (uintptr_t)base;
+    range->node.end = (uintptr_t)base + length - 1;
+    range->vaSpace = vs;
+    range->type = UVM_RANGE_TYPE_EXTERNAL;
+    range->size = length;
+    range->memfd = -1;
+
+    vs_lock(vs);
+    TpuStatus st = uvmRangeTreeAdd(&vs->ranges, &range->node);
+    vs_unlock(vs);
+    if (st != TPU_OK) {
+        free(range);
+        return st;
+    }
+    /* No snapshot rebuild: external ranges are intentionally NOT in the
+     * fault snapshot (faults on unmapped spans are real segfaults, not
+     * managed work), so the managed-only snapshot is unchanged. */
+    return TPU_OK;
+}
+
+static UvmVaRange *ext_range_find(UvmVaSpace *vs, void *base, uint64_t len)
+{
+    UvmVaBlock *blk;
+    UvmVaRange *range = uvmRangeFind(vs, (uintptr_t)base, &blk);
+    if (!range || range->type != UVM_RANGE_TYPE_EXTERNAL)
+        return NULL;
+    if ((uintptr_t)base + len - 1 > range->node.end)
+        return NULL;
+    return range;
+}
+
+TpuStatus uvmMapExternal(UvmVaSpace *vs, void *base, uint64_t length,
+                         struct TpuDmabuf *buf, uint64_t bufOffset)
+{
+    if (!vs || !base || length == 0 || !buf)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t ps = (uint64_t)sysconf(_SC_PAGESIZE);
+    if (((uintptr_t)base & (ps - 1)) || (length & (ps - 1)) ||
+        (bufOffset & (ps - 1)))
+        return TPU_ERR_INVALID_ADDRESS;
+
+    uint32_t devInst;
+    uint64_t dOff, dSize;
+    TpuStatus st = tpuDmabufInfo(buf, &devInst, &dOff, &dSize);
+    if (st != TPU_OK)
+        return st;
+    if (bufOffset > dSize || length > dSize - bufOffset)
+        return TPU_ERR_INVALID_LIMIT;
+    TpurmDevice *dev = tpurmDeviceGet(devInst);
+    if (!dev)
+        return TPU_ERR_INVALID_DEVICE;
+    if (dev->hbmFd < 0)
+        return TPU_ERR_NOT_SUPPORTED;   /* anon-arena fallback in use */
+
+    vs_lock(vs);
+    UvmVaRange *range = ext_range_find(vs, base, length);
+    if (!range) {
+        vs_unlock(vs);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    /* Reject overlap with a live window (reference rejects remap). */
+    for (UvmExtMapping *m = range->extMappings; m; m = m->next) {
+        if ((uintptr_t)base < m->start + m->len &&
+            m->start < (uintptr_t)base + length) {
+            vs_unlock(vs);
+            return TPU_ERR_INVALID_ADDRESS;
+        }
+    }
+    UvmExtMapping *m = calloc(1, sizeof(*m));
+    if (!m) {
+        vs_unlock(vs);
+        return TPU_ERR_NO_MEMORY;
+    }
+    uint64_t arenaOff = dOff + bufOffset;
+    if (arenaOff & (ps - 1)) {
+        /* The dmabuf window itself must land on an OS page boundary. */
+        free(m);
+        vs_unlock(vs);
+        return TPU_ERR_INVALID_ADDRESS;
+    }
+    if (mmap(base, length, PROT_READ | PROT_WRITE,
+             MAP_SHARED | MAP_FIXED, dev->hbmFd,
+             (off_t)arenaOff) == MAP_FAILED) {
+        free(m);
+        vs_unlock(vs);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+    m->start = (uintptr_t)base;
+    m->len = length;
+    m->buf = tpuDmabufGet(buf);
+    m->devInst = devInst;
+    m->arenaOff = arenaOff;
+    m->next = range->extMappings;
+    range->extMappings = m;
+    vs_unlock(vs);
+    tpuCounterAdd("uvm_external_maps", 1);
+    return TPU_OK;
+}
+
+TpuStatus uvmUnmapExternal(UvmVaSpace *vs, void *base, uint64_t length)
+{
+    if (!vs || !base || length == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    UvmVaRange *range = ext_range_find(vs, base, length);
+    if (!range) {
+        vs_unlock(vs);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    UvmExtMapping **pp = &range->extMappings;
+    while (*pp) {
+        UvmExtMapping *m = *pp;
+        if (m->start == (uintptr_t)base && m->len == length) {
+            *pp = m->next;
+            ext_unmap_span(range, m);
+            free(m);
+            vs_unlock(vs);
+            return TPU_OK;
+        }
+        pp = &m->next;
+    }
+    vs_unlock(vs);
+    return TPU_ERR_OBJECT_NOT_FOUND;
+}
+
+TpuStatus uvmExternalFlush(UvmVaSpace *vs, void *base, uint64_t length)
+{
+    if (!vs || !base || length == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    UvmVaRange *range = ext_range_find(vs, base, length);
+    if (!range) {
+        vs_unlock(vs);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    /* Publish every mapped window intersecting [base, base+length) to
+     * the real-arena mirror (CPU writes through the alias bypass the
+     * channel executors that normally notify). */
+    for (UvmExtMapping *m = range->extMappings; m; m = m->next) {
+        uint64_t lo = m->start > (uintptr_t)base ? m->start
+                                                 : (uintptr_t)base;
+        uint64_t hi = m->start + m->len < (uintptr_t)base + length
+                          ? m->start + m->len
+                          : (uintptr_t)base + length;
+        if (lo >= hi)
+            continue;
+        TpurmDevice *dev = tpurmDeviceGet(m->devInst);
+        if (dev && dev->hbmBase)
+            tpuHbmMirrorNotify((char *)dev->hbmBase + m->arenaOff +
+                                   (lo - m->start),
+                               hi - lo);
+    }
+    vs_unlock(vs);
+    return TPU_OK;
 }
 
 /* --------------------------------------------------------- introspection */

@@ -1,0 +1,86 @@
+/*
+ * mmap-surface test, run UNDER THE LD_PRELOAD SHIM (Makefile runs it
+ * with libtpurm_interpose.so preloaded): plain open/ioctl/mmap/munmap
+ * against /dev/nvidia-uvm, the way reference userspace drives uvm_mmap
+ * (reference uvm.c:792).  Exercises the interposed-munmap re-entrancy
+ * path (range_destroy's internal munmap binds to the shim's symbol) and
+ * the UVM_FREE-then-munmap ordering, both of which deadlocked in review.
+ */
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/ioctl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#define UVM_INITIALIZE 0x30000001
+#define UVM_FREE       34
+
+typedef struct {
+    uint64_t flags;
+    uint32_t rmStatus;
+} InitParams;
+
+typedef struct {
+    uint64_t base __attribute__((aligned(8)));
+    uint64_t length __attribute__((aligned(8)));
+    uint32_t rmStatus;
+} FreeParams;
+
+#define CHECK(cond)                                                     \
+    do {                                                                \
+        if (!(cond)) {                                                  \
+            fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,     \
+                    #cond);                                             \
+            exit(1);                                                    \
+        }                                                               \
+    } while (0)
+
+int main(void)
+{
+    int fd = open("/dev/nvidia-uvm", O_RDWR);
+    CHECK(fd >= 0);
+
+    /* mmap before INITIALIZE is rejected. */
+    void *early = mmap(NULL, 1 << 20, PROT_READ | PROT_WRITE, MAP_SHARED,
+                       fd, 0);
+    CHECK(early == MAP_FAILED);
+
+    InitParams init = { 0, 0 };
+    CHECK(ioctl(fd, UVM_INITIALIZE, &init) == 0 && init.rmStatus == 0);
+
+    /* mmap creates a managed range; plain stores fault + populate it. */
+    size_t len = 1 << 20;
+    volatile uint8_t *p = mmap(NULL, len, PROT_READ | PROT_WRITE,
+                               MAP_SHARED, fd, 0);
+    CHECK(p != MAP_FAILED);
+    for (size_t i = 0; i < len; i += 4096)
+        p[i] = (uint8_t)(i >> 12);
+    CHECK(p[8 * 4096] == 8);
+
+    /* munmap frees the range through the interposed hook (this is the
+     * re-entrancy path: range teardown munmaps internally). */
+    CHECK(munmap((void *)p, len) == 0);
+
+    /* Second range freed via the UVM_FREE ioctl instead; the later
+     * munmap of the (now dead) VA must NOT be consumed by the hook. */
+    volatile uint8_t *q = mmap(NULL, len, PROT_READ | PROT_WRITE,
+                               MAP_SHARED, fd, 0);
+    CHECK(q != MAP_FAILED);
+    q[123] = 0x5A;
+    FreeParams fp = { (uint64_t)(uintptr_t)q, len, 0 };
+    CHECK(ioctl(fd, UVM_FREE, &fp) == 0 && fp.rmStatus == 0);
+
+    /* Plain anonymous mmap/munmap still work untouched. */
+    void *anon = mmap(NULL, 4096, PROT_READ | PROT_WRITE,
+                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    CHECK(anon != MAP_FAILED);
+    memset(anon, 7, 4096);
+    CHECK(munmap(anon, 4096) == 0);
+
+    CHECK(close(fd) == 0);
+    printf("uvm_mmap_shim_test OK\n");
+    return 0;
+}

@@ -66,6 +66,7 @@ int main(void)
     run_module_test(fd, UVM_TPU_TEST_ACCESS_COUNTERS, "access_counters");
     run_module_test(fd, UVM_TPU_TEST_REPLAY_CANCEL, "replay_cancel");
     run_module_test(fd, UVM_TPU_TEST_SUSPEND_RESUME, "suspend_resume");
+    run_module_test(fd, UVM_TPU_TEST_EXTERNAL_RANGE, "external_range");
 
     /* ---- managed lifecycle over the raw ABI ---- */
     UvmTpuAllocManagedParams alloc = { .length = 8 << 20 };
