@@ -458,6 +458,7 @@ enum {
     UVM_TPU_TEST_REPLAY_CANCEL        = 11,
     UVM_TPU_TEST_SUSPEND_RESUME       = 12,
     UVM_TPU_TEST_EXTERNAL_RANGE       = 13,
+    UVM_TPU_TEST_RANGE_SPLIT          = 14,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

@@ -152,8 +152,16 @@ int ioctl(int fd, unsigned long request, ...)
 void *name(void *addr, size_t length, int prot, int flags, int fd,         \
            off_t_type offset)                                               \
 {                                                                          \
-    if (fd >= 0 && is_pseudo_fd(fd))                                       \
+    if (fd >= 0 && is_pseudo_fd(fd)) {                                     \
+        /* The engine picks the VA: honoring a MAP_FIXED/addr-hinted or  \
+         * offset request is not possible, so fail loudly rather than   \
+         * succeed at a different address than the caller required. */     \
+        if (addr != NULL || offset != 0 || (flags & MAP_FIXED)) {          \
+            errno = EINVAL;                                                \
+            return MAP_FAILED;                                             \
+        }                                                                  \
         return tpurm_mmap(fd, length);                                     \
+    }                                                                      \
     typedef void *(*fn)(void *, size_t, int, int, int, off_t_type);        \
     static fn real;                                                        \
     if (!real)                                                             \

@@ -207,6 +207,9 @@ typedef struct UvmVaRange {
     UvmVaSpace *vaSpace;
     UvmRangeType type;
     uint64_t size;
+    /* Original allocation extent, preserved across splits: uvmMemFree
+     * on the allocation base frees every fragment. */
+    uint64_t allocStart, allocSize;
     /* Managed host backing: a memfd mapped twice — the user VA (node
      * start; protection-controlled, faults drive migration) and an
      * engine alias that is always RW.  The copy engine reads/writes the

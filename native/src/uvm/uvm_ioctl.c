@@ -28,6 +28,12 @@ typedef struct {
     pthread_rwlock_t lock;
     UvmVaSpace *vs;              /* NULL until UVM_INITIALIZE */
     UvmToolsSession *tools;      /* NULL until TOOLS_INIT_EVENT_TRACKER */
+    /* Pin count for paths that reach this state OUTSIDE the rmapi fd
+     * table (the munmap hook): close waits for pins to drain before
+     * tearing the state down. */
+    pthread_mutex_t pinLock;
+    pthread_cond_t pinCond;
+    uint32_t pins;
 } UvmFdState;
 
 /* ------------------------------------------------------- uuid conversion */
@@ -72,8 +78,11 @@ static void mmap_registry_purge(UvmFdState *fd);
 void *tpuUvmFdOpen(void)
 {
     UvmFdState *fd = calloc(1, sizeof(UvmFdState));
-    if (fd)
+    if (fd) {
         pthread_rwlock_init(&fd->lock, NULL);
+        pthread_mutex_init(&fd->pinLock, NULL);
+        pthread_cond_init(&fd->pinCond, NULL);
+    }
     return fd;
 }
 
@@ -87,6 +96,13 @@ void tpuUvmFdClose(void *state)
      * fd->lock while waiting on the registry (lock-order: registry
      * first, fd->lock second, everywhere). */
     mmap_registry_purge(fd);
+    /* Wait for hook-held pins: a hook that unlinked its entry before
+     * our purge still owns a pin taken under the registry lock (where
+     * the fd was provably alive); destruction must not race it. */
+    pthread_mutex_lock(&fd->pinLock);
+    while (fd->pins > 0)
+        pthread_cond_wait(&fd->pinCond, &fd->pinLock);
+    pthread_mutex_unlock(&fd->pinLock);
     pthread_rwlock_wrlock(&fd->lock);
     if (fd->tools)
         uvmToolsSessionDestroy(fd->tools);
@@ -96,6 +112,8 @@ void tpuUvmFdClose(void *state)
     fd->vs = NULL;
     pthread_rwlock_unlock(&fd->lock);
     pthread_rwlock_destroy(&fd->lock);
+    pthread_mutex_destroy(&fd->pinLock);
+    pthread_cond_destroy(&fd->pinCond);
     free(fd);
 }
 
@@ -173,19 +191,26 @@ int tpuUvmMunmapHook(void *addr, uint64_t length)
             break;
         }
     }
+    UvmFdState *fd = found ? found->fd : NULL;
+    if (fd) {
+        /* Pin the fd state WHILE the registry lock still proves it
+         * alive (close purges the registry before freeing, under this
+         * same lock): close then waits for the pin to drain. */
+        pthread_mutex_lock(&fd->pinLock);
+        fd->pins++;
+        pthread_mutex_unlock(&fd->pinLock);
+    }
     pthread_mutex_unlock(&g_mmapLock);
     if (!found)
         return 0;
-    UvmFdState *fd = found->fd;
-    /* fd stays valid: tpuUvmFdClose purges the registry before tearing
-     * the state down, and it cannot have purged this entry (we held it
-     * until the unlink above; a racing close now simply finds the
-     * registry without it and proceeds — the rdlock below orders us
-     * against the actual VA-space destruction). */
     pthread_rwlock_rdlock(&fd->lock);
     if (fd->vs)
         uvmMemFree(fd->vs, addr);
     pthread_rwlock_unlock(&fd->lock);
+    pthread_mutex_lock(&fd->pinLock);
+    fd->pins--;
+    pthread_cond_broadcast(&fd->pinCond);
+    pthread_mutex_unlock(&fd->pinLock);
     free(found);
     return 1;
 }

@@ -719,6 +719,50 @@ static TpuStatus test_external_range(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* ---------------------------------------------------- range splitting */
+
+static TpuStatus test_range_split(UvmVaSpace *vs)
+{
+    uint64_t half = 2 * UVM_BLOCK_SIZE;        /* 2 blocks per half */
+    void *ptr = NULL;
+    CHECK(uvmMemAlloc(vs, 2 * half, &ptr) == TPU_OK);
+    uint8_t *p = ptr;
+
+    /* Populate host-side. */
+    memset(p, 0x11, 2 * half);
+
+    /* Different tiers on the two halves of ONE allocation. */
+    UvmLocation cxl = { .tier = UVM_TIER_CXL, .devInst = 0 };
+    UvmLocation hbm = { .tier = UVM_TIER_HBM, .devInst = 0 };
+    CHECK(uvmSetPreferredLocation(vs, p, half, cxl) == TPU_OK);
+    CHECK(uvmSetPreferredLocation(vs, p + half, half, hbm) == TPU_OK);
+
+    /* A sub-block policy span is rejected, not silently widened. */
+    CHECK(uvmSetPreferredLocation(vs, p, uvmPageSize(), hbm) ==
+          TPU_ERR_INVALID_ADDRESS);
+
+    /* Device access migrates each half to ITS preferred tier. */
+    CHECK(uvmDeviceAccess(vs, 0, p, 2 * half, /*write=*/1) == TPU_OK);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.residentCxl && !info.residentHbm);
+    CHECK(uvmResidencyInfo(vs, p + half - 1, &info) == TPU_OK);
+    CHECK(info.residentCxl && !info.residentHbm);
+    CHECK(uvmResidencyInfo(vs, p + half, &info) == TPU_OK);
+    CHECK(info.residentHbm && !info.residentCxl);
+    CHECK(uvmResidencyInfo(vs, p + 2 * half - 1, &info) == TPU_OK);
+    CHECK(info.residentHbm && !info.residentCxl);
+
+    /* Data integrity across the split boundary (CPU re-faults back). */
+    volatile uint8_t *vp = p;
+    CHECK(vp[half - 1] == 0x11 && vp[half] == 0x11);
+
+    /* Freeing the allocation base frees every fragment. */
+    CHECK(uvmMemFree(vs, ptr) == TPU_OK);
+    CHECK(uvmMemFree(vs, ptr) == TPU_ERR_OBJECT_NOT_FOUND);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -750,6 +794,8 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return vs ? test_suspend_resume(vs) : TPU_ERR_INVALID_ARGUMENT;
     case UVM_TPU_TEST_EXTERNAL_RANGE:
         return vs ? test_external_range(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_RANGE_SPLIT:
+        return vs ? test_range_split(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

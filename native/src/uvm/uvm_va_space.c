@@ -3,14 +3,16 @@
  *
  * Re-design of the reference's uvm_va_space.c (2,703 LoC): registered
  * devices, the VA range tree, policy application, and range groups.
- * Managed ranges are created by uvmMemAlloc (the reference creates them
- * via mmap of /dev/nvidia-uvm + cudaMallocManaged; the tpurm escape
- * surface has no kernel mmap hook, so allocation is explicit — noted in
- * uvm.h ABI section).  Policy simplification vs the reference: policies
- * apply to whole managed ranges intersecting the requested span rather
- * than splitting ranges at span boundaries (uvm_va_range split machinery,
- * uvm_va_range.c); ranges are per-allocation here so the difference only
- * shows when callers set policy on a sub-span.
+ * Managed ranges are created by uvmMemAlloc or by mmap of the uvm
+ * pseudo-fd (reference uvm_mmap + cudaMallocManaged).
+ *
+ * Policy on a sub-span SPLITS the containing range at the span
+ * boundaries (reference uvm_va_range.c split machinery), so different
+ * halves of one allocation can carry different preferred tiers.  Split
+ * points must land on 2 MB block boundaries — blocks are the residency/
+ * backing unit and are not split here (the reference splits blocks too,
+ * uvm_va_block_split); sub-block policy spans return INVALID_ADDRESS
+ * explicitly rather than silently applying to the whole range.
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
@@ -259,6 +261,8 @@ static TpuStatus mem_alloc_gated(UvmVaSpace *vs, uint64_t size,
     range->vaSpace = vs;
     range->type = UVM_RANGE_TYPE_MANAGED;
     range->size = size;
+    range->allocStart = aligned;
+    range->allocSize = size;
 
     uint32_t ppb = uvmPagesPerBlock();
     range->blockCount = (uint32_t)((size + UVM_BLOCK_SIZE - 1) /
@@ -332,11 +336,25 @@ static TpuStatus mem_free_gated(UvmVaSpace *vs, void *ptr)
         return TPU_ERR_INVALID_ARGUMENT;
     vs_lock(vs);
     UvmRangeTreeNode *n = uvmRangeTreeFind(&vs->ranges, (uintptr_t)ptr);
-    if (!n || n->start != (uintptr_t)ptr) {
+    if (!n || n->start != (uintptr_t)ptr ||
+        ((UvmVaRange *)n)->allocStart != (uintptr_t)ptr) {
         vs_unlock(vs);
         return TPU_ERR_OBJECT_NOT_FOUND;
     }
-    range_destroy(vs, (UvmVaRange *)n);
+    /* Free the WHOLE allocation: every fragment a policy split carved
+     * out of it (the reference's uvm_free tears down the full vma). */
+    uint64_t allocStart = ((UvmVaRange *)n)->allocStart;
+    uint64_t allocEnd = allocStart + ((UvmVaRange *)n)->allocSize - 1;
+    uint64_t cursor = allocStart;
+    while (cursor <= allocEnd) {
+        UvmRangeTreeNode *f = uvmRangeTreeFind(&vs->ranges, cursor);
+        if (!f || ((UvmVaRange *)f)->allocStart != allocStart)
+            break;
+        cursor = f->end + 1;
+        range_destroy(vs, (UvmVaRange *)f);
+        if (cursor == 0)
+            break;                       /* end was UINT64_MAX */
+    }
     vs_unlock(vs);
     uvmFaultSnapshotRebuild();
     return TPU_OK;
@@ -353,6 +371,109 @@ UvmVaRange *uvmRangeFind(UvmVaSpace *vs, uint64_t addr, UvmVaBlock **blockOut)
         *blockOut = bi < range->blockCount ? range->blocks[bi] : NULL;
     }
     return range;
+}
+
+/* ------------------------------------------------------- range splitting */
+
+/* Split `range` at splitVa (vs->lock held): the head keeps
+ * [start, splitVa), a new tail range takes [splitVa, end].  splitVa
+ * must be 2 MB block-aligned relative to the range start so every block
+ * lands wholly in one side.  The tail inherits the head's policy
+ * (reference: uvm_va_range_split preserves policy on both halves) and
+ * shares the memfd backing (dup'd fd; per-range alias sub-pointers).  */
+static TpuStatus range_split_locked(UvmVaSpace *vs, UvmVaRange *range,
+                                    uint64_t splitVa)
+{
+    if (range->type != UVM_RANGE_TYPE_MANAGED)
+        return TPU_ERR_INVALID_ADDRESS;
+    uint64_t start = range->node.start;
+    if (splitVa <= start || splitVa > range->node.end)
+        return TPU_ERR_INVALID_ADDRESS;
+    if ((splitVa - start) % UVM_BLOCK_SIZE)
+        return TPU_ERR_INVALID_ADDRESS;   /* sub-block split unsupported */
+
+    uint32_t headBlocks = (uint32_t)((splitVa - start) / UVM_BLOCK_SIZE);
+    uint32_t tailBlocks = range->blockCount - headBlocks;
+
+    UvmVaRange *tail = calloc(1, sizeof(*tail));
+    if (!tail)
+        return TPU_ERR_NO_MEMORY;
+    tail->blocks = calloc(tailBlocks, sizeof(UvmVaBlock *));
+    if (!tail->blocks) {
+        free(tail);
+        return TPU_ERR_NO_MEMORY;
+    }
+    int newFd = range->memfd >= 0 ? dup(range->memfd) : -1;
+    if (range->memfd >= 0 && newFd < 0) {
+        free(tail->blocks);
+        free(tail);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+
+    tail->node.start = splitVa;
+    tail->node.end = range->node.end;
+    tail->vaSpace = vs;
+    tail->type = UVM_RANGE_TYPE_MANAGED;
+    tail->size = range->size - (splitVa - start);
+    tail->allocStart = range->allocStart;
+    tail->allocSize = range->allocSize;
+    tail->memfd = newFd;
+    tail->alias = (char *)range->alias + (splitVa - start);
+    /* Policy inheritance. */
+    tail->hasPreferred = range->hasPreferred;
+    tail->preferred = range->preferred;
+    tail->accessedByMask = range->accessedByMask;
+    tail->readDuplication = range->readDuplication;
+    tail->rangeGroupId = range->rangeGroupId;
+    /* Move the tail's blocks over (block start addresses are absolute,
+     * so only the owning-range pointer changes). */
+    tail->blockCount = tailBlocks;
+    for (uint32_t i = 0; i < tailBlocks; i++) {
+        tail->blocks[i] = range->blocks[headBlocks + i];
+        if (tail->blocks[i])
+            tail->blocks[i]->range = tail;
+        range->blocks[headBlocks + i] = NULL;
+    }
+    /* Shrink the head in place (tree order is keyed by start; end only
+     * participates in containment queries). */
+    range->blockCount = headBlocks;
+    range->size = splitVa - start;
+    range->node.end = splitVa - 1;
+
+    TpuStatus st = uvmRangeTreeAdd(&vs->ranges, &tail->node);
+    if (st != TPU_OK) {
+        /* Roll back (cannot actually happen: the span was ours). */
+        for (uint32_t i = 0; i < tailBlocks; i++) {
+            range->blocks[headBlocks + i] = tail->blocks[i];
+            if (tail->blocks[i])
+                tail->blocks[i]->range = range;
+        }
+        range->blockCount = headBlocks + tailBlocks;
+        range->size += tail->size;
+        range->node.end = tail->node.end;
+        if (newFd >= 0)
+            close(newFd);
+        free(tail->blocks);
+        free(tail);
+        return st;
+    }
+    tpuCounterAdd("uvm_range_splits", 1);
+    return TPU_OK;
+}
+
+/* Ensure range edges exist at `va` (no-op when va already starts a
+ * range or lies outside any range).  *didSplit reports whether the
+ * tree actually changed. */
+static TpuStatus split_at_locked(UvmVaSpace *vs, uint64_t va,
+                                 bool *didSplit)
+{
+    UvmRangeTreeNode *n = uvmRangeTreeFind(&vs->ranges, va);
+    if (!n || n->start == va)
+        return TPU_OK;
+    TpuStatus st = range_split_locked(vs, (UvmVaRange *)n, va);
+    if (st == TPU_OK)
+        *didSplit = true;
+    return st;
 }
 
 /* ----------------------------------------------------------- policy ops */
@@ -381,11 +502,26 @@ static TpuStatus for_ranges_in(UvmVaSpace *vs, void *base, uint64_t len,
             return TPU_ERR_INVALID_ADDRESS;
         }
     }
+    /* Split at the span edges so policy applies EXACTLY to [start, end]
+     * (reference uvm_va_range.c split machinery): a sub-span of one
+     * allocation gets its own range carrying its own policy. */
+    bool didSplit = false;
+    TpuStatus st = split_at_locked(vs, start, &didSplit);
+    if (st == TPU_OK && end != UINT64_MAX)
+        st = split_at_locked(vs, end + 1, &didSplit);
+    if (st != TPU_OK) {
+        vs_unlock(vs);
+        return st;
+    }
+    if (didSplit)
+        n = uvmRangeTreeIterFirst(&vs->ranges, start, end);
     while (n) {
         fn((UvmVaRange *)n, arg);
         n = uvmRangeTreeIterNext(n, end);
     }
     vs_unlock(vs);
+    if (didSplit)
+        uvmFaultSnapshotRebuild();
     return TPU_OK;
 }
 
@@ -595,6 +731,8 @@ TpuStatus uvmExternalRangeCreate(UvmVaSpace *vs, void *base, uint64_t length)
     range->vaSpace = vs;
     range->type = UVM_RANGE_TYPE_EXTERNAL;
     range->size = length;
+    range->allocStart = (uintptr_t)base;
+    range->allocSize = length;
     range->memfd = -1;
 
     vs_lock(vs);

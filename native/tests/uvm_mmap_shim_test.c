@@ -23,9 +23,9 @@ typedef struct {
     uint32_t rmStatus;
 } InitParams;
 
+/* Must match UvmFreeParams (native/include/tpurm/uvm.h): {base, rmStatus}. */
 typedef struct {
     uint64_t base __attribute__((aligned(8)));
-    uint64_t length __attribute__((aligned(8)));
     uint32_t rmStatus;
 } FreeParams;
 
@@ -70,7 +70,7 @@ int main(void)
                                MAP_SHARED, fd, 0);
     CHECK(q != MAP_FAILED);
     q[123] = 0x5A;
-    FreeParams fp = { (uint64_t)(uintptr_t)q, len, 0 };
+    FreeParams fp = { (uint64_t)(uintptr_t)q, 0xFFFFFFFFu };
     CHECK(ioctl(fd, UVM_FREE, &fp) == 0 && fp.rmStatus == 0);
 
     /* Plain anonymous mmap/munmap still work untouched. */

@@ -67,6 +67,7 @@ int main(void)
     run_module_test(fd, UVM_TPU_TEST_REPLAY_CANCEL, "replay_cancel");
     run_module_test(fd, UVM_TPU_TEST_SUSPEND_RESUME, "suspend_resume");
     run_module_test(fd, UVM_TPU_TEST_EXTERNAL_RANGE, "external_range");
+    run_module_test(fd, UVM_TPU_TEST_RANGE_SPLIT, "range_split");
 
     /* ---- managed lifecycle over the raw ABI ---- */
     UvmTpuAllocManagedParams alloc = { .length = 8 << 20 };
@@ -105,7 +106,7 @@ int main(void)
     /* Policy + range group ABI round-trips. */
     UvmSetPreferredLocationParams pref = { 0 };
     pref.requestedBase = alloc.base;
-    pref.length = 1 << 20;
+    pref.length = 2 << 20;          /* policy spans split at 2 MB blocks */
     pref.preferredLocation.uuid[0] = 'C';
     pref.preferredLocation.uuid[1] = 'X';
     pref.preferredLocation.uuid[2] = 'L';
@@ -117,7 +118,7 @@ int main(void)
     EXPECT(grp.rmStatus == TPU_OK && grp.rangeGroupId != 0);
     UvmSetRangeGroupParams sgrp = { .rangeGroupId = grp.rangeGroupId,
                                     .requestedBase = alloc.base,
-                                    .length = 1 << 20 };
+                                    .length = 2 << 20 };
     EXPECT(tpurm_ioctl(fd, UVM_SET_RANGE_GROUP, &sgrp) == 0);
     EXPECT(sgrp.rmStatus == TPU_OK);
 
@@ -135,11 +136,11 @@ int main(void)
     EXPECT(res.residentHost == 1 && res.residentHbm == 0);
     EXPECT(tpurm_ioctl(fd, UVM_ALLOW_MIGRATION_RANGE_GROUPS, &prev) == 0);
 
-    /* Clear the preferred location first: policies apply per managed
-     * range (uvm_va_space.c simplification), and a CXL preference would
-     * steer the device fault below to the CXL tier. */
+    /* Clear the preferred location on the first span (the device-access
+     * below targets a DIFFERENT span of the allocation, which a range
+     * split now isolates — but keep the state clean for it anyway). */
     UvmRangeOpParams unpref = { .requestedBase = alloc.base,
-                                .length = 1 << 20 };
+                                .length = 2 << 20 };
     EXPECT(tpurm_ioctl(fd, UVM_UNSET_PREFERRED_LOCATION, &unpref) == 0);
     EXPECT(unpref.rmStatus == TPU_OK);
 

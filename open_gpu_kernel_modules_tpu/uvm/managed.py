@@ -332,16 +332,25 @@ class ManagedBuffer:
                                          1 if write else 0),
                "uvmDeviceAccess")
 
-    def set_preferred(self, tier: Tier, dev: int = 0) -> None:
+    def set_preferred(self, tier: Tier, dev: int = 0, offset: int = 0,
+                      length: Optional[int] = None) -> None:
+        """Preferred location for [offset, offset+length) — a sub-span
+        SPLITS the underlying VA range at 2 MB block boundaries (native
+        range_split_locked), so different spans of one buffer can carry
+        different tiers; sub-block spans raise INVALID_ADDRESS."""
+        length = self.nbytes - offset if length is None else length
         loc = _Location(int(tier), dev)
         _check(self._lib.uvmSetPreferredLocation(self._vs._handle,
-                                                 self.address, self.nbytes,
-                                                 loc),
+                                                 self.address + offset,
+                                                 length, loc),
                "uvmSetPreferredLocation")
 
-    def unset_preferred(self) -> None:
+    def unset_preferred(self, offset: int = 0,
+                        length: Optional[int] = None) -> None:
+        length = self.nbytes - offset if length is None else length
         _check(self._lib.uvmUnsetPreferredLocation(self._vs._handle,
-                                                   self.address, self.nbytes),
+                                                   self.address + offset,
+                                                   length),
                "uvmUnsetPreferredLocation")
 
     def set_read_duplication(self, enable: bool) -> None:
