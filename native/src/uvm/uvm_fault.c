@@ -76,6 +76,36 @@ typedef struct {
     UvmFaultEntry *e;
 } RingSlot;
 
+/* Per-worker service state (reference: per-GPU bottom halves on
+ * dedicated kthread queues, uvm_gpu_isr.c:115,145).  Faults partition
+ * by VA BLOCK — every fault on a given 2 MB block lands on the same
+ * worker — which preserves the single-writer property the per-block
+ * perf state (prefetch windows, thrashing, access counters) and batch
+ * coalescing rely on, while different blocks service concurrently. */
+#define FAULT_MAX_WORKERS 8
+
+typedef struct {
+    /* Fault ring (MPSC per worker). */
+    RingSlot ring[FAULT_RING_SIZE];
+    _Atomic uint64_t widx;
+    uint64_t ridx;                    /* owning worker only */
+    uint32_t pending;                 /* futex word */
+
+    pthread_t thread;
+    pid_t tid;
+    uint32_t index;
+
+    /* ONCE replay policy: wakes deferred until this worker's ring
+     * drains (owning worker only). */
+    UvmFaultEntry *onceDeferred[FAULT_RING_SIZE];
+    uint32_t onceCount;
+
+    /* True while a batch is being serviced (PM drain barrier). */
+    _Atomic bool servicing;
+
+    uint64_t lastSweepNs;             /* owning worker only */
+} FaultWorker;
+
 static struct {
     pthread_once_t once;
     bool ready;
@@ -88,30 +118,23 @@ static struct {
     _Atomic(Snapshot *) snap;
     _Atomic uint32_t snapReaders;
 
-    /* Fault ring (MPSC). */
-    RingSlot ring[FAULT_RING_SIZE];
-    _Atomic uint64_t widx;
-    uint64_t ridx;                    /* service thread only */
-    uint32_t pending;                 /* futex word */
-
-    pthread_t serviceThread;
-    pid_t serviceTid;
+    FaultWorker workers[FAULT_MAX_WORKERS];
+    uint32_t nWorkers;
     struct sigaction oldSegv;
 
-    /* ONCE replay policy: wakes deferred until the ring drains
-     * (service thread only). */
-    UvmFaultEntry *onceDeferred[FAULT_RING_SIZE];
-    uint32_t onceCount;
-
-    /* True while a batch is being serviced (PM drain barrier). */
-    _Atomic bool servicing;
-
-    /* Stats. */
+    /* Stats (shared; latNs slot writes race benignly — it is a
+     * sampling window, not an exact log). */
     _Atomic uint64_t faultsCpu, faultsDevice, batches, migratedBytes,
         evictions;
     uint32_t latNs[LAT_WINDOW];
     _Atomic uint32_t latIdx;
 } g_fault = { .once = PTHREAD_ONCE_INIT };
+
+/* Block-stable worker assignment. */
+static FaultWorker *worker_for(uint64_t addr)
+{
+    return &g_fault.workers[(addr / UVM_BLOCK_SIZE) % g_fault.nWorkers];
+}
 
 void uvmFaultStatsRecordMigration(uint64_t bytes)
 {
@@ -271,10 +294,10 @@ void uvmFaultEngineUnregisterSpace(UvmVaSpace *vs)
 /* ----------------------------------------------------------- ring (MPSC) */
 
 /* Producer side is async-signal-safe: atomics + futex syscalls only. */
-static void ring_push(UvmFaultEntry *e)
+static void ring_push(FaultWorker *w, UvmFaultEntry *e)
 {
-    uint64_t t = atomic_fetch_add(&g_fault.widx, 1);
-    RingSlot *slot = &g_fault.ring[t % FAULT_RING_SIZE];
+    uint64_t t = atomic_fetch_add(&w->widx, 1);
+    RingSlot *slot = &w->ring[t % FAULT_RING_SIZE];
     while (atomic_load_explicit(&slot->seq, memory_order_acquire) != t) {
 #ifdef __x86_64__
         __builtin_ia32_pause();
@@ -284,45 +307,45 @@ static void ring_push(UvmFaultEntry *e)
     }
     slot->e = e;
     atomic_store_explicit(&slot->seq, t + 1, memory_order_release);
-    __atomic_fetch_add(&g_fault.pending, 1, __ATOMIC_SEQ_CST);
-    futex_call(&g_fault.pending, FUTEX_WAKE, 1);
+    __atomic_fetch_add(&w->pending, 1, __ATOMIC_SEQ_CST);
+    futex_call(&w->pending, FUTEX_WAKE, 1);
 }
 
-/* Consumer (service thread only).  Returns NULL when the ring is empty. */
-static UvmFaultEntry *ring_pop(void)
+/* Consumer (owning worker only).  Returns NULL when the ring is empty. */
+static UvmFaultEntry *ring_pop(FaultWorker *w)
 {
-    RingSlot *slot = &g_fault.ring[g_fault.ridx % FAULT_RING_SIZE];
+    RingSlot *slot = &w->ring[w->ridx % FAULT_RING_SIZE];
     if (atomic_load_explicit(&slot->seq, memory_order_acquire) !=
-        g_fault.ridx + 1)
+        w->ridx + 1)
         return NULL;
     UvmFaultEntry *e = slot->e;
-    atomic_store_explicit(&slot->seq, g_fault.ridx + FAULT_RING_SIZE,
+    atomic_store_explicit(&slot->seq, w->ridx + FAULT_RING_SIZE,
                           memory_order_release);
-    g_fault.ridx++;
-    __atomic_fetch_sub(&g_fault.pending, 1, __ATOMIC_SEQ_CST);
+    w->ridx++;
+    __atomic_fetch_sub(&w->pending, 1, __ATOMIC_SEQ_CST);
     return e;
 }
 
 /* Returns true when work is pending, false on timeout (the service loop
  * uses timeouts to run the access-counter decay sweep while idle). */
-static bool ring_wait_nonempty(uint64_t timeoutNs)
+static bool ring_wait_nonempty(FaultWorker *w, uint64_t timeoutNs)
 {
     uint64_t deadline = uvmMonotonicNs() + timeoutNs;
     for (;;) {
-        uint32_t p = __atomic_load_n(&g_fault.pending, __ATOMIC_SEQ_CST);
+        uint32_t p = __atomic_load_n(&w->pending, __ATOMIC_SEQ_CST);
         if (p > 0)
             return true;
         uint64_t now = uvmMonotonicNs();
         if (now >= deadline)
             return false;
-        futex_wait_timeout(&g_fault.pending, 0, deadline - now);
+        futex_wait_timeout(&w->pending, 0, deadline - now);
     }
 }
 
 /* -------------------------------------------------------- fault service */
 
 /* Access-counter promotion: move a hot span to the accessing device's
- * HBM (vs lock held).  Overrides accessed-by mappings and thrash pins —
+ * HBM (block pinned).  Overrides accessed-by mappings and thrash pins —
  * sustained hotness is stronger evidence than either hint. */
 static void service_promote(UvmVaSpace *vs, UvmVaBlock *blk,
                             const UvmFaultEntry *e, uint32_t firstPage,
@@ -341,7 +364,14 @@ static void service_promote(UvmVaSpace *vs, UvmVaBlock *blk,
 
 /* Service one fault entry: resolve range/block, pick the target tier,
  * expand via prefetch, make resident.  Mirrors
- * service_fault_batch_dispatch (reference :1946). */
+ * service_fault_batch_dispatch (reference :1946).
+ *
+ * Locking: vs->lock covers ONLY the range/block lookup + a policy
+ * snapshot; the block is pinned (serviceRefs) across the actual
+ * service, which runs under the block's own lock inside
+ * uvmBlockMakeResidentEx — so fault service no longer serializes
+ * against every migrate/alloc in the space (reference: per-block
+ * service locking, service_fault_batch_block_locked :1375). */
 static TpuStatus service_one(UvmFaultEntry *e)
 {
     UvmVaSpace *vs = e->vs;
@@ -352,17 +382,31 @@ static TpuStatus service_one(UvmFaultEntry *e)
     uint64_t addr = e->addr & ~(ps - 1);
     uint64_t end = e->addr + (e->len ? e->len : 1) - 1;
 
-    pthread_mutex_lock(&vs->lock);
-    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "vaspace");
     TpuStatus st = TPU_OK;
 
     while (addr <= end && st == TPU_OK) {
+        pthread_mutex_lock(&vs->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "vaspace");
         UvmVaBlock *blk = NULL;
         UvmVaRange *range = uvmRangeFind(vs, addr, &blk);
         if (!range || !blk) {
+            tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
+            pthread_mutex_unlock(&vs->lock);
             st = TPU_ERR_OBJECT_NOT_FOUND;
             break;
         }
+        /* Policy snapshot + block pin, then drop the space lock: the
+         * range pointer must not be used past this point (splits and
+         * frees run under vs->lock; the pin keeps only the BLOCK
+         * alive — uvmBlockFreeBacking waits for it to drain). */
+        bool hasPreferred = range->hasPreferred;
+        UvmLocation preferred = range->preferred;
+        uint64_t accessedByMask = range->accessedByMask;
+        atomic_fetch_add_explicit(&blk->serviceRefs, 1,
+                                  memory_order_acq_rel);
+        tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
+        pthread_mutex_unlock(&vs->lock);
+
         uint64_t blockEnd = blk->start + (uint64_t)blk->npages * ps - 1;
         uint64_t spanEnd = end < blockEnd ? end : blockEnd;
         uint32_t firstPage = (uint32_t)((addr - blk->start) / ps);
@@ -386,9 +430,8 @@ static TpuStatus service_one(UvmFaultEntry *e)
         } else {
             dst.tier = UVM_TIER_HBM;
             dst.devInst = e->devInst;
-            if (range->hasPreferred &&
-                range->preferred.tier != UVM_TIER_HOST)
-                dst = range->preferred;
+            if (hasPreferred && preferred.tier != UVM_TIER_HOST)
+                dst = preferred;
             if (uvmPerfBlockPinnedAgainst(blk, UVM_TIER_HBM)) {
                 dst.tier = UVM_TIER_CXL;
                 dst.devInst = 0;
@@ -423,8 +466,9 @@ static TpuStatus service_one(UvmFaultEntry *e)
          * not a migration (reference: service_fault_batch services
          * accessed_by processors by map, uvm_va_policy semantics).  Falls
          * back to migration when the span isn't resident anywhere yet. */
+        bool serviced = false;
         if (e->source == UVM_FAULT_SRC_DEVICE &&
-            (range->accessedByMask >> e->devInst) & 1) {
+            (accessedByMask >> e->devInst) & 1) {
             st = uvmBlockMapDevice(blk, firstPage, count, e->isWrite != 0);
             if (st == TPU_OK) {
                 uvmToolsEmit(vs, UVM_EVENT_GPU_FAULT, UVM_TIER_COUNT,
@@ -442,33 +486,34 @@ static TpuStatus service_one(UvmFaultEntry *e)
                     uvmAccessCounterRecord(blk))
                     service_promote(vs, blk, e, firstPage, count,
                                     UVM_TIER_COUNT);
-                addr = blockEnd + 1;
-                continue;
+                serviced = true;
+            } else if (st == TPU_ERR_INVALID_STATE) {
+                st = TPU_OK;        /* not resident: migrate normally */
             }
-            if (st != TPU_ERR_INVALID_STATE)
-                break;
-            st = TPU_OK;            /* not resident: migrate normally */
         }
 
-        st = uvmBlockMakeResidentEx(blk, dst, firstPage, count,
-                                    e->isWrite != 0, forceDup);
-        if (st == TPU_OK) {
-            uvmToolsEmit(vs, e->source == UVM_FAULT_SRC_CPU
-                                 ? UVM_EVENT_CPU_FAULT
-                                 : UVM_EVENT_GPU_FAULT,
-                         UVM_TIER_COUNT, dst.tier, dst.devInst,
-                         addr, (uint64_t)count * ps);
-            /* Device access placed off-HBM (CXL preference / thrash pin):
-             * hotness accumulates; threshold promotes to HBM. */
-            if (e->source == UVM_FAULT_SRC_DEVICE &&
-                dst.tier != UVM_TIER_HBM && uvmAccessCounterRecord(blk))
-                service_promote(vs, blk, e, firstPage, count, dst.tier);
+        if (!serviced && st == TPU_OK) {
+            st = uvmBlockMakeResidentEx(blk, dst, firstPage, count,
+                                        e->isWrite != 0, forceDup);
+            if (st == TPU_OK) {
+                uvmToolsEmit(vs, e->source == UVM_FAULT_SRC_CPU
+                                     ? UVM_EVENT_CPU_FAULT
+                                     : UVM_EVENT_GPU_FAULT,
+                             UVM_TIER_COUNT, dst.tier, dst.devInst,
+                             addr, (uint64_t)count * ps);
+                /* Device access placed off-HBM (CXL preference / thrash
+                 * pin): hotness accumulates; threshold promotes to HBM. */
+                if (e->source == UVM_FAULT_SRC_DEVICE &&
+                    dst.tier != UVM_TIER_HBM && uvmAccessCounterRecord(blk))
+                    service_promote(vs, blk, e, firstPage, count, dst.tier);
+            }
         }
+
+        atomic_fetch_sub_explicit(&blk->serviceRefs, 1,
+                                  memory_order_acq_rel);
         addr = blockEnd + 1;
     }
 
-    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
-    pthread_mutex_unlock(&vs->lock);
     return st;
 }
 
@@ -538,17 +583,20 @@ static void service_cancel(UvmFaultEntry *e)
 
 /* Decay sweep: demote counter-promoted blocks that went cold (service
  * thread only; same spacesLock -> vs lock order as snapshot rebuild). */
-static void access_counter_sweep(void)
+/* Each worker sweeps ONLY its own blocks (worker_for partitioning):
+ * the per-block perf/counter state stays single-writer — the sweep of
+ * a block runs on the same thread that services its faults, so the two
+ * can never interleave. */
+static void access_counter_sweep(FaultWorker *w)
 {
-    static uint64_t lastSweepNs;
     if (!tpuRegistryGet("uvm_access_counter_enable", 1))
         return;
     uint64_t now = uvmMonotonicNs();
     uint64_t interval = tpuRegistryGet("uvm_access_counter_sweep_ms", 50) *
                         1000000ull;
-    if (now - lastSweepNs < interval)
+    if (now - w->lastSweepNs < interval)
         return;
-    lastSweepNs = now;
+    w->lastSweepNs = now;
 
     pthread_mutex_lock(&g_fault.spacesLock);
     for (UvmVaSpace *vs = g_fault.spacesHead; vs; vs = vs->nextSpace) {
@@ -558,8 +606,9 @@ static void access_counter_sweep(void)
              n = uvmRangeTreeNext(n)) {
             UvmVaRange *r = (UvmVaRange *)n;
             for (uint32_t b = 0; b < r->blockCount; b++) {
-                if (r->blocks[b])
-                    uvmAccessCounterMaybeDemote(vs, r->blocks[b]);
+                UvmVaBlock *blk = r->blocks[b];
+                if (blk && worker_for(blk->start) == w)
+                    uvmAccessCounterMaybeDemote(vs, blk);
             }
         }
         tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "ac-sweep");
@@ -570,8 +619,8 @@ static void access_counter_sweep(void)
 
 static void *fault_service_thread(void *arg)
 {
-    (void)arg;
-    g_fault.serviceTid = (pid_t)syscall(SYS_gettid);
+    FaultWorker *w = arg;
+    w->tid = (pid_t)syscall(SYS_gettid);
     uint32_t maxBatch = (uint32_t)tpuRegistryGet("uvm_fault_batch_size", 256);
     if (maxBatch == 0 || maxBatch > FAULT_RING_SIZE)
         maxBatch = 256;
@@ -585,24 +634,25 @@ static void *fault_service_thread(void *arg)
         /* fetch_fault_buffer_entries (:844): block for the first fault,
          * then drain opportunistically up to the batch bound.  Timeouts
          * run the access-counter decay sweep while idle. */
-        if (!ring_wait_nonempty(sweepNs)) {
+        if (!ring_wait_nonempty(w, sweepNs)) {
             /* Idle: flush any ONCE-deferred wakes (covers transient
              * pending-counter skew and a policy change away from ONCE)
-             * and run the decay sweep. */
-            atomic_store(&g_fault.servicing, false);
-            if (g_fault.onceCount) {
+             * and run the decay sweep (worker 0 only — it walks every
+             * space and needs no per-block affinity). */
+            atomic_store(&w->servicing, false);
+            if (w->onceCount) {
                 uint64_t tn = uvmMonotonicNs();
-                for (uint32_t i = 0; i < g_fault.onceCount; i++)
-                    replay_wake(g_fault.onceDeferred[i], tn);
-                g_fault.onceCount = 0;
+                for (uint32_t i = 0; i < w->onceCount; i++)
+                    replay_wake(w->onceDeferred[i], tn);
+                w->onceCount = 0;
             }
-            access_counter_sweep();
+            access_counter_sweep(w);
             continue;
         }
-        atomic_store(&g_fault.servicing, true);
+        atomic_store(&w->servicing, true);
         uint32_t n = 0;
         while (n < maxBatch) {
-            UvmFaultEntry *e = ring_pop();
+            UvmFaultEntry *e = ring_pop(w);
             if (!e)
                 break;
             batch[n++] = e;
@@ -692,7 +742,7 @@ static void *fault_service_thread(void *arg)
         if (policy == 2 && n > 0 &&
             dups * 100 >= n * tpuRegistryGet("uvm_fault_flush_ratio", 50)) {
             UvmFaultEntry *extra;
-            while (n < maxBatch && (extra = ring_pop()) != NULL) {
+            while (n < maxBatch && (extra = ring_pop(w)) != NULL) {
                 /* The storm re-faults the just-serviced pages: inherit a
                  * serviced primary's outcome instead of a second full
                  * service pass (the reference's flush replays storms as
@@ -733,21 +783,21 @@ static void *fault_service_thread(void *arg)
             for (uint32_t i = 0; i < n; i++) {
                 if (!batch[i])
                     continue;
-                if (g_fault.onceCount < FAULT_RING_SIZE)
-                    g_fault.onceDeferred[g_fault.onceCount++] = batch[i];
+                if (w->onceCount < FAULT_RING_SIZE)
+                    w->onceDeferred[w->onceCount++] = batch[i];
                 else
                     replay_wake(batch[i], t1);   /* overflow: wake now */
             }
-            if (__atomic_load_n(&g_fault.pending, __ATOMIC_SEQ_CST) == 0) {
-                for (uint32_t i = 0; i < g_fault.onceCount; i++)
-                    replay_wake(g_fault.onceDeferred[i], t1);
-                g_fault.onceCount = 0;
+            if (__atomic_load_n(&w->pending, __ATOMIC_SEQ_CST) == 0) {
+                for (uint32_t i = 0; i < w->onceCount; i++)
+                    replay_wake(w->onceDeferred[i], t1);
+                w->onceCount = 0;
             }
         } else {
             /* Policy moved off ONCE with wakes still deferred: flush. */
-            for (uint32_t i = 0; i < g_fault.onceCount; i++)
-                replay_wake(g_fault.onceDeferred[i], t1);
-            g_fault.onceCount = 0;
+            for (uint32_t i = 0; i < w->onceCount; i++)
+                replay_wake(w->onceDeferred[i], t1);
+            w->onceCount = 0;
             /* replay (:2986): wake every parked waiter. */
             for (uint32_t i = 0; i < n; i++) {
                 if (batch[i])
@@ -756,8 +806,8 @@ static void *fault_service_thread(void *arg)
         }
         atomic_fetch_add(&g_fault.batches, 1);
         tpuCounterAdd("uvm_fault_batches", 1);
-        atomic_store(&g_fault.servicing, false);
-        access_counter_sweep();
+        atomic_store(&w->servicing, false);
+        access_counter_sweep(w);
     }
     return NULL;
 }
@@ -771,9 +821,16 @@ void uvmFaultRingDrain(void)
     if (!g_fault.ready)
         return;
     for (;;) {
-        bool busy = atomic_load(&g_fault.servicing);
-        uint32_t p = __atomic_load_n(&g_fault.pending, __ATOMIC_SEQ_CST);
-        if (!busy && p == 0)
+        bool anyBusy = false;
+        for (uint32_t i = 0; i < g_fault.nWorkers; i++) {
+            FaultWorker *w = &g_fault.workers[i];
+            if (atomic_load(&w->servicing) ||
+                __atomic_load_n(&w->pending, __ATOMIC_SEQ_CST) != 0) {
+                anyBusy = true;
+                break;
+            }
+        }
+        if (!anyBusy)
             return;
         sched_yield();
     }
@@ -836,10 +893,16 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
         fault_fallback(sig, si, uctx);
         return;
     }
-    if (tid == g_fault.serviceTid) {
-        snapshot_release();
-        fault_fallback(sig, si, uctx);
-        return;
+    /* A fault ON a service worker is a real bug (it would deadlock its
+     * own ring): fall through.  Worker tids are written once at thread
+     * start; a reader racing that assignment just misses the match,
+     * which is the pre-existing window for any brand-new thread. */
+    for (uint32_t i = 0; i < g_fault.nWorkers; i++) {
+        if (tid == g_fault.workers[i].tid) {
+            snapshot_release();
+            fault_fallback(sig, si, uctx);
+            return;
+        }
     }
 
     int isWrite = 1;
@@ -865,7 +928,7 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
         .serviceStatus = (TpuStatus)~0u,
         .doneWord = &done,
     };
-    ring_push(&entry);
+    ring_push(worker_for(addr), &entry);
     for (;;) {
         uint32_t v = __atomic_load_n(&done, __ATOMIC_SEQ_CST);
         if (v != 0) {
@@ -883,12 +946,35 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
 static void fault_engine_init_once(void)
 {
     pthread_mutex_init(&g_fault.spacesLock, NULL);
-    for (uint64_t i = 0; i < FAULT_RING_SIZE; i++)
-        atomic_store(&g_fault.ring[i].seq, i);
-    if (pthread_create(&g_fault.serviceThread, NULL, fault_service_thread,
-                       NULL) != 0) {
-        tpuLog(TPU_LOG_ERROR, "uvm", "fault service thread create failed");
-        return;
+    /* Worker count (reference: one bottom half per GPU): default scales
+     * with the device count but never past the online CPUs — extra
+     * workers on a starved box only add preemption to the tail
+     * latency.  Registry uvm_fault_service_threads overrides. */
+    uint32_t ndev = tpurmDeviceCount();
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    uint32_t dflt = ndev < 2 ? 2 : ndev;
+    if (ncpu > 0 && dflt > (uint32_t)ncpu)
+        dflt = (uint32_t)ncpu;
+    uint32_t nw = (uint32_t)tpuRegistryGet("uvm_fault_service_threads",
+                                           dflt);
+    if (nw < 1)
+        nw = 1;
+    if (nw > FAULT_MAX_WORKERS)
+        nw = FAULT_MAX_WORKERS;
+    g_fault.nWorkers = nw;
+    for (uint32_t wi = 0; wi < nw; wi++) {
+        FaultWorker *w = &g_fault.workers[wi];
+        w->index = wi;
+        for (uint64_t i = 0; i < FAULT_RING_SIZE; i++)
+            atomic_store(&w->ring[i].seq, i);
+        if (pthread_create(&w->thread, NULL, fault_service_thread, w) != 0) {
+            tpuLog(TPU_LOG_ERROR, "uvm",
+                   "fault service worker %u create failed", wi);
+            if (wi == 0)
+                return;          /* no engine without at least one */
+            g_fault.nWorkers = wi;
+            break;
+        }
     }
     struct sigaction sa;
     memset(&sa, 0, sizeof(sa));
@@ -901,8 +987,8 @@ static void fault_engine_init_once(void)
     }
     g_fault.ready = true;
     tpuLog(TPU_LOG_INFO, "uvm",
-           "fault engine ready (software replayable faults, ring=%d)",
-           FAULT_RING_SIZE);
+           "fault engine ready (software replayable faults, ring=%d, "
+           "workers=%u)", FAULT_RING_SIZE, g_fault.nWorkers);
 }
 
 void uvmFaultEngineInit(void)
@@ -910,24 +996,95 @@ void uvmFaultEngineInit(void)
     pthread_once(&g_fault.once, fault_engine_init_once);
 }
 
+/* Wait one entry's doneWord; returns its resolved status. */
+static TpuStatus sync_wait_entry(UvmFaultEntry *e, uint32_t *done)
+{
+    for (;;) {
+        uint32_t v = __atomic_load_n(done, __ATOMIC_SEQ_CST);
+        if (v != 0)
+            return e->serviceStatus == (TpuStatus)~0u
+                       ? (v == 1 ? TPU_OK : TPU_ERR_INVALID_STATE)
+                       : e->serviceStatus;
+        futex_call(done, FUTEX_WAIT, 0);
+    }
+}
+
+/* Enqueue-and-wait protocol for one entry on its block's worker. */
+static TpuStatus sync_push_and_wait(UvmFaultEntry *e)
+{
+    uint32_t done = 0;
+    e->doneWord = &done;
+    e->enqueueNs = uvmMonotonicNs();
+    e->serviceStatus = (TpuStatus)~0u;
+    ring_push(worker_for(e->addr), e);
+    return sync_wait_entry(e, &done);
+}
+
 TpuStatus uvmFaultServiceSync(UvmFaultEntry *e)
 {
     uvmFaultEngineInit();
     if (!g_fault.ready)
         return TPU_ERR_INVALID_STATE;
-    uint32_t done = 0;
-    e->doneWord = &done;
-    e->enqueueNs = uvmMonotonicNs();
-    e->serviceStatus = (TpuStatus)~0u;
-    ring_push(e);
-    for (;;) {
-        uint32_t v = __atomic_load_n(&done, __ATOMIC_SEQ_CST);
-        if (v != 0)
-            return e->serviceStatus == (TpuStatus)~0u
-                       ? (v == 1 ? TPU_OK : TPU_ERR_INVALID_STATE)
-                       : e->serviceStatus;
-        futex_call(&done, FUTEX_WAIT, 0);
+
+    /* Worker assignment is per 2 MB block; a span crossing blocks that
+     * hash to different workers is SPLIT into per-block sub-entries so
+     * each worker only ever touches its own blocks' perf state (and the
+     * sub-services run concurrently — the parallel win for large
+     * device_access spans). */
+    uint64_t start = e->addr;
+    uint64_t end = e->addr + (e->len ? e->len : 1) - 1;
+    uint64_t firstBlock = start / UVM_BLOCK_SIZE;
+    uint64_t lastBlock = end / UVM_BLOCK_SIZE;
+
+    if (firstBlock == lastBlock || g_fault.nWorkers == 1)
+        return sync_push_and_wait(e);
+
+    uint64_t nsub = lastBlock - firstBlock + 1;
+    UvmFaultEntry *subs = malloc(nsub * (sizeof(UvmFaultEntry) +
+                                         sizeof(uint32_t)));
+    if (!subs) {
+        /* Degrade: service block-by-block SEQUENTIALLY, each sub-span
+         * on its own block's worker — slower, but the single-writer
+         * per-block invariant (perf state) is preserved. */
+        TpuStatus st = TPU_OK;
+        for (uint64_t b = firstBlock; b <= lastBlock; b++) {
+            uint64_t bStart = b * UVM_BLOCK_SIZE;
+            uint64_t bEnd = bStart + UVM_BLOCK_SIZE - 1;
+            uint64_t lo = start > bStart ? start : bStart;
+            uint64_t hi = end < bEnd ? end : bEnd;
+            UvmFaultEntry sub = *e;
+            sub.addr = lo;
+            sub.len = hi - lo + 1;
+            TpuStatus s = sync_push_and_wait(&sub);
+            if (s != TPU_OK && st == TPU_OK)
+                st = s;
+        }
+        return st;
     }
+    uint32_t *dones = (uint32_t *)(subs + nsub);
+    uint64_t now = uvmMonotonicNs();
+    for (uint64_t i = 0; i < nsub; i++) {
+        uint64_t bStart = (firstBlock + i) * UVM_BLOCK_SIZE;
+        uint64_t bEnd = bStart + UVM_BLOCK_SIZE - 1;
+        uint64_t lo = start > bStart ? start : bStart;
+        uint64_t hi = end < bEnd ? end : bEnd;
+        subs[i] = *e;
+        subs[i].addr = lo;
+        subs[i].len = hi - lo + 1;
+        subs[i].enqueueNs = now;
+        subs[i].serviceStatus = (TpuStatus)~0u;
+        dones[i] = 0;
+        subs[i].doneWord = &dones[i];
+        ring_push(worker_for(lo), &subs[i]);
+    }
+    TpuStatus st = TPU_OK;
+    for (uint64_t i = 0; i < nsub; i++) {
+        TpuStatus s = sync_wait_entry(&subs[i], &dones[i]);
+        if (s != TPU_OK && st == TPU_OK)
+            st = s;
+    }
+    free(subs);
+    return st;
 }
 
 TpuStatus uvmDeviceAccess(UvmVaSpace *vs, uint32_t devInst, void *base,

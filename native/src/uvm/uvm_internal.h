@@ -153,6 +153,10 @@ typedef struct UvmVaBlock {
     struct UvmVaRange *range;
     uint64_t start;                   /* VA, block-aligned */
     uint32_t npages;
+    /* Held by fault workers across a service (taken under vs->lock, so
+     * the space lock is NOT held during block work); uvmBlockFreeBacking
+     * waits for it to drain before teardown. */
+    _Atomic uint32_t serviceRefs;
     UvmPageMask resident[UVM_TIER_COUNT];
     UvmPageMask cpuMapped;            /* pages with valid (RW) host PTEs */
     UvmPageMask devMapped;            /* pages device may access directly */

@@ -765,6 +765,12 @@ TpuStatus uvmBlockMapDevice(UvmVaBlock *blk, uint32_t firstPage,
 
 void uvmBlockFreeBacking(UvmVaBlock *blk)
 {
+    /* Fault workers pin blocks (serviceRefs, taken under vs->lock)
+     * while servicing without the space lock: wait for in-flight
+     * services to drain — they never re-take vs->lock, so waiting here
+     * (typically under it) cannot deadlock. */
+    while (atomic_load_explicit(&blk->serviceRefs, memory_order_acquire))
+        sched_yield();
     UvmTierArena *hbm = uvmTierArenaHbm(blk->hbmDevInst);
     UvmTierArena *cxl = uvmTierArenaCxl();
     /* An evictor may have popped this block off an LRU and still hold the

@@ -18,6 +18,9 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Exercise the multi-worker fault engine in tests regardless of host
+# CPU count (production defaults clamp workers to online CPUs).
+os.environ.setdefault("TPUMEM_UVM_FAULT_SERVICE_THREADS", "4")
 
 import jax  # noqa: E402
 
