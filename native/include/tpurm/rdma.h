@@ -1,0 +1,103 @@
+/*
+ * tpurm rdma — the ib_core analog: peer-memory-client registration and
+ * MR lifecycle for TPU-direct RDMA that LEAVES THE PROCESS.
+ *
+ * Re-design of the reference's ib_peer_memory_client contract
+ * (reference kernel-open/nvidia-peermem/nvidia-peermem.c):
+ *   ib_register_peer_memory_client (:515)  -> tpuIbRegisterPeerMemoryClient
+ *   nv_mem_acquire (:198)                  -> client->acquire
+ *   nv_mem_get_pages (:216)               -> client->getPages
+ *   nv_dma_map (:245)                     -> client->dmaMap
+ *   free-callback revocation (:134)       -> ib invalidate_peer_memory
+ *
+ * The "NIC" side is a SEPARATE PROCESS: device arenas are memfd-backed,
+ * so an MR is described to the consumer as (arena memfd + IOVA list)
+ * shipped over a unix socket (SCM_RIGHTS), and NIC "DMA" is the
+ * consumer process mapping the memfd and reading/writing at the IOVAs —
+ * genuinely crossing the process boundary the way BAR-mapped GPU memory
+ * crosses to a NIC.  Mid-MR invalidation (the hard case: the underlying
+ * allocation is freed while the MR is live) is published to the
+ * consumer through a shared control page (its own memfd) the ib core
+ * flips on the peer client's free callback.
+ */
+#ifndef TPURM_RDMA_H
+#define TPURM_RDMA_H
+
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Faithful peer_memory_client vtable.  acquire() claims a VA range
+ * (returns nonzero + clientCtx when this client owns it); the remaining
+ * ops run against the returned context.  getPages receives the ib
+ * core's per-MR context, which the client hands back through the
+ * invalidate callback when the underlying memory dies mid-MR (the
+ * reference's core_context / invalidate_peer_memory contract). */
+typedef struct TpuPeerMemoryClient {
+    const char *name;
+    int (*acquire)(uint64_t addr, uint64_t size, void **clientCtx);
+    TpuStatus (*getPages)(void *clientCtx, void *coreContext);
+    TpuStatus (*dmaMap)(void *clientCtx, uint32_t nicId,
+                        uint32_t *outDevInst, uint32_t *outPageSize,
+                        uint32_t *outEntries, const uint64_t **outIova);
+    TpuStatus (*dmaUnmap)(void *clientCtx, uint32_t nicId);
+    void (*putPages)(void *clientCtx);
+    void (*release)(void *clientCtx);
+} TpuPeerMemoryClient;
+
+/* The ib core's invalidation entry point: the peer client calls it with
+ * the coreContext from getPages when the backing goes away (reference:
+ * invalidate_peer_memory returned by ib_register_peer_memory_client,
+ * called from the free callback at nvidia-peermem.c:134). */
+typedef void (*TpuIbInvalidateCallback)(void *coreContext);
+
+/* Register/unregister a client with the ib core (reference :515/:546).
+ * outInvalidate receives the core's invalidation callback for this
+ * registration.  Returns a handle (NULL on failure). */
+typedef struct TpuIbPeerReg TpuIbPeerReg;
+TpuIbPeerReg *tpuIbRegisterPeerMemoryClient(
+    const TpuPeerMemoryClient *c, TpuIbInvalidateCallback *outInvalidate);
+void tpuIbUnregisterPeerMemoryClient(TpuIbPeerReg *reg);
+
+/* Register the built-in UVM peer client (managed-memory VAs; pins pages
+ * device-side via tpuP2pGetPages).  Idempotent. */
+void tpuIbRegisterUvmPeerClient(void);
+
+/* ------------------------------------------------------------ MR API */
+
+/* Shared control page the consumer process maps (its own memfd). */
+typedef struct {
+    _Atomic uint32_t revoked;    /* ib core sets 1 on peer invalidation */
+    _Atomic uint32_t consumerAck;/* consumer sets 1 when it stopped    */
+} TpuIbMrControl;
+
+typedef struct TpuIbMr TpuIbMr;
+
+/* ibv_reg_mr analog: walk registered peer clients, claim the VA, pin,
+ * dma-map for nicId. */
+TpuStatus tpuIbRegMr(uint64_t va, uint64_t size, uint32_t nicId,
+                     TpuIbMr **out);
+TpuStatus tpuIbDeregMr(TpuIbMr *mr);
+/* 0 after peer invalidation (free-callback fired mid-MR). */
+int tpuIbMrValid(TpuIbMr *mr);
+
+/* IOVAs carry the NIC id in the top byte (per-NIC IOMMU domains); the
+ * consumer's "IOMMU translation" to an arena offset is masking it off. */
+#define TPU_IB_IOVA_OFFSET_MASK ((1ull << 56) - 1)
+
+/* Consumer-side description: the device arena memfd to map, the control
+ * memfd, and the per-page IOVAs.  The fds are owned by the MR (dup
+ * before shipping cross-process). */
+TpuStatus tpuIbMrDescribe(TpuIbMr *mr, int *outArenaFd, int *outCtrlFd,
+                          uint32_t *outPageSize, uint32_t *outEntries,
+                          const uint64_t **outIova);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_RDMA_H */

@@ -79,6 +79,16 @@ static void device_init_once(void)
             dev->hbmBase = NULL;
             dev->hbmSize = 0;
         }
+        /* Conformance support: TPUMEM_FAKE_HBM_SEED=<0..255> pre-seeds
+         * the arena with the reference walker's pattern ((i + seed) &
+         * 0xFF), so its GPU->CXL readback verifies actual data flow
+         * instead of reading a zeroed arena. */
+        uint64_t seed = tpuRegistryGet("fake_hbm_seed", 0x100);
+        if (seed <= 0xFF && dev->hbmBase) {
+            uint8_t *p = dev->hbmBase;
+            for (uint64_t b = 0; b < hbmBytes; b++)
+                p[b] = (uint8_t)((b + seed) & 0xFF);
+        }
         uint32_t pool = (uint32_t)tpuRegistryGet("uvm_ce_channels", 4);
         if (pool < 1)
             pool = 1;

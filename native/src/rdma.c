@@ -1,0 +1,412 @@
+/*
+ * rdma — the ib_core analog (see include/tpurm/rdma.h).
+ *
+ * Two halves:
+ *   1. the core: peer-memory-client registry + MR lifecycle (reg ->
+ *      acquire -> get_pages -> dma_map; dereg -> dma_unmap -> put_pages
+ *      -> release), with the invalidation contract — a peer client
+ *      calls the core's invalidate callback with the MR's core context
+ *      when the backing dies mid-MR, and the core revokes the MR and
+ *      publishes the revocation to the out-of-process consumer through
+ *      the MR's shared control page (reference flow:
+ *      nvidia-peermem.c:515 registration, :198 acquire, :245 dma_map,
+ *      :134 free-callback revocation);
+ *   2. the built-in UVM peer client: claims managed VAs
+ *      (uvmFaultSpaceForAddr), pins them device-side through
+ *      tpuP2pGetPages, and maps per-NIC IOVAs through
+ *      tpuP2pDmaMapPages.
+ *
+ * The consumer process maps the device arena memfd (the "BAR") and the
+ * control memfd; tpuIbMrDescribe hands both out for SCM_RIGHTS
+ * shipping.  NIC writes through the arena mapping land in the same
+ * bytes the channel engine DMAs — genuine cross-process peer access.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/peermem.h"
+#include "tpurm/rdma.h"
+#include "uvm/uvm_internal.h"
+
+#include <errno.h>
+#include <linux/futex.h>
+#include <stdatomic.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#define MAX_PEER_CLIENTS 4
+
+struct TpuIbPeerReg {
+    const TpuPeerMemoryClient *client;
+    bool used;
+};
+
+static struct {
+    pthread_mutex_t lock;
+    struct TpuIbPeerReg regs[MAX_PEER_CLIENTS];
+} g_ib = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+struct TpuIbMr {
+    const TpuPeerMemoryClient *client;
+    void *clientCtx;
+    uint32_t nicId;
+    uint32_t devInst, pageSize, entries;
+    const uint64_t *iova;
+    int ctrlFd;
+    TpuIbMrControl *ctrl;
+    _Atomic int valid;
+    bool dmaMapped;
+    struct TpuIbMr *nextLive;    /* live-MR list (under g_mrLock) */
+};
+
+/* Live-MR list: orders invalidation against deregistration.  An
+ * invalidate racing tpuIbDeregMr must never touch a freed MR — dereg
+ * unlinks the MR under the lock first, and invalidate only acts on MRs
+ * it still finds linked (the reference guards the same window with MR
+ * refcounts). */
+static pthread_mutex_t g_mrLock = PTHREAD_MUTEX_INITIALIZER;
+static TpuIbMr *g_mrLive;
+
+static void mr_live_add(TpuIbMr *mr)
+{
+    pthread_mutex_lock(&g_mrLock);
+    mr->nextLive = g_mrLive;
+    g_mrLive = mr;
+    pthread_mutex_unlock(&g_mrLock);
+}
+
+static void mr_live_remove(TpuIbMr *mr)
+{
+    pthread_mutex_lock(&g_mrLock);
+    for (TpuIbMr **pp = &g_mrLive; *pp; pp = &(*pp)->nextLive) {
+        if (*pp == mr) {
+            *pp = mr->nextLive;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_mrLock);
+}
+
+/* Core invalidation: peer client reports the backing died mid-MR.  The
+ * MR flips invalid and the consumer process sees `revoked` in its
+ * mapped control page.  Resource teardown stays in tpuIbDeregMr — this
+ * runs from the range-destroy path and must not call back into UVM. */
+static void ib_invalidate(void *coreContext)
+{
+    pthread_mutex_lock(&g_mrLock);
+    TpuIbMr *mr = NULL;
+    for (TpuIbMr *m = g_mrLive; m; m = m->nextLive) {
+        if (m == coreContext) {
+            mr = m;
+            break;
+        }
+    }
+    if (!mr) {
+        /* Already deregistered: nothing to revoke. */
+        pthread_mutex_unlock(&g_mrLock);
+        return;
+    }
+    atomic_store(&mr->valid, 0);
+    if (mr->ctrl) {
+        atomic_store(&mr->ctrl->revoked, 1);
+        syscall(SYS_futex, &mr->ctrl->revoked, FUTEX_WAKE, INT32_MAX,
+                NULL, NULL, 0);
+    }
+    pthread_mutex_unlock(&g_mrLock);
+    tpuCounterAdd("ib_mr_invalidations", 1);
+    tpuLog(TPU_LOG_WARN, "rdma", "MR revoked mid-registration "
+           "(backing freed); consumer notified");
+}
+
+TpuIbPeerReg *tpuIbRegisterPeerMemoryClient(
+    const TpuPeerMemoryClient *c, TpuIbInvalidateCallback *outInvalidate)
+{
+    if (!c || !outInvalidate)
+        return NULL;
+    pthread_mutex_lock(&g_ib.lock);
+    for (int i = 0; i < MAX_PEER_CLIENTS; i++) {
+        if (!g_ib.regs[i].used) {
+            g_ib.regs[i].used = true;
+            g_ib.regs[i].client = c;
+            pthread_mutex_unlock(&g_ib.lock);
+            *outInvalidate = ib_invalidate;
+            tpuLog(TPU_LOG_INFO, "rdma", "peer memory client '%s' "
+                   "registered", c->name);
+            return &g_ib.regs[i];
+        }
+    }
+    pthread_mutex_unlock(&g_ib.lock);
+    return NULL;
+}
+
+void tpuIbUnregisterPeerMemoryClient(TpuIbPeerReg *reg)
+{
+    if (!reg)
+        return;
+    pthread_mutex_lock(&g_ib.lock);
+    reg->used = false;
+    reg->client = NULL;
+    pthread_mutex_unlock(&g_ib.lock);
+}
+
+/* ------------------------------------------------- UVM peer client */
+
+typedef struct {
+    UvmVaSpace *vs;
+    uint64_t va, size;
+    uint32_t devInst;
+    TpuP2pPageTable *pt;
+    TpuP2pDmaMapping *map;
+    uint32_t mappedNic;
+    void *coreContext;
+    _Atomic int revoked;
+} UvmPeerCtx;
+
+static TpuIbInvalidateCallback g_uvmInvalidate;
+
+static int uvm_peer_acquire(uint64_t addr, uint64_t size, void **clientCtx)
+{
+    UvmVaSpace *vs = uvmFaultSpaceForAddr(addr);
+    /* Both endpoints must resolve to the SAME space (a range spanning
+     * two spaces or a hole is not one exportable object). */
+    if (!vs || uvmFaultSpaceForAddr(addr + size - 1) != vs)
+        return 0;                 /* not managed memory: not ours */
+    UvmPeerCtx *ctx = calloc(1, sizeof(*ctx));
+    if (!ctx)
+        return 0;
+    ctx->vs = vs;
+    ctx->va = addr;
+    ctx->size = size;
+    ctx->devInst = (uint32_t)tpuRegistryGet("rdma_export_dev", 0);
+    *clientCtx = ctx;
+    return 1;
+}
+
+static void uvm_peer_free_cb(void *data)
+{
+    UvmPeerCtx *ctx = data;
+    atomic_store(&ctx->revoked, 1);
+    if (g_uvmInvalidate && ctx->coreContext)
+        g_uvmInvalidate(ctx->coreContext);
+}
+
+static TpuStatus uvm_peer_get_pages(void *clientCtx, void *coreContext)
+{
+    UvmPeerCtx *ctx = clientCtx;
+    ctx->coreContext = coreContext;
+    return tpuP2pGetPages(ctx->vs, ctx->devInst, ctx->va, ctx->size,
+                          &ctx->pt, uvm_peer_free_cb, ctx);
+}
+
+static TpuStatus uvm_peer_dma_map(void *clientCtx, uint32_t nicId,
+                                  uint32_t *outDevInst,
+                                  uint32_t *outPageSize,
+                                  uint32_t *outEntries,
+                                  const uint64_t **outIova)
+{
+    UvmPeerCtx *ctx = clientCtx;
+    TpuStatus st = tpuP2pDmaMapPages(ctx->pt, nicId, &ctx->map);
+    if (st != TPU_OK)
+        return st;
+    ctx->mappedNic = nicId;
+    *outDevInst = ctx->pt->devInst;
+    *outPageSize = ctx->pt->pageSize;
+    *outEntries = ctx->map->entries;
+    *outIova = ctx->map->iova;
+    return TPU_OK;
+}
+
+static TpuStatus uvm_peer_dma_unmap(void *clientCtx, uint32_t nicId)
+{
+    UvmPeerCtx *ctx = clientCtx;
+    (void)nicId;
+    if (!ctx->map)
+        return TPU_OK;
+    TpuStatus st = tpuP2pDmaUnmapPages(ctx->map);
+    ctx->map = NULL;
+    return st;
+}
+
+static void uvm_peer_put_pages(void *clientCtx)
+{
+    UvmPeerCtx *ctx = clientCtx;
+    if (ctx->pt) {
+        tpuP2pPutPages(ctx->pt);
+        ctx->pt = NULL;
+    }
+}
+
+static void uvm_peer_release(void *clientCtx)
+{
+    free(clientCtx);
+}
+
+static const TpuPeerMemoryClient g_uvmPeerClient = {
+    .name = "tpurm-uvm",
+    .acquire = uvm_peer_acquire,
+    .getPages = uvm_peer_get_pages,
+    .dmaMap = uvm_peer_dma_map,
+    .dmaUnmap = uvm_peer_dma_unmap,
+    .putPages = uvm_peer_put_pages,
+    .release = uvm_peer_release,
+};
+
+static TpuIbPeerReg *g_uvmReg;
+
+void tpuIbRegisterUvmPeerClient(void)
+{
+    pthread_mutex_lock(&g_ib.lock);
+    bool have = g_uvmReg != NULL;
+    pthread_mutex_unlock(&g_ib.lock);
+    if (have)
+        return;
+    TpuIbInvalidateCallback inval = NULL;
+    TpuIbPeerReg *reg = tpuIbRegisterPeerMemoryClient(&g_uvmPeerClient,
+                                                      &inval);
+    pthread_mutex_lock(&g_ib.lock);
+    if (!g_uvmReg) {
+        g_uvmReg = reg;
+        g_uvmInvalidate = inval;
+        reg = NULL;
+    }
+    pthread_mutex_unlock(&g_ib.lock);
+    if (reg)
+        tpuIbUnregisterPeerMemoryClient(reg);   /* lost the race */
+}
+
+/* ------------------------------------------------------------ MR API */
+
+TpuStatus tpuIbRegMr(uint64_t va, uint64_t size, uint32_t nicId,
+                     TpuIbMr **out)
+{
+    if (!out || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    tpuIbRegisterUvmPeerClient();
+
+    /* acquire: first claiming client wins (reference ib_umem_get peer
+     * path walks registered clients). */
+    const TpuPeerMemoryClient *client = NULL;
+    void *ctx = NULL;
+    pthread_mutex_lock(&g_ib.lock);
+    for (int i = 0; i < MAX_PEER_CLIENTS && !client; i++) {
+        const TpuPeerMemoryClient *c =
+            g_ib.regs[i].used ? g_ib.regs[i].client : NULL;
+        if (c && c->acquire(va, size, &ctx))
+            client = c;
+    }
+    pthread_mutex_unlock(&g_ib.lock);
+    if (!client)
+        return TPU_ERR_OBJECT_NOT_FOUND;
+
+    TpuIbMr *mr = calloc(1, sizeof(*mr));
+    if (!mr) {
+        client->release(ctx);
+        return TPU_ERR_NO_MEMORY;
+    }
+    mr->client = client;
+    mr->clientCtx = ctx;
+    mr->nicId = nicId;
+    atomic_store(&mr->valid, 1);
+
+    /* Control page (its own memfd so it ships cross-process). */
+    mr->ctrlFd = memfd_create("tpurm-mr-ctrl", MFD_CLOEXEC);
+    if (mr->ctrlFd < 0 ||
+        ftruncate(mr->ctrlFd, 4096) != 0 ||
+        (mr->ctrl = mmap(NULL, 4096, PROT_READ | PROT_WRITE, MAP_SHARED,
+                         mr->ctrlFd, 0)) == MAP_FAILED) {
+        if (mr->ctrlFd >= 0)
+            close(mr->ctrlFd);
+        client->release(ctx);
+        free(mr);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+    memset(mr->ctrl, 0, sizeof(*mr->ctrl));
+
+    /* Link BEFORE getPages: once the client pins the backing, an
+     * immediate concurrent free must find the MR and revoke it — a gap
+     * here would lose the revocation and leave a valid-looking MR over
+     * dead backing.  (Invalidation only touches valid/ctrl, both set.) */
+    mr_live_add(mr);
+    TpuStatus st = client->getPages(ctx, mr);
+    if (st == TPU_OK) {
+        st = client->dmaMap(ctx, nicId, &mr->devInst, &mr->pageSize,
+                            &mr->entries, &mr->iova);
+        if (st != TPU_OK)
+            client->putPages(ctx);
+    }
+    if (st != TPU_OK) {
+        mr_live_remove(mr);
+        munmap(mr->ctrl, 4096);
+        close(mr->ctrlFd);
+        client->release(ctx);
+        free(mr);
+        return st;
+    }
+    mr->dmaMapped = true;
+    tpuCounterAdd("ib_mr_registrations", 1);
+    *out = mr;
+    return TPU_OK;
+}
+
+TpuStatus tpuIbDeregMr(TpuIbMr *mr)
+{
+    if (!mr)
+        return TPU_ERR_INVALID_ARGUMENT;
+    /* Unlink first: a racing invalidation (free callback) finds the MR
+     * gone and does nothing, so teardown below cannot be interleaved
+     * with it. */
+    mr_live_remove(mr);
+    bool wasValid = atomic_load(&mr->valid) != 0;
+    if (wasValid) {
+        /* Publish NIC-written bytes to the real-arena mirror BEFORE the
+         * dma unmap frees the IOVA table and the pins drop: DMA writes
+         * bypass the channel executors that normally notify. */
+        TpurmDevice *dev = tpurmDeviceGet(mr->devInst);
+        if (dev && dev->hbmBase && mr->iova) {
+            for (uint32_t i = 0; i < mr->entries; i++)
+                tpuHbmMirrorNotify(
+                    (char *)dev->hbmBase +
+                        (mr->iova[i] & TPU_IB_IOVA_OFFSET_MASK),
+                    mr->pageSize);
+        }
+    }
+    if (mr->dmaMapped)
+        mr->client->dmaUnmap(mr->clientCtx, mr->nicId);
+    mr->client->putPages(mr->clientCtx);
+    mr->client->release(mr->clientCtx);
+    if (mr->ctrl)
+        munmap(mr->ctrl, 4096);
+    if (mr->ctrlFd >= 0)
+        close(mr->ctrlFd);
+    free(mr);
+    return TPU_OK;
+}
+
+int tpuIbMrValid(TpuIbMr *mr)
+{
+    return mr ? atomic_load(&mr->valid) : 0;
+}
+
+TpuStatus tpuIbMrDescribe(TpuIbMr *mr, int *outArenaFd, int *outCtrlFd,
+                          uint32_t *outPageSize, uint32_t *outEntries,
+                          const uint64_t **outIova)
+{
+    if (!mr || !outArenaFd || !outCtrlFd)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpurmDevice *dev = tpurmDeviceGet(mr->devInst);
+    if (!dev)
+        return TPU_ERR_INVALID_DEVICE;
+    if (dev->hbmFd < 0)
+        return TPU_ERR_NOT_SUPPORTED;     /* anon-arena fallback */
+    *outArenaFd = dev->hbmFd;
+    *outCtrlFd = mr->ctrlFd;
+    if (outPageSize)
+        *outPageSize = mr->pageSize;
+    if (outEntries)
+        *outEntries = mr->entries;
+    if (outIova)
+        *outIova = mr->iova;
+    return TPU_OK;
+}

@@ -271,6 +271,26 @@ void uvmFaultSnapshotRebuild(void)
     pthread_mutex_unlock(&g_fault.spacesLock);
 }
 
+/* Address -> owning VA space (registered spaces walk; NULL when no
+ * managed range covers addr).  Used by subsystems that receive raw VAs
+ * from outside the UVM API — e.g. the RDMA peer-memory client's
+ * acquire() claims a VA exactly this way (reference nv_mem_acquire,
+ * nvidia-peermem.c:198). */
+UvmVaSpace *uvmFaultSpaceForAddr(uint64_t addr)
+{
+    UvmVaSpace *found = NULL;
+    pthread_mutex_lock(&g_fault.spacesLock);
+    for (UvmVaSpace *vs = g_fault.spacesHead; vs && !found;
+         vs = vs->nextSpace) {
+        pthread_mutex_lock(&vs->lock);
+        if (uvmRangeTreeFind(&vs->ranges, addr))
+            found = vs;
+        pthread_mutex_unlock(&vs->lock);
+    }
+    pthread_mutex_unlock(&g_fault.spacesLock);
+    return found;
+}
+
 void uvmFaultEngineRegisterSpace(UvmVaSpace *vs)
 {
     pthread_mutex_lock(&g_fault.spacesLock);

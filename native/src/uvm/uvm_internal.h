@@ -357,6 +357,7 @@ typedef struct UvmFaultEntry {
 
 void uvmFaultEngineInit(void);        /* idempotent */
 void uvmFaultEngineRegisterSpace(UvmVaSpace *vs);
+UvmVaSpace *uvmFaultSpaceForAddr(uint64_t addr);
 void uvmFaultEngineUnregisterSpace(UvmVaSpace *vs);
 /* Rebuild the signal-safe VA lookup snapshot after range add/remove. */
 void uvmFaultSnapshotRebuild(void);

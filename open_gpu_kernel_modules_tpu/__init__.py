@@ -18,8 +18,9 @@ P2P fork), re-designed TPU-first:
 - ``parallel`` — device meshes, shardings, ICI topology, ring attention /
   sequence parallelism over ``shard_map`` (reference substrate: nvlink/
   nvswitch/peermem, SURVEY.md §2.7).
-- ``utils``    — registry (config KV), journal ring, lock-order validation,
-  tools event queues (reference: diagnostics/, nv-reg.h, uvm_lock.h).
+- ``utils``    — diagnostics bindings over the NATIVE engine's journal
+  ring, counters, and env-backed registry (reference: diagnostics/,
+  nv-reg.h); UVM tools event queues live in ``uvm`` (ToolsSession).
 """
 
 __version__ = "0.1.0"

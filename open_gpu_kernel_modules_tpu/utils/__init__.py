@@ -1,34 +1,52 @@
-"""Utility subsystems shared by the whole framework.
+"""Diagnostics surface over the NATIVE engine's auxiliary subsystems.
 
-TPU-native re-designs of the reference's auxiliary subsystems (SURVEY.md §5):
-
-- :mod:`.registry`  — the RM registry: string key/value config DB populated
-  from env vars and programmatic overrides (reference:
-  kernel-open/nvidia/nv-reg.h, arch/nvalloc/unix/src/registry.c).
-- :mod:`.journal`   — error/event journal ring (reference:
-  src/nvidia/src/kernel/diagnostics/journal.c, nvlog.c).
-- :mod:`.locking`   — documented global lock order enforced by runtime
-  assertions (reference: kernel-open/nvidia-uvm/uvm_lock.h:31+,
-  uvm_thread_context.c).
-- :mod:`.events`    — tools event queues: lock-free ring buffers consumed by
-  profiling tools (reference: kernel-open/nvidia-uvm/uvm_tools.c:54-70).
+These bind the real subsystems (native/src/diag.c — journal ring,
+counters, env-backed registry; reference analogs:
+src/nvidia/src/kernel/diagnostics/journal.c, nv-reg.h registry) instead
+of maintaining parallel Python implementations.  The UVM tools event
+queues are bound separately in :mod:`..uvm.managed` (ToolsSession).
 """
 
-from .registry import Registry, registry
-from .journal import Journal, JournalRecord
-from .locking import LockOrder, OrderedLock, LockOrderError
-from .events import EventQueue, EventRecord, EventType, Counters
+from __future__ import annotations
 
-__all__ = [
-    "Registry",
-    "registry",
-    "Journal",
-    "JournalRecord",
-    "LockOrder",
-    "OrderedLock",
-    "LockOrderError",
-    "EventQueue",
-    "EventRecord",
-    "EventType",
-    "Counters",
-]
+import os
+from typing import Dict, List, Optional
+
+from ..runtime import native
+
+
+def journal_dump(max_bytes: int = 1 << 16) -> List[str]:
+    """Drain the native journal ring (reference: RCDB journal records)."""
+    import ctypes
+
+    lib = native.load()
+    buf = ctypes.create_string_buffer(max_bytes)
+    n = lib.tpurmJournalDump(buf, max_bytes)
+    text = buf.raw[:n].decode(errors="replace")
+    return [line for line in text.splitlines() if line]
+
+
+def counter(name: str) -> int:
+    """Monotonic named engine counter (pushes, copies, pins, ...)."""
+    return native.load().tpurmCounterGet(name.encode())
+
+
+def counters(names) -> Dict[str, int]:
+    return {n: counter(n) for n in names}
+
+
+def registry_get(key: str, default: Optional[int] = None) -> Optional[int]:
+    """Read a registry knob the way the native engine does: the env var
+    ``TPUMEM_<KEY>`` (decimal or 0x hex; reference: RM registry keys,
+    nv-reg.h).  Python-side readers use this so both halves of the
+    framework resolve configuration identically."""
+    raw = os.environ.get("TPUMEM_" + key.upper())
+    if raw is None:
+        return default
+    try:
+        return int(raw, 0)
+    except ValueError:
+        return default
+
+
+__all__ = ["journal_dump", "counter", "counters", "registry_get"]

@@ -40,16 +40,16 @@ class TestNativeBinaries:
         tests/cxl_p2p_test.c:634)."""
         if not os.path.exists("/root/reference/tests/cxl_p2p_test.c"):
             pytest.skip("reference tree not mounted")
+        # The Makefile target itself now ASSERTS on the walker's output
+        # (seeded arena -> byte-exact step 7/8 verification, plus a
+        # clamp-split pass); the wrapper checks the target's verdict.
         res = subprocess.run(
             ["make", "-C", NATIVE_DIR, "conformance-reference"],
             capture_output=True, text=True)
         assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-4000:]
-        assert "=== Test COMPLETED ===" in res.stdout
-        # Every RM op must have succeeded, not degraded gracefully.
-        assert "OK: RM client initialized" in res.stdout
-        assert "OK: Buffer registered with kernel" in res.stdout
-        assert res.stdout.count("OK: Transfer completed") == 2
-        assert "OK: Buffer unregistered" in res.stdout
+        assert "conformance-reference OK" in res.stdout
+        assert "(default clamp)" in res.stdout
+        assert "(clamp=65536)" in res.stdout
 
 
 class TestRmClient:
