@@ -126,6 +126,25 @@ void          tpurmChannelInjectError(TpurmChannel *ch);
 /* Robust-channel recovery: clear a latched channel error so new work can
  * proceed (reference: per-channel RC, src/nvidia/src/kernel/gpu/rc/). */
 void          tpurmChannelResetError(TpurmChannel *ch);
+/* Non-replayable fault kinds (reference: CE/PBDMA engine faults,
+ * uvm_gpu_non_replayable_faults.c; watchdog kernel_rc_watchdog.c). */
+enum {
+    TPU_RC_CE_FAULT = 1,
+    TPU_RC_WATCHDOG_TIMEOUT = 2,
+};
+
+/* Per-channel error notifier (reference: error notifiers on every
+ * channel): invoked by the RC service for every non-replayable fault
+ * attributed to this channel.  Runs under the RC registry lock: the
+ * callback must not create or destroy channels. */
+typedef void (*TpurmChannelErrorNotifier)(void *ctx, uint64_t value,
+                                          uint32_t kind);
+void          tpurmChannelSetErrorNotifier(TpurmChannel *ch,
+                                           TpurmChannelErrorNotifier cb,
+                                           void *ctx);
+/* Fault injection: stall the channel executor for ms before its next
+ * push (drives the RC watchdog in tests). */
+void          tpurmChannelInjectStall(TpurmChannel *ch, uint32_t ms);
 
 /* ------------------------------------------------------------- tracker */
 
@@ -187,6 +206,15 @@ void      tpuPushAbort(TpuPush *p);
 size_t tpurmJournalDump(char *buf, size_t bufSize);
 /* Monotonic named counter read (pinned bytes, pushes, copies...). */
 uint64_t tpurmCounterGet(const char *name);
+
+/* procfs analog (reference: nv-procfs.c, uvm_procfs.c:36-49): virtual
+ * observability nodes rendered on demand.  Paths accept both tpurm and
+ * the reference's /proc/driver/nvidia spellings; debug-gated nodes
+ * (counters, journal) require registry procfs_debug=1.  The LD_PRELOAD
+ * shim serves open("/proc/driver/...") of these nodes via memfd. */
+size_t tpurmProcfsRead(const char *path, char *buf, size_t bufSize);
+size_t tpurmProcfsList(char *buf, size_t bufSize);
+int    tpurmProcfsIsNode(const char *path);
 
 #ifdef __cplusplus
 }

@@ -72,6 +72,10 @@ struct TpurmChannel {
     bool stop;
     bool injectNext;
     _Atomic int error;         /* latched channel error */
+    _Atomic uint32_t stallMs;  /* test injection: executor stall */
+    uint64_t rcId;             /* unique id for RC attribution (ABA) */
+    TpurmChannelErrorNotifier errNotifier;   /* under lock */
+    void *errNotifierCtx;
     pthread_mutex_t lock;      /* pushbuffer + inject latch */
     pthread_cond_t cond;       /* pushbuffer space freed */
 };
@@ -106,6 +110,14 @@ static void *channel_executor(void *arg)
     TpuMsgqCmd cmd;
 
     while (tpuMsgqReceive(ch->fifo, &cmd, 1) == 1) {
+        uint32_t stall = atomic_exchange_explicit(&ch->stallMs, 0,
+                                                  memory_order_acq_rel);
+        if (stall) {
+            struct timespec ts = { .tv_sec = stall / 1000,
+                                   .tv_nsec = (long)(stall % 1000) *
+                                              1000000L };
+            nanosleep(&ts, NULL);
+        }
         bool failed = (cmd.flags & TPU_MSGQ_FLAG_INJECT_ERROR) != 0;
         uint64_t bytes = 0;
         if (!failed && cmd.op == TPU_MSGQ_CE_PUSH) {
@@ -125,10 +137,14 @@ static void *channel_executor(void *arg)
         pthread_mutex_unlock(&ch->lock);
 
         if (failed) {
+            /* Latch synchronously (wait semantics) AND post to the
+             * non-replayable shadow buffer for attribution/recovery
+             * (rc.c — the reference's CE-fault delivery split). */
             atomic_store_explicit(&ch->error, 1, memory_order_release);
             tpuLog(TPU_LOG_ERROR, "channel",
                    "injected CE fault at tracker value %llu",
                    (unsigned long long)cmd.seq);
+            tpuRcPostFault(ch, ch->rcId, cmd.seq, TPU_RC_CE_FAULT);
         }
         tpuCounterAdd("channel_copies_completed", 1);
         tpuCounterAdd("channel_bytes_copied", failed ? 0 : bytes);
@@ -180,6 +196,12 @@ TpurmChannel *tpurmChannelCreate(TpurmDevice *dev, TpurmCeType ce,
         return NULL;
     }
     ch->executorStarted = true;
+    /* Unique id guards RC attribution against pointer reuse (a stale
+     * shadow record must not land on a recycled channel address). */
+    static _Atomic uint64_t nextRcId;
+    ch->rcId = atomic_fetch_add_explicit(&nextRcId, 1,
+                                         memory_order_relaxed) + 1;
+    tpuRcChannelRegister(ch, ch->rcId);
     return ch;
 }
 
@@ -187,6 +209,9 @@ void tpurmChannelDestroy(TpurmChannel *ch)
 {
     if (!ch)
         return;
+    /* Leave the RC registry first: the RC service delivers under the
+     * registry lock, so after this returns no delivery can hold ch. */
+    tpuRcChannelUnregister(ch);
     pthread_mutex_lock(&ch->lock);
     ch->stop = true;
     pthread_cond_broadcast(&ch->cond);
@@ -396,6 +421,47 @@ void tpurmChannelInjectError(TpurmChannel *ch)
     pthread_mutex_lock(&ch->lock);
     ch->injectNext = true;
     pthread_mutex_unlock(&ch->lock);
+}
+
+void tpurmChannelSetErrorNotifier(TpurmChannel *ch,
+                                  TpurmChannelErrorNotifier cb, void *ctx)
+{
+    if (!ch)
+        return;
+    pthread_mutex_lock(&ch->lock);
+    ch->errNotifier = cb;
+    ch->errNotifierCtx = ctx;
+    pthread_mutex_unlock(&ch->lock);
+}
+
+void tpurmChannelInjectStall(TpurmChannel *ch, uint32_t ms)
+{
+    if (ch)
+        atomic_store_explicit(&ch->stallMs, ms, memory_order_release);
+}
+
+/* RC-service delivery (rc.c, under the RC registry lock): notifier +
+ * recovery policy (registry rc_policy: 0 = latch only, 1 = auto-reset
+ * so subsequent work flows without an explicit ResetError). */
+void tpurmChannelRcDeliver(TpurmChannel *ch, uint64_t value, uint32_t kind)
+{
+    pthread_mutex_lock(&ch->lock);
+    TpurmChannelErrorNotifier cb = ch->errNotifier;
+    void *ctx = ch->errNotifierCtx;
+    pthread_mutex_unlock(&ch->lock);
+    if (cb)
+        cb(ctx, value, kind);
+    if (kind == TPU_RC_CE_FAULT && tpuRegistryGet("rc_policy", 0) == 1) {
+        tpurmChannelResetError(ch);
+        tpuCounterAdd("rc_auto_resets", 1);
+    }
+}
+
+void tpurmChannelProgress(TpurmChannel *ch, uint64_t *completed,
+                          uint64_t *pendingDepth)
+{
+    *completed = tpuMsgqCompletedSeq(ch->fifo);
+    *pendingDepth = tpuMsgqDepth(ch->fifo);
 }
 
 void tpurmChannelResetError(TpurmChannel *ch)

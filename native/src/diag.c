@@ -124,6 +124,22 @@ void tpuCounterAdd(const char *name, uint64_t delta)
     pthread_mutex_unlock(&g_counters.lock);
 }
 
+size_t tpuCountersDump(char *buf, size_t bufSize)
+{
+    size_t off = 0;
+    pthread_mutex_lock(&g_counters.lock);
+    for (int i = 0; i < g_counters.n && off + 1 < bufSize; i++) {
+        int n = snprintf(buf + off, bufSize - off, "%-40s %llu\n",
+                         g_counters.c[i].name,
+                         (unsigned long long)g_counters.c[i].value);
+        if (n < 0)
+            break;
+        off += (size_t)n < bufSize - off ? (size_t)n : bufSize - off - 1;
+    }
+    pthread_mutex_unlock(&g_counters.lock);
+    return off;
+}
+
 uint64_t tpurmCounterGet(const char *name)
 {
     uint64_t v = 0;

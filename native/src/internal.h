@@ -53,6 +53,7 @@ typedef enum {
 void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
     __attribute__((format(printf, 3, 4)));
 void tpuCounterAdd(const char *name, uint64_t delta);
+size_t tpuCountersDump(char *buf, size_t bufSize);
 
 /* --------------------------------------------------------------- registry */
 
@@ -169,6 +170,23 @@ void  uvmMmapRegistryOnRangeDestroy(uint64_t base);
 TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
                      TpuMemDesc *src, uint64_t srcOff, uint64_t size,
                      bool async, uint64_t *outTrackerValue);
+
+/* ------------------------------------------------- robust channel RC */
+
+/* (Fault kinds TPU_RC_* live in tpurm.h beside the public notifier.) */
+
+void tpuRcInit(void);
+void tpuRcPostFault(TpurmChannel *ch, uint64_t rcId, uint64_t value,
+                    uint32_t kind);
+void tpuRcChannelRegister(TpurmChannel *ch, uint64_t rcId);
+void tpuRcChannelUnregister(TpurmChannel *ch);
+/* Channel-side delivery (called by the RC service under its registry
+ * lock): invoke the channel's error notifier + apply recovery policy. */
+void tpurmChannelRcDeliver(TpurmChannel *ch, uint64_t value,
+                           uint32_t kind);
+/* Watchdog probe: completed tracker value + outstanding push count. */
+void tpurmChannelProgress(TpurmChannel *ch, uint64_t *completed,
+                          uint64_t *pendingDepth);
 
 /* CE pool striper: round-robins pieces of a copy across the device's
  * channel pool, recording each push in a tracker (reference: channel

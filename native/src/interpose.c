@@ -28,9 +28,11 @@
 #include <errno.h>
 #include <stdarg.h>
 #include <fcntl.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/types.h>
+#include <unistd.h>
 
 #define PSEUDO_FD_BASE 0x40000000
 
@@ -71,11 +73,53 @@ typedef int (*openat_fn)(int, const char *, int, ...);
 #define NEEDS_MODE(flags) ((flags) & O_CREAT)
 #endif
 
+/* Serve a synthetic procfs node as a real fd: render into a memfd and
+ * rewind, so read/close need no interposition.  The node is read-only
+ * like the real /proc tree (write opens fail), and O_CLOEXEC carries
+ * through to the memfd. */
+static int procfs_open(const char *path, int flags)
+{
+    if ((flags & O_ACCMODE) != O_RDONLY) {
+        errno = EACCES;
+        return -1;
+    }
+    char *buf = malloc(1 << 16);
+    if (!buf) {
+        errno = ENOMEM;
+        return -1;
+    }
+    size_t n = tpurmProcfsRead(path, buf, 1 << 16);
+    int fd = memfd_create("tpurm-procfs",
+                          (flags & O_CLOEXEC) ? MFD_CLOEXEC : 0);
+    if (fd < 0) {
+        free(buf);
+        return -1;
+    }
+    size_t off = 0;
+    while (off < n) {
+        ssize_t w = write(fd, buf + off, n - off);
+        if (w <= 0)
+            break;
+        off += (size_t)w;
+    }
+    free(buf);
+    lseek(fd, 0, SEEK_SET);
+    return fd;
+}
+
+static int is_procfs_path(const char *path)
+{
+    return path && strncmp(path, "/proc/driver/", 13) == 0 &&
+           tpurmProcfsIsNode(path);
+}
+
 #define DEFINE_OPEN(name)                                                  \
 int name(const char *path, int flags, ...)                                 \
 {                                                                          \
     if (is_tpurm_path(path))                                               \
         return tpurm_open(path);                                           \
+    if (is_procfs_path(path))                                              \
+        return procfs_open(path, flags);                                   \
     static open_fn real;                                                   \
     if (!real)                                                             \
         real = (open_fn)dlsym(RTLD_NEXT, #name);                           \
@@ -103,6 +147,8 @@ int name(int dirfd, const char *path, int flags, ...)                      \
      * is_tpurm_path is NULL-safe and only matches absolute paths. */     \
     if (is_tpurm_path(path))                                               \
         return tpurm_open(path);                                           \
+    if (is_procfs_path(path))                                              \
+        return procfs_open(path, flags);                                   \
     static openat_fn real;                                                 \
     if (!real)                                                             \
         real = (openat_fn)dlsym(RTLD_NEXT, #name);                         \

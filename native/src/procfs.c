@@ -1,0 +1,195 @@
+/*
+ * procfs — the /proc/driver observability tree.
+ *
+ * Re-design of the reference's procfs surface (nv-procfs.c:
+ * /proc/driver/nvidia/gpus/<id>/information, version;
+ * uvm_procfs.c:36-49: /proc/driver/nvidia-uvm with debug gating).
+ * Userspace engine shape: a virtual node table rendered on demand —
+ * tpurmProcfsRead() fills a caller buffer, and the LD_PRELOAD shim
+ * serves open("/proc/driver/tpurm...") (also accepting the reference's
+ * /proc/driver/nvidia spellings) by rendering into a memfd, so plain
+ * cat/read works against the synthetic tree.
+ *
+ * Debug gating (uvm_procfs.c:36-49): nodes marked dbg render only when
+ * registry "procfs_debug" is set, mirroring uvm_enable_debug_procfs.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "uvm/uvm_internal.h"
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* Render helpers append into a bounded cursor. */
+typedef struct {
+    char *buf;
+    size_t cap, off;
+} Cur;
+
+static void curf(Cur *c, const char *fmt, ...)
+    __attribute__((format(printf, 2, 3)));
+
+static void curf(Cur *c, const char *fmt, ...)
+{
+    if (c->off + 1 >= c->cap)
+        return;
+    va_list ap;
+    va_start(ap, fmt);
+    int n = vsnprintf(c->buf + c->off, c->cap - c->off, fmt, ap);
+    va_end(ap);
+    if (n > 0)
+        c->off += (size_t)n < c->cap - c->off ? (size_t)n
+                                              : c->cap - c->off - 1;
+}
+
+/* ------------------------------------------------------------ renderers */
+
+static void render_version(Cur *c)
+{
+    curf(c, "tpurm version: 1.0 (round 3)\n");
+    curf(c, "engine: userspace RM + UVM over libtpu/XLA\n");
+}
+
+static void render_gpu_info(Cur *c, uint32_t inst)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev)
+        return;
+    curf(c, "Device Instance:     %u\n", inst);
+    curf(c, "Probed Id:           0x%x\n", dev->devId);
+    curf(c, "HBM Arena:           %llu MB\n",
+         (unsigned long long)(tpurmDeviceHbmSize(dev) >> 20));
+    curf(c, "Arena Backend:       %s\n",
+         tpurmDeviceArenaIsReal(inst) ? "real (mirror stream open)"
+                                      : "fake (host shadow only)");
+    curf(c, "CE Channels:         %u\n", dev->cePoolSize);
+    curf(c, "Device Lost:         %s\n", dev->lost ? "yes" : "no");
+}
+
+static void render_gpus(Cur *c)
+{
+    uint32_t n = tpurmDeviceCount();
+    for (uint32_t i = 0; i < n; i++) {
+        curf(c, "[gpu %u]\n", i);
+        render_gpu_info(c, i);
+        curf(c, "\n");
+    }
+}
+
+static void render_fault_stats(Cur *c)
+{
+    UvmFaultStats st;
+    uvmFaultStatsGet(&st);
+    curf(c, "cpu_faults:          %llu\n",
+         (unsigned long long)st.faultsCpu);
+    curf(c, "device_faults:       %llu\n",
+         (unsigned long long)st.faultsDevice);
+    curf(c, "batches:             %llu\n",
+         (unsigned long long)st.batches);
+    curf(c, "migrated_bytes:      %llu\n",
+         (unsigned long long)st.migratedBytes);
+    curf(c, "evictions:           %llu\n",
+         (unsigned long long)st.evictions);
+    curf(c, "service_p50_ns:      %llu\n",
+         (unsigned long long)st.serviceNsP50);
+    curf(c, "service_p95_ns:      %llu\n",
+         (unsigned long long)st.serviceNsP95);
+}
+
+static void render_counters(Cur *c)
+{
+    if (c->off + 1 >= c->cap)
+        return;
+    c->off += tpuCountersDump(c->buf + c->off, c->cap - c->off);
+}
+
+static void render_journal(Cur *c)
+{
+    if (c->off + 1 >= c->cap)
+        return;
+    c->off += tpurmJournalDump(c->buf + c->off, c->cap - c->off);
+}
+
+/* ---------------------------------------------------------- node table */
+
+typedef struct {
+    const char *path;
+    void (*render)(Cur *c);
+    bool dbg;                    /* gated by registry procfs_debug */
+} ProcNode;
+
+static const ProcNode g_nodes[] = {
+    { "driver/tpurm/version", render_version, false },
+    { "driver/tpurm/gpus", render_gpus, false },
+    { "driver/tpurm-uvm/fault_stats", render_fault_stats, false },
+    { "driver/tpurm-uvm/counters", render_counters, true },
+    { "driver/tpurm/journal", render_journal, true },
+};
+
+#define N_NODES (sizeof(g_nodes) / sizeof(g_nodes[0]))
+
+/* Accept the reference's spellings too: /proc/driver/nvidia/... and
+ * /proc/driver/nvidia-uvm/... alias the tpurm trees, and per-gpu
+ * information paths (gpus/<id>/information) alias the gpus listing. */
+static const char *normalize(const char *path, char *tmp, size_t tmpSize)
+{
+    if (strncmp(path, "/proc/", 6) == 0)
+        path += 6;
+    snprintf(tmp, tmpSize, "%s", path);
+    char *p;
+    if ((p = strstr(tmp, "driver/nvidia-uvm")) != NULL)
+        memcpy(p, "driver/tpurm-uvm/", 17),
+            memmove(p + 16, p + 17, strlen(p + 17) + 1);
+    else if ((p = strstr(tmp, "driver/nvidia")) != NULL)
+        memcpy(p, "driver/tpurm/", 13),
+            memmove(p + 12, p + 13, strlen(p + 13) + 1);
+    /* gpus/<id>/information -> gpus */
+    if ((p = strstr(tmp, "/gpus/")) != NULL)
+        p[5] = '\0';
+    return tmp;
+}
+
+size_t tpurmProcfsRead(const char *path, char *buf, size_t bufSize)
+{
+    if (!path || !buf || bufSize == 0)
+        return 0;
+    tpuDeviceGlobalInit();
+    char tmp[256];
+    const char *norm = normalize(path, tmp, sizeof(tmp));
+    for (size_t i = 0; i < N_NODES; i++) {
+        if (strcmp(g_nodes[i].path, norm) != 0)
+            continue;
+        if (g_nodes[i].dbg && !tpuRegistryGet("procfs_debug", 0))
+            return 0;            /* gated (uvm_enable_debug_procfs) */
+        Cur c = { buf, bufSize, 0 };
+        g_nodes[i].render(&c);
+        return c.off;
+    }
+    return 0;
+}
+
+size_t tpurmProcfsList(char *buf, size_t bufSize)
+{
+    if (!buf || bufSize == 0)
+        return 0;
+    Cur c = { buf, bufSize, 0 };
+    bool dbg = tpuRegistryGet("procfs_debug", 0) != 0;
+    for (size_t i = 0; i < N_NODES; i++) {
+        if (!g_nodes[i].dbg || dbg)
+            curf(&c, "%s\n", g_nodes[i].path);
+    }
+    return c.off;
+}
+
+int tpurmProcfsIsNode(const char *path)
+{
+    char tmp[256];
+    const char *norm = normalize(path, tmp, sizeof(tmp));
+    for (size_t i = 0; i < N_NODES; i++) {
+        if (strcmp(g_nodes[i].path, norm) == 0)
+            return !g_nodes[i].dbg || tpuRegistryGet("procfs_debug", 0);
+    }
+    return 0;
+}

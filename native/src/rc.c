@@ -1,0 +1,230 @@
+/*
+ * rc — robust-channel recovery: the non-replayable fault subsystem and
+ * the channel watchdog.
+ *
+ * Reference split (SURVEY.md §5): replayable faults replay after
+ * service; NON-replayable faults (Copy Engine / PBDMA) are delivered
+ * through an RM SHADOW BUFFER and serviced without replay — fatal ones
+ * trigger per-channel robust-channel recovery
+ * (uvm_gpu_non_replayable_faults.c; rc/kernel_rc.c; watchdog
+ * kernel_rc_watchdog.c).  TPU-native shape:
+ *
+ *   shadow buffer — a msgq (msgq.c) the channel executors post fault
+ *                   records into when a push fails (the executor also
+ *                   latches the channel error synchronously, so wait
+ *                   semantics are unchanged — the shadow path is the
+ *                   ATTRIBUTION/RECOVERY plane, exactly the reference's
+ *                   split between fault delivery and RC);
+ *   RC service    — drains the shadow buffer: journal + counters +
+ *                   per-channel error notifier callbacks (reference:
+ *                   error notifiers on every channel) + recovery policy
+ *                   (registry "rc_policy": 0 = latch only, 1 =
+ *                   auto-reset the channel);
+ *   watchdog      — periodic scan of all live channels: pending work
+ *                   with no completion progress for longer than
+ *                   "rc_watchdog_timeout_ms" posts a WATCHDOG fault
+ *                   into the same shadow buffer (reference:
+ *                   krcWatchdogCheckChannelsDueToTimeout).
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/msgq.h"
+#include "uvm/uvm_internal.h"   /* uvmMonotonicNs */
+
+#include <stdatomic.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+/* Shadow record wire format inside a TpuMsgqCmd: dst = channel pointer,
+ * src = tracker value, bytes = kind, pbEnd = channel rc id. */
+
+/* Watchdog bookkeeping per registered channel. */
+typedef struct RcChannel {
+    TpurmChannel *ch;
+    uint64_t rcId;
+    uint64_t lastCompleted;
+    uint64_t stuckSinceNs;       /* 0 = progressing */
+    bool barked;                 /* one watchdog fault per stall */
+    struct RcChannel *next;
+} RcChannel;
+
+static struct {
+    pthread_once_t once;
+    TpuMsgq *shadow;             /* the non-replayable fault buffer */
+    pthread_t service;
+    pthread_t watchdog;
+    bool ready;
+
+    pthread_mutex_t chLock;
+    RcChannel *channels;
+} g_rc = { .once = PTHREAD_ONCE_INIT,
+           .chLock = PTHREAD_MUTEX_INITIALIZER };
+
+/* ------------------------------------------------------ shadow service */
+
+static void *rc_service_thread(void *arg)
+{
+    (void)arg;
+    TpuMsgqCmd cmd;
+    while (tpuMsgqReceive(g_rc.shadow, &cmd, 1) == 1) {
+        TpurmChannel *ch = (TpurmChannel *)(uintptr_t)cmd.dst;
+        uint64_t value = cmd.src;
+        uint32_t kind = (uint32_t)cmd.bytes;
+        uint64_t rcId = cmd.pbEnd;
+        tpuLog(TPU_LOG_ERROR, "rc",
+               "non-replayable %s on channel %p at value %llu",
+               kind == TPU_RC_WATCHDOG_TIMEOUT ? "watchdog timeout"
+                                               : "CE fault",
+               (void *)ch, (unsigned long long)value);
+        tpuCounterAdd("rc_nonreplayable_faults", 1);
+        if (kind == TPU_RC_WATCHDOG_TIMEOUT)
+            tpuCounterAdd("rc_watchdog_timeouts", 1);
+
+        /* Attribution under chLock: a racing channel destroy calls
+         * tpuRcChannelUnregister (same lock) before freeing, so a LIVE
+         * channel cannot vanish mid-delivery.  Notifiers therefore run
+         * under the RC lock and must not create/destroy channels. */
+        pthread_mutex_lock(&g_rc.chLock);
+        for (RcChannel *rc = g_rc.channels; rc; rc = rc->next) {
+            /* Pointer AND id must match: a recycled allocation at the
+             * same address has a different id, so stale records from a
+             * destroyed channel never misattribute (ABA guard). */
+            if (rc->ch == ch && rc->rcId == rcId) {
+                tpurmChannelRcDeliver(ch, value, kind);
+                break;
+            }
+        }
+        pthread_mutex_unlock(&g_rc.chLock);
+        tpuMsgqComplete(g_rc.shadow, cmd.seq);
+    }
+    return NULL;
+}
+
+/* ---------------------------------------------------------- watchdog */
+
+static void *rc_watchdog_thread(void *arg)
+{
+    (void)arg;
+    for (;;) {
+        uint64_t periodMs = tpuRegistryGet("rc_watchdog_period_ms", 100);
+        uint64_t timeoutMs = tpuRegistryGet("rc_watchdog_timeout_ms", 2000);
+        struct timespec ts = { .tv_sec = (time_t)(periodMs / 1000),
+                               .tv_nsec = (long)(periodMs % 1000) *
+                                          1000000L };
+        nanosleep(&ts, NULL);
+        if (!tpuRegistryGet("rc_watchdog_enable", 1))
+            continue;
+
+        uint64_t now = uvmMonotonicNs();
+        pthread_mutex_lock(&g_rc.chLock);
+        for (RcChannel *rc = g_rc.channels; rc; rc = rc->next) {
+            uint64_t completed, pendingDepth;
+            tpurmChannelProgress(rc->ch, &completed, &pendingDepth);
+            if (pendingDepth == 0 || completed != rc->lastCompleted) {
+                rc->lastCompleted = completed;
+                rc->stuckSinceNs = 0;
+                rc->barked = false;
+                continue;
+            }
+            if (rc->stuckSinceNs == 0) {
+                rc->stuckSinceNs = now;
+                continue;
+            }
+            if (!rc->barked &&
+                now - rc->stuckSinceNs > timeoutMs * 1000000ull) {
+                rc->barked = true;
+                tpuRcPostFault(rc->ch, rc->rcId, completed,
+                               TPU_RC_WATCHDOG_TIMEOUT);
+            }
+        }
+        pthread_mutex_unlock(&g_rc.chLock);
+    }
+    return NULL;
+}
+
+/* --------------------------------------------------------------- init */
+
+static void rc_init_once(void)
+{
+    g_rc.shadow = tpuMsgqCreate(
+        (uint32_t)tpuRegistryGet("rc_shadow_entries", 256), TPU_MSGQ_MPSC);
+    if (!g_rc.shadow)
+        return;
+    if (pthread_create(&g_rc.service, NULL, rc_service_thread, NULL) != 0) {
+        tpuLog(TPU_LOG_ERROR, "rc", "RC service thread create failed");
+        tpuMsgqDestroy(g_rc.shadow);
+        g_rc.shadow = NULL;
+        return;
+    }
+    if (pthread_create(&g_rc.watchdog, NULL, rc_watchdog_thread,
+                       NULL) != 0) {
+        /* Tear down cleanly: shutdown wakes the service thread out of
+         * its Receive loop, then the queue can be freed. */
+        tpuLog(TPU_LOG_ERROR, "rc", "RC watchdog thread create failed");
+        tpuMsgqShutdown(g_rc.shadow);
+        pthread_join(g_rc.service, NULL);
+        tpuMsgqDestroy(g_rc.shadow);
+        g_rc.shadow = NULL;
+        return;
+    }
+    g_rc.ready = true;
+    tpuLog(TPU_LOG_INFO, "rc", "robust-channel recovery ready "
+           "(shadow buffer + watchdog)");
+}
+
+void tpuRcInit(void)
+{
+    pthread_once(&g_rc.once, rc_init_once);
+}
+
+/* Post a non-replayable fault record into the shadow buffer.  Callers
+ * are channel executors (CE faults) and the watchdog; NEVER blocks —
+ * on a full shadow buffer the record is dropped with a counter (the
+ * channel error latch itself is synchronous, so no error is lost,
+ * only its attribution). */
+void tpuRcPostFault(TpurmChannel *ch, uint64_t rcId, uint64_t value,
+                    uint32_t kind)
+{
+    tpuRcInit();
+    if (!g_rc.ready)
+        return;
+    TpuMsgqCmd cmd = { .op = TPU_MSGQ_NOP,
+                       .dst = (uint64_t)(uintptr_t)ch,
+                       .src = value,
+                       .bytes = kind,
+                       .pbEnd = rcId };
+    if (tpuMsgqTrySubmit(g_rc.shadow, &cmd, 1, NULL) != 0)
+        tpuCounterAdd("rc_shadow_overflows", 1);
+}
+
+/* -------------------------------------------- channel registry hooks */
+
+void tpuRcChannelRegister(TpurmChannel *ch, uint64_t rcId)
+{
+    tpuRcInit();
+    RcChannel *rc = calloc(1, sizeof(*rc));
+    if (!rc)
+        return;
+    rc->ch = ch;
+    rc->rcId = rcId;
+    pthread_mutex_lock(&g_rc.chLock);
+    rc->next = g_rc.channels;
+    g_rc.channels = rc;
+    pthread_mutex_unlock(&g_rc.chLock);
+}
+
+void tpuRcChannelUnregister(TpurmChannel *ch)
+{
+    pthread_mutex_lock(&g_rc.chLock);
+    for (RcChannel **pp = &g_rc.channels; *pp; pp = &(*pp)->next) {
+        if ((*pp)->ch == ch) {
+            RcChannel *dead = *pp;
+            *pp = dead->next;
+            free(dead);
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_rc.chLock);
+}
+

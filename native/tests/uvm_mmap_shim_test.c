@@ -73,6 +73,20 @@ int main(void)
     FreeParams fp = { (uint64_t)(uintptr_t)q, 0xFFFFFFFFu };
     CHECK(ioctl(fd, UVM_FREE, &fp) == 0 && fp.rmStatus == 0);
 
+    /* procfs tree through the shim: the reference spelling resolves to
+     * a synthetic node served as a real fd (nv-procfs.c analog). */
+    int pfd = open("/proc/driver/nvidia/gpus/0/information", O_RDONLY);
+    CHECK(pfd >= 0);
+    char info[4096];
+    ssize_t got = read(pfd, info, sizeof(info) - 1);
+    CHECK(got > 0);
+    info[got] = '\0';
+    CHECK(strstr(info, "Device Instance:") != NULL);
+    CHECK(strstr(info, "HBM Arena:") != NULL);
+    CHECK(close(pfd) == 0);
+    /* Debug-gated node hidden without procfs_debug. */
+    CHECK(open("/proc/driver/tpurm-uvm/counters", O_RDONLY) == -1);
+
     /* Plain anonymous mmap/munmap still work untouched. */
     void *anon = mmap(NULL, 4096, PROT_READ | PROT_WRITE,
                       MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);

@@ -237,6 +237,11 @@ def load() -> ctypes.CDLL:
     lib.tpurmCounterGet.restype = ctypes.c_uint64
     lib.tpurmJournalDump.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.tpurmJournalDump.restype = ctypes.c_size_t
+    lib.tpurmProcfsRead.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_size_t]
+    lib.tpurmProcfsRead.restype = ctypes.c_size_t
+    lib.tpurmProcfsList.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.tpurmProcfsList.restype = ctypes.c_size_t
 
     _lib = lib
     return lib

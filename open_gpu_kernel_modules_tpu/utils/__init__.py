@@ -49,4 +49,26 @@ def registry_get(key: str, default: Optional[int] = None) -> Optional[int]:
         return default
 
 
-__all__ = ["journal_dump", "counter", "counters", "registry_get"]
+def procfs_read(path: str, max_bytes: int = 1 << 16) -> str:
+    """Render a procfs node (reference: /proc/driver/nvidia*,
+    /proc/driver/nvidia-uvm/*; both spellings accepted).  Empty string
+    for unknown or debug-gated nodes."""
+    import ctypes
+
+    lib = native.load()
+    buf = ctypes.create_string_buffer(max_bytes)
+    n = lib.tpurmProcfsRead(path.encode(), buf, max_bytes)
+    return buf.raw[:n].decode(errors="replace")
+
+
+def procfs_list(max_bytes: int = 4096) -> List[str]:
+    import ctypes
+
+    lib = native.load()
+    buf = ctypes.create_string_buffer(max_bytes)
+    n = lib.tpurmProcfsList(buf, max_bytes)
+    return [p for p in buf.raw[:n].decode().splitlines() if p]
+
+
+__all__ = ["journal_dump", "counter", "counters", "registry_get",
+           "procfs_read", "procfs_list"]

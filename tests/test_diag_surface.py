@@ -29,3 +29,21 @@ def test_registry_matches_native_resolution(monkeypatch):
     assert utils.registry_get("absent_knob", 7) == 7
     monkeypatch.setenv("TPUMEM_BAD_KNOB", "zzz")
     assert utils.registry_get("bad_knob", 9) == 9
+
+
+def test_procfs_nodes(monkeypatch):
+    """/proc/driver observability analog (reference nv-procfs.c,
+    uvm_procfs.c debug gating)."""
+    info = utils.procfs_read("/proc/driver/nvidia/gpus/0/information")
+    assert "Device Instance:" in info and "Arena Backend:" in info
+    ver = utils.procfs_read("driver/tpurm/version")
+    assert "tpurm version" in ver
+    stats = utils.procfs_read("/proc/driver/nvidia-uvm/fault_stats")
+    assert "cpu_faults:" in stats and "service_p50_ns:" in stats
+    # Debug gating: counters node hidden unless procfs_debug=1.
+    assert utils.procfs_read("driver/tpurm-uvm/counters") == ""
+    monkeypatch.setenv("TPUMEM_PROCFS_DEBUG", "1")
+    body = utils.procfs_read("driver/tpurm-uvm/counters")
+    assert "channel_pushes" in body
+    nodes = utils.procfs_list()
+    assert "driver/tpurm/version" in nodes
