@@ -84,6 +84,7 @@ extern "C" {
 #define UVM_TPU_ALLOC_MANAGED             1001
 #define UVM_TPU_DEVICE_ACCESS             1002
 #define UVM_TPU_RESIDENCY_INFO            1003
+#define UVM_TPU_ADOPT_PAGEABLE            1004
 
 #define UVM_MIGRATE_FLAG_ASYNC            0x00000001
 
@@ -202,6 +203,12 @@ typedef struct {
     uint32_t testCmd;
     TpuStatus rmStatus;
 } UvmRunTestParams;
+
+typedef struct {
+    uint64_t base   __attribute__((aligned(8)));   /* IN */
+    uint64_t length __attribute__((aligned(8)));   /* IN */
+    TpuStatus rmStatus;                            /* OUT */
+} UvmAdoptPageableParams;
 
 /* External ranges (reference: UVM_CREATE_EXTERNAL_RANGE_PARAMS,
  * uvm_ioctl.h:1042; UVM_UNMAP_EXTERNAL_PARAMS:935 — ours omits gpuUuid
@@ -349,6 +356,15 @@ typedef struct {
 } UvmFaultStats;
 void uvmFaultStatsGet(UvmFaultStats *out);
 
+/* Pageable memory (HMM analog, reference uvm_hmm.c): adopt an existing
+ * anonymous mapping into a managed range IN PLACE, preserving contents
+ * — device faults, tiering, policies and eviction then apply to memory
+ * the engine did not allocate.  2 MB block alignment required; freeing
+ * the range restores a plain anonymous mapping with current contents.
+ * Device accesses to non-managed pageable VAs are serviced in place
+ * (ATS analog) when HMM is enabled (registry uvm_disable_hmm=0). */
+TpuStatus uvmPageableAdopt(UvmVaSpace *vs, void *base, uint64_t len);
+
 /* ------------------------------------------------- external mappings */
 
 /* External VA ranges (reference: uvm_map_external.c; ioctls 73/33/66).
@@ -459,6 +475,7 @@ enum {
     UVM_TPU_TEST_SUSPEND_RESUME       = 12,
     UVM_TPU_TEST_EXTERNAL_RANGE       = 13,
     UVM_TPU_TEST_RANGE_SPLIT          = 14,
+    UVM_TPU_TEST_HMM_PAGEABLE         = 15,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

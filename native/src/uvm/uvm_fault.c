@@ -1114,6 +1114,23 @@ TpuStatus uvmDeviceAccess(UvmVaSpace *vs, uint32_t devInst, void *base,
         return TPU_ERR_INVALID_ARGUMENT;
     if (!tpurmDeviceGet(devInst))
         return TPU_ERR_INVALID_DEVICE;
+    /* Non-managed span: the pageable/ATS path (uvm_hmm.c) services it
+     * in place when HMM is enabled (reference: pageable faults route to
+     * HMM/ATS, service_fault_batch_dispatch). */
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "dev-access");
+    bool managed = uvmRangeTreeIterFirst(&vs->ranges, (uintptr_t)base,
+                                         (uintptr_t)base + len - 1) != NULL;
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "dev-access");
+    pthread_mutex_unlock(&vs->lock);
+    if (!managed) {
+        uvmPmEnterShared();
+        TpuStatus ps = uvmPageableDeviceAccess(vs, devInst, base, len,
+                                               isWrite);
+        uvmPmExitShared();
+        return ps;
+    }
+
     UvmFaultEntry e = {
         .addr = (uintptr_t)base,
         .len = len,

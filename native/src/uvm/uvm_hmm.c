@@ -1,0 +1,252 @@
+/*
+ * uvm_hmm — the pageable-memory path: managed semantics for memory the
+ * engine did not allocate.
+ *
+ * Reference capability (uvm_hmm.c, 3,790 LoC; uvm_ats*.c): with HMM,
+ * ANY malloc'd/pageable CPU memory is GPU-accessible — device faults on
+ * pageable VAs either migrate the pages into vidmem via device-private
+ * pages (HMM) or access them in place through the CPU page tables
+ * (ATS).  TPU-native shape, both halves:
+ *
+ *   ATS analog    — uvmDeviceAccess on a VA with no managed range
+ *                   services IN PLACE: the span stays in host memory
+ *                   (which TPU DMA engines reach anyway — our CE
+ *                   consumes host pointers), pages are touched/pinned
+ *                   best-effort, and access is accounted.  Gated by
+ *                   registry "uvm_disable_hmm" (reference module param
+ *                   uvm_disable_hmm, uvm_hmm.c:28-49).
+ *   HMM adoption  — uvmPageableAdopt converts an existing anonymous
+ *                   mapping into a FULL managed range in place,
+ *                   preserving contents (the migrate_vma analog: the
+ *                   engine takes ownership of the pages): faults,
+ *                   tiering, policies, eviction all apply afterwards.
+ *                   Freeing the range restores a plain anonymous
+ *                   mapping with the current contents, so the caller's
+ *                   allocator (e.g. malloc arena) keeps working.
+ *
+ * Adoption requires 2 MB block alignment: VA blocks partition fault
+ * service by ABSOLUTE 2 MB windows (uvm_fault.c worker_for), so an
+ * unaligned managed range would break the one-worker-per-block
+ * invariant the perf state depends on.
+ */
+#define _GNU_SOURCE
+#include "uvm_internal.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+bool uvmHmmEnabled(void)
+{
+    return tpuRegistryGet("uvm_disable_hmm", 0) == 0;
+}
+
+/* True when [base, base+len) lies entirely inside writable private
+ * anonymous mappings (rw-p with no backing path) per /proc/self/maps. */
+static bool hmm_span_is_private_anon_rw(uintptr_t base, uint64_t len)
+{
+    FILE *f = fopen("/proc/self/maps", "r");
+    if (!f)
+        return false;
+    uintptr_t need = base, end = base + len;
+    char line[512];
+    while (need < end && fgets(line, sizeof(line), f)) {
+        uintptr_t lo, hi;
+        char perms[8] = "";
+        uint64_t off;
+        unsigned devMaj, devMin;
+        uint64_t inode = 1;
+        char path[256] = "";
+        int n = sscanf(line, "%lx-%lx %7s %lx %x:%x %lu %255s",
+                       (unsigned long *)&lo, (unsigned long *)&hi, perms,
+                       (unsigned long *)&off, &devMaj, &devMin,
+                       (unsigned long *)&inode, path);
+        if (n < 7 || hi <= need || lo > need)
+            continue;
+        if (perms[0] != 'r' || perms[1] != 'w' || perms[3] != 'p' ||
+            inode != 0 || (n >= 8 && path[0] == '/'))
+            break;              /* wrong kind of mapping */
+        need = hi;              /* covered up to here; keep walking */
+    }
+    fclose(f);
+    return need >= end;
+}
+
+/* ----------------------------------------------------- ATS-style access */
+
+/* Service a device access to PAGEABLE (non-managed) memory in place.
+ * The bytes stay host-resident — TPU DMA reads them through the normal
+ * host path — so "service" means: verify the span is readable, touch
+ * the pages so they are materialized for DMA, and account the access
+ * (reference: service_fault_batch_ats, uvm_ats_faults.c:1892). */
+TpuStatus uvmPageableDeviceAccess(UvmVaSpace *vs, uint32_t devInst,
+                                  void *base, uint64_t len, int isWrite)
+{
+    (void)vs;
+    (void)devInst;
+    if (!uvmHmmEnabled())
+        return TPU_ERR_OBJECT_NOT_FOUND;    /* pre-HMM behavior */
+
+    /* msync validates the span maps SOMETHING without risking a fault
+     * in the engine (EINVAL/ENOMEM for bogus VAs). */
+    uint64_t ps = (uint64_t)sysconf(_SC_PAGESIZE);
+    uintptr_t start = (uintptr_t)base & ~(ps - 1);
+    uintptr_t end = ((uintptr_t)base + len + ps - 1) & ~(ps - 1);
+    if (msync((void *)start, end - start, MS_ASYNC) != 0)
+        return TPU_ERR_INVALID_ADDRESS;
+
+    /* Touch so DMA sees materialized pages; the transient mlock pins
+     * them across the touch and is released (an unbounded pin over
+     * every ATS span would pile toward RLIMIT_MEMLOCK). */
+    mlock((void *)start, end - start);      /* best-effort */
+    volatile const uint8_t *p = (const uint8_t *)start;
+    for (uintptr_t off = 0; off < end - start; off += ps)
+        (void)p[off];
+    munlock((void *)start, end - start);
+    (void)isWrite;
+    tpuCounterAdd("uvm_ats_accesses", 1);
+    tpuCounterAdd("uvm_ats_bytes", len);
+    return TPU_OK;
+}
+
+/* --------------------------------------------------------- HMM adoption */
+
+TpuStatus uvmPageableAdopt(UvmVaSpace *vs, void *base, uint64_t len)
+{
+    if (!vs || !base || len == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (!uvmHmmEnabled())
+        return TPU_ERR_NOT_SUPPORTED;
+    if (((uintptr_t)base & (UVM_BLOCK_SIZE - 1)) ||
+        (len & (UVM_BLOCK_SIZE - 1)))
+        return TPU_ERR_INVALID_ADDRESS;     /* block-aligned spans only */
+
+    /* The span must be existing writable PRIVATE ANONYMOUS memory:
+     * adopting a file-backed or read-only mapping would silently sever
+     * file coherence / grant writability (checked against
+     * /proc/self/maps — adoption is rare, the parse is cheap). */
+    if (!hmm_span_is_private_anon_rw((uintptr_t)base, len))
+        return TPU_ERR_INVALID_ADDRESS;
+
+    /* Managed backing: memfd + always-RW engine alias (exactly the
+     * mem_alloc layout), preloaded with the CALLER'S BYTES. */
+    int memfd = memfd_create("tpurm-uvm-adopt", MFD_CLOEXEC);
+    if (memfd < 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    if (ftruncate(memfd, (off_t)len) != 0) {
+        close(memfd);
+        return TPU_ERR_NO_MEMORY;
+    }
+    void *alias = mmap(NULL, len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                       memfd, 0);
+    if (alias == MAP_FAILED) {
+        close(memfd);
+        return TPU_ERR_NO_MEMORY;
+    }
+    memcpy(alias, base, len);               /* take ownership of bytes */
+
+    UvmVaRange *range = calloc(1, sizeof(*range));
+    UvmVaBlock **blocks = calloc(len / UVM_BLOCK_SIZE, sizeof(*blocks));
+    if (!range || !blocks) {
+        free(range);
+        free(blocks);
+        munmap(alias, len);
+        close(memfd);
+        return TPU_ERR_NO_MEMORY;
+    }
+
+    uint64_t ps = uvmPageSize();
+    uint32_t ppb = uvmPagesPerBlock();
+    range->memfd = memfd;
+    range->alias = alias;
+    range->node.start = (uintptr_t)base;
+    range->node.end = (uintptr_t)base + len - 1;
+    range->vaSpace = vs;
+    range->type = UVM_RANGE_TYPE_MANAGED;
+    range->adopted = true;
+    range->size = len;
+    range->allocStart = (uintptr_t)base;
+    range->allocSize = len;
+    range->blockCount = (uint32_t)(len / UVM_BLOCK_SIZE);
+    range->blocks = blocks;
+    for (uint32_t i = 0; i < range->blockCount; i++) {
+        UvmVaBlock *blk = calloc(1, sizeof(*blk));
+        if (!blk) {
+            for (uint32_t j = 0; j < i; j++)
+                free(range->blocks[j]);
+            free(blocks);
+            free(range);
+            munmap(alias, len);
+            close(memfd);
+            return TPU_ERR_NO_MEMORY;
+        }
+        pthread_mutex_init(&blk->lock, NULL);
+        blk->range = range;
+        blk->start = (uintptr_t)base + (uint64_t)i * UVM_BLOCK_SIZE;
+        blk->npages = ppb;
+        blk->pinnedTier = -1;
+        blk->lastTargetTier = -1;
+        /* Adopted pages are live host data with valid RW PTEs. */
+        uvmPageMaskSetRange(&blk->resident[UVM_TIER_HOST], 0, ppb);
+        uvmPageMaskSetRange(&blk->cpuMapped, 0, ppb);
+        range->blocks[i] = blk;
+    }
+    (void)ps;
+
+    /* Reserve the span in the tree FIRST (atomic overlap check +
+     * insert, so concurrent adopters of overlapping spans cannot both
+     * proceed to the MAP_FIXED swap), then swap the backing under the
+     * VA: the memfd mapping replaces the anonymous pages in place
+     * (contents identical, so the caller observes nothing). */
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "hmm-adopt");
+    TpuStatus st = uvmRangeTreeAdd(&vs->ranges, &range->node);
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "hmm-adopt");
+    pthread_mutex_unlock(&vs->lock);
+    if (st != TPU_OK) {
+        for (uint32_t i = 0; i < range->blockCount; i++)
+            free(range->blocks[i]);
+        free(blocks);
+        free(range);
+        munmap(alias, len);
+        close(memfd);
+        return st == TPU_ERR_STATE_IN_USE ? TPU_ERR_INSERT_DUPLICATE_NAME
+                                          : st;
+    }
+    if (mmap(base, len, PROT_READ | PROT_WRITE,
+             MAP_SHARED | MAP_FIXED, memfd, 0) == MAP_FAILED) {
+        pthread_mutex_lock(&vs->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "hmm-adopt");
+        uvmRangeTreeRemove(&vs->ranges, &range->node);
+        tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "hmm-adopt");
+        pthread_mutex_unlock(&vs->lock);
+        for (uint32_t i = 0; i < range->blockCount; i++)
+            free(range->blocks[i]);
+        free(blocks);
+        free(range);
+        munmap(alias, len);
+        close(memfd);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+    uvmFaultSnapshotRebuild();
+    tpuCounterAdd("uvm_hmm_adoptions", 1);
+    tpuLog(TPU_LOG_INFO, "uvm", "adopted pageable span %p + %llu MB",
+           base, (unsigned long long)(len >> 20));
+    return TPU_OK;
+}
+
+/* Called by range_destroy for adopted ranges (vs lock held): put a
+ * plain anonymous mapping with the CURRENT contents back under the VA
+ * so the caller's allocator keeps working.  The engine alias always
+ * reflects the memfd (host tier); pages resident only device-side are
+ * pulled home by the migrate in uvmMemFree's adopted pre-pass. */
+void uvmHmmRestoreOnDestroy(UvmVaRange *range)
+{
+    void *base = (void *)(uintptr_t)range->node.start;
+    if (mmap(base, range->size, PROT_READ | PROT_WRITE,
+             MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0) == MAP_FAILED)
+        return;                 /* VA lost; nothing safe to do */
+    memcpy(base, range->alias, range->size);
+}

@@ -214,6 +214,9 @@ typedef struct UvmVaRange {
     /* Original allocation extent, preserved across splits: uvmMemFree
      * on the allocation base frees every fragment. */
     uint64_t allocStart, allocSize;
+    /* HMM adoption (uvm_hmm.c): the VA belongs to the caller; destroy
+     * restores an anonymous mapping with the current contents. */
+    bool adopted;
     /* Managed host backing: a memfd mapped twice — the user VA (node
      * start; protection-controlled, faults drive migration) and an
      * engine alias that is always RW.  The copy engine reads/writes the
@@ -358,6 +361,13 @@ typedef struct UvmFaultEntry {
 void uvmFaultEngineInit(void);        /* idempotent */
 void uvmFaultEngineRegisterSpace(UvmVaSpace *vs);
 UvmVaSpace *uvmFaultSpaceForAddr(uint64_t addr);
+
+/* ------------------------------------------------------ pageable (HMM) */
+
+bool uvmHmmEnabled(void);
+TpuStatus uvmPageableDeviceAccess(UvmVaSpace *vs, uint32_t devInst,
+                                  void *base, uint64_t len, int isWrite);
+void uvmHmmRestoreOnDestroy(UvmVaRange *range);
 void uvmFaultEngineUnregisterSpace(UvmVaSpace *vs);
 /* Rebuild the signal-safe VA lookup snapshot after range add/remove. */
 void uvmFaultSnapshotRebuild(void);

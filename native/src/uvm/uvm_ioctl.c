@@ -339,9 +339,17 @@ static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
         return 0;
     }
     case UVM_PAGEABLE_MEM_ACCESS: {
-        /* No ATS/HMM analog wired yet: pageable access unsupported. */
+        /* HMM/ATS analog wired (uvm_hmm.c): pageable memory is device
+         * accessible unless registry uvm_disable_hmm is set (reference
+         * uvm_hmm.c:28-49 module param). */
         struct { uint8_t pageableMemAccess; } *p = argp;
-        p->pageableMemAccess = 0;
+        p->pageableMemAccess = uvmHmmEnabled() ? 1 : 0;
+        return 0;
+    }
+    case UVM_TPU_ADOPT_PAGEABLE: {
+        UvmAdoptPageableParams *p = argp;
+        p->rmStatus = uvmPageableAdopt(vs, (void *)(uintptr_t)p->base,
+                                       p->length);
         return 0;
     }
     case UVM_TPU_ALLOC_MANAGED: {

@@ -763,6 +763,58 @@ static TpuStatus test_range_split(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* --------------------------------------------------- pageable (HMM) */
+
+static TpuStatus test_hmm_pageable(UvmVaSpace *vs)
+{
+    /* ATS path: device access to plain malloc'd memory services in
+     * place (no managed range anywhere near it). */
+    uint64_t before = tpurmCounterGet("uvm_ats_accesses");
+    size_t sz = 256 * 1024;
+    uint8_t *p = malloc(sz);
+    CHECK(p != NULL);
+    memset(p, 0x31, sz);
+    CHECK(uvmDeviceAccess(vs, 0, p, sz, 0) == TPU_OK);
+    CHECK(tpurmCounterGet("uvm_ats_accesses") > before);
+    CHECK(p[100] == 0x31);               /* untouched, in place */
+    free(p);
+
+    /* Adoption: an aligned span becomes fully managed IN PLACE. */
+    void *a = NULL;
+    CHECK(posix_memalign(&a, UVM_BLOCK_SIZE, 2 * UVM_BLOCK_SIZE) == 0);
+    memset(a, 0x77, 2 * UVM_BLOCK_SIZE);
+    CHECK(uvmPageableAdopt(vs, a, 2 * UVM_BLOCK_SIZE) == TPU_OK);
+    volatile uint8_t *va = a;
+    CHECK(va[123] == 0x77);              /* contents preserved */
+    CHECK(va[2 * UVM_BLOCK_SIZE - 1] == 0x77);
+
+    /* Misaligned spans are rejected. */
+    uint8_t *mis = malloc(3 * UVM_BLOCK_SIZE);
+    CHECK(mis != NULL);
+    uintptr_t misAligned = ((uintptr_t)mis + UVM_BLOCK_SIZE) &
+                           ~(UVM_BLOCK_SIZE - 1);
+    CHECK(uvmPageableAdopt(vs, (void *)(misAligned + 4096),
+                           UVM_BLOCK_SIZE) == TPU_ERR_INVALID_ADDRESS);
+    free(mis);
+
+    /* Full managed semantics on adopted memory: device write fault
+     * migrates to HBM; CPU read faults it home with the data intact. */
+    CHECK(uvmDeviceAccess(vs, 0, a, UVM_BLOCK_SIZE, 1) == TPU_OK);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, a, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+    CHECK(va[123] == 0x77);              /* CPU fault pulls it back */
+    va[7] = 0x42;
+
+    /* Freeing restores a plain anonymous mapping with CURRENT bytes:
+     * the caller's allocator keeps working. */
+    CHECK(uvmMemFree(vs, a) == TPU_OK);
+    CHECK(va[7] == 0x42 && va[123] == 0x77);
+    va[8] = 1;                           /* still writable anon memory */
+    free(a);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -796,6 +848,8 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return vs ? test_external_range(vs) : TPU_ERR_INVALID_ARGUMENT;
     case UVM_TPU_TEST_RANGE_SPLIT:
         return vs ? test_range_split(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_HMM_PAGEABLE:
+        return vs ? test_hmm_pageable(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

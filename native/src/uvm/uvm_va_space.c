@@ -101,7 +101,12 @@ static void range_destroy(UvmVaSpace *vs, UvmVaRange *range)
         free(range);
         return;
     }
-    munmap((void *)(uintptr_t)range->node.start, range->size);
+    if (range->adopted)
+        /* Put an anonymous mapping with the current contents back under
+         * the caller's VA (their allocator still owns it). */
+        uvmHmmRestoreOnDestroy(range);
+    else
+        munmap((void *)(uintptr_t)range->node.start, range->size);
     if (range->alias)
         munmap(range->alias, range->size);
     if (range->memfd >= 0)
@@ -113,6 +118,28 @@ void uvmVaSpaceDestroy(UvmVaSpace *vs)
 {
     if (!vs)
         return;
+    /* Adopted ranges must carry their CURRENT bytes into the restored
+     * anonymous mappings: pull device residency home before teardown
+     * (the memFree path does the same per allocation). */
+    enum { MAX_ADOPTED = 64 };
+    struct { uint64_t start, size; } adopted[MAX_ADOPTED];
+    uint32_t nAdopted = 0;
+    vs_lock(vs);
+    for (UvmRangeTreeNode *n = vs->ranges.first;
+         n && nAdopted < MAX_ADOPTED; n = uvmRangeTreeNext(n)) {
+        UvmVaRange *r = (UvmVaRange *)n;
+        if (r->adopted) {
+            adopted[nAdopted].start = n->start;
+            adopted[nAdopted].size = r->size;
+            nAdopted++;
+        }
+    }
+    vs_unlock(vs);
+    UvmLocation home = { .tier = UVM_TIER_HOST, .devInst = 0 };
+    for (uint32_t i = 0; i < nAdopted; i++)
+        uvmMigrate(vs, (void *)(uintptr_t)adopted[i].start,
+                   adopted[i].size, home, 0);
+
     uvmFaultEngineUnregisterSpace(vs);
     vs_lock(vs);
     UvmRangeTreeNode *n = vs->ranges.first;
@@ -322,6 +349,26 @@ static TpuStatus mem_free_gated(UvmVaSpace *vs, void *ptr);
 
 TpuStatus uvmMemFree(UvmVaSpace *vs, void *ptr)
 {
+    /* Adopted ranges: pull device-resident pages home FIRST so the
+     * restored anonymous mapping carries the current bytes (uvm_hmm.c
+     * contract).  Peek under the lock, migrate outside it. */
+    if (vs && ptr) {
+        vs_lock(vs);
+        UvmRangeTreeNode *n = uvmRangeTreeFind(&vs->ranges,
+                                               (uintptr_t)ptr);
+        bool adopted = n && n->start == (uintptr_t)ptr &&
+                       ((UvmVaRange *)n)->adopted;
+        uint64_t asize = adopted ? ((UvmVaRange *)n)->allocSize : 0;
+        vs_unlock(vs);
+        if (adopted) {
+            UvmLocation host = { .tier = UVM_TIER_HOST, .devInst = 0 };
+            TpuStatus ms = uvmMigrate(vs, ptr, asize, host, 0);
+            if (ms != TPU_OK)
+                /* Restoring stale bytes would silently lose the
+                 * caller's data: refuse the free instead. */
+                return ms;
+        }
+    }
     /* PM gate (shared): frees block while suspended (saved-residency
      * records must not dangle). */
     uvmPmEnterShared();
@@ -417,6 +464,7 @@ static TpuStatus range_split_locked(UvmVaSpace *vs, UvmVaRange *range,
     tail->size = range->size - (splitVa - start);
     tail->allocStart = range->allocStart;
     tail->allocSize = range->allocSize;
+    tail->adopted = range->adopted;    /* frees must restore, not unmap */
     tail->memfd = newFd;
     tail->alias = (char *)range->alias + (splitVa - start);
     /* Policy inheritance. */

@@ -395,3 +395,55 @@ def test_fault_latency_bounds_and_parallel_service(vs):
 
     for b in bufs:
         b.free()
+
+
+def test_hmm_pageable_adopt_and_ats(vs):
+    """HMM analog: device access to pageable (non-managed) memory, and
+    adoption of an existing anonymous mapping into managed memory in
+    place with contents preserved (reference uvm_hmm.c capability)."""
+    import ctypes
+
+    from open_gpu_kernel_modules_tpu.runtime import native
+    from open_gpu_kernel_modules_tpu import utils
+
+    lib = native.load()
+    lib.uvmPageableAdopt.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_uint64]
+    lib.uvmPageableAdopt.restype = ctypes.c_uint32
+
+    # ATS path: plain numpy (malloc'd) memory is device-accessible.
+    arr = np.full(64 * 1024, 7, np.uint8)
+    before = utils.counter("uvm_ats_accesses")
+    st = lib.uvmDeviceAccess(vs._handle, 0, arr.ctypes.data, arr.nbytes, 0)
+    assert st == 0
+    assert utils.counter("uvm_ats_accesses") > before
+    assert int(arr[100]) == 7
+
+    # Adoption: a 2MB-aligned anonymous mapping becomes managed.
+    libc = ctypes.CDLL(None, use_errno=True)
+    libc.mmap.restype = ctypes.c_void_p
+    libc.mmap.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+                          ctypes.c_int, ctypes.c_int, ctypes.c_long]
+    size = 4 * MB
+    raw = libc.mmap(None, size + 2 * MB, 0x3, 0x22, -1, 0)  # RW anon
+    base = (raw + 2 * MB - 1) & ~(2 * MB - 1)
+    view = np.frombuffer((ctypes.c_char * size).from_address(base),
+                         np.uint8)
+    view[:] = 0x5E
+    assert lib.uvmPageableAdopt(vs._handle, base, size) == 0
+    assert int(view[123]) == 0x5E                  # contents preserved
+
+    # Managed semantics now apply: device fault moves it to HBM.
+    assert lib.uvmDeviceAccess(vs._handle, 0, base, 2 * MB, 1) == 0
+    from open_gpu_kernel_modules_tpu.uvm.managed import _ResidencyInfo
+    raw_info = _ResidencyInfo()
+    assert lib.uvmResidencyInfo(vs._handle, base,
+                                ctypes.byref(raw_info)) == 0
+    assert raw_info.residentHbm
+    assert int(view[123]) == 0x5E                  # CPU fault home
+
+    # Free restores plain anonymous memory with the current bytes.
+    view[7] = 0x42
+    assert lib.uvmMemFree(vs._handle, base) == 0
+    assert int(view[7]) == 0x42 and int(view[123]) == 0x5E
+    view[8] = 1                                    # still writable
