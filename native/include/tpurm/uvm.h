@@ -476,6 +476,7 @@ enum {
     UVM_TPU_TEST_EXTERNAL_RANGE       = 13,
     UVM_TPU_TEST_RANGE_SPLIT          = 14,
     UVM_TPU_TEST_HMM_PAGEABLE         = 15,
+    UVM_TPU_TEST_DEV_MMU              = 16,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

@@ -491,6 +491,14 @@ static TpuStatus service_one(UvmFaultEntry *e)
             (accessedByMask >> e->devInst) & 1) {
             st = uvmBlockMapDevice(blk, firstPage, count, e->isWrite != 0);
             if (st == TPU_OK) {
+                /* Install the accessed-by device's PTEs onto the data
+                 * where it lives (aperture tiers only). */
+                pthread_mutex_lock(&blk->lock);
+                tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "pte-map");
+                uvmBlockPtePopulate(blk, firstPage, count, e->devInst,
+                                    e->isWrite != 0);
+                tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "pte-map");
+                pthread_mutex_unlock(&blk->lock);
                 uvmToolsEmit(vs, UVM_EVENT_GPU_FAULT, UVM_TIER_COUNT,
                              UVM_TIER_COUNT, e->devInst, addr,
                              (uint64_t)count * ps);
@@ -516,6 +524,17 @@ static TpuStatus service_one(UvmFaultEntry *e)
             st = uvmBlockMakeResidentEx(blk, dst, firstPage, count,
                                         e->isWrite != 0, forceDup);
             if (st == TPU_OK) {
+                /* Device faults install the faulting device's PTEs onto
+                 * the new residency (reference: fault service writes
+                 * GPU PTEs + TLB membar, uvm_pte_batch/uvm_tlb_batch). */
+                if (e->source == UVM_FAULT_SRC_DEVICE) {
+                    pthread_mutex_lock(&blk->lock);
+                    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "pte-install");
+                    uvmBlockPtePopulate(blk, firstPage, count, e->devInst,
+                                        e->isWrite != 0);
+                    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "pte-install");
+                    pthread_mutex_unlock(&blk->lock);
+                }
                 uvmToolsEmit(vs, e->source == UVM_FAULT_SRC_CPU
                                      ? UVM_EVENT_CPU_FAULT
                                      : UVM_EVENT_GPU_FAULT,

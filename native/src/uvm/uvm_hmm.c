@@ -36,6 +36,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 bool uvmHmmEnabled(void)
@@ -89,23 +90,33 @@ TpuStatus uvmPageableDeviceAccess(UvmVaSpace *vs, uint32_t devInst,
     if (!uvmHmmEnabled())
         return TPU_ERR_OBJECT_NOT_FOUND;    /* pre-HMM behavior */
 
-    /* msync validates the span maps SOMETHING without risking a fault
-     * in the engine (EINVAL/ENOMEM for bogus VAs). */
+    /* Probe + materialize every page WITHOUT risking a fault in the
+     * engine: process_vm_readv on our own pid returns EFAULT/partial
+     * for unmapped or PROT_NONE pages instead of delivering SIGSEGV,
+     * and for writes process_vm_writev proves writability (writing a
+     * byte back to itself).  The transient mlock pins the span across
+     * the probe and is released (an unbounded pin over every ATS span
+     * would pile toward RLIMIT_MEMLOCK). */
     uint64_t ps = (uint64_t)sysconf(_SC_PAGESIZE);
     uintptr_t start = (uintptr_t)base & ~(ps - 1);
     uintptr_t end = ((uintptr_t)base + len + ps - 1) & ~(ps - 1);
-    if (msync((void *)start, end - start, MS_ASYNC) != 0)
-        return TPU_ERR_INVALID_ADDRESS;
-
-    /* Touch so DMA sees materialized pages; the transient mlock pins
-     * them across the touch and is released (an unbounded pin over
-     * every ATS span would pile toward RLIMIT_MEMLOCK). */
     mlock((void *)start, end - start);      /* best-effort */
-    volatile const uint8_t *p = (const uint8_t *)start;
-    for (uintptr_t off = 0; off < end - start; off += ps)
-        (void)p[off];
+    pid_t self = getpid();
+    for (uintptr_t off = 0; off < end - start; off += ps) {
+        uint8_t byte;
+        struct iovec lv = { &byte, 1 };
+        struct iovec rv = { (void *)(start + off), 1 };
+        if (process_vm_readv(self, &lv, 1, &rv, 1, 0) != 1) {
+            munlock((void *)start, end - start);
+            return TPU_ERR_INVALID_ADDRESS;
+        }
+        if (isWrite &&
+            process_vm_writev(self, &lv, 1, &rv, 1, 0) != 1) {
+            munlock((void *)start, end - start);
+            return TPU_ERR_INVALID_ADDRESS;   /* not writable */
+        }
+    }
     munlock((void *)start, end - start);
-    (void)isWrite;
     tpuCounterAdd("uvm_ats_accesses", 1);
     tpuCounterAdd("uvm_ats_bytes", len);
     return TPU_OK;
@@ -145,7 +156,6 @@ TpuStatus uvmPageableAdopt(UvmVaSpace *vs, void *base, uint64_t len)
         close(memfd);
         return TPU_ERR_NO_MEMORY;
     }
-    memcpy(alias, base, len);               /* take ownership of bytes */
 
     UvmVaRange *range = calloc(1, sizeof(*range));
     UvmVaBlock **blocks = calloc(len / UVM_BLOCK_SIZE, sizeof(*blocks));
@@ -215,6 +225,11 @@ TpuStatus uvmPageableAdopt(UvmVaSpace *vs, void *base, uint64_t len)
         return st == TPU_ERR_STATE_IN_USE ? TPU_ERR_INSERT_DUPLICATE_NAME
                                           : st;
     }
+    /* Take ownership of the bytes immediately before the swap.  The
+     * copy->swap window is not atomic: a concurrent writer to the span
+     * can lose its store (same contract as the kernel's migrate_vma —
+     * the caller must quiesce writers while adopting). */
+    memcpy(alias, base, len);
     if (mmap(base, len, PROT_READ | PROT_WRITE,
              MAP_SHARED | MAP_FIXED, memfd, 0) == MAP_FAILED) {
         pthread_mutex_lock(&vs->lock);

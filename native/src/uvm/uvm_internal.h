@@ -298,6 +298,12 @@ void uvmBlockFreeBacking(UvmVaBlock *blk);
  * page has no HBM run. */
 bool uvmBlockHbmArenaOffset(UvmVaBlock *blk, uint32_t page,
                             uint64_t *outOffset);
+/* Device-MMU wiring (blk->lock held): install PTEs for aperture-resident
+ * pages of the span / revoke the span's PTEs on every device. */
+void uvmBlockPtePopulate(UvmVaBlock *blk, uint32_t firstPage,
+                         uint32_t count, uint32_t devInst, bool writable);
+void uvmBlockPteRevoke(UvmVaBlock *blk, uint32_t firstPage,
+                       uint32_t count);
 
 /* Accessed-by mapping: map pages for a device where they currently
  * reside, without migration (fails TPU_ERR_INVALID_STATE if any page is
@@ -361,6 +367,40 @@ typedef struct UvmFaultEntry {
 void uvmFaultEngineInit(void);        /* idempotent */
 void uvmFaultEngineRegisterSpace(UvmVaSpace *vs);
 UvmVaSpace *uvmFaultSpaceForAddr(uint64_t addr);
+
+/* ------------------------------------------------------ device MMU */
+
+/* Per-device page tables + batched PTE/TLB operations (reference:
+ * uvm_mmu.c, uvm_pte_batch.c, uvm_tlb_batch.c).  The device VA is the
+ * managed VA (identity, like the reference's UVM mapping); a PTE
+ * resolves to (tier, offset-in-tier-arena). */
+#define UVM_PTE_BATCH_MAX 64
+
+typedef struct {
+    uint32_t devInst;
+    uint32_t count;
+    uint32_t clearedLive;       /* clears that hit a VALID pte */
+    struct { uint64_t va, pte; } entries[UVM_PTE_BATCH_MAX];
+} UvmPteBatch;
+
+typedef struct {
+    uint32_t devInst;
+    uint64_t pendingPages;
+} UvmTlbBatch;
+
+void uvmPteBatchBegin(UvmPteBatch *b, uint32_t devInst);
+void uvmPteBatchWrite(UvmPteBatch *b, uint64_t va, UvmTier tier,
+                      uint64_t tierOff, bool writable);
+void uvmPteBatchClear(UvmPteBatch *b, uint64_t va);
+void uvmPteBatchEnd(UvmPteBatch *b);
+void uvmTlbBatchBegin(UvmTlbBatch *b, uint32_t devInst);
+void uvmTlbBatchAdd(UvmTlbBatch *b, uint64_t va, uint32_t npages);
+void uvmTlbBatchEnd(UvmTlbBatch *b);
+TpuStatus uvmDevMmuTranslate(uint32_t devInst, uint64_t va, UvmTier *tier,
+                             uint64_t *tierOff, bool *writable);
+uint64_t uvmDevMmuTlbGeneration(uint32_t devInst);
+void uvmDevMmuStats(uint32_t devInst, uint64_t *pteWrites,
+                    uint64_t *pteClears, uint64_t *tlbInvalidates);
 
 /* ------------------------------------------------------ pageable (HMM) */
 

@@ -1,0 +1,284 @@
+/*
+ * uvm_mmu — the device-side MMU: per-device page tables over the
+ * managed VA, with batched PTE writes and batched TLB invalidates.
+ *
+ * Re-design of the reference trio (uvm_mmu.c — GPU page-table tree over
+ * the portable walker lib; uvm_pte_batch.c — PTE writes coalesced into
+ * batches; uvm_tlb_batch.c — invalidates accumulated per operation and
+ * issued once with a membar).  TPU-native shape: the device VA equals
+ * the managed CPU VA (the reference's UVM identity mapping), and a PTE
+ * resolves it to (tier, arena offset) — the address a DMA engine needs.
+ * "TLB" state is a per-device invalidate generation: consumers caching
+ * translations revalidate when the generation moves, and every batch
+ * flush is one generation bump + one release fence, exactly the
+ * one-invalidate-per-batch economy the reference's batch exists for.
+ *
+ * Tables: 3-level radix over the 48-bit VA at uvm-page granularity
+ * (VPN split 13/13/10 — covers the full 36-bit VPN at the 4 KB page
+ * floor; at the 64 KB default the top bits are simply zero).
+ * Directories install
+ * with CAS so concurrent faults on different blocks never lock; PTE
+ * stores are release so a translate acquiring the PTE sees the mapped
+ * bytes.
+ */
+#define _GNU_SOURCE
+#include "uvm_internal.h"
+
+#include <stdatomic.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* 13/13/10 covers a 36-bit VPN — the full 48-bit VA even at the 4 KB
+ * registry page size (uvm_page_size floor). */
+#define MMU_TOP_BITS 13
+#define MMU_MID_BITS 13
+#define MMU_LEAF_BITS 10
+#define MMU_TOP_N (1u << MMU_TOP_BITS)
+#define MMU_MID_N (1u << MMU_MID_BITS)
+#define MMU_LEAF_N (1u << MMU_LEAF_BITS)
+
+/* PTE layout: [63:pageShift] offset (page-aligned by construction —
+ * the mask is derived from the RUNTIME uvm page size, which the
+ * registry may lower to 4 KB), [3:2] tier, [1] writable, [0] valid. */
+#define PTE_VALID 0x1ull
+#define PTE_WRITE 0x2ull
+#define PTE_TIER_SHIFT 2
+#define PTE_TIER_MASK (0x3ull << PTE_TIER_SHIFT)
+
+static uint64_t pte_off_mask(void)
+{
+    return ~(uvmPageSize() - 1);
+}
+
+typedef struct {
+    _Atomic uint64_t pte[MMU_LEAF_N];
+} MmuLeaf;
+
+typedef struct {
+    _Atomic(MmuLeaf *) leaves[MMU_MID_N];
+} MmuMid;
+
+typedef struct {
+    _Atomic(MmuMid *) mids[MMU_TOP_N];
+    _Atomic uint64_t tlbGeneration;
+    _Atomic uint64_t pteWrites, pteClears, tlbInvalidates;
+} DevMmu;
+
+static struct {
+    pthread_once_t once;
+    DevMmu *mmus;               /* one per enumerated device */
+    uint32_t count;
+} g_mmu = { .once = PTHREAD_ONCE_INIT };
+
+static void mmu_init_once(void)
+{
+    tpuDeviceGlobalInit();
+    g_mmu.count = tpurmDeviceCount();
+    g_mmu.mmus = calloc(g_mmu.count, sizeof(DevMmu));
+}
+
+static DevMmu *mmu_get(uint32_t devInst)
+{
+    pthread_once(&g_mmu.once, mmu_init_once);
+    if (!g_mmu.mmus || devInst >= g_mmu.count)
+        return NULL;
+    return &g_mmu.mmus[devInst];
+}
+
+/* Leaf for `va`, creating directories on demand (NULL = no table and
+ * create not requested, or OOM). */
+static MmuLeaf *mmu_leaf(DevMmu *m, uint64_t va, bool create,
+                         uint32_t *leafIdx)
+{
+    uint64_t vpn = va >> __builtin_ctzll(uvmPageSize());
+    uint32_t li = (uint32_t)(vpn & (MMU_LEAF_N - 1));
+    uint32_t mi = (uint32_t)((vpn >> MMU_LEAF_BITS) & (MMU_MID_N - 1));
+    uint32_t ti = (uint32_t)((vpn >> (MMU_LEAF_BITS + MMU_MID_BITS)) &
+                             (MMU_TOP_N - 1));
+    *leafIdx = li;
+
+    MmuMid *mid = atomic_load_explicit(&m->mids[ti], memory_order_acquire);
+    if (!mid) {
+        if (!create)
+            return NULL;
+        MmuMid *fresh = calloc(1, sizeof(*fresh));
+        if (!fresh)
+            return NULL;
+        MmuMid *expect = NULL;
+        if (atomic_compare_exchange_strong(&m->mids[ti], &expect, fresh))
+            mid = fresh;
+        else {
+            free(fresh);
+            mid = expect;
+        }
+    }
+    MmuLeaf *leaf = atomic_load_explicit(&mid->leaves[mi],
+                                         memory_order_acquire);
+    if (!leaf) {
+        if (!create)
+            return NULL;
+        MmuLeaf *fresh = calloc(1, sizeof(*fresh));
+        if (!fresh)
+            return NULL;
+        MmuLeaf *expect = NULL;
+        if (atomic_compare_exchange_strong(&mid->leaves[mi], &expect,
+                                           fresh))
+            leaf = fresh;
+        else {
+            free(fresh);
+            leaf = expect;
+        }
+    }
+    return leaf;
+}
+
+/* ----------------------------------------------------------- PTE batch */
+
+void uvmPteBatchBegin(UvmPteBatch *b, uint32_t devInst)
+{
+    b->devInst = devInst;
+    b->count = 0;
+    b->clearedLive = 0;
+}
+
+static void pte_batch_flush(UvmPteBatch *b)
+{
+    DevMmu *m = mmu_get(b->devInst);
+    if (m) {
+        for (uint32_t i = 0; i < b->count; i++) {
+            uint32_t li;
+            MmuLeaf *leaf = mmu_leaf(m, b->entries[i].va,
+                                     /*create=*/b->entries[i].pte != 0,
+                                     &li);
+            if (!leaf)
+                continue;       /* clear of a never-mapped page */
+            uint64_t old = atomic_exchange_explicit(
+                &leaf->pte[li], b->entries[i].pte, memory_order_release);
+            if (b->entries[i].pte) {
+                atomic_fetch_add_explicit(&m->pteWrites, 1,
+                                          memory_order_relaxed);
+            } else if (old & PTE_VALID) {
+                atomic_fetch_add_explicit(&m->pteClears, 1,
+                                          memory_order_relaxed);
+                b->clearedLive++;
+            }
+        }
+        tpuCounterAdd("uvm_mmu_pte_batches", 1);
+    }
+    b->count = 0;
+}
+
+static void pte_batch_add(UvmPteBatch *b, uint64_t va, uint64_t pte)
+{
+    if (b->count == UVM_PTE_BATCH_MAX)
+        pte_batch_flush(b);
+    b->entries[b->count].va = va;
+    b->entries[b->count].pte = pte;
+    b->count++;
+}
+
+void uvmPteBatchWrite(UvmPteBatch *b, uint64_t va, UvmTier tier,
+                      uint64_t tierOff, bool writable)
+{
+    pte_batch_add(b, va, (tierOff & pte_off_mask()) |
+                         ((uint64_t)tier << PTE_TIER_SHIFT) |
+                         (writable ? PTE_WRITE : 0) | PTE_VALID);
+}
+
+void uvmPteBatchClear(UvmPteBatch *b, uint64_t va)
+{
+    pte_batch_add(b, va, 0);
+}
+
+void uvmPteBatchEnd(UvmPteBatch *b)
+{
+    if (b->count)
+        pte_batch_flush(b);
+}
+
+/* ----------------------------------------------------------- TLB batch */
+
+void uvmTlbBatchBegin(UvmTlbBatch *b, uint32_t devInst)
+{
+    b->devInst = devInst;
+    b->pendingPages = 0;
+}
+
+void uvmTlbBatchAdd(UvmTlbBatch *b, uint64_t va, uint32_t npages)
+{
+    (void)va;                   /* ranges fold into one invalidate */
+    b->pendingPages += npages;
+}
+
+/* One invalidate for the whole batch (uvm_tlb_batch economy): a release
+ * fence orders the preceding PTE stores, then the generation bump tells
+ * translation caches to revalidate. */
+void uvmTlbBatchEnd(UvmTlbBatch *b)
+{
+    if (b->pendingPages == 0)
+        return;
+    DevMmu *m = mmu_get(b->devInst);
+    if (!m)
+        return;
+    atomic_thread_fence(memory_order_release);
+    atomic_fetch_add_explicit(&m->tlbGeneration, 1, memory_order_acq_rel);
+    atomic_fetch_add_explicit(&m->tlbInvalidates, 1, memory_order_relaxed);
+    tpuCounterAdd("uvm_mmu_tlb_invalidates", 1);
+    tpuCounterAdd("uvm_mmu_tlb_pages", b->pendingPages);
+    b->pendingPages = 0;
+}
+
+/* ----------------------------------------------------------- translate */
+
+TpuStatus uvmDevMmuTranslate(uint32_t devInst, uint64_t va, UvmTier *tier,
+                             uint64_t *tierOff, bool *writable)
+{
+    DevMmu *m = mmu_get(devInst);
+    if (!m)
+        return TPU_ERR_INVALID_DEVICE;
+    uint32_t li;
+    MmuLeaf *leaf = mmu_leaf(m, va, /*create=*/false, &li);
+    if (!leaf)
+        return TPU_ERR_INVALID_ADDRESS;
+    uint64_t pte = atomic_load_explicit(&leaf->pte[li],
+                                        memory_order_acquire);
+    if (!(pte & PTE_VALID))
+        return TPU_ERR_INVALID_ADDRESS;
+    uint64_t ps = uvmPageSize();
+    if (tier)
+        *tier = (UvmTier)((pte & PTE_TIER_MASK) >> PTE_TIER_SHIFT);
+    if (tierOff)
+        *tierOff = (pte & ~(ps - 1)) | (va & (ps - 1));
+    if (writable)
+        *writable = (pte & PTE_WRITE) != 0;
+    return TPU_OK;
+}
+
+uint64_t uvmDevMmuTlbGeneration(uint32_t devInst)
+{
+    DevMmu *m = mmu_get(devInst);
+    return m ? atomic_load_explicit(&m->tlbGeneration,
+                                    memory_order_acquire)
+             : 0;
+}
+
+void uvmDevMmuStats(uint32_t devInst, uint64_t *pteWrites,
+                    uint64_t *pteClears, uint64_t *tlbInvalidates)
+{
+    DevMmu *m = mmu_get(devInst);
+    if (!m) {
+        if (pteWrites)
+            *pteWrites = 0;
+        if (pteClears)
+            *pteClears = 0;
+        if (tlbInvalidates)
+            *tlbInvalidates = 0;
+        return;
+    }
+    if (pteWrites)
+        *pteWrites = atomic_load(&m->pteWrites);
+    if (pteClears)
+        *pteClears = atomic_load(&m->pteClears);
+    if (tlbInvalidates)
+        *tlbInvalidates = atomic_load(&m->tlbInvalidates);
+}

@@ -815,6 +815,63 @@ static TpuStatus test_hmm_pageable(UvmVaSpace *vs)
     return TPU_OK;
 }
 
+/* ----------------------------------------------------- device MMU */
+
+static TpuStatus test_dev_mmu(UvmVaSpace *vs)
+{
+    uint64_t ps = uvmPageSize();
+    void *ptr = NULL;
+    CHECK(uvmMemAlloc(vs, 2 * UVM_BLOCK_SIZE, &ptr) == TPU_OK);
+    memset(ptr, 0x21, 2 * UVM_BLOCK_SIZE);
+
+    /* Unmapped VA: no translation. */
+    UvmTier tier;
+    uint64_t off;
+    bool writable;
+    CHECK(uvmDevMmuTranslate(0, (uintptr_t)ptr, &tier, &off, &writable) ==
+          TPU_ERR_INVALID_ADDRESS);
+
+    /* Device write fault installs PTEs pointing at the HBM backing. */
+    CHECK(uvmDeviceAccess(vs, 0, ptr, UVM_BLOCK_SIZE, 1) == TPU_OK);
+    CHECK(uvmDevMmuTranslate(0, (uintptr_t)ptr, &tier, &off, &writable) ==
+          TPU_OK);
+    CHECK(tier == UVM_TIER_HBM && writable);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, ptr, &info) == TPU_OK);
+    CHECK(info.residentHbm && off == info.hbmOffset);
+    /* Page-offset bits carry through the translation. */
+    uint64_t off2;
+    CHECK(uvmDevMmuTranslate(0, (uintptr_t)ptr + ps + 123, &tier, &off2,
+                             NULL) == TPU_OK);
+    CHECK((off2 & (ps - 1)) == 123);
+
+    /* Migration home revokes the PTEs and bumps the TLB generation. */
+    uint64_t gen = uvmDevMmuTlbGeneration(0);
+    UvmLocation home = { .tier = UVM_TIER_HOST, .devInst = 0 };
+    CHECK(uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, home, 0) == TPU_OK);
+    CHECK(uvmDevMmuTranslate(0, (uintptr_t)ptr, &tier, &off, NULL) ==
+          TPU_ERR_INVALID_ADDRESS);
+    CHECK(uvmDevMmuTlbGeneration(0) > gen);
+
+    /* CXL-preferred data: device read fault maps the CXL aperture. */
+    UvmLocation cxl = { .tier = UVM_TIER_CXL, .devInst = 0 };
+    CHECK(uvmSetPreferredLocation(vs, ptr, 2 * UVM_BLOCK_SIZE, cxl) ==
+          TPU_OK);
+    CHECK(uvmDeviceAccess(vs, 0, (char *)ptr + UVM_BLOCK_SIZE,
+                          UVM_BLOCK_SIZE, 0) == TPU_OK);
+    CHECK(uvmDevMmuTranslate(0, (uintptr_t)ptr + UVM_BLOCK_SIZE, &tier,
+                             &off, &writable) == TPU_OK);
+    CHECK(tier == UVM_TIER_CXL && !writable);
+
+    /* PTE/TLB batch accounting moved. */
+    uint64_t w, c, inv;
+    uvmDevMmuStats(0, &w, &c, &inv);
+    CHECK(w >= 2 && c >= 1 && inv >= 1);
+
+    CHECK(uvmMemFree(vs, ptr) == TPU_OK);
+    return TPU_OK;
+}
+
 /* ----------------------------------------------------------- dispatch */
 
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
@@ -850,6 +907,8 @@ TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd)
         return vs ? test_range_split(vs) : TPU_ERR_INVALID_ARGUMENT;
     case UVM_TPU_TEST_HMM_PAGEABLE:
         return vs ? test_hmm_pageable(vs) : TPU_ERR_INVALID_ARGUMENT;
+    case UVM_TPU_TEST_DEV_MMU:
+        return vs ? test_dev_mmu(vs) : TPU_ERR_INVALID_ARGUMENT;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }

@@ -74,6 +74,68 @@ bool uvmBlockHbmArenaOffset(UvmVaBlock *blk, uint32_t page,
     return true;
 }
 
+/* ------------------------------------------------- device MMU wiring */
+
+/* Arena offset of `page` in `tier` (HBM/CXL only; blk->lock held). */
+static bool block_tier_offset(UvmVaBlock *blk, UvmTier tier, uint32_t page,
+                              uint64_t *outOffset)
+{
+    UvmChunkRun *r = run_find(blk, tier, page);
+    if (!r)
+        return false;
+    *outOffset = r->chunk->offset +
+                 (uint64_t)(page - r->firstPage) * uvmPageSize();
+    return true;
+}
+
+/* Install device PTEs for every page of the span resident in a device
+ * aperture (HBM first, CXL second; host-resident pages carry no PTE —
+ * the sysmem path flows through CE host pointers).  blk->lock held. */
+void uvmBlockPtePopulate(UvmVaBlock *blk, uint32_t firstPage,
+                         uint32_t count, uint32_t devInst, bool writable)
+{
+    uint64_t ps = uvmPageSize();
+    UvmPteBatch pb;
+    uvmPteBatchBegin(&pb, devInst);
+    for (uint32_t p = firstPage; p < firstPage + count; p++) {
+        uint64_t off;
+        uint64_t va = blk->start + (uint64_t)p * ps;
+        if (uvmPageMaskTest(&blk->resident[UVM_TIER_HBM], p) &&
+            block_tier_offset(blk, UVM_TIER_HBM, p, &off))
+            uvmPteBatchWrite(&pb, va, UVM_TIER_HBM, off, writable);
+        else if (uvmPageMaskTest(&blk->resident[UVM_TIER_CXL], p) &&
+                 block_tier_offset(blk, UVM_TIER_CXL, p, &off))
+            uvmPteBatchWrite(&pb, va, UVM_TIER_CXL, off, writable);
+    }
+    uvmPteBatchEnd(&pb);
+}
+
+/* Revoke device PTEs for the span on EVERY device and issue one TLB
+ * invalidate per device (uvm_tlb_batch economy).  Called on any
+ * transition that moves or drops aperture residency.  blk->lock held. */
+void uvmBlockPteRevoke(UvmVaBlock *blk, uint32_t firstPage, uint32_t count)
+{
+    uint64_t ps = uvmPageSize();
+    uint32_t ndev = tpurmDeviceCount();
+    for (uint32_t d = 0; d < ndev; d++) {
+        UvmPteBatch pb;
+        UvmTlbBatch tb;
+        uvmPteBatchBegin(&pb, d);
+        uvmTlbBatchBegin(&tb, d);
+        for (uint32_t p = firstPage; p < firstPage + count; p++)
+            uvmPteBatchClear(&pb, blk->start + (uint64_t)p * ps);
+        uvmPteBatchEnd(&pb);
+        /* Invalidate only when a LIVE translation was torn down — CPU
+         * faults on host-only blocks must not thrash every device's
+         * translation caches. */
+        if (pb.clearedLive) {
+            uvmTlbBatchAdd(&tb, blk->start + (uint64_t)firstPage * ps,
+                           count);
+            uvmTlbBatchEnd(&tb);
+        }
+    }
+}
+
 /* Allocate backing runs in `arena` covering every page of [first,
  * first+count) that lacks one.  Greedy largest-pow2 chunks.  Returns
  * TPU_ERR_NO_MEMORY if the arena is exhausted (caller evicts + retries). */
@@ -445,8 +507,10 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                          UVM_TIER_HOST, blk->hbmDevInst, blk->start, bytes);
         }
         uvmPageMaskClearRange(&blk->resident[tier], first, last - first + 1);
-        /* Evicted pages lose any accessed-by device mapping into them. */
+        /* Evicted pages lose any accessed-by device mapping into them,
+         * and their device PTEs (one TLB invalidate per device). */
         uvmPageMaskClearRange(&blk->devMapped, first, last - first + 1);
+        uvmBlockPteRevoke(blk, first, last - first + 1);
     }
     block_gc_runs(blk, tier);
     uvmFaultStatsRecordEviction();
@@ -489,6 +553,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
 
     UvmVaRange *range = blk->range;
     bool readDup = (range->readDuplication || forceDup) && !forWrite;
+    bool pteRevoked = false;    /* one PTE revoke per span, not two */
     UvmTierArena *arena = NULL;
     if (dst.tier == UVM_TIER_HBM) {
         arena = uvmTierArenaHbm(dst.devInst);
@@ -612,7 +677,10 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
 
         /* Commit masks.  Residency movement stales any accessed-by device
          * mapping to the old location; clear so the next device access
-         * re-establishes it (reference revokes mappings on migration). */
+         * re-establishes it (reference revokes mappings on migration),
+         * and drop the device PTEs covering the moved span. */
+        uvmBlockPteRevoke(blk, firstPage, count);
+        pteRevoked = true;
         for (uint32_t p = firstPage; p < firstPage + count; p++) {
             if (!uvmPageMaskTest(&needed, p))
                 continue;
@@ -673,6 +741,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             /* Exclusive write revokes remote (accessed-by) mappings. */
             uvmPageMaskClear(&blk->devMapped, p);
         }
+        if (!pteRevoked)        /* commit loop may already have */
+            uvmBlockPteRevoke(blk, firstPage, count);
         if (dst.tier != UVM_TIER_HOST) {
             uvmBlockSetCpuAccess(blk, firstPage, count, PROT_NONE);
         } else {
@@ -756,6 +826,8 @@ TpuStatus uvmBlockMapDevice(UvmVaBlock *blk, uint32_t firstPage,
         }
     }
     uvmPageMaskSetRange(&blk->devMapped, firstPage, count);
+    /* (The caller installs the mapping device's PTEs: this function has
+     * no device identity — service_one does.) */
 
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-map");
     pthread_mutex_unlock(&blk->lock);
@@ -771,6 +843,10 @@ void uvmBlockFreeBacking(UvmVaBlock *blk)
      * (typically under it) cannot deadlock. */
     while (atomic_load_explicit(&blk->serviceRefs, memory_order_acquire))
         sched_yield();
+    /* Dying block: its device PTEs must not outlive the backing.  AFTER
+     * the drain — a pinned service could otherwise re-populate PTEs
+     * behind the revoke, leaving them dangling into freed chunks. */
+    uvmBlockPteRevoke(blk, 0, blk->npages);
     UvmTierArena *hbm = uvmTierArenaHbm(blk->hbmDevInst);
     UvmTierArena *cxl = uvmTierArenaCxl();
     /* An evictor may have popped this block off an LRU and still hold the

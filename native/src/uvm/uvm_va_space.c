@@ -120,25 +120,43 @@ void uvmVaSpaceDestroy(UvmVaSpace *vs)
         return;
     /* Adopted ranges must carry their CURRENT bytes into the restored
      * anonymous mappings: pull device residency home before teardown
-     * (the memFree path does the same per allocation). */
-    enum { MAX_ADOPTED = 64 };
-    struct { uint64_t start, size; } adopted[MAX_ADOPTED];
-    uint32_t nAdopted = 0;
+     * (the memFree path does the same per allocation).  No cap — every
+     * adopted range is collected; a failed migrate is LOGGED loudly
+     * (destroy cannot refuse like memFree does, but silent stale
+     * restores are the one unacceptable outcome). */
+    struct AdoptedSpan { uint64_t start, size; };
+    struct AdoptedSpan *adopted = NULL;
+    uint32_t nAdopted = 0, capAdopted = 0;
     vs_lock(vs);
-    for (UvmRangeTreeNode *n = vs->ranges.first;
-         n && nAdopted < MAX_ADOPTED; n = uvmRangeTreeNext(n)) {
+    for (UvmRangeTreeNode *n = vs->ranges.first; n;
+         n = uvmRangeTreeNext(n)) {
         UvmVaRange *r = (UvmVaRange *)n;
-        if (r->adopted) {
-            adopted[nAdopted].start = n->start;
-            adopted[nAdopted].size = r->size;
-            nAdopted++;
+        if (!r->adopted)
+            continue;
+        if (nAdopted == capAdopted) {
+            capAdopted = capAdopted ? capAdopted * 2 : 16;
+            struct AdoptedSpan *grown =
+                realloc(adopted, capAdopted * sizeof(*adopted));
+            if (!grown)
+                break;          /* OOM: remaining ranges get the log */
+            adopted = grown;
         }
+        adopted[nAdopted].start = n->start;
+        adopted[nAdopted].size = r->size;
+        nAdopted++;
     }
     vs_unlock(vs);
     UvmLocation home = { .tier = UVM_TIER_HOST, .devInst = 0 };
-    for (uint32_t i = 0; i < nAdopted; i++)
-        uvmMigrate(vs, (void *)(uintptr_t)adopted[i].start,
-                   adopted[i].size, home, 0);
+    for (uint32_t i = 0; i < nAdopted; i++) {
+        TpuStatus ms = uvmMigrate(vs, (void *)(uintptr_t)adopted[i].start,
+                                  adopted[i].size, home, 0);
+        if (ms != TPU_OK)
+            tpuLog(TPU_LOG_ERROR, "uvm",
+                   "adopted range %#llx migrate-home failed (0x%x): "
+                   "restored contents will be STALE",
+                   (unsigned long long)adopted[i].start, ms);
+    }
+    free(adopted);
 
     uvmFaultEngineUnregisterSpace(vs);
     vs_lock(vs);
