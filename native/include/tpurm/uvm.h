@@ -423,7 +423,23 @@ typedef enum {
     UVM_EVENT_READ_DUP = 6,
     UVM_EVENT_ACCESS_COUNTER = 7,
     UVM_EVENT_FATAL_FAULT = 8,
-    UVM_EVENT_COUNT = 9,
+    /* Lifecycle/infra events (reference vocabulary: GPU_FAULT_REPLAY,
+     * FAULT_BUFFER_FLUSH, MAP_REMOTE, READ_DUPLICATE_INVALIDATE, ...). */
+    UVM_EVENT_GPU_FAULT_REPLAY = 9,
+    UVM_EVENT_FAULT_BUFFER_FLUSH = 10,
+    UVM_EVENT_MAP_REMOTE = 11,
+    UVM_EVENT_READ_DUP_INVALIDATE = 12,
+    UVM_EVENT_PTE_UPDATE = 13,
+    UVM_EVENT_TLB_INVALIDATE = 14,
+    UVM_EVENT_CHANNEL_RC = 15,
+    UVM_EVENT_WATCHDOG = 16,
+    UVM_EVENT_PM_SUSPEND = 17,
+    UVM_EVENT_PM_RESUME = 18,
+    UVM_EVENT_EXTERNAL_MAP = 19,
+    UVM_EVENT_EXTERNAL_UNMAP = 20,
+    UVM_EVENT_HMM_ADOPT = 21,
+    UVM_EVENT_ATS_ACCESS = 22,
+    UVM_EVENT_COUNT = 23,
 } UvmEventType;
 
 typedef struct {

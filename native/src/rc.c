@@ -80,6 +80,11 @@ static void *rc_service_thread(void *arg)
         tpuCounterAdd("rc_nonreplayable_faults", 1);
         if (kind == TPU_RC_WATCHDOG_TIMEOUT)
             tpuCounterAdd("rc_watchdog_timeouts", 1);
+        uvmToolsEmit(NULL,
+                     kind == TPU_RC_WATCHDOG_TIMEOUT ? UVM_EVENT_WATCHDOG
+                                                     : UVM_EVENT_CHANNEL_RC,
+                     UVM_TIER_COUNT, UVM_TIER_COUNT, 0,
+                     (uint64_t)(uintptr_t)ch, value);
 
         /* Attribution under chLock: a racing channel destroy calls
          * tpuRcChannelUnregister (same lock) before freeing, so a LIVE

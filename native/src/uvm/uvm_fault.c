@@ -499,7 +499,7 @@ static TpuStatus service_one(UvmFaultEntry *e)
                                     e->isWrite != 0);
                 tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "pte-map");
                 pthread_mutex_unlock(&blk->lock);
-                uvmToolsEmit(vs, UVM_EVENT_GPU_FAULT, UVM_TIER_COUNT,
+                uvmToolsEmit(vs, UVM_EVENT_MAP_REMOTE, UVM_TIER_COUNT,
                              UVM_TIER_COUNT, e->devInst, addr,
                              (uint64_t)count * ps);
                 /* Remote (mapped) access: feed the access counters; a hot
@@ -559,6 +559,12 @@ static TpuStatus service_one(UvmFaultEntry *e)
 static void replay_wake(UvmFaultEntry *e, uint64_t nowNs)
 {
     lat_record(nowNs - e->enqueueNs);
+    /* Only successfully serviced device faults REPLAY; fatal ones were
+     * cancelled (FATAL_FAULT already emitted) and must not also read as
+     * replayed. */
+    if (e->source == UVM_FAULT_SRC_DEVICE && e->serviceStatus == TPU_OK)
+        uvmToolsEmit(e->vs, UVM_EVENT_GPU_FAULT_REPLAY, UVM_TIER_COUNT,
+                     UVM_TIER_COUNT, e->devInst, e->addr, e->len);
     uint32_t doneVal = e->serviceStatus == TPU_OK ? 1 : 2;
     __atomic_store_n(e->doneWord, doneVal, __ATOMIC_SEQ_CST);
     futex_call(e->doneWord, FUTEX_WAKE, 1);
@@ -813,6 +819,8 @@ static void *fault_service_thread(void *arg)
                 batch[n++] = extra;
                 tpuCounterAdd("uvm_fault_flush_serviced", 1);
             }
+            uvmToolsEmit(NULL, UVM_EVENT_FAULT_BUFFER_FLUSH,
+                         UVM_TIER_COUNT, UVM_TIER_COUNT, 0, 0, n);
         }
 
         uint64_t t1 = uvmMonotonicNs();

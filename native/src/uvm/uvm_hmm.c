@@ -119,6 +119,8 @@ TpuStatus uvmPageableDeviceAccess(UvmVaSpace *vs, uint32_t devInst,
     munlock((void *)start, end - start);
     tpuCounterAdd("uvm_ats_accesses", 1);
     tpuCounterAdd("uvm_ats_bytes", len);
+    uvmToolsEmit(vs, UVM_EVENT_ATS_ACCESS, UVM_TIER_HOST, UVM_TIER_HOST,
+                 devInst, (uintptr_t)base, len);
     return TPU_OK;
 }
 
@@ -247,6 +249,8 @@ TpuStatus uvmPageableAdopt(UvmVaSpace *vs, void *base, uint64_t len)
     }
     uvmFaultSnapshotRebuild();
     tpuCounterAdd("uvm_hmm_adoptions", 1);
+    uvmToolsEmit(vs, UVM_EVENT_HMM_ADOPT, UVM_TIER_HOST, UVM_TIER_HOST,
+                 0, (uintptr_t)base, len);
     tpuLog(TPU_LOG_INFO, "uvm", "adopted pageable span %p + %llu MB",
            base, (unsigned long long)(len >> 20));
     return TPU_OK;

@@ -164,6 +164,9 @@ static void pte_batch_flush(UvmPteBatch *b)
             }
         }
         tpuCounterAdd("uvm_mmu_pte_batches", 1);
+        uvmToolsEmit(NULL, UVM_EVENT_PTE_UPDATE, UVM_TIER_COUNT,
+                     UVM_TIER_COUNT, b->devInst,
+                     b->count ? b->entries[0].va : 0, b->count);
     }
     b->count = 0;
 }
@@ -225,6 +228,8 @@ void uvmTlbBatchEnd(UvmTlbBatch *b)
     atomic_fetch_add_explicit(&m->tlbInvalidates, 1, memory_order_relaxed);
     tpuCounterAdd("uvm_mmu_tlb_invalidates", 1);
     tpuCounterAdd("uvm_mmu_tlb_pages", b->pendingPages);
+    uvmToolsEmit(NULL, UVM_EVENT_TLB_INVALIDATE, UVM_TIER_COUNT,
+                 UVM_TIER_COUNT, b->devInst, 0, b->pendingPages);
     b->pendingPages = 0;
 }
 

@@ -144,6 +144,8 @@ TpuStatus uvmSuspend(void)
     uvmFaultForEachSpace(pm_save_block);
 
     tpuCounterAdd("uvm_suspends", 1);
+    uvmToolsEmit(NULL, UVM_EVENT_PM_SUSPEND, UVM_TIER_COUNT,
+                 UVM_TIER_COUNT, 0, 0, 0);
     tpuLog(TPU_LOG_INFO, "uvm_pm", "suspended (arenas saved to host)");
     /* Gate stays closed (g_suspended) until uvmResume — from any thread. */
     return TPU_OK;
@@ -189,6 +191,8 @@ TpuStatus uvmResume(void)
     pthread_cond_broadcast(&g_pmCond);   /* reopen the gate */
     pthread_mutex_unlock(&g_pmMutex);
     tpuCounterAdd("uvm_resumes", 1);
+    uvmToolsEmit(NULL, UVM_EVENT_PM_RESUME, UVM_TIER_COUNT,
+                 UVM_TIER_COUNT, 0, 0, 0);
     tpuLog(TPU_LOG_INFO, "uvm_pm", "resumed");
     return TPU_OK;
 }

@@ -8,8 +8,11 @@
  * reference's mmap).  Overflow drops the oldest event and counts drops,
  * like the reference's queue wrap accounting.  Event types cover the
  * migration engine's lifecycle (fault/migration/eviction/thrashing/
- * prefetch/read-dup); the reference's 60+ types include channel and perf
- * internals that map onto tpurm counters instead (tpurmCounterGet).
+ * prefetch/read-dup), fault-loop internals (replay, buffer flush,
+ * remote maps), the device MMU (PTE updates, TLB invalidates), channel
+ * RC + watchdog, PM suspend/resume, external mappings, and the
+ * HMM/ATS pageable paths; remaining reference types map onto tpurm
+ * counters (tpurmCounterGet).
  */
 #include "uvm_internal.h"
 
@@ -174,10 +177,18 @@ void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
                   uint32_t dstTier, uint32_t devInst, uint64_t address,
                   uint64_t bytes)
 {
+    /* No-session fast path: emit sites on hot paths (PTE batches under
+     * blk->lock) must not serialize on the tools mutex when nobody is
+     * listening.  A racy NULL read only delays the first events of a
+     * session being created concurrently — benign for telemetry. */
+    if (__atomic_load_n(&g_tools.head, __ATOMIC_ACQUIRE) == NULL)
+        return;
     pthread_mutex_lock(&g_tools.lock);
     tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
     for (UvmToolsSession *s = g_tools.head; s; s = s->next) {
-        if (s->vs && s->vs != vs)
+        /* vs == NULL marks a GLOBAL event (RC, PM, MMU, links):
+         * delivered to every session regardless of its space filter. */
+        if (s->vs && vs && s->vs != vs)
             continue;
         if (!(s->typeMask & (1ull << type)))
             continue;

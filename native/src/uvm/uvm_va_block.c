@@ -733,7 +733,13 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
      * earlier device-read duplication; without this fix-up the store
      * would re-fault forever because nneeded==0 skips the commit path). */
     if (forWrite) {
+        bool hadDup = false;
         for (uint32_t p = firstPage; p < firstPage + count; p++) {
+            for (int t = 0; t < UVM_TIER_COUNT; t++) {
+                if (t != (int)dst.tier &&
+                    uvmPageMaskTest(&blk->resident[t], p))
+                    hadDup = true;
+            }
             for (int t = 0; t < UVM_TIER_COUNT; t++) {
                 if (t != (int)dst.tier)
                     uvmPageMaskClear(&blk->resident[t], p);
@@ -741,6 +747,13 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             /* Exclusive write revokes remote (accessed-by) mappings. */
             uvmPageMaskClear(&blk->devMapped, p);
         }
+        if (hadDup)
+            /* Duplicates dropped by the exclusive write (reference:
+             * UvmEventTypeReadDuplicateInvalidate). */
+            uvmToolsEmit(range->vaSpace, UVM_EVENT_READ_DUP_INVALIDATE,
+                         UVM_TIER_COUNT, dst.tier, dst.devInst,
+                         blk->start + (uint64_t)firstPage * uvmPageSize(),
+                         (uint64_t)count * uvmPageSize());
         if (!pteRevoked)        /* commit loop may already have */
             uvmBlockPteRevoke(blk, firstPage, count);
         if (dst.tier != UVM_TIER_HOST) {

@@ -890,6 +890,8 @@ TpuStatus uvmMapExternal(UvmVaSpace *vs, void *base, uint64_t length,
     range->extMappings = m;
     vs_unlock(vs);
     tpuCounterAdd("uvm_external_maps", 1);
+    uvmToolsEmit(vs, UVM_EVENT_EXTERNAL_MAP, UVM_TIER_HBM, UVM_TIER_COUNT,
+                 devInst, (uintptr_t)base, length);
     return TPU_OK;
 }
 
@@ -907,10 +909,13 @@ TpuStatus uvmUnmapExternal(UvmVaSpace *vs, void *base, uint64_t length)
     while (*pp) {
         UvmExtMapping *m = *pp;
         if (m->start == (uintptr_t)base && m->len == length) {
+            uint32_t mdev = m->devInst;
             *pp = m->next;
             ext_unmap_span(range, m);
             free(m);
             vs_unlock(vs);
+            uvmToolsEmit(vs, UVM_EVENT_EXTERNAL_UNMAP, UVM_TIER_HBM,
+                         UVM_TIER_COUNT, mdev, (uintptr_t)base, length);
             return TPU_OK;
         }
         pp = &m->next;

@@ -38,6 +38,20 @@ class EventType(enum.IntEnum):
     READ_DUP = 6
     ACCESS_COUNTER = 7
     FATAL_FAULT = 8
+    GPU_FAULT_REPLAY = 9
+    FAULT_BUFFER_FLUSH = 10
+    MAP_REMOTE = 11
+    READ_DUP_INVALIDATE = 12
+    PTE_UPDATE = 13
+    TLB_INVALIDATE = 14
+    CHANNEL_RC = 15
+    WATCHDOG = 16
+    PM_SUSPEND = 17
+    PM_RESUME = 18
+    EXTERNAL_MAP = 19
+    EXTERNAL_UNMAP = 20
+    HMM_ADOPT = 21
+    ATS_ACCESS = 22
 
 
 class _Location(ctypes.Structure):

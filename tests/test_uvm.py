@@ -447,3 +447,34 @@ def test_hmm_pageable_adopt_and_ats(vs):
     assert lib.uvmMemFree(vs._handle, base) == 0
     assert int(view[7]) == 0x42 and int(view[123]) == 0x5E
     view[8] = 1                                    # still writable
+
+
+def test_widened_event_vocabulary(vs):
+    """Round-3 tools expansion: lifecycle/infra events (replay, PTE/TLB,
+    PM, ATS) flow into sessions — global events reach every session."""
+    import numpy as _np
+
+    from open_gpu_kernel_modules_tpu.uvm.managed import EventType
+
+    with vs.tools_session(capacity=4096) as sess:
+        sess.enable(list(EventType))
+        buf = vs.alloc(4 * MB)
+        buf.view()[:] = 1
+        buf.device_access(dev=0, write=True)     # replay + PTE updates
+        buf.migrate(Tier.HOST)                   # TLB invalidate
+        arr = _np.full(64 * 1024, 3, _np.uint8)  # ATS access
+        from open_gpu_kernel_modules_tpu.runtime import native
+        lib = native.load()
+        assert lib.uvmDeviceAccess(vs._handle, 0, arr.ctypes.data,
+                                   arr.nbytes, 0) == 0
+        uvm.suspend()                            # PM events (global)
+        uvm.resume()
+
+        types = {e.type for e in sess.read(4096)}
+        assert EventType.GPU_FAULT_REPLAY in types
+        assert EventType.PTE_UPDATE in types
+        assert EventType.TLB_INVALIDATE in types
+        assert EventType.ATS_ACCESS in types
+        assert EventType.PM_SUSPEND in types
+        assert EventType.PM_RESUME in types
+        buf.free()
