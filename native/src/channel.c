@@ -482,7 +482,7 @@ void tpurmChannelResetError(TpurmChannel *ch)
 
 TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
                      TpuMemDesc *src, uint64_t srcOff, uint64_t size,
-                     bool async, uint64_t *outTrackerValue)
+                     bool async, TpuTracker *outTracker)
 {
     if (!dev || !dst || !src || size == 0)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -490,21 +490,29 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
         return TPU_ERR_INVALID_LIMIT;
     if (dev->lost)
         return TPU_ERR_GPU_IS_LOST;
+    if (dev->cePoolSize == 0)
+        return TPU_ERR_INVALID_STATE;
 
-    TpurmChannel *ch = dev->ce;
     uint64_t clamp = tpuRegistryGet("ce_copy_clamp_bytes", TPU_CE_COPY_CLAMP);
     uint64_t remaining = size;
-    uint64_t lastValue = 0;
+    TpuTracker local;
+    tpuTrackerInit(&local);
 
     /* Contiguity-split loop (reference: ce_utils.c:646-661): each segment
      * covers the largest run contiguous in BOTH surfaces, clamped.
-     * Segments batch into push objects (up to 64 per push) so one tracker
-     * value completes a whole request chunk. */
+     * Segments batch into push objects (up to 64 per push), and pushes
+     * STRIPE round-robin across the device's CE pool (reference: channel
+     * pools per CE type; large transfers ride several engines), all
+     * recorded in one tracker. */
     enum { SEGS_PER_PUSH = 64 };
+    uint32_t ceIdx = 0;
+    TpurmChannel *ch = dev->cePool[0];
     TpuPush push;
     TpuStatus st = tpuPushBegin(ch, SEGS_PER_PUSH, &push);
-    if (st != TPU_OK)
+    if (st != TPU_OK) {
+        tpuTrackerDeinit(&local);
         return st;
+    }
     while (remaining > 0) {
         void *dptr, *sptr;
         uint64_t drun, srun;
@@ -522,18 +530,19 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
         if (len > clamp)
             len = clamp;
         if (push.nsegs == SEGS_PER_PUSH) {
-            uint64_t v = tpuPushEnd(&push, NULL);
-            if (v == 0) {
+            if (tpuPushEnd(&push, &local) == 0) {
                 st = TPU_ERR_INVALID_STATE;
-                if (lastValue)
-                    tpurmChannelWait(ch, lastValue);
+                tpuTrackerWait(&local);
+                tpuTrackerDeinit(&local);
                 return st;
             }
-            lastValue = v;
+            ceIdx = (ceIdx + 1) % dev->cePoolSize;
+            ch = dev->cePool[ceIdx];
             st = tpuPushBegin(ch, SEGS_PER_PUSH, &push);
             if (st != TPU_OK) {
                 /* Drain submitted work before unwinding (drain rule). */
-                tpurmChannelWait(ch, lastValue);
+                tpuTrackerWait(&local);
+                tpuTrackerDeinit(&local);
                 return st;
             }
         }
@@ -545,30 +554,35 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
         remaining -= len;
     }
     if (push.nsegs > 0) {
-        uint64_t v = tpuPushEnd(&push, NULL);
-        if (v == 0) {
-            if (lastValue)
-                tpurmChannelWait(ch, lastValue);
+        if (tpuPushEnd(&push, &local) == 0) {
+            tpuTrackerWait(&local);
+            tpuTrackerDeinit(&local);
             return TPU_ERR_INVALID_STATE;
         }
-        lastValue = v;
     } else {
         tpuPushAbort(&push);
     }
 
-    if (outTrackerValue)
-        *outTrackerValue = lastValue;
-    if (async)
-        return TPU_OK;
-    return lastValue ? tpurmChannelWait(ch, lastValue) : TPU_OK;
+    if (async && outTracker) {
+        /* Hand the dependencies to the caller (unregister quiesce etc.);
+         * an OOM merging them degrades to synchronous completion so no
+         * dependency is silently lost. */
+        if (tpuTrackerAddTracker(outTracker, &local) != TPU_OK)
+            st = tpuTrackerWait(&local);
+        tpuTrackerDeinit(&local);
+        return st;
+    }
+    st = tpuTrackerWait(&local);
+    tpuTrackerDeinit(&local);
+    return st;
 
 fail:
     tpuPushAbort(&push);
     /* Drain pushes already submitted: the caller may free/unpin the
      * surfaces on error while workers are still writing them (same rule
      * as block_copy_in's drain-before-unwind). */
-    if (lastValue)
-        tpurmChannelWait(ch, lastValue);
+    tpuTrackerWait(&local);
+    tpuTrackerDeinit(&local);
     return st;
 }
 

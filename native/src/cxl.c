@@ -313,7 +313,8 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
      * per-push clamp lives in tpuMemCopy's contiguity-split loop, so the
      * full size is handed down — never truncated. */
     uint64_t hbmSize = tpurmDeviceHbmSize(dev);
-    uint64_t tracker = 0;
+    TpuTracker dmaTracker;
+    tpuTrackerInit(&dmaTracker);
     TpuMemDesc *devMd = NULL;
     /* Overflow-safe bounds check (a wrapped gpuOffset must not pass). */
     if (size > hbmSize || gpuOffset > hbmSize - size) {
@@ -327,26 +328,34 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
     if (st == TPU_OK) {
         if (cxlToDev)
             st = tpuMemCopy(dev, devMd, 0, cxlMd, cxlOffset, size,
-                            async, &tracker);
+                            async, &dmaTracker);
         else
             st = tpuMemCopy(dev, cxlMd, cxlOffset, devMd, 0, size,
-                            async, &tracker);
+                            async, &dmaTracker);
         tpuMemdescDestroy(devMd);
     }
 
-    /* Drop the DMA reference; async submissions record into the buffer's
-     * tracker so unregister can quiesce all channels before teardown. */
+    /* Record async dependencies into the buffer's tracker (pushes may
+     * span the whole CE pool) so unregister can quiesce every involved
+     * channel, THEN drop the DMA reference: the activeDma>0 guard must
+     * keep covering any copy whose dependency could not be merged — a
+     * fallback wait after the decrement would race unregister's
+     * teardown. */
     pthread_mutex_lock(&g_cxl.lock);
-    buf->activeDma--;
-    if (st == TPU_OK && async && tracker &&
-        tpuTrackerAdd(&buf->pending, dev->ce, tracker) != TPU_OK) {
-        /* Dep could not be recorded: complete it now rather than let
-         * unregister's quiesce miss an in-flight copy. */
+    bool merged = true;
+    if (st == TPU_OK && async)
+        merged = tpuTrackerAddTracker(&buf->pending, &dmaTracker) == TPU_OK;
+    if (!merged) {
+        /* Deps could not be recorded: complete them now (still holding
+         * the DMA reference) rather than let unregister's quiesce miss
+         * an in-flight copy. */
         pthread_mutex_unlock(&g_cxl.lock);
-        tpurmChannelWait(dev->ce, tracker);
+        tpuTrackerWait(&dmaTracker);
         pthread_mutex_lock(&g_cxl.lock);
     }
+    buf->activeDma--;
     pthread_mutex_unlock(&g_cxl.lock);
+    tpuTrackerDeinit(&dmaTracker);
 
     if (st != TPU_OK) {
         tpuLog(TPU_LOG_ERROR, "cxl", "DMA %s failed: %s",
@@ -356,6 +365,7 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
     tpuCounterAdd("cxl_dma_requests", 1);
     tpuCounterAdd("cxl_dma_bytes", size);
     if (outTransferId)
-        *outTransferId = async ? (uint32_t)(tracker & 0x7fffffff) | 1u : 1;
+        *outTransferId = 1;     /* opaque non-zero id (completion rides
+                                 * the buffer's pending tracker) */
     return TPU_OK;
 }

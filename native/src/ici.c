@@ -17,6 +17,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/ici.h"
+#include "tpurm/uvm.h"
 
 #include <stdlib.h>
 #include <string.h>
@@ -379,18 +380,147 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
     char *pp = (char *)tpurmDeviceHbmBase(peer) + peerOff;
     void *dst = direction == 0 ? pp : lp;
     const void *src = direction == 0 ? lp : pp;
-    uint64_t v = tpurmChannelPushCopy(local->ce, dst, src, size);
-    if (v == 0)
+    uint32_t from = direction == 0 ? ap->srcInst : ap->peerInst;
+    uint32_t to = direction == 0 ? ap->peerInst : ap->srcInst;
+
+    /* PERFORMANCE MODEL: multi-hop routes STORE-AND-FORWARD through a
+     * staging chunk on each intermediate device (allocated from its
+     * UVM tier PMM, like any other HBM tenant) — every hop is a real
+     * channel copy on the hop's source device, so a 3-hop transfer
+     * costs 3x the link work and rides 3 devices' CEs, exactly the
+     * bandwidth shape real torus detours have.  Payloads stream in
+     * chunk-sized segments. */
+    uint32_t hops = 0;
+    if (tpuIciRouteHops(from, to, &hops) != TPU_OK)
         return TPU_ERR_INVALID_STATE;
-    tpuCounterAdd("ici_peer_copy_bytes", size);
-    if (tracker) {
-        if (tpuTrackerAdd(tracker, local->ce, v) == TPU_OK)
-            return TPU_OK;
-        /* Dep could not be recorded: complete it now instead of leaving
-         * an untracked in-flight copy behind an error return. */
+    if (hops <= 1) {
+        uint64_t v = tpurmChannelPushCopy(local->ce, dst, src, size);
+        if (v == 0)
+            return TPU_ERR_INVALID_STATE;
+        tpuCounterAdd("ici_peer_copy_bytes", size);
+        if (tracker) {
+            if (tpuTrackerAdd(tracker, local->ce, v) == TPU_OK)
+                return TPU_OK;
+            /* Dep could not be recorded: complete it now instead of
+             * leaving an untracked in-flight copy behind an error. */
+            return tpurmChannelWait(local->ce, v);
+        }
         return tpurmChannelWait(local->ce, v);
     }
-    return tpurmChannelWait(local->ce, v);
+
+    /* Build the hop chain from..to. */
+    enum { MAX_HOPS = 32 };
+    uint32_t chain[MAX_HOPS + 1];
+    uint32_t n = 0;
+    chain[n++] = from;
+    uint32_t cur = from;
+    while (cur != to && n <= MAX_HOPS) {
+        uint32_t next;
+        if (tpuIciRouteNextHop(cur, to, &next) != TPU_OK)
+            return TPU_ERR_INVALID_STATE;
+        chain[n++] = next;
+        cur = next;
+    }
+    if (cur != to)
+        return TPU_ERR_INVALID_STATE;
+
+    /* Every device ALONG the route must be healthy: routing through a
+     * lost chip is as fatal as a lost endpoint. */
+    TpurmDevice *chainDev[MAX_HOPS + 1];
+    for (uint32_t i = 0; i < n; i++) {
+        chainDev[i] = tpurmDeviceGet(chain[i]);
+        if (!chainDev[i])
+            return TPU_ERR_INVALID_DEVICE;
+        if (chainDev[i]->lost)
+            return TPU_ERR_GPU_IS_LOST;
+    }
+
+    /* Staging chunk on each INTERMEDIATE device (clamped to the PMM's
+     * 2 MB chunk ceiling the way uvm_page_size clamps). */
+    uint64_t seg = tpuRegistryGet("ici_staging_bytes", 1ull << 20);
+    if (seg > 2ull * 1024 * 1024)
+        seg = 2ull * 1024 * 1024;
+    if (seg < 4096)
+        seg = 4096;
+    if (seg > size)
+        seg = size;
+    uint64_t stageOff[MAX_HOPS];
+    void *stageHandle[MAX_HOPS];
+    uint32_t nStage = 0;
+    st = TPU_OK;
+    for (uint32_t i = 1; i + 1 < n && st == TPU_OK; i++) {
+        st = uvmHbmChunkAlloc(chain[i], seg, &stageOff[nStage],
+                              &stageHandle[nStage]);
+        if (st == TPU_OK)
+            nStage++;
+    }
+    if (st != TPU_OK)
+        goto out_free;
+
+    /* Stream segments through the chain as a SOFTWARE PIPELINE: each
+     * hop is an async push on the hop-source device's CE, waiting only
+     * its two real dependencies — the same segment's previous hop (the
+     * data it forwards) and the PREVIOUS segment's next hop (the
+     * staging slot it overwrites).  Hop 0 of segment s+1 therefore
+     * overlaps the later hops of segment s, which is exactly how
+     * wormhole-ish torus traffic keeps every link busy. */
+    {
+        uint64_t prevVal[MAX_HOPS + 1];
+        uint64_t curVal[MAX_HOPS + 1];
+        memset(prevVal, 0, sizeof(prevVal));
+        uint32_t lastHop = n - 2;
+        for (uint64_t off = 0; off < size && st == TPU_OK; off += seg) {
+            uint64_t len = size - off < seg ? size - off : seg;
+            const char *hopSrc = (const char *)src + off;
+            for (uint32_t h = 0; h + 1 < n && st == TPU_OK; h++) {
+                /* Data dependency: previous hop of THIS segment. */
+                if (h > 0) {
+                    st = tpurmChannelWait(chainDev[h - 1]->ce,
+                                          curVal[h - 1]);
+                    if (st != TPU_OK)
+                        break;
+                }
+                /* Staging reuse: the PREVIOUS segment must have been
+                 * read out of the slot this push overwrites. */
+                if (h < lastHop && prevVal[h + 1]) {
+                    st = tpurmChannelWait(chainDev[h + 1]->ce,
+                                          prevVal[h + 1]);
+                    if (st != TPU_OK)
+                        break;
+                }
+                void *hopDst = (h == lastHop)
+                                   ? (char *)dst + off
+                                   : (char *)tpurmDeviceHbmBase(
+                                         chainDev[h + 1]) + stageOff[h];
+                curVal[h] = tpurmChannelPushCopy(chainDev[h]->ce, hopDst,
+                                                 hopSrc, len);
+                if (curVal[h] == 0) {
+                    st = TPU_ERR_INVALID_STATE;
+                    break;
+                }
+                tpuCounterAdd("ici_hop_bytes", len);
+                hopSrc = hopDst;
+            }
+            memcpy(prevVal, curVal, sizeof(prevVal));
+        }
+        /* Drain the tail (staging frees below must not race copies). */
+        for (uint32_t h = 0; h + 1 < n; h++) {
+            TpuStatus ws = tpurmChannelWait(chainDev[h]->ce, prevVal[h]);
+            if (ws != TPU_OK && st == TPU_OK)
+                st = ws;
+        }
+    }
+    if (st == TPU_OK) {
+        tpuCounterAdd("ici_peer_copy_bytes", size);
+        tpuCounterAdd("ici_multihop_copies", 1);
+    }
+
+out_free:
+    for (uint32_t i = 0; i < nStage; i++)
+        uvmHbmChunkFree(chain[i + 1], stageHandle[i]);
+    (void)tracker;   /* staged path drains before returning: staging
+                      * chunks cannot outlive their in-flight reads */
+    return st;
 }
 
 TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,

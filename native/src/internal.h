@@ -163,13 +163,15 @@ void  uvmMmapRegistryOnRangeDestroy(uint64_t base);
 
 /* -------------------------------------------------------------- transfer  */
 
-/* memmgrMemCopy analog: copy between two memdescs through the device's CE
- * channel, splitting per contiguous extent and clamping each submission
+/* memmgrMemCopy analog: copy between two memdescs through the device's
+ * CE POOL (pushes stripe round-robin across the pool's channels),
+ * splitting per contiguous extent and clamping each submission
  * (reference: mem_utils.c:567, ce_utils.c:571,646-661; clamp
- * p2p_cxl.c:617-621). */
+ * p2p_cxl.c:617-621).  async records every push's dependency into
+ * outTracker; sync waits them all. */
 TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
                      TpuMemDesc *src, uint64_t srcOff, uint64_t size,
-                     bool async, uint64_t *outTrackerValue);
+                     bool async, TpuTracker *outTracker);
 
 /* ------------------------------------------------- robust channel RC */
 

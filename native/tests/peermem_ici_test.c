@@ -210,6 +210,25 @@ static void test_ici(void)
         tpuIciPeerApertureDestroy(ap2);
     }
 
+    /* Store-and-forward performance model: a 2-hop copy stages through
+     * the intermediate device and costs 2x the hop work (per-hop bytes
+     * counter), with the payload intact end to end. */
+    {
+        TpuIciPeerAperture *ap2 = NULL;
+        EXPECT(tpuIciPeerApertureCreate(0, 2, &ap2) == TPU_OK);
+        TpurmDevice *d2 = tpurmDeviceGet(2);
+        uint64_t hopBefore = tpurmCounterGet("ici_hop_bytes");
+        uint64_t mhBefore = tpurmCounterGet("ici_multihop_copies");
+        memset((char *)tpurmDeviceHbmBase(d0) + 40960, 0x9D, 4096);
+        memset((char *)tpurmDeviceHbmBase(d2) + 40960, 0, 4096);
+        EXPECT(tpuIciPeerCopy(ap2, 40960, 40960, 4096, 0) == TPU_OK);
+        EXPECT(((unsigned char *)tpurmDeviceHbmBase(d2))[40960 + 7] ==
+               0x9D);
+        EXPECT(tpurmCounterGet("ici_hop_bytes") - hopBefore >= 2 * 4096);
+        EXPECT(tpurmCounterGet("ici_multihop_copies") > mhBefore);
+        tpuIciPeerApertureDestroy(ap2);
+    }
+
     tpuIciPeerApertureDestroy(ap);
     printf("  ici flows ok (%u devices)\n", ndev);
 }

@@ -250,6 +250,13 @@ class ManagedKVBacking:
     must not steal pages the CPU upload path re-reads).  ``read_pages``
     drives the fault engine over each page's span (hotness, prefetch,
     thrashing, tier residency) before handing the bytes up.
+
+    Backing layout is PAGE-MAJOR ([N, L, page...] vs the device pool's
+    layer-major [L, N, page...]): one logical page is ONE contiguous
+    span covering all its layers, so activation faults it with a single
+    device_access and reads it as one slice — 2 operations per page
+    instead of 2 * num_layers (and the UVM engine sees large contiguous
+    spans its prefetcher can grow over).
     """
 
     def __init__(self, pool_shape: Tuple[int, ...], np_dtype: np.dtype,
@@ -257,11 +264,15 @@ class ManagedKVBacking:
         from .. import uvm
         from ..uvm.managed import Tier
 
-        self.pool_shape = pool_shape
+        self.pool_shape = pool_shape            # device layout [L, N, ...]
         self.np_dtype = np_dtype
         self.page_bytes = page_bytes
         self.total_pages = pool_shape[1]
         self.num_layers = pool_shape[0]
+        # Page-major storage shape.
+        self.store_shape = (self.total_pages, self.num_layers) + \
+            pool_shape[2:]
+        self.rec_bytes = self.num_layers * page_bytes
         self.dev = dev
         pool_bytes = int(np.prod(pool_shape)) * np_dtype.itemsize
         self.vs = uvm.VaSpace(register_devices=(dev,))
@@ -273,31 +284,42 @@ class ManagedKVBacking:
             buf.set_read_duplication(True)
             buf.migrate(Tier.CXL)
 
+    def _store_k(self) -> np.ndarray:
+        return self.k_buf.view(self.np_dtype, self.store_shape)
+
+    def _store_v(self) -> np.ndarray:
+        return self.v_buf.view(self.np_dtype, self.store_shape)
+
     def k_view(self) -> np.ndarray:
-        return self.k_buf.view(self.np_dtype, self.pool_shape)
+        """Pool view in DEVICE layout [L, N, ...] (test/introspection:
+        a transposed view over the page-major store; reads fault)."""
+        return self._store_k().transpose(1, 0, *range(2, len(
+            self.store_shape)))
 
     def v_view(self) -> np.ndarray:
-        return self.v_buf.view(self.np_dtype, self.pool_shape)
+        return self._store_v().transpose(1, 0, *range(2, len(
+            self.store_shape)))
 
     def read_pages(self, pages: List[int]
                    ) -> Tuple[np.ndarray, np.ndarray]:
         """Fault + fetch pages; returns (k, v) chunks [L, n, P, KV, D]."""
-        layer_stride = self.total_pages * self.page_bytes
         for page in pages:
-            off = page * self.page_bytes
-            for layer in range(self.num_layers):
-                span = layer * layer_stride + off
-                self.k_buf.device_access(dev=self.dev, offset=span,
-                                         length=self.page_bytes)
-                self.v_buf.device_access(dev=self.dev, offset=span,
-                                         length=self.page_bytes)
+            off = page * self.rec_bytes
+            self.k_buf.device_access(dev=self.dev, offset=off,
+                                     length=self.rec_bytes)
+            self.v_buf.device_access(dev=self.dev, offset=off,
+                                     length=self.rec_bytes)
         idx = np.array(pages, np.int64)
-        return self.k_view()[:, idx], self.v_view()[:, idx]
+        k = self._store_k()[idx]                # [n, L, page...]
+        v = self._store_v()[idx]
+        perm = (1, 0) + tuple(range(2, len(self.store_shape)))
+        return np.ascontiguousarray(k.transpose(perm)), \
+            np.ascontiguousarray(v.transpose(perm))
 
     def write_page(self, page: int, k_rec: np.ndarray,
                    v_rec: np.ndarray) -> None:
-        self.k_view()[:, page] = k_rec
-        self.v_view()[:, page] = v_rec
+        self._store_k()[page] = k_rec           # [L, page...] chunk
+        self._store_v()[page] = v_rec
 
     def close(self) -> None:
         self.vs.close()
