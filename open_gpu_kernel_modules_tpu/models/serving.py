@@ -527,13 +527,25 @@ class TieredKVCache:
             seq_lens=jnp.asarray(self.seq_lens[np.array(seq_ids)]))
 
     def sync_from(self, view: PagedKVCache, seq_ids: Sequence[int],
-                  last_tokens: Optional[np.ndarray] = None) -> None:
-        """Adopt the decode view's pool + lengths; unpin the group."""
+                  last_tokens: Optional[np.ndarray] = None,
+                  decoded: int = 0) -> None:
+        """Adopt the decode view's pool + lengths; unpin the group.
+
+        Length bookkeeping is HOST-side arithmetic (`decoded` tokens
+        were appended per sequence) — fetching view.seq_lens back from
+        the device would cost a transport round trip per turn, which on
+        a relay-attached chip dominates the whole decode step."""
         self.k_slots = view.k_pages
         self.v_slots = view.v_pages
-        self.seq_lens[np.array(seq_ids)] = np.asarray(view.seq_lens)
+        idx = np.array(seq_ids)
+        if decoded:
+            self.seq_lens[idx] = np.minimum(
+                self.seq_lens[idx] + decoded,
+                self.pages_per_seq * self.page_size)
+        else:
+            self.seq_lens[idx] = np.asarray(view.seq_lens)
         if last_tokens is not None:
-            self.last_token[np.array(seq_ids)] = np.asarray(last_tokens)
+            self.last_token[idx] = np.asarray(last_tokens)
         self._active_slots.clear()
 
     def close(self) -> None:
@@ -556,17 +568,39 @@ def decode_rounds(cfg: llama.LlamaConfig, params: Dict[str, Any],
     decodes ``tokens_per_turn`` for it — the config #4 serving shape
     (many resident sequences, an active working set cycling through the
     device pool).  Returns (decoded tokens, seconds)."""
+    # Device-resident token caching assumes DISJOINT groups (a sequence
+    # in two groups would fork divergent token streams).
+    seen: set = set()
+    for g in groups:
+        for b in g:
+            if b in seen:
+                raise ValueError(f"groups must be disjoint (seq {b})")
+            seen.add(b)
+
     total = 0
     t0 = time.perf_counter()
-    tok = None
-    for _ in range(turns):
-        for g in groups:
-            view = cache.activate(g, new_tokens=tokens_per_turn)
-            tok = jnp.asarray(cache.last_token[np.array(g)])
-            tok, view, _ = decode_scan(cfg, params, tok, view,
-                                       tokens_per_turn)
-            cache.sync_from(view, g, np.asarray(tok, np.int32))
-            total += len(g) * tokens_per_turn
-    if tok is not None:
-        jax.block_until_ready(tok)
+    # Last-token state stays ON DEVICE per group between its turns:
+    # fetching tokens back each turn costs a transport round trip that
+    # the next activation does not actually need (lengths advance by
+    # host arithmetic; only the caller's final read materializes).
+    dev_tok: Dict[Tuple[int, ...], jax.Array] = {}
+    try:
+        for _ in range(turns):
+            for g in groups:
+                key = tuple(g)
+                view = cache.activate(g, new_tokens=tokens_per_turn)
+                tok = dev_tok.get(key)
+                if tok is None:
+                    tok = jnp.asarray(cache.last_token[np.array(g)])
+                tok, view, _ = decode_scan(cfg, params, tok, view,
+                                           tokens_per_turn)
+                dev_tok[key] = tok
+                cache.sync_from(view, g, decoded=tokens_per_turn)
+                total += len(g) * tokens_per_turn
+    finally:
+        # Materialize final tokens once — ALSO on error paths, so the
+        # cache's last_token stays consistent with the seq_lens that
+        # already advanced for completed turns.
+        for g, tok in dev_tok.items():
+            cache.last_token[np.array(g)] = np.asarray(tok, np.int32)
     return total, time.perf_counter() - t0
