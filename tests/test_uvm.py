@@ -130,7 +130,10 @@ def test_fault_stats_progress(vs):
     assert after.batches > before.batches
     # µs-scale p50 is the metric of record (BASELINE.md): enforce a
     # generous ceiling so regressions to ms-scale fail loudly.
-    assert 0 < after.service_ns_p50 < 1_000_000
+    # The latency window is process-global; suites that cycle the PM
+    # gate legitimately park faults for ms, so only sanity-bound here
+    # (the fresh-process latency test asserts the tight us-scale bound).
+    assert 0 < after.service_ns_p50 < 50_000_000
     buf.free()
 
 
@@ -357,44 +360,58 @@ def test_policy_split_two_halves(vs):
             raise native.RmError(st, "uvmMemFree")
 
 
-def test_fault_latency_bounds_and_parallel_service(vs):
+def test_fault_latency_bounds_and_parallel_service():
     """Parallel fault service (per-worker rings, per-block locking):
     concurrent faults on different blocks service correctly from
-    multiple threads, and latency percentiles stay in the us range
-    (generous bounds — CI machines are noisy; the bench records exact
-    round-over-round numbers)."""
-    import threading
+    multiple threads, and latency percentiles stay in the us range.
+    Runs in a SUBPROCESS: the latency window is process-global and other
+    tests (PM-cycle soak) legitimately park faults for milliseconds."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
 
-    bufs = [vs.alloc(4 * MB) for _ in range(4)]
-    for i, b in enumerate(bufs):
-        b.view()[:] = i + 1
-
-    errs = []
-
-    def hammer(b, val):
-        try:
-            for _ in range(3):
-                b.device_access(dev=0, write=False)
-                v = b.view()
-                assert int(v[0]) == val and int(v[4 * MB - 1]) == val
-                b.migrate(Tier.HOST)
-        except Exception as e:            # pragma: no cover
-            errs.append(e)
-
-    threads = [threading.Thread(target=hammer, args=(b, i + 1))
-               for i, b in enumerate(bufs)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=60)
-    assert not errs and not any(t.is_alive() for t in threads)
-
-    stats = uvm.fault_stats()
-    assert stats.service_ns_p50 < 100_000       # p50 well under 100 us
-    assert stats.service_ns_p95 < 5_000_000     # p95 under 5 ms
-
-    for b in bufs:
-        b.free()
+    script = textwrap.dedent("""
+        import sys, threading
+        sys.path.insert(0, %r)
+        from open_gpu_kernel_modules_tpu import uvm
+        from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+        MB = 1 << 20
+        vs = uvm.VaSpace()
+        bufs = [vs.alloc(4 * MB) for _ in range(4)]
+        for i, b in enumerate(bufs):
+            b.view()[:] = i + 1
+        errs = []
+        def hammer(b, val):
+            try:
+                for _ in range(3):
+                    b.device_access(dev=0, write=False)
+                    v = b.view()
+                    assert int(v[0]) == val and int(v[4 * MB - 1]) == val
+                    b.migrate(Tier.HOST)
+            except Exception as e:
+                errs.append(e)
+        threads = [threading.Thread(target=hammer, args=(b, i + 1))
+                   for i, b in enumerate(bufs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs and not any(t.is_alive() for t in threads)
+        stats = uvm.fault_stats()
+        assert stats.service_ns_p50 < 100_000, stats
+        assert stats.service_ns_p95 < 5_000_000, stats
+        for b in bufs:
+            b.free()
+        vs.close()
+        print("latency ok", stats.service_ns_p50, stats.service_ns_p95)
+    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.setdefault("TPUMEM_UVM_FAULT_SERVICE_THREADS", "4")
+    res = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "latency ok" in res.stdout
 
 
 def test_hmm_pageable_adopt_and_ats(vs):
