@@ -452,6 +452,35 @@ typedef struct {
 } UvmEvent;
 
 typedef struct UvmToolsSession UvmToolsSession;
+
+/* Layout of a tools queue mapping (reference: user-mmap'd lock-free
+ * event queues, uvm_tools.c:54-70): page 0 is this header, events
+ * follow at offset 4096.  The producer owns widx (release-published
+ * after the event is written); the consumer owns ridx; when the ring
+ * is full NEW events are dropped and counted (reference queue-full
+ * accounting) so an external consumer's ridx is never stolen. */
+#ifdef __cplusplus
+/* C++ has no _Atomic; the fields are plain integers of identical layout
+ * (consumers load/store them with std::atomic_ref or equivalent). */
+#define UVM_TOOLS_ATOMIC_U64 uint64_t
+#else
+#define UVM_TOOLS_ATOMIC_U64 _Atomic uint64_t
+#endif
+typedef struct {
+    UVM_TOOLS_ATOMIC_U64 widx;    /* producer-owned, monotonic */
+    UVM_TOOLS_ATOMIC_U64 ridx;    /* consumer-owned, monotonic */
+    UVM_TOOLS_ATOMIC_U64 dropped; /* events dropped while full  */
+    uint32_t capacity;            /* ring entries (power of two) */
+    uint32_t eventSize;           /* sizeof(UvmEvent) sanity     */
+} UvmToolsQueueHeader;
+
+#define UVM_TOOLS_QUEUE_RING_OFFSET 4096
+
+/* The memfd backing a session's queue: map it (header + ring) for
+ * zero-copy event consumption, exactly the reference's mmap contract.
+ * ONE consumer per session: ridx has a single owner — mix the mapped
+ * consumer with uvmToolsReadEvents and they rewind each other. */
+int uvmToolsSessionQueueFd(UvmToolsSession *s);
 TpuStatus uvmToolsSessionCreate(UvmVaSpace *vs, uint32_t capacity,
                                 UvmToolsSession **out);
 void      uvmToolsSessionDestroy(UvmToolsSession *s);

@@ -14,10 +14,14 @@
  * HMM/ATS pageable paths; remaining reference types map onto tpurm
  * counters (tpurmCounterGet).
  */
+#define _GNU_SOURCE
 #include "uvm_internal.h"
 
+#include <stdatomic.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
 
 struct UvmToolsSession {
     UvmVaSpace *vs;                   /* filter; NULL = all spaces */
@@ -27,10 +31,24 @@ struct UvmToolsSession {
     uint64_t notifications;           /* threshold crossings */
     bool aboveThresh;                 /* latched: depth >= threshold */
     uint32_t capacity;                /* power of two */
-    uint64_t widx, ridx;
-    UvmEvent *ring;
+    /* memfd-backed queue: header page + event ring, mappable by the
+     * consumer (the reference's user-mmap'd queue). */
+    int queueFd;
+    UvmToolsQueueHeader *hdr;
+    UvmEvent *ring;                   /* hdr + UVM_TOOLS_QUEUE_RING_OFFSET */
+    size_t mapBytes;
     struct UvmToolsSession *next;
 };
+
+static uint64_t sess_pending(const UvmToolsSession *s)
+{
+    /* ridx FIRST: widx only grows and ridx never exceeds it, so this
+     * order can momentarily under-count but can never wrap negative
+     * (loading widx first could pair a stale widx with a newer ridx). */
+    uint64_t r = atomic_load_explicit(&s->hdr->ridx, memory_order_acquire);
+    uint64_t w = atomic_load_explicit(&s->hdr->widx, memory_order_acquire);
+    return w - r;
+}
 
 static struct {
     pthread_mutex_t lock;             /* order TPU_LOCK_DIAG */
@@ -51,11 +69,22 @@ TpuStatus uvmToolsSessionCreate(UvmVaSpace *vs, uint32_t capacity,
     UvmToolsSession *s = calloc(1, sizeof(*s));
     if (!s)
         return TPU_ERR_NO_MEMORY;
-    s->ring = calloc(capacity, sizeof(UvmEvent));
-    if (!s->ring) {
+    s->mapBytes = UVM_TOOLS_QUEUE_RING_OFFSET +
+                  (size_t)capacity * sizeof(UvmEvent);
+    s->queueFd = memfd_create("tpurm-tools-queue", MFD_CLOEXEC);
+    if (s->queueFd < 0 ||
+        ftruncate(s->queueFd, (off_t)s->mapBytes) != 0 ||
+        (s->hdr = mmap(NULL, s->mapBytes, PROT_READ | PROT_WRITE,
+                       MAP_SHARED, s->queueFd, 0)) == MAP_FAILED) {
+        if (s->queueFd >= 0)
+            close(s->queueFd);
         free(s);
         return TPU_ERR_NO_MEMORY;
     }
+    memset(s->hdr, 0, sizeof(*s->hdr));
+    s->hdr->capacity = capacity;
+    s->hdr->eventSize = (uint32_t)sizeof(UvmEvent);
+    s->ring = (UvmEvent *)((char *)s->hdr + UVM_TOOLS_QUEUE_RING_OFFSET);
     s->vs = vs;
     s->capacity = capacity;
     s->typeMask = ~0ull;
@@ -83,8 +112,14 @@ void uvmToolsSessionDestroy(UvmToolsSession *s)
         *p = s->next;
     tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
     pthread_mutex_unlock(&g_tools.lock);
-    free(s->ring);
+    munmap(s->hdr, s->mapBytes);
+    close(s->queueFd);
     free(s);
+}
+
+int uvmToolsSessionQueueFd(UvmToolsSession *s)
+{
+    return s ? s->queueFd : -1;
 }
 
 void uvmToolsEnableEvents(UvmToolsSession *s, uint64_t typeMask)
@@ -131,7 +166,7 @@ bool uvmToolsCounterGet(UvmToolsSession *s, const char *name, uint64_t *out)
 static void tools_notify_update_locked(UvmToolsSession *s)
 {
     bool above = s->notifThreshold &&
-                 s->widx - s->ridx >= s->notifThreshold;
+                 sess_pending(s) >= s->notifThreshold;
     if (above && !s->aboveThresh)
         s->notifications++;
     s->aboveThresh = above;
@@ -153,12 +188,7 @@ uint64_t uvmToolsPendingEvents(UvmToolsSession *s)
 {
     if (!s)
         return 0;
-    pthread_mutex_lock(&g_tools.lock);
-    tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
-    uint64_t n = s->widx - s->ridx;
-    tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
-    pthread_mutex_unlock(&g_tools.lock);
-    return n;
+    return sess_pending(s);
 }
 
 uint64_t uvmToolsNotificationCount(UvmToolsSession *s)
@@ -192,11 +222,21 @@ void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
             continue;
         if (!(s->typeMask & (1ull << type)))
             continue;
-        if (s->widx - s->ridx >= s->capacity) {
-            s->ridx++;                /* drop oldest */
+        uint64_t w = atomic_load_explicit(&s->hdr->widx,
+                                          memory_order_relaxed);
+        if (w - atomic_load_explicit(&s->hdr->ridx,
+                                     memory_order_acquire) >=
+            s->capacity) {
+            /* Ring full: drop the NEW event (reference queue-full
+             * accounting).  ridx belongs to the consumer — possibly an
+             * external process mapping the queue — and is never stolen. */
+            atomic_fetch_add_explicit(&s->hdr->dropped, 1,
+                                      memory_order_relaxed);
             tpuCounterAdd("uvm_tools_events_dropped", 1);
+            tools_notify_update_locked(s);
+            continue;
         }
-        UvmEvent *e = &s->ring[s->widx % s->capacity];
+        UvmEvent *e = &s->ring[w % s->capacity];
         e->type = type;
         e->srcTier = srcTier;
         e->dstTier = dstTier;
@@ -204,7 +244,9 @@ void uvmToolsEmit(UvmVaSpace *vs, UvmEventType type, uint32_t srcTier,
         e->address = address;
         e->bytes = bytes;
         e->timestampNs = uvmMonotonicNs();
-        s->widx++;
+        /* Release-publish so a mapped consumer's acquire of widx sees
+         * the completed event record. */
+        atomic_store_explicit(&s->hdr->widx, w + 1, memory_order_release);
         /* Notification threshold: count the crossing (reference wakes
          * the queue's wait_queue when pending reaches the threshold). */
         tools_notify_update_locked(s);
@@ -220,10 +262,13 @@ size_t uvmToolsReadEvents(UvmToolsSession *s, UvmEvent *buf, size_t max)
     pthread_mutex_lock(&g_tools.lock);
     tpuLockTrackAcquire(TPU_LOCK_DIAG, "tools");
     size_t n = 0;
-    while (n < max && s->ridx < s->widx) {
-        buf[n++] = s->ring[s->ridx % s->capacity];
-        s->ridx++;
+    uint64_t r = atomic_load_explicit(&s->hdr->ridx, memory_order_relaxed);
+    uint64_t w = atomic_load_explicit(&s->hdr->widx, memory_order_acquire);
+    while (n < max && r < w) {
+        buf[n++] = s->ring[r % s->capacity];
+        r++;
     }
+    atomic_store_explicit(&s->hdr->ridx, r, memory_order_release);
     tools_notify_update_locked(s);    /* drain may re-arm the latch */
     tpuLockTrackRelease(TPU_LOCK_DIAG, "tools");
     pthread_mutex_unlock(&g_tools.lock);

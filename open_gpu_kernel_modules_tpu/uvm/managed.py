@@ -198,6 +198,8 @@ def _lib() -> ctypes.CDLL:
     lib.uvmToolsReadEvents.argtypes = [vp, ctypes.POINTER(_Event),
                                        ctypes.c_size_t]
     lib.uvmToolsReadEvents.restype = ctypes.c_size_t
+    lib.uvmToolsSessionQueueFd.argtypes = [vp]
+    lib.uvmToolsSessionQueueFd.restype = ctypes.c_int
     lib.uvmSuspend.argtypes = []
     lib.uvmSuspend.restype = u32
     lib.uvmResume.argtypes = []
@@ -287,7 +289,24 @@ class ToolsSession:
     def notifications(self) -> int:
         return self._lib.uvmToolsNotificationCount(self._handle)
 
+    def queue_fd(self) -> int:
+        """The memfd backing this session's event queue (reference:
+        user-mmap'd queues, uvm_tools.c:54-70).  Map it for zero-copy
+        consumption; dup before shipping cross-process."""
+        return self._lib.uvmToolsSessionQueueFd(self._handle)
+
+    def map_queue(self) -> "MappedQueue":
+        """Switch this session to the mapped consumer.  ridx has ONE
+        owner: after this, ToolsSession.read() raises — the two read
+        paths would rewind each other's progress."""
+        self._mapped = True
+        return MappedQueue(self.queue_fd())
+
     def read(self, max_events: int = 1024) -> List[Event]:
+        if getattr(self, "_mapped", False):
+            raise RuntimeError(
+                "session queue is mapped: consume via MappedQueue.read "
+                "(ridx has a single owner)")
         buf = (_Event * max_events)()
         n = self._lib.uvmToolsReadEvents(self._handle, buf, max_events)
         return [Event(EventType(e.type), _tier_or_none(e.srcTier),
@@ -401,6 +420,82 @@ class ManagedBuffer:
             _check(self._lib.uvmMemFree(self._vs._handle, self.address),
                    "uvmMemFree")
             self.address = 0
+
+
+class MappedQueue:
+    """Zero-copy consumer over a session's mmap'd event queue.
+
+    Page 0 is UvmToolsQueueHeader {widx, ridx, dropped: u64;
+    capacity, eventSize: u32}; events follow at offset 4096.  The
+    producer release-publishes widx; this consumer owns ridx.
+
+    Ordering note: slot reads after the widx load rely on total-store
+    ordering (x86-class); a consumer on a weakly-ordered CPU should use
+    the C API (uvmToolsReadEvents), whose loads carry acquire fences."""
+
+    RING_OFFSET = 4096
+
+    def __init__(self, fd: int):
+        import mmap as _mmap
+
+        self._fd = fd
+        # Header first, to size the full mapping.
+        head = _mmap.mmap(fd, 4096)
+        cap, esize = np.frombuffer(head[24:32], np.uint32)
+        head.close()
+        self.capacity = int(cap)
+        self.event_size = int(esize)
+        self._mm = _mmap.mmap(fd, self.RING_OFFSET +
+                              self.capacity * self.event_size)
+        self._hdr = np.frombuffer(self._mm, np.uint64, 3)
+        if self.event_size != ctypes.sizeof(_Event):
+            raise RuntimeError(
+                f"event ABI skew: queue eventSize={self.event_size}, "
+                f"consumer expects {ctypes.sizeof(_Event)}")
+        self._ring = np.frombuffer(
+            self._mm, np.uint8,
+            self.capacity * self.event_size,
+            self.RING_OFFSET).reshape(self.capacity, self.event_size)
+
+    @property
+    def widx(self) -> int:
+        return int(self._hdr[0])
+
+    @property
+    def ridx(self) -> int:
+        return int(self._hdr[1])
+
+    @property
+    def dropped(self) -> int:
+        return int(self._hdr[2])
+
+    def read(self, max_events: int = 1024) -> List[Event]:
+        """Drain directly from the mapping (no engine call)."""
+        out: List[Event] = []
+        r, w = self.ridx, self.widx
+        while r < w and len(out) < max_events:
+            raw = _Event.from_buffer_copy(
+                self._ring[r % self.capacity].tobytes())
+            out.append(Event(EventType(raw.type),
+                             _tier_or_none(raw.srcTier),
+                             _tier_or_none(raw.dstTier), raw.devInst,
+                             raw.address, raw.bytes, raw.timestampNs))
+            r += 1
+        self._hdr[1] = r          # consumer owns ridx
+        return out
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._hdr = None
+            self._ring = None
+            self._mm.close()
+            self._mm = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class VaSpace:

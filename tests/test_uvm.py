@@ -495,3 +495,37 @@ def test_widened_event_vocabulary(vs):
         assert EventType.PM_SUSPEND in types
         assert EventType.PM_RESUME in types
         buf.free()
+
+
+def test_tools_mmap_queue(vs):
+    """The reference's mmap'd-queue contract (uvm_tools.c:54-70): map
+    the session's queue memfd and consume events ZERO-COPY — no engine
+    call on the read path — with producer-owned widx, consumer-owned
+    ridx, and drop-newest accounting when full."""
+    from open_gpu_kernel_modules_tpu.uvm.managed import EventType
+
+    with vs.tools_session(capacity=64) as sess:
+        sess.enable([EventType.MIGRATION])
+        with sess.map_queue() as q:
+            assert q.capacity == 64
+            buf = vs.alloc(2 * MB)
+            buf.view()[:] = 1
+            buf.migrate(Tier.HBM)
+            assert q.widx > q.ridx            # producer published
+            events = q.read()
+            assert any(e.type == EventType.MIGRATION for e in events)
+            assert q.ridx == q.widx           # consumer drained
+
+            # Overflow drops NEW events (the mapped consumer's ridx is
+            # never stolen): fill beyond capacity without draining.
+            for _ in range(70):
+                buf.migrate(Tier.HOST)
+                buf.migrate(Tier.HBM)
+            assert q.widx - q.ridx == 64      # pinned at capacity
+            assert q.dropped > 0
+            # The engine-side reader and the mapping agree.
+            assert sess.pending == 64
+            # ridx has one owner: the engine-side read path refuses.
+            with pytest.raises(RuntimeError, match="single owner"):
+                sess.read()
+            buf.free()
