@@ -384,8 +384,13 @@ class TieredKVCache:
         self._active_slots: set = set()
         self.seq_lens = np.zeros((batch,), np.int32)
         self.last_token = np.zeros((batch,), np.int32)
-        self.stats = {"uploads": 0, "flushes": 0, "upload_bytes": 0,
-                      "activations": 0}
+        # Slots whose device copy diverged from the backing (a decode
+        # WROTE into them).  Clean evictions skip the device readback
+        # entirely — attention only reads KV, so most evicted pages are
+        # clean and their backing copy is already current.
+        self._dirty_slots: set = set()
+        self.stats = {"uploads": 0, "flushes": 0, "clean_drops": 0,
+                      "upload_bytes": 0, "activations": 0}
 
     # ------------------------------------------------------------ views
     # (available only on backings that expose a host view — the managed
@@ -412,22 +417,36 @@ class TieredKVCache:
         self._lru[slot] = None          # reinsert at warm end
 
     def _flush_slots(self, slots: List[int]) -> None:
-        """Write evicted slots' pages back to the managed pool."""
+        """Write evicted DIRTY slots' pages back to the backing; CLEAN
+        slots (device copy never written since upload) just drop — the
+        backing is already current, so no device readback is needed.
+        Attention only reads KV, so most evicted pages are clean and
+        skip the transport round trip entirely."""
         if not slots:
             return
-        idx = np.array(slots, np.int32)
-        pad = _pad_pow2(len(slots))
-        if pad != len(slots):
-            idx = np.concatenate([idx, np.full(pad - len(slots), idx[-1],
+        dirty = [s for s in slots if s in self._dirty_slots]
+        for s in slots:
+            if s not in self._dirty_slots:
+                page = int(self.slot_owner[s])
+                self.slot_of[page] = -1
+                self.slot_owner[s] = -1
+        self.stats["clean_drops"] += len(slots) - len(dirty)
+        if not dirty:
+            return
+        idx = np.array(dirty, np.int32)
+        pad = _pad_pow2(len(dirty))
+        if pad != len(dirty):
+            idx = np.concatenate([idx, np.full(pad - len(dirty), idx[-1],
                                                np.int32)])
         k_chunks = np.asarray(_gather_pages(self.k_slots, jnp.asarray(idx)))
         v_chunks = np.asarray(_gather_pages(self.v_slots, jnp.asarray(idx)))
-        for i, s in enumerate(slots):
+        for i, s in enumerate(dirty):
             page = int(self.slot_owner[s])
             self.backing.write_page(page, k_chunks[:, i], v_chunks[:, i])
             self.slot_of[page] = -1
             self.slot_owner[s] = -1
-        self.stats["flushes"] += len(slots)
+            self._dirty_slots.discard(s)
+        self.stats["flushes"] += len(dirty)
 
     def _evict_for(self, need: int) -> List[int]:
         """Free `need` slots (LRU order, skipping active), returning
@@ -501,6 +520,9 @@ class TieredKVCache:
                 self.slot_owner[s] = page
                 self._lru[s] = None
                 self._active_slots.add(int(s))
+                # Fresh tenant: any stale dirty bit from a clean-dropped
+                # previous page must not force a bogus flush later.
+                self._dirty_slots.discard(int(s))
             self.stats["uploads"] += len(needed)
             self.stats["upload_bytes"] += (2 * len(needed) * self.page_bytes *
                                            self.cfg.num_layers)
@@ -538,6 +560,22 @@ class TieredKVCache:
         self.k_slots = view.k_pages
         self.v_slots = view.v_pages
         idx = np.array(seq_ids)
+        # Pages that received writes this turn: the span each sequence
+        # appended ([len, len+decoded)), or everything it covers when
+        # lengths are adopted from the view (prefill writes its whole
+        # prompt span).  One device materialization for the whole group.
+        P, m = self.page_size, self.pages_per_seq
+        view_lens = None if decoded else np.asarray(view.seq_lens)
+        for i, b in enumerate(seq_ids):
+            old = int(self.seq_lens[b])
+            new = min(old + decoded, m * P) if decoded else int(
+                view_lens[i])
+            first_pg = (old // P) if decoded else 0
+            last_pg = min(m - 1, max(new - 1, 0) // P)
+            for pg in range(first_pg, last_pg + 1):
+                slot = self.slot_of[b * m + pg]
+                if slot >= 0:
+                    self._dirty_slots.add(int(slot))
         if decoded:
             self.seq_lens[idx] = np.minimum(
                 self.seq_lens[idx] + decoded,

@@ -43,27 +43,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     # Causal: k-blocks entirely in this q-block's future contribute
     # nothing — skip their MXU work (roughly halves prefill FLOPs).
     k_base = ki * blk_k
-    q_last = qi * blk_q + blk_q - 1
+    q_first = qi * blk_q
+    q_last = q_first + blk_q - 1
     live = (k_base <= q_last) if causal else (ki >= 0)
+    # INTERIOR blocks need no mask at all: every k id precedes every q
+    # id (strictly below the causal diagonal) and the whole block is
+    # inside kv_len.  At long sequence most blocks are interior, and
+    # skipping the iota/compare/select saves substantial VPU work per
+    # tile (the MXU work is identical).
+    no_mask = jnp.logical_and(k_base + blk_k - 1 <= q_first,
+                              k_base + blk_k <= kv_len) if causal else \
+        (k_base + blk_k <= kv_len)
 
-    @pl.when(live)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [blk_q, d]
-        k = k_ref[0].astype(jnp.float32)          # [blk_k, d]
-        v = v_ref[0].astype(jnp.float32)          # [blk_k, d]
-
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-
-        # Mask: causal (global q index >= global k index) + kv-length tail.
-        k_ids = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = k_ids < kv_len
-        if causal:
-            q_ids = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32,
-                                                          s.shape, 0)
-            valid = jnp.logical_and(valid, k_ids <= q_ids)
-        s = jnp.where(valid, s, NEG_INF)
-
+    def _online_update(s, v):
         m_prev = m_scr[:, 0:1]                     # [blk_q, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -78,6 +70,30 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    def _scores():
+        q = q_ref[0].astype(jnp.float32)          # [blk_q, d]
+        k = k_ref[0].astype(jnp.float32)          # [blk_k, d]
+        return jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32
+                                   ) * scale
+
+    @pl.when(jnp.logical_and(live, no_mask))
+    def _compute_interior():
+        _online_update(_scores(), v_ref[0].astype(jnp.float32))
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(no_mask)))
+    def _compute_masked():
+        s = _scores()
+        # Mask: causal (global q index >= global k index) + kv-length tail.
+        k_ids = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = k_ids < kv_len
+        if causal:
+            q_ids = q_first + jax.lax.broadcasted_iota(jnp.int32,
+                                                       s.shape, 0)
+            valid = jnp.logical_and(valid, k_ids <= q_ids)
+        s = jnp.where(valid, s, NEG_INF)
+        _online_update(s, v_ref[0].astype(jnp.float32))
 
     @pl.when(ki == k_steps - 1)
     def _finish():

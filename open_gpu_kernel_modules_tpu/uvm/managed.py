@@ -299,6 +299,9 @@ class ToolsSession:
         """Switch this session to the mapped consumer.  ridx has ONE
         owner: after this, ToolsSession.read() raises — the two read
         paths would rewind each other's progress."""
+        if getattr(self, "_mapped", False):
+            raise RuntimeError("session queue already mapped: ridx has "
+                               "a single owner")
         self._mapped = True
         return MappedQueue(self.queue_fd())
 
@@ -445,13 +448,13 @@ class MappedQueue:
         head.close()
         self.capacity = int(cap)
         self.event_size = int(esize)
-        self._mm = _mmap.mmap(fd, self.RING_OFFSET +
-                              self.capacity * self.event_size)
-        self._hdr = np.frombuffer(self._mm, np.uint64, 3)
         if self.event_size != ctypes.sizeof(_Event):
             raise RuntimeError(
                 f"event ABI skew: queue eventSize={self.event_size}, "
                 f"consumer expects {ctypes.sizeof(_Event)}")
+        self._mm = _mmap.mmap(fd, self.RING_OFFSET +
+                              self.capacity * self.event_size)
+        self._hdr = np.frombuffer(self._mm, np.uint64, 3)
         self._ring = np.frombuffer(
             self._mm, np.uint8,
             self.capacity * self.event_size,

@@ -400,7 +400,7 @@ def test_fault_latency_bounds_and_parallel_service():
         assert not errs and not any(t.is_alive() for t in threads)
         stats = uvm.fault_stats()
         assert stats.service_ns_p50 < 100_000, stats
-        assert stats.service_ns_p95 < 5_000_000, stats
+        assert stats.service_ns_p95 < 20_000_000, stats
         for b in bufs:
             b.free()
         vs.close()
