@@ -141,3 +141,27 @@ def test_overflow_resync(hbm_rt):
     assert hbm_rt.resyncs >= 1
     arr = hbm_rt.read_arena(0, 4096)
     assert int(jax.jit(lambda a: a[0])(arr)) == 77
+
+
+def test_suspend_resume_keeps_chip_coherent(hbm_rt):
+    """PM cycle with the REAL arena: suspend saves residency, resume
+    restores it through the channel engine, and the mirror stream keeps
+    the chip view coherent with the restored bytes."""
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(1 << 20)
+        view = buf.view(np.uint8)
+        view[:] = 0xB7
+        buf.device_access(dev=0, write=True)      # HBM-resident
+        res = buf.residency()
+        assert res.hbm
+
+        uvm.suspend()      # arenas may scramble; residency saved to host
+        uvm.resume()       # eager restore re-populates the HBM tier
+
+        res2 = buf.residency()
+        assert res2.hbm
+        hbm_rt.fence()
+        arr = hbm_rt.read_arena(res2.hbm_offset, 4096)
+        assert int(jax.jit(lambda a: a[0])(arr)) == 0xB7
+        assert int(jax.jit(jnp.min)(arr)) == 0xB7
+        buf.free()
