@@ -182,6 +182,9 @@ void tpuRcPostFault(TpurmChannel *ch, uint64_t rcId, uint64_t value,
                     uint32_t kind);
 void tpuRcChannelRegister(TpurmChannel *ch, uint64_t rcId);
 void tpuRcChannelUnregister(TpurmChannel *ch);
+void tpuRcForEachChannel(void (*fn)(TpurmChannel *ch, uint64_t completed,
+                                    uint64_t pending, void *arg),
+                         void *arg);
 /* Channel-side delivery (called by the RC service under its registry
  * lock): invoke the channel's error notifier + apply recovery policy. */
 void tpurmChannelRcDeliver(TpurmChannel *ch, uint64_t value,

@@ -98,6 +98,20 @@ static void render_fault_stats(Cur *c)
          (unsigned long long)st.serviceNsP95);
 }
 
+static void channel_row(TpurmChannel *ch, uint64_t completed,
+                        uint64_t pending, void *arg)
+{
+    curf((Cur *)arg, "%-18p completed=%-12llu pending=%llu\n",
+         (void *)ch, (unsigned long long)completed,
+         (unsigned long long)pending);
+}
+
+static void render_channels(Cur *c)
+{
+    curf(c, "%-18s %-22s %s\n", "channel", "tracker", "fifo");
+    tpuRcForEachChannel(channel_row, c);
+}
+
 static void render_counters(Cur *c)
 {
     if (c->off + 1 >= c->cap)
@@ -124,6 +138,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm/version", render_version, false },
     { "driver/tpurm/gpus", render_gpus, false },
     { "driver/tpurm-uvm/fault_stats", render_fault_stats, false },
+    { "driver/tpurm/channels", render_channels, false },
     { "driver/tpurm-uvm/counters", render_counters, true },
     { "driver/tpurm/journal", render_journal, true },
 };

@@ -219,6 +219,22 @@ void tpuRcChannelRegister(TpurmChannel *ch, uint64_t rcId)
     pthread_mutex_unlock(&g_rc.chLock);
 }
 
+/* Iterate live channels under the registry lock (procfs renderer).
+ * The callback must not create/destroy channels. */
+void tpuRcForEachChannel(void (*fn)(TpurmChannel *ch, uint64_t completed,
+                                    uint64_t pending, void *arg),
+                         void *arg)
+{
+    tpuRcInit();
+    pthread_mutex_lock(&g_rc.chLock);
+    for (RcChannel *rc = g_rc.channels; rc; rc = rc->next) {
+        uint64_t completed, pending;
+        tpurmChannelProgress(rc->ch, &completed, &pending);
+        fn(rc->ch, completed, pending, arg);
+    }
+    pthread_mutex_unlock(&g_rc.chLock);
+}
+
 void tpuRcChannelUnregister(TpurmChannel *ch)
 {
     pthread_mutex_lock(&g_rc.chLock);

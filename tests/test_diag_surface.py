@@ -45,5 +45,8 @@ def test_procfs_nodes(monkeypatch):
     monkeypatch.setenv("TPUMEM_PROCFS_DEBUG", "1")
     body = utils.procfs_read("driver/tpurm-uvm/counters")
     assert "channel_pushes" in body
+    chans = utils.procfs_read("driver/tpurm/channels")
+    assert "completed=" in chans            # live CE pool listed
     nodes = utils.procfs_list()
     assert "driver/tpurm/version" in nodes
+    assert "driver/tpurm/channels" in nodes
