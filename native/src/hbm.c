@@ -113,11 +113,14 @@ void tpuHbmMirrorNotify(const void *dst, uint64_t bytes)
         const char *d = dst;
         if (d >= end || d + bytes <= base)
             continue;
-        /* Under overflow everything is already dirty; skip the submit
-         * until the consumer resyncs and clears the flag. */
-        if (atomic_load_explicit(&dev->mirrorOverflow,
-                                 memory_order_acquire))
-            continue;
+        /* NOTE: we do NOT skip while the overflow latch is set.  There
+         * is no happens-before between this thread's shadow write +
+         * latch load and the consumer's latch clear + whole-arena
+         * resync read: a write landing in that window could observe a
+         * stale latch and be skipped yet be missed by the resync
+         * snapshot, leaving chip HBM stale across a later fence.
+         * Submitting unconditionally is safe — worst case a range is
+         * applied twice (idempotent copy). */
         const char *lo = d > base ? d : base;
         const char *hi = d + bytes < end ? d + bytes : end;
         TpuMsgqCmd cmd = {
@@ -130,9 +133,9 @@ void tpuHbmMirrorNotify(const void *dst, uint64_t bytes)
         if (rc == 0) {
             tpuCounterAdd("hbm_mirror_bytes", cmd.bytes);
         } else if (rc == -EAGAIN) {
-            atomic_store_explicit(&dev->mirrorOverflow, 1,
-                                  memory_order_release);
-            tpuCounterAdd("hbm_mirror_overflows", 1);
+            if (!atomic_exchange_explicit(&dev->mirrorOverflow, 1,
+                                          memory_order_acq_rel))
+                tpuCounterAdd("hbm_mirror_overflows", 1);
         }
     }
 }

@@ -468,6 +468,12 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
         uint64_t prevVal[MAX_HOPS + 1];
         uint64_t curVal[MAX_HOPS + 1];
         memset(prevVal, 0, sizeof(prevVal));
+        /* curVal must start zeroed: if the FIRST segment fails before
+         * all hops are submitted, the prevVal memcpy below would
+         * otherwise propagate stack garbage for the never-submitted
+         * hops and the tail drain would block on arbitrary tracker
+         * values (tpurmChannelWait short-circuits on value==0). */
+        memset(curVal, 0, sizeof(curVal));
         uint32_t lastHop = n - 2;
         for (uint64_t off = 0; off < size && st == TPU_OK; off += seg) {
             uint64_t len = size - off < seg ? size - off : seg;

@@ -472,7 +472,28 @@ class TieredKVCache:
 
         Pages covering each sequence's current tokens plus `new_tokens`
         of growth become slot-resident and pinned until ``sync_from``.
+
+        On failure (slot pool exhausted, backing read error) every pin
+        taken by this call is rolled back and evicted-but-unfilled slots
+        rejoin the LRU, so a failed activation never shrinks the pool
+        visible to later ones.
         """
+        pinned_before = set(self._active_slots)
+        lru_before = list(self._lru)
+        try:
+            return self._activate_body(seq_ids, new_tokens)
+        except BaseException:
+            self._active_slots = pinned_before
+            # Rebuild the LRU in its pre-call order: slots _evict_for
+            # removed rejoin at their old (cold) position whether or not
+            # they were flushed (_evict_for can raise before flushing,
+            # leaving slot_owner set), and slots added mid-call keep a
+            # warm position at the tail.
+            self._lru = dict.fromkeys(lru_before) | self._lru
+            raise
+
+    def _activate_body(self, seq_ids: Sequence[int], new_tokens: int
+                       ) -> PagedKVCache:
         self.stats["activations"] += 1
         m, P = self.pages_per_seq, self.page_size
         needed: List[int] = []

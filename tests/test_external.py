@@ -73,7 +73,8 @@ def test_external_range_aliases_device_arena():
     with uvm.VaSpace() as vs:
         base = libc.mmap(None, length, PROT_NONE,
                          MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0)
-        assert base not in (None, -1)
+        # restype is c_void_p, so MAP_FAILED surfaces as 2**64-1, not -1
+        assert base not in (None, ctypes.c_void_p(-1).value)
         try:
             assert lib.uvmExternalRangeCreate(vs._handle, base, length) == 0
 
