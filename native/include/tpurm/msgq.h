@@ -40,6 +40,9 @@ enum {
     TPU_MSGQ_FENCE = 3,        /* completion marker only              */
     TPU_MSGQ_CE_PUSH = 5,      /* src = CopySeg methods in a channel
                                 * pushbuffer, bytes = method count    */
+    TPU_MSGQ_HBM_READBACK = 6, /* chip[dst..+bytes] is newer than the
+                                * shadow: consumer must download it
+                                * into the shadow before completing   */
 };
 
 /* Command flags. */

@@ -95,6 +95,33 @@ void      tpurmHbmMirrorComplete(uint32_t inst, uint64_t seq);
 int       tpurmHbmMirrorConsumeOverflow(uint32_t inst);
 uint64_t  tpurmHbmFence(uint32_t inst);
 TpuStatus tpurmHbmWaitSeq(uint32_t inst, uint64_t seq);
+/* 1 when the mirror stream has nothing outstanding (fence would be a
+ * no-op); read paths use it to skip the round trip. */
+int       tpurmHbmMirrorIdle(uint32_t inst);
+
+/* Chip-dirty tracking — the chip->host direction of the boundary.
+ * When a jitted computation writes the on-chip arena, the runtime
+ * installs the result and marks the span chip-dirty; engine reads of
+ * chip-dirty spans (eviction, CPU-fault service, CE/CXL DMA, RDMA
+ * pinning, PM save) first block on a READBACK op that downloads the
+ * pages into the shadow.  Mirrors the reference's direction-agnostic
+ * copy engine (mem_utils.c:567, ce_utils.c:571) and fbsr.c save
+ * semantics: device memory, not a host mirror, is the truth once the
+ * device wrote it. */
+void      tpurmHbmMarkChipDirty(uint32_t inst, uint64_t off,
+                                uint64_t bytes);
+int       tpurmHbmChipDirtyTest(uint32_t inst, uint64_t off,
+                                uint64_t bytes);
+/* First chip-dirty span within [off, end): 1 + [*lo, *hi) on hit. */
+int       tpurmHbmChipDirtyNextSpan(uint32_t inst, uint64_t off,
+                                    uint64_t end, uint64_t *lo,
+                                    uint64_t *hi);
+void      tpurmHbmChipDirtyClear(uint32_t inst, uint64_t off,
+                                 uint64_t bytes);
+/* Blocking: submit a READBACK for [off, off+bytes) and wait until the
+ * consumer has made the shadow coherent.  TPU_OK immediately when the
+ * arena is fake or the span has no chip-dirty pages. */
+TpuStatus tpurmHbmReadback(uint32_t inst, uint64_t off, uint64_t bytes);
 
 /* -------------------------------------------------------- DMA channels */
 

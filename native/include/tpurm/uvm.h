@@ -329,6 +329,15 @@ TpuStatus uvmRangeGroupSetMigratable(UvmVaSpace *vs, uint64_t id,
 TpuStatus uvmDeviceAccess(UvmVaSpace *vs, uint32_t devInst, void *base,
                           uint64_t len, int isWrite);
 
+/* Device-wrote invalidation (chip->host write side): a jitted
+ * computation wrote HBM arena [off, off+bytes) on devInst — drop every
+ * stale CPU/CXL duplicate of managed pages backed by the span and
+ * revoke their user PTEs so the next CPU touch faults the chip truth
+ * back.  Caller must have marked the span chip-dirty first
+ * (tpurmHbmMarkChipDirty).  Returns pages invalidated. */
+uint64_t uvmHbmDeviceWroteRange(uint32_t devInst, uint64_t off,
+                                uint64_t bytes);
+
 /* Introspection (UVM_TEST_VA_RESIDENCY_INFO analog, uvm_test.c:288). */
 typedef struct {
     uint8_t residentHost, residentHbm, residentCxl;

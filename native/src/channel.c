@@ -119,11 +119,31 @@ static void *channel_executor(void *arg)
             nanosleep(&ts, NULL);
         }
         bool failed = (cmd.flags & TPU_MSGQ_FLAG_INJECT_ERROR) != 0;
+        bool readbackFailed = false;
         uint64_t bytes = 0;
         if (!failed && cmd.op == TPU_MSGQ_CE_PUSH) {
             const CopySeg *segs = (const CopySeg *)(uintptr_t)cmd.src;
             for (uint64_t i = 0; i < cmd.bytes; i++) {
                 if (segs[i].bytes > 0) {
+                    /* Direction-agnostic device boundary (reference
+                     * mem_utils.c:567): if either side overlaps pages
+                     * a jitted computation wrote on-chip, download
+                     * them into the shadow first — the src so we copy
+                     * chip truth, the dst so untouched bytes of
+                     * partially-overwritten pages aren't lost when the
+                     * write republishes the (otherwise stale) span.
+                     * Failure means the shadow is STALE: fail the push
+                     * (CE fault) rather than copy — an eviction that
+                     * committed a stale read would free the only copy
+                     * of chip-computed data. */
+                    if (tpuHbmCoherentForRead(segs[i].src,
+                                              segs[i].bytes) != TPU_OK ||
+                        tpuHbmCoherentForRead(segs[i].dst,
+                                              segs[i].bytes) != TPU_OK) {
+                        failed = true;
+                        readbackFailed = true;
+                        break;
+                    }
                     memmove(segs[i].dst, segs[i].src, segs[i].bytes);
                     tpuHbmMirrorNotify(segs[i].dst, segs[i].bytes);
                 }
@@ -142,7 +162,10 @@ static void *channel_executor(void *arg)
              * (rc.c — the reference's CE-fault delivery split). */
             atomic_store_explicit(&ch->error, 1, memory_order_release);
             tpuLog(TPU_LOG_ERROR, "channel",
-                   "injected CE fault at tracker value %llu",
+                   readbackFailed
+                       ? "CE fault: chip readback unavailable at tracker "
+                         "value %llu"
+                       : "injected CE fault at tracker value %llu",
                    (unsigned long long)cmd.seq);
             tpuRcPostFault(ch, ch->rcId, cmd.seq, TPU_RC_CE_FAULT);
         }

@@ -32,7 +32,19 @@
 #include "tpurm/msgq.h"
 
 #include <errno.h>
+#include <stdlib.h>
 #include <string.h>
+
+/* Chip-dirty bitmap granularity.  4 KB regardless of uvmPageSize():
+ * hbm.c must not depend on the UVM engine, and finer granularity only
+ * costs 32 KB of bitmap per GB of arena. */
+#define CHIP_DIRTY_PAGE 4096ull
+
+static uint64_t chip_dirty_words(const TpurmDevice *dev)
+{
+    uint64_t pages = (dev->hbmSize + CHIP_DIRTY_PAGE - 1) / CHIP_DIRTY_PAGE;
+    return (pages + 63) / 64;
+}
 
 TpuStatus tpurmDeviceRegisterHbm(uint32_t inst)
 {
@@ -42,8 +54,26 @@ TpuStatus tpurmDeviceRegisterHbm(uint32_t inst)
 
     pthread_mutex_lock(&dev->hbmLock);
     if (atomic_load_explicit(&dev->arenaReal, memory_order_acquire)) {
+        /* Already registered: do NOT touch the chip-dirty state — the
+         * live consumer may have unsynced chip writes whose bits a
+         * reset would silently drop. */
         pthread_mutex_unlock(&dev->hbmLock);
-        return TPU_OK;                    /* already registered */
+        return TPU_OK;
+    }
+    if (!dev->chipDirty) {
+        dev->chipDirty = calloc(chip_dirty_words(dev), sizeof(uint64_t));
+        if (!dev->chipDirty) {
+            pthread_mutex_unlock(&dev->hbmLock);
+            return TPU_ERR_NO_MEMORY;
+        }
+    } else {
+        /* Fresh runtime attach (fake -> real transition): chip HBM
+         * holds nothing of ours yet, so stale dirty state from a
+         * previous consumer must not trigger spurious readbacks. */
+        memset((void *)dev->chipDirty, 0,
+               chip_dirty_words(dev) * sizeof(uint64_t));
+        atomic_store_explicit(&dev->chipDirtyPages, 0,
+                              memory_order_release);
     }
     if (dev->mirrorq) {
         /* Re-register after unregister: reopen the queue (the object is
@@ -187,6 +217,19 @@ uint64_t tpurmHbmFence(uint32_t inst)
     return seq;
 }
 
+/* 1 when every published mirror command has been applied (or there is
+ * nothing to apply): lets read paths skip the fence round trip on an
+ * idle stream. */
+int tpurmHbmMirrorIdle(uint32_t inst)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->mirrorq ||
+        !atomic_load_explicit(&dev->arenaReal, memory_order_acquire))
+        return 1;
+    return tpuMsgqCompletedSeq(dev->mirrorq) >=
+           tpuMsgqSubmittedSeq(dev->mirrorq);
+}
+
 TpuStatus tpurmHbmWaitSeq(uint32_t inst, uint64_t seq)
 {
     if (seq == 0)
@@ -196,4 +239,176 @@ TpuStatus tpurmHbmWaitSeq(uint32_t inst, uint64_t seq)
         return TPU_ERR_INVALID_DEVICE;
     return tpuMsgqWaitSeq(dev->mirrorq, seq) ? TPU_OK
                                              : TPU_ERR_INVALID_STATE;
+}
+
+/* ------------------------------------------- chip-dirty page tracking
+ * (the chip->host direction: a jitted computation wrote the on-chip
+ * arena, so the chip copy is newer than the shadow until downloaded).
+ * Reference: the CE copies both directions (mem_utils.c:567,
+ * ce_utils.c:571), suspend saves real vidmem (fbsr.c), and UVM
+ * eviction copies actual GPU memory back (uvm_va_block.c:4660). */
+
+static void chip_dirty_range(const TpurmDevice *dev, uint64_t off,
+                             uint64_t bytes, uint64_t *firstPage,
+                             uint64_t *lastPage)
+{
+    uint64_t end = off + bytes;
+    if (end > dev->hbmSize)
+        end = dev->hbmSize;
+    *firstPage = off / CHIP_DIRTY_PAGE;
+    *lastPage = end ? (end - 1) / CHIP_DIRTY_PAGE : 0;
+}
+
+void tpurmHbmMarkChipDirty(uint32_t inst, uint64_t off, uint64_t bytes)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->chipDirty || bytes == 0 || off >= dev->hbmSize)
+        return;
+    uint64_t first, last;
+    chip_dirty_range(dev, off, bytes, &first, &last);
+    uint64_t added = 0;
+    for (uint64_t p = first; p <= last; p++) {
+        uint64_t mask = 1ull << (p & 63);
+        uint64_t old = atomic_fetch_or_explicit(&dev->chipDirty[p >> 6],
+                                                mask,
+                                                memory_order_acq_rel);
+        if (!(old & mask))
+            added++;
+    }
+    if (added)
+        atomic_fetch_add_explicit(&dev->chipDirtyPages, added,
+                                  memory_order_acq_rel);
+}
+
+void tpurmHbmChipDirtyClear(uint32_t inst, uint64_t off, uint64_t bytes)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->chipDirty || bytes == 0 || off >= dev->hbmSize)
+        return;
+    uint64_t first, last;
+    chip_dirty_range(dev, off, bytes, &first, &last);
+    uint64_t removed = 0;
+    for (uint64_t p = first; p <= last; p++) {
+        uint64_t mask = 1ull << (p & 63);
+        uint64_t old = atomic_fetch_and_explicit(&dev->chipDirty[p >> 6],
+                                                 ~mask,
+                                                 memory_order_acq_rel);
+        if (old & mask)
+            removed++;
+    }
+    if (removed)
+        atomic_fetch_sub_explicit(&dev->chipDirtyPages, removed,
+                                  memory_order_acq_rel);
+}
+
+int tpurmHbmChipDirtyTest(uint32_t inst, uint64_t off, uint64_t bytes)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->chipDirty || bytes == 0 || off >= dev->hbmSize)
+        return 0;
+    if (atomic_load_explicit(&dev->chipDirtyPages,
+                             memory_order_acquire) == 0)
+        return 0;
+    uint64_t first, last;
+    chip_dirty_range(dev, off, bytes, &first, &last);
+    for (uint64_t p = first; p <= last; p++)
+        if (atomic_load_explicit(&dev->chipDirty[p >> 6],
+                                 memory_order_acquire) &
+            (1ull << (p & 63)))
+            return 1;
+    return 0;
+}
+
+int tpurmHbmChipDirtyNextSpan(uint32_t inst, uint64_t off, uint64_t end,
+                              uint64_t *lo, uint64_t *hi)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev || !dev->chipDirty || off >= end)
+        return 0;
+    if (end > dev->hbmSize)
+        end = dev->hbmSize;
+    if (atomic_load_explicit(&dev->chipDirtyPages,
+                             memory_order_acquire) == 0)
+        return 0;
+    uint64_t first = off / CHIP_DIRTY_PAGE;
+    uint64_t last = (end - 1) / CHIP_DIRTY_PAGE;
+    uint64_t p = first;
+    while (p <= last &&
+           !(atomic_load_explicit(&dev->chipDirty[p >> 6],
+                                  memory_order_acquire) &
+             (1ull << (p & 63))))
+        p++;
+    if (p > last)
+        return 0;
+    uint64_t q = p;
+    while (q + 1 <= last &&
+           (atomic_load_explicit(&dev->chipDirty[(q + 1) >> 6],
+                                 memory_order_acquire) &
+            (1ull << ((q + 1) & 63))))
+        q++;
+    *lo = p * CHIP_DIRTY_PAGE;
+    *hi = (q + 1) * CHIP_DIRTY_PAGE;
+    if (*lo < off)
+        *lo = off;
+    if (*hi > end)
+        *hi = end;
+    return 1;
+}
+
+TpuStatus tpurmHbmReadback(uint32_t inst, uint64_t off, uint64_t bytes)
+{
+    TpurmDevice *dev = tpurmDeviceGet(inst);
+    if (!dev)
+        return TPU_ERR_INVALID_DEVICE;
+    if (!atomic_load_explicit(&dev->arenaReal, memory_order_acquire) ||
+        !tpurmHbmChipDirtyTest(inst, off, bytes))
+        return TPU_OK;          /* shadow already authoritative */
+    TpuMsgqCmd cmd = {
+        .op = TPU_MSGQ_HBM_READBACK,
+        .devInst = inst,
+        .dst = off,
+        .bytes = bytes,
+    };
+    uint64_t seq = 0;
+    if (tpuMsgqSubmit(dev->mirrorq, &cmd, 1, &seq) != 0)
+        return TPU_ERR_INVALID_STATE;
+    tpuCounterAdd("hbm_readback_requests", 1);
+    return tpuMsgqWaitSeq(dev->mirrorq, seq) ? TPU_OK
+                                             : TPU_ERR_INVALID_STATE;
+}
+
+TpuStatus tpuHbmCoherentForRead(const void *src, uint64_t bytes)
+{
+    if (!src || bytes == 0)
+        return TPU_OK;
+    TpuStatus worst = TPU_OK;
+    uint32_t n = tpurmDeviceCount();
+    for (uint32_t i = 0; i < n; i++) {
+        TpurmDevice *dev = tpurmDeviceGet(i);
+        if (!dev ||
+            !atomic_load_explicit(&dev->arenaReal, memory_order_acquire))
+            continue;
+        if (atomic_load_explicit(&dev->chipDirtyPages,
+                                 memory_order_acquire) == 0)
+            continue;
+        const char *base = dev->hbmBase;
+        const char *end = base + dev->hbmSize;
+        const char *s = src;
+        if (s >= end || s + bytes <= base)
+            continue;
+        const char *lo = s > base ? s : base;
+        const char *hi = s + bytes < end ? s + bytes : end;
+        TpuStatus st = tpurmHbmReadback(i, (uint64_t)(lo - base),
+                                        (uint64_t)(hi - lo));
+        if (st != TPU_OK) {
+            /* The caller must FAIL the copy rather than proceed with a
+             * stale shadow — an eviction that committed it would free
+             * the only copy of chip-computed data. */
+            tpuLog(TPU_LOG_WARN, "hbm",
+                   "chip readback failed (status %d): refusing to "
+                   "serve the stale shadow", st);
+            worst = st;
+        }
+    }
+    return worst;
 }

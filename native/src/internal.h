@@ -121,11 +121,29 @@ struct TpurmDevice {
     _Atomic int mirrorOverflow;
     TpuMsgq *mirrorq;
     pthread_mutex_t hbmLock;
+    /* Chip-dirty page bitmap (1 bit per 4 KB arena page): set when a
+     * jitted computation wrote the on-chip arena (the chip copy is
+     * newer than the shadow), cleared when the consumer downloads the
+     * pages back into the shadow.  chipDirtyPages gates the read-path
+     * check to one atomic load when no chip writes exist. */
+    _Atomic(uint64_t) *chipDirty;
+    _Atomic uint64_t chipDirtyPages;
 };
 
 /* hbm.c engine hook: publish [dst, dst+bytes) as dirty if it lies in a
  * real-registered device's shadow arena. */
 void tpuHbmMirrorNotify(const void *dst, uint64_t bytes);
+
+/* hbm.c engine hook: make [src, src+bytes) coherent for a host-side
+ * read.  If the span lies in a real arena and intersects chip-dirty
+ * pages (a jitted computation wrote them), blocks until the consumer
+ * has downloaded those pages into the shadow.  TPU_OK when there is
+ * nothing to do; a non-OK status (dead consumer, queue shutdown) means
+ * the shadow is STALE and the caller must fail the copy rather than
+ * serve it.  Reference: direction-agnostic copies, mem_utils.c:567 /
+ * ce_utils.c:571; eviction reads real vidmem,
+ * kernel-open/nvidia-uvm/uvm_va_block.c:4660. */
+TpuStatus tpuHbmCoherentForRead(const void *src, uint64_t bytes);
 
 void tpuDeviceGlobalInit(void);     /* idempotent */
 TpurmDevice *tpuDeviceByDevId(uint32_t devId);

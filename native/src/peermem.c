@@ -155,6 +155,17 @@ TpuStatus tpuP2pGetPages(UvmVaSpace *vs, uint32_t devInst, uint64_t va,
                         run->chunk->offset +
                         (uint64_t)(page - run->firstPage) * ps;
                     ptr = (char *)run->arena->base;
+                    /* The NIC reads the arena mapping directly, so any
+                     * chip-computed bytes must be downloaded into the
+                     * shadow before the bus address is handed out
+                     * (GPUDirect pins real vidmem, not a host mirror).
+                     * Failure = stale shadow: refuse the registration. */
+                    if (tpuHbmCoherentForRead(
+                            (char *)ptr + pages[pageIx].busAddress,
+                            ps) != TPU_OK) {
+                        st = TPU_ERR_INVALID_STATE;
+                        ptr = NULL;
+                    }
                     break;
                 }
             }

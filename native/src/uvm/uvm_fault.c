@@ -884,8 +884,9 @@ void uvmFaultRingDrain(void)
 }
 
 /* Iterate every block of every registered space (spacesLock -> vs lock,
- * the snapshot-rebuild order) calling fn(vs, blk). */
-void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk))
+ * the snapshot-rebuild order) calling fn(vs, blk, ctx). */
+void uvmFaultForEachSpaceCtx(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk,
+                                        void *ctx), void *ctx)
 {
     pthread_mutex_lock(&g_fault.spacesLock);
     for (UvmVaSpace *vs = g_fault.spacesHead; vs; vs = vs->nextSpace) {
@@ -896,13 +897,26 @@ void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk))
             UvmVaRange *r = (UvmVaRange *)n;
             for (uint32_t b = 0; b < r->blockCount; b++) {
                 if (r->blocks[b])
-                    fn(vs, r->blocks[b]);
+                    fn(vs, r->blocks[b], ctx);
             }
         }
         tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "pm-iter");
         pthread_mutex_unlock(&vs->lock);
     }
     pthread_mutex_unlock(&g_fault.spacesLock);
+}
+
+static void foreach_nullctx_tramp(UvmVaSpace *vs, UvmVaBlock *blk,
+                                  void *ctx)
+{
+    void (*fn)(UvmVaSpace *, UvmVaBlock *) =
+        (void (*)(UvmVaSpace *, UvmVaBlock *))(uintptr_t)ctx;
+    fn(vs, blk);
+}
+
+void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk))
+{
+    uvmFaultForEachSpaceCtx(foreach_nullctx_tramp, (void *)(uintptr_t)fn);
 }
 
 /* ------------------------------------------------------- SIGSEGV handler */

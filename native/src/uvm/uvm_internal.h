@@ -418,6 +418,8 @@ void uvmFaultStatsRecordEviction(void);
 /* PM drain barrier + space/block iteration (uvm_pm.c consumers). */
 void uvmFaultRingDrain(void);
 void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk));
+void uvmFaultForEachSpaceCtx(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk,
+                                        void *ctx), void *ctx);
 /* Global PM gate (reference: uvm_lock.h:43-49).  Entry points enter the
  * shared side; uvmSuspend holds it exclusively until uvmResume. */
 void uvmPmEnterShared(void);

@@ -335,6 +335,14 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
              * fault-service path from committing pages the caller never
              * reads (big win for prefetch-expanded regions). */
             if (dstTier != UVM_TIER_HOST) {
+                /* Direct shadow write: like the executor, make any
+                 * chip-dirty overlap coherent first so the zero-fill's
+                 * republish can't resurrect stale shadow bytes. */
+                if (tpuHbmCoherentForRead(dstPtr, ps) != TPU_OK) {
+                    tpuTrackerWait(&tracker);
+                    tpuTrackerDeinit(&tracker);
+                    return TPU_ERR_INVALID_STATE;
+                }
                 memset(dstPtr, 0, ps);
                 /* Direct shadow write: publish to the real-arena mirror
                  * (every other HBM write rides the channel executor,
@@ -456,6 +464,10 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                        tier_page_ptr(blk, tier, p + span) ==
                            (char *)src + (uint64_t)span * ps)
                     span++;
+                /* Eviction saves what the DEVICE computed, not a stale
+                 * shadow (reference: uvm_va_block.c:4660 copies actual
+                 * GPU memory back): the channel executor downloads any
+                 * chip-dirty source pages before the copy runs. */
                 /* Copies land in the engine alias; user PTEs stay
                  * PROT_NONE until the data is home, so racing CPU
                  * accesses fault and queue behind this eviction rather
@@ -884,4 +896,102 @@ void uvmBlockFreeBacking(UvmVaBlock *blk)
         }
         *runs_head(blk, (UvmTier)tier) = NULL;
     }
+}
+
+/* -------------------------------------------- device-wrote invalidation
+ * (chip->host direction, write side).  A jitted computation wrote HBM
+ * arena [off, off+bytes) on device `devInst`: any CPU/CXL copy of a
+ * managed page backed by that span is now stale and must be dropped,
+ * with user PTEs revoked so the next CPU touch faults and migrates the
+ * chip truth back (reference: device writes hold write exclusivity and
+ * remote mappings are revoked — uvm_va_block.c make-resident unmap
+ * semantics; reverse lookup plays uvm_pmm_sysmem.c's reverse-map role).
+ * Caller must already have marked the span chip-dirty
+ * (tpurmHbmMarkChipDirty) so engine reads of it block on readback. */
+
+typedef struct {
+    uint32_t devInst;
+    uint64_t off, end;
+    uint64_t invalidated;       /* pages dropped (stat/return) */
+    bool pinnedOverlap;         /* span hits a P2P-pinned block */
+} DeviceWroteCtx;
+
+static void device_wrote_visit(UvmVaSpace *vs, UvmVaBlock *blk, void *ctxv)
+{
+    (void)vs;
+    DeviceWroteCtx *ctx = ctxv;
+    uint64_t ps = uvmPageSize();
+
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "dev-wrote");
+    for (UvmChunkRun *r = blk->hbmRuns; r; r = r->next) {
+        if (r->arena->tier != UVM_TIER_HBM ||
+            r->arena->devInst != ctx->devInst)
+            continue;
+        uint64_t runLo = r->chunk->offset;
+        uint64_t runHi = runLo + (uint64_t)r->numPages * ps;
+        uint64_t lo = ctx->off > runLo ? ctx->off : runLo;
+        uint64_t hi = ctx->end < runHi ? ctx->end : runHi;
+        if (lo >= hi)
+            continue;
+        /* RDMA consumers hold live bus addresses into this block and
+         * read the arena mapping directly — nothing on their path can
+         * block on a READBACK, so the caller must download the span
+         * synchronously (GPUDirect invariant: exported memory is the
+         * device truth, nvidia-peermem.c dma_map semantics). */
+        if (blk->p2pPinCount)
+            ctx->pinnedOverlap = true;
+        uint32_t firstP = r->firstPage + (uint32_t)((lo - runLo) / ps);
+        uint32_t lastP = r->firstPage + (uint32_t)((hi - 1 - runLo) / ps);
+        uint32_t spanStart = UINT32_MAX, spanLen = 0;
+        for (uint32_t p = firstP; p <= lastP; p++) {
+            if (!uvmPageMaskTest(&blk->resident[UVM_TIER_HBM], p))
+                continue;
+            bool hadOther = false;
+            for (int t = 0; t < UVM_TIER_COUNT; t++) {
+                if (t == (int)UVM_TIER_HBM)
+                    continue;
+                if (uvmPageMaskTest(&blk->resident[t], p)) {
+                    uvmPageMaskClear(&blk->resident[t], p);
+                    hadOther = true;
+                }
+            }
+            ctx->invalidated++;
+            /* Revoke CPU access even for previously HBM-exclusive pages:
+             * PTEs may be read-only-valid under read duplication. */
+            (void)hadOther;
+            if (spanStart == UINT32_MAX) {
+                spanStart = p;
+                spanLen = 1;
+            } else if (p == spanStart + spanLen) {
+                spanLen++;
+            } else {
+                uvmBlockSetCpuAccess(blk, spanStart, spanLen, PROT_NONE);
+                spanStart = p;
+                spanLen = 1;
+            }
+        }
+        if (spanStart != UINT32_MAX)
+            uvmBlockSetCpuAccess(blk, spanStart, spanLen, PROT_NONE);
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "dev-wrote");
+    pthread_mutex_unlock(&blk->lock);
+}
+
+uint64_t uvmHbmDeviceWroteRange(uint32_t devInst, uint64_t off,
+                                uint64_t bytes)
+{
+    DeviceWroteCtx ctx = { .devInst = devInst, .off = off,
+                           .end = off + bytes };
+    if (bytes == 0)
+        return 0;
+    uvmFaultForEachSpaceCtx(device_wrote_visit, &ctx);
+    if (ctx.invalidated)
+        tpuCounterAdd("uvm_device_wrote_invalidations", ctx.invalidated);
+    /* Pinned overlap: force the chip->shadow download NOW (no engine
+     * locks held here) so RDMA readers of the arena mapping see the
+     * device-written bytes. */
+    if (ctx.pinnedOverlap)
+        (void)tpurmHbmReadback(devInst, off, bytes);
+    return ctx.invalidated;
 }

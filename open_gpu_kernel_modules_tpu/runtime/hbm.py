@@ -50,6 +50,7 @@ class MsgqCmd(ctypes.Structure):
 
 OP_HBM_MIRROR = 2
 OP_FENCE = 3
+OP_HBM_READBACK = 6
 
 _hbm_bound = False
 
@@ -74,6 +75,19 @@ def _lib() -> ctypes.CDLL:
         lib.tpurmHbmFence.restype = u64
         lib.tpurmHbmWaitSeq.argtypes = [u32, u64]
         lib.tpurmHbmWaitSeq.restype = u32
+        lib.tpurmHbmMarkChipDirty.argtypes = [u32, u64, u64]
+        lib.tpurmHbmChipDirtyTest.argtypes = [u32, u64, u64]
+        lib.tpurmHbmChipDirtyTest.restype = ctypes.c_int
+        lib.tpurmHbmChipDirtyNextSpan.argtypes = [
+            u32, u64, u64, ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        lib.tpurmHbmChipDirtyNextSpan.restype = ctypes.c_int
+        lib.tpurmHbmChipDirtyClear.argtypes = [u32, u64, u64]
+        lib.tpurmHbmReadback.argtypes = [u32, u64, u64]
+        lib.tpurmHbmReadback.restype = u32
+        lib.uvmHbmDeviceWroteRange.argtypes = [u32, u64, u64]
+        lib.uvmHbmDeviceWroteRange.restype = u64
+        lib.tpurmHbmMirrorIdle.argtypes = [u32]
+        lib.tpurmHbmMirrorIdle.restype = ctypes.c_int
         _hbm_bound = True
     return lib
 
@@ -103,11 +117,18 @@ class HbmRuntime:
         # None = never dirtied; materialized lazily from the shadow.
         self._blocks: List[Optional[object]] = [None] * self.n_blocks
         self._blocks_lock = threading.Lock()
+        # Serializes whole coherence transactions (merge+upload+install
+        # on the drain side, install+mark on the write_arena side) so a
+        # stale-shadow upload can never clobber a just-installed chip
+        # write. RLock: block() -> _upload_blocks nests under callers.
+        self._coh_lock = threading.RLock()
         self.mirrored_bytes = 0
         self.resyncs = 0
         self.drain_batches = 0
         self.upload_calls = 0
         self.upload_seconds = 0.0
+        self.readbacks = 0
+        self.readback_bytes = 0
         self._drain_error: Optional[BaseException] = None
 
         st = self._lib.tpurmDeviceRegisterHbm(dev)
@@ -127,21 +148,94 @@ class HbmRuntime:
         if not ids:
             return
         t0 = _time.perf_counter()
-        chunks = []
-        for b in ids:
-            lo = b * self.block_bytes
-            hi = min(lo + self.block_bytes, self.arena_bytes)
-            # Copy out of the shadow: device_put may be async and the
-            # engine can redirty the span behind us; the copy pins the
-            # snapshot this batch covers.
-            chunks.append(np.array(self._shadow[lo:hi]))
-        arrs = jax.device_put(chunks, self.device)
-        with self._blocks_lock:
-            for b, arr in zip(ids, arrs):
-                self._blocks[b] = arr
+        with self._coh_lock:
+            # Chip->host direction first: a block that still holds
+            # chip-computed pages must have them downloaded into the
+            # shadow before a whole-block upload republishes it, or the
+            # upload would overwrite chip truth with stale shadow bytes.
+            for b in ids:
+                lo = b * self.block_bytes
+                hi = min(lo + self.block_bytes, self.arena_bytes)
+                if self._lib.tpurmHbmChipDirtyTest(self.dev, lo, hi - lo):
+                    self._readback_merge(lo, hi - lo)
+            chunks = []
+            for b in ids:
+                lo = b * self.block_bytes
+                hi = min(lo + self.block_bytes, self.arena_bytes)
+                # Copy out of the shadow: device_put may be async and
+                # the engine can redirty the span behind us; the copy
+                # pins the snapshot this batch covers.
+                chunks.append(np.array(self._shadow[lo:hi]))
+            arrs = jax.device_put(chunks, self.device)
+            with self._blocks_lock:
+                for b, arr in zip(ids, arrs):
+                    self._blocks[b] = arr
         self.mirrored_bytes += sum(c.nbytes for c in chunks)
         self.upload_calls += 1
         self.upload_seconds += _time.perf_counter() - t0
+
+    def _readback_merge(self, offset: int, length: int) -> None:
+        """Download chip-dirty pages in [offset, offset+length) into the
+        shadow and clear their dirty bits — the chip->host op the native
+        engine blocks on (reference: eviction copies real vidmem back,
+        uvm_va_block.c:4660; fbsr.c saves actual FB contents)."""
+        import jax
+
+        u64 = ctypes.c_uint64
+        # Round the request out to dirty-granule (4 KB) boundaries: the
+        # native clear below is granule-granular, so merging only a
+        # byte sub-range of a granule would clear its bit while leaving
+        # chip-newer bytes outside the sub-range untracked (data loss).
+        gran = 4096
+        start = (offset // gran) * gran
+        end = min(-(-(offset + length) // gran) * gran, self.arena_bytes)
+        spans: List[tuple] = []
+        pos = start
+        lo, hi = u64(), u64()
+        with self._coh_lock:
+            while pos < end and self._lib.tpurmHbmChipDirtyNextSpan(
+                    self.dev, pos, end, ctypes.byref(lo),
+                    ctypes.byref(hi)):
+                spans.append((lo.value, hi.value))
+                pos = hi.value
+            if not spans:
+                return
+            # Group by block; one device_get per covering block batch.
+            needed = set()
+            for s_lo, s_hi in spans:
+                first = s_lo // self.block_bytes
+                last = (s_hi - 1) // self.block_bytes
+                needed.update(range(int(first), int(last) + 1))
+            with self._blocks_lock:
+                refs = {b: self._blocks[b] for b in needed}
+            live = {b: a for b, a in refs.items() if a is not None}
+            hosts = {}
+            if live:
+                got = jax.device_get(list(live.values()))
+                hosts = dict(zip(live.keys(), got))
+            for s_lo, s_hi in spans:
+                b_first = int(s_lo // self.block_bytes)
+                b_last = int((s_hi - 1) // self.block_bytes)
+                for b in range(b_first, b_last + 1):
+                    blk_lo = b * self.block_bytes
+                    blk_hi = min(blk_lo + self.block_bytes,
+                                 self.arena_bytes)
+                    c_lo, c_hi = max(s_lo, blk_lo), min(s_hi, blk_hi)
+                    if c_lo >= c_hi:
+                        continue
+                    host = hosts.get(b)
+                    if host is not None:
+                        # Chip truth -> shadow (direct write, no mirror
+                        # notify: shadow == chip afterwards by
+                        # construction).
+                        self._shadow[c_lo:c_hi] = host[
+                            c_lo - blk_lo:c_hi - blk_lo]
+                        self.readback_bytes += c_hi - c_lo
+                    # A block never uploaded (None) holds nothing newer;
+                    # either way the span is now coherent.
+                self._lib.tpurmHbmChipDirtyClear(self.dev, s_lo,
+                                                 s_hi - s_lo)
+            self.readbacks += 1
 
     def _drain(self) -> None:
         # Large receive batches: the producer (fault engine) runs far
@@ -167,6 +261,15 @@ class HbmRuntime:
                         first = cmd.dst // self.block_bytes
                         last = (cmd.dst + cmd.bytes - 1) // self.block_bytes
                         dirty.update(range(int(first), int(last) + 1))
+                    elif cmd.op == OP_HBM_READBACK:
+                        # Engine blocked on chip->host coherence: pull
+                        # the chip-dirty pages into the shadow.  Safe to
+                        # run before this batch's uploads — a mirror for
+                        # the same span can only be queued AFTER the
+                        # requester observes completion (it holds the
+                        # write until the readback returns).
+                        self._readback_merge(int(cmd.dst),
+                                             int(cmd.bytes))
                     # OP_FENCE carries no payload: completing the batch
                     # (below, after uploads) releases its waiters.
                 self._upload_blocks(dirty)
@@ -185,6 +288,8 @@ class HbmRuntime:
         if self._drain_error is not None:
             raise RuntimeError("HBM mirror drain thread died"
                                ) from self._drain_error
+        if self._lib.tpurmHbmMirrorIdle(self.dev):
+            return          # nothing outstanding: skip the round trip
         seq = self._lib.tpurmHbmFence(self.dev)
         st = self._lib.tpurmHbmWaitSeq(self.dev, seq)
         if self._drain_error is not None:
@@ -206,12 +311,15 @@ class HbmRuntime:
     def read_arena(self, offset: int, length: int):
         """On-chip view of arena [offset, offset+length) as uint8.
 
-        Concatenation of the covering blocks, sliced on device — the
-        bytes come from chip HBM, not the shadow."""
+        Fences first so every dirty range published by the engine up to
+        this call is applied, then returns the covering on-chip blocks
+        sliced on device — the bytes come from chip HBM, not the shadow,
+        and include any chip-side writes installed via write_arena."""
         import jax.numpy as jnp
 
         if offset < 0 or offset + length > self.arena_bytes:
             raise ValueError("arena range out of bounds")
+        self.fence()
         first = offset // self.block_bytes
         last = (offset + length - 1) // self.block_bytes
         parts = [self.block(b) for b in range(first, last + 1)]
@@ -219,12 +327,80 @@ class HbmRuntime:
         lo = offset - first * self.block_bytes
         return whole[lo:lo + length]
 
+    def write_arena(self, offset: int, data, sync: bool = True) -> None:
+        """Install a device-computed byte array as the new content of
+        arena [offset, offset+len(data)) — the chip->host direction of
+        the boundary (reference: direction-agnostic CE copies,
+        mem_utils.c:567/ce_utils.c:571).
+
+        ``data`` is a 1-D uint8 array (jax.Array stays on-chip — no
+        host round trip for the install itself).  The span is marked
+        CHIP-DIRTY in the native engine: until downloaded, evictions,
+        CPU-fault service, CXL DMA and RDMA pinning over it block on a
+        READBACK op instead of trusting the shadow.
+
+        sync=True (default) performs that download before returning
+        (ctypes releases the GIL, so the drain thread can serve it);
+        sync=False leaves the window open — engine reads will pull the
+        bytes on demand.  NOTE: with sync=False, OTHER Python threads
+        must not CPU-touch managed pages backed by this span until a
+        sync point — a faulting thread parks holding the GIL, which
+        would starve the drain thread (same class of documented
+        constraint as the reference's fault-service locks)."""
+        import jax
+        import jax.numpy as jnp
+
+        length = int(data.shape[0]) if hasattr(data, "shape") else len(data)
+        if offset < 0 or offset + length > self.arena_bytes:
+            raise ValueError("arena range out of bounds")
+        if length == 0:
+            return
+        # Apply everything the engine published before this install —
+        # otherwise a queued (older) host write could later be uploaded
+        # over the chip bytes without the merge seeing a dirty bit.
+        self.fence()
+        dev_data = jax.device_put(jnp.asarray(data, dtype=jnp.uint8),
+                                  self.device)
+        with self._coh_lock:
+            first = offset // self.block_bytes
+            last = (offset + length - 1) // self.block_bytes
+            pos = 0
+            for b in range(int(first), int(last) + 1):
+                blk_lo = b * self.block_bytes
+                blk_hi = min(blk_lo + self.block_bytes, self.arena_bytes)
+                c_lo = max(offset, blk_lo)
+                c_hi = min(offset + length, blk_hi)
+                piece = jax.lax.slice(dev_data, (pos,),
+                                      (pos + (c_hi - c_lo),))
+                pos += c_hi - c_lo
+                cur = self.block(b)
+                new = jax.lax.dynamic_update_slice(cur, piece,
+                                                   (c_lo - blk_lo,))
+                with self._blocks_lock:
+                    self._blocks[b] = new
+            self._lib.tpurmHbmMarkChipDirty(self.dev, offset, length)
+        # OUTSIDE _coh_lock (the walk takes engine block locks, and an
+        # engine thread may hold one while blocked on a readback that
+        # needs _coh_lock): drop stale CPU/CXL duplicates of managed
+        # pages backed by the span — device write takes exclusivity.
+        self._lib.uvmHbmDeviceWroteRange(self.dev, offset, length)
+        if sync:
+            st = self._lib.tpurmHbmReadback(self.dev, offset, length)
+            if st != 0:
+                raise native.RmError(st, "tpurmHbmReadback")
+
     @property
     def is_real(self) -> bool:
         return bool(self._lib.tpurmDeviceArenaIsReal(self.dev))
 
     def close(self) -> None:
         if self._drain_thread is not None:
+            # fbsr.c save semantics: chip-computed bytes must survive
+            # the runtime detach — download any chip-dirty pages into
+            # the shadow before the arena falls back to FAKE.  Best
+            # effort: a dead drain thread fails the wait fast.
+            if self._drain_error is None:
+                self._lib.tpurmHbmReadback(self.dev, 0, self.arena_bytes)
             self._lib.tpurmDeviceUnregisterHbm(self.dev)
             self._drain_thread.join(timeout=10)
             self._drain_thread = None
