@@ -4,24 +4,38 @@ Metric (BASELINE.json): the fault-heavy oversubscription path — device
 accesses streaming managed memory into HBM at 4x oversubscription, with
 LRU eviction pushing cold blocks out, through the UVM engine's software
 fault loop (native/src/uvm/).  When a real chip is present the device
-arena is registered as REAL (runtime/hbm.py): faulted bytes stream
-through the mirror msgq onto actual chip HBM and the measurement fences
-that stream, so `value` is end-to-end into device memory
-(`arena: "real"`).  vs_baseline is measured against the reference's only
-in-tree bandwidth constant: the CXL link bandwidth its GET_CXL_INFO
-reports, 3,900 MB/s (reference:
-src/nvidia/src/kernel/gpu/bus/kern_bus_ctrl.c:772-775).
+arena is registered as REAL (runtime/hbm.py) and `value` is
+CHIP-VERIFIED bytes/s: exact dirty-range bytes the engine published to
+the mirror stream during the run, all applied to chip HBM before the
+closing fence (`arena: "real"`).  Bytes the engine deduped, coalesced
+or clean-dropped never cross and are not counted; overflow whole-arena
+resyncs are accounted separately (`resync_mb`) and never inflate the
+numerator — so `value` cannot exceed the transport ceiling (VERDICT r3
+weak #1).  vs_baseline is measured against the reference's only in-tree
+bandwidth constant: the CXL link bandwidth its GET_CXL_INFO reports,
+3,900 MB/s (reference: src/nvidia/src/kernel/gpu/bus/kern_bus_ctrl.c:
+772-775).
 
-Extra fields (recorded for trend + the round-3 additions):
+Extra fields (recorded for trend):
   arena                    — real|fake backing of the metric of record
+  engine_gbps              — engine-side pipeline throughput (bytes the
+                             fault+evict machinery moved per second,
+                             including traffic it proved skippable —
+                             the r3 headline, now secondary)
   oversub_fake_gbps        — same bench against the host-only arena
-  chip_upload_ceiling_gbps — raw device_put bandwidth measured idle (the
-                             transport ceiling the real-arena number is
-                             bound by)
+  chip_upload_ceiling_gbps — raw device_put bandwidth measured idle
   loaded_ceiling_gbps      — the same probe measured while the workload
                              pool is alive (this environment's relay
                              slows with process RSS, so this is the fair
                              ceiling for the mirror stream)
+  in_hbm_copy_gbps         — on-chip d2d copy bandwidth (north-star
+                             denominator, BASELINE.md)
+  north_star_ratio         — value / in_hbm_copy_gbps (BASELINE.md
+                             definition: fault-path bw as a fraction of
+                             in-HBM bw at 4x oversubscription)
+  transport_efficiency     — value / loaded_ceiling_gbps (the fair
+                             ratio on a relay-attached chip, where the
+                             transport, not the engine, binds)
   fault_p50_us/fault_p95_us— fault service latency (north star: µs-scale)
   mfu_flash_prefill        — flash-attention prefill MFU on the chip
   flash_tflops             — achieved TFLOP/s for the same kernel
@@ -93,6 +107,7 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
                 b.view()[:] = 0xA5          # populate host tier
 
             before = uvm.fault_stats()
+            published0 = lib.tpurmCounterGet(b"hbm_mirror_bytes")
             t0 = time.perf_counter()
             # Two passes: pass 1 is cold faults, pass 2 re-faults evicted
             # slices — the steady-state fault+evict pipeline.
@@ -111,8 +126,28 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
                 "evictions": after.evictions - before.evictions,
                 "oversub_bytes": total,
             }
+            crossed = 0
             if rt is not None:
-                extra["mirror_mb"] = round(rt.mirrored_bytes / 1e6, 1)
+                # CHIP-VERIFIED numerator: bytes that PHYSICALLY crossed
+                # to chip HBM for this workload — consumer block uploads
+                # minus whole-arena overflow resyncs.  Dirty ranges the
+                # consumer coalesced (a block re-dirtied 8x uploads
+                # once) are counted ONCE; bytes the engine deduped or
+                # clean-dropped never cross and are never counted.  By
+                # construction this cannot exceed what the transport
+                # moved in dt.  (VERDICT r3 weak #1: the r3 headline
+                # counted all oversub bytes, 4x what crossed.)
+                crossed = rt.mirrored_bytes - rt.resync_bytes
+                published = (lib.tpurmCounterGet(b"hbm_mirror_bytes") -
+                             published0)
+                extra["chip_verified_mb"] = round(crossed / 1e6, 1)
+                extra["published_dirty_mb"] = round(published / 1e6, 1)
+                extra["resync_mb"] = round(rt.resync_bytes / 1e6, 1)
+                # Engine-side throughput (bytes the fault+evict pipeline
+                # moved per second, including traffic it proved
+                # skippable or coalescible) — the r3 headline, now
+                # secondary.
+                extra["engine_gbps"] = round(total / dt / 1e9, 3)
                 # Transport ceiling UNDER WORKLOAD CONDITIONS: this
                 # environment's relay slows markedly with process RSS,
                 # so the fair ceiling is measured while the managed pool
@@ -124,7 +159,13 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
                     pass
             for b in bufs:
                 b.free()
-            return total / dt, extra
+            # Metric of record: chip-verified bytes/s for the real
+            # arena (cannot exceed the transport ceiling — every
+            # counted byte crossed device_put within dt); engine
+            # throughput for the fake arena (no chip to verify
+            # against).
+            bps = (crossed / dt) if rt is not None else (total / dt)
+            return bps, extra
     finally:
         if rt is not None:
             rt.close()
@@ -150,6 +191,57 @@ def measure_jax_transfer_gbps(total_mib: int = 128, block_mib: int = 1,
         del outs
         best = max(best, nblocks * block_bytes / dt)
     return best / 1e9
+
+
+def measure_in_hbm_copy_gbps(mib: int = 256, iters: int = 4) -> float:
+    """On-chip HBM copy bandwidth (device-to-device, no host transport):
+    the denominator of BASELINE.md's north star (fault-path bandwidth as
+    a fraction of in-HBM bandwidth).  A jitted elementwise pass reads
+    and writes every byte once (2x traffic).  Timed differentially: the
+    relay's block_until_ready does not serialize execution, so a chain
+    of N vs 2N data-dependent kernels isolates per-kernel time from the
+    constant round-trip latency."""
+    import jax
+    import jax.numpy as jnp
+
+    import statistics
+
+    del iters
+    dev = jax.devices()[0]
+    n = mib * MB
+    # int32 counters so the +1 chain NEVER revisits a value, and each
+    # chain resumes where the last ended: the relay caches repeated
+    # executions (an alternating xor chain measures cache hits at
+    # impossible TB/s), so no (kernel, input-value) pair may ever recur
+    # across the whole measurement.
+    x = jax.device_put(jnp.zeros((n // 4,), jnp.int32), dev)
+    step = jax.jit(lambda a: a + 1)
+    x = step(x)
+    float(x[0])                                 # compile + force
+    state = {"x": x}
+
+    def chain(k: int) -> float:
+        cur = state["x"]
+        t0 = time.perf_counter()
+        for _ in range(k):
+            cur = step(cur)
+        float(cur[0])
+        dt = time.perf_counter() - t0
+        state["x"] = cur                        # never replay a value
+        return dt
+
+    chain(1)
+    # 128-kernel differential: per-kernel time is well under a
+    # millisecond, so the chain difference must dwarf the ~100 ms
+    # round-trip jitter; median of 3 resists outliers.
+    vals = []
+    for _ in range(3):
+        t_n = min(chain(64) for _ in range(2))
+        t_2n = min(chain(192) for _ in range(2))
+        dt = (t_2n - t_n) / 128
+        if dt > 0:
+            vals.append(2.0 * n / dt)
+    return statistics.median(vals) / 1e9 if vals else 0.0
 
 
 def measure_flash_mfu(batch: int = 8, seq: int = 4096, heads: int = 16,
@@ -304,6 +396,24 @@ def main() -> None:
             extra["chip_upload_ceiling_gbps"] = round(ceiling, 3)
         except Exception:
             pass
+        if on_tpu and extra.get("arena") == "real":
+            try:
+                in_hbm = measure_in_hbm_copy_gbps()
+                if in_hbm > 0:
+                    extra["in_hbm_copy_gbps"] = round(in_hbm, 1)
+                    # BASELINE.md north star: fault-path bandwidth /
+                    # in-HBM bandwidth at 4x oversubscription.  On this
+                    # relay-attached chip the transport ceiling (not the
+                    # engine) binds the numerator, so the transport
+                    # efficiency is reported alongside for the fair
+                    # local comparison.
+                    extra["north_star_ratio"] = round(
+                        bps / 1e9 / in_hbm, 5)
+            except Exception:
+                pass
+            if extra.get("loaded_ceiling_gbps"):
+                extra["transport_efficiency"] = round(
+                    bps / 1e9 / extra["loaded_ceiling_gbps"], 3)
         if on_tpu:
             try:
                 extra.update(measure_flash_mfu())

@@ -118,6 +118,7 @@ int       tpurmHbmChipDirtyNextSpan(uint32_t inst, uint64_t off,
                                     uint64_t *hi);
 void      tpurmHbmChipDirtyClear(uint32_t inst, uint64_t off,
                                  uint64_t bytes);
+uint64_t  tpurmHbmChipDirtyGranule(void);
 /* Blocking: submit a READBACK for [off, off+bytes) and wait until the
  * consumer has made the shadow coherent.  TPU_OK immediately when the
  * arena is fake or the span has no chip-dirty pages. */

@@ -226,8 +226,21 @@ int tpurmHbmMirrorIdle(uint32_t inst)
     if (!dev || !dev->mirrorq ||
         !atomic_load_explicit(&dev->arenaReal, memory_order_acquire))
         return 1;
+    /* A latched overflow means a dropped notify is awaiting the
+     * whole-arena resync the next consumer batch performs — the stream
+     * is NOT coherent even if every queued command completed, and the
+     * fence this fast path would skip is what wakes the consumer. */
+    if (atomic_load_explicit(&dev->mirrorOverflow, memory_order_acquire))
+        return 0;
     return tpuMsgqCompletedSeq(dev->mirrorq) >=
            tpuMsgqSubmittedSeq(dev->mirrorq);
+}
+
+/* Granularity of the chip-dirty bitmap, exported so the consumer never
+ * hardcodes a mismatching value (silent tracking loss otherwise). */
+uint64_t tpurmHbmChipDirtyGranule(void)
+{
+    return CHIP_DIRTY_PAGE;
 }
 
 TpuStatus tpurmHbmWaitSeq(uint32_t inst, uint64_t seq)

@@ -88,6 +88,9 @@ def _lib() -> ctypes.CDLL:
         lib.uvmHbmDeviceWroteRange.restype = u64
         lib.tpurmHbmMirrorIdle.argtypes = [u32]
         lib.tpurmHbmMirrorIdle.restype = ctypes.c_int
+        lib.tpurmHbmChipDirtyGranule.argtypes = []
+        lib.tpurmHbmChipDirtyGranule.restype = u64
+        lib.tpuHbmMirrorNotify.argtypes = [ctypes.c_void_p, u64]
         _hbm_bound = True
     return lib
 
@@ -111,8 +114,10 @@ class HbmRuntime:
 
         base, size = native.hbm_view(dev)
         self.arena_bytes = size
+        self._base = base
         self._shadow = np.frombuffer(
             (ctypes.c_char * size).from_address(base), dtype=np.uint8)
+        self._granule = int(self._lib.tpurmHbmChipDirtyGranule())
         self.n_blocks = math.ceil(size / block_bytes)
         # None = never dirtied; materialized lazily from the shadow.
         self._blocks: List[Optional[object]] = [None] * self.n_blocks
@@ -123,6 +128,7 @@ class HbmRuntime:
         # write. RLock: block() -> _upload_blocks nests under callers.
         self._coh_lock = threading.RLock()
         self.mirrored_bytes = 0
+        self.resync_bytes = 0    # whole-arena resync uploads (overflow)
         self.resyncs = 0
         self.drain_batches = 0
         self.upload_calls = 0
@@ -182,11 +188,11 @@ class HbmRuntime:
         import jax
 
         u64 = ctypes.c_uint64
-        # Round the request out to dirty-granule (4 KB) boundaries: the
-        # native clear below is granule-granular, so merging only a
-        # byte sub-range of a granule would clear its bit while leaving
+        # Round the request out to dirty-granule boundaries: the native
+        # clear below is granule-granular, so merging only a byte
+        # sub-range of a granule would clear its bit while leaving
         # chip-newer bytes outside the sub-range untracked (data loss).
-        gran = 4096
+        gran = self._granule
         start = (offset // gran) * gran
         end = min(-(-(offset + length) // gran) * gran, self.arena_bytes)
         spans: List[tuple] = []
@@ -252,8 +258,12 @@ class HbmRuntime:
                 if self._lib.tpurmHbmMirrorConsumeOverflow(self.dev):
                     # A notify was dropped: everything is suspect.
                     # Resync the whole arena from the coherent shadow.
+                    # Account these bytes separately — they must not
+                    # inflate workload-throughput numerators.
                     self.resyncs += 1
+                    pre = self.mirrored_bytes
                     self._upload_blocks(range(self.n_blocks))
+                    self.resync_bytes += self.mirrored_bytes - pre
                 dirty = set()
                 for i in range(n):
                     cmd = buf[i]
@@ -361,31 +371,61 @@ class HbmRuntime:
         self.fence()
         dev_data = jax.device_put(jnp.asarray(data, dtype=jnp.uint8),
                                   self.device)
-        with self._coh_lock:
-            first = offset // self.block_bytes
-            last = (offset + length - 1) // self.block_bytes
-            pos = 0
-            for b in range(int(first), int(last) + 1):
-                blk_lo = b * self.block_bytes
-                blk_hi = min(blk_lo + self.block_bytes, self.arena_bytes)
-                c_lo = max(offset, blk_lo)
-                c_hi = min(offset + length, blk_hi)
-                piece = jax.lax.slice(dev_data, (pos,),
-                                      (pos + (c_hi - c_lo),))
-                pos += c_hi - c_lo
-                cur = self.block(b)
-                new = jax.lax.dynamic_update_slice(cur, piece,
-                                                   (c_lo - blk_lo,))
-                with self._blocks_lock:
-                    self._blocks[b] = new
-            self._lib.tpurmHbmMarkChipDirty(self.dev, offset, length)
+        # Chip-dirty marking must never cover bytes the device did NOT
+        # write: the bitmap is granule-granular, and a whole-granule
+        # mark over a partial write would let a later merge revert a
+        # concurrent engine write elsewhere in the same granule.  So the
+        # granule-ALIGNED interior is installed device-side and marked,
+        # while partial boundary granules take the host path (one small
+        # device_get) — shadow write + mirror notify, immediately
+        # authoritative.
+        gran = self._granule
+        end = offset + length
+        a_lo = min(-(-offset // gran) * gran, end)
+        a_hi = max((end // gran) * gran, a_lo)
+        if a_hi > a_lo:
+            with self._coh_lock:
+                first = a_lo // self.block_bytes
+                last = (a_hi - 1) // self.block_bytes
+                for b in range(int(first), int(last) + 1):
+                    blk_lo = b * self.block_bytes
+                    blk_hi = min(blk_lo + self.block_bytes,
+                                 self.arena_bytes)
+                    c_lo = max(a_lo, blk_lo)
+                    c_hi = min(a_hi, blk_hi)
+                    pos = c_lo - offset
+                    piece = jax.lax.slice(dev_data, (pos,),
+                                          (pos + (c_hi - c_lo),))
+                    cur = self.block(b)
+                    new = jax.lax.dynamic_update_slice(cur, piece,
+                                                       (c_lo - blk_lo,))
+                    with self._blocks_lock:
+                        self._blocks[b] = new
+                self._lib.tpurmHbmMarkChipDirty(self.dev, a_lo,
+                                                a_hi - a_lo)
+        for s_lo, s_hi in ((offset, a_lo), (a_hi, end)):
+            if s_lo >= s_hi:
+                continue
+            # If a previous device write left this granule chip-dirty,
+            # download it first (executor-style dst coherence) so the
+            # shadow write + republish can't revert those bytes.
+            g_lo = (s_lo // gran) * gran
+            g_hi = min(-(-s_hi // gran) * gran, self.arena_bytes)
+            if self._lib.tpurmHbmChipDirtyTest(self.dev, g_lo,
+                                               g_hi - g_lo):
+                self._lib.tpurmHbmReadback(self.dev, g_lo, g_hi - g_lo)
+            host = np.asarray(jax.device_get(
+                jax.lax.slice(dev_data, (s_lo - offset,),
+                              (s_hi - offset,))))
+            self._shadow[s_lo:s_hi] = host
+            self._lib.tpuHbmMirrorNotify(self._base + s_lo, s_hi - s_lo)
         # OUTSIDE _coh_lock (the walk takes engine block locks, and an
         # engine thread may hold one while blocked on a readback that
         # needs _coh_lock): drop stale CPU/CXL duplicates of managed
         # pages backed by the span — device write takes exclusivity.
         self._lib.uvmHbmDeviceWroteRange(self.dev, offset, length)
-        if sync:
-            st = self._lib.tpurmHbmReadback(self.dev, offset, length)
+        if sync and a_hi > a_lo:
+            st = self._lib.tpurmHbmReadback(self.dev, a_lo, a_hi - a_lo)
             if st != 0:
                 raise native.RmError(st, "tpurmHbmReadback")
 

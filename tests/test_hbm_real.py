@@ -256,21 +256,35 @@ def test_write_arena_partial_block_and_close_merge():
                                            ctypes.c_uint64]
         lib.tpuHbmMirrorNotify(base, 8192)
         rt.fence()
-        # Unaligned 1000-byte install at offset 100.
+        # Unaligned 1000-byte install at offset 100: entirely inside one
+        # dirty granule, so it takes the HOST path (shadow write +
+        # republish) — chip-dirty marking must never cover bytes the
+        # device did not write, or a later merge could revert a
+        # concurrent engine write elsewhere in the granule.
         rt.write_arena(100, jnp.full((1000,), 99, jnp.uint8), sync=False)
+        assert lib.tpurmHbmChipDirtyTest(0, 100, 1000) == 0
+        shadow2 = np.frombuffer((ctypes.c_char * size).from_address(base),
+                                dtype=np.uint8)
+        assert shadow2[100] == 99 and shadow2[1099] == 99
+        assert shadow2[99] == 17 and shadow2[1100] == 17
         arr = np.asarray(jax.device_get(rt.read_arena(0, 2048)))
         assert arr[99] == 17 and arr[100] == 99
         assert arr[1099] == 99 and arr[1100] == 17
-        assert lib.tpurmHbmChipDirtyTest(0, 100, 1000) == 1
+        # A granule-ALIGNED install stays device-side and marks.
+        gran = int(lib.tpurmHbmChipDirtyGranule())
+        rt.write_arena(gran, jnp.full((gran,), 55, jnp.uint8), sync=False)
+        assert lib.tpurmHbmChipDirtyTest(0, gran, gran) == 1
     finally:
         rt.close()
     # close() merged the chip bytes into the shadow and cleared bits.
-    assert lib.tpurmHbmChipDirtyTest(0, 100, 1000) == 0
+    gran = int(lib.tpurmHbmChipDirtyGranule())
+    assert lib.tpurmHbmChipDirtyTest(0, gran, gran) == 0
     base, size = native.hbm_view(0)
     shadow = np.frombuffer((ctypes.c_char * size).from_address(base),
                            dtype=np.uint8)
     assert shadow[100] == 99 and shadow[1099] == 99
     assert shadow[99] == 17 and shadow[1100] == 17
+    assert shadow[gran] == 55 and shadow[2 * gran - 1] == 55
 
 
 def test_partial_readback_keeps_granule_tracking():
