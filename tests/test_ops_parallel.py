@@ -64,6 +64,22 @@ class TestFlashAttention:
         np.testing.assert_allclose(out.astype(jnp.float32),
                                    ref.astype(jnp.float32), atol=3e-2)
 
+    @pytest.mark.parametrize("impl", ["grid", "rows"])
+    def test_impls_match_reference(self, impl):
+        """Both kernel variants (3-D grid with revolver k map; 2-D grid
+        with the in-kernel k fori_loop) against the dense reference —
+        `impl` is a public knob, and whichever is not the default would
+        otherwise ship untested."""
+        q, k, v = _qkv(jax.random.key(9), 2, 96, 2, 32)
+        ref = attention(q, k, v, causal_mask(96, 96))
+        out = flash_attention(q, k, v, causal=True, blk_q=32, blk_k=32,
+                              impl=impl)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+        ref = attention(q, k, v, None)
+        out = flash_attention(q, k, v, causal=False, blk_q=32, blk_k=32,
+                              impl=impl)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
 
 class TestPagedAttention:
     def test_matches_dense_decode(self):
@@ -146,3 +162,16 @@ class TestMeshAndRing:
             logits = jax.jit(lambda p, t: llama.forward(cfg, p, t))(
                 sharded, tokens)
         assert logits.shape == (2, 16, cfg.vocab_size)
+
+
+class TestFlashLayouts:
+    def test_bhsd_layout_matches_bshd(self):
+        """Head-major inputs (layout="bhsd") skip the transpose copies
+        but must produce the transposed same result."""
+        q, k, v = _qkv(jax.random.key(11), 2, 96, 2, 32)
+        ref = flash_attention(q, k, v, causal=True, blk_q=32, blk_k=32)
+        qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out = flash_attention(qh, kh, vh, causal=True, blk_q=32, blk_k=32,
+                              layout="bhsd")
+        np.testing.assert_allclose(out, ref.transpose(0, 2, 1, 3),
+                                   atol=2e-5)
