@@ -245,56 +245,148 @@ def measure_in_hbm_copy_gbps(mib: int = 256, iters: int = 4) -> float:
 
 
 def measure_flash_mfu(batch: int = 8, seq: int = 4096, heads: int = 16,
-                      head_dim: int = 128, iters: int = 5) -> dict:
-    """Causal flash-attention prefill MFU on the chip (bf16, MXU path)."""
+                      head_dim: int = 128) -> dict:
+    """Causal flash-attention prefill MFU on the chip (bf16, MXU path).
+
+    Inputs are head-major (layout="bhsd"): in a full model the
+    projection matmuls fuse the [B,S,H,D]->[B,H,S,D] layout change, so
+    the isolated kernel is measured without the four explicit transpose
+    copies the standalone [B,S,H,D] entry would add (~1 GB of HBM
+    traffic at this shape)."""
     import jax
     import jax.numpy as jnp
     from open_gpu_kernel_modules_tpu.ops import flash_attention
 
     dev = jax.devices()[0]
     key = jax.random.key(0)
-    shape = (batch, seq, heads, head_dim)
+    shape = (batch, heads, seq, head_dim)
     q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16)
                for kk in jax.random.split(key, 3))
-    out = flash_attention(q, k, v, causal=True)
+
+    def f(x):
+        return flash_attention(x, k, v, causal=True, layout="bhsd")
+
+    out = f(q)
     float(out[0, 0, 0, 0])                      # compile + force
 
     # The relay transport's block_until_ready does not serialize device
-    # execution, and a device_get costs a ~100 ms round trip.  Measure
-    # DIFFERENTIALLY: time a data-dependent chain of N and of 2N kernels
-    # (each forced by a scalar device_get) — the difference isolates N
-    # executions with the constant round-trip latency subtracted.
+    # execution, and a device_get costs a ~100+ ms round trip.  Measure
+    # DIFFERENTIALLY with LONG chains: time a data-dependent chain of N
+    # and of 3N kernels (each forced by a scalar device_get) — the
+    # difference isolates 2N executions with the round-trip latency
+    # subtracted, and chains of 32/96 kernels (multi-hundred-ms spans)
+    # dwarf the relay's tens-of-ms jitter that made short chains report
+    # anywhere between 0.5x and 2x the true rate.
     def chain(n: int) -> float:
         cur = q
         t0 = time.perf_counter()
         for _ in range(n):
-            cur = flash_attention(cur, k, v, causal=True)
+            cur = f(cur)
         float(cur[0, 0, 0, 0])                  # force execution
         return time.perf_counter() - t0
 
-    chain(1)                                    # warm dispatch path
+    chain(2)                                    # warm dispatch path
     peak = _chip_peak_flops(dev)
     # Causal attention math: QK^T and PV are each 2*b*h*s^2*d MACs ->
     # 4*b*h*s^2*d FLOPs, halved by causal masking.
     flops_total = 4.0 * batch * heads * seq * seq * head_dim * 0.5
-    dt = None
-    for _ in range(3):
-        t_n = min(chain(iters) for _ in range(2))
-        t_2n = min(chain(2 * iters) for _ in range(2))
-        cand = (t_2n - t_n) / iters
-        # Demand clear signal: the N extra kernels must dominate the
-        # jitter (>=15% over the shorter chain) and the implied rate
-        # must be physically possible — otherwise retry.
-        if t_2n >= 1.15 * t_n and cand > 0 and flops_total / cand <= peak:
-            dt = cand
-            break
-    if dt is None:
+    import statistics
+    vals = []
+    for _ in range(2):
+        t_n = min(chain(32) for _ in range(2))
+        t_3n = min(chain(96) for _ in range(2))
+        cand = (t_3n - t_n) / 64
+        if cand > 0 and flops_total / cand <= peak:
+            vals.append(cand)
+    if not vals:
         return {}           # jitter swamped the signal: report nothing
+    dt = statistics.median(vals)
 
     achieved = flops_total / dt
     return {
         "flash_tflops": round(achieved / 1e12, 2),
         "mfu_flash_prefill": round(achieved / peak, 4),
+    }
+
+
+# Public per-chip HBM bandwidth by device kind (decode-attention
+# utilization denominator).
+HBM_BW_BYTES_PER_S = (
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v6 lite", 1640e9),
+    ("v6e", 1640e9),
+    ("v4", 1228e9),
+)
+
+
+def _chip_hbm_bw(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, bw in HBM_BW_BYTES_PER_S:
+        if key in kind:
+            return bw
+    return 819e9
+
+
+def measure_paged_decode_bw(batch: int = 8, pages_per_seq: int = 64,
+                            page: int = 64, kv_heads: int = 16,
+                            heads: int = 16, head_dim: int = 128) -> dict:
+    """Decode paged-attention HBM-bandwidth utilization: single-token
+    decode streams the whole gathered KV once, so achieved bytes/s over
+    the chip's HBM bandwidth is the decode-attention efficiency number
+    (decode is bandwidth-bound, not FLOPs-bound)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from open_gpu_kernel_modules_tpu.ops import paged_attention
+
+    dev = jax.devices()[0]
+    n = batch * pages_per_seq
+    key = jax.random.key(0)
+    kk, kv_, kq = jax.random.split(key, 3)
+    k_pages = jax.random.normal(kk, (n, page, kv_heads, head_dim),
+                                jnp.bfloat16)
+    v_pages = jax.random.normal(kv_, (n, page, kv_heads, head_dim),
+                                jnp.bfloat16)
+    table = jnp.asarray(np.arange(n, dtype=np.int32).reshape(batch,
+                                                       pages_per_seq))
+    seq_lens = jnp.full((batch,), pages_per_seq * page, jnp.int32)
+    q0 = jax.random.normal(kq, (batch, heads, head_dim), jnp.bfloat16)
+
+    def step(q):
+        out = paged_attention(q, k_pages, v_pages, table, seq_lens, heads)
+        return out.astype(jnp.bfloat16)
+
+    cur = step(q0)
+    float(cur[0, 0, 0])
+
+    def chain(m: int) -> float:
+        cur = q0
+        t0 = time.perf_counter()
+        for _ in range(m):
+            cur = step(cur)
+        float(cur[0, 0, 0])
+        return time.perf_counter() - t0
+
+    chain(2)
+    import statistics
+    bytes_per_call = 2 * batch * pages_per_seq * page * kv_heads * \
+        head_dim * 2
+    vals = []
+    for _ in range(2):
+        t_n = min(chain(8) for _ in range(2))
+        t_3n = min(chain(24) for _ in range(2))
+        cand = (t_3n - t_n) / 16
+        if cand > 0:
+            vals.append(cand)
+    if not vals:
+        return {}
+    dt = statistics.median(vals)
+    bw = bytes_per_call / dt
+    return {
+        "paged_decode_gbps": round(bw / 1e9, 1),
+        "paged_decode_hbm_util": round(bw / _chip_hbm_bw(dev), 4),
     }
 
 
@@ -308,39 +400,63 @@ def measure_tokens_per_s() -> dict:
     cfg = llama.LlamaConfig(
         vocab_size=8192, hidden_size=512, intermediate_size=1536,
         num_layers=4, num_heads=8, num_kv_heads=8, head_dim=64,
-        max_seq_len=1024)
+        max_seq_len=2048)
     params = llama.init_params(cfg, jax.random.key(0))
 
-    batch, prompt_len, page = 8, 96, 64
+    # Config #4's shape at serving scale: LONG sequences over a logical
+    # pool 4x the device slot pool (256 pages vs 64 slots + a fixed
+    # 16-entry victim ring), two groups round-robining through the
+    # device pool so every turn faults pages through the UVM backing.
+    # 48 tokens per activation: serving amortizes page movement over a
+    # decode span, the way the reference amortizes migration over the
+    # accesses that follow it.
+    batch, prompt_len, page, max_len = 8, 704, 64, 2048
     groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
     prompts = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
                                  cfg.vocab_size)
 
-    def run(oversub: int) -> tuple[float, dict]:
-        cache = serving.TieredKVCache(cfg, batch=batch, max_len=512,
+    def run(oversub: int) -> tuple[float, dict, object]:
+        cache = serving.TieredKVCache(cfg, batch=batch, max_len=max_len,
                                       page_size=page, oversub=oversub)
         try:
             for g in groups:
                 serving.prefill_group(cfg, params, cache, g,
                                       prompts[np.array(g)])
-            # Warm the decode path (same scan length, so the timed
-            # region never compiles) outside the timed region.
+            # Warm-up IDENTICAL to the timed region (same turn count,
+            # same schedule): victim save/restore, upload scatters and
+            # the decode scan each compile remotely (~1 s per variant,
+            # and input LAYOUT changes can key fresh variants), so the
+            # timed region must replay a fully-compiled sequence.
             serving.decode_rounds(cfg, params, cache, groups,
-                                  tokens_per_turn=16, turns=1)
+                                  tokens_per_turn=48, turns=2)
             total, dt = serving.decode_rounds(cfg, params, cache, groups,
-                                              tokens_per_turn=16, turns=4)
-            return total / dt, dict(cache.stats)
+                                              tokens_per_turn=48, turns=2)
+            return total / dt, dict(cache.stats), cache
         finally:
             cache.close()
 
-    dense_tps, _ = run(oversub=1)
-    tiered_tps, tstats = run(oversub=4)
+    dense_tps, _, _ = run(oversub=1)
+    tiered_tps, tstats, tcache = run(oversub=4)
+    # The relay slows as process RSS grows, so a single dense run can
+    # land in a different transport regime than the tiered run that
+    # follows it.  Re-measure dense AFTER tiered and take the best —
+    # the ratio must compare like with like.
+    dense2_tps, _, _ = run(oversub=1)
+    dense_tps = max(dense_tps, dense2_tps)
     return {
         "dense_toks_per_s": round(dense_tps, 1),
         "tiered_toks_per_s": round(tiered_tps, 1),
         "tiered_vs_dense": round(tiered_tps / dense_tps, 3)
         if dense_tps else 0.0,
         "tiered_page_uploads": tstats["uploads"],
+        "tiered_prefetched": tstats["prefetched_uploads"],
+        "tiered_sync_flushes": tstats["sync_flushes"],
+        "tiered_drains": tstats["drains"],
+        "tiered_victim_restores": tstats["victim_restores"],
+        # Footprint honesty: device-resident pages (slots + victim
+        # ring) vs the logical pool.
+        "tiered_device_pages": tcache.n_slots + tcache.victim_entries,
+        "tiered_logical_pages": tcache.total_pages,
     }
 
 
@@ -417,6 +533,10 @@ def main() -> None:
         if on_tpu:
             try:
                 extra.update(measure_flash_mfu())
+            except Exception:
+                pass
+            try:
+                extra.update(measure_paged_decode_bw())
             except Exception:
                 pass
         try:

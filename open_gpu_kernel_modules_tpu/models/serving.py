@@ -235,11 +235,52 @@ def _gather_pages(pool: jax.Array, idx: jax.Array) -> jax.Array:
     return pool[:, idx]
 
 
+@partial(jax.jit, donate_argnums=(2, 3))
+def _victim_save(k_slots: jax.Array, v_slots: jax.Array,
+                 vic_k: jax.Array, vic_v: jax.Array,
+                 slot_idx: jax.Array, vic_idx: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Copy evicted slots' pages into victim-ring entries (device-side).
+    slot_idx/vic_idx are FIXED length (padded with repeats), so this
+    compiles exactly once per pool shape — a fresh shape key per
+    eviction epoch would trigger a remote compile mid-decode."""
+    return (vic_k.at[:, vic_idx].set(k_slots[:, slot_idx]),
+            vic_v.at[:, vic_idx].set(v_slots[:, slot_idx]))
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _victim_restore(k_slots: jax.Array, v_slots: jax.Array,
+                    vic_k: jax.Array, vic_v: jax.Array,
+                    vic_idx: jax.Array, dest_slots: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Copy victim-ring entries back into slots (fixed-length indices,
+    one compile)."""
+    return (k_slots.at[:, dest_slots].set(vic_k[:, vic_idx]),
+            v_slots.at[:, dest_slots].set(vic_v[:, vic_idx]))
+
+
 def _pad_pow2(n: int) -> int:
     p = 1
     while p < n:
         p <<= 1
     return p
+
+
+@dataclasses.dataclass(frozen=True)
+class StagedActivation:
+    """In-flight activation state from TieredKVCache.prefetch: the page
+    list it staged and the device-resident (possibly still streaming)
+    padded upload chunks."""
+    pages: Tuple[int, ...]
+    k_dev: Optional[jax.Array]
+    v_dev: Optional[jax.Array]
+    pad: int
+    # Drain epoch at read time: a drain between prefetch and commit
+    # folds parked deltas into the backing, making these (pre-drain)
+    # base bytes unusable — the commit re-reads instead.
+    epoch: int = 0
+
+
 
 
 class ManagedKVBacking:
@@ -384,13 +425,40 @@ class TieredKVCache:
         self._active_slots: set = set()
         self.seq_lens = np.zeros((batch,), np.int32)
         self.last_token = np.zeros((batch,), np.int32)
-        # Slots whose device copy diverged from the backing (a decode
-        # WROTE into them).  Clean evictions skip the device readback
-        # entirely — attention only reads KV, so most evicted pages are
-        # clean and their backing copy is already current.
+        # Slots a decode WROTE since their last upload/restore.
+        # Attention only reads KV, so most slots stay clean and evict
+        # as free drops; dirty slots' pages must be preserved.
         self._dirty_slots: set = set()
+        # Victim ring: evicted DIRTY pages are copied (device-side,
+        # _victim_save) into a FIXED-shape ring of n_slots page
+        # records instead of being read back to the host — a
+        # device->host readback costs a full transport round trip per
+        # eviction epoch on a relay-attached chip.  A re-activated
+        # page restores from its ring entry (_victim_restore) and the
+        # entry recycles, so in steady state the ring never fills and
+        # nothing crosses to the host.  Ring entries materialize into
+        # the backing only at drain points (host view reads, close,
+        # ring pressure at prefetch).  Reference analog: pipelined
+        # migration copies that complete under later work
+        # (uvm_migrate.c:555); the fixed shape keeps the save/restore
+        # kernels at ONE compile each (a fresh shape key per epoch
+        # would remote-compile mid-decode).
+        # A FIXED, small ring (16 entries) regardless of pool scale:
+        # it is a write-back buffer for the recently-written eviction
+        # tail, not a second cache tier — at serving scale it is a few
+        # percent of the slot pool, keeping the oversubscription claim
+        # real.
+        self.victim_entries = min(self.n_slots, 16)
+        vic_shape = (cfg.num_layers, self.victim_entries) + self.page_shape
+        self._victim_k = jnp.zeros(vic_shape, cfg.dtype)
+        self._victim_v = jnp.zeros(vic_shape, cfg.dtype)
+        self._victim_map: Dict[int, int] = {}    # page -> ring entry
+        self._victim_free: List[int] = list(range(self.victim_entries))
+        self._drain_epoch = 0
         self.stats = {"uploads": 0, "flushes": 0, "clean_drops": 0,
-                      "upload_bytes": 0, "activations": 0}
+                      "upload_bytes": 0, "activations": 0,
+                      "prefetched_uploads": 0, "victim_restores": 0,
+                      "sync_flushes": 0, "drains": 0}
 
     # ------------------------------------------------------------ views
     # (available only on backings that expose a host view — the managed
@@ -398,16 +466,20 @@ class TieredKVCache:
 
     @property
     def k_buf(self):
+        self.drain_flushes()
         return self.backing.k_buf
 
     @property
     def v_buf(self):
+        self.drain_flushes()
         return self.backing.v_buf
 
     def k_view(self) -> np.ndarray:
+        self.drain_flushes()
         return self.backing.k_view()
 
     def v_view(self) -> np.ndarray:
+        self.drain_flushes()
         return self.backing.v_view()
 
     # ----------------------------------------------------- slot machine
@@ -417,11 +489,11 @@ class TieredKVCache:
         self._lru[slot] = None          # reinsert at warm end
 
     def _flush_slots(self, slots: List[int]) -> None:
-        """Write evicted DIRTY slots' pages back to the backing; CLEAN
-        slots (device copy never written since upload) just drop — the
-        backing is already current, so no device readback is needed.
-        Attention only reads KV, so most evicted pages are clean and
-        skip the transport round trip entirely."""
+        """Evict slots: CLEAN slots (device copy never written since
+        upload/restore) just drop — the backing or a victim entry
+        already reconstructs them.  DIRTY slots' pages are copied into
+        victim-ring entries with ONE fixed-shape device op; no
+        device->host transfer happens here."""
         if not slots:
             return
         dirty = [s for s in slots if s in self._dirty_slots]
@@ -433,45 +505,202 @@ class TieredKVCache:
         self.stats["clean_drops"] += len(slots) - len(dirty)
         if not dirty:
             return
-        idx = np.array(dirty, np.int32)
-        pad = _pad_pow2(len(dirty))
-        if pad != len(dirty):
-            idx = np.concatenate([idx, np.full(pad - len(dirty), idx[-1],
-                                               np.int32)])
-        k_chunks = np.asarray(_gather_pages(self.k_slots, jnp.asarray(idx)))
-        v_chunks = np.asarray(_gather_pages(self.v_slots, jnp.asarray(idx)))
-        for i, s in enumerate(dirty):
+        evicting = set(dirty)
+        saves: List[Tuple[int, int]] = []      # (slot, entry)
+        spill: List[int] = []
+        for s in dirty:
             page = int(self.slot_owner[s])
-            self.backing.write_page(page, k_chunks[:, i], v_chunks[:, i])
+            e = self._victim_map.get(page)
+            if e is None:
+                e = self._alloc_victim_entry(evicting)
+            if e is None:
+                spill.append(s)
+                continue
+            self._victim_map[page] = e
+            saves.append((s, e))
             self.slot_of[page] = -1
             self.slot_owner[s] = -1
             self._dirty_slots.discard(s)
-        self.stats["flushes"] += len(dirty)
+        if spill:
+            # Ring truly exhausted (even after reclaim): spill the
+            # overflow synchronously.  NEVER drain here — eviction runs
+            # inside an activation whose staged bases were read before
+            # this point; a drain now would clear entries those bases
+            # still compose with.
+            idx = np.array(spill, np.int32)
+            k_c = np.asarray(_gather_pages(self.k_slots, jnp.asarray(idx)))
+            v_c = np.asarray(_gather_pages(self.v_slots, jnp.asarray(idx)))
+            for i, s in enumerate(spill):
+                page = int(self.slot_owner[s])
+                self.backing.write_page(page, k_c[:, i], v_c[:, i])
+                self.slot_of[page] = -1
+                self.slot_owner[s] = -1
+                self._dirty_slots.discard(s)
+            self.stats["sync_flushes"] += len(spill)
+        if not saves:
+            return
+        dirty = [s for s, _ in saves]
+        entries = [e for _, e in saves]
+        # Fixed-length index vectors (pad by repeating the last pair —
+        # a duplicate same-source same-destination copy is a no-op), so
+        # the save kernel compiles exactly once.
+        n, V = len(dirty), self.victim_entries
+        sl = np.array(dirty + [dirty[-1]] * (V - n), np.int32)
+        vi = np.array(entries + [entries[-1]] * (V - n), np.int32)
+        self._victim_k, self._victim_v = _victim_save(
+            self.k_slots, self.v_slots, self._victim_k, self._victim_v,
+            jnp.asarray(sl), jnp.asarray(vi))
+        self.stats["flushes"] += n
+
+    def _alloc_victim_entry(self, evicting: set) -> Optional[int]:
+        """A free ring entry, reclaiming one from a RESIDENT page if the
+        free list is dry: the slot holds that page's truth, so dropping
+        its entry only obliges the slot to re-save on eviction (mark it
+        dirty).  Entries of evicted pages are never reclaimed — they are
+        the only copy."""
+        if self._victim_free:
+            return self._victim_free.pop()
+        for pg, e in list(self._victim_map.items()):
+            slot = int(self.slot_of[pg])
+            if slot >= 0 and slot not in evicting:
+                del self._victim_map[pg]
+                self._dirty_slots.add(slot)
+                return e
+        return None
+
+    def drain_flushes(self) -> None:
+        """Materialize every victim-ring entry into the backing: ONE
+        batched device_get, then host-side page writes.  Never called
+        on the decode hot path — only from host view reads, close(),
+        or ring pressure at prefetch.  Bumps the drain epoch: staged
+        bases read before a drain no longer compose with the
+        (now-recycled) entries, so their activations must re-read."""
+        if not self._victim_map:
+            return
+        vk, vv = jax.device_get((self._victim_k, self._victim_v))
+        for page, e in self._victim_map.items():
+            self.backing.write_page(page, np.asarray(vk[:, e]),
+                                    np.asarray(vv[:, e]))
+        self._victim_map.clear()
+        self._victim_free = list(range(self.victim_entries))
+        self._drain_epoch += 1
+        self.stats["drains"] += 1
+
+    def _maybe_drain_for_cap(self) -> None:
+        # Prefetch-time pressure valve: fires only when the ring is full
+        # AND nothing is reclaimable (entries of resident pages can be
+        # dropped by _alloc_victim_entry instead).  A drain costs a
+        # device_get round trip AND invalidates in-flight stagings
+        # (epoch bump), so it must stay off the steady-state path.
+        if self._victim_free:
+            return
+        if any(int(self.slot_of[pg]) >= 0 for pg in self._victim_map):
+            return
+        self.drain_flushes()
 
     def _evict_for(self, need: int) -> List[int]:
-        """Free `need` slots (LRU order, skipping active), returning
-        them.  Slots that still own a page are flushed to the backing."""
-        freed: List[int] = []
-        for s in list(self._lru):
-            if len(freed) == need:
-                break
+        """Free `need` slots, returning them.  CLEAN slots go first (a
+        clean drop is free; evicting a dirty slot parks a delta), each
+        class in LRU order, always skipping pinned slots."""
+        clean: List[int] = []
+        dirty: List[int] = []
+        for s in self._lru:
             if s in self._active_slots:
                 continue
-            del self._lru[s]
-            freed.append(s)
+            (dirty if s in self._dirty_slots else clean).append(s)
+        freed = (clean + dirty)[:need]
         if len(freed) < need:
             raise RuntimeError(
                 f"slot pool exhausted: need {need}, "
                 f"{len(self._active_slots)} pinned of {self.n_slots}")
+        for s in freed:
+            del self._lru[s]
         self._flush_slots([s for s in freed if self.slot_owner[s] >= 0])
         return freed
 
-    def activate(self, seq_ids: Sequence[int], new_tokens: int
+    def _pad_chunks(self, k_chunk: np.ndarray, v_chunk: np.ndarray,
+                    n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Pad upload chunks to the fixed batch length (repeat the last
+        page — its duplicate scatter targets the same slot with the
+        same bytes).  Preallocated fills, no concatenate churn."""
+        pad = self._upload_pad(n)
+        if pad == n:
+            return k_chunk, v_chunk
+        out_k = np.empty((k_chunk.shape[0], pad) + k_chunk.shape[2:],
+                         k_chunk.dtype)
+        out_v = np.empty_like(out_k)
+        out_k[:, :n] = k_chunk
+        out_v[:, :n] = v_chunk
+        out_k[:, n:] = k_chunk[:, -1:]
+        out_v[:, n:] = v_chunk[:, -1:]
+        return out_k, out_v
+
+    def _upload_pad(self, n: int) -> int:
+        """Upload batches are padded to ONE fixed length (the slot-pool
+        size) so the scatter kernel compiles exactly once — pow2
+        bucketing still produced several shape keys, and each fresh key
+        is a ~1 s remote compile landing mid-decode."""
+        return self.n_slots if n <= self.n_slots else _pad_pow2(n)
+
+    def _needed_pages(self, seq_ids: Sequence[int], new_tokens: int
+                      ) -> List[int]:
+        """Non-resident pages the group's activation will upload, in
+        the exact order ``_activate_body`` walks them (prefetch and
+        commit must agree on this order)."""
+        m, P = self.pages_per_seq, self.page_size
+        needed: List[int] = []
+        for b in seq_ids:
+            npages = min(m, (int(self.seq_lens[b]) + new_tokens + P - 1) // P)
+            npages = max(npages, 1)
+            for pg in range(npages):
+                page = b * m + pg
+                if self.slot_of[page] < 0:
+                    needed.append(page)
+        return needed
+
+    def prefetch(self, seq_ids: Sequence[int], new_tokens: int
+                 ) -> "StagedActivation":
+        """Start the group's next activation while the device computes.
+
+        Runs everything that does NOT need the slot pool's final state:
+        faults the group's missing pages through the backing and starts
+        an async host->device upload of the (stale-base) page bytes
+        into a staging buffer — parked deltas are re-applied on-device
+        at commit, so no drain is needed here.  ``activate(...,
+        staged=...)`` then only picks slots and runs on-device scatters
+        — the transport is off the decode critical path.  Reference
+        analog: the prefetcher grows fault batches into pipelined
+        pushes that complete under later work (uvm_perf_prefetch.c,
+        uvm_migrate.c:555)."""
+        self._maybe_drain_for_cap()
+        needed = self._needed_pages(seq_ids, new_tokens)
+        # Pages with live victim entries need NO base upload — the
+        # entry holds the full page and the commit's device-side
+        # restore overwrites whatever the slot held.  Reading + moving
+        # their stale bases would double the transport volume.
+        misses = [p for p in needed if p not in self._victim_map]
+        if not misses:
+            return StagedActivation(tuple(misses), None, None, 0,
+                                    self._drain_epoch)
+        k_chunk, v_chunk = self.backing.read_pages(misses)
+        k_chunk, v_chunk = self._pad_chunks(k_chunk, v_chunk, len(misses))
+        # device_put returns immediately; the copy streams in while the
+        # current decode runs.
+        k_dev, v_dev = jax.device_put((k_chunk, v_chunk))
+        pad = k_chunk.shape[1]
+        return StagedActivation(tuple(misses), k_dev, v_dev, pad,
+                                self._drain_epoch)
+
+    def activate(self, seq_ids: Sequence[int], new_tokens: int,
+                 staged: Optional["StagedActivation"] = None
                  ) -> PagedKVCache:
         """Fault the group's pages device-side; return a decode view.
 
         Pages covering each sequence's current tokens plus `new_tokens`
         of growth become slot-resident and pinned until ``sync_from``.
+        ``staged`` (from a prior ``prefetch`` of the same group) serves
+        the uploads from device-staged bytes when its page list still
+        matches; a stale staging falls back to the synchronous path.
 
         On failure (slot pool exhausted, backing read error) every pin
         taken by this call is rolled back and evicted-but-unfilled slots
@@ -481,7 +710,7 @@ class TieredKVCache:
         pinned_before = set(self._active_slots)
         lru_before = list(self._lru)
         try:
-            return self._activate_body(seq_ids, new_tokens)
+            return self._activate_body(seq_ids, new_tokens, staged)
         except BaseException:
             self._active_slots = pinned_before
             # Rebuild the LRU in its pre-call order: slots _evict_for
@@ -492,11 +721,16 @@ class TieredKVCache:
             self._lru = dict.fromkeys(lru_before) | self._lru
             raise
 
-    def _activate_body(self, seq_ids: Sequence[int], new_tokens: int
+    def _activate_body(self, seq_ids: Sequence[int], new_tokens: int,
+                       staged: Optional["StagedActivation"] = None
                        ) -> PagedKVCache:
         self.stats["activations"] += 1
         m, P = self.pages_per_seq, self.page_size
-        needed: List[int] = []
+        # ONE page walker shared with prefetch() — the staged.pages
+        # match below depends on both sides computing the identical
+        # miss list, so there must be a single source of truth for it.
+        needed = self._needed_pages(seq_ids, new_tokens)
+        needed_set = set(needed)
         # Pin the group's already-resident slots BEFORE any eviction:
         # _evict_for skips pinned slots, so a large activation can never
         # reclaim (and silently zero the table entry of) a page this
@@ -507,46 +741,83 @@ class TieredKVCache:
             base = b * m
             for pg in range(npages):
                 page = base + pg
+                if page in needed_set:
+                    continue
                 s = self.slot_of[page]
-                if s < 0:
-                    needed.append(page)
-                else:
+                if s >= 0:
                     self._touch_lru(int(s))
                     self._active_slots.add(int(s))
 
         if needed:
             slots = self._evict_for(len(needed))
-            # Fault + fetch through the backing (UVM fault engine for the
-            # managed backing; ICI peer copies for the multi-chip pool),
-            # then upload into the freed slots (bucketed).
-            k_chunk, v_chunk = self.backing.read_pages(needed)
-            idx = np.array(slots, np.int32)
-            pad = _pad_pow2(len(slots))
-            if pad != len(slots):
-                fill = pad - len(slots)
-                idx = np.concatenate([idx, np.full(fill, idx[-1], np.int32)])
-                k_chunk = np.concatenate(
-                    [k_chunk, np.repeat(k_chunk[:, -1:], fill, axis=1)],
-                    axis=1)
-                v_chunk = np.concatenate(
-                    [v_chunk, np.repeat(v_chunk[:, -1:], fill, axis=1)],
-                    axis=1)
-            jidx = jnp.asarray(idx)
-            self.k_slots = _scatter_pages(self.k_slots, jidx,
-                                          jnp.asarray(k_chunk))
-            self.v_slots = _scatter_pages(self.v_slots, jidx,
-                                          jnp.asarray(v_chunk))
+            # Slot bookkeeping for the WHOLE group (victim hits get a
+            # slot too; their bytes arrive via the device-side restore
+            # below, never over the transport).
             for page, s in zip(needed, slots):
                 self.slot_of[page] = s
                 self.slot_owner[s] = page
                 self._lru[s] = None
                 self._active_slots.add(int(s))
-                # Fresh tenant: any stale dirty bit from a clean-dropped
-                # previous page must not force a bogus flush later.
+                # Fresh tenant: any stale dirty bit from the previous
+                # occupant must not survive into the new page.
                 self._dirty_slots.discard(int(s))
-            self.stats["uploads"] += len(needed)
-            self.stats["upload_bytes"] += (2 * len(needed) * self.page_bytes *
-                                           self.cfg.num_layers)
+            misses = [p for p in needed if p not in self._victim_map]
+            if misses:
+                if (staged is not None and staged.pages == tuple(misses)
+                        and staged.epoch == self._drain_epoch):
+                    # Bytes already staged on device by prefetch():
+                    # faults, backing reads and the host->device copy
+                    # all happened under the previous group's compute
+                    # window.
+                    k_up, v_up = staged.k_dev, staged.v_dev
+                    pad = staged.pad
+                    self.stats["prefetched_uploads"] += len(misses)
+                else:
+                    # Synchronous path (no/stale staging): fault + fetch
+                    # through the backing (UVM fault engine for the
+                    # managed backing; ICI peer copies for the
+                    # multi-chip pool).
+                    self._maybe_drain_for_cap()
+                    k_chunk, v_chunk = self.backing.read_pages(misses)
+                    k_chunk, v_chunk = self._pad_chunks(k_chunk, v_chunk,
+                                                        len(misses))
+                    pad = k_chunk.shape[1]
+                    k_up, v_up = jnp.asarray(k_chunk), jnp.asarray(v_chunk)
+                idx = np.array([int(self.slot_of[p]) for p in misses],
+                               np.int32)
+                if pad != len(misses):
+                    idx = np.concatenate(
+                        [idx, np.full(pad - len(misses), idx[-1], np.int32)])
+                jidx = jnp.asarray(idx)
+                self.k_slots = _scatter_pages(self.k_slots, jidx, k_up)
+                self.v_slots = _scatter_pages(self.v_slots, jidx, v_up)
+                self.stats["uploads"] += len(misses)
+                self.stats["upload_bytes"] += (2 * len(misses) *
+                                               self.page_bytes *
+                                               self.cfg.num_layers)
+            # Restore pages with live victim entries: the uploaded base
+            # is the backing's STALE copy; the victim entry holds the
+            # page's full truth at eviction.  One fixed-shape device op;
+            # the entry recycles and the restored slot is DIRTY (its
+            # content still differs from the backing).
+            hits = [p for p in needed if p in self._victim_map]
+            if hits:
+                entries = [self._victim_map[p] for p in hits]
+                dests = [int(self.slot_of[p]) for p in hits]
+                n, V = len(hits), self.victim_entries
+                vi = np.array(entries + [entries[-1]] * (V - n), np.int32)
+                de = np.array(dests + [dests[-1]] * (V - n), np.int32)
+                self.k_slots, self.v_slots = _victim_restore(
+                    self.k_slots, self.v_slots, self._victim_k,
+                    self._victim_v, jnp.asarray(vi), jnp.asarray(de))
+                # Entries stay LIVE and the restored slots stay CLEAN:
+                # slot == entry content, so a later clean eviction drops
+                # the slot for free and the entry remains the truth.  A
+                # write to the slot re-dirties it and its next save
+                # overwrites the same entry.  (Freeing entries on
+                # restore made every restored slot dirty, doubling save
+                # traffic and churning the ring into sync spills.)
+                self.stats["victim_restores"] += n
 
         # Map the group's pages onto slots (entries past the resident
         # span are masked by seq_lens in attention).
@@ -588,15 +859,18 @@ class TieredKVCache:
         P, m = self.page_size, self.pages_per_seq
         view_lens = None if decoded else np.asarray(view.seq_lens)
         for i, b in enumerate(seq_ids):
-            old = int(self.seq_lens[b])
-            new = min(old + decoded, m * P) if decoded else int(
-                view_lens[i])
-            first_pg = (old // P) if decoded else 0
+            if decoded:
+                old = int(self.seq_lens[b])
+                new = min(old + decoded, m * P)
+            else:
+                old = 0                      # prefill wrote [0, new)
+                new = int(view_lens[i])
+            first_pg = old // P
             last_pg = min(m - 1, max(new - 1, 0) // P)
             for pg in range(first_pg, last_pg + 1):
-                slot = self.slot_of[b * m + pg]
+                slot = int(self.slot_of[b * m + pg])
                 if slot >= 0:
-                    self._dirty_slots.add(int(slot))
+                    self._dirty_slots.add(slot)
         if decoded:
             self.seq_lens[idx] = np.minimum(
                 self.seq_lens[idx] + decoded,
@@ -608,7 +882,10 @@ class TieredKVCache:
         self._active_slots.clear()
 
     def close(self) -> None:
-        self.backing.close()
+        try:
+            self.drain_flushes()
+        finally:
+            self.backing.close()
 
 
 def prefill_group(cfg: llama.LlamaConfig, params: Dict[str, Any],
@@ -643,19 +920,35 @@ def decode_rounds(cfg: llama.LlamaConfig, params: Dict[str, Any],
     # the next activation does not actually need (lengths advance by
     # host arithmetic; only the caller's final read materializes).
     dev_tok: Dict[Tuple[int, ...], jax.Array] = {}
+    # Software pipeline over the turn schedule: after DISPATCHING group
+    # A's decode scan (async — the host regains control immediately),
+    # the host prefetches group B's activation — draining A's parked
+    # eviction writebacks, faulting B's missing pages through the UVM
+    # backing, and streaming the bytes to a device staging buffer —
+    # all under A's compute window.  B's activate() then only picks
+    # slots and scatters on-device.  This is the serving-level analog
+    # of the reference's prefetch pipeline (uvm_perf_prefetch.c;
+    # pipelined migration pushes, uvm_migrate.c:555): page movement
+    # overlaps compute instead of serializing with it.
+    schedule = [g for _ in range(turns) for g in groups]
+    staged: Dict[Tuple[int, ...], StagedActivation] = {}
     try:
-        for _ in range(turns):
-            for g in groups:
-                key = tuple(g)
-                view = cache.activate(g, new_tokens=tokens_per_turn)
-                tok = dev_tok.get(key)
-                if tok is None:
-                    tok = jnp.asarray(cache.last_token[np.array(g)])
-                tok, view, _ = decode_scan(cfg, params, tok, view,
-                                           tokens_per_turn)
-                dev_tok[key] = tok
-                cache.sync_from(view, g, decoded=tokens_per_turn)
-                total += len(g) * tokens_per_turn
+        for i, g in enumerate(schedule):
+            key = tuple(g)
+            view = cache.activate(g, new_tokens=tokens_per_turn,
+                                  staged=staged.pop(key, None))
+            tok = dev_tok.get(key)
+            if tok is None:
+                tok = jnp.asarray(cache.last_token[np.array(g)])
+            tok, view, _ = decode_scan(cfg, params, tok, view,
+                                       tokens_per_turn)
+            dev_tok[key] = tok
+            cache.sync_from(view, g, decoded=tokens_per_turn)
+            if i + 1 < len(schedule):
+                nxt = schedule[i + 1]
+                staged[tuple(nxt)] = cache.prefetch(
+                    nxt, new_tokens=tokens_per_turn)
+            total += len(g) * tokens_per_turn
     finally:
         # Materialize final tokens once — ALSO on error paths, so the
         # cache's last_token stays consistent with the seq_lens that

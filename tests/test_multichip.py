@@ -68,6 +68,9 @@ def run_multichip():
         out["detour_hops"] = ici.route_hops(0, 1)
 
         serving.decode_rounds(cfg, params, cache, groups, 3, 2)
+        # Push parked victim-ring entries home over ICI (the decode loop
+        # itself recycles them device-side and never needs the wire).
+        cache.drain_flushes()
         after = cache.backing.link_traffic()
 
         out["tokens"] = [int(t) for t in cache.last_token]

@@ -185,3 +185,52 @@ def test_decode_step_drops_writes_at_max_len(setup):
     # the last page, and seq_lens stays clamped at max_len.
     np.testing.assert_array_equal(np.asarray(cache2.k_pages), before_k)
     assert int(cache2.seq_lens[0]) == 8
+
+
+def test_prefetch_stages_activation(setup):
+    """prefetch() + activate(staged=...) must upload the same bytes the
+    synchronous path would, mark them as prefetched, and keep parked
+    eviction writebacks visible through every read path."""
+    cfg, params = setup
+    tiered = serving.TieredKVCache(cfg, batch=4, max_len=128, page_size=16,
+                                   oversub=4)     # 32 pages, 8 slots
+    try:
+        kview = tiered.k_view()
+        for b in range(4):
+            kview[:, b * 8, :, :, :] = float(b + 1)
+            tiered.seq_lens[b] = 12
+
+        st = tiered.prefetch([0, 1], new_tokens=1)
+        assert st.pages == (0, 8)
+        view = tiered.activate([0, 1], new_tokens=1, staged=st)
+        assert tiered.stats["prefetched_uploads"] == 2
+        assert float(view.k_pages[0, int(view.page_table[0, 0]),
+                                 0, 0, 0]) == 1.0
+        assert float(view.k_pages[0, int(view.page_table[1, 0]),
+                                 0, 0, 0]) == 2.0
+        tiered.sync_from(view, [0, 1], decoded=1)   # marks pages dirty
+
+        # A STALE staging (residency changed since prefetch) must fall
+        # back to the synchronous read path and still be correct.
+        st23 = tiered.prefetch([2], new_tokens=1)
+        view = tiered.activate([2, 3], new_tokens=1, staged=st23)
+        assert float(view.k_pages[0, int(view.page_table[0, 0]),
+                                 0, 0, 0]) == 3.0
+        assert float(view.k_pages[0, int(view.page_table[1, 0]),
+                                 0, 0, 0]) == 4.0
+        tiered.sync_from(view, [2, 3], decoded=1)
+
+        # Fill the WHOLE pool in one activation so seqs 0/1's dirty
+        # slots must evict (clean-preferred eviction would otherwise
+        # spare them): their written spans park as device-side deltas;
+        # a host view read must drain them into the backing first.
+        tiered.seq_lens[2] = 60
+        tiered.seq_lens[3] = 60
+        v = tiered.activate([2, 3], new_tokens=1)
+        tiered.sync_from(v, [2, 3], decoded=1)
+        assert tiered.stats["flushes"] >= 2       # seqs 0/1 evicted dirty
+        assert len(tiered._victim_map) > 0
+        assert float(tiered.k_view()[0, 0, 0, 0, 0]) == 1.0
+        assert not tiered._victim_map             # view read drained
+    finally:
+        tiered.close()
