@@ -198,6 +198,64 @@ typedef struct {
 /* Single-copy clamp (reference: p2p_cxl.c:617-621). */
 #define TPU_CE_COPY_CLAMP           0xFFFFF000ull
 
+/* --------------------------------------------------- RM event notification
+ * NV01_EVENT_OS_EVENT analog (reference: cl0005.h:35-47 alloc params;
+ * event_notification.c delivery; nvgputypes.h:57-64 NvNotification).
+ * The reference signals an OS event handle passed in `data`; the tpurm
+ * userspace redesign points `data` at a TpuOsEvent in client memory —
+ * `signaled` is a futex word the engine increments and FUTEX_WAKEs, and
+ * the notification record is filled in the reference's documented order
+ * (timeStamp, info32, info16, status last). */
+
+#define TPU_CLASS_EVENT_OS     0x00000079u  /* NV01_EVENT_OS_EVENT */
+
+typedef struct {
+    uint32_t hParentClient;
+    uint32_t hSrcResource;
+    uint32_t hClass;
+    uint32_t notifyIndex;
+    uint64_t data __attribute__((aligned(8)));  /* TpuOsEvent* */
+} TpuEventAllocParams;
+
+/* NvNotification layout, byte-exact (nvgputypes.h:57-64: 16 bytes). */
+typedef struct {
+    uint32_t timeStampNanoseconds[2];
+    uint32_t info32;
+    uint16_t info16;
+    uint16_t status;
+} TpuNvNotification;
+
+typedef struct {
+    uint32_t signaled;          /* futex word; incremented per delivery */
+    uint32_t reserved;
+    TpuNvNotification rec;
+} TpuOsEvent;
+
+#define TPU_NOTIFICATION_STATUS_IN_PROGRESS  0x8000u
+#define TPU_NOTIFICATION_STATUS_DONE_SUCCESS 0x0000u
+
+/* NV2080_CTRL_CMD_EVENT_SET_NOTIFICATION (ctrl2080event.h:79-94). */
+#define TPU_CTRL_CMD_EVENT_SET_NOTIFICATION 0x20800301u
+#define TPU_EVENT_ACTION_DISABLE 0x0u
+#define TPU_EVENT_ACTION_SINGLE  0x1u
+#define TPU_EVENT_ACTION_REPEAT  0x2u
+
+typedef struct {
+    uint32_t event;             /* notifier index */
+    uint32_t action;
+    uint8_t  bNotifyState;
+    uint32_t info32;
+    uint16_t info16;
+} TpuCtrlEventSetNotificationParams;
+
+/* Notifier indices (cl2080_notification.h vocabulary).  CXL DMA
+ * completion is a fork-space index: the reference's CXL fork exposes
+ * completion only via the async tracker; tpurm also delivers it as an
+ * RM event so clients need not poll. */
+#define TPU_NOTIFIER_SW        0u    /* NV2080_NOTIFIERS_SW */
+#define TPU_NOTIFIER_RC_ERROR  37u   /* NV2080_NOTIFIERS_RC_ERROR */
+#define TPU_NOTIFIER_CXL_DMA   180u  /* fork: async CXL DMA completion */
+
 #ifdef __cplusplus
 }
 #endif

@@ -40,6 +40,12 @@ extern "C" {
  * "/dev/nvidiactl", "/dev/tpuctl" (control node); "/dev/nvidia0",
  * "/dev/accel/tpu0" etc (per-device nodes). */
 int tpurm_open(const char *path);
+
+/* Multi-process RM broker (broker.c): serve this process's engine over
+ * a unix socket; other processes attach by setting TPURM_BROKER=<path>
+ * before their first open (the rs_server client model — each
+ * connection gets an isolated handle namespace). */
+TpuStatus tpurmBrokerServe(const char *path);
 int tpurm_close(int pfd);
 /* Emulates ioctl(2) on a pseudo-fd: returns 0 on success (RM status is in
  * the param block), -1 with errno on transport errors. */

@@ -531,6 +531,7 @@ enum {
     UVM_TPU_TEST_RANGE_SPLIT          = 14,
     UVM_TPU_TEST_HMM_PAGEABLE         = 15,
     UVM_TPU_TEST_DEV_MMU              = 16,
+    UVM_TPU_TEST_MULTI_WORKER         = 17,
 };
 TpuStatus uvmRunTest(UvmVaSpace *vs, uint32_t testCmd);
 

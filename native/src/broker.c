@@ -1,0 +1,685 @@
+/*
+ * Multi-process RM: broker server + client forwarding.
+ *
+ * The reference is a kernel driver — any process opens /dev/nvidiactl
+ * and resserv gives it an isolated client namespace
+ * (src/libraries/resserv/src/rs_server.c).  tpurm's engine lives in a
+ * process, so multi-process attach is brokered: one process (the
+ * engine host / tpurm_brokerd) serves the NVOS escapes over a unix
+ * socket, and client processes' shims forward open/ioctl/close to it.
+ *
+ *   - handle namespaces: each connection's client handles (hClient)
+ *     are remapped to globally-unique engine handles, so two processes
+ *     running the UNMODIFIED reference walker (which hardcodes its
+ *     hClient) never collide — the rs_server per-client model.
+ *   - user memory: the reference kernel copies DMA user buffers with
+ *     copy_from/to_user; the broker's analog is process_vm_readv/
+ *     writev against a server-side shadow mapping, synced around CXL
+ *     DMA requests.  Async DMA from remote clients executes
+ *     synchronously (completion must happen before the copy-back —
+ *     remote completion events are not forwarded).
+ *   - lifetime: a dropped connection frees every RM client it created
+ *     (rs_server frees clients of dead processes the same way).
+ *
+ * The wire protocol is internal (both ends are this file); the CLIENT
+ * ABI is still the NVOS ioctl surface.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/abi.h"
+
+#include <errno.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define BROKER_FD_BASE   0x50000000
+#define BROKER_MAX_FDS   64
+#define BROKER_MAX_AUX   (1u << 20)
+#define BROKER_MAX_CLIENTS_PER_CONN 16
+#define BROKER_MAX_SHADOWS 32
+
+enum { BR_OP_OPEN = 1, BR_OP_CLOSE = 2, BR_OP_IOCTL = 3 };
+
+typedef struct {
+    uint32_t op;
+    uint32_t fdToken;
+    uint32_t escNr;
+    uint32_t mainSize;
+    uint32_t auxSize;
+    char path[64];
+} BrokerReq;
+
+typedef struct {
+    int32_t ret;
+    int32_t err;
+    uint32_t mainSize;
+    uint32_t auxSize;
+} BrokerRep;
+
+/* ------------------------------------------------------------ wire io */
+
+static int io_all(int fd, void *buf, size_t n, bool write_side)
+{
+    char *p = buf;
+    while (n) {
+        ssize_t r = write_side ? write(fd, p, n) : read(fd, p, n);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR)
+                continue;
+            return -1;
+        }
+        p += r;
+        n -= (size_t)r;
+    }
+    return 0;
+}
+
+/* ============================================================ server */
+
+typedef struct {
+    uint64_t clientVa;
+    uint64_t size;
+    void *shadow;
+    uint64_t handle;
+    bool used;
+} BrokerShadow;
+
+typedef struct {
+    int sock;
+    pid_t peer;
+    int fds[BROKER_MAX_FDS];            /* token -> local pseudo fd */
+    struct {
+        uint32_t clientH;
+        uint32_t realH;
+        bool used;
+    } clients[BROKER_MAX_CLIENTS_PER_CONN];
+    BrokerShadow shadows[BROKER_MAX_SHADOWS];
+} BrokerConn;
+
+static _Atomic uint32_t g_next_hclient = 0xB0000001u;
+
+static uint32_t conn_map_client(BrokerConn *c, uint32_t clientH,
+                                bool create)
+{
+    for (int i = 0; i < BROKER_MAX_CLIENTS_PER_CONN; i++)
+        if (c->clients[i].used && c->clients[i].clientH == clientH)
+            return c->clients[i].realH;
+    if (!create)
+        return 0;
+    for (int i = 0; i < BROKER_MAX_CLIENTS_PER_CONN; i++) {
+        if (!c->clients[i].used) {
+            c->clients[i].used = true;
+            c->clients[i].clientH = clientH;
+            c->clients[i].realH = atomic_fetch_add(&g_next_hclient, 1);
+            return c->clients[i].realH;
+        }
+    }
+    return 0;
+}
+
+static void conn_unmap_client(BrokerConn *c, uint32_t clientH)
+{
+    for (int i = 0; i < BROKER_MAX_CLIENTS_PER_CONN; i++)
+        if (c->clients[i].used && c->clients[i].clientH == clientH)
+            c->clients[i].used = false;
+}
+
+static int peer_copy(pid_t pid, void *local, uint64_t remote, size_t n,
+                     bool to_peer)
+{
+    struct iovec lv = { .iov_base = local, .iov_len = n };
+    struct iovec rv = { .iov_base = (void *)(uintptr_t)remote,
+                        .iov_len = n };
+    ssize_t r = to_peer ? process_vm_writev(pid, &lv, 1, &rv, 1, 0)
+                        : process_vm_readv(pid, &lv, 1, &rv, 1, 0);
+    return r == (ssize_t)n ? 0 : -1;
+}
+
+static BrokerShadow *shadow_find(BrokerConn *c, uint64_t handle)
+{
+    for (int i = 0; i < BROKER_MAX_SHADOWS; i++)
+        if (c->shadows[i].used && c->shadows[i].handle == handle)
+            return &c->shadows[i];
+    return NULL;
+}
+
+/* CXL controls against a remote client: swap user VAs for server-side
+ * shadow mappings and sync them with process_vm copies — the kernel
+ * reference's copy_from/to_user analog. */
+static TpuStatus conn_control_cxl(BrokerConn *c, TpuRmControlParams *p,
+                                  void *aux)
+{
+    switch (p->cmd) {
+    case TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER: {
+        TpuCtrlRegisterCxlBufferParams *rp = aux;
+        int slot;
+        for (slot = 0; slot < BROKER_MAX_SHADOWS; slot++)
+            if (!c->shadows[slot].used)
+                break;
+        if (slot == BROKER_MAX_SHADOWS)
+            return TPU_ERR_INSUFFICIENT_RESOURCES;
+        void *shadow = mmap(NULL, rp->size, PROT_READ | PROT_WRITE,
+                            MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+        if (shadow == MAP_FAILED)
+            return TPU_ERR_NO_MEMORY;
+        if (peer_copy(c->peer, shadow, rp->baseAddress, rp->size,
+                      false) != 0) {
+            munmap(shadow, rp->size);
+            return TPU_ERR_INVALID_ADDRESS;
+        }
+        uint64_t clientVa = rp->baseAddress;
+        rp->baseAddress = (uint64_t)(uintptr_t)shadow;
+        TpuStatus st = tpurmControl(p);
+        if (st == TPU_OK && p->status == TPU_OK) {
+            c->shadows[slot] = (BrokerShadow){
+                .clientVa = clientVa, .size = rp->size, .shadow = shadow,
+                .handle = rp->bufferHandle, .used = true };
+        } else {
+            munmap(shadow, rp->size);
+        }
+        rp->baseAddress = clientVa;       /* never leak server VAs */
+        return st;
+    }
+    case TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER: {
+        TpuCtrlUnregisterCxlBufferParams *up = aux;
+        BrokerShadow *sh = shadow_find(c, up->bufferHandle);
+        TpuStatus st = tpurmControl(p);
+        if (st == TPU_OK && p->status == TPU_OK && sh) {
+            munmap(sh->shadow, sh->size);
+            sh->used = false;
+        }
+        return st;
+    }
+    case TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST: {
+        TpuCtrlCxlP2pDmaRequestParams *dp = aux;
+        BrokerShadow *sh = shadow_find(c, dp->cxlBufferHandle);
+        if (!sh) /* unknown handle: let the engine produce the status */
+            return tpurmControl(p);
+        bool toDev = (dp->flags & TPU_CXL_DMA_FLAG_CXL_TO_DEV) != 0;
+        if (dp->cxlOffset > sh->size || dp->size > sh->size - dp->cxlOffset)
+            return tpurmControl(p);       /* OOB: engine rejects */
+        /* Remote DMA is synchronous: the shadow<->client sync must
+         * bracket the copy (async completion is not forwarded). */
+        uint32_t flags = dp->flags;
+        dp->flags &= ~TPU_CXL_DMA_FLAG_ASYNC;
+        if (toDev &&
+            peer_copy(c->peer, (char *)sh->shadow + dp->cxlOffset,
+                      sh->clientVa + dp->cxlOffset, dp->size, false) != 0)
+            return TPU_ERR_INVALID_ADDRESS;
+        TpuStatus st = tpurmControl(p);
+        if (st == TPU_OK && p->status == TPU_OK && !toDev &&
+            peer_copy(c->peer, (char *)sh->shadow + dp->cxlOffset,
+                      sh->clientVa + dp->cxlOffset, dp->size, true) != 0)
+            st = TPU_ERR_INVALID_ADDRESS;
+        dp->flags = flags;
+        return st;
+    }
+    default:
+        return tpurmControl(p);
+    }
+}
+
+static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
+                             BrokerRep *rep, void **auxOut)
+{
+    rep->ret = 0;
+    rep->err = 0;
+    *auxOut = aux;
+    switch (rq->escNr) {
+    case TPU_ESC_RM_ALLOC: {
+        TpuRmAllocParams p;
+        if (rq->mainSize != sizeof(p)) {
+            rep->ret = -1; rep->err = EINVAL; return;
+        }
+        memcpy(&p, (char *)aux + rq->auxSize, sizeof(p));
+        if (p.hClass == TPU_CLASS_ROOT) {
+            uint32_t h = p.hObjectNew ? p.hObjectNew : p.hRoot;
+            uint32_t real = conn_map_client(c, h, true);
+            if (!real) {
+                p.status = TPU_ERR_INSUFFICIENT_RESOURCES;
+                memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+                rep->mainSize = sizeof(p);
+                return;
+            }
+            uint32_t orig = h;
+            p.hRoot = p.hObjectParent = p.hObjectNew = real;
+            p.pAllocParms = 0;
+            tpurmAlloc(&p);
+            if (p.status != TPU_OK)
+                conn_unmap_client(c, orig);
+            p.hRoot = p.hObjectParent = p.hObjectNew = orig;
+        } else if (p.hClass == TPU_CLASS_EVENT_OS) {
+            /* Remote events are NOT forwarded: the alloc's `data` is a
+             * TpuOsEvent* in the CLIENT's address space — registering
+             * it would make the engine host deliver (write + futex)
+             * through a foreign VA.  Same stance as async DMA: remote
+             * clients poll synchronously. */
+            p.status = TPU_ERR_NOT_SUPPORTED;
+            memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+            rep->mainSize = sizeof(p);
+            rep->auxSize = rq->auxSize;
+            return;
+        } else {
+            uint32_t real = conn_map_client(c, p.hRoot, false);
+            uint32_t clientH = p.hRoot;
+            if (!real) {
+                p.status = TPU_ERR_INVALID_CLIENT;
+                memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+                rep->mainSize = sizeof(p);
+                return;
+            }
+            p.hRoot = real;
+            if (p.hObjectParent == clientH)
+                p.hObjectParent = real;
+            p.pAllocParms = rq->auxSize ? (uint64_t)(uintptr_t)aux : 0;
+            tpurmAlloc(&p);
+            p.hRoot = clientH;
+            if (p.hObjectParent == real)
+                p.hObjectParent = clientH;
+        }
+        memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+        rep->mainSize = sizeof(p);
+        rep->auxSize = rq->auxSize;
+        return;
+    }
+    case TPU_ESC_RM_CONTROL: {
+        TpuRmControlParams p;
+        if (rq->mainSize != sizeof(p)) {
+            rep->ret = -1; rep->err = EINVAL; return;
+        }
+        memcpy(&p, (char *)aux + rq->auxSize, sizeof(p));
+        uint32_t clientH = p.hClient;
+        uint32_t real = conn_map_client(c, p.hClient, false);
+        if (!real) {
+            p.status = TPU_ERR_INVALID_CLIENT;
+        } else {
+            p.hClient = real;
+            if (p.hObject == clientH)
+                p.hObject = real;
+            p.params = rq->auxSize ? (uint64_t)(uintptr_t)aux : 0;
+            conn_control_cxl(c, &p, aux);
+            p.hClient = clientH;
+            if (p.hObject == real)
+                p.hObject = clientH;
+        }
+        memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+        rep->mainSize = sizeof(p);
+        rep->auxSize = rq->auxSize;
+        return;
+    }
+    case TPU_ESC_RM_FREE: {
+        TpuRmFreeParams p;
+        if (rq->mainSize != sizeof(p)) {
+            rep->ret = -1; rep->err = EINVAL; return;
+        }
+        memcpy(&p, (char *)aux + rq->auxSize, sizeof(p));
+        uint32_t clientH = p.hRoot;
+        uint32_t real = conn_map_client(c, p.hRoot, false);
+        if (!real) {
+            p.status = TPU_ERR_INVALID_CLIENT;
+        } else {
+            p.hRoot = real;
+            if (p.hObjectOld == clientH)
+                p.hObjectOld = real;
+            if (p.hObjectParent == clientH)
+                p.hObjectParent = real;
+            tpurmFree(&p);
+            if (p.status == TPU_OK && p.hObjectOld == real)
+                conn_unmap_client(c, clientH);
+            p.hRoot = clientH;
+        }
+        memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+        rep->mainSize = sizeof(p);
+        return;
+    }
+    default:
+        rep->ret = -1;
+        rep->err = ENOTTY;
+        return;
+    }
+}
+
+static void *conn_thread(void *arg)
+{
+    BrokerConn *c = arg;
+    /* main struct rides AFTER the aux buffer in one allocation. */
+    char *buf = malloc(BROKER_MAX_AUX + 256);
+    BrokerReq rq;
+    if (!buf)
+        goto out;
+
+    while (io_all(c->sock, &rq, sizeof(rq), false) == 0) {
+        if (rq.auxSize > BROKER_MAX_AUX || rq.mainSize > 256)
+            break;
+        if (rq.auxSize + rq.mainSize &&
+            io_all(c->sock, buf, rq.auxSize + rq.mainSize, false) != 0)
+            break;
+        BrokerRep rep = { 0 };
+        void *auxOut = buf;
+        switch (rq.op) {
+        case BR_OP_OPEN: {
+            rq.path[sizeof(rq.path) - 1] = 0;
+            int fd = tpurm_open(rq.path);
+            if (fd < 0) {
+                rep.ret = -1;
+                rep.err = errno;
+            } else {
+                int tok;
+                for (tok = 0; tok < BROKER_MAX_FDS; tok++)
+                    if (c->fds[tok] == 0)
+                        break;
+                if (tok == BROKER_MAX_FDS) {
+                    tpurm_close(fd);
+                    rep.ret = -1;
+                    rep.err = EMFILE;
+                } else {
+                    c->fds[tok] = fd;
+                    rep.ret = tok;
+                }
+            }
+            break;
+        }
+        case BR_OP_CLOSE:
+            if (rq.fdToken < BROKER_MAX_FDS && c->fds[rq.fdToken]) {
+                tpurm_close(c->fds[rq.fdToken]);
+                c->fds[rq.fdToken] = 0;
+            } else {
+                rep.ret = -1;
+                rep.err = EBADF;
+            }
+            break;
+        case BR_OP_IOCTL:
+            if (rq.fdToken >= BROKER_MAX_FDS || !c->fds[rq.fdToken]) {
+                rep.ret = -1;
+                rep.err = EBADF;
+            } else {
+                conn_serve_ioctl(c, &rq, buf, &rep, &auxOut);
+            }
+            break;
+        default:
+            rep.ret = -1;
+            rep.err = EINVAL;
+        }
+        if (io_all(c->sock, &rep, sizeof(rep), true) != 0)
+            break;
+        if (rep.auxSize + rep.mainSize &&
+            io_all(c->sock, auxOut, rep.auxSize + rep.mainSize, true) != 0)
+            break;
+    }
+
+out:
+    /* Connection died: free its RM clients (rs_server frees clients of
+     * dead processes) and release shadows + fds. */
+    for (int i = 0; i < BROKER_MAX_CLIENTS_PER_CONN; i++) {
+        if (c->clients[i].used) {
+            TpuRmFreeParams fp = { .hRoot = c->clients[i].realH,
+                                   .hObjectOld = c->clients[i].realH };
+            tpurmFree(&fp);
+        }
+    }
+    for (int i = 0; i < BROKER_MAX_SHADOWS; i++)
+        if (c->shadows[i].used)
+            munmap(c->shadows[i].shadow, c->shadows[i].size);
+    for (int i = 0; i < BROKER_MAX_FDS; i++)
+        if (c->fds[i])
+            tpurm_close(c->fds[i]);
+    close(c->sock);
+    free(buf);
+    free(c);
+    return NULL;
+}
+
+typedef struct {
+    int listenFd;
+} BrokerServer;
+
+static void *accept_thread(void *arg)
+{
+    BrokerServer *srv = arg;
+    for (;;) {
+        int s = accept(srv->listenFd, NULL, NULL);
+        if (s < 0) {
+            if (errno == EINTR)
+                continue;
+            break;
+        }
+        struct ucred cred;
+        socklen_t len = sizeof(cred);
+        BrokerConn *c = calloc(1, sizeof(*c));
+        if (!c || getsockopt(s, SOL_SOCKET, SO_PEERCRED, &cred,
+                             &len) != 0) {
+            free(c);
+            close(s);
+            continue;
+        }
+        c->sock = s;
+        c->peer = cred.pid;
+        pthread_t tid;
+        if (pthread_create(&tid, NULL, conn_thread, c) != 0) {
+            close(s);
+            free(c);
+            continue;
+        }
+        pthread_detach(tid);
+    }
+    free(srv);
+    return NULL;
+}
+
+TpuStatus tpurmBrokerServe(const char *path)
+{
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    struct sockaddr_un addr = { .sun_family = AF_UNIX };
+    snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", path);
+    unlink(path);
+    if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0 ||
+        listen(fd, 16) != 0) {
+        close(fd);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+    BrokerServer *srv = calloc(1, sizeof(*srv));
+    if (!srv) {
+        close(fd);
+        return TPU_ERR_NO_MEMORY;
+    }
+    srv->listenFd = fd;
+    pthread_t tid;
+    if (pthread_create(&tid, NULL, accept_thread, srv) != 0) {
+        close(fd);
+        free(srv);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+    pthread_detach(tid);
+    tpuLog(TPU_LOG_INFO, "broker", "serving on %s", path);
+    return TPU_OK;
+}
+
+/* ============================================================ client */
+
+static struct {
+    pthread_mutex_t lock;
+    int sock;                 /* -1 until connected */
+    bool fdUsed[BROKER_MAX_FDS];
+} g_cli = { .lock = PTHREAD_MUTEX_INITIALIZER, .sock = -1 };
+
+bool tpurmBrokerIsRemoteFd(int fd)
+{
+    return fd >= BROKER_FD_BASE && fd < BROKER_FD_BASE + BROKER_MAX_FDS;
+}
+
+static int cli_connect_locked(void)
+{
+    if (g_cli.sock >= 0)
+        return 0;
+    const char *path = getenv("TPURM_BROKER");
+    if (!path)
+        return -1;
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0)
+        return -1;
+    struct sockaddr_un addr = { .sun_family = AF_UNIX };
+    snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", path);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return -1;
+    }
+    g_cli.sock = fd;
+    return 0;
+}
+
+/* One round trip.  Returns -1 with errno on transport failure. */
+static int cli_call(BrokerReq *rq, const void *aux, BrokerRep *rep,
+                    void *auxBack, uint32_t auxBackCap)
+{
+    pthread_mutex_lock(&g_cli.lock);
+    if (cli_connect_locked() != 0) {
+        pthread_mutex_unlock(&g_cli.lock);
+        errno = ECONNREFUSED;
+        return -1;
+    }
+    int rc = -1;
+    if (io_all(g_cli.sock, rq, sizeof(*rq), true) != 0)
+        goto out;
+    if (rq->auxSize + rq->mainSize &&
+        io_all(g_cli.sock, (void *)aux, rq->auxSize + rq->mainSize,
+               true) != 0)
+        goto out;
+    if (io_all(g_cli.sock, rep, sizeof(*rep), false) != 0)
+        goto out;
+    if (rep->auxSize + rep->mainSize) {
+        if (rep->auxSize + rep->mainSize > auxBackCap)
+            goto out;
+        if (io_all(g_cli.sock, auxBack, rep->auxSize + rep->mainSize,
+                   false) != 0)
+            goto out;
+    }
+    rc = 0;
+out:
+    if (rc != 0) {
+        close(g_cli.sock);
+        g_cli.sock = -1;
+        errno = EPIPE;
+    }
+    pthread_mutex_unlock(&g_cli.lock);
+    return rc;
+}
+
+int tpurmBrokerOpen(const char *path)
+{
+    BrokerReq rq = { .op = BR_OP_OPEN };
+    BrokerRep rep;
+    snprintf(rq.path, sizeof(rq.path), "%s", path);
+    if (cli_call(&rq, NULL, &rep, NULL, 0) != 0)
+        return -1;
+    if (rep.ret < 0) {
+        errno = rep.err ? rep.err : EIO;
+        return -1;
+    }
+    pthread_mutex_lock(&g_cli.lock);
+    g_cli.fdUsed[rep.ret] = true;
+    pthread_mutex_unlock(&g_cli.lock);
+    return BROKER_FD_BASE + rep.ret;
+}
+
+int tpurmBrokerClose(int fd)
+{
+    BrokerReq rq = { .op = BR_OP_CLOSE,
+                     .fdToken = (uint32_t)(fd - BROKER_FD_BASE) };
+    BrokerRep rep;
+    if (cli_call(&rq, NULL, &rep, NULL, 0) != 0)
+        return -1;
+    pthread_mutex_lock(&g_cli.lock);
+    g_cli.fdUsed[fd - BROKER_FD_BASE] = false;
+    pthread_mutex_unlock(&g_cli.lock);
+    if (rep.ret < 0) {
+        errno = rep.err ? rep.err : EIO;
+        return -1;
+    }
+    return 0;
+}
+
+int tpurmBrokerIoctl(int fd, unsigned long request, void *argp)
+{
+    if (_IOC_TYPE(request) != TPU_IOCTL_MAGIC) {
+        errno = ENOTTY;
+        return -1;
+    }
+    uint32_t nr = _IOC_NR(request);
+    /* Marshal: [embedded param buffer][main struct]. */
+    char stackBuf[8192];
+    char *buf = stackBuf;
+    uint32_t auxSize = 0, mainSize = 0;
+    uint64_t *embedPtr = NULL;          /* field to restore afterwards */
+    uint64_t embedSave = 0;
+    char *heapBuf = NULL;
+
+    if (nr == TPU_ESC_RM_ALLOC) {
+        TpuRmAllocParams *p = argp;
+        mainSize = sizeof(*p);
+        auxSize = p->paramsSize;
+        embedPtr = &p->pAllocParms;
+    } else if (nr == TPU_ESC_RM_CONTROL) {
+        TpuRmControlParams *p = argp;
+        mainSize = sizeof(*p);
+        auxSize = p->paramsSize;
+        embedPtr = &p->params;
+    } else if (nr == TPU_ESC_RM_FREE) {
+        mainSize = sizeof(TpuRmFreeParams);
+    } else {
+        errno = ENOTTY;
+        return -1;
+    }
+    if (auxSize > BROKER_MAX_AUX) {
+        errno = EINVAL;
+        return -1;
+    }
+    if (auxSize + mainSize > sizeof(stackBuf)) {
+        heapBuf = malloc(auxSize + mainSize);
+        if (!heapBuf) {
+            errno = ENOMEM;
+            return -1;
+        }
+        buf = heapBuf;
+    }
+    if (embedPtr) {
+        embedSave = *embedPtr;
+        if (auxSize && embedSave)
+            memcpy(buf, (void *)(uintptr_t)embedSave, auxSize);
+        else
+            auxSize = 0;    /* NULL param pointer: let the engine produce
+                             * its INVALID_PARAM_STRUCT status */
+    }
+    memcpy(buf + auxSize, argp, mainSize);
+
+    BrokerReq rq = { .op = BR_OP_IOCTL,
+                     .fdToken = (uint32_t)(fd - BROKER_FD_BASE),
+                     .escNr = nr, .mainSize = mainSize,
+                     .auxSize = auxSize };
+    BrokerRep rep;
+    int rc = cli_call(&rq, buf, &rep, buf, auxSize + mainSize);
+    if (rc == 0 && rep.ret < 0) {
+        errno = rep.err ? rep.err : EIO;
+        rc = -1;
+    } else if (rc == 0) {
+        /* Copy back: main struct (status + outputs), then the embedded
+         * buffer with its pointer restored. */
+        if (rep.mainSize == mainSize)
+            memcpy(argp, buf + rep.auxSize, mainSize);
+        if (embedPtr) {
+            *embedPtr = embedSave;
+            if (rep.auxSize && embedSave)
+                memcpy((void *)(uintptr_t)embedSave, buf, rep.auxSize);
+        }
+    }
+    free(heapBuf);
+    return rc;
+}

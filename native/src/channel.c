@@ -72,6 +72,8 @@ struct TpurmChannel {
     bool stop;
     bool injectNext;
     _Atomic int error;         /* latched channel error */
+    _Atomic uint32_t evRefs;   /* live event-worker jobs referencing us
+                                * (event.c); destroy waits for zero */
     _Atomic uint32_t stallMs;  /* test injection: executor stall */
     uint64_t rcId;             /* unique id for RC attribution (ABA) */
     TpurmChannelErrorNotifier errNotifier;   /* under lock */
@@ -235,6 +237,12 @@ void tpurmChannelDestroy(TpurmChannel *ch)
     /* Leave the RC registry first: the RC service delivers under the
      * registry lock, so after this returns no delivery can hold ch. */
     tpuRcChannelUnregister(ch);
+    /* Event-worker jobs hold (channel, seq) dependencies pinned by a
+     * per-channel refcount taken while the submitter still held the
+     * channel live; the executor is still draining here, so their
+     * waits complete.  Wait for THIS channel's jobs only — a global
+     * drain would block on unrelated (possibly wedged) channels. */
+    tpurmEventQuiesceChannel(ch);
     pthread_mutex_lock(&ch->lock);
     ch->stop = true;
     pthread_cond_broadcast(&ch->cond);
@@ -474,6 +482,12 @@ void tpurmChannelRcDeliver(TpurmChannel *ch, uint64_t value, uint32_t kind)
     pthread_mutex_unlock(&ch->lock);
     if (cb)
         cb(ctx, value, kind);
+    /* RM event path (NV0005 analog, NV2080_NOTIFIERS_RC_ERROR): armed
+     * clients hear channel RC without registering a per-channel
+     * callback — the reference's krcEvent notification. */
+    if (ch->dev)
+        tpurmEventFire(ch->dev->inst, TPU_NOTIFIER_RC_ERROR,
+                       (uint32_t)value, (uint16_t)kind);
     if (kind == TPU_RC_CE_FAULT && tpuRegistryGet("rc_policy", 0) == 1) {
         tpurmChannelResetError(ch);
         tpuCounterAdd("rc_auto_resets", 1);
@@ -643,4 +657,21 @@ TpuStatus tpuCeStriperPush(TpuCeStriper *s, void *dst, const void *src,
         off += piece;
     }
     return TPU_OK;
+}
+
+/* ---- event-job pinning (event.c) ---- */
+
+void tpurmChannelEvRef(TpurmChannel *ch)
+{
+    atomic_fetch_add_explicit(&ch->evRefs, 1, memory_order_acq_rel);
+}
+
+void tpurmChannelEvUnref(TpurmChannel *ch)
+{
+    atomic_fetch_sub_explicit(&ch->evRefs, 1, memory_order_acq_rel);
+}
+
+uint32_t tpurmChannelEvRefs(TpurmChannel *ch)
+{
+    return atomic_load_explicit(&ch->evRefs, memory_order_acquire);
 }

@@ -355,6 +355,15 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
     }
     buf->activeDma--;
     pthread_mutex_unlock(&g_cxl.lock);
+    /* RM event delivery (NV0005 analog): clients that armed
+     * TPU_NOTIFIER_CXL_DMA hear the completion without polling the
+     * tracker — the event worker waits the copy's dependencies and
+     * fires.  A sync request's tracker is already complete, so the
+     * event fires immediately. */
+    if (st == TPU_OK)
+        tpurmEventNotifyTracker(&dmaTracker, dev->inst,
+                                TPU_NOTIFIER_CXL_DMA, /*info32=*/1,
+                                (uint16_t)(cxlToDev ? 1 : 0));
     tpuTrackerDeinit(&dmaTracker);
 
     if (st != TPU_OK) {

@@ -1,0 +1,325 @@
+/*
+ * RM event notification — NV0005 analog.
+ *
+ * Re-design of the reference's async event stack
+ * (src/nvidia/src/kernel/rmapi/event_notification.c, event.c): clients
+ * allocate an NV01_EVENT_OS_EVENT object under a subdevice, enable it
+ * with NV2080_CTRL_CMD_EVENT_SET_NOTIFICATION, and the engine delivers
+ * notifications without the client polling.  Where the reference
+ * signals a kernel OS-event handle, the userspace engine writes an
+ * NvNotification-layout record into client memory (in the reference's
+ * documented order: timestamp, info32, info16, status last —
+ * nvgputypes.h:50-55) and FUTEX_WAKEs a signal word.
+ *
+ * Async completion delivery: engines hand a completion DEPENDENCY
+ * (TpuTracker) to tpurmEventNotifyTracker; a worker thread waits the
+ * tracker and fires the matching notifier index.  This is the analog of
+ * the reference firing events from its completion interrupt bottom half
+ * — the tracker wait plays the interrupt's role.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "uvm/uvm_internal.h"
+
+#include <limits.h>
+#include <linux/futex.h>
+#include <stdatomic.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+typedef struct TpuRmEvent {
+    uint32_t hClient;
+    uint32_t handle;
+    uint32_t devInst;
+    uint32_t notifyIndex;
+    uint32_t action;            /* TPU_EVENT_ACTION_* (starts DISABLE:
+                                 * reference events notify only after
+                                 * SET_NOTIFICATION arms them) */
+    TpuOsEvent *os;             /* client memory; may be NULL */
+    struct TpuRmEvent *next;
+} TpuRmEvent;
+
+typedef struct EventJob {
+    TpuTracker deps;
+    /* Channel snapshot taken at enqueue (tracker entries prune as they
+     * complete): each holds an evRef pinning the channel until this
+     * job fires, so a concurrent channel destroy waits instead of
+     * freeing memory the tracker wait still touches. */
+    TpurmChannel **chans;
+    uint32_t nChans;
+    uint32_t devInst;
+    uint32_t notifyIndex;
+    uint32_t info32;
+    uint16_t info16;
+    struct EventJob *next;
+} EventJob;
+
+static struct {
+    pthread_mutex_t lock;
+    TpuRmEvent *events;
+    /* completion worker */
+    pthread_mutex_t jobLock;
+    pthread_cond_t jobCond;
+    EventJob *jobs, *jobsTail;
+    bool workerUp;
+    uint32_t jobsQueued, jobsDone;
+} g_ev = { .lock = PTHREAD_MUTEX_INITIALIZER,
+           .jobLock = PTHREAD_MUTEX_INITIALIZER,
+           .jobCond = PTHREAD_COND_INITIALIZER };
+
+/* ------------------------------------------------------------- registry */
+
+TpuStatus tpurmEventCreate(uint32_t hClient, uint32_t handle,
+                           uint32_t devInst, uint32_t notifyIndex,
+                           uint64_t userPtr)
+{
+    TpuRmEvent *e = calloc(1, sizeof(*e));
+    if (!e)
+        return TPU_ERR_NO_MEMORY;
+    e->hClient = hClient;
+    e->handle = handle;
+    e->devInst = devInst;
+    e->notifyIndex = notifyIndex;
+    e->action = TPU_EVENT_ACTION_DISABLE;
+    e->os = (TpuOsEvent *)(uintptr_t)userPtr;
+    pthread_mutex_lock(&g_ev.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
+    e->next = g_ev.events;
+    g_ev.events = e;
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
+    pthread_mutex_unlock(&g_ev.lock);
+    tpuCounterAdd("rm_events_allocated", 1);
+    return TPU_OK;
+}
+
+void tpurmEventDestroy(uint32_t hClient, uint32_t handle)
+{
+    pthread_mutex_lock(&g_ev.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
+    TpuRmEvent **pp = &g_ev.events;
+    while (*pp) {
+        if ((*pp)->hClient == hClient && (*pp)->handle == handle) {
+            TpuRmEvent *dead = *pp;
+            *pp = dead->next;
+            free(dead);
+            break;
+        }
+        pp = &(*pp)->next;
+    }
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
+    pthread_mutex_unlock(&g_ev.lock);
+}
+
+void tpurmEventDestroyClient(uint32_t hClient)
+{
+    pthread_mutex_lock(&g_ev.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
+    TpuRmEvent **pp = &g_ev.events;
+    while (*pp) {
+        if ((*pp)->hClient == hClient) {
+            TpuRmEvent *dead = *pp;
+            *pp = dead->next;
+            free(dead);
+            continue;
+        }
+        pp = &(*pp)->next;
+    }
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
+    pthread_mutex_unlock(&g_ev.lock);
+}
+
+TpuStatus tpurmEventSetNotification(uint32_t hClient, uint32_t devInst,
+                                    uint32_t notifyIndex, uint32_t action)
+{
+    if (action > TPU_EVENT_ACTION_REPEAT)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuStatus st = TPU_ERR_OBJECT_NOT_FOUND;
+    pthread_mutex_lock(&g_ev.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
+    for (TpuRmEvent *e = g_ev.events; e; e = e->next) {
+        if (e->hClient == hClient && e->devInst == devInst &&
+            e->notifyIndex == notifyIndex) {
+            e->action = action;
+            st = TPU_OK;
+        }
+    }
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
+    pthread_mutex_unlock(&g_ev.lock);
+    return st;
+}
+
+/* ------------------------------------------------------------- delivery */
+
+static void event_deliver(TpuRmEvent *e, uint32_t info32, uint16_t info16)
+{
+    TpuOsEvent *os = e->os;
+    if (os) {
+        uint64_t ns = uvmMonotonicNs();
+        /* Reference fill order (nvgputypes.h:50-55): timestamp,
+         * info32, info16, then status — status is the client's "data
+         * valid" flag, so it is stored LAST with release ordering. */
+        os->rec.timeStampNanoseconds[0] = (uint32_t)ns;
+        os->rec.timeStampNanoseconds[1] = (uint32_t)(ns >> 32);
+        os->rec.info32 = info32;
+        os->rec.info16 = info16;
+        __atomic_store_n(&os->rec.status,
+                         (uint16_t)TPU_NOTIFICATION_STATUS_DONE_SUCCESS,
+                         __ATOMIC_RELEASE);
+        __atomic_fetch_add(&os->signaled, 1, __ATOMIC_RELEASE);
+        syscall(SYS_futex, &os->signaled, FUTEX_WAKE, INT_MAX,
+                NULL, NULL, NULL);
+    }
+    if (e->action == TPU_EVENT_ACTION_SINGLE)
+        e->action = TPU_EVENT_ACTION_DISABLE;
+    tpuCounterAdd("rm_events_delivered", 1);
+}
+
+void tpurmEventFire(uint32_t devInst, uint32_t notifyIndex,
+                    uint32_t info32, uint16_t info16)
+{
+    pthread_mutex_lock(&g_ev.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
+    for (TpuRmEvent *e = g_ev.events; e; e = e->next) {
+        if (e->devInst == devInst && e->notifyIndex == notifyIndex &&
+            e->action != TPU_EVENT_ACTION_DISABLE)
+            event_deliver(e, info32, info16);
+    }
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
+    pthread_mutex_unlock(&g_ev.lock);
+}
+
+bool tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex)
+{
+    bool armed = false;
+    pthread_mutex_lock(&g_ev.lock);
+    tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
+    for (TpuRmEvent *e = g_ev.events; e; e = e->next) {
+        if (e->devInst == devInst && e->notifyIndex == notifyIndex &&
+            e->action != TPU_EVENT_ACTION_DISABLE) {
+            armed = true;
+            break;
+        }
+    }
+    tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
+    pthread_mutex_unlock(&g_ev.lock);
+    return armed;
+}
+
+/* ---------------------------------------------------- completion worker */
+
+static void *event_worker(void *arg)
+{
+    (void)arg;
+    for (;;) {
+        pthread_mutex_lock(&g_ev.jobLock);
+        while (!g_ev.jobs)
+            pthread_cond_wait(&g_ev.jobCond, &g_ev.jobLock);
+        EventJob *job = g_ev.jobs;
+        g_ev.jobs = job->next;
+        if (!g_ev.jobs)
+            g_ev.jobsTail = NULL;
+        pthread_mutex_unlock(&g_ev.jobLock);
+
+        tpuTrackerWait(&job->deps);
+        tpurmEventFire(job->devInst, job->notifyIndex, job->info32,
+                       job->info16);
+        pthread_mutex_lock(&g_ev.jobLock);
+        for (uint32_t i = 0; i < job->nChans; i++)
+            tpurmChannelEvUnref(job->chans[i]);
+        g_ev.jobsDone++;
+        pthread_cond_broadcast(&g_ev.jobCond);
+        pthread_mutex_unlock(&g_ev.jobLock);
+        tpuTrackerDeinit(&job->deps);
+        free(job->chans);
+        free(job);
+    }
+    return NULL;
+}
+
+TpuStatus tpurmEventNotifyTracker(const TpuTracker *deps, uint32_t devInst,
+                                  uint32_t notifyIndex, uint32_t info32,
+                                  uint16_t info16)
+{
+    /* Nobody armed: skip the job (the arm-after-submit race just means
+     * that request notifies nobody — same as the reference, where an
+     * event registered after the interrupt fired hears nothing). */
+    if (!tpurmEventArmed(devInst, notifyIndex))
+        return TPU_OK;
+    EventJob *job = calloc(1, sizeof(*job));
+    if (!job)
+        return TPU_ERR_NO_MEMORY;
+    tpuTrackerInit(&job->deps);
+    if (deps && tpuTrackerAddTracker(&job->deps, deps) != TPU_OK) {
+        tpuTrackerDeinit(&job->deps);
+        free(job);
+        return TPU_ERR_NO_MEMORY;
+    }
+    job->devInst = devInst;
+    job->notifyIndex = notifyIndex;
+    job->info32 = info32;
+    job->info16 = info16;
+    if (job->deps.count) {
+        job->chans = calloc(job->deps.count, sizeof(*job->chans));
+        if (!job->chans) {
+            tpuTrackerDeinit(&job->deps);
+            free(job);
+            return TPU_ERR_NO_MEMORY;
+        }
+        job->nChans = job->deps.count;
+        for (uint32_t i = 0; i < job->nChans; i++)
+            job->chans[i] = job->deps.entries[i].ch;
+    }
+
+    pthread_mutex_lock(&g_ev.jobLock);
+    /* Pin the channels under jobLock: the caller holds them live right
+     * now (it just submitted work on them), and the refs make a
+     * concurrent tpurmChannelDestroy wait in tpurmEventQuiesceChannel
+     * until this job has fired. */
+    for (uint32_t i = 0; i < job->nChans; i++)
+        tpurmChannelEvRef(job->chans[i]);
+    if (!g_ev.workerUp) {
+        pthread_t tid;
+        if (pthread_create(&tid, NULL, event_worker, NULL) != 0) {
+            pthread_mutex_unlock(&g_ev.jobLock);
+            tpuTrackerDeinit(&job->deps);
+            free(job);
+            return TPU_ERR_OPERATING_SYSTEM;
+        }
+        pthread_detach(tid);
+        g_ev.workerUp = true;
+    }
+    if (g_ev.jobsTail)
+        g_ev.jobsTail->next = job;
+    else
+        g_ev.jobs = job;
+    g_ev.jobsTail = job;
+    g_ev.jobsQueued++;
+    pthread_cond_signal(&g_ev.jobCond);
+    pthread_mutex_unlock(&g_ev.jobLock);
+    return TPU_OK;
+}
+
+/* Wait until every queued completion job has fired (teardown barrier:
+ * jobs hold channel pointers in their trackers, so engines quiesce
+ * events before destroying channels). */
+void tpurmEventQuiesce(void)
+{
+    pthread_mutex_lock(&g_ev.jobLock);
+    while (g_ev.jobsDone < g_ev.jobsQueued)
+        pthread_cond_wait(&g_ev.jobCond, &g_ev.jobLock);
+    pthread_mutex_unlock(&g_ev.jobLock);
+}
+
+/* Wait until no event job references `ch` (its evRef count drops to
+ * zero as jobs fire).  Unlike the global quiesce this never blocks on
+ * jobs waiting for OTHER channels — a wedged channel elsewhere must
+ * not stall an unrelated destroy. */
+void tpurmEventQuiesceChannel(TpurmChannel *ch)
+{
+    pthread_mutex_lock(&g_ev.jobLock);
+    while (tpurmChannelEvRefs(ch) != 0)
+        pthread_cond_wait(&g_ev.jobCond, &g_ev.jobLock);
+    pthread_mutex_unlock(&g_ev.jobLock);
+}

@@ -53,6 +53,9 @@ typedef enum {
 void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
     __attribute__((format(printf, 3, 4)));
 void tpuCounterAdd(const char *name, uint64_t delta);
+_Atomic uint64_t *tpuCounterRef(const char *name);
+void tpuCounterAddScoped(const char *name, uint32_t devInst,
+                         uint64_t delta);
 size_t tpuCountersDump(char *buf, size_t bufSize);
 
 /* --------------------------------------------------------------- registry */
@@ -190,6 +193,36 @@ void  uvmMmapRegistryOnRangeDestroy(uint64_t base);
 TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
                      TpuMemDesc *src, uint64_t srcOff, uint64_t size,
                      bool async, TpuTracker *outTracker);
+
+/* ------------------------------------------------- RM event notification
+ * (event.c — NV0005 analog; see abi.h for the wire structs.) */
+
+TpuStatus tpurmEventCreate(uint32_t hClient, uint32_t handle,
+                           uint32_t devInst, uint32_t notifyIndex,
+                           uint64_t userPtr);
+void      tpurmEventDestroy(uint32_t hClient, uint32_t handle);
+void      tpurmEventDestroyClient(uint32_t hClient);
+TpuStatus tpurmEventSetNotification(uint32_t hClient, uint32_t devInst,
+                                    uint32_t notifyIndex, uint32_t action);
+void      tpurmEventFire(uint32_t devInst, uint32_t notifyIndex,
+                         uint32_t info32, uint16_t info16);
+bool      tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex);
+TpuStatus tpurmEventNotifyTracker(const TpuTracker *deps, uint32_t devInst,
+                                  uint32_t notifyIndex, uint32_t info32,
+                                  uint16_t info16);
+void      tpurmEventQuiesce(void);
+void      tpurmEventQuiesceChannel(TpurmChannel *ch);
+void      tpurmChannelEvRef(TpurmChannel *ch);
+void      tpurmChannelEvUnref(TpurmChannel *ch);
+uint32_t  tpurmChannelEvRefs(TpurmChannel *ch);
+
+/* ------------------------------------------------- multi-process broker */
+
+TpuStatus tpurmBrokerServe(const char *path);
+int  tpurmBrokerOpen(const char *path);
+int  tpurmBrokerClose(int fd);
+int  tpurmBrokerIoctl(int fd, unsigned long request, void *argp);
+bool tpurmBrokerIsRemoteFd(int fd);
 
 /* ------------------------------------------------- robust channel RC */
 

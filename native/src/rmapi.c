@@ -83,6 +83,13 @@ int tpurm_open(const char *path)
         errno = EINVAL;
         return -1;
     }
+    /* Broker mode: RM traffic forwards to the engine-host process
+     * (UVM stays local — managed memory cannot cross a process without
+     * the arena mapping the RDMA path provides). */
+    if (getenv("TPURM_BROKER") &&
+        strcmp(path, "/dev/nvidia-uvm") != 0 &&
+        strcmp(path, "/dev/tpu-uvm") != 0)
+        return tpurmBrokerOpen(path);
     tpuDeviceGlobalInit();
 
     if (strcmp(path, "/dev/nvidiactl") == 0 || strcmp(path, "/dev/tpuctl") == 0) {
@@ -147,6 +154,8 @@ static void fd_finalize_locked(PseudoFd *fd)
 
 int tpurm_close(int pfd)
 {
+    if (tpurmBrokerIsRemoteFd(pfd))
+        return tpurmBrokerClose(pfd);
     int idx = pfd - PSEUDO_FD_BASE;
     if (idx < 0 || idx >= MAX_PSEUDO_FDS) {
         errno = EBADF;
@@ -206,6 +215,8 @@ static void object_free_subtree(RmClient *client, uint32_t handle)
         if ((*pp)->handle == handle) {
             RmObject *dead = *pp;
             *pp = dead->next;
+            if (dead->hClass == TPU_CLASS_EVENT_OS)
+                tpurmEventDestroy(client->hClient, dead->handle);
             free(dead);
             return;
         }
@@ -269,6 +280,21 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
         if (sp->subDeviceId != 0)
             return TPU_ERR_INVALID_ARGUMENT;
         dev = parent->dev;
+    } else if (p->hClass == TPU_CLASS_EVENT_OS) {
+        /* NV01_EVENT_OS_EVENT (cl0005.h): parented under a subdevice
+         * (or device); hSrcResource must resolve within the client. */
+        RmObject *parent = object_find(client, p->hObjectParent);
+        if (!parent || !parent->dev)
+            return TPU_ERR_INVALID_OBJECT_PARENT;
+        if (p->paramsSize != sizeof(TpuEventAllocParams) || !allocParams)
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuEventAllocParams *ep = allocParams;
+        if (ep->hClass != TPU_CLASS_EVENT_OS)
+            return TPU_ERR_INVALID_CLASS;
+        if (ep->hSrcResource != p->hObjectParent &&
+            !object_find(client, ep->hSrcResource))
+            return TPU_ERR_OBJECT_NOT_FOUND;
+        dev = parent->dev;
     } else {
         return TPU_ERR_INVALID_CLASS;
     }
@@ -276,6 +302,20 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
     RmObject *obj = calloc(1, sizeof(*obj));
     if (!obj)
         return TPU_ERR_NO_MEMORY;
+    if (p->hClass == TPU_CLASS_EVENT_OS) {
+        /* Register only now that the handle-tree node exists — the
+         * reverse order would leave an ownerless live event behind if
+         * this alloc failed (un-freeable, yet armable + delivering
+         * into client memory). */
+        TpuEventAllocParams *ep = allocParams;
+        TpuStatus est = tpurmEventCreate(client->hClient, p->hObjectNew,
+                                         dev->inst, ep->notifyIndex,
+                                         ep->data);
+        if (est != TPU_OK) {
+            free(obj);
+            return est;
+        }
+    }
     obj->handle = p->hObjectNew;
     obj->hClass = p->hClass;
     obj->hParent = p->hObjectParent;
@@ -320,6 +360,7 @@ TpuStatus tpurmFree(TpuRmFreeParams *p)
             client->objects = o->next;
             free(o);
         }
+        tpurmEventDestroyClient(client->hClient);
         client->used = false;
         tpuLog(TPU_LOG_INFO, "rmapi", "client 0x%x freed", p->hRoot);
     } else if (!object_find(client, p->hObjectOld)) {
@@ -434,6 +475,16 @@ static TpuStatus ctrl_subdevice(RmObject *subdev, TpuRmControlParams *p,
     TpurmDevice *dev = subdev->dev;
 
     switch (p->cmd) {
+    case TPU_CTRL_CMD_EVENT_SET_NOTIFICATION: {
+        /* NV2080_CTRL_CMD_EVENT_SET_NOTIFICATION (ctrl2080event.h:79):
+         * arms/disarms the client's events on this subdevice's
+         * notifier index. */
+        if (p->paramsSize != sizeof(TpuCtrlEventSetNotificationParams))
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuCtrlEventSetNotificationParams *ep = params;
+        return tpurmEventSetNotification(p->hClient, dev->inst,
+                                         ep->event, ep->action);
+    }
     case TPU_CTRL_CMD_BUS_GET_CXL_INFO: {
         if (p->paramsSize != sizeof(TpuCtrlGetCxlInfoParams))
             return TPU_ERR_INVALID_PARAM_STRUCT;
@@ -592,6 +643,8 @@ int tpurm_munmap_hook(void *addr, size_t length)
 
 int tpurm_ioctl(int pfd, unsigned long request, void *argp)
 {
+    if (tpurmBrokerIsRemoteFd(pfd))
+        return tpurmBrokerIoctl(pfd, request, argp);
     int idx = pfd - PSEUDO_FD_BASE;
     if (idx < 0 || idx >= MAX_PSEUDO_FDS) {
         errno = EBADF;

@@ -39,6 +39,7 @@
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/syscall.h>
+#include <stdio.h>
 #include <ucontext.h>
 #include <unistd.h>
 
@@ -120,6 +121,8 @@ static struct {
 
     FaultWorker workers[FAULT_MAX_WORKERS];
     uint32_t nWorkers;
+    _Atomic uint32_t inService;       /* workers currently in a batch */
+    _Atomic uint32_t serviceHighWater;/* max simultaneous (observability) */
     struct sigaction oldSegv;
 
     /* Stats (shared; latNs slot writes race benignly — it is a
@@ -535,6 +538,52 @@ static TpuStatus service_one(UvmFaultEntry *e)
                     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "pte-install");
                     pthread_mutex_unlock(&blk->lock);
                 }
+                if (e->source == UVM_FAULT_SRC_CPU) {
+                    /* Ref caches are _Atomic: several workers race the
+                     * first resolution (idempotent, but a plain pointer
+                     * would be a C11 data race). */
+                    static _Atomic(_Atomic uint64_t *) cpuRef;
+                    _Atomic uint64_t *r = atomic_load_explicit(
+                        &cpuRef, memory_order_relaxed);
+                    if (!r) {
+                        r = tpuCounterRef("uvm_cpu_fault_count");
+                        atomic_store_explicit(&cpuRef, r,
+                                              memory_order_relaxed);
+                    }
+                    if (r)
+                        atomic_fetch_add_explicit(r, 1,
+                                                  memory_order_relaxed);
+                } else {
+                    /* Per-device + aggregate, refs resolved once. */
+                    static _Atomic(_Atomic uint64_t *) aggRef;
+                    static _Atomic(_Atomic uint64_t *) devRef[32];
+                    _Atomic uint64_t *r = atomic_load_explicit(
+                        &aggRef, memory_order_relaxed);
+                    if (!r) {
+                        r = tpuCounterRef("uvm_gpu_fault_count");
+                        atomic_store_explicit(&aggRef, r,
+                                              memory_order_relaxed);
+                    }
+                    if (r)
+                        atomic_fetch_add_explicit(r, 1,
+                                                  memory_order_relaxed);
+                    if (e->devInst < 32) {
+                        r = atomic_load_explicit(&devRef[e->devInst],
+                                                 memory_order_relaxed);
+                        if (!r) {
+                            char nm[48];
+                            snprintf(nm, sizeof(nm),
+                                     "uvm_gpu_fault_count[d%u]",
+                                     e->devInst);
+                            r = tpuCounterRef(nm);
+                            atomic_store_explicit(&devRef[e->devInst], r,
+                                                  memory_order_relaxed);
+                        }
+                        if (r)
+                            atomic_fetch_add_explicit(r, 1,
+                                                      memory_order_relaxed);
+                    }
+                }
                 uvmToolsEmit(vs, e->source == UVM_FAULT_SRC_CPU
                                      ? UVM_EVENT_CPU_FAULT
                                      : UVM_EVENT_GPU_FAULT,
@@ -704,6 +753,18 @@ static void *fault_service_thread(void *arg)
         }
         if (n == 0)
             continue;
+        /* Cross-worker concurrency high-water (observability for the
+         * multi-worker module test and procfs): counted only once a
+         * real batch is in hand — an empty wake must not inflate the
+         * concurrency the test asserts. */
+        uint32_t now = atomic_fetch_add_explicit(&g_fault.inService, 1,
+                                                 memory_order_acq_rel) + 1;
+        uint32_t hw = atomic_load_explicit(&g_fault.serviceHighWater,
+                                           memory_order_relaxed);
+        while (now > hw &&
+               !atomic_compare_exchange_weak_explicit(
+                   &g_fault.serviceHighWater, &hw, now,
+                   memory_order_acq_rel, memory_order_relaxed)) { }
 
         /* preprocess_fault_batch (:1134): coalesce duplicates — entries
          * whose page span is covered by an earlier entry of the same
@@ -852,7 +913,19 @@ static void *fault_service_thread(void *arg)
             }
         }
         atomic_fetch_add(&g_fault.batches, 1);
-        tpuCounterAdd("uvm_fault_batches", 1);
+        {
+            static _Atomic(_Atomic uint64_t *) ref;
+            _Atomic uint64_t *r = atomic_load_explicit(
+                &ref, memory_order_relaxed);
+            if (!r) {
+                r = tpuCounterRef("uvm_fault_batches");
+                atomic_store_explicit(&ref, r, memory_order_relaxed);
+            }
+            if (r)
+                atomic_fetch_add_explicit(r, 1, memory_order_relaxed);
+        }
+        atomic_fetch_sub_explicit(&g_fault.inService, 1,
+                                  memory_order_acq_rel);
         atomic_store(&w->servicing, false);
         access_counter_sweep(w);
     }
@@ -1186,4 +1259,16 @@ TpuStatus uvmDeviceAccess(UvmVaSpace *vs, uint32_t devInst, void *base,
     TpuStatus st = uvmFaultServiceSync(&e);
     uvmPmExitShared();
     return st;
+}
+
+/* Multi-worker observability (module test + procfs). */
+uint32_t uvmFaultWorkerCount(void)
+{
+    return g_fault.nWorkers;
+}
+
+uint32_t uvmFaultServiceHighWater(void)
+{
+    return atomic_load_explicit(&g_fault.serviceHighWater,
+                                memory_order_acquire);
 }

@@ -417,6 +417,8 @@ void uvmFaultStatsRecordMigration(uint64_t bytes);
 void uvmFaultStatsRecordEviction(void);
 /* PM drain barrier + space/block iteration (uvm_pm.c consumers). */
 void uvmFaultRingDrain(void);
+uint32_t uvmFaultWorkerCount(void);
+uint32_t uvmFaultServiceHighWater(void);
 void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk));
 void uvmFaultForEachSpaceCtx(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk,
                                         void *ctx), void *ctx);

@@ -515,6 +515,9 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                 p += span - 1;
             }
             uvmFaultStatsRecordMigration(bytes);
+            if (bytes)
+                tpuCounterAddScoped("uvm_bytes_xfer_dth", blk->hbmDevInst,
+                                    bytes);
             uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_EVICTION, tier,
                          UVM_TIER_HOST, blk->hbmDevInst, blk->start, bytes);
         }
@@ -686,6 +689,12 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             pthread_mutex_unlock(&blk->lock);
             return st;
         }
+        /* Transfer accounting with the reference's counter-scope split
+         * (UvmCounterNameBytesXferHtD/DtH, uvm_types.h:283-284; scope
+         * ProcessSingleGpu vs ProcessAllGpus): per-device lines live
+         * beside the aggregate. */
+        if (bytes && dst.tier == UVM_TIER_HBM)
+            tpuCounterAddScoped("uvm_bytes_xfer_htd", dst.devInst, bytes);
 
         /* Commit masks.  Residency movement stales any accessed-by device
          * mapping to the old location; clear so the next device access
@@ -727,6 +736,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         }
         if (bytes) {
             uvmFaultStatsRecordMigration(bytes);
+            tpuCounterAddScoped("uvm_bytes_xfer_dth", blk->hbmDevInst,
+                                bytes);
             if (readDup)
                 /* Source copies survived: this copy created duplicates
                  * (reference emits UvmEventTypeReadDuplicate from the

@@ -1,0 +1,204 @@
+/*
+ * RM event notification test (NV0005 analog).
+ *
+ * Walker-style flow against the reference's async event semantics
+ * (rmapi/event_notification.c): allocate an NV01_EVENT_OS_EVENT under
+ * the subdevice, arm it with NV2080_CTRL_CMD_EVENT_SET_NOTIFICATION,
+ * fire an ASYNC CXL DMA, and observe completion by futex-waiting the
+ * OS-event word — never polling the transfer tracker.  Also covers
+ * SINGLE-shot disarm, validation errors, and teardown.
+ */
+#include <assert.h>
+#include <errno.h>
+#include <linux/futex.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "tpurm/tpurm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+#define BUF_SIZE (4u * 1024 * 1024)
+
+static TpuStatus rm_alloc(uint32_t hRoot, uint32_t hParent, uint32_t hNew,
+                          uint32_t hClass, void *params, uint32_t size)
+{
+    TpuRmAllocParams p;
+    memset(&p, 0, sizeof(p));
+    p.hRoot = hClass == TPU_CLASS_ROOT ? hNew : hRoot;
+    p.hObjectParent = hClass == TPU_CLASS_ROOT ? hNew : hParent;
+    p.hObjectNew = hNew;
+    p.hClass = hClass;
+    p.pAllocParms = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    return tpurmAlloc(&p);
+}
+
+static TpuStatus rm_control(uint32_t hClient, uint32_t hObject, uint32_t cmd,
+                            void *params, uint32_t size)
+{
+    TpuRmControlParams p;
+    memset(&p, 0, sizeof(p));
+    p.hClient = hClient;
+    p.hObject = hObject;
+    p.cmd = cmd;
+    p.params = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    return tpurmControl(&p);
+}
+
+/* Futex-wait until *word != seen (with a deadline) — the client-side
+ * half of the OS-event protocol.  Returns 0 on wake, -1 on timeout. */
+static int os_event_wait(TpuOsEvent *ev, uint32_t seen, int timeout_s)
+{
+    struct timespec deadline, now;
+    clock_gettime(CLOCK_REALTIME, &deadline);
+    deadline.tv_sec += timeout_s;
+    for (;;) {
+        uint32_t cur = __atomic_load_n(&ev->signaled, __ATOMIC_ACQUIRE);
+        if (cur != seen)
+            return 0;
+        clock_gettime(CLOCK_REALTIME, &now);
+        if (now.tv_sec >= deadline.tv_sec)
+            return -1;
+        struct timespec rel = { .tv_sec = 1, .tv_nsec = 0 };
+        syscall(SYS_futex, &ev->signaled, FUTEX_WAIT, cur, &rel, NULL, 0);
+    }
+}
+
+int main(void)
+{
+    const uint32_t hClient = 0xeeee0001, hDevice = 0xeeee0002,
+                   hSubdev = 0xeeee0003, hEvent = 0xeeee0004;
+
+    CHECK(rm_alloc(0, 0, hClient, TPU_CLASS_ROOT, NULL, 0) == TPU_OK);
+    TpuCtrlAttachIdsParams attach;
+    memset(&attach, 0, sizeof(attach));
+    attach.gpuIds[0] = TPU_CTRL_ATTACH_ALL_PROBED;
+    CHECK(rm_control(hClient, hClient, TPU_CTRL_CMD_GPU_ATTACH_IDS, &attach,
+                     sizeof(attach)) == TPU_OK);
+    TpuDeviceAllocParams devParams;
+    memset(&devParams, 0, sizeof(devParams));
+    CHECK(rm_alloc(hClient, hClient, hDevice, TPU_CLASS_DEVICE, &devParams,
+                   sizeof(devParams)) == TPU_OK);
+    TpuSubdeviceAllocParams subParams = { .subDeviceId = 0 };
+    CHECK(rm_alloc(hClient, hDevice, hSubdev, TPU_CLASS_SUBDEVICE,
+                   &subParams, sizeof(subParams)) == TPU_OK);
+
+    /* ---- event alloc validation ---- */
+    TpuOsEvent os;
+    memset(&os, 0, sizeof(os));
+    os.rec.status = TPU_NOTIFICATION_STATUS_IN_PROGRESS;
+    TpuEventAllocParams ep;
+    memset(&ep, 0, sizeof(ep));
+    ep.hParentClient = hClient;
+    ep.hSrcResource = hSubdev;
+    ep.hClass = TPU_CLASS_EVENT_OS;
+    ep.notifyIndex = TPU_NOTIFIER_CXL_DMA;
+    ep.data = (uint64_t)(uintptr_t)&os;
+    /* Wrong size. */
+    CHECK(rm_alloc(hClient, hSubdev, hEvent, TPU_CLASS_EVENT_OS, &ep, 4) ==
+          TPU_ERR_INVALID_PARAM_STRUCT);
+    /* Parent must resolve to a device-backed object. */
+    CHECK(rm_alloc(hClient, hClient, hEvent, TPU_CLASS_EVENT_OS, &ep,
+                   sizeof(ep)) == TPU_ERR_INVALID_OBJECT_PARENT);
+    CHECK(rm_alloc(hClient, hSubdev, hEvent, TPU_CLASS_EVENT_OS, &ep,
+                   sizeof(ep)) == TPU_OK);
+
+    /* Unarmed events never fire.  Arm: unknown index is OBJECT_NOT_FOUND,
+     * then arm REPEAT for real. */
+    TpuCtrlEventSetNotificationParams sn;
+    memset(&sn, 0, sizeof(sn));
+    sn.event = 77;
+    sn.action = TPU_EVENT_ACTION_REPEAT;
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_EVENT_SET_NOTIFICATION,
+                     &sn, sizeof(sn)) == TPU_ERR_OBJECT_NOT_FOUND);
+    sn.event = TPU_NOTIFIER_CXL_DMA;
+    sn.action = 99;     /* invalid action */
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_EVENT_SET_NOTIFICATION,
+                     &sn, sizeof(sn)) == TPU_ERR_INVALID_ARGUMENT);
+    sn.action = TPU_EVENT_ACTION_REPEAT;
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_EVENT_SET_NOTIFICATION,
+                     &sn, sizeof(sn)) == TPU_OK);
+
+    /* ---- async CXL DMA completes the event, no polling ---- */
+    uint8_t *buf = mmap(NULL, BUF_SIZE, PROT_READ | PROT_WRITE,
+                        MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    CHECK(buf != MAP_FAILED);
+    memset(buf, 0x5a, BUF_SIZE);
+    TpuCtrlRegisterCxlBufferParams reg;
+    memset(&reg, 0, sizeof(reg));
+    reg.baseAddress = (uint64_t)(uintptr_t)buf;
+    reg.size = BUF_SIZE;
+    reg.cxlVersion = 2;
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER,
+                     &reg, sizeof(reg)) == TPU_OK);
+
+    TpuCtrlCxlP2pDmaRequestParams dma;
+    memset(&dma, 0, sizeof(dma));
+    dma.cxlBufferHandle = reg.bufferHandle;
+    dma.size = BUF_SIZE;
+    dma.flags = TPU_CXL_DMA_FLAG_CXL_TO_DEV | TPU_CXL_DMA_FLAG_ASYNC;
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST,
+                     &dma, sizeof(dma)) == TPU_OK);
+
+    /* Completion arrives via futex wake; the notification record is
+     * filled timestamp/info32/info16 then status (release-ordered). */
+    CHECK(os_event_wait(&os, 0, 10) == 0);
+    CHECK(__atomic_load_n(&os.rec.status, __ATOMIC_ACQUIRE) ==
+          TPU_NOTIFICATION_STATUS_DONE_SUCCESS);
+    CHECK(os.rec.info32 == 1);
+    CHECK(os.rec.timeStampNanoseconds[0] != 0 ||
+          os.rec.timeStampNanoseconds[1] != 0);
+    uint32_t fired = os.signaled;
+    CHECK(fired >= 1);
+
+    /* ---- SINGLE action disarms after one delivery ---- */
+    sn.action = TPU_EVENT_ACTION_SINGLE;
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_EVENT_SET_NOTIFICATION,
+                     &sn, sizeof(sn)) == TPU_OK);
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST,
+                     &dma, sizeof(dma)) == TPU_OK);
+    CHECK(os_event_wait(&os, fired, 10) == 0);
+    uint32_t after_single = os.signaled;
+    /* Now disarmed: another DMA must NOT signal. */
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST,
+                     &dma, sizeof(dma)) == TPU_OK);
+    CHECK(os_event_wait(&os, after_single, 2) == -1);
+
+    /* ---- teardown: freeing the event object unregisters it ---- */
+    sn.action = TPU_EVENT_ACTION_REPEAT;
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_EVENT_SET_NOTIFICATION,
+                     &sn, sizeof(sn)) == TPU_OK);
+    TpuRmFreeParams fp;
+    memset(&fp, 0, sizeof(fp));
+    fp.hRoot = hClient;
+    fp.hObjectParent = hSubdev;
+    fp.hObjectOld = hEvent;
+    CHECK(tpurmFree(&fp) == TPU_OK);
+    uint32_t before = os.signaled;
+    CHECK(rm_control(hClient, hSubdev, TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST,
+                     &dma, sizeof(dma)) == TPU_OK);
+    CHECK(os_event_wait(&os, before, 2) == -1);
+
+    TpuCtrlUnregisterCxlBufferParams unreg = { .bufferHandle =
+                                                   reg.bufferHandle };
+    CHECK(rm_control(hClient, hSubdev,
+                     TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER, &unreg,
+                     sizeof(unreg)) == TPU_OK);
+    memset(&fp, 0, sizeof(fp));
+    fp.hRoot = hClient;
+    fp.hObjectOld = hClient;
+    CHECK(tpurmFree(&fp) == TPU_OK);
+    munmap(buf, BUF_SIZE);
+    printf("event_test: all assertions passed\n");
+    return 0;
+}

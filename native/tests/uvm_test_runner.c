@@ -70,6 +70,7 @@ int main(void)
     run_module_test(fd, UVM_TPU_TEST_RANGE_SPLIT, "range_split");
     run_module_test(fd, UVM_TPU_TEST_HMM_PAGEABLE, "hmm_pageable");
     run_module_test(fd, UVM_TPU_TEST_DEV_MMU, "dev_mmu");
+    run_module_test(fd, UVM_TPU_TEST_MULTI_WORKER, "multi_worker");
 
     /* ---- managed lifecycle over the raw ABI ---- */
     UvmTpuAllocManagedParams alloc = { .length = 8 << 20 };

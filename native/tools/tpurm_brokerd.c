@@ -1,0 +1,61 @@
+/*
+ * tpurm_brokerd — engine-host daemon for multi-process RM.
+ *
+ * Owns the device engine in this process and serves the NVOS escape
+ * surface over a unix socket (broker.c); client processes run the
+ * UNMODIFIED reference userspace under the LD_PRELOAD shim with
+ * TPURM_BROKER=<socket> and attach concurrently, each in its own
+ * handle namespace — the reference's rs_server client model
+ * (src/libraries/resserv/src/rs_server.c) with the kernel replaced by
+ * a host process.
+ *
+ * Usage: tpurm_brokerd <socket-path> [ready-file]
+ * Writes "ready\n" to ready-file once listening, then serves until
+ * SIGTERM/SIGINT.
+ */
+#define _GNU_SOURCE
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+#include "tpurm/tpurm.h"
+
+static volatile sig_atomic_t g_stop;
+
+static void on_sig(int sig)
+{
+    (void)sig;
+    g_stop = 1;
+}
+
+int main(int argc, char **argv)
+{
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <socket-path> [ready-file]\n", argv[0]);
+        return 2;
+    }
+    /* Engine init (device table, arenas). */
+    int fd = tpurm_open("/dev/tpuctl");
+    if (fd < 0) {
+        perror("tpurm_open");
+        return 1;
+    }
+    if (tpurmBrokerServe(argv[1]) != TPU_OK) {
+        fprintf(stderr, "broker serve failed on %s\n", argv[1]);
+        return 1;
+    }
+    if (argc > 2) {
+        FILE *f = fopen(argv[2], "w");
+        if (f) {
+            fputs("ready\n", f);
+            fclose(f);
+        }
+    }
+    signal(SIGTERM, on_sig);
+    signal(SIGINT, on_sig);
+    while (!g_stop)
+        pause();
+    tpurm_close(fd);
+    return 0;
+}
