@@ -460,6 +460,51 @@ def measure_tokens_per_s() -> dict:
     }
 
 
+def _measure_isolated(fn_name: str, timeout_s: int, fallback,
+                      tag: str) -> dict:
+    """Run a measurement in a FRESH subprocess: the relay slows with
+    process RSS, and by the time main() reaches the later sections the
+    managed pools have pushed RSS past the point where timings reflect
+    the code under test rather than the process.  The result carries
+    `<tag>_isolated` so a reader can tell which path produced it.  A
+    child TIMEOUT returns only the marker — rerunning the same
+    multi-minute measurement in-process would both double the wall time
+    and produce exactly the RSS-distorted number this path exists to
+    avoid.  Other child failures (e.g. an exclusive-access backend
+    refusing a second client) fall back in-process, marked."""
+    import json as _json
+    import subprocess
+    import sys
+
+    code = (f"import json; from bench import {fn_name}; "
+            f"print('ISO_JSON ' + json.dumps({fn_name}()))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in proc.stdout.splitlines():
+            if line.startswith("ISO_JSON "):
+                out = _json.loads(line[len("ISO_JSON "):])
+                out[f"{tag}_isolated"] = True
+                return out
+        # The child ran (possibly for minutes) but produced no result:
+        # rerunning in-process would both double the wall time and
+        # yield the distorted number isolation exists to avoid.  Record
+        # the failure cause instead.
+        return {f"{tag}_isolated": False,
+                f"{tag}_child_error":
+                    (proc.stderr or "")[-200:] or f"rc={proc.returncode}"}
+    except subprocess.TimeoutExpired:
+        return {f"{tag}_isolated": False, f"{tag}_timeout": True}
+    except Exception:
+        pass
+    # Spawn itself failed (no subprocess ever ran): in-process fallback.
+    out = fallback()
+    out[f"{tag}_isolated"] = False
+    return out
+
+
 def _prior_round_latencies() -> dict:
     """p50/p95 from the newest BENCH_r*.json the driver recorded, so the
     judge (and we) see round-over-round fault-latency movement — r2
@@ -531,16 +576,37 @@ def main() -> None:
                 extra["transport_efficiency"] = round(
                     bps / 1e9 / extra["loaded_ceiling_gbps"], 3)
         if on_tpu:
+            # Release this process's device state before the isolated
+            # sections: the relay's transport slows with the total
+            # buffer footprint it serves, and the oversub/ceiling
+            # sections above leave a large allocator reservation that
+            # would otherwise tax every child measurement.
             try:
-                extra.update(measure_flash_mfu())
+                import jax
+                import jax.extend.backend as _jeb
+                jax.clear_caches()
+                _jeb.clear_backends()
             except Exception:
                 pass
             try:
-                extra.update(measure_paged_decode_bw())
+                extra.update(_measure_isolated(
+                    "measure_flash_mfu", 600,
+                    measure_flash_mfu, "flash"))
+            except Exception:
+                pass
+            try:
+                extra.update(_measure_isolated(
+                    "measure_paged_decode_bw", 300,
+                    measure_paged_decode_bw, "paged"))
             except Exception:
                 pass
         try:
-            extra.update(measure_tokens_per_s())
+            if on_tpu:
+                extra.update(_measure_isolated(
+                    "measure_tokens_per_s", 480,
+                    measure_tokens_per_s, "tokens"))
+            else:
+                extra.update(measure_tokens_per_s())
         except Exception:
             pass
 

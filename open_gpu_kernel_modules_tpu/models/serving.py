@@ -527,15 +527,12 @@ class TieredKVCache:
             # inside an activation whose staged bases were read before
             # this point; a drain now would clear entries those bases
             # still compose with.
-            idx = np.array(spill, np.int32)
-            k_c = np.asarray(_gather_pages(self.k_slots, jnp.asarray(idx)))
-            v_c = np.asarray(_gather_pages(self.v_slots, jnp.asarray(idx)))
-            for i, s in enumerate(spill):
+            self._write_back([(s, int(self.slot_owner[s])) for s in spill])
+            for s in spill:
                 page = int(self.slot_owner[s])
-                self.backing.write_page(page, k_c[:, i], v_c[:, i])
-                self.slot_of[page] = -1
+                if page >= 0:
+                    self.slot_of[page] = -1
                 self.slot_owner[s] = -1
-                self._dirty_slots.discard(s)
             self.stats["sync_flushes"] += len(spill)
         if not saves:
             return
@@ -881,6 +878,44 @@ class TieredKVCache:
             self.last_token[idx] = np.asarray(last_tokens)
         self._active_slots.clear()
 
+    def _write_back(self, pairs: List[Tuple[int, int]]) -> None:
+        """Synchronously materialize (slot, page) pairs into the
+        backing (one batched device readback) and clear their dirty
+        bits.  Shared by the ring-spill path and flush_group."""
+        if not pairs:
+            return
+        idx = np.array([s for s, _ in pairs], np.int32)
+        k_c = np.asarray(_gather_pages(self.k_slots, jnp.asarray(idx)))
+        v_c = np.asarray(_gather_pages(self.v_slots, jnp.asarray(idx)))
+        for i, (slot, page) in enumerate(pairs):
+            self.backing.write_page(page, k_c[:, i], v_c[:, i])
+            self._dirty_slots.discard(slot)
+
+    def flush_group(self, seq_ids: Sequence[int]) -> None:
+        """Write a group's dirty RESIDENT pages to the backing and mark
+        them clean (one batched device readback).  A setup-time call —
+        prefill marks every prompt page dirty, and flushing them here
+        turns the decode phase's evictions of prompt pages into free
+        clean drops instead of victim-ring traffic.  Any parked ring
+        entries for these pages are superseded and recycle."""
+        m = self.pages_per_seq
+        flush: List[Tuple[int, int]] = []       # (slot, page)
+        for b in seq_ids:
+            for pg in range(m):
+                page = b * m + pg
+                slot = int(self.slot_of[page])
+                if slot >= 0 and slot in self._dirty_slots:
+                    flush.append((slot, page))
+        if not flush:
+            return
+        self._write_back(flush)
+        self.stats["setup_flushes"] = self.stats.get("setup_flushes", 0) + \
+            len(flush)
+        for _, page in flush:
+            e = self._victim_map.pop(page, None)
+            if e is not None:
+                self._victim_free.append(e)
+
     def close(self) -> None:
         try:
             self.drain_flushes()
@@ -890,11 +925,15 @@ class TieredKVCache:
 
 def prefill_group(cfg: llama.LlamaConfig, params: Dict[str, Any],
                   cache: TieredKVCache, seq_ids, prompt: jax.Array) -> None:
-    """Prefill a group of sequences into the tiered cache."""
+    """Prefill a group of sequences into the tiered cache.  The
+    group's pages are flushed to the backing before returning (setup
+    cost), so the decode phase starts with a clean pool and its
+    evictions of prompt pages are free drops."""
     view = cache.activate(seq_ids, new_tokens=prompt.shape[1])
     logits, view = prefill(cfg, params, prompt, view)
     cache.sync_from(view, seq_ids,
                     np.asarray(jnp.argmax(logits, axis=-1), np.int32))
+    cache.flush_group(seq_ids)
 
 
 def decode_rounds(cfg: llama.LlamaConfig, params: Dict[str, Any],

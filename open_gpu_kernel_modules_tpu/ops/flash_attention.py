@@ -25,6 +25,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LOG2_E = 1.4426950408889634   # softmax runs base-2; scale carries log2(e)
 
 
 def _flash_kernel_rows(q_ref, k_ref, v_ref, o_ref, *, scale: float,
@@ -253,7 +254,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # pass inside the kernel would touch every score element on the
     # VPU instead (scores outnumber Q elements by seq/d * the k-step
     # count).
-    scale = 1.4426950408889634 / (d ** 0.5)
+    scale = LOG2_E / (d ** 0.5)
     if prescale_q:
         qf = (q.astype(jnp.float32) * scale).astype(q.dtype)
     else:

@@ -6,10 +6,18 @@ CXL-tiered KV cache (BASELINE config #4): the pool's backing pages live
 in UVM managed memory and migrate HBM<->CXL under the fault engine,
 while this op consumes whatever pages are device-resident.
 
-Decode is HBM-bandwidth-bound, not FLOPs-bound, so the op is expressed
-in jnp (gather + one [B,H,1,S] attention) and left to XLA to fuse — a
-hand-tiled kernel buys nothing when a single query row streams the
-whole cache once.  Prefill uses ops.flash_attention instead.
+Decode is HBM-bandwidth-bound, not FLOPs-bound.  Two paths:
+
+- a Pallas kernel (impl="kernel") that streams each sequence's pages
+  DIRECTLY from the pool via scalar-prefetched page-table indices —
+  one HBM pass over the live KV.  The jnp expression materializes the
+  gathered [B, S, KV, D] K and V (a full read+write) before attention
+  reads them again, ~3x the fundamental traffic.
+- the jnp fallback (impl="jnp") for small head dims (the kernel's K/V
+  block collapses [KV, D] into the lane axis, which Mosaic requires be
+  a multiple of 128) and non-TPU backends.
+
+Prefill uses ops.flash_attention instead.
 """
 
 from __future__ import annotations
@@ -18,12 +26,121 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import LOG2_E, NEG_INF
 
 
-@functools.partial(jax.jit, static_argnames=("num_heads",))
+def _paged_decode_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page: int, heads: int,
+                         kv_heads: int, head_dim: int):
+    b = pl.program_id(0)
+    mi = pl.program_id(1)
+    m_steps = pl.num_programs(1)
+    rep = heads // kv_heads
+    d = head_dim
+
+    @pl.when(mi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+    live = mi * page < seq_len
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                       # [H, D] (pre-scaled)
+        k = k_ref[0]                       # [page, KV*D]
+        v = v_ref[0]
+        # Scores per kv head: [rep, D] x [D, page] on the MXU.  The
+        # python loop is static (KV is a compile-time constant).
+        srows = []
+        for kvh in range(kv_heads):
+            qs = q[kvh * rep:(kvh + 1) * rep, :]
+            ks = k[:, kvh * d:(kvh + 1) * d]
+            srows.append(jax.lax.dot_general(
+                qs, ks, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        s = jnp.concatenate(srows, axis=0)          # [H, page]
+
+        tok = mi * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(tok < seq_len, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
+        l_scr[:, 0:1] = corr * l_scr[:, 0:1] + jnp.sum(p, axis=-1,
+                                                       keepdims=True)
+        pv_rows = []
+        pb = p.astype(v.dtype)
+        for kvh in range(kv_heads):
+            vs = v[:, kvh * d:(kvh + 1) * d]        # [page, D]
+            pv_rows.append(jax.lax.dot_general(
+                pb[kvh * rep:(kvh + 1) * rep, :], vs,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc_scr[:] = acc_scr[:] * corr + jnp.concatenate(pv_rows, axis=0)
+        m_scr[:, 0:1] = m_new
+
+    @pl.when(mi == m_steps - 1)
+    def _finish():
+        denom = l_scr[:, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _paged_attention_kernel(q, k_pages, v_pages, page_table, seq_lens,
+                            num_heads, interpret):
+    b, h, d = q.shape
+    n, p, kv, _ = k_pages.shape
+    m = page_table.shape[1]
+    scale = LOG2_E / (d ** 0.5)
+    qf = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    kf = k_pages.reshape(n, p, kv * d)
+    vf = v_pages.reshape(n, p, kv * d)
+
+    def kv_map(bi, mi, table, lens):
+        # Revolver: pages past the sequence's live span alias the last
+        # live page — their HBM->VMEM copy is skipped and the kernel's
+        # `live` predicate skips the compute.
+        last_live = jnp.maximum(lens[bi] - 1, 0) // p
+        return (table[bi, jnp.minimum(mi, last_live)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, m),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bi, mi, table, lens: (bi, 0, 0)),
+            pl.BlockSpec((1, p, kv * d), kv_map),
+            pl.BlockSpec((1, p, kv * d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda bi, mi, table, lens: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page=p, heads=num_heads,
+                          kv_heads=kv, head_dim=d),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qf, kf, vf)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("num_heads", "impl"))
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array, seq_lens: jax.Array,
-                    num_heads: int) -> jax.Array:
+                    num_heads: int, impl: str = "auto") -> jax.Array:
     """Single-token decode attention.
 
     q:          [B, H, D]      query for the next position
@@ -36,6 +153,21 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     b, h, d = q.shape
     n, p, kv, _ = k_pages.shape
     m = page_table.shape[1]
+
+    if impl == "auto":
+        # The kernel needs the collapsed [KV*D] lane axis to be a
+        # multiple of 128 and a TPU backend, and it pays off when the
+        # per-sequence KV stream is large (the jnp gather's extra pass
+        # is cheap for small pools, while the kernel's per-page grid
+        # step has fixed overhead — e.g. decode_step's scan-internal
+        # call on modest pools).
+        kv_bytes = m * p * kv * d * 2 * k_pages.dtype.itemsize
+        impl = ("kernel" if kv * d % 128 == 0 and kv_bytes >= (8 << 20)
+                and jax.default_backend() == "tpu" else "jnp")
+    if impl == "kernel":
+        return _paged_attention_kernel(
+            q, k_pages, v_pages, page_table, seq_lens, num_heads,
+            interpret=jax.default_backend() != "tpu")
 
     # Gather each sequence's pages: [B, M, P, KV, D] -> [B, M*P, KV, D].
     k = k_pages[page_table].reshape(b, m * p, kv, d)

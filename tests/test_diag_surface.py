@@ -50,3 +50,10 @@ def test_procfs_nodes(monkeypatch):
     nodes = utils.procfs_list()
     assert "driver/tpurm/version" in nodes
     assert "driver/tpurm/channels" in nodes
+    # Tools event coverage table vs the reference's UvmEventType enum.
+    events = utils.procfs_read("driver/tpurm-uvm/tools_events")
+    assert "reference(UvmEventType)" in events
+    assert "GpuFaultReplay" in events and "MapRemote" in events
+    # RDMA surface must label the transport honestly (no NIC in env).
+    rdma = utils.procfs_read("driver/tpurm/rdma")
+    assert "EMULATED" in rdma and "ib_mr_registrations" in rdma
