@@ -354,31 +354,52 @@ def measure_paged_decode_bw(batch: int = 8, pages_per_seq: int = 64,
     seq_lens = jnp.full((batch,), pages_per_seq * page, jnp.int32)
     q0 = jax.random.normal(kq, (batch, heads, head_dim), jnp.bfloat16)
 
-    def step(q):
-        out = paged_attention(q, k_pages, v_pages, table, seq_lens, heads)
-        return out.astype(jnp.bfloat16)
+    # Iterated attention converges to a fixed point in a few steps —
+    # identical inputs which the relay then serves from cache at
+    # impossible rates.  Perturb every step with a distinct increment
+    # so no (kernel, input) pair ever recurs.  (The perturbation is its
+    # own tiny jit: wrapping the whole step in jit would bake the page
+    # pools in as constants and blow past the compile proxy's request
+    # size limit.)
+    perturb = jax.jit(lambda x, i: (x + i * 1e-3).astype(jnp.bfloat16))
 
-    cur = step(q0)
+    def step(q, i):
+        out = paged_attention(q, k_pages, v_pages, table, seq_lens, heads)
+        return perturb(out, i)
+
+    cur = step(q0, jnp.float32(0))
     float(cur[0, 0, 0])
+    counter = [0]
 
     def chain(m: int) -> float:
         cur = q0
+        base = counter[0]
         t0 = time.perf_counter()
-        for _ in range(m):
-            cur = step(cur)
+        for j in range(m):
+            cur = step(cur, jnp.float32(base + j))
         float(cur[0, 0, 0])
+        counter[0] = base + m
         return time.perf_counter() - t0
 
     chain(2)
     import statistics
     bytes_per_call = 2 * batch * pages_per_seq * page * kv_heads * \
         head_dim * 2
+    hbm_bw = _chip_hbm_bw(dev)
     vals = []
     for _ in range(2):
         t_n = min(chain(8) for _ in range(2))
         t_3n = min(chain(24) for _ in range(2))
         cand = (t_3n - t_n) / 16
-        if cand > 0:
+        # Reject samples implying super-physical bandwidth (residual
+        # relay caching or jitter collapse).  The known-chip table
+        # gates strictly; an UNRECOGNIZED device kind only sanity-caps
+        # at 4x the fallback figure so a faster future chip still
+        # reports (its util ratio is labeled by the fallback anyway).
+        known = any(key in getattr(dev, "device_kind", "").lower()
+                    for key, _ in HBM_BW_BYTES_PER_S)
+        cap = (1.05 if known else 4.0) * hbm_bw
+        if cand > 0 and bytes_per_call / cand <= cap:
             vals.append(cand)
     if not vals:
         return {}
@@ -466,12 +487,14 @@ def _measure_isolated(fn_name: str, timeout_s: int, fallback,
     process RSS, and by the time main() reaches the later sections the
     managed pools have pushed RSS past the point where timings reflect
     the code under test rather than the process.  The result carries
-    `<tag>_isolated` so a reader can tell which path produced it.  A
-    child TIMEOUT returns only the marker — rerunning the same
-    multi-minute measurement in-process would both double the wall time
-    and produce exactly the RSS-distorted number this path exists to
-    avoid.  Other child failures (e.g. an exclusive-access backend
-    refusing a second client) fall back in-process, marked."""
+    `<tag>_isolated` so a reader can tell which path produced it.
+
+    Failure policy: a child that RAN but produced no result (timeout,
+    crash, exclusive-access backend refusing a second client) returns
+    only the failure marker — rerunning the same multi-minute
+    measurement in-process would both double the wall time and produce
+    exactly the RSS-distorted number this path exists to avoid.  Only
+    a spawn that never launched a child falls back in-process."""
     import json as _json
     import subprocess
     import sys
@@ -485,7 +508,10 @@ def _measure_isolated(fn_name: str, timeout_s: int, fallback,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         for line in proc.stdout.splitlines():
             if line.startswith("ISO_JSON "):
-                out = _json.loads(line[len("ISO_JSON "):])
+                try:
+                    out = _json.loads(line[len("ISO_JSON "):])
+                except ValueError:
+                    break           # garbled child output: marker below
                 out[f"{tag}_isolated"] = True
                 return out
         # The child ran (possibly for minutes) but produced no result:
@@ -497,8 +523,8 @@ def _measure_isolated(fn_name: str, timeout_s: int, fallback,
                     (proc.stderr or "")[-200:] or f"rc={proc.returncode}"}
     except subprocess.TimeoutExpired:
         return {f"{tag}_isolated": False, f"{tag}_timeout": True}
-    except Exception:
-        pass
+    except OSError:
+        pass                        # spawn never launched a child
     # Spawn itself failed (no subprocess ever ran): in-process fallback.
     out = fallback()
     out[f"{tag}_isolated"] = False

@@ -12,3 +12,4 @@ from .mesh import (  # noqa: F401
     data_sharding,
 )
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401

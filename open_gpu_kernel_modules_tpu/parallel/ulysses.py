@@ -1,0 +1,85 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The second standard long-context scheme beside ring attention
+(ring_attention.py).  Inputs arrive sequence-sharded over the ``sp``
+axis; an all_to_all reshards them to HEAD-sharded with the FULL
+sequence local, attention runs locally over the whole sequence (any
+local kernel — here ops.flash_attention), and a second all_to_all
+restores sequence sharding.  Two collectives total per call,
+independent of the sequence length — versus ring attention's n-1
+ppermute hops — at the cost of requiring heads % sp == 0.
+
+Trade-off guidance (the "How to Scale Your Model" framing): ring
+overlaps its hops with compute and scales to any head count; all-to-all
+moves each byte twice but in two large dense collectives that ride ICI
+efficiently, and keeps the local attention a single unsharded kernel
+call (so Pallas flash runs at full tile sizes).
+
+Reference analog: the reference's NCCL alltoall collectives over
+NVLink (SURVEY.md §2.7); here the collective is lax.all_to_all over a
+jax.sharding.Mesh axis and XLA lowers it onto ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import flash_attention
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      causal: bool = True, axis_name: str = "sp"
+                      ) -> jax.Array:
+    """Per-shard body (run under shard_map).
+
+    q,k,v: LOCAL [B, S/n, H, D] (sequence-sharded).  Returns the same
+    local sharding.  Requires H % n == 0.
+    """
+    n = jax.lax.axis_size(axis_name)
+    b, s_local, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"the {axis_name} axis size ({n}) must divide "
+                         f"the head count ({h}) for all-to-all sequence "
+                         f"parallelism")
+
+    # Reshard sequence->heads: split the head axis n ways, concatenate
+    # the sequence chunks in source-device order (device i holds global
+    # sequence chunk i, so the concat IS global sequence order):
+    # [B, S/n, H, D] -> [B, S, H/n, D] with the FULL sequence local.
+    def seq_to_head(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qh = seq_to_head(q)
+    kh = seq_to_head(k)
+    vh = seq_to_head(v)
+
+    out = flash_attention(qh, kh, vh, causal=causal)    # [B, S, H/n, D]
+
+    # Inverse reshard heads->sequence: split the sequence, concatenate
+    # the head groups back in source order.
+    o = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    return o.astype(q.dtype)
+
+
+def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                              mesh, causal: bool = True,
+                              axis_name: str = "sp") -> jax.Array:
+    """Convenience wrapper: shard_map ulysses_attention over ``mesh``.
+
+    q,k,v: GLOBAL [B, S, H, D]; batch over dp, sequence over sp.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("dp", axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
