@@ -386,19 +386,19 @@ def measure_paged_decode_bw(batch: int = 8, pages_per_seq: int = 64,
     bytes_per_call = 2 * batch * pages_per_seq * page * kv_heads * \
         head_dim * 2
     hbm_bw = _chip_hbm_bw(dev)
+    # Reject samples implying super-physical bandwidth (residual relay
+    # caching or jitter collapse).  The known-chip table gates strictly;
+    # an UNRECOGNIZED device kind only sanity-caps at 4x the fallback
+    # figure so a faster future chip still reports (its util ratio is
+    # labeled by the fallback anyway).
+    known = any(key in getattr(dev, "device_kind", "").lower()
+                for key, _ in HBM_BW_BYTES_PER_S)
+    cap = (1.05 if known else 4.0) * hbm_bw
     vals = []
     for _ in range(2):
         t_n = min(chain(8) for _ in range(2))
         t_3n = min(chain(24) for _ in range(2))
         cand = (t_3n - t_n) / 16
-        # Reject samples implying super-physical bandwidth (residual
-        # relay caching or jitter collapse).  The known-chip table
-        # gates strictly; an UNRECOGNIZED device kind only sanity-caps
-        # at 4x the fallback figure so a faster future chip still
-        # reports (its util ratio is labeled by the fallback anyway).
-        known = any(key in getattr(dev, "device_kind", "").lower()
-                    for key, _ in HBM_BW_BYTES_PER_S)
-        cap = (1.05 if known else 4.0) * hbm_bw
         if cand > 0 and bytes_per_call / cand <= cap:
             vals.append(cand)
     if not vals:
@@ -407,7 +407,7 @@ def measure_paged_decode_bw(batch: int = 8, pages_per_seq: int = 64,
     bw = bytes_per_call / dt
     return {
         "paged_decode_gbps": round(bw / 1e9, 1),
-        "paged_decode_hbm_util": round(bw / _chip_hbm_bw(dev), 4),
+        "paged_decode_hbm_util": round(bw / hbm_bw, 4),
     }
 
 

@@ -198,6 +198,53 @@ typedef struct {
 /* Single-copy clamp (reference: p2p_cxl.c:617-621). */
 #define TPU_CE_COPY_CLAMP           0xFFFFF000ull
 
+/* ------------------------------------------------ FB memory + BAR mapping
+ * NV01_MEMORY_LOCAL_USER (cl0040.h:34) + NVOS33/NVOS34 map/unmap
+ * escapes (nv_escape.h:42-43, nvos.h NVOS33_PARAMETERS).  The device
+ * arena is the BAR1 analog: a memory object is a PMM chunk of the
+ * arena, and mapping returns a CPU pointer into the coherent shadow.
+ * Writes through the mapping reach chip HBM at unmap (or any fence) —
+ * the write-combining flush analog. */
+
+#define TPU_CLASS_MEMORY_LOCAL 0x00000040u  /* NV01_MEMORY_LOCAL_USER */
+
+/* NV_MEMORY_ALLOCATION_PARAMS subset (nvos.h:1591-1625): the fields the
+ * vidmem path consumes; surface/layout fields are display-domain and
+ * designed out (SURVEY §7). */
+typedef struct {
+    uint32_t owner;
+    uint32_t type;
+    uint32_t flags;
+    uint64_t size      __attribute__((aligned(8)));  /* IN/OUT */
+    uint64_t alignment __attribute__((aligned(8)));
+    uint64_t offset    __attribute__((aligned(8)));  /* OUT: FB offset */
+} TpuMemoryAllocParams;
+
+#define TPU_ESC_RM_MAP_MEMORY   0x4E
+#define TPU_ESC_RM_UNMAP_MEMORY 0x4F
+
+/* NVOS33_PARAMETERS (nvos.h:1827-1837). */
+typedef struct {
+    uint32_t hClient;
+    uint32_t hDevice;
+    uint32_t hMemory;
+    uint64_t offset         __attribute__((aligned(8)));
+    uint64_t length         __attribute__((aligned(8)));
+    uint64_t pLinearAddress __attribute__((aligned(8)));  /* OUT */
+    uint32_t status;
+    uint32_t flags;
+} TpuMapMemoryParams;
+
+/* NVOS34_PARAMETERS (nvos.h:1844-1852 subset). */
+typedef struct {
+    uint32_t hClient;
+    uint32_t hDevice;
+    uint32_t hMemory;
+    uint64_t pLinearAddress __attribute__((aligned(8)));
+    uint32_t status;
+    uint32_t flags;
+} TpuUnmapMemoryParams;
+
 /* --------------------------------------------------- RM event notification
  * NV01_EVENT_OS_EVENT analog (reference: cl0005.h:35-47 alloc params;
  * event_notification.c delivery; nvgputypes.h:57-64 NvNotification).

@@ -340,6 +340,10 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
         return;
     }
     default:
+        /* NVOS33/34 (BAR mapping) intentionally not forwarded: a map
+         * returns a pointer into the ENGINE HOST's address space,
+         * meaningless to a remote client — same stance as events.
+         * Remote data access rides the CXL DMA escapes instead. */
         rep->ret = -1;
         rep->err = ENOTTY;
         return;

@@ -34,6 +34,12 @@ typedef struct RmObject {
     uint32_t hParent;          /* client handle for devices, device handle
                                 * for subdevices, self for the client root */
     TpurmDevice *dev;          /* resolved device for DEVICE/SUBDEVICE */
+    /* MEMORY_LOCAL objects: a PMM chunk of the device arena (the BAR1
+     * analog) + mapping state. */
+    uint64_t memOffset;
+    uint64_t memSize;
+    void *memChunk;            /* uvmHbmChunkAlloc handle */
+    uint32_t mapCount;
     struct RmObject *next;
 } RmObject;
 
@@ -196,6 +202,21 @@ static RmObject *object_find(RmClient *client, uint32_t handle)
     return NULL;
 }
 
+/* MEMORY_LOCAL teardown: an implicit unmap precedes the chunk release
+ * — CPU stores through a still-live mapping must reach chip HBM (the
+ * NVOS34 flush), and only then may the range return to the shared PMM.
+ * A client that keeps dereferencing the pointer after free is the same
+ * use-after-free it would be against the reference's BAR1. */
+static void mem_obj_release(RmObject *obj)
+{
+    if (!obj->memChunk || !obj->dev)
+        return;
+    tpuHbmMirrorNotify((char *)obj->dev->hbmBase + obj->memOffset,
+                       obj->memSize);
+    uvmHbmChunkFree(obj->dev->inst, obj->memChunk);
+    obj->memChunk = NULL;
+}
+
 /* Free an object and (recursively) every object parented under it
  * (resserv frees subtrees on parent free). */
 static void object_free_subtree(RmClient *client, uint32_t handle)
@@ -217,6 +238,7 @@ static void object_free_subtree(RmClient *client, uint32_t handle)
             *pp = dead->next;
             if (dead->hClass == TPU_CLASS_EVENT_OS)
                 tpurmEventDestroy(client->hClient, dead->handle);
+            mem_obj_release(dead);
             free(dead);
             return;
         }
@@ -280,6 +302,22 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
         if (sp->subDeviceId != 0)
             return TPU_ERR_INVALID_ARGUMENT;
         dev = parent->dev;
+    } else if (p->hClass == TPU_CLASS_MEMORY_LOCAL) {
+        /* NV01_MEMORY_LOCAL_USER: vidmem allocation under a device,
+         * drawn from the SAME per-device PMM the fault engine uses
+         * (reference: PMA serves both RM and UVM, uvm_pmm_gpu.h:27-47).
+         */
+        RmObject *parent = object_find(client, p->hObjectParent);
+        if (!parent || !parent->dev ||
+            (parent->hClass != TPU_CLASS_DEVICE &&
+             parent->hClass != TPU_CLASS_SUBDEVICE))
+            return TPU_ERR_INVALID_OBJECT_PARENT;
+        if (p->paramsSize != sizeof(TpuMemoryAllocParams) || !allocParams)
+            return TPU_ERR_INVALID_PARAM_STRUCT;
+        TpuMemoryAllocParams *mp = allocParams;
+        if (mp->size == 0)
+            return TPU_ERR_INVALID_ARGUMENT;
+        dev = parent->dev;
     } else if (p->hClass == TPU_CLASS_EVENT_OS) {
         /* NV01_EVENT_OS_EVENT (cl0005.h): parented under a subdevice
          * (or device); hSrcResource must resolve within the client. */
@@ -302,6 +340,17 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
     RmObject *obj = calloc(1, sizeof(*obj));
     if (!obj)
         return TPU_ERR_NO_MEMORY;
+    if (p->hClass == TPU_CLASS_MEMORY_LOCAL) {
+        TpuMemoryAllocParams *mp = allocParams;
+        TpuStatus mst = uvmHbmChunkAlloc(dev->inst, mp->size,
+                                         &obj->memOffset, &obj->memChunk);
+        if (mst != TPU_OK) {
+            free(obj);
+            return mst;
+        }
+        obj->memSize = mp->size;
+        mp->offset = obj->memOffset;        /* OUT: FB offset */
+    }
     if (p->hClass == TPU_CLASS_EVENT_OS) {
         /* Register only now that the handle-tree node exists — the
          * reverse order would leave an ownerless live event behind if
@@ -358,6 +407,7 @@ TpuStatus tpurmFree(TpuRmFreeParams *p)
         while (client->objects) {
             RmObject *o = client->objects;
             client->objects = o->next;
+            mem_obj_release(o);
             free(o);
         }
         tpurmEventDestroyClient(client->hClient);
@@ -579,6 +629,75 @@ TpuStatus tpurmControl(TpuRmControlParams *p)
 
 /* ------------------------------------------------------------- ioctl glue */
 
+/* NVOS33/34: map a memory object's arena window into the caller (the
+ * BAR1 mapping analog — escape.c:502 NV_ESC_RM_MAP_MEMORY).  The arena
+ * is the coherent shadow of chip HBM: reads are made chip-coherent up
+ * front, and dirty bytes publish to the mirror stream at unmap (the
+ * write-combining flush point). */
+static TpuStatus rm_map_memory(TpuMapMemoryParams *p)
+{
+    pthread_mutex_lock(&g_rm.lock);
+    tpuLockTrackAcquire(TPU_LOCK_RM, "rm");
+    TpuStatus st = TPU_OK;
+    RmClient *client = client_find(p->hClient);
+    RmObject *obj = client ? object_find(client, p->hMemory) : NULL;
+    if (!client) {
+        st = TPU_ERR_INVALID_CLIENT;
+    } else if (!obj || obj->hClass != TPU_CLASS_MEMORY_LOCAL) {
+        st = TPU_ERR_INVALID_OBJECT_HANDLE;
+    } else if (p->offset > obj->memSize ||
+               p->length > obj->memSize - p->offset || p->length == 0) {
+        st = TPU_ERR_INVALID_LIMIT;
+    } else {
+        char *base = (char *)obj->dev->hbmBase + obj->memOffset +
+                     p->offset;
+        if (tpuHbmCoherentForRead(base, p->length) != TPU_OK) {
+            st = TPU_ERR_INVALID_STATE;
+        } else {
+            obj->mapCount++;
+            p->pLinearAddress = (uint64_t)(uintptr_t)base;
+            tpuCounterAdd("rm_memory_maps", 1);
+        }
+    }
+    tpuLockTrackRelease(TPU_LOCK_RM, "rm");
+    pthread_mutex_unlock(&g_rm.lock);
+    p->status = st;
+    return st;
+}
+
+static TpuStatus rm_unmap_memory(TpuUnmapMemoryParams *p)
+{
+    pthread_mutex_lock(&g_rm.lock);
+    tpuLockTrackAcquire(TPU_LOCK_RM, "rm");
+    TpuStatus st = TPU_OK;
+    RmClient *client = client_find(p->hClient);
+    RmObject *obj = client ? object_find(client, p->hMemory) : NULL;
+    if (!client) {
+        st = TPU_ERR_INVALID_CLIENT;
+    } else if (!obj || obj->hClass != TPU_CLASS_MEMORY_LOCAL) {
+        st = TPU_ERR_INVALID_OBJECT_HANDLE;
+    } else if (obj->mapCount == 0) {
+        st = TPU_ERR_INVALID_STATE;
+    } else {
+        char *base = (char *)obj->dev->hbmBase + obj->memOffset;
+        uint64_t want = (uint64_t)(uintptr_t)base;
+        if (p->pLinearAddress < want ||
+            p->pLinearAddress >= want + obj->memSize) {
+            st = TPU_ERR_INVALID_ADDRESS;
+        } else {
+            obj->mapCount--;
+            /* Flush: CPU stores through the mapping reach chip HBM
+             * here (reference: BAR writes post to vidmem; our shadow
+             * publishes via the mirror stream). */
+            tpuHbmMirrorNotify(base, obj->memSize);
+        }
+    }
+    tpuLockTrackRelease(TPU_LOCK_RM, "rm");
+    pthread_mutex_unlock(&g_rm.lock);
+    p->status = st;
+    return st;
+}
+
 static int tpurm_ioctl_dispatch(unsigned long request, void *argp)
 {
     if (_IOC_TYPE(request) != TPU_IOCTL_MAGIC) {
@@ -594,6 +713,12 @@ static int tpurm_ioctl_dispatch(unsigned long request, void *argp)
         return 0;
     case TPU_ESC_RM_FREE:
         tpurmFree((TpuRmFreeParams *)argp);
+        return 0;
+    case TPU_ESC_RM_MAP_MEMORY:
+        rm_map_memory((TpuMapMemoryParams *)argp);
+        return 0;
+    case TPU_ESC_RM_UNMAP_MEMORY:
+        rm_unmap_memory((TpuUnmapMemoryParams *)argp);
         return 0;
     default:
         errno = ENOTTY;

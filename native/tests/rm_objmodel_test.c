@@ -151,6 +151,70 @@ int main(void)
     ap.hClass = TPU_CLASS_ROOT;
     CHECK(tpurm_ioctl(fd, TPU_ESC_RM_ALLOC_IOCTL, &ap) == 0);
     CHECK(ap.status == TPU_OK);
+
+    /* ---- FB memory objects + NVOS33/34 BAR mapping analog ---- */
+    const uint32_t hC = 0xcaf20009, hDev = 0xcaf2000a, hMem = 0xcaf2000b;
+    TpuCtrlAttachIdsParams at2;
+    memset(&at2, 0, sizeof(at2));
+    at2.gpuIds[0] = TPU_CTRL_ATTACH_ALL_PROBED;
+    CHECK(do_control(hC, hC, TPU_CTRL_CMD_GPU_ATTACH_IDS, &at2,
+                     sizeof(at2)) == TPU_OK);
+    TpuDeviceAllocParams dp2;
+    memset(&dp2, 0, sizeof(dp2));
+    CHECK(do_alloc(hC, hC, hDev, TPU_CLASS_DEVICE, &dp2,
+                   sizeof(dp2)) == TPU_OK);
+
+    TpuMemoryAllocParams mp;
+    memset(&mp, 0, sizeof(mp));
+    CHECK(do_alloc(hC, hDev, hMem, TPU_CLASS_MEMORY_LOCAL, &mp,
+                   sizeof(mp)) == TPU_ERR_INVALID_ARGUMENT);  /* size 0 */
+    mp.size = 256 * 1024;
+    CHECK(do_alloc(hC, hDev, hMem, TPU_CLASS_MEMORY_LOCAL, &mp,
+                   sizeof(mp)) == TPU_OK);
+
+    TpuMapMemoryParams mm;
+    memset(&mm, 0, sizeof(mm));
+    mm.hClient = hC;
+    mm.hDevice = hDev;
+    mm.hMemory = hMem;
+    mm.offset = 4096;
+    mm.length = mp.size;                 /* OOB: offset + length > size */
+    CHECK(tpurm_ioctl(fd, _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_MAP_MEMORY,
+                                TpuMapMemoryParams), &mm) == 0);
+    CHECK(mm.status == TPU_ERR_INVALID_LIMIT);
+    mm.length = 64 * 1024;
+    CHECK(tpurm_ioctl(fd, _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_MAP_MEMORY,
+                                TpuMapMemoryParams), &mm) == 0);
+    CHECK(mm.status == TPU_OK && mm.pLinearAddress != 0);
+
+    /* CPU stores through the BAR mapping land in the device arena at
+     * the allocation's FB offset. */
+    memset((void *)(uintptr_t)mm.pLinearAddress, 0x77, mm.length);
+    TpurmDevice *d0 = tpurmDeviceGet(0);
+    const uint8_t *arena = tpurmDeviceHbmBase(d0);
+    CHECK(arena[mp.offset + 4096] == 0x77);
+    CHECK(arena[mp.offset + 4096 + mm.length - 1] == 0x77);
+
+    TpuUnmapMemoryParams um;
+    memset(&um, 0, sizeof(um));
+    um.hClient = hC;
+    um.hDevice = hDev;
+    um.hMemory = hMem;
+    um.pLinearAddress = 0xdead;          /* not inside the mapping */
+    CHECK(tpurm_ioctl(fd, _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_UNMAP_MEMORY,
+                                TpuUnmapMemoryParams), &um) == 0);
+    CHECK(um.status == TPU_ERR_INVALID_ADDRESS);
+    um.pLinearAddress = mm.pLinearAddress;
+    CHECK(tpurm_ioctl(fd, _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_UNMAP_MEMORY,
+                                TpuUnmapMemoryParams), &um) == 0);
+    CHECK(um.status == TPU_OK);
+    /* Double unmap: nothing mapped. */
+    CHECK(tpurm_ioctl(fd, _IOWR(TPU_IOCTL_MAGIC, TPU_ESC_RM_UNMAP_MEMORY,
+                                TpuUnmapMemoryParams), &um) == 0);
+    CHECK(um.status == TPU_ERR_INVALID_STATE);
+
+    CHECK(do_free(hC, hDev, hMem) == TPU_OK);
+    CHECK(do_free(hC, 0, hC) == TPU_OK);
     CHECK(tpurm_close(fd) == 0);
 
     printf("rm_objmodel_test OK\n");
