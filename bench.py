@@ -39,9 +39,17 @@ Extra fields (recorded for trend):
   fault_p50_us/fault_p95_us— fault service latency (north star: µs-scale)
   mfu_flash_prefill        — flash-attention prefill MFU on the chip
   flash_tflops             — achieved TFLOP/s for the same kernel
+  paged_decode_gbps/_hbm_util — Pallas paged-decode attention streaming
+                             bandwidth and its fraction of chip HBM BW
+  migrate_engine_*_gbps    — EXPLICIT UVM_MIGRATE path (SURVEY §3.3),
+                             engine-side vs the coherent shadow (the
+                             async mirror is not awaited)
   dense_toks_per_s         — grouped Llama decode, fully-resident pool
   tiered_toks_per_s        — same workload at 4x KV oversubscription
                              through the UVM-backed tiered cache
+  <tag>_isolated           — whether flash/paged/tokens ran in a fresh
+                             subprocess (the relay slows with process
+                             footprint; in-process numbers are marked)
 All units decimal (GB = 1e9 bytes) to match the baseline's MB/s.
 """
 
@@ -169,6 +177,32 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
     finally:
         if rt is not None:
             rt.close()
+
+
+def measure_explicit_migrate_gbps(total_mib: int = 256) -> dict:
+    """SURVEY §3.3: the EXPLICIT UVM_MIGRATE path, ENGINE-SIDE — one
+    ioctl moves a whole range through the CE pool with batched
+    page-mask commits.  The fields are named *_engine_* deliberately:
+    this times the engine pipeline against the coherent shadow (mirror
+    publication to a real chip is asynchronous and NOT awaited here);
+    chip-verified transport bandwidth is the metric of record above."""
+    from open_gpu_kernel_modules_tpu import uvm
+    from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(total_mib * MB)
+        buf.view()[:] = 0x5C
+        t0 = time.perf_counter()
+        buf.migrate(Tier.HBM)
+        up = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        buf.migrate(Tier.HOST)
+        down = time.perf_counter() - t0
+        buf.free()
+    return {
+        "migrate_engine_htod_gbps": round(total_mib * MB / up / 1e9, 3),
+        "migrate_engine_dtoh_gbps": round(total_mib * MB / down / 1e9, 3),
+    }
 
 
 def measure_jax_transfer_gbps(total_mib: int = 128, block_mib: int = 1,
@@ -636,6 +670,10 @@ def main() -> None:
         except Exception:
             pass
 
+    try:
+        extra.update(measure_explicit_migrate_gbps())
+    except Exception:
+        pass
     extra.update(_prior_round_latencies())
     if "prev_fault_p95_us" in extra and extra["prev_fault_p95_us"]:
         extra["fault_p95_vs_prev"] = round(

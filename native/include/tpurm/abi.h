@@ -210,7 +210,10 @@ typedef struct {
 
 /* NV_MEMORY_ALLOCATION_PARAMS subset (nvos.h:1591-1625): the fields the
  * vidmem path consumes; surface/layout fields are display-domain and
- * designed out (SURVEY §7). */
+ * designed out (SURVEY §7).  size is IN/OUT: the PMM rounds up to its
+ * power-of-two chunk ladder and allocations are capped at the 2 MB VA
+ * block granularity (reference chunk ceiling, uvm_pmm_gpu.h:60-85) —
+ * larger surfaces compose multiple objects. */
 typedef struct {
     uint32_t owner;
     uint32_t type;

@@ -348,7 +348,14 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
             free(obj);
             return mst;
         }
-        obj->memSize = mp->size;
+        /* The PMM rounds to its power-of-two chunk ladder (capped at
+         * the 2 MB block size, abi.h documents the limit); size is
+         * IN/OUT so the client sees what it actually holds. */
+        uint64_t got = uvmPageSize();
+        while (got < mp->size)
+            got <<= 1;
+        obj->memSize = got;
+        mp->size = got;
         mp->offset = obj->memOffset;        /* OUT: FB offset */
     }
     if (p->hClass == TPU_CLASS_EVENT_OS) {
@@ -639,28 +646,49 @@ static TpuStatus rm_map_memory(TpuMapMemoryParams *p)
     pthread_mutex_lock(&g_rm.lock);
     tpuLockTrackAcquire(TPU_LOCK_RM, "rm");
     TpuStatus st = TPU_OK;
+    char *base = NULL;
     RmClient *client = client_find(p->hClient);
     RmObject *obj = client ? object_find(client, p->hMemory) : NULL;
+    RmObject *devObj = client ? object_find(client, p->hDevice) : NULL;
     if (!client) {
         st = TPU_ERR_INVALID_CLIENT;
     } else if (!obj || obj->hClass != TPU_CLASS_MEMORY_LOCAL) {
         st = TPU_ERR_INVALID_OBJECT_HANDLE;
+    } else if (!devObj || !devObj->dev || devObj->dev != obj->dev) {
+        /* NVOS33 takes the owning device (or subdevice) handle; a
+         * mismatched device must fail like the reference. */
+        st = TPU_ERR_INVALID_DEVICE;
     } else if (p->offset > obj->memSize ||
                p->length > obj->memSize - p->offset || p->length == 0) {
         st = TPU_ERR_INVALID_LIMIT;
     } else {
-        char *base = (char *)obj->dev->hbmBase + obj->memOffset +
-                     p->offset;
+        /* Publish the map BEFORE the (possibly slow) chip readback and
+         * do the readback OUTSIDE g_rm.lock — a mirror round trip must
+         * not stall every other RM operation.  mapCount pins the
+         * object against concurrent free. */
+        obj->mapCount++;
+        base = (char *)obj->dev->hbmBase + obj->memOffset + p->offset;
+    }
+    tpuLockTrackRelease(TPU_LOCK_RM, "rm");
+    pthread_mutex_unlock(&g_rm.lock);
+    if (st == TPU_OK && base) {
         if (tpuHbmCoherentForRead(base, p->length) != TPU_OK) {
+            /* Re-resolve: the object may have been freed while the
+             * readback ran outside the lock (client racing free with
+             * its own map) — never touch the stale pointer. */
+            pthread_mutex_lock(&g_rm.lock);
+            client = client_find(p->hClient);
+            obj = client ? object_find(client, p->hMemory) : NULL;
+            if (obj && obj->hClass == TPU_CLASS_MEMORY_LOCAL &&
+                obj->mapCount)
+                obj->mapCount--;
+            pthread_mutex_unlock(&g_rm.lock);
             st = TPU_ERR_INVALID_STATE;
         } else {
-            obj->mapCount++;
             p->pLinearAddress = (uint64_t)(uintptr_t)base;
             tpuCounterAdd("rm_memory_maps", 1);
         }
     }
-    tpuLockTrackRelease(TPU_LOCK_RM, "rm");
-    pthread_mutex_unlock(&g_rm.lock);
     p->status = st;
     return st;
 }
@@ -672,10 +700,13 @@ static TpuStatus rm_unmap_memory(TpuUnmapMemoryParams *p)
     TpuStatus st = TPU_OK;
     RmClient *client = client_find(p->hClient);
     RmObject *obj = client ? object_find(client, p->hMemory) : NULL;
+    RmObject *devObj = client ? object_find(client, p->hDevice) : NULL;
     if (!client) {
         st = TPU_ERR_INVALID_CLIENT;
     } else if (!obj || obj->hClass != TPU_CLASS_MEMORY_LOCAL) {
         st = TPU_ERR_INVALID_OBJECT_HANDLE;
+    } else if (!devObj || !devObj->dev || devObj->dev != obj->dev) {
+        st = TPU_ERR_INVALID_DEVICE;
     } else if (obj->mapCount == 0) {
         st = TPU_ERR_INVALID_STATE;
     } else {
