@@ -403,6 +403,9 @@ TpuStatus uvmExternalFlush(UvmVaSpace *vs, void *base, uint64_t length);
  * carving arena bytes privately.  size is rounded up to a power-of-two
  * chunk (max 2 MB).  Reference analog: PMA serving both UVM and RM
  * (uvm_pmm_gpu.h:27-47). */
+TpuStatus uvmHbmChunkAllocSized(uint32_t devInst, uint64_t size,
+                                uint64_t *outOffset, uint64_t *outSize,
+                                void **outHandle);
 TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
                            uint64_t *outOffset, void **outHandle);
 TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle);

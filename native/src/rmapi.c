@@ -40,6 +40,8 @@ typedef struct RmObject {
     uint64_t memSize;
     void *memChunk;            /* uvmHbmChunkAlloc handle */
     uint32_t mapCount;
+    uint32_t mapBusy;          /* readbacks in flight outside g_rm.lock;
+                                * free paths wait for zero (g_rm.cond) */
     struct RmObject *next;
 } RmObject;
 
@@ -51,8 +53,10 @@ typedef struct {
 
 static struct {
     pthread_mutex_t lock;
+    pthread_cond_t cond;       /* mapBusy drained */
     RmClient clients[MAX_CLIENTS];
-} g_rm = { .lock = PTHREAD_MUTEX_INITIALIZER };
+} g_rm = { .lock = PTHREAD_MUTEX_INITIALIZER,
+           .cond = PTHREAD_COND_INITIALIZER };
 
 /* ------------------------------------------------------------ pseudo fds */
 
@@ -231,10 +235,19 @@ static void object_free_subtree(RmClient *client, uint32_t handle)
         }
         pp = &o->next;
     }
+restart:
     pp = &client->objects;
     while (*pp) {
         if ((*pp)->handle == handle) {
             RmObject *dead = *pp;
+            if (dead->mapBusy) {
+                /* A map's chip readback is running outside g_rm.lock;
+                 * freeing now would hand its target range back to the
+                 * PMM mid-copy.  Wait (the cond releases g_rm.lock, so
+                 * rescan — the list may have changed). */
+                pthread_cond_wait(&g_rm.cond, &g_rm.lock);
+                goto restart;
+            }
             *pp = dead->next;
             if (dead->hClass == TPU_CLASS_EVENT_OS)
                 tpurmEventDestroy(client->hClient, dead->handle);
@@ -342,20 +355,19 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
         return TPU_ERR_NO_MEMORY;
     if (p->hClass == TPU_CLASS_MEMORY_LOCAL) {
         TpuMemoryAllocParams *mp = allocParams;
-        TpuStatus mst = uvmHbmChunkAlloc(dev->inst, mp->size,
-                                         &obj->memOffset, &obj->memChunk);
+        uint64_t granted = 0;
+        TpuStatus mst = uvmHbmChunkAllocSized(dev->inst, mp->size,
+                                              &obj->memOffset, &granted,
+                                              &obj->memChunk);
         if (mst != TPU_OK) {
             free(obj);
             return mst;
         }
-        /* The PMM rounds to its power-of-two chunk ladder (capped at
-         * the 2 MB block size, abi.h documents the limit); size is
-         * IN/OUT so the client sees what it actually holds. */
-        uint64_t got = uvmPageSize();
-        while (got < mp->size)
-            got <<= 1;
-        obj->memSize = got;
-        mp->size = got;
+        /* size is IN/OUT: the ALLOCATOR reports what its chunk ladder
+         * granted (pow2, capped at the 2 MB block size — abi.h) so
+         * this layer never re-derives PMM policy. */
+        obj->memSize = granted;
+        mp->size = granted;
         mp->offset = obj->memOffset;        /* OUT: FB offset */
     }
     if (p->hClass == TPU_CLASS_EVENT_OS) {
@@ -410,7 +422,19 @@ TpuStatus tpurmFree(TpuRmFreeParams *p)
     if (!client) {
         st = TPU_ERR_INVALID_CLIENT;
     } else if (p->hObjectOld == client->hClient) {
-        /* Freeing the root frees the whole client. */
+        /* Freeing the root frees the whole client.  In-flight map
+         * readbacks must drain first (see object_free_subtree). */
+        for (;;) {
+            bool busy = false;
+            for (RmObject *o = client->objects; o; o = o->next)
+                if (o->mapBusy) {
+                    busy = true;
+                    break;
+                }
+            if (!busy)
+                break;
+            pthread_cond_wait(&g_rm.cond, &g_rm.lock);
+        }
         while (client->objects) {
             RmObject *o = client->objects;
             client->objects = o->next;
@@ -654,39 +678,41 @@ static TpuStatus rm_map_memory(TpuMapMemoryParams *p)
         st = TPU_ERR_INVALID_CLIENT;
     } else if (!obj || obj->hClass != TPU_CLASS_MEMORY_LOCAL) {
         st = TPU_ERR_INVALID_OBJECT_HANDLE;
-    } else if (!devObj || !devObj->dev || devObj->dev != obj->dev) {
-        /* NVOS33 takes the owning device (or subdevice) handle; a
-         * mismatched device must fail like the reference. */
+    } else if (!devObj ||
+               (devObj->hClass != TPU_CLASS_DEVICE &&
+                devObj->hClass != TPU_CLASS_SUBDEVICE) ||
+               devObj->dev != obj->dev) {
+        /* NVOS33 takes the OWNING device (or subdevice) handle — any
+         * other class, or a different device, fails like the
+         * reference. */
         st = TPU_ERR_INVALID_DEVICE;
     } else if (p->offset > obj->memSize ||
                p->length > obj->memSize - p->offset || p->length == 0) {
         st = TPU_ERR_INVALID_LIMIT;
     } else {
-        /* Publish the map BEFORE the (possibly slow) chip readback and
-         * do the readback OUTSIDE g_rm.lock — a mirror round trip must
-         * not stall every other RM operation.  mapCount pins the
-         * object against concurrent free. */
-        obj->mapCount++;
+        /* Run the (possibly slow) chip readback OUTSIDE g_rm.lock — a
+         * mirror round trip must not stall every other RM operation.
+         * mapBusy pins the object: every free path waits for it to
+         * drain, so `obj` cannot be freed or its chunk reallocated
+         * while the readback runs. */
+        obj->mapBusy++;
         base = (char *)obj->dev->hbmBase + obj->memOffset + p->offset;
     }
     tpuLockTrackRelease(TPU_LOCK_RM, "rm");
     pthread_mutex_unlock(&g_rm.lock);
     if (st == TPU_OK && base) {
-        if (tpuHbmCoherentForRead(base, p->length) != TPU_OK) {
-            /* Re-resolve: the object may have been freed while the
-             * readback ran outside the lock (client racing free with
-             * its own map) — never touch the stale pointer. */
-            pthread_mutex_lock(&g_rm.lock);
-            client = client_find(p->hClient);
-            obj = client ? object_find(client, p->hMemory) : NULL;
-            if (obj && obj->hClass == TPU_CLASS_MEMORY_LOCAL &&
-                obj->mapCount)
-                obj->mapCount--;
-            pthread_mutex_unlock(&g_rm.lock);
-            st = TPU_ERR_INVALID_STATE;
-        } else {
+        bool ok = tpuHbmCoherentForRead(base, p->length) == TPU_OK;
+        pthread_mutex_lock(&g_rm.lock);
+        obj->mapBusy--;                 /* pinned: pointer still valid */
+        if (ok)
+            obj->mapCount++;
+        pthread_cond_broadcast(&g_rm.cond);
+        pthread_mutex_unlock(&g_rm.lock);
+        if (ok) {
             p->pLinearAddress = (uint64_t)(uintptr_t)base;
             tpuCounterAdd("rm_memory_maps", 1);
+        } else {
+            st = TPU_ERR_INVALID_STATE;
         }
     }
     p->status = st;
@@ -705,7 +731,10 @@ static TpuStatus rm_unmap_memory(TpuUnmapMemoryParams *p)
         st = TPU_ERR_INVALID_CLIENT;
     } else if (!obj || obj->hClass != TPU_CLASS_MEMORY_LOCAL) {
         st = TPU_ERR_INVALID_OBJECT_HANDLE;
-    } else if (!devObj || !devObj->dev || devObj->dev != obj->dev) {
+    } else if (!devObj ||
+               (devObj->hClass != TPU_CLASS_DEVICE &&
+                devObj->hClass != TPU_CLASS_SUBDEVICE) ||
+               devObj->dev != obj->dev) {
         st = TPU_ERR_INVALID_DEVICE;
     } else if (obj->mapCount == 0) {
         st = TPU_ERR_INVALID_STATE;

@@ -123,8 +123,9 @@ UvmTierArena *uvmTierArenaCxl(void)
  * Reference analog: PMA serves both UVM and RM allocations from one
  * per-GPU allocator (uvm_pmm_gpu.h:27-47 external/internal types). */
 
-TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
-                           uint64_t *outOffset, void **outHandle)
+TpuStatus uvmHbmChunkAllocSized(uint32_t devInst, uint64_t size,
+                                uint64_t *outOffset, uint64_t *outSize,
+                                void **outHandle)
 {
     if (!outOffset || !outHandle || size == 0)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -141,8 +142,18 @@ TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
     if (st != TPU_OK)
         return st;
     *outOffset = chunk->offset;
+    if (outSize)
+        *outSize = want;    /* the ladder's granted size — callers must
+                             * not re-derive it (policy lives HERE) */
     *outHandle = chunk;
     return TPU_OK;
+}
+
+TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
+                           uint64_t *outOffset, void **outHandle)
+{
+    return uvmHbmChunkAllocSized(devInst, size, outOffset, NULL,
+                                 outHandle);
 }
 
 TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle)
