@@ -246,6 +246,10 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
                 p.status = TPU_ERR_INSUFFICIENT_RESOURCES;
                 memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
                 rep->mainSize = sizeof(p);
+                /* Reply layout is [aux][main] on EVERY path — a reply
+                 * missing the aux bytes would make the client read its
+                 * own stale buffer as the main struct. */
+                rep->auxSize = rq->auxSize;
                 return;
             }
             uint32_t orig = h;
@@ -273,6 +277,7 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
                 p.status = TPU_ERR_INVALID_CLIENT;
                 memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
                 rep->mainSize = sizeof(p);
+                rep->auxSize = rq->auxSize;
                 return;
             }
             p.hRoot = real;
@@ -337,6 +342,7 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
         }
         memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
         rep->mainSize = sizeof(p);
+        rep->auxSize = rq->auxSize;
         return;
     }
     default:

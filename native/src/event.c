@@ -212,6 +212,16 @@ bool tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex)
 static void *event_worker(void *arg)
 {
     (void)arg;
+    /* Jobs are POLLED (tpuTrackerIsCompleted), not block-waited: a
+     * blocking wait at the head serialized the queue, so a wedged
+     * channel's job head-of-line-blocked unrelated jobs — and the
+     * channel destroys quiescing on them (tpurmEventQuiesceChannel
+     * promises it never blocks on OTHER channels' jobs).  An unready
+     * job requeues at the tail; when a full pass over the pending set
+     * makes no progress the worker backs off (50 µs, doubling to 2 ms)
+     * instead of spinning. */
+    uint32_t barren = 0;            /* unready pops since last fire */
+    useconds_t backoff = 50;
     for (;;) {
         pthread_mutex_lock(&g_ev.jobLock);
         while (!g_ev.jobs)
@@ -220,9 +230,27 @@ static void *event_worker(void *arg)
         g_ev.jobs = job->next;
         if (!g_ev.jobs)
             g_ev.jobsTail = NULL;
+        uint32_t pending = g_ev.jobsQueued - g_ev.jobsDone;
         pthread_mutex_unlock(&g_ev.jobLock);
 
-        tpuTrackerWait(&job->deps);
+        if (!tpuTrackerIsCompleted(&job->deps)) {
+            pthread_mutex_lock(&g_ev.jobLock);
+            job->next = NULL;
+            if (g_ev.jobsTail)
+                g_ev.jobsTail->next = job;
+            else
+                g_ev.jobs = job;
+            g_ev.jobsTail = job;
+            pthread_mutex_unlock(&g_ev.jobLock);
+            if (++barren >= pending) {
+                usleep(backoff);
+                backoff = backoff * 2 > 2000 ? 2000 : backoff * 2;
+                barren = 0;
+            }
+            continue;
+        }
+        barren = 0;
+        backoff = 50;
         tpurmEventFire(job->devInst, job->notifyIndex, job->info32,
                        job->info16);
         pthread_mutex_lock(&g_ev.jobLock);

@@ -35,6 +35,10 @@ int main(int argc, char **argv)
         fprintf(stderr, "usage: %s <socket-path> [ready-file]\n", argv[0]);
         return 2;
     }
+    /* The daemon IS the engine host: if TPURM_BROKER leaked into its
+     * environment, tpurm_open would forward to the (not yet listening)
+     * socket this process is about to serve and fail startup. */
+    unsetenv("TPURM_BROKER");
     /* Engine init (device table, arenas). */
     int fd = tpurm_open("/dev/tpuctl");
     if (fd < 0) {

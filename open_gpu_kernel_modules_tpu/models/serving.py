@@ -723,6 +723,15 @@ class TieredKVCache:
                        ) -> PagedKVCache:
         self.stats["activations"] += 1
         m, P = self.pages_per_seq, self.page_size
+        # Ring pressure valve runs FIRST, before anything reads
+        # _victim_map: a drain clears the map, so firing it between the
+        # miss-list computation and the victim-restore below would leave
+        # victim-hit pages with neither an upload nor a restore (their
+        # slots silently keeping the previous occupant's KV).  Draining
+        # here bumps the epoch, so a staging read before the drain falls
+        # back to the synchronous path instead of composing with
+        # recycled entries.
+        self._maybe_drain_for_cap()
         # ONE page walker shared with prefetch() — the staged.pages
         # match below depends on both sides computing the identical
         # miss list, so there must be a single source of truth for it.
@@ -774,7 +783,6 @@ class TieredKVCache:
                     # through the backing (UVM fault engine for the
                     # managed backing; ICI peer copies for the
                     # multi-chip pool).
-                    self._maybe_drain_for_cap()
                     k_chunk, v_chunk = self.backing.read_pages(misses)
                     k_chunk, v_chunk = self._pad_chunks(k_chunk, v_chunk,
                                                         len(misses))

@@ -106,9 +106,13 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table, seq_lens,
     def kv_map(bi, mi, table, lens):
         # Revolver: pages past the sequence's live span alias the last
         # live page — their HBM->VMEM copy is skipped and the kernel's
-        # `live` predicate skips the compute.
+        # `live` predicate skips the compute.  The looked-up index is
+        # clamped to the pool: for an EMPTY sequence (lens[bi]==0) the
+        # table row may be uninitialized, and an out-of-range index
+        # would fault the block DMA even though compute is masked.
         last_live = jnp.maximum(lens[bi] - 1, 0) // p
-        return (table[bi, jnp.minimum(mi, last_live)], 0, 0)
+        page = table[bi, jnp.minimum(mi, last_live)]
+        return (jnp.clip(page, 0, n - 1), 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
