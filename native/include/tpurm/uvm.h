@@ -362,8 +362,17 @@ typedef struct {
     uint64_t evictions;        /* block evictions (oversubscription) */
     uint64_t serviceNsP50;     /* latest-window service latency percentiles */
     uint64_t serviceNsP95;
+    /* Phase decomposition of the headline latency: wake = enqueue ->
+     * batch pop (futex + scheduler), svcOne = one service_one call
+     * (engine work).  headline ~= wake + svcOne (+ batch-mates). */
+    uint64_t wakeNsP50;
+    uint64_t wakeNsP95;
+    uint64_t svcOneNsP50;
+    uint64_t svcOneNsP95;
 } UvmFaultStats;
 void uvmFaultStatsGet(UvmFaultStats *out);
+/* Restart the percentile sampling windows (not the counters). */
+void uvmFaultStatsResetWindows(void);
 
 /* Pageable memory (HMM analog, reference uvm_hmm.c): adopt an existing
  * anonymous mapping into a managed range IN PLACE, preserving contents

@@ -631,7 +631,12 @@ bool tpuCeStriperInit(TpuCeStriper *s, TpurmDevice *dev)
         return false;
     s->dev = dev;
     s->next = 0;
-    s->stripe = tpuRegistryGet("uvm_ce_stripe_bytes", 512 * 1024);
+    /* Stripe default: 512 KB spreads a block copy across the pool; with
+     * a single executor (1-CPU box) striping buys no overlap, so larger
+     * 2 MB stripes cut per-push overhead instead. */
+    s->stripe = tpuRegistryGet("uvm_ce_stripe_bytes",
+                               dev->cePoolSize > 1 ? 512 * 1024
+                                                   : 2 * 1024 * 1024);
     if (s->stripe < 4096)
         s->stripe = 4096;
     return true;

@@ -89,7 +89,16 @@ static void device_init_once(void)
             for (uint64_t b = 0; b < hbmBytes; b++)
                 p[b] = (uint8_t)((b + seed) & 0xFF);
         }
-        uint32_t pool = (uint32_t)tpuRegistryGet("uvm_ce_channels", 4);
+        /* CE pool default scales with online CPUs (cap 4): each channel
+         * is an executor THREAD, and on a starved box extra executors
+         * only preempt each other mid-memmove — same rationale as the
+         * fault-worker count.  Registry uvm_ce_channels overrides. */
+        long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+        uint32_t poolDflt = 4;
+        if (ncpu > 0 && poolDflt > (uint32_t)ncpu)
+            poolDflt = (uint32_t)ncpu;
+        uint32_t pool = (uint32_t)tpuRegistryGet("uvm_ce_channels",
+                                                 poolDflt);
         if (pool < 1)
             pool = 1;
         if (pool > TPU_CE_POOL_MAX)

@@ -193,6 +193,18 @@ uint64_t tpurmCounterGet(const char *name)
 
 /* --------------------------------------------------------------- registry */
 
+static _Atomic uint64_t g_registry_gen;
+
+uint64_t tpuRegistryGen(void)
+{
+    return atomic_load_explicit(&g_registry_gen, memory_order_acquire);
+}
+
+void tpuRegistryBump(void)
+{
+    atomic_fetch_add_explicit(&g_registry_gen, 1, memory_order_acq_rel);
+}
+
 uint64_t tpuRegistryGet(const char *key, uint64_t defval)
 {
     char envName[96] = "TPUMEM_";

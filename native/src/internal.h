@@ -63,6 +63,33 @@ size_t tpuCountersDump(char *buf, size_t bufSize);
 /* Env-backed config: TPUMEM_<KEY> (decimal or 0x hex), else default. */
 uint64_t tpuRegistryGet(const char *key, uint64_t defval);
 
+/* Hot-path registry reads go through a per-site cache: tpuRegistryGet is
+ * a getenv (linear environ scan) and the fault-service path was paying
+ * several per fault.  The cache re-resolves only when the registry
+ * GENERATION changes; code that rewrites TPUMEM_* at runtime (in-module
+ * tests flipping knobs) must call tpuRegistryBump() afterwards.  The
+ * reference's registry is likewise snapshotted, not re-read per op
+ * (NVreg_* parsed at module load). */
+uint64_t tpuRegistryGen(void);
+void tpuRegistryBump(void);
+
+typedef struct {
+    _Atomic uint64_t gen;             /* registry gen + 1; 0 = empty */
+    _Atomic uint64_t val;
+} TpuRegCache;
+
+static inline uint64_t tpuRegCacheGet(TpuRegCache *c, const char *key,
+                                      uint64_t defval)
+{
+    uint64_t g = tpuRegistryGen() + 1;
+    if (atomic_load_explicit(&c->gen, memory_order_acquire) == g)
+        return atomic_load_explicit(&c->val, memory_order_relaxed);
+    uint64_t v = tpuRegistryGet(key, defval);
+    atomic_store_explicit(&c->val, v, memory_order_relaxed);
+    atomic_store_explicit(&c->gen, g, memory_order_release);
+    return v;
+}
+
 /* ---------------------------------------------------------------- memdesc */
 
 typedef enum {

@@ -38,16 +38,113 @@ typedef struct {
     uint64_t bits[UVM_MAX_PAGES_PER_BLOCK / 64];
 } UvmPageMask;
 
-void uvmPageMaskZero(UvmPageMask *m);
-void uvmPageMaskFill(UvmPageMask *m, uint32_t npages);
-bool uvmPageMaskTest(const UvmPageMask *m, uint32_t page);
-void uvmPageMaskSet(UvmPageMask *m, uint32_t page);
-void uvmPageMaskClear(UvmPageMask *m, uint32_t page);
-void uvmPageMaskSetRange(UvmPageMask *m, uint32_t first, uint32_t count);
-void uvmPageMaskClearRange(UvmPageMask *m, uint32_t first, uint32_t count);
-uint32_t uvmPageMaskWeight(const UvmPageMask *m, uint32_t npages);
-bool uvmPageMaskEmpty(const UvmPageMask *m, uint32_t npages);
-bool uvmPageMaskFull(const UvmPageMask *m, uint32_t npages);
+/* Mask primitives are inline word ops (reference: uvm_page_mask_* are
+ * bitmap.h wrappers, uvm_va_block_types.h) — the fault-service commit
+ * path runs hundreds of these per fault, so they must not be calls. */
+#include <string.h>
+
+static inline void uvmPageMaskZero(UvmPageMask *m)
+{
+    memset(m->bits, 0, sizeof(m->bits));
+}
+
+static inline bool uvmPageMaskTest(const UvmPageMask *m, uint32_t page)
+{
+    return (m->bits[page / 64] >> (page % 64)) & 1;
+}
+
+static inline void uvmPageMaskSet(UvmPageMask *m, uint32_t page)
+{
+    m->bits[page / 64] |= 1ull << (page % 64);
+}
+
+static inline void uvmPageMaskClear(UvmPageMask *m, uint32_t page)
+{
+    m->bits[page / 64] &= ~(1ull << (page % 64));
+}
+
+/* Word-at-a-time range walker: invokes op(wordIndex, mask) for each
+ * 64-bit word the range touches, with mask covering the in-range bits. */
+#define UVM_MASK_RANGE_WORDS(first, count, wvar, mvar, body)               \
+    do {                                                                   \
+        uint32_t _p = (first), _left = (count);                            \
+        while (_left) {                                                    \
+            uint32_t wvar = _p / 64, _b = _p % 64;                         \
+            uint32_t _span = 64 - _b;                                      \
+            if (_span > _left)                                             \
+                _span = _left;                                             \
+            uint64_t mvar = _span == 64 ? ~0ull                            \
+                                        : (((1ull << _span) - 1) << _b);   \
+            body;                                                          \
+            _p += _span;                                                   \
+            _left -= _span;                                                \
+        }                                                                  \
+    } while (0)
+
+static inline void uvmPageMaskSetRange(UvmPageMask *m, uint32_t first,
+                                       uint32_t count)
+{
+    UVM_MASK_RANGE_WORDS(first, count, w, bm, m->bits[w] |= bm);
+}
+
+static inline void uvmPageMaskClearRange(UvmPageMask *m, uint32_t first,
+                                         uint32_t count)
+{
+    UVM_MASK_RANGE_WORDS(first, count, w, bm, m->bits[w] &= ~bm);
+}
+
+static inline void uvmPageMaskFill(UvmPageMask *m, uint32_t npages)
+{
+    uvmPageMaskZero(m);
+    uvmPageMaskSetRange(m, 0, npages);
+}
+
+/* dst |= src / dst &= ~src over the whole mask. */
+static inline void uvmPageMaskOr(UvmPageMask *dst, const UvmPageMask *src)
+{
+    for (uint32_t i = 0; i < UVM_MAX_PAGES_PER_BLOCK / 64; i++)
+        dst->bits[i] |= src->bits[i];
+}
+
+static inline void uvmPageMaskAndNot(UvmPageMask *dst,
+                                     const UvmPageMask *src)
+{
+    for (uint32_t i = 0; i < UVM_MAX_PAGES_PER_BLOCK / 64; i++)
+        dst->bits[i] &= ~src->bits[i];
+}
+
+/* Any set bit inside [first, first+count)? */
+static inline bool uvmPageMaskIntersectsRange(const UvmPageMask *m,
+                                              uint32_t first, uint32_t count)
+{
+    UVM_MASK_RANGE_WORDS(first, count, w, bm,
+                         if (m->bits[w] & bm) return true);
+    return false;
+}
+
+static inline uint32_t uvmPageMaskWeight(const UvmPageMask *m,
+                                         uint32_t npages)
+{
+    uint32_t w = 0;
+    for (uint32_t i = 0; i < (npages + 63) / 64; i++) {
+        uint64_t word = m->bits[i];
+        if ((i + 1) * 64 > npages && npages % 64)
+            word &= (1ull << (npages % 64)) - 1;
+        w += (uint32_t)__builtin_popcountll(word);
+    }
+    return w;
+}
+
+static inline bool uvmPageMaskEmpty(const UvmPageMask *m, uint32_t npages)
+{
+    return uvmPageMaskWeight(m, npages) == 0;
+}
+
+static inline bool uvmPageMaskFull(const UvmPageMask *m, uint32_t npages)
+{
+    return uvmPageMaskWeight(m, npages) == npages;
+}
+
 /* First set/clear bit at or after `from`; returns npages if none. */
 uint32_t uvmPageMaskFindSet(const UvmPageMask *m, uint32_t npages,
                             uint32_t from);
@@ -199,6 +296,11 @@ typedef struct UvmVaBlock {
      * onto a poison mapping; excluded from residency/migration. */
     UvmPageMask cancelled;
     bool hasCancelled;
+    /* True once uvmBlockPtePopulate wrote any device PTE for this block;
+     * lets uvmBlockPteRevoke skip the per-device table walks on blocks
+     * no device ever mapped (the CPU-fault-only hot path).  Cleared only
+     * by a whole-block revoke — partial revokes may leave live PTEs. */
+    bool devPtesLive;
 } UvmVaBlock;
 
 typedef enum {

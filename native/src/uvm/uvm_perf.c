@@ -29,11 +29,14 @@ void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
 {
     *firstPage = page;
     *count = 1;
-    if (!tpuRegistryGet("uvm_prefetch_enable", 1))
+    static TpuRegCache c_pfEnable;
+    if (!tpuRegCacheGet(&c_pfEnable, "uvm_prefetch_enable", 1))
         return;
 
     uint64_t now = uvmMonotonicNs();
-    uint64_t windowNs = tpuRegistryGet("uvm_prefetch_window_ms", 20) *
+    static TpuRegCache c_pfWindow;
+    uint64_t windowNs = tpuRegCacheGet(&c_pfWindow,
+                                       "uvm_prefetch_window_ms", 20) *
                         1000000ull;
     if (now - blk->windowStartNs > windowNs) {
         blk->windowStartNs = now;
@@ -44,7 +47,10 @@ void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
     blk->lastFaultNs = now;
 
     /* Region doubles with fault pressure: 2^(faults-1) pages, aligned. */
-    uint32_t maxPages = (uint32_t)tpuRegistryGet("uvm_prefetch_max_pages", 32);
+    static TpuRegCache c_pfMax;
+    uint32_t maxPages = (uint32_t)tpuRegCacheGet(&c_pfMax,
+                                                 "uvm_prefetch_max_pages",
+                                                 32);
     uint32_t ppb = blk->npages;
     uint32_t want = 1;
     uint32_t f = blk->windowFaults;
@@ -74,10 +80,13 @@ void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
 
 void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier)
 {
-    if (!tpuRegistryGet("uvm_thrash_enable", 1))
+    static TpuRegCache c_thEnable;
+    if (!tpuRegCacheGet(&c_thEnable, "uvm_thrash_enable", 1))
         return;
     uint64_t now = uvmMonotonicNs();
-    uint64_t windowNs = tpuRegistryGet("uvm_thrash_window_ms", 100) *
+    static TpuRegCache c_thWindow;
+    uint64_t windowNs = tpuRegCacheGet(&c_thWindow,
+                                       "uvm_thrash_window_ms", 100) *
                         1000000ull;
 
     if (blk->pinnedTier >= 0 && blk->pinExpiryNs <= now) {
@@ -94,8 +103,9 @@ void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier)
             blk->windowSwitches = 0;
         }
         blk->windowSwitches++;
+        static TpuRegCache c_thThresh;
         uint32_t threshold =
-            (uint32_t)tpuRegistryGet("uvm_thrash_threshold", 3);
+            (uint32_t)tpuRegCacheGet(&c_thThresh, "uvm_thrash_threshold", 3);
         if (blk->windowSwitches >= threshold && blk->pinnedTier < 0) {
             /* Pin to the device-side tier of the ping-pong pair so the
              * device copy survives; CPU reads duplicate against it. */
@@ -105,7 +115,9 @@ void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier)
             if (pinTo == UVM_TIER_HOST)
                 pinTo = UVM_TIER_HBM;
             blk->pinnedTier = (int32_t)pinTo;
-            blk->pinExpiryNs = now + tpuRegistryGet("uvm_thrash_pin_ms",
+            static TpuRegCache c_thPin;
+            blk->pinExpiryNs = now + tpuRegCacheGet(&c_thPin,
+                                                    "uvm_thrash_pin_ms",
                                                     300) * 1000000ull;
             blk->windowSwitches = 0;
             tpuCounterAdd("uvm_thrash_pins", 1);
@@ -143,11 +155,14 @@ bool uvmPerfBlockPinnedAgainst(UvmVaBlock *blk, UvmTier targetTier)
  */
 bool uvmAccessCounterRecord(UvmVaBlock *blk)
 {
-    if (!tpuRegistryGet("uvm_access_counter_enable", 1))
+    static TpuRegCache c_acEnable;
+    if (!tpuRegCacheGet(&c_acEnable, "uvm_access_counter_enable", 1))
         return false;
     uint64_t now = uvmMonotonicNs();
-    uint64_t windowNs = tpuRegistryGet("uvm_access_counter_window_ms", 100) *
-                        1000000ull;
+    static TpuRegCache c_acWindow;
+    uint64_t windowNs = tpuRegCacheGet(&c_acWindow,
+                                       "uvm_access_counter_window_ms",
+                                       100) * 1000000ull;
     if (now - blk->acWindowStartNs > windowNs) {
         blk->acWindowStartNs = now;
         blk->acCount = 0;
@@ -158,8 +173,10 @@ bool uvmAccessCounterRecord(UvmVaBlock *blk)
      * device hammering a block reads as idle and the sweeper demotes
      * still-hot data. */
     blk->lastFaultNs = now;
+    static TpuRegCache c_acThresh;
     uint32_t threshold =
-        (uint32_t)tpuRegistryGet("uvm_access_counter_threshold", 8);
+        (uint32_t)tpuRegCacheGet(&c_acThresh,
+                                 "uvm_access_counter_threshold", 8);
     if (blk->acCount >= threshold) {
         blk->acCount = 0;
         tpuCounterAdd("uvm_access_counter_promotions", 1);
@@ -173,7 +190,9 @@ bool uvmAccessCounterMaybeDemote(UvmVaSpace *vs, UvmVaBlock *blk)
     if (!blk->acPromoted)
         return false;
     uint64_t now = uvmMonotonicNs();
-    uint64_t decayNs = tpuRegistryGet("uvm_access_counter_decay_ms", 250) *
+    static TpuRegCache c_acDecay;
+    uint64_t decayNs = tpuRegCacheGet(&c_acDecay,
+                                      "uvm_access_counter_decay_ms", 250) *
                        1000000ull;
     if (now - blk->lastFaultNs < decayNs)
         return false;

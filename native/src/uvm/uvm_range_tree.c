@@ -16,65 +16,8 @@
 
 /* ------------------------------------------------------------ page masks */
 
-void uvmPageMaskZero(UvmPageMask *m)
-{
-    memset(m->bits, 0, sizeof(m->bits));
-}
-
-void uvmPageMaskFill(UvmPageMask *m, uint32_t npages)
-{
-    uvmPageMaskZero(m);
-    uvmPageMaskSetRange(m, 0, npages);
-}
-
-bool uvmPageMaskTest(const UvmPageMask *m, uint32_t page)
-{
-    return (m->bits[page / 64] >> (page % 64)) & 1;
-}
-
-void uvmPageMaskSet(UvmPageMask *m, uint32_t page)
-{
-    m->bits[page / 64] |= 1ull << (page % 64);
-}
-
-void uvmPageMaskClear(UvmPageMask *m, uint32_t page)
-{
-    m->bits[page / 64] &= ~(1ull << (page % 64));
-}
-
-void uvmPageMaskSetRange(UvmPageMask *m, uint32_t first, uint32_t count)
-{
-    for (uint32_t p = first; p < first + count; p++)
-        uvmPageMaskSet(m, p);
-}
-
-void uvmPageMaskClearRange(UvmPageMask *m, uint32_t first, uint32_t count)
-{
-    for (uint32_t p = first; p < first + count; p++)
-        uvmPageMaskClear(m, p);
-}
-
-uint32_t uvmPageMaskWeight(const UvmPageMask *m, uint32_t npages)
-{
-    uint32_t w = 0;
-    for (uint32_t i = 0; i < (npages + 63) / 64; i++) {
-        uint64_t word = m->bits[i];
-        if ((i + 1) * 64 > npages && npages % 64)
-            word &= (1ull << (npages % 64)) - 1;
-        w += (uint32_t)__builtin_popcountll(word);
-    }
-    return w;
-}
-
-bool uvmPageMaskEmpty(const UvmPageMask *m, uint32_t npages)
-{
-    return uvmPageMaskWeight(m, npages) == 0;
-}
-
-bool uvmPageMaskFull(const UvmPageMask *m, uint32_t npages)
-{
-    return uvmPageMaskWeight(m, npages) == npages;
-}
+/* Single-bit and range primitives are inline in uvm_internal.h; only the
+ * search helpers stay out of line. */
 
 uint32_t uvmPageMaskFindSet(const UvmPageMask *m, uint32_t npages,
                             uint32_t from)

@@ -454,6 +454,7 @@ static TpuStatus test_access_counters(UvmVaSpace *vs)
     setenv("TPUMEM_UVM_ACCESS_COUNTER_WINDOW_MS", "10000", 1);
     setenv("TPUMEM_UVM_ACCESS_COUNTER_DECAY_MS", "30", 1);
     setenv("TPUMEM_UVM_ACCESS_COUNTER_SWEEP_MS", "10", 1);
+    tpuRegistryBump();          /* hot-path caches re-resolve */
 
     void *hot, *cold;
     CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &hot) == TPU_OK);
@@ -505,6 +506,7 @@ static TpuStatus test_access_counters(UvmVaSpace *vs)
     unsetenv("TPUMEM_UVM_ACCESS_COUNTER_WINDOW_MS");
     unsetenv("TPUMEM_UVM_ACCESS_COUNTER_DECAY_MS");
     unsetenv("TPUMEM_UVM_ACCESS_COUNTER_SWEEP_MS");
+    tpuRegistryBump();
     CHECK(uvmMemFree(vs, hot) == TPU_OK);
     CHECK(uvmMemFree(vs, cold) == TPU_OK);
     return TPU_OK;
@@ -519,6 +521,7 @@ static TpuStatus test_replay_cancel(UvmVaSpace *vs)
     static const char *policies[] = { "0", "1", "2", "3" };
     for (int pi = 0; pi < 4; pi++) {
         setenv("TPUMEM_UVM_FAULT_REPLAY_POLICY", policies[pi], 1);
+        tpuRegistryBump();
         void *p;
         CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &p) == TPU_OK);
         volatile uint8_t *b = p;
@@ -529,6 +532,7 @@ static TpuStatus test_replay_cancel(UvmVaSpace *vs)
         CHECK(uvmMemFree(vs, p) == TPU_OK);
     }
     unsetenv("TPUMEM_UVM_FAULT_REPLAY_POLICY");
+    tpuRegistryBump();
 
     /* Precise fatal-fault cancel (reference :2690): a CPU fault whose
      * service fails (injected CE error under it) is cancelled precisely —

@@ -108,6 +108,7 @@ void uvmBlockPtePopulate(UvmVaBlock *blk, uint32_t firstPage,
             uvmPteBatchWrite(&pb, va, UVM_TIER_CXL, off, writable);
     }
     uvmPteBatchEnd(&pb);
+    blk->devPtesLive = true;
 }
 
 /* Revoke device PTEs for the span on EVERY device and issue one TLB
@@ -115,6 +116,11 @@ void uvmBlockPtePopulate(UvmVaBlock *blk, uint32_t firstPage,
  * transition that moves or drops aperture residency.  blk->lock held. */
 void uvmBlockPteRevoke(UvmVaBlock *blk, uint32_t firstPage, uint32_t count)
 {
+    /* Blocks no device ever mapped (CPU-only traffic) skip the
+     * per-device table walks entirely — this runs on every fault-commit
+     * and every exclusive write. */
+    if (!blk->devPtesLive)
+        return;
     uint64_t ps = uvmPageSize();
     uint32_t ndev = tpurmDeviceCount();
     for (uint32_t d = 0; d < ndev; d++) {
@@ -134,6 +140,8 @@ void uvmBlockPteRevoke(UvmVaBlock *blk, uint32_t firstPage, uint32_t count)
             uvmTlbBatchEnd(&tb);
         }
     }
+    if (firstPage == 0 && count == blk->npages)
+        blk->devPtesLive = false;
 }
 
 /* Allocate backing runs in `arena` covering every page of [first,
@@ -311,7 +319,10 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
     TpuCeStriper striper;
     TpuTracker tracker;
     tpuTrackerInit(&tracker);
-    bool haveCe = block_striper_init(&striper, blk);
+    /* Striper init is LAZY: the first-touch zero-fill path (every
+     * populate fault) never pushes a copy, so it must not pay the CE
+     * pool lookup. */
+    bool haveCe = false, triedCe = false;
     uint64_t bytes = 0;
 
     /* On any failure, drain already-issued stripes before unwinding —
@@ -370,6 +381,10 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                tier_page_ptr(blk, (UvmTier)src, p + span) ==
                    (char *)srcPtr + (uint64_t)span * ps)
             span++;
+        if (!triedCe) {
+            triedCe = true;
+            haveCe = block_striper_init(&striper, blk);
+        }
         if (!haveCe) {
             tpuTrackerDeinit(&tracker);
             return TPU_ERR_INVALID_STATE;
@@ -569,6 +584,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
     UvmVaRange *range = blk->range;
     bool readDup = (range->readDuplication || forceDup) && !forWrite;
     bool pteRevoked = false;    /* one PTE revoke per span, not two */
+    bool hostRwCommitted = false;   /* commit already made span host-RW */
     UvmTierArena *arena = NULL;
     if (dst.tier == UVM_TIER_HBM) {
         arena = uvmTierArenaHbm(dst.devInst);
@@ -630,17 +646,17 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         blk->hbmDevInst = dst.devInst;
 
     for (int retry = 0; ; retry++) {
-        /* Pages not yet resident in dst. */
+        /* Pages not yet resident in dst (word ops: span & ~resident &
+         * ~cancelled). */
         UvmPageMask needed;
         uvmPageMaskZero(&needed);
         uint32_t nneeded = 0;
-        for (uint32_t p = firstPage; p < firstPage + count; p++) {
-            if (!uvmPageMaskTest(&blk->resident[dst.tier], p) &&
-                !uvmPageMaskTest(&blk->cancelled, p)) {
-                uvmPageMaskSet(&needed, p);
-                nneeded++;
-            }
-        }
+        UVM_MASK_RANGE_WORDS(firstPage, count, w, bm, {
+            uint64_t want = bm & ~blk->resident[dst.tier].bits[w] &
+                            ~blk->cancelled.bits[w];
+            needed.bits[w] = want;
+            nneeded += (uint32_t)__builtin_popcountll(want);
+        });
         if (nneeded == 0)
             break;
 
@@ -702,17 +718,12 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
          * and drop the device PTEs covering the moved span. */
         uvmBlockPteRevoke(blk, firstPage, count);
         pteRevoked = true;
-        for (uint32_t p = firstPage; p < firstPage + count; p++) {
-            if (!uvmPageMaskTest(&needed, p))
-                continue;
-            uvmPageMaskSet(&blk->resident[dst.tier], p);
-            uvmPageMaskClear(&blk->devMapped, p);
-            if (!readDup) {
-                for (int t = 0; t < UVM_TIER_COUNT; t++) {
-                    if (t == (int)dst.tier)
-                        continue;
-                    uvmPageMaskClear(&blk->resident[t], p);
-                }
+        uvmPageMaskOr(&blk->resident[dst.tier], &needed);
+        uvmPageMaskAndNot(&blk->devMapped, &needed);
+        if (!readDup) {
+            for (int t = 0; t < UVM_TIER_COUNT; t++) {
+                if (t != (int)dst.tier)
+                    uvmPageMaskAndNot(&blk->resident[t], &needed);
             }
         }
         if (dst.tier == UVM_TIER_HOST) {
@@ -727,6 +738,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
                 block_set_cpu_mapped(blk, firstPage, count);
                 block_gc_runs(blk, UVM_TIER_HBM);
                 block_gc_runs(blk, UVM_TIER_CXL);
+                hostRwCommitted = true;
             }
         } else if (!readDup) {
             /* CPU must re-fault on next touch. */
@@ -757,19 +769,28 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
      * would re-fault forever because nneeded==0 skips the commit path). */
     if (forWrite) {
         bool hadDup = false;
-        for (uint32_t p = firstPage; p < firstPage + count; p++) {
-            for (int t = 0; t < UVM_TIER_COUNT; t++) {
-                if (t != (int)dst.tier &&
-                    uvmPageMaskTest(&blk->resident[t], p))
-                    hadDup = true;
-            }
-            for (int t = 0; t < UVM_TIER_COUNT; t++) {
-                if (t != (int)dst.tier)
-                    uvmPageMaskClear(&blk->resident[t], p);
-            }
-            /* Exclusive write revokes remote (accessed-by) mappings. */
-            uvmPageMaskClear(&blk->devMapped, p);
+        for (int t = 0; t < UVM_TIER_COUNT; t++) {
+            if (t != (int)dst.tier &&
+                uvmPageMaskIntersectsRange(&blk->resident[t], firstPage,
+                                           count))
+                hadDup = true;
         }
+        bool devMappedAny = uvmPageMaskIntersectsRange(&blk->devMapped,
+                                                       firstPage, count);
+        /* Fast path for the CPU-write populate fault: the commit loop
+         * just made this exact span host-exclusive RW (protections,
+         * cpuMapped, run gc and PTE revoke all done there).  With no
+         * duplicate residency and no accessed-by mappings to tear down,
+         * the fix-up below would only repeat that work — notably a
+         * second mprotect syscall over the same span. */
+        if (hostRwCommitted && !hadDup && !devMappedAny)
+            goto fixup_done;
+        for (int t = 0; t < UVM_TIER_COUNT; t++) {
+            if (t != (int)dst.tier)
+                uvmPageMaskClearRange(&blk->resident[t], firstPage, count);
+        }
+        /* Exclusive write revokes remote (accessed-by) mappings. */
+        uvmPageMaskClearRange(&blk->devMapped, firstPage, count);
         if (hadDup)
             /* Duplicates dropped by the exclusive write (reference:
              * UvmEventTypeReadDuplicateInvalidate). */
@@ -791,6 +812,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         block_gc_runs(blk, UVM_TIER_CXL);
     }
 
+fixup_done:
     if (arena)
         uvmLruTouch(arena, blk);
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");

@@ -204,6 +204,12 @@ int main(void)
            (unsigned long long)stats.serviceNsP95,
            (unsigned long long)stats.evictions,
            (unsigned long long)(stats.migratedBytes >> 20));
+    printf("  fault phases: wake p50=%lluns p95=%lluns | svc_one "
+           "p50=%lluns p95=%lluns\n",
+           (unsigned long long)stats.wakeNsP50,
+           (unsigned long long)stats.wakeNsP95,
+           (unsigned long long)stats.svcOneNsP50,
+           (unsigned long long)stats.svcOneNsP95);
 
     EXPECT(tpurm_close(fd) == 0);
 

@@ -168,10 +168,16 @@ class HbmRuntime:
             for b in ids:
                 lo = b * self.block_bytes
                 hi = min(lo + self.block_bytes, self.arena_bytes)
-                # Copy out of the shadow: device_put may be async and
-                # the engine can redirty the span behind us; the copy
-                # pins the snapshot this batch covers.
-                chunks.append(np.array(self._shadow[lo:hi]))
+                # Shadow VIEWS go straight to device_put — no staging
+                # copy.  device_put reads the buffer during the call;
+                # the engine may redirty a span mid-marshal, but any
+                # redirty REPUBLISHES the range, so a later upload
+                # supersedes whatever torn bytes this one carried.  The
+                # shadow itself is always coherent, so the final upload
+                # of every span is correct — and the dropped memcpy was
+                # a full extra pass over every mirrored byte on a box
+                # where the transport is CPU-bound.
+                chunks.append(self._shadow[lo:hi])
             arrs = jax.device_put(chunks, self.device)
             with self._blocks_lock:
                 for b, arr in zip(ids, arrs):

@@ -22,6 +22,7 @@ from .managed import (  # noqa: F401
     Event,
     EventType,
     fault_stats,
+    fault_stats_reset_windows,
     suspend,
     resume,
 )

@@ -81,6 +81,10 @@ class _FaultStats(ctypes.Structure):
         ("evictions", ctypes.c_uint64),
         ("serviceNsP50", ctypes.c_uint64),
         ("serviceNsP95", ctypes.c_uint64),
+        ("wakeNsP50", ctypes.c_uint64),
+        ("wakeNsP95", ctypes.c_uint64),
+        ("svcOneNsP50", ctypes.c_uint64),
+        ("svcOneNsP95", ctypes.c_uint64),
     ]
 
 
@@ -118,6 +122,12 @@ class FaultStats:
     evictions: int
     service_ns_p50: int
     service_ns_p95: int
+    # Phase decomposition: wake = enqueue->batch-pop (futex+scheduler),
+    # svc_one = engine work for one service call.
+    wake_ns_p50: int = 0
+    wake_ns_p95: int = 0
+    svc_one_ns_p50: int = 0
+    svc_one_ns_p95: int = 0
 
 
 @dataclass(frozen=True)
@@ -228,6 +238,12 @@ def resume() -> None:
     _check(_lib().uvmResume(), "uvmResume")
 
 
+def fault_stats_reset_windows() -> None:
+    """Restart the latency percentile windows (counters unaffected), so
+    percentiles read afterwards cover only faults from this point on."""
+    _lib().uvmFaultStatsResetWindows()
+
+
 def fault_stats() -> FaultStats:
     """Global fault-engine statistics (uvm.h uvmFaultStatsGet)."""
     lib = _lib()
@@ -235,7 +251,8 @@ def fault_stats() -> FaultStats:
     lib.uvmFaultStatsGet(ctypes.byref(raw))
     return FaultStats(raw.faultsCpu, raw.faultsDevice, raw.batches,
                       raw.migratedBytes, raw.evictions, raw.serviceNsP50,
-                      raw.serviceNsP95)
+                      raw.serviceNsP95, raw.wakeNsP50, raw.wakeNsP95,
+                      raw.svcOneNsP50, raw.svcOneNsP95)
 
 
 class ToolsSession:
