@@ -173,6 +173,15 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
             before = uvm.fault_stats()
             total = 2 * nbufs * slice_bytes
             ntrials = 3 if rt is not None else 1
+            # Fault-latency metrics are CPU-side and independent of the
+            # relay's transport mode, so they are captured once after
+            # trial 1 (populate + first fault/evict passes — the r4-
+            # comparable window); the transport figures come from the
+            # best PAIR, which may be a later trial.  The two metric
+            # families are measured independently, not pretended to be
+            # one run.
+            fault_after = None
+            fault_evictions = 0
             for _ in range(ntrials):
                 m0 = rt.mirrored_bytes if rt is not None else 0
                 r0 = rt.resync_bytes if rt is not None else 0
@@ -188,6 +197,10 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
                 if rt is not None:
                     rt.fence()  # bytes must be ON-CHIP before we stop
                 dt = time.perf_counter() - t0
+                if fault_after is None:
+                    fault_after = uvm.fault_stats()
+                    fault_evictions = (fault_after.evictions -
+                                       before.evictions)
                 if rt is None:
                     trials.append({"dt": dt, "gbps": total / dt / 1e9})
                     continue
@@ -213,7 +226,7 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
                 })
                 if ceil >= 0.3 and 0.6 <= trials[-1]["eff"] <= 1.0:
                     break       # trustworthy pair at target; stop early
-            after = uvm.fault_stats()
+            after = fault_after
 
             extra = {
                 "fault_p50_us": round(after.service_ns_p50 / 1e3, 1),
@@ -225,7 +238,7 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
                 # not engine cost.
                 "fault_wake_p50_us": round(after.wake_ns_p50 / 1e3, 1),
                 "fault_svc_p50_us": round(after.svc_one_ns_p50 / 1e3, 1),
-                "evictions": after.evictions - before.evictions,
+                "evictions": fault_evictions,
                 "oversub_bytes": total,
             }
             if rt is not None:

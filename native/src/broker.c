@@ -15,9 +15,27 @@
  *   - user memory: the reference kernel copies DMA user buffers with
  *     copy_from/to_user; the broker's analog is process_vm_readv/
  *     writev against a server-side shadow mapping, synced around CXL
- *     DMA requests.  Async DMA from remote clients executes
- *     synchronously (completion must happen before the copy-back —
- *     remote completion events are not forwarded).
+ *     DMA requests.
+ *   - NVOS33/34 (BAR mapping) forwards: a remote map returns the
+ *     DEVICE ARENA MEMFD + offset over SCM_RIGHTS and the client shim
+ *     mmaps the same pages the engine host serves — the client's
+ *     stores land directly in the coherent shadow (reference: the BAR
+ *     is one physical aperture every process maps, escape.c:502).
+ *     NVOS34 forwards the unmap for its flush semantics.
+ *   - events forward: a per-connection SIGNAL PAGE (memfd, shared both
+ *     sides) carries NvNotification records; the engine fires into a
+ *     broker-private slot, a per-event forwarder thread publishes into
+ *     the shared page, and a client-side relay copies into the
+ *     walker's own TpuOsEvent and FUTEX_WAKEs it — the reference's
+ *     OS-event delivery chain (event_notification.c osSetEvent ->
+ *     client waiter) with futexes on shared memory as the OS event.
+ *   - async CXL DMA from remote clients stays ASYNC: device->CXL
+ *     copy-backs into client memory are performed by the event
+ *     forwarder BEFORE the completion notification is published, so a
+ *     client that waits its event (not polls) observes its buffer
+ *     filled — completion-ordered exactly like the reference's DMA
+ *     interrupt -> event chain.  (Clients that arm no event get the
+ *     copy-back at buffer unregister, the quiesce point.)
  *   - lifetime: a dropped connection frees every RM client it created
  *     (rs_server frees clients of dead processes the same way).
  *
@@ -29,12 +47,15 @@
 #include "tpurm/abi.h"
 
 #include <errno.h>
+#include <limits.h>
+#include <linux/futex.h>
 #include <stdatomic.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/syscall.h>
 #include <sys/uio.h>
 #include <sys/un.h>
 #include <unistd.h>
@@ -44,8 +65,18 @@
 #define BROKER_MAX_AUX   (1u << 20)
 #define BROKER_MAX_CLIENTS_PER_CONN 16
 #define BROKER_MAX_SHADOWS 32
+#define BROKER_EV_SLOTS  16
+#define BROKER_MAX_DMA_SPANS 64
+#define BROKER_MAX_CLI_MAPS  64
 
 enum { BR_OP_OPEN = 1, BR_OP_CLOSE = 2, BR_OP_IOCTL = 3 };
+
+/* Reply flag: an fd rides the rep via SCM_RIGHTS (arena memfd for a
+ * map, signal-page memfd for the first event). */
+#define BR_REP_FLAG_FD     0x1u
+/* A whole client root was freed: every event relay the shim runs for
+ * this connection is dead — stop them all. */
+#define BR_REP_FLAG_EV_ALL 0x2u
 
 typedef struct {
     uint32_t op;
@@ -61,6 +92,9 @@ typedef struct {
     int32_t err;
     uint32_t mainSize;
     uint32_t auxSize;
+    uint32_t flags;             /* BR_REP_FLAG_* */
+    uint32_t slot;              /* event signal slot + 1 (0 = none) */
+    uint64_t mapOffset;         /* memfd offset for a map reply */
 } BrokerRep;
 
 /* ------------------------------------------------------------ wire io */
@@ -81,6 +115,67 @@ static int io_all(int fd, void *buf, size_t n, bool write_side)
     return 0;
 }
 
+/* Send `rep` with an optional fd attached via SCM_RIGHTS. */
+static int rep_send(int sock, BrokerRep *rep, int fd)
+{
+    struct iovec iov = { .iov_base = rep, .iov_len = sizeof(*rep) };
+    union { struct cmsghdr h; char buf[CMSG_SPACE(sizeof(int))]; } cm;
+    struct msghdr msg = { .msg_iov = &iov, .msg_iovlen = 1 };
+    if (fd >= 0) {
+        memset(&cm, 0, sizeof(cm));
+        msg.msg_control = cm.buf;
+        msg.msg_controllen = CMSG_SPACE(sizeof(int));
+        struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+        c->cmsg_level = SOL_SOCKET;
+        c->cmsg_type = SCM_RIGHTS;
+        c->cmsg_len = CMSG_LEN(sizeof(int));
+        memcpy(CMSG_DATA(c), &fd, sizeof(int));
+    }
+    ssize_t r;
+    do {
+        r = sendmsg(sock, &msg, 0);
+    } while (r < 0 && errno == EINTR);
+    if (r < 0)
+        return -1;
+    /* Remainder (rep is small; partial sendmsg on stream sockets can
+     * still happen under pressure). */
+    if ((size_t)r < sizeof(*rep))
+        return io_all(sock, (char *)rep + r, sizeof(*rep) - r, true);
+    return 0;
+}
+
+/* Receive a full BrokerRep, capturing an SCM_RIGHTS fd if attached. */
+static int rep_recv(int sock, BrokerRep *rep, int *fdOut)
+{
+    struct iovec iov = { .iov_base = rep, .iov_len = sizeof(*rep) };
+    union { struct cmsghdr h; char buf[CMSG_SPACE(sizeof(int))]; } cm;
+    struct msghdr msg = { .msg_iov = &iov, .msg_iovlen = 1,
+                          .msg_control = cm.buf,
+                          .msg_controllen = sizeof(cm.buf) };
+    if (fdOut)
+        *fdOut = -1;
+    ssize_t r;
+    do {
+        r = recvmsg(sock, &msg, 0);
+    } while (r < 0 && errno == EINTR);
+    if (r <= 0)
+        return -1;
+    for (struct cmsghdr *c = CMSG_FIRSTHDR(&msg); c;
+         c = CMSG_NXTHDR(&msg, c)) {
+        if (c->cmsg_level == SOL_SOCKET && c->cmsg_type == SCM_RIGHTS) {
+            int fd;
+            memcpy(&fd, CMSG_DATA(c), sizeof(int));
+            if (fdOut && *fdOut < 0)
+                *fdOut = fd;
+            else
+                close(fd);
+        }
+    }
+    if ((size_t)r < sizeof(*rep))
+        return io_all(sock, (char *)rep + r, sizeof(*rep) - r, false);
+    return 0;
+}
+
 /* ============================================================ server */
 
 typedef struct {
@@ -91,7 +186,36 @@ typedef struct {
     bool used;
 } BrokerShadow;
 
+struct BrokerConn;
+
+/* Per-event forwarder: engine fires into the PRIVATE slot; the thread
+ * performs the connection's pending DMA copy-backs, then publishes the
+ * record into the SHARED signal page the client mmaps. */
 typedef struct {
+    struct BrokerConn *conn;
+    uint32_t slot;
+    uint32_t clientH;           /* engine-side (real) client handle */
+    uint32_t handle;            /* event object handle */
+    pthread_t tid;
+    _Atomic bool stop;
+    bool used;
+} BrokerEvSlot;
+
+/* Async dev->CXL span awaiting copy-back into client memory.  Spans
+ * stay recorded (and are re-copied on every later completion) until
+ * their buffer unregisters — a span copied while ANOTHER transfer is
+ * still in flight may be torn, and the in-flight transfer's own
+ * completion event re-copies it complete.  The client contract (as
+ * with real DMA) is to read only after its completion event. */
+typedef struct {
+    uint64_t bufHandle;
+    uint64_t clientVa;
+    char *shadow;
+    uint64_t size;
+    bool used;
+} BrokerDmaSpan;
+
+typedef struct BrokerConn {
     int sock;
     pid_t peer;
     int fds[BROKER_MAX_FDS];            /* token -> local pseudo fd */
@@ -101,6 +225,16 @@ typedef struct {
         bool used;
     } clients[BROKER_MAX_CLIENTS_PER_CONN];
     BrokerShadow shadows[BROKER_MAX_SHADOWS];
+
+    /* Event plumbing (lazy: created on the first EVENT_OS alloc). */
+    int evFd;                           /* signal page memfd (-1: none) */
+    TpuOsEvent *evShared;               /* mmap of evFd (server side) */
+    TpuOsEvent *evPriv;                 /* engine fires here */
+    BrokerEvSlot evSlots[BROKER_EV_SLOTS];
+    bool evFdSent;                      /* client already holds the fd */
+
+    pthread_mutex_t dmaLock;
+    BrokerDmaSpan dmaSpans[BROKER_MAX_DMA_SPANS];
 } BrokerConn;
 
 static _Atomic uint32_t g_next_hclient = 0xB0000001u;
@@ -150,6 +284,146 @@ static BrokerShadow *shadow_find(BrokerConn *c, uint64_t handle)
     return NULL;
 }
 
+/* ------------------------------------------------------- event forward */
+
+static long br_futex(uint32_t *uaddr, int op, uint32_t val,
+                     const struct timespec *ts)
+{
+    return syscall(SYS_futex, uaddr, op, val, ts, NULL, 0);
+}
+
+/* Copy every recorded async dev->CXL span back into client memory.
+ * Runs before a completion notification is published, so the client's
+ * event-ordered reads see their bytes (see header comment). */
+static void conn_dma_copyback(BrokerConn *c, uint64_t onlyBuf)
+{
+    pthread_mutex_lock(&c->dmaLock);
+    for (int i = 0; i < BROKER_MAX_DMA_SPANS; i++) {
+        BrokerDmaSpan *s = &c->dmaSpans[i];
+        if (!s->used || (onlyBuf && s->bufHandle != onlyBuf))
+            continue;
+        if (peer_copy(c->peer, s->shadow, s->clientVa, s->size,
+                      true) != 0)
+            tpuLog(TPU_LOG_WARN, "broker",
+                   "async DMA copy-back to pid %d failed", c->peer);
+        if (onlyBuf)
+            s->used = false;    /* unregister: span retires */
+    }
+    pthread_mutex_unlock(&c->dmaLock);
+}
+
+/* Returns true when a NEW span was recorded; false when an identical
+ * span already exists (a still-in-flight earlier request owns it) or
+ * the table is full. */
+static bool conn_dma_record(BrokerConn *c, uint64_t bufHandle,
+                            uint64_t clientVa, char *shadow, uint64_t size)
+{
+    pthread_mutex_lock(&c->dmaLock);
+    int freeIdx = -1;
+    for (int i = 0; i < BROKER_MAX_DMA_SPANS; i++) {
+        BrokerDmaSpan *s = &c->dmaSpans[i];
+        if (s->used && s->bufHandle == bufHandle &&
+            s->clientVa == clientVa && s->size == size) {
+            pthread_mutex_unlock(&c->dmaLock);   /* duplicate request */
+            return false;
+        }
+        if (!s->used && freeIdx < 0)
+            freeIdx = i;
+    }
+    if (freeIdx < 0) {
+        /* Table full: the dropped span's copy-back then only happens
+         * at unregister — a documented degradation, never corruption:
+         * the shadow stays authoritative. */
+        tpuLog(TPU_LOG_WARN, "broker", "async DMA span table full");
+        pthread_mutex_unlock(&c->dmaLock);
+        return false;
+    }
+    c->dmaSpans[freeIdx] = (BrokerDmaSpan){ .bufHandle = bufHandle,
+                                            .clientVa = clientVa,
+                                            .shadow = shadow,
+                                            .size = size, .used = true };
+    pthread_mutex_unlock(&c->dmaLock);
+    return true;
+}
+
+/* Forwarder thread: private slot -> (copy-backs) -> shared slot. */
+static void *ev_forwarder(void *arg)
+{
+    BrokerEvSlot *es = arg;
+    BrokerConn *c = es->conn;
+    TpuOsEvent *priv = &c->evPriv[es->slot];
+    TpuOsEvent *pub = &c->evShared[es->slot];
+    /* Start from the CURRENT count: a reused slot's counters carry the
+     * previous occupant's total, which must not replay as spurious
+     * deliveries.  Safe because events start DISABLED — nothing fires
+     * between registration and this thread observing the snapshot. */
+    uint32_t seen = __atomic_load_n(&priv->signaled, __ATOMIC_ACQUIRE);
+    struct timespec ts = { .tv_sec = 0, .tv_nsec = 100 * 1000 * 1000 };
+    while (!atomic_load_explicit(&es->stop, memory_order_acquire)) {
+        uint32_t cur = __atomic_load_n(&priv->signaled, __ATOMIC_ACQUIRE);
+        if (cur == seen) {
+            br_futex(&priv->signaled, FUTEX_WAIT, cur, &ts);
+            continue;
+        }
+        /* Completion-ordering: client buffers fill BEFORE the client
+         * can observe the notification. */
+        conn_dma_copyback(c, 0);
+        /* Publish in the reference's field order (nvgputypes.h:50-55):
+         * payload first, status + signal word last with release. */
+        pub->rec.timeStampNanoseconds[0] = priv->rec.timeStampNanoseconds[0];
+        pub->rec.timeStampNanoseconds[1] = priv->rec.timeStampNanoseconds[1];
+        pub->rec.info32 = priv->rec.info32;
+        pub->rec.info16 = priv->rec.info16;
+        __atomic_store_n(&pub->rec.status, priv->rec.status,
+                         __ATOMIC_RELEASE);
+        __atomic_fetch_add(&pub->signaled, cur - seen, __ATOMIC_RELEASE);
+        br_futex(&pub->signaled, FUTEX_WAKE, INT_MAX, NULL);
+        seen = cur;
+    }
+    return NULL;
+}
+
+/* Lazy per-connection signal page: a memfd both sides map.  Returns
+ * the fd to ship to the client on first use, -1 afterwards. */
+static int conn_ev_init(BrokerConn *c)
+{
+    if (c->evFd >= 0)
+        return -1;
+    int fd = memfd_create("tpurm-ev", MFD_CLOEXEC);
+    if (fd < 0)
+        return -2;
+    size_t sz = BROKER_EV_SLOTS * sizeof(TpuOsEvent);
+    if (ftruncate(fd, (off_t)sz) != 0) {
+        close(fd);
+        return -2;
+    }
+    void *m = mmap(NULL, sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    TpuOsEvent *priv = calloc(BROKER_EV_SLOTS, sizeof(TpuOsEvent));
+    if (m == MAP_FAILED || !priv) {
+        if (m != MAP_FAILED)
+            munmap(m, sz);
+        free(priv);
+        close(fd);
+        return -2;
+    }
+    c->evFd = fd;
+    c->evShared = m;
+    c->evPriv = priv;
+    return fd;
+}
+
+static void conn_ev_slot_stop(BrokerEvSlot *es)
+{
+    if (!es->used)
+        return;
+    atomic_store_explicit(&es->stop, true, memory_order_release);
+    /* Nudge the forwarder out of its futex wait. */
+    br_futex(&es->conn->evPriv[es->slot].signaled, FUTEX_WAKE, INT_MAX,
+             NULL);
+    pthread_join(es->tid, NULL);
+    es->used = false;
+}
+
 /* CXL controls against a remote client: swap user VAs for server-side
  * shadow mappings and sync them with process_vm copies — the kernel
  * reference's copy_from/to_user analog. */
@@ -192,6 +466,9 @@ static TpuStatus conn_control_cxl(BrokerConn *c, TpuRmControlParams *p,
         BrokerShadow *sh = shadow_find(c, up->bufferHandle);
         TpuStatus st = tpurmControl(p);
         if (st == TPU_OK && p->status == TPU_OK && sh) {
+            /* Unregister quiesced every in-flight DMA on this buffer:
+             * final copy-back of any async spans, then retire them. */
+            conn_dma_copyback(c, up->bufferHandle);
             munmap(sh->shadow, sh->size);
             sh->used = false;
         }
@@ -203,22 +480,54 @@ static TpuStatus conn_control_cxl(BrokerConn *c, TpuRmControlParams *p,
         if (!sh) /* unknown handle: let the engine produce the status */
             return tpurmControl(p);
         bool toDev = (dp->flags & TPU_CXL_DMA_FLAG_CXL_TO_DEV) != 0;
+        bool async = (dp->flags & TPU_CXL_DMA_FLAG_ASYNC) != 0;
         if (dp->cxlOffset > sh->size || dp->size > sh->size - dp->cxlOffset)
             return tpurmControl(p);       /* OOB: engine rejects */
-        /* Remote DMA is synchronous: the shadow<->client sync must
-         * bracket the copy (async completion is not forwarded). */
-        uint32_t flags = dp->flags;
-        dp->flags &= ~TPU_CXL_DMA_FLAG_ASYNC;
+        /* CXL->device needs the client's bytes in the shadow BEFORE the
+         * engine reads them — always synchronous on the inbound side
+         * (the reference's copy_from_user happens before the CE push
+         * too).  The request itself keeps its ASYNC flag. */
         if (toDev &&
             peer_copy(c->peer, (char *)sh->shadow + dp->cxlOffset,
                       sh->clientVa + dp->cxlOffset, dp->size, false) != 0)
             return TPU_ERR_INVALID_ADDRESS;
+        /* Async dev->CXL: the copy-back into client memory is
+         * COMPLETION-ORDERED — the event forwarder performs it before
+         * publishing the completion notification; clients that never
+         * arm an event get it at unregister (the quiesce point).  The
+         * span is recorded BEFORE submission: a fast completion can
+         * fire the event while this thread is still between submit and
+         * record, and the forwarder must find the span then.  (An
+         * early copy of a not-yet-finished span hands over stale
+         * bytes nobody has been notified about — harmless.) */
+        bool recorded = false;
+        if (async && !toDev)
+            recorded = conn_dma_record(c, dp->cxlBufferHandle,
+                                       sh->clientVa + dp->cxlOffset,
+                                       (char *)sh->shadow + dp->cxlOffset,
+                                       dp->size);
         TpuStatus st = tpurmControl(p);
-        if (st == TPU_OK && p->status == TPU_OK && !toDev &&
-            peer_copy(c->peer, (char *)sh->shadow + dp->cxlOffset,
-                      sh->clientVa + dp->cxlOffset, dp->size, true) != 0)
-            st = TPU_ERR_INVALID_ADDRESS;
-        dp->flags = flags;
+        if (recorded && !(st == TPU_OK && p->status == TPU_OK)) {
+            /* OUR submission failed: retire the span WE recorded (an
+             * identical span owned by an earlier in-flight request was
+             * never re-recorded and must keep its copy-back). */
+            pthread_mutex_lock(&c->dmaLock);
+            for (int i = 0; i < BROKER_MAX_DMA_SPANS; i++) {
+                BrokerDmaSpan *s = &c->dmaSpans[i];
+                if (s->used && s->bufHandle == dp->cxlBufferHandle &&
+                    s->clientVa == sh->clientVa + dp->cxlOffset &&
+                    s->size == dp->size)
+                    s->used = false;
+            }
+            pthread_mutex_unlock(&c->dmaLock);
+        }
+        if (st == TPU_OK && p->status == TPU_OK && !toDev && !async) {
+            if (peer_copy(c->peer,
+                          (char *)sh->shadow + dp->cxlOffset,
+                          sh->clientVa + dp->cxlOffset,
+                          dp->size, true) != 0)
+                st = TPU_ERR_INVALID_ADDRESS;
+        }
         return st;
     }
     default:
@@ -226,12 +535,28 @@ static TpuStatus conn_control_cxl(BrokerConn *c, TpuRmControlParams *p,
     }
 }
 
+/* Find the device whose arena shadow contains server VA `addr`. */
+static TpurmDevice *dev_for_addr(uint64_t addr)
+{
+    uint32_t n = tpurmDeviceCount();
+    for (uint32_t i = 0; i < n; i++) {
+        TpurmDevice *d = tpurmDeviceGet(i);
+        if (!d || !d->hbmBase)
+            continue;
+        uint64_t base = (uint64_t)(uintptr_t)d->hbmBase;
+        if (addr >= base && addr < base + d->hbmSize)
+            return d;
+    }
+    return NULL;
+}
+
 static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
-                             BrokerRep *rep, void **auxOut)
+                             BrokerRep *rep, void **auxOut, int *fdOut)
 {
     rep->ret = 0;
     rep->err = 0;
     *auxOut = aux;
+    *fdOut = -1;
     switch (rq->escNr) {
     case TPU_ESC_RM_ALLOC: {
         TpuRmAllocParams p;
@@ -259,17 +584,6 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
             if (p.status != TPU_OK)
                 conn_unmap_client(c, orig);
             p.hRoot = p.hObjectParent = p.hObjectNew = orig;
-        } else if (p.hClass == TPU_CLASS_EVENT_OS) {
-            /* Remote events are NOT forwarded: the alloc's `data` is a
-             * TpuOsEvent* in the CLIENT's address space — registering
-             * it would make the engine host deliver (write + futex)
-             * through a foreign VA.  Same stance as async DMA: remote
-             * clients poll synchronously. */
-            p.status = TPU_ERR_NOT_SUPPORTED;
-            memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
-            rep->mainSize = sizeof(p);
-            rep->auxSize = rq->auxSize;
-            return;
         } else {
             uint32_t real = conn_map_client(c, p.hRoot, false);
             uint32_t clientH = p.hRoot;
@@ -280,6 +594,47 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
                 rep->auxSize = rq->auxSize;
                 return;
             }
+            /* EVENT_OS forwards: the alloc's `data` is a TpuOsEvent*
+             * in the CLIENT's address space — the engine cannot signal
+             * a foreign VA, so the registration is REDIRECTED to a
+             * broker-private slot whose forwarder publishes into the
+             * shared signal page the client maps (reference: the
+             * kernel signals an OS event handle, not user memory —
+             * event_notification.c osSetEvent). */
+            int evSlot = -1;
+            uint64_t origData = 0;
+            if (p.hClass == TPU_CLASS_EVENT_OS &&
+                rq->auxSize == sizeof(TpuEventAllocParams)) {
+                int shipFd = conn_ev_init(c);
+                if (shipFd == -2) {
+                    p.status = TPU_ERR_NO_MEMORY;
+                    memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+                    rep->mainSize = sizeof(p);
+                    rep->auxSize = rq->auxSize;
+                    return;
+                }
+                for (int i = 0; i < BROKER_EV_SLOTS; i++) {
+                    if (!c->evSlots[i].used) {
+                        evSlot = i;
+                        break;
+                    }
+                }
+                if (evSlot < 0) {
+                    p.status = TPU_ERR_INSUFFICIENT_RESOURCES;
+                    memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+                    rep->mainSize = sizeof(p);
+                    rep->auxSize = rq->auxSize;
+                    return;
+                }
+                TpuEventAllocParams *ep = aux;
+                origData = ep->data;
+                ep->data = (uint64_t)(uintptr_t)&c->evPriv[evSlot];
+                if (shipFd >= 0 && !c->evFdSent) {
+                    *fdOut = shipFd;
+                    rep->flags |= BR_REP_FLAG_FD;
+                    c->evFdSent = true;
+                }
+            }
             p.hRoot = real;
             if (p.hObjectParent == clientH)
                 p.hObjectParent = real;
@@ -288,6 +643,29 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
             p.hRoot = clientH;
             if (p.hObjectParent == real)
                 p.hObjectParent = clientH;
+            if (evSlot >= 0) {
+                TpuEventAllocParams *ep = aux;
+                ep->data = origData;        /* never leak server VAs */
+                if (p.status == TPU_OK) {
+                    BrokerEvSlot *es = &c->evSlots[evSlot];
+                    es->conn = c;
+                    es->slot = (uint32_t)evSlot;
+                    es->clientH = real;
+                    es->handle = p.hObjectNew;
+                    atomic_store(&es->stop, false);
+                    if (pthread_create(&es->tid, NULL, ev_forwarder,
+                                       es) == 0) {
+                        es->used = true;
+                        rep->slot = (uint32_t)evSlot + 1;
+                    } else {
+                        /* No forwarder, no event: unwind the alloc. */
+                        TpuRmFreeParams fp = { .hRoot = real,
+                                               .hObjectOld = p.hObjectNew };
+                        tpurmFree(&fp);
+                        p.status = TPU_ERR_OPERATING_SYSTEM;
+                    }
+                }
+            }
         }
         memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
         rep->mainSize = sizeof(p);
@@ -336,8 +714,31 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
             if (p.hObjectParent == clientH)
                 p.hObjectParent = real;
             tpurmFree(&p);
-            if (p.status == TPU_OK && p.hObjectOld == real)
-                conn_unmap_client(c, clientH);
+            if (p.status == TPU_OK) {
+                if (p.hObjectOld == real) {
+                    /* Whole client root freed: every event under it is
+                     * gone — stop all of this connection's forwarders
+                     * registered against that client, and tell the
+                     * shim to retire its relays too. */
+                    for (int i = 0; i < BROKER_EV_SLOTS; i++)
+                        if (c->evSlots[i].used &&
+                            c->evSlots[i].clientH == real) {
+                            conn_ev_slot_stop(&c->evSlots[i]);
+                            rep->flags |= BR_REP_FLAG_EV_ALL;
+                        }
+                    conn_unmap_client(c, clientH);
+                } else {
+                    for (int i = 0; i < BROKER_EV_SLOTS; i++) {
+                        BrokerEvSlot *es = &c->evSlots[i];
+                        if (es->used && es->clientH == real &&
+                            es->handle == p.hObjectOld) {
+                            conn_ev_slot_stop(es);
+                            /* Tell the shim which relay to retire. */
+                            rep->slot = (uint32_t)i + 1;
+                        }
+                    }
+                }
+            }
             p.hRoot = clientH;
         }
         memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
@@ -345,11 +746,84 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
         rep->auxSize = rq->auxSize;
         return;
     }
+    case TPU_ESC_RM_MAP_MEMORY: {
+        /* NVOS33 remotely: serve the map on the engine side, then hand
+         * the client (arena memfd, offset) over SCM_RIGHTS — the
+         * client shim mmaps the SAME pages, so its loads/stores hit
+         * the coherent shadow directly (reference: every process maps
+         * the one physical BAR aperture, escape.c:502).  The reply's
+         * pLinearAddress carries the SERVER address as an opaque
+         * cookie the shim hands back at unmap. */
+        TpuMapMemoryParams p;
+        if (rq->mainSize != sizeof(p)) {
+            rep->ret = -1; rep->err = EINVAL; return;
+        }
+        memcpy(&p, (char *)aux + rq->auxSize, sizeof(p));
+        uint32_t clientH = p.hClient;
+        uint32_t real = conn_map_client(c, p.hClient, false);
+        if (!real) {
+            p.status = TPU_ERR_INVALID_CLIENT;
+        } else {
+            p.hClient = real;
+            int lfd = c->fds[rq->fdToken];
+            if (tpurm_ioctl(lfd, _IOWR(TPU_IOCTL_MAGIC,
+                                       TPU_ESC_RM_MAP_MEMORY,
+                                       TpuMapMemoryParams), &p) != 0)
+                p.status = TPU_ERR_OPERATING_SYSTEM;
+            p.hClient = clientH;
+            if (p.status == TPU_OK) {
+                TpurmDevice *d = dev_for_addr(p.pLinearAddress);
+                if (d && d->hbmFd >= 0) {
+                    *fdOut = d->hbmFd;
+                    rep->flags |= BR_REP_FLAG_FD;
+                    rep->mapOffset = p.pLinearAddress -
+                                     (uint64_t)(uintptr_t)d->hbmBase;
+                } else {
+                    /* Anonymous arena: nothing shippable.  Undo. */
+                    TpuUnmapMemoryParams up = {
+                        .hClient = real, .hDevice = p.hDevice,
+                        .hMemory = p.hMemory,
+                        .pLinearAddress = p.pLinearAddress };
+                    tpurm_ioctl(lfd, _IOWR(TPU_IOCTL_MAGIC,
+                                           TPU_ESC_RM_UNMAP_MEMORY,
+                                           TpuUnmapMemoryParams), &up);
+                    p.status = TPU_ERR_NOT_SUPPORTED;
+                }
+            }
+        }
+        memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+        rep->mainSize = sizeof(p);
+        rep->auxSize = rq->auxSize;
+        return;
+    }
+    case TPU_ESC_RM_UNMAP_MEMORY: {
+        /* NVOS34 remotely: the shim already munmapped its window and
+         * hands back the server cookie; the engine-side unmap performs
+         * the flush (mirror publish) semantics. */
+        TpuUnmapMemoryParams p;
+        if (rq->mainSize != sizeof(p)) {
+            rep->ret = -1; rep->err = EINVAL; return;
+        }
+        memcpy(&p, (char *)aux + rq->auxSize, sizeof(p));
+        uint32_t clientH = p.hClient;
+        uint32_t real = conn_map_client(c, p.hClient, false);
+        if (!real) {
+            p.status = TPU_ERR_INVALID_CLIENT;
+        } else {
+            p.hClient = real;
+            if (tpurm_ioctl(c->fds[rq->fdToken],
+                            _IOWR(TPU_IOCTL_MAGIC,
+                                  TPU_ESC_RM_UNMAP_MEMORY,
+                                  TpuUnmapMemoryParams), &p) != 0)
+                p.status = TPU_ERR_OPERATING_SYSTEM;
+            p.hClient = clientH;
+        }
+        memcpy((char *)aux + rq->auxSize, &p, sizeof(p));
+        rep->mainSize = sizeof(p);
+        rep->auxSize = rq->auxSize;
+        return;
+    }
     default:
-        /* NVOS33/34 (BAR mapping) intentionally not forwarded: a map
-         * returns a pointer into the ENGINE HOST's address space,
-         * meaningless to a remote client — same stance as events.
-         * Remote data access rides the CXL DMA escapes instead. */
         rep->ret = -1;
         rep->err = ENOTTY;
         return;
@@ -373,6 +847,7 @@ static void *conn_thread(void *arg)
             break;
         BrokerRep rep = { 0 };
         void *auxOut = buf;
+        int repFd = -1;
         switch (rq.op) {
         case BR_OP_OPEN: {
             rq.path[sizeof(rq.path) - 1] = 0;
@@ -410,14 +885,16 @@ static void *conn_thread(void *arg)
                 rep.ret = -1;
                 rep.err = EBADF;
             } else {
-                conn_serve_ioctl(c, &rq, buf, &rep, &auxOut);
+                conn_serve_ioctl(c, &rq, buf, &rep, &auxOut, &repFd);
             }
             break;
         default:
             rep.ret = -1;
             rep.err = EINVAL;
         }
-        if (io_all(c->sock, &rep, sizeof(rep), true) != 0)
+        /* repFd (arena memfd / signal page) is connection-owned state;
+         * sendmsg duplicates it into the peer, nothing to close here. */
+        if (rep_send(c->sock, &rep, repFd) != 0)
             break;
         if (rep.auxSize + rep.mainSize &&
             io_all(c->sock, auxOut, rep.auxSize + rep.mainSize, true) != 0)
@@ -425,8 +902,11 @@ static void *conn_thread(void *arg)
     }
 
 out:
-    /* Connection died: free its RM clients (rs_server frees clients of
-     * dead processes) and release shadows + fds. */
+    /* Connection died: stop event forwarders first (they reference the
+     * conn + client memory), then free its RM clients (rs_server frees
+     * clients of dead processes) and release shadows + fds. */
+    for (int i = 0; i < BROKER_EV_SLOTS; i++)
+        conn_ev_slot_stop(&c->evSlots[i]);
     for (int i = 0; i < BROKER_MAX_CLIENTS_PER_CONN; i++) {
         if (c->clients[i].used) {
             TpuRmFreeParams fp = { .hRoot = c->clients[i].realH,
@@ -440,6 +920,12 @@ out:
     for (int i = 0; i < BROKER_MAX_FDS; i++)
         if (c->fds[i])
             tpurm_close(c->fds[i]);
+    if (c->evFd >= 0) {
+        munmap(c->evShared, BROKER_EV_SLOTS * sizeof(TpuOsEvent));
+        free(c->evPriv);
+        close(c->evFd);
+    }
+    pthread_mutex_destroy(&c->dmaLock);
     close(c->sock);
     free(buf);
     free(c);
@@ -471,6 +957,8 @@ static void *accept_thread(void *arg)
         }
         c->sock = s;
         c->peer = cred.pid;
+        c->evFd = -1;
+        pthread_mutex_init(&c->dmaLock, NULL);
         pthread_t tid;
         if (pthread_create(&tid, NULL, conn_thread, c) != 0) {
             close(s);
@@ -521,6 +1009,95 @@ static struct {
     bool fdUsed[BROKER_MAX_FDS];
 } g_cli = { .lock = PTHREAD_MUTEX_INITIALIZER, .sock = -1 };
 
+/* Client-side NVOS33 windows: userPtr is what the caller dereferences
+ * (a local mmap of the arena memfd); cookie is the server VA handed
+ * back verbatim at unmap. */
+static struct {
+    pthread_mutex_t lock;
+    struct {
+        uint64_t userPtr;
+        void *mapBase;
+        size_t mapLen;
+        uint64_t cookie;
+        uint64_t length;
+        uint32_t hMemory;
+        bool used;
+    } maps[BROKER_MAX_CLI_MAPS];
+} g_cliMaps = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+/* Client-side event relays: one thread per armed slot copies the
+ * shared-page record into the walker's own TpuOsEvent and wakes its
+ * futex — the reference's client-side OS-event waiter. */
+static struct {
+    pthread_mutex_t lock;
+    TpuOsEvent *page;                     /* mmap of the signal memfd */
+    struct {
+        TpuOsEvent *walker;
+        pthread_t tid;
+        _Atomic bool stop;
+        bool used;
+    } slots[BROKER_EV_SLOTS];
+} g_cliEv = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+typedef struct {
+    uint32_t slot;
+} CliRelayArg;
+
+static void *cli_ev_relay(void *argp)
+{
+    uint32_t slot = ((CliRelayArg *)argp)->slot;
+    free(argp);
+    TpuOsEvent *pub = &g_cliEv.page[slot];
+    TpuOsEvent *walker = g_cliEv.slots[slot].walker;
+    /* Same snapshot rule as the server forwarder: a reused slot's
+     * counter must not replay the previous occupant's deliveries. */
+    uint32_t seen = __atomic_load_n(&pub->signaled, __ATOMIC_ACQUIRE);
+    struct timespec ts = { .tv_sec = 0, .tv_nsec = 100 * 1000 * 1000 };
+    while (!atomic_load_explicit(&g_cliEv.slots[slot].stop,
+                                 memory_order_acquire)) {
+        uint32_t cur = __atomic_load_n(&pub->signaled, __ATOMIC_ACQUIRE);
+        if (cur == seen) {
+            br_futex(&pub->signaled, FUTEX_WAIT, cur, &ts);
+            continue;
+        }
+        if (walker) {
+            /* Reference fill order: payload, then status, then the
+             * signal word (nvgputypes.h:50-55). */
+            walker->rec.timeStampNanoseconds[0] =
+                pub->rec.timeStampNanoseconds[0];
+            walker->rec.timeStampNanoseconds[1] =
+                pub->rec.timeStampNanoseconds[1];
+            walker->rec.info32 = pub->rec.info32;
+            walker->rec.info16 = pub->rec.info16;
+            __atomic_store_n(&walker->rec.status, pub->rec.status,
+                             __ATOMIC_RELEASE);
+            __atomic_fetch_add(&walker->signaled, cur - seen,
+                               __ATOMIC_RELEASE);
+            br_futex(&walker->signaled, FUTEX_WAKE, INT_MAX, NULL);
+        }
+        seen = cur;
+    }
+    return NULL;
+}
+
+static void cli_ev_slot_stop(uint32_t slot)
+{
+    pthread_mutex_lock(&g_cliEv.lock);
+    if (slot < BROKER_EV_SLOTS && g_cliEv.slots[slot].used) {
+        atomic_store_explicit(&g_cliEv.slots[slot].stop, true,
+                              memory_order_release);
+        if (g_cliEv.page)
+            br_futex(&g_cliEv.page[slot].signaled, FUTEX_WAKE, INT_MAX,
+                     NULL);
+        pthread_t tid = g_cliEv.slots[slot].tid;
+        g_cliEv.slots[slot].used = false;
+        pthread_mutex_unlock(&g_cliEv.lock);
+        pthread_join(tid, NULL);
+        return;
+    }
+    pthread_mutex_unlock(&g_cliEv.lock);
+}
+
 bool tpurmBrokerIsRemoteFd(int fd)
 {
     return fd >= BROKER_FD_BASE && fd < BROKER_FD_BASE + BROKER_MAX_FDS;
@@ -546,9 +1123,10 @@ static int cli_connect_locked(void)
     return 0;
 }
 
-/* One round trip.  Returns -1 with errno on transport failure. */
+/* One round trip.  Returns -1 with errno on transport failure.  An
+ * SCM_RIGHTS fd in the reply lands in *fdOut (caller owns it). */
 static int cli_call(BrokerReq *rq, const void *aux, BrokerRep *rep,
-                    void *auxBack, uint32_t auxBackCap)
+                    void *auxBack, uint32_t auxBackCap, int *fdOut)
 {
     pthread_mutex_lock(&g_cli.lock);
     if (cli_connect_locked() != 0) {
@@ -563,7 +1141,7 @@ static int cli_call(BrokerReq *rq, const void *aux, BrokerRep *rep,
         io_all(g_cli.sock, (void *)aux, rq->auxSize + rq->mainSize,
                true) != 0)
         goto out;
-    if (io_all(g_cli.sock, rep, sizeof(*rep), false) != 0)
+    if (rep_recv(g_cli.sock, rep, fdOut) != 0)
         goto out;
     if (rep->auxSize + rep->mainSize) {
         if (rep->auxSize + rep->mainSize > auxBackCap)
@@ -588,7 +1166,7 @@ int tpurmBrokerOpen(const char *path)
     BrokerReq rq = { .op = BR_OP_OPEN };
     BrokerRep rep;
     snprintf(rq.path, sizeof(rq.path), "%s", path);
-    if (cli_call(&rq, NULL, &rep, NULL, 0) != 0)
+    if (cli_call(&rq, NULL, &rep, NULL, 0, NULL) != 0)
         return -1;
     if (rep.ret < 0) {
         errno = rep.err ? rep.err : EIO;
@@ -605,7 +1183,7 @@ int tpurmBrokerClose(int fd)
     BrokerReq rq = { .op = BR_OP_CLOSE,
                      .fdToken = (uint32_t)(fd - BROKER_FD_BASE) };
     BrokerRep rep;
-    if (cli_call(&rq, NULL, &rep, NULL, 0) != 0)
+    if (cli_call(&rq, NULL, &rep, NULL, 0, NULL) != 0)
         return -1;
     pthread_mutex_lock(&g_cli.lock);
     g_cli.fdUsed[fd - BROKER_FD_BASE] = false;
@@ -644,9 +1222,35 @@ int tpurmBrokerIoctl(int fd, unsigned long request, void *argp)
         embedPtr = &p->params;
     } else if (nr == TPU_ESC_RM_FREE) {
         mainSize = sizeof(TpuRmFreeParams);
+    } else if (nr == TPU_ESC_RM_MAP_MEMORY) {
+        mainSize = sizeof(TpuMapMemoryParams);
+    } else if (nr == TPU_ESC_RM_UNMAP_MEMORY) {
+        mainSize = sizeof(TpuUnmapMemoryParams);
     } else {
         errno = ENOTTY;
         return -1;
+    }
+    /* NVOS34: swap the caller's local window address for the server
+     * cookie before marshaling (restored below; the local munmap
+     * happens only on success). */
+    int unmapIdx = -1;
+    uint64_t unmapOrigAddr = 0;
+    if (nr == TPU_ESC_RM_UNMAP_MEMORY) {
+        TpuUnmapMemoryParams *p = argp;
+        unmapOrigAddr = p->pLinearAddress;
+        pthread_mutex_lock(&g_cliMaps.lock);
+        for (int i = 0; i < BROKER_MAX_CLI_MAPS; i++) {
+            if (g_cliMaps.maps[i].used &&
+                g_cliMaps.maps[i].hMemory == p->hMemory &&
+                p->pLinearAddress >= g_cliMaps.maps[i].userPtr &&
+                p->pLinearAddress < g_cliMaps.maps[i].userPtr +
+                                    g_cliMaps.maps[i].length) {
+                p->pLinearAddress = g_cliMaps.maps[i].cookie;
+                unmapIdx = i;
+                break;
+            }
+        }
+        pthread_mutex_unlock(&g_cliMaps.lock);
     }
     if (auxSize > BROKER_MAX_AUX) {
         errno = EINVAL;
@@ -675,7 +1279,8 @@ int tpurmBrokerIoctl(int fd, unsigned long request, void *argp)
                      .escNr = nr, .mainSize = mainSize,
                      .auxSize = auxSize };
     BrokerRep rep;
-    int rc = cli_call(&rq, buf, &rep, buf, auxSize + mainSize);
+    int repFd = -1;
+    int rc = cli_call(&rq, buf, &rep, buf, auxSize + mainSize, &repFd);
     if (rc == 0 && rep.ret < 0) {
         errno = rep.err ? rep.err : EIO;
         rc = -1;
@@ -690,6 +1295,134 @@ int tpurmBrokerIoctl(int fd, unsigned long request, void *argp)
                 memcpy((void *)(uintptr_t)embedSave, buf, rep.auxSize);
         }
     }
+
+    if (rc == 0 && nr == TPU_ESC_RM_MAP_MEMORY) {
+        /* Successful remote map: mmap the arena memfd window and hand
+         * the caller a LOCAL pointer; the server VA stays recorded as
+         * the unmap cookie. */
+        TpuMapMemoryParams *p = argp;
+        if (p->status == TPU_OK && (rep.flags & BR_REP_FLAG_FD) &&
+            repFd >= 0) {
+            long psz = sysconf(_SC_PAGESIZE);
+            uint64_t aoff = rep.mapOffset & ~(uint64_t)(psz - 1);
+            uint64_t delta = rep.mapOffset - aoff;
+            size_t mlen = (size_t)(p->length + delta);
+            void *m = mmap(NULL, mlen, PROT_READ | PROT_WRITE,
+                           MAP_SHARED, repFd, (off_t)aoff);
+            int slot = -1;
+            if (m != MAP_FAILED) {
+                pthread_mutex_lock(&g_cliMaps.lock);
+                for (int i = 0; i < BROKER_MAX_CLI_MAPS; i++) {
+                    if (!g_cliMaps.maps[i].used) {
+                        slot = i;
+                        g_cliMaps.maps[i].used = true;
+                        g_cliMaps.maps[i].userPtr =
+                            (uint64_t)(uintptr_t)m + delta;
+                        g_cliMaps.maps[i].mapBase = m;
+                        g_cliMaps.maps[i].mapLen = mlen;
+                        g_cliMaps.maps[i].cookie = p->pLinearAddress;
+                        g_cliMaps.maps[i].length = p->length;
+                        g_cliMaps.maps[i].hMemory = p->hMemory;
+                        break;
+                    }
+                }
+                pthread_mutex_unlock(&g_cliMaps.lock);
+            }
+            if (slot >= 0) {
+                p->pLinearAddress = (uint64_t)(uintptr_t)m + delta;
+            } else {
+                /* mmap failed or table full: undo the server map. */
+                if (m != MAP_FAILED)
+                    munmap(m, mlen);
+                TpuUnmapMemoryParams up = {
+                    .hClient = p->hClient, .hDevice = p->hDevice,
+                    .hMemory = p->hMemory,
+                    .pLinearAddress = p->pLinearAddress };
+                tpurmBrokerIoctl(fd, _IOWR(TPU_IOCTL_MAGIC,
+                                           TPU_ESC_RM_UNMAP_MEMORY,
+                                           TpuUnmapMemoryParams), &up);
+                p->status = TPU_ERR_OPERATING_SYSTEM;
+            }
+        } else if (p->status == TPU_OK) {
+            /* Map succeeded server-side but no window arrived. */
+            p->status = TPU_ERR_NOT_SUPPORTED;
+        }
+    } else if (nr == TPU_ESC_RM_UNMAP_MEMORY) {
+        TpuUnmapMemoryParams *p = argp;
+        bool ok = rc == 0 && p->status == TPU_OK;
+        if (!ok)
+            p->pLinearAddress = unmapOrigAddr;   /* caller may retry */
+        if (unmapIdx >= 0) {
+            pthread_mutex_lock(&g_cliMaps.lock);
+            if (ok && g_cliMaps.maps[unmapIdx].used) {
+                munmap(g_cliMaps.maps[unmapIdx].mapBase,
+                       g_cliMaps.maps[unmapIdx].mapLen);
+                g_cliMaps.maps[unmapIdx].used = false;
+            }
+            pthread_mutex_unlock(&g_cliMaps.lock);
+        }
+    } else if (rc == 0 && nr == TPU_ESC_RM_ALLOC) {
+        /* Remote EVENT_OS: map the signal page (first time) and start
+         * the relay for the granted slot. */
+        TpuRmAllocParams *p = argp;
+        if (repFd >= 0 && (rep.flags & BR_REP_FLAG_FD)) {
+            pthread_mutex_lock(&g_cliEv.lock);
+            if (!g_cliEv.page) {
+                void *m = mmap(NULL,
+                               BROKER_EV_SLOTS * sizeof(TpuOsEvent),
+                               PROT_READ | PROT_WRITE, MAP_SHARED,
+                               repFd, 0);
+                if (m != MAP_FAILED)
+                    g_cliEv.page = m;
+            }
+            pthread_mutex_unlock(&g_cliEv.lock);
+        }
+        if (p->hClass == TPU_CLASS_EVENT_OS && p->status == TPU_OK &&
+            rep.slot && embedSave) {
+            uint32_t slot = rep.slot - 1;
+            TpuOsEvent *walker = (TpuOsEvent *)(uintptr_t)
+                ((TpuEventAllocParams *)(uintptr_t)embedSave)->data;
+            pthread_mutex_lock(&g_cliEv.lock);
+            bool startable = slot < BROKER_EV_SLOTS && g_cliEv.page &&
+                             !g_cliEv.slots[slot].used;
+            if (startable) {
+                CliRelayArg *ra = malloc(sizeof(*ra));
+                if (ra) {
+                    ra->slot = slot;
+                    g_cliEv.slots[slot].walker = walker;
+                    atomic_store(&g_cliEv.slots[slot].stop, false);
+                    if (pthread_create(&g_cliEv.slots[slot].tid, NULL,
+                                       cli_ev_relay, ra) == 0)
+                        g_cliEv.slots[slot].used = true;
+                    else
+                        free(ra);
+                }
+            }
+            pthread_mutex_unlock(&g_cliEv.lock);
+            if (slot < BROKER_EV_SLOTS && !g_cliEv.slots[slot].used) {
+                /* Relay could not start: the event would deliver into
+                 * the void.  Undo the alloc so the caller knows. */
+                TpuRmFreeParams fp = { .hRoot = p->hRoot,
+                                       .hObjectOld = p->hObjectNew };
+                tpurmBrokerIoctl(fd, _IOWR(TPU_IOCTL_MAGIC,
+                                           TPU_ESC_RM_FREE,
+                                           TpuRmFreeParams), &fp);
+                p->status = TPU_ERR_OPERATING_SYSTEM;
+            }
+        }
+    } else if (rc == 0 && nr == TPU_ESC_RM_FREE) {
+        if (rep.flags & BR_REP_FLAG_EV_ALL) {
+            /* Whole client root freed server-side: every relay on this
+             * connection is dead. */
+            for (uint32_t i = 0; i < BROKER_EV_SLOTS; i++)
+                cli_ev_slot_stop(i);
+        } else if (rep.slot) {
+            /* Server retired one event slot: stop its relay. */
+            cli_ev_slot_stop(rep.slot - 1);
+        }
+    }
+    if (repFd >= 0)
+        close(repFd);
     free(heapBuf);
     return rc;
 }

@@ -270,7 +270,7 @@ uint64_t tpuCxlPinnedBytes(void)
 TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
                            uint64_t gpuOffset, uint64_t cxlOffset,
                            uint64_t size, uint32_t flags,
-                           uint32_t *outTransferId)
+                           uint32_t hClient, uint32_t *outTransferId)
 {
     if (!dev)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -360,10 +360,15 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
      * tracker — the event worker waits the copy's dependencies and
      * fires.  A sync request's tracker is already complete, so the
      * event fires immediately. */
+    /* Completion notification is SCOPED to the requesting client: a
+     * second client armed on the same notifier must not hear someone
+     * else's transfer complete (its own copy-back ordering depends on
+     * its own completions). */
     if (st == TPU_OK)
-        tpurmEventNotifyTracker(&dmaTracker, dev->inst,
-                                TPU_NOTIFIER_CXL_DMA, /*info32=*/1,
-                                (uint16_t)(cxlToDev ? 1 : 0));
+        tpurmEventNotifyTrackerScoped(&dmaTracker, dev->inst,
+                                      TPU_NOTIFIER_CXL_DMA, hClient,
+                                      /*info32=*/1,
+                                      (uint16_t)(cxlToDev ? 1 : 0));
     tpuTrackerDeinit(&dmaTracker);
 
     if (st != TPU_OK) {

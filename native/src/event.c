@@ -43,6 +43,7 @@ typedef struct TpuRmEvent {
 
 typedef struct EventJob {
     TpuTracker deps;
+    uint32_t hClient;           /* 0 = broadcast */
     /* Channel snapshot taken at enqueue (tracker entries prune as they
      * complete): each holds an evRef pinning the channel until this
      * job fires, so a concurrent channel destroy waits instead of
@@ -152,6 +153,14 @@ TpuStatus tpurmEventSetNotification(uint32_t hClient, uint32_t devInst,
 
 /* ------------------------------------------------------------- delivery */
 
+static bool event_matches(const TpuRmEvent *e, uint32_t devInst,
+                          uint32_t notifyIndex, uint32_t hClient)
+{
+    return e->devInst == devInst && e->notifyIndex == notifyIndex &&
+           e->action != TPU_EVENT_ACTION_DISABLE &&
+           (hClient == 0 || e->hClient == hClient);
+}
+
 static void event_deliver(TpuRmEvent *e, uint32_t info32, uint16_t info16)
 {
     TpuOsEvent *os = e->os;
@@ -176,28 +185,34 @@ static void event_deliver(TpuRmEvent *e, uint32_t info32, uint16_t info16)
     tpuCounterAdd("rm_events_delivered", 1);
 }
 
-void tpurmEventFire(uint32_t devInst, uint32_t notifyIndex,
-                    uint32_t info32, uint16_t info16)
+void tpurmEventFireScoped(uint32_t devInst, uint32_t notifyIndex,
+                          uint32_t hClient, uint32_t info32,
+                          uint16_t info16)
 {
     pthread_mutex_lock(&g_ev.lock);
     tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
     for (TpuRmEvent *e = g_ev.events; e; e = e->next) {
-        if (e->devInst == devInst && e->notifyIndex == notifyIndex &&
-            e->action != TPU_EVENT_ACTION_DISABLE)
+        if (event_matches(e, devInst, notifyIndex, hClient))
             event_deliver(e, info32, info16);
     }
     tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
     pthread_mutex_unlock(&g_ev.lock);
 }
 
-bool tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex)
+void tpurmEventFire(uint32_t devInst, uint32_t notifyIndex,
+                    uint32_t info32, uint16_t info16)
+{
+    tpurmEventFireScoped(devInst, notifyIndex, 0, info32, info16);
+}
+
+static bool event_armed_scoped(uint32_t devInst, uint32_t notifyIndex,
+                               uint32_t hClient)
 {
     bool armed = false;
     pthread_mutex_lock(&g_ev.lock);
     tpuLockTrackAcquire(TPU_LOCK_DIAG, "event");
     for (TpuRmEvent *e = g_ev.events; e; e = e->next) {
-        if (e->devInst == devInst && e->notifyIndex == notifyIndex &&
-            e->action != TPU_EVENT_ACTION_DISABLE) {
+        if (event_matches(e, devInst, notifyIndex, hClient)) {
             armed = true;
             break;
         }
@@ -205,6 +220,11 @@ bool tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex)
     tpuLockTrackRelease(TPU_LOCK_DIAG, "event");
     pthread_mutex_unlock(&g_ev.lock);
     return armed;
+}
+
+bool tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex)
+{
+    return event_armed_scoped(devInst, notifyIndex, 0);
 }
 
 /* ---------------------------------------------------- completion worker */
@@ -251,8 +271,8 @@ static void *event_worker(void *arg)
         }
         barren = 0;
         backoff = 50;
-        tpurmEventFire(job->devInst, job->notifyIndex, job->info32,
-                       job->info16);
+        tpurmEventFireScoped(job->devInst, job->notifyIndex, job->hClient,
+                             job->info32, job->info16);
         pthread_mutex_lock(&g_ev.jobLock);
         for (uint32_t i = 0; i < job->nChans; i++)
             tpurmChannelEvUnref(job->chans[i]);
@@ -266,14 +286,16 @@ static void *event_worker(void *arg)
     return NULL;
 }
 
-TpuStatus tpurmEventNotifyTracker(const TpuTracker *deps, uint32_t devInst,
-                                  uint32_t notifyIndex, uint32_t info32,
-                                  uint16_t info16)
+TpuStatus tpurmEventNotifyTrackerScoped(const TpuTracker *deps,
+                                        uint32_t devInst,
+                                        uint32_t notifyIndex,
+                                        uint32_t hClient, uint32_t info32,
+                                        uint16_t info16)
 {
     /* Nobody armed: skip the job (the arm-after-submit race just means
      * that request notifies nobody — same as the reference, where an
      * event registered after the interrupt fired hears nothing). */
-    if (!tpurmEventArmed(devInst, notifyIndex))
+    if (!event_armed_scoped(devInst, notifyIndex, hClient))
         return TPU_OK;
     EventJob *job = calloc(1, sizeof(*job));
     if (!job)
@@ -284,6 +306,7 @@ TpuStatus tpurmEventNotifyTracker(const TpuTracker *deps, uint32_t devInst,
         free(job);
         return TPU_ERR_NO_MEMORY;
     }
+    job->hClient = hClient;
     job->devInst = devInst;
     job->notifyIndex = notifyIndex;
     job->info32 = info32;
@@ -327,6 +350,14 @@ TpuStatus tpurmEventNotifyTracker(const TpuTracker *deps, uint32_t devInst,
     pthread_cond_signal(&g_ev.jobCond);
     pthread_mutex_unlock(&g_ev.jobLock);
     return TPU_OK;
+}
+
+TpuStatus tpurmEventNotifyTracker(const TpuTracker *deps, uint32_t devInst,
+                                  uint32_t notifyIndex, uint32_t info32,
+                                  uint16_t info16)
+{
+    return tpurmEventNotifyTrackerScoped(deps, devInst, notifyIndex, 0,
+                                         info32, info16);
 }
 
 /* Wait until every queued completion job has fired (teardown barrier:

@@ -190,7 +190,7 @@ TpuStatus tpuCxlUnregister(uint64_t handle);
 TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
                            uint64_t gpuOffset, uint64_t cxlOffset,
                            uint64_t size, uint32_t flags,
-                           uint32_t *outTransferId);
+                           uint32_t hClient, uint32_t *outTransferId);
 /* Test/introspection surface. */
 uint32_t  tpuCxlRegisteredCount(void);
 uint64_t  tpuCxlPinnedBytes(void);
@@ -231,6 +231,18 @@ void      tpurmEventDestroy(uint32_t hClient, uint32_t handle);
 void      tpurmEventDestroyClient(uint32_t hClient);
 TpuStatus tpurmEventSetNotification(uint32_t hClient, uint32_t devInst,
                                     uint32_t notifyIndex, uint32_t action);
+/* hClient scope: 0 = broadcast to every armed listener; nonzero fires
+ * only that client's events (completion-style notifiers, where the
+ * condition belongs to the REQUESTING client — a concurrent client's
+ * identical notifier must not hear someone else's completion). */
+void      tpurmEventFireScoped(uint32_t devInst, uint32_t notifyIndex,
+                               uint32_t hClient, uint32_t info32,
+                               uint16_t info16);
+TpuStatus tpurmEventNotifyTrackerScoped(const TpuTracker *deps,
+                                        uint32_t devInst,
+                                        uint32_t notifyIndex,
+                                        uint32_t hClient, uint32_t info32,
+                                        uint16_t info16);
 void      tpurmEventFire(uint32_t devInst, uint32_t notifyIndex,
                          uint32_t info32, uint16_t info16);
 bool      tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex);

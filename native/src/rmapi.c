@@ -615,7 +615,8 @@ static TpuStatus ctrl_subdevice(RmObject *subdev, TpuRmControlParams *p,
         uint32_t transferId = 0;
         TpuStatus st = tpuCxlDmaRequest(dev, dp->cxlBufferHandle,
                                         dp->gpuOffset, dp->cxlOffset,
-                                        dp->size, dp->flags, &transferId);
+                                        dp->size, dp->flags, p->hClient,
+                                        &transferId);
         dp->transferId = (st == TPU_OK) ? transferId : 0;
         return st;
     }

@@ -1,0 +1,353 @@
+/*
+ * Brokered full-surface test: NVOS33/34 mapping, RM events, and
+ * completion-ordered async CXL DMA — all through the multi-process
+ * broker (broker.c), from TWO concurrent client processes sharing one
+ * engine host.
+ *
+ * Reference semantics being proven:
+ *   - NV_ESC_RM_MAP_MEMORY through the same ioctl door for every
+ *     process (escape.c:502): a remote map returns a window the client
+ *     dereferences directly (here: an mmap of the shared arena memfd),
+ *     and NVOS34 unmap is the flush point.
+ *   - OS-event delivery to a foreign process (event_notification.c
+ *     osSetEvent -> client waiter): the client futex-waits its OWN
+ *     TpuOsEvent, never polling.
+ *   - async DMA completion-ordering: a dev->CXL async transfer's bytes
+ *     are visible in CLIENT memory by the time its completion event
+ *     wakes the client (DMA interrupt -> event chain).
+ *
+ * Both clients deliberately use the SAME hClient value — the broker's
+ * per-connection handle namespace (rs_server model) must keep them
+ * isolated.
+ *
+ * Usage: broker_surface_test            (spawns its own brokerd)
+ *        broker_surface_test --attach <socket>   (one client, existing
+ *        broker — used by the conformance-reference-dual target to mix
+ *        map/unmap+event traffic with the unmodified reference walkers)
+ */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <linux/futex.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "tpurm/tpurm.h"
+
+#define CHECKR(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+#define BUF_SIZE (1u << 20)
+
+static int rm_ioctl(int fd, uint32_t nr, void *p, size_t size)
+{
+    return tpurm_ioctl(fd, _IOC(_IOC_READ | _IOC_WRITE, TPU_IOCTL_MAGIC,
+                                nr, size), p);
+}
+
+static TpuStatus do_alloc(int fd, uint32_t hRoot, uint32_t hParent,
+                          uint32_t hNew, uint32_t hClass, void *params,
+                          uint32_t size)
+{
+    TpuRmAllocParams p;
+    memset(&p, 0, sizeof(p));
+    p.hRoot = hClass == TPU_CLASS_ROOT ? hNew : hRoot;
+    p.hObjectParent = hClass == TPU_CLASS_ROOT ? hNew : hParent;
+    p.hObjectNew = hNew;
+    p.hClass = hClass;
+    p.pAllocParms = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    if (rm_ioctl(fd, TPU_ESC_RM_ALLOC, &p, sizeof(p)) != 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    return (TpuStatus)p.status;
+}
+
+static TpuStatus do_control(int fd, uint32_t hClient, uint32_t hObject,
+                            uint32_t cmd, void *params, uint32_t size)
+{
+    TpuRmControlParams p;
+    memset(&p, 0, sizeof(p));
+    p.hClient = hClient;
+    p.hObject = hObject;
+    p.cmd = cmd;
+    p.params = (uint64_t)(uintptr_t)params;
+    p.paramsSize = size;
+    if (rm_ioctl(fd, TPU_ESC_RM_CONTROL, &p, sizeof(p)) != 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    return (TpuStatus)p.status;
+}
+
+static TpuStatus do_free(int fd, uint32_t hRoot, uint32_t hParent,
+                         uint32_t hOld)
+{
+    TpuRmFreeParams p;
+    memset(&p, 0, sizeof(p));
+    p.hRoot = hRoot;
+    p.hObjectParent = hParent;
+    p.hObjectOld = hOld;
+    if (rm_ioctl(fd, TPU_ESC_RM_FREE, &p, sizeof(p)) != 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    return (TpuStatus)p.status;
+}
+
+static int os_event_wait(TpuOsEvent *ev, uint32_t seen, int timeout_s)
+{
+    struct timespec deadline, now;
+    clock_gettime(CLOCK_REALTIME, &deadline);
+    deadline.tv_sec += timeout_s;
+    for (;;) {
+        uint32_t cur = __atomic_load_n(&ev->signaled, __ATOMIC_ACQUIRE);
+        if (cur != seen)
+            return 0;
+        clock_gettime(CLOCK_REALTIME, &now);
+        if (now.tv_sec >= deadline.tv_sec)
+            return -1;
+        struct timespec rel = { .tv_sec = 1, .tv_nsec = 0 };
+        syscall(SYS_futex, &ev->signaled, FUTEX_WAIT, cur, &rel, NULL, 0);
+    }
+}
+
+/* One brokered client exercising the full remote surface.  `idx`
+ * differentiates the data patterns so two concurrent clients verify
+ * their OWN bytes.  `mutate` = write+verify through the NVOS33 window;
+ * false verifies the seeded arena bytes read-only instead — used when
+ * attached NEXT TO the unmodified reference walkers, whose step-7
+ * verification reads the same arena range an FB object may land in. */
+static int client_run(const char *sock, int idx, int mutate)
+{
+    setenv("TPURM_BROKER", sock, 1);
+    int fd = tpurm_open("/dev/nvidiactl");
+    CHECKR(fd >= 0);
+
+    /* SAME handle values in every client: namespace isolation. */
+    const uint32_t hClient = 0xbb000001, hDevice = 0xbb000002,
+                   hSubdev = 0xbb000003, hEvent = 0xbb000004,
+                   hMem = 0xbb000005;
+
+    CHECKR(do_alloc(fd, 0, 0, hClient, TPU_CLASS_ROOT, NULL, 0) == TPU_OK);
+    TpuCtrlAttachIdsParams attach;
+    memset(&attach, 0, sizeof(attach));
+    attach.gpuIds[0] = TPU_CTRL_ATTACH_ALL_PROBED;
+    CHECKR(do_control(fd, hClient, hClient, TPU_CTRL_CMD_GPU_ATTACH_IDS,
+                      &attach, sizeof(attach)) == TPU_OK);
+    TpuDeviceAllocParams devParams;
+    memset(&devParams, 0, sizeof(devParams));
+    CHECKR(do_alloc(fd, hClient, hClient, hDevice, TPU_CLASS_DEVICE,
+                    &devParams, sizeof(devParams)) == TPU_OK);
+    TpuSubdeviceAllocParams subParams = { .subDeviceId = 0 };
+    CHECKR(do_alloc(fd, hClient, hDevice, hSubdev, TPU_CLASS_SUBDEVICE,
+                    &subParams, sizeof(subParams)) == TPU_OK);
+
+    /* ---- NVOS33/34 through the broker ---- */
+    TpuMemoryAllocParams mp;
+    memset(&mp, 0, sizeof(mp));
+    mp.size = 256 * 1024;
+    CHECKR(do_alloc(fd, hClient, hDevice, hMem, TPU_CLASS_MEMORY_LOCAL,
+                    &mp, sizeof(mp)) == TPU_OK);
+
+    TpuMapMemoryParams mm;
+    memset(&mm, 0, sizeof(mm));
+    mm.hClient = hClient;
+    mm.hDevice = hDevice;
+    mm.hMemory = hMem;
+    mm.offset = 4096;
+    mm.length = 64 * 1024;
+    CHECKR(rm_ioctl(fd, TPU_ESC_RM_MAP_MEMORY, &mm, sizeof(mm)) == 0);
+    CHECKR(mm.status == TPU_OK && mm.pLinearAddress != 0);
+
+    uint64_t seedv = strtoull(getenv("TPUMEM_FAKE_HBM_SEED")
+                                  ? getenv("TPUMEM_FAKE_HBM_SEED") : "0",
+                              NULL, 0);
+    uint8_t pattern = (uint8_t)(0x50 + idx);
+    volatile uint8_t *win = (volatile uint8_t *)(uintptr_t)mm.pLinearAddress;
+    uint64_t arenaOff = mp.offset + mm.offset;   /* FB offset of window */
+    if (mutate) {
+        for (uint64_t i = 0; i < mm.length; i++)
+            win[i] = pattern;
+        CHECKR(win[0] == pattern && win[mm.length - 1] == pattern);
+    } else {
+        /* Read-only: the window must show the seeded arena bytes. */
+        CHECKR(win[0] == (uint8_t)((arenaOff + seedv) & 0xFF));
+        CHECKR(win[mm.length - 1] ==
+               (uint8_t)((arenaOff + mm.length - 1 + seedv) & 0xFF));
+    }
+
+    TpuUnmapMemoryParams um;
+    memset(&um, 0, sizeof(um));
+    um.hClient = hClient;
+    um.hDevice = hDevice;
+    um.hMemory = hMem;
+    um.pLinearAddress = mm.pLinearAddress;
+    CHECKR(rm_ioctl(fd, TPU_ESC_RM_UNMAP_MEMORY, &um, sizeof(um)) == 0);
+    CHECKR(um.status == TPU_OK);
+
+    /* Re-map: the bytes live in the engine-host arena, not this
+     * process — a fresh window must read them back. */
+    TpuMapMemoryParams mm2 = mm;
+    mm2.pLinearAddress = 0;
+    mm2.status = ~0u;
+    CHECKR(rm_ioctl(fd, TPU_ESC_RM_MAP_MEMORY, &mm2, sizeof(mm2)) == 0);
+    CHECKR(mm2.status == TPU_OK && mm2.pLinearAddress != 0);
+    volatile uint8_t *win2 =
+        (volatile uint8_t *)(uintptr_t)mm2.pLinearAddress;
+    if (mutate) {
+        CHECKR(win2[0] == pattern && win2[mm.length - 1] == pattern);
+        /* Restore the seeded bytes so concurrent verifiers of the
+         * shared arena (reference walkers) stay byte-consistent. */
+        for (uint64_t i = 0; i < mm.length; i++)
+            win2[i] = (uint8_t)((arenaOff + i + seedv) & 0xFF);
+    } else {
+        CHECKR(win2[0] == (uint8_t)((arenaOff + seedv) & 0xFF));
+    }
+    um.pLinearAddress = mm2.pLinearAddress;
+    CHECKR(rm_ioctl(fd, TPU_ESC_RM_UNMAP_MEMORY, &um, sizeof(um)) == 0);
+    CHECKR(um.status == TPU_OK);
+
+    /* ---- events + completion-ordered async DMA ---- */
+    TpuOsEvent os;
+    memset(&os, 0, sizeof(os));
+    os.rec.status = TPU_NOTIFICATION_STATUS_IN_PROGRESS;
+    TpuEventAllocParams ep;
+    memset(&ep, 0, sizeof(ep));
+    ep.hParentClient = hClient;
+    ep.hSrcResource = hSubdev;
+    ep.hClass = TPU_CLASS_EVENT_OS;
+    ep.notifyIndex = TPU_NOTIFIER_CXL_DMA;
+    ep.data = (uint64_t)(uintptr_t)&os;
+    CHECKR(do_alloc(fd, hClient, hSubdev, hEvent, TPU_CLASS_EVENT_OS,
+                    &ep, sizeof(ep)) == TPU_OK);
+
+    TpuCtrlEventSetNotificationParams sn;
+    memset(&sn, 0, sizeof(sn));
+    sn.event = TPU_NOTIFIER_CXL_DMA;
+    sn.action = TPU_EVENT_ACTION_REPEAT;
+    CHECKR(do_control(fd, hClient, hSubdev,
+                      TPU_CTRL_CMD_EVENT_SET_NOTIFICATION, &sn,
+                      sizeof(sn)) == TPU_OK);
+
+    uint8_t *buf = mmap(NULL, BUF_SIZE, PROT_READ | PROT_WRITE,
+                        MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    CHECKR(buf != MAP_FAILED);
+    memset(buf, 0, BUF_SIZE);
+
+    TpuCtrlRegisterCxlBufferParams reg;
+    memset(&reg, 0, sizeof(reg));
+    reg.baseAddress = (uint64_t)(uintptr_t)buf;
+    reg.size = BUF_SIZE;
+    reg.cxlVersion = 2;
+    CHECKR(do_control(fd, hClient, hSubdev,
+                      TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &reg,
+                      sizeof(reg)) == TPU_OK);
+    CHECKR(reg.bufferHandle != 0);
+
+    /* Async device->CXL: completion must arrive via the EVENT (the
+     * buffer is read only after the wake — no polling). */
+    uint64_t gpuOffset = (uint64_t)(1 + idx) * BUF_SIZE;
+    TpuCtrlCxlP2pDmaRequestParams dma;
+    memset(&dma, 0, sizeof(dma));
+    dma.cxlBufferHandle = reg.bufferHandle;
+    dma.gpuOffset = gpuOffset;
+    dma.cxlOffset = 0;
+    dma.size = BUF_SIZE;
+    dma.flags = TPU_CXL_DMA_FLAG_DEV_TO_CXL | TPU_CXL_DMA_FLAG_ASYNC;
+    CHECKR(do_control(fd, hClient, hSubdev,
+                      TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                      sizeof(dma)) == TPU_OK);
+
+    CHECKR(os_event_wait(&os, 0, 10) == 0);
+    CHECKR(os.rec.status == TPU_NOTIFICATION_STATUS_DONE_SUCCESS);
+
+    /* Arena is seeded (i + seed) & 0xFF by the harness. */
+    for (uint64_t i = 0; i < BUF_SIZE; i += 4097) {
+        uint8_t want = (uint8_t)((gpuOffset + i + seedv) & 0xFF);
+        if (buf[i] != want) {
+            fprintf(stderr, "FAIL: dma byte %llu: got 0x%02x want 0x%02x\n",
+                    (unsigned long long)i, buf[i], want);
+            return 1;
+        }
+    }
+
+    TpuCtrlUnregisterCxlBufferParams unreg = {
+        .bufferHandle = reg.bufferHandle };
+    CHECKR(do_control(fd, hClient, hSubdev,
+                      TPU_CTRL_CMD_BUS_UNREGISTER_CXL_BUFFER, &unreg,
+                      sizeof(unreg)) == TPU_OK);
+
+    /* Event free retires the relay; then the full teardown. */
+    CHECKR(do_free(fd, hClient, hSubdev, hEvent) == TPU_OK);
+    CHECKR(do_free(fd, hClient, 0, hClient) == TPU_OK);
+    CHECKR(tpurm_close(fd) == 0);
+    munmap(buf, BUF_SIZE);
+    printf("broker client %d OK\n", idx);
+    return 0;
+}
+
+int main(int argc, char **argv)
+{
+    if (argc == 3 && strcmp(argv[1], "--attach") == 0)
+        return client_run(argv[2], (int)(getpid() % 7), /*mutate=*/0);
+
+    /* Spawn a broker daemon, then two concurrent clients. */
+    unsetenv("TPURM_BROKER");
+    char sock[64], ready[72];
+    snprintf(sock, sizeof(sock), "/tmp/tpurm_bst_%d.sock", getpid());
+    snprintf(ready, sizeof(ready), "%s.ready", sock);
+    const char *brokerd = getenv("TPURM_BROKERD");
+    if (!brokerd)
+        brokerd = "build/tpurm_brokerd";
+
+    pid_t bpid = fork();
+    if (bpid == 0) {
+        setenv("TPUMEM_FAKE_CXL_DEVICES", "1", 1);
+        setenv("TPUMEM_FAKE_HBM_SEED", "0xAB", 1);
+        execl(brokerd, brokerd, sock, ready, (char *)NULL);
+        perror("execl brokerd");
+        _exit(127);
+    }
+    int ok = 0;
+    for (int i = 0; i < 100; i++) {
+        if (access(ready, F_OK) == 0) {
+            ok = 1;
+            break;
+        }
+        usleep(100 * 1000);
+    }
+    if (!ok) {
+        fprintf(stderr, "FAIL: brokerd never ready\n");
+        kill(bpid, SIGTERM);
+        return 1;
+    }
+
+    setenv("TPUMEM_FAKE_HBM_SEED", "0xAB", 1);   /* for verification */
+    pid_t c1 = fork();
+    if (c1 == 0)
+        _exit(client_run(sock, 1, /*mutate=*/1));
+    pid_t c2 = fork();
+    if (c2 == 0)
+        _exit(client_run(sock, 2, /*mutate=*/1));
+
+    int st1 = -1, st2 = -1;
+    waitpid(c1, &st1, 0);
+    waitpid(c2, &st2, 0);
+    kill(bpid, SIGTERM);
+    waitpid(bpid, NULL, 0);
+    unlink(sock);
+    unlink(ready);
+    if (!WIFEXITED(st1) || WEXITSTATUS(st1) != 0 ||
+        !WIFEXITED(st2) || WEXITSTATUS(st2) != 0) {
+        fprintf(stderr, "FAIL: client exit %d / %d\n", st1, st2);
+        return 1;
+    }
+    printf("broker_surface_test OK (2 clients: map/unmap, events, "
+           "async DMA)\n");
+    return 0;
+}
