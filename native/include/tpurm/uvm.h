@@ -352,6 +352,23 @@ typedef struct {
 } UvmResidencyInfo;
 TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out);
 
+/* ------------------------------------------- multi-process managed memory
+ * A second process (a broker client) attaches a WINDOW onto the engine
+ * host's managed range: the window maps the owner range's host-backing
+ * memfd (shipped over SCM_RIGHTS), starts PROT_NONE, and CPU faults
+ * forward over the broker to the owner engine — which services them in
+ * the owner's VA space (migrating device-resident pages home into the
+ * shared backing) — before the local protection opens.  Stance
+ * (documented contract): coherence is enforced at FAULT granularity
+ * while pages are host-resident; once the child holds an open window
+ * page, a LATER owner-side migration device-ward does not revoke it
+ * (no cross-process PTE shootdown from userspace) — detach/re-attach
+ * re-validates.  Reference: per-fd VA spaces (uvm.c:144,792); the
+ * share itself is the CUDA-IPC model, not fork inheritance. */
+TpuStatus uvmRemoteAttach(UvmVaSpace *vs, uint64_t ownerAddr,
+                          void **outLocalBase, uint64_t *outSize);
+TpuStatus uvmRemoteDetach(UvmVaSpace *vs, void *localBase);
+
 /* ------------------------------------------------------------- fault API */
 
 typedef struct {

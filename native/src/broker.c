@@ -69,7 +69,22 @@
 #define BROKER_MAX_DMA_SPANS 64
 #define BROKER_MAX_CLI_MAPS  64
 
-enum { BR_OP_OPEN = 1, BR_OP_CLOSE = 2, BR_OP_IOCTL = 3 };
+enum { BR_OP_OPEN = 1, BR_OP_CLOSE = 2, BR_OP_IOCTL = 3,
+       BR_OP_UVM_BACKING = 4, BR_OP_UVM_RFAULT = 5 };
+
+/* Payload of the UVM multi-process ops (rides where ioctl payloads
+ * do).  BACKING resolves an owner VA to the range's host-backing memfd
+ * (fd ships via SCM_RIGHTS, bounds in rangeStart/rangeSize); RFAULT
+ * forwards a client CPU fault for service in the owner's space. */
+typedef struct {
+    uint64_t ownerAddr;
+    uint64_t len;
+    uint32_t isWrite;
+    uint32_t status;            /* out: TpuStatus */
+    uint64_t rangeStart;        /* out */
+    uint64_t rangeSize;         /* out */
+    uint64_t fdOffset;          /* out: range bytes start here in the fd */
+} BrokerUvmMsg;
 
 /* Reply flag: an fd rides the rep via SCM_RIGHTS (arena memfd for a
  * map, signal-page memfd for the first event). */
@@ -848,6 +863,7 @@ static void *conn_thread(void *arg)
         BrokerRep rep = { 0 };
         void *auxOut = buf;
         int repFd = -1;
+        bool repFdOwned = false;    /* close repFd after the send */
         switch (rq.op) {
         case BR_OP_OPEN: {
             rq.path[sizeof(rq.path) - 1] = 0;
@@ -888,13 +904,50 @@ static void *conn_thread(void *arg)
                 conn_serve_ioctl(c, &rq, buf, &rep, &auxOut, &repFd);
             }
             break;
+        case BR_OP_UVM_BACKING: {
+            /* Same-trust-domain share (any process that can reach this
+             * socket can already drive the whole RM surface). */
+            BrokerUvmMsg *m = (BrokerUvmMsg *)buf;
+            if (rq.mainSize != sizeof(*m)) {
+                rep.ret = -1;
+                rep.err = EINVAL;
+                break;
+            }
+            int bfd = -1;
+            m->status = (uint32_t)uvmRangeBackingForAddr(
+                m->ownerAddr, &bfd, &m->fdOffset, &m->rangeStart,
+                &m->rangeSize);
+            if (m->status == TPU_OK && bfd >= 0) {
+                repFd = bfd;
+                repFdOwned = true;      /* dup'd for us: close after send */
+                rep.flags |= BR_REP_FLAG_FD;
+            }
+            rep.mainSize = sizeof(*m);
+            break;
+        }
+        case BR_OP_UVM_RFAULT: {
+            BrokerUvmMsg *m = (BrokerUvmMsg *)buf;
+            if (rq.mainSize != sizeof(*m)) {
+                rep.ret = -1;
+                rep.err = EINVAL;
+                break;
+            }
+            m->status = (uint32_t)uvmRemoteFaultService(
+                m->ownerAddr, m->len, (int)m->isWrite);
+            rep.mainSize = sizeof(*m);
+            break;
+        }
         default:
             rep.ret = -1;
             rep.err = EINVAL;
         }
-        /* repFd (arena memfd / signal page) is connection-owned state;
-         * sendmsg duplicates it into the peer, nothing to close here. */
-        if (rep_send(c->sock, &rep, repFd) != 0)
+        /* repFd is usually connection-owned state (arena memfd / signal
+         * page — sendmsg duplicates it into the peer); a dup'd backing
+         * fd (repFdOwned) is ours to close once shipped. */
+        int sendRc = rep_send(c->sock, &rep, repFd);
+        if (repFdOwned && repFd >= 0)
+            close(repFd);
+        if (sendRc != 0)
             break;
         if (rep.auxSize + rep.mainSize &&
             io_all(c->sock, auxOut, rep.auxSize + rep.mainSize, true) != 0)
@@ -1036,6 +1089,7 @@ static struct {
         pthread_t tid;
         _Atomic bool stop;
         bool used;
+        bool stopping;          /* used stays true until the join ends */
     } slots[BROKER_EV_SLOTS];
 } g_cliEv = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
@@ -1083,17 +1137,24 @@ static void *cli_ev_relay(void *argp)
 static void cli_ev_slot_stop(uint32_t slot)
 {
     pthread_mutex_lock(&g_cliEv.lock);
-    if (slot < BROKER_EV_SLOTS && g_cliEv.slots[slot].used) {
+    if (slot < BROKER_EV_SLOTS && g_cliEv.slots[slot].used &&
+        !g_cliEv.slots[slot].stopping) {
+        /* `used` stays TRUE until the relay has joined: a concurrent
+         * EVENT_OS alloc granted this (server-free) slot must see it
+         * occupied and back off, or it would reset `stop` under the
+         * exiting thread and leave this join hanging. */
+        g_cliEv.slots[slot].stopping = true;
         atomic_store_explicit(&g_cliEv.slots[slot].stop, true,
                               memory_order_release);
         if (g_cliEv.page)
             br_futex(&g_cliEv.page[slot].signaled, FUTEX_WAKE, INT_MAX,
                      NULL);
         pthread_t tid = g_cliEv.slots[slot].tid;
-        g_cliEv.slots[slot].used = false;
         pthread_mutex_unlock(&g_cliEv.lock);
         pthread_join(tid, NULL);
-        return;
+        pthread_mutex_lock(&g_cliEv.lock);
+        g_cliEv.slots[slot].used = false;
+        g_cliEv.slots[slot].stopping = false;
     }
     pthread_mutex_unlock(&g_cliEv.lock);
 }
@@ -1159,6 +1220,47 @@ out:
     }
     pthread_mutex_unlock(&g_cli.lock);
     return rc;
+}
+
+int tpurmBrokerUvmBacking(uint64_t ownerAddr, int *fdOut,
+                          uint64_t *fdOffset, uint64_t *rangeStart,
+                          uint64_t *rangeSize)
+{
+    BrokerUvmMsg m = { .ownerAddr = ownerAddr };
+    BrokerReq rq = { .op = BR_OP_UVM_BACKING, .mainSize = sizeof(m) };
+    BrokerRep rep;
+    int fd = -1;
+    if (cli_call(&rq, &m, &rep, &m, sizeof(m), &fd) != 0)
+        return -1;
+    if (rep.ret < 0) {
+        errno = rep.err ? rep.err : EIO;
+        if (fd >= 0)
+            close(fd);
+        return -1;
+    }
+    if (m.status != 0) {
+        if (fd >= 0)
+            close(fd);
+        return (int)m.status;
+    }
+    *fdOut = fd;
+    *fdOffset = m.fdOffset;
+    *rangeStart = m.rangeStart;
+    *rangeSize = m.rangeSize;
+    return 0;
+}
+
+int tpurmBrokerUvmFault(uint64_t ownerAddr, uint64_t len, int isWrite)
+{
+    BrokerUvmMsg m = { .ownerAddr = ownerAddr, .len = len,
+                       .isWrite = (uint32_t)(isWrite != 0) };
+    BrokerReq rq = { .op = BR_OP_UVM_RFAULT, .mainSize = sizeof(m) };
+    BrokerRep rep;
+    if (cli_call(&rq, &m, &rep, &m, sizeof(m), NULL) != 0)
+        return (int)TPU_ERR_OPERATING_SYSTEM;
+    if (rep.ret < 0)
+        return (int)TPU_ERR_OPERATING_SYSTEM;
+    return (int)m.status;
 }
 
 int tpurmBrokerOpen(const char *path)

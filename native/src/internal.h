@@ -90,6 +90,27 @@ static inline uint64_t tpuRegCacheGet(TpuRegCache *c, const char *key,
     return v;
 }
 
+/* ------------------------------------------------------ broker UVM server */
+
+/* Owner side of a forwarded remote CPU fault (broker BR_OP_UVM_RFAULT). */
+TpuStatus uvmRemoteFaultService(uint64_t addr, uint64_t len, int isWrite);
+/* Owner side of remote-backing resolution (BR_OP_UVM_BACKING). */
+TpuStatus uvmRangeBackingForAddr(uint64_t ownerAddr, int *fdOut,
+                                 uint64_t *fdOffset, uint64_t *rangeStart,
+                                 uint64_t *rangeSize);
+
+/* ------------------------------------------------------ broker UVM client */
+
+/* Fetch the owner range's host-backing memfd + bounds for ownerAddr
+ * (caller owns *fdOut).  Engine-host side resolves via
+ * uvmRangeBackingForAddr. */
+int tpurmBrokerUvmBacking(uint64_t ownerAddr, int *fdOut,
+                          uint64_t *fdOffset, uint64_t *rangeStart,
+                          uint64_t *rangeSize);
+/* Forward a CPU fault on owner memory; returns the service TpuStatus
+ * (engine-host side runs uvmRemoteFaultService). */
+int tpurmBrokerUvmFault(uint64_t ownerAddr, uint64_t len, int isWrite);
+
 /* ---------------------------------------------------------------- memdesc */
 
 typedef enum {

@@ -272,7 +272,8 @@ void uvmFaultSnapshotRebuild(void)
         pthread_mutex_lock(&vs->lock);
         for (UvmRangeTreeNode *n = vs->ranges.first; n;
              n = uvmRangeTreeNext(n))
-            if (((UvmVaRange *)n)->type == UVM_RANGE_TYPE_MANAGED)
+            if (((UvmVaRange *)n)->type == UVM_RANGE_TYPE_MANAGED ||
+                ((UvmVaRange *)n)->type == UVM_RANGE_TYPE_REMOTE)
                 count++;
         pthread_mutex_unlock(&vs->lock);
     }
@@ -287,8 +288,10 @@ void uvmFaultSnapshotRebuild(void)
         for (UvmRangeTreeNode *n = vs->ranges.first;
              n && i < count; n = uvmRangeTreeNext(n)) {
             /* EXTERNAL ranges take no fault service: a fault on an
-             * unmapped span is a real segfault. */
-            if (((UvmVaRange *)n)->type != UVM_RANGE_TYPE_MANAGED)
+             * unmapped span is a real segfault.  REMOTE windows DO
+             * fault-service (forwarded to the owner engine). */
+            if (((UvmVaRange *)n)->type != UVM_RANGE_TYPE_MANAGED &&
+                ((UvmVaRange *)n)->type != UVM_RANGE_TYPE_REMOTE)
                 continue;
             ns->entries[i].start = n->start;
             ns->entries[i].end = n->end;
@@ -446,6 +449,43 @@ static TpuStatus service_one(UvmFaultEntry *e)
         tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "vaspace");
         UvmVaBlock *blk = NULL;
         UvmVaRange *range = uvmRangeFind(vs, addr, &blk);
+        if (range && range->type == UVM_RANGE_TYPE_REMOTE) {
+            /* REMOTE window: forward to the owner engine, which makes
+             * the span host-resident in the SHARED backing this window
+             * maps, then open the local protection (fault-granularity
+             * coherence — uvm.h uvmRemoteAttach contract). */
+            uint64_t rBase = range->remoteBase;
+            uint64_t lBase = range->node.start;
+            uint64_t rEnd = range->node.end;
+            tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
+            pthread_mutex_unlock(&vs->lock);
+            /* Service whole uvm pages (windows are page-aligned). */
+            uint64_t spanEnd = end < rEnd ? end : rEnd;
+            spanEnd = spanEnd - (spanEnd % ps) + ps - 1;
+            if (spanEnd > rEnd)
+                spanEnd = rEnd;
+            uint64_t len = spanEnd - addr + 1;
+            int fst = tpurmBrokerUvmFault(rBase + (addr - lBase), len,
+                                          e->isWrite != 0);
+            st = (TpuStatus)fst;
+            if (st == TPU_OK) {
+                /* Read faults open READ-ONLY: the owner may have
+                 * serviced them with read duplication (device copy
+                 * survives), so the window's first WRITE must re-fault
+                 * and forward as a write for the owner to invalidate
+                 * its duplicates (host-exclusive) before the store
+                 * lands in the shared backing. */
+                int prot = e->isWrite ? (PROT_READ | PROT_WRITE)
+                                      : PROT_READ;
+                if (mprotect((void *)(uintptr_t)addr, len, prot) != 0)
+                    st = TPU_ERR_OPERATING_SYSTEM;
+                else
+                    uvmToolsEmit(vs, UVM_EVENT_CPU_FAULT, UVM_TIER_COUNT,
+                                 UVM_TIER_HOST, 0, addr, len);
+            }
+            addr = spanEnd + 1;
+            continue;
+        }
         if (!range || !blk) {
             tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
             pthread_mutex_unlock(&vs->lock);
@@ -1270,6 +1310,29 @@ TpuStatus uvmFaultServiceSync(UvmFaultEntry *e)
             st = s;
     }
     free(subs);
+    return st;
+}
+
+/* Owner-engine side of a forwarded remote CPU fault: service the span
+ * in the OWNING space (host target — device-resident pages migrate
+ * home into the shared backing the remote window maps). */
+TpuStatus uvmRemoteFaultService(uint64_t addr, uint64_t len, int isWrite)
+{
+    uvmFaultEngineInit();
+    UvmVaSpace *vs = uvmFaultSpaceForAddr(addr);
+    if (!vs)
+        return TPU_ERR_INVALID_ADDRESS;
+    UvmFaultEntry e = {
+        .addr = addr,
+        .len = len ? len : 1,
+        .isWrite = (uint8_t)(isWrite != 0),
+        .source = UVM_FAULT_SRC_CPU,
+        .devInst = 0,
+        .vs = vs,
+    };
+    uvmPmEnterShared();
+    TpuStatus st = uvmFaultServiceSync(&e);
+    uvmPmExitShared();
     return st;
 }
 

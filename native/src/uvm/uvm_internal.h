@@ -306,6 +306,13 @@ typedef struct UvmVaBlock {
 typedef enum {
     UVM_RANGE_TYPE_MANAGED = 0,
     UVM_RANGE_TYPE_EXTERNAL = 1,
+    /* Local window onto ANOTHER process's managed range (the engine
+     * host's), attached over the broker: the window maps the owner
+     * range's host-backing memfd, CPU faults forward to the owner for
+     * service, and protections open at fault granularity.  Reference:
+     * per-fd VA spaces with IPC-shared allocations (uvm.c:144,792 +
+     * the CUDA IPC model). */
+    UVM_RANGE_TYPE_REMOTE = 2,
 } UvmRangeType;
 
 typedef struct UvmVaRange {
@@ -327,6 +334,9 @@ typedef struct UvmVaRange {
      * not user PTEs). */
     int memfd;
     void *alias;
+    /* REMOTE ranges: owner-process VA of the range start (fault
+     * forwarding translates local addr -> remoteBase + delta). */
+    uint64_t remoteBase;
     /* Policy (reference: uvm_va_policy.c). */
     bool hasPreferred;
     UvmLocation preferred;

@@ -245,6 +245,34 @@ TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr)
     return pmSt;
 }
 
+/* Reserve an `align`-aligned VA window of `size` and place a SHARED
+ * mapping of (fd, off) there (over-reserve + trim + MAP_FIXED).  Used
+ * by managed alloc (2 MB alignment) and remote attach (uvm-page
+ * alignment). */
+static void *map_aligned_shared(int fd, uint64_t off, uint64_t size,
+                                uint64_t align, int prot)
+{
+    uint64_t mapSize = size + align;
+    char *raw = mmap(NULL, mapSize, PROT_NONE,
+                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
+    if (raw == MAP_FAILED)
+        return NULL;
+    uintptr_t aligned = ((uintptr_t)raw + align - 1) &
+                        ~((uintptr_t)align - 1);
+    if (aligned > (uintptr_t)raw)
+        munmap(raw, aligned - (uintptr_t)raw);
+    uintptr_t tailStart = aligned + size;
+    uint64_t tailLen = (uintptr_t)raw + mapSize - tailStart;
+    if (tailLen)
+        munmap((void *)tailStart, tailLen);
+    if (mmap((void *)aligned, size, prot, MAP_SHARED | MAP_FIXED, fd,
+             (off_t)off) == MAP_FAILED) {
+        munmap((void *)aligned, size);
+        return NULL;
+    }
+    return (void *)aligned;
+}
+
 static TpuStatus mem_alloc_gated(UvmVaSpace *vs, uint64_t size,
                                  void **outPtr)
 {
@@ -261,29 +289,14 @@ static TpuStatus mem_alloc_gated(UvmVaSpace *vs, uint64_t size,
         return TPU_ERR_NO_MEMORY;
     }
 
-    /* 2 MB-aligned reservation: over-map and trim, then fix the memfd
-     * mapping over the aligned window. */
-    uint64_t mapSize = size + UVM_BLOCK_SIZE;
-    char *raw = mmap(NULL, mapSize, PROT_NONE,
-                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
-    if (raw == MAP_FAILED) {
+    /* 2 MB-aligned reservation with the memfd fixed over it. */
+    void *alignedPtr = map_aligned_shared(memfd, 0, size, UVM_BLOCK_SIZE,
+                                          PROT_NONE);
+    if (!alignedPtr) {
         close(memfd);
         return TPU_ERR_NO_MEMORY;
     }
-    uintptr_t aligned = ((uintptr_t)raw + UVM_BLOCK_SIZE - 1) &
-                        ~((uintptr_t)UVM_BLOCK_SIZE - 1);
-    if (aligned > (uintptr_t)raw)
-        munmap(raw, aligned - (uintptr_t)raw);
-    uintptr_t tailStart = aligned + size;
-    uint64_t tailLen = (uintptr_t)raw + mapSize - tailStart;
-    if (tailLen)
-        munmap((void *)tailStart, tailLen);
-    if (mmap((void *)aligned, size, PROT_NONE, MAP_SHARED | MAP_FIXED,
-             memfd, 0) == MAP_FAILED) {
-        munmap((void *)aligned, size);
-        close(memfd);
-        return TPU_ERR_NO_MEMORY;
-    }
+    uintptr_t aligned = (uintptr_t)alignedPtr;
     void *alias = mmap(NULL, size, PROT_READ | PROT_WRITE, MAP_SHARED,
                        memfd, 0);
     if (alias == MAP_FAILED) {
@@ -422,6 +435,129 @@ static TpuStatus mem_free_gated(UvmVaSpace *vs, void *ptr)
     }
     vs_unlock(vs);
     uvmFaultSnapshotRebuild();
+    return TPU_OK;
+}
+
+/* ------------------------------------------- multi-process attach (owner) */
+
+/* Engine-host side: resolve the MANAGED range covering ownerAddr to its
+ * host-backing memfd + bounds (the broker ships the fd via SCM_RIGHTS;
+ * reference analog: the IPC handle resolving to the same physical
+ * allocation). */
+TpuStatus uvmRangeBackingForAddr(uint64_t ownerAddr, int *fdOut,
+                                 uint64_t *fdOffset, uint64_t *rangeStart,
+                                 uint64_t *rangeSize)
+{
+    UvmVaSpace *vs = uvmFaultSpaceForAddr(ownerAddr);
+    if (!vs)
+        return TPU_ERR_INVALID_ADDRESS;
+    TpuStatus st = TPU_ERR_INVALID_ADDRESS;
+    vs_lock(vs);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "remote-backing");
+    UvmRangeTreeNode *n = uvmRangeTreeFind(&vs->ranges, ownerAddr);
+    if (n) {
+        UvmVaRange *r = (UvmVaRange *)n;
+        if (r->type == UVM_RANGE_TYPE_MANAGED && r->memfd >= 0) {
+            /* dup UNDER the lock: the raw fd number could be closed
+             * (range freed) and reused between unlock and the broker's
+             * sendmsg — the dup pins the file.  Caller owns *fdOut. */
+            int d = dup(r->memfd);
+            if (d < 0) {
+                st = TPU_ERR_OPERATING_SYSTEM;
+            } else {
+                *fdOut = d;
+                /* A split-off tail range shares the ALLOCATION's memfd:
+                 * its bytes start at node.start - allocStart within the
+                 * file, not at 0. */
+                *fdOffset = n->start - r->allocStart;
+                *rangeStart = n->start;
+                *rangeSize = r->size;
+                st = TPU_OK;
+            }
+        }
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "remote-backing");
+    vs_unlock(vs);
+    return st;
+}
+
+/* Client side: window onto an owner range (see uvm.h contract). */
+TpuStatus uvmRemoteAttach(UvmVaSpace *vs, uint64_t ownerAddr,
+                          void **outLocalBase, uint64_t *outSize)
+{
+    if (!vs || !outLocalBase)
+        return TPU_ERR_INVALID_ARGUMENT;
+    int fd = -1;
+    uint64_t fdOff = 0, start = 0, size = 0;
+    int rc = tpurmBrokerUvmBacking(ownerAddr, &fd, &fdOff, &start, &size);
+    if (rc != 0 || fd < 0)
+        return rc > 0 ? (TpuStatus)rc : TPU_ERR_OPERATING_SYSTEM;
+    /* The window must be UVM-page aligned (the fault path aligns
+     * addresses down to uvm pages; a 4 KB-aligned mmap would put those
+     * below the range start). */
+    void *base = map_aligned_shared(fd, fdOff, size, uvmPageSize(),
+                                    PROT_NONE);
+    close(fd);
+    if (!base)
+        return TPU_ERR_NO_MEMORY;
+
+    UvmVaRange *range = calloc(1, sizeof(*range));
+    if (!range) {
+        munmap(base, size);
+        return TPU_ERR_NO_MEMORY;
+    }
+    range->node.start = (uint64_t)(uintptr_t)base;
+    range->node.end = range->node.start + size - 1;
+    range->vaSpace = vs;
+    range->type = UVM_RANGE_TYPE_REMOTE;
+    range->size = size;
+    range->allocStart = range->node.start;
+    range->allocSize = size;
+    range->memfd = -1;
+    range->remoteBase = start;
+
+    vs_lock(vs);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "remote-attach");
+    TpuStatus st = uvmRangeTreeAdd(&vs->ranges, &range->node);
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "remote-attach");
+    vs_unlock(vs);
+    if (st != TPU_OK) {
+        munmap(base, size);
+        free(range);
+        return st;
+    }
+    /* The space registered with the fault engine at creation; only the
+     * snapshot needs the new range. */
+    uvmFaultSnapshotRebuild();
+    *outLocalBase = base;
+    if (outSize)
+        *outSize = size;
+    return TPU_OK;
+}
+
+TpuStatus uvmRemoteDetach(UvmVaSpace *vs, void *localBase)
+{
+    if (!vs || !localBase)
+        return TPU_ERR_INVALID_ARGUMENT;
+    vs_lock(vs);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "remote-detach");
+    UvmRangeTreeNode *n = uvmRangeTreeFind(&vs->ranges,
+                                           (uint64_t)(uintptr_t)localBase);
+    UvmVaRange *range = (UvmVaRange *)n;
+    TpuStatus st = TPU_OK;
+    if (!n || range->type != UVM_RANGE_TYPE_REMOTE ||
+        n->start != (uint64_t)(uintptr_t)localBase) {
+        st = TPU_ERR_INVALID_ADDRESS;
+    } else {
+        uvmRangeTreeRemove(&vs->ranges, n);
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "remote-detach");
+    vs_unlock(vs);
+    if (st != TPU_OK)
+        return st;
+    uvmFaultSnapshotRebuild();
+    munmap(localBase, range->size);
+    free(range);
     return TPU_OK;
 }
 

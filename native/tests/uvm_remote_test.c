@@ -1,0 +1,179 @@
+/*
+ * Multi-process managed memory: a SECOND process attaches a window onto
+ * the engine host's managed range and faults pages the owner migrated
+ * to HBM and CXL — the last structural piece of the per-fd VA-space
+ * model (reference: any process opens /dev/nvidia-uvm and gets its own
+ * VA space, uvm.c:144,792; the cross-process share itself follows the
+ * CUDA-IPC model, not fork inheritance).
+ *
+ * Flow:
+ *   parent (engine host): serves the broker in-process, allocates a
+ *     managed range, writes a pattern, migrates spans to HBM and CXL
+ *     (host backing now stale for those spans), spawns the child.
+ *   child (fresh exec): attaches its own VA space, maps the owner
+ *     range's backing via uvmRemoteAttach, and READS the migrated
+ *     spans — each CPU fault forwards over the broker, the owner
+ *     services it (migrating device-resident pages home into the
+ *     shared backing), and only then does the child's window open.
+ *     The child then WRITES a byte (write fault -> host-exclusive in
+ *     the owner) and checks its own tools queue saw its fault events.
+ *   parent: waits, then verifies the child's write through its own
+ *     mapping and that its own tools queue saw its own (migration)
+ *     events.
+ */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+#define CHECKR(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+#define RANGE_BYTES (4ull << 20)
+#define HBM_SPAN    (2ull << 20)          /* [0, 2M) -> HBM */
+#define CXL_SPAN    (1ull << 20)          /* [2M, 3M) -> CXL */
+#define WRITE_OFF   (64 * 1024 + 17)      /* inside the HBM span */
+
+static uint8_t pat(uint64_t off)
+{
+    return (uint8_t)((off * 7 + 3) & 0xFF);
+}
+
+static int child_main(const char *sock, uint64_t ownerBase)
+{
+    setenv("TPURM_BROKER", sock, 1);
+
+    UvmVaSpace *vs = NULL;
+    CHECKR(uvmVaSpaceCreate(&vs) == TPU_OK);
+    UvmToolsSession *ts = NULL;
+    CHECKR(uvmToolsSessionCreate(vs, 256, &ts) == TPU_OK);
+    uvmToolsEnableEvents(ts, ~0ull);
+
+    void *base = NULL;
+    uint64_t size = 0;
+    CHECKR(uvmRemoteAttach(vs, ownerBase, &base, &size) == TPU_OK);
+    CHECKR(size == RANGE_BYTES);
+
+    /* Faulting reads across all three residencies the owner set up:
+     * HBM span, CXL span, host tail.  Every access below SIGSEGVs
+     * locally, forwards over the broker, and must read OWNER truth. */
+    const volatile uint8_t *p = base;
+    uint64_t offs[] = { 0, 4096, HBM_SPAN - 1,            /* HBM span */
+                        HBM_SPAN, HBM_SPAN + CXL_SPAN - 1,/* CXL span */
+                        HBM_SPAN + CXL_SPAN,              /* host tail */
+                        RANGE_BYTES - 1 };
+    for (size_t i = 0; i < sizeof(offs) / sizeof(offs[0]); i++) {
+        uint8_t got = p[offs[i]];
+        if (got != pat(offs[i])) {
+            fprintf(stderr, "FAIL: off %llu got 0x%02x want 0x%02x\n",
+                    (unsigned long long)offs[i], got, pat(offs[i]));
+            return 1;
+        }
+    }
+
+    /* Read-then-write on the same page: the read opens the window
+     * READ-ONLY, so the write must RE-FAULT and forward as a write
+     * (owner goes host-exclusive) before the store lands in the
+     * SHARED backing, visible to the owner. */
+    CHECKR(p[WRITE_OFF] == pat(WRITE_OFF));
+    ((volatile uint8_t *)base)[WRITE_OFF] = 0x5A;
+    CHECKR(p[WRITE_OFF] == 0x5A);
+
+    /* The child's OWN tools queue saw the child's fault events. */
+    UvmEvent evs[64];
+    size_t n = uvmToolsReadEvents(ts, evs, 64);
+    size_t cpuFaults = 0;
+    for (size_t i = 0; i < n; i++)
+        if (evs[i].type == UVM_EVENT_CPU_FAULT)
+            cpuFaults++;
+    CHECKR(cpuFaults >= 3);
+
+    CHECKR(uvmRemoteDetach(vs, base) == TPU_OK);
+    uvmToolsSessionDestroy(ts);
+    uvmVaSpaceDestroy(vs);
+    printf("uvm_remote child OK (%zu cpu-fault events)\n", cpuFaults);
+    return 0;
+}
+
+int main(int argc, char **argv)
+{
+    if (argc == 4 && strcmp(argv[1], "--child") == 0)
+        return child_main(argv[2], strtoull(argv[3], NULL, 0));
+
+    unsetenv("TPURM_BROKER");       /* parent IS the engine host */
+    char sock[64];
+    snprintf(sock, sizeof(sock), "/tmp/tpurm_uvmr_%d.sock", getpid());
+    CHECKR(tpurmBrokerServe(sock) == TPU_OK);
+
+    UvmVaSpace *vs = NULL;
+    CHECKR(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECKR(uvmRegisterDevice(vs, 0) == TPU_OK);
+    UvmToolsSession *ts = NULL;
+    CHECKR(uvmToolsSessionCreate(vs, 256, &ts) == TPU_OK);
+    uvmToolsEnableEvents(ts, ~0ull);
+
+    void *base = NULL;
+    CHECKR(uvmMemAlloc(vs, RANGE_BYTES, &base) == TPU_OK);
+    uint8_t *b = base;
+    for (uint64_t i = 0; i < RANGE_BYTES; i++)
+        b[i] = pat(i);
+
+    /* Owner moves spans device-ward: the host backing goes STALE for
+     * them (and PROT_NONE in the owner) until a fault migrates them
+     * home. */
+    UvmLocation hbm = { .tier = UVM_TIER_HBM, .devInst = 0 };
+    UvmLocation cxl = { .tier = UVM_TIER_CXL, .devInst = 0 };
+    CHECKR(uvmMigrate(vs, b, HBM_SPAN, hbm, 0) == TPU_OK);
+    CHECKR(uvmMigrate(vs, b + HBM_SPAN, CXL_SPAN, cxl, 0) == TPU_OK);
+    UvmResidencyInfo ri;
+    CHECKR(uvmResidencyInfo(vs, b, &ri) == TPU_OK);
+    CHECKR(ri.residentHbm && !ri.residentHost);
+    CHECKR(uvmResidencyInfo(vs, b + HBM_SPAN, &ri) == TPU_OK);
+    CHECKR(ri.residentCxl && !ri.residentHost);
+
+    char addrArg[32];
+    snprintf(addrArg, sizeof(addrArg), "0x%llx",
+             (unsigned long long)(uintptr_t)base);
+    pid_t c = fork();
+    if (c == 0) {
+        execl(argv[0], argv[0], "--child", sock, addrArg, (char *)NULL);
+        perror("execl");
+        _exit(127);
+    }
+    int st = -1;
+    waitpid(c, &st, 0);
+    CHECKR(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+
+    /* The child's faults migrated the spans home and its write landed
+     * in the shared backing: the owner reads it directly. */
+    CHECKR(uvmResidencyInfo(vs, b, &ri) == TPU_OK);
+    CHECKR(ri.residentHost);
+    CHECKR(b[WRITE_OFF] == 0x5A);
+    CHECKR(b[0] == pat(0));
+    CHECKR(b[HBM_SPAN + 5] == pat(HBM_SPAN + 5));
+
+    /* The parent's OWN tools queue saw the parent's events. */
+    UvmEvent evs[128];
+    size_t n = uvmToolsReadEvents(ts, evs, 128);
+    size_t migrations = 0;
+    for (size_t i = 0; i < n; i++)
+        if (evs[i].type == UVM_EVENT_MIGRATION)
+            migrations++;
+    CHECKR(migrations >= 2);
+
+    CHECKR(uvmMemFree(vs, base) == TPU_OK);
+    uvmToolsSessionDestroy(ts);
+    uvmVaSpaceDestroy(vs);
+    unlink(sock);
+    printf("uvm_remote_test OK (child faulted HBM/CXL pages home, "
+           "%zu parent migration events)\n", migrations);
+    return 0;
+}
