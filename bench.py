@@ -396,20 +396,20 @@ def measure_in_hbm_copy_gbps(mib: int = 256, iters: int = 4) -> float:
     return statistics.median(vals) / 1e9 if vals else 0.0
 
 
-def measure_flash_mfu(batch: int = 8, seq: int = 4096, heads: int = 16,
-                      head_dim: int = 128) -> dict:
-    """Causal flash-attention prefill MFU on the chip (bf16, MXU path).
-
-    Inputs are head-major (layout="bhsd"): in a full model the
-    projection matmuls fuse the [B,S,H,D]->[B,H,S,D] layout change, so
-    the isolated kernel is measured without the four explicit transpose
-    copies the standalone [B,S,H,D] entry would add (~1 GB of HBM
-    traffic at this shape)."""
+def _flash_chain_child(n: int) -> None:
+    """Run ONE flash-attention chain of n kernels and print its raw
+    duration.  Runs in a FRESH process so the chain executes entirely
+    PRE-POISON: this relay's first device->host readback permanently
+    degrades the process (uploads ~150x, and per-dispatch execution
+    overhead ~10x), so a chain timed after any force measures relay
+    overhead, not the kernel.  The single force here is the LAST thing
+    the process does; the XLA compile cache is server-side, so only the
+    first child ever pays the compile."""
     import jax
     import jax.numpy as jnp
     from open_gpu_kernel_modules_tpu.ops import flash_attention
 
-    dev = jax.devices()[0]
+    batch, heads, seq, head_dim = 8, 16, 4096, 128
     key = jax.random.key(0)
     shape = (batch, heads, seq, head_dim)
     q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16)
@@ -418,41 +418,79 @@ def measure_flash_mfu(batch: int = 8, seq: int = 4096, heads: int = 16,
     def f(x):
         return flash_attention(x, k, v, causal=True, layout="bhsd")
 
-    out = f(q)
-    float(out[0, 0, 0, 0])                      # compile + force
+    cur = f(q)                      # compile (blocking) — no readback
+    t0 = time.perf_counter()
+    for _ in range(n):
+        cur = f(cur)
+    float(cur[0, 0, 0, 0])          # the process's FIRST d2h: chain done
+    print("CHAIN_T %.6f" % (time.perf_counter() - t0), flush=True)
 
-    # The relay transport's block_until_ready does not serialize device
-    # execution, and a device_get costs a ~100+ ms round trip.  Measure
-    # DIFFERENTIALLY with LONG chains: time a data-dependent chain of N
-    # and of 3N kernels (each forced by a scalar device_get) — the
-    # difference isolates 2N executions with the round-trip latency
-    # subtracted, and chains of 32/96 kernels (multi-hundred-ms spans)
-    # dwarf the relay's tens-of-ms jitter that made short chains report
-    # anywhere between 0.5x and 2x the true rate.
-    def chain(n: int) -> float:
-        cur = q
-        t0 = time.perf_counter()
-        for _ in range(n):
-            cur = f(cur)
-        float(cur[0, 0, 0, 0])                  # force execution
-        return time.perf_counter() - t0
 
-    chain(2)                                    # warm dispatch path
+def _chain_subprocess(child_fn: str, n: int, timeout_s: int):
+    """Run `python -c "from bench import <child_fn>; <child_fn>(n)"` and
+    return its CHAIN_T seconds, or None."""
+    import subprocess
+    import sys
+
+    code = f"from bench import {child_fn}; {child_fn}({n})"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHAIN_T "):
+            try:
+                return float(line.split()[1])
+            except ValueError:
+                return None
+    return None
+
+
+def measure_flash_mfu(batch: int = 8, seq: int = 4096, heads: int = 16,
+                      head_dim: int = 128) -> dict:
+    """Causal flash-attention prefill MFU on the chip (bf16, MXU path).
+
+    Inputs are head-major (layout="bhsd"): in a full model the
+    projection matmuls fuse the [B,S,H,D]->[B,H,S,D] layout change, so
+    the isolated kernel is measured without the four explicit transpose
+    copies the standalone [B,S,H,D] entry would add (~1 GB of HBM
+    traffic at this shape).
+
+    Timing: data-dependent chains of 32 and 96 kernels, each chain in
+    its OWN subprocess so it executes pre-poison (see
+    _flash_chain_child) with exactly one terminal force; the
+    128-vs-384 difference of minimum durations cancels the force's
+    round-trip latency.  r2-r4 timed chains after an initial force —
+    i.e. in the poisoned regime, where per-dispatch overhead belongs to
+    the relay, not the kernel."""
+    import jax
+
+    dev = jax.devices()[0]
     peak = _chip_peak_flops(dev)
     # Causal attention math: QK^T and PV are each 2*b*h*s^2*d MACs ->
     # 4*b*h*s^2*d FLOPs, halved by causal masking.
     flops_total = 4.0 * batch * heads * seq * seq * head_dim * 0.5
-    # Estimator: difference of the MINIMUM raw durations.  Relay
-    # interference is strictly additive on RAW durations (it can slow a
-    # chain, never speed it — caching is excluded by the data-dependent
-    # chain), so min() is the clean estimate for each chain length;
-    # differencing per-pair instead would let a stall inside a short
-    # chain deflate the difference and over-report.
+
+    # Chain lengths: pre-poison kernels are ~4 ms, while process-to-
+    # process jitter (init + force latency) is a few hundred ms — the
+    # 128-vs-384 delta (~1 s of pure kernel time) keeps the signal well
+    # above it.  First child may pay the (server-cached) compile:
+    # generous budget.
     t_n_all, t_3n_all = [], []
-    for _ in range(2):
-        t_n_all += [chain(32) for _ in range(2)]
-        t_3n_all += [chain(96) for _ in range(2)]
-    dt = (min(t_3n_all) - min(t_n_all)) / 64
+    for i in range(3):
+        t = _chain_subprocess("_flash_chain_child", 128,
+                              420 if i == 0 else 240)
+        if t is not None:
+            t_n_all.append(t)
+        t = _chain_subprocess("_flash_chain_child", 384, 300)
+        if t is not None:
+            t_3n_all.append(t)
+    if not t_n_all or not t_3n_all:
+        return {}
+    dt = (min(t_3n_all) - min(t_n_all)) / 256
     if dt <= 0 or flops_total / dt > peak:
         return {}           # jitter swamped the signal: report nothing
 
@@ -460,6 +498,10 @@ def measure_flash_mfu(batch: int = 8, seq: int = 4096, heads: int = 16,
     return {
         "flash_tflops": round(achieved / 1e12, 2),
         "mfu_flash_prefill": round(achieved / peak, 4),
+        "flash_chain_trials": {
+            "n128_s": [round(t, 3) for t in t_n_all],
+            "n384_s": [round(t, 3) for t in t_3n_all],
+        },
     }
 
 
@@ -483,155 +525,256 @@ def _chip_hbm_bw(device) -> float:
     return 819e9
 
 
-def measure_paged_decode_bw(batch: int = 8, pages_per_seq: int = 64,
-                            page: int = 64, kv_heads: int = 16,
-                            heads: int = 16, head_dim: int = 128) -> dict:
-    """Decode paged-attention HBM-bandwidth utilization: single-token
-    decode streams the whole gathered KV once, so achieved bytes/s over
-    the chip's HBM bandwidth is the decode-attention efficiency number
-    (decode is bandwidth-bound, not FLOPs-bound)."""
+def _paged_chain_child(n: int) -> None:
+    """One paged-decode chain of n steps in a FRESH process (pre-poison
+    execution; see _flash_chain_child).  Every step perturbs its query
+    with a distinct increment so no (kernel, input) pair recurs for the
+    relay to cache; the single force is the process's last act."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from open_gpu_kernel_modules_tpu.ops import paged_attention
 
-    dev = jax.devices()[0]
-    n = batch * pages_per_seq
+    batch, pages_per_seq, page = 8, 64, 64
+    kv_heads, heads, head_dim = 16, 16, 128
+    npages = batch * pages_per_seq
     key = jax.random.key(0)
     kk, kv_, kq = jax.random.split(key, 3)
-    k_pages = jax.random.normal(kk, (n, page, kv_heads, head_dim),
+    k_pages = jax.random.normal(kk, (npages, page, kv_heads, head_dim),
                                 jnp.bfloat16)
-    v_pages = jax.random.normal(kv_, (n, page, kv_heads, head_dim),
+    v_pages = jax.random.normal(kv_, (npages, page, kv_heads, head_dim),
                                 jnp.bfloat16)
-    table = jnp.asarray(np.arange(n, dtype=np.int32).reshape(batch,
-                                                       pages_per_seq))
+    table = jnp.asarray(np.arange(npages, dtype=np.int32)
+                        .reshape(batch, pages_per_seq))
     seq_lens = jnp.full((batch,), pages_per_seq * page, jnp.int32)
     q0 = jax.random.normal(kq, (batch, heads, head_dim), jnp.bfloat16)
-
-    # Iterated attention converges to a fixed point in a few steps —
-    # identical inputs which the relay then serves from cache at
-    # impossible rates.  Perturb every step with a distinct increment
-    # so no (kernel, input) pair ever recurs.  (The perturbation is its
-    # own tiny jit: wrapping the whole step in jit would bake the page
-    # pools in as constants and blow past the compile proxy's request
-    # size limit.)
     perturb = jax.jit(lambda x, i: (x + i * 1e-3).astype(jnp.bfloat16))
 
     def step(q, i):
         out = paged_attention(q, k_pages, v_pages, table, seq_lens, heads)
         return perturb(out, i)
 
-    cur = step(q0, jnp.float32(0))
-    float(cur[0, 0, 0])
-    counter = [0]
+    cur = step(q0, jnp.float32(0))      # compile — no readback
+    t0 = time.perf_counter()
+    for j in range(n):
+        cur = step(cur, jnp.float32(1 + j))
+    float(cur[0, 0, 0])                 # first d2h: chain done
+    print("CHAIN_T %.6f" % (time.perf_counter() - t0), flush=True)
 
-    def chain(m: int) -> float:
-        cur = q0
-        base = counter[0]
-        t0 = time.perf_counter()
-        for j in range(m):
-            cur = step(cur, jnp.float32(base + j))
-        float(cur[0, 0, 0])
-        counter[0] = base + m
-        return time.perf_counter() - t0
 
-    chain(2)
+def measure_paged_decode_bw(batch: int = 8, pages_per_seq: int = 64,
+                            page: int = 64, kv_heads: int = 16,
+                            heads: int = 16, head_dim: int = 128) -> dict:
+    """Decode paged-attention HBM-bandwidth utilization: single-token
+    decode streams the whole gathered KV once, so achieved bytes/s over
+    the chip's HBM bandwidth is the decode-attention efficiency number
+    (decode is bandwidth-bound, not FLOPs-bound).
+
+    Timing: pre-poison subprocess chains (see _flash_chain_child /
+    _paged_chain_child).  Each ATTEMPT pairs one 128-step and one
+    384-step child back-to-back (adjacent in time, same relay regime —
+    the same pairing discipline as the oversub replay ceiling) and the
+    difference isolates 256 steps with the force latency cancelled.
+    r2-r4 timed chains in the poisoned regime — the recorded 68.7 GB/s
+    vs interactive ~300 was relay overhead, not kernel dispersion.
+    Three attempts; minimum-duration pairing across them; every
+    attempt is recorded as dispersion."""
+    import jax
+
+    dev = jax.devices()[0]
     bytes_per_call = 2 * batch * pages_per_seq * page * kv_heads * \
         head_dim * 2
     hbm_bw = _chip_hbm_bw(dev)
-    # Reject samples implying super-physical bandwidth (residual relay
-    # caching or jitter collapse).  The known-chip table gates strictly;
-    # an UNRECOGNIZED device kind only sanity-caps at 4x the fallback
-    # figure so a faster future chip still reports (its util ratio is
-    # labeled by the fallback anyway).
     known = any(key in getattr(dev, "device_kind", "").lower()
                 for key, _ in HBM_BW_BYTES_PER_S)
     cap = (1.05 if known else 4.0) * hbm_bw
-    # Difference of minimum RAW durations (see measure_flash_mfu for
-    # why per-pair differencing over-reports); caching is excluded by
-    # the per-step perturbation and the physical cap gates the result.
-    t_n_all, t_3n_all = [], []
-    for _ in range(3):
-        t_n_all += [chain(8) for _ in range(2)]
-        t_3n_all += [chain(24) for _ in range(2)]
-    dt = (min(t_3n_all) - min(t_n_all)) / 16
-    if dt <= 0 or bytes_per_call / dt > cap:
+
+    # Estimator: difference of MINIMUM durations per chain length.
+    # Relay stalls are additive-positive on raw chain times (they can
+    # slow a chain, never speed it), so min() is the clean estimate for
+    # each length; per-attempt differencing would let a stall inside a
+    # SHORT chain deflate the difference and over-report.
+    t_n_all, t_3n_all, attempts = [], [], []
+    for i in range(3):
+        t_n = _chain_subprocess("_paged_chain_child", 128,
+                                420 if i == 0 else 240)
+        t_3n = _chain_subprocess("_paged_chain_child", 384, 300)
+        if t_n is None or t_3n is None:
+            continue
+        t_n_all.append(t_n)
+        t_3n_all.append(t_3n)
+        attempts.append({"t128_s": round(t_n, 3),
+                         "t384_s": round(t_3n, 3)})
+    if not t_n_all or not t_3n_all:
         return {}
+    dt = (min(t_3n_all) - min(t_n_all)) / 256
+    if dt <= 0 or bytes_per_call / dt > cap:
+        return {"paged_chain_trials": attempts}
     bw = bytes_per_call / dt
     return {
         "paged_decode_gbps": round(bw / 1e9, 1),
         "paged_decode_hbm_util": round(bw / hbm_bw, 4),
+        "paged_chain_trials": attempts,
     }
 
 
-def measure_tokens_per_s() -> dict:
-    """Config #4: grouped Llama decode, dense pool vs 4x-oversubscribed
-    UVM-tiered pool (same code path, oversub=1 vs 4)."""
-    import numpy as np
+def _tokens_setup():
+    """Shared config #4 workload: grouped Llama decode at serving scale
+    (long sequences, logical pool 4x the device slot pool, two groups
+    round-robining so every turn faults pages through the UVM backing).
+
+    CRITICAL relay property this section is built around: the FIRST
+    device->host readback in a process permanently degrades every later
+    host->device upload ~150x (measured: 1.5 GB/s -> 10 MB/s, no
+    recovery even via clear_backends).  Each serving variant therefore
+    runs in its OWN subprocess, keeps tokens/lengths device-side or
+    host-derived through warm-up and the timed region
+    (decode_rounds(force=False) / set_last_tokens_dev), and performs
+    its single materializing force only at the END of the timed
+    region."""
     import jax
-    from open_gpu_kernel_modules_tpu.models import llama, serving
+    from open_gpu_kernel_modules_tpu.models import llama
 
     cfg = llama.LlamaConfig(
         vocab_size=8192, hidden_size=512, intermediate_size=1536,
         num_layers=4, num_heads=8, num_kv_heads=8, head_dim=64,
         max_seq_len=2048)
     params = llama.init_params(cfg, jax.random.key(0))
-
-    # Config #4's shape at serving scale: LONG sequences over a logical
-    # pool 4x the device slot pool (256 pages vs 64 slots + a fixed
-    # 16-entry victim ring), two groups round-robining through the
-    # device pool so every turn faults pages through the UVM backing.
-    # 48 tokens per activation: serving amortizes page movement over a
-    # decode span, the way the reference amortizes migration over the
-    # accesses that follow it.
     batch, prompt_len, page, max_len = 8, 704, 64, 2048
     groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
     prompts = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
                                  cfg.vocab_size)
+    return cfg, params, batch, prompt_len, page, max_len, groups, prompts
 
-    def run(oversub: int) -> tuple[float, dict, object]:
-        cache = serving.TieredKVCache(cfg, batch=batch, max_len=max_len,
-                                      page_size=page, oversub=oversub)
-        try:
-            for g in groups:
-                serving.prefill_group(cfg, params, cache, g,
-                                      prompts[np.array(g)])
-            # Warm-up IDENTICAL to the timed region (same turn count,
-            # same schedule): victim save/restore, upload scatters and
-            # the decode scan each compile remotely (~1 s per variant,
-            # and input LAYOUT changes can key fresh variants), so the
-            # timed region must replay a fully-compiled sequence.
-            serving.decode_rounds(cfg, params, cache, groups,
-                                  tokens_per_turn=48, turns=2)
-            total, dt = serving.decode_rounds(cfg, params, cache, groups,
-                                              tokens_per_turn=48, turns=2)
-            return total / dt, dict(cache.stats), cache
-        finally:
-            cache.close()
 
-    dense_tps, _, _ = run(oversub=1)
-    tiered_tps, tstats, tcache = run(oversub=4)
-    # The relay slows as process RSS grows, so a single dense run can
-    # land in a different transport regime than the tiered run that
-    # follows it.  Re-measure dense AFTER tiered and take the best —
-    # the ratio must compare like with like.
-    dense2_tps, _, _ = run(oversub=1)
-    dense_tps = max(dense_tps, dense2_tps)
+def _tokens_tiered_run(oversub: int, victim_entries=None,
+                       tokens_per_turn: int = 48,
+                       turns: int = 2) -> tuple[float, dict, dict]:
+    """One tiered-cache variant: prefill, unforced warm-up (identical
+    schedule, compiles + pipeline warm, NO readback), then the timed
+    region whose single force lands at its end."""
+    import numpy as np
+    from open_gpu_kernel_modules_tpu.models import serving
+
+    (cfg, params, batch, _plen, page, max_len, groups,
+     prompts) = _tokens_setup()
+    cache = serving.TieredKVCache(cfg, batch=batch, max_len=max_len,
+                                  page_size=page, oversub=oversub,
+                                  victim_entries=victim_entries)
+    try:
+        for g in groups:
+            serving.prefill_group(cfg, params, cache, g,
+                                  prompts[np.array(g)])
+        serving.decode_rounds(cfg, params, cache, groups,
+                              tokens_per_turn=tokens_per_turn,
+                              turns=turns, force=False)
+        total, dt = serving.decode_rounds(cfg, params, cache, groups,
+                                          tokens_per_turn=tokens_per_turn,
+                                          turns=turns, force=True)
+        geom = {"device_pages": cache.n_slots + cache.victim_entries,
+                "logical_pages": cache.total_pages}
+        return total / dt, dict(cache.stats), geom
+    finally:
+        cache.close()
+
+
+def measure_tokens_dense() -> dict:
+    """Tiering machinery at 1x residency (after the initial faults
+    nothing evicts) — the like-for-like machinery baseline."""
+    tps, _, _ = _tokens_tiered_run(oversub=1)
+    return {"dense_toks_per_s": round(tps, 1)}
+
+
+def measure_tokens_tiered() -> dict:
+    """The metric of interest: 4x KV oversubscription through the
+    UVM-backed tiered cache."""
+    tps, stats, geom = _tokens_tiered_run(oversub=4)
     return {
-        "dense_toks_per_s": round(dense_tps, 1),
-        "tiered_toks_per_s": round(tiered_tps, 1),
-        "tiered_vs_dense": round(tiered_tps / dense_tps, 3)
-        if dense_tps else 0.0,
-        "tiered_page_uploads": tstats["uploads"],
-        "tiered_prefetched": tstats["prefetched_uploads"],
-        "tiered_sync_flushes": tstats["sync_flushes"],
-        "tiered_drains": tstats["drains"],
-        "tiered_victim_restores": tstats["victim_restores"],
-        # Footprint honesty: device-resident pages (slots + victim
-        # ring) vs the logical pool.
-        "tiered_device_pages": tcache.n_slots + tcache.victim_entries,
-        "tiered_logical_pages": tcache.total_pages,
+        "tiered_toks_per_s": round(tps, 1),
+        "tiered_page_uploads": stats["uploads"],
+        "tiered_prefetched": stats["prefetched_uploads"],
+        "tiered_sync_flushes": stats["sync_flushes"],
+        "tiered_drains": stats["drains"],
+        "tiered_victim_restores": stats["victim_restores"],
+        # Footprint honesty, read from the LIVE cache: device-resident
+        # pages (slots + victim ring) vs the logical pool.
+        "tiered_device_pages": geom["device_pages"],
+        "tiered_logical_pages": geom["logical_pages"],
     }
+
+
+def measure_tokens_spill() -> dict:
+    """Ring-exhausted spill path, measured: a 2-entry victim ring +
+    128-token turns (2 freshly-written pages per sequence per turn)
+    force more dirty evictions per activation than the ring holds, so
+    the synchronous flush path (serving.py _flush_slots spill branch)
+    runs under the bench.  48-token turns never spill: clean-first LRU
+    + group pinning keeps written tail pages resident."""
+    tps, stats, _ = _tokens_tiered_run(oversub=4, victim_entries=2,
+                                       tokens_per_turn=128, turns=1)
+    return {
+        "spill_toks_per_s": round(tps, 1),
+        "spill_sync_flushes": stats["sync_flushes"],
+    }
+
+
+def measure_tokens_plain() -> dict:
+    """TRUE dense baseline: a plain fully-resident PagedKVCache — no
+    slots, no victim ring, no backing, no activation machinery.  Group
+    views share one device pool; functional KV updates thread the pool
+    arrays between turns.  (The oversub=1 run keeps the tiered code
+    path for a like-for-like machinery comparison; this one answers
+    "what does tiering cost vs no tiering at all".)"""
+    import numpy as np
+    import jax.numpy as jnp
+    from open_gpu_kernel_modules_tpu.models import serving
+
+    (cfg, params, batch, prompt_len, page, max_len, groups,
+     prompts) = _tokens_setup()
+    m = (max_len + page - 1) // page
+    n = batch * m
+    page_shape = (cfg.num_layers, n, page, cfg.num_kv_heads, cfg.head_dim)
+    k_pool = jnp.zeros(page_shape, cfg.dtype)
+    v_pool = jnp.zeros(page_shape, cfg.dtype)
+    table = np.arange(n, dtype=np.int32).reshape(batch, m)
+    seq_lens = np.zeros((batch,), np.int32)
+    dev_tok = {}
+
+    def view(g):
+        return serving.PagedKVCache(
+            cfg=cfg, page_size=page, k_pages=k_pool, v_pages=v_pool,
+            page_table=jnp.asarray(table[np.array(g)]),
+            seq_lens=jnp.asarray(seq_lens[np.array(g)]))
+
+    for g in groups:
+        logits, v = serving.prefill(cfg, params, prompts[np.array(g)],
+                                    view(g))
+        k_pool, v_pool = v.k_pages, v.v_pages
+        seq_lens[np.array(g)] = prompt_len
+        # Tokens stay ON DEVICE (no readback before the timed region).
+        dev_tok[tuple(g)] = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def rounds(turns: int, force: bool) -> tuple[int, float]:
+        nonlocal k_pool, v_pool
+        total = 0
+        t0 = time.perf_counter()
+        for _ in range(turns):
+            for g in groups:
+                key = tuple(g)
+                tok, v, _ = serving.decode_scan(cfg, params, dev_tok[key],
+                                                view(g), 48)
+                dev_tok[key] = tok
+                k_pool, v_pool = v.k_pages, v.v_pages
+                seq_lens[np.array(g)] += 48
+                total += len(g) * 48
+        if force:
+            for tok in dev_tok.values():
+                np.asarray(tok)
+        return total, time.perf_counter() - t0
+
+    rounds(2, force=False)             # warm-up: compiles, no readback
+    total, dt = rounds(2, force=True)
+    return {"dense_plain_toks_per_s": round(total / dt, 1)}
 
 
 def _measure_isolated(fn_name: str, timeout_s: int, fallback,
@@ -779,15 +922,50 @@ def main() -> None:
                     measure_paged_decode_bw, "paged"))
             except Exception:
                 pass
-        try:
-            if on_tpu:
-                extra.update(_measure_isolated(
-                    "measure_tokens_per_s", 480,
-                    measure_tokens_per_s, "tokens"))
-            else:
-                extra.update(measure_tokens_per_s())
-        except Exception:
-            pass
+        token_variants = (
+            ("measure_tokens_plain", measure_tokens_plain,
+             "tokens_plain", 300),
+            ("measure_tokens_dense", measure_tokens_dense,
+             "tokens_dense", 480),
+            ("measure_tokens_tiered", measure_tokens_tiered,
+             "tokens", 480),
+            ("measure_tokens_spill", measure_tokens_spill,
+             "tokens_spill", 480))
+        if on_tpu:
+            # Each serving variant in its OWN subprocess: the first
+            # device->host readback permanently degrades a process's
+            # uploads ~150x (relay property, see _tokens_setup), so one
+            # variant's terminal force must not poison the next.
+            for fn_name, fn, tag, budget in token_variants:
+                try:
+                    extra.update(_measure_isolated(fn_name, budget, fn,
+                                                   tag))
+                except Exception:
+                    pass
+        else:
+            # Non-relay backends have no poison: run in-process.
+            for _fn_name, fn, _tag, _budget in token_variants:
+                try:
+                    extra.update(fn())
+                except Exception:
+                    pass
+        if extra.get("tiered_toks_per_s") and \
+                extra.get("dense_toks_per_s"):
+            extra["tiered_vs_dense"] = round(
+                extra["tiered_toks_per_s"] /
+                extra["dense_toks_per_s"], 3)
+        if extra.get("tiered_toks_per_s") and \
+                extra.get("dense_plain_toks_per_s"):
+            # The honesty ratio: tiering at 4x oversubscription vs NO
+            # tiering machinery at 1x residency.
+            extra["tiered_vs_dense_plain"] = round(
+                extra["tiered_toks_per_s"] /
+                extra["dense_plain_toks_per_s"], 3)
+        if extra.get("spill_toks_per_s") and \
+                extra.get("tiered_toks_per_s"):
+            extra["spill_vs_tiered"] = round(
+                extra["spill_toks_per_s"] /
+                extra["tiered_toks_per_s"], 3)
 
     try:
         extra.update(measure_explicit_migrate_gbps())

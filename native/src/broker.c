@@ -1230,8 +1230,11 @@ int tpurmBrokerUvmBacking(uint64_t ownerAddr, int *fdOut,
     BrokerReq rq = { .op = BR_OP_UVM_BACKING, .mainSize = sizeof(m) };
     BrokerRep rep;
     int fd = -1;
-    if (cli_call(&rq, &m, &rep, &m, sizeof(m), &fd) != 0)
+    if (cli_call(&rq, &m, &rep, &m, sizeof(m), &fd) != 0) {
+        if (fd >= 0)
+            close(fd);      /* fd can arrive before the payload fails */
         return -1;
+    }
     if (rep.ret < 0) {
         errno = rep.err ? rep.err : EIO;
         if (fd >= 0)

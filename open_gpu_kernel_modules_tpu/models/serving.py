@@ -393,7 +393,7 @@ class TieredKVCache:
 
     def __init__(self, cfg: llama.LlamaConfig, batch: int, max_len: int,
                  page_size: int = 64, oversub: int = 4, dev: int = 0,
-                 backing=None):
+                 backing=None, victim_entries: Optional[int] = None):
         self.cfg = cfg
         self.page_size = page_size
         self.dev = dev
@@ -425,6 +425,13 @@ class TieredKVCache:
         self._active_slots: set = set()
         self.seq_lens = np.zeros((batch,), np.int32)
         self.last_token = np.zeros((batch,), np.int32)
+        # Device-parked last tokens, keyed by group tuple.  A
+        # device->host readback on this relay both costs a transport
+        # round trip AND permanently degrades every later host->device
+        # upload in the process, so the serving loop keeps tokens on
+        # device and materializes only when a caller asks
+        # (decode_rounds(force=True)).
+        self._last_token_dev: Dict[Tuple[int, ...], jax.Array] = {}
         # Slots a decode WROTE since their last upload/restore.
         # Attention only reads KV, so most slots stay clean and evict
         # as free drops; dirty slots' pages must be preserved.
@@ -443,12 +450,16 @@ class TieredKVCache:
         # (uvm_migrate.c:555); the fixed shape keeps the save/restore
         # kernels at ONE compile each (a fresh shape key per epoch
         # would remote-compile mid-decode).
-        # A FIXED, small ring (16 entries) regardless of pool scale:
-        # it is a write-back buffer for the recently-written eviction
-        # tail, not a second cache tier — at serving scale it is a few
-        # percent of the slot pool, keeping the oversubscription claim
-        # real.
-        self.victim_entries = min(self.n_slots, 16)
+        # A FIXED, small ring (16 entries by default) regardless of pool
+        # scale: it is a write-back buffer for the recently-written
+        # eviction tail, not a second cache tier — at serving scale it
+        # is a few percent of the slot pool, keeping the
+        # oversubscription claim real.  `victim_entries` overrides for
+        # benchmarks that deliberately exercise the ring-exhausted
+        # synchronous-spill slow path.
+        self.victim_entries = min(self.n_slots,
+                                  victim_entries
+                                  if victim_entries is not None else 16)
         vic_shape = (cfg.num_layers, self.victim_entries) + self.page_shape
         self._victim_k = jnp.zeros(vic_shape, cfg.dtype)
         self._victim_v = jnp.zeros(vic_shape, cfg.dtype)
@@ -845,9 +856,17 @@ class TieredKVCache:
             page_table=jnp.asarray(table),
             seq_lens=jnp.asarray(self.seq_lens[np.array(seq_ids)]))
 
+    def set_last_tokens_dev(self, seq_ids: Sequence[int],
+                            toks: jax.Array) -> None:
+        """Park the group's last tokens ON DEVICE (no materialization;
+        see _last_token_dev).  decode_rounds picks them up; host readers
+        get them at the next force."""
+        self._last_token_dev[tuple(int(b) for b in seq_ids)] = toks
+
     def sync_from(self, view: PagedKVCache, seq_ids: Sequence[int],
                   last_tokens: Optional[np.ndarray] = None,
-                  decoded: int = 0) -> None:
+                  decoded: int = 0,
+                  host_lens: Optional[np.ndarray] = None) -> None:
         """Adopt the decode view's pool + lengths; unpin the group.
 
         Length bookkeeping is HOST-side arithmetic (`decoded` tokens
@@ -862,7 +881,11 @@ class TieredKVCache:
         # lengths are adopted from the view (prefill writes its whole
         # prompt span).  One device materialization for the whole group.
         P, m = self.page_size, self.pages_per_seq
-        view_lens = None if decoded else np.asarray(view.seq_lens)
+        # Prefer host-known lengths: np.asarray(view.seq_lens) is a
+        # device readback (see _last_token_dev note).
+        view_lens = None if decoded else (
+            host_lens if host_lens is not None
+            else np.asarray(view.seq_lens))
         for i, b in enumerate(seq_ids):
             if decoded:
                 old = int(self.seq_lens[b])
@@ -881,7 +904,7 @@ class TieredKVCache:
                 self.seq_lens[idx] + decoded,
                 self.pages_per_seq * self.page_size)
         else:
-            self.seq_lens[idx] = np.asarray(view.seq_lens)
+            self.seq_lens[idx] = view_lens
         if last_tokens is not None:
             self.last_token[idx] = np.asarray(last_tokens)
         self._active_slots.clear()
@@ -936,17 +959,24 @@ def prefill_group(cfg: llama.LlamaConfig, params: Dict[str, Any],
     """Prefill a group of sequences into the tiered cache.  The
     group's pages are flushed to the backing before returning (setup
     cost), so the decode phase starts with a clean pool and its
-    evictions of prompt pages are free drops."""
+    evictions of prompt pages are free drops.
+
+    The prompt's last tokens stay ON DEVICE (set_last_tokens_dev) and
+    lengths come from host arithmetic: a readback here would poison the
+    process's upload path for the whole decode (relay property)."""
     view = cache.activate(seq_ids, new_tokens=prompt.shape[1])
     logits, view = prefill(cfg, params, prompt, view)
-    cache.sync_from(view, seq_ids,
-                    np.asarray(jnp.argmax(logits, axis=-1), np.int32))
+    cache.sync_from(view, seq_ids, decoded=0,
+                    host_lens=np.full((len(seq_ids),), prompt.shape[1],
+                                      np.int32))
+    cache.set_last_tokens_dev(seq_ids,
+                              jnp.argmax(logits, axis=-1).astype(jnp.int32))
     cache.flush_group(seq_ids)
 
 
 def decode_rounds(cfg: llama.LlamaConfig, params: Dict[str, Any],
                   cache: TieredKVCache, groups, tokens_per_turn: int,
-                  turns: int) -> Tuple[int, float]:
+                  turns: int, force: bool = True) -> Tuple[int, float]:
     """Round-robin grouped decode: each turn activates one group and
     decodes ``tokens_per_turn`` for it — the config #4 serving shape
     (many resident sequences, an active working set cycling through the
@@ -986,6 +1016,17 @@ def decode_rounds(cfg: llama.LlamaConfig, params: Dict[str, Any],
                                   staged=staged.pop(key, None))
             tok = dev_tok.get(key)
             if tok is None:
+                tok = cache._last_token_dev.pop(key, None)
+            if tok is None:
+                # Grouping differs from the one that parked tokens:
+                # materialize any parked groups overlapping this one
+                # into host last_token first (costs a readback — the
+                # exact-key fast path above avoids it), or decode would
+                # silently seed from stale host tokens.
+                for pk in [k for k in list(cache._last_token_dev)
+                           if set(k) & set(int(b) for b in g)]:
+                    cache.last_token[np.array(pk)] = np.asarray(
+                        cache._last_token_dev.pop(pk), np.int32)
                 tok = jnp.asarray(cache.last_token[np.array(g)])
             tok, view, _ = decode_scan(cfg, params, tok, view,
                                        tokens_per_turn)
@@ -997,9 +1038,15 @@ def decode_rounds(cfg: llama.LlamaConfig, params: Dict[str, Any],
                     nxt, new_tokens=tokens_per_turn)
             total += len(g) * tokens_per_turn
     finally:
-        # Materialize final tokens once — ALSO on error paths, so the
-        # cache's last_token stays consistent with the seq_lens that
-        # already advanced for completed turns.
+        # force=True: materialize final tokens once — ALSO on error
+        # paths, so cache.last_token stays consistent with the
+        # seq_lens that already advanced for completed turns.  This
+        # readback is the process's upload-path poison point (relay
+        # property), so warm-up callers pass force=False, which parks
+        # the tokens on device for the next rounds to pick up.
         for g, tok in dev_tok.items():
-            cache.last_token[np.array(g)] = np.asarray(tok, np.int32)
+            if force:
+                cache.last_token[np.array(g)] = np.asarray(tok, np.int32)
+            else:
+                cache._last_token_dev[g] = tok
     return total, time.perf_counter() - t0
