@@ -39,6 +39,23 @@
  *   - lifetime: a dropped connection frees every RM client it created
  *     (rs_server frees clients of dead processes the same way).
  *
+ * Coherence stance for concurrent remote windows (documented contract):
+ * a remote NVOS33 window maps the SAME physical pages the engine host
+ * serves (one shared memfd), so client stores are immediately visible
+ * to engine-side readers at hardware cache coherence — there is no
+ * stale-shadow window.  What is NOT ordered is a client writing through
+ * its window CONCURRENTLY with a local DMA reading the same span: the
+ * DMA observes an arbitrary interleaving of old and new bytes, exactly
+ * as racing a CPU store against an in-flight DMA does on the reference
+ * hardware (BAR writes vs CE reads are unordered without a fence).  The
+ * serialization points are the NVOS34 unmap (flush) and CXL DMA
+ * completion events; clients that need ordering use them.
+ *
+ * Fixed caps: BROKER_MAX_CLIENTS_PER_CONN/BROKER_MAX_SHADOWS/
+ * BROKER_EV_SLOTS bound per-connection state; exceeding them returns
+ * INSUFFICIENT_RESOURCES rather than growing unboundedly on behalf of a
+ * remote peer (the rs_server-style fixed client tables).
+ *
  * The wire protocol is internal (both ends are this file); the CLIENT
  * ABI is still the NVOS ioctl surface.
  */
