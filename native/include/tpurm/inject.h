@@ -1,0 +1,101 @@
+/*
+ * inject — seeded, site-addressable fault injection.
+ *
+ * Every recovery path in the engine must be exercisable on demand
+ * (reference: UVM error-injection ioctls, uvm_test.c:286,308; the
+ * channel layer's old one-shot latch generalized here).  A SITE is a
+ * named point in a critical path where the engine asks "should this
+ * operation fail now?".  Sites are armed per-process with a mode:
+ *
+ *   ONESHOT — fail exactly one evaluation (optionally scoped to one
+ *             object, e.g. one channel), then disarm;
+ *   NTH     — fail every Nth evaluation (deterministic cadence);
+ *   PPM     — fail with probability arg/1,000,000 per evaluation,
+ *             driven by a per-site xorshift PRNG seeded from the
+ *             global seed (same seed => same hit sequence).
+ *
+ * An optional BURST makes every hit fail the next burst-1 evaluations
+ * too — long enough bursts defeat bounded retry and drive the
+ * retry-exhausted / quarantine recovery paths.
+ *
+ * Configuration: C API below, ctypes (open_gpu_kernel_modules_tpu/
+ * uvm/inject.py), or environment at load:
+ *
+ *   TPUMEM_INJECT_SEED=<u64>
+ *   TPUMEM_INJECT_<SITE>=once | nth=<N> | ppm=<P>[,burst=<B>][,scope=<S>]
+ *
+ * where <SITE> is the enum name (PMM_ALLOC, MIGRATE_COPY, ...).
+ *
+ * The disarmed fast path is a single relaxed atomic load of a global
+ * mask — no counters, no locks — so fault-path latency is unchanged
+ * while injection is off.
+ */
+#ifndef TPURM_INJECT_H
+#define TPURM_INJECT_H
+
+#include <stdbool.h>
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Injection sites (keep tpurmInjectSiteName in sync). */
+typedef enum {
+    TPU_INJECT_SITE_PMM_ALLOC = 0,   /* PMM chunk allocation (HBM/CXL)   */
+    TPU_INJECT_SITE_MIGRATE_COPY,    /* block migration copy pass        */
+    TPU_INJECT_SITE_MSGQ_PUBLISH,    /* msgq submit (mirror/shadow/fifo) */
+    TPU_INJECT_SITE_ICI_LINK,        /* ICI link flap / retrain failure  */
+    TPU_INJECT_SITE_RDMA_COMPLETION, /* MR pin/map completion error      */
+    TPU_INJECT_SITE_CHANNEL_CE,      /* channel CE push fault            */
+    TPU_INJECT_SITE_FENCE_TIMEOUT,   /* fault-service / fence timeout    */
+    TPU_INJECT_SITE_COUNT
+} TpuInjectSite;
+
+/* Site modes. */
+enum {
+    TPU_INJECT_OFF = 0,
+    TPU_INJECT_ONESHOT = 1,
+    TPU_INJECT_NTH = 2,              /* arg = N: every Nth evaluation    */
+    TPU_INJECT_PPM = 3,              /* arg = parts-per-million          */
+};
+
+/* Reseed every site PRNG (deterministic: same seed => same hit
+ * sequence per site, counted by evaluation index). */
+void tpurmInjectSetSeed(uint64_t seed);
+
+/* Arm a site.  burst >= 1 (a hit fails burst consecutive evaluations);
+ * scope 0 matches every evaluation, nonzero only evaluations carrying
+ * the same scope key.  Mode TPU_INJECT_OFF disarms. */
+TpuStatus tpurmInjectConfigure(uint32_t site, uint32_t mode, uint64_t arg,
+                               uint32_t burst, uint64_t scope);
+
+/* Queue one scoped one-shot without disturbing the site's main mode
+ * (several may be armed at once; each is consumed by exactly one
+ * matching evaluation).  TPU_ERR_INSUFFICIENT_RESOURCES when the arm
+ * table is full. */
+TpuStatus tpurmInjectArmOneShot(uint32_t site, uint64_t scope);
+
+void tpurmInjectDisable(uint32_t site);
+void tpurmInjectDisableAll(void);
+
+/* Re-parse TPUMEM_INJECT_* from the environment (also done once at
+ * library load). */
+void tpurmInjectReloadEnv(void);
+
+/* Observability: evaluations and hits since process start. */
+void tpurmInjectCounts(uint32_t site, uint64_t *evals, uint64_t *hits);
+const char *tpurmInjectSiteName(uint32_t site);
+
+/* Engine-side checks (exported so tests can drive them directly).
+ * The scoped variant carries an object key (e.g. channel rc id). */
+bool tpurmInjectShouldFail(uint32_t site);
+bool tpurmInjectShouldFailScoped(uint32_t site, uint64_t scopeKey);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_INJECT_H */

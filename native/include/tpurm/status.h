@@ -34,6 +34,18 @@ typedef uint32_t TpuStatus;
 #define TPU_ERR_OPERATING_SYSTEM          0x00000059u
 #define TPU_ERR_STATE_IN_USE              0x00000063u
 
+/* Recovery-path error classes (fork-local; outside the reference's
+ * nvstatuscodes range so they can never be confused with ABI codes):
+ *   PAGE_QUARANTINED — the page faulted fatally through every bounded
+ *     retry and has been retired onto a poison mapping;
+ *   RETRAIN_FAILED   — an ICI link could not be retrained and no
+ *     degraded route exists;
+ *   RETRY_EXHAUSTED  — a transient-error recovery loop (copy/fault/
+ *     RDMA) ran out of attempts. */
+#define TPU_ERR_PAGE_QUARANTINED          0x00000070u
+#define TPU_ERR_RETRAIN_FAILED            0x00000071u
+#define TPU_ERR_RETRY_EXHAUSTED           0x00000072u
+
 const char *tpuStatusToString(TpuStatus status);
 
 #endif /* TPURM_STATUS_H */

@@ -153,6 +153,13 @@ uint64_t      tpurmChannelPushCopy(TpurmChannel *ch, void *dst,
 /* Tracker semantics (reference: uvm_tracker.c): wait until the channel's
  * completed value >= value. */
 TpuStatus     tpurmChannelWait(TpurmChannel *ch, uint64_t value);
+/* Range wait: like tpurmChannelWait but fails ONLY if a push whose
+ * tracker value lies in [minValue, value] faulted — failure attribution
+ * survives a concurrent RC reset (recovery retry on another thread
+ * cannot turn this caller's faulted copy into a silent success).  Used
+ * by trackers and the hardened-recovery retry loops. */
+TpuStatus     tpurmChannelWaitRange(TpurmChannel *ch, uint64_t minValue,
+                                    uint64_t value);
 uint64_t      tpurmChannelCompletedValue(TpurmChannel *ch);
 /* Fault injection: force the next push to fail (reference: UVM error
  * injection ioctls, uvm_test.c:286,308). */
@@ -189,7 +196,9 @@ void          tpurmChannelInjectStall(TpurmChannel *ch, uint32_t ms);
 
 typedef struct {
     TpurmChannel *ch;
-    uint64_t value;
+    uint64_t value;            /* max value added for this channel      */
+    uint64_t minValue;         /* min value added (failure attribution
+                                * window for tpurmChannelWaitRange)     */
 } TpuTrackerEntry;
 
 typedef struct {

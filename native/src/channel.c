@@ -31,11 +31,15 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/inject.h"
 #include "tpurm/msgq.h"
 
 #include <stdatomic.h>
 #include <stdlib.h>
 #include <string.h>
+
+/* Failed-push history depth per channel (see errSeqs below). */
+#define CH_ERR_RING 64
 
 /* A copy method within a push (the reference encodes CE methods into
  * pushbuffer space; here a segment IS the method). */
@@ -70,8 +74,18 @@ struct TpurmChannel {
     PbChunk *pbChunks, *pbChunksTail;
     PbChunk *pbChunkFree;          /* recycled chunk nodes */
     bool stop;
-    bool injectNext;
+    bool injectNext;           /* legacy latch (arm-table-full fallback) */
     _Atomic int error;         /* latched channel error */
+    /* Failed-push attribution, immune to RC resets: the executor
+     * records every faulted push's tracker value here (monotonic
+     * append; the latch above can be cleared by recovery while another
+     * thread still owes a wait on the faulted push, but this history
+     * cannot).  tpurmChannelWaitRange checks it so a concurrent
+     * RC reset-and-replay never turns a faulted copy into a silent
+     * success. */
+    _Atomic uint64_t errSeqs[CH_ERR_RING];
+    _Atomic uint32_t errSeqCount;   /* total failures (write cursor)   */
+    _Atomic uint64_t errEvictedMax; /* highest seq aged out of the ring */
     _Atomic uint32_t evRefs;   /* live event-worker jobs referencing us
                                 * (event.c); destroy waits for zero */
     _Atomic uint32_t stallMs;  /* test injection: executor stall */
@@ -159,6 +173,25 @@ static void *channel_executor(void *arg)
         pthread_mutex_unlock(&ch->lock);
 
         if (failed) {
+            /* Record the faulted value in the failed-push history
+             * BEFORE retiring the command: a waiter that observes
+             * completion of this seq is then guaranteed to see the
+             * record (release via the msgq's completedSeq store). */
+            uint32_t n = atomic_load_explicit(&ch->errSeqCount,
+                                              memory_order_relaxed);
+            if (n >= CH_ERR_RING) {
+                uint64_t old = atomic_load_explicit(
+                    &ch->errSeqs[n % CH_ERR_RING], memory_order_relaxed);
+                uint64_t evicted = atomic_load_explicit(
+                    &ch->errEvictedMax, memory_order_relaxed);
+                if (old > evicted)
+                    atomic_store_explicit(&ch->errEvictedMax, old,
+                                          memory_order_release);
+            }
+            atomic_store_explicit(&ch->errSeqs[n % CH_ERR_RING], cmd.seq,
+                                  memory_order_release);
+            atomic_store_explicit(&ch->errSeqCount, n + 1,
+                                  memory_order_release);
             /* Latch synchronously (wait semantics) AND post to the
              * non-replayable shadow buffer for attribution/recovery
              * (rc.c — the reference's CE-fault delivery split). */
@@ -366,6 +399,12 @@ uint64_t tpuPushEnd(TpuPush *p, TpuTracker *t)
     ch->injectNext = false;
     tpuLockTrackRelease(TPU_LOCK_CHANNEL, "push-end");
     pthread_mutex_unlock(&ch->lock);
+    /* Framework channel-CE site: a global arming (ppm chaos) or a
+     * scoped one-shot (the tpurmChannelInjectError shim, keyed by this
+     * channel's rc id) fails this push exactly like the legacy latch. */
+    if (!inject &&
+        tpurmInjectShouldFailScoped(TPU_INJECT_SITE_CHANNEL_CE, ch->rcId))
+        inject = true;
     if (stopped) {
         tpuPushAbort(p);
         return 0;
@@ -445,9 +484,57 @@ uint64_t tpurmChannelCompletedValue(TpurmChannel *ch)
     return ch ? tpuMsgqCompletedSeq(ch->fifo) : 0;
 }
 
+/* Range wait: completion of `value`, failing only if a push whose
+ * tracker value lies in [minValue, value] faulted.  Unlike the latch
+ * check in tpurmChannelWait, this attributes failures to the caller's
+ * own window of pushes — a concurrent RC reset (recovery on another
+ * thread) cannot hide them, and another client's later fault cannot
+ * leak in.  Used by trackers and every engine retry loop. */
+TpuStatus tpurmChannelWaitRange(TpurmChannel *ch, uint64_t minValue,
+                                uint64_t value)
+{
+    if (!ch)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (value == 0)
+        return TPU_OK;
+    if (!tpuMsgqWaitSeq(ch->fifo, value))
+        return TPU_ERR_INVALID_STATE;
+    uint32_t n = atomic_load_explicit(&ch->errSeqCount,
+                                      memory_order_acquire);
+    if (n) {
+        uint32_t scan = n < CH_ERR_RING ? n : CH_ERR_RING;
+        for (uint32_t i = 0; i < scan; i++) {
+            uint64_t s = atomic_load_explicit(&ch->errSeqs[i],
+                                              memory_order_acquire);
+            if (s >= minValue && s <= value)
+                return TPU_ERR_INVALID_STATE;
+        }
+        /* History aged out past our window: cannot prove the window
+         * clean, so fail conservatively (caller retries). */
+        if (atomic_load_explicit(&ch->errEvictedMax,
+                                 memory_order_acquire) >= minValue)
+            return TPU_ERR_INVALID_STATE;
+    }
+    return TPU_OK;
+}
+
+bool tpurmChannelErrorPending(TpurmChannel *ch)
+{
+    return ch && atomic_load_explicit(&ch->error,
+                                      memory_order_acquire) != 0;
+}
+
+/* Thin shim over the injection framework's channel-CE site: arm a
+ * one-shot scoped to this channel's rc id — consumed by this channel's
+ * next push, which then carries TPU_MSGQ_FLAG_INJECT_ERROR exactly as
+ * the old latch did.  The legacy latch survives only as the fallback
+ * when the arm table is full. */
 void tpurmChannelInjectError(TpurmChannel *ch)
 {
     if (!ch)
+        return;
+    if (tpurmInjectArmOneShot(TPU_INJECT_SITE_CHANNEL_CE, ch->rcId) ==
+        TPU_OK)
         return;
     pthread_mutex_lock(&ch->lock);
     ch->injectNext = true;

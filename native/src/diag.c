@@ -99,7 +99,9 @@ size_t tpurmJournalDump(char *buf, size_t bufSize)
 
 /* --------------------------------------------------------------- counters */
 
-#define MAX_COUNTERS 64
+/* Static names (~70 after the recovery counters) plus per-device
+ * scoped "name[dN]" lines: size for a 16-device worst case. */
+#define MAX_COUNTERS 256
 
 static struct {
     pthread_mutex_t lock;                /* registration only */
@@ -267,19 +269,25 @@ const char *tpuStatusToString(TpuStatus status)
     case TPU_ERR_GPU_IS_LOST:            return "DEVICE_LOST";
     case TPU_ERR_INSERT_DUPLICATE_NAME:  return "DUPLICATE_HANDLE";
     case TPU_ERR_INSUFFICIENT_RESOURCES: return "INSUFFICIENT_RESOURCES";
+    case TPU_ERR_INVALID_ADDRESS:        return "INVALID_ADDRESS";
     case TPU_ERR_INVALID_ARGUMENT:       return "INVALID_ARGUMENT";
+    case TPU_ERR_INVALID_CLASS:          return "INVALID_CLASS";
     case TPU_ERR_INVALID_CLIENT:         return "INVALID_CLIENT";
     case TPU_ERR_INVALID_COMMAND:        return "INVALID_COMMAND";
     case TPU_ERR_INVALID_DEVICE:         return "INVALID_DEVICE";
     case TPU_ERR_INVALID_LIMIT:          return "INVALID_LIMIT";
     case TPU_ERR_INVALID_OBJECT_HANDLE:  return "INVALID_OBJECT_HANDLE";
     case TPU_ERR_INVALID_OBJECT_PARENT:  return "INVALID_OBJECT_PARENT";
+    case TPU_ERR_INVALID_PARAM_STRUCT:   return "INVALID_PARAM_STRUCT";
     case TPU_ERR_INVALID_STATE:          return "INVALID_STATE";
     case TPU_ERR_NO_MEMORY:              return "NO_MEMORY";
     case TPU_ERR_NOT_SUPPORTED:          return "NOT_SUPPORTED";
     case TPU_ERR_OBJECT_NOT_FOUND:       return "OBJECT_NOT_FOUND";
     case TPU_ERR_OPERATING_SYSTEM:       return "OPERATING_SYSTEM";
     case TPU_ERR_STATE_IN_USE:           return "STATE_IN_USE";
+    case TPU_ERR_PAGE_QUARANTINED:       return "PAGE_QUARANTINED";
+    case TPU_ERR_RETRAIN_FAILED:         return "RETRAIN_FAILED";
+    case TPU_ERR_RETRY_EXHAUSTED:        return "RETRY_EXHAUSTED";
     default:                             return "UNKNOWN";
     }
 }

@@ -17,6 +17,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/ici.h"
+#include "tpurm/inject.h"
 #include "tpurm/uvm.h"
 
 #include <stdlib.h>
@@ -33,6 +34,12 @@ typedef struct {
     uint32_t errorCount;
     uint8_t dim;                /* 0 = x, 1 = y */
     int8_t dir;                 /* +1 / -1 around the torus */
+    /* Flap recovery state: softFail marks failures from the injection
+     * framework (transient link flaps) that the lazy retrain policy may
+     * recover; admin failures via tpuIciInjectLinkFailure stay FAILED
+     * until an explicit reset (tests rely on sticky detours). */
+    bool softFail;
+    uint64_t failedAtNs;
 } IciLink;
 
 static struct {
@@ -50,6 +57,8 @@ static uint64_t now_ns(void)
 }
 
 static void train_links_locked(uint32_t devInst);
+static TpuStatus next_hop_locked(uint32_t src, uint32_t dst,
+                                 uint32_t *next);
 
 static void ici_add_link(uint32_t dev, uint32_t peer, uint8_t dim, int8_t dir)
 {
@@ -188,16 +197,97 @@ TpuStatus tpuIciInjectLinkFailure(uint32_t devInst, uint32_t link)
     pthread_mutex_lock(&g_ici.lock);
     IciLink *l = &g_ici.links[devInst][link];
     l->state = TPU_ICI_LINK_FAILED;
+    l->softFail = false;        /* admin failure: sticky until reset */
+    l->failedAtNs = now_ns();
     l->errorCount++;
     IciLink *back = link_to(l->peerInst, devInst);
     if (back) {
         back->state = TPU_ICI_LINK_FAILED;
+        back->softFail = false;
+        back->failedAtNs = l->failedAtNs;
         back->errorCount++;
     }
     tpuLog(TPU_LOG_WARN, "ici", "link %u.%u -> %u FAILED (injected)",
            devInst, link, l->peerInst);
     pthread_mutex_unlock(&g_ici.lock);
     return TPU_OK;
+}
+
+/* Flap the direct link along src's route toward dst (framework
+ * injection site): both directions drop to FAILED with the soft flag,
+ * so the lazy retrain policy recovers them.  g_ici.lock held. */
+static void ici_flap_route_locked(uint32_t src, uint32_t dst)
+{
+    uint32_t next;
+    if (next_hop_locked(src, dst, &next) != TPU_OK || next == src)
+        return;
+    IciLink *l = link_to(src, next);
+    if (!l || l->state != TPU_ICI_LINK_ACTIVE)
+        return;
+    uint64_t now = now_ns();
+    l->state = TPU_ICI_LINK_FAILED;
+    l->softFail = true;
+    l->failedAtNs = now;
+    l->errorCount++;
+    IciLink *back = link_to(next, src);
+    if (back && back->state == TPU_ICI_LINK_ACTIVE) {
+        back->state = TPU_ICI_LINK_FAILED;
+        back->softFail = true;
+        back->failedAtNs = now;
+        back->errorCount++;
+    }
+    tpuCounterAdd("ici_link_flaps", 1);
+    tpuLog(TPU_LOG_WARN, "ici", "link flap (injected): %u -> %u FAILED",
+           src, next);
+}
+
+/* Lazy retrain of soft-failed links (recovery policy: every peer copy
+ * first gives flapped links a chance to come back).  `force` ignores
+ * the backoff — used when a copy finds the fabric partitioned.  A
+ * retrain attempt can itself fail (injection site fires again), which
+ * leaves the link FAILED with a fresh backoff window.  Returns links
+ * restored to ACTIVE.  g_ici.lock held. */
+static uint32_t ici_retrain_soft_locked(bool force)
+{
+    uint64_t now = now_ns();
+    uint64_t backoffNs = tpuRegistryGet("ici_retrain_backoff_ms", 0) *
+                         1000000ull;
+    uint32_t recovered = 0;
+    for (uint32_t d = 0; d < g_ici.count; d++) {
+        for (uint32_t i = 0; i < g_ici.linkCount[d]; i++) {
+            IciLink *l = &g_ici.links[d][i];
+            if (l->state != TPU_ICI_LINK_FAILED || !l->softFail)
+                continue;
+            if (!force && now - l->failedAtNs < backoffNs)
+                continue;
+            if (tpurmInjectShouldFail(TPU_INJECT_SITE_ICI_LINK)) {
+                /* Retrain itself failed: stay FAILED, re-arm backoff. */
+                l->failedAtNs = now;
+                tpuCounterAdd("ici_retrain_failures", 1);
+                tpuLog(TPU_LOG_WARN, "ici",
+                       "retrain FAILED for link %u -> %u (%s)", d,
+                       l->peerInst,
+                       tpuStatusToString(TPU_ERR_RETRAIN_FAILED));
+                continue;
+            }
+            l->state = TPU_ICI_LINK_ACTIVE;
+            l->softFail = false;
+            l->trainedAtNs = now;
+            IciLink *back = link_to(l->peerInst, d);
+            if (back && back->state == TPU_ICI_LINK_FAILED &&
+                back->softFail) {
+                back->state = TPU_ICI_LINK_ACTIVE;
+                back->softFail = false;
+                back->trainedAtNs = now;
+            }
+            recovered++;
+            tpuCounterAdd("recover_link_retrains", 1);
+            tpuCounterAdd("ici_links_trained", 1);
+            tpuLog(TPU_LOG_WARN, "ici", "link %u -> %u retrained ACTIVE",
+                   d, l->peerInst);
+        }
+    }
+    return recovered;
 }
 
 TpuStatus tpuIciResetLink(uint32_t devInst, uint32_t link)
@@ -370,8 +460,31 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
         peerOff > phbm || size > phbm - peerOff)
         return TPU_ERR_INVALID_LIMIT;
 
+    /* Recovery-first: give flapped links their lazy retrain, then let
+     * the injection framework flap a link on this copy's route (chaos:
+     * the copy must still complete — detour or retrain). */
     pthread_mutex_lock(&g_ici.lock);
+    ici_retrain_soft_locked(false);
+    if (tpurmInjectShouldFail(TPU_INJECT_SITE_ICI_LINK))
+        ici_flap_route_locked(ap->srcInst, ap->peerInst);
     TpuStatus st = account_route_locked(ap->srcInst, ap->peerInst, size);
+    if (st != TPU_OK) {
+        /* Partitioned: force retrain of soft-failed links and retry the
+         * route once.  If nothing retrains (or retrain itself failed)
+         * report RETRAIN_FAILED when a flapped link is the cause. */
+        bool anySoft = false;
+        for (uint32_t d = 0; d < g_ici.count && !anySoft; d++)
+            for (uint32_t i = 0; i < g_ici.linkCount[d]; i++)
+                if (g_ici.links[d][i].state == TPU_ICI_LINK_FAILED &&
+                    g_ici.links[d][i].softFail) {
+                    anySoft = true;
+                    break;
+                }
+        if (ici_retrain_soft_locked(true) > 0)
+            st = account_route_locked(ap->srcInst, ap->peerInst, size);
+        if (st != TPU_OK && anySoft)
+            st = TPU_ERR_RETRAIN_FAILED;
+    }
     pthread_mutex_unlock(&g_ici.lock);
     if (st != TPU_OK)
         return st;
@@ -393,19 +506,46 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
     uint32_t hops = 0;
     if (tpuIciRouteHops(from, to, &hops) != TPU_OK)
         return TPU_ERR_INVALID_STATE;
+    if (hops > 1) {
+        /* Multi-hop while a direct link exists but is down: the copy is
+         * riding a detour (degraded routing). */
+        pthread_mutex_lock(&g_ici.lock);
+        IciLink *direct = link_to(from, to);
+        if (direct && direct->state != TPU_ICI_LINK_ACTIVE)
+            tpuCounterAdd("ici_degraded_routes", 1);
+        pthread_mutex_unlock(&g_ici.lock);
+    }
     if (hops <= 1) {
-        uint64_t v = tpurmChannelPushCopy(local->ce, dst, src, size);
-        if (v == 0)
-            return TPU_ERR_INVALID_STATE;
-        tpuCounterAdd("ici_peer_copy_bytes", size);
-        if (tracker) {
-            if (tpuTrackerAdd(tracker, local->ce, v) == TPU_OK)
+        /* Bounded retry: a CE fault under the hop copy (injected or
+         * real) recovers via RC reset-and-replay + re-push.  Range
+         * waits attribute failures to OUR push only, so concurrent
+         * recoveries elsewhere neither mask nor pollute this copy. */
+        uint32_t lim = (uint32_t)tpuRegistryGet("recover_copy_retries", 3);
+        for (uint32_t attempt = 0; ; attempt++) {
+            uint64_t v = tpurmChannelPushCopy(local->ce, dst, src, size);
+            st = TPU_ERR_INVALID_STATE;
+            if (v != 0) {
+                if (tracker && attempt == 0 &&
+                    tpuTrackerAdd(tracker, local->ce, v) == TPU_OK) {
+                    /* Async contract: failure surfaces at the caller's
+                     * tracker wait (range-checked), where the caller
+                     * retries. */
+                    tpuCounterAdd("ici_peer_copy_bytes", size);
+                    return TPU_OK;
+                }
+                st = tpurmChannelWaitRange(local->ce, v, v);
+            }
+            if (st == TPU_OK) {
+                tpuCounterAdd("ici_peer_copy_bytes", size);
                 return TPU_OK;
-            /* Dep could not be recorded: complete it now instead of
-             * leaving an untracked in-flight copy behind an error. */
-            return tpurmChannelWait(local->ce, v);
+            }
+            if (attempt >= lim)
+                return attempt ? TPU_ERR_RETRY_EXHAUSTED : st;
+            tpuCounterAdd("recover_retries", 1);
+            tpuCounterAdd("recover_ici_retries", 1);
+            tpuRcRecoverAll();
+            tpuRecoverBackoff(attempt);
         }
-        return tpurmChannelWait(local->ce, v);
     }
 
     /* Build the hop chain from..to. */
@@ -449,8 +589,17 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
     uint32_t nStage = 0;
     st = TPU_OK;
     for (uint32_t i = 1; i + 1 < n && st == TPU_OK; i++) {
-        st = uvmHbmChunkAlloc(chain[i], seg, &stageOff[nStage],
-                              &stageHandle[nStage]);
+        /* Staging allocation rides the same PMM as everything else, so
+         * the injected allocation fault can land here too: bounded
+         * retry (a transient chunk fault won't repeat), then give up. */
+        for (uint32_t attempt = 0; ; attempt++) {
+            st = uvmHbmChunkAlloc(chain[i], seg, &stageOff[nStage],
+                                  &stageHandle[nStage]);
+            if (st != TPU_ERR_INSUFFICIENT_RESOURCES || attempt >= 3)
+                break;
+            tpuCounterAdd("recover_retries", 1);
+            tpuRecoverBackoff(attempt);
+        }
         if (st == TPU_OK)
             nStage++;
     }
@@ -479,18 +628,21 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
             uint64_t len = size - off < seg ? size - off : seg;
             const char *hopSrc = (const char *)src + off;
             for (uint32_t h = 0; h + 1 < n && st == TPU_OK; h++) {
-                /* Data dependency: previous hop of THIS segment. */
+                /* Data dependency: previous hop of THIS segment.
+                 * (Range waits: only THIS pipeline's pushes fail us.) */
                 if (h > 0) {
-                    st = tpurmChannelWait(chainDev[h - 1]->ce,
-                                          curVal[h - 1]);
+                    st = tpurmChannelWaitRange(chainDev[h - 1]->ce,
+                                               curVal[h - 1],
+                                               curVal[h - 1]);
                     if (st != TPU_OK)
                         break;
                 }
                 /* Staging reuse: the PREVIOUS segment must have been
                  * read out of the slot this push overwrites. */
                 if (h < lastHop && prevVal[h + 1]) {
-                    st = tpurmChannelWait(chainDev[h + 1]->ce,
-                                          prevVal[h + 1]);
+                    st = tpurmChannelWaitRange(chainDev[h + 1]->ce,
+                                               prevVal[h + 1],
+                                               prevVal[h + 1]);
                     if (st != TPU_OK)
                         break;
                 }
@@ -511,7 +663,8 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
         }
         /* Drain the tail (staging frees below must not race copies). */
         for (uint32_t h = 0; h + 1 < n; h++) {
-            TpuStatus ws = tpurmChannelWait(chainDev[h]->ce, prevVal[h]);
+            TpuStatus ws = tpurmChannelWaitRange(chainDev[h]->ce,
+                                                 prevVal[h], prevVal[h]);
             if (ws != TPU_OK && st == TPU_OK)
                 st = ws;
         }

@@ -1,0 +1,346 @@
+/*
+ * inject — seeded, site-addressable fault injection (see
+ * include/tpurm/inject.h for the model).
+ *
+ * Concurrency: evaluations are lock-free (atomics only; the armed-mask
+ * fast path is one relaxed load).  Configuration takes a mutex but only
+ * flips atomics, so it can race evaluations safely — a torn config is
+ * at worst one spurious or missed hit during the transition, which
+ * chaos tests tolerate by design.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/inject.h"
+
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define INJECT_ARM_SLOTS 16
+
+/* Scope sentinel stored in an arm slot meaning "any scope". */
+#define ARM_ANY UINT64_MAX
+
+typedef struct {
+    _Atomic uint32_t mode;
+    _Atomic uint64_t arg;
+    _Atomic uint32_t burst;                 /* >= 1 */
+    _Atomic uint64_t scope;                 /* 0 = any */
+    _Atomic uint64_t calls, hits;
+    _Atomic uint64_t rng;                   /* xorshift64 state, never 0 */
+    _Atomic uint64_t nth;                   /* NTH evaluation counter */
+    _Atomic int32_t burstLeft;
+    _Atomic uint64_t arms[INJECT_ARM_SLOTS];/* scoped one-shots; 0 empty */
+} InjectSiteState;
+
+static struct {
+    pthread_mutex_t lock;                   /* configuration only */
+    _Atomic uint32_t activeMask;            /* bit per armed site */
+    uint64_t seed;
+    InjectSiteState sites[TPU_INJECT_SITE_COUNT];
+} g_inject = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
+    "pmm.alloc",
+    "migrate.copy",
+    "msgq.publish",
+    "ici.link",
+    "rdma.completion",
+    "channel.ce",
+    "fence.timeout",
+};
+
+/* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
+static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
+    "PMM_ALLOC",
+    "MIGRATE_COPY",
+    "MSGQ_PUBLISH",
+    "ICI_LINK",
+    "RDMA_COMPLETION",
+    "CHANNEL_CE",
+    "FENCE_TIMEOUT",
+};
+
+const char *tpurmInjectSiteName(uint32_t site)
+{
+    return site < TPU_INJECT_SITE_COUNT ? g_siteNames[site] : NULL;
+}
+
+/* splitmix64: turns (seed, site) into a well-mixed nonzero PRNG state. */
+static uint64_t mix64(uint64_t x)
+{
+    x += 0x9E3779B97F4A7C15ull;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+    x = x ^ (x >> 31);
+    return x ? x : 1;
+}
+
+static void mask_set(uint32_t site)
+{
+    atomic_fetch_or_explicit(&g_inject.activeMask, 1u << site,
+                             memory_order_acq_rel);
+}
+
+static void mask_clear(uint32_t site)
+{
+    atomic_fetch_and_explicit(&g_inject.activeMask, ~(1u << site),
+                              memory_order_acq_rel);
+}
+
+void tpurmInjectSetSeed(uint64_t seed)
+{
+    pthread_mutex_lock(&g_inject.lock);
+    g_inject.seed = seed;
+    for (uint32_t s = 0; s < TPU_INJECT_SITE_COUNT; s++) {
+        atomic_store(&g_inject.sites[s].rng, mix64(seed ^ (0x51ull + s)));
+        atomic_store(&g_inject.sites[s].nth, 0);
+    }
+    pthread_mutex_unlock(&g_inject.lock);
+}
+
+TpuStatus tpurmInjectConfigure(uint32_t site, uint32_t mode, uint64_t arg,
+                               uint32_t burst, uint64_t scope)
+{
+    if (site >= TPU_INJECT_SITE_COUNT || mode > TPU_INJECT_PPM)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (mode == TPU_INJECT_NTH && arg == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    InjectSiteState *st = &g_inject.sites[site];
+    pthread_mutex_lock(&g_inject.lock);
+    atomic_store(&st->arg, arg);
+    atomic_store(&st->burst, burst ? burst : 1);
+    atomic_store(&st->scope, scope);
+    atomic_store(&st->nth, 0);
+    atomic_store(&st->burstLeft, 0);
+    if (!atomic_load(&st->rng))
+        atomic_store(&st->rng, mix64(g_inject.seed ^ (0x51ull + site)));
+    atomic_store(&st->mode, mode);
+    if (mode == TPU_INJECT_OFF) {
+        bool armed = false;
+        for (int i = 0; i < INJECT_ARM_SLOTS; i++)
+            if (atomic_load(&st->arms[i]))
+                armed = true;
+        if (!armed)
+            mask_clear(site);
+    } else {
+        mask_set(site);
+        tpuLog(TPU_LOG_INFO, "inject", "site %s armed: mode=%u arg=%llu "
+               "burst=%u scope=%llu", g_siteNames[site], mode,
+               (unsigned long long)arg, burst ? burst : 1,
+               (unsigned long long)scope);
+    }
+    pthread_mutex_unlock(&g_inject.lock);
+    return TPU_OK;
+}
+
+TpuStatus tpurmInjectArmOneShot(uint32_t site, uint64_t scope)
+{
+    if (site >= TPU_INJECT_SITE_COUNT)
+        return TPU_ERR_INVALID_ARGUMENT;
+    InjectSiteState *st = &g_inject.sites[site];
+    uint64_t key = scope ? scope : ARM_ANY;
+    for (int i = 0; i < INJECT_ARM_SLOTS; i++) {
+        uint64_t expect = 0;
+        if (atomic_compare_exchange_strong(&st->arms[i], &expect, key)) {
+            mask_set(site);
+            return TPU_OK;
+        }
+    }
+    return TPU_ERR_INSUFFICIENT_RESOURCES;
+}
+
+void tpurmInjectDisable(uint32_t site)
+{
+    if (site >= TPU_INJECT_SITE_COUNT)
+        return;
+    InjectSiteState *st = &g_inject.sites[site];
+    pthread_mutex_lock(&g_inject.lock);
+    atomic_store(&st->mode, TPU_INJECT_OFF);
+    atomic_store(&st->burstLeft, 0);
+    for (int i = 0; i < INJECT_ARM_SLOTS; i++)
+        atomic_store(&st->arms[i], 0);
+    mask_clear(site);
+    pthread_mutex_unlock(&g_inject.lock);
+}
+
+void tpurmInjectDisableAll(void)
+{
+    for (uint32_t s = 0; s < TPU_INJECT_SITE_COUNT; s++)
+        tpurmInjectDisable(s);
+}
+
+void tpurmInjectCounts(uint32_t site, uint64_t *evals, uint64_t *hits)
+{
+    if (site >= TPU_INJECT_SITE_COUNT) {
+        if (evals)
+            *evals = 0;
+        if (hits)
+            *hits = 0;
+        return;
+    }
+    if (evals)
+        *evals = atomic_load(&g_inject.sites[site].calls);
+    if (hits)
+        *hits = atomic_load(&g_inject.sites[site].hits);
+}
+
+/* ----------------------------------------------------------- evaluation */
+
+static bool inject_eval(uint32_t site, uint64_t scopeKey)
+{
+    InjectSiteState *st = &g_inject.sites[site];
+    atomic_fetch_add_explicit(&st->calls, 1, memory_order_relaxed);
+
+    /* Scoped one-shot arms (the tpurmChannelInjectError shim): consume
+     * the first slot matching this evaluation's scope. */
+    for (int i = 0; i < INJECT_ARM_SLOTS; i++) {
+        uint64_t arm = atomic_load_explicit(&st->arms[i],
+                                            memory_order_acquire);
+        if (!arm)
+            continue;
+        if (arm != ARM_ANY && scopeKey != arm)
+            continue;
+        if (atomic_compare_exchange_strong(&st->arms[i], &arm, 0)) {
+            atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
+            return true;
+        }
+    }
+
+    /* Burst tail of a previous hit fails regardless of mode. */
+    if (atomic_load_explicit(&st->burstLeft, memory_order_acquire) > 0 &&
+        atomic_fetch_sub(&st->burstLeft, 1) > 0) {
+        atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
+        return true;
+    }
+
+    uint32_t mode = atomic_load_explicit(&st->mode, memory_order_acquire);
+    if (mode == TPU_INJECT_OFF) {
+        /* Nothing armed anymore: drop the mask bit opportunistically so
+         * the fast path goes quiet again (benign if raced). */
+        bool armed = false;
+        for (int i = 0; i < INJECT_ARM_SLOTS; i++)
+            if (atomic_load(&st->arms[i]))
+                armed = true;
+        if (!armed && atomic_load(&st->burstLeft) <= 0)
+            mask_clear(site);
+        return false;
+    }
+
+    uint64_t scope = atomic_load_explicit(&st->scope, memory_order_relaxed);
+    if (scope != 0 && scopeKey != scope)
+        return false;
+
+    bool hit = false;
+    switch (mode) {
+    case TPU_INJECT_ONESHOT: {
+        uint32_t expect = TPU_INJECT_ONESHOT;
+        hit = atomic_compare_exchange_strong(&st->mode, &expect,
+                                             TPU_INJECT_OFF);
+        break;
+    }
+    case TPU_INJECT_NTH: {
+        uint64_t n = atomic_fetch_add(&st->nth, 1) + 1;
+        uint64_t arg = atomic_load(&st->arg);
+        hit = arg && (n % arg) == 0;
+        break;
+    }
+    case TPU_INJECT_PPM: {
+        /* xorshift64 step (racing threads may reuse a state — the rate
+         * is preserved; exact sequences are per-thread-interleaving). */
+        uint64_t x = atomic_load_explicit(&st->rng, memory_order_relaxed);
+        x ^= x << 13;
+        x ^= x >> 7;
+        x ^= x << 17;
+        if (!x)
+            x = 1;
+        atomic_store_explicit(&st->rng, x, memory_order_relaxed);
+        hit = (x % 1000000ull) < atomic_load(&st->arg);
+        break;
+    }
+    default:
+        break;
+    }
+    if (hit) {
+        atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
+        uint32_t burst = atomic_load(&st->burst);
+        if (burst > 1)
+            atomic_store(&st->burstLeft, (int32_t)burst - 1);
+        tpuLog(TPU_LOG_DEBUG, "inject", "site %s fired (scope=%llu)",
+               g_siteNames[site], (unsigned long long)scopeKey);
+    }
+    return hit;
+}
+
+bool tpurmInjectShouldFailScoped(uint32_t site, uint64_t scopeKey)
+{
+    /* Bounds first (the shift below would be UB for site >= 32), then
+     * the disarmed fast path: one relaxed load, nothing else —
+     * injection must not tax fault-path latency when off. */
+    if (site >= TPU_INJECT_SITE_COUNT)
+        return false;
+    uint32_t mask = atomic_load_explicit(&g_inject.activeMask,
+                                         memory_order_relaxed);
+    if (!(mask & (1u << site)))
+        return false;
+    return inject_eval(site, scopeKey);
+}
+
+bool tpurmInjectShouldFail(uint32_t site)
+{
+    return tpurmInjectShouldFailScoped(site, 0);
+}
+
+/* --------------------------------------------------------------- env */
+
+static void inject_parse_spec(uint32_t site, const char *spec)
+{
+    uint32_t mode = TPU_INJECT_OFF;
+    uint64_t arg = 0, scope = 0;
+    uint32_t burst = 1;
+
+    if (strncmp(spec, "once", 4) == 0) {
+        mode = TPU_INJECT_ONESHOT;
+    } else if (strncmp(spec, "nth=", 4) == 0) {
+        mode = TPU_INJECT_NTH;
+        arg = strtoull(spec + 4, NULL, 0);
+    } else if (strncmp(spec, "ppm=", 4) == 0) {
+        mode = TPU_INJECT_PPM;
+        arg = strtoull(spec + 4, NULL, 0);
+    } else {
+        tpuLog(TPU_LOG_WARN, "inject", "bad spec for site %s: '%s'",
+               g_siteNames[site], spec);
+        return;
+    }
+    const char *p = strchr(spec, ',');
+    while (p) {
+        p++;
+        if (strncmp(p, "burst=", 6) == 0)
+            burst = (uint32_t)strtoul(p + 6, NULL, 0);
+        else if (strncmp(p, "scope=", 6) == 0)
+            scope = strtoull(p + 6, NULL, 0);
+        p = strchr(p, ',');
+    }
+    if ((mode == TPU_INJECT_NTH && arg == 0) ||
+        tpurmInjectConfigure(site, mode, arg, burst, scope) != TPU_OK)
+        tpuLog(TPU_LOG_WARN, "inject", "bad spec for site %s: '%s'",
+               g_siteNames[site], spec);
+}
+
+void tpurmInjectReloadEnv(void)
+{
+    tpurmInjectSetSeed(tpuRegistryGet("inject_seed", 0));
+    for (uint32_t s = 0; s < TPU_INJECT_SITE_COUNT; s++) {
+        char key[64];
+        snprintf(key, sizeof(key), "TPUMEM_INJECT_%s", g_siteEnv[s]);
+        const char *spec = getenv(key);
+        if (spec && *spec)
+            inject_parse_spec(s, spec);
+    }
+}
+
+__attribute__((constructor)) static void inject_ctor(void)
+{
+    tpurmInjectReloadEnv();
+}

@@ -291,6 +291,16 @@ bool tpurmBrokerIsRemoteFd(int fd);
 void tpuRcInit(void);
 void tpuRcPostFault(TpurmChannel *ch, uint64_t rcId, uint64_t value,
                     uint32_t kind);
+/* Reset-and-replay: clear every latched channel error (recovery loops
+ * call this before re-issuing failed work); returns latches cleared.
+ * Failure attribution is unaffected (tpurmChannelWaitRange history). */
+uint32_t tpuRcRecoverAll(void);
+/* True while ch carries a latched (unreset) error. */
+bool tpurmChannelErrorPending(TpurmChannel *ch);
+/* Bounded-backoff sleep for recovery retries: attempt 0,1,2... sleeps
+ * base<<attempt microseconds (registry recover_backoff_us, default
+ * 100). */
+void tpuRecoverBackoff(uint32_t attempt);
 void tpuRcChannelRegister(TpurmChannel *ch, uint64_t rcId);
 void tpuRcChannelUnregister(TpurmChannel *ch);
 void tpuRcForEachChannel(void (*fn)(TpurmChannel *ch, uint64_t completed,

@@ -13,8 +13,10 @@
  */
 #define _GNU_SOURCE
 #include "tpurm/msgq.h"
+#include "tpurm/inject.h"
 
 #include <errno.h>
+#include <time.h>
 #include <limits.h>
 #include <linux/futex.h>
 #include <pthread.h>
@@ -90,6 +92,16 @@ void tpuMsgqDestroy(TpuMsgq *q)
 void tpuMsgqShutdown(TpuMsgq *q)
 {
     atomic_store_explicit(&q->shutdown, 1, memory_order_release);
+    /* Bump the futex words BEFORE waking: a waiter that checked the
+     * shutdown flag before this store but has not yet parked would
+     * otherwise miss the wake entirely (its expected value still
+     * matches) and sleep until the next submit — a lost-wakeup hang
+     * the chaos soak exposed in the channel destroy path.  With the
+     * bump, its FUTEX_WAIT fails value-changed and it re-checks
+     * shutdown.  The words are pure doorbell counters; no reader
+     * interprets their value. */
+    atomic_fetch_add_explicit(&q->writeSeqLow, 1, memory_order_release);
+    atomic_fetch_add_explicit(&q->completeLow, 1, memory_order_release);
     futex_wake_all(&q->writeSeqLow);
     futex_wake_all(&q->completeLow);
 }
@@ -113,6 +125,25 @@ static int msgq_submit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
         if (q->flags & TPU_MSGQ_MPSC)
             pthread_mutex_unlock(&q->txLock);
         return -ESHUTDOWN;
+    }
+
+    /* Injected publish fault.  Non-blocking producers see -EAGAIN and
+     * take their documented overflow recovery (HBM mirror: latch +
+     * whole-arena resync; RC shadow: drop + counter).  Blocking
+     * producers model retry-after-transient-failure: one bounded
+     * backoff, then the publish proceeds — counted as a recovery
+     * retry. */
+    if (tpurmInjectShouldFail(TPU_INJECT_SITE_MSGQ_PUBLISH)) {
+        if (!block) {
+            if (q->flags & TPU_MSGQ_MPSC)
+                pthread_mutex_unlock(&q->txLock);
+            return -EAGAIN;
+        }
+        extern void tpuCounterAdd(const char *name, uint64_t delta);
+        tpuCounterAdd("recover_retries", 1);
+        tpuCounterAdd("recover_msgq_retries", 1);
+        struct timespec ts = { .tv_sec = 0, .tv_nsec = 50000L };
+        nanosleep(&ts, NULL);
     }
 
     /* Back-pressure: wait for ring space.  readPtr only grows, so the

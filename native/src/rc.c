@@ -203,6 +203,18 @@ void tpuRcPostFault(TpurmChannel *ch, uint64_t rcId, uint64_t value,
         tpuCounterAdd("rc_shadow_overflows", 1);
 }
 
+/* Exponential-backoff sleep shared by every bounded recovery loop. */
+void tpuRecoverBackoff(uint32_t attempt)
+{
+    uint64_t us = tpuRegistryGet("recover_backoff_us", 100);
+    if (attempt > 10)
+        attempt = 10;
+    us <<= attempt;
+    struct timespec ts = { .tv_sec = (time_t)(us / 1000000ull),
+                           .tv_nsec = (long)(us % 1000000ull) * 1000L };
+    nanosleep(&ts, NULL);
+}
+
 /* -------------------------------------------- channel registry hooks */
 
 void tpuRcChannelRegister(TpurmChannel *ch, uint64_t rcId)
@@ -233,6 +245,43 @@ void tpuRcForEachChannel(void (*fn)(TpurmChannel *ch, uint64_t completed,
         fn(rc->ch, completed, pending, arg);
     }
     pthread_mutex_unlock(&g_rc.chLock);
+}
+
+/* Reset-and-replay entry point for the hardened recovery loops: clear
+ * latched errors on the ENGINE-OWNED channels (every device's CE pool)
+ * so the caller can re-issue (replay) its failed work.  Scope matters:
+ * engine-internal waits on the shared pool all use the failed-push
+ * history (tpurmChannelWaitRange), which a reset never erases, so
+ * clearing the pool latches is safe against concurrent engine waiters
+ * — but CLIENT-created channels keep the legacy latch contract
+ * (fault -> wait fails -> explicit ResetError), so a recovery running
+ * inside the engine must never touch them: clearing a client latch
+ * before the client's wait observes it would turn their faulted copy
+ * into silent success.  Counts one recover_rc_resets per cleared latch
+ * (the acceptance counter for RC recovery). */
+uint32_t tpuRcRecoverAll(void)
+{
+    tpuRcInit();
+    uint32_t cleared = 0;
+    uint32_t ndev = tpurmDeviceCount();
+    for (uint32_t i = 0; i < ndev; i++) {
+        TpurmDevice *dev = tpurmDeviceGet(i);
+        if (!dev)
+            continue;
+        for (uint32_t c = 0; c < dev->cePoolSize; c++) {
+            if (tpurmChannelErrorPending(dev->cePool[c])) {
+                tpurmChannelResetError(dev->cePool[c]);
+                cleared++;
+            }
+        }
+    }
+    if (cleared) {
+        tpuCounterAdd("recover_rc_resets", cleared);
+        tpuLog(TPU_LOG_WARN, "rc",
+               "reset-and-replay: cleared %u latched CE-pool error(s)",
+               cleared);
+    }
+    return cleared;
 }
 
 void tpuRcChannelUnregister(TpurmChannel *ch)

@@ -23,6 +23,7 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/inject.h"
 #include "tpurm/peermem.h"
 #include "tpurm/rdma.h"
 #include "uvm/uvm_internal.h"
@@ -329,12 +330,39 @@ TpuStatus tpuIbRegMr(uint64_t va, uint64_t size, uint32_t nicId,
      * here would lose the revocation and leave a valid-looking MR over
      * dead backing.  (Invalidation only touches valid/ctrl, both set.) */
     mr_live_add(mr);
-    TpuStatus st = client->getPages(ctx, mr);
-    if (st == TPU_OK) {
-        st = client->dmaMap(ctx, nicId, &mr->devInst, &mr->pageSize,
-                            &mr->entries, &mr->iova);
-        if (st != TPU_OK)
-            client->putPages(ctx);
+    /* Pin + DMA-map with bounded retry: a transient completion error
+     * (injected RDMA_COMPLETION fault, or a pin lost to a concurrent
+     * migration) is recovered by re-pinning after a backoff; only
+     * exhaustion surfaces to the caller as RETRY_EXHAUSTED.  Each
+     * failed attempt fully unwinds (putPages) so retries start clean. */
+    uint32_t lim = (uint32_t)tpuRegistryGet("recover_rdma_retries", 3);
+    TpuStatus st;
+    for (uint32_t attempt = 0; ; attempt++) {
+        st = TPU_OK;
+        if (tpurmInjectShouldFail(TPU_INJECT_SITE_RDMA_COMPLETION))
+            st = TPU_ERR_INVALID_STATE;     /* pin completion error */
+        if (st == TPU_OK) {
+            st = client->getPages(ctx, mr);
+            if (st == TPU_OK) {
+                st = client->dmaMap(ctx, nicId, &mr->devInst,
+                                    &mr->pageSize, &mr->entries,
+                                    &mr->iova);
+                if (st != TPU_OK)
+                    client->putPages(ctx);
+            }
+        }
+        if (st == TPU_OK)
+            break;
+        bool transient = st == TPU_ERR_INVALID_STATE ||
+                         st == TPU_ERR_STATE_IN_USE;
+        if (!transient || attempt >= lim) {
+            if (transient && attempt)
+                st = TPU_ERR_RETRY_EXHAUSTED;
+            break;
+        }
+        tpuCounterAdd("recover_retries", 1);
+        tpuCounterAdd("recover_rdma_retries", 1);
+        tpuRecoverBackoff(attempt);
     }
     if (st != TPU_OK) {
         mr_live_remove(mr);

@@ -32,7 +32,16 @@ void tpuTrackerDeinit(TpuTracker *t)
     t->entries = t->inlineEntries;
 }
 
+static TpuStatus tracker_add_range(TpuTracker *t, TpurmChannel *ch,
+                                   uint64_t minValue, uint64_t value);
+
 TpuStatus tpuTrackerAdd(TpuTracker *t, TpurmChannel *ch, uint64_t value)
+{
+    return tracker_add_range(t, ch, value, value);
+}
+
+static TpuStatus tracker_add_range(TpuTracker *t, TpurmChannel *ch,
+                                   uint64_t minValue, uint64_t value)
 {
     if (!t || !ch || value == 0)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -40,6 +49,8 @@ TpuStatus tpuTrackerAdd(TpuTracker *t, TpurmChannel *ch, uint64_t value)
         if (t->entries[i].ch == ch) {
             if (value > t->entries[i].value)
                 t->entries[i].value = value;
+            if (minValue < t->entries[i].minValue)
+                t->entries[i].minValue = minValue;
             return TPU_OK;
         }
     }
@@ -57,6 +68,7 @@ TpuStatus tpuTrackerAdd(TpuTracker *t, TpurmChannel *ch, uint64_t value)
     }
     t->entries[t->count].ch = ch;
     t->entries[t->count].value = value;
+    t->entries[t->count].minValue = minValue;
     t->count++;
     return TPU_OK;
 }
@@ -66,8 +78,9 @@ TpuStatus tpuTrackerAddTracker(TpuTracker *dst, const TpuTracker *src)
     if (!dst || !src)
         return TPU_ERR_INVALID_ARGUMENT;
     for (uint32_t i = 0; i < src->count; i++) {
-        TpuStatus st = tpuTrackerAdd(dst, src->entries[i].ch,
-                                     src->entries[i].value);
+        TpuStatus st = tracker_add_range(dst, src->entries[i].ch,
+                                         src->entries[i].minValue,
+                                         src->entries[i].value);
         if (st != TPU_OK)
             return st;
     }
@@ -97,8 +110,13 @@ TpuStatus tpuTrackerWait(TpuTracker *t)
         return TPU_ERR_INVALID_ARGUMENT;
     TpuStatus st = TPU_OK;
     for (uint32_t i = 0; i < t->count; i++) {
-        TpuStatus s = tpurmChannelWait(t->entries[i].ch,
-                                       t->entries[i].value);
+        /* Range wait: only failures within THIS tracker's window of
+         * pushes fail the wait, so a concurrent RC reset-and-replay on
+         * another thread can neither hide our failure nor leak its own
+         * into us. */
+        TpuStatus s = tpurmChannelWaitRange(t->entries[i].ch,
+                                            t->entries[i].minValue,
+                                            t->entries[i].value);
         if (s != TPU_OK && st == TPU_OK)
             st = s;      /* keep waiting the rest; report first failure */
     }

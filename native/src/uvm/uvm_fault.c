@@ -29,8 +29,10 @@
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
+#include "tpurm/inject.h"
 
 #include <errno.h>
+#include <execinfo.h>
 #include <linux/futex.h>
 #include <sched.h>
 #include <signal.h>
@@ -438,6 +440,12 @@ static TpuStatus service_one(UvmFaultEntry *e)
     if (!vs)
         return TPU_ERR_OBJECT_NOT_FOUND;
 
+    /* Injected service-loop/fence timeout: the service attempt stalls
+     * and reports a transient failure; the bounded retry in
+     * service_with_retry recovers it (or exhausts into quarantine). */
+    if (tpurmInjectShouldFail(TPU_INJECT_SITE_FENCE_TIMEOUT))
+        return TPU_ERR_INVALID_STATE;
+
     uint64_t ps = uvmPageSize();
     uint64_t addr = e->addr & ~(ps - 1);
     uint64_t end = e->addr + (e->len ? e->len : 1) - 1;
@@ -508,6 +516,32 @@ static TpuStatus service_one(UvmFaultEntry *e)
         uint64_t spanEnd = end < blockEnd ? end : blockEnd;
         uint32_t firstPage = (uint32_t)((addr - blk->start) / ps);
         uint32_t count = (uint32_t)((spanEnd - addr) / ps) + 1;
+
+        /* Fully-quarantined span: the page(s) were retired after
+         * exhausting every bounded retry — report that rather than
+         * re-servicing forever.  Only device accesses can land here
+         * (the CPU side of a quarantined page is a RW poison mapping
+         * that never faults again).  Read the cancel state under the
+         * block lock: service_cancel writes it under the same lock on
+         * another worker. */
+        if (e->source == UVM_FAULT_SRC_DEVICE) {
+            pthread_mutex_lock(&blk->lock);
+            tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "quarantine-check");
+            bool allCancelled = blk->hasCancelled;
+            for (uint32_t p = firstPage;
+                 allCancelled && p < firstPage + count; p++) {
+                if (!uvmPageMaskTest(&blk->cancelled, p))
+                    allCancelled = false;
+            }
+            tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "quarantine-check");
+            pthread_mutex_unlock(&blk->lock);
+            if (allCancelled) {
+                atomic_fetch_sub_explicit(&blk->serviceRefs, 1,
+                                          memory_order_acq_rel);
+                st = TPU_ERR_PAGE_QUARANTINED;
+                break;
+            }
+        }
 
         /* Target selection (service_fault_batch_block analog):
          *   CPU fault    -> HOST (read faults honor a device-side
@@ -679,6 +713,39 @@ static TpuStatus service_one(UvmFaultEntry *e)
     return st;
 }
 
+/* Bounded retry around one fault service (the hardened recovery core):
+ * transient failures — CE faults bubbling out of the copy layer,
+ * allocation churn, injected timeouts — get RC reset-and-replay plus an
+ * exponential backoff, up to registry "uvm_fault_retry_limit" attempts.
+ * A fault that stays fatal through every attempt reports
+ * RETRY_EXHAUSTED, which service_cancel turns into page quarantine:
+ * "pages that fault fatally more than N times are retired". */
+static bool status_transient(TpuStatus st)
+{
+    return st == TPU_ERR_INVALID_STATE || st == TPU_ERR_NO_MEMORY ||
+           st == TPU_ERR_STATE_IN_USE;
+}
+
+static TpuStatus service_with_retry(UvmFaultEntry *e)
+{
+    TpuStatus st = service_one(e);
+    if (st == TPU_OK || !status_transient(st))
+        return st;
+    uint32_t limit = (uint32_t)tpuRegistryGet("uvm_fault_retry_limit", 3);
+    uint32_t attempt = 0;
+    while (attempt < limit && status_transient(st)) {
+        tpuCounterAdd("recover_retries", 1);
+        tpuCounterAdd("recover_fault_retries", 1);
+        tpuRcRecoverAll();
+        tpuRecoverBackoff(attempt);
+        attempt++;
+        st = service_one(e);
+    }
+    if (st != TPU_OK && status_transient(st))
+        st = TPU_ERR_RETRY_EXHAUSTED;
+    return st;
+}
+
 static void replay_wake(UvmFaultEntry *e, uint64_t nowNs)
 {
     lat_record(nowNs - e->enqueueNs);
@@ -741,6 +808,14 @@ static void service_cancel(UvmFaultEntry *e)
             uvmPageMaskClear(&blk->cpuMapped, page);
             uvmPageMaskClear(&blk->devMapped, page);
             e->serviceStatus = TPU_OK;   /* waiter proceeds on poison */
+            /* Page retirement: it faulted fatally through every bounded
+             * retry (service_with_retry) and is now quarantined on the
+             * poison mapping. */
+            tpuCounterAdd("recover_page_quarantines", 1);
+            tpuLog(TPU_LOG_WARN, "uvm",
+                   "page 0x%llx quarantined (%s)",
+                   (unsigned long long)pageAddr,
+                   tpuStatusToString(TPU_ERR_PAGE_QUARANTINED));
         }
         tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "cancel");
         pthread_mutex_unlock(&blk->lock);
@@ -901,7 +976,7 @@ static void *fault_service_thread(void *arg)
                 continue;
             }
             uint64_t tSvc = uvmMonotonicNs();
-            e->serviceStatus = service_one(e);
+            e->serviceStatus = service_with_retry(e);
             win_record(g_fault.svcNs, &g_fault.svcIdx,
                        uvmMonotonicNs() - tSvc);
             if (e->serviceStatus != TPU_OK)
@@ -960,7 +1035,7 @@ static void *fault_service_thread(void *arg)
                     }
                 }
                 if (!inherited) {
-                    extra->serviceStatus = service_one(extra);
+                    extra->serviceStatus = service_with_retry(extra);
                     if (extra->serviceStatus != TPU_OK)
                         service_cancel(extra);
                 }
@@ -1106,6 +1181,21 @@ static void fault_fallback(int sig, siginfo_t *si, void *uctx)
         else
             old->sa_handler(sig);
         return;
+    }
+    /* Last gasp before the process dies on the re-fault: emit the
+     * faulting address and a native backtrace to stderr (technically
+     * async-signal-unsafe, but the alternative is dying silently —
+     * invaluable when a chaos run hits a real engine bug). */
+    {
+        char msg[96];
+        int n = snprintf(msg, sizeof(msg),
+                         "tpurm FATAL: unhandled SIGSEGV at %p\n",
+                         si ? si->si_addr : NULL);
+        if (n > 0)
+            (void)!write(2, msg, (size_t)n);
+        void *frames[32];
+        int nf = backtrace(frames, 32);
+        backtrace_symbols_fd(frames, nf, 2);
     }
     signal(sig, SIG_DFL);
 }

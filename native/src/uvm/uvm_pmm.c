@@ -14,6 +14,7 @@
  * until used.
  */
 #include "uvm_internal.h"
+#include "tpurm/inject.h"
 
 #include <stdlib.h>
 
@@ -121,6 +122,13 @@ TpuStatus uvmPmmAlloc(UvmPmm *pmm, uint64_t size, UvmPmmChunk **out)
     if (size < pmm->chunkMin || size > UVM_BLOCK_SIZE ||
         (size & (size - 1)) != 0)
         return TPU_ERR_INVALID_ARGUMENT;
+
+    /* Injected allocation fault (ECC-retired-chunk analog).  A distinct
+     * status from genuine exhaustion: eviction cannot cure a bad chunk,
+     * so the caller goes straight to tier fallback instead of churning
+     * the LRU. */
+    if (tpurmInjectShouldFail(TPU_INJECT_SITE_PMM_ALLOC))
+        return TPU_ERR_INSUFFICIENT_RESOURCES;
 
     pthread_mutex_lock(&pmm->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "pmm");

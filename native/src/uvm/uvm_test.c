@@ -8,6 +8,7 @@
  */
 #include "uvm_internal.h"
 
+#include "tpurm/inject.h"
 #include "tpurm/peermem.h"
 
 #include <pthread.h>
@@ -291,27 +292,47 @@ static TpuStatus test_lock_sanity(void)
 
 static TpuStatus test_fault_inject(UvmVaSpace *vs)
 {
-    /* Injected CE error must surface as a migrate failure, and the
-     * engine must keep working afterwards (robust-channel recovery
-     * analog: the error latches per-channel; a fresh channel would be
-     * allocated by RC in the reference — here we assert the failure is
-     * detected and reported, reference uvm_test.c:286 inject pattern. */
+    /* Hardened recovery: a ONE-SHOT injected CE error under a migrate
+     * is recovered transparently — RC reset-and-replay + bounded copy
+     * retry — so the client sees success, data stays intact, and the
+     * recovery counters record what happened.  With retries disabled
+     * (registry recover_copy_retries=0) the failure surfaces to the
+     * caller, the legacy contract (reference uvm_test.c:286 inject
+     * pattern). */
     void *ptr;
     CHECK(uvmMemAlloc(vs, UVM_BLOCK_SIZE, &ptr) == TPU_OK);
     memset(ptr, 0x5A, UVM_BLOCK_SIZE);
 
     TpurmDevice *dev = tpurmDeviceGet(0);
     CHECK(dev != NULL);
-    tpurmChannelInjectError(dev->ce);
     UvmLocation hbm = { UVM_TIER_HBM, 0 };
-    TpuStatus st = uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, hbm, 0);
-    CHECK(st != TPU_OK);
+    UvmLocation host = { UVM_TIER_HOST, 0 };
 
-    /* RC recovery: reset the channel, then the same migrate succeeds. */
-    tpurmChannelResetError(dev->ce);
+    uint64_t retriesBefore = tpurmCounterGet("recover_retries");
+    uint64_t resetsBefore = tpurmCounterGet("recover_rc_resets");
+    tpurmChannelInjectError(dev->ce);
     CHECK(uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, hbm, 0) == TPU_OK);
+    CHECK(tpurmCounterGet("recover_retries") > retriesBefore);
+    CHECK(tpurmCounterGet("recover_rc_resets") > resetsBefore);
     volatile uint8_t *bytes = ptr;
     CHECK(bytes[17] == 0x5A);   /* faults back from HBM intact */
+
+    /* Retries off: the injected failure is the caller's problem. */
+    CHECK(uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, hbm, 0) == TPU_OK);
+    setenv("TPUMEM_RECOVER_COPY_RETRIES", "0", 1);
+    setenv("TPUMEM_UVM_FAULT_RETRY_LIMIT", "0", 1);
+    tpuRegistryBump();
+    tpurmChannelInjectError(dev->ce);
+    TpuStatus st = uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, host, 0);
+    CHECK(st != TPU_OK);
+    unsetenv("TPUMEM_RECOVER_COPY_RETRIES");
+    unsetenv("TPUMEM_UVM_FAULT_RETRY_LIMIT");
+    tpuRegistryBump();
+
+    /* Explicit RC reset, then the same migrate succeeds losslessly. */
+    tpurmChannelResetError(dev->ce);
+    CHECK(uvmMigrate(vs, ptr, UVM_BLOCK_SIZE, host, 0) == TPU_OK);
+    CHECK(bytes[17] == 0x5A);
 
     CHECK(uvmMemFree(vs, ptr) == TPU_OK);
     return TPU_OK;
@@ -545,17 +566,23 @@ static TpuStatus test_replay_cancel(UvmVaSpace *vs)
     UvmLocation hbm = { UVM_TIER_HBM, 0 };
     CHECK(uvmMigrate(vs, p, UVM_BLOCK_SIZE, hbm, 0) == TPU_OK);
 
-    /* Injected CE error makes the copy-back fail while the CPU read is
-     * being serviced. */
+    /* A PERSISTENT CE fault (framework channel-CE site, every push)
+     * makes the copy-back fail through every bounded retry while the
+     * CPU read is being serviced: retry exhaustion quarantines the
+     * page (retirement after N fatal faults). */
     TpurmDevice *dev = tpurmDeviceGet(0);
     CHECK(dev != NULL);
-    tpurmChannelInjectError(dev->ce);
+    uint64_t quarantinesBefore = tpurmCounterGet("recover_page_quarantines");
+    CHECK(tpurmInjectConfigure(TPU_INJECT_SITE_CHANNEL_CE, TPU_INJECT_NTH,
+                               1, 1, 0) == TPU_OK);
     volatile uint8_t *b = p;
     uint8_t got = b[3];                    /* survives via poison page */
     (void)got;
-    tpurmChannelResetError(dev->ce);
+    tpurmInjectDisable(TPU_INJECT_SITE_CHANNEL_CE);
+    tpuRcRecoverAll();                     /* clear chaos-latched errors */
 
     CHECK(tpurmCounterGet("uvm_fault_cancels") > cancelsBefore);
+    CHECK(tpurmCounterGet("recover_page_quarantines") > quarantinesBefore);
     UvmResidencyInfo info;
     CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
     CHECK(info.cancelled);

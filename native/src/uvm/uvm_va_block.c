@@ -22,6 +22,7 @@
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
+#include "tpurm/inject.h"
 
 #include <sched.h>
 #include <stdlib.h>
@@ -315,6 +316,12 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                                const UvmPageMask *pages, uint32_t first,
                                uint32_t count, uint64_t *bytesOut)
 {
+    /* Injected migration-copy fault: fail BEFORE any byte moves or any
+     * mask commits, so the retry in make-resident re-runs the whole
+     * pass losslessly. */
+    if (tpurmInjectShouldFail(TPU_INJECT_SITE_MIGRATE_COPY))
+        return TPU_ERR_INVALID_STATE;
+
     uint64_t ps = uvmPageSize();
     TpuCeStriper striper;
     TpuTracker tracker;
@@ -645,6 +652,18 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
     if (dst.tier == UVM_TIER_HBM)
         blk->hbmDevInst = dst.devInst;
 
+    /* Hardened recovery state: bounded copy retries (transient CE
+     * faults recover via RC reset-and-replay + re-copy) and one-way
+     * HBM/CXL -> HOST tier fallback when the aperture cannot deliver
+     * backing (injected allocation fault or genuine exhaustion).  The
+     * host tier is always viable — device traffic to host-resident
+     * pages flows through CE host pointers — so degraded placement
+     * beats a failed service. */
+    uint32_t copyAttempts = 0;
+    uint32_t copyLimit = (uint32_t)tpuRegistryGet("recover_copy_retries",
+                                                  3);
+    bool fallbackEnabled = tpuRegistryGet("recover_tier_fallback", 1) != 0;
+
     for (int retry = 0; ; retry++) {
         /* Pages not yet resident in dst (word ops: span & ~resident &
          * ~cancelled). */
@@ -661,22 +680,68 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             break;
 
         TpuStatus st = TPU_OK;
+        bool wantFallback = false;
         if (arena)
             st = block_alloc_backing(blk, arena, firstPage, count);
-        if (st == TPU_ERR_NO_MEMORY) {
-            if (retry >= 32) {
+        if (st == TPU_ERR_INSUFFICIENT_RESOURCES && arena) {
+            /* Injected/ECC allocation fault: eviction cannot cure a bad
+             * chunk — fall back to the host tier directly.  With
+             * fallback disabled the DISTINCT status surfaces (the
+             * caller must not confuse a bad chunk with mere
+             * exhaustion and start evicting). */
+            if (!fallbackEnabled) {
                 tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
                 pthread_mutex_unlock(&blk->lock);
-                return TPU_ERR_NO_MEMORY;
-            }
-            /* Drop the block lock around eviction (see header note). */
-            tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
-            pthread_mutex_unlock(&blk->lock);
-            st = arena_evict_some(arena, blk);
-            if (st != TPU_OK)
                 return st;
-            pthread_mutex_lock(&blk->lock);
-            tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block");
+            }
+            wantFallback = true;
+        } else if (st == TPU_ERR_NO_MEMORY) {
+            if (retry >= 32) {
+                /* Eviction churned 32 rounds without freeing enough:
+                 * degrade to host rather than failing the service. */
+                if (!fallbackEnabled) {
+                    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
+                    pthread_mutex_unlock(&blk->lock);
+                    return TPU_ERR_NO_MEMORY;
+                }
+                wantFallback = true;
+            } else {
+                /* Drop the block lock around eviction (see header note). */
+                tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
+                pthread_mutex_unlock(&blk->lock);
+                st = arena_evict_some(arena, blk);
+                if (st == TPU_ERR_INVALID_STATE &&
+                    copyAttempts < copyLimit) {
+                    /* Victim's copy-back hit a (possibly injected) CE
+                     * fault: reset-and-replay, then retry the alloc. */
+                    copyAttempts++;
+                    tpuCounterAdd("recover_retries", 1);
+                    tpuCounterAdd("recover_copy_retries", 1);
+                    tpuRcRecoverAll();
+                    tpuRecoverBackoff(copyAttempts - 1);
+                    st = TPU_OK;
+                } else if (st == TPU_ERR_NO_MEMORY && fallbackEnabled) {
+                    wantFallback = true;
+                    st = TPU_OK;
+                } else if (st != TPU_OK) {
+                    return st;
+                }
+                pthread_mutex_lock(&blk->lock);
+                tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block");
+                if (!wantFallback)
+                    continue;
+            }
+        }
+        if (wantFallback) {
+            tpuCounterAdd("recover_tier_fallbacks", 1);
+            tpuLog(TPU_LOG_WARN, "uvm",
+                   "tier fallback: block %llx pages [%u,+%u) %s -> HOST "
+                   "(aperture allocation failed)",
+                   (unsigned long long)blk->start, firstPage, count,
+                   dst.tier == UVM_TIER_HBM ? "HBM" : "CXL");
+            dst.tier = UVM_TIER_HOST;
+            dst.devInst = 0;
+            arena = NULL;
             continue;
         }
         if (st != TPU_OK) {
@@ -701,8 +766,29 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         uint64_t bytes = 0;
         st = block_copy_in(blk, dst.tier, &needed, firstPage, count, &bytes);
         if (st != TPU_OK) {
+            /* Transient copy fault (CE error, chip-readback stall,
+             * injection): nothing was committed — masks and user PTEs
+             * are untouched and sources are intact — so RC
+             * reset-and-replay plus a bounded backoff retry recovers
+             * losslessly.  Exhaustion surfaces as RETRY_EXHAUSTED so
+             * the fault layer can quarantine the page instead of
+             * spinning. */
+            if (st == TPU_ERR_INVALID_STATE && copyAttempts < copyLimit) {
+                copyAttempts++;
+                tpuCounterAdd("recover_retries", 1);
+                tpuCounterAdd("recover_copy_retries", 1);
+                tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
+                pthread_mutex_unlock(&blk->lock);
+                tpuRcRecoverAll();
+                tpuRecoverBackoff(copyAttempts - 1);
+                pthread_mutex_lock(&blk->lock);
+                tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block");
+                continue;
+            }
             tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
             pthread_mutex_unlock(&blk->lock);
+            if (st == TPU_ERR_INVALID_STATE && copyAttempts)
+                st = TPU_ERR_RETRY_EXHAUSTED;
             return st;
         }
         /* Transfer accounting with the reference's counter-scope split

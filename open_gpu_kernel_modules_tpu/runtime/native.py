@@ -233,6 +233,10 @@ def load() -> ctypes.CDLL:
     lib.tpurmChannelCompletedValue.argtypes = [ctypes.c_void_p]
     lib.tpurmChannelCompletedValue.restype = ctypes.c_uint64
     lib.tpurmChannelInjectError.argtypes = [ctypes.c_void_p]
+    lib.tpurmChannelResetError.argtypes = [ctypes.c_void_p]
+    lib.tpurmChannelWaitRange.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                          ctypes.c_uint64]
+    lib.tpurmChannelWaitRange.restype = ctypes.c_uint32
     lib.tpurmCounterGet.argtypes = [ctypes.c_char_p]
     lib.tpurmCounterGet.restype = ctypes.c_uint64
     lib.tpurmJournalDump.argtypes = [ctypes.c_char_p, ctypes.c_size_t]

@@ -12,6 +12,7 @@ in-process library the serving stack calls directly (SURVEY.md §1: TPU
 devices are driven from userspace).
 """
 
+from . import inject  # noqa: F401  (fault injection + recovery counters)
 from .managed import (  # noqa: F401
     Tier,
     VaSpace,

@@ -1,0 +1,162 @@
+"""Fault injection + recovery-counter surface (native/src/inject.c).
+
+Python face of the seeded, site-addressable fault-injection framework:
+arm named engine sites (PMM allocation, migration copies, msgq publish,
+ICI links, RDMA completions, channel CE pushes, fault-service timeouts)
+with one-shot / every-Nth / probabilistic modes, then read back the
+recovery counters that the hardened engine paths bump while they absorb
+the faults (bounded retry, tier fallback, page quarantine, channel RC
+reset-and-replay, ICI retrain).
+
+Deterministic: ``set_seed`` reseeds every site PRNG, so a fixed seed
+replays the same hit sequence (per-site, by evaluation index).
+Everything can also be armed from the environment before the library
+loads: ``TPUMEM_INJECT_SEED`` and
+``TPUMEM_INJECT_<SITE>=once|nth=N|ppm=P[,burst=B][,scope=S]``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+from typing import Dict, Tuple
+
+from ..runtime import native
+
+
+class Site(enum.IntEnum):
+    """Injection sites (inject.h TpuInjectSite)."""
+
+    PMM_ALLOC = 0        # PMM chunk allocation (HBM/CXL backing)
+    MIGRATE_COPY = 1     # block migration copy pass
+    MSGQ_PUBLISH = 2     # msgq submit (mirror / RC shadow / GPFIFO)
+    ICI_LINK = 3         # ICI link flap / retrain failure
+    RDMA_COMPLETION = 4  # MR pin/map completion error
+    CHANNEL_CE = 5       # channel CE push fault
+    FENCE_TIMEOUT = 6    # fault-service / fence timeout
+
+
+class Mode(enum.IntEnum):
+    OFF = 0
+    ONESHOT = 1
+    NTH = 2              # arg = N: every Nth evaluation
+    PPM = 3              # arg = parts-per-million probability
+
+
+#: The five acceptance counters: every hardened recovery action the
+#: engine can take, each counted where it happens.
+RECOVERY_COUNTERS = (
+    "recover_retries",           # bounded retries (copy/fault/msgq/...)
+    "recover_tier_fallbacks",    # HBM/CXL -> HOST placement fallback
+    "recover_page_quarantines",  # fatally-faulting page retired
+    "recover_rc_resets",         # channel RC reset-and-replay
+    "recover_link_retrains",     # ICI link retrained after a flap
+)
+
+#: Finer-grained recovery/diagnostic counters (subset by subsystem).
+DETAIL_COUNTERS = (
+    "recover_copy_retries",
+    "recover_fault_retries",
+    "recover_msgq_retries",
+    "recover_rdma_retries",
+    "recover_ici_retries",
+    "ici_link_flaps",
+    "ici_degraded_routes",
+    "ici_retrain_failures",
+    "uvm_fault_cancels",
+    "rc_nonreplayable_faults",
+)
+
+_bound = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is not None:
+        return _bound
+    lib = native.load()
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    lib.tpurmInjectSetSeed.argtypes = [u64]
+    lib.tpurmInjectSetSeed.restype = None
+    lib.tpurmInjectConfigure.argtypes = [u32, u32, u64, u32, u64]
+    lib.tpurmInjectConfigure.restype = u32
+    lib.tpurmInjectArmOneShot.argtypes = [u32, u64]
+    lib.tpurmInjectArmOneShot.restype = u32
+    lib.tpurmInjectDisable.argtypes = [u32]
+    lib.tpurmInjectDisable.restype = None
+    lib.tpurmInjectDisableAll.argtypes = []
+    lib.tpurmInjectDisableAll.restype = None
+    lib.tpurmInjectReloadEnv.argtypes = []
+    lib.tpurmInjectReloadEnv.restype = None
+    lib.tpurmInjectCounts.argtypes = [u32, ctypes.POINTER(u64),
+                                      ctypes.POINTER(u64)]
+    lib.tpurmInjectCounts.restype = None
+    lib.tpurmInjectSiteName.argtypes = [u32]
+    lib.tpurmInjectSiteName.restype = ctypes.c_char_p
+    _bound = lib
+    return lib
+
+
+def _check(status: int, what: str) -> None:
+    if status != 0:
+        raise native.RmError(status, what)
+
+
+def set_seed(seed: int) -> None:
+    """Reseed every site PRNG (same seed => same hit sequence)."""
+    _lib().tpurmInjectSetSeed(seed)
+
+
+def enable(site: Site, mode: Mode, arg: int = 0, burst: int = 1,
+           scope: int = 0) -> None:
+    """Arm a site.  ``burst`` makes each hit fail that many consecutive
+    evaluations (defeats bounded retry, driving quarantine paths);
+    ``scope`` restricts hits to evaluations carrying that object key."""
+    _check(_lib().tpurmInjectConfigure(int(site), int(mode), arg, burst,
+                                       scope), "tpurmInjectConfigure")
+
+
+def arm_oneshot(site: Site, scope: int = 0) -> None:
+    """Queue one scoped one-shot without disturbing the site's mode."""
+    _check(_lib().tpurmInjectArmOneShot(int(site), scope),
+           "tpurmInjectArmOneShot")
+
+
+def disable(site: Site) -> None:
+    _lib().tpurmInjectDisable(int(site))
+
+
+def disable_all() -> None:
+    _lib().tpurmInjectDisableAll()
+
+
+def reload_env() -> None:
+    """Re-parse TPUMEM_INJECT_* from the environment."""
+    _lib().tpurmInjectReloadEnv()
+
+
+def site_name(site: Site) -> str:
+    return _lib().tpurmInjectSiteName(int(site)).decode()
+
+
+def counts(site: Site) -> Tuple[int, int]:
+    """(evaluations, hits) for a site since process start."""
+    evals, hits = ctypes.c_uint64(), ctypes.c_uint64()
+    _lib().tpurmInjectCounts(int(site), ctypes.byref(evals),
+                             ctypes.byref(hits))
+    return evals.value, hits.value
+
+
+def stats() -> Dict[str, Tuple[int, int]]:
+    """Per-site (evaluations, hits) keyed by canonical site name."""
+    return {site_name(s): counts(s) for s in Site}
+
+
+def recovery_counters(detail: bool = False) -> Dict[str, int]:
+    """Read the recovery counters (0 for counters never bumped).
+
+    The five RECOVERY_COUNTERS cover every hardened recovery action;
+    ``detail=True`` adds the per-subsystem breakdown."""
+    lib = _lib()
+    names = RECOVERY_COUNTERS + (DETAIL_COUNTERS if detail else ())
+    return {n: lib.tpurmCounterGet(n.encode()) for n in names}

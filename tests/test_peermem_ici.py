@@ -73,3 +73,72 @@ def test_ici_and_p2p_caps():
     caps = out["p2p_caps"]
     assert caps & 0x4                        # ICI supported
     assert caps & 0x10                       # CXL supported (fork delta)
+
+
+_INJECT_SCRIPT = r"""
+import json
+import sys
+sys.path.insert(0, %(repo)r)
+
+import ctypes
+
+from open_gpu_kernel_modules_tpu.runtime import ici, native
+from open_gpu_kernel_modules_tpu.uvm import inject as inj
+
+out = {}
+lib = native.load()
+d0 = lib.tpurmDeviceGet(0)
+d1 = lib.tpurmDeviceGet(1)
+base0 = lib.tpurmDeviceHbmBase(d0)
+base1 = lib.tpurmDeviceHbmBase(d1)
+ctypes.memset(base0, 0x5C, 8192)
+ctypes.memset(base1, 0, 8192)
+
+inj.set_seed(7)
+with ici.PeerAperture(0, 1) as ap:
+    # One-shot link-flap injection: the copy's route drops mid-flight;
+    # the 4-ring detours (degraded routing) and the copy still lands.
+    inj.enable(inj.Site.ICI_LINK, inj.Mode.ONESHOT)
+    ap.write(0, 0, 4096)
+    inj.disable(inj.Site.ICI_LINK)
+
+    # The direct 0<->1 link was driven to FAILED by the injection.
+    states = [int(ici.link_info(0, l).state) for l in
+              range(ici.link_count(0))]
+    out["failed_after_flap"] = int(ici.LinkState.FAILED) in states
+    out["flaps"] = inj.recovery_counters(detail=True)["ici_link_flaps"]
+    out["byte_after_flap"] = ctypes.cast(
+        base1, ctypes.POINTER(ctypes.c_ubyte))[100]
+
+    # Traffic recovers: the next copy lazily retrains the flapped link
+    # back to ACTIVE and the direct route returns.
+    ap.write(4096, 4096, 4096)
+    out["retrains"] = inj.recovery_counters()["recover_link_retrains"]
+    out["states_after_retrain"] = [int(ici.link_info(0, l).state)
+                                   for l in range(ici.link_count(0))]
+    out["hops_after_retrain"] = ici.route_hops(0, 1)
+    out["byte_after_retrain"] = ctypes.cast(
+        base1, ctypes.POINTER(ctypes.c_ubyte))[4096 + 100]
+
+print(json.dumps(out))
+"""
+
+
+def test_ici_injected_flap_recovers():
+    """Satellite: drive a link to LinkState.FAILED via the injection
+    framework mid-copy and assert traffic recovers (detour first, then
+    lazy retrain restores the direct route)."""
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    script = _INJECT_SCRIPT % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["flaps"] >= 1                  # injection flapped a link
+    assert out["failed_after_flap"]           # ... to LinkState.FAILED
+    assert out["byte_after_flap"] == 0x5C     # copy survived via detour
+    assert out["retrains"] >= 1               # lazy retrain recovered it
+    assert all(s == 2 for s in out["states_after_retrain"])  # ACTIVE
+    assert out["hops_after_retrain"] == 1     # direct route restored
+    assert out["byte_after_retrain"] == 0x5C  # post-recovery traffic OK
