@@ -155,9 +155,15 @@ def measure_fault_latency() -> dict:
             for b in bufs:
                 b.view()[:] = 0xA5
             st = uvm.fault_stats()
+            # p99 straight off the log-linear histogram (the sampled
+            # windows this replaced could not answer tail quantiles).
+            from open_gpu_kernel_modules_tpu import utils as _utils
             trials.append({
                 "p50_us": round(st.service_ns_p50 / 1e3, 1),
                 "p95_us": round(st.service_ns_p95 / 1e3, 1),
+                "p99_us": round(
+                    _utils.trace_quantile_ns("fault.latency", 0.99) / 1e3,
+                    1),
                 "wake_p50_us": round(st.wake_ns_p50 / 1e3, 1),
                 "svc_p50_us": round(st.svc_one_ns_p50 / 1e3, 1),
             })
@@ -167,6 +173,7 @@ def measure_fault_latency() -> dict:
     return {
         "fault_p50_us": best["p50_us"],
         "fault_p95_us": best["p95_us"],
+        "fault_p99_us": best.get("p99_us", 0.0),
         "fault_wake_p50_us": best["wake_p50_us"],
         "fault_svc_p50_us": best["svc_p50_us"],
         "fault_latency_trials": trials,
@@ -912,8 +919,43 @@ def _prior_round_latencies() -> dict:
         return {}
 
 
+def _metrics_snapshot() -> dict:
+    """One scrape of the tputrace metrics machinery: fault-latency
+    quantiles straight from the log-linear histograms plus select
+    counters, as a BENCH-recordable dict.  The --metrics-snapshot flag
+    takes one before and one after the run so a round's record shows
+    exactly what the workload added."""
+    from open_gpu_kernel_modules_tpu import utils
+
+    out = {}
+    for site, tag in (("fault.latency", "fault"),
+                      ("fault.wake", "wake"),
+                      ("fault.service", "svc")):
+        n = utils.trace_hist_count(site)
+        out[f"{tag}_count"] = n
+        if n:
+            for q, qt in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                out[f"{tag}_{qt}_us"] = round(
+                    utils.trace_quantile_ns(site, q) / 1e3, 1)
+    for name in ("uvm_fault_batches", "channel_pushes",
+                 "recover_retries"):
+        out[name] = utils.counter(name)
+    out["metrics_node_bytes"] = len(utils.metrics_text())
+    return out
+
+
 def main() -> None:
+    import sys
+
     skip_jax = os.environ.get("BENCH_SKIP_JAX") == "1"
+    metrics_snap = ("--metrics-snapshot" in sys.argv[1:] or
+                    os.environ.get("BENCH_METRICS_SNAPSHOT") == "1")
+    snap_before = None
+    if metrics_snap:
+        try:
+            snap_before = _metrics_snapshot()
+        except Exception:
+            metrics_snap = False
 
     # Fault-latency probe FIRST — before _on_tpu() initializes the jax
     # backend in-process (its threads add scheduler delay on a 1-CPU
@@ -1046,6 +1088,12 @@ def main() -> None:
     if "prev_fault_p95_us" in extra and extra["prev_fault_p95_us"]:
         extra["fault_p95_vs_prev"] = round(
             extra["fault_p95_us"] / extra["prev_fault_p95_us"], 2)
+    if metrics_snap:
+        try:
+            extra["metrics_before"] = snap_before
+            extra["metrics_after"] = _metrics_snapshot()
+        except Exception:
+            pass
 
     print(json.dumps({
         "metric": "oversub_4x_fault_migrate_bandwidth",

@@ -1,0 +1,146 @@
+/*
+ * tputrace — unified cross-engine tracing + metrics.
+ *
+ * One observability spine for every engine (fault, migrate, pmm, tier,
+ * channel, rc, ici, rdma, msgq) replacing the three disconnected
+ * surfaces the port inherited (journal ring, tools event queues, fixed
+ * two-point percentile windows):
+ *
+ *   span rings  — per-THREAD lock-free rings of fixed 64-byte records.
+ *                 Spans carry (site, start ns, duration ns, object id,
+ *                 bytes); instants (duration 0) mark point events:
+ *                 every injected fault and every hardened-recovery
+ *                 action from the fault-injection framework.  Rings
+ *                 overwrite oldest (flight-recorder); overwritten and
+ *                 table-full records are counted, never silently lost.
+ *   histograms  — per-site log-linear HDR-style latency histograms
+ *                 (128 sub-buckets per power of two: <= 0.8% relative
+ *                 error over the full uint64 range).  The fault
+ *                 engine's UvmFaultStats percentiles derive from these
+ *                 (ABI unchanged); all other sites accumulate while
+ *                 tracing is armed.
+ *
+ * Export three ways:
+ *   - tpurmTraceExportJson: Chrome trace-event / Perfetto JSON
+ *     ({"traceEvents":[...]}, "X" spans + "i" instants, ts/dur in us);
+ *   - /proc/driver/tpurm/metrics: Prometheus text exposition (named
+ *     counters + histogram buckets), served through procfs.c so plain
+ *     `cat` works under the LD_PRELOAD shim;
+ *   - Python: utils.trace_start/stop/export, utils.span() app spans.
+ *
+ * Fast-path discipline (same as inject.h): with tracing DISARMED a
+ * site costs ONE relaxed atomic load (tpurmTraceBegin returns 0) — no
+ * lock, no allocation, no histogram traffic.  Timestamps share
+ * tpuNowNs() with the journal and injection framework so all three
+ * timelines are directly comparable.
+ *
+ * Environment (parsed at library load):
+ *   TPUMEM_TRACE=1            arm tracing at load
+ *   TPUMEM_TRACE_RING=<N>     per-thread ring capacity in records
+ *                             (rounded up to a power of two; default
+ *                             8192, 64 B per record)
+ */
+#ifndef TPURM_TRACE_H
+#define TPURM_TRACE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Trace sites (keep tpurmTraceSiteName / site table in trace.c in
+ * sync).  Span sites come first; the tail block is instant-only. */
+typedef enum {
+    TPU_TRACE_FAULT_LATENCY = 0, /* enqueue -> replay (headline)       */
+    TPU_TRACE_FAULT_WAKE,        /* enqueue -> batch pop               */
+    TPU_TRACE_FAULT_SERVICE,     /* one service_one call               */
+    TPU_TRACE_FAULT_BATCH,       /* whole service-loop batch           */
+    TPU_TRACE_MIGRATE,           /* explicit UVM_MIGRATE call          */
+    TPU_TRACE_MIGRATE_COPY,      /* block residency copy pass          */
+    TPU_TRACE_PMM_ALLOC,         /* PMM chunk allocation               */
+    TPU_TRACE_EVICT,             /* block eviction                     */
+    TPU_TRACE_CHANNEL_PUSH,      /* push submit (begin -> GPFIFO)      */
+    TPU_TRACE_CHANNEL_FENCE,     /* tracker-value wait                 */
+    TPU_TRACE_ICI_COPY,          /* ICI peer copy (direct or detour)   */
+    TPU_TRACE_ICI_RETRAIN,       /* soft-link retrain pass             */
+    TPU_TRACE_RDMA_PIN,          /* MR pin + DMA map                   */
+    TPU_TRACE_MSGQ_PUBLISH,      /* msgq submit                        */
+    TPU_TRACE_APP,               /* application span (Python utils.span) */
+    /* Instant-only sites. */
+    TPU_TRACE_INJECT_HIT,        /* injection framework fired          */
+    TPU_TRACE_RECOVER_RETRY,     /* bounded-backoff retry taken        */
+    TPU_TRACE_RECOVER_TIER_FALLBACK,
+    TPU_TRACE_RECOVER_QUARANTINE,
+    TPU_TRACE_RECOVER_RC_RESET,
+    TPU_TRACE_RECOVER_RETRAIN,
+    TPU_TRACE_SITE_COUNT
+} TpuTraceSite;
+
+/* ---------------------------------------------------------- arm control */
+
+void tpurmTraceStart(void);
+void tpurmTraceStop(void);
+/* Clear every ring, the drop accounting, and every SITE histogram.
+ * (The three fault-stats histograms also reset — they are the
+ * FAULT_LATENCY/WAKE/SERVICE sites; uvmFaultStatsResetWindows resets
+ * only those three.) */
+void tpurmTraceReset(void);
+int  tpurmTraceIsArmed(void);
+
+/* --------------------------------------------------------------- emission */
+
+/* Begin a span: returns tpuNowNs(), or 0 when tracing is disarmed (the
+ * single-relaxed-load fast path).  Pass the token to tpurmTraceEnd,
+ * which is a no-op for token 0. */
+uint64_t tpurmTraceBegin(void);
+void tpurmTraceEnd(uint32_t site, uint64_t t0, uint64_t obj,
+                   uint64_t bytes);
+/* Span with explicit endpoints (cross-thread phases, e.g. fault wake:
+ * enqueue happened on the faulting thread, pop on the worker).  Ring
+ * record + site histogram, armed check inside. */
+void tpurmTraceSpanAt(uint32_t site, uint64_t t0, uint64_t t1,
+                      uint64_t obj, uint64_t bytes);
+/* Ring-only span record (no histogram) — for sites whose histogram is
+ * fed separately (the always-on fault-stats windows). */
+void tpurmTraceEventAt(uint32_t site, uint64_t t0, uint64_t t1,
+                       uint64_t obj, uint64_t bytes);
+/* Instant event ("i" phase).  The labeled variant overrides the
+ * rendered name (app spans, injection site names). */
+void tpurmTraceInstant(uint32_t site, uint64_t obj, uint64_t bytes);
+void tpurmTraceInstantLabel(uint32_t site, uint64_t obj, uint64_t bytes,
+                            const char *label);
+/* Application span (Python utils.span): t0 from tpurmTraceNowNs(). */
+void tpurmTraceAppSpan(const char *name, uint64_t t0, uint64_t obj,
+                       uint64_t bytes);
+uint64_t tpurmTraceNowNs(void);
+
+/* ----------------------------------------------------------------- export */
+
+/* Chrome trace-event JSON into buf; always a complete, parseable
+ * document (truncation drops whole trailing events, counted in
+ * "args.exportDropped" on the final metadata event).  Returns bytes
+ * written (excluding NUL). */
+size_t tpurmTraceExportJson(char *buf, size_t bufSize);
+
+/* Prometheus text exposition (the /proc/driver/tpurm/metrics body):
+ * every named counter + every non-empty site histogram. */
+size_t tpurmTraceRenderProm(char *buf, size_t bufSize);
+
+/* Ring accounting: records ever emitted, records lost (overwritten by
+ * ring wrap or dropped with no ring slot), live per-thread rings. */
+void tpurmTraceStats(uint64_t *outRecorded, uint64_t *outDropped,
+                     uint32_t *outRings);
+
+/* Site histogram readout: q in [0,1]; 0 when the histogram is empty. */
+uint64_t tpurmTraceHistQuantileNs(uint32_t site, double q);
+uint64_t tpurmTraceHistCountNs(uint32_t site);
+
+const char *tpurmTraceSiteName(uint32_t site);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_TRACE_H */

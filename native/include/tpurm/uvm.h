@@ -377,7 +377,11 @@ typedef struct {
     uint64_t batches;          /* service-loop batches */
     uint64_t migratedBytes;    /* bytes moved by fault servicing */
     uint64_t evictions;        /* block evictions (oversubscription) */
-    uint64_t serviceNsP50;     /* latest-window service latency percentiles */
+    /* Service-latency percentiles, derived from the tputrace
+     * log-linear histograms (trace.h; ~1% relative error, full
+     * history — formerly a bounded 4096-sample window).  Struct
+     * layout is unchanged: histogram adoption is ABI-compatible. */
+    uint64_t serviceNsP50;
     uint64_t serviceNsP95;
     /* Phase decomposition of the headline latency: wake = enqueue ->
      * batch pop (futex + scheduler), svcOne = one service_one call
@@ -388,7 +392,8 @@ typedef struct {
     uint64_t svcOneNsP95;
 } UvmFaultStats;
 void uvmFaultStatsGet(UvmFaultStats *out);
-/* Restart the percentile sampling windows (not the counters). */
+/* Restart the percentile histograms (not the counters): resets the
+ * three fault-latency trace histograms only. */
 void uvmFaultStatsResetWindows(void);
 
 /* Pageable memory (HMM analog, reference uvm_hmm.c): adopt an existing

@@ -33,6 +33,7 @@
 #include "internal.h"
 #include "tpurm/inject.h"
 #include "tpurm/msgq.h"
+#include "tpurm/trace.h"
 
 #include <stdatomic.h>
 #include <stdlib.h>
@@ -391,6 +392,7 @@ uint64_t tpuPushEnd(TpuPush *p, TpuTracker *t)
     if (!p || !p->ch)
         return 0;
     TpurmChannel *ch = p->ch;
+    uint64_t tSpan = tpurmTraceBegin();
 
     pthread_mutex_lock(&ch->lock);
     tpuLockTrackAcquire(TPU_LOCK_CHANNEL, "push-end");
@@ -424,12 +426,20 @@ uint64_t tpuPushEnd(TpuPush *p, TpuTracker *t)
         .bytes = p->nsegs,
         .pbEnd = p->pbEndOffset,
     };
+    /* Sum BEFORE submit: once the executor retires the push its
+     * pushbuffer chunk recycles and another producer may rewrite it. */
+    uint64_t pushBytes = 0;
+    if (tSpan)
+        for (uint32_t i = 0; i < p->nsegs; i++)
+            pushBytes += ((const CopySeg *)p->segs)[i].bytes;
     uint64_t value = 0;
     if (tpuMsgqSubmit(ch->fifo, &cmd, 1, &value) != 0) {
         tpuPushAbort(p);
         return 0;
     }
     tpuCounterAdd("channel_pushes", 1);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_CHANNEL_PUSH, tSpan, ch->rcId, pushBytes);
 
     p->ch = NULL;
     if (t && tpuTrackerAdd(t, ch, value) != TPU_OK)
@@ -470,10 +480,13 @@ TpuStatus tpurmChannelWait(TpurmChannel *ch, uint64_t value)
 {
     if (!ch)
         return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t tSpan = tpurmTraceBegin();
     /* The executor always drains (even through shutdown), so waiting on
      * the sequence either succeeds or the queue was shut down with the
      * value never reached. */
     bool reached = value == 0 || tpuMsgqWaitSeq(ch->fifo, value);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_CHANNEL_FENCE, tSpan, ch->rcId, value);
     if (atomic_load_explicit(&ch->error, memory_order_acquire))
         return TPU_ERR_INVALID_STATE;
     return reached ? TPU_OK : TPU_ERR_INVALID_STATE;
@@ -497,7 +510,11 @@ TpuStatus tpurmChannelWaitRange(TpurmChannel *ch, uint64_t minValue,
         return TPU_ERR_INVALID_ARGUMENT;
     if (value == 0)
         return TPU_OK;
-    if (!tpuMsgqWaitSeq(ch->fifo, value))
+    uint64_t tSpan = tpurmTraceBegin();
+    bool reached = tpuMsgqWaitSeq(ch->fifo, value);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_CHANNEL_FENCE, tSpan, ch->rcId, value);
+    if (!reached)
         return TPU_ERR_INVALID_STATE;
     uint32_t n = atomic_load_explicit(&ch->errSeqCount,
                                       memory_order_acquire);

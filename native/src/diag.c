@@ -39,13 +39,6 @@ static struct {
     uint64_t seq;
 } g_journal = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
-static uint64_t now_ns(void)
-{
-    struct timespec ts;
-    clock_gettime(CLOCK_MONOTONIC, &ts);
-    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
-}
-
 void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
 {
     va_list ap;
@@ -62,7 +55,7 @@ void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
     tpuLockTrackAcquire(TPU_LOCK_DIAG, "journal");
     rec = &g_journal.ring[g_journal.seq % JOURNAL_CAP];
     rec->seq = g_journal.seq++;
-    rec->ns = now_ns();
+    rec->ns = tpuNowNs();
     rec->level = level;
     snprintf(rec->subsys, sizeof(rec->subsys), "%s", subsys);
     memcpy(rec->msg, msg, sizeof(rec->msg));
@@ -102,32 +95,66 @@ size_t tpurmJournalDump(char *buf, size_t bufSize)
 /* Static names (~70 after the recovery counters) plus per-device
  * scoped "name[dN]" lines: size for a 16-device worst case. */
 #define MAX_COUNTERS 256
+/* Open-addressed hash index over the slots: power of two, load factor
+ * <= 0.25 at MAX_COUNTERS so probe chains stay O(1). */
+#define COUNTER_HASH_SIZE 1024
 
 static struct {
     pthread_mutex_t lock;                /* registration only */
     struct { char name[48]; _Atomic uint64_t value; } c[MAX_COUNTERS];
     _Atomic int n;
+    /* hash bucket -> slot index + 1 (0 = empty).  Written under the
+     * lock with release; lock-free readers see the slot's name fully
+     * published (the name is written before the bucket). */
+    _Atomic uint32_t hash[COUNTER_HASH_SIZE];
 } g_counters = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
+/* FNV-1a. */
+static uint32_t counter_hash(const char *name)
+{
+    uint32_t h = 2166136261u;
+    for (const unsigned char *p = (const unsigned char *)name; *p; p++) {
+        h ^= *p;
+        h *= 16777619u;
+    }
+    return h;
+}
+
+/* Probe the hash index for name; returns slot index or -1.  Lock-free:
+ * buckets only transition empty -> filled. */
+static int counter_find(const char *name, uint32_t h)
+{
+    for (uint32_t i = 0; i < COUNTER_HASH_SIZE; i++) {
+        uint32_t b = (h + i) & (COUNTER_HASH_SIZE - 1);
+        uint32_t slot = atomic_load_explicit(&g_counters.hash[b],
+                                             memory_order_acquire);
+        if (slot == 0)
+            return -1;
+        if (strcmp(g_counters.c[slot - 1].name, name) == 0)
+            return (int)slot - 1;
+    }
+    return -1;
+}
+
 /* Stable pointer to a counter cell (registering it on first use): hot
- * paths cache the pointer once and bump it with a single atomic add —
- * the name lookup's mutex + strcmp scan must not sit on the fault
+ * paths cache the pointer once and bump it with a single atomic add.
+ * The lookup itself is O(1) — a lock-free hash probe replaces the old
+ * linear scan, which at 256 registered names was back on the fault
  * service path (VERDICT r3 weak #4: p50 regression from per-event
- * bookkeeping). */
+ * bookkeeping).  The insertion-order slot array is kept for dumps. */
 _Atomic uint64_t *tpuCounterRef(const char *name)
 {
-    int n = atomic_load_explicit(&g_counters.n, memory_order_acquire);
-    for (int i = 0; i < n; i++)
-        if (strcmp(g_counters.c[i].name, name) == 0)
-            return &g_counters.c[i].value;
+    uint32_t h = counter_hash(name);
+    int idx = counter_find(name, h);
+    if (idx >= 0)
+        return &g_counters.c[idx].value;
     pthread_mutex_lock(&g_counters.lock);
-    n = atomic_load_explicit(&g_counters.n, memory_order_relaxed);
-    for (int i = 0; i < n; i++) {
-        if (strcmp(g_counters.c[i].name, name) == 0) {
-            pthread_mutex_unlock(&g_counters.lock);
-            return &g_counters.c[i].value;
-        }
+    idx = counter_find(name, h);
+    if (idx >= 0) {
+        pthread_mutex_unlock(&g_counters.lock);
+        return &g_counters.c[idx].value;
     }
+    int n = atomic_load_explicit(&g_counters.n, memory_order_relaxed);
     if (n >= MAX_COUNTERS) {
         pthread_mutex_unlock(&g_counters.lock);
         return NULL;
@@ -135,7 +162,17 @@ _Atomic uint64_t *tpuCounterRef(const char *name)
     snprintf(g_counters.c[n].name, sizeof(g_counters.c[0].name), "%s",
              name);
     atomic_store(&g_counters.c[n].value, 0);
-    /* Publish the name before the slot becomes visible. */
+    /* Publish order: name first, then the hash bucket (release), then
+     * the insertion count for dump readers. */
+    for (uint32_t i = 0; i < COUNTER_HASH_SIZE; i++) {
+        uint32_t b = (h + i) & (COUNTER_HASH_SIZE - 1);
+        if (atomic_load_explicit(&g_counters.hash[b],
+                                 memory_order_relaxed) == 0) {
+            atomic_store_explicit(&g_counters.hash[b], (uint32_t)n + 1,
+                                  memory_order_release);
+            break;
+        }
+    }
     atomic_store_explicit(&g_counters.n, n + 1, memory_order_release);
     pthread_mutex_unlock(&g_counters.lock);
     return &g_counters.c[n].value;
@@ -178,6 +215,19 @@ size_t tpuCountersDump(char *buf, size_t bufSize)
     return off;
 }
 
+void tpuCountersForEach(void (*fn)(const char *name, uint64_t value,
+                                   void *ctx), void *ctx)
+{
+    int n = atomic_load_explicit(&g_counters.n, memory_order_acquire);
+    for (int i = 0; i < n; i++)
+        fn(g_counters.c[i].name,
+           atomic_load_explicit(&g_counters.c[i].value,
+                                memory_order_relaxed), ctx);
+}
+
+/* Deliberately still the insertion-order linear scan: the native test
+ * (trace_test.c) uses it as the independent oracle that the hash index
+ * in tpuCounterRef resolves every name to the same cell. */
 uint64_t tpurmCounterGet(const char *name)
 {
     uint64_t v = 0;

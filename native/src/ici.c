@@ -18,6 +18,7 @@
 #include "internal.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
+#include "tpurm/trace.h"
 #include "tpurm/uvm.h"
 
 #include <stdlib.h>
@@ -49,12 +50,6 @@ static struct {
     IciLink links[MAX_ICI_DEVICES][MAX_LINKS_PER_DEV];
     uint32_t linkCount[MAX_ICI_DEVICES];
 } g_ici = { .lock = PTHREAD_MUTEX_INITIALIZER };
-
-static uint64_t now_ns(void)
-{
-    extern uint64_t uvmMonotonicNs(void);
-    return uvmMonotonicNs();
-}
 
 static void train_links_locked(uint32_t devInst);
 static TpuStatus next_hop_locked(uint32_t src, uint32_t dst,
@@ -168,7 +163,7 @@ static void train_links_locked(uint32_t devInst)
          * trains with it (links are bidirectional pairs). */
         l->state = TPU_ICI_LINK_TRAINING;
         l->state = TPU_ICI_LINK_ACTIVE;
-        l->trainedAtNs = now_ns();
+        l->trainedAtNs = tpuNowNs();
         IciLink *back = link_to(l->peerInst, devInst);
         if (back && back->state != TPU_ICI_LINK_FAILED) {
             back->state = TPU_ICI_LINK_ACTIVE;
@@ -198,7 +193,7 @@ TpuStatus tpuIciInjectLinkFailure(uint32_t devInst, uint32_t link)
     IciLink *l = &g_ici.links[devInst][link];
     l->state = TPU_ICI_LINK_FAILED;
     l->softFail = false;        /* admin failure: sticky until reset */
-    l->failedAtNs = now_ns();
+    l->failedAtNs = tpuNowNs();
     l->errorCount++;
     IciLink *back = link_to(l->peerInst, devInst);
     if (back) {
@@ -224,7 +219,7 @@ static void ici_flap_route_locked(uint32_t src, uint32_t dst)
     IciLink *l = link_to(src, next);
     if (!l || l->state != TPU_ICI_LINK_ACTIVE)
         return;
-    uint64_t now = now_ns();
+    uint64_t now = tpuNowNs();
     l->state = TPU_ICI_LINK_FAILED;
     l->softFail = true;
     l->failedAtNs = now;
@@ -249,7 +244,8 @@ static void ici_flap_route_locked(uint32_t src, uint32_t dst)
  * restored to ACTIVE.  g_ici.lock held. */
 static uint32_t ici_retrain_soft_locked(bool force)
 {
-    uint64_t now = now_ns();
+    uint64_t now = tpuNowNs();
+    uint64_t tSpan = tpurmTraceBegin();
     uint64_t backoffNs = tpuRegistryGet("ici_retrain_backoff_ms", 0) *
                          1000000ull;
     uint32_t recovered = 0;
@@ -282,11 +278,17 @@ static uint32_t ici_retrain_soft_locked(bool force)
             }
             recovered++;
             tpuCounterAdd("recover_link_retrains", 1);
+            tpurmTraceInstant(TPU_TRACE_RECOVER_RETRAIN,
+                              ((uint64_t)d << 32) | l->peerInst, 0);
             tpuCounterAdd("ici_links_trained", 1);
             tpuLog(TPU_LOG_WARN, "ici", "link %u -> %u retrained ACTIVE",
                    d, l->peerInst);
         }
     }
+    /* Only a pass that actually restored links earns a span; the
+     * common every-copy no-op stays off the rings. */
+    if (tSpan && recovered)
+        tpurmTraceEnd(TPU_TRACE_ICI_RETRAIN, tSpan, force, recovered);
     return recovered;
 }
 
@@ -441,9 +443,10 @@ void tpuIciPeerApertureDestroy(TpuIciPeerAperture *ap)
     free(ap);
 }
 
-TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
-                              uint64_t peerOff, uint64_t size, int direction,
-                              TpuTracker *tracker)
+static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
+                                     uint64_t localOff, uint64_t peerOff,
+                                     uint64_t size, int direction,
+                                     TpuTracker *tracker)
 {
     if (!ap || size == 0)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -543,6 +546,8 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
                 return attempt ? TPU_ERR_RETRY_EXHAUSTED : st;
             tpuCounterAdd("recover_retries", 1);
             tpuCounterAdd("recover_ici_retries", 1);
+            tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, (uintptr_t)dst,
+                              attempt);
             tpuRcRecoverAll();
             tpuRecoverBackoff(attempt);
         }
@@ -598,6 +603,7 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
             if (st != TPU_ERR_INSUFFICIENT_RESOURCES || attempt >= 3)
                 break;
             tpuCounterAdd("recover_retries", 1);
+            tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, chain[i], attempt);
             tpuRecoverBackoff(attempt);
         }
         if (st == TPU_OK)
@@ -679,6 +685,21 @@ out_free:
         uvmHbmChunkFree(chain[i + 1], stageHandle[i]);
     (void)tracker;   /* staged path drains before returning: staging
                       * chunks cannot outlive their in-flight reads */
+    return st;
+}
+
+TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
+                              uint64_t peerOff, uint64_t size, int direction,
+                              TpuTracker *tracker)
+{
+    /* Span chokepoint: the inner function has many returns. */
+    uint64_t t0 = tpurmTraceBegin();
+    TpuStatus st = ici_peer_copy_async(ap, localOff, peerOff, size,
+                                       direction, tracker);
+    if (t0)
+        tpurmTraceEnd(TPU_TRACE_ICI_COPY,
+                      t0, ap ? (((uint64_t)ap->srcInst << 32) |
+                                ap->peerInst) : 0, size);
     return st;
 }
 

@@ -11,6 +11,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/inject.h"
+#include "tpurm/trace.h"
 
 #include <stdatomic.h>
 #include <stdio.h>
@@ -204,6 +205,8 @@ static bool inject_eval(uint32_t site, uint64_t scopeKey)
             continue;
         if (atomic_compare_exchange_strong(&st->arms[i], &arm, 0)) {
             atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
+            tpurmTraceInstantLabel(TPU_TRACE_INJECT_HIT, scopeKey, site,
+                                   g_siteNames[site]);
             return true;
         }
     }
@@ -212,6 +215,8 @@ static bool inject_eval(uint32_t site, uint64_t scopeKey)
     if (atomic_load_explicit(&st->burstLeft, memory_order_acquire) > 0 &&
         atomic_fetch_sub(&st->burstLeft, 1) > 0) {
         atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
+        tpurmTraceInstantLabel(TPU_TRACE_INJECT_HIT, scopeKey, site,
+                               g_siteNames[site]);
         return true;
     }
 
@@ -264,6 +269,8 @@ static bool inject_eval(uint32_t site, uint64_t scopeKey)
     }
     if (hit) {
         atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
+        tpurmTraceInstantLabel(TPU_TRACE_INJECT_HIT, scopeKey, site,
+                               g_siteNames[site]);
         uint32_t burst = atomic_load(&st->burst);
         if (burst > 1)
             atomic_store(&st->burstLeft, (int32_t)burst - 1);

@@ -19,10 +19,61 @@
 #include <stdatomic.h>
 #include <stdbool.h>
 #include <stdint.h>
+#include <time.h>
 
 #include "tpurm/abi.h"
 #include "tpurm/status.h"
 #include "tpurm/tpurm.h"
+
+/* ------------------------------------------------------------ monotonic ns
+ *
+ * THE process clock: journal records, injection decisions, trace spans
+ * and fault latencies all stamp with this, so the timelines are
+ * directly comparable (previously diag.c, ici.c and uvm_tier.c each
+ * carried a private copy). */
+static inline uint64_t tpuNowNs(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+/* ------------------------------------------------------------- histogram
+ *
+ * Log-linear HDR-style latency histogram (trace.c): values below
+ * 2^SUB_BITS land in exact unit buckets; above that, each power of two
+ * splits into 2^SUB_BITS linear sub-buckets, so the relative bucket
+ * width is <= 2^-SUB_BITS (~0.8%) across the full uint64 range.
+ * Recording is three relaxed atomic adds — safe on any hot path. */
+#define TPU_HIST_SUB_BITS 7
+#define TPU_HIST_SUB      (1u << TPU_HIST_SUB_BITS)
+#define TPU_HIST_BUCKETS  ((64 - TPU_HIST_SUB_BITS + 1) * TPU_HIST_SUB)
+
+typedef struct {
+    _Atomic uint64_t count;
+    _Atomic uint64_t sum;
+    _Atomic uint64_t buckets[TPU_HIST_BUCKETS];
+} TpuHist;
+
+void     tpuHistRecord(TpuHist *h, uint64_t v);
+uint64_t tpuHistQuantile(const TpuHist *h, double q);
+uint64_t tpuHistBucketLow(uint32_t idx);   /* bucket lower bound value */
+void     tpuHistReset(TpuHist *h);
+
+/* The trace subsystem's per-site histogram (trace.h site ids).  The
+ * fault engine feeds FAULT_LATENCY/WAKE/SERVICE unconditionally (they
+ * back the UvmFaultStats ABI); other sites fill while armed. */
+TpuHist *tpurmTraceHistRef(uint32_t site);
+
+/* Bounded render cursor shared by the procfs and trace renderers
+ * (appends are silently truncated at cap-1; off never exceeds it). */
+typedef struct {
+    char *buf;
+    size_t cap, off;
+} TpuCur;
+
+void tpuCurf(TpuCur *c, const char *fmt, ...)
+    __attribute__((format(printf, 2, 3)));
 
 /* ------------------------------------------------------------- lock order */
 
@@ -57,6 +108,10 @@ _Atomic uint64_t *tpuCounterRef(const char *name);
 void tpuCounterAddScoped(const char *name, uint32_t devInst,
                          uint64_t delta);
 size_t tpuCountersDump(char *buf, size_t bufSize);
+/* Insertion-order iteration over every registered counter (metrics
+ * exposition). */
+void tpuCountersForEach(void (*fn)(const char *name, uint64_t value,
+                                   void *ctx), void *ctx);
 
 /* --------------------------------------------------------------- registry */
 

@@ -14,6 +14,7 @@
 #define _GNU_SOURCE
 #include "tpurm/msgq.h"
 #include "tpurm/inject.h"
+#include "tpurm/trace.h"
 
 #include <errno.h>
 #include <time.h>
@@ -111,6 +112,7 @@ static int msgq_submit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
 {
     if (!q || !cmds || n == 0 || n > q->n)
         return -EINVAL;
+    uint64_t tSpan = tpurmTraceBegin();
     if (q->flags & TPU_MSGQ_MPSC) {
         if (block) {
             pthread_mutex_lock(&q->txLock);
@@ -142,6 +144,7 @@ static int msgq_submit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
         extern void tpuCounterAdd(const char *name, uint64_t delta);
         tpuCounterAdd("recover_retries", 1);
         tpuCounterAdd("recover_msgq_retries", 1);
+        tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, (uintptr_t)q, 0);
         struct timespec ts = { .tv_sec = 0, .tv_nsec = 50000L };
         nanosleep(&ts, NULL);
     }
@@ -186,6 +189,8 @@ static int msgq_submit(TpuMsgq *q, TpuMsgqCmd *cmds, uint32_t n,
 
     if (q->flags & TPU_MSGQ_MPSC)
         pthread_mutex_unlock(&q->txLock);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_MSGQ_PUBLISH, tSpan, (uintptr_t)q, n);
     if (outLastSeq)
         *outLastSeq = last;
     return 0;

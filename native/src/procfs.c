@@ -15,6 +15,7 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/trace.h"
 #include "uvm/uvm_internal.h"
 
 #include <stdarg.h>
@@ -22,97 +23,78 @@
 #include <stdlib.h>
 #include <string.h>
 
-/* Render helpers append into a bounded cursor. */
-typedef struct {
-    char *buf;
-    size_t cap, off;
-} Cur;
-
-static void curf(Cur *c, const char *fmt, ...)
-    __attribute__((format(printf, 2, 3)));
-
-static void curf(Cur *c, const char *fmt, ...)
-{
-    if (c->off + 1 >= c->cap)
-        return;
-    va_list ap;
-    va_start(ap, fmt);
-    int n = vsnprintf(c->buf + c->off, c->cap - c->off, fmt, ap);
-    va_end(ap);
-    if (n > 0)
-        c->off += (size_t)n < c->cap - c->off ? (size_t)n
-                                              : c->cap - c->off - 1;
-}
+/* Render helpers append into the shared bounded cursor (internal.h
+ * TpuCur; implementation in trace.c). */
 
 /* ------------------------------------------------------------ renderers */
 
-static void render_version(Cur *c)
+static void render_version(TpuCur *c)
 {
-    curf(c, "tpurm version: 1.0 (round 3)\n");
-    curf(c, "engine: userspace RM + UVM over libtpu/XLA\n");
+    tpuCurf(c, "tpurm version: 1.0 (round 3)\n");
+    tpuCurf(c, "engine: userspace RM + UVM over libtpu/XLA\n");
 }
 
-static void render_gpu_info(Cur *c, uint32_t inst)
+static void render_gpu_info(TpuCur *c, uint32_t inst)
 {
     TpurmDevice *dev = tpurmDeviceGet(inst);
     if (!dev)
         return;
-    curf(c, "Device Instance:     %u\n", inst);
-    curf(c, "Probed Id:           0x%x\n", dev->devId);
-    curf(c, "HBM Arena:           %llu MB\n",
+    tpuCurf(c, "Device Instance:     %u\n", inst);
+    tpuCurf(c, "Probed Id:           0x%x\n", dev->devId);
+    tpuCurf(c, "HBM Arena:           %llu MB\n",
          (unsigned long long)(tpurmDeviceHbmSize(dev) >> 20));
-    curf(c, "Arena Backend:       %s\n",
+    tpuCurf(c, "Arena Backend:       %s\n",
          tpurmDeviceArenaIsReal(inst) ? "real (mirror stream open)"
                                       : "fake (host shadow only)");
-    curf(c, "CE Channels:         %u\n", dev->cePoolSize);
-    curf(c, "Device Lost:         %s\n", dev->lost ? "yes" : "no");
+    tpuCurf(c, "CE Channels:         %u\n", dev->cePoolSize);
+    tpuCurf(c, "Device Lost:         %s\n", dev->lost ? "yes" : "no");
 }
 
-static void render_gpus(Cur *c)
+static void render_gpus(TpuCur *c)
 {
     uint32_t n = tpurmDeviceCount();
     for (uint32_t i = 0; i < n; i++) {
-        curf(c, "[gpu %u]\n", i);
+        tpuCurf(c, "[gpu %u]\n", i);
         render_gpu_info(c, i);
-        curf(c, "\n");
+        tpuCurf(c, "\n");
     }
 }
 
-static void render_fault_stats(Cur *c)
+static void render_fault_stats(TpuCur *c)
 {
     UvmFaultStats st;
     uvmFaultStatsGet(&st);
-    curf(c, "cpu_faults:          %llu\n",
+    tpuCurf(c, "cpu_faults:          %llu\n",
          (unsigned long long)st.faultsCpu);
-    curf(c, "device_faults:       %llu\n",
+    tpuCurf(c, "device_faults:       %llu\n",
          (unsigned long long)st.faultsDevice);
-    curf(c, "batches:             %llu\n",
+    tpuCurf(c, "batches:             %llu\n",
          (unsigned long long)st.batches);
-    curf(c, "migrated_bytes:      %llu\n",
+    tpuCurf(c, "migrated_bytes:      %llu\n",
          (unsigned long long)st.migratedBytes);
-    curf(c, "evictions:           %llu\n",
+    tpuCurf(c, "evictions:           %llu\n",
          (unsigned long long)st.evictions);
-    curf(c, "service_p50_ns:      %llu\n",
+    tpuCurf(c, "service_p50_ns:      %llu\n",
          (unsigned long long)st.serviceNsP50);
-    curf(c, "service_p95_ns:      %llu\n",
+    tpuCurf(c, "service_p95_ns:      %llu\n",
          (unsigned long long)st.serviceNsP95);
 }
 
 static void channel_row(TpurmChannel *ch, uint64_t completed,
                         uint64_t pending, void *arg)
 {
-    curf((Cur *)arg, "%-18p completed=%-12llu pending=%llu\n",
+    tpuCurf((TpuCur *)arg, "%-18p completed=%-12llu pending=%llu\n",
          (void *)ch, (unsigned long long)completed,
          (unsigned long long)pending);
 }
 
-static void render_channels(Cur *c)
+static void render_channels(TpuCur *c)
 {
-    curf(c, "%-18s %-22s %s\n", "channel", "tracker", "fifo");
+    tpuCurf(c, "%-18s %-22s %s\n", "channel", "tracker", "fifo");
     tpuRcForEachChannel(channel_row, c);
 }
 
-static void render_counters(Cur *c)
+static void render_counters(TpuCur *c)
 {
     if (c->off + 1 >= c->cap)
         return;
@@ -123,7 +105,7 @@ static void render_counters(Cur *c)
  * (reference kernel-open/nvidia-uvm/uvm_types.h:361-391): every
  * reference type with the tpurm event that plays its role, or the
  * design reason there is none.  VERDICT r3 missing #4. */
-static void render_tools_events(Cur *c)
+static void render_tools_events(TpuCur *c)
 {
     static const struct { const char *ref, *ours, *note; } rows[] = {
         { "CpuFault/MemoryViolation", "CPU_FAULT", "" },
@@ -150,10 +132,10 @@ static void render_tools_events(Cur *c)
         { "(fork)HmmAdopt",       "HMM_ADOPT", "" },
         { "(fork)AtsAccess",      "ATS_ACCESS", "" },
     };
-    curf(c, "%-28s %-26s %s\n", "reference(UvmEventType)", "tpurm",
+    tpuCurf(c, "%-28s %-26s %s\n", "reference(UvmEventType)", "tpurm",
          "note");
     for (size_t i = 0; i < sizeof(rows) / sizeof(rows[0]); i++)
-        curf(c, "%-28s %-26s %s\n", rows[i].ref, rows[i].ours,
+        tpuCurf(c, "%-28s %-26s %s\n", rows[i].ref, rows[i].ours,
              rows[i].note);
 }
 
@@ -162,9 +144,9 @@ static void render_tools_events(Cur *c)
  * emulations (no NIC exists in this environment); the cross-process
  * consumer, pin lifetime and mid-MR revocation semantics are real
  * (VERDICT r3 missing #5: say so in the procfs surface). */
-static void render_rdma(Cur *c)
+static void render_rdma(TpuCur *c)
 {
-    curf(c, "transport: EMULATED (no NIC in environment; IOVA spaces are\n"
+    tpuCurf(c, "transport: EMULATED (no NIC in environment; IOVA spaces are\n"
             "  process-local; consumer attaches cross-process via the\n"
             "  arena memfd over SCM_RIGHTS)\n");
     static const char *names[] = {
@@ -173,22 +155,32 @@ static void render_rdma(Cur *c)
         "peermem_dma_maps", "peermem_revocations", "dmabuf_exports",
     };
     for (size_t i = 0; i < sizeof(names) / sizeof(names[0]); i++)
-        curf(c, "%-24s %llu\n", names[i],
+        tpuCurf(c, "%-24s %llu\n", names[i],
              (unsigned long long)tpurmCounterGet(names[i]));
 }
 
-static void render_journal(Cur *c)
+static void render_journal(TpuCur *c)
 {
     if (c->off + 1 >= c->cap)
         return;
     c->off += tpurmJournalDump(c->buf + c->off, c->cap - c->off);
 }
 
+/* Prometheus text exposition (trace.c): named counters + the tputrace
+ * site latency histograms.  `cat /proc/driver/tpurm/metrics` under the
+ * LD_PRELOAD shim is a scrape. */
+static void render_metrics(TpuCur *c)
+{
+    if (c->off + 1 >= c->cap)
+        return;
+    c->off += tpurmTraceRenderProm(c->buf + c->off, c->cap - c->off);
+}
+
 /* ---------------------------------------------------------- node table */
 
 typedef struct {
     const char *path;
-    void (*render)(Cur *c);
+    void (*render)(TpuCur *c);
     bool dbg;                    /* gated by registry procfs_debug */
 } ProcNode;
 
@@ -201,6 +193,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm-uvm/tools_events", render_tools_events, false },
     { "driver/tpurm/rdma", render_rdma, false },
     { "driver/tpurm/journal", render_journal, true },
+    { "driver/tpurm/metrics", render_metrics, false },
 };
 
 #define N_NODES (sizeof(g_nodes) / sizeof(g_nodes[0]))
@@ -238,7 +231,7 @@ size_t tpurmProcfsRead(const char *path, char *buf, size_t bufSize)
             continue;
         if (g_nodes[i].dbg && !tpuRegistryGet("procfs_debug", 0))
             return 0;            /* gated (uvm_enable_debug_procfs) */
-        Cur c = { buf, bufSize, 0 };
+        TpuCur c = { buf, bufSize, 0 };
         g_nodes[i].render(&c);
         return c.off;
     }
@@ -249,11 +242,11 @@ size_t tpurmProcfsList(char *buf, size_t bufSize)
 {
     if (!buf || bufSize == 0)
         return 0;
-    Cur c = { buf, bufSize, 0 };
+    TpuCur c = { buf, bufSize, 0 };
     bool dbg = tpuRegistryGet("procfs_debug", 0) != 0;
     for (size_t i = 0; i < N_NODES; i++) {
         if (!g_nodes[i].dbg || dbg)
-            curf(&c, "%s\n", g_nodes[i].path);
+            tpuCurf(&c, "%s\n", g_nodes[i].path);
     }
     return c.off;
 }

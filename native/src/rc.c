@@ -30,6 +30,7 @@
 #include "internal.h"
 #include "tpurm/msgq.h"
 #include "uvm/uvm_internal.h"   /* uvmMonotonicNs */
+#include "tpurm/trace.h"
 
 #include <stdatomic.h>
 #include <stdlib.h>
@@ -277,6 +278,9 @@ uint32_t tpuRcRecoverAll(void)
     }
     if (cleared) {
         tpuCounterAdd("recover_rc_resets", cleared);
+        /* bytes carries the per-call latch count so trace-side
+         * accounting can reconcile exactly with the counter delta. */
+        tpurmTraceInstant(TPU_TRACE_RECOVER_RC_RESET, 0, cleared);
         tpuLog(TPU_LOG_WARN, "rc",
                "reset-and-replay: cleared %u latched CE-pool error(s)",
                cleared);

@@ -24,6 +24,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/inject.h"
+#include "tpurm/trace.h"
 #include "tpurm/peermem.h"
 #include "tpurm/rdma.h"
 #include "uvm/uvm_internal.h"
@@ -337,6 +338,7 @@ TpuStatus tpuIbRegMr(uint64_t va, uint64_t size, uint32_t nicId,
      * failed attempt fully unwinds (putPages) so retries start clean. */
     uint32_t lim = (uint32_t)tpuRegistryGet("recover_rdma_retries", 3);
     TpuStatus st;
+    uint64_t tSpan = tpurmTraceBegin();
     for (uint32_t attempt = 0; ; attempt++) {
         st = TPU_OK;
         if (tpurmInjectShouldFail(TPU_INJECT_SITE_RDMA_COMPLETION))
@@ -362,8 +364,11 @@ TpuStatus tpuIbRegMr(uint64_t va, uint64_t size, uint32_t nicId,
         }
         tpuCounterAdd("recover_retries", 1);
         tpuCounterAdd("recover_rdma_retries", 1);
+        tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, va, attempt);
         tpuRecoverBackoff(attempt);
     }
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_RDMA_PIN, tSpan, va, size);
     if (st != TPU_OK) {
         mr_live_remove(mr);
         munmap(mr->ctrl, 4096);

@@ -1,0 +1,618 @@
+/*
+ * tputrace — unified cross-engine tracing + metrics (see
+ * include/tpurm/trace.h for the model).
+ *
+ * Concurrency:
+ *   - the armed flag is one relaxed-load fast path (inject.h
+ *     discipline);
+ *   - each thread owns a private ring: the owning thread is the only
+ *     WRITER (records + widx release-store), the exporter is a reader
+ *     that snapshots widx with acquire.  A record being overwritten
+ *     during an export can tear — exports are meant to run at
+ *     quiescence (trace_stop first), and a torn 64-byte record at
+ *     worst misrenders one event, never corrupts engine state;
+ *   - rings are registered once and never freed (bounded: 64 rings *
+ *     ring capacity * 64 B), so an export can always walk dead
+ *     threads' rings;
+ *   - histograms are relaxed atomic adds, safe from any thread.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/trace.h"
+
+#include <stdarg.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#define TRACE_MAX_RINGS 64
+#define TRACE_LABEL_MAX 24
+#define TRACE_RING_DEFAULT 8192
+
+/* One 64-byte record; durNs == 0 renders as an instant ("i"). */
+typedef struct {
+    uint64_t tsNs;
+    uint64_t durNs;
+    uint64_t obj;
+    uint64_t bytes;
+    uint32_t site;
+    uint32_t flags;                    /* reserved */
+    char label[TRACE_LABEL_MAX];       /* "" -> site name */
+} TpuTraceRec;
+
+typedef struct {
+    _Atomic uint64_t widx;             /* monotonic; slot = widx & mask */
+    uint32_t tid;
+    uint32_t cap;                      /* power of two */
+    TpuTraceRec *recs;
+} TraceRing;
+
+static struct {
+    pthread_mutex_t lock;              /* ring registration only */
+    TraceRing *rings[TRACE_MAX_RINGS];
+    _Atomic uint32_t nRings;
+    _Atomic uint32_t armed;
+    _Atomic uint64_t droppedNoRing;    /* emits with no ring slot left */
+} g_trace = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+static __thread TraceRing *t_ring;
+
+/* Site table: name + Perfetto category.  Order == TpuTraceSite. */
+static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = {
+    { "fault.latency",          "fault"   },
+    { "fault.wake",             "fault"   },
+    { "fault.service",          "fault"   },
+    { "fault.batch",            "fault"   },
+    { "migrate.call",           "migrate" },
+    { "migrate.copy",           "migrate" },
+    { "pmm.alloc",              "migrate" },
+    { "migrate.evict",          "migrate" },
+    { "channel.push",           "channel" },
+    { "channel.fence",          "channel" },
+    { "ici.copy",               "ici"     },
+    { "ici.retrain",            "ici"     },
+    { "rdma.pin",               "rdma"    },
+    { "msgq.publish",           "msgq"    },
+    { "app.span",               "app"     },
+    { "inject.hit",             "inject"  },
+    { "recover.retry",          "recover" },
+    { "recover.tier_fallback",  "recover" },
+    { "recover.quarantine",     "recover" },
+    { "recover.rc_reset",       "recover" },
+    { "recover.retrain",        "recover" },
+};
+
+/* Per-site latency histograms (~60 KB each, BSS; pages materialize on
+ * first touch). */
+static TpuHist g_hist[TPU_TRACE_SITE_COUNT];
+
+const char *tpurmTraceSiteName(uint32_t site)
+{
+    return site < TPU_TRACE_SITE_COUNT ? g_sites[site].name : NULL;
+}
+
+TpuHist *tpurmTraceHistRef(uint32_t site)
+{
+    return site < TPU_TRACE_SITE_COUNT ? &g_hist[site] : NULL;
+}
+
+/* ------------------------------------------------------------- histogram */
+
+/* Bucket index: exact unit buckets below 2^SUB_BITS, then SUB linear
+ * sub-buckets per power of two. */
+static uint32_t hist_index(uint64_t v)
+{
+    if ((v >> TPU_HIST_SUB_BITS) == 0)
+        return (uint32_t)v;
+    int msb = 63 - __builtin_clzll(v);
+    uint32_t sub = (uint32_t)((v >> (msb - TPU_HIST_SUB_BITS)) &
+                              (TPU_HIST_SUB - 1));
+    return (uint32_t)(msb - TPU_HIST_SUB_BITS + 1) * TPU_HIST_SUB + sub;
+}
+
+uint64_t tpuHistBucketLow(uint32_t idx)
+{
+    if (idx < TPU_HIST_SUB)
+        return idx;
+    uint32_t g = idx >> TPU_HIST_SUB_BITS;
+    uint32_t sub = idx & (TPU_HIST_SUB - 1);
+    int msb = (int)g + TPU_HIST_SUB_BITS - 1;
+    return (1ull << msb) | ((uint64_t)sub << (msb - TPU_HIST_SUB_BITS));
+}
+
+void tpuHistRecord(TpuHist *h, uint64_t v)
+{
+    atomic_fetch_add_explicit(&h->buckets[hist_index(v)], 1,
+                              memory_order_relaxed);
+    atomic_fetch_add_explicit(&h->sum, v, memory_order_relaxed);
+    atomic_fetch_add_explicit(&h->count, 1, memory_order_relaxed);
+}
+
+uint64_t tpuHistQuantile(const TpuHist *h, double q)
+{
+    uint64_t n = atomic_load_explicit(&h->count, memory_order_relaxed);
+    if (n == 0)
+        return 0;
+    if (q < 0)
+        q = 0;
+    if (q > 1)
+        q = 1;
+    /* Rank of the q-quantile (nearest-rank, 1-based). */
+    uint64_t rank = (uint64_t)(q * (double)n);
+    if (rank < 1)
+        rank = 1;
+    if (rank > n)
+        rank = n;
+    uint64_t seen = 0;
+    for (uint32_t i = 0; i < TPU_HIST_BUCKETS; i++) {
+        uint64_t c = atomic_load_explicit(&h->buckets[i],
+                                          memory_order_relaxed);
+        if (c == 0)
+            continue;
+        seen += c;
+        if (seen >= rank) {
+            /* Bucket midpoint halves the worst-case error. */
+            uint64_t lo = tpuHistBucketLow(i);
+            uint64_t width = i < TPU_HIST_SUB
+                                 ? 1
+                                 : 1ull << ((i >> TPU_HIST_SUB_BITS) - 1);
+            return lo + width / 2;
+        }
+    }
+    return 0;
+}
+
+void tpuHistReset(TpuHist *h)
+{
+    /* Racy against concurrent recorders by design (same contract the
+     * old sampling windows had): a reset during traffic loses a few
+     * in-flight samples, never corrupts. */
+    atomic_store_explicit(&h->count, 0, memory_order_relaxed);
+    atomic_store_explicit(&h->sum, 0, memory_order_relaxed);
+    for (uint32_t i = 0; i < TPU_HIST_BUCKETS; i++)
+        atomic_store_explicit(&h->buckets[i], 0, memory_order_relaxed);
+}
+
+/* ------------------------------------------------------------ arm control */
+
+void tpurmTraceStart(void)
+{
+    atomic_store_explicit(&g_trace.armed, 1, memory_order_release);
+    tpuLog(TPU_LOG_INFO, "trace", "tracing armed");
+}
+
+void tpurmTraceStop(void)
+{
+    atomic_store_explicit(&g_trace.armed, 0, memory_order_release);
+}
+
+int tpurmTraceIsArmed(void)
+{
+    return atomic_load_explicit(&g_trace.armed, memory_order_relaxed) != 0;
+}
+
+void tpurmTraceReset(void)
+{
+    uint32_t n = atomic_load_explicit(&g_trace.nRings,
+                                      memory_order_acquire);
+    for (uint32_t i = 0; i < n; i++)
+        atomic_store_explicit(&g_trace.rings[i]->widx, 0,
+                              memory_order_release);
+    atomic_store_explicit(&g_trace.droppedNoRing, 0, memory_order_relaxed);
+    for (uint32_t s = 0; s < TPU_TRACE_SITE_COUNT; s++)
+        tpuHistReset(&g_hist[s]);
+}
+
+uint64_t tpurmTraceNowNs(void)
+{
+    return tpuNowNs();
+}
+
+/* ---------------------------------------------------------------- emission */
+
+static TraceRing *ring_acquire(void)
+{
+    TraceRing *r = t_ring;
+    if (r)
+        return r;
+    uint64_t cap = tpuRegistryGet("trace_ring", TRACE_RING_DEFAULT);
+    if (cap < 64)
+        cap = 64;
+    if (cap > (1ull << 24))
+        cap = 1ull << 24;
+    /* Round up to a power of two. */
+    uint64_t p = 64;
+    while (p < cap)
+        p <<= 1;
+    r = calloc(1, sizeof(*r));
+    TpuTraceRec *recs = r ? calloc(p, sizeof(*recs)) : NULL;
+    if (!recs) {
+        free(r);
+        atomic_fetch_add_explicit(&g_trace.droppedNoRing, 1,
+                                  memory_order_relaxed);
+        return NULL;
+    }
+    r->recs = recs;
+    r->cap = (uint32_t)p;
+    r->tid = (uint32_t)syscall(SYS_gettid);
+    pthread_mutex_lock(&g_trace.lock);
+    uint32_t n = atomic_load_explicit(&g_trace.nRings,
+                                      memory_order_relaxed);
+    if (n >= TRACE_MAX_RINGS) {
+        pthread_mutex_unlock(&g_trace.lock);
+        free(recs);
+        free(r);
+        atomic_fetch_add_explicit(&g_trace.droppedNoRing, 1,
+                                  memory_order_relaxed);
+        return NULL;
+    }
+    g_trace.rings[n] = r;
+    atomic_store_explicit(&g_trace.nRings, n + 1, memory_order_release);
+    pthread_mutex_unlock(&g_trace.lock);
+    t_ring = r;
+    return r;
+}
+
+static void trace_emit(uint32_t site, uint64_t t0, uint64_t t1,
+                       uint64_t obj, uint64_t bytes, const char *label)
+{
+    if (site >= TPU_TRACE_SITE_COUNT)
+        return;
+    /* Re-check armed at commit: a span that was begun before
+     * trace_stop() must not land in a ring that trace_reset() may be
+     * clearing concurrently (shrinks the race to this window; exports
+     * are defined at quiescence). */
+    if (!atomic_load_explicit(&g_trace.armed, memory_order_relaxed))
+        return;
+    TraceRing *r = ring_acquire();
+    if (!r)
+        return;
+    uint64_t w = atomic_load_explicit(&r->widx, memory_order_relaxed);
+    TpuTraceRec *rec = &r->recs[w & (r->cap - 1)];
+    rec->tsNs = t0;
+    rec->durNs = t1 > t0 ? t1 - t0 : 0;
+    rec->obj = obj;
+    rec->bytes = bytes;
+    rec->site = site;
+    rec->flags = 0;
+    if (label)
+        snprintf(rec->label, sizeof(rec->label), "%s", label);
+    else
+        rec->label[0] = '\0';
+    atomic_store_explicit(&r->widx, w + 1, memory_order_release);
+}
+
+uint64_t tpurmTraceBegin(void)
+{
+    /* THE disarmed fast path: one relaxed load, nothing else. */
+    if (!atomic_load_explicit(&g_trace.armed, memory_order_relaxed))
+        return 0;
+    return tpuNowNs();
+}
+
+void tpurmTraceEnd(uint32_t site, uint64_t t0, uint64_t obj,
+                   uint64_t bytes)
+{
+    if (t0 == 0)
+        return;
+    if (!atomic_load_explicit(&g_trace.armed, memory_order_relaxed))
+        return;                 /* disarmed mid-span: drop it whole */
+    uint64_t t1 = tpuNowNs();
+    if (site < TPU_TRACE_SITE_COUNT)
+        tpuHistRecord(&g_hist[site], t1 - t0);
+    trace_emit(site, t0, t1, obj, bytes, NULL);
+}
+
+void tpurmTraceSpanAt(uint32_t site, uint64_t t0, uint64_t t1,
+                      uint64_t obj, uint64_t bytes)
+{
+    if (!tpurmTraceIsArmed())
+        return;
+    if (site < TPU_TRACE_SITE_COUNT)
+        tpuHistRecord(&g_hist[site], t1 > t0 ? t1 - t0 : 0);
+    trace_emit(site, t0, t1, obj, bytes, NULL);
+}
+
+void tpurmTraceEventAt(uint32_t site, uint64_t t0, uint64_t t1,
+                       uint64_t obj, uint64_t bytes)
+{
+    if (!tpurmTraceIsArmed())
+        return;
+    trace_emit(site, t0, t1, obj, bytes, NULL);
+}
+
+void tpurmTraceInstant(uint32_t site, uint64_t obj, uint64_t bytes)
+{
+    if (!tpurmTraceIsArmed())
+        return;
+    uint64_t now = tpuNowNs();
+    trace_emit(site, now, now, obj, bytes, NULL);
+}
+
+void tpurmTraceInstantLabel(uint32_t site, uint64_t obj, uint64_t bytes,
+                            const char *label)
+{
+    if (!tpurmTraceIsArmed())
+        return;
+    uint64_t now = tpuNowNs();
+    trace_emit(site, now, now, obj, bytes, label);
+}
+
+void tpurmTraceAppSpan(const char *name, uint64_t t0, uint64_t obj,
+                       uint64_t bytes)
+{
+    if (!tpurmTraceIsArmed() || t0 == 0)
+        return;
+    uint64_t t1 = tpuNowNs();
+    tpuHistRecord(&g_hist[TPU_TRACE_APP], t1 > t0 ? t1 - t0 : 0);
+    trace_emit(TPU_TRACE_APP, t0, t1, obj, bytes, name);
+}
+
+/* ------------------------------------------------------------- accounting */
+
+void tpurmTraceStats(uint64_t *outRecorded, uint64_t *outDropped,
+                     uint32_t *outRings)
+{
+    uint64_t recorded = 0;
+    uint64_t dropped = atomic_load_explicit(&g_trace.droppedNoRing,
+                                            memory_order_relaxed);
+    uint32_t n = atomic_load_explicit(&g_trace.nRings,
+                                      memory_order_acquire);
+    for (uint32_t i = 0; i < n; i++) {
+        TraceRing *r = g_trace.rings[i];
+        uint64_t w = atomic_load_explicit(&r->widx, memory_order_acquire);
+        recorded += w;
+        if (w > r->cap)
+            dropped += w - r->cap;     /* overwritten by ring wrap */
+    }
+    if (outRecorded)
+        *outRecorded = recorded;
+    if (outDropped)
+        *outDropped = dropped;
+    if (outRings)
+        *outRings = n;
+}
+
+/* ------------------------------------------------------------ JSON export */
+
+/* The one bounded-cursor implementation (internal.h TpuCur); the
+ * procfs renderers share it. */
+void tpuCurf(TpuCur *c, const char *fmt, ...)
+{
+    if (c->off + 1 >= c->cap)
+        return;
+    va_list ap;
+    va_start(ap, fmt);
+    int n = vsnprintf(c->buf + c->off, c->cap - c->off, fmt, ap);
+    va_end(ap);
+    if (n > 0)
+        c->off += (size_t)n < c->cap - c->off ? (size_t)n
+                                              : c->cap - c->off - 1;
+}
+
+/* Minimal string escape for labels (app span names are caller input). */
+static void json_escape(const char *in, char *out, size_t outSize)
+{
+    size_t o = 0;
+    for (size_t i = 0; in[i] && o + 2 < outSize; i++) {
+        unsigned char ch = (unsigned char)in[i];
+        if (ch == '"' || ch == '\\') {
+            out[o++] = '\\';
+            out[o++] = (char)ch;
+        } else if (ch < 0x20) {
+            out[o++] = ' ';
+        } else {
+            out[o++] = (char)ch;
+        }
+    }
+    out[o] = '\0';
+}
+
+size_t tpurmTraceExportJson(char *buf, size_t bufSize)
+{
+    if (!buf || bufSize < 32)
+        return 0;
+    TpuCur c = { buf, bufSize, 0 };
+    uint64_t exportDropped = 0;
+    int pid = (int)getpid();
+    tpuCurf(&c, "{\"traceEvents\":[");
+    bool first = true;
+    uint32_t nr = atomic_load_explicit(&g_trace.nRings,
+                                       memory_order_acquire);
+    /* Worst-case sizes: a span event is ~110 B of fixed JSON + a
+     * 46-char escaped label + two %.3f timestamps + full-width
+     * obj/bytes (~300 B total); the closing metadata event carries
+     * three 20-digit counters (~260 B).  Reserving both keeps the
+     * document parseable under any truncation. */
+    const size_t EVENT_MAX = 320;
+    const size_t TAIL = 280;
+    for (uint32_t i = 0; i < nr; i++) {
+        TraceRing *r = g_trace.rings[i];
+        uint64_t w = atomic_load_explicit(&r->widx, memory_order_acquire);
+        uint64_t n = w < r->cap ? w : r->cap;
+        for (uint64_t k = w - n; k < w; k++) {
+            const TpuTraceRec *rec = &r->recs[k & (r->cap - 1)];
+            if (rec->site >= TPU_TRACE_SITE_COUNT)
+                continue;          /* torn concurrent write: skip */
+            if (c.off + EVENT_MAX + TAIL >= c.cap) {
+                exportDropped += w - k;
+                break;
+            }
+            char name[3 * TRACE_LABEL_MAX];
+            if (rec->label[0])
+                json_escape(rec->label, name, sizeof(name));
+            else
+                snprintf(name, sizeof(name), "%s",
+                         g_sites[rec->site].name);
+            double tsUs = (double)rec->tsNs / 1000.0;
+            if (rec->durNs > 0)
+                tpuCurf(&c,
+                         "%s{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"X\","
+                         "\"ts\":%.3f,\"dur\":%.3f,\"pid\":%d,\"tid\":%u,"
+                         "\"args\":{\"obj\":\"0x%llx\",\"bytes\":%llu}}",
+                         first ? "" : ",", name, g_sites[rec->site].cat,
+                         tsUs, (double)rec->durNs / 1000.0, pid, r->tid,
+                         (unsigned long long)rec->obj,
+                         (unsigned long long)rec->bytes);
+            else
+                tpuCurf(&c,
+                         "%s{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"i\","
+                         "\"s\":\"t\",\"ts\":%.3f,\"pid\":%d,\"tid\":%u,"
+                         "\"args\":{\"obj\":\"0x%llx\",\"bytes\":%llu}}",
+                         first ? "" : ",", name, g_sites[rec->site].cat,
+                         tsUs, pid, r->tid,
+                         (unsigned long long)rec->obj,
+                         (unsigned long long)rec->bytes);
+            first = false;
+        }
+    }
+    /* Trailing metadata instant: process identity + export accounting
+     * (carries the full ph/ts/pid/tid/name set like every event).
+     * Rendered to the side first and appended only if it fits WHOLE
+     * (with the closing brackets): a document too small for the
+     * metadata still closes as valid JSON. */
+    uint64_t recorded, ringDropped;
+    tpurmTraceStats(&recorded, &ringDropped, NULL);
+    char meta[TAIL];
+    int mlen = snprintf(meta, sizeof(meta),
+             "%s{\"name\":\"tpurm.export\",\"cat\":\"meta\",\"ph\":\"i\","
+             "\"s\":\"g\",\"ts\":%.3f,\"pid\":%d,\"tid\":0,\"args\":"
+             "{\"recorded\":%llu,\"ringDropped\":%llu,"
+             "\"exportDropped\":%llu}}",
+             first ? "" : ",", (double)tpuNowNs() / 1000.0, pid,
+             (unsigned long long)recorded,
+             (unsigned long long)ringDropped,
+             (unsigned long long)exportDropped);
+    if (mlen > 0 && (size_t)mlen < sizeof(meta) &&
+        c.off + (size_t)mlen + 3 <= c.cap)
+        tpuCurf(&c, "%s", meta);
+    tpuCurf(&c, "]}");
+    return c.off;
+}
+
+/* ------------------------------------------------- Prometheus exposition */
+
+static void prom_counter_row(const char *name, uint64_t value, void *ctx)
+{
+    TpuCur *c = ctx;
+    /* Scoped "name[dN]" counters render as a dev label. */
+    const char *br = strchr(name, '[');
+    if (br && br[1] == 'd') {
+        char base[48];
+        size_t blen = (size_t)(br - name);
+        if (blen >= sizeof(base))
+            blen = sizeof(base) - 1;
+        memcpy(base, name, blen);
+        base[blen] = '\0';
+        unsigned dev = (unsigned)strtoul(br + 2, NULL, 10);
+        tpuCurf(c, "tpurm_counter{name=\"%s\",dev=\"%u\"} %llu\n", base,
+                 dev, (unsigned long long)value);
+    } else {
+        tpuCurf(c, "tpurm_counter{name=\"%s\"} %llu\n", name,
+                 (unsigned long long)value);
+    }
+}
+
+/* Coarse export boundaries (ns): the fine 7k-bucket histogram collapses
+ * onto log-spaced Prometheus buckets (1-2.5-5 per decade, 1 us .. 10 s). */
+static const uint64_t g_promLe[] = {
+    1000, 2500, 5000, 10000, 25000, 50000, 100000, 250000, 500000,
+    1000000, 2500000, 5000000, 10000000, 25000000, 50000000, 100000000,
+    1000000000, 10000000000ull,
+};
+#define PROM_LE_COUNT (sizeof(g_promLe) / sizeof(g_promLe[0]))
+
+static void prom_site_name(uint32_t site, char *out, size_t outSize)
+{
+    const char *n = g_sites[site].name;
+    size_t o = 0;
+    for (size_t i = 0; n[i] && o + 1 < outSize; i++)
+        out[o++] = n[i] == '.' ? '_' : n[i];
+    out[o] = '\0';
+}
+
+size_t tpurmTraceRenderProm(char *buf, size_t bufSize)
+{
+    if (!buf || bufSize == 0)
+        return 0;
+    TpuCur c = { buf, bufSize, 0 };
+
+    /* Named engine counters: one family, the raw name as a label. */
+    tpuCurf(&c, "# HELP tpurm_counter Named engine counters (diag.c).\n");
+    tpuCurf(&c, "# TYPE tpurm_counter counter\n");
+    tpuCountersForEach(prom_counter_row, &c);
+
+    /* Trace drop accounting. */
+    uint64_t recorded, dropped;
+    uint32_t rings;
+    tpurmTraceStats(&recorded, &dropped, &rings);
+    tpuCurf(&c, "# TYPE tpurm_trace_records_total counter\n");
+    tpuCurf(&c, "tpurm_trace_records_total %llu\n",
+             (unsigned long long)recorded);
+    tpuCurf(&c, "# TYPE tpurm_trace_dropped_total counter\n");
+    tpuCurf(&c, "tpurm_trace_dropped_total %llu\n",
+             (unsigned long long)dropped);
+    tpuCurf(&c, "# TYPE tpurm_trace_rings gauge\n");
+    tpuCurf(&c, "tpurm_trace_rings %u\n", rings);
+
+    /* Site latency histograms (non-empty only): cumulative buckets per
+     * the exposition format; le="+Inf" == _count. */
+    for (uint32_t s = 0; s < TPU_TRACE_SITE_COUNT; s++) {
+        TpuHist *h = &g_hist[s];
+        uint64_t count = atomic_load_explicit(&h->count,
+                                              memory_order_relaxed);
+        if (count == 0)
+            continue;
+        char metric[64];
+        prom_site_name(s, metric, sizeof(metric));
+        tpuCurf(&c, "# TYPE tpurm_%s_ns histogram\n", metric);
+        uint64_t cum = 0;
+        uint32_t bi = 0;
+        for (size_t li = 0; li < PROM_LE_COUNT; li++) {
+            while (bi < TPU_HIST_BUCKETS &&
+                   tpuHistBucketLow(bi) <= g_promLe[li]) {
+                cum += atomic_load_explicit(&h->buckets[bi],
+                                            memory_order_relaxed);
+                bi++;
+            }
+            tpuCurf(&c, "tpurm_%s_ns_bucket{le=\"%llu\"} %llu\n", metric,
+                     (unsigned long long)g_promLe[li],
+                     (unsigned long long)cum);
+        }
+        tpuCurf(&c, "tpurm_%s_ns_bucket{le=\"+Inf\"} %llu\n", metric,
+                 (unsigned long long)count);
+        tpuCurf(&c, "tpurm_%s_ns_sum %llu\n", metric,
+                 (unsigned long long)atomic_load_explicit(
+                     &h->sum, memory_order_relaxed));
+        tpuCurf(&c, "tpurm_%s_ns_count %llu\n", metric,
+                 (unsigned long long)count);
+    }
+    return c.off;
+}
+
+/* --------------------------------------------------------------- readout */
+
+uint64_t tpurmTraceHistQuantileNs(uint32_t site, double q)
+{
+    if (site >= TPU_TRACE_SITE_COUNT)
+        return 0;
+    return tpuHistQuantile(&g_hist[site], q);
+}
+
+uint64_t tpurmTraceHistCountNs(uint32_t site)
+{
+    if (site >= TPU_TRACE_SITE_COUNT)
+        return 0;
+    return atomic_load_explicit(&g_hist[site].count, memory_order_relaxed);
+}
+
+/* ------------------------------------------------------------------- env */
+
+__attribute__((constructor)) static void trace_ctor(void)
+{
+    if (tpuRegistryGet("trace", 0))
+        tpurmTraceStart();
+}

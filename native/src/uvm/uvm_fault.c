@@ -25,11 +25,14 @@
  *
  * Batching/latency stats mirror the reference's knobs: registry
  * "uvm_fault_batch_size" (reference uvm_perf_fault_batch_count) bounds a
- * batch; service latency percentiles come from a 4096-sample window.
+ * batch; service latency percentiles come from the tputrace log-linear
+ * histograms (full range, <=0.8% relative error — the old bounded
+ * 4096-sample windows could only describe the last window).
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
 #include "tpurm/inject.h"
+#include "tpurm/trace.h"
 
 #include <errno.h>
 #include <execinfo.h>
@@ -46,7 +49,6 @@
 #include <unistd.h>
 
 #define FAULT_RING_SIZE 4096          /* power of two */
-#define LAT_WINDOW 4096
 
 static long futex_call(uint32_t *uaddr, int op, uint32_t val)
 {
@@ -127,20 +129,16 @@ static struct {
     _Atomic uint32_t serviceHighWater;/* max simultaneous (observability) */
     struct sigaction oldSegv;
 
-    /* Stats (shared; latNs slot writes race benignly — it is a
-     * sampling window, not an exact log).  Three windows decompose the
-     * end-to-end latency: latNs = enqueue->replay (the headline),
-     * wakeNs = enqueue->batch-pop (signal + futex + scheduler cost —
-     * on a 1-CPU box this is a context switch, not engine work),
-     * svcNs = one service_one call (the engine's own work). */
+    /* Stats (shared).  Latencies land in three tputrace histograms
+     * that decompose the end-to-end cost: FAULT_LATENCY =
+     * enqueue->replay (the headline), FAULT_WAKE = enqueue->batch-pop
+     * (signal + futex + scheduler cost — on a 1-CPU box this is a
+     * context switch, not engine work), FAULT_SERVICE = one
+     * service_one call (the engine's own work).  The histograms record
+     * unconditionally (they back the UvmFaultStats ABI); ring events
+     * emit only while tracing is armed. */
     _Atomic uint64_t faultsCpu, faultsDevice, batches, migratedBytes,
         evictions;
-    uint32_t latNs[LAT_WINDOW];
-    _Atomic uint32_t latIdx;
-    uint32_t wakeNs[LAT_WINDOW];
-    _Atomic uint32_t wakeIdx;
-    uint32_t svcNs[LAT_WINDOW];
-    _Atomic uint32_t svcIdx;
 } g_fault = { .once = PTHREAD_ONCE_INIT };
 
 /* Block-stable worker assignment. */
@@ -157,52 +155,23 @@ void uvmFaultStatsRecordMigration(uint64_t bytes)
 void uvmFaultStatsRecordEviction(void)
 {
     atomic_fetch_add(&g_fault.evictions, 1);
-}
-
-static void win_record(uint32_t *win, _Atomic uint32_t *idx, uint64_t ns)
-{
-    uint32_t i = atomic_fetch_add(idx, 1) % LAT_WINDOW;
-    win[i] = ns > UINT32_MAX ? UINT32_MAX : (uint32_t)ns;
+    tpurmTraceInstant(TPU_TRACE_EVICT, 0, 0);
 }
 
 static void lat_record(uint64_t ns)
 {
-    win_record(g_fault.latNs, &g_fault.latIdx, ns);
+    tpuHistRecord(tpurmTraceHistRef(TPU_TRACE_FAULT_LATENCY), ns);
 }
 
-static int u32cmp(const void *a, const void *b)
-{
-    uint32_t x = *(const uint32_t *)a, y = *(const uint32_t *)b;
-    return x < y ? -1 : x > y;
-}
-
-static void win_percentiles(const uint32_t *win, _Atomic uint32_t *idx,
-                            uint64_t *p50, uint64_t *p95)
-{
-    uint32_t n = atomic_load(idx);
-    if (n > LAT_WINDOW)
-        n = LAT_WINDOW;
-    if (n == 0)
-        return;
-    uint32_t *copy = malloc(n * sizeof(uint32_t));
-    if (!copy)
-        return;
-    memcpy(copy, win, n * sizeof(uint32_t));
-    qsort(copy, n, sizeof(uint32_t), u32cmp);
-    *p50 = copy[n / 2];
-    *p95 = copy[(uint64_t)n * 95 / 100];
-    free(copy);
-}
-
-/* Restart the latency sampling windows (percentiles onward cover only
+/* Restart the latency histograms (percentiles onward cover only
  * faults after this call).  Counters (faultsCpu etc.) are NOT reset —
- * only the percentile windows, so a benchmark can scope its recorded
- * p50/p95 to exactly the workload it reports. */
+ * only the three fault-latency histograms, so a benchmark can scope
+ * its recorded p50/p95 to exactly the workload it reports. */
 void uvmFaultStatsResetWindows(void)
 {
-    atomic_store(&g_fault.latIdx, 0);
-    atomic_store(&g_fault.wakeIdx, 0);
-    atomic_store(&g_fault.svcIdx, 0);
+    tpuHistReset(tpurmTraceHistRef(TPU_TRACE_FAULT_LATENCY));
+    tpuHistReset(tpurmTraceHistRef(TPU_TRACE_FAULT_WAKE));
+    tpuHistReset(tpurmTraceHistRef(TPU_TRACE_FAULT_SERVICE));
 }
 
 void uvmFaultStatsGet(UvmFaultStats *out)
@@ -213,12 +182,16 @@ void uvmFaultStatsGet(UvmFaultStats *out)
     out->batches = atomic_load(&g_fault.batches);
     out->migratedBytes = atomic_load(&g_fault.migratedBytes);
     out->evictions = atomic_load(&g_fault.evictions);
-    win_percentiles(g_fault.latNs, &g_fault.latIdx,
-                    &out->serviceNsP50, &out->serviceNsP95);
-    win_percentiles(g_fault.wakeNs, &g_fault.wakeIdx,
-                    &out->wakeNsP50, &out->wakeNsP95);
-    win_percentiles(g_fault.svcNs, &g_fault.svcIdx,
-                    &out->svcOneNsP50, &out->svcOneNsP95);
+    out->serviceNsP50 = tpurmTraceHistQuantileNs(TPU_TRACE_FAULT_LATENCY,
+                                                 0.50);
+    out->serviceNsP95 = tpurmTraceHistQuantileNs(TPU_TRACE_FAULT_LATENCY,
+                                                 0.95);
+    out->wakeNsP50 = tpurmTraceHistQuantileNs(TPU_TRACE_FAULT_WAKE, 0.50);
+    out->wakeNsP95 = tpurmTraceHistQuantileNs(TPU_TRACE_FAULT_WAKE, 0.95);
+    out->svcOneNsP50 = tpurmTraceHistQuantileNs(TPU_TRACE_FAULT_SERVICE,
+                                                0.50);
+    out->svcOneNsP95 = tpurmTraceHistQuantileNs(TPU_TRACE_FAULT_SERVICE,
+                                                0.95);
 }
 
 /* ------------------------------------------------------ snapshot access */
@@ -736,6 +709,7 @@ static TpuStatus service_with_retry(UvmFaultEntry *e)
     while (attempt < limit && status_transient(st)) {
         tpuCounterAdd("recover_retries", 1);
         tpuCounterAdd("recover_fault_retries", 1);
+        tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, e->addr, attempt);
         tpuRcRecoverAll();
         tpuRecoverBackoff(attempt);
         attempt++;
@@ -749,6 +723,8 @@ static TpuStatus service_with_retry(UvmFaultEntry *e)
 static void replay_wake(UvmFaultEntry *e, uint64_t nowNs)
 {
     lat_record(nowNs - e->enqueueNs);
+    tpurmTraceEventAt(TPU_TRACE_FAULT_LATENCY, e->enqueueNs, nowNs,
+                      e->addr, e->len);
     /* Only successfully serviced device faults REPLAY; fatal ones were
      * cancelled (FATAL_FAULT already emitted) and must not also read as
      * replayed. */
@@ -812,6 +788,7 @@ static void service_cancel(UvmFaultEntry *e)
              * retry (service_with_retry) and is now quarantined on the
              * poison mapping. */
             tpuCounterAdd("recover_page_quarantines", 1);
+            tpurmTraceInstant(TPU_TRACE_RECOVER_QUARANTINE, pageAddr, ps);
             tpuLog(TPU_LOG_WARN, "uvm",
                    "page 0x%llx quarantined (%s)",
                    (unsigned long long)pageAddr,
@@ -906,13 +883,21 @@ static void *fault_service_thread(void *arg)
         }
         if (n == 0)
             continue;
+        uint64_t tBatch0 = uvmMonotonicNs();
         {
-            /* Wake-latency window: enqueue -> batch pop.  What remains
-             * after subtracting this from the headline is engine work. */
-            uint64_t tPop = uvmMonotonicNs();
-            for (uint32_t i = 0; i < n; i++)
-                win_record(g_fault.wakeNs, &g_fault.wakeIdx,
-                           tPop - batch[i]->enqueueNs);
+            /* Wake-latency histogram: enqueue -> batch pop.  What
+             * remains after subtracting this from the headline is
+             * engine work.  Armed tracing additionally emits each wake
+             * as a span (enqueue on the faulting thread, pop here). */
+            TpuHist *wakeHist = tpurmTraceHistRef(TPU_TRACE_FAULT_WAKE);
+            bool traced = tpurmTraceIsArmed();
+            for (uint32_t i = 0; i < n; i++) {
+                tpuHistRecord(wakeHist, tBatch0 - batch[i]->enqueueNs);
+                if (traced)
+                    tpurmTraceEventAt(TPU_TRACE_FAULT_WAKE,
+                                      batch[i]->enqueueNs, tBatch0,
+                                      batch[i]->addr, batch[i]->len);
+            }
         }
         /* Cross-worker concurrency high-water (observability for the
          * multi-worker module test and procfs): counted only once a
@@ -977,8 +962,11 @@ static void *fault_service_thread(void *arg)
             }
             uint64_t tSvc = uvmMonotonicNs();
             e->serviceStatus = service_with_retry(e);
-            win_record(g_fault.svcNs, &g_fault.svcIdx,
-                       uvmMonotonicNs() - tSvc);
+            uint64_t tSvcEnd = uvmMonotonicNs();
+            tpuHistRecord(tpurmTraceHistRef(TPU_TRACE_FAULT_SERVICE),
+                          tSvcEnd - tSvc);
+            tpurmTraceEventAt(TPU_TRACE_FAULT_SERVICE, tSvc, tSvcEnd,
+                              e->addr, e->len);
             if (e->serviceStatus != TPU_OK)
                 service_cancel(e);
             if (e->source == UVM_FAULT_SRC_CPU)
@@ -1080,6 +1068,8 @@ static void *fault_service_thread(void *arg)
             }
         }
         atomic_fetch_add(&g_fault.batches, 1);
+        tpurmTraceEventAt(TPU_TRACE_FAULT_BATCH, tBatch0,
+                          uvmMonotonicNs(), w->index, n);
         {
             static _Atomic(_Atomic uint64_t *) ref;
             _Atomic uint64_t *r = atomic_load_explicit(

@@ -12,6 +12,7 @@
  * uvm_migrate.c:735, fires on completion, which here is at return).
  */
 #include "uvm_internal.h"
+#include "tpurm/trace.h"
 
 TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
                      UvmLocation dst, uint32_t flags)
@@ -28,6 +29,7 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
     uint64_t start = (uintptr_t)base & ~(ps - 1);
     uint64_t end = ((uintptr_t)base + len - 1) | (ps - 1);
 
+    uint64_t tSpan = tpurmTraceBegin();
     /* PM gate (shared): migrations block while suspended
      * (uvm_lock.h:43-49 global power management lock). */
     uvmPmEnterShared();
@@ -85,5 +87,7 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
     pthread_mutex_unlock(&vs->lock);
     uvmPmExitShared();
     tpuCounterAdd("uvm_migrate_calls", 1);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_MIGRATE, tSpan, (uintptr_t)base, len);
     return st;
 }

@@ -14,6 +14,7 @@
  * until used.
  */
 #include "uvm_internal.h"
+#include "tpurm/trace.h"
 #include "tpurm/inject.h"
 
 #include <stdlib.h>
@@ -130,6 +131,7 @@ TpuStatus uvmPmmAlloc(UvmPmm *pmm, uint64_t size, UvmPmmChunk **out)
     if (tpurmInjectShouldFail(TPU_INJECT_SITE_PMM_ALLOC))
         return TPU_ERR_INSUFFICIENT_RESOURCES;
 
+    uint64_t tSpan = tpurmTraceBegin();
     pthread_mutex_lock(&pmm->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "pmm");
     uint8_t want = size_to_level(pmm, size);
@@ -177,6 +179,8 @@ TpuStatus uvmPmmAlloc(UvmPmm *pmm, uint64_t size, UvmPmmChunk **out)
     tpuCounterAdd("pmm_chunk_allocs", 1);
     tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "pmm");
     pthread_mutex_unlock(&pmm->lock);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_PMM_ALLOC, tSpan, c->offset, size);
     *out = c;
     return TPU_OK;
 }

@@ -25,11 +25,11 @@
 
 #define MAX_HBM_ARENAS 16
 
+/* Alias of the process-wide clock (internal.h tpuNowNs): journal,
+ * inject and trace timestamps are directly comparable with UVM's. */
 uint64_t uvmMonotonicNs(void)
 {
-    struct timespec ts;
-    clock_gettime(CLOCK_MONOTONIC, &ts);
-    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+    return tpuNowNs();
 }
 
 static struct {

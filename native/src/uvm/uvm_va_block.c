@@ -22,6 +22,7 @@
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
+#include "tpurm/trace.h"
 #include "tpurm/inject.h"
 
 #include <sched.h>
@@ -717,6 +718,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
                     copyAttempts++;
                     tpuCounterAdd("recover_retries", 1);
                     tpuCounterAdd("recover_copy_retries", 1);
+                    tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, blk->start,
+                                      copyAttempts - 1);
                     tpuRcRecoverAll();
                     tpuRecoverBackoff(copyAttempts - 1);
                     st = TPU_OK;
@@ -734,6 +737,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         }
         if (wantFallback) {
             tpuCounterAdd("recover_tier_fallbacks", 1);
+            tpurmTraceInstant(TPU_TRACE_RECOVER_TIER_FALLBACK, blk->start,
+                              dst.tier);
             tpuLog(TPU_LOG_WARN, "uvm",
                    "tier fallback: block %llx pages [%u,+%u) %s -> HOST "
                    "(aperture allocation failed)",
@@ -764,7 +769,10 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             uvmBlockSetCpuAccess(blk, firstPage, count, PROT_READ);
 
         uint64_t bytes = 0;
+        uint64_t tCopy = tpurmTraceBegin();
         st = block_copy_in(blk, dst.tier, &needed, firstPage, count, &bytes);
+        if (tCopy && bytes)
+            tpurmTraceEnd(TPU_TRACE_MIGRATE_COPY, tCopy, blk->start, bytes);
         if (st != TPU_OK) {
             /* Transient copy fault (CE error, chip-readback stall,
              * injection): nothing was committed — masks and user PTEs
@@ -777,6 +785,8 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
                 copyAttempts++;
                 tpuCounterAdd("recover_retries", 1);
                 tpuCounterAdd("recover_copy_retries", 1);
+                tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, blk->start,
+                                  copyAttempts - 1);
                 tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
                 pthread_mutex_unlock(&blk->lock);
                 tpuRcRecoverAll();

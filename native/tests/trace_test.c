@@ -1,0 +1,205 @@
+/*
+ * tputrace test: histogram quantile error bound vs an exact sort, ring
+ * wrap + drop accounting, disarmed-path no-emission, JSON export
+ * well-formedness, Prometheus exposition shape, and the O(1) counter
+ * hash index agreeing with the insertion-order scan.
+ */
+#define _GNU_SOURCE
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpurm/trace.h"
+
+/* Internal diag surface (exported symbols; internal.h is not installed). */
+extern void tpuCounterAdd(const char *name, uint64_t delta);
+extern uint64_t *tpuCounterRef(const char *name);
+extern uint64_t tpurmCounterGet(const char *name);
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+static uint64_t xorshift(uint64_t *s)
+{
+    uint64_t x = *s;
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    *s = x;
+    return x;
+}
+
+static int cmp_u64(const void *a, const void *b)
+{
+    uint64_t x = *(const uint64_t *)a, y = *(const uint64_t *)b;
+    return x < y ? -1 : x > y;
+}
+
+/* Quantile error bound: log-linear buckets promise <= ~0.8% relative
+ * error; assert 2% against an exact sort over a log-spread sample. */
+static int test_hist_quantile_error(void)
+{
+    enum { N = 50000 };
+    static uint64_t vals[N];
+    uint64_t seed = 0x1234567;
+    tpurmTraceStart();
+    uint32_t site = TPU_TRACE_ICI_RETRAIN;   /* unused by this test's engines */
+    for (int i = 0; i < N; i++) {
+        /* Log-uniform-ish: random mantissa at a random scale 1us..100ms. */
+        uint64_t scale = 1000ull << (xorshift(&seed) % 17);
+        uint64_t v = scale + xorshift(&seed) % scale;
+        vals[i] = v;
+        tpurmTraceSpanAt(site, 0, v, 0, 0);
+    }
+    CHECK(tpurmTraceHistCountNs(site) == N);
+    qsort(vals, N, sizeof(vals[0]), cmp_u64);
+    static const double qs[] = { 0.50, 0.95, 0.99 };
+    for (unsigned i = 0; i < 3; i++) {
+        uint64_t rank = (uint64_t)(qs[i] * N);
+        if (rank < 1)
+            rank = 1;
+        uint64_t exact = vals[rank - 1];
+        uint64_t approx = tpurmTraceHistQuantileNs(site, qs[i]);
+        double rel = exact > approx ? (double)(exact - approx) / exact
+                                    : (double)(approx - exact) / exact;
+        if (rel > 0.02) {
+            fprintf(stderr, "q=%.2f exact=%llu approx=%llu rel=%f\n",
+                    qs[i], (unsigned long long)exact,
+                    (unsigned long long)approx, rel);
+            CHECK(0);
+        }
+    }
+    return 0;
+}
+
+/* Ring wrap overwrites oldest and counts every lost record. */
+static int test_ring_wrap_and_drops(void)
+{
+    tpurmTraceStart();
+    tpurmTraceReset();
+    enum { EMIT = 3000, CAP = 1024 };    /* TPUMEM_TRACE_RING=1024 (main) */
+    for (int i = 0; i < EMIT; i++)
+        tpurmTraceInstant(TPU_TRACE_INJECT_HIT, i, 0);
+    uint64_t recorded, dropped;
+    uint32_t rings;
+    tpurmTraceStats(&recorded, &dropped, &rings);
+    CHECK(rings >= 1);
+    CHECK(recorded == EMIT);
+    CHECK(dropped == EMIT - CAP);
+
+    /* Export carries exactly the surviving CAP events (+1 metadata). */
+    size_t cap = 4u << 20;
+    char *buf = malloc(cap);
+    CHECK(buf);
+    size_t n = tpurmTraceExportJson(buf, cap);
+    CHECK(n > 0 && n < cap);
+    CHECK(strncmp(buf, "{\"traceEvents\":[", 16) == 0);
+    CHECK(strcmp(buf + n - 2, "]}") == 0);
+    int events = 0;
+    for (char *p = buf; (p = strstr(p, "\"ph\":")) != NULL; p++)
+        events++;
+    CHECK(events == CAP + 1);
+    /* Required Chrome trace-event keys appear per event. */
+    int tids = 0;
+    for (char *p = buf; (p = strstr(p, "\"tid\":")) != NULL; p++)
+        tids++;
+    CHECK(tids == events);
+    free(buf);
+    return 0;
+}
+
+/* Disarmed: begin returns 0 and nothing reaches rings or histograms. */
+static int test_disarmed_no_emission(void)
+{
+    tpurmTraceStop();
+    tpurmTraceReset();
+    CHECK(!tpurmTraceIsArmed());
+    CHECK(tpurmTraceBegin() == 0);
+    tpurmTraceEnd(TPU_TRACE_CHANNEL_PUSH, 0, 1, 2);   /* token 0: no-op */
+    tpurmTraceInstant(TPU_TRACE_INJECT_HIT, 1, 2);
+    tpurmTraceSpanAt(TPU_TRACE_CHANNEL_PUSH, 0, 100, 1, 2);
+    tpurmTraceAppSpan("nope", 123, 0, 0);
+    uint64_t recorded, dropped;
+    tpurmTraceStats(&recorded, &dropped, NULL);
+    CHECK(recorded == 0);
+    CHECK(tpurmTraceHistCountNs(TPU_TRACE_CHANNEL_PUSH) == 0);
+    return 0;
+}
+
+/* Prometheus render: TYPE lines, cumulative buckets, +Inf == count. */
+static int test_prom_render(void)
+{
+    tpurmTraceStart();
+    tpurmTraceReset();
+    for (int i = 1; i <= 100; i++)
+        tpurmTraceSpanAt(TPU_TRACE_RDMA_PIN, 0, (uint64_t)i * 10000, 0, 0);
+    tpuCounterAdd("trace_test_counter", 7);
+    size_t cap = 1u << 20;
+    char *buf = malloc(cap);
+    CHECK(buf);
+    size_t n = tpurmTraceRenderProm(buf, cap);
+    CHECK(n > 0 && n < cap);
+    CHECK(strstr(buf, "# TYPE tpurm_counter counter"));
+    CHECK(strstr(buf, "tpurm_counter{name=\"trace_test_counter\"} 7"));
+    CHECK(strstr(buf, "# TYPE tpurm_rdma_pin_ns histogram"));
+    CHECK(strstr(buf, "tpurm_rdma_pin_ns_count 100"));
+    CHECK(strstr(buf, "tpurm_rdma_pin_ns_bucket{le=\"+Inf\"} 100"));
+    /* Buckets are cumulative: parse them in order. */
+    long long prev = -1;
+    for (char *p = buf; (p = strstr(p, "tpurm_rdma_pin_ns_bucket")); ) {
+        p = strchr(p, '}');
+        CHECK(p);
+        long long v = atoll(p + 1);
+        CHECK(v >= prev);
+        prev = v;
+    }
+    CHECK(prev == 100);
+    free(buf);
+    tpurmTraceStop();
+    return 0;
+}
+
+/* The O(1) hash index must resolve every name to the same cell the
+ * insertion-order scan (tpurmCounterGet) finds. */
+static int test_counter_hash_agrees_with_scan(void)
+{
+    enum { N = 180 };
+    char name[48];
+    for (int i = 0; i < N; i++) {
+        snprintf(name, sizeof(name), "trace_test_c%03d", i);
+        tpuCounterAdd(name, (uint64_t)i + 1);
+    }
+    for (int i = 0; i < N; i++) {
+        snprintf(name, sizeof(name), "trace_test_c%03d", i);
+        CHECK(tpurmCounterGet(name) == (uint64_t)i + 1);
+        uint64_t *ref = tpuCounterRef(name);
+        CHECK(ref != NULL);
+        CHECK(*(volatile uint64_t *)ref == (uint64_t)i + 1);
+    }
+    CHECK(tpurmCounterGet("trace_test_never_registered") == 0);
+    return 0;
+}
+
+int main(void)
+{
+    /* Small per-thread rings so the wrap test is cheap; must be set
+     * before the first emission creates this thread's ring. */
+    setenv("TPUMEM_TRACE_RING", "1024", 1);
+
+    if (test_hist_quantile_error())
+        return 1;
+    if (test_ring_wrap_and_drops())
+        return 1;
+    if (test_disarmed_no_emission())
+        return 1;
+    if (test_prom_render())
+        return 1;
+    if (test_counter_hash_agrees_with_scan())
+        return 1;
+    printf("trace_test OK\n");
+    return 0;
+}

@@ -247,6 +247,27 @@ def load() -> ctypes.CDLL:
     lib.tpurmProcfsList.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.tpurmProcfsList.restype = ctypes.c_size_t
 
+    # tputrace — unified tracing + metrics (trace.h)
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    lib.tpurmTraceStart.argtypes = []
+    lib.tpurmTraceStop.argtypes = []
+    lib.tpurmTraceReset.argtypes = []
+    lib.tpurmTraceIsArmed.restype = ctypes.c_int
+    lib.tpurmTraceNowNs.restype = u64
+    lib.tpurmTraceAppSpan.argtypes = [ctypes.c_char_p, u64, u64, u64]
+    lib.tpurmTraceAppSpan.restype = None
+    lib.tpurmTraceExportJson.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.tpurmTraceExportJson.restype = ctypes.c_size_t
+    lib.tpurmTraceStats.argtypes = [ctypes.POINTER(u64), ctypes.POINTER(u64),
+                                    ctypes.POINTER(u32)]
+    lib.tpurmTraceStats.restype = None
+    lib.tpurmTraceHistQuantileNs.argtypes = [u32, ctypes.c_double]
+    lib.tpurmTraceHistQuantileNs.restype = u64
+    lib.tpurmTraceHistCountNs.argtypes = [u32]
+    lib.tpurmTraceHistCountNs.restype = u64
+    lib.tpurmTraceSiteName.argtypes = [u32]
+    lib.tpurmTraceSiteName.restype = ctypes.c_char_p
+
     _lib = lib
     return lib
 

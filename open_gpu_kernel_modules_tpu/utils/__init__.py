@@ -70,5 +70,135 @@ def procfs_list(max_bytes: int = 4096) -> List[str]:
     return [p for p in buf.raw[:n].decode().splitlines() if p]
 
 
+# ------------------------------------------------------------------ tracing
+#
+# Python face of tputrace (native/src/trace.c): arm/disarm the
+# per-thread span rings, export Chrome trace-event / Perfetto JSON,
+# read the per-site latency histograms, and emit application-level
+# spans into the same rings so app phases line up with engine spans on
+# one timeline.
+
+#: Site name -> id (trace.h TpuTraceSite order; resolved lazily against
+#: the native table so the two can never drift).
+_TRACE_SITES: Dict[str, int] = {}
+
+
+def _trace_sites() -> Dict[str, int]:
+    if not _TRACE_SITES:
+        lib = native.load()
+        i = 0
+        while True:
+            name = lib.tpurmTraceSiteName(i)
+            if name is None:
+                break
+            _TRACE_SITES[name.decode()] = i
+            i += 1
+    return _TRACE_SITES
+
+
+def trace_start() -> None:
+    """Arm tracing (every engine site starts emitting spans)."""
+    native.load().tpurmTraceStart()
+
+
+def trace_stop() -> None:
+    native.load().tpurmTraceStop()
+
+
+def trace_reset() -> None:
+    """Clear rings, drop accounting and site histograms."""
+    native.load().tpurmTraceReset()
+
+
+def trace_armed() -> bool:
+    return bool(native.load().tpurmTraceIsArmed())
+
+
+def trace_export_json(max_bytes: int = 16 << 20) -> str:
+    """Chrome trace-event JSON (load in Perfetto / chrome://tracing)."""
+    import ctypes
+
+    lib = native.load()
+    buf = ctypes.create_string_buffer(max_bytes)
+    n = lib.tpurmTraceExportJson(buf, max_bytes)
+    return buf.raw[:n].decode(errors="replace")
+
+
+def trace_export(max_bytes: int = 16 << 20) -> dict:
+    """Parsed export: {"traceEvents": [...]}."""
+    import json
+
+    return json.loads(trace_export_json(max_bytes))
+
+
+def trace_save(path: str, max_bytes: int = 16 << 20) -> str:
+    """Write the JSON export to ``path`` (Perfetto round-trip)."""
+    text = trace_export_json(max_bytes)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def trace_stats() -> Dict[str, int]:
+    """Ring accounting: records emitted, records lost, live rings."""
+    import ctypes
+
+    lib = native.load()
+    rec = ctypes.c_uint64()
+    drop = ctypes.c_uint64()
+    rings = ctypes.c_uint32()
+    lib.tpurmTraceStats(ctypes.byref(rec), ctypes.byref(drop),
+                        ctypes.byref(rings))
+    return {"recorded": rec.value, "dropped": drop.value,
+            "rings": rings.value}
+
+
+def trace_quantile_ns(site, q: float) -> int:
+    """Latency quantile from a site's log-linear histogram (~1%% rel.
+    error).  ``site`` is a name ("fault.latency", "channel.push", ...)
+    or a raw id; 0 when the histogram is empty."""
+    sid = _trace_sites()[site] if isinstance(site, str) else int(site)
+    return native.load().tpurmTraceHistQuantileNs(sid, float(q))
+
+
+def trace_hist_count(site) -> int:
+    sid = _trace_sites()[site] if isinstance(site, str) else int(site)
+    return native.load().tpurmTraceHistCountNs(sid)
+
+
+class span:
+    """Context manager emitting an application span into the trace
+    rings (site "app.span", rendered under the given name)::
+
+        with utils.span("tokenize", nbytes=len(blob)):
+            ...
+
+    No-op overhead when tracing is disarmed (one native call each way).
+    """
+
+    def __init__(self, name: str, obj: int = 0, nbytes: int = 0):
+        self._name = name.encode()
+        self._obj = obj
+        self._bytes = nbytes
+        self._t0 = 0
+
+    def __enter__(self) -> "span":
+        self._t0 = native.load().tpurmTraceNowNs()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        native.load().tpurmTraceAppSpan(self._name, self._t0, self._obj,
+                                        self._bytes)
+
+
+def metrics_text(max_bytes: int = 1 << 20) -> str:
+    """The Prometheus exposition (/proc/driver/tpurm/metrics body)."""
+    return procfs_read("/proc/driver/tpurm/metrics", max_bytes)
+
+
 __all__ = ["journal_dump", "counter", "counters", "registry_get",
-           "procfs_read", "procfs_list"]
+           "procfs_read", "procfs_list", "trace_start", "trace_stop",
+           "trace_reset", "trace_armed", "trace_export",
+           "trace_export_json", "trace_save", "trace_stats",
+           "trace_quantile_ns", "trace_hist_count", "span",
+           "metrics_text"]

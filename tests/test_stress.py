@@ -158,7 +158,7 @@ sys.path.insert(0, %(repo)r)
 
 import numpy as np
 
-from open_gpu_kernel_modules_tpu import uvm
+from open_gpu_kernel_modules_tpu import utils, uvm
 from open_gpu_kernel_modules_tpu.runtime import ici, native
 from open_gpu_kernel_modules_tpu.uvm import inject as inj
 from open_gpu_kernel_modules_tpu.uvm.managed import Tier
@@ -182,6 +182,12 @@ out["phase0_counters"] = inj.recovery_counters()
 out["phase0_evals"] = {k: v[0] for k, v in inj.stats().items()}
 
 # ---------------- phase 1: chaos at 1%% across 7 sites ---------------
+# Tracing ARMED for the whole chaos window: the soak must stay
+# corruption-free with every site emitting, every injected fault must
+# surface as an instant event, and every recovery-counter increment
+# must have a matching recovery trace event.
+utils.trace_reset()
+utils.trace_start()
 inj.set_seed(42)
 SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MSGQ_PUBLISH, inj.Site.ICI_LINK,
@@ -314,6 +320,35 @@ for i, b in enumerate(bufs):
 intact = intact and bool((rbuf.view() == 0xA5).all())
 out["data_intact"] = intact
 
+# Trace accounting for the armed chaos window (before phase 2 so the
+# counters snapshot matches exactly what the rings saw).
+utils.trace_stop()
+out["counters_armed"] = inj.recovery_counters()
+out["hits_armed"] = sum(v[1] for v in inj.stats().values())
+tstats = utils.trace_stats()
+out["trace_dropped"] = tstats["dropped"]
+out["trace_recorded"] = tstats["recorded"]
+doc = utils.trace_export(96 << 20)
+inject_events = 0
+recover_events = {}
+rc_reset_latches = 0
+export_dropped = 0
+for e in doc["traceEvents"]:
+    cat = e.get("cat")
+    if cat == "inject":
+        inject_events += 1
+    elif cat == "recover":
+        recover_events[e["name"]] = recover_events.get(e["name"], 0) + 1
+        if e["name"] == "recover.rc_reset":
+            rc_reset_latches += int(e["args"]["bytes"])
+    elif e["name"] == "tpurm.export":
+        export_dropped = int(e["args"].get("exportDropped", 0))
+out["trace_inject_events"] = inject_events
+out["trace_recover_events"] = recover_events
+out["trace_rc_reset_latches"] = rc_reset_latches
+out["trace_export_dropped"] = export_dropped
+utils.trace_reset()
+
 # -------- phase 2: persistent timeout -> page quarantine ------------
 sac = vs.alloc(2 * MB)
 sac.view()[:] = 9
@@ -332,12 +367,18 @@ print(json.dumps(out))
 
 def test_engine_soak_injection():
     """Chaos soak (acceptance): ~1% injection across 7 sites at a fixed
-    seed; the soak completes with zero corruption, every recovery
-    counter is nonzero, and with injection disabled all counters are
-    zero and the disarmed fast path never even counts an evaluation."""
+    seed, now with tracing ARMED for the whole chaos window; the soak
+    completes with zero corruption, every recovery counter is nonzero,
+    every injected fault surfaces as an instant trace event, each
+    recovery-counter increment has a matching recovery trace event, and
+    with injection disabled all counters are zero and the disarmed fast
+    path never even counts an evaluation."""
     env = dict(os.environ)
     env["TPUMEM_FAKE_TPU_COUNT"] = "4"
     env["TPUMEM_FAKE_HBM_MB"] = "64"
+    # Rings sized so the 4-second chaos window fits without wrap: the
+    # exact hit<->event reconciliation below needs a lossless record.
+    env.setdefault("TPUMEM_TRACE_RING", str(1 << 17))
     script = _INJECT_SOAK % {"repo": _REPO}
     proc = subprocess.run([sys.executable, "-c", script], env=env,
                           capture_output=True, text=True, timeout=300)
@@ -364,6 +405,38 @@ def test_engine_soak_injection():
     assert c["recover_rc_resets"] > 0, c
     assert c["recover_link_retrains"] > 0, c
     assert c["recover_page_quarantines"] > 0, c
+
+    # Tracing rode the whole chaos window: spans/instants were emitted
+    # (the corruption/counter assertions above all held WITH tracing
+    # armed — observability does not perturb recovery).
+    assert out["trace_recorded"] > 0
+
+    # Every injected fault shows an instant event; every recovery
+    # counter increment has a matching recovery event.  With zero ring
+    # drops the reconciliation is EXACT; under wrap (slow container)
+    # fall back to existence.
+    ca = out["counters_armed"]
+    rec = out["trace_recover_events"]
+    if out["trace_dropped"] == 0 and out["trace_export_dropped"] == 0:
+        assert out["trace_inject_events"] == out["hits_armed"], out
+        assert rec.get("recover.retry", 0) == ca["recover_retries"], out
+        assert rec.get("recover.tier_fallback", 0) == \
+            ca["recover_tier_fallbacks"], out
+        assert rec.get("recover.quarantine", 0) == \
+            ca["recover_page_quarantines"], out
+        assert out["trace_rc_reset_latches"] == ca["recover_rc_resets"], out
+        assert rec.get("recover.retrain", 0) == \
+            ca["recover_link_retrains"], out
+    else:
+        assert out["trace_inject_events"] > 0, out
+        for name, counter in (("recover.retry", "recover_retries"),
+                              ("recover.tier_fallback",
+                               "recover_tier_fallbacks"),
+                              ("recover.rc_reset", "recover_rc_resets"),
+                              ("recover.retrain",
+                               "recover_link_retrains")):
+            if ca[counter] > 0:
+                assert rec.get(name, 0) > 0, (name, out)
 
     # The quarantined page was retired precisely: poison reads zeros,
     # the residency surface reports the cancellation.

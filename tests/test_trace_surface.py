@@ -1,0 +1,160 @@
+"""tputrace surface: engine spans for a managed alloc -> device_access
+-> free cycle export as valid Chrome trace-event JSON, application
+spans ride the same rings, and /proc/driver/tpurm/metrics renders
+valid Prometheus text exposition with cumulative histogram buckets.
+"""
+
+import json
+
+import pytest
+
+from open_gpu_kernel_modules_tpu import utils, uvm
+
+MB = 1 << 20
+
+
+@pytest.fixture
+def traced():
+    """Armed tracing scoped to one test (rings cleared both ways so
+    tests stay order-independent)."""
+    utils.trace_reset()
+    utils.trace_start()
+    yield
+    utils.trace_stop()
+    utils.trace_reset()
+
+
+def _workload():
+    """Managed alloc -> write (CPU faults) -> device access (migration
+    + channel pushes) -> free."""
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(2 * MB)
+        with utils.span("app.phase.populate", nbytes=2 * MB):
+            buf.view()[:] = 0x5C
+        buf.device_access(dev=0, write=False)
+        buf.free()
+
+
+def test_spans_and_chrome_trace_format(traced):
+    _workload()
+    utils.trace_stop()
+
+    text = utils.trace_export_json()
+    doc = json.loads(text)                       # must parse as-is
+    events = doc["traceEvents"]
+    assert events, "no trace events for a full alloc/access/free cycle"
+
+    # Chrome trace-event spec: every event carries ph/ts/pid/tid/name.
+    for e in events:
+        for key in ("ph", "ts", "pid", "tid", "name"):
+            assert key in e, (key, e)
+        assert e["ph"] in ("X", "i")
+        if e["ph"] == "X":
+            assert "dur" in e
+
+    names = {e["name"] for e in events}
+    # The connected fault-service chain: wake -> service -> migrate
+    # copy -> channel push/fence, all present for the same workload.
+    for want in ("fault.wake", "fault.service", "fault.latency",
+                 "migrate.copy", "channel.push", "channel.fence",
+                 "msgq.publish", "pmm.alloc"):
+        assert want in names, (want, sorted(names))
+
+    # The app span rides the same rings under its own name.
+    app = [e for e in events if e["name"] == "app.phase.populate"]
+    assert len(app) == 1 and app[0]["ph"] == "X"
+    assert app[0]["args"]["bytes"] == 2 * MB
+
+    # Span nesting sanity: each fault.service span falls inside ITS
+    # fault's wake->replay window on the shared clock (fault.latency
+    # covers enqueue->replay, service runs within it).
+    lats = [e for e in events if e["name"] == "fault.latency"]
+    svcs = [e for e in events if e["name"] == "fault.service"]
+    assert lats and svcs
+    assert any(
+        lat["ts"] <= svc["ts"] and
+        svc["ts"] + svc["dur"] <= lat["ts"] + lat["dur"] + 1e3
+        for lat in lats for svc in svcs)
+
+    st = utils.trace_stats()
+    assert st["recorded"] > 0 and st["rings"] >= 1
+
+
+def test_disarmed_emits_nothing():
+    utils.trace_stop()
+    utils.trace_reset()
+    _workload()
+    assert utils.trace_stats()["recorded"] == 0
+    # Export is still a valid (near-empty) document.
+    doc = utils.trace_export()
+    assert [e["name"] for e in doc["traceEvents"]] == ["tpurm.export"]
+
+
+def test_histograms_back_fault_stats(traced):
+    uvm.fault_stats_reset_windows()
+    _workload()
+    st = uvm.fault_stats()
+    assert st.service_ns_p50 > 0
+    assert st.service_ns_p95 >= st.service_ns_p50
+    # Same numbers via the trace histogram readout (same histograms).
+    p50 = utils.trace_quantile_ns("fault.latency", 0.50)
+    p95 = utils.trace_quantile_ns("fault.latency", 0.95)
+    assert p50 == st.service_ns_p50
+    assert p95 == st.service_ns_p95
+    assert utils.trace_hist_count("fault.latency") > 0
+
+
+def _parse_prometheus(text):
+    """Minimal exposition parser: returns (types, samples) and asserts
+    every sample's family was TYPE-declared BEFORE the sample."""
+    types = {}
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        metric, _, value = line.rpartition(" ")
+        name = metric.split("{", 1)[0]
+        family = name
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if family.endswith(suffix) and family[: -len(suffix)] in types:
+                family = family[: -len(suffix)]
+                break
+        if family not in types and name in types:
+            family = name
+        assert family in types, f"sample before # TYPE: {line}"
+        samples.append((name, metric, float(value)))
+    return types, samples
+
+
+def test_prometheus_metrics_node(traced):
+    _workload()
+    text = utils.metrics_text()
+    assert text, "metrics node rendered empty"
+    types, samples = _parse_prometheus(text)
+    assert types.get("tpurm_counter") == "counter"
+
+    # Histogram families: buckets cumulative, le="+Inf" == _count.
+    hist_families = [f for f, k in types.items() if k == "histogram"]
+    assert "tpurm_fault_latency_ns" in hist_families
+    for fam in hist_families:
+        buckets = [(m, v) for (n, m, v) in samples
+                   if n == fam + "_bucket"]
+        count = [v for (n, m, v) in samples if n == fam + "_count"]
+        assert buckets and len(count) == 1
+        values = [v for _, v in buckets]
+        assert values == sorted(values), fam        # cumulative
+        inf = [v for m, v in buckets if 'le="+Inf"' in m]
+        assert inf == [count[0]], fam
+
+    # The engine's named counters surface through the scrape.
+    names = {m for (_, m, _) in samples}
+    assert any('name="channel_pushes"' in m for m in names)
+
+    # The node also serves under the procfs listing.
+    assert "driver/tpurm/metrics" in utils.procfs_list()
