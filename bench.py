@@ -341,6 +341,65 @@ def measure_oversub_fault_bandwidth(real_arena: bool) -> tuple[float, dict]:
             rt.close()
 
 
+def measure_memring_async_vs_sync(spans: int = 256,
+                                  span_bytes: int = 64 * 1024) -> dict:
+    """tpumemring microbench (acceptance): batched async MIGRATE of
+    256 x 64 KB spans through the submission ring vs an equivalent loop
+    of synchronous uvmMigrate calls.  The ring wins by BATCHING: the
+    worker pool coalesces contiguous same-destination spans into
+    block-granular engine calls (one VA-space lock round trip and one
+    make_resident walk per merged span instead of one per 64 KB), which
+    is the paper's ring-offload claim in miniature.  Reported as ops/s
+    each way plus the ratio; native-only (no JAX involvement)."""
+    from open_gpu_kernel_modules_tpu import uvm
+    from open_gpu_kernel_modules_tpu.uvm import memring
+    from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(spans * span_bytes)
+        buf.view()[:] = 0x6D
+
+        def sync_pass() -> float:
+            t0 = time.perf_counter()
+            for tier in (Tier.HBM, Tier.HOST):
+                for i in range(spans):
+                    buf.migrate(tier, offset=i * span_bytes,
+                                length=span_bytes)
+            return time.perf_counter() - t0
+
+        def async_pass(ring) -> float:
+            t0 = time.perf_counter()
+            for tier in (Tier.HBM, Tier.HOST):
+                for i in range(spans):
+                    ring.migrate(buf.address + i * span_bytes,
+                                 span_bytes, tier)
+                ring.submit_and_wait()
+                ring.completions(max_cqes=spans, check=True)
+            return time.perf_counter() - t0
+
+        # Warm both directions once (first-touch population, PMM setup),
+        # then best-of-3 per mode: scheduler interference on a small box
+        # is additive-positive, so min() is the clean estimate.
+        sync_pass()
+        sync_dt = min(sync_pass() for _ in range(3))
+        with memring.MemRing(vs, entries=spans * 2) as ring:
+            async_pass(ring)
+            async_dt = min(async_pass(ring) for _ in range(3))
+        ok = bool((buf.view() == 0x6D).all())
+        buf.free()
+
+    ops = 2 * spans
+    out = {
+        "memring_sync_ops_per_s": round(ops / sync_dt, 1),
+        "memring_async_ops_per_s": round(ops / async_dt, 1),
+        "memring_speedup": round(sync_dt / async_dt, 2),
+        "memring_span_kb": span_bytes // 1024,
+        "memring_spans": spans,
+        "memring_data_intact": ok,
+    }
+    return out
+
+
 def measure_explicit_migrate_gbps(total_mib: int = 256) -> dict:
     """SURVEY §3.3: the EXPLICIT UVM_MIGRATE path, ENGINE-SIDE — one
     ioctl moves a whole range through the CE pool with batched
@@ -1084,6 +1143,10 @@ def main() -> None:
         extra.update(measure_explicit_migrate_gbps())
     except Exception:
         pass
+    try:
+        extra.update(measure_memring_async_vs_sync())
+    except Exception as exc:
+        extra["memring_error"] = str(exc)[:200]
     extra.update(_prior_round_latencies())
     if "prev_fault_p95_us" in extra and extra["prev_fault_p95_us"]:
         extra["fault_p95_vs_prev"] = round(
@@ -1095,13 +1158,26 @@ def main() -> None:
         except Exception:
             pass
 
-    print(json.dumps({
+    record = {
         "metric": "oversub_4x_fault_migrate_bandwidth",
         "value": round(bps / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(bps / BASELINE_CXL_LINK_BYTES_PER_S, 3),
         **extra,
-    }))
+    }
+    # Artifact of record: the FULL result JSON goes to a file (the
+    # driver's 2,000-char stdout tail capture truncated past rounds'
+    # records into a null `parsed` field).  BENCH_OUT overrides the
+    # destination; writing must never fail the bench itself.
+    out_path = os.environ.get("BENCH_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_out.json")
+    try:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    except OSError:
+        pass
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

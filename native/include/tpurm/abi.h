@@ -301,7 +301,23 @@ typedef struct {
 /* Notifier indices (cl2080_notification.h vocabulary).  CXL DMA
  * completion is a fork-space index: the reference's CXL fork exposes
  * completion only via the async tracker; tpurm also delivers it as an
- * RM event so clients need not poll. */
+ * RM event so clients need not poll.
+ *
+ * TPU_NOTIFIER_CXL_DMA delivery contract (per-hClient scoping):
+ * completion events are SCOPED to the client that issued the DMA
+ * request — when two clients arm identical listeners on this index,
+ * each hears only its own transfers complete (a completion is a
+ * statement about the requesting client's ordering, not a device-wide
+ * broadcast; a concurrent client's copy-back discipline must not
+ * trigger on someone else's DMA).  Fallback: when the REQUESTING
+ * client holds no armed listener at this index, the completion is
+ * delivered BROADCAST (scope 0) so pure observers — monitoring
+ * clients armed on the notifier without issuing DMA themselves —
+ * still hear it rather than the event being silently dropped.
+ * Corollary: a DMA-issuing client MUST arm its own listener to keep
+ * scoped delivery in force; if any issuer skips arming, its
+ * completions fall back to broadcast and other armed clients will
+ * hear them. */
 #define TPU_NOTIFIER_SW        0u    /* NV2080_NOTIFIERS_SW */
 #define TPU_NOTIFIER_RC_ERROR  37u   /* NV2080_NOTIFIERS_RC_ERROR */
 #define TPU_NOTIFIER_CXL_DMA   180u  /* fork: async CXL DMA completion */

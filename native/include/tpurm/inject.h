@@ -51,6 +51,7 @@ typedef enum {
     TPU_INJECT_SITE_RDMA_COMPLETION, /* MR pin/map completion error      */
     TPU_INJECT_SITE_CHANNEL_CE,      /* channel CE push fault            */
     TPU_INJECT_SITE_FENCE_TIMEOUT,   /* fault-service / fence timeout    */
+    TPU_INJECT_SITE_MEMRING_SUBMIT,  /* memring op execution (run)       */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

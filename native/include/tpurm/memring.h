@@ -1,0 +1,230 @@
+/*
+ * tpumemring — io_uring-style asynchronous memory-op submission and
+ * completion rings (the paper's namesake capability: CXLMemUring's
+ * ring-based asynchronous offload of far-memory operations, shaped
+ * after Linux io_uring / liburing).
+ *
+ * A ring is a fixed-size SHARED-MEMORY region (one memfd: header page,
+ * then the SQ array, then the CQ array) holding two power-of-two rings
+ * of cacheline-sized entries:
+ *
+ *   SQ — submission queue.  The producer fills TpuMemringSqe slots
+ *        (tpurmMemringPrep), then publishes a whole batch with ONE
+ *        tpurmMemringSubmit: a release store of sqTail plus a futex
+ *        wake on the doorbell word.  No locks on the producer side.
+ *   CQ — completion queue.  The worker pool posts one TpuMemringCqe
+ *        per SQE (status, bytes moved, the user_data cookie echoed
+ *        back) and futex-wakes the cqReady word; the consumer reaps
+ *        with tpurmMemringReap / parks with tpurmMemringWait.
+ *
+ * A small worker pool (registry "memring_workers", default 2) drains
+ * SQEs into the existing engines: MIGRATE/PREFETCH/EVICT/ADVISE run
+ * against the ring's UVM VA space, PEER_COPY against the ICI peer
+ * aperture substrate.  Workers BATCH: a popped run of compatible
+ * non-linked ops (same opcode/destination, virtually contiguous) is
+ * coalesced into one engine call — one VA-space lock acquisition and
+ * one block-granular make_resident walk instead of one per span.
+ * That coalescing is where the async ring beats the synchronous
+ * uvmMigrate loop (bench.py memring microbench), exactly the paper's
+ * batched-offload claim.
+ *
+ * Ordering:
+ *   TPU_MEMRING_SQE_LINK — io_uring IOSQE_LINK analog: the next SQE
+ *        starts only after this one completes; a failure cancels every
+ *        remaining entry of the chain (their CQEs post
+ *        TPU_ERR_INVALID_STATE with bytes = 0).  A chain must be
+ *        published by a single tpurmMemringSubmit call; the publication
+ *        boundary terminates a chain.
+ *   TPU_MEMRING_OP_FENCE — completes only after every previously
+ *        submitted SQE has posted its CQE (io_uring IOSQE_IO_DRAIN
+ *        analog: later SQEs do not begin until the fence retires).
+ *
+ * Failure recovery: every op execution evaluates the memring.submit
+ * injection site (inject.h) and wraps the engine call in a bounded
+ * backoff retry (registry "memring_retry_max", default 3).  Retry
+ * exhaustion posts an ERROR CQE carrying the failing TpuStatus —
+ * errors surface per-op through the CQ instead of tearing down the
+ * ring.  Recovery is counted (memring_retries / memring_error_cqes /
+ * memring_inject_retries / memring_inject_error_runs) and traced
+ * (memring.submit + memring.op spans, recover.retry instants).
+ *
+ * CQ overflow: when the consumer leaves the CQ full, new CQEs are
+ * DROPPED and counted (hdr.cqOverflows / "memring_cq_overflows") —
+ * fences and completion accounting still advance, so a slow reaper
+ * can never deadlock the pool (io_uring's overflow accounting).
+ */
+#ifndef TPURM_MEMRING_H
+#define TPURM_MEMRING_H
+
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+struct UvmVaSpace;
+
+/* ------------------------------------------------------------- opcodes */
+
+enum {
+    TPU_MEMRING_OP_NOP = 0,       /* completes immediately (testing)    */
+    TPU_MEMRING_OP_MIGRATE = 1,   /* uvmMigrate(addr, len) -> dst tier  */
+    TPU_MEMRING_OP_PREFETCH = 2,  /* uvmDeviceAccess: fault span onto
+                                   * devInst's HBM (read unless WRITE)  */
+    TPU_MEMRING_OP_EVICT = 3,     /* tier demote: migrate to dstTier
+                                   * (HOST or CXL only)                 */
+    TPU_MEMRING_OP_ADVISE = 4,    /* policy op, subcode in arg0         */
+    TPU_MEMRING_OP_PEER_COPY = 5, /* ICI peer copy local<->peer HBM     */
+    TPU_MEMRING_OP_FENCE = 6,     /* completes after all prior CQEs     */
+    TPU_MEMRING_OP_COUNT
+};
+
+/* SQE flags.  LINK chains are capped at 64 entries (one worker claim,
+ * so claimed-whole execution holds); a longer chain fails prep with
+ * TPU_ERR_INVALID_LIMIT. */
+#define TPU_MEMRING_SQE_LINK  0x1u  /* chain with the NEXT sqe          */
+#define TPU_MEMRING_SQE_WRITE 0x2u  /* PREFETCH faults for write        */
+
+/* ADVISE subcodes (sqe.arg0). */
+enum {
+    TPU_MEMRING_ADVISE_PREFERRED = 1,        /* dstTier / devInst       */
+    TPU_MEMRING_ADVISE_UNSET_PREFERRED = 2,
+    TPU_MEMRING_ADVISE_ACCESSED_BY = 3,      /* devInst                 */
+    TPU_MEMRING_ADVISE_UNSET_ACCESSED_BY = 4,
+    TPU_MEMRING_ADVISE_READ_DUP = 5,         /* arg1: 0 off / 1 on      */
+};
+
+/* PEER_COPY direction (sqe.arg0): 0 local->peer, 1 peer->local. */
+#define TPU_MEMRING_PEER_WRITE 0u
+#define TPU_MEMRING_PEER_READ  1u
+
+/* --------------------------------------------------------- ring entries */
+
+/* Submission entry — exactly one cacheline. */
+typedef struct {
+    uint8_t  opcode;              /* TPU_MEMRING_OP_*                   */
+    uint8_t  flags;               /* TPU_MEMRING_SQE_*                  */
+    uint16_t dstTier;             /* UvmTier for MIGRATE/EVICT/ADVISE   */
+    uint32_t devInst;             /* HBM target / faulting device /
+                                   * PEER_COPY local device             */
+    uint64_t addr;                /* managed VA; PEER_COPY: local HBM
+                                   * arena offset                       */
+    uint64_t len;                 /* bytes                              */
+    uint64_t userData;            /* echoed in the CQE                  */
+    uint32_t peerInst;            /* PEER_COPY remote device            */
+    uint32_t arg0;                /* ADVISE subcode / PEER direction    */
+    uint64_t peerOff;             /* PEER_COPY peer HBM arena offset    */
+    uint64_t arg1;                /* ADVISE READ_DUP on/off             */
+    uint64_t pad;
+} TpuMemringSqe;
+
+/* Completion entry — exactly one cacheline. */
+typedef struct {
+    uint64_t userData;            /* cookie from the SQE                */
+    uint32_t status;              /* TpuStatus (TPU_OK on success)      */
+    uint32_t opcode;              /* the completed op                   */
+    uint64_t bytes;               /* bytes the op moved                 */
+    uint64_t seq;                 /* pop order (FIFO submission order)  */
+    uint64_t startNs, endNs;      /* execution window, tpuNowNs clock   */
+    uint64_t pad[2];
+} TpuMemringCqe;
+
+/* Shared-memory header (page 0 of the ring memfd).  The producer owns
+ * sqTail (release-published), the worker pool owns sqHead and cqTail,
+ * the consumer owns cqHead.  doorbell / cqReady are futex words bumped
+ * on submit / CQE post. */
+#ifdef __cplusplus
+#define TPU_MEMRING_ATOMIC_U32 uint32_t
+#define TPU_MEMRING_ATOMIC_U64 uint64_t
+#else
+#define TPU_MEMRING_ATOMIC_U32 _Atomic uint32_t
+#define TPU_MEMRING_ATOMIC_U64 _Atomic uint64_t
+#endif
+typedef struct {
+    TPU_MEMRING_ATOMIC_U32 sqHead;
+    TPU_MEMRING_ATOMIC_U32 sqTail;
+    TPU_MEMRING_ATOMIC_U32 cqHead;
+    TPU_MEMRING_ATOMIC_U32 cqTail;
+    uint32_t sqEntries;           /* power of two                       */
+    uint32_t cqEntries;           /* power of two (2x sqEntries)        */
+    uint32_t sqeSize, cqeSize;    /* ABI sanity for mapped consumers    */
+    TPU_MEMRING_ATOMIC_U32 doorbell;
+    TPU_MEMRING_ATOMIC_U32 cqReady;
+    /* Consumers parked (or about to park) on cqReady.  Workers wake
+     * the futex only when nonzero (io_uring's SQ_NEED_WAKEUP shape),
+     * so the per-CQE post path costs no syscall without a waiter. */
+    TPU_MEMRING_ATOMIC_U32 cqWaiters;
+    TPU_MEMRING_ATOMIC_U64 submitted;    /* SQEs ever published         */
+    TPU_MEMRING_ATOMIC_U64 completed;    /* CQEs ever posted            */
+    TPU_MEMRING_ATOMIC_U64 errorCqes;    /* CQEs with status != TPU_OK  */
+    TPU_MEMRING_ATOMIC_U64 cqOverflows;  /* CQEs dropped, CQ full       */
+} TpuMemringHdr;
+
+#define TPU_MEMRING_SQ_OFFSET 4096
+
+/* ----------------------------------------------------------------- API */
+
+typedef struct TpuMemring TpuMemring;
+
+/* Create a ring bound to `vs` (the VA space MIGRATE/PREFETCH/EVICT/
+ * ADVISE execute against; PEER_COPY and NOP/FENCE work with vs == NULL).
+ * sqEntries is rounded up to a power of two (default 256 when 0); the
+ * CQ holds 2x.  workers == 0 takes registry "memring_workers"
+ * (default 2).  The ring pins `vs`: destroy the ring before the space. */
+TpuStatus tpurmMemringCreate(struct UvmVaSpace *vs, uint32_t sqEntries,
+                             uint32_t workers, TpuMemring **out);
+void      tpurmMemringDestroy(TpuMemring *r);
+
+/* Stage one SQE into the next free SQ slot (NOT yet visible to the
+ * workers).  TPU_ERR_INSUFFICIENT_RESOURCES when the SQ is full —
+ * submit and reap first. */
+TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe);
+
+/* Publish every staged SQE (one release store + doorbell futex wake);
+ * returns the number newly submitted. */
+uint32_t  tpurmMemringSubmit(TpuMemring *r);
+
+/* Submit, then block until at least waitFor CQEs are reapable
+ * (waitFor == 0: no wait).  Returns the number submitted.  NOTE: the
+ * wait's status is discarded (a convenience for reap-everything
+ * callers); when a timeout or the CQ-overflow bail must be observed,
+ * call tpurmMemringSubmit + tpurmMemringWait/WaitDrain yourself. */
+uint32_t  tpurmMemringSubmitAndWait(TpuMemring *r, uint32_t waitFor);
+
+/* Reap up to max completions into out; returns the count reaped. */
+uint32_t  tpurmMemringReap(TpuMemring *r, TpuMemringCqe *out, uint32_t max);
+
+/* Park until at least n CQEs are reapable or timeoutNs elapses
+ * (timeoutNs == 0: wait forever).  TPU_OK when n are reapable;
+ * TPU_ERR_RETRY_EXHAUSTED on timeout;
+ * TPU_ERR_INSUFFICIENT_RESOURCES when the wait can never be satisfied
+ * because CQEs were dropped on CQ overflow (nothing left in flight). */
+TpuStatus tpurmMemringWait(TpuMemring *r, uint32_t n, uint64_t timeoutNs);
+
+/* Park until EVERY SQE submitted so far has posted its CQE
+ * (completed == submitted) or timeoutNs elapses (0: wait forever).
+ * Unlike tpurmMemringWait this keys off completion COUNTS, not
+ * reapable CQEs, so unreaped backlog can't satisfy it early and CQ
+ * overflow can't starve it.  TPU_OK on drain;
+ * TPU_ERR_RETRY_EXHAUSTED on timeout. */
+TpuStatus tpurmMemringWaitDrain(TpuMemring *r, uint64_t timeoutNs);
+
+/* Free SQ slots available for tpurmMemringPrep. */
+uint32_t  tpurmMemringSqSpace(TpuMemring *r);
+
+/* Lifetime accounting (also visible in the shared header). */
+void tpurmMemringCounts(TpuMemring *r, uint64_t *submitted,
+                        uint64_t *completed, uint64_t *errorCqes,
+                        uint64_t *cqOverflows);
+
+/* The memfd backing the ring region (header + SQ + CQ): map it for
+ * external observation; dup before shipping cross-process. */
+int tpurmMemringShmFd(TpuMemring *r);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_MEMRING_H */

@@ -67,6 +67,8 @@ typedef enum {
     TPU_TRACE_ICI_RETRAIN,       /* soft-link retrain pass             */
     TPU_TRACE_RDMA_PIN,          /* MR pin + DMA map                   */
     TPU_TRACE_MSGQ_PUBLISH,      /* msgq submit                        */
+    TPU_TRACE_MEMRING_SUBMIT,    /* memring batch publish + doorbell   */
+    TPU_TRACE_MEMRING_OP,        /* one memring run (coalesced span)   */
     TPU_TRACE_APP,               /* application span (Python utils.span) */
     /* Instant-only sites. */
     TPU_TRACE_INJECT_HIT,        /* injection framework fired          */

@@ -107,8 +107,14 @@ typedef struct {
  * map, signal-page memfd for the first event). */
 #define BR_REP_FLAG_FD     0x1u
 /* A whole client root was freed: every event relay the shim runs for
- * this connection is dead — stop them all. */
+ * this connection is dead — stop them all.  Legacy over-kill form:
+ * superseded by BR_REP_FLAG_EV_MASK (kept for wire-compat reading). */
 #define BR_REP_FLAG_EV_ALL 0x2u
+/* A client-root free retired a SET of event slots: rep.mapOffset
+ * (unused in FREE replies) carries the slot bitmask (bit i = slot i),
+ * so the shim stops exactly those relays — a connection serving TWO
+ * client roots keeps the survivor's relays running. */
+#define BR_REP_FLAG_EV_MASK 0x4u
 
 typedef struct {
     uint32_t op;
@@ -748,15 +754,27 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
             tpurmFree(&p);
             if (p.status == TPU_OK) {
                 if (p.hObjectOld == real) {
-                    /* Whole client root freed: every event under it is
-                     * gone — stop all of this connection's forwarders
-                     * registered against that client, and tell the
-                     * shim to retire its relays too. */
+                    /* Whole client root freed: every event under THAT
+                     * client is gone — stop this connection's
+                     * forwarders registered against it and return the
+                     * retired-slot set (bitmask in the unused
+                     * rep.mapOffset) so the shim stops exactly those
+                     * relays.  The old BR_REP_FLAG_EV_ALL reply killed
+                     * every relay on the connection, including ones
+                     * belonging to a different, still-live client
+                     * root. */
                     for (int i = 0; i < BROKER_EV_SLOTS; i++)
                         if (c->evSlots[i].used &&
                             c->evSlots[i].clientH == real) {
                             conn_ev_slot_stop(&c->evSlots[i]);
-                            rep->flags |= BR_REP_FLAG_EV_ALL;
+                            /* EV_ALL rides along for shims that predate
+                             * EV_MASK: they fall back to the old
+                             * stop-everything behaviour (safe, merely
+                             * over-broad); mask-aware shims test
+                             * EV_MASK first and stop only these. */
+                            rep->flags |= BR_REP_FLAG_EV_MASK |
+                                          BR_REP_FLAG_EV_ALL;
+                            rep->mapOffset |= 1ull << i;
                         }
                     conn_unmap_client(c, clientH);
                 } else {
@@ -1533,9 +1551,16 @@ int tpurmBrokerIoctl(int fd, unsigned long request, void *argp)
             }
         }
     } else if (rc == 0 && nr == TPU_ESC_RM_FREE) {
-        if (rep.flags & BR_REP_FLAG_EV_ALL) {
-            /* Whole client root freed server-side: every relay on this
-             * connection is dead. */
+        if (rep.flags & BR_REP_FLAG_EV_MASK) {
+            /* Client root freed server-side: stop exactly the relays
+             * whose slots the server retired (bitmask in mapOffset) —
+             * relays serving another client root keep running. */
+            for (uint32_t i = 0; i < BROKER_EV_SLOTS; i++)
+                if (rep.mapOffset & (1ull << i))
+                    cli_ev_slot_stop(i);
+        } else if (rep.flags & BR_REP_FLAG_EV_ALL) {
+            /* Legacy over-kill reply (older server): every relay on
+             * this connection is dead. */
             for (uint32_t i = 0; i < BROKER_EV_SLOTS; i++)
                 cli_ev_slot_stop(i);
         } else if (rep.slot) {

@@ -363,12 +363,22 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
     /* Completion notification is SCOPED to the requesting client: a
      * second client armed on the same notifier must not hear someone
      * else's transfer complete (its own copy-back ordering depends on
-     * its own completions). */
-    if (st == TPU_OK)
+     * its own completions).  When the requesting client has NO armed
+     * listener of its own, fall back to BROADCAST delivery so a pure
+     * observer (a monitor client armed on the notifier without issuing
+     * DMA) still hears the completion instead of it being silently
+     * dropped — see the TPU_NOTIFIER_CXL_DMA contract in abi.h. */
+    if (st == TPU_OK) {
+        uint32_t evScope = hClient;
+        if (evScope && !tpurmEventArmedForClient(dev->inst,
+                                                 TPU_NOTIFIER_CXL_DMA,
+                                                 evScope))
+            evScope = 0;
         tpurmEventNotifyTrackerScoped(&dmaTracker, dev->inst,
-                                      TPU_NOTIFIER_CXL_DMA, hClient,
+                                      TPU_NOTIFIER_CXL_DMA, evScope,
                                       /*info32=*/1,
                                       (uint16_t)(cxlToDev ? 1 : 0));
+    }
     tpuTrackerDeinit(&dmaTracker);
 
     if (st != TPU_OK) {

@@ -227,6 +227,16 @@ bool tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex)
     return event_armed_scoped(devInst, notifyIndex, 0);
 }
 
+/* Does THIS client hold an armed listener at (devInst, notifyIndex)?
+ * Completion-style notifiers use it to decide between client-scoped
+ * delivery and the broadcast fallback (see cxl.c / abi.h
+ * TPU_NOTIFIER_CXL_DMA contract). */
+bool tpurmEventArmedForClient(uint32_t devInst, uint32_t notifyIndex,
+                              uint32_t hClient)
+{
+    return event_armed_scoped(devInst, notifyIndex, hClient);
+}
+
 /* ---------------------------------------------------- completion worker */
 
 static void *event_worker(void *arg)

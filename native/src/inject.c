@@ -50,6 +50,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "rdma.completion",
     "channel.ce",
     "fence.timeout",
+    "memring.submit",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -61,6 +62,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "RDMA_COMPLETION",
     "CHANNEL_CE",
     "FENCE_TIMEOUT",
+    "MEMRING_SUBMIT",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)

@@ -322,6 +322,9 @@ TpuStatus tpurmEventNotifyTrackerScoped(const TpuTracker *deps,
 void      tpurmEventFire(uint32_t devInst, uint32_t notifyIndex,
                          uint32_t info32, uint16_t info16);
 bool      tpurmEventArmed(uint32_t devInst, uint32_t notifyIndex);
+/* True when hClient itself holds an armed listener at the notifier. */
+bool      tpurmEventArmedForClient(uint32_t devInst, uint32_t notifyIndex,
+                                   uint32_t hClient);
 TpuStatus tpurmEventNotifyTracker(const TpuTracker *deps, uint32_t devInst,
                                   uint32_t notifyIndex, uint32_t info32,
                                   uint16_t info16);

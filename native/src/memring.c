@@ -1,0 +1,843 @@
+/*
+ * tpumemring — async memory-op submission/completion rings (memring.h).
+ *
+ * Structure:
+ *   - one memfd region: header page + SQ array + CQ array (both rings
+ *     power-of-two, cacheline entries);
+ *   - producer side lock-free (prep fills slots, submit release-stores
+ *     sqTail and futex-wakes the doorbell);
+ *   - a worker pool pops under a mutex (chains and fences need an
+ *     ordered, atomic claim), executes OUTSIDE the lock, and posts
+ *     CQEs under a short CQ lock;
+ *   - FENCE drains: the popper holds the pop lock while waiting for
+ *     in-flight ops to retire, so nothing later can be claimed until
+ *     the fence completes (IOSQE_IO_DRAIN semantics);
+ *   - LINK chains are claimed whole and executed sequentially by one
+ *     worker; the first failure cancels the chain's remainder;
+ *   - runs of compatible non-linked ops are COALESCED into single
+ *     engine calls (one uvmMigrate over a merged span instead of one
+ *     per 64 KB SQE) — the batching win the ring exists for.
+ *
+ * Recovery: each run evaluates the memring.submit injection site and
+ * retries transient failures with bounded backoff; exhaustion posts
+ * error CQEs (the ring never tears down on op failure).  Exact
+ * accounting invariant, kept test-checkable:
+ *     memring.submit inject hits ==
+ *         memring_inject_retries + memring_inject_error_runs
+ * (every hit either triggered a retry or terminally failed its run).
+ */
+#define _GNU_SOURCE
+#include "tpurm/memring.h"
+
+#include <errno.h>
+#include <linux/futex.h>
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdbool.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "internal.h"
+#include "tpurm/ici.h"
+#include "tpurm/inject.h"
+#include "tpurm/trace.h"
+#include "tpurm/uvm.h"
+
+#define MEMRING_MAX_WORKERS 8
+#define MEMRING_POP_BATCH   64     /* max non-linked ops claimed per pop */
+#define MEMRING_APERTURES   8      /* cached ICI peer apertures per ring */
+
+struct TpuMemring {
+    UvmVaSpace *vs;
+    int shmFd;
+    void *shm;
+    size_t shmSize;
+    TpuMemringHdr *hdr;
+    TpuMemringSqe *sq;
+    TpuMemringCqe *cq;
+    uint32_t sqMask, cqMask;
+
+    /* Producer-private staging cursor (slots filled but unpublished). */
+    uint32_t pendTail;
+    /* Length of the currently-open (unterminated) LINK chain being
+     * staged — chains are capped at MEMRING_POP_BATCH so a worker can
+     * always claim one whole (claimed-whole execution semantics). */
+    uint32_t pendChain;
+
+    /* Pop path: FIFO claim + fence drain + inflight accounting.
+     * inflight is atomic so the per-CQE retire never touches popLock;
+     * drainWaiters gates the drainCond broadcast the same way
+     * hdr->cqWaiters gates the CQ futex wake (register BEFORE the last
+     * predicate re-check — seq_cst total order rules out the lost
+     * wakeup). */
+    pthread_mutex_t popLock;
+    pthread_cond_t drainCond;
+    atomic_uint inflight;         /* claimed, CQE not yet posted */
+    atomic_uint drainWaiters;     /* fence workers parked on drainCond */
+    uint64_t popSeq;              /* total SQEs ever claimed      */
+
+    pthread_mutex_t cqLock;
+
+    /* ICI peer-aperture cache (created on first PEER_COPY per pair). */
+    pthread_mutex_t apLock;
+    struct {
+        uint32_t src, peer;
+        TpuIciPeerAperture *ap;
+    } apertures[MEMRING_APERTURES];
+    uint32_t apCount;
+
+    pthread_t workers[MEMRING_MAX_WORKERS];
+    uint32_t workerCount;
+    _Atomic bool shutdown;
+};
+
+static long mr_futex(TPU_MEMRING_ATOMIC_U32 *uaddr, int op, uint32_t val,
+                     const struct timespec *ts)
+{
+    return syscall(SYS_futex, uaddr, op | FUTEX_PRIVATE_FLAG, val, ts,
+                   NULL, 0);
+}
+
+static uint32_t pow2_at_least(uint32_t v, uint32_t floor)
+{
+    uint32_t p = floor;
+    while (p < v)
+        p <<= 1;
+    return p;
+}
+
+/* ------------------------------------------------------------ CQE post */
+
+static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
+                     TpuStatus st, uint64_t bytes, uint64_t seq,
+                     uint64_t t0, uint64_t t1, bool countInflight)
+{
+    pthread_mutex_lock(&r->cqLock);
+    uint32_t head = atomic_load_explicit(&r->hdr->cqHead,
+                                         memory_order_acquire);
+    uint32_t tail = atomic_load_explicit(&r->hdr->cqTail,
+                                         memory_order_relaxed);
+    if (tail - head >= r->hdr->cqEntries) {
+        /* Consumer asleep at the wheel: drop + count, never block the
+         * pool (fences key off `completed`, not off CQ slots). */
+        atomic_fetch_add(&r->hdr->cqOverflows, 1);
+        tpuCounterAdd("memring_cq_overflows", 1);
+    } else {
+        TpuMemringCqe *c = &r->cq[tail & r->cqMask];
+        c->userData = sqe->userData;
+        c->status = (uint32_t)st;
+        c->opcode = sqe->opcode;
+        c->bytes = bytes;
+        c->seq = seq;
+        c->startNs = t0;
+        c->endNs = t1;
+        c->pad[0] = c->pad[1] = 0;
+        atomic_store_explicit(&r->hdr->cqTail, tail + 1,
+                              memory_order_release);
+    }
+    atomic_fetch_add(&r->hdr->completed, 1);
+    if (st != TPU_OK) {
+        atomic_fetch_add(&r->hdr->errorCqes, 1);
+        tpuCounterAdd("memring_error_cqes", 1);
+    }
+    tpuCounterAdd("memring_cqes", 1);
+    atomic_fetch_add(&r->hdr->cqReady, 1);
+    pthread_mutex_unlock(&r->cqLock);
+    /* Wake only when a consumer is (about to be) parked: the waiter
+     * registers in cqWaiters BEFORE its last availability re-check, so
+     * a zero read here (seq_cst, after the cqReady bump) means any
+     * concurrent waiter will see this CQE, or see cqReady changed and
+     * fail its FUTEX_WAIT with EAGAIN — never a lost wakeup.  Saves a
+     * syscall per CQE on the waiter-free fast path. */
+    if (atomic_load(&r->hdr->cqWaiters) != 0)
+        mr_futex(&r->hdr->cqReady, FUTEX_WAKE, INT32_MAX, NULL);
+
+    if (countInflight) {
+        atomic_fetch_sub(&r->inflight, 1);
+        /* Broadcast only when a fence worker is (about to be) parked:
+         * the waiter registers in drainWaiters before its predicate
+         * re-check, and we must take popLock to broadcast, so the wake
+         * cannot slip between that check and the cond_wait.  The
+         * common fence-free retire stays off the pop mutex. */
+        if (atomic_load(&r->drainWaiters) != 0) {
+            pthread_mutex_lock(&r->popLock);
+            pthread_cond_broadcast(&r->drainCond);
+            pthread_mutex_unlock(&r->popLock);
+        }
+    }
+}
+
+/* ------------------------------------------------------- op execution */
+
+/* Cached aperture for (src, peer), creating + caching on first use.
+ * When the cache is full the aperture is created UNCACHED and
+ * *tempOut tells the caller to destroy it after the copy — a cold
+ * cache must degrade to slower, not to a permanent wrong error. */
+static TpuIciPeerAperture *aperture_get(TpuMemring *r, uint32_t src,
+                                        uint32_t peer, bool *tempOut)
+{
+    TpuIciPeerAperture *ap = NULL;
+    *tempOut = false;
+    pthread_mutex_lock(&r->apLock);
+    for (uint32_t i = 0; i < r->apCount; i++)
+        if (r->apertures[i].src == src && r->apertures[i].peer == peer) {
+            ap = r->apertures[i].ap;
+            break;
+        }
+    if (!ap && tpuIciPeerApertureCreate(src, peer, &ap) == TPU_OK) {
+        if (r->apCount < MEMRING_APERTURES) {
+            r->apertures[r->apCount].src = src;
+            r->apertures[r->apCount].peer = peer;
+            r->apertures[r->apCount].ap = ap;
+            r->apCount++;
+        } else {
+            *tempOut = true;
+        }
+    }
+    pthread_mutex_unlock(&r->apLock);
+    return ap;
+}
+
+/* One engine call for one SQE (runs are pre-merged by the caller, which
+ * extends `len` over a coalesced span). */
+static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
+                          uint64_t len, uint64_t *bytesOut)
+{
+    *bytesOut = 0;
+    switch (sqe->opcode) {
+    case TPU_MEMRING_OP_NOP:
+        return TPU_OK;
+    case TPU_MEMRING_OP_MIGRATE: {
+        if (!r->vs)
+            return TPU_ERR_INVALID_STATE;
+        UvmLocation loc = { (UvmTier)sqe->dstTier, sqe->devInst };
+        TpuStatus st = uvmMigrate(r->vs, (void *)(uintptr_t)sqe->addr,
+                                  len, loc, 0);
+        if (st == TPU_OK)
+            *bytesOut = len;
+        return st;
+    }
+    case TPU_MEMRING_OP_PREFETCH: {
+        if (!r->vs)
+            return TPU_ERR_INVALID_STATE;
+        TpuStatus st = uvmDeviceAccess(r->vs, sqe->devInst,
+                                       (void *)(uintptr_t)sqe->addr, len,
+                                       (sqe->flags & TPU_MEMRING_SQE_WRITE)
+                                           ? 1 : 0);
+        if (st == TPU_OK)
+            *bytesOut = len;
+        return st;
+    }
+    case TPU_MEMRING_OP_EVICT: {
+        if (!r->vs)
+            return TPU_ERR_INVALID_STATE;
+        /* Tier DEMOTE only: HBM is a promotion, not an eviction. */
+        if (sqe->dstTier != UVM_TIER_HOST && sqe->dstTier != UVM_TIER_CXL)
+            return TPU_ERR_INVALID_ARGUMENT;
+        UvmLocation loc = { (UvmTier)sqe->dstTier, 0 };
+        TpuStatus st = uvmMigrate(r->vs, (void *)(uintptr_t)sqe->addr,
+                                  len, loc, 0);
+        if (st == TPU_OK)
+            *bytesOut = len;
+        return st;
+    }
+    case TPU_MEMRING_OP_ADVISE: {
+        if (!r->vs)
+            return TPU_ERR_INVALID_STATE;
+        void *addr = (void *)(uintptr_t)sqe->addr;
+        switch (sqe->arg0) {
+        case TPU_MEMRING_ADVISE_PREFERRED: {
+            UvmLocation loc = { (UvmTier)sqe->dstTier, sqe->devInst };
+            return uvmSetPreferredLocation(r->vs, addr, len, loc);
+        }
+        case TPU_MEMRING_ADVISE_UNSET_PREFERRED:
+            return uvmUnsetPreferredLocation(r->vs, addr, len);
+        case TPU_MEMRING_ADVISE_ACCESSED_BY:
+            return uvmSetAccessedBy(r->vs, addr, len, sqe->devInst);
+        case TPU_MEMRING_ADVISE_UNSET_ACCESSED_BY:
+            return uvmUnsetAccessedBy(r->vs, addr, len, sqe->devInst);
+        case TPU_MEMRING_ADVISE_READ_DUP:
+            return uvmSetReadDuplication(r->vs, addr, len,
+                                         sqe->arg1 ? 1 : 0);
+        default:
+            return TPU_ERR_INVALID_ARGUMENT;
+        }
+    }
+    case TPU_MEMRING_OP_PEER_COPY: {
+        bool temp = false;
+        TpuIciPeerAperture *ap = aperture_get(r, sqe->devInst,
+                                              sqe->peerInst, &temp);
+        if (!ap)
+            return TPU_ERR_INVALID_DEVICE;
+        TpuStatus st = tpuIciPeerCopy(ap, sqe->addr, sqe->peerOff, len,
+                                      sqe->arg0 == TPU_MEMRING_PEER_READ
+                                          ? 1 : 0);
+        if (temp)
+            tpuIciPeerApertureDestroy(ap);
+        if (st == TPU_OK)
+            *bytesOut = len;
+        return st;
+    }
+    default:
+        return TPU_ERR_INVALID_COMMAND;
+    }
+}
+
+/* Fail-fast statuses: argument/state validation that a retry can never
+ * change (bounded retry is for transients). */
+static bool status_permanent(TpuStatus st)
+{
+    switch (st) {
+    case TPU_ERR_INVALID_ARGUMENT:
+    case TPU_ERR_INVALID_ADDRESS:
+    case TPU_ERR_INVALID_DEVICE:
+    case TPU_ERR_INVALID_COMMAND:
+    case TPU_ERR_INVALID_STATE:
+    case TPU_ERR_OBJECT_NOT_FOUND:
+        return true;
+    default:
+        return false;
+    }
+}
+
+static TpuRegCache g_retryCache;
+
+/* Execute one RUN (one engine call over a possibly-coalesced span) with
+ * injection + bounded-backoff retry.  The run is the failure domain:
+ * one inject evaluation per attempt, mirroring one coalesced DMA.
+ * Invariant (exact, test-checked): every memring.submit inject hit
+ * bumps exactly one of memring_inject_retries /
+ * memring_inject_error_runs.  *injectedFail reports whether the
+ * TERMINAL failure came from injection (callers attribute the run's
+ * error CQEs). */
+static TpuStatus exec_run_recovered(TpuMemring *r,
+                                    const TpuMemringSqe *sqe,
+                                    uint64_t len, uint64_t *bytesOut,
+                                    bool *injectedFail)
+{
+    uint32_t maxRetry = (uint32_t)tpuRegCacheGet(&g_retryCache,
+                                                 "memring_retry_max", 3);
+    *injectedFail = false;
+    for (uint32_t attempt = 0;; attempt++) {
+        TpuStatus st;
+        bool injected = tpurmInjectShouldFailScoped(
+            TPU_INJECT_SITE_MEMRING_SUBMIT, sqe->userData);
+        if (injected)
+            st = TPU_ERR_RETRY_EXHAUSTED;   /* transient by construction */
+        else
+            st = exec_sqe(r, sqe, len, bytesOut);
+        if (st == TPU_OK)
+            return TPU_OK;
+        if (!injected && status_permanent(st))
+            return st;
+        if (attempt >= maxRetry) {
+            if (injected) {
+                tpuCounterAdd("memring_inject_error_runs", 1);
+                *injectedFail = true;
+            }
+            return st;
+        }
+        tpuCounterAdd("memring_retries", 1);
+        tpuCounterAdd("recover_retries", 1);
+        if (injected)
+            tpuCounterAdd("memring_inject_retries", 1);
+        tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, sqe->userData, 0);
+        tpuRecoverBackoff(attempt);
+    }
+}
+
+/* ------------------------------------------------------- worker drain */
+
+/* Can SQE b extend a run started by SQE a into one engine call? */
+static bool run_merges(const TpuMemringSqe *a, uint64_t runEnd,
+                       const TpuMemringSqe *b)
+{
+    if (b->opcode != a->opcode || b->flags != a->flags)
+        return false;
+    if (a->opcode != TPU_MEMRING_OP_MIGRATE &&
+        a->opcode != TPU_MEMRING_OP_PREFETCH &&
+        a->opcode != TPU_MEMRING_OP_EVICT)
+        return false;
+    if (b->dstTier != a->dstTier || b->devInst != a->devInst)
+        return false;
+    return b->addr == runEnd;      /* virtually contiguous */
+}
+
+/* Execute batch[0..n) (no links, no fences): coalesce contiguous
+ * compatible spans, run each merged span once, post per-SQE CQEs. */
+static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
+                       uint32_t n, uint64_t firstSeq)
+{
+    uint32_t i = 0;
+    while (i < n) {
+        uint32_t runLen = 1;
+        uint64_t spanLen = batch[i].len;
+        while (i + runLen < n &&
+               run_merges(&batch[i], batch[i].addr + spanLen,
+                          &batch[i + runLen])) {
+            spanLen += batch[i + runLen].len;
+            runLen++;
+        }
+        if (runLen > 1)
+            tpuCounterAdd("memring_coalesced_sqes", runLen);
+        uint64_t t0 = tpuNowNs();
+        uint64_t moved = 0;
+        bool injectedFail = false;
+        uint64_t tSpan = tpurmTraceBegin();
+        TpuStatus st = exec_run_recovered(r, &batch[i], spanLen, &moved,
+                                          &injectedFail);
+        if (tSpan)
+            tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan,
+                          batch[i].userData, spanLen);
+        uint64_t t1 = tpuNowNs();
+        tpuCounterAdd("memring_ops", runLen);
+        if (injectedFail)
+            tpuCounterAdd("memring_inject_error_cqes", runLen);
+        for (uint32_t k = 0; k < runLen; k++)
+            /* Shared status; bytes attributed per-SQE.  Merged runs
+             * (always move ops) split the span by each SQE's len; a
+             * lone op reports what exec_sqe actually moved, so ADVISE/
+             * NOP post bytes == 0 here exactly as they do in chains. */
+            post_cqe(r, &batch[i + k], st,
+                     st != TPU_OK ? 0
+                                  : (runLen > 1 ? batch[i + k].len
+                                                : moved),
+                     firstSeq + i + k, t0, t1, true);
+        i += runLen;
+    }
+}
+
+/* Execute a LINK chain sequentially; first failure cancels the rest. */
+static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
+                       uint32_t n, uint64_t firstSeq)
+{
+    bool cancelled = false;
+    for (uint32_t i = 0; i < n; i++) {
+        if (cancelled) {
+            uint64_t now = tpuNowNs();
+            tpuCounterAdd("memring_links_cancelled", 1);
+            post_cqe(r, &chain[i], TPU_ERR_INVALID_STATE, 0,
+                     firstSeq + i, now, now, true);
+            continue;
+        }
+        uint64_t t0 = tpuNowNs();
+        uint64_t moved = 0;
+        bool injectedFail = false;
+        uint64_t tSpan = tpurmTraceBegin();
+        TpuStatus st = exec_run_recovered(r, &chain[i], chain[i].len,
+                                          &moved, &injectedFail);
+        if (tSpan)
+            tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan, chain[i].userData,
+                          chain[i].len);
+        tpuCounterAdd("memring_ops", 1);
+        if (injectedFail)
+            tpuCounterAdd("memring_inject_error_cqes", 1);
+        post_cqe(r, &chain[i], st, moved, firstSeq + i, t0, tpuNowNs(),
+                 true);
+        if (st != TPU_OK)
+            cancelled = true;
+    }
+}
+
+static void *worker_main(void *arg)
+{
+    TpuMemring *r = arg;
+    TpuMemringSqe local[MEMRING_POP_BATCH];
+
+    for (;;) {
+        pthread_mutex_lock(&r->popLock);
+        uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
+                                             memory_order_relaxed);
+        uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
+                                             memory_order_acquire);
+        if (atomic_load(&r->shutdown) && head == tail) {
+            pthread_mutex_unlock(&r->popLock);
+            break;
+        }
+        if (head == tail) {
+            pthread_mutex_unlock(&r->popLock);
+            uint32_t d = atomic_load(&r->hdr->doorbell);
+            /* Re-check after snapshotting the doorbell so a submit
+             * between the check and the wait cannot be missed. */
+            if (atomic_load_explicit(&r->hdr->sqTail,
+                                     memory_order_acquire) ==
+                    atomic_load_explicit(&r->hdr->sqHead,
+                                         memory_order_relaxed) &&
+                !atomic_load(&r->shutdown)) {
+                /* No timeout needed: the doorbell value re-check above
+                 * makes a missed wake impossible (a submit between the
+                 * check and the wait changes the word and WAIT returns
+                 * EAGAIN), and destroy bumps + wakes before each join. */
+                mr_futex(&r->hdr->doorbell, FUTEX_WAIT, d, NULL);
+            }
+            continue;
+        }
+
+        const TpuMemringSqe *first = &r->sq[head & r->sqMask];
+        if (first->opcode == TPU_MEMRING_OP_FENCE) {
+            /* Drain: nothing later can be claimed until every
+             * in-flight op retires.  cond_wait RELEASES the pop lock,
+             * so another worker may consume this same fence while we
+             * sleep — after any wakeup, loop back and re-read
+             * head/tail fresh instead of trusting the stale claim. */
+            atomic_fetch_add(&r->drainWaiters, 1);
+            if (atomic_load(&r->inflight) > 0 &&
+                !atomic_load(&r->shutdown)) {
+                pthread_cond_wait(&r->drainCond, &r->popLock);
+                atomic_fetch_sub(&r->drainWaiters, 1);
+                pthread_mutex_unlock(&r->popLock);
+                continue;
+            }
+            atomic_fetch_sub(&r->drainWaiters, 1);
+            TpuMemringSqe fence = *first;
+            uint64_t seq = r->popSeq++;
+            atomic_store_explicit(&r->hdr->sqHead, head + 1,
+                                  memory_order_release);
+            pthread_mutex_unlock(&r->popLock);
+            uint64_t now = tpuNowNs();
+            tpuCounterAdd("memring_fences", 1);
+            post_cqe(r, &fence, TPU_OK, 0, seq, now, now, false);
+            continue;
+        }
+
+        uint32_t n = 0;
+        bool chain = (first->flags & TPU_MEMRING_SQE_LINK) != 0;
+        if (chain) {
+            /* Claim the whole chain (terminated by a no-LINK entry or
+             * the publication boundary). */
+            while (head + n != tail && n < MEMRING_POP_BATCH) {
+                local[n] = r->sq[(head + n) & r->sqMask];
+                n++;
+                if (!(local[n - 1].flags & TPU_MEMRING_SQE_LINK))
+                    break;
+            }
+        } else {
+            /* Claim a run of plain ops, stopping before any FENCE or
+             * chain start. */
+            while (head + n != tail && n < MEMRING_POP_BATCH) {
+                const TpuMemringSqe *s = &r->sq[(head + n) & r->sqMask];
+                if (s->opcode == TPU_MEMRING_OP_FENCE ||
+                    (s->flags & TPU_MEMRING_SQE_LINK))
+                    break;
+                local[n++] = *s;
+            }
+        }
+        uint64_t firstSeq = r->popSeq;
+        r->popSeq += n;
+        atomic_fetch_add(&r->inflight, n);
+        atomic_store_explicit(&r->hdr->sqHead, head + n,
+                              memory_order_release);
+        pthread_mutex_unlock(&r->popLock);
+
+        if (chain)
+            exec_chain(r, local, n, firstSeq);
+        else
+            exec_batch(r, local, n, firstSeq);
+    }
+    return NULL;
+}
+
+/* ------------------------------------------------------------ lifecycle */
+
+TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
+                             uint32_t workers, TpuMemring **out)
+{
+    if (!out)
+        return TPU_ERR_INVALID_ARGUMENT;
+    _Static_assert(sizeof(TpuMemringSqe) == 64, "SQE must be 64 bytes");
+    _Static_assert(sizeof(TpuMemringCqe) == 64, "CQE must be 64 bytes");
+
+    if (sqEntries == 0)
+        sqEntries = 256;
+    /* Bound BEFORE rounding: pow2_at_least on a value past 2^31 would
+     * overflow its shift to 0 and never terminate. */
+    if (sqEntries > (1u << 16))
+        return TPU_ERR_INVALID_LIMIT;
+    sqEntries = pow2_at_least(sqEntries, 8);
+    uint32_t cqEntries = sqEntries * 2;
+    if (workers == 0)
+        workers = (uint32_t)tpuRegistryGet("memring_workers", 2);
+    if (workers > MEMRING_MAX_WORKERS)
+        workers = MEMRING_MAX_WORKERS;
+
+    TpuMemring *r = calloc(1, sizeof(*r));
+    if (!r)
+        return TPU_ERR_NO_MEMORY;
+
+    size_t sqBytes = (size_t)sqEntries * sizeof(TpuMemringSqe);
+    size_t cqBytes = (size_t)cqEntries * sizeof(TpuMemringCqe);
+    r->shmSize = TPU_MEMRING_SQ_OFFSET + sqBytes + cqBytes;
+    r->shmFd = memfd_create("tpumemring", MFD_CLOEXEC);
+    if (r->shmFd < 0 || ftruncate(r->shmFd, (off_t)r->shmSize) != 0) {
+        if (r->shmFd >= 0)
+            close(r->shmFd);
+        free(r);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+    r->shm = mmap(NULL, r->shmSize, PROT_READ | PROT_WRITE, MAP_SHARED,
+                  r->shmFd, 0);
+    if (r->shm == MAP_FAILED) {
+        close(r->shmFd);
+        free(r);
+        return TPU_ERR_NO_MEMORY;
+    }
+    r->hdr = r->shm;
+    r->sq = (TpuMemringSqe *)((char *)r->shm + TPU_MEMRING_SQ_OFFSET);
+    r->cq = (TpuMemringCqe *)((char *)r->shm + TPU_MEMRING_SQ_OFFSET +
+                              sqBytes);
+    r->hdr->sqEntries = sqEntries;
+    r->hdr->cqEntries = cqEntries;
+    r->hdr->sqeSize = sizeof(TpuMemringSqe);
+    r->hdr->cqeSize = sizeof(TpuMemringCqe);
+    r->sqMask = sqEntries - 1;
+    r->cqMask = cqEntries - 1;
+    r->vs = vs;
+    pthread_mutex_init(&r->popLock, NULL);
+    pthread_cond_init(&r->drainCond, NULL);
+    pthread_mutex_init(&r->cqLock, NULL);
+    pthread_mutex_init(&r->apLock, NULL);
+
+    r->workerCount = workers;
+    for (uint32_t i = 0; i < workers; i++) {
+        if (pthread_create(&r->workers[i], NULL, worker_main, r) != 0) {
+            r->workerCount = i;
+            tpurmMemringDestroy(r);
+            return TPU_ERR_OPERATING_SYSTEM;
+        }
+    }
+    tpuCounterAdd("memring_rings_created", 1);
+    tpuLog(TPU_LOG_INFO, "memring",
+           "ring created: sq=%u cq=%u workers=%u", sqEntries, cqEntries,
+           workers);
+    *out = r;
+    return TPU_OK;
+}
+
+void tpurmMemringDestroy(TpuMemring *r)
+{
+    if (!r)
+        return;
+    atomic_store(&r->shutdown, true);
+    /* Wake sleepers: poppers on the doorbell, drain-waiters on cond. */
+    atomic_fetch_add(&r->hdr->doorbell, 1);
+    mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+    pthread_mutex_lock(&r->popLock);
+    pthread_cond_broadcast(&r->drainCond);
+    pthread_mutex_unlock(&r->popLock);
+    for (uint32_t i = 0; i < r->workerCount; i++) {
+        /* Workers drain the published SQ before exiting; keep waking
+         * in case one raced into a futex wait. */
+        atomic_fetch_add(&r->hdr->doorbell, 1);
+        mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+        pthread_join(r->workers[i], NULL);
+    }
+    for (uint32_t i = 0; i < r->apCount; i++)
+        tpuIciPeerApertureDestroy(r->apertures[i].ap);
+    munmap(r->shm, r->shmSize);
+    close(r->shmFd);
+    pthread_mutex_destroy(&r->popLock);
+    pthread_cond_destroy(&r->drainCond);
+    pthread_mutex_destroy(&r->cqLock);
+    pthread_mutex_destroy(&r->apLock);
+    free(r);
+}
+
+/* ------------------------------------------------------- producer side */
+
+TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe)
+{
+    if (!r || !sqe)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (sqe->opcode >= TPU_MEMRING_OP_COUNT)
+        return TPU_ERR_INVALID_COMMAND;
+    /* Chains must fit one worker claim (claimed-whole semantics): a
+     * longer chain would be split across workers, breaking ordering
+     * and cancel-on-failure. */
+    if (r->pendChain + 1 > MEMRING_POP_BATCH)
+        return TPU_ERR_INVALID_LIMIT;
+    uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
+                                         memory_order_acquire);
+    if (r->pendTail - head >= r->hdr->sqEntries)
+        return TPU_ERR_INSUFFICIENT_RESOURCES;
+    r->sq[r->pendTail & r->sqMask] = *sqe;
+    r->pendTail++;
+    r->pendChain = (sqe->flags & TPU_MEMRING_SQE_LINK)
+                       ? r->pendChain + 1 : 0;
+    return TPU_OK;
+}
+
+uint32_t tpurmMemringSubmit(TpuMemring *r)
+{
+    if (!r)
+        return 0;
+    uint64_t tSpan = tpurmTraceBegin();
+    uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
+                                         memory_order_relaxed);
+    uint32_t n = r->pendTail - tail;
+    if (n == 0)
+        return 0;
+    /* The publication boundary terminates any open chain (header
+     * contract).  ENFORCE it in the ring itself: an open chain's last
+     * staged SQE still carries LINK, and a worker walking the chain
+     * from it would absorb whatever a LATER submit publishes next into
+     * the chain (cancelling independent ops on a chain failure).  The
+     * entry is still unpublished (sqTail not yet released), so clearing
+     * the flag here is race-free. */
+    if (r->pendChain > 0) {
+        r->sq[(r->pendTail - 1) & r->sqMask].flags &=
+            (uint8_t)~TPU_MEMRING_SQE_LINK;
+        r->pendChain = 0;
+    }
+    atomic_store_explicit(&r->hdr->sqTail, r->pendTail,
+                          memory_order_release);
+    atomic_fetch_add(&r->hdr->submitted, n);
+    tpuCounterAdd("memring_submits", 1);
+    tpuCounterAdd("memring_sqes", n);
+    atomic_fetch_add(&r->hdr->doorbell, 1);
+    mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_MEMRING_SUBMIT, tSpan, 0, n);
+    return n;
+}
+
+/* ------------------------------------------------------- consumer side */
+
+static uint32_t cq_available(TpuMemring *r)
+{
+    return atomic_load_explicit(&r->hdr->cqTail, memory_order_acquire) -
+           atomic_load_explicit(&r->hdr->cqHead, memory_order_relaxed);
+}
+
+/* Shared parking loop: `satisfied` tests the wake condition (reapable
+ * count for Wait, completed==submitted for WaitDrain).  The waiter
+ * registers in cqWaiters BEFORE the final condition re-check so
+ * post_cqe's gated FUTEX_WAKE can never miss it. */
+typedef bool (*mr_wait_pred)(TpuMemring *r, uint32_t n);
+
+static bool pred_reapable(TpuMemring *r, uint32_t n)
+{
+    return cq_available(r) >= n;
+}
+
+static bool pred_drained(TpuMemring *r, uint32_t n)
+{
+    (void)n;
+    /* Load completed FIRST: submitted only grows, so
+     * completed >= submitted here proves a real drain point. */
+    uint64_t done = atomic_load(&r->hdr->completed);
+    return done >= atomic_load(&r->hdr->submitted);
+}
+
+static TpuStatus mr_wait(TpuMemring *r, mr_wait_pred satisfied,
+                         uint32_t n, uint64_t timeoutNs)
+{
+    if (!r)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t deadline = timeoutNs ? tpuNowNs() + timeoutNs : 0;
+    TpuStatus st = TPU_OK;
+    if (satisfied(r, n))
+        return TPU_OK;
+    atomic_fetch_add(&r->hdr->cqWaiters, 1);
+    while (!satisfied(r, n)) {
+        /* Nothing in flight and still short: the missing CQEs were
+         * dropped on CQ overflow (counted) — they will never become
+         * reapable, so an infinite wait here would hang forever.
+         * (Only the reapable-count predicate can starve this way;
+         * a drain wait keys off `completed`, which always advances.) */
+        if (satisfied == pred_reapable &&
+            atomic_load(&r->hdr->completed) ==
+                atomic_load(&r->hdr->submitted) &&
+            atomic_load(&r->hdr->cqOverflows) > 0 &&
+            !satisfied(r, n)) {
+            st = TPU_ERR_INSUFFICIENT_RESOURCES;
+            break;
+        }
+        uint32_t ready = atomic_load(&r->hdr->cqReady);
+        if (satisfied(r, n))
+            break;
+        struct timespec ts, *tsp = NULL;
+        if (deadline) {
+            uint64_t now = tpuNowNs();
+            if (now >= deadline) {
+                st = TPU_ERR_RETRY_EXHAUSTED;
+                break;
+            }
+            uint64_t left = deadline - now;
+            ts.tv_sec = (time_t)(left / 1000000000ull);
+            ts.tv_nsec = (long)(left % 1000000000ull);
+            tsp = &ts;
+        }
+        mr_futex(&r->hdr->cqReady, FUTEX_WAIT, ready, tsp);
+    }
+    atomic_fetch_sub(&r->hdr->cqWaiters, 1);
+    return st;
+}
+
+TpuStatus tpurmMemringWait(TpuMemring *r, uint32_t n, uint64_t timeoutNs)
+{
+    return mr_wait(r, pred_reapable, n, timeoutNs);
+}
+
+TpuStatus tpurmMemringWaitDrain(TpuMemring *r, uint64_t timeoutNs)
+{
+    return mr_wait(r, pred_drained, 0, timeoutNs);
+}
+
+uint32_t tpurmMemringSubmitAndWait(TpuMemring *r, uint32_t waitFor)
+{
+    uint32_t n = tpurmMemringSubmit(r);
+    if (waitFor)
+        tpurmMemringWait(r, waitFor, 0);
+    return n;
+}
+
+uint32_t tpurmMemringReap(TpuMemring *r, TpuMemringCqe *out, uint32_t max)
+{
+    if (!r || !out)
+        return 0;
+    uint32_t head = atomic_load_explicit(&r->hdr->cqHead,
+                                         memory_order_relaxed);
+    uint32_t tail = atomic_load_explicit(&r->hdr->cqTail,
+                                         memory_order_acquire);
+    uint32_t n = 0;
+    while (head != tail && n < max) {
+        out[n++] = r->cq[head & r->cqMask];
+        head++;
+    }
+    atomic_store_explicit(&r->hdr->cqHead, head, memory_order_release);
+    return n;
+}
+
+uint32_t tpurmMemringSqSpace(TpuMemring *r)
+{
+    if (!r)
+        return 0;
+    uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
+                                         memory_order_acquire);
+    return r->hdr->sqEntries - (r->pendTail - head);
+}
+
+void tpurmMemringCounts(TpuMemring *r, uint64_t *submitted,
+                        uint64_t *completed, uint64_t *errorCqes,
+                        uint64_t *cqOverflows)
+{
+    if (!r)
+        return;
+    if (submitted)
+        *submitted = atomic_load(&r->hdr->submitted);
+    if (completed)
+        *completed = atomic_load(&r->hdr->completed);
+    if (errorCqes)
+        *errorCqes = atomic_load(&r->hdr->errorCqes);
+    if (cqOverflows)
+        *cqOverflows = atomic_load(&r->hdr->cqOverflows);
+}
+
+int tpurmMemringShmFd(TpuMemring *r)
+{
+    return r ? r->shmFd : -1;
+}

@@ -76,6 +76,8 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "ici.retrain",            "ici"     },
     { "rdma.pin",               "rdma"    },
     { "msgq.publish",           "msgq"    },
+    { "memring.submit",         "memring" },
+    { "memring.op",             "memring" },
     { "app.span",               "app"     },
     { "inject.hit",             "inject"  },
     { "recover.retry",          "recover" },

@@ -438,6 +438,12 @@ static TpuStatus service_one(UvmFaultEntry *e)
             uint64_t rBase = range->remoteBase;
             uint64_t lBase = range->node.start;
             uint64_t rEnd = range->node.end;
+            /* Pin the window across the forward (taken under vs->lock,
+             * released after the local mprotect): uvmRemoteDetach
+             * drains this before munmap, so the forward can never
+             * reprotect a recycled mapping. */
+            atomic_fetch_add_explicit(&range->remoteRefs, 1,
+                                      memory_order_acq_rel);
             tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "vaspace");
             pthread_mutex_unlock(&vs->lock);
             /* Service whole uvm pages (windows are page-aligned). */
@@ -464,6 +470,8 @@ static TpuStatus service_one(UvmFaultEntry *e)
                     uvmToolsEmit(vs, UVM_EVENT_CPU_FAULT, UVM_TIER_COUNT,
                                  UVM_TIER_HOST, 0, addr, len);
             }
+            atomic_fetch_sub_explicit(&range->remoteRefs, 1,
+                                      memory_order_acq_rel);
             addr = spanEnd + 1;
             continue;
         }

@@ -337,6 +337,12 @@ typedef struct UvmVaRange {
     /* REMOTE ranges: owner-process VA of the range start (fault
      * forwarding translates local addr -> remoteBase + delta). */
     uint64_t remoteBase;
+    /* REMOTE ranges: forwarded-fault pin (serviceRefs analog).  The
+     * fault worker increments under vs->lock before forwarding over
+     * the broker; uvmRemoteDetach removes the range from the tree and
+     * then waits for this to drain before munmap/free, so an in-flight
+     * forward can never mprotect a recycled mapping. */
+    _Atomic uint32_t remoteRefs;
     /* Policy (reference: uvm_va_policy.c). */
     bool hasPreferred;
     UvmLocation preferred;

@@ -556,6 +556,15 @@ TpuStatus uvmRemoteDetach(UvmVaSpace *vs, void *localBase)
     if (st != TPU_OK)
         return st;
     uvmFaultSnapshotRebuild();
+    /* Drain in-flight forwarded faults before tearing the window down:
+     * a fault worker that found this range (and pinned it under
+     * vs->lock, see service_one's remoteRefs) may still be mid-forward
+     * — munmap now and its mprotect could land on a recycled mapping.
+     * The range left the tree above, so no NEW pins can appear; the
+     * snapshot rebuild's grace period already drained handler lookups. */
+    while (atomic_load_explicit(&range->remoteRefs,
+                                memory_order_acquire) != 0)
+        sched_yield();
     munmap(localBase, range->size);
     free(range);
     return TPU_OK;

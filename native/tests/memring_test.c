@@ -1,0 +1,561 @@
+/*
+ * tpumemring test: SQ/CQ wrap + full-SQ backpressure, batched MIGRATE
+ * coalescing, LINK-chain ordering + cancel-on-failure, FENCE drain
+ * semantics, multi-worker completion accounting, and inject-driven
+ * bounded-retry / error-CQE recovery with exact hit reconciliation.
+ */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+
+#include "tpurm/inject.h"
+#include "tpurm/memring.h"
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+#define SPAN (64 * 1024)
+
+static TpuMemringSqe sqe_migrate(void *addr, uint64_t len, uint32_t tier,
+                                 uint32_t dev, uint64_t cookie)
+{
+    TpuMemringSqe s;
+    memset(&s, 0, sizeof(s));
+    s.opcode = TPU_MEMRING_OP_MIGRATE;
+    s.dstTier = (uint16_t)tier;
+    s.devInst = dev;
+    s.addr = (uint64_t)(uintptr_t)addr;
+    s.len = len;
+    s.userData = cookie;
+    return s;
+}
+
+static TpuMemringSqe sqe_nop(uint64_t cookie)
+{
+    TpuMemringSqe s;
+    memset(&s, 0, sizeof(s));
+    s.opcode = TPU_MEMRING_OP_NOP;
+    s.userData = cookie;
+    return s;
+}
+
+/* SQ/CQ wrap: an 8-entry ring carries 64 ops in waves; every cookie
+ * completes exactly once; prepping past the SQ bound backpressures. */
+static int test_wrap_and_backpressure(void)
+{
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(NULL, 8, 2, &r) == TPU_OK);
+
+    /* Fill the SQ without submitting: the 9th prep must refuse. */
+    for (int i = 0; i < 8; i++) {
+        TpuMemringSqe s = sqe_nop(1000 + i);
+        CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    }
+    TpuMemringSqe extra = sqe_nop(9999);
+    CHECK(tpurmMemringPrep(r, &extra) ==
+          TPU_ERR_INSUFFICIENT_RESOURCES);
+    CHECK(tpurmMemringSubmitAndWait(r, 8) == 8);
+
+    uint64_t seen[64] = { 0 };
+    TpuMemringCqe cq[16];
+    uint32_t got = tpurmMemringReap(r, cq, 16);
+    CHECK(got == 8);
+    for (uint32_t i = 0; i < got; i++)
+        seen[cq[i].userData - 1000] = 1;
+
+    /* Seven more waves wrap both rings several times over. */
+    for (int w = 1; w < 8; w++) {
+        for (int i = 0; i < 8; i++) {
+            TpuMemringSqe s = sqe_nop(1000 + w * 8 + i);
+            CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+        }
+        CHECK(tpurmMemringSubmitAndWait(r, 8) == 8);
+        got = tpurmMemringReap(r, cq, 16);
+        CHECK(got == 8);
+        for (uint32_t i = 0; i < got; i++) {
+            CHECK(cq[i].userData >= 1000 && cq[i].userData < 1064);
+            CHECK(cq[i].status == TPU_OK);
+            seen[cq[i].userData - 1000]++;
+        }
+    }
+    for (int i = 0; i < 64; i++)
+        CHECK(seen[i] == 1);
+
+    uint64_t sub, comp, err, ovf;
+    tpurmMemringCounts(r, &sub, &comp, &err, &ovf);
+    CHECK(sub == 64 && comp == 64 && err == 0 && ovf == 0);
+    tpurmMemringDestroy(r);
+    return 0;
+}
+
+/* Batched MIGRATE of contiguous spans: coalesced into block-granular
+ * engine calls, bytes intact, residency follows the destination. */
+static int test_batched_migrate(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    enum { N = 32 };
+    void *p;
+    CHECK(uvmMemAlloc(vs, N * SPAN, &p) == TPU_OK);
+    memset(p, 0x5A, N * SPAN);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 64, 2, &r) == TPU_OK);
+    uint64_t coalescedBefore = tpurmCounterGet("memring_coalesced_sqes");
+
+    for (int i = 0; i < N; i++) {
+        TpuMemringSqe s = sqe_migrate((char *)p + i * SPAN, SPAN,
+                                      UVM_TIER_HBM, 0, i);
+        CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    }
+    CHECK(tpurmMemringSubmitAndWait(r, N) == N);
+    TpuMemringCqe cq[N];
+    CHECK(tpurmMemringReap(r, cq, N) == N);
+    for (int i = 0; i < N; i++) {
+        CHECK(cq[i].status == TPU_OK);
+        CHECK(cq[i].bytes == SPAN);
+    }
+    /* Contiguous same-destination spans were merged. */
+    CHECK(tpurmCounterGet("memring_coalesced_sqes") > coalescedBefore);
+
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, (char *)p + 5 * SPAN, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+
+    /* EVICT (tier demote) back to host; HBM demote target is refused. */
+    TpuMemringSqe ev = sqe_migrate(p, N * SPAN, UVM_TIER_HOST, 0, 77);
+    ev.opcode = TPU_MEMRING_OP_EVICT;
+    CHECK(tpurmMemringPrep(r, &ev) == TPU_OK);
+    TpuMemringSqe bad = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 78);
+    bad.opcode = TPU_MEMRING_OP_EVICT;
+    CHECK(tpurmMemringPrep(r, &bad) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 2) == 2);
+    CHECK(tpurmMemringReap(r, cq, 2) == 2);
+    for (int i = 0; i < 2; i++) {
+        if (cq[i].userData == 77)
+            CHECK(cq[i].status == TPU_OK);
+        else
+            CHECK(cq[i].status == TPU_ERR_INVALID_ARGUMENT);
+    }
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.residentHost && !info.residentHbm);
+
+    volatile uint8_t *bytes = p;
+    CHECK(bytes[0] == 0x5A && bytes[N * SPAN - 1] == 0x5A);
+
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* LINK chain: executes sequentially in submission order; a mid-chain
+ * failure cancels the remainder with error CQEs. */
+static int test_link_chains(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    void *p;
+    CHECK(uvmMemAlloc(vs, 4 * SPAN, &p) == TPU_OK);
+    memset(p, 0x33, 4 * SPAN);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 32, 2, &r) == TPU_OK);
+
+    /* Ordered chain: HBM -> CXL -> HOST.  Because the links serialize,
+     * the final residency must be the LAST op's destination. */
+    TpuMemringSqe a = sqe_migrate(p, 4 * SPAN, UVM_TIER_HBM, 0, 1);
+    a.flags |= TPU_MEMRING_SQE_LINK;
+    TpuMemringSqe b = sqe_migrate(p, 4 * SPAN, UVM_TIER_CXL, 0, 2);
+    b.flags |= TPU_MEMRING_SQE_LINK;
+    TpuMemringSqe c = sqe_migrate(p, 4 * SPAN, UVM_TIER_HOST, 0, 3);
+    CHECK(tpurmMemringPrep(r, &a) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &b) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &c) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 3) == 3);
+    TpuMemringCqe cq[8];
+    CHECK(tpurmMemringReap(r, cq, 8) == 3);
+    for (int i = 0; i < 3; i++) {
+        CHECK(cq[i].status == TPU_OK);
+        /* One worker ran the chain FIFO: seq mirrors submission. */
+        CHECK(cq[i].userData == (uint64_t)(i + 1));
+        if (i)
+            CHECK(cq[i].startNs >= cq[i - 1].endNs);
+    }
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.residentHost && !info.residentHbm && !info.residentCxl);
+
+    /* Cancel-on-failure: head op targets unmanaged VA (permanent
+     * failure), so the two linked followers must cancel. */
+    uint64_t cancelledBefore = tpurmCounterGet("memring_links_cancelled");
+    TpuMemringSqe x = sqe_migrate((void *)0x1000, SPAN, UVM_TIER_HBM, 0,
+                                  11);
+    x.flags |= TPU_MEMRING_SQE_LINK;
+    TpuMemringSqe y = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 12);
+    y.flags |= TPU_MEMRING_SQE_LINK;
+    TpuMemringSqe z = sqe_migrate(p, SPAN, UVM_TIER_CXL, 0, 13);
+    CHECK(tpurmMemringPrep(r, &x) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &y) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &z) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 3) == 3);
+    CHECK(tpurmMemringReap(r, cq, 8) == 3);
+    CHECK(cq[0].userData == 11 && cq[0].status != TPU_OK);
+    CHECK(cq[1].userData == 12 &&
+          cq[1].status == TPU_ERR_INVALID_STATE && cq[1].bytes == 0);
+    CHECK(cq[2].userData == 13 &&
+          cq[2].status == TPU_ERR_INVALID_STATE && cq[2].bytes == 0);
+    CHECK(tpurmCounterGet("memring_links_cancelled") ==
+          cancelledBefore + 2);
+    /* The buffer never moved: the chain cancelled before touching it. */
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.residentHost);
+
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* An open chain at the submit boundary: the header contract says the
+ * publication boundary terminates a chain, and submit must ENFORCE it
+ * in the ring — otherwise a worker walking the still-LINK-flagged tail
+ * would absorb the NEXT submitted batch into the chain (and a chain
+ * failure would cancel independent ops).  The trailing SQE's LINK flag
+ * must read back cleared through the shared mapping, and an op
+ * submitted afterwards must complete on its own terms. */
+static int test_open_chain_submit_boundary(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    void *p;
+    CHECK(uvmMemAlloc(vs, SPAN, &p) == TPU_OK);
+    memset(p, 0x29, SPAN);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 16, 2, &r) == TPU_OK);
+    TpuMemringSqe *sq = (TpuMemringSqe *)(
+        (char *)mmap(NULL, TPU_MEMRING_SQ_OFFSET +
+                         16 * sizeof(TpuMemringSqe),
+                     PROT_READ, MAP_SHARED, tpurmMemringShmFd(r), 0) +
+        TPU_MEMRING_SQ_OFFSET);
+    CHECK((void *)sq != (void *)((char *)MAP_FAILED +
+                                 TPU_MEMRING_SQ_OFFSET));
+
+    /* Chain left OPEN: the head op fails permanently (unmanaged VA)
+     * so absorption of a later batch would surface as a cancel. */
+    TpuMemringSqe a = sqe_migrate((void *)0x1000, SPAN, UVM_TIER_HBM, 0,
+                                  21);
+    a.flags |= TPU_MEMRING_SQE_LINK;
+    CHECK(tpurmMemringPrep(r, &a) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    /* Submit terminated the chain IN the ring (slot 0 = first SQE). */
+    CHECK((sq[0].flags & TPU_MEMRING_SQE_LINK) == 0);
+
+    /* An independent op published next must run, not cancel. */
+    TpuMemringSqe b = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 22);
+    CHECK(tpurmMemringPrep(r, &b) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r) == 1);
+    /* Both CQEs (A's error after its bounded retries, B's success). */
+    CHECK(tpurmMemringWait(r, 2, 0) == TPU_OK);
+    TpuMemringCqe cq[4];
+    CHECK(tpurmMemringReap(r, cq, 4) == 2);
+    for (int i = 0; i < 2; i++) {
+        if (cq[i].userData == 21)
+            CHECK(cq[i].status != TPU_OK &&
+                  cq[i].status != TPU_ERR_INVALID_STATE);
+        else
+            CHECK(cq[i].userData == 22 && cq[i].status == TPU_OK);
+    }
+
+    munmap((char *)sq - TPU_MEMRING_SQ_OFFSET,
+           TPU_MEMRING_SQ_OFFSET + 16 * sizeof(TpuMemringSqe));
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* FENCE: posts only after every previously submitted op retired. */
+static int test_fence(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    enum { N = 16 };
+    void *p;
+    CHECK(uvmMemAlloc(vs, N * SPAN, &p) == TPU_OK);
+    memset(p, 0x44, N * SPAN);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 64, 4, &r) == TPU_OK);
+
+    /* Alternate destinations so spans cannot all coalesce into one
+     * call — several workers genuinely run concurrently. */
+    for (int i = 0; i < N; i++) {
+        TpuMemringSqe s = sqe_migrate((char *)p + i * SPAN, SPAN,
+                                      (i & 1) ? UVM_TIER_CXL
+                                              : UVM_TIER_HBM, 0, i);
+        CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    }
+    TpuMemringSqe f;
+    memset(&f, 0, sizeof(f));
+    f.opcode = TPU_MEMRING_OP_FENCE;
+    f.userData = 500;
+    CHECK(tpurmMemringPrep(r, &f) == TPU_OK);
+    /* Post-fence op: must not complete before the fence. */
+    TpuMemringSqe after = sqe_migrate(p, SPAN, UVM_TIER_HOST, 0, 501);
+    CHECK(tpurmMemringPrep(r, &after) == TPU_OK);
+
+    CHECK(tpurmMemringSubmitAndWait(r, N + 2) == N + 2);
+    TpuMemringCqe cq[N + 2];
+    CHECK(tpurmMemringReap(r, cq, N + 2) == N + 2);
+    uint64_t fenceStart = 0, fenceSeq = 0;
+    for (int i = 0; i < N + 2; i++)
+        if (cq[i].userData == 500) {
+            fenceStart = cq[i].startNs;
+            fenceSeq = cq[i].seq;
+        }
+    int checked = 0;
+    for (int i = 0; i < N + 2; i++) {
+        if (cq[i].userData < N) {
+            CHECK(cq[i].status == TPU_OK);
+            /* Drain semantics: the fence began only after this op's
+             * CQE had posted. */
+            CHECK(cq[i].endNs <= fenceStart);
+            CHECK(cq[i].seq < fenceSeq);
+            checked++;
+        }
+        if (cq[i].userData == 501) {
+            CHECK(cq[i].seq > fenceSeq);
+            CHECK(cq[i].startNs >= fenceStart);
+        }
+    }
+    CHECK(checked == N);
+    CHECK(tpurmCounterGet("memring_fences") > 0);
+
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* Multi-worker accounting: a 4-worker pool completes exactly what was
+ * submitted, with the header counts and CQE count agreeing. */
+static int test_multiworker_accounting(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    enum { N = 24, WAVES = 4 };
+    void *p;
+    CHECK(uvmMemAlloc(vs, N * SPAN, &p) == TPU_OK);
+    memset(p, 0x66, N * SPAN);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 32, 4, &r) == TPU_OK);
+    uint32_t total = 0, reaped = 0;
+    TpuMemringCqe cq[N];
+    for (int w = 0; w < WAVES; w++) {
+        for (int i = 0; i < N; i++) {
+            /* Mixed op stream, distinct buffers per op parity. */
+            TpuMemringSqe s = sqe_migrate(
+                (char *)p + i * SPAN, SPAN,
+                (w & 1) ? UVM_TIER_HOST : UVM_TIER_HBM, 0,
+                (uint64_t)w * 100 + i);
+            if (i % 5 == 4)
+                s.opcode = TPU_MEMRING_OP_NOP;
+            CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+        }
+        CHECK(tpurmMemringSubmitAndWait(r, N) == N);
+        total += N;
+        uint32_t got = tpurmMemringReap(r, cq, N);
+        CHECK(got == N);
+        for (uint32_t i = 0; i < got; i++)
+            CHECK(cq[i].status == TPU_OK);
+        reaped += got;
+    }
+    uint64_t sub, comp, err, ovf;
+    tpurmMemringCounts(r, &sub, &comp, &err, &ovf);
+    CHECK(sub == total && comp == total && reaped == total);
+    CHECK(err == 0 && ovf == 0);
+    volatile uint8_t *bytes = p;
+    CHECK(bytes[0] == 0x66 && bytes[N * SPAN - 1] == 0x66);
+
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* ADVISE + PEER_COPY smoke: policy ops complete OK and the peer copy
+ * moves real bytes between two devices' HBM arenas. */
+static int test_advise_and_peer_copy(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    void *p;
+    CHECK(uvmMemAlloc(vs, 4 * SPAN, &p) == TPU_OK);
+    memset(p, 0x21, 4 * SPAN);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 32, 2, &r) == TPU_OK);
+
+    TpuMemringSqe adv;
+    memset(&adv, 0, sizeof(adv));
+    adv.opcode = TPU_MEMRING_OP_ADVISE;
+    adv.arg0 = TPU_MEMRING_ADVISE_PREFERRED;
+    adv.dstTier = UVM_TIER_CXL;
+    adv.addr = (uint64_t)(uintptr_t)p;
+    adv.len = 4 * SPAN;
+    adv.userData = 1;
+    adv.flags = TPU_MEMRING_SQE_LINK;  /* order: advise, then demote */
+    CHECK(tpurmMemringPrep(r, &adv) == TPU_OK);
+    TpuMemringSqe ev = sqe_migrate(p, 4 * SPAN, UVM_TIER_CXL, 0, 2);
+    ev.opcode = TPU_MEMRING_OP_EVICT;
+    CHECK(tpurmMemringPrep(r, &ev) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 2) == 2);
+    TpuMemringCqe cq[4];
+    CHECK(tpurmMemringReap(r, cq, 4) == 2);
+    CHECK(cq[0].status == TPU_OK && cq[1].status == TPU_OK);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.residentCxl);
+
+    /* Peer copy between dev0 and dev1 HBM arena chunks. */
+    uint64_t off0, off1;
+    void *h0, *h1;
+    CHECK(uvmHbmChunkAlloc(0, SPAN, &off0, &h0) == TPU_OK);
+    CHECK(uvmHbmChunkAlloc(1, SPAN, &off1, &h1) == TPU_OK);
+    TpurmDevice *d0 = tpurmDeviceGet(0), *d1 = tpurmDeviceGet(1);
+    CHECK(d0 && d1);
+    memset((char *)tpurmDeviceHbmBase(d0) + off0, 0xB7, SPAN);
+    memset((char *)tpurmDeviceHbmBase(d1) + off1, 0, SPAN);
+
+    TpuMemringSqe pc;
+    memset(&pc, 0, sizeof(pc));
+    pc.opcode = TPU_MEMRING_OP_PEER_COPY;
+    pc.devInst = 0;
+    pc.peerInst = 1;
+    pc.addr = off0;
+    pc.peerOff = off1;
+    pc.len = SPAN;
+    pc.arg0 = TPU_MEMRING_PEER_WRITE;
+    pc.userData = 9;
+    CHECK(tpurmMemringPrep(r, &pc) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringReap(r, cq, 4) == 1);
+    CHECK(cq[0].status == TPU_OK && cq[0].bytes == SPAN);
+    volatile uint8_t *peer =
+        (uint8_t *)tpurmDeviceHbmBase(d1) + off1;
+    CHECK(peer[0] == 0xB7 && peer[SPAN - 1] == 0xB7);
+
+    CHECK(uvmHbmChunkFree(0, h0) == TPU_OK);
+    CHECK(uvmHbmChunkFree(1, h1) == TPU_OK);
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* Injection: a burst long enough to defeat the bounded retry drives an
+ * error CQE; a short burst recovers invisibly.  Exact reconciliation:
+ * site hits == memring_inject_retries + memring_inject_error_runs. */
+static int test_inject_recovery(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    void *p;
+    CHECK(uvmMemAlloc(vs, 2 * SPAN, &p) == TPU_OK);
+    memset(p, 0x77, 2 * SPAN);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 32, 2, &r) == TPU_OK);
+
+    uint64_t e0, h0;
+    tpurmInjectCounts(TPU_INJECT_SITE_MEMRING_SUBMIT, &e0, &h0);
+    uint64_t retriesBefore = tpurmCounterGet("memring_inject_retries");
+    uint64_t errRunsBefore = tpurmCounterGet("memring_inject_error_runs");
+    uint64_t errCqesBefore = tpurmCounterGet("memring_error_cqes");
+
+    /* Short burst (1 hit): retry absorbs it, CQE is clean. */
+    CHECK(tpurmInjectConfigure(TPU_INJECT_SITE_MEMRING_SUBMIT,
+                               TPU_INJECT_ONESHOT, 0, 1, 0) == TPU_OK);
+    TpuMemringSqe s = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 1);
+    CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    TpuMemringCqe cq[4];
+    CHECK(tpurmMemringReap(r, cq, 4) == 1);
+    CHECK(cq[0].status == TPU_OK);
+    CHECK(tpurmCounterGet("memring_inject_retries") == retriesBefore + 1);
+
+    /* Burst 4 exhausts the default 3 retries: error CQE, counted. */
+    CHECK(tpurmInjectConfigure(TPU_INJECT_SITE_MEMRING_SUBMIT,
+                               TPU_INJECT_ONESHOT, 0, 4, 0) == TPU_OK);
+    s = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 2);
+    CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringReap(r, cq, 4) == 1);
+    CHECK(cq[0].status == TPU_ERR_RETRY_EXHAUSTED);
+    CHECK(tpurmCounterGet("memring_inject_error_runs") ==
+          errRunsBefore + 1);
+    CHECK(tpurmCounterGet("memring_error_cqes") == errCqesBefore + 1);
+    tpurmInjectDisable(TPU_INJECT_SITE_MEMRING_SUBMIT);
+
+    /* Exact reconciliation over the whole sequence. */
+    uint64_t e1, h1;
+    tpurmInjectCounts(TPU_INJECT_SITE_MEMRING_SUBMIT, &e1, &h1);
+    uint64_t hits = h1 - h0;
+    uint64_t recRetries = tpurmCounterGet("memring_inject_retries") -
+                          retriesBefore;
+    uint64_t recErrRuns = tpurmCounterGet("memring_inject_error_runs") -
+                          errRunsBefore;
+    CHECK(hits == recRetries + recErrRuns);
+    CHECK(hits == 5);   /* 1 (absorbed) + 4 (burst to exhaustion) */
+
+    /* The failed migrate left data readable (host residency intact). */
+    volatile uint8_t *bytes = p;
+    CHECK(bytes[0] == 0x77 && bytes[SPAN - 1] == 0x77);
+
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+int main(void)
+{
+    /* Two fake devices so PEER_COPY has a real peer (set before any
+     * engine touch initializes the device table). */
+    setenv("TPUMEM_FAKE_TPU_COUNT", "2", 0);
+    if (test_wrap_and_backpressure())
+        return 1;
+    if (test_batched_migrate())
+        return 1;
+    if (test_link_chains())
+        return 1;
+    if (test_open_chain_submit_boundary())
+        return 1;
+    if (test_fence())
+        return 1;
+    if (test_multiworker_accounting())
+        return 1;
+    if (test_advise_and_peer_copy())
+        return 1;
+    if (test_inject_recovery())
+        return 1;
+    printf("memring_test OK\n");
+    return 0;
+}

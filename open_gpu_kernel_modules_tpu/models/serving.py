@@ -303,6 +303,7 @@ class ManagedKVBacking:
     def __init__(self, pool_shape: Tuple[int, ...], np_dtype: np.dtype,
                  page_bytes: int, dev: int):
         from .. import uvm
+        from ..uvm import memring
         from ..uvm.managed import Tier
 
         self.pool_shape = pool_shape            # device layout [L, N, ...]
@@ -324,6 +325,14 @@ class ManagedKVBacking:
             buf.view(np_dtype)[:] = 0
             buf.set_read_duplication(True)
             buf.migrate(Tier.CXL)
+        # Async submission ring (tpumemring): a group's page faults go
+        # down as ONE batched submission the worker pool drains —
+        # coalescing contiguous spans into block-granular engine calls
+        # — instead of 2 blocking uvmDeviceAccess ioctls per page.
+        try:
+            self.ring = memring.MemRing(self.vs, entries=512)
+        except Exception:
+            self.ring = None        # fall back to the sync loop
 
     def _store_k(self) -> np.ndarray:
         return self.k_buf.view(self.np_dtype, self.store_shape)
@@ -343,13 +352,38 @@ class ManagedKVBacking:
 
     def read_pages(self, pages: List[int]
                    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Fault + fetch pages; returns (k, v) chunks [L, n, P, KV, D]."""
-        for page in pages:
-            off = page * self.rec_bytes
-            self.k_buf.device_access(dev=self.dev, offset=off,
-                                     length=self.rec_bytes)
-            self.v_buf.device_access(dev=self.dev, offset=off,
-                                     length=self.rec_bytes)
+        """Fault + fetch pages; returns (k, v) chunks [L, n, P, KV, D].
+
+        The fault pass is BATCHED async submission through the memring:
+        every page span of both pools goes down in one submit (one
+        doorbell), the worker pool faults them concurrently — merging
+        adjacent spans into block-granular engine calls — and errors
+        come back as per-op CQEs (raised here as RmError, matching the
+        sync path's contract)."""
+        if self.ring is not None and pages:
+            n = 0
+            for page in pages:
+                off = page * self.rec_bytes
+                if self.ring.sq_space < 2:
+                    # Giant group: flush a full SQ wave and keep going.
+                    self.ring.submit_and_wait(n)
+                    self.ring.completions(max_cqes=max(n, 64),
+                                          check=True)
+                    n = 0
+                self.ring.prefetch(self.k_buf.address + off,
+                                   self.rec_bytes, dev=self.dev)
+                self.ring.prefetch(self.v_buf.address + off,
+                                   self.rec_bytes, dev=self.dev)
+                n += 2
+            self.ring.submit_and_wait(n)
+            self.ring.completions(max_cqes=max(n, 64), check=True)
+        else:
+            for page in pages:
+                off = page * self.rec_bytes
+                self.k_buf.device_access(dev=self.dev, offset=off,
+                                         length=self.rec_bytes)
+                self.v_buf.device_access(dev=self.dev, offset=off,
+                                         length=self.rec_bytes)
         idx = np.array(pages, np.int64)
         k = self._store_k()[idx]                # [n, L, page...]
         v = self._store_v()[idx]
@@ -363,6 +397,9 @@ class ManagedKVBacking:
         self._store_v()[page] = v_rec
 
     def close(self) -> None:
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
         self.vs.close()
 
 
@@ -863,6 +900,28 @@ class TieredKVCache:
         get them at the next force."""
         self._last_token_dev[tuple(int(b) for b in seq_ids)] = toks
 
+    def materialize(self, seq_ids: Optional[Sequence[int]] = None
+                    ) -> np.ndarray:
+        """Fold device-parked last tokens into host ``last_token``.
+
+        Without it, a caller reading ``cache.last_token`` after
+        ``prefill_group`` (which parks the prompt's argmax on device)
+        saw stale zeros until some later decode happened to pop the
+        exact group key.  ``seq_ids=None`` materializes every parked
+        group; otherwise only groups overlapping the given sequences.
+        Costs one device readback per parked group (the relay poison
+        point — steady-state decode keeps using the parked fast path
+        and never calls this).  Returns ``last_token`` (the requested
+        sequences' slice when ``seq_ids`` is given)."""
+        ids = None if seq_ids is None else {int(b) for b in seq_ids}
+        for key in list(self._last_token_dev):
+            if ids is None or set(key) & ids:
+                self.last_token[np.array(key)] = np.asarray(
+                    self._last_token_dev.pop(key), np.int32)
+        if seq_ids is None:
+            return self.last_token
+        return self.last_token[np.array(list(seq_ids), dtype=np.intp)]
+
     def sync_from(self, view: PagedKVCache, seq_ids: Sequence[int],
                   last_tokens: Optional[np.ndarray] = None,
                   decoded: int = 0,
@@ -928,7 +987,14 @@ class TieredKVCache:
         prefill marks every prompt page dirty, and flushing them here
         turns the decode phase's evictions of prompt pages into free
         clean drops instead of victim-ring traffic.  Any parked ring
-        entries for these pages are superseded and recycle."""
+        entries for these pages are superseded and recycle.
+
+        Device-parked last tokens for the group also materialize here:
+        a flush is already a readback point (the page gather below), so
+        folding the parked tokens costs no extra poison and leaves
+        ``last_token`` consistent for any host reader that follows the
+        flush.  decode_rounds then simply seeds from host tokens."""
+        self.materialize(seq_ids)
         m = self.pages_per_seq
         flush: List[Tuple[int, int]] = []       # (slot, page)
         for b in seq_ids:
@@ -949,6 +1015,9 @@ class TieredKVCache:
 
     def close(self) -> None:
         try:
+            # Parked tokens materialize first: last_token must hold the
+            # true final tokens after close, never stale zeros.
+            self.materialize()
             self.drain_flushes()
         finally:
             self.backing.close()
@@ -961,9 +1030,12 @@ def prefill_group(cfg: llama.LlamaConfig, params: Dict[str, Any],
     cost), so the decode phase starts with a clean pool and its
     evictions of prompt pages are free drops.
 
-    The prompt's last tokens stay ON DEVICE (set_last_tokens_dev) and
-    lengths come from host arithmetic: a readback here would poison the
-    process's upload path for the whole decode (relay property)."""
+    The prompt's last tokens park ON DEVICE (set_last_tokens_dev) until
+    the flush, which folds them to host inside its own page-gather
+    readback window (see flush_group) — so ``cache.last_token`` is
+    correct immediately after prefill and the group's first decode turn
+    seeds from host tokens.  Lengths come from host arithmetic; no
+    readback happens outside the flush."""
     view = cache.activate(seq_ids, new_tokens=prompt.shape[1])
     logits, view = prefill(cfg, params, prompt, view)
     cache.sync_from(view, seq_ids, decoded=0,

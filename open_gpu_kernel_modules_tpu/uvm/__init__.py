@@ -13,6 +13,7 @@ devices are driven from userspace).
 """
 
 from . import inject  # noqa: F401  (fault injection + recovery counters)
+from . import memring  # noqa: F401  (async memory-op rings, tpumemring)
 from .managed import (  # noqa: F401
     Tier,
     VaSpace,

@@ -34,6 +34,7 @@ class Site(enum.IntEnum):
     RDMA_COMPLETION = 4  # MR pin/map completion error
     CHANNEL_CE = 5       # channel CE push fault
     FENCE_TIMEOUT = 6    # fault-service / fence timeout
+    MEMRING_SUBMIT = 7   # memring op execution (per coalesced run)
 
 
 class Mode(enum.IntEnum):
@@ -65,6 +66,11 @@ DETAIL_COUNTERS = (
     "ici_retrain_failures",
     "uvm_fault_cancels",
     "rc_nonreplayable_faults",
+    "memring_retries",
+    "memring_inject_retries",
+    "memring_inject_error_runs",
+    "memring_inject_error_cqes",
+    "memring_error_cqes",
 )
 
 _bound = None

@@ -1,0 +1,332 @@
+"""tpumemring — io_uring-style async memory-op rings (native/src/memring.c).
+
+Python face of the submission/completion-ring subsystem: stage batches
+of memory operations (migrate / prefetch / evict / advise / peer-copy),
+publish them with one doorbell, and reap per-op completions carrying
+the ``user_data`` cookie, status, and bytes moved.  The native worker
+pool coalesces contiguous compatible spans into block-granular engine
+calls — batched async submission beats an equivalent loop of
+synchronous ``uvmMigrate`` calls by avoiding one lock round trip and
+one page-granular walk per span (the bench.py memring microbench
+records the ratio).
+
+Ordering tools mirror io_uring: ``link=True`` chains an op to the next
+(failure cancels the chain's remainder with error CQEs), and
+``fence()`` completes only after every previously submitted op has
+posted its completion.
+
+Typical batched use::
+
+    ring = MemRing(vs)
+    for off in range(0, n * SPAN, SPAN):
+        ring.migrate(buf.address + off, SPAN, Tier.HBM)
+    ring.submit_and_wait()
+    for c in ring.completions():
+        assert c.status == 0, c
+
+Errors surface per-op: an op that exhausts the bounded retry posts an
+ERROR completion (status carries the TpuStatus) instead of tearing the
+ring down.  ``check=True`` reap helpers raise :class:`native.RmError`
+on the first error completion.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import enum
+from typing import List, Optional
+
+from ..runtime import native
+from .managed import Tier
+
+
+class Op(enum.IntEnum):
+    """Opcodes (memring.h TPU_MEMRING_OP_*)."""
+
+    NOP = 0
+    MIGRATE = 1
+    PREFETCH = 2
+    EVICT = 3
+    ADVISE = 4
+    PEER_COPY = 5
+    FENCE = 6
+
+
+class Advise(enum.IntEnum):
+    """ADVISE subcodes."""
+
+    PREFERRED = 1
+    UNSET_PREFERRED = 2
+    ACCESSED_BY = 3
+    UNSET_ACCESSED_BY = 4
+    READ_DUP = 5
+
+
+SQE_LINK = 0x1
+SQE_WRITE = 0x2
+
+
+class _Sqe(ctypes.Structure):
+    _fields_ = [
+        ("opcode", ctypes.c_uint8),
+        ("flags", ctypes.c_uint8),
+        ("dstTier", ctypes.c_uint16),
+        ("devInst", ctypes.c_uint32),
+        ("addr", ctypes.c_uint64),
+        ("len", ctypes.c_uint64),
+        ("userData", ctypes.c_uint64),
+        ("peerInst", ctypes.c_uint32),
+        ("arg0", ctypes.c_uint32),
+        ("peerOff", ctypes.c_uint64),
+        ("arg1", ctypes.c_uint64),
+        ("pad", ctypes.c_uint64),
+    ]
+
+
+class _Cqe(ctypes.Structure):
+    _fields_ = [
+        ("userData", ctypes.c_uint64),
+        ("status", ctypes.c_uint32),
+        ("opcode", ctypes.c_uint32),
+        ("bytes", ctypes.c_uint64),
+        ("seq", ctypes.c_uint64),
+        ("startNs", ctypes.c_uint64),
+        ("endNs", ctypes.c_uint64),
+        ("pad", ctypes.c_uint64 * 2),
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class Completion:
+    """One reaped CQE."""
+
+    user_data: int
+    status: int
+    opcode: Op
+    bytes: int
+    seq: int
+    start_ns: int
+    end_ns: int
+
+    @property
+    def ok(self) -> bool:
+        return self.status == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RingCounts:
+    submitted: int
+    completed: int
+    error_cqes: int
+    cq_overflows: int
+
+
+_bound = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is not None:
+        return _bound
+    lib = native.load()
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    vp = ctypes.c_void_p
+    lib.tpurmMemringCreate.argtypes = [vp, u32, u32, ctypes.POINTER(vp)]
+    lib.tpurmMemringCreate.restype = u32
+    lib.tpurmMemringDestroy.argtypes = [vp]
+    lib.tpurmMemringDestroy.restype = None
+    lib.tpurmMemringPrep.argtypes = [vp, ctypes.POINTER(_Sqe)]
+    lib.tpurmMemringPrep.restype = u32
+    lib.tpurmMemringSubmit.argtypes = [vp]
+    lib.tpurmMemringSubmit.restype = u32
+    lib.tpurmMemringSubmitAndWait.argtypes = [vp, u32]
+    lib.tpurmMemringSubmitAndWait.restype = u32
+    lib.tpurmMemringReap.argtypes = [vp, ctypes.POINTER(_Cqe), u32]
+    lib.tpurmMemringReap.restype = u32
+    lib.tpurmMemringWait.argtypes = [vp, u32, u64]
+    lib.tpurmMemringWait.restype = u32
+    lib.tpurmMemringWaitDrain.argtypes = [vp, u64]
+    lib.tpurmMemringWaitDrain.restype = u32
+    lib.tpurmMemringSqSpace.argtypes = [vp]
+    lib.tpurmMemringSqSpace.restype = u32
+    lib.tpurmMemringCounts.argtypes = [vp, ctypes.POINTER(u64),
+                                       ctypes.POINTER(u64),
+                                       ctypes.POINTER(u64),
+                                       ctypes.POINTER(u64)]
+    lib.tpurmMemringCounts.restype = None
+    lib.tpurmMemringShmFd.argtypes = [vp]
+    lib.tpurmMemringShmFd.restype = ctypes.c_int
+    _bound = lib
+    return lib
+
+
+def _check(status: int, what: str) -> None:
+    if status != 0:
+        raise native.RmError(status, what)
+
+
+class MemRing:
+    """An async memory-op ring bound to a UVM VA space.
+
+    ``vs`` may be a :class:`..managed.VaSpace` or ``None`` (PEER_COPY /
+    NOP / FENCE only).  Destroy the ring before closing the space.
+    The prep methods stage SQEs; nothing reaches the workers until
+    :meth:`submit`.  A staged op's position in the batch is its
+    execution order only within LINK chains and across fences —
+    unlinked ops run concurrently on the worker pool.
+    """
+
+    def __init__(self, vs=None, entries: int = 256, workers: int = 0):
+        self._lib = _lib()
+        handle = ctypes.c_void_p()
+        vs_handle = vs._handle if vs is not None else None
+        _check(self._lib.tpurmMemringCreate(vs_handle, entries, workers,
+                                            ctypes.byref(handle)),
+               "tpurmMemringCreate")
+        self._handle = handle
+        self._auto_cookie = 0
+
+    # ------------------------------------------------------------- preps
+
+    def _prep(self, sqe: _Sqe) -> int:
+        if sqe.userData == 0:
+            self._auto_cookie += 1
+            sqe.userData = self._auto_cookie
+        _check(self._lib.tpurmMemringPrep(self._handle,
+                                          ctypes.byref(sqe)),
+               "tpurmMemringPrep")
+        return sqe.userData
+
+    def migrate(self, addr: int, length: int, tier: Tier, dev: int = 0,
+                user_data: int = 0, link: bool = False) -> int:
+        """Stage an async migrate of [addr, addr+length) to ``tier``.
+        Returns the op's cookie (auto-assigned when 0)."""
+        s = _Sqe(opcode=Op.MIGRATE, flags=SQE_LINK if link else 0,
+                 dstTier=int(tier), devInst=dev, addr=addr, len=length,
+                 userData=user_data)
+        return self._prep(s)
+
+    def prefetch(self, addr: int, length: int, dev: int = 0,
+                 write: bool = False, user_data: int = 0,
+                 link: bool = False) -> int:
+        """Stage a device-access prefetch: fault the span onto
+        ``dev``'s HBM through the batch service loop."""
+        flags = (SQE_LINK if link else 0) | (SQE_WRITE if write else 0)
+        s = _Sqe(opcode=Op.PREFETCH, flags=flags, devInst=dev, addr=addr,
+                 len=length, userData=user_data)
+        return self._prep(s)
+
+    def evict(self, addr: int, length: int, tier: Tier = Tier.HOST,
+              user_data: int = 0, link: bool = False) -> int:
+        """Stage a tier demote (HOST or CXL destination only)."""
+        s = _Sqe(opcode=Op.EVICT, flags=SQE_LINK if link else 0,
+                 dstTier=int(tier), addr=addr, len=length,
+                 userData=user_data)
+        return self._prep(s)
+
+    def advise(self, addr: int, length: int, advice: Advise,
+               tier: Tier = Tier.HOST, dev: int = 0, on: bool = True,
+               user_data: int = 0, link: bool = False) -> int:
+        """Stage a policy op (preferred tier / accessed-by / read dup)."""
+        s = _Sqe(opcode=Op.ADVISE, flags=SQE_LINK if link else 0,
+                 dstTier=int(tier), devInst=dev, addr=addr, len=length,
+                 userData=user_data, arg0=int(advice),
+                 arg1=1 if on else 0)
+        return self._prep(s)
+
+    def peer_copy(self, dev: int, peer: int, local_off: int,
+                  peer_off: int, length: int, read: bool = False,
+                  user_data: int = 0, link: bool = False) -> int:
+        """Stage an ICI peer copy between HBM arena offsets
+        (write: local->peer; ``read=True``: peer->local)."""
+        s = _Sqe(opcode=Op.PEER_COPY, flags=SQE_LINK if link else 0,
+                 devInst=dev, peerInst=peer, addr=local_off,
+                 peerOff=peer_off, len=length, userData=user_data,
+                 arg0=1 if read else 0)
+        return self._prep(s)
+
+    def fence(self, user_data: int = 0) -> int:
+        """Stage a fence: completes only after every previously
+        submitted op has posted its CQE; later ops wait for it."""
+        s = _Sqe(opcode=Op.FENCE, userData=user_data)
+        return self._prep(s)
+
+    # --------------------------------------------------- submit / reap
+
+    def submit(self) -> int:
+        """Publish every staged SQE (one doorbell); returns the count."""
+        return self._lib.tpurmMemringSubmit(self._handle)
+
+    def submit_and_wait(self, wait_for: Optional[int] = None) -> int:
+        """Submit, then park until the work completes.
+
+        Default (``wait_for=None``): drains — returns once EVERY op
+        submitted so far has posted its CQE (``completed == submitted``),
+        so unreaped backlog can't satisfy it early.  An explicit
+        ``wait_for`` parks until that many CQEs are reapable instead.
+        Either way the wait status is checked (RmError on timeout or
+        the dropped-CQE bail), unlike the C convenience
+        ``tpurmMemringSubmitAndWait`` which discards it."""
+        n = self.submit()
+        if wait_for is None:
+            self.drain()
+        elif wait_for:
+            self.wait(wait_for)
+        return n
+
+    def drain(self, timeout_ns: int = 0) -> None:
+        """Park until every op submitted so far has completed
+        (``completed == submitted``); RmError on timeout."""
+        _check(self._lib.tpurmMemringWaitDrain(self._handle, timeout_ns),
+               "tpurmMemringWaitDrain")
+
+    def wait(self, n: int, timeout_ns: int = 0) -> None:
+        """Park until ``n`` CQEs are reapable; RmError on timeout."""
+        _check(self._lib.tpurmMemringWait(self._handle, n, timeout_ns),
+               "tpurmMemringWait")
+
+    def completions(self, max_cqes: int = 1024,
+                    check: bool = False) -> List[Completion]:
+        """Reap up to ``max_cqes``.  ``check=True`` raises RmError on
+        the first error completion (after draining the batch)."""
+        buf = (_Cqe * max_cqes)()
+        n = self._lib.tpurmMemringReap(self._handle, buf, max_cqes)
+        out = [Completion(c.userData, c.status, Op(c.opcode), c.bytes,
+                          c.seq, c.startNs, c.endNs) for c in buf[:n]]
+        if check:
+            for c in out:
+                if not c.ok:
+                    raise native.RmError(
+                        c.status, f"memring op {c.opcode.name} "
+                                  f"user_data={c.user_data}")
+        return out
+
+    @property
+    def sq_space(self) -> int:
+        return self._lib.tpurmMemringSqSpace(self._handle)
+
+    @property
+    def counts(self) -> RingCounts:
+        sub, comp = ctypes.c_uint64(), ctypes.c_uint64()
+        err, ovf = ctypes.c_uint64(), ctypes.c_uint64()
+        self._lib.tpurmMemringCounts(self._handle, ctypes.byref(sub),
+                                     ctypes.byref(comp),
+                                     ctypes.byref(err),
+                                     ctypes.byref(ovf))
+        return RingCounts(sub.value, comp.value, err.value, ovf.value)
+
+    def shm_fd(self) -> int:
+        """The memfd backing the ring region (header + SQ + CQ)."""
+        return self._lib.tpurmMemringShmFd(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tpurmMemringDestroy(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "MemRing":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

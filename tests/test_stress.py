@@ -191,7 +191,8 @@ utils.trace_start()
 inj.set_seed(42)
 SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MSGQ_PUBLISH, inj.Site.ICI_LINK,
-         inj.Site.RDMA_COMPLETION, inj.Site.FENCE_TIMEOUT]
+         inj.Site.RDMA_COMPLETION, inj.Site.FENCE_TIMEOUT,
+         inj.Site.MEMRING_SUBMIT]
 for s in SITES:
     inj.enable(s, inj.Mode.PPM, 10000)
 
@@ -278,6 +279,36 @@ def ici_hammer():
     ap.write(off0.value, off1.value, 64 * 1024)
 
 
+# Memring hammer: drive the engine through the ASYNC submission ring
+# with injection armed — batched migrate/evict/prefetch waves plus a
+# fence, errors surfacing as per-op CQEs (counted, reconciled below).
+from open_gpu_kernel_modules_tpu.uvm import memring
+
+mbuf = vs.alloc(4 * MB)
+mbuf.view()[:] = 0x4D
+mring = memring.MemRing(vs, entries=128)
+mr_stats = {"error_cqes": 0, "reaped": 0}
+SPAN = 256 * 1024
+
+
+def memring_hammer():
+    n = 0
+    for i in range(8):
+        mring.migrate(mbuf.address + i * SPAN, SPAN, Tier.HBM)
+        n += 1
+    mring.fence()
+    n += 1
+    for i in range(8):
+        mring.evict(mbuf.address + i * SPAN, SPAN, Tier.HOST)
+        n += 1
+    mring.submit_and_wait(n)
+    cqes = mring.completions(max_cqes=n)
+    mr_stats["reaped"] += len(cqes)
+    mr_stats["error_cqes"] += sum(1 for c in cqes if not c.ok)
+    v = mbuf.view()
+    assert int(v[0]) == 0x4D and int(v[4 * MB - 1]) == 0x4D
+
+
 rbuf = vs.alloc(2 * MB)
 rbuf.view()[:] = 0xA5
 lib.tpuIbRegMr.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
@@ -297,7 +328,7 @@ def rdma_hammer():
 
 threads = [threading.Thread(target=guard(f)) for f in
            [hammer(0), hammer(1), migrate_cycle, channel_hammer,
-            ici_hammer, rdma_hammer]]
+            ici_hammer, rdma_hammer, memring_hammer]]
 for t in threads:
     t.start()
 for t in threads:
@@ -318,7 +349,28 @@ for i, b in enumerate(bufs):
     if not (b.view() == i + 1).all():
         intact = False
 intact = intact and bool((rbuf.view() == 0xA5).all())
+intact = intact and bool((mbuf.view() == 0x4D).all())
 out["data_intact"] = intact
+
+# Memring reconciliation: exact invariant — every memring.submit inject
+# hit either triggered a bounded retry or terminally failed its run —
+# plus CQE-level accounting against what the hammer reaped.
+mr_ring_counts = mring.counts
+mring.close()
+mr_evals, mr_hits = inj.counts(inj.Site.MEMRING_SUBMIT)
+out["memring"] = {
+    "evals": mr_evals,
+    "hits": mr_hits,
+    "inject_retries": utils.counter("memring_inject_retries"),
+    "inject_error_runs": utils.counter("memring_inject_error_runs"),
+    "inject_error_cqes": utils.counter("memring_inject_error_cqes"),
+    "error_cqes_counter": utils.counter("memring_error_cqes"),
+    "observed_error_cqes": mr_stats["error_cqes"],
+    "reaped": mr_stats["reaped"],
+    "submitted": mr_ring_counts.submitted,
+    "completed": mr_ring_counts.completed,
+    "cq_overflows": mr_ring_counts.cq_overflows,
+}
 
 # Trace accounting for the armed chaos window (before phase 2 so the
 # counters snapshot matches exactly what the rings saw).
@@ -397,6 +449,20 @@ def test_engine_soak_injection():
     # The chaos genuinely fired across >= 5 distinct sites.
     fired = [k for k, h in out["hits"].items() if h > 0]
     assert len(fired) >= 5, out["hits"]
+
+    # Memring rode the chaos: ops flowed through the ring, completion
+    # accounting balanced, and the error-CQE reconciliation is EXACT —
+    # every memring.submit inject hit either became a bounded retry or
+    # terminally failed its run (whose CQEs are the injected error
+    # CQEs the hammer reaped).
+    mr = out["memring"]
+    assert mr["submitted"] > 0 and mr["completed"] == mr["submitted"], mr
+    assert mr["reaped"] == mr["completed"], mr
+    assert mr["cq_overflows"] == 0, mr
+    assert mr["evals"] > 0, mr
+    assert mr["hits"] == mr["inject_retries"] + mr["inject_error_runs"], mr
+    assert mr["observed_error_cqes"] == mr["error_cqes_counter"], mr
+    assert mr["inject_error_cqes"] <= mr["error_cqes_counter"], mr
 
     # Every recovery counter is nonzero.
     c = out["counters"]
