@@ -322,6 +322,26 @@ typedef struct {
 #define TPU_NOTIFIER_RC_ERROR  37u   /* NV2080_NOTIFIERS_RC_ERROR */
 #define TPU_NOTIFIER_CXL_DMA   180u  /* fork: async CXL DMA completion */
 
+/* UVM_ADVISE_COMPRESSIBLE contract (uvm.h UVM_TPU_SET_COMPRESSIBLE /
+ * uvmSetCompressible / memring ADVISE subcode COMPRESSIBLE).
+ *
+ * The advise opts a VA span into the tpuce page-compression stage:
+ * host->HBM uploads quantize the payload (fp8 e4m3 or int8 with a
+ * per-stripe absmax scale, payload treated as float32) and HBM->host
+ * downloads dequantize it; the wire carries ~1/4 the raw bytes
+ * (tpuce_compressed_bytes_in/out counters).  This is a PRECISION
+ * CONTRACT, not a hint: data in an advised span round-trips lossily
+ * (<= 1/16 relative error per element for fp8; <= absmax/254 absolute
+ * for int8).  It is safe exactly when the payload is float data that
+ * tolerates reduced precision — KV-cache pages are the intended user
+ * — and UNSAFE for integers, pointers, packed structs, or any
+ * bit-exact data; those ranges must keep the default (OFF).
+ * Non-finite elements pass through bit-exact, the advise splits
+ * ranges at the span edges like every other policy (a sub-span of an
+ * allocation carries its own setting, inherited across splits), and
+ * a compressed stripe that exhausts its copy retries falls back to
+ * the lossless path rather than corrupting the destination. */
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,176 @@
+/*
+ * tpuce — the multi-channel copy-engine subsystem.
+ *
+ * One scheduled path for every bulk byte the stack moves (block
+ * migration, tier evict/promote, memring coalesced runs, ICI peer
+ * copies, memdesc transfers).  Reference analog: the mem_mgr CE utils
+ * layer striping work across parallel FIFO channels with per-channel
+ * trackers (SURVEY layer 3; ce_utils.c / channel pools per CE type in
+ * uvm_channel.c).
+ *
+ * Structure:
+ *
+ *   manager   — one per device (lazy), owning N logical copy channels
+ *               (registry "tpuce_channels", default 4 capped at the
+ *               online CPUs — each channel is an executor thread; the
+ *               manager grows the device's CE pool to N so RC
+ *               reset-and-replay covers every channel it schedules).  Each channel
+ *               carries its own submission queue (the underlying DMA
+ *               channel's GPFIFO), completion tracker values, and
+ *               busy/bytes accounting exported as tpuce_ch{N}_bytes /
+ *               tpuce_ch{N}_busy_ns counters.
+ *   scheduler — block-granular copies split into stripes (registry
+ *               "tpuce_stripe_bytes", default 512 KB) and each stripe
+ *               lands on the channel with the fewest outstanding
+ *               bytes (load balance by queue depth, not round robin).
+ *               Splits are counted (tpuce_stripe_splits).
+ *   batch     — the submission object: copies striped across the
+ *               manager pipeline freely; tpuCeBatchWait() fences them
+ *               all with PER-STRIPE recovery — a failed stripe is
+ *               retried (bounded, RC reset-and-replay + backoff) or,
+ *               when compressed, re-sent through the lossless path, so
+ *               a stripe failure never corrupts the destination.
+ *
+ * Compression: an opt-in quantize-on-upload / dequantize-on-download
+ * stage on the host<->HBM path for ranges advised COMPRESSIBLE (KV
+ * cache pages tolerate reduced precision; exact ranges stay lossless).
+ * The stripe payload is treated as float32 and quantized to fp8-e4m3
+ * or int8 (per-stripe absmax scale); the destination receives the
+ * DEQUANTIZED working copy at full stride — device compute always
+ * sees valid float data — while the transport-layer saving is modeled
+ * by accounting stripe wire bytes at the compressed size
+ * (tpuce_compressed_bytes_in/out vs tpuce_compressed_bytes_raw).
+ * Non-finite elements pass through bit-exact (never quantized), and a
+ * stripe that exhausts its retries compressed falls back to the
+ * lossless path (tpuce_lossless_fallbacks).
+ *
+ * Failure injection: the "ce.copy" site (TPUMEM_INJECT_CE_COPY) fires
+ * per stripe-submission attempt.  Exact accounting invariant
+ * (test-checked): every ce.copy hit bumps exactly one of
+ * tpuce_inject_retries / tpuce_inject_errors; the general
+ * tpuce_retries / tpuce_stripe_errors counters cover injected and
+ * real failures alike.
+ */
+#ifndef TPURM_CE_H
+#define TPURM_CE_H
+
+#include <stdbool.h>
+#include <stdint.h>
+
+#include "status.h"
+#include "tpurm.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Compression formats (low bits) + direction flag.  The direction only
+ * steers wire-byte accounting (bytes_in = toward HBM, bytes_out = back
+ * toward host); the transform itself is direction-agnostic. */
+enum {
+    TPU_CE_COMP_NONE = 0,
+    TPU_CE_COMP_FP8 = 1,          /* e4m3: 3 mantissa bits, max 448   */
+    TPU_CE_COMP_INT8 = 2,         /* symmetric, per-stripe absmax     */
+    TPU_CE_COMP_FMT_MASK = 0x0F,
+    TPU_CE_COMP_DOWNLOAD = 0x10,  /* accounting: HBM -> host direction */
+};
+
+#define TPUCE_MAX_CHANNELS 8
+#define TPUCE_BATCH_STRIPES 64
+#define TPUCE_GATHER_SEGS 32
+
+typedef struct TpuCeMgr TpuCeMgr;
+
+/* The per-device manager (lazy; NULL when the device does not exist or
+ * its channel pool could not be built). */
+TpuCeMgr *tpuCeMgrGet(uint32_t devInst);
+
+/* Channels currently schedulable (registry tpuce_channels, re-read per
+ * copy through a generation cache so tests/bench can flip it with
+ * tpuRegistryBump; clamped to what the manager could create). */
+uint32_t tpuCeMgrChannels(TpuCeMgr *m);
+
+/* Per-channel accounting snapshot: bytes executed, busy-ns in the
+ * executor, and bytes submitted-but-not-retired.  Any of the out
+ * pointers may be NULL. */
+TpuStatus tpuCeChannelStats(TpuCeMgr *m, uint32_t ch, uint64_t *bytes,
+                            uint64_t *busyNs, uint64_t *outstanding);
+
+/* One discontiguous copy segment (gather submission). */
+typedef struct {
+    void *dst;
+    const void *src;
+    uint64_t len;
+} TpuCeSeg;
+
+/* One stripe in flight (internal layout exposed so batches can live on
+ * the caller's stack; treat as opaque).  A stripe is either one
+ * contiguous span (nsegs == 0: dst/src/len) or a GATHER of up to
+ * TPUCE_GATHER_SEGS discontiguous segments riding one push — one
+ * channel, one submission, one recovery domain (restores the old
+ * 64-segs-per-push economy for fragmented memdesc copies). */
+typedef struct {
+    TpurmChannel *ch;
+    uint32_t chIdx;
+    uint32_t comp;
+    uint32_t attempts;
+    bool injected;                /* current failure came from ce.copy */
+    uint64_t val;                 /* tracker value (0: not in flight)  */
+    TpuStatus subSt;              /* submission status when val == 0   */
+    void *dst;
+    const void *src;
+    uint64_t len;                 /* contiguous span / gather total    */
+    uint32_t nsegs;               /* 0: contiguous; else gather count  */
+    TpuCeSeg segs[TPUCE_GATHER_SEGS];
+} TpuCeStripe;
+
+/* A submission batch: stripes pipeline across the channel pool until
+ * the batch is waited.  When the stripe table fills, the next copy
+ * drains it first (bounded memory, slightly less overlap). */
+typedef struct {
+    TpuCeMgr *m;
+    uint32_t n;
+    TpuStatus st;                 /* sticky first terminal error */
+    TpuCeStripe stripes[TPUCE_BATCH_STRIPES];
+} TpuCeBatch;
+
+TpuStatus tpuCeBatchBegin(TpuCeMgr *m, TpuCeBatch *b);
+
+/* Stripe [src, src+len) -> dst across the pool.  comp is a
+ * TPU_CE_COMP_* format (|DOWNLOAD for accounting); ineligible payloads
+ * (unaligned / tiny) silently degrade to lossless. */
+TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
+                         uint64_t len, uint32_t comp);
+
+/* Gather submission: n (<= TPUCE_GATHER_SEGS) discontiguous segments
+ * as ONE stripe on the least-loaded channel — one push, one recovery
+ * domain.  Lossless only (fragmented payloads never compress). */
+TpuStatus tpuCeBatchCopySegs(TpuCeBatch *b, const TpuCeSeg *segs,
+                             uint32_t n);
+
+/* Fence the batch: waits every stripe, running per-stripe recovery
+ * (bounded retry, lossless fallback).  Idempotent; returns the first
+ * terminal error.  In-flight stripes are always drained before return
+ * (the caller may free the surfaces on error). */
+TpuStatus tpuCeBatchWait(TpuCeBatch *b);
+
+/* Async handoff: move the batch's completion dependencies into the
+ * caller's tracker instead of waiting.  Per-stripe retry does NOT run
+ * on this path — failures surface at the caller's tracker wait
+ * (range-checked), exactly like a raw channel dependency. */
+TpuStatus tpuCeBatchHandoff(TpuCeBatch *b, TpuTracker *t);
+
+/* Convenience: Begin + Copy + Wait. */
+TpuStatus tpuCeCopySync(TpuCeMgr *m, void *dst, const void *src,
+                        uint64_t len, uint32_t comp);
+
+/* Drain every channel the manager schedules (fence semantics for
+ * concurrent submitters: all work submitted before the call completes
+ * before it returns). */
+TpuStatus tpuCeMgrDrain(TpuCeMgr *m);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_CE_H */

@@ -52,6 +52,7 @@ typedef enum {
     TPU_INJECT_SITE_CHANNEL_CE,      /* channel CE push fault            */
     TPU_INJECT_SITE_FENCE_TIMEOUT,   /* fault-service / fence timeout    */
     TPU_INJECT_SITE_MEMRING_SUBMIT,  /* memring op execution (run)       */
+    TPU_INJECT_SITE_CE_COPY,         /* tpuce stripe submission          */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

@@ -94,6 +94,8 @@ enum {
     TPU_MEMRING_ADVISE_ACCESSED_BY = 3,      /* devInst                 */
     TPU_MEMRING_ADVISE_UNSET_ACCESSED_BY = 4,
     TPU_MEMRING_ADVISE_READ_DUP = 5,         /* arg1: 0 off / 1 on      */
+    TPU_MEMRING_ADVISE_COMPRESSIBLE = 6,     /* arg1: UVM_ADVISE_
+                                              * COMPRESSIBLE_* format   */
 };
 
 /* PEER_COPY direction (sqe.arg0): 0 local->peer, 1 peer->local. */

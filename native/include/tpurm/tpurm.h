@@ -234,6 +234,11 @@ typedef struct TpuPush {
 TpuStatus tpuPushBegin(TpurmChannel *ch, uint32_t maxSegs, TpuPush *p);
 TpuStatus tpuPushCopySeg(TpuPush *p, void *dst, const void *src,
                          uint64_t bytes);
+/* Segment with an executor-side transform (TPU_CE_COMP_* format from
+ * ce.h; 0 = plain copy).  The tpuce compression stage rides this: the
+ * executor quantizes+dequantizes the payload in place of memmove. */
+TpuStatus tpuPushCopySegEx(TpuPush *p, void *dst, const void *src,
+                           uint64_t bytes, uint32_t xform);
 /* Submit; returns the tracker value (0 on failure).  If t is non-NULL the
  * (channel, value) pair is recorded there.  An empty push (no segments)
  * is submitted as a no-op marker — useful as a completion fence. */

@@ -69,6 +69,8 @@ typedef enum {
     TPU_TRACE_MSGQ_PUBLISH,      /* msgq submit                        */
     TPU_TRACE_MEMRING_SUBMIT,    /* memring batch publish + doorbell   */
     TPU_TRACE_MEMRING_OP,        /* one memring run (coalesced span)   */
+    TPU_TRACE_CE_COPY,           /* tpuce batch copy (split + submit)  */
+    TPU_TRACE_CE_STRIPE,         /* executor stripe run (obj = channel) */
     TPU_TRACE_APP,               /* application span (Python utils.span) */
     /* Instant-only sites. */
     TPU_TRACE_INJECT_HIT,        /* injection framework fired          */

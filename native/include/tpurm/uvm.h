@@ -85,6 +85,14 @@ extern "C" {
 #define UVM_TPU_DEVICE_ACCESS             1002
 #define UVM_TPU_RESIDENCY_INFO            1003
 #define UVM_TPU_ADOPT_PAGEABLE            1004
+#define UVM_TPU_SET_COMPRESSIBLE          1005
+
+/* UVM_ADVISE_COMPRESSIBLE values (UvmTpuSetCompressibleParams.format,
+ * uvmSetCompressible, memring ADVISE subcode COMPRESSIBLE).  Numeric
+ * values match ce.h TPU_CE_COMP_* formats. */
+#define UVM_ADVISE_COMPRESSIBLE_OFF       0   /* lossless (default)     */
+#define UVM_ADVISE_COMPRESSIBLE_FP8       1   /* fp8 e4m3 quantization  */
+#define UVM_ADVISE_COMPRESSIBLE_INT8      2   /* int8, per-stripe scale */
 
 #define UVM_MIGRATE_FLAG_ASYNC            0x00000001
 
@@ -210,6 +218,15 @@ typedef struct {
     TpuStatus rmStatus;                            /* OUT */
 } UvmAdoptPageableParams;
 
+/* UVM_TPU_SET_COMPRESSIBLE: opt a span into (or out of) the tpuce
+ * page-compression stage.  format is UVM_ADVISE_COMPRESSIBLE_*. */
+typedef struct {
+    uint64_t base   __attribute__((aligned(8)));   /* IN */
+    uint64_t length __attribute__((aligned(8)));   /* IN */
+    uint32_t format;                               /* IN */
+    TpuStatus rmStatus;                            /* OUT */
+} UvmTpuSetCompressibleParams;
+
 /* External ranges (reference: UVM_CREATE_EXTERNAL_RANGE_PARAMS,
  * uvm_ioctl.h:1042; UVM_UNMAP_EXTERNAL_PARAMS:935 — ours omits gpuUuid
  * because the mapped window is a CPU-visible alias, not a per-GPU VA). */
@@ -313,6 +330,13 @@ TpuStatus uvmUnsetAccessedBy(UvmVaSpace *vs, void *base, uint64_t len,
                              uint32_t devInst);
 TpuStatus uvmSetReadDuplication(UvmVaSpace *vs, void *base, uint64_t len,
                                 int enable);
+/* UVM_ADVISE_COMPRESSIBLE: route host<->HBM copies of the span through
+ * the tpuce quantize stage (format = UVM_ADVISE_COMPRESSIBLE_*; OFF
+ * restores lossless).  A precision contract, not a hint: the span's
+ * data will round-trip through fp8/int8 — only KV-cache-like payloads
+ * that tolerate it may opt in. */
+TpuStatus uvmSetCompressible(UvmVaSpace *vs, void *base, uint64_t len,
+                             uint32_t format);
 
 /* Range groups (uvm_range_group.c analog). */
 TpuStatus uvmRangeGroupCreate(UvmVaSpace *vs, uint64_t *outId);

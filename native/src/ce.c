@@ -1,0 +1,634 @@
+/*
+ * tpuce — multi-channel copy-engine manager (see include/tpurm/ce.h).
+ *
+ * Scheduling model: a copy is split into stripes (registry
+ * tpuce_stripe_bytes) and each stripe is submitted to the logical
+ * channel with the fewest outstanding bytes — queue-depth load balance
+ * rather than blind round robin, so one slow channel (RC recovery,
+ * injected stall) sheds load to its peers instead of gating every
+ * fourth stripe.  The logical channels ARE the device's CE pool
+ * (grown to registry tpuce_channels at manager init): RC
+ * reset-and-replay (rc.c tpuRcRecoverAll walks the pool) and the
+ * failed-push history both cover them with no new plumbing.
+ *
+ * Recovery is per stripe: tpuCeBatchWait range-checks every stripe's
+ * own tracker window, so one failed stripe retries (bounded, RC reset
+ * + backoff) while its siblings' completions stand.  A compressed
+ * stripe that exhausts retries is re-sent through the lossless path —
+ * precision downgrade must never become data loss.
+ */
+#define _GNU_SOURCE
+#include "tpurm/ce.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+#include <string.h>
+
+#include "internal.h"
+#include "tpurm/inject.h"
+#include "tpurm/trace.h"
+
+#define TPUCE_MAX_DEVICES 16
+
+typedef struct {
+    TpurmChannel *ch;
+    _Atomic uint64_t outstanding;     /* submitted, not yet retired */
+    _Atomic uint64_t *bytesCtr;       /* tpuce_ch{N}_bytes (executor) */
+    _Atomic uint64_t *busyCtr;        /* tpuce_ch{N}_busy_ns          */
+} CeChannel;
+
+struct TpuCeMgr {
+    TpurmDevice *dev;
+    /* Channels wired into the pool: written under g_ce.lock with a
+     * release store AFTER the slot's counter refs are published, read
+     * with relaxed/acquire loads by every submitter. */
+    _Atomic uint32_t created;
+    _Atomic uint32_t rr;              /* tie-break rotation            */
+    TpuRegCache activeCache;
+    CeChannel ch[TPUCE_MAX_CHANNELS];
+};
+
+static struct {
+    pthread_mutex_t lock;
+    _Atomic(TpuCeMgr *) mgr[TPUCE_MAX_DEVICES];
+} g_ce = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+/* ------------------------------------------------------------ transform */
+
+/* Round to fp8 e4m3: 3 mantissa bits, max normal 448, min normal 2^-6
+ * (subnormal quantum 2^-9).  Non-finite values pass through bit-exact
+ * — compression may lose precision, never meaning. */
+static inline float ce_fp8_round(float v)
+{
+    if (!isfinite(v) || v == 0.0f)
+        return v;
+    float a = fabsf(v);
+    if (a >= 448.0f)
+        return copysignf(448.0f, v);
+    int e;
+    frexpf(a, &e);                    /* a = m * 2^e, m in [0.5, 1) */
+    int q = e - 1 - 3;                /* ulp exponent for 3 mantissa bits */
+    if (q < -9)
+        q = -9;                       /* subnormal floor */
+    float step = ldexpf(1.0f, q);
+    return copysignf(roundf(a / step) * step, v);
+}
+
+/* The executor-side quantize+dequantize stage (channel.c calls this in
+ * place of memmove for xform-tagged segments).  The destination gets
+ * the dequantized working copy at full stride; see ce.h for the wire
+ * accounting model.  bytes not a multiple of 4 keeps a raw tail. */
+void tpuCeXformExec(uint32_t xform, void *dst, const void *src,
+                    uint64_t bytes)
+{
+    uint32_t fmt = xform & TPU_CE_COMP_FMT_MASK;
+    uint64_t n = bytes / 4;
+    const float *s = src;
+    float *d = dst;
+    if (fmt == TPU_CE_COMP_FP8) {
+        for (uint64_t i = 0; i < n; i++)
+            d[i] = ce_fp8_round(s[i]);
+    } else if (fmt == TPU_CE_COMP_INT8) {
+        float absmax = 0.0f;
+        for (uint64_t i = 0; i < n; i++) {
+            float a = fabsf(s[i]);
+            if (isfinite(a) && a > absmax)
+                absmax = a;
+        }
+        if (absmax == 0.0f) {
+            memmove(d, s, n * 4);     /* all zero / non-finite */
+        } else {
+            float scale = absmax / 127.0f;
+            for (uint64_t i = 0; i < n; i++) {
+                float v = s[i];
+                if (!isfinite(v)) {
+                    d[i] = v;
+                    continue;
+                }
+                float q = roundf(v / scale);
+                if (q > 127.0f)
+                    q = 127.0f;
+                else if (q < -127.0f)
+                    q = -127.0f;
+                d[i] = q * scale;
+            }
+        }
+    } else {
+        memmove(dst, src, bytes);
+        return;
+    }
+    if (bytes % 4)
+        memmove((char *)dst + n * 4, (const char *)src + n * 4, bytes % 4);
+}
+
+/* ------------------------------------------------------------- manager */
+
+static TpuRegCache g_stripeCache, g_retryCache, g_copyRetryCache;
+
+/* Default channel count: 4 (the ISSUE shape), capped at the online
+ * CPUs — every channel is an executor THREAD, and on a starved box
+ * surplus executors only preempt each other mid-memmove and stretch
+ * fault-latency tails (same rationale as device.c's base pool).
+ * Registry tpuce_channels overrides either way. */
+static uint32_t ce_default_channels(void)
+{
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    uint32_t dflt = 4;
+    if (ncpu > 0 && dflt > (uint32_t)ncpu)
+        dflt = (uint32_t)ncpu;
+    return dflt < 1 ? 1 : dflt;
+}
+
+static uint64_t ce_stripe_bytes(void)
+{
+    uint64_t s = tpuRegCacheGet(&g_stripeCache, "tpuce_stripe_bytes",
+                                512 * 1024);
+    if (s < 4096)
+        s = 4096;
+    return s;
+}
+
+static uint32_t ce_retry_max(void)
+{
+    /* Defaults to the recovery framework's copy-retry knob so
+     * "retries disabled" (recover_copy_retries=0) governs the whole
+     * copy path; tpuce_retry_max overrides independently. */
+    uint64_t dflt = tpuRegCacheGet(&g_copyRetryCache,
+                                   "recover_copy_retries", 3);
+    return (uint32_t)tpuRegCacheGet(&g_retryCache, "tpuce_retry_max",
+                                    dflt);
+}
+
+/* Wire channel `i` (creating it in the device pool if the base pool is
+ * smaller than the tpuce request).  g_ce.lock held. */
+static bool ce_wire_channel(TpuCeMgr *m, uint32_t i)
+{
+    TpurmDevice *dev = m->dev;
+    _Static_assert(TPUCE_MAX_CHANNELS <= TPU_CE_POOL_MAX,
+                   "tpuce channels must fit the device CE pool");
+    if (i >= dev->cePoolSize) {
+        TpurmChannel *ch = tpurmChannelCreate(dev, TPURM_CE_ANY, 0);
+        if (!ch)
+            return false;
+        dev->cePool[i] = ch;
+        /* seq_cst store publishes the pointer write above to the
+         * lockless rc.c / procfs.c readers. */
+        dev->cePoolSize = i + 1;
+    }
+    char name[48];
+    m->ch[i].ch = dev->cePool[i];
+    snprintf(name, sizeof(name), "tpuce_ch%u_bytes", i);
+    m->ch[i].bytesCtr = tpuCounterRef(name);
+    snprintf(name, sizeof(name), "tpuce_ch%u_busy_ns", i);
+    m->ch[i].busyCtr = tpuCounterRef(name);
+    tpurmChannelSetCeAcct(dev->cePool[i], m->ch[i].bytesCtr,
+                          m->ch[i].busyCtr, i);
+    /* Publish AFTER the slot is fully wired: a submitter reading
+     * created with acquire sees the counter refs. */
+    atomic_store_explicit(&m->created, i + 1, memory_order_release);
+    return true;
+}
+
+static inline uint32_t ce_created(TpuCeMgr *m)
+{
+    return atomic_load_explicit(&m->created, memory_order_acquire);
+}
+
+/* Active channel count: registry tpuce_channels through a generation
+ * cache (bench flips it with tpuRegistryBump), growing the wired set
+ * on demand and clamping to what could be built. */
+static uint32_t ce_active(TpuCeMgr *m)
+{
+    uint32_t want = (uint32_t)tpuRegCacheGet(&m->activeCache,
+                                             "tpuce_channels",
+                                             ce_default_channels());
+    if (want < 1)
+        want = 1;
+    if (want > TPUCE_MAX_CHANNELS)
+        want = TPUCE_MAX_CHANNELS;
+    if (want > ce_created(m)) {
+        pthread_mutex_lock(&g_ce.lock);
+        while (ce_created(m) < want && ce_wire_channel(m, ce_created(m)))
+            ;
+        pthread_mutex_unlock(&g_ce.lock);
+    }
+    uint32_t created = ce_created(m);
+    return want > created ? created : want;
+}
+
+TpuCeMgr *tpuCeMgrGet(uint32_t devInst)
+{
+    if (devInst >= TPUCE_MAX_DEVICES)
+        return NULL;
+    TpuCeMgr *m = atomic_load_explicit(&g_ce.mgr[devInst],
+                                       memory_order_acquire);
+    if (m)
+        return m;
+    TpurmDevice *dev = tpurmDeviceGet(devInst);
+    if (!dev)
+        return NULL;
+    pthread_mutex_lock(&g_ce.lock);
+    m = atomic_load_explicit(&g_ce.mgr[devInst], memory_order_relaxed);
+    if (!m) {
+        m = calloc(1, sizeof(*m));
+        if (m) {
+            m->dev = dev;
+            uint32_t want = (uint32_t)tpuRegistryGet(
+                "tpuce_channels", ce_default_channels());
+            if (want < 1)
+                want = 1;
+            if (want > TPUCE_MAX_CHANNELS)
+                want = TPUCE_MAX_CHANNELS;
+            for (uint32_t i = 0; i < want; i++)
+                if (!ce_wire_channel(m, i))
+                    break;
+            if (ce_created(m) == 0) {
+                free(m);
+                m = NULL;
+            } else {
+                tpuLog(TPU_LOG_INFO, "tpuce",
+                       "dev %u: %u copy channel(s), stripe %llu KB",
+                       devInst, ce_created(m),
+                       (unsigned long long)(ce_stripe_bytes() >> 10));
+                atomic_store_explicit(&g_ce.mgr[devInst], m,
+                                      memory_order_release);
+            }
+        }
+    }
+    pthread_mutex_unlock(&g_ce.lock);
+    return m;
+}
+
+uint32_t tpuCeMgrChannels(TpuCeMgr *m)
+{
+    return m ? ce_active(m) : 0;
+}
+
+TpuStatus tpuCeChannelStats(TpuCeMgr *m, uint32_t ch, uint64_t *bytes,
+                            uint64_t *busyNs, uint64_t *outstanding)
+{
+    if (!m || ch >= ce_created(m))
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (bytes)
+        *bytes = atomic_load_explicit(m->ch[ch].bytesCtr,
+                                      memory_order_relaxed);
+    if (busyNs)
+        *busyNs = atomic_load_explicit(m->ch[ch].busyCtr,
+                                       memory_order_relaxed);
+    if (outstanding)
+        *outstanding = atomic_load_explicit(&m->ch[ch].outstanding,
+                                            memory_order_relaxed);
+    return TPU_OK;
+}
+
+/* ------------------------------------------------------------ scheduler */
+
+/* Least-outstanding-bytes channel among the active set; ties rotate. */
+static uint32_t ce_pick(TpuCeMgr *m, uint32_t active)
+{
+    uint32_t start = atomic_fetch_add_explicit(&m->rr, 1,
+                                               memory_order_relaxed) %
+                     active;
+    uint32_t best = start;
+    uint64_t bestOut = atomic_load_explicit(&m->ch[start].outstanding,
+                                            memory_order_relaxed);
+    for (uint32_t k = 1; k < active; k++) {
+        uint32_t i = (start + k) % active;
+        uint64_t out = atomic_load_explicit(&m->ch[i].outstanding,
+                                            memory_order_relaxed);
+        if (out < bestOut) {
+            best = i;
+            bestOut = out;
+        }
+    }
+    return best;
+}
+
+/* Submit one stripe (no injection evaluation — the recovered wrappers
+ * below own that).  On success records the tracker value and bumps the
+ * channel's outstanding + wire accounting. */
+static TpuStatus ce_stripe_push(TpuCeMgr *m, TpuCeStripe *s)
+{
+    TpuPush p;
+    TpuStatus st = tpuPushBegin(s->ch, s->nsegs ? s->nsegs : 1, &p);
+    if (st != TPU_OK)
+        return st;
+    if (s->nsegs) {
+        for (uint32_t i = 0; i < s->nsegs && st == TPU_OK; i++)
+            st = tpuPushCopySegEx(&p, s->segs[i].dst, s->segs[i].src,
+                                  s->segs[i].len, 0);
+    } else {
+        st = tpuPushCopySegEx(&p, s->dst, s->src, s->len,
+                              s->comp & TPU_CE_COMP_FMT_MASK);
+    }
+    if (st != TPU_OK) {
+        tpuPushAbort(&p);
+        return st;
+    }
+    uint64_t v = tpuPushEnd(&p, NULL);
+    if (v == 0)
+        return TPU_ERR_INVALID_STATE;
+    s->val = v;
+    atomic_fetch_add_explicit(&m->ch[s->chIdx].outstanding, s->len,
+                              memory_order_relaxed);
+    if (s->comp & TPU_CE_COMP_FMT_MASK) {
+        /* Wire model: 4 raw bytes -> 1 compressed byte (+ raw tail).
+         * Counted per successful submission — a retried stripe crosses
+         * the wire again. */
+        uint64_t wire = s->len / 4 + s->len % 4;
+        tpuCounterAdd(s->comp & TPU_CE_COMP_DOWNLOAD
+                          ? "tpuce_compressed_bytes_out"
+                          : "tpuce_compressed_bytes_in", wire);
+        tpuCounterAdd("tpuce_compressed_bytes_raw", s->len);
+    }
+    return TPU_OK;
+}
+
+/* Submission attempt with the ce.copy injection site evaluated (one
+ * evaluation per attempt; a hit fails the attempt before any byte is
+ * staged, so the destination is untouched). */
+static TpuStatus ce_stripe_submit(TpuCeMgr *m, TpuCeStripe *s)
+{
+    uint64_t scope = (uint64_t)(uintptr_t)(s->nsegs ? s->segs[0].dst
+                                                    : s->dst);
+    if (tpurmInjectShouldFailScoped(TPU_INJECT_SITE_CE_COPY, scope)) {
+        s->injected = true;
+        s->val = 0;
+        s->subSt = TPU_ERR_RETRY_EXHAUSTED;   /* transient by design */
+        return s->subSt;
+    }
+    s->injected = false;
+    s->subSt = ce_stripe_push(m, s);
+    return s->subSt;
+}
+
+/* Complete one stripe with per-stripe recovery.  Failure handling:
+ * bounded retry (RC reset-and-replay + backoff, counted), then — for
+ * compressed stripes — one recovered lossless pass before giving up.
+ * Exact invariant: each ce.copy inject hit bumps exactly one of
+ * tpuce_inject_retries / tpuce_inject_errors. */
+static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s)
+{
+    uint32_t lim = ce_retry_max();
+    for (;;) {
+        TpuStatus st;
+        if (s->val) {
+            st = tpurmChannelWaitRange(s->ch, s->val, s->val);
+            atomic_fetch_sub_explicit(&m->ch[s->chIdx].outstanding,
+                                      s->len, memory_order_relaxed);
+            s->val = 0;
+            /* A wait-side failure is the channel's, not injection's. */
+            s->injected = false;
+        } else {
+            st = s->subSt;
+        }
+        if (st == TPU_OK)
+            return TPU_OK;
+        if (s->attempts < lim) {
+            s->attempts++;
+            tpuCounterAdd("tpuce_retries", 1);
+            tpuCounterAdd("recover_retries", 1);
+            if (s->injected)
+                tpuCounterAdd("tpuce_inject_retries", 1);
+            tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY,
+                              (uint64_t)(uintptr_t)s->dst,
+                              s->attempts - 1);
+            tpuRcRecoverAll();
+            tpuRecoverBackoff(s->attempts - 1);
+            ce_stripe_submit(m, s);
+            continue;
+        }
+        /* Retries exhausted. */
+        tpuCounterAdd("tpuce_stripe_errors", 1);
+        if (s->injected)
+            tpuCounterAdd("tpuce_inject_errors", 1);
+        if (s->comp & TPU_CE_COMP_FMT_MASK) {
+            /* Lossless fallback: the compressed path is optional by
+             * contract — strip the format and run one recovered raw
+             * pass.  No ce.copy evaluation here (the fallback must be
+             * able to land; channel-level faults still apply). */
+            tpuCounterAdd("tpuce_lossless_fallbacks", 1);
+            tpuLog(TPU_LOG_WARN, "tpuce",
+                   "stripe %p+%llu: compressed path exhausted, lossless "
+                   "fallback", s->dst, (unsigned long long)s->len);
+            s->comp = TPU_CE_COMP_NONE;
+            s->injected = false;
+            for (uint32_t a = 0; a <= lim; a++) {
+                if (ce_stripe_push(m, s) == TPU_OK) {
+                    st = tpurmChannelWaitRange(s->ch, s->val, s->val);
+                    atomic_fetch_sub_explicit(
+                        &m->ch[s->chIdx].outstanding, s->len,
+                        memory_order_relaxed);
+                    s->val = 0;
+                    if (st == TPU_OK)
+                        return TPU_OK;
+                }
+                if (a < lim) {
+                    tpuCounterAdd("tpuce_retries", 1);
+                    tpuCounterAdd("recover_retries", 1);
+                    /* Paired instant: the armed chaos soak reconciles
+                     * recover_retries against recover.retry events
+                     * EXACTLY — every bump site must emit one. */
+                    tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY,
+                                      (uint64_t)(uintptr_t)s->dst, a);
+                    tpuRcRecoverAll();
+                    tpuRecoverBackoff(a);
+                }
+            }
+        }
+        return st == TPU_ERR_INVALID_STATE || s->attempts
+                   ? TPU_ERR_RETRY_EXHAUSTED : st;
+    }
+}
+
+/* ---------------------------------------------------------------- batch */
+
+TpuStatus tpuCeBatchBegin(TpuCeMgr *m, TpuCeBatch *b)
+{
+    if (!m || !b)
+        return TPU_ERR_INVALID_ARGUMENT;
+    b->m = m;
+    b->n = 0;
+    b->st = TPU_OK;
+    return TPU_OK;
+}
+
+TpuStatus tpuCeBatchWait(TpuCeBatch *b)
+{
+    if (!b || !b->m)
+        return TPU_ERR_INVALID_ARGUMENT;
+    for (uint32_t i = 0; i < b->n; i++) {
+        TpuStatus st = ce_stripe_complete(b->m, &b->stripes[i]);
+        if (st != TPU_OK && b->st == TPU_OK)
+            b->st = st;
+    }
+    b->n = 0;
+    return b->st;
+}
+
+TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
+                         uint64_t len, uint32_t comp)
+{
+    if (!b || !b->m || (len && (!dst || !src)))
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (len == 0)
+        return TPU_OK;
+    TpuCeMgr *m = b->m;
+    uint64_t tSpan = tpurmTraceBegin();
+    /* Compression eligibility: float32 payloads only (aligned, at
+     * least one element); anything else rides lossless. */
+    if ((comp & TPU_CE_COMP_FMT_MASK) &&
+        (len < 4 || (((uintptr_t)dst | (uintptr_t)src | len) & 3)))
+        comp = TPU_CE_COMP_NONE;
+
+    uint32_t active = ce_active(m);
+    uint64_t stripe = ce_stripe_bytes();
+    uint32_t nstripes = 0;
+    uint64_t off = 0;
+    while (off < len) {
+        uint64_t piece = len - off;
+        if (piece > stripe)
+            piece = stripe;
+        /* Compressed stripes must stay 4-aligned so every piece is a
+         * whole float array. */
+        if ((comp & TPU_CE_COMP_FMT_MASK) && piece < len - off)
+            piece &= ~3ull;
+        if (b->n == TPUCE_BATCH_STRIPES) {
+            /* Table full: drain before staging more (bounded memory;
+             * the sticky batch error is preserved). */
+            TpuStatus st = tpuCeBatchWait(b);
+            if (st != TPU_OK) {
+                if (tSpan)
+                    tpurmTraceEnd(TPU_TRACE_CE_COPY, tSpan,
+                                  (uint64_t)(uintptr_t)dst, off);
+                return st;
+            }
+        }
+        TpuCeStripe *s = &b->stripes[b->n];
+        memset(s, 0, sizeof(*s) - sizeof(s->segs));   /* nsegs = 0 */
+        s->chIdx = ce_pick(m, active);
+        s->ch = m->ch[s->chIdx].ch;
+        s->dst = (char *)dst + off;
+        s->src = (const char *)src + off;
+        s->len = piece;
+        s->comp = comp;
+        /* Submission failures are not terminal here: the stripe is
+         * recorded and ce_stripe_complete re-drives it with the full
+         * recovery ladder at wait time. */
+        ce_stripe_submit(m, s);
+        b->n++;
+        nstripes++;
+        off += piece;
+    }
+    if (nstripes > 1)
+        tpuCounterAdd("tpuce_stripe_splits", nstripes - 1);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_CE_COPY, tSpan, (uint64_t)(uintptr_t)dst,
+                      len);
+    return TPU_OK;
+}
+
+TpuStatus tpuCeBatchCopySegs(TpuCeBatch *b, const TpuCeSeg *segs,
+                             uint32_t n)
+{
+    if (!b || !b->m || !segs || n == 0 || n > TPUCE_GATHER_SEGS)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t total = 0;
+    for (uint32_t i = 0; i < n; i++) {
+        if (segs[i].len && (!segs[i].dst || !segs[i].src))
+            return TPU_ERR_INVALID_ARGUMENT;
+        total += segs[i].len;
+    }
+    if (total == 0)
+        return TPU_OK;
+    TpuCeMgr *m = b->m;
+    if (b->n == TPUCE_BATCH_STRIPES) {
+        TpuStatus st = tpuCeBatchWait(b);
+        if (st != TPU_OK)
+            return st;
+    }
+    TpuCeStripe *s = &b->stripes[b->n];
+    memset(s, 0, sizeof(*s) - sizeof(s->segs));
+    s->chIdx = ce_pick(m, ce_active(m));
+    s->ch = m->ch[s->chIdx].ch;
+    s->nsegs = n;
+    memcpy(s->segs, segs, (size_t)n * sizeof(*segs));
+    s->dst = segs[0].dst;             /* trace / inject-scope anchor */
+    s->src = segs[0].src;
+    s->len = total;
+    s->comp = TPU_CE_COMP_NONE;
+    ce_stripe_submit(m, s);
+    b->n++;
+    return TPU_OK;
+}
+
+TpuStatus tpuCeBatchHandoff(TpuCeBatch *b, TpuTracker *t)
+{
+    if (!b || !b->m || !t)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuStatus st = b->st;
+    for (uint32_t i = 0; i < b->n; i++) {
+        TpuCeStripe *s = &b->stripes[i];
+        if (s->val == 0) {
+            /* Never submitted (injected/transient at submit): one
+             * recovered completion now — a dependency that does not
+             * exist cannot be handed off. */
+            TpuStatus cs = ce_stripe_complete(b->m, s);
+            if (cs != TPU_OK && st == TPU_OK)
+                st = cs;
+            continue;
+        }
+        /* Outstanding accounting is forfeited at handoff: nobody will
+         * call back when the caller's tracker completes, and leaking
+         * the count would permanently skew the least-loaded scheduler
+         * against this channel — under-reporting briefly is the lesser
+         * distortion.  (Handed-off stripes may still be in flight
+         * while ChannelStats.outstanding reads 0.) */
+        atomic_fetch_sub_explicit(&b->m->ch[s->chIdx].outstanding,
+                                  s->len, memory_order_relaxed);
+        if (tpuTrackerAdd(t, s->ch, s->val) != TPU_OK) {
+            /* Cannot record the dep: complete it instead of losing it. */
+            TpuStatus ws = tpurmChannelWaitRange(s->ch, s->val, s->val);
+            if (ws != TPU_OK && st == TPU_OK)
+                st = ws;
+        }
+    }
+    b->n = 0;
+    b->st = TPU_OK;
+    return st;
+}
+
+TpuStatus tpuCeCopySync(TpuCeMgr *m, void *dst, const void *src,
+                        uint64_t len, uint32_t comp)
+{
+    TpuCeBatch b;
+    TpuStatus st = tpuCeBatchBegin(m, &b);
+    if (st != TPU_OK)
+        return st;
+    st = tpuCeBatchCopy(&b, dst, src, len, comp);
+    TpuStatus ws = tpuCeBatchWait(&b);
+    return st != TPU_OK ? st : ws;
+}
+
+TpuStatus tpuCeMgrDrain(TpuCeMgr *m)
+{
+    if (!m)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuStatus st = TPU_OK;
+    uint32_t created = ce_created(m);
+    for (uint32_t i = 0; i < created; i++) {
+        /* A zero-byte push is a pure fence: its tracker value orders
+         * after everything already in the channel's GPFIFO. */
+        uint64_t v = tpurmChannelPushCopy(m->ch[i].ch, NULL, NULL, 0);
+        if (v == 0) {
+            st = TPU_ERR_INVALID_STATE;
+            continue;
+        }
+        TpuStatus ws = tpurmChannelWaitRange(m->ch[i].ch, v, v);
+        if (ws != TPU_OK && st == TPU_OK)
+            st = ws;
+    }
+    return st;
+}

@@ -31,6 +31,7 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/ce.h"
 #include "tpurm/inject.h"
 #include "tpurm/msgq.h"
 #include "tpurm/trace.h"
@@ -43,11 +44,15 @@
 #define CH_ERR_RING 64
 
 /* A copy method within a push (the reference encodes CE methods into
- * pushbuffer space; here a segment IS the method). */
+ * pushbuffer space; here a segment IS the method).  xform selects an
+ * executor-side transform (TPU_CE_COMP_* from ce.h; 0 = plain copy) —
+ * the tpuce compression stage quantizes through it. */
 typedef struct {
     void *dst;
     const void *src;
     uint64_t bytes;
+    uint32_t xform;
+    uint32_t pad;
 } CopySeg;
 
 /* Outstanding pushbuffer chunk, in allocation order.  gpu_get advances
@@ -89,6 +94,11 @@ struct TpurmChannel {
     _Atomic uint64_t errEvictedMax; /* highest seq aged out of the ring */
     _Atomic uint32_t evRefs;   /* live event-worker jobs referencing us
                                 * (event.c); destroy waits for zero */
+    /* tpuce per-channel accounting (ce.c): executed bytes / busy-ns
+     * land in these counter cells; ceIdx tags ce.stripe trace spans. */
+    _Atomic(_Atomic uint64_t *) ceBytesCtr;
+    _Atomic(_Atomic uint64_t *) ceBusyCtr;
+    uint32_t ceIdx;
     _Atomic uint32_t stallMs;  /* test injection: executor stall */
     uint64_t rcId;             /* unique id for RC attribution (ABA) */
     TpurmChannelErrorNotifier errNotifier;   /* under lock */
@@ -138,6 +148,11 @@ static void *channel_executor(void *arg)
         bool failed = (cmd.flags & TPU_MSGQ_FLAG_INJECT_ERROR) != 0;
         bool readbackFailed = false;
         uint64_t bytes = 0;
+        _Atomic uint64_t *ceBytes = atomic_load_explicit(
+            &ch->ceBytesCtr, memory_order_acquire);
+        _Atomic uint64_t *ceBusy = atomic_load_explicit(
+            &ch->ceBusyCtr, memory_order_acquire);
+        uint64_t tExec = ceBusy ? tpuNowNs() : 0;
         if (!failed && cmd.op == TPU_MSGQ_CE_PUSH) {
             const CopySeg *segs = (const CopySeg *)(uintptr_t)cmd.src;
             for (uint64_t i = 0; i < cmd.bytes; i++) {
@@ -161,11 +176,29 @@ static void *channel_executor(void *arg)
                         readbackFailed = true;
                         break;
                     }
-                    memmove(segs[i].dst, segs[i].src, segs[i].bytes);
+                    if (segs[i].xform)
+                        tpuCeXformExec(segs[i].xform, segs[i].dst,
+                                       segs[i].src, segs[i].bytes);
+                    else
+                        memmove(segs[i].dst, segs[i].src, segs[i].bytes);
                     tpuHbmMirrorNotify(segs[i].dst, segs[i].bytes);
                 }
                 bytes += segs[i].bytes;
             }
+        }
+        /* tpuce accounting: executed bytes + executor busy time on the
+         * channel's counter cells (Prometheus tpuce_ch{N}_* series),
+         * plus a per-channel ce.stripe span while tracing is armed. */
+        if (ceBusy) {
+            uint64_t tDone = tpuNowNs();
+            atomic_fetch_add_explicit(ceBusy, tDone - tExec,
+                                      memory_order_relaxed);
+            if (!failed && bytes && ceBytes)
+                atomic_fetch_add_explicit(ceBytes, bytes,
+                                          memory_order_relaxed);
+            if (bytes && tpurmTraceIsArmed())
+                tpurmTraceSpanAt(TPU_TRACE_CE_STRIPE, tExec, tDone,
+                                 ch->ceIdx, bytes);
         }
 
         pthread_mutex_lock(&ch->lock);
@@ -373,8 +406,8 @@ TpuStatus tpuPushBegin(TpurmChannel *ch, uint32_t maxSegs, TpuPush *p)
     return TPU_OK;
 }
 
-TpuStatus tpuPushCopySeg(TpuPush *p, void *dst, const void *src,
-                         uint64_t bytes)
+TpuStatus tpuPushCopySegEx(TpuPush *p, void *dst, const void *src,
+                           uint64_t bytes, uint32_t xform)
 {
     if (!p || !p->ch || p->nsegs >= p->maxSegs)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -384,7 +417,15 @@ TpuStatus tpuPushCopySeg(TpuPush *p, void *dst, const void *src,
     s->dst = dst;
     s->src = src;
     s->bytes = bytes;
+    s->xform = xform;
+    s->pad = 0;
     return TPU_OK;
+}
+
+TpuStatus tpuPushCopySeg(TpuPush *p, void *dst, const void *src,
+                         uint64_t bytes)
+{
+    return tpuPushCopySegEx(p, dst, src, bytes, 0);
 }
 
 uint64_t tpuPushEnd(TpuPush *p, TpuTracker *t)
@@ -631,29 +672,27 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
         return TPU_ERR_INVALID_LIMIT;
     if (dev->lost)
         return TPU_ERR_GPU_IS_LOST;
-    if (dev->cePoolSize == 0)
+    TpuCeMgr *mgr = tpuCeMgrGet(dev->inst);
+    if (!mgr)
         return TPU_ERR_INVALID_STATE;
 
     uint64_t clamp = tpuRegistryGet("ce_copy_clamp_bytes", TPU_CE_COPY_CLAMP);
     uint64_t remaining = size;
-    TpuTracker local;
-    tpuTrackerInit(&local);
-
-    /* Contiguity-split loop (reference: ce_utils.c:646-661): each segment
-     * covers the largest run contiguous in BOTH surfaces, clamped.
-     * Segments batch into push objects (up to 64 per push), and pushes
-     * STRIPE round-robin across the device's CE pool (reference: channel
-     * pools per CE type; large transfers ride several engines), all
-     * recorded in one tracker. */
-    enum { SEGS_PER_PUSH = 64 };
-    uint32_t ceIdx = 0;
-    TpurmChannel *ch = dev->cePool[0];
-    TpuPush push;
-    TpuStatus st = tpuPushBegin(ch, SEGS_PER_PUSH, &push);
-    if (st != TPU_OK) {
-        tpuTrackerDeinit(&local);
+    TpuCeBatch batch;
+    TpuStatus st = tpuCeBatchBegin(mgr, &batch);
+    if (st != TPU_OK)
         return st;
-    }
+
+    /* Contiguity-split loop (reference: ce_utils.c:646-661): each copy
+     * covers the largest run contiguous in BOTH surfaces, clamped, and
+     * rides the tpuce scheduler — stripes land on the least-loaded
+     * channel with per-stripe recovery at the fence.  Fragmented
+     * surfaces (page-list memdescs split into 4 KB runs) GATHER up to
+     * TPUCE_GATHER_SEGS runs per stripe, keeping the old
+     * many-segments-per-push submission economy. */
+    TpuCeSeg gather[TPUCE_GATHER_SEGS];
+    uint32_t ngather = 0;
+    uint64_t gatherMax = 64 * 1024;     /* runs below this batch up */
     while (remaining > 0) {
         void *dptr, *sptr;
         uint64_t drun, srun;
@@ -670,102 +709,56 @@ TpuStatus tpuMemCopy(TpurmDevice *dev, TpuMemDesc *dst, uint64_t dstOff,
             len = srun;
         if (len > clamp)
             len = clamp;
-        if (push.nsegs == SEGS_PER_PUSH) {
-            if (tpuPushEnd(&push, &local) == 0) {
-                st = TPU_ERR_INVALID_STATE;
-                tpuTrackerWait(&local);
-                tpuTrackerDeinit(&local);
-                return st;
+        if (len < gatherMax) {
+            gather[ngather].dst = dptr;
+            gather[ngather].src = sptr;
+            gather[ngather].len = len;
+            if (++ngather == TPUCE_GATHER_SEGS) {
+                st = tpuCeBatchCopySegs(&batch, gather, ngather);
+                ngather = 0;
+                if (st != TPU_OK)
+                    goto fail;
             }
-            ceIdx = (ceIdx + 1) % dev->cePoolSize;
-            ch = dev->cePool[ceIdx];
-            st = tpuPushBegin(ch, SEGS_PER_PUSH, &push);
-            if (st != TPU_OK) {
-                /* Drain submitted work before unwinding (drain rule). */
-                tpuTrackerWait(&local);
-                tpuTrackerDeinit(&local);
-                return st;
-            }
+        } else {
+            st = tpuCeBatchCopy(&batch, dptr, sptr, len,
+                                TPU_CE_COMP_NONE);
+            if (st != TPU_OK)
+                goto fail;
         }
-        st = tpuPushCopySeg(&push, dptr, sptr, len);
-        if (st != TPU_OK)
-            goto fail;
         dstOff += len;
         srcOff += len;
         remaining -= len;
     }
-    if (push.nsegs > 0) {
-        if (tpuPushEnd(&push, &local) == 0) {
-            tpuTrackerWait(&local);
-            tpuTrackerDeinit(&local);
-            return TPU_ERR_INVALID_STATE;
-        }
-    } else {
-        tpuPushAbort(&push);
+    if (ngather) {
+        st = tpuCeBatchCopySegs(&batch, gather, ngather);
+        if (st != TPU_OK)
+            goto fail;
     }
 
-    if (async && outTracker) {
+    if (async && outTracker)
         /* Hand the dependencies to the caller (unregister quiesce etc.);
-         * an OOM merging them degrades to synchronous completion so no
-         * dependency is silently lost. */
-        if (tpuTrackerAddTracker(outTracker, &local) != TPU_OK)
-            st = tpuTrackerWait(&local);
-        tpuTrackerDeinit(&local);
-        return st;
-    }
-    st = tpuTrackerWait(&local);
-    tpuTrackerDeinit(&local);
-    return st;
+         * failures then surface at the caller's range-checked wait. */
+        return tpuCeBatchHandoff(&batch, outTracker);
+    return tpuCeBatchWait(&batch);
 
 fail:
-    tpuPushAbort(&push);
-    /* Drain pushes already submitted: the caller may free/unpin the
+    /* Drain stripes already submitted: the caller may free/unpin the
      * surfaces on error while workers are still writing them (same rule
      * as block_copy_in's drain-before-unwind). */
-    tpuTrackerWait(&local);
-    tpuTrackerDeinit(&local);
+    tpuCeBatchWait(&batch);
     return st;
 }
 
-/* ------------------------------------------------------- CE pool striper */
+/* ---------------------------------------------------- tpuce accounting */
 
-bool tpuCeStriperInit(TpuCeStriper *s, TpurmDevice *dev)
+void tpurmChannelSetCeAcct(TpurmChannel *ch, _Atomic uint64_t *bytesCtr,
+                           _Atomic uint64_t *busyCtr, uint32_t ceIdx)
 {
-    if (!dev || dev->cePoolSize == 0)
-        return false;
-    s->dev = dev;
-    s->next = 0;
-    /* Stripe default: 512 KB spreads a block copy across the pool; with
-     * a single executor (1-CPU box) striping buys no overlap, so larger
-     * 2 MB stripes cut per-push overhead instead. */
-    s->stripe = tpuRegistryGet("uvm_ce_stripe_bytes",
-                               dev->cePoolSize > 1 ? 512 * 1024
-                                                   : 2 * 1024 * 1024);
-    if (s->stripe < 4096)
-        s->stripe = 4096;
-    return true;
-}
-
-TpuStatus tpuCeStriperPush(TpuCeStriper *s, void *dst, const void *src,
-                           uint64_t len, TpuTracker *t)
-{
-    uint64_t off = 0;
-    while (off < len) {
-        uint64_t piece = len - off;
-        if (piece > s->stripe)
-            piece = s->stripe;
-        TpurmChannel *ch = s->dev->cePool[s->next];
-        s->next = (s->next + 1) % s->dev->cePoolSize;
-        uint64_t v = tpurmChannelPushCopy(ch, (char *)dst + off,
-                                          (const char *)src + off, piece);
-        if (v == 0)
-            return TPU_ERR_INVALID_STATE;
-        if (t && tpuTrackerAdd(t, ch, v) != TPU_OK)
-            /* Can't record the dep: complete it now instead of losing it. */
-            tpurmChannelWait(ch, v);
-        off += piece;
-    }
-    return TPU_OK;
+    if (!ch)
+        return;
+    ch->ceIdx = ceIdx;
+    atomic_store_explicit(&ch->ceBytesCtr, bytesCtr, memory_order_release);
+    atomic_store_explicit(&ch->ceBusyCtr, busyCtr, memory_order_release);
 }
 
 /* ---- event-job pinning (event.c) ---- */

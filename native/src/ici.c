@@ -16,6 +16,7 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/ce.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
 #include "tpurm/trace.h"
@@ -519,38 +520,29 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
         pthread_mutex_unlock(&g_ici.lock);
     }
     if (hops <= 1) {
-        /* Bounded retry: a CE fault under the hop copy (injected or
-         * real) recovers via RC reset-and-replay + re-push.  Range
-         * waits attribute failures to OUR push only, so concurrent
-         * recoveries elsewhere neither mask nor pollute this copy. */
-        uint32_t lim = (uint32_t)tpuRegistryGet("recover_copy_retries", 3);
-        for (uint32_t attempt = 0; ; attempt++) {
-            uint64_t v = tpurmChannelPushCopy(local->ce, dst, src, size);
-            st = TPU_ERR_INVALID_STATE;
-            if (v != 0) {
-                if (tracker && attempt == 0 &&
-                    tpuTrackerAdd(tracker, local->ce, v) == TPU_OK) {
-                    /* Async contract: failure surfaces at the caller's
-                     * tracker wait (range-checked), where the caller
-                     * retries. */
-                    tpuCounterAdd("ici_peer_copy_bytes", size);
-                    return TPU_OK;
-                }
-                st = tpurmChannelWaitRange(local->ce, v, v);
-            }
-            if (st == TPU_OK) {
-                tpuCounterAdd("ici_peer_copy_bytes", size);
-                return TPU_OK;
-            }
-            if (attempt >= lim)
-                return attempt ? TPU_ERR_RETRY_EXHAUSTED : st;
-            tpuCounterAdd("recover_retries", 1);
-            tpuCounterAdd("recover_ici_retries", 1);
-            tpurmTraceInstant(TPU_TRACE_RECOVER_RETRY, (uintptr_t)dst,
-                              attempt);
-            tpuRcRecoverAll();
-            tpuRecoverBackoff(attempt);
+        /* PEER_COPY rides the hop-source device's tpuce manager:
+         * stripes spread across its channel pool, and tpuce owns the
+         * bounded retry + RC reset-and-replay per stripe (the bespoke
+         * retry loop this replaces).  With a tracker, the stripes'
+         * dependencies hand off to the caller (failures surface at its
+         * range-checked wait); without one, completion is synchronous
+         * with per-stripe recovery. */
+        TpuCeMgr *mgr = tpuCeMgrGet(from);
+        if (!mgr)
+            return TPU_ERR_INVALID_STATE;
+        TpuCeBatch b;
+        tpuCeBatchBegin(mgr, &b);
+        st = tpuCeBatchCopy(&b, dst, src, size, TPU_CE_COMP_NONE);
+        if (tracker && st == TPU_OK) {
+            st = tpuCeBatchHandoff(&b, tracker);
+        } else {
+            TpuStatus ws = tpuCeBatchWait(&b);
+            if (st == TPU_OK)
+                st = ws;
         }
+        if (st == TPU_OK)
+            tpuCounterAdd("ici_peer_copy_bytes", size);
+        return st;
     }
 
     /* Build the hop chain from..to. */
@@ -613,66 +605,89 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
         goto out_free;
 
     /* Stream segments through the chain as a SOFTWARE PIPELINE: each
-     * hop is an async push on the hop-source device's CE, waiting only
-     * its two real dependencies — the same segment's previous hop (the
-     * data it forwards) and the PREVIOUS segment's next hop (the
-     * staging slot it overwrites).  Hop 0 of segment s+1 therefore
-     * overlaps the later hops of segment s, which is exactly how
-     * wormhole-ish torus traffic keeps every link busy. */
+     * hop is a tpuce batch on the hop-source device's manager (striped
+     * across its channel pool), fencing only its two real dependencies
+     * — the same segment's previous hop (the data it forwards) and the
+     * PREVIOUS segment's next hop (the staging slot it overwrites).
+     * Hop 0 of segment s+1 therefore overlaps the later hops of
+     * segment s, which is exactly how wormhole-ish torus traffic keeps
+     * every link busy.  tpuCeBatchWait is idempotent, so dependency
+     * fences, slot-reuse fences and the tail drain can all hit the
+     * same batch. */
     {
-        uint64_t prevVal[MAX_HOPS + 1];
-        uint64_t curVal[MAX_HOPS + 1];
-        memset(prevVal, 0, sizeof(prevVal));
-        /* curVal must start zeroed: if the FIRST segment fails before
-         * all hops are submitted, the prevVal memcpy below would
-         * otherwise propagate stack garbage for the never-submitted
-         * hops and the tail drain would block on arbitrary tracker
-         * values (tpurmChannelWait short-circuits on value==0). */
-        memset(curVal, 0, sizeof(curVal));
+        TpuCeMgr *hopMgr[MAX_HOPS + 1];
+        for (uint32_t h = 0; h + 1 < n; h++) {
+            hopMgr[h] = tpuCeMgrGet(chain[h]);
+            if (!hopMgr[h]) {
+                st = TPU_ERR_INVALID_STATE;
+                break;
+            }
+        }
+        /* Two batch rows (previous / current segment), heap-side and
+         * sized to the ACTUAL chain: a batch embeds its stripe table,
+         * so rows for the worst-case MAX_HOPS would zero megabytes per
+         * detour copy for nothing. */
+        TpuCeBatch *rows = st == TPU_OK ? calloc(2 * n, sizeof(*rows))
+                                        : NULL;
+        if (st == TPU_OK && !rows)
+            st = TPU_ERR_NO_MEMORY;
+        TpuCeBatch *prevB = rows, *curB = rows ? rows + n : NULL;
+        if (rows)
+            for (uint32_t h = 0; h + 1 < n; h++) {
+                tpuCeBatchBegin(hopMgr[h], &prevB[h]);
+                tpuCeBatchBegin(hopMgr[h], &curB[h]);
+            }
         uint32_t lastHop = n - 2;
         for (uint64_t off = 0; off < size && st == TPU_OK; off += seg) {
             uint64_t len = size - off < seg ? size - off : seg;
             const char *hopSrc = (const char *)src + off;
             for (uint32_t h = 0; h + 1 < n && st == TPU_OK; h++) {
-                /* Data dependency: previous hop of THIS segment.
-                 * (Range waits: only THIS pipeline's pushes fail us.) */
+                /* Data dependency: previous hop of THIS segment. */
                 if (h > 0) {
-                    st = tpurmChannelWaitRange(chainDev[h - 1]->ce,
-                                               curVal[h - 1],
-                                               curVal[h - 1]);
+                    st = tpuCeBatchWait(&curB[h - 1]);
                     if (st != TPU_OK)
                         break;
                 }
                 /* Staging reuse: the PREVIOUS segment must have been
-                 * read out of the slot this push overwrites. */
-                if (h < lastHop && prevVal[h + 1]) {
-                    st = tpurmChannelWaitRange(chainDev[h + 1]->ce,
-                                               prevVal[h + 1],
-                                               prevVal[h + 1]);
+                 * read out of the slot this copy overwrites. */
+                if (h < lastHop) {
+                    st = tpuCeBatchWait(&prevB[h + 1]);
                     if (st != TPU_OK)
                         break;
                 }
+                /* The slot we are about to refill carried the copy two
+                 * segments back: fence it before reuse. */
+                st = tpuCeBatchWait(&curB[h]);
+                if (st != TPU_OK)
+                    break;
                 void *hopDst = (h == lastHop)
                                    ? (char *)dst + off
                                    : (char *)tpurmDeviceHbmBase(
                                          chainDev[h + 1]) + stageOff[h];
-                curVal[h] = tpurmChannelPushCopy(chainDev[h]->ce, hopDst,
-                                                 hopSrc, len);
-                if (curVal[h] == 0) {
-                    st = TPU_ERR_INVALID_STATE;
+                st = tpuCeBatchCopy(&curB[h], hopDst, hopSrc, len,
+                                    TPU_CE_COMP_NONE);
+                if (st != TPU_OK)
                     break;
-                }
                 tpuCounterAdd("ici_hop_bytes", len);
                 hopSrc = hopDst;
             }
-            memcpy(prevVal, curVal, sizeof(prevVal));
+            if (rows) {
+                TpuCeBatch *t = prevB;
+                prevB = curB;
+                curB = t;
+            }
         }
         /* Drain the tail (staging frees below must not race copies). */
-        for (uint32_t h = 0; h + 1 < n; h++) {
-            TpuStatus ws = tpurmChannelWaitRange(chainDev[h]->ce,
-                                                 prevVal[h], prevVal[h]);
-            if (ws != TPU_OK && st == TPU_OK)
-                st = ws;
+        if (rows) {
+            for (uint32_t h = 0; h + 1 < n; h++) {
+                TpuStatus ws = tpuCeBatchWait(&prevB[h]);
+                if (ws != TPU_OK && st == TPU_OK)
+                    st = ws;
+                ws = tpuCeBatchWait(&curB[h]);
+                if (ws != TPU_OK && st == TPU_OK)
+                    st = ws;
+            }
+            free(rows);
         }
     }
     if (st == TPU_OK) {

@@ -51,6 +51,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "channel.ce",
     "fence.timeout",
     "memring.submit",
+    "ce.copy",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -63,6 +64,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "CHANNEL_CE",
     "FENCE_TIMEOUT",
     "MEMRING_SUBMIT",
+    "CE_COPY",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)

@@ -215,9 +215,12 @@ struct TpurmDevice {
     TpurmChannel *ce;          /* legacy shared CE channel (== cePool[0]) */
     /* CE channel pool (reference: channel pools per CE type,
      * uvm_channel.c): large copies stripe across the pool so the
-     * worker threads memcpy in parallel. */
+     * worker threads memcpy in parallel.  cePoolSize is atomic because
+     * tpuce (ce.c) GROWS the pool at runtime while rc.c/procfs.c read
+     * it locklessly — the seq_cst store publishes the cePool[i] write
+     * that precedes it. */
     TpurmChannel *cePool[TPU_CE_POOL_MAX];
-    uint32_t cePoolSize;
+    _Atomic uint32_t cePoolSize;
     /* Real-arena backend (hbm.c): when registered, engine writes to the
      * shadow publish dirty ranges on mirrorq for the JAX runtime. */
     _Atomic int arenaReal;
@@ -372,18 +375,21 @@ void tpurmChannelRcDeliver(TpurmChannel *ch, uint64_t value,
 void tpurmChannelProgress(TpurmChannel *ch, uint64_t *completed,
                           uint64_t *pendingDepth);
 
-/* CE pool striper: round-robins pieces of a copy across the device's
- * channel pool, recording each push in a tracker (reference: channel
- * pools per CE type + pipelined pushes + uvm_tracker.c dependencies).
- * Replaces the old per-callsite fan-out. */
-typedef struct {
-    TpurmDevice *dev;
-    uint32_t next;
-    uint64_t stripe;
-} TpuCeStriper;
+/* ------------------------------------------------------------- tpuce
+ *
+ * The multi-channel copy-engine subsystem (ce.h / ce.c) replaced the
+ * old per-callsite TpuCeStriper fan-out: every bulk copy path submits
+ * through a TpuCeBatch now.  These are the cross-module hooks. */
 
-bool      tpuCeStriperInit(TpuCeStriper *s, TpurmDevice *dev);
-TpuStatus tpuCeStriperPush(TpuCeStriper *s, void *dst, const void *src,
-                           uint64_t len, TpuTracker *t);
+/* Executor-side compression stage (ce.c): applied by the channel
+ * executor in place of memmove for xform-tagged segments. */
+void tpuCeXformExec(uint32_t xform, void *dst, const void *src,
+                    uint64_t bytes);
+
+/* Attach tpuce per-channel accounting to a DMA channel: the executor
+ * adds executed bytes / busy-ns to the given counter cells and tags
+ * its ce.stripe trace spans with ceIdx.  NULL counters detach. */
+void tpurmChannelSetCeAcct(TpurmChannel *ch, _Atomic uint64_t *bytesCtr,
+                           _Atomic uint64_t *busyCtr, uint32_t ceIdx);
 
 #endif /* TPURM_INTERNAL_H */

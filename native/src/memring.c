@@ -263,6 +263,9 @@ static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
         case TPU_MEMRING_ADVISE_READ_DUP:
             return uvmSetReadDuplication(r->vs, addr, len,
                                          sqe->arg1 ? 1 : 0);
+        case TPU_MEMRING_ADVISE_COMPRESSIBLE:
+            return uvmSetCompressible(r->vs, addr, len,
+                                      (uint32_t)sqe->arg1);
         default:
             return TPU_ERR_INVALID_ARGUMENT;
         }

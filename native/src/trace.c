@@ -78,6 +78,8 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "msgq.publish",           "msgq"    },
     { "memring.submit",         "memring" },
     { "memring.op",             "memring" },
+    { "ce.copy",                "ce"      },
+    { "ce.stripe",              "ce"      },
     { "app.span",               "app"     },
     { "inject.hit",             "inject"  },
     { "recover.retry",          "recover" },

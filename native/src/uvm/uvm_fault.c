@@ -38,6 +38,7 @@
 #include <execinfo.h>
 #include <linux/futex.h>
 #include <sched.h>
+#include <sys/uio.h>
 #include <signal.h>
 #include <stdatomic.h>
 #include <stdlib.h>
@@ -127,6 +128,10 @@ static struct {
     uint32_t nWorkers;
     _Atomic uint32_t inService;       /* workers currently in a batch */
     _Atomic uint32_t serviceHighWater;/* max simultaneous (observability) */
+    /* Set once any fault delivers a nonzero x86 page-fault error code:
+     * the kernel reports access types and the service can skip the
+     * write-inference fallback (sandboxes zero the field). */
+    _Atomic int regErrWorks;
     struct sigaction oldSegv;
 
     /* Stats (shared).  Latencies land in three tputrace histograms
@@ -452,6 +457,25 @@ static TpuStatus service_one(UvmFaultEntry *e)
             if (spanEnd > rEnd)
                 spanEnd = rEnd;
             uint64_t len = spanEnd - addr + 1;
+            /* Write-fault inference for remote windows (same sandbox
+             * REG_ERR limitation as the managed branch below, but no
+             * residency masks to consult here): probe the page's
+             * CURRENT readability with process_vm_readv — it reports
+             * EFAULT instead of faulting.  A CPU fault on a readable
+             * page can only be a write (read-open windows are RO, so
+             * the first store must forward as a write or it storms). */
+            if (e->source == UVM_FAULT_SRC_CPU && !e->isWrite &&
+                !atomic_load_explicit(&g_fault.regErrWorks,
+                                      memory_order_relaxed)) {
+                char probe;
+                struct iovec liov = { &probe, 1 };
+                struct iovec riov = { (void *)(uintptr_t)e->addr, 1 };
+                if (process_vm_readv(getpid(), &liov, 1, &riov, 1, 0) ==
+                    1) {
+                    e->isWrite = 1;
+                    tpuCounterAdd("uvm_write_faults_inferred", 1);
+                }
+            }
             int fst = tpurmBrokerUvmFault(rBase + (addr - lBase), len,
                                           e->isWrite != 0);
             st = (TpuStatus)fst;
@@ -497,6 +521,49 @@ static TpuStatus service_one(UvmFaultEntry *e)
         uint64_t spanEnd = end < blockEnd ? end : blockEnd;
         uint32_t firstPage = (uint32_t)((addr - blk->start) / ps);
         uint32_t count = (uint32_t)((spanEnd - addr) / ps) + 1;
+
+        /* Write-fault inference.  Sandboxed kernels (this container's
+         * included) zero the x86 page-fault error code, so the SIGSEGV
+         * handler cannot tell writes from reads and reports everything
+         * as a read.  The engine itself knows better: a CPU fault on a
+         * page that is host-resident and CPU-readable — mapped RO by
+         * read duplication, pre-migration write protection, or an
+         * accessed-by downgrade — can ONLY be a write, because reads
+         * of readable pages do not fault.  Without the upgrade the
+         * read-service is a no-op, the store replays into the same RO
+         * page, and the fault storms forever (the long-standing
+         * test_read_duplication / uvm_test_runner VA_BLOCK livelock,
+         * also the serving flush path's pathological slowness). */
+        if (e->source == UVM_FAULT_SRC_CPU && !e->isWrite &&
+            !atomic_load_explicit(&g_fault.regErrWorks,
+                                  memory_order_relaxed)) {
+            pthread_mutex_lock(&blk->lock);
+            tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "write-infer");
+            bool roMapped =
+                uvmPageMaskTest(&blk->resident[UVM_TIER_HOST], firstPage) &&
+                !uvmPageMaskTest(&blk->cpuMapped, firstPage) &&
+                !(blk->hasCancelled &&
+                  uvmPageMaskTest(&blk->cancelled, firstPage));
+            tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "write-infer");
+            pthread_mutex_unlock(&blk->lock);
+            if (roMapped) {
+                /* Confirm the page is actually READABLE before
+                 * upgrading: a host-resident page can also sit behind
+                 * PROT_NONE (e.g. a surviving read-dup copy after an
+                 * exclusive migrate device-ward protects the whole
+                 * span), where a plain read fault is legitimate.
+                 * process_vm_readv reports EFAULT instead of faulting,
+                 * so the probe is safe from a service worker. */
+                char probe;
+                struct iovec liov = { &probe, 1 };
+                struct iovec riov = { (void *)(uintptr_t)e->addr, 1 };
+                if (process_vm_readv(getpid(), &liov, 1, &riov, 1, 0) ==
+                    1) {
+                    e->isWrite = 1;
+                    tpuCounterAdd("uvm_write_faults_inferred", 1);
+                }
+            }
+        }
 
         /* Fully-quarantined span: the page(s) were retired after
          * exhausting every bounded retry — report that rather than
@@ -1221,9 +1288,16 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
 
     int isWrite = 1;
 #ifdef __x86_64__
-    /* Page-fault error code bit 1 = write access. */
+    /* Page-fault error code bit 1 = write access.  Sandboxed kernels
+     * zero REG_ERR entirely; real kernels always set the USER bit for
+     * user-space faults, so ANY nonzero value proves the field works
+     * and lets the service skip its write-inference fallback. */
     ucontext_t *uc = uctx;
-    isWrite = (uc->uc_mcontext.gregs[REG_ERR] & 0x2) != 0;
+    uint64_t err = (uint64_t)uc->uc_mcontext.gregs[REG_ERR];
+    if (err)
+        atomic_store_explicit(&g_fault.regErrWorks, 1,
+                              memory_order_relaxed);
+    isWrite = (err & 0x2) != 0;
 #else
     (void)uctx;
 #endif

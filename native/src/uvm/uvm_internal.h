@@ -348,6 +348,11 @@ typedef struct UvmVaRange {
     UvmLocation preferred;
     uint64_t accessedByMask;          /* bit per device inst */
     bool readDuplication;
+    /* UVM_ADVISE_COMPRESSIBLE: TPU_CE_COMP_* format (0 = lossless).
+     * Host<->HBM copies of this range ride the tpuce quantize stage —
+     * only safe for data that tolerates reduced precision (KV-cache
+     * pages); exact ranges must never set it. */
+    uint32_t compressFormat;
     uint64_t rangeGroupId;            /* 0 = none */
     /* Blocks, one per 2 MB span. */
     UvmVaBlock **blocks;

@@ -455,6 +455,12 @@ static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
         p->rmStatus = st;
         return 0;
     }
+    case UVM_TPU_SET_COMPRESSIBLE: {
+        UvmTpuSetCompressibleParams *p = argp;
+        p->rmStatus = uvmSetCompressible(
+            vs, (void *)(uintptr_t)p->base, p->length, p->format);
+        return 0;
+    }
     case UVM_TPU_DEVICE_ACCESS: {
         UvmTpuDeviceAccessParams *p = argp;
         UvmLocation loc;

@@ -22,6 +22,7 @@
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
+#include "tpurm/ce.h"
 #include "tpurm/trace.h"
 #include "tpurm/inject.h"
 
@@ -264,20 +265,30 @@ void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
         uvmPageMaskClearRange(&blk->cpuMapped, firstPage, count);
 }
 
-/* Block copies stripe across the device's CE pool and synchronize
- * through a tracker — the same (channel, value) dependency object the
- * ICI and CXL engines use (reference: uvm_tracker.c; channel pools per
- * CE type + pipelined pushes, uvm_channel.c / uvm_migrate.c:555). */
-static bool block_striper_init(TpuCeStriper *s, UvmVaBlock *blk)
+/* Block copies ride the tpuce multi-channel manager (ce.h): stripes
+ * land on the least-loaded channel with per-stripe recovery at the
+ * batch fence (reference: mem_mgr CE utils striping across FIFO
+ * channels with per-channel trackers, uvm_channel.c pools). */
+static TpuCeMgr *block_ce_mgr(UvmVaBlock *blk)
 {
-    TpurmDevice *dev = tpurmDeviceGet(blk->hbmDevInst);
-    if (!dev)
-        dev = tpurmDeviceGet(0);
-    if (!tpuCeStriperInit(s, dev))
-        return false;
-    if (s->stripe < uvmPageSize())
-        s->stripe = uvmPageSize();
-    return true;
+    TpuCeMgr *m = tpuCeMgrGet(blk->hbmDevInst);
+    return m ? m : tpuCeMgrGet(0);
+}
+
+/* Compression stage selection for one copy span: ranges advised
+ * COMPRESSIBLE quantize on the host->HBM upload and dequantize on the
+ * HBM->host download (ce.h wire model); every other direction — and
+ * every advise-free range — stays lossless. */
+static uint32_t block_comp_for(UvmVaBlock *blk, UvmTier dstTier, int srcTier)
+{
+    uint32_t fmt = blk->range->compressFormat;
+    if (!fmt)
+        return TPU_CE_COMP_NONE;
+    if (dstTier == UVM_TIER_HBM && srcTier == UVM_TIER_HOST)
+        return fmt;
+    if (dstTier == UVM_TIER_HOST && srcTier == UVM_TIER_HBM)
+        return fmt | TPU_CE_COMP_DOWNLOAD;
+    return TPU_CE_COMP_NONE;
 }
 
 /* cpuMapped tracks live managed RW PTEs; cancelled pages sit on poison
@@ -324,12 +335,10 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         return TPU_ERR_INVALID_STATE;
 
     uint64_t ps = uvmPageSize();
-    TpuCeStriper striper;
-    TpuTracker tracker;
-    tpuTrackerInit(&tracker);
-    /* Striper init is LAZY: the first-touch zero-fill path (every
+    TpuCeBatch batch;
+    /* Manager lookup is LAZY: the first-touch zero-fill path (every
      * populate fault) never pushes a copy, so it must not pay the CE
-     * pool lookup. */
+     * manager lookup. */
     bool haveCe = false, triedCe = false;
     uint64_t bytes = 0;
 
@@ -344,8 +353,8 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         int src = page_src_tier(blk, p);
         void *dstPtr = tier_page_ptr(blk, dstTier, p);
         if (!dstPtr) {
-            tpuTrackerWait(&tracker);
-            tpuTrackerDeinit(&tracker);
+            if (haveCe)
+                tpuCeBatchWait(&batch);
             return TPU_ERR_INVALID_STATE;
         }
         if (src < 0) {
@@ -358,8 +367,8 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                  * chip-dirty overlap coherent first so the zero-fill's
                  * republish can't resurrect stale shadow bytes. */
                 if (tpuHbmCoherentForRead(dstPtr, ps) != TPU_OK) {
-                    tpuTrackerWait(&tracker);
-                    tpuTrackerDeinit(&tracker);
+                    if (haveCe)
+                        tpuCeBatchWait(&batch);
                     return TPU_ERR_INVALID_STATE;
                 }
                 memset(dstPtr, 0, ps);
@@ -374,8 +383,8 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         }
         void *srcPtr = tier_page_ptr(blk, (UvmTier)src, p);
         if (!srcPtr) {
-            tpuTrackerWait(&tracker);
-            tpuTrackerDeinit(&tracker);
+            if (haveCe)
+                tpuCeBatchWait(&batch);
             return TPU_ERR_INVALID_STATE;
         }
         /* Grow the span while pages are selected, same source tier, and
@@ -391,17 +400,16 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
             span++;
         if (!triedCe) {
             triedCe = true;
-            haveCe = block_striper_init(&striper, blk);
+            TpuCeMgr *m = block_ce_mgr(blk);
+            haveCe = m && tpuCeBatchBegin(m, &batch) == TPU_OK;
         }
-        if (!haveCe) {
-            tpuTrackerDeinit(&tracker);
+        if (!haveCe)
             return TPU_ERR_INVALID_STATE;
-        }
-        TpuStatus st = tpuCeStriperPush(&striper, dstPtr, srcPtr,
-                                        (uint64_t)span * ps, &tracker);
+        TpuStatus st = tpuCeBatchCopy(&batch, dstPtr, srcPtr,
+                                      (uint64_t)span * ps,
+                                      block_comp_for(blk, dstTier, src));
         if (st != TPU_OK) {
-            tpuTrackerWait(&tracker);
-            tpuTrackerDeinit(&tracker);
+            tpuCeBatchWait(&batch);
             return st;
         }
         bytes += (uint64_t)span * ps;
@@ -409,9 +417,7 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
     }
     if (bytesOut)
         *bytesOut = bytes;
-    TpuStatus st = tpuTrackerWait(&tracker);
-    tpuTrackerDeinit(&tracker);
-    return st;
+    return haveCe ? tpuCeBatchWait(&batch) : TPU_OK;
 }
 
 /* ---------------------------------------------------------- eviction */
@@ -472,10 +478,9 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
 
     if (first <= last) {
         if (!uvmPageMaskEmpty(&toHost, np)) {
-            TpuCeStriper striper;
-            TpuTracker tracker;
-            tpuTrackerInit(&tracker);
-            bool haveCe = block_striper_init(&striper, blk);
+            TpuCeBatch batch;
+            TpuCeMgr *mgr = block_ce_mgr(blk);
+            bool haveCe = mgr && tpuCeBatchBegin(mgr, &batch) == TPU_OK;
             uint64_t bytes = 0;
             for (uint32_t p = first; p <= last; p++) {
                 if (!uvmPageMaskTest(&toHost, p))
@@ -496,13 +501,15 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                  * accesses fault and queue behind this eviction rather
                  * than reading stale bytes or losing stores. */
                 TpuStatus st = haveCe
-                                   ? tpuCeStriperPush(&striper, dst, src,
-                                                      (uint64_t)span * ps,
-                                                      &tracker)
+                                   ? tpuCeBatchCopy(&batch, dst, src,
+                                                    (uint64_t)span * ps,
+                                                    block_comp_for(
+                                                        blk, UVM_TIER_HOST,
+                                                        (int)tier))
                                    : TPU_ERR_INVALID_STATE;
                 if (st != TPU_OK) {
-                    tpuTrackerWait(&tracker);   /* drain in-flight stripes */
-                    tpuTrackerDeinit(&tracker);
+                    if (haveCe)
+                        tpuCeBatchWait(&batch); /* drain in-flight stripes */
                     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-evict");
                     pthread_mutex_unlock(&blk->lock);
                     return st;
@@ -511,8 +518,8 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                 p += span - 1;
             }
             {
-                TpuStatus st = tpuTrackerWait(&tracker);
-                tpuTrackerDeinit(&tracker);
+                TpuStatus st = haveCe ? tpuCeBatchWait(&batch)
+                                      : TPU_ERR_INVALID_STATE;
                 if (st != TPU_OK) {
                     /* Nothing committed: masks and user PTEs unchanged,
                      * so the device copy stays authoritative and CPU

@@ -16,6 +16,7 @@
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
+#include "tpurm/ce.h"
 
 #include "tpurm/peermem.h"
 
@@ -635,6 +636,7 @@ static TpuStatus range_split_locked(UvmVaSpace *vs, UvmVaRange *range,
     tail->preferred = range->preferred;
     tail->accessedByMask = range->accessedByMask;
     tail->readDuplication = range->readDuplication;
+    tail->compressFormat = range->compressFormat;
     tail->rangeGroupId = range->rangeGroupId;
     /* Move the tail's blocks over (block start addresses are absolute,
      * so only the owning-range pointer changes). */
@@ -825,6 +827,28 @@ TpuStatus uvmSetReadDuplication(UvmVaSpace *vs, void *base, uint64_t len,
                                 int enable)
 {
     return for_ranges_in(vs, base, len, read_dup_fn, &enable);
+}
+
+static void compressible_fn(UvmVaRange *r, void *arg)
+{
+    r->compressFormat = *(uint32_t *)arg;
+}
+
+/* UVM_ADVISE_COMPRESSIBLE: opt [base, base+len) into the tpuce
+ * quantize-on-upload / dequantize-on-download stage (ce.h).  format is
+ * a TPU_CE_COMP_* value; 0 restores lossless.  The advise is an
+ * explicit precision contract — only data that tolerates fp8/int8
+ * round-trips (KV-cache pages) may set it; exact data must not. */
+TpuStatus uvmSetCompressible(UvmVaSpace *vs, void *base, uint64_t len,
+                             uint32_t format)
+{
+    if (format != TPU_CE_COMP_NONE && format != TPU_CE_COMP_FP8 &&
+        format != TPU_CE_COMP_INT8)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpuStatus st = for_ranges_in(vs, base, len, compressible_fn, &format);
+    if (st == TPU_OK)
+        tpuCounterAdd("uvm_compressible_advises", 1);
+    return st;
 }
 
 /* ---------------------------------------------------------- range groups */

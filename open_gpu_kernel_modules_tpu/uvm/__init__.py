@@ -12,9 +12,11 @@ in-process library the serving stack calls directly (SURVEY.md §1: TPU
 devices are driven from userspace).
 """
 
+from . import ce  # noqa: F401  (tpuce copy-engine stats surface)
 from . import inject  # noqa: F401  (fault injection + recovery counters)
 from . import memring  # noqa: F401  (async memory-op rings, tpumemring)
 from .managed import (  # noqa: F401
+    Compress,
     Tier,
     VaSpace,
     ManagedBuffer,

@@ -35,6 +35,7 @@ class Site(enum.IntEnum):
     CHANNEL_CE = 5       # channel CE push fault
     FENCE_TIMEOUT = 6    # fault-service / fence timeout
     MEMRING_SUBMIT = 7   # memring op execution (per coalesced run)
+    CE_COPY = 8          # tpuce stripe submission (per attempt)
 
 
 class Mode(enum.IntEnum):
@@ -60,7 +61,6 @@ DETAIL_COUNTERS = (
     "recover_fault_retries",
     "recover_msgq_retries",
     "recover_rdma_retries",
-    "recover_ici_retries",
     "ici_link_flaps",
     "ici_degraded_routes",
     "ici_retrain_failures",
@@ -71,6 +71,11 @@ DETAIL_COUNTERS = (
     "memring_inject_error_runs",
     "memring_inject_error_cqes",
     "memring_error_cqes",
+    "tpuce_retries",
+    "tpuce_stripe_errors",
+    "tpuce_inject_retries",
+    "tpuce_inject_errors",
+    "tpuce_lossless_fallbacks",
 )
 
 _bound = None

@@ -26,6 +26,21 @@ class Tier(enum.IntEnum):
     CXL = 2
 
 
+class Compress(enum.IntEnum):
+    """UVM_ADVISE_COMPRESSIBLE formats (uvm.h / ce.h).
+
+    A precision contract, not a hint: an advised span's float32 data
+    round-trips through the tpuce quantize stage on host<->HBM copies
+    (fp8 e4m3 or int8 with per-stripe scale).  Only payloads that
+    tolerate reduced precision — KV-cache pages — may opt in; exact
+    data must stay OFF.
+    """
+
+    OFF = 0
+    FP8 = 1
+    INT8 = 2
+
+
 class EventType(enum.IntEnum):
     """Tools event types (uvm.h UvmEventType)."""
 
@@ -175,6 +190,8 @@ def _lib() -> ctypes.CDLL:
     lib.uvmUnsetAccessedBy.restype = u32
     lib.uvmSetReadDuplication.argtypes = [vp, vp, u64, ctypes.c_int]
     lib.uvmSetReadDuplication.restype = u32
+    lib.uvmSetCompressible.argtypes = [vp, vp, u64, u32]
+    lib.uvmSetCompressible.restype = u32
     lib.uvmRangeGroupCreate.argtypes = [vp, ctypes.POINTER(u64)]
     lib.uvmRangeGroupCreate.restype = u32
     lib.uvmRangeGroupDestroy.argtypes = [vp, u64]
@@ -421,6 +438,16 @@ class ManagedBuffer:
         _check(self._lib.uvmUnsetAccessedBy(self._vs._handle, self.address,
                                             self.nbytes, dev),
                "uvmUnsetAccessedBy")
+
+    def set_compressible(self, fmt: "Compress", offset: int = 0,
+                         length: Optional[int] = None) -> None:
+        """UVM_ADVISE_COMPRESSIBLE: opt the span into (fmt=FP8/INT8) or
+        out of (fmt=OFF) the tpuce compression stage.  Lossy by design
+        — see :class:`Compress`."""
+        _check(self._lib.uvmSetCompressible(
+            self._vs._handle, self.address + offset,
+            self.nbytes - offset if length is None else length, int(fmt)),
+               "uvmSetCompressible")
 
     def residency(self, offset: int = 0) -> ResidencyInfo:
         raw = _ResidencyInfo()

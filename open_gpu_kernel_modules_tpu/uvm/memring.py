@@ -61,6 +61,7 @@ class Advise(enum.IntEnum):
     ACCESSED_BY = 3
     UNSET_ACCESSED_BY = 4
     READ_DUP = 5
+    COMPRESSIBLE = 6     # arg1 = Compress format (UVM_ADVISE_COMPRESSIBLE)
 
 
 SQE_LINK = 0x1
@@ -227,12 +228,15 @@ class MemRing:
 
     def advise(self, addr: int, length: int, advice: Advise,
                tier: Tier = Tier.HOST, dev: int = 0, on: bool = True,
-               user_data: int = 0, link: bool = False) -> int:
-        """Stage a policy op (preferred tier / accessed-by / read dup)."""
+               user_data: int = 0, link: bool = False,
+               arg: Optional[int] = None) -> int:
+        """Stage a policy op (preferred tier / accessed-by / read dup /
+        compressible).  ``arg`` overrides the on/off payload for
+        subcodes that carry a value (COMPRESSIBLE: Compress format)."""
         s = _Sqe(opcode=Op.ADVISE, flags=SQE_LINK if link else 0,
                  dstTier=int(tier), devInst=dev, addr=addr, len=length,
                  userData=user_data, arg0=int(advice),
-                 arg1=1 if on else 0)
+                 arg1=(1 if on else 0) if arg is None else int(arg))
         return self._prep(s)
 
     def peer_copy(self, dev: int, peer: int, local_off: int,

@@ -1,0 +1,100 @@
+"""tpuce surface: multi-channel striping accounting through the Python
+stats face, the UVM_ADVISE_COMPRESSIBLE precision contract (bounded
+lossy round-trip for advised ranges, bit-exact otherwise), and the
+memring ADVISE subcode that sets it asynchronously.
+"""
+
+import numpy as np
+
+from open_gpu_kernel_modules_tpu import uvm
+from open_gpu_kernel_modules_tpu.uvm import ce, memring
+from open_gpu_kernel_modules_tpu.uvm.managed import Compress, Tier
+
+MB = 1 << 20
+
+
+def test_striping_stats_and_drain():
+    """A block-granular migrate splits into stripes across >= 2
+    channels; per-channel byte accounting covers the copy; drain
+    leaves nothing outstanding."""
+    before = ce.stats()
+    assert ce.channels() >= 2
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(4 * MB)
+        buf.view()[:] = 0x7E
+        buf.migrate(Tier.HBM)
+        buf.migrate(Tier.HOST)
+        assert bool((buf.view() == 0x7E).all())
+        buf.free()
+    ce.drain()
+    after = ce.stats()
+    assert after.stripe_splits > before.stripe_splits
+    moved = [a.bytes - b.bytes
+             for a, b in zip(after.channels, before.channels)]
+    assert sum(moved) >= 8 * MB          # both directions accounted
+    assert sum(1 for m in moved if m > 0) >= 2   # load-balanced
+    assert all(c.outstanding == 0 for c in after.channels)
+    assert sum(c.busy_ns for c in after.channels) > 0
+
+
+def test_compressible_round_trip_bounds():
+    """Advised ranges round-trip through evict+fault within the format
+    bound (fp8: rel 1/16 for normals, 2^-9 grid below); un-advised
+    ranges stay bit-exact on the same workload."""
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(2 * MB)
+        arr = buf.view(np.float32)
+        rng = np.random.default_rng(7)
+        src = rng.uniform(-100.0, 100.0, arr.size).astype(np.float32)
+        arr[:] = src
+        buf.set_compressible(Compress.FP8)
+        wire0 = ce.stats().compressed_bytes_in
+        buf.migrate(Tier.HBM)
+        buf.migrate(Tier.HOST)         # evict+fault round trip
+        err = np.abs(arr - src)
+        bound = np.maximum(np.abs(src) / 16.0, 2.0 ** -9)
+        assert bool((err <= bound + 1e-6).all())
+        s = ce.stats()
+        assert s.compressed_bytes_in - wire0 >= 2 * MB // 4
+        assert s.compression_ratio > 3.5
+
+        # Back to lossless: the advise is reversible and exact.
+        buf.set_compressible(Compress.OFF)
+        arr[:] = src
+        buf.migrate(Tier.HBM)
+        buf.migrate(Tier.HOST)
+        assert bool((arr == src).all())
+        buf.free()
+
+
+def test_memring_compressible_advise():
+    """The ADVISE subcode sets the range policy through the async ring:
+    a linked advise+migrate chain quantizes (int8 bound), and advising
+    OFF restores bit-exact copies."""
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(2 * MB)
+        arr = buf.view(np.float32)
+        src = np.linspace(-127.0, 127.0, arr.size, dtype=np.float32)
+        arr[:] = src
+        with memring.MemRing(vs, entries=16) as ring:
+            ring.advise(buf.address, 2 * MB, memring.Advise.COMPRESSIBLE,
+                        arg=int(Compress.INT8), link=True)
+            ring.migrate(buf.address, 2 * MB, Tier.HBM)
+            ring.submit_and_wait()
+            ring.completions(max_cqes=2, check=True)
+            ring.evict(buf.address, 2 * MB, Tier.HOST)
+            ring.submit_and_wait()
+            ring.completions(max_cqes=1, check=True)
+            err = np.abs(arr - src)
+            absmax = float(np.abs(src).max())
+            assert bool((err <= absmax / 254.0 + 1e-5).all())
+
+            ring.advise(buf.address, 2 * MB, memring.Advise.COMPRESSIBLE,
+                        arg=int(Compress.OFF))
+            ring.submit_and_wait()
+            ring.completions(max_cqes=1, check=True)
+        arr[:] = src
+        buf.migrate(Tier.HBM)
+        buf.migrate(Tier.HOST)
+        assert bool((arr == src).all())
+        buf.free()

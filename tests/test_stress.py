@@ -192,7 +192,7 @@ inj.set_seed(42)
 SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MSGQ_PUBLISH, inj.Site.ICI_LINK,
          inj.Site.RDMA_COMPLETION, inj.Site.FENCE_TIMEOUT,
-         inj.Site.MEMRING_SUBMIT]
+         inj.Site.MEMRING_SUBMIT, inj.Site.CE_COPY]
 for s in SITES:
     inj.enable(s, inj.Mode.PPM, 10000)
 
@@ -309,6 +309,24 @@ def memring_hammer():
     assert int(v[0]) == 0x4D and int(v[4 * MB - 1]) == 0x4D
 
 
+# Compressed-range actor: a COMPRESSIBLE (fp8) buffer filled with a
+# value exactly representable in fp8 (64.0 is a power of two), so the
+# lossy transport must still round-trip it BIT-EXACT — any corruption
+# under chaos (including a botched lossless fallback) is detectable.
+from open_gpu_kernel_modules_tpu.uvm.managed import Compress
+
+cbuf = vs.alloc(2 * MB)
+cbuf.view(np.float32)[:] = np.float32(64.0)
+cbuf.set_compressible(Compress.FP8)
+
+
+def compress_cycle():
+    cbuf.migrate(Tier.HBM)
+    cbuf.migrate(Tier.HOST)
+    v = cbuf.view(np.float32)
+    assert float(v[0]) == 64.0 and float(v[-1]) == 64.0
+
+
 rbuf = vs.alloc(2 * MB)
 rbuf.view()[:] = 0xA5
 lib.tpuIbRegMr.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
@@ -328,7 +346,7 @@ def rdma_hammer():
 
 threads = [threading.Thread(target=guard(f)) for f in
            [hammer(0), hammer(1), migrate_cycle, channel_hammer,
-            ici_hammer, rdma_hammer, memring_hammer]]
+            ici_hammer, rdma_hammer, memring_hammer, compress_cycle]]
 for t in threads:
     t.start()
 for t in threads:
@@ -343,14 +361,32 @@ out["errors"] = errors
 out["tolerated"] = tolerated["n"]
 
 # Zero corruption: every checksummed byte of every managed buffer still
-# carries its pattern after the chaos.
+# carries its pattern after the chaos — including the COMPRESSED range
+# (fp8-exact fill, so lossy transport must reproduce it bit-exact).
 intact = True
 for i, b in enumerate(bufs):
     if not (b.view() == i + 1).all():
         intact = False
 intact = intact and bool((rbuf.view() == 0xA5).all())
 intact = intact and bool((mbuf.view() == 0x4D).all())
+intact = intact and bool(
+    (cbuf.view(np.float32) == np.float32(64.0)).all())
 out["data_intact"] = intact
+
+# tpuce reconciliation: exact invariant — every ce.copy inject hit
+# either became a bounded stripe retry or a terminal stripe error —
+# with the general counters covering injected and real faults alike.
+ce_evals, ce_hits = inj.counts(inj.Site.CE_COPY)
+out["tpuce"] = {
+    "evals": ce_evals,
+    "hits": ce_hits,
+    "inject_retries": utils.counter("tpuce_inject_retries"),
+    "inject_errors": utils.counter("tpuce_inject_errors"),
+    "retries": utils.counter("tpuce_retries"),
+    "stripe_errors": utils.counter("tpuce_stripe_errors"),
+    "lossless_fallbacks": utils.counter("tpuce_lossless_fallbacks"),
+    "stripe_splits": utils.counter("tpuce_stripe_splits"),
+}
 
 # Memring reconciliation: exact invariant — every memring.submit inject
 # hit either triggered a bounded retry or terminally failed its run —
@@ -464,6 +500,20 @@ def test_engine_soak_injection():
     assert mr["observed_error_cqes"] == mr["error_cqes_counter"], mr
     assert mr["inject_error_cqes"] <= mr["error_cqes_counter"], mr
 
+    # tpuce rode the chaos: stripes flowed (splits grew), the ce.copy
+    # site fired, and the reconciliation is EXACT — every hit became a
+    # bounded stripe retry or a terminal stripe error.  The general
+    # counters cover injected and real (channel.ce) faults alike, so
+    # they bound the inject-attributed ones from above.
+    tc = out["tpuce"]
+    assert tc["evals"] > 0 and tc["hits"] > 0, tc
+    assert tc["hits"] == tc["inject_retries"] + tc["inject_errors"], tc
+    assert tc["retries"] >= tc["inject_retries"], tc
+    assert tc["stripe_errors"] >= tc["inject_errors"], tc
+    # data_intact above is the fallback's correctness proof: the
+    # compressed buffer's fp8-exact fill survived every exhausted
+    # stripe, whether it fell back lossless or its run surfaced as a
+    # tolerated RmError.
     # Every recovery counter is nonzero.
     c = out["counters"]
     assert c["recover_retries"] > 0, c

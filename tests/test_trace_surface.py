@@ -156,5 +156,17 @@ def test_prometheus_metrics_node(traced):
     names = {m for (_, m, _) in samples}
     assert any('name="channel_pushes"' in m for m in names)
 
+    # tpuce per-channel series: the workload's device_access migrated
+    # through the CE manager, so at least channel 0's bytes/busy
+    # counters must be registered and exposed.
+    assert any('name="tpuce_ch0_bytes"' in m for m in names), \
+        sorted(n for n in names if "tpuce" in n)
+    assert any('name="tpuce_ch0_busy_ns"' in m for m in names)
+    # With >= 2 schedulable channels the 2 MB copy stripes across the
+    # pool, so a second channel's series appears too.
+    from open_gpu_kernel_modules_tpu.uvm import ce as _ce
+    if _ce.channels() >= 2:
+        assert any('name="tpuce_ch1_bytes"' in m for m in names)
+
     # The node also serves under the procfs listing.
     assert "driver/tpurm/metrics" in utils.procfs_list()
