@@ -53,6 +53,7 @@ typedef enum {
     TPU_INJECT_SITE_FENCE_TIMEOUT,   /* fault-service / fence timeout    */
     TPU_INJECT_SITE_MEMRING_SUBMIT,  /* memring op execution (run)       */
     TPU_INJECT_SITE_CE_COPY,         /* tpuce stripe submission          */
+    TPU_INJECT_SITE_SCHED_ADMIT,     /* tpusched admission decision      */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

@@ -46,6 +46,15 @@ int tpurm_open(const char *path);
  * before their first open (the rs_server client model — each
  * connection gets an isolated handle namespace). */
 TpuStatus tpurmBrokerServe(const char *path);
+/* Tenant QoS over the broker (BR_OP_TENANT): configure a per-client
+ * tenant (priority + HBM/CXL page quotas, uvm.h uvmTenantConfigure) in
+ * the ENGINE HOST's tenant table.  A process with TPURM_BROKER set
+ * forwards the op to the brokerd; a process hosting the engine itself
+ * applies it locally — callers (the tpusched Python surface) need not
+ * care which side they are on. */
+TpuStatus tpurmBrokerTenantConfigure(uint32_t tenantId, uint32_t priority,
+                                     uint64_t hbmQuotaPages,
+                                     uint64_t cxlQuotaPages);
 int tpurm_close(int pfd);
 /* Emulates ioctl(2) on a pseudo-fd: returns 0 on success (RM status is in
  * the param block), -1 with errno on transport errors. */

@@ -71,6 +71,9 @@ typedef enum {
     TPU_TRACE_MEMRING_OP,        /* one memring run (coalesced span)   */
     TPU_TRACE_CE_COPY,           /* tpuce batch copy (split + submit)  */
     TPU_TRACE_CE_STRIPE,         /* executor stripe run (obj = channel) */
+    TPU_TRACE_SCHED_ROUND,       /* tpusched decode round (obj = round) */
+    TPU_TRACE_SCHED_ADMIT,       /* tpusched admission pass            */
+    TPU_TRACE_SCHED_PREEMPT,     /* tpusched preempt + swap-out        */
     TPU_TRACE_APP,               /* application span (Python utils.span) */
     /* Instant-only sites. */
     TPU_TRACE_INJECT_HIT,        /* injection framework fired          */

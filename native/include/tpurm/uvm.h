@@ -86,6 +86,7 @@ extern "C" {
 #define UVM_TPU_RESIDENCY_INFO            1003
 #define UVM_TPU_ADOPT_PAGEABLE            1004
 #define UVM_TPU_SET_COMPRESSIBLE          1005
+#define UVM_TPU_SET_TENANT                1006
 
 /* UVM_ADVISE_COMPRESSIBLE values (UvmTpuSetCompressibleParams.format,
  * uvmSetCompressible, memring ADVISE subcode COMPRESSIBLE).  Numeric
@@ -226,6 +227,20 @@ typedef struct {
     uint32_t format;                               /* IN */
     TpuStatus rmStatus;                            /* OUT */
 } UvmTpuSetCompressibleParams;
+
+/* UVM_TPU_SET_TENANT: configure tenant `tenantId` (priority + per-tier
+ * page quotas) and bind the calling VA space to it.  The serving
+ * scheduler's per-client QoS hook: quotas steer SLO-aware eviction
+ * (over-quota tenants' cold blocks are victimized first), priority
+ * orders victims among quota-compliant tenants (lower = evicted
+ * earlier).  quota 0 = unlimited. */
+typedef struct {
+    uint32_t tenantId;                             /* IN (0 = default) */
+    uint32_t priority;                             /* IN */
+    uint64_t hbmQuotaPages __attribute__((aligned(8)));  /* IN */
+    uint64_t cxlQuotaPages __attribute__((aligned(8)));  /* IN */
+    TpuStatus rmStatus;                            /* OUT */
+} UvmTpuSetTenantParams;
 
 /* External ranges (reference: UVM_CREATE_EXTERNAL_RANGE_PARAMS,
  * uvm_ioctl.h:1042; UVM_UNMAP_EXTERNAL_PARAMS:935 — ours omits gpuUuid
@@ -464,6 +479,44 @@ TpuStatus uvmHbmChunkAllocSized(uint32_t devInst, uint64_t size,
 TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
                            uint64_t *outOffset, void **outHandle);
 TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle);
+
+/* ------------------------------------------------------- tenant QoS API
+ *
+ * Per-client (tenant) HBM/CXL page quotas + eviction priority, the
+ * policy substrate under the tpusched serving scheduler.  Tenants are
+ * process-global (id 0 is the implicit default tenant every VA space
+ * starts in: unlimited quota, priority UVM_TENANT_PRIO_DEFAULT).  A VA
+ * space binds to one tenant; every backing page its blocks hold in an
+ * HBM/CXL arena is charged to that tenant.  Enforcement is eviction
+ * pressure, not allocation failure: when an arena needs a victim, the
+ * LRU walk becomes SLO-aware — cold blocks of over-quota tenants go
+ * first, then lower-priority tenants, then plain LRU order — so an
+ * over-quota tenant preempts itself under pressure while compliant
+ * higher-priority tenants keep their residency.  Usage/quotas render
+ * as tpurm_tenant_pages gauges in the Prometheus exposition and in
+ * /proc/driver/tpurm/tenants. */
+
+#define UVM_TENANT_PRIO_DEFAULT 100
+
+typedef struct {
+    uint32_t priority;
+    uint64_t hbmQuotaPages;    /* 0 = unlimited */
+    uint64_t cxlQuotaPages;
+    uint64_t hbmPages;         /* OUT: current charged usage */
+    uint64_t cxlPages;
+} UvmTenantInfo;
+
+/* Create-or-update a tenant.  Safe while traffic runs (usage counters
+ * survive reconfiguration). */
+TpuStatus uvmTenantConfigure(uint32_t tenantId, uint32_t priority,
+                             uint64_t hbmQuotaPages,
+                             uint64_t cxlQuotaPages);
+/* OBJECT_NOT_FOUND for an id never configured (except 0: the default
+ * tenant always exists). */
+TpuStatus uvmTenantInfoGet(uint32_t tenantId, UvmTenantInfo *out);
+/* Bind vs (and the pages its blocks already hold) to tenantId; the
+ * tenant must exist.  Re-binding moves the existing charge. */
+TpuStatus uvmVaSpaceBindTenant(UvmVaSpace *vs, uint32_t tenantId);
 
 /* -------------------------------------------------------- suspend/resume */
 

@@ -62,6 +62,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/abi.h"
+#include "tpurm/uvm.h"
 
 #include <errno.h>
 #include <limits.h>
@@ -87,7 +88,7 @@
 #define BROKER_MAX_CLI_MAPS  64
 
 enum { BR_OP_OPEN = 1, BR_OP_CLOSE = 2, BR_OP_IOCTL = 3,
-       BR_OP_UVM_BACKING = 4, BR_OP_UVM_RFAULT = 5 };
+       BR_OP_UVM_BACKING = 4, BR_OP_UVM_RFAULT = 5, BR_OP_TENANT = 6 };
 
 /* Payload of the UVM multi-process ops (rides where ioctl payloads
  * do).  BACKING resolves an owner VA to the range's host-backing memfd
@@ -102,6 +103,19 @@ typedef struct {
     uint64_t rangeSize;         /* out */
     uint64_t fdOffset;          /* out: range bytes start here in the fd */
 } BrokerUvmMsg;
+
+/* BR_OP_TENANT payload: per-client QoS configuration applied to the
+ * ENGINE HOST's tenant table (uvm.h uvmTenantConfigure) — the broker
+ * analog of UVM_TPU_SET_TENANT for clients that drive the C API
+ * directly instead of the ioctl surface. */
+typedef struct {
+    uint32_t tenantId;
+    uint32_t priority;
+    uint64_t hbmQuotaPages;
+    uint64_t cxlQuotaPages;
+    uint32_t status;            /* out: TpuStatus */
+    uint32_t pad;
+} BrokerTenantMsg;
 
 /* Reply flag: an fd rides the rep via SCM_RIGHTS (arena memfd for a
  * map, signal-page memfd for the first event). */
@@ -972,6 +986,19 @@ static void *conn_thread(void *arg)
             rep.mainSize = sizeof(*m);
             break;
         }
+        case BR_OP_TENANT: {
+            BrokerTenantMsg *m = (BrokerTenantMsg *)buf;
+            if (rq.mainSize != sizeof(*m)) {
+                rep.ret = -1;
+                rep.err = EINVAL;
+                break;
+            }
+            m->status = (uint32_t)uvmTenantConfigure(
+                m->tenantId, m->priority, m->hbmQuotaPages,
+                m->cxlQuotaPages);
+            rep.mainSize = sizeof(*m);
+            break;
+        }
         default:
             rep.ret = -1;
             rep.err = EINVAL;
@@ -1299,6 +1326,28 @@ int tpurmBrokerUvmFault(uint64_t ownerAddr, uint64_t len, int isWrite)
     if (rep.ret < 0)
         return (int)TPU_ERR_OPERATING_SYSTEM;
     return (int)m.status;
+}
+
+TpuStatus tpurmBrokerTenantConfigure(uint32_t tenantId, uint32_t priority,
+                                     uint64_t hbmQuotaPages,
+                                     uint64_t cxlQuotaPages)
+{
+    /* Engine-hosting processes (no TPURM_BROKER) apply locally; broker
+     * clients forward so the quota lands in the table the ENGINE's
+     * eviction walk actually consults. */
+    if (!getenv("TPURM_BROKER"))
+        return uvmTenantConfigure(tenantId, priority, hbmQuotaPages,
+                                  cxlQuotaPages);
+    BrokerTenantMsg m = { .tenantId = tenantId, .priority = priority,
+                          .hbmQuotaPages = hbmQuotaPages,
+                          .cxlQuotaPages = cxlQuotaPages };
+    BrokerReq rq = { .op = BR_OP_TENANT, .mainSize = sizeof(m) };
+    BrokerRep rep;
+    if (cli_call(&rq, &m, &rep, &m, sizeof(m), NULL) != 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    if (rep.ret < 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    return (TpuStatus)m.status;
 }
 
 int tpurmBrokerOpen(const char *path)

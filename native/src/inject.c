@@ -52,6 +52,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "fence.timeout",
     "memring.submit",
     "ce.copy",
+    "sched.admit",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -65,6 +66,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "FENCE_TIMEOUT",
     "MEMRING_SUBMIT",
     "CE_COPY",
+    "SCHED_ADMIT",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)

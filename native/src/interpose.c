@@ -83,12 +83,17 @@ static int procfs_open(const char *path, int flags)
         errno = EACCES;
         return -1;
     }
-    char *buf = malloc(1 << 16);
+    /* 1 MB render buffer: the metrics node (counters + histograms +
+     * per-tenant gauges) outgrew the old 64 KB cap once scoped
+     * per-device and per-tenant series joined the exposition — a
+     * truncated scrape parses but silently drops trailing series. */
+    const size_t cap = 1 << 20;
+    char *buf = malloc(cap);
     if (!buf) {
         errno = ENOMEM;
         return -1;
     }
-    size_t n = tpurmProcfsRead(path, buf, 1 << 16);
+    size_t n = tpurmProcfsRead(path, buf, cap);
     int fd = memfd_create("tpurm-procfs",
                           (flags & O_CLOEXEC) ? MFD_CLOEXEC : 0);
     if (fd < 0) {

@@ -167,13 +167,21 @@ static void render_journal(TpuCur *c)
 }
 
 /* Prometheus text exposition (trace.c): named counters + the tputrace
- * site latency histograms.  `cat /proc/driver/tpurm/metrics` under the
+ * site latency histograms, plus the per-tenant QoS usage/quota gauges
+ * (uvm_va_space.c).  `cat /proc/driver/tpurm/metrics` under the
  * LD_PRELOAD shim is a scrape. */
 static void render_metrics(TpuCur *c)
 {
     if (c->off + 1 >= c->cap)
         return;
     c->off += tpurmTraceRenderProm(c->buf + c->off, c->cap - c->off);
+    uvmTenantRenderProm(c);
+}
+
+/* Tenant QoS table: id, priority, per-tier usage vs quota. */
+static void render_tenants(TpuCur *c)
+{
+    uvmTenantRenderTable(c);
 }
 
 /* ---------------------------------------------------------- node table */
@@ -194,6 +202,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm/rdma", render_rdma, false },
     { "driver/tpurm/journal", render_journal, true },
     { "driver/tpurm/metrics", render_metrics, false },
+    { "driver/tpurm/tenants", render_tenants, false },
 };
 
 #define N_NODES (sizeof(g_nodes) / sizeof(g_nodes[0]))

@@ -80,6 +80,9 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "memring.op",             "memring" },
     { "ce.copy",                "ce"      },
     { "ce.stripe",              "ce"      },
+    { "sched.round",            "sched"   },
+    { "sched.admit",            "sched"   },
+    { "sched.preempt",          "sched"   },
     { "app.span",               "app"     },
     { "inject.hit",             "inject"  },
     { "recover.retry",          "recover" },

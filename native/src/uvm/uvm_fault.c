@@ -633,6 +633,12 @@ static TpuStatus service_one(UvmFaultEntry *e)
                 forceDup = true;
         }
 
+        /* Prefetch effectiveness: this access DEMANDED [firstPage,
+         * count) — pages there that an earlier expansion staged
+         * speculatively count as prefetch hits (and unmark). */
+        uint32_t reqFirst = firstPage, reqCount = count;
+        uvmPerfPrefetchTouch(blk, reqFirst, reqCount);
+
         /* Prefetch growth only for single-page (CPU) faults; device spans
          * are explicit already. */
         if (e->len <= ps)
@@ -683,6 +689,11 @@ static TpuStatus service_one(UvmFaultEntry *e)
             st = uvmBlockMakeResidentEx(blk, dst, firstPage, count,
                                         e->isWrite != 0, forceDup);
             if (st == TPU_OK) {
+                /* Pages the expansion pulled in BEYOND the demanded
+                 * span are speculative until something touches them. */
+                if (firstPage != reqFirst || count != reqCount)
+                    uvmPerfPrefetchMark(blk, reqFirst, reqCount,
+                                        firstPage, count);
                 /* Device faults install the faulting device's PTEs onto
                  * the new residency (reference: fault service writes
                  * GPU PTEs + TLB membar, uvm_pte_batch/uvm_tlb_batch). */

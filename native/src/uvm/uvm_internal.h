@@ -270,6 +270,13 @@ typedef struct UvmVaBlock {
         bool on;
         bool evicting;
     } lru[2];
+    /* Prefetch effectiveness: pages made resident by prefetch region
+     * growth that no access has touched yet.  A later fault/device
+     * access landing on a marked page counts uvm_prefetch_hits; an
+     * eviction that drops a still-marked page counts
+     * uvm_prefetch_useless (the feedback signal the ROADMAP prefetch
+     * item needs).  Mutated under blk->lock. */
+    UvmPageMask prefetched;
     /* Perf state (thrashing/prefetch, uvm_perf_thrashing.h:33-46). */
     uint32_t faultCount;
     uint64_t lastFaultNs;
@@ -380,6 +387,12 @@ struct UvmVaSpace {
     struct UvmVaSpace *nextSpace;     /* global list for fault lookup */
     uint64_t pageSize;
     struct UvmToolsSession *toolsHead;/* sessions (under vs lock) */
+    /* Tenant binding (QoS).  tenantId 0 = the default tenant; the
+     * per-space page charge mirrors what this space contributed to its
+     * tenant so a rebind can move the charge without walking blocks.
+     * Atomics: charged from block paths without the vs lock. */
+    _Atomic uint32_t tenantId;
+    _Atomic uint64_t tenantPages[UVM_TIER_COUNT];
 };
 
 typedef struct UvmRangeGroup {
@@ -387,6 +400,43 @@ typedef struct UvmRangeGroup {
     bool migratable;
     struct UvmRangeGroup *next;
 } UvmRangeGroup;
+
+/* ------------------------------------------------------------- tenants */
+
+/* Process-global tenant table (uvm.h tenant QoS API).  Slot 0 is the
+ * default tenant (always live).  Usage counters are atomics: the block
+ * paths charge without taking the table lock. */
+#define UVM_MAX_TENANTS 64
+
+typedef struct UvmTenant {
+    uint32_t id;
+    /* priority/quotas are _Atomic because reconfiguration is allowed
+     * while traffic runs: the victim walk and the over-quota test read
+     * them lock-free (relaxed — a racing reconfigure simply lands on
+     * the next decision, but never as a torn value). */
+    _Atomic uint32_t priority;        /* higher = keep longer */
+    _Atomic uint64_t quotaPages[UVM_TIER_COUNT];   /* 0 = unlimited */
+    _Atomic uint64_t usedPages[UVM_TIER_COUNT];
+    bool used;
+} UvmTenant;
+
+/* Lookup (NULL when the id was never configured). */
+UvmTenant *uvmTenantGet(uint32_t tenantId);
+/* The tenant a block's pages charge to (never NULL: default tenant). */
+UvmTenant *uvmTenantOfSpace(UvmVaSpace *vs);
+/* True once any tenant beyond the default has been configured — the
+ * SLO-aware victim walk is gated on this so an unconfigured process
+ * keeps the exact historical LRU eviction order. */
+bool uvmTenantsActive(void);
+/* Over-quota test for an aperture tier (always false for quota 0). */
+bool uvmTenantOverQuota(const UvmTenant *t, UvmTier tier);
+/* Charge/uncharge `pages` backing pages of `tier` to vs's tenant
+ * (negative delta uncharges).  HBM/CXL only; HOST is unbounded. */
+void uvmTenantCharge(UvmVaSpace *vs, UvmTier tier, int64_t pages);
+/* Render per-tenant usage/quota gauges (Prometheus exposition) and the
+ * human procfs table (TpuCur from internal.h). */
+void uvmTenantRenderProm(TpuCur *c);
+void uvmTenantRenderTable(TpuCur *c);
 
 /* ------------------------------------------------------- block services */
 
@@ -556,6 +606,19 @@ void uvmPmExitShared(void);
  * (prefetch region growth, uvm_perf_prefetch.c analog). */
 void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
                            uint32_t *firstPage, uint32_t *count);
+/* Prefetch-effectiveness accounting (all take blk->lock internally):
+ * Touch — an access landed on [first,count): marked pages count as
+ * prefetch HITS and unmark.  Mark — a service expanded by prefetch
+ * made [first,count) resident; every page OUTSIDE the requested
+ * [reqFirst,reqCount) span is marked speculative.  Evict — the span is
+ * losing aperture residency; still-marked pages count as USELESS
+ * prefetches and unmark (caller already holds blk->lock). */
+void uvmPerfPrefetchTouch(UvmVaBlock *blk, uint32_t first, uint32_t count);
+void uvmPerfPrefetchMark(UvmVaBlock *blk, uint32_t reqFirst,
+                         uint32_t reqCount, uint32_t first,
+                         uint32_t count);
+void uvmPerfPrefetchEvictLocked(UvmVaBlock *blk, uint32_t first,
+                                uint32_t count);
 /* Record a fault on blk; may pin the block to its current tier for a
  * window (thrashing mitigation, uvm_perf_thrashing.h:33-46). */
 void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier);

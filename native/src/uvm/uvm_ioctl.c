@@ -461,6 +461,19 @@ static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
             vs, (void *)(uintptr_t)p->base, p->length, p->format);
         return 0;
     }
+    case UVM_TPU_SET_TENANT: {
+        /* Per-client QoS: configure the tenant and bind the calling VA
+         * space to it — one call gives a broker client its quota
+         * identity (the serving scheduler's admission/eviction policy
+         * reads usage against these quotas). */
+        UvmTpuSetTenantParams *p = argp;
+        p->rmStatus = uvmTenantConfigure(p->tenantId, p->priority,
+                                         p->hbmQuotaPages,
+                                         p->cxlQuotaPages);
+        if (p->rmStatus == TPU_OK)
+            p->rmStatus = uvmVaSpaceBindTenant(vs, p->tenantId);
+        return 0;
+    }
     case UVM_TPU_DEVICE_ACCESS: {
         UvmTpuDeviceAccessParams *p = argp;
         UvmLocation loc;

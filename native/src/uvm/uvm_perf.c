@@ -78,6 +78,60 @@ void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
     }
 }
 
+/* ------------------------------------- prefetch effectiveness counters
+ *
+ * The ROADMAP prefetch item's feedback signal: every speculative page
+ * the region growth pulls in is tracked until either an access lands
+ * on it (uvm_prefetch_hits — the prefetch saved a fault) or an
+ * eviction drops it untouched (uvm_prefetch_useless — the prefetch
+ * wasted transport and arena space).  hits/(hits+useless) is the
+ * prefetcher's measured precision.
+ */
+
+static uint32_t prefetch_count_and_clear(UvmVaBlock *blk, uint32_t first,
+                                         uint32_t count)
+{
+    uint32_t n = 0;
+    UVM_MASK_RANGE_WORDS(first, count, w, bm, {
+        n += (uint32_t)__builtin_popcountll(blk->prefetched.bits[w] & bm);
+        blk->prefetched.bits[w] &= ~bm;
+    });
+    return n;
+}
+
+void uvmPerfPrefetchTouch(UvmVaBlock *blk, uint32_t first, uint32_t count)
+{
+    if (!uvmPageMaskIntersectsRange(&blk->prefetched, first, count))
+        return;                  /* common case: no lock, no counters */
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "prefetch-touch");
+    uint32_t n = prefetch_count_and_clear(blk, first, count);
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "prefetch-touch");
+    pthread_mutex_unlock(&blk->lock);
+    if (n)
+        tpuCounterAdd("uvm_prefetch_hits", n);
+}
+
+void uvmPerfPrefetchMark(UvmVaBlock *blk, uint32_t reqFirst,
+                         uint32_t reqCount, uint32_t first, uint32_t count)
+{
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "prefetch-mark");
+    uvmPageMaskSetRange(&blk->prefetched, first, count);
+    /* The requested span was DEMANDED, not speculated. */
+    uvmPageMaskClearRange(&blk->prefetched, reqFirst, reqCount);
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "prefetch-mark");
+    pthread_mutex_unlock(&blk->lock);
+}
+
+void uvmPerfPrefetchEvictLocked(UvmVaBlock *blk, uint32_t first,
+                                uint32_t count)
+{
+    uint32_t n = prefetch_count_and_clear(blk, first, count);
+    if (n)
+        tpuCounterAdd("uvm_prefetch_useless", n);
+}
+
 void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier)
 {
     static TpuRegCache c_thEnable;

@@ -18,6 +18,7 @@
 #define _GNU_SOURCE
 #include "uvm_internal.h"
 
+#include <stdio.h>
 #include <stdlib.h>
 #include <sys/mman.h>
 
@@ -238,6 +239,52 @@ UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
         if (blk != exclude && !pinned)
             break;
         blk = blk->lru[ix].next;
+    }
+    /* SLO-aware victim selection (multi-tenant QoS): once tenants are
+     * configured, the plain LRU-head pop becomes a scored walk — cold
+     * blocks of OVER-QUOTA tenants victimize first, then lower-priority
+     * tenants, and within a class the list order (coldest first) is the
+     * tie-break; pinned blocks stay exempt.  An unconfigured process
+     * never enters this walk, keeping the historical eviction order
+     * byte-for-byte.  Reference analog: the reference's eviction also
+     * consults policy before the root-chunk LRU order
+     * (uvm_pmm_gpu.c chunk_free_locked policy hooks). */
+    if (blk && uvmTenantsActive()) {
+        UvmVaBlock *best = blk;
+        UvmTenant *bt = uvmTenantOfSpace(blk->range->vaSpace);
+        bool bestOver = uvmTenantOverQuota(bt, a->tier);
+        uint32_t bestPrio = atomic_load_explicit(&bt->priority,
+                                                 memory_order_relaxed);
+        for (UvmVaBlock *cand = blk->lru[ix].next; cand;
+             cand = cand->lru[ix].next) {
+            bool pinned = (cand->pinnedTier == (int32_t)a->tier &&
+                           cand->pinExpiryNs > now) ||
+                          cand->p2pPinCount > 0;
+            if (cand == exclude || pinned)
+                continue;
+            UvmTenant *ct = uvmTenantOfSpace(cand->range->vaSpace);
+            bool over = uvmTenantOverQuota(ct, a->tier);
+            uint32_t prio = atomic_load_explicit(&ct->priority,
+                                                 memory_order_relaxed);
+            /* Lexicographic (overQuota desc, priority asc); earlier
+             * list position (colder) wins ties by never replacing. */
+            if ((over && !bestOver) ||
+                (over == bestOver && prio < bestPrio)) {
+                best = cand;
+                bestOver = over;
+                bestPrio = prio;
+            }
+        }
+        if (best != blk)
+            tpuCounterAdd("tier_tenant_slo_reorders", 1);
+        if (bestOver)
+            tpuCounterAdd("tier_tenant_over_quota_evictions", 1);
+        blk = best;
+        char scoped[48];
+        snprintf(scoped, sizeof(scoped), "tier_tenant_evictions[t%u]",
+                 uvmTenantOfSpace(blk->range->vaSpace)->id);
+        tpuCounterAdd(scoped, 1);
+        tpuCounterAdd("tier_tenant_evictions", 1);
     }
     if (blk) {
         if (blk->lru[ix].prev)

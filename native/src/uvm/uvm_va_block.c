@@ -188,6 +188,11 @@ static TpuStatus block_alloc_backing(UvmVaBlock *blk, UvmTierArena *arena,
             run->arena = arena;
             run->next = *runs_head(blk, arena->tier);
             *runs_head(blk, arena->tier) = run;
+            /* QoS accounting: the run's backing pages charge to the
+             * owning space's tenant; the SLO-aware victim walk reads
+             * this usage against the tenant's quota. */
+            uvmTenantCharge(blk->range->vaSpace, arena->tier,
+                            (int64_t)run->numPages);
             covered += run->numPages;
         }
         p += gap;
@@ -213,6 +218,8 @@ static void block_gc_runs(UvmVaBlock *blk, UvmTier tier)
         if (!live) {
             *prev = r->next;
             uvmPmmFree(&r->arena->pmm, r->chunk);
+            uvmTenantCharge(blk->range->vaSpace, tier,
+                            -(int64_t)r->numPages);
             UvmChunkRun *dead = r;
             r = r->next;
             free(dead);
@@ -551,6 +558,9 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
             uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_EVICTION, tier,
                          UVM_TIER_HOST, blk->hbmDevInst, blk->start, bytes);
         }
+        /* Still-marked speculative pages leaving the aperture untouched
+         * are USELESS prefetches (blk->lock held here). */
+        uvmPerfPrefetchEvictLocked(blk, first, last - first + 1);
         uvmPageMaskClearRange(&blk->resident[tier], first, last - first + 1);
         /* Evicted pages lose any accessed-by device mapping into them,
          * and their device PTEs (one TLB invalidate per device). */
@@ -1027,6 +1037,8 @@ void uvmBlockFreeBacking(UvmVaBlock *blk)
         while (r) {
             UvmChunkRun *next = r->next;
             uvmPmmFree(&r->arena->pmm, r->chunk);
+            uvmTenantCharge(blk->range->vaSpace, (UvmTier)tier,
+                            -(int64_t)r->numPages);
             free(r);
             r = next;
         }

@@ -38,6 +38,210 @@ static void vs_unlock(UvmVaSpace *vs)
     pthread_mutex_unlock(&vs->lock);
 }
 
+/* ------------------------------------------------------------- tenants
+ *
+ * Process-global QoS table (uvm.h tenant API; uvm_internal.h UvmTenant).
+ * Slot 0 is the default tenant every space starts in.  Configuration
+ * takes the table lock; the block-path charge/uncharge and the victim
+ * walk read the table lock-free (slots only transition unused -> used,
+ * published with a release store on `used`; usage counters are atomics).
+ */
+
+static struct {
+    pthread_mutex_t lock;
+    UvmTenant t[UVM_MAX_TENANTS];
+    _Atomic int active;          /* nonzero once a non-default tenant
+                                  * or non-default policy exists */
+} g_tenants = {
+    .lock = PTHREAD_MUTEX_INITIALIZER,
+    .t = { [0] = { .id = 0, .priority = UVM_TENANT_PRIO_DEFAULT,
+                   .used = true } },
+};
+
+bool uvmTenantsActive(void)
+{
+    return atomic_load_explicit(&g_tenants.active,
+                                memory_order_relaxed) != 0;
+}
+
+UvmTenant *uvmTenantGet(uint32_t tenantId)
+{
+    for (int i = 0; i < UVM_MAX_TENANTS; i++) {
+        UvmTenant *t = &g_tenants.t[i];
+        if (__atomic_load_n(&t->used, __ATOMIC_ACQUIRE) &&
+            t->id == tenantId)
+            return t;
+    }
+    return NULL;
+}
+
+TpuStatus uvmTenantConfigure(uint32_t tenantId, uint32_t priority,
+                             uint64_t hbmQuotaPages,
+                             uint64_t cxlQuotaPages)
+{
+    pthread_mutex_lock(&g_tenants.lock);
+    UvmTenant *t = uvmTenantGet(tenantId);
+    if (!t) {
+        for (int i = 0; i < UVM_MAX_TENANTS; i++) {
+            if (!g_tenants.t[i].used) {
+                t = &g_tenants.t[i];
+                break;
+            }
+        }
+        if (!t) {
+            pthread_mutex_unlock(&g_tenants.lock);
+            return TPU_ERR_INSUFFICIENT_RESOURCES;
+        }
+        t->id = tenantId;
+    }
+    atomic_store_explicit(&t->priority, priority, memory_order_relaxed);
+    atomic_store_explicit(&t->quotaPages[UVM_TIER_HBM], hbmQuotaPages,
+                          memory_order_relaxed);
+    atomic_store_explicit(&t->quotaPages[UVM_TIER_CXL], cxlQuotaPages,
+                          memory_order_relaxed);
+    /* First publication AFTER the fields (release on `used`); later
+     * reconfigures rely on the fields themselves being atomic. */
+    __atomic_store_n(&t->used, true, __ATOMIC_RELEASE);
+    atomic_store_explicit(&g_tenants.active, 1, memory_order_release);
+    pthread_mutex_unlock(&g_tenants.lock);
+    tpuCounterAdd("tier_tenant_configs", 1);
+    tpuLog(TPU_LOG_INFO, "uvm",
+           "tenant %u: prio=%u quota hbm=%llu cxl=%llu pages", tenantId,
+           priority, (unsigned long long)hbmQuotaPages,
+           (unsigned long long)cxlQuotaPages);
+    return TPU_OK;
+}
+
+TpuStatus uvmTenantInfoGet(uint32_t tenantId, UvmTenantInfo *out)
+{
+    if (!out)
+        return TPU_ERR_INVALID_ARGUMENT;
+    UvmTenant *t = uvmTenantGet(tenantId);
+    if (!t)
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    out->priority = atomic_load_explicit(&t->priority,
+                                         memory_order_relaxed);
+    out->hbmQuotaPages = atomic_load_explicit(
+        &t->quotaPages[UVM_TIER_HBM], memory_order_relaxed);
+    out->cxlQuotaPages = atomic_load_explicit(
+        &t->quotaPages[UVM_TIER_CXL], memory_order_relaxed);
+    out->hbmPages = atomic_load_explicit(&t->usedPages[UVM_TIER_HBM],
+                                         memory_order_relaxed);
+    out->cxlPages = atomic_load_explicit(&t->usedPages[UVM_TIER_CXL],
+                                         memory_order_relaxed);
+    return TPU_OK;
+}
+
+UvmTenant *uvmTenantOfSpace(UvmVaSpace *vs)
+{
+    UvmTenant *t = vs ? uvmTenantGet(atomic_load_explicit(
+                            &vs->tenantId, memory_order_relaxed))
+                      : NULL;
+    return t ? t : &g_tenants.t[0];
+}
+
+bool uvmTenantOverQuota(const UvmTenant *t, UvmTier tier)
+{
+    if (!t || tier >= UVM_TIER_COUNT)
+        return false;
+    uint64_t quota = atomic_load_explicit(&t->quotaPages[tier],
+                                          memory_order_relaxed);
+    if (!quota)
+        return false;
+    return atomic_load_explicit(&t->usedPages[tier],
+                                memory_order_relaxed) > quota;
+}
+
+void uvmTenantCharge(UvmVaSpace *vs, UvmTier tier, int64_t pages)
+{
+    if (!vs || pages == 0 ||
+        (tier != UVM_TIER_HBM && tier != UVM_TIER_CXL))
+        return;
+    UvmTenant *t = uvmTenantOfSpace(vs);
+    atomic_fetch_add_explicit(&t->usedPages[tier], (uint64_t)pages,
+                              memory_order_relaxed);
+    atomic_fetch_add_explicit(&vs->tenantPages[tier], (uint64_t)pages,
+                              memory_order_relaxed);
+}
+
+TpuStatus uvmVaSpaceBindTenant(UvmVaSpace *vs, uint32_t tenantId)
+{
+    if (!vs)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_tenants.lock);
+    UvmTenant *to = uvmTenantGet(tenantId);
+    if (!to) {
+        pthread_mutex_unlock(&g_tenants.lock);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    UvmTenant *from = uvmTenantOfSpace(vs);
+    if (from != to) {
+        /* Move the space's existing charge so usage stays truthful
+         * across a rebind (concurrent block-path charges land on
+         * whichever tenant the racing read resolves — benign: the
+         * next uncharge follows the same binding). */
+        for (int tier = 0; tier < UVM_TIER_COUNT; tier++) {
+            uint64_t held = atomic_load_explicit(
+                &vs->tenantPages[tier], memory_order_relaxed);
+            if (held) {
+                atomic_fetch_sub_explicit(&from->usedPages[tier], held,
+                                          memory_order_relaxed);
+                atomic_fetch_add_explicit(&to->usedPages[tier], held,
+                                          memory_order_relaxed);
+            }
+        }
+        atomic_store_explicit(&vs->tenantId, tenantId,
+                              memory_order_release);
+    }
+    pthread_mutex_unlock(&g_tenants.lock);
+    tpuCounterAdd("tier_tenant_binds", 1);
+    return TPU_OK;
+}
+
+void uvmTenantRenderProm(TpuCur *c)
+{
+    static const char *tierName[UVM_TIER_COUNT] = { "host", "hbm",
+                                                    "cxl" };
+    tpuCurf(c, "# TYPE tpurm_tenant_pages gauge\n");
+    tpuCurf(c, "# TYPE tpurm_tenant_quota_pages gauge\n");
+    for (int i = 0; i < UVM_MAX_TENANTS; i++) {
+        UvmTenant *t = &g_tenants.t[i];
+        if (!__atomic_load_n(&t->used, __ATOMIC_ACQUIRE))
+            continue;
+        for (int tier = UVM_TIER_HBM; tier <= UVM_TIER_CXL; tier++) {
+            tpuCurf(c, "tpurm_tenant_pages{tenant=\"%u\",tier=\"%s\"} "
+                    "%llu\n", t->id, tierName[tier],
+                    (unsigned long long)atomic_load_explicit(
+                        &t->usedPages[tier], memory_order_relaxed));
+            tpuCurf(c, "tpurm_tenant_quota_pages{tenant=\"%u\","
+                    "tier=\"%s\"} %llu\n", t->id, tierName[tier],
+                    (unsigned long long)atomic_load_explicit(
+                        &t->quotaPages[tier], memory_order_relaxed));
+        }
+    }
+}
+
+void uvmTenantRenderTable(TpuCur *c)
+{
+    tpuCurf(c, "%-8s %-8s %-12s %-12s %-12s %-12s\n", "tenant", "prio",
+            "hbm_pages", "hbm_quota", "cxl_pages", "cxl_quota");
+    for (int i = 0; i < UVM_MAX_TENANTS; i++) {
+        UvmTenant *t = &g_tenants.t[i];
+        if (!__atomic_load_n(&t->used, __ATOMIC_ACQUIRE))
+            continue;
+        tpuCurf(c, "%-8u %-8u %-12llu %-12llu %-12llu %-12llu\n", t->id,
+                atomic_load_explicit(&t->priority, memory_order_relaxed),
+                (unsigned long long)atomic_load_explicit(
+                    &t->usedPages[UVM_TIER_HBM], memory_order_relaxed),
+                (unsigned long long)atomic_load_explicit(
+                    &t->quotaPages[UVM_TIER_HBM], memory_order_relaxed),
+                (unsigned long long)atomic_load_explicit(
+                    &t->usedPages[UVM_TIER_CXL], memory_order_relaxed),
+                (unsigned long long)atomic_load_explicit(
+                    &t->quotaPages[UVM_TIER_CXL], memory_order_relaxed));
+    }
+}
+
 TpuStatus uvmVaSpaceCreate(UvmVaSpace **out)
 {
     if (!out)

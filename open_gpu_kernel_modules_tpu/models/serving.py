@@ -1013,6 +1013,54 @@ class TieredKVCache:
             if e is not None:
                 self._victim_free.append(e)
 
+    def release_sequence(self, b: int, keep_len: bool = False) -> None:
+        """Drop sequence ``b``'s device residency NOW (tpusched retire/
+        preempt hook): its slots rejoin the LRU at the COLD end so the
+        next activation reclaims them first, its victim-ring entries
+        recycle, and any parked device tokens overlapping it fold to
+        host.  ``keep_len=True`` (preemption) preserves ``seq_lens`` —
+        the sequence's KV truth stays in the backing keyed by its seq
+        index, ready for a later restore; the default (retire) resets
+        the length so a new request can reuse the slot.
+
+        DIRTY slots are NOT written back here — callers that need the
+        backing current (preemption) must ``flush_group([b])`` first;
+        a retire deliberately skips that readback (the tokens are
+        decoded; the KV is garbage the moment the request finishes)."""
+        self.materialize([b])
+        m = self.pages_per_seq
+        if keep_len and any((b * m + pg) in self._victim_map
+                            for pg in range(m)):
+            # A victim-ring entry can be the ONLY copy of an evicted
+            # dirty page (and the truth behind a clean restored slot):
+            # a preempted sequence must materialize those into the
+            # backing before the entries recycle, or its restore would
+            # read stale bytes.  Retire (keep_len=False) skips this —
+            # the KV is garbage once the request finished.
+            self.drain_flushes()
+        freed: List[int] = []
+        for pg in range(m):
+            page = b * m + pg
+            s = int(self.slot_of[page])
+            if s >= 0:
+                self.slot_of[page] = -1
+                self.slot_owner[s] = -1
+                self._dirty_slots.discard(s)
+                self._active_slots.discard(s)
+                if s in self._lru:
+                    del self._lru[s]
+                freed.append(s)
+            e = self._victim_map.pop(page, None)
+            if e is not None:
+                self._victim_free.append(e)
+        if freed:
+            # Cold end = FRONT of the insertion-ordered dict.
+            self._lru = dict.fromkeys(freed) | self._lru
+        if not keep_len:
+            self.seq_lens[b] = 0
+            self.last_token[b] = 0
+        self.stats["releases"] = self.stats.get("releases", 0) + 1
+
     def close(self) -> None:
         try:
             # Parked tokens materialize first: last_token must hold the

@@ -24,6 +24,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Compiler-params class across pallas versions: newer jax renamed
+# TPUCompilerParams -> CompilerParams; the installed jax only has the
+# old spelling (same constructor surface for the fields used here).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 LOG2_E = 1.4426950408889634   # softmax runs base-2; scale carries log2(e)
 
@@ -289,7 +295,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             ],
             out_specs=pl.BlockSpec((1, blk_q, d),
                                    lambda bh, qi: (bh, qi, 0)),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel")),
             interpret=interpret,
         )(qf, kf, vf)
@@ -331,7 +337,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             pltpu.VMEM((blk_q, 128), jnp.float32),
             pltpu.VMEM((blk_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)

@@ -27,6 +27,23 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: new-style toplevel export
+    (``check_vma`` keyword) with a fallback to the older
+    ``jax.experimental.shard_map.shard_map`` (``check_rep`` keyword) —
+    the installed jax here only ships the experimental spelling, and
+    the bare ``from jax import shard_map`` raised ImportError for every
+    sharded-attention test."""
+    try:
+        from jax import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 def _block_attend(q, k, v, q_pos, k_pos, causal, scale):
     """Partial attention of local q against one visiting K/V block.
     Returns (m, l, acc): rowmax [B,H,Sq,1], rowsum [B,H,Sq,1],
@@ -100,12 +117,10 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
 
     q,k,v: GLOBAL [B, S, H, D]; batch over dp, sequence over sp.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P("dp", axis_name, None, None)
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -38,7 +38,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q,k,v: LOCAL [B, S/n, H, D] (sequence-sharded).  Returns the same
     local sharding.  Requires H % n == 0.
     """
-    n = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size is a newer addition; psum(1, axis) is the
+    # version-stable spelling (constant-folds for a static mesh axis,
+    # exactly how ring_attention derives its ring size).
+    axis_size = getattr(jax.lax, "axis_size", None)
+    n = axis_size(axis_name) if axis_size is not None \
+        else jax.lax.psum(1, axis_name)
     b, s_local, h, d = q.shape
     if h % n != 0:
         raise ValueError(f"the {axis_name} axis size ({n}) must divide "
@@ -73,13 +78,13 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
 
     q,k,v: GLOBAL [B, S, H, D]; batch over dp, sequence over sp.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from .ring_attention import shard_map_compat
+
     spec = P("dp", axis_name, None, None)
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -1,0 +1,665 @@
+"""tpusched — multi-tenant continuous-batching serving scheduler.
+
+The policy layer that turns the tiered KV cache into a *server*: many
+concurrent request streams multiplexed over one oversubscribed
+:class:`~..models.serving.TieredKVCache`, Orca-style (iteration-level
+scheduling: the decode batch re-forms EVERY round from the currently
+runnable sequences) with vLLM-style paged admission (a request is
+admitted only when its projected page need fits the device slot pool).
+
+Shape of the loop (one :meth:`Scheduler.step` = one decode round):
+
+  retire    — sequences that hit their token budget leave the batch and
+              free their device pages IMMEDIATELY (cold-end LRU
+              reinsert, ``TieredKVCache.release_sequence``), so the
+              next admission reclaims them before anything warm.
+  admit     — restores first (preempted sequences re-enter via ONE
+              batched memring PREFETCH chain that warms their backing
+              pages), then queued requests in arrival order, each gated
+              on projected page need vs. free device pages and on its
+              tenant's scheduler page quota.  The whole pass sits
+              behind the ``sched.admit`` inject site with bounded
+              retry; exhaustion DEGRADES TO PREEMPT (load shed), never
+              an error.
+  preempt   — when the runnable set's projected pages outgrow the slot
+              pool (decode grew the sequences), victims are chosen
+              SLO-aware — over-quota tenants first, then lowest
+              priority, then largest resident footprint — flushed to
+              the backing, and parked; their seq slot (and therefore
+              their backing pages) stays reserved for the restore.
+  decode    — one ``decode_scan`` dispatch for the whole batch
+              (group padded to a power of two so the kernel compiles
+              once per bucket), host-side length arithmetic, per-token
+              latency sampled per stream.
+
+Tenancy is two-layered, matching the stack: the scheduler enforces
+*device slot pool* quotas (pages of the HBM-resident slot pool) and
+admission/preemption ordering; ``configure_tenant`` also programs the
+NATIVE tenant table (uvm.h tenant QoS API, broker-aware), which
+governs arena eviction for VA spaces BOUND to a tenant — per-client
+spaces in a brokered deployment (see configure_tenant's scope note;
+the in-process cache's single shared backing space stays on the
+default tenant, its QoS enforced by the scheduler itself).
+
+Observability: ``sched.round`` / ``sched.admit`` / ``sched.preempt``
+tputrace spans (arm with ``utils.trace_start()``) and ``tpusched_*``
+counters in the Prometheus exposition (/proc/driver/tpurm/metrics).
+
+The streams are SIMULATED (prompts in, greedy tokens out) — the point
+is the scheduling policy and its interaction with the memory stack,
+not an RPC front end.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import enum
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models import llama, serving
+from . import native
+
+
+# --------------------------------------------------------------- plumbing
+
+_bound = None
+
+_TRACE_SITES: Dict[str, int] = {}
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is not None:
+        return _bound
+    lib = native.load()
+    lib.tpuCounterAdd.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.tpuCounterAdd.restype = None
+    lib.tpurmTraceBegin.argtypes = []
+    lib.tpurmTraceBegin.restype = ctypes.c_uint64
+    lib.tpurmTraceEnd.argtypes = [ctypes.c_uint32, ctypes.c_uint64,
+                                  ctypes.c_uint64, ctypes.c_uint64]
+    lib.tpurmTraceEnd.restype = None
+    lib.tpurmTraceSiteName.argtypes = [ctypes.c_uint32]
+    lib.tpurmTraceSiteName.restype = ctypes.c_char_p
+    _bound = lib
+    return lib
+
+
+def _counter_add(name: str, delta: int = 1) -> None:
+    _lib().tpuCounterAdd(name.encode(), delta)
+
+
+def _trace_site(name: str) -> int:
+    if not _TRACE_SITES:
+        lib = _lib()
+        i = 0
+        while True:
+            s = lib.tpurmTraceSiteName(i)
+            if s is None:
+                break
+            _TRACE_SITES[s.decode()] = i
+            i += 1
+    return _TRACE_SITES[name]
+
+
+class _span:
+    """Native tputrace span for a sched.* site (no-op while tracing is
+    disarmed: tpurmTraceBegin's single-relaxed-load fast path)."""
+
+    def __init__(self, site: str, obj: int = 0):
+        self._site = _trace_site(site)
+        self._obj = obj
+
+    def __enter__(self) -> "_span":
+        self._t0 = _lib().tpurmTraceBegin()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _lib().tpurmTraceEnd(self._site, self._t0, self._obj, 0)
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+# ----------------------------------------------------------------- model
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class Request:
+    """One simulated stream: a prompt and a token budget."""
+
+    rid: int
+    prompt: np.ndarray              # [S] int32
+    max_new_tokens: int
+    tenant: int = 0
+    state: RequestState = RequestState.QUEUED
+    seq: Optional[int] = None       # cache sequence slot while admitted
+    decoded: int = 0                # tokens decoded so far (rounded up
+                                    # to round granularity internally)
+    tokens: Optional[np.ndarray] = None   # [max_new_tokens] on finish
+    preempts: int = 0
+    _chunks: List[np.ndarray] = dataclasses.field(default_factory=list)
+    _token_lat_s: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def token_latencies_s(self) -> List[float]:
+        """Per-token decode latency samples (round wall time amortized
+        over the round's tokens — queueing/preemption stalls between a
+        stream's rounds are NOT hidden: they surface as the wall-clock
+        gap in aggregate throughput and in time-to-last-token)."""
+        return self._token_lat_s
+
+
+@dataclasses.dataclass
+class SchedTenant:
+    """Scheduler-level QoS identity: eviction/preemption priority
+    (higher = preempted later) and a device slot-pool page quota
+    (0 = unlimited).  Mirrored into the native tier-layer tenant table
+    by :meth:`Scheduler.configure_tenant`."""
+
+    tenant: int
+    priority: int = 100
+    device_page_quota: int = 0
+
+
+class Scheduler:
+    """Continuous-batching engine over a :class:`TieredKVCache`.
+
+    ``max_seqs`` bounds concurrent admitted sequences (the cache's
+    sequence-slot dimension); the device slot pool holds
+    ``max_seqs * pages_per_seq / oversub`` pages, so at oversub > 1 the
+    admitted set can outgrow device residency — that pressure is what
+    drives preemption, and the backing (UVM managed memory, preferred
+    CXL) is where preempted sequences park.
+    """
+
+    def __init__(self, cfg: llama.LlamaConfig, params,
+                 max_seqs: int = 8, max_len: int = 512,
+                 page_size: int = 64, oversub: int = 1,
+                 tokens_per_round: int = 8,
+                 admit_retries: int = 3,
+                 cache: Optional[serving.TieredKVCache] = None):
+        from ..uvm import inject as _inject
+
+        self.cfg = cfg
+        self.params = params
+        self.tokens_per_round = tokens_per_round
+        self.admit_retries = admit_retries
+        self._inject = _inject
+        self.cache = cache if cache is not None else serving.TieredKVCache(
+            cfg, batch=max_seqs, max_len=max_len, page_size=page_size,
+            oversub=oversub)
+        self.max_seqs = self.cache.batch
+        self.max_len = self.cache.pages_per_seq * self.cache.page_size
+
+        self._free_seqs: List[int] = list(range(self.max_seqs))
+        self._queue: List[Request] = []
+        self._running: Dict[int, Request] = {}     # seq -> request
+        self._preempted: List[Request] = []
+        self._by_rid: Dict[int, Request] = {}
+        self._next_rid = 1
+        self._cur_tok = np.zeros((self.max_seqs,), np.int32)
+        self.tenants: Dict[int, SchedTenant] = {
+            0: SchedTenant(tenant=0)}
+        self.stats = {"admitted": 0, "retired": 0, "preempted": 0,
+                      "restored": 0, "rounds": 0, "cancelled": 0,
+                      "admit_retries": 0, "admit_sheds": 0,
+                      "round_errors": 0, "decoded_tokens": 0}
+
+    # ------------------------------------------------------------ tenants
+
+    def configure_tenant(self, tenant: int, priority: int = 100,
+                         device_page_quota: int = 0,
+                         hbm_quota_pages: int = 0,
+                         cxl_quota_pages: int = 0) -> None:
+        """Register a tenant at BOTH policy layers: the scheduler's
+        slot-pool quota/priority here, and the native tier-layer quota
+        table (managed.tenant_configure — broker-aware).
+
+        Scope note: the native table governs VA SPACES BOUND to a
+        tenant.  This scheduler's shared cache backing lives in one VA
+        space (default tenant), so the native quotas bite for clients
+        that hold their own spaces — broker-attached serving processes
+        that bind_tenant() their space, or side allocations — not for
+        the shared slot pool, whose QoS is enforced HERE (admission
+        deferral + SLO-ordered preemption)."""
+        from ..uvm import managed
+
+        self.tenants[tenant] = SchedTenant(tenant, priority,
+                                           device_page_quota)
+        managed.tenant_configure(tenant, priority=priority,
+                                 hbm_quota_pages=hbm_quota_pages,
+                                 cxl_quota_pages=cxl_quota_pages)
+
+    def _tenant(self, tid: int) -> SchedTenant:
+        return self.tenants.get(tid) or self.tenants[0]
+
+    # ------------------------------------------------------------ intake
+
+    def submit(self, prompt, max_new_tokens: int,
+               tenant: int = 0) -> Request:
+        """Enqueue one stream.  Admission happens inside step()."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        need = prompt.size + self._round_up(max_new_tokens)
+        if need > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) rounded to {need} exceeds max_len "
+                f"({self.max_len})")
+        req = Request(rid=self._next_rid, prompt=prompt,
+                      max_new_tokens=max_new_tokens, tenant=tenant)
+        self._next_rid += 1
+        self._by_rid[req.rid] = req
+        self._queue.append(req)
+        _counter_add("tpusched_submitted")
+        return req
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a stream in any live state; frees its pages at once."""
+        req = self._by_rid.get(rid)
+        if req is None or req.state in (RequestState.FINISHED,
+                                        RequestState.CANCELLED):
+            return False
+        if req.state is RequestState.QUEUED:
+            self._queue.remove(req)
+        elif req.state is RequestState.RUNNING:
+            del self._running[req.seq]
+            self.cache.release_sequence(req.seq)
+            self._free_seqs.append(req.seq)
+            req.seq = None
+        elif req.state is RequestState.PREEMPTED:
+            self._preempted.remove(req)
+            self.cache.release_sequence(req.seq)
+            self._free_seqs.append(req.seq)
+            req.seq = None
+        req.state = RequestState.CANCELLED
+        self.stats["cancelled"] += 1
+        _counter_add("tpusched_cancelled")
+        return True
+
+    # ------------------------------------------------------- projections
+
+    def _round_up(self, tokens: int) -> int:
+        r = self.tokens_per_round
+        return (tokens + r - 1) // r * r
+
+    def _pages_for(self, length: int) -> int:
+        P = self.cache.page_size
+        return max(1, min(self.cache.pages_per_seq,
+                          (min(length, self.max_len) + P - 1) // P))
+
+    def _seq_pages(self, req: Request) -> int:
+        """Projected device pages req needs for ONE more round."""
+        return self._pages_for(int(self.cache.seq_lens[req.seq]) +
+                               self.tokens_per_round)
+
+    def _projected_pages(self, extra: int = 0) -> int:
+        return sum(self._seq_pages(r) for r in self._running.values()) \
+            + extra
+
+    def _tenant_pages(self, tid: int) -> int:
+        return sum(self._seq_pages(r) for r in self._running.values()
+                   if r.tenant == tid)
+
+    def free_device_pages(self) -> int:
+        """Slot-pool headroom the admission gate checks against."""
+        return self.cache.n_slots - self._projected_pages()
+
+    # -------------------------------------------------------- preemption
+
+    def _pick_victim(self) -> Optional[Request]:
+        """SLO ordering, mirroring the native arena walk: over-quota
+        tenants first, then lowest priority, then largest footprint
+        (frees the most pages per preempt)."""
+        best = None
+        best_key = None
+        for req in self._running.values():
+            t = self._tenant(req.tenant)
+            over = bool(t.device_page_quota and
+                        self._tenant_pages(req.tenant) >
+                        t.device_page_quota)
+            key = (0 if over else 1, t.priority, -self._seq_pages(req))
+            if best is None or key < best_key:
+                best, best_key = req, key
+        return best
+
+    def _preempt(self, req: Request) -> None:
+        """Swap a sequence out: dirty pages flush to the backing (the
+        seq keeps its slot index, i.e. its backing pages), device slots
+        free, the request parks until a restore fits."""
+        with _span("sched.preempt", obj=req.rid):
+            # The scheduler's _cur_tok is the stream's truth (updated
+            # every round); only the KV pages need persisting.
+            self.cache.flush_group([req.seq])
+            self.cache.release_sequence(req.seq, keep_len=True)
+        del self._running[req.seq]
+        req.state = RequestState.PREEMPTED
+        req.preempts += 1
+        self._preempted.append(req)
+        self.stats["preempted"] += 1
+        _counter_add("tpusched_preempted")
+
+    def _restore(self, req: Request) -> None:
+        """Re-admit a preempted sequence.  Its pages' truth sits in the
+        backing store; ONE batched memring submission of linked
+        PREFETCH ops (chained per claim-size segment, single doorbell)
+        warms them device-ward before the activation re-uploads — the
+        serving-level analog of the fault engine's batched service.
+        Falls back to plain activation faulting when the backing has no
+        ring."""
+        backing = self.cache.backing
+        ring = getattr(backing, "ring", None)
+        try:
+            self._restore_prefetch(backing, ring, req)
+        except native.RmError:
+            # The warm-up chain is an optimization: a failed PREFETCH
+            # CQE (injected or real) just means the activation below
+            # faults the pages itself.  Leave the ring QUIESCED —
+            # staged-but-unsubmitted SQEs or unreaped CQEs left behind
+            # would skew the backing read path's own completion
+            # accounting on the shared ring.
+            if ring is not None:
+                try:
+                    ring.submit_and_wait(None)
+                except native.RmError:
+                    pass
+                ring.completions(max_cqes=8192)
+            self.stats["round_errors"] = \
+                self.stats.get("round_errors", 0) + 1
+            _counter_add("tpusched_round_errors")
+        self._running[req.seq] = req
+        req.state = RequestState.RUNNING
+        self._preempted.remove(req)
+        self.stats["restored"] += 1
+        _counter_add("tpusched_restored")
+
+    def _restore_prefetch(self, backing, ring, req: Request) -> None:
+        if ring is not None:
+            pages = range(req.seq * self.cache.pages_per_seq,
+                          req.seq * self.cache.pages_per_seq +
+                          self._pages_for(int(self.cache.seq_lens[req.seq])))
+            ops = []
+            for page in pages:
+                off = page * backing.rec_bytes
+                ops.append(backing.k_buf.address + off)
+                ops.append(backing.v_buf.address + off)
+            # LINK chains are capped at one worker claim (64 entries);
+            # chain per segment, publish everything with one doorbell.
+            n = 0
+            for i, addr in enumerate(ops):
+                if ring.sq_space < 1:
+                    ring.submit_and_wait(None)
+                    ring.completions(max_cqes=max(n, 64), check=True)
+                    n = 0
+                last_in_chain = (i % 64 == 63) or i == len(ops) - 1
+                ring.prefetch(addr, backing.rec_bytes, dev=backing.dev,
+                              link=not last_in_chain)
+                n += 1
+            ring.submit_and_wait(None)
+            ring.completions(max_cqes=max(n, 64), check=True)
+
+    # --------------------------------------------------------- admission
+
+    def _admit_gate(self) -> bool:
+        """The sched.admit inject site (10th): bounded retry, then
+        degrade-to-preempt — a failed gate sheds load (skips this
+        round's admissions, preempting one victim if anything runs)
+        instead of erroring the serving loop."""
+        for attempt in range(self.admit_retries + 1):
+            if not self._inject.should_fail(self._inject.Site.SCHED_ADMIT):
+                return True
+            if attempt < self.admit_retries:
+                self.stats["admit_retries"] += 1
+                _counter_add("tpusched_admit_retries")
+                time.sleep(0.0005 * (1 << attempt))
+        self.stats["admit_sheds"] += 1
+        _counter_add("tpusched_admit_sheds")
+        # Degrade-to-preempt only under REAL pressure: someone is
+        # waiting AND the pool cannot fit them.  With headroom, skipping
+        # this round's admissions already shed the load — swapping out a
+        # healthy stream would buy nothing for a flush + restore.
+        waiting = self._preempted + self._queue
+        if waiting and len(self._running) > 1:
+            first = waiting[0]
+            need = self._pages_for(
+                (int(self.cache.seq_lens[first.seq]) if first.seq is not
+                 None else first.prompt.size) + self.tokens_per_round)
+            if self._projected_pages(extra=need) > self.cache.n_slots:
+                victim = self._pick_victim()
+                if victim is not None:
+                    self._preempt(victim)
+        return False
+
+    def _admit_one(self, req: Request) -> bool:
+        seq = self._free_seqs.pop(0)
+        req.seq = seq
+        self.cache.seq_lens[seq] = 0
+        try:
+            serving.prefill_group(self.cfg, self.params, self.cache,
+                                  [seq], jnp.asarray(req.prompt[None, :]))
+        except native.RmError:
+            # Transient backing fault that outlived the engine's own
+            # bounded retries (chaos soak territory): the failed
+            # activation rolled itself back — requeue at the head and
+            # let a later round retry instead of erroring the loop.
+            self.cache.release_sequence(seq)
+            self._free_seqs.append(seq)
+            req.seq = None
+            self.stats["round_errors"] = \
+                self.stats.get("round_errors", 0) + 1
+            _counter_add("tpusched_round_errors")
+            return False
+        self._cur_tok[seq] = self.cache.last_token[seq]
+        self._running[seq] = req
+        req.state = RequestState.RUNNING
+        self.stats["admitted"] += 1
+        _counter_add("tpusched_admitted")
+        return True
+
+    def _try_admissions(self) -> None:
+        with _span("sched.admit"):
+            if (self._preempted or self._queue) and not self._admit_gate():
+                return
+            # Restores outrank fresh admissions (they were admitted
+            # first); higher priority first, then oldest preempt.
+            for req in sorted(self._preempted,
+                              key=lambda r:
+                              (-self._tenant(r.tenant).priority, r.rid)):
+                need = self._pages_for(int(self.cache.seq_lens[req.seq]) +
+                                       self.tokens_per_round)
+                if self._projected_pages(extra=need) > self.cache.n_slots:
+                    break
+                self._restore(req)
+            # Fresh admissions in arrival order, gated on projected
+            # page need vs free device pages and the tenant quota.
+            admitted_any = True
+            while self._queue and self._free_seqs and admitted_any:
+                admitted_any = False
+                for req in list(self._queue):
+                    if not self._free_seqs:
+                        break
+                    need = self._pages_for(req.prompt.size +
+                                           self.tokens_per_round)
+                    if self._projected_pages(extra=need) > \
+                            self.cache.n_slots:
+                        continue
+                    t = self._tenant(req.tenant)
+                    if t.device_page_quota and \
+                            self._tenant_pages(req.tenant) + need > \
+                            t.device_page_quota:
+                        continue      # tenant at quota: stays queued
+                    self._queue.remove(req)
+                    if self._admit_one(req):
+                        admitted_any = True
+                    else:
+                        self._queue.insert(0, req)
+                        return
+
+    # ------------------------------------------------------------ rounds
+
+    def _retire(self, req: Request) -> None:
+        toks = (np.concatenate(req._chunks) if req._chunks
+                else np.zeros((0,), np.int32))
+        req.tokens = toks[:req.max_new_tokens]
+        req.state = RequestState.FINISHED
+        # Finished sequences free their pages IMMEDIATELY: cold-end LRU
+        # reinsert means the next activation reclaims them first.
+        self.cache.release_sequence(req.seq)
+        del self._running[req.seq]
+        self._free_seqs.append(req.seq)
+        req.seq = None
+        self.stats["retired"] += 1
+        _counter_add("tpusched_retired")
+
+    def step(self) -> Dict[str, int]:
+        """One scheduling round: admit/restore, fit-check (preempting
+        SLO-ordered victims if decode growth outgrew the pool), ONE
+        batched decode dispatch, retire.  Returns live counts."""
+        with _span("sched.round", obj=self.stats["rounds"]):
+            self._try_admissions()
+            # Decode growth can push the runnable set past the slot
+            # pool: preempt until the round fits (never below one).
+            while (self._running and
+                   self._projected_pages() > self.cache.n_slots and
+                   len(self._running) > 1):
+                victim = self._pick_victim()
+                if victim is None:
+                    break
+                self._preempt(victim)
+            if not self._running:
+                return self.live_counts()
+
+            ids = sorted(self._running)
+            tpr = self.tokens_per_round
+            t0 = time.perf_counter()
+            try:
+                view = self.cache.activate(ids, new_tokens=tpr)
+            except native.RmError:
+                # Backing fault past the engine's bounded retries: the
+                # activation rolled back (no pins survive), so the
+                # round simply retries — chaos sheds a round, never the
+                # server.
+                self.stats["round_errors"] = \
+                    self.stats.get("round_errors", 0) + 1
+                _counter_add("tpusched_round_errors")
+                return self.live_counts()
+            # Pad the batch to a power of two by REPEATING row 0: the
+            # duplicate decodes identical tokens and scatters identical
+            # bytes to the same slots (idempotent), and decode_scan
+            # compiles once per bucket instead of once per batch size.
+            pad = _pad_pow2(len(ids))
+            toks_in = self._cur_tok[np.array(ids)]
+            if pad != len(ids):
+                reps = pad - len(ids)
+                view = dataclasses.replace(
+                    view,
+                    page_table=jnp.concatenate(
+                        [view.page_table,
+                         jnp.repeat(view.page_table[:1], reps, axis=0)]),
+                    seq_lens=jnp.concatenate(
+                        [view.seq_lens,
+                         jnp.repeat(view.seq_lens[:1], reps)]))
+                toks_in = np.concatenate(
+                    [toks_in, np.repeat(toks_in[:1], reps)])
+            _, view, toks = serving.decode_scan(
+                self.cfg, self.params, jnp.asarray(toks_in), view, tpr)
+            toks = np.asarray(toks[:, :len(ids)], np.int32)   # [tpr, B]
+            self.cache.sync_from(view, ids, decoded=tpr)
+            dt = time.perf_counter() - t0
+
+            per_tok = dt / tpr
+            for i, seq in enumerate(ids):
+                req = self._running[seq]
+                req._chunks.append(toks[:, i])
+                req._token_lat_s.extend([per_tok] * tpr)
+                req.decoded += tpr
+                self._cur_tok[seq] = toks[-1, i]
+            self.stats["rounds"] += 1
+            self.stats["decoded_tokens"] += tpr * len(ids)
+            _counter_add("tpusched_rounds")
+            _counter_add("tpusched_decoded_tokens", tpr * len(ids))
+
+            for seq in list(ids):
+                req = self._running.get(seq)
+                if req is not None and req.decoded >= req.max_new_tokens:
+                    self._retire(req)
+        return self.live_counts()
+
+    def live_counts(self) -> Dict[str, int]:
+        return {"queued": len(self._queue),
+                "running": len(self._running),
+                "preempted": len(self._preempted)}
+
+    @property
+    def idle(self) -> bool:
+        return not (self._queue or self._running or self._preempted)
+
+    def run(self, max_rounds: int = 100000) -> Dict[str, float]:
+        """Drive until every submitted stream finished (or the round
+        budget trips); returns the serving report."""
+        t0 = time.perf_counter()
+        rounds = 0
+        while not self.idle and rounds < max_rounds:
+            before = self.stats["decoded_tokens"]
+            self.step()
+            rounds += 1
+            if (self.stats["decoded_tokens"] == before and
+                    not self._running and
+                    (self._queue or self._preempted)):
+                # Nothing ran and nothing could admit (e.g. shed storm):
+                # spin-guard so an armed inject site cannot livelock us.
+                time.sleep(0.001)
+        wall = time.perf_counter() - t0
+        return self.report(wall)
+
+    def report(self, wall_s: float) -> Dict[str, float]:
+        lats = [s for r in self._by_rid.values()
+                for s in r._token_lat_s]
+        finished = [r for r in self._by_rid.values()
+                    if r.state is RequestState.FINISHED]
+        out = {
+            "streams": len(self._by_rid),
+            "finished": len(finished),
+            "wall_s": round(wall_s, 3),
+            "agg_toks_per_s": round(
+                sum(min(r.decoded, r.max_new_tokens)
+                    for r in finished) / wall_s, 2) if wall_s else 0.0,
+            "p50_token_ms": round(
+                1e3 * float(np.percentile(lats, 50)), 3) if lats else 0.0,
+            "p99_token_ms": round(
+                1e3 * float(np.percentile(lats, 99)), 3) if lats else 0.0,
+        }
+        out.update({k: v for k, v in self.stats.items()})
+        return out
+
+    # ---------------------------------------------------------- teardown
+
+    def close(self) -> None:
+        if self.cache is not None:
+            self.cache.close()
+            self.cache = None
+
+    def __enter__(self) -> "Scheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -36,6 +36,7 @@ class Site(enum.IntEnum):
     FENCE_TIMEOUT = 6    # fault-service / fence timeout
     MEMRING_SUBMIT = 7   # memring op execution (per coalesced run)
     CE_COPY = 8          # tpuce stripe submission (per attempt)
+    SCHED_ADMIT = 9      # tpusched admission decision (per pass)
 
 
 class Mode(enum.IntEnum):
@@ -76,7 +77,19 @@ DETAIL_COUNTERS = (
     "tpuce_inject_retries",
     "tpuce_inject_errors",
     "tpuce_lossless_fallbacks",
+    "tpusched_admit_retries",
+    "tpusched_admit_sheds",
 )
+
+
+def should_fail(site: Site, scope: int = 0) -> bool:
+    """Evaluate a site the way an engine check does (exported for the
+    Python-side tpusched admission gate: one native call, disarmed fast
+    path intact)."""
+    lib = _lib()
+    if scope:
+        return bool(lib.tpurmInjectShouldFailScoped(int(site), scope))
+    return bool(lib.tpurmInjectShouldFail(int(site)))
 
 _bound = None
 
@@ -104,6 +117,10 @@ def _lib() -> ctypes.CDLL:
     lib.tpurmInjectCounts.restype = None
     lib.tpurmInjectSiteName.argtypes = [u32]
     lib.tpurmInjectSiteName.restype = ctypes.c_char_p
+    lib.tpurmInjectShouldFail.argtypes = [u32]
+    lib.tpurmInjectShouldFail.restype = ctypes.c_bool
+    lib.tpurmInjectShouldFailScoped.argtypes = [u32, u64]
+    lib.tpurmInjectShouldFailScoped.restype = ctypes.c_bool
     _bound = lib
     return lib
 

@@ -87,6 +87,16 @@ class _ResidencyInfo(ctypes.Structure):
     ]
 
 
+class _TenantInfo(ctypes.Structure):
+    _fields_ = [
+        ("priority", ctypes.c_uint32),
+        ("hbmQuotaPages", ctypes.c_uint64),
+        ("cxlQuotaPages", ctypes.c_uint64),
+        ("hbmPages", ctypes.c_uint64),
+        ("cxlPages", ctypes.c_uint64),
+    ]
+
+
 class _FaultStats(ctypes.Structure):
     _fields_ = [
         ("faultsCpu", ctypes.c_uint64),
@@ -154,6 +164,19 @@ class Event:
     address: int
     bytes: int
     timestamp_ns: int
+
+
+@dataclass(frozen=True)
+class TenantInfo:
+    """Tenant QoS state (uvm.h UvmTenantInfo): eviction priority,
+    per-tier page quotas (0 = unlimited) and the current charged
+    usage."""
+
+    priority: int
+    hbm_quota_pages: int
+    cxl_quota_pages: int
+    hbm_pages: int
+    cxl_pages: int
 
 
 _bound = None
@@ -231,6 +254,14 @@ def _lib() -> ctypes.CDLL:
     lib.uvmSuspend.restype = u32
     lib.uvmResume.argtypes = []
     lib.uvmResume.restype = u32
+    lib.uvmTenantConfigure.argtypes = [u32, u32, u64, u64]
+    lib.uvmTenantConfigure.restype = u32
+    lib.uvmTenantInfoGet.argtypes = [u32, ctypes.POINTER(_TenantInfo)]
+    lib.uvmTenantInfoGet.restype = u32
+    lib.uvmVaSpaceBindTenant.argtypes = [vp, u32]
+    lib.uvmVaSpaceBindTenant.restype = u32
+    lib.tpurmBrokerTenantConfigure.argtypes = [u32, u32, u64, u64]
+    lib.tpurmBrokerTenantConfigure.restype = u32
 
     _bound = lib
     return lib
@@ -253,6 +284,33 @@ def suspend() -> None:
 def resume() -> None:
     """Restore saved residency and reopen the PM gate."""
     _check(_lib().uvmResume(), "uvmResume")
+
+
+def tenant_configure(tenant_id: int, priority: int = 100,
+                     hbm_quota_pages: int = 0,
+                     cxl_quota_pages: int = 0) -> None:
+    """Create-or-update a QoS tenant (uvm.h tenant API): eviction
+    priority (higher = keep longer) and HBM/CXL backing-page quotas
+    (0 = unlimited).  Enforcement is eviction pressure: when an arena
+    needs a victim, over-quota tenants' cold blocks go first, then
+    lower-priority tenants, then plain LRU order.
+
+    Broker-aware: under ``TPURM_BROKER`` the op forwards to the engine
+    host (BR_OP_TENANT) so the quota lands in the table the engine's
+    eviction walk actually consults."""
+    _check(_lib().tpurmBrokerTenantConfigure(tenant_id, priority,
+                                             hbm_quota_pages,
+                                             cxl_quota_pages),
+           "tpurmBrokerTenantConfigure")
+
+
+def tenant_info(tenant_id: int) -> TenantInfo:
+    """Usage + quota snapshot for a configured tenant."""
+    raw = _TenantInfo()
+    _check(_lib().uvmTenantInfoGet(tenant_id, ctypes.byref(raw)),
+           "uvmTenantInfoGet")
+    return TenantInfo(raw.priority, raw.hbmQuotaPages, raw.cxlQuotaPages,
+                      raw.hbmPages, raw.cxlPages)
 
 
 def fault_stats_reset_windows() -> None:
@@ -563,6 +621,13 @@ class VaSpace:
         buf = ManagedBuffer(self, nbytes)
         self._buffers.append(buf)
         return buf
+
+    def bind_tenant(self, tenant_id: int) -> None:
+        """Bind this space (and the pages its blocks already hold) to a
+        configured tenant; its allocations then charge that tenant's
+        quotas and inherit its eviction priority."""
+        _check(self._lib.uvmVaSpaceBindTenant(self._handle, tenant_id),
+               "uvmVaSpaceBindTenant")
 
     def run_test(self, test_cmd: int) -> None:
         _check(self._lib.uvmRunTest(self._handle, test_cmd), "uvmRunTest")

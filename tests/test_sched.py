@@ -1,0 +1,362 @@
+"""tpusched: continuous-batching scheduler + tenant QoS.
+
+Three layers under test:
+  - scheduler semantics (runtime/sched.py): mid-decode admission is
+    token-exact, preemption+restore round-trips through the backing,
+    scheduler-level tenant quotas preempt the over-quota tenant only,
+    the sched.admit inject site sheds load instead of erroring;
+  - native tenant quotas (uvm.h tenant API): SLO-aware arena eviction
+    victimizes over-quota / low-priority tenants' blocks first (driven
+    in subprocesses with a small fake HBM arena — no jax needed there);
+  - prefetch effectiveness counters (uvm_prefetch_hits / _useless).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from open_gpu_kernel_modules_tpu.models import llama
+from open_gpu_kernel_modules_tpu.runtime import sched
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
+        max_seq_len=256, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _mk(cfg, params, **kw):
+    args = dict(max_seqs=4, max_len=128, page_size=16, oversub=1,
+                tokens_per_round=4)
+    args.update(kw)
+    return sched.Scheduler(cfg, params, **args)
+
+
+def _solo_tokens(cfg, params, prompt, n, **kw):
+    """Reference stream: the same request alone in its own scheduler."""
+    s = _mk(cfg, params, **kw)
+    try:
+        r = s.submit(prompt, max_new_tokens=n)
+        s.run()
+        return r.tokens.copy()
+    finally:
+        s.close()
+
+
+def test_mid_decode_admission_bit_identical(setup):
+    """Streams admitted MID-decode of others produce exactly the tokens
+    they produce alone: iteration-level batching composes row-wise."""
+    cfg, params = setup
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(0, 256, size=24)
+    p2 = rng.integers(0, 256, size=16)
+    p3 = rng.integers(0, 256, size=24)
+
+    s = _mk(cfg, params)
+    r1 = s.submit(p1, max_new_tokens=16)
+    s.step()                      # r1 alone for a few rounds
+    s.step()
+    r2 = s.submit(p2, max_new_tokens=12)   # arrives mid-decode of r1
+    s.step()
+    r3 = s.submit(p3, max_new_tokens=8)    # and another
+    s.run()
+    assert r1.state is sched.RequestState.FINISHED
+    assert r2.state is sched.RequestState.FINISHED
+    assert r3.state is sched.RequestState.FINISHED
+    got = [r1.tokens, r2.tokens, r3.tokens]
+    s.close()
+
+    refs = [_solo_tokens(cfg, params, p, n)
+            for p, n in ((p1, 16), (p2, 12), (p3, 8))]
+    for i, (g, ref) in enumerate(zip(got, refs)):
+        assert np.array_equal(g, ref), \
+            f"stream {i} tokens diverged: {g} vs {ref}"
+
+
+def test_preempt_restore_bit_identical(setup):
+    """Oversubscription forces preempt+restore cycles; every stream's
+    tokens still match its solo run exactly (the swap-out/in through
+    the backing + memring PREFETCH chain loses nothing)."""
+    cfg, params = setup
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256, size=24) for _ in range(4)]
+
+    s = _mk(cfg, params, oversub=4, tokens_per_round=8)
+    reqs = [s.submit(p, max_new_tokens=48) for p in prompts]
+    rep = s.run()
+    assert rep["finished"] == 4
+    assert rep["preempted"] >= 1, "oversubscription never preempted"
+    assert rep["restored"] == rep["preempted"]
+    got = [r.tokens.copy() for r in reqs]
+    s.close()
+
+    for i, (p, g) in enumerate(zip(prompts, got)):
+        ref = _solo_tokens(cfg, params, p, 48, oversub=4,
+                           tokens_per_round=8)
+        assert np.array_equal(g, ref), f"stream {i} corrupted by preempt"
+
+
+def test_tenant_quota_preemption(setup):
+    """Scheduler-level QoS: the over-quota low-priority tenant gets
+    preempted/deferred under pressure; the compliant high-priority
+    tenant is never preempted and both tenants' streams finish."""
+    cfg, params = setup
+    rng = np.random.default_rng(3)
+
+    s = _mk(cfg, params, max_seqs=4, oversub=2, tokens_per_round=8)
+    # Tenant 1: low priority, slot quota of 6 pages (each stream grows
+    # to ~4 pages: two concurrent streams breach it).  Tenant 2: high
+    # priority, unlimited.
+    s.configure_tenant(1, priority=1, device_page_quota=6)
+    s.configure_tenant(2, priority=50)
+    low = [s.submit(rng.integers(0, 256, size=24), 40, tenant=1)
+           for _ in range(3)]
+    high = [s.submit(rng.integers(0, 256, size=24), 40, tenant=2)
+            for _ in range(1)]
+    rep = s.run()
+    assert rep["finished"] == 4
+    assert all(r.state is sched.RequestState.FINISHED
+               for r in low + high)
+    # The QoS asymmetry: any preemption taken landed on tenant 1.
+    assert all(r.preempts == 0 for r in high), \
+        "high-priority compliant tenant was preempted"
+    s.close()
+
+
+def test_admit_inject_shed(setup):
+    """The sched.admit inject site (10th): bounded retry then
+    degrade-to-preempt — admissions shed, nothing errors, every stream
+    still completes once the site disarms its burst."""
+    from open_gpu_kernel_modules_tpu.uvm import inject as inj
+
+    cfg, params = setup
+    rng = np.random.default_rng(5)
+    s = _mk(cfg, params, admit_retries=2)
+    evals0, hits0 = inj.counts(inj.Site.SCHED_ADMIT)
+    # One hit with a burst long enough to defeat the bounded retry:
+    # the first admission pass must shed.
+    inj.enable(inj.Site.SCHED_ADMIT, inj.Mode.ONESHOT, burst=8)
+    try:
+        reqs = [s.submit(rng.integers(0, 256, size=16), 8)
+                for _ in range(3)]
+        rep = s.run()
+    finally:
+        inj.disable(inj.Site.SCHED_ADMIT)
+    assert rep["finished"] == 3
+    assert rep["admit_retries"] >= 2, rep
+    assert rep["admit_sheds"] >= 1, rep
+    evals, hits = inj.counts(inj.Site.SCHED_ADMIT)
+    assert evals > evals0 and hits > hits0
+    assert all(r.state is sched.RequestState.FINISHED for r in reqs)
+    s.close()
+
+
+def test_sched_counters_and_spans(setup):
+    """tpusched_* counters reach the Prometheus exposition and the
+    sched.round/admit tputrace spans land in their site histograms."""
+    from open_gpu_kernel_modules_tpu import utils
+
+    cfg, params = setup
+    utils.trace_reset()
+    utils.trace_start()
+    try:
+        s = _mk(cfg, params)
+        rng = np.random.default_rng(9)
+        s.submit(rng.integers(0, 256, size=16), 8)
+        s.run()
+        s.close()
+    finally:
+        utils.trace_stop()
+    assert utils.trace_hist_count("sched.round") > 0
+    assert utils.trace_hist_count("sched.admit") > 0
+    text = utils.metrics_text()
+    assert 'tpurm_counter{name="tpusched_admitted"' in text
+    assert 'tpurm_counter{name="tpusched_retired"' in text
+    assert "tpurm_tenant_pages{" in text
+    utils.trace_reset()
+
+
+# ------------------------------------------------------ native QoS layer
+#
+# Subprocesses with a tiny fake HBM arena (device geometry is fixed at
+# process start) and NO jax import — they drive the native tier layer
+# through the ctypes surface only.
+
+_NATIVE_QUOTA = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import uvm, utils
+from open_gpu_kernel_modules_tpu.uvm import managed
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+MB = 1 << 20
+out = {}
+
+# Low-priority tenant A with a tiny HBM quota; high-priority B without.
+managed.tenant_configure(1, priority=1, hbm_quota_pages=16)   # 1 MB
+managed.tenant_configure(2, priority=50)
+vsA, vsB = uvm.VaSpace(), uvm.VaSpace()
+vsA.bind_tenant(1)
+vsB.bind_tenant(2)
+
+# A takes 4 MB of the 16 MB arena (way over its 1 MB quota), then B's
+# 13 MB allocation pressures the arena: the SLO walk must evict A's
+# over-quota blocks first and leave B fully resident.
+bufA = vsA.alloc(4 * MB)
+bufA.view()[:] = 0xA1
+bufA.migrate(Tier.HBM)
+out["a_before"] = managed.tenant_info(1).hbm_pages
+bufB = vsB.alloc(13 * MB)
+bufB.view()[:] = 0xB2
+bufB.migrate(Tier.HBM)
+
+infoA, infoB = managed.tenant_info(1), managed.tenant_info(2)
+out["a_after"] = infoA.hbm_pages
+out["b_after"] = infoB.hbm_pages
+out["a_resident_hbm"] = bool(bufA.residency().hbm)
+out["b_resident_hbm"] = bool(bufB.residency().hbm)
+out["over_quota_evictions"] = utils.counter(
+    "tier_tenant_over_quota_evictions")
+out["slo_reorders"] = utils.counter("tier_tenant_slo_reorders")
+out["a_intact"] = bool((bufA.view() == 0xA1).all())
+out["b_intact"] = bool((bufB.view() == 0xB2).all())
+vsA.close(); vsB.close()
+print(json.dumps(out))
+"""
+
+_NATIVE_PRIO = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import uvm, utils
+from open_gpu_kernel_modules_tpu.uvm import managed
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+MB = 1 << 20
+out = {}
+
+# No quotas anywhere: victim order is priority-only.  The LOW-priority
+# tenant's block is the WARMEST (touched last) — plain LRU would evict
+# the high-priority tenant's colder block; the SLO walk must not.
+managed.tenant_configure(3, priority=1)
+managed.tenant_configure(4, priority=90)
+vsL, vsH = uvm.VaSpace(), uvm.VaSpace()
+vsL.bind_tenant(3)
+vsH.bind_tenant(4)
+bufH = vsH.alloc(6 * MB)
+bufH.view()[:] = 0x11
+bufH.migrate(Tier.HBM)          # high priority, COLD (migrated first)
+bufL = vsL.alloc(6 * MB)
+bufL.view()[:] = 0x22
+bufL.migrate(Tier.HBM)          # low priority, WARM
+# Pressure: another high-priority span that cannot fit (16 MB arena).
+bufH2 = vsH.alloc(6 * MB)
+bufH2.view()[:] = 0x33
+bufH2.migrate(Tier.HBM)
+
+out["low_resident"] = bool(bufL.residency().hbm)
+out["high_resident"] = bool(bufH.residency().hbm)
+out["high2_resident"] = bool(bufH2.residency().hbm)
+out["low_pages"] = managed.tenant_info(3).hbm_pages
+out["high_pages"] = managed.tenant_info(4).hbm_pages
+out["intact"] = bool((bufL.view() == 0x22).all() and
+                     (bufH.view() == 0x11).all() and
+                     (bufH2.view() == 0x33).all())
+vsL.close(); vsH.close()
+print(json.dumps(out))
+"""
+
+_PREFETCH_FX = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import uvm, utils
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+MB = 1 << 20
+out = {}
+vs = uvm.VaSpace()
+
+# Streaming single-page device accesses: fault density grows the
+# serviced region, so later accesses land on pages an earlier
+# expansion staged speculatively -> HITS.  (CPU touches on prefetched
+# pages never re-fault — the engine only observes uses that reach the
+# fault path, i.e. device accesses; that is also the serving stack's
+# access pattern.)
+buf = vs.alloc(2 * MB)
+buf.view()[:] = 2
+for off in range(0, 2 * MB, 64 * 1024):
+    buf.device_access(dev=0, offset=off, length=64 * 1024)
+out["hits"] = utils.counter("uvm_prefetch_hits")
+
+# A second streaming span stages speculative pages in HBM, then a big
+# allocation pressures them out UNTOUCHED -> USELESS.
+buf2 = vs.alloc(2 * MB)
+buf2.view()[:] = 4
+for off in range(0, 256 * 1024, 64 * 1024):
+    buf2.device_access(dev=0, offset=off, length=64 * 1024)
+big = vs.alloc(15 * MB)
+big.view()[:] = 3
+big.device_access(dev=0)
+out["useless"] = utils.counter("uvm_prefetch_useless")
+out["prefetch_pages"] = utils.counter("uvm_prefetch_pages")
+out["intact"] = bool((buf.view() == 2).all() and
+                     (buf2.view() == 4).all())
+vs.close()
+print(json.dumps(out))
+"""
+
+
+def _run_native(script):
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "1"
+    env["TPUMEM_FAKE_HBM_MB"] = "16"
+    proc = subprocess.run([sys.executable, "-c",
+                           script % {"repo": _REPO}],
+                          env=env, capture_output=True, text=True,
+                          timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_native_tenant_quota_eviction():
+    """Arena pressure evicts the over-quota tenant's pages first; the
+    compliant tenant keeps residency and nobody's bytes corrupt."""
+    out = _run_native(_NATIVE_QUOTA)
+    assert out["a_before"] > 16, out           # A genuinely over quota
+    assert out["b_resident_hbm"], out          # compliant B kept HBM
+    assert out["a_after"] < out["a_before"], out   # A lost pages
+    assert out["b_after"] > 0, out
+    assert out["over_quota_evictions"] > 0, out
+    assert out["a_intact"] and out["b_intact"], out
+
+
+def test_native_slo_priority_victim_order():
+    """With no quotas, victim order is tenant priority: the WARM
+    low-priority block is evicted before the COLD high-priority one
+    (plain LRU would do the opposite)."""
+    out = _run_native(_NATIVE_PRIO)
+    assert not out["low_resident"], out
+    assert out["high_resident"] and out["high2_resident"], out
+    assert out["intact"], out
+
+
+def test_prefetch_effectiveness_counters():
+    """uvm_prefetch_hits counts staged pages later used;
+    uvm_prefetch_useless counts staged pages evicted untouched."""
+    out = _run_native(_PREFETCH_FX)
+    assert out["prefetch_pages"] > 0, out
+    assert out["hits"] > 0, out
+    assert out["useless"] > 0, out
+    assert out["intact"], out
