@@ -1073,6 +1073,108 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
     }
 
 
+def measure_reset_mttr(streams: int = 32, resets: int = 5) -> dict:
+    """Full-device reset MTTR under serving load (tpurm/reset.h): the
+    1->128 sweep's heavy shape (page-boundary prompts at oversub=2, the
+    preempt/restore machine live), A/B: one reset-free pass (the steady
+    baseline, which also warms every decode_scan bucket) against one
+    pass with ``resets`` forced device resets injected mid-decode.
+    Records the quiesce->resume MTTR distribution (per-reset samples
+    from TpuResetStats), the p99 per-token latency of reset-affected
+    rounds vs the steady pass, and the whole-run tokens/s dip — the
+    number a fleet operator actually budgets: what one lost device
+    costs the serving tail."""
+    import numpy as np
+    import jax
+    from open_gpu_kernel_modules_tpu.models import llama
+    from open_gpu_kernel_modules_tpu.runtime import sched as tpusched
+    from open_gpu_kernel_modules_tpu.uvm import reset as tpureset
+
+    cfg = llama.LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=8, num_kv_heads=8, head_dim=32,
+        max_seq_len=512)
+    params = llama.init_params(cfg, jax.random.key(0))
+    # Longer streams than the sweep's (48 new tokens): the injected
+    # pass needs enough decode rounds to spread N resets across.
+    prompt_len, max_new, tpr = 112, 48, 8
+
+    def one_pass(n_resets):
+        rng = np.random.default_rng(7)      # identical workload per pass
+        s = tpusched.Scheduler(cfg, params, max_seqs=16, max_len=256,
+                               page_size=64, oversub=2,
+                               tokens_per_round=tpr)
+        for _ in range(streams):
+            s.submit(rng.integers(0, cfg.vocab_size, size=prompt_len),
+                     max_new_tokens=max_new)
+        mttr = []
+        rows = []            # (tokens, dt_s, reset_affected)
+        forced = 0
+        rounds = 0
+        affected_next = 0
+        wall0 = time.perf_counter()
+        while not s.idle and rounds < 20000:
+            before = s.stats["decoded_tokens"]
+            t0 = time.perf_counter()
+            s.step()
+            dt = time.perf_counter() - t0
+            toks = s.stats["decoded_tokens"] - before
+            if toks:
+                rows.append((toks, dt, affected_next > 0))
+            if affected_next:
+                affected_next -= 1
+            rounds += 1
+            # Every third round until the budget is spent, so steady
+            # rounds interleave with reset-affected ones.
+            if forced < n_resets and rounds % 3 == 1 and not s.idle:
+                tpureset.device_reset()
+                mttr.append(tpureset.stats().last_mttr_ms)
+                # The next TWO rounds wear the reset: the preempt-all
+                # observation round and the restore round.
+                affected_next = 2
+                forced += 1
+        wall = time.perf_counter() - wall0
+        rep = s.report(wall)
+        s.close()
+        toks_total = sum(t for t, _, _ in rows)
+        return rows, mttr, forced, rep, wall, toks_total
+
+    # Warmup pass: wears every decode_scan pow2-bucket compile so the
+    # measured passes time serving, not XLA.
+    one_pass(0)
+    # Pass A: reset-free steady baseline.
+    rows_a, _, _, _, wall_a, toks_a = one_pass(0)
+    # Pass B: same workload with the resets injected.
+    rows_b, mttr_ms, forced, rep_b, wall_b, toks_b = one_pass(resets)
+
+    def _tok_ms(rows, q):
+        per_tok = [1e3 * d / t for t, d, _ in rows for _ in range(t)]
+        return round(float(np.percentile(per_tok, q)), 3) if per_tok \
+            else 0.0
+
+    steady_tps = toks_a / wall_a if wall_a else 0.0
+    reset_tps = toks_b / wall_b if wall_b else 0.0
+    out = {
+        "reset_count": forced,
+        "reset_mttr_ms": round(float(np.percentile(mttr_ms, 50)), 3)
+        if mttr_ms else 0.0,
+        "reset_mttr_p95_ms": round(float(np.percentile(mttr_ms, 95)), 3)
+        if mttr_ms else 0.0,
+        "reset_mttr_max_ms": round(max(mttr_ms), 3) if mttr_ms else 0.0,
+        "serve_p99_during_reset_ms":
+            _tok_ms([r for r in rows_b if r[2]], 99),
+        "serve_p99_steady_ms": _tok_ms(rows_a, 99),
+        # Whole-run throughput dip with N resets vs the reset-free run
+        # of the identical workload (0 = free, 0.5 = half speed).
+        "serve_toks_dip_frac": round(1.0 - reset_tps / steady_tps, 3)
+        if steady_tps and reset_tps else 0.0,
+        "reset_resets_observed_by_sched":
+            rep_b.get("device_resets_observed", 0),
+        "reset_stale_completions": tpureset.stats().stale_completions,
+    }
+    return out
+
+
 def _measure_isolated(fn_name: str, timeout_s: int, fallback,
                       tag: str) -> dict:
     """Run a measurement in a FRESH subprocess: the relay slows with
@@ -1321,6 +1423,20 @@ def main() -> None:
                 extra.update(measure_serving_sweep())
         except Exception as exc:
             extra["serve_error"] = str(exc)[:200]
+        # Reset MTTR under the same serving shape: N forced full-device
+        # resets mid-decode; MTTR distribution + the serving tail's
+        # reset cost.  Own subprocess on the chip (readbacks), and also
+        # isolated from the sweep's process state either way — a reset
+        # suspends/restores EVERY managed page in the process.
+        try:
+            if on_tpu:
+                extra.update(_measure_isolated(
+                    "measure_reset_mttr", 900,
+                    measure_reset_mttr, "reset"))
+            else:
+                extra.update(measure_reset_mttr())
+        except Exception as exc:
+            extra["reset_error"] = str(exc)[:200]
 
     try:
         extra.update(measure_explicit_migrate_gbps())

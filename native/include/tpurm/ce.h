@@ -117,6 +117,12 @@ typedef struct {
     bool injected;                /* current failure came from ce.copy */
     uint64_t val;                 /* tracker value (0: not in flight)  */
     TpuStatus subSt;              /* submission status when val == 0   */
+    uint64_t gen;                 /* device generation at submission:
+                                   * a completion that crosses a full-
+                                   * device reset is STALE — the wait
+                                   * rejects it (tpuce_stale_
+                                   * completions) and replays the
+                                   * stripe against the new generation */
     void *dst;
     const void *src;
     uint64_t len;                 /* contiguous span / gather total    */
@@ -131,10 +137,19 @@ typedef struct {
     TpuCeMgr *m;
     uint32_t n;
     TpuStatus st;                 /* sticky first terminal error */
+    uint64_t deadlineNs;          /* 0 = none; absolute tpuNowNs bound:
+                                   * once past it, stripe recovery stops
+                                   * retrying and fails fast (counted
+                                   * tpuce_deadline_expired) — the hung-
+                                   * op ladder's fail-fast floor        */
     TpuCeStripe stripes[TPUCE_BATCH_STRIPES];
 } TpuCeBatch;
 
 TpuStatus tpuCeBatchBegin(TpuCeMgr *m, TpuCeBatch *b);
+
+/* Arm a completion deadline on the batch (applies to every stripe wait
+ * from now on; 0 clears). */
+void tpuCeBatchSetDeadline(TpuCeBatch *b, uint64_t deadlineNs);
 
 /* Stripe [src, src+len) -> dst across the pool.  comp is a
  * TPU_CE_COMP_* format (|DOWNLOAD for accounting); ineligible payloads

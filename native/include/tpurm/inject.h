@@ -54,6 +54,11 @@ typedef enum {
     TPU_INJECT_SITE_MEMRING_SUBMIT,  /* memring op execution (run)       */
     TPU_INJECT_SITE_CE_COPY,         /* tpuce stripe submission          */
     TPU_INJECT_SITE_SCHED_ADMIT,     /* tpusched admission decision      */
+    TPU_INJECT_SITE_RESET_DEVICE,    /* forced full-device reset (the
+                                      * reset watchdog evaluates this
+                                      * once per tick; a hit injects a
+                                      * device-level fatal fault whose
+                                      * recovery IS tpurmDeviceReset)   */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

@@ -52,6 +52,16 @@
  * DROPPED and counted (hdr.cqOverflows / "memring_cq_overflows") —
  * fences and completion accounting still advance, so a slow reaper
  * can never deadlock the pool (io_uring's overflow accounting).
+ *
+ * Reset integration (tpurm/reset.h): a full-device reset PARKS the
+ * worker pools (claimed ops drain bounded; published-but-unclaimed
+ * SQEs stay queued and replay after resume — every opcode is
+ * idempotent by design), and every claim records the device
+ * generation it executed under: a completion that crosses a
+ * generation bump (possible only when quiesce timed out on a hung op)
+ * posts TPU_ERR_DEVICE_RESET instead of its result and is counted
+ * (memring_stale_completions) — a zombie's late completion can never
+ * masquerade as valid post-reset state.
  */
 #ifndef TPURM_MEMRING_H
 #define TPURM_MEMRING_H
@@ -118,8 +128,16 @@ typedef struct {
     uint32_t peerInst;            /* PEER_COPY remote device            */
     uint32_t arg0;                /* ADVISE subcode / PEER direction    */
     uint64_t peerOff;             /* PEER_COPY peer HBM arena offset    */
-    uint64_t arg1;                /* ADVISE READ_DUP on/off             */
-    uint64_t pad;
+    uint64_t arg1;                /* ADVISE READ_DUP on/off; NOP: an
+                                   * execution delay in ns (test/pacing
+                                   * knob for the hung-op machinery)    */
+    uint64_t deadlineNs;          /* 0 = none; absolute tpuNowNs
+                                   * deadline — an op claimed past it
+                                   * posts TPU_ERR_RETRY_EXHAUSTED
+                                   * without executing (counted
+                                   * memring_deadline_expired).  The
+                                   * hung-op watchdog (tpurm/reset.h)
+                                   * escalates ops stuck in flight.    */
 } TpuMemringSqe;
 
 /* Completion entry — exactly one cacheline. */

@@ -85,6 +85,15 @@ TpuStatus tpuIbDeregMr(TpuIbMr *mr);
 /* 0 after peer invalidation (free-callback fired mid-MR). */
 int tpuIbMrValid(TpuIbMr *mr);
 
+/* Full-device reset hook (tpurm/reset.h): re-establish every live MR's
+ * DMA mapping against the post-reset device state — the peer client's
+ * dmaMap is re-run per MR (counted rdma_mrs_revalidated).  An MR whose
+ * pin cannot re-establish is REVOKED through its control page exactly
+ * like a mid-MR free (counted rdma_reset_revocations) — a reset must
+ * never leave a valid-looking MR over unverified backing.  Returns the
+ * number of MRs that revalidated. */
+uint32_t tpuIbMrRevalidateAll(void);
+
 /* IOVAs carry the NIC id in the top byte (per-NIC IOMMU domains); the
  * consumer's "IOMMU translation" to an arena offset is masking it off. */
 #define TPU_IB_IOVA_OFFSET_MASK ((1ull << 56) - 1)

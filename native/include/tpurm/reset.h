@@ -1,0 +1,128 @@
+/*
+ * tpureset — coordinated full-device reset, hung-op watchdog
+ * escalation, and the device-wide generation fence.
+ *
+ * The per-channel half of "surviving the hardware" already exists (rc.c
+ * robust-channel recovery: latch, shadow-buffer attribution, bounded
+ * reset-and-replay).  tpureset owns the two failures a serving fleet
+ * actually sees above that layer:
+ *
+ *   a WEDGED DEVICE — an engine stops retiring work entirely.  The
+ *   recovery is a *full-device reset* (reference: RM fatal-fault
+ *   teardown + fbsr save/restore, SURVEY layer 3), structured as three
+ *   phases:
+ *
+ *     quiesce — park the memring worker pools (published-but-unclaimed
+ *               SQEs stay queued for replay; claimed ops drain with a
+ *               bounded timeout), take the UVM PM gate exclusively and
+ *               save device-resident pages to their host backing
+ *               (uvmSuspend — the fbsr path), pause the fault-service
+ *               loop between batches, and drain every tpuce copy
+ *               channel;
+ *     reset   — bump the DEVICE-WIDE GENERATION (stale trackers and
+ *               completions that cross the bump are rejected with
+ *               TPU_ERR_DEVICE_RESET — see the fencing contract
+ *               below), clear every latched channel error
+ *               (tpuRcRecoverAll), retrain every ICI link, and
+ *               re-validate live RDMA MR pins;
+ *     resume  — restore saved residency from the backing (uvmResume:
+ *               HBM survivors are re-materialized from host truth, the
+ *               fbsr semantics), resume fault service, and unpark the
+ *               memring pools — pending idempotent SQEs re-issue
+ *               against the new generation.
+ *
+ *   a HUNG OP — work is in flight but never retires.  SQEs and tpuce
+ *   batches carry optional DEADLINES (absolute tpuNowNs); expired ops
+ *   fail fast instead of waiting forever.  Above that, a watchdog
+ *   thread scans for no-progress-with-inflight rings and walks an
+ *   ESCALATION LADDER, each rung counted:
+ *
+ *     rung 1  nudge     — re-ring the doorbells (a lost wake is the
+ *                         cheapest wedge)            tpurm_watchdog_nudges
+ *     rung 2  RC reset  — channel reset-and-replay   tpurm_watchdog_rc_resets
+ *     rung 3  device    — full-device reset          tpurm_watchdog_device_resets
+ *
+ *   The ladder saturates after rung 3 until the ring makes progress
+ *   again (no reset storms).
+ *
+ * Generation fencing contract: every claim records the generation it
+ * executed under.  Quiesce waits for in-flight work, so the only ops
+ * that can cross a generation bump are ones quiesce TIMED OUT on —
+ * genuinely hung or wedged work whose eventual "completion" must not
+ * be mistaken for valid post-reset state.  Their CQEs/waits surface
+ * TPU_ERR_DEVICE_RESET and are counted (memring_stale_completions /
+ * tpuce_stale_completions); the memring caller re-issues, a tpuce
+ * batch replays the stripe itself.
+ *
+ * The reset.device injection site (TPUMEM_INJECT_RESET_DEVICE) is
+ * evaluated once per watchdog tick: a hit injects a device-level fatal
+ * fault whose recovery IS a full reset (counted tpurm_reset_injected,
+ * reconciled exactly: injected hits == tpurm_reset_injected).
+ *
+ * Observability: /proc/driver/tpurm/reset node; Prometheus series
+ * tpurm_reset_total, tpurm_device_generation, tpurm_reset_mttr_ns
+ * (cumulative quiesce->resume ns; with tpurm_reset_total this yields
+ * the mean, per-reset samples come from TpuResetStats.lastMttrNs), and
+ * the three ladder counters above; reset.device / reset.quiesce
+ * tputrace spans while tracing is armed.
+ *
+ * Registry knobs (TPUMEM_*):
+ *   reset_watchdog_enable      (1)    master switch for the watchdog
+ *   reset_watchdog_period_ms   (100)  scan + inject-evaluation period
+ *   reset_hang_timeout_ms      (5000) stall age before the ladder runs
+ *   reset_quiesce_timeout_ms   (2000) bounded in-flight drain per phase
+ */
+#ifndef TPURM_RESET_H
+#define TPURM_RESET_H
+
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+    uint64_t generation;        /* current device-wide generation (>=1) */
+    uint64_t resets;            /* completed full-device resets          */
+    uint64_t failedResets;      /* reset attempts that could not run     */
+    uint64_t injectedResets;    /* resets forced by the reset.device site */
+    uint64_t watchdogNudges;    /* ladder rung 1 */
+    uint64_t watchdogRcResets;  /* ladder rung 2 */
+    uint64_t watchdogDeviceResets; /* ladder rung 3 */
+    uint64_t lastMttrNs;        /* last reset: quiesce -> resume        */
+    uint64_t lastQuiesceNs;     /* last reset: quiesce phase alone      */
+    uint64_t lastRestoreNs;     /* last reset: reset + resume phases    */
+    uint64_t mttrSumNs;         /* cumulative MTTR over all resets      */
+    uint64_t staleCompletions;  /* generation-fenced completions (all
+                                 * engines: memring + tpuce)            */
+} TpuResetStats;
+
+/* The device-wide generation.  Starts at 1; each completed (or
+ * force-proceeded) reset bumps it.  Safe from any thread, any time. */
+uint64_t tpurmDeviceGeneration(void);
+
+/* Coordinated full-device reset (all devices — the engine's arenas,
+ * channel pools and rings are process-global, exactly like the
+ * reference RM's per-GPU lock domain collapsed onto one fake chip set).
+ * Concurrent callers COALESCE: a reset already in flight absorbs the
+ * second request, which returns TPU_OK once that reset completes.
+ *
+ * Fails with TPU_ERR_INVALID_STATE when the UVM PM gate is already
+ * held by an explicit uvmSuspend (the operator owns the suspension;
+ * resetting under them would yank the arenas they froze). */
+TpuStatus tpurmDeviceReset(void);
+
+/* Snapshot the reset/watchdog statistics. */
+void tpurmResetStats(TpuResetStats *out);
+
+/* Start the hung-op watchdog thread (idempotent; also started lazily
+ * by tpuRcInit so any process that creates a channel is covered). */
+void tpurmResetWatchdogStart(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_RESET_H */

@@ -41,10 +41,15 @@ typedef uint32_t TpuStatus;
  *   RETRAIN_FAILED   — an ICI link could not be retrained and no
  *     degraded route exists;
  *   RETRY_EXHAUSTED  — a transient-error recovery loop (copy/fault/
- *     RDMA) ran out of attempts. */
+ *     RDMA) ran out of attempts;
+ *   DEVICE_RESET     — the op's result is fenced by a full-device
+ *     reset generation bump (a stale tracker/completion crossed a
+ *     tpurmDeviceReset; the caller must re-issue against the new
+ *     generation). */
 #define TPU_ERR_PAGE_QUARANTINED          0x00000070u
 #define TPU_ERR_RETRAIN_FAILED            0x00000071u
 #define TPU_ERR_RETRY_EXHAUSTED           0x00000072u
+#define TPU_ERR_DEVICE_RESET              0x00000073u
 
 const char *tpuStatusToString(TpuStatus status);
 

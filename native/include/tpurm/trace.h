@@ -74,6 +74,8 @@ typedef enum {
     TPU_TRACE_SCHED_ROUND,       /* tpusched decode round (obj = round) */
     TPU_TRACE_SCHED_ADMIT,       /* tpusched admission pass            */
     TPU_TRACE_SCHED_PREEMPT,     /* tpusched preempt + swap-out        */
+    TPU_TRACE_RESET_DEVICE,      /* full-device reset (quiesce->resume) */
+    TPU_TRACE_RESET_QUIESCE,     /* reset quiesce phase alone          */
     TPU_TRACE_APP,               /* application span (Python utils.span) */
     /* Instant-only sites. */
     TPU_TRACE_INJECT_HIT,        /* injection framework fired          */

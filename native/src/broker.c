@@ -88,7 +88,8 @@
 #define BROKER_MAX_CLI_MAPS  64
 
 enum { BR_OP_OPEN = 1, BR_OP_CLOSE = 2, BR_OP_IOCTL = 3,
-       BR_OP_UVM_BACKING = 4, BR_OP_UVM_RFAULT = 5, BR_OP_TENANT = 6 };
+       BR_OP_UVM_BACKING = 4, BR_OP_UVM_RFAULT = 5, BR_OP_TENANT = 6,
+       BR_OP_PING = 7 };
 
 /* Payload of the UVM multi-process ops (rides where ioctl payloads
  * do).  BACKING resolves an owner VA to the range's host-backing memfd
@@ -251,6 +252,16 @@ typedef struct {
     pthread_t tid;
     _Atomic bool stop;
     bool used;
+    /* Slot EPOCH: bumped on every (re)registration; the forwarder
+     * snapshots it at start and re-validates before each publish.
+     * Under today's stop-then-join protocol a slot cannot be reused
+     * while its forwarder lives, so this is an INVARIANT GUARD, not a
+     * live race window: broker_zombie_doorbells must stay 0, and a
+     * nonzero value means the teardown ordering broke (e.g. a future
+     * refactor drops the join) — the guard then contains the damage
+     * (the zombie exits instead of delivering into the recycled slot)
+     * and makes the breakage visible. */
+    _Atomic uint64_t epoch;
 } BrokerEvSlot;
 
 /* Async dev->CXL span awaiting copy-back into client memory.  Spans
@@ -270,6 +281,13 @@ typedef struct {
 typedef struct BrokerConn {
     int sock;
     pid_t peer;
+    /* Client-death plumbing: every connection registers in g_conns so
+     * the heartbeat reaper can find wedged clients; lastSeenNs is
+     * stamped on every request (and BR_OP_PING exists for clients that
+     * go quiet legitimately). */
+    struct BrokerConn *next;
+    uint64_t epoch;                     /* global accept epoch */
+    _Atomic uint64_t lastSeenNs;
     int fds[BROKER_MAX_FDS];            /* token -> local pseudo fd */
     struct {
         uint32_t clientH;
@@ -290,6 +308,83 @@ typedef struct BrokerConn {
 } BrokerConn;
 
 static _Atomic uint32_t g_next_hclient = 0xB0000001u;
+
+/* Connection registry + heartbeat reaper (server side).  A connection
+ * registers at accept and DEREGISTERS (under the lock) before any of
+ * its teardown, so the reaper can never touch freed state. */
+static struct {
+    pthread_mutex_t lock;
+    struct BrokerConn *head;
+    _Atomic uint64_t epoch;             /* accept counter */
+    pthread_once_t reaperOnce;
+} g_conns = { .lock = PTHREAD_MUTEX_INITIALIZER,
+              .reaperOnce = PTHREAD_ONCE_INIT };
+
+static void conns_register(BrokerConn *c)
+{
+    c->epoch = atomic_fetch_add(&g_conns.epoch, 1) + 1;
+    atomic_store(&c->lastSeenNs, tpuNowNs());
+    pthread_mutex_lock(&g_conns.lock);
+    c->next = g_conns.head;
+    g_conns.head = c;
+    pthread_mutex_unlock(&g_conns.lock);
+}
+
+static void conns_deregister(BrokerConn *c)
+{
+    pthread_mutex_lock(&g_conns.lock);
+    for (BrokerConn **pp = &g_conns.head; *pp; pp = &(*pp)->next) {
+        if (*pp == c) {
+            *pp = c->next;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_conns.lock);
+}
+
+/* Stale-heartbeat reaper: a client that stops talking for longer than
+ * registry broker_heartbeat_timeout_ms (0 = disabled, the default —
+ * fd hangup already catches process death; the heartbeat catches
+ * WEDGED clients that keep the socket open) gets its socket shut
+ * down, which unblocks conn_thread's read and funnels the connection
+ * through the one reclamation path below. */
+static void *conn_reaper_thread(void *arg)
+{
+    (void)arg;
+    for (;;) {
+        struct timespec ts = { .tv_sec = 0, .tv_nsec = 200 * 1000000L };
+        nanosleep(&ts, NULL);
+        uint64_t timeoutMs = tpuRegistryGet("broker_heartbeat_timeout_ms",
+                                            0);
+        if (!timeoutMs)
+            continue;
+        uint64_t now = tpuNowNs();
+        pthread_mutex_lock(&g_conns.lock);
+        for (BrokerConn *c = g_conns.head; c; c = c->next) {
+            uint64_t last = atomic_load(&c->lastSeenNs);
+            if (now - last > timeoutMs * 1000000ull) {
+                tpuCounterAdd("broker_heartbeat_reaps", 1);
+                tpuLog(TPU_LOG_WARN, "broker",
+                       "reaping stale client pid %d (silent %llu ms)",
+                       c->peer,
+                       (unsigned long long)((now - last) / 1000000ull));
+                /* Refresh so we shut down once; the read error path
+                 * does the actual teardown. */
+                atomic_store(&c->lastSeenNs, now);
+                shutdown(c->sock, SHUT_RDWR);
+            }
+        }
+        pthread_mutex_unlock(&g_conns.lock);
+    }
+    return NULL;
+}
+
+static void conn_reaper_start(void)
+{
+    pthread_t t;
+    if (pthread_create(&t, NULL, conn_reaper_thread, NULL) == 0)
+        pthread_detach(t);
+}
 
 static uint32_t conn_map_client(BrokerConn *c, uint32_t clientH,
                                 bool create)
@@ -405,6 +500,10 @@ static void *ev_forwarder(void *arg)
     BrokerConn *c = es->conn;
     TpuOsEvent *priv = &c->evPriv[es->slot];
     TpuOsEvent *pub = &c->evShared[es->slot];
+    /* Registration epoch: re-validated before every publish so a
+     * forwarder that outlives its registration can never deliver into
+     * a recycled slot (see BrokerEvSlot.epoch). */
+    uint64_t myEpoch = atomic_load(&es->epoch);
     /* Start from the CURRENT count: a reused slot's counters carry the
      * previous occupant's total, which must not replay as spurious
      * deliveries.  Safe because events start DISABLED — nothing fires
@@ -416,6 +515,14 @@ static void *ev_forwarder(void *arg)
         if (cur == seen) {
             br_futex(&priv->signaled, FUTEX_WAIT, cur, &ts);
             continue;
+        }
+        if (atomic_load(&es->epoch) != myEpoch) {
+            /* Invariant guard (see BrokerEvSlot.epoch): unreachable
+             * while stop-then-join holds; a hit means the slot was
+             * recycled under a live forwarder — bail without touching
+             * it, and surface the protocol breach as a counter. */
+            tpuCounterAdd("broker_zombie_doorbells", 1);
+            break;
         }
         /* Completion-ordering: client buffers fill BEFORE the client
          * can observe the notification. */
@@ -704,6 +811,9 @@ static void conn_serve_ioctl(BrokerConn *c, BrokerReq *rq, void *aux,
                     es->slot = (uint32_t)evSlot;
                     es->clientH = real;
                     es->handle = p.hObjectNew;
+                    /* New registration epoch: a zombie forwarder from
+                     * a prior occupancy sees the bump and exits. */
+                    atomic_fetch_add(&es->epoch, 1);
                     atomic_store(&es->stop, false);
                     if (pthread_create(&es->tid, NULL, ev_forwarder,
                                        es) == 0) {
@@ -904,6 +1014,7 @@ static void *conn_thread(void *arg)
         goto out;
 
     while (io_all(c->sock, &rq, sizeof(rq), false) == 0) {
+        atomic_store(&c->lastSeenNs, tpuNowNs());
         if (rq.auxSize > BROKER_MAX_AUX || rq.mainSize > 256)
             break;
         if (rq.auxSize + rq.mainSize &&
@@ -999,6 +1110,10 @@ static void *conn_thread(void *arg)
             rep.mainSize = sizeof(*m);
             break;
         }
+        case BR_OP_PING:
+            /* Heartbeat: lastSeenNs was stamped above; the reply
+             * doubles as the client's liveness probe of the engine. */
+            break;
         default:
             rep.ret = -1;
             rep.err = EINVAL;
@@ -1017,24 +1132,63 @@ static void *conn_thread(void *arg)
     }
 
 out:
-    /* Connection died: stop event forwarders first (they reference the
-     * conn + client memory), then free its RM clients (rs_server frees
-     * clients of dead processes) and release shadows + fds. */
-    for (int i = 0; i < BROKER_EV_SLOTS; i++)
+    /* Connection died: reclaim EVERYTHING the client pinned, charged
+     * or registered (the reference frees dead processes' clients the
+     * same way — rs_server client teardown).  Deregister from the
+     * reaper's view first so nothing observes the conn mid-teardown,
+     * then: stop event forwarders (they reference the conn + client
+     * memory), unregister engine-global CXL buffers (their PINS belong
+     * to no RM client — a dead client would strand them forever), free
+     * its RM clients (cascading RM object teardown), close its pseudo
+     * fds (uvm fds free their VA spaces, which uncharges tenant pages
+     * and returns PMM pages), and release shadows.  All counted, so a
+     * fleet can alarm on reclamation volume. */
+    conns_deregister(c);
+    bool abnormal = false;
+    for (int i = 0; i < BROKER_EV_SLOTS; i++) {
+        if (c->evSlots[i].used)
+            abnormal = true;
         conn_ev_slot_stop(&c->evSlots[i]);
+    }
+    for (int i = 0; i < BROKER_MAX_SHADOWS; i++) {
+        if (!c->shadows[i].used)
+            continue;
+        abnormal = true;
+        /* The registration is engine-global (tpuCxlRegister), NOT a
+         * child of the client root: reclaim its pin explicitly. */
+        if (tpuCxlUnregister(c->shadows[i].handle) == TPU_OK) {
+            tpuCounterAdd("broker_reclaimed_pins", 1);
+            tpuCounterAdd("broker_reclaimed_pin_bytes",
+                          c->shadows[i].size);
+        }
+    }
     for (int i = 0; i < BROKER_MAX_CLIENTS_PER_CONN; i++) {
         if (c->clients[i].used) {
+            abnormal = true;
             TpuRmFreeParams fp = { .hRoot = c->clients[i].realH,
                                    .hObjectOld = c->clients[i].realH };
             tpurmFree(&fp);
+            tpuCounterAdd("broker_reclaimed_clients", 1);
         }
     }
     for (int i = 0; i < BROKER_MAX_SHADOWS; i++)
         if (c->shadows[i].used)
             munmap(c->shadows[i].shadow, c->shadows[i].size);
-    for (int i = 0; i < BROKER_MAX_FDS; i++)
-        if (c->fds[i])
+    for (int i = 0; i < BROKER_MAX_FDS; i++) {
+        if (c->fds[i]) {
+            abnormal = true;
             tpurm_close(c->fds[i]);
+            tpuCounterAdd("broker_reclaimed_fds", 1);
+        }
+    }
+    if (abnormal) {
+        /* Died with live resources: a crash/kill/wedge, not a clean
+         * teardown. */
+        tpuCounterAdd("broker_client_deaths", 1);
+        tpuLog(TPU_LOG_WARN, "broker",
+               "client pid %d died with live resources: reclaimed",
+               c->peer);
+    }
     if (c->evFd >= 0) {
         munmap(c->evShared, BROKER_EV_SLOTS * sizeof(TpuOsEvent));
         free(c->evPriv);
@@ -1074,8 +1228,11 @@ static void *accept_thread(void *arg)
         c->peer = cred.pid;
         c->evFd = -1;
         pthread_mutex_init(&c->dmaLock, NULL);
+        pthread_once(&g_conns.reaperOnce, conn_reaper_start);
+        conns_register(c);
         pthread_t tid;
         if (pthread_create(&tid, NULL, conn_thread, c) != 0) {
+            conns_deregister(c);
             close(s);
             free(c);
             continue;
@@ -1348,6 +1505,17 @@ TpuStatus tpurmBrokerTenantConfigure(uint32_t tenantId, uint32_t priority,
     if (rep.ret < 0)
         return TPU_ERR_OPERATING_SYSTEM;
     return (TpuStatus)m.status;
+}
+
+/* Heartbeat: keeps a legitimately-quiet client out of the stale-
+ * heartbeat reaper's sights (any other request also refreshes). */
+int tpurmBrokerPing(void)
+{
+    BrokerReq rq = { .op = BR_OP_PING };
+    BrokerRep rep;
+    if (cli_call(&rq, NULL, &rep, NULL, 0, NULL) != 0)
+        return -1;
+    return rep.ret;
 }
 
 int tpurmBrokerOpen(const char *path)

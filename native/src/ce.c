@@ -28,6 +28,7 @@
 
 #include "internal.h"
 #include "tpurm/inject.h"
+#include "tpurm/reset.h"
 #include "tpurm/trace.h"
 
 #define TPUCE_MAX_DEVICES 16
@@ -331,6 +332,9 @@ static TpuStatus ce_stripe_push(TpuCeMgr *m, TpuCeStripe *s)
     if (v == 0)
         return TPU_ERR_INVALID_STATE;
     s->val = v;
+    /* Generation stamp: the wait side rejects completions that cross a
+     * full-device reset (tpurm/reset.h fencing contract). */
+    s->gen = tpurmDeviceGeneration();
     atomic_fetch_add_explicit(&m->ch[s->chIdx].outstanding, s->len,
                               memory_order_relaxed);
     if (s->comp & TPU_CE_COMP_FMT_MASK) {
@@ -368,8 +372,14 @@ static TpuStatus ce_stripe_submit(TpuCeMgr *m, TpuCeStripe *s)
  * bounded retry (RC reset-and-replay + backoff, counted), then — for
  * compressed stripes — one recovered lossless pass before giving up.
  * Exact invariant: each ce.copy inject hit bumps exactly one of
- * tpuce_inject_retries / tpuce_inject_errors. */
-static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s)
+ * tpuce_inject_retries / tpuce_inject_errors.  deadlineNs != 0 caps
+ * the recovery: once past it, no more retries (fail fast — counted).
+ * A completion whose submission crossed a full-device reset is STALE:
+ * rejected and replayed against the new generation (the reset's
+ * quiesce drained everything it could wait for; only hung work gets
+ * here). */
+static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s,
+                                    uint64_t deadlineNs)
 {
     uint32_t lim = ce_retry_max();
     for (;;) {
@@ -381,11 +391,23 @@ static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s)
             s->val = 0;
             /* A wait-side failure is the channel's, not injection's. */
             s->injected = false;
+            if (st == TPU_OK && s->gen != tpurmDeviceGeneration()) {
+                /* Stale completion across a reset: replay the stripe
+                 * (idempotent copy) rather than trusting it. */
+                tpuCounterAdd("tpuce_stale_completions", 1);
+                st = TPU_ERR_DEVICE_RESET;
+            }
         } else {
             st = s->subSt;
         }
         if (st == TPU_OK)
             return TPU_OK;
+        if (deadlineNs && tpuNowNs() > deadlineNs && s->attempts < lim) {
+            /* Deadline expired mid-recovery: stop retrying (the hung-op
+             * ladder owns anything still wedged in the engine). */
+            tpuCounterAdd("tpuce_deadline_expired", 1);
+            s->attempts = lim;
+        }
         if (s->attempts < lim) {
             s->attempts++;
             tpuCounterAdd("tpuce_retries", 1);
@@ -422,6 +444,14 @@ static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s)
                         &m->ch[s->chIdx].outstanding, s->len,
                         memory_order_relaxed);
                     s->val = 0;
+                    /* Same generation fence as the primary wait: a
+                     * fallback completion crossing a device reset is
+                     * just as stale — retry against the new gen. */
+                    if (st == TPU_OK &&
+                        s->gen != tpurmDeviceGeneration()) {
+                        tpuCounterAdd("tpuce_stale_completions", 1);
+                        st = TPU_ERR_DEVICE_RESET;
+                    }
                     if (st == TPU_OK)
                         return TPU_OK;
                 }
@@ -452,7 +482,14 @@ TpuStatus tpuCeBatchBegin(TpuCeMgr *m, TpuCeBatch *b)
     b->m = m;
     b->n = 0;
     b->st = TPU_OK;
+    b->deadlineNs = 0;
     return TPU_OK;
+}
+
+void tpuCeBatchSetDeadline(TpuCeBatch *b, uint64_t deadlineNs)
+{
+    if (b)
+        b->deadlineNs = deadlineNs;
 }
 
 TpuStatus tpuCeBatchWait(TpuCeBatch *b)
@@ -460,7 +497,8 @@ TpuStatus tpuCeBatchWait(TpuCeBatch *b)
     if (!b || !b->m)
         return TPU_ERR_INVALID_ARGUMENT;
     for (uint32_t i = 0; i < b->n; i++) {
-        TpuStatus st = ce_stripe_complete(b->m, &b->stripes[i]);
+        TpuStatus st = ce_stripe_complete(b->m, &b->stripes[i],
+                                          b->deadlineNs);
         if (st != TPU_OK && b->st == TPU_OK)
             b->st = st;
     }
@@ -575,7 +613,7 @@ TpuStatus tpuCeBatchHandoff(TpuCeBatch *b, TpuTracker *t)
             /* Never submitted (injected/transient at submit): one
              * recovered completion now — a dependency that does not
              * exist cannot be handed off. */
-            TpuStatus cs = ce_stripe_complete(b->m, s);
+            TpuStatus cs = ce_stripe_complete(b->m, s, b->deadlineNs);
             if (cs != TPU_OK && st == TPU_OK)
                 st = cs;
             continue;
@@ -631,4 +669,16 @@ TpuStatus tpuCeMgrDrain(TpuCeMgr *m)
             st = ws;
     }
     return st;
+}
+
+/* Reset-quiesce helper (internal.h): drain every instantiated manager.
+ * Managers are lazy — uninstantiated devices have nothing in flight. */
+void tpuCeDrainAll(void)
+{
+    for (uint32_t d = 0; d < TPUCE_MAX_DEVICES; d++) {
+        TpuCeMgr *m = atomic_load_explicit(&g_ce.mgr[d],
+                                           memory_order_acquire);
+        if (m)
+            tpuCeMgrDrain(m);
+    }
 }

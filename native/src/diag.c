@@ -257,6 +257,14 @@ void tpuRegistryBump(void)
     atomic_fetch_add_explicit(&g_registry_gen, 1, memory_order_acq_rel);
 }
 
+/* getenv/setenv are not thread-safe against each other, and the
+ * registry is read from BACKGROUND threads (rc + reset watchdogs poll
+ * their knobs every period).  One process lock covers every registry
+ * read plus tpuRegistrySet, the sanctioned runtime-flip API — code
+ * that mutates TPUMEM_* at runtime must go through it (tests that
+ * setenv before threads exist are fine). */
+static pthread_mutex_t g_registryLock = PTHREAD_MUTEX_INITIALIZER;
+
 uint64_t tpuRegistryGet(const char *key, uint64_t defval)
 {
     char envName[96] = "TPUMEM_";
@@ -267,15 +275,32 @@ uint64_t tpuRegistryGet(const char *key, uint64_t defval)
     }
     envName[j] = '\0';
 
+    pthread_mutex_lock(&g_registryLock);
     const char *val = getenv(envName);
-    if (!val || !*val)
-        return defval;
-    errno = 0;
-    char *end = NULL;
-    uint64_t parsed = strtoull(val, &end, 0);
-    if (errno != 0 || end == val)
-        return defval;
-    return parsed;
+    uint64_t out = defval;
+    if (val && *val) {
+        errno = 0;
+        char *end = NULL;
+        uint64_t parsed = strtoull(val, &end, 0);
+        if (errno == 0 && end != val)
+            out = parsed;
+    }
+    pthread_mutex_unlock(&g_registryLock);
+    return out;
+}
+
+/* Runtime knob flip: setenv under the registry lock (ordered against
+ * every watchdog's poll), then bump the generation so TpuRegCache
+ * sites re-resolve.  value == NULL unsets. */
+void tpuRegistrySet(const char *key, const char *value)
+{
+    pthread_mutex_lock(&g_registryLock);
+    if (value)
+        setenv(key, value, 1);
+    else
+        unsetenv(key);
+    pthread_mutex_unlock(&g_registryLock);
+    tpuRegistryBump();
 }
 
 /* ----------------------------------------------------- lock-order tracker */
@@ -338,6 +363,7 @@ const char *tpuStatusToString(TpuStatus status)
     case TPU_ERR_PAGE_QUARANTINED:       return "PAGE_QUARANTINED";
     case TPU_ERR_RETRAIN_FAILED:         return "RETRAIN_FAILED";
     case TPU_ERR_RETRY_EXHAUSTED:        return "RETRY_EXHAUSTED";
+    case TPU_ERR_DEVICE_RESET:           return "DEVICE_RESET";
     default:                             return "UNKNOWN";
     }
 }

@@ -185,6 +185,36 @@ TpuStatus tpuIciTrainLinks(uint32_t devInst)
     return TPU_OK;
 }
 
+/* Full-device reset hook (internal.h): retrain every device's links —
+ * the reference RC path retrains NVLink after a GPU reset the same
+ * way (nvlink_lib_mgmt.c re-init sequences).  Returns links ACTIVE
+ * after the pass; each pass is counted so the reset MTTR can be
+ * decomposed. */
+uint32_t tpuIciRetrainAll(void)
+{
+    tpuIciInit();
+    uint32_t active = 0;
+    pthread_mutex_lock(&g_ici.lock);
+    for (uint32_t d = 0; d < g_ici.count; d++) {
+        /* Admin link failures are sticky "until reset" — this IS the
+         * reset: FAILED drops to DOWN so the training pass below can
+         * bring the link back (matching tpuIciResetLink per link). */
+        for (uint32_t l = 0; l < g_ici.linkCount[d]; l++)
+            if (g_ici.links[d][l].state == TPU_ICI_LINK_FAILED)
+                g_ici.links[d][l].state = TPU_ICI_LINK_DOWN;
+    }
+    for (uint32_t d = 0; d < g_ici.count; d++) {
+        train_links_locked(d);
+        for (uint32_t l = 0; l < g_ici.linkCount[d]; l++)
+            if (g_ici.links[d][l].state == TPU_ICI_LINK_ACTIVE)
+                active++;
+    }
+    pthread_mutex_unlock(&g_ici.lock);
+    if (g_ici.count > 0)
+        tpuCounterAdd("ici_reset_retrains", 1);
+    return active;
+}
+
 TpuStatus tpuIciInjectLinkFailure(uint32_t devInst, uint32_t link)
 {
     tpuIciInit();

@@ -53,6 +53,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "memring.submit",
     "ce.copy",
     "sched.admit",
+    "reset.device",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -67,6 +68,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "MEMRING_SUBMIT",
     "CE_COPY",
     "SCHED_ADMIT",
+    "RESET_DEVICE",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)

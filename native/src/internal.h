@@ -127,6 +127,15 @@ uint64_t tpuRegistryGet(const char *key, uint64_t defval);
  * (NVreg_* parsed at module load). */
 uint64_t tpuRegistryGen(void);
 void tpuRegistryBump(void);
+/* Runtime knob flip: setenv/unsetenv (value NULL) under the registry
+ * lock + generation bump — the only safe way to rewrite TPUMEM_* once
+ * background pollers (rc/reset watchdogs) exist.  NOTE the asymmetry
+ * with tpuRegistryGet: Get takes the bare key and prefixes/upcases it
+ * ("reset_hang_timeout_ms" -> TPUMEM_RESET_HANG_TIMEOUT_MS); Set takes
+ * the FULL environment-variable name verbatim, because callers also
+ * use it for non-registry env (and the bare-key spelling would
+ * silently set a name no reader consults). */
+void tpuRegistrySet(const char *key, const char *value);
 
 typedef struct {
     _Atomic uint64_t gen;             /* registry gen + 1; 0 = empty */
@@ -344,6 +353,9 @@ int  tpurmBrokerOpen(const char *path);
 int  tpurmBrokerClose(int fd);
 int  tpurmBrokerIoctl(int fd, unsigned long request, void *argp);
 bool tpurmBrokerIsRemoteFd(int fd);
+/* Heartbeat round trip (stale-client reaper: registry
+ * broker_heartbeat_timeout_ms). */
+int  tpurmBrokerPing(void);
 
 /* ------------------------------------------------- robust channel RC */
 
@@ -391,5 +403,33 @@ void tpuCeXformExec(uint32_t xform, void *dst, const void *src,
  * its ce.stripe trace spans with ceIdx.  NULL counters detach. */
 void tpurmChannelSetCeAcct(TpurmChannel *ch, _Atomic uint64_t *bytesCtr,
                            _Atomic uint64_t *busyCtr, uint32_t ceIdx);
+
+/* ------------------------------------------------------------ tpureset
+ *
+ * Cross-module hooks the full-device reset engine (reset.c, public
+ * surface in tpurm/reset.h) uses to quiesce and monitor the pools. */
+
+/* Park every memring worker pool: no new SQE claims; waits (bounded)
+ * for claimed ops to retire.  Published-but-unclaimed SQEs stay queued
+ * and re-issue after unpark (idempotent replay).  TPU_OK when all
+ * in-flight work drained inside timeoutNs, TPU_ERR_RETRY_EXHAUSTED
+ * when something is still in flight (hung — the caller proceeds and
+ * generation fencing rejects the zombie completion). */
+TpuStatus tpurmMemringParkAll(uint64_t timeoutNs);
+void      tpurmMemringUnparkAll(void);
+
+/* Hung-op watchdog scan: for every ring with in-flight work and no
+ * completion progress for hangNs, take the next escalation-ladder rung
+ * (1 = doorbell nudge, 2 = channel RC reset, 3 = request a full device
+ * reset — performed by the CALLER; the ladder saturates afterwards
+ * until the ring progresses).  Returns the highest rung taken. */
+uint32_t  tpurmMemringWatchdogScan(uint64_t hangNs);
+
+/* Drain every device's tpuce manager (fence semantics per manager). */
+void tpuCeDrainAll(void);
+
+/* Retrain every device's ICI links (reset phase); returns links that
+ * ended ACTIVE.  Counted as ici_reset_retrains. */
+uint32_t tpuIciRetrainAll(void);
 
 #endif /* TPURM_INTERNAL_H */

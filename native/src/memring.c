@@ -44,6 +44,7 @@
 #include "internal.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
+#include "tpurm/reset.h"
 #include "tpurm/trace.h"
 #include "tpurm/uvm.h"
 
@@ -93,7 +94,24 @@ struct TpuMemring {
     pthread_t workers[MEMRING_MAX_WORKERS];
     uint32_t workerCount;
     _Atomic bool shutdown;
+
+    /* Reset/watchdog plumbing (tpurm/reset.h): rings register in a
+     * process-global list so a full-device reset can park every pool
+     * and the hung-op watchdog can scan for stalls. */
+    struct TpuMemring *next;          /* g_mrings list (under its lock) */
+    _Atomic uint64_t lastProgressNs;  /* claim or CQE-post timestamp    */
+    _Atomic uint32_t wdRung;          /* escalation-ladder position     */
 };
+
+/* Process-global ring registry + park gate.  `parked` stops NEW claims
+ * (workers spin-park between batches); in-flight claims drain.  The
+ * parkWord futex wakes parked workers on unpark. */
+static struct {
+    pthread_mutex_t lock;
+    struct TpuMemring *head;
+    _Atomic int parked;
+    _Atomic uint32_t parkWord;
+} g_mrings = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
 static long mr_futex(TPU_MEMRING_ATOMIC_U32 *uaddr, int op, uint32_t val,
                      const struct timespec *ts)
@@ -114,8 +132,21 @@ static uint32_t pow2_at_least(uint32_t v, uint32_t floor)
 
 static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
                      TpuStatus st, uint64_t bytes, uint64_t seq,
-                     uint64_t t0, uint64_t t1, bool countInflight)
+                     uint64_t t0, uint64_t t1, bool countInflight,
+                     uint64_t claimGen)
 {
+    /* Generation fence: a completion whose claim predates a full-device
+     * reset is STALE — quiesce waited for in-flight work, so the only
+     * way here is an op quiesce timed out on (hung/wedged).  Its result
+     * must not read as valid post-reset state: surface DEVICE_RESET so
+     * the consumer re-issues against the new generation.  claimGen 0 is
+     * exempt (fence CQEs carry no engine result). */
+    if (claimGen && claimGen != tpurmDeviceGeneration()) {
+        st = TPU_ERR_DEVICE_RESET;
+        bytes = 0;
+        tpuCounterAdd("memring_stale_completions", 1);
+    }
+    atomic_store_explicit(&r->lastProgressNs, t1, memory_order_relaxed);
     pthread_mutex_lock(&r->cqLock);
     uint32_t head = atomic_load_explicit(&r->hdr->cqHead,
                                          memory_order_acquire);
@@ -210,6 +241,20 @@ static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
     *bytesOut = 0;
     switch (sqe->opcode) {
     case TPU_MEMRING_OP_NOP:
+        /* arg1 = execution delay in ns: the deterministic hung-op used
+         * by the watchdog/reset tests (capped; sliced so a ring destroy
+         * is never held hostage by a parked delay). */
+        if (sqe->arg1) {
+            uint64_t left = sqe->arg1 > 10000000000ull ? 10000000000ull
+                                                       : sqe->arg1;
+            while (left && !atomic_load(&r->shutdown)) {
+                uint64_t slice = left > 10000000ull ? 10000000ull : left;
+                struct timespec ts = { .tv_sec = 0,
+                                       .tv_nsec = (long)slice };
+                nanosleep(&ts, NULL);
+                left -= slice;
+            }
+        }
         return TPU_OK;
     case TPU_MEMRING_OP_MIGRATE: {
         if (!r->vs)
@@ -367,16 +412,38 @@ static bool run_merges(const TpuMemringSqe *a, uint64_t runEnd,
         return false;
     if (b->dstTier != a->dstTier || b->devInst != a->devInst)
         return false;
+    /* Deadlines stay per-run homogeneous so expiry applies whole-run. */
+    if (b->deadlineNs != a->deadlineNs)
+        return false;
     return b->addr == runEnd;      /* virtually contiguous */
+}
+
+/* Deadline check: an op claimed past its SQE deadline fails fast
+ * (counted) instead of occupying a worker — the watchdog ladder covers
+ * ops that hang INSIDE the engine. */
+static bool sqe_deadline_expired(const TpuMemringSqe *sqe, uint64_t now)
+{
+    if (sqe->deadlineNs && now > sqe->deadlineNs) {
+        tpuCounterAdd("memring_deadline_expired", 1);
+        return true;
+    }
+    return false;
 }
 
 /* Execute batch[0..n) (no links, no fences): coalesce contiguous
  * compatible spans, run each merged span once, post per-SQE CQEs. */
 static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
-                       uint32_t n, uint64_t firstSeq)
+                       uint32_t n, uint64_t firstSeq, uint64_t claimGen)
 {
     uint32_t i = 0;
     while (i < n) {
+        if (sqe_deadline_expired(&batch[i], tpuNowNs())) {
+            uint64_t now = tpuNowNs();
+            post_cqe(r, &batch[i], TPU_ERR_RETRY_EXHAUSTED, 0,
+                     firstSeq + i, now, now, true, claimGen);
+            i++;
+            continue;
+        }
         uint32_t runLen = 1;
         uint64_t spanLen = batch[i].len;
         while (i + runLen < n &&
@@ -409,14 +476,14 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
                      st != TPU_OK ? 0
                                   : (runLen > 1 ? batch[i + k].len
                                                 : moved),
-                     firstSeq + i + k, t0, t1, true);
+                     firstSeq + i + k, t0, t1, true, claimGen);
         i += runLen;
     }
 }
 
 /* Execute a LINK chain sequentially; first failure cancels the rest. */
 static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
-                       uint32_t n, uint64_t firstSeq)
+                       uint32_t n, uint64_t firstSeq, uint64_t claimGen)
 {
     bool cancelled = false;
     for (uint32_t i = 0; i < n; i++) {
@@ -424,10 +491,16 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
             uint64_t now = tpuNowNs();
             tpuCounterAdd("memring_links_cancelled", 1);
             post_cqe(r, &chain[i], TPU_ERR_INVALID_STATE, 0,
-                     firstSeq + i, now, now, true);
+                     firstSeq + i, now, now, true, claimGen);
             continue;
         }
         uint64_t t0 = tpuNowNs();
+        if (sqe_deadline_expired(&chain[i], t0)) {
+            post_cqe(r, &chain[i], TPU_ERR_RETRY_EXHAUSTED, 0,
+                     firstSeq + i, t0, t0, true, claimGen);
+            cancelled = true;      /* chain semantics: failure cancels */
+            continue;
+        }
         uint64_t moved = 0;
         bool injectedFail = false;
         uint64_t tSpan = tpurmTraceBegin();
@@ -440,7 +513,7 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
         if (injectedFail)
             tpuCounterAdd("memring_inject_error_cqes", 1);
         post_cqe(r, &chain[i], st, moved, firstSeq + i, t0, tpuNowNs(),
-                 true);
+                 true, claimGen);
         if (st != TPU_OK)
             cancelled = true;
     }
@@ -452,6 +525,22 @@ static void *worker_main(void *arg)
     TpuMemringSqe local[MEMRING_POP_BATCH];
 
     for (;;) {
+        /* Reset park gate: while a full-device reset is quiescing or
+         * running, workers make no NEW claims (published SQEs stay
+         * queued and replay after unpark).  Parked workers wait on the
+         * global parkWord futex; unpark bumps + wakes it. */
+        while (atomic_load_explicit(&g_mrings.parked,
+                                    memory_order_acquire) &&
+               !atomic_load(&r->shutdown)) {
+            uint32_t pw = atomic_load(&g_mrings.parkWord);
+            if (atomic_load_explicit(&g_mrings.parked,
+                                     memory_order_acquire) &&
+                !atomic_load(&r->shutdown)) {
+                struct timespec ts = { .tv_sec = 0,
+                                       .tv_nsec = 50 * 1000 * 1000 };
+                mr_futex(&g_mrings.parkWord, FUTEX_WAIT, pw, &ts);
+            }
+        }
         pthread_mutex_lock(&r->popLock);
         uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
                                              memory_order_relaxed);
@@ -503,7 +592,7 @@ static void *worker_main(void *arg)
             pthread_mutex_unlock(&r->popLock);
             uint64_t now = tpuNowNs();
             tpuCounterAdd("memring_fences", 1);
-            post_cqe(r, &fence, TPU_OK, 0, seq, now, now, false);
+            post_cqe(r, &fence, TPU_OK, 0, seq, now, now, false, 0);
             continue;
         }
 
@@ -534,12 +623,18 @@ static void *worker_main(void *arg)
         atomic_fetch_add(&r->inflight, n);
         atomic_store_explicit(&r->hdr->sqHead, head + n,
                               memory_order_release);
+        /* Claim-time generation: post_cqe fences completions whose
+         * claim crossed a device reset.  Stamped under popLock so the
+         * park/drain in tpurmMemringParkAll orders against it. */
+        uint64_t claimGen = tpurmDeviceGeneration();
+        atomic_store_explicit(&r->lastProgressNs, tpuNowNs(),
+                              memory_order_relaxed);
         pthread_mutex_unlock(&r->popLock);
 
         if (chain)
-            exec_chain(r, local, n, firstSeq);
+            exec_chain(r, local, n, firstSeq, claimGen);
         else
-            exec_batch(r, local, n, firstSeq);
+            exec_batch(r, local, n, firstSeq, claimGen);
     }
     return NULL;
 }
@@ -612,6 +707,12 @@ TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
             return TPU_ERR_OPERATING_SYSTEM;
         }
     }
+    atomic_store_explicit(&r->lastProgressNs, tpuNowNs(),
+                          memory_order_relaxed);
+    pthread_mutex_lock(&g_mrings.lock);
+    r->next = g_mrings.head;
+    g_mrings.head = r;
+    pthread_mutex_unlock(&g_mrings.lock);
     tpuCounterAdd("memring_rings_created", 1);
     tpuLog(TPU_LOG_INFO, "memring",
            "ring created: sq=%u cq=%u workers=%u", sqEntries, cqEntries,
@@ -624,7 +725,21 @@ void tpurmMemringDestroy(TpuMemring *r)
 {
     if (!r)
         return;
+    /* Deregister first: the reset/watchdog scans must never observe a
+     * ring mid-teardown. */
+    pthread_mutex_lock(&g_mrings.lock);
+    for (TpuMemring **pp = &g_mrings.head; *pp; pp = &(*pp)->next) {
+        if (*pp == r) {
+            *pp = r->next;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_mrings.lock);
     atomic_store(&r->shutdown, true);
+    /* Parked workers sit on the global parkWord (timed): wake them so
+     * shutdown is prompt even mid-reset. */
+    atomic_fetch_add(&g_mrings.parkWord, 1);
+    mr_futex(&g_mrings.parkWord, FUTEX_WAKE, INT32_MAX, NULL);
     /* Wake sleepers: poppers on the doorbell, drain-waiters on cond. */
     atomic_fetch_add(&r->hdr->doorbell, 1);
     mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
@@ -843,4 +958,108 @@ void tpurmMemringCounts(TpuMemring *r, uint64_t *submitted,
 int tpurmMemringShmFd(TpuMemring *r)
 {
     return r ? r->shmFd : -1;
+}
+
+/* -------------------------------------------------- reset / watchdog */
+
+/* Park every worker pool (internal.h contract).  Claims that slipped
+ * past the gate drain through the bounded wait below; published-but-
+ * unclaimed SQEs stay queued for post-reset replay. */
+TpuStatus tpurmMemringParkAll(uint64_t timeoutNs)
+{
+    atomic_store_explicit(&g_mrings.parked, 1, memory_order_release);
+    uint64_t deadline = tpuNowNs() + timeoutNs;
+    for (;;) {
+        uint32_t busy = 0;
+        pthread_mutex_lock(&g_mrings.lock);
+        for (TpuMemring *r = g_mrings.head; r; r = r->next)
+            busy += atomic_load(&r->inflight);
+        pthread_mutex_unlock(&g_mrings.lock);
+        if (busy == 0)
+            return TPU_OK;
+        if (tpuNowNs() >= deadline) {
+            tpuCounterAdd("memring_park_timeouts", 1);
+            tpuLog(TPU_LOG_WARN, "memring",
+                   "park: %u op(s) still in flight at timeout (hung — "
+                   "their completions will be generation-fenced)", busy);
+            return TPU_ERR_RETRY_EXHAUSTED;
+        }
+        struct timespec ts = { .tv_sec = 0, .tv_nsec = 200 * 1000 };
+        nanosleep(&ts, NULL);
+    }
+}
+
+void tpurmMemringUnparkAll(void)
+{
+    atomic_store_explicit(&g_mrings.parked, 0, memory_order_release);
+    atomic_fetch_add(&g_mrings.parkWord, 1);
+    mr_futex(&g_mrings.parkWord, FUTEX_WAKE, INT32_MAX, NULL);
+    /* Re-ring every doorbell: SQEs published while parked must not
+     * wait for the next submit's wake. */
+    pthread_mutex_lock(&g_mrings.lock);
+    for (TpuMemring *r = g_mrings.head; r; r = r->next) {
+        atomic_fetch_add(&r->hdr->doorbell, 1);
+        mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+    }
+    pthread_mutex_unlock(&g_mrings.lock);
+}
+
+/* Hung-op watchdog scan (internal.h contract): escalation ladder per
+ * stalled ring, saturating after the device-reset rung until the ring
+ * progresses again. */
+uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
+{
+    uint32_t maxRung = 0;
+    uint64_t now = tpuNowNs();
+    /* Never escalate while parked: a reset in flight stalls rings by
+     * design. */
+    if (atomic_load_explicit(&g_mrings.parked, memory_order_acquire))
+        return 0;
+    pthread_mutex_lock(&g_mrings.lock);
+    for (TpuMemring *r = g_mrings.head; r; r = r->next) {
+        if (atomic_load(&r->inflight) == 0) {
+            atomic_store(&r->wdRung, 0);
+            continue;
+        }
+        uint64_t last = atomic_load_explicit(&r->lastProgressNs,
+                                             memory_order_relaxed);
+        if (now - last < hangNs) {
+            atomic_store(&r->wdRung, 0);
+            continue;
+        }
+        uint32_t rung = atomic_load(&r->wdRung) + 1;
+        if (rung > 4)
+            rung = 4;                      /* saturated: no storms */
+        atomic_store(&r->wdRung, rung);
+        switch (rung) {
+        case 1:
+            /* A lost wake is the cheapest wedge: re-ring the doorbell
+             * and the drain cond. */
+            tpuCounterAdd("tpurm_watchdog_nudges", 1);
+            atomic_fetch_add(&r->hdr->doorbell, 1);
+            mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+            pthread_mutex_lock(&r->popLock);
+            pthread_cond_broadcast(&r->drainCond);
+            pthread_mutex_unlock(&r->popLock);
+            break;
+        case 2:
+            tpuCounterAdd("tpurm_watchdog_rc_resets", 1);
+            tpuLog(TPU_LOG_WARN, "memring",
+                   "watchdog: ring %p stalled %llu ms — channel RC "
+                   "reset-and-replay", (void *)r,
+                   (unsigned long long)((now - last) / 1000000ull));
+            tpuRcRecoverAll();
+            break;
+        case 3:
+            /* Caller performs the device reset (rung counted there via
+             * tpurm_watchdog_device_resets). */
+            break;
+        default:
+            break;                         /* saturated */
+        }
+        if (rung <= 3 && rung > maxRung)
+            maxRung = rung;
+    }
+    pthread_mutex_unlock(&g_mrings.lock);
+    return maxRung;
 }

@@ -15,6 +15,7 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/reset.h"
 #include "tpurm/trace.h"
 #include "uvm/uvm_internal.h"
 
@@ -184,6 +185,49 @@ static void render_tenants(TpuCur *c)
     uvmTenantRenderTable(c);
 }
 
+/* Reset & recovery: device generation, reset totals/MTTR, the hung-op
+ * escalation-ladder counters, and client-death reclamation. */
+static void render_reset(TpuCur *c)
+{
+    TpuResetStats st;
+    tpurmResetStats(&st);
+    tpuCurf(c, "device_generation:        %llu\n",
+            (unsigned long long)st.generation);
+    tpuCurf(c, "resets_total:             %llu\n",
+            (unsigned long long)st.resets);
+    tpuCurf(c, "resets_failed:            %llu\n",
+            (unsigned long long)st.failedResets);
+    tpuCurf(c, "resets_injected:          %llu\n",
+            (unsigned long long)st.injectedResets);
+    tpuCurf(c, "last_mttr_us:             %llu\n",
+            (unsigned long long)(st.lastMttrNs / 1000));
+    tpuCurf(c, "last_quiesce_us:          %llu\n",
+            (unsigned long long)(st.lastQuiesceNs / 1000));
+    tpuCurf(c, "last_restore_us:          %llu\n",
+            (unsigned long long)(st.lastRestoreNs / 1000));
+    tpuCurf(c, "mttr_sum_us:              %llu\n",
+            (unsigned long long)(st.mttrSumNs / 1000));
+    tpuCurf(c, "stale_completions:        %llu\n",
+            (unsigned long long)st.staleCompletions);
+    tpuCurf(c, "watchdog_nudges:          %llu\n",
+            (unsigned long long)st.watchdogNudges);
+    tpuCurf(c, "watchdog_rc_resets:       %llu\n",
+            (unsigned long long)st.watchdogRcResets);
+    tpuCurf(c, "watchdog_device_resets:   %llu\n",
+            (unsigned long long)st.watchdogDeviceResets);
+    tpuCurf(c, "rc_device_escalations:    %llu\n",
+            (unsigned long long)tpurmCounterGet("rc_device_escalations"));
+    tpuCurf(c, "client_deaths:            %llu\n",
+            (unsigned long long)tpurmCounterGet("broker_client_deaths"));
+    tpuCurf(c, "heartbeat_reaps:          %llu\n",
+            (unsigned long long)tpurmCounterGet("broker_heartbeat_reaps"));
+    tpuCurf(c, "reclaimed_cxl_pins:       %llu\n",
+            (unsigned long long)tpurmCounterGet("broker_reclaimed_pins"));
+    tpuCurf(c, "reclaimed_clients:        %llu\n",
+            (unsigned long long)
+                tpurmCounterGet("broker_reclaimed_clients"));
+}
+
 /* ---------------------------------------------------------- node table */
 
 typedef struct {
@@ -203,6 +247,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm/journal", render_journal, true },
     { "driver/tpurm/metrics", render_metrics, false },
     { "driver/tpurm/tenants", render_tenants, false },
+    { "driver/tpurm/reset", render_reset, false },
 };
 
 #define N_NODES (sizeof(g_nodes) / sizeof(g_nodes[0]))

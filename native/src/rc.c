@@ -30,6 +30,7 @@
 #include "internal.h"
 #include "tpurm/msgq.h"
 #include "uvm/uvm_internal.h"   /* uvmMonotonicNs */
+#include "tpurm/reset.h"
 #include "tpurm/trace.h"
 
 #include <stdatomic.h>
@@ -47,6 +48,7 @@ typedef struct RcChannel {
     uint64_t lastCompleted;
     uint64_t stuckSinceNs;       /* 0 = progressing */
     bool barked;                 /* one watchdog fault per stall */
+    bool escalated;              /* one device-reset escalation per stall */
     struct RcChannel *next;
 } RcChannel;
 
@@ -122,6 +124,14 @@ static void *rc_watchdog_thread(void *arg)
         if (!tpuRegistryGet("rc_watchdog_enable", 1))
             continue;
 
+        /* Optional last rung above the per-channel bark: a channel
+         * still frozen this long AFTER its watchdog fault escalates to
+         * a FULL DEVICE RESET (tpurm/reset.h).  Off by default — the
+         * bark + RC policy handle channel-scoped stalls; the ladder is
+         * for operators who want the reference's "lose the channel,
+         * then lose the GPU, never the process" end-to-end. */
+        uint64_t escalateMs = tpuRegistryGet("rc_escalate_device_ms", 0);
+        bool escalate = false;
         uint64_t now = uvmMonotonicNs();
         pthread_mutex_lock(&g_rc.chLock);
         for (RcChannel *rc = g_rc.channels; rc; rc = rc->next) {
@@ -131,6 +141,7 @@ static void *rc_watchdog_thread(void *arg)
                 rc->lastCompleted = completed;
                 rc->stuckSinceNs = 0;
                 rc->barked = false;
+                rc->escalated = false;
                 continue;
             }
             if (rc->stuckSinceNs == 0) {
@@ -143,8 +154,22 @@ static void *rc_watchdog_thread(void *arg)
                 tpuRcPostFault(rc->ch, rc->rcId, completed,
                                TPU_RC_WATCHDOG_TIMEOUT);
             }
+            if (escalateMs && rc->barked && !rc->escalated &&
+                now - rc->stuckSinceNs >
+                    (timeoutMs + escalateMs) * 1000000ull) {
+                rc->escalated = true;
+                escalate = true;
+            }
         }
         pthread_mutex_unlock(&g_rc.chLock);
+        if (escalate) {
+            /* Outside chLock: the reset's RC recovery walks channels. */
+            tpuCounterAdd("rc_device_escalations", 1);
+            tpuLog(TPU_LOG_ERROR, "rc",
+                   "channel stall outlived its watchdog fault: "
+                   "escalating to full-device reset");
+            tpurmDeviceReset();
+        }
     }
     return NULL;
 }
@@ -175,6 +200,9 @@ static void rc_init_once(void)
         return;
     }
     g_rc.ready = true;
+    /* The hung-op/reset watchdog rides the same lifecycle: any process
+     * that creates a channel is covered by the full ladder. */
+    tpurmResetWatchdogStart();
     tpuLog(TPU_LOG_INFO, "rc", "robust-channel recovery ready "
            "(shadow buffer + watchdog)");
 }

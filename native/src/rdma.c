@@ -422,6 +422,45 @@ int tpuIbMrValid(TpuIbMr *mr)
     return mr ? atomic_load(&mr->valid) : 0;
 }
 
+/* Full-device reset hook (rdma.h contract): re-run dmaMap on every
+ * live, still-valid MR so the IOVA tables reflect post-reset device
+ * state.  Runs under g_mrLock — dereg unlinks under the same lock, so
+ * an MR observed here cannot be torn down mid-revalidation (the same
+ * ordering argument as ib_invalidate). */
+uint32_t tpuIbMrRevalidateAll(void)
+{
+    uint32_t ok = 0;
+    pthread_mutex_lock(&g_mrLock);
+    for (TpuIbMr *mr = g_mrLive; mr; mr = mr->nextLive) {
+        if (!atomic_load(&mr->valid) || !mr->dmaMapped)
+            continue;
+        TpuStatus st = mr->client->dmaMap(mr->clientCtx, mr->nicId,
+                                          &mr->devInst, &mr->pageSize,
+                                          &mr->entries, &mr->iova);
+        if (st == TPU_OK) {
+            ok++;
+            tpuCounterAdd("rdma_mrs_revalidated", 1);
+        } else {
+            /* A pin that cannot re-establish is revoked exactly like a
+             * mid-MR free: flip valid, publish through the control
+             * page, wake the consumer. */
+            atomic_store(&mr->valid, 0);
+            if (mr->ctrl) {
+                atomic_store(&mr->ctrl->revoked, 1);
+                syscall(SYS_futex, &mr->ctrl->revoked, FUTEX_WAKE,
+                        INT32_MAX, NULL, NULL, 0);
+            }
+            tpuCounterAdd("rdma_reset_revocations", 1);
+            tpuCounterAdd("ib_mr_invalidations", 1);
+            tpuLog(TPU_LOG_WARN, "rdma",
+                   "MR revoked at device reset (re-pin failed: %s)",
+                   tpuStatusToString(st));
+        }
+    }
+    pthread_mutex_unlock(&g_mrLock);
+    return ok;
+}
+
 TpuStatus tpuIbMrDescribe(TpuIbMr *mr, int *outArenaFd, int *outCtrlFd,
                           uint32_t *outPageSize, uint32_t *outEntries,
                           const uint64_t **outIova)

@@ -1,0 +1,249 @@
+/*
+ * tpureset — coordinated full-device reset, hung-op watchdog
+ * escalation, and the device-wide generation fence (see
+ * include/tpurm/reset.h for the model and the fencing contract).
+ *
+ * Reference shape (SURVEY layer 3): the RM survives a lost GPU by
+ * tearing the device down and bringing it back — fatal-fault teardown,
+ * fbsr framebuffer save/restore across the reset, NVLink re-init, and
+ * peer-memory revalidation — while UVM's PM lock quiesces every entry
+ * point.  tpureset composes the pieces this stack already has into
+ * that sequence:
+ *
+ *   quiesce  tpurmMemringParkAll   (no new claims; bounded drain)
+ *            uvmSuspend            (PM gate + fault-ring drain + fbsr
+ *                                   save of device residency to host)
+ *            uvmFaultServicePause  (service loop parks between batches)
+ *            tpuCeDrainAll         (copy channels idle)
+ *   reset    generation++          (stale completions now fenced)
+ *            tpuRcRecoverAll       (clear every latched channel error)
+ *            tpuIciRetrainAll      (links DOWN/FAILED -> ACTIVE)
+ *            tpuIbMrRevalidateAll  (re-pin or revoke live MRs)
+ *   resume   uvmFaultServiceResume
+ *            uvmResume             (fbsr restore from host backing)
+ *            tpurmMemringUnparkAll (queued SQEs replay, new generation)
+ *
+ * ORDERING MATTERS: memring workers are PM readers (their ops enter
+ * uvmMigrate/uvmDeviceAccess through the shared PM gate), so they park
+ * FIRST — parking them after taking the gate exclusively would
+ * deadlock a worker blocked at the gate against the suspend waiting
+ * for readers to drain.  The fault loop pauses only after uvmSuspend
+ * drained the ring, so the pause never strands a pre-suspend fault.
+ *
+ * The watchdog thread owns the escalation ladder for hung ops and the
+ * reset.device injection site (one evaluation per tick; a hit is a
+ * forced device-level fatal fault, recovered by a full reset).
+ */
+#define _GNU_SOURCE
+#include "tpurm/reset.h"
+
+#include <pthread.h>
+#include <stdatomic.h>
+#include <time.h>
+
+#include "internal.h"
+#include "tpurm/inject.h"
+#include "tpurm/rdma.h"
+#include "tpurm/trace.h"
+#include "tpurm/uvm.h"
+#include "uvm/uvm_internal.h"
+
+static struct {
+    _Atomic uint64_t generation;
+    pthread_mutex_t lock;            /* serializes whole resets */
+    pthread_cond_t done;
+    bool inProgress;
+
+    _Atomic uint64_t resets, failed, injected;
+    _Atomic uint64_t wdDeviceResets;
+    _Atomic uint64_t lastMttrNs, lastQuiesceNs, lastRestoreNs;
+    _Atomic uint64_t mttrSumNs;
+
+    pthread_once_t wdOnce;
+    bool wdReady;
+} g_reset = { .generation = 1,
+              .lock = PTHREAD_MUTEX_INITIALIZER,
+              .done = PTHREAD_COND_INITIALIZER,
+              .wdOnce = PTHREAD_ONCE_INIT };
+
+uint64_t tpurmDeviceGeneration(void)
+{
+    return atomic_load_explicit(&g_reset.generation,
+                                memory_order_acquire);
+}
+
+/* The three phases, serialized by g_reset.lock (held by the caller). */
+static TpuStatus reset_locked(void)
+{
+    uint64_t quiesceTimeoutNs =
+        tpuRegistryGet("reset_quiesce_timeout_ms", 2000) * 1000000ull;
+    uint64_t t0 = tpuNowNs();
+    uint64_t tSpan = tpurmTraceBegin();
+    uint64_t tQuiesce = tpurmTraceBegin();
+
+    /* ---- quiesce ---- */
+    TpuStatus parkSt = tpurmMemringParkAll(quiesceTimeoutNs);
+    TpuStatus susSt = uvmSuspend();
+    if (susSt == TPU_ERR_INVALID_STATE) {
+        /* The PM gate is already held by an explicit operator suspend:
+         * resetting under them would yank the arenas they froze.  Back
+         * out completely. */
+        tpurmMemringUnparkAll();
+        atomic_fetch_add(&g_reset.failed, 1);
+        tpuCounterAdd("tpurm_reset_failed", 1);
+        tpuLog(TPU_LOG_WARN, "reset",
+               "device reset refused: PM gate held by an explicit "
+               "suspend");
+        return TPU_ERR_INVALID_STATE;
+    }
+    uvmFaultServicePause(quiesceTimeoutNs);
+    tpuCeDrainAll();
+    uint64_t t1 = tpuNowNs();
+    if (tQuiesce)
+        tpurmTraceEnd(TPU_TRACE_RESET_QUIESCE, tQuiesce, 0,
+                      parkSt == TPU_OK ? 0 : 1);
+
+    /* ---- reset ---- */
+    uint64_t gen = atomic_fetch_add_explicit(&g_reset.generation, 1,
+                                             memory_order_acq_rel) + 1;
+    tpuCounterAdd("tpurm_device_generation", 1);   /* gauge-as-counter */
+    uint32_t latches = tpuRcRecoverAll();
+    uint32_t links = tpuIciRetrainAll();
+    uint32_t mrs = tpuIbMrRevalidateAll();
+
+    /* ---- resume ---- */
+    uvmFaultServiceResume();
+    TpuStatus resSt = susSt == TPU_OK ? uvmResume() : susSt;
+    tpurmMemringUnparkAll();
+
+    uint64_t t2 = tpuNowNs();
+    atomic_store(&g_reset.lastQuiesceNs, t1 - t0);
+    atomic_store(&g_reset.lastRestoreNs, t2 - t1);
+    atomic_store(&g_reset.lastMttrNs, t2 - t0);
+    atomic_fetch_add(&g_reset.mttrSumNs, t2 - t0);
+    atomic_fetch_add(&g_reset.resets, 1);
+    tpuCounterAdd("tpurm_reset_total", 1);
+    tpuCounterAdd("tpurm_reset_mttr_ns", t2 - t0);
+    if (tSpan)
+        tpurmTraceEnd(TPU_TRACE_RESET_DEVICE, tSpan, gen, t2 - t0);
+    tpuLog(TPU_LOG_WARN, "reset",
+           "full-device reset complete: gen=%llu mttr=%llu us "
+           "(quiesce %llu us%s, %u latch(es), %u link(s) active, "
+           "%u MR(s) revalidated, resume %s)",
+           (unsigned long long)gen,
+           (unsigned long long)((t2 - t0) / 1000),
+           (unsigned long long)((t1 - t0) / 1000),
+           parkSt == TPU_OK ? "" : " TIMED OUT", latches, links, mrs,
+           tpuStatusToString(resSt));
+    return resSt;
+}
+
+TpuStatus tpurmDeviceReset(void)
+{
+    tpurmResetWatchdogStart();
+    uint64_t genBefore = tpurmDeviceGeneration();
+    pthread_mutex_lock(&g_reset.lock);
+    if (g_reset.inProgress) {
+        /* Coalesce: the in-flight reset IS this caller's recovery. */
+        while (g_reset.inProgress)
+            pthread_cond_wait(&g_reset.done, &g_reset.lock);
+        pthread_mutex_unlock(&g_reset.lock);
+        return TPU_OK;
+    }
+    if (tpurmDeviceGeneration() != genBefore) {
+        /* A whole reset completed between the caller's decision and
+         * the lock: absorbed. */
+        pthread_mutex_unlock(&g_reset.lock);
+        return TPU_OK;
+    }
+    g_reset.inProgress = true;
+    pthread_mutex_unlock(&g_reset.lock);
+
+    TpuStatus st = reset_locked();
+
+    pthread_mutex_lock(&g_reset.lock);
+    g_reset.inProgress = false;
+    pthread_cond_broadcast(&g_reset.done);
+    pthread_mutex_unlock(&g_reset.lock);
+    return st;
+}
+
+void tpurmResetStats(TpuResetStats *out)
+{
+    if (!out)
+        return;
+    out->generation = tpurmDeviceGeneration();
+    out->resets = atomic_load(&g_reset.resets);
+    out->failedResets = atomic_load(&g_reset.failed);
+    out->injectedResets = atomic_load(&g_reset.injected);
+    out->watchdogNudges = tpurmCounterGet("tpurm_watchdog_nudges");
+    out->watchdogRcResets = tpurmCounterGet("tpurm_watchdog_rc_resets");
+    out->watchdogDeviceResets = atomic_load(&g_reset.wdDeviceResets);
+    out->lastMttrNs = atomic_load(&g_reset.lastMttrNs);
+    out->lastQuiesceNs = atomic_load(&g_reset.lastQuiesceNs);
+    out->lastRestoreNs = atomic_load(&g_reset.lastRestoreNs);
+    out->mttrSumNs = atomic_load(&g_reset.mttrSumNs);
+    out->staleCompletions =
+        tpurmCounterGet("memring_stale_completions") +
+        tpurmCounterGet("tpuce_stale_completions");
+}
+
+/* ------------------------------------------------------------ watchdog */
+
+static void *reset_watchdog_thread(void *arg)
+{
+    (void)arg;
+    for (;;) {
+        uint64_t periodMs = tpuRegistryGet("reset_watchdog_period_ms",
+                                           100);
+        struct timespec ts = { .tv_sec = (time_t)(periodMs / 1000),
+                               .tv_nsec = (long)(periodMs % 1000) *
+                                          1000000L };
+        nanosleep(&ts, NULL);
+        if (!tpuRegistryGet("reset_watchdog_enable", 1))
+            continue;
+
+        /* Injected device-level fatal fault: one evaluation per tick,
+         * reconciled exactly (hits == tpurm_reset_injected). */
+        if (tpurmInjectShouldFail(TPU_INJECT_SITE_RESET_DEVICE)) {
+            atomic_fetch_add(&g_reset.injected, 1);
+            tpuCounterAdd("tpurm_reset_injected", 1);
+            tpuLog(TPU_LOG_WARN, "reset",
+                   "reset.device injection fired: forcing full-device "
+                   "reset");
+            tpurmDeviceReset();
+        }
+
+        /* Hung-op ladder over the memring pools.  Rung 3 lands here
+         * (the ring layer cannot call up into the reset engine). */
+        uint64_t hangNs = tpuRegistryGet("reset_hang_timeout_ms",
+                                         5000) * 1000000ull;
+        if (tpurmMemringWatchdogScan(hangNs) >= 3) {
+            atomic_fetch_add(&g_reset.wdDeviceResets, 1);
+            tpuCounterAdd("tpurm_watchdog_device_resets", 1);
+            tpuLog(TPU_LOG_ERROR, "reset",
+                   "watchdog escalation rung 3: full-device reset");
+            tpurmDeviceReset();
+        }
+    }
+    return NULL;
+}
+
+static void reset_wd_start_once(void)
+{
+    pthread_t t;
+    if (pthread_create(&t, NULL, reset_watchdog_thread, NULL) == 0) {
+        pthread_detach(t);
+        g_reset.wdReady = true;
+        tpuLog(TPU_LOG_INFO, "reset",
+               "hung-op watchdog ready (ladder: nudge -> RC reset -> "
+               "device reset)");
+    } else {
+        tpuLog(TPU_LOG_ERROR, "reset", "watchdog thread create failed");
+    }
+}
+
+void tpurmResetWatchdogStart(void)
+{
+    pthread_once(&g_reset.wdOnce, reset_wd_start_once);
+}

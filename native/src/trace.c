@@ -83,6 +83,8 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "sched.round",            "sched"   },
     { "sched.admit",            "sched"   },
     { "sched.preempt",          "sched"   },
+    { "reset.device",           "reset"   },
+    { "reset.quiesce",          "reset"   },
     { "app.span",               "app"     },
     { "inject.hit",             "inject"  },
     { "recover.retry",          "recover" },

@@ -98,7 +98,11 @@ typedef struct {
     uint32_t pending;                 /* futex word */
 
     pthread_t thread;
-    pid_t tid;
+    /* Written once by the worker at startup, read by the SIGSEGV
+     * handler's am-I-a-worker check: atomic (relaxed) so the benign
+     * startup race is also a CLEAN one — TSAN runs the reset/park
+     * handshakes over this path. */
+    _Atomic pid_t tid;
     uint32_t index;
 
     /* ONCE replay policy: wakes deferred until this worker's ring
@@ -132,6 +136,11 @@ static struct {
      * the kernel reports access types and the service can skip the
      * write-inference fallback (sandboxes zero the field). */
     _Atomic int regErrWorks;
+    /* Full-device reset quiesce (reset.c): while set, workers park
+     * between batches — pending faults wait (their threads are parked
+     * in the SIGSEGV handler anyway) until resume.  The pause window
+     * is the reset's reset phase, i.e. milliseconds. */
+    _Atomic int paused;
     struct sigaction oldSegv;
 
     /* Stats (shared).  Latencies land in three tputrace histograms
@@ -818,8 +827,14 @@ static void replay_wake(UvmFaultEntry *e, uint64_t nowNs)
         uvmToolsEmit(e->vs, UVM_EVENT_GPU_FAULT_REPLAY, UVM_TIER_COUNT,
                      UVM_TIER_COUNT, e->devInst, e->addr, e->len);
     uint32_t doneVal = e->serviceStatus == TPU_OK ? 1 : 2;
-    __atomic_store_n(e->doneWord, doneVal, __ATOMIC_SEQ_CST);
-    futex_call(e->doneWord, FUTEX_WAKE, 1);
+    /* The entry lives on the FAULTING thread's stack and dies the
+     * instant that thread observes the done store — every read of *e
+     * must precede it.  Cache the futex word: re-reading e->doneWord
+     * after the store races the stack slot's reuse by the thread's
+     * next fault (a stale-address FUTEX_WAKE itself is harmless). */
+    uint32_t *dw = e->doneWord;
+    __atomic_store_n(dw, doneVal, __ATOMIC_SEQ_CST);
+    futex_call(dw, FUTEX_WAKE, 1);
 }
 
 /* Fatal-fault cancellation (reference: cancel_faults_precise,
@@ -928,7 +943,8 @@ static void access_counter_sweep(FaultWorker *w)
 static void *fault_service_thread(void *arg)
 {
     FaultWorker *w = arg;
-    w->tid = (pid_t)syscall(SYS_gettid);
+    atomic_store_explicit(&w->tid, (pid_t)syscall(SYS_gettid),
+                          memory_order_relaxed);
     uint32_t maxBatch = (uint32_t)tpuRegistryGet("uvm_fault_batch_size", 256);
     if (maxBatch == 0 || maxBatch > FAULT_RING_SIZE)
         maxBatch = 256;
@@ -938,6 +954,16 @@ static void *fault_service_thread(void *arg)
 
     static TpuRegCache c_sweep;
     for (;;) {
+        /* Reset park gate: no NEW batches while the reset engine holds
+         * the pause (a 2 ms poll only while paused — resets are rare
+         * and the window short; no wakeup protocol to get wrong). */
+        while (atomic_load_explicit(&g_fault.paused,
+                                    memory_order_acquire)) {
+            atomic_store(&w->servicing, false);
+            struct timespec pts = { .tv_sec = 0,
+                                    .tv_nsec = 2 * 1000 * 1000 };
+            nanosleep(&pts, NULL);
+        }
         uint64_t sweepNs = tpuRegCacheGet(&c_sweep,
                                           "uvm_access_counter_sweep_ms",
                                           50) * 1000000ull;
@@ -959,6 +985,8 @@ static void *fault_service_thread(void *arg)
             access_counter_sweep(w);
             continue;
         }
+        if (atomic_load_explicit(&g_fault.paused, memory_order_acquire))
+            continue;   /* entries stay pending; park at the loop top */
         atomic_store(&w->servicing, true);
         uint32_t n = 0;
         while (n < maxBatch) {
@@ -1175,6 +1203,32 @@ static void *fault_service_thread(void *arg)
     return NULL;
 }
 
+/* Reset quiesce (reset.c): park the service loop between batches.
+ * Pending and newly-arriving faults WAIT (their threads are parked in
+ * the SIGSEGV handler / device-fault sync path) until resume — the
+ * pause covers only the reset's generation-bump window, so the added
+ * latency is the reset itself.  Bounded: gives up waiting for an
+ * in-flight batch after timeoutNs (the batch services to HOST under
+ * the already-held PM gate, which is safe — same argument as
+ * uvmSuspend's trickle faults). */
+void uvmFaultServicePause(uint64_t timeoutNs)
+{
+    if (!g_fault.ready)
+        return;
+    atomic_store_explicit(&g_fault.paused, 1, memory_order_release);
+    uint64_t deadline = uvmMonotonicNs() + timeoutNs;
+    while (atomic_load(&g_fault.inService) > 0 &&
+           uvmMonotonicNs() < deadline)
+        sched_yield();
+}
+
+void uvmFaultServiceResume(void)
+{
+    if (!g_fault.ready)
+        return;
+    atomic_store_explicit(&g_fault.paused, 0, memory_order_release);
+}
+
 /* PM drain barrier: returns once everything enqueued before the call has
  * been serviced (the ring observed empty with no batch in flight).  New
  * CPU faults may arrive afterwards; while suspended they service to the
@@ -1290,7 +1344,8 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
      * start; a reader racing that assignment just misses the match,
      * which is the pre-existing window for any brand-new thread. */
     for (uint32_t i = 0; i < g_fault.nWorkers; i++) {
-        if (tid == g_fault.workers[i].tid) {
+        if (tid == atomic_load_explicit(&g_fault.workers[i].tid,
+                                        memory_order_relaxed)) {
             snapshot_release();
             fault_fallback(sig, si, uctx);
             return;

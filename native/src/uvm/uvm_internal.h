@@ -590,6 +590,10 @@ void uvmFaultStatsRecordMigration(uint64_t bytes);
 void uvmFaultStatsRecordEviction(void);
 /* PM drain barrier + space/block iteration (uvm_pm.c consumers). */
 void uvmFaultRingDrain(void);
+/* Reset quiesce (reset.c): park/resume the fault-service loop between
+ * batches (pending faults wait; bounded in-flight-batch drain). */
+void uvmFaultServicePause(uint64_t timeoutNs);
+void uvmFaultServiceResume(void);
 uint32_t uvmFaultWorkerCount(void);
 uint32_t uvmFaultServiceHighWater(void);
 void uvmFaultForEachSpace(void (*fn)(UvmVaSpace *vs, UvmVaBlock *blk));

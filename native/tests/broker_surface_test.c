@@ -24,6 +24,13 @@
  *        broker_surface_test --attach <socket>   (one client, existing
  *        broker — used by the conformance-reference-dual target to mix
  *        map/unmap+event traffic with the unmodified reference walkers)
+ *        broker_surface_test --victim <socket>   (client-death actor:
+ *        sets up a root + CXL pin + armed event, prints "victim ready",
+ *        then loops DMA traffic until SIGKILLed — the engine host
+ *        asserts full reclamation afterwards)
+ *        broker_surface_test --loop <socket> <iters>  (survivor actor:
+ *        the full client_run surface repeated, each pass re-verifying
+ *        its bytes — bit-identical traffic through a neighbour's death)
  */
 #define _GNU_SOURCE
 #include <errno.h>
@@ -291,10 +298,100 @@ static int client_run(const char *sock, int idx, int mutate)
     return 0;
 }
 
+/* Client-death actor: acquire every class of reclaimable resource
+ * (RM client root + device tree, registered CXL buffer = a live pin,
+ * armed event = a live forwarder/relay pair, open pseudo fd), then
+ * loop traffic until killed.  Exits 2 on setup failure so the harness
+ * can distinguish "never armed" from "killed mid-traffic". */
+static int victim_run(const char *sock)
+{
+    setenv("TPURM_BROKER", sock, 1);
+    int fd = tpurm_open("/dev/nvidiactl");
+    if (fd < 0)
+        return 2;
+    const uint32_t hClient = 0xdd000001, hDevice = 0xdd000002,
+                   hSubdev = 0xdd000003, hEvent = 0xdd000004;
+    if (do_alloc(fd, 0, 0, hClient, TPU_CLASS_ROOT, NULL, 0) != TPU_OK)
+        return 2;
+    TpuCtrlAttachIdsParams attach;
+    memset(&attach, 0, sizeof(attach));
+    attach.gpuIds[0] = TPU_CTRL_ATTACH_ALL_PROBED;
+    if (do_control(fd, hClient, hClient, TPU_CTRL_CMD_GPU_ATTACH_IDS,
+                   &attach, sizeof(attach)) != TPU_OK)
+        return 2;
+    TpuDeviceAllocParams devParams;
+    memset(&devParams, 0, sizeof(devParams));
+    if (do_alloc(fd, hClient, hClient, hDevice, TPU_CLASS_DEVICE,
+                 &devParams, sizeof(devParams)) != TPU_OK)
+        return 2;
+    TpuSubdeviceAllocParams subParams = { .subDeviceId = 0 };
+    if (do_alloc(fd, hClient, hDevice, hSubdev, TPU_CLASS_SUBDEVICE,
+                 &subParams, sizeof(subParams)) != TPU_OK)
+        return 2;
+
+    static TpuOsEvent os;
+    os.rec.status = TPU_NOTIFICATION_STATUS_IN_PROGRESS;
+    TpuEventAllocParams ep;
+    memset(&ep, 0, sizeof(ep));
+    ep.hParentClient = hClient;
+    ep.hSrcResource = hSubdev;
+    ep.hClass = TPU_CLASS_EVENT_OS;
+    ep.notifyIndex = TPU_NOTIFIER_CXL_DMA;
+    ep.data = (uint64_t)(uintptr_t)&os;
+    if (do_alloc(fd, hClient, hSubdev, hEvent, TPU_CLASS_EVENT_OS,
+                 &ep, sizeof(ep)) != TPU_OK)
+        return 2;
+
+    uint8_t *buf = mmap(NULL, BUF_SIZE, PROT_READ | PROT_WRITE,
+                        MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (buf == MAP_FAILED)
+        return 2;
+    TpuCtrlRegisterCxlBufferParams reg;
+    memset(&reg, 0, sizeof(reg));
+    reg.baseAddress = (uint64_t)(uintptr_t)buf;
+    reg.size = BUF_SIZE;
+    reg.cxlVersion = 2;
+    if (do_control(fd, hClient, hSubdev,
+                   TPU_CTRL_CMD_BUS_REGISTER_CXL_BUFFER, &reg,
+                   sizeof(reg)) != TPU_OK || reg.bufferHandle == 0)
+        return 2;
+
+    printf("victim ready\n");
+    fflush(stdout);
+    for (;;) {
+        TpuCtrlCxlP2pDmaRequestParams dma;
+        memset(&dma, 0, sizeof(dma));
+        dma.cxlBufferHandle = reg.bufferHandle;
+        dma.gpuOffset = 0;
+        dma.cxlOffset = 0;
+        dma.size = 64 * 1024;
+        dma.flags = TPU_CXL_DMA_FLAG_DEV_TO_CXL;
+        do_control(fd, hClient, hSubdev,
+                   TPU_CTRL_CMD_BUS_CXL_P2P_DMA_REQUEST, &dma,
+                   sizeof(dma));
+        struct timespec ts = { .tv_sec = 0, .tv_nsec = 5 * 1000000L };
+        nanosleep(&ts, NULL);
+    }
+    return 0;                           /* unreachable: SIGKILL ends us */
+}
+
 int main(int argc, char **argv)
 {
     if (argc == 3 && strcmp(argv[1], "--attach") == 0)
         return client_run(argv[2], (int)(getpid() % 7), /*mutate=*/0);
+    if (argc == 3 && strcmp(argv[1], "--victim") == 0)
+        return victim_run(argv[2]);
+    if (argc == 4 && strcmp(argv[1], "--loop") == 0) {
+        int iters = atoi(argv[3]);
+        for (int i = 0; i < iters; i++) {
+            int rc = client_run(argv[2], (int)(getpid() % 7),
+                                /*mutate=*/0);
+            if (rc != 0)
+                return rc;
+        }
+        printf("loop client OK\n");
+        return 0;
+    }
 
     /* Spawn a broker daemon, then two concurrent clients. */
     unsetenv("TPURM_BROKER");

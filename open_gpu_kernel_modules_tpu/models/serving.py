@@ -29,6 +29,11 @@ import jax.numpy as jnp
 from . import llama
 from ..ops import paged_attention
 
+# status.h TPU_ERR_DEVICE_RESET: a completion rejected by the device-
+# generation fence (a full reset ran under the op) — retryable by
+# contract, the backing holds the truth.
+_ERR_DEVICE_RESET = 0x73
+
 
 @dataclasses.dataclass
 class PagedKVCache:
@@ -334,6 +339,24 @@ class ManagedKVBacking:
         except Exception:
             self.ring = None        # fall back to the sync loop
 
+    def _ring_fault_pages(self, pages: List[int]) -> None:
+        """One batched prefetch pass over ``pages`` (both pools)."""
+        n = 0
+        for page in pages:
+            off = page * self.rec_bytes
+            if self.ring.sq_space < 2:
+                # Giant group: flush a full SQ wave and keep going.
+                self.ring.submit_and_wait(n)
+                self.ring.completions(max_cqes=max(n, 64), check=True)
+                n = 0
+            self.ring.prefetch(self.k_buf.address + off,
+                               self.rec_bytes, dev=self.dev)
+            self.ring.prefetch(self.v_buf.address + off,
+                               self.rec_bytes, dev=self.dev)
+            n += 2
+        self.ring.submit_and_wait(n)
+        self.ring.completions(max_cqes=max(n, 64), check=True)
+
     def _store_k(self) -> np.ndarray:
         return self.k_buf.view(self.np_dtype, self.store_shape)
 
@@ -359,24 +382,27 @@ class ManagedKVBacking:
         doorbell), the worker pool faults them concurrently — merging
         adjacent spans into block-granular engine calls — and errors
         come back as per-op CQEs (raised here as RmError, matching the
-        sync path's contract)."""
+        sync path's contract).
+
+        Reset integration: a CQE carrying DEVICE_RESET is a completion
+        the generation fence rejected (a full-device reset ran under
+        the batch).  The pages' truth is intact in the backing — the
+        whole fault pass simply re-issues ONCE against the new
+        generation; any other error still raises."""
         if self.ring is not None and pages:
-            n = 0
-            for page in pages:
-                off = page * self.rec_bytes
-                if self.ring.sq_space < 2:
-                    # Giant group: flush a full SQ wave and keep going.
-                    self.ring.submit_and_wait(n)
-                    self.ring.completions(max_cqes=max(n, 64),
-                                          check=True)
-                    n = 0
-                self.ring.prefetch(self.k_buf.address + off,
-                                   self.rec_bytes, dev=self.dev)
-                self.ring.prefetch(self.v_buf.address + off,
-                                   self.rec_bytes, dev=self.dev)
-                n += 2
-            self.ring.submit_and_wait(n)
-            self.ring.completions(max_cqes=max(n, 64), check=True)
+            from ..runtime import native as _native
+
+            for attempt in (0, 1):
+                try:
+                    self._ring_fault_pages(pages)
+                    break
+                except _native.RmError as e:
+                    if attempt == 1 or e.status != _ERR_DEVICE_RESET:
+                        raise
+                    # Quiesce leftovers, then replay the idempotent
+                    # prefetch pass against the new generation.
+                    self.ring.drain()
+                    self.ring.completions(max_cqes=8192)
         else:
             for page in pages:
                 off = page * self.rec_bytes

@@ -197,12 +197,19 @@ class Scheduler:
                  admit_retries: int = 3,
                  cache: Optional[serving.TieredKVCache] = None):
         from ..uvm import inject as _inject
+        from ..uvm import reset as _reset
 
         self.cfg = cfg
         self.params = params
         self.tokens_per_round = tokens_per_round
         self.admit_retries = admit_retries
         self._inject = _inject
+        self._reset = _reset
+        # Device-generation watch: a bump between rounds means a full
+        # device reset ran under the scheduler (watchdog escalation,
+        # injected reset.device fault, or an operator) — see
+        # _check_generation for the recovery contract.
+        self._gen = _reset.generation()
         self.cache = cache if cache is not None else serving.TieredKVCache(
             cfg, batch=max_seqs, max_len=max_len, page_size=page_size,
             oversub=oversub)
@@ -221,7 +228,8 @@ class Scheduler:
         self.stats = {"admitted": 0, "retired": 0, "preempted": 0,
                       "restored": 0, "rounds": 0, "cancelled": 0,
                       "admit_retries": 0, "admit_sheds": 0,
-                      "round_errors": 0, "decoded_tokens": 0}
+                      "round_errors": 0, "decoded_tokens": 0,
+                      "device_resets_observed": 0}
 
     # ------------------------------------------------------------ tenants
 
@@ -530,11 +538,33 @@ class Scheduler:
         self.stats["retired"] += 1
         _counter_add("tpusched_retired")
 
+    def _check_generation(self) -> None:
+        """Full-device reset detection (tpurm/reset.h): the native
+        engine saved device residency to the host backing (fbsr),
+        reset channels/links/pins, and restored — but the scheduler's
+        own device slot pool sits ABOVE the arenas, so its residency
+        is conservatively re-validated: every running sequence is
+        preempted (its dirty pages flush to the preserved backing) and
+        restored from backing over the next rounds.  The preempt/
+        restore machinery's bit-identity guarantee makes decode streams
+        continue TOKEN-EXACT through the reset."""
+        gen = self._reset.generation()
+        if gen == self._gen:
+            return
+        self._gen = gen
+        self.stats["device_resets_observed"] += 1
+        _counter_add("tpusched_device_resets")
+        for seq in list(self._running):
+            req = self._running.get(seq)
+            if req is not None:
+                self._preempt(req)
+
     def step(self) -> Dict[str, int]:
         """One scheduling round: admit/restore, fit-check (preempting
         SLO-ordered victims if decode growth outgrew the pool), ONE
         batched decode dispatch, retire.  Returns live counts."""
         with _span("sched.round", obj=self.stats["rounds"]):
+            self._check_generation()
             self._try_admissions()
             # Decode growth can push the runnable set past the slot
             # pool: preempt until the round fits (never below one).

@@ -54,6 +54,8 @@ def _lib() -> ctypes.CDLL:
     lib.tpuCeMgrDrain.argtypes = [vp]
     lib.tpuCeMgrDrain.restype = u32
     lib.tpuRegistryBump.argtypes = []
+    lib.tpuRegistrySet.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tpuRegistrySet.restype = None
     _bound = lib
     return lib
 
@@ -144,11 +146,11 @@ def drain(dev: int = 0) -> None:
 
 def set_channels(n: int) -> int:
     """Resize the schedulable pool at runtime (bench A/B): writes the
-    registry env key and bumps the native registry generation so the
-    next copy re-reads it.  Returns the count now in force."""
+    registry key through the native tpuRegistrySet (serialized against
+    the rc/reset watchdogs' background polls, bumps the generation) so
+    the next copy re-reads it.  Returns the count now in force."""
     if not 1 <= n <= MAX_CHANNELS:
         raise ValueError(f"channels must be 1..{MAX_CHANNELS}")
-    os.environ[CHANNELS_KEY] = str(n)
     lib = _lib()
-    lib.tpuRegistryBump()
+    lib.tpuRegistrySet(CHANNELS_KEY.encode(), str(n).encode())
     return channels()

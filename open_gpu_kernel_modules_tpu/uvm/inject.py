@@ -37,6 +37,7 @@ class Site(enum.IntEnum):
     MEMRING_SUBMIT = 7   # memring op execution (per coalesced run)
     CE_COPY = 8          # tpuce stripe submission (per attempt)
     SCHED_ADMIT = 9      # tpusched admission decision (per pass)
+    RESET_DEVICE = 10    # forced full-device reset (per watchdog tick)
 
 
 class Mode(enum.IntEnum):
@@ -79,6 +80,17 @@ DETAIL_COUNTERS = (
     "tpuce_lossless_fallbacks",
     "tpusched_admit_retries",
     "tpusched_admit_sheds",
+    "tpurm_reset_total",
+    "tpurm_reset_injected",
+    "tpurm_watchdog_nudges",
+    "tpurm_watchdog_rc_resets",
+    "tpurm_watchdog_device_resets",
+    "memring_stale_completions",
+    "memring_deadline_expired",
+    "tpuce_stale_completions",
+    "tpuce_deadline_expired",
+    "broker_client_deaths",
+    "broker_reclaimed_pins",
 )
 
 

@@ -81,7 +81,7 @@ class _Sqe(ctypes.Structure):
         ("arg0", ctypes.c_uint32),
         ("peerOff", ctypes.c_uint64),
         ("arg1", ctypes.c_uint64),
-        ("pad", ctypes.c_uint64),
+        ("deadlineNs", ctypes.c_uint64),
     ]
 
 
@@ -200,30 +200,34 @@ class MemRing:
         return sqe.userData
 
     def migrate(self, addr: int, length: int, tier: Tier, dev: int = 0,
-                user_data: int = 0, link: bool = False) -> int:
+                user_data: int = 0, link: bool = False,
+                deadline_ns: int = 0) -> int:
         """Stage an async migrate of [addr, addr+length) to ``tier``.
-        Returns the op's cookie (auto-assigned when 0)."""
+        Returns the op's cookie (auto-assigned when 0).
+        ``deadline_ns`` (absolute, utils clock) fails the op fast with
+        RETRY_EXHAUSTED if it is claimed past the deadline."""
         s = _Sqe(opcode=Op.MIGRATE, flags=SQE_LINK if link else 0,
                  dstTier=int(tier), devInst=dev, addr=addr, len=length,
-                 userData=user_data)
+                 userData=user_data, deadlineNs=deadline_ns)
         return self._prep(s)
 
     def prefetch(self, addr: int, length: int, dev: int = 0,
                  write: bool = False, user_data: int = 0,
-                 link: bool = False) -> int:
+                 link: bool = False, deadline_ns: int = 0) -> int:
         """Stage a device-access prefetch: fault the span onto
         ``dev``'s HBM through the batch service loop."""
         flags = (SQE_LINK if link else 0) | (SQE_WRITE if write else 0)
         s = _Sqe(opcode=Op.PREFETCH, flags=flags, devInst=dev, addr=addr,
-                 len=length, userData=user_data)
+                 len=length, userData=user_data, deadlineNs=deadline_ns)
         return self._prep(s)
 
     def evict(self, addr: int, length: int, tier: Tier = Tier.HOST,
-              user_data: int = 0, link: bool = False) -> int:
+              user_data: int = 0, link: bool = False,
+              deadline_ns: int = 0) -> int:
         """Stage a tier demote (HOST or CXL destination only)."""
         s = _Sqe(opcode=Op.EVICT, flags=SQE_LINK if link else 0,
                  dstTier=int(tier), addr=addr, len=length,
-                 userData=user_data)
+                 userData=user_data, deadlineNs=deadline_ns)
         return self._prep(s)
 
     def advise(self, addr: int, length: int, advice: Advise,
@@ -254,6 +258,15 @@ class MemRing:
         """Stage a fence: completes only after every previously
         submitted op has posted its CQE; later ops wait for it."""
         s = _Sqe(opcode=Op.FENCE, userData=user_data)
+        return self._prep(s)
+
+    def nop(self, user_data: int = 0, delay_ns: int = 0,
+            deadline_ns: int = 0) -> int:
+        """Stage a NOP.  ``delay_ns`` makes the worker sleep that long
+        before completing — the deterministic hung-op the reset
+        watchdog/ladder tests use."""
+        s = _Sqe(opcode=Op.NOP, userData=user_data, arg1=delay_ns,
+                 deadlineNs=deadline_ns)
         return self._prep(s)
 
     # --------------------------------------------------- submit / reap

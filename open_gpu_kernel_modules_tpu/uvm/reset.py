@@ -1,0 +1,131 @@
+"""tpureset — full-device reset, generation fence, hung-op watchdog.
+
+Python face of native/src/reset.c (public header tpurm/reset.h): force
+a coordinated full-device reset (quiesce -> generation bump + channel
+RC clear + ICI retrain + RDMA re-pin -> fbsr restore), read the
+device-wide generation the engines fence stale completions against,
+and observe the hung-op escalation ladder's counters.
+
+The serving scheduler (runtime/sched.py) polls :func:`generation`
+every round: a bump means the device went through a reset under it —
+running sequences are conservatively preempted and restored from their
+backing so decode streams continue TOKEN-EXACT (the preempt/restore
+machinery's bit-identity guarantee does the heavy lifting).
+
+Chaos: the ``reset.device`` injection site
+(``TPUMEM_INJECT_RESET_DEVICE``, ``inject.Site.RESET_DEVICE``) is
+evaluated once per watchdog tick; a hit forces a full reset, counted
+``tpurm_reset_injected`` and reconciled exactly against the site's hit
+count.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+
+from ..runtime import native
+
+_bound = None
+
+
+class _Stats(ctypes.Structure):
+    _fields_ = [
+        ("generation", ctypes.c_uint64),
+        ("resets", ctypes.c_uint64),
+        ("failedResets", ctypes.c_uint64),
+        ("injectedResets", ctypes.c_uint64),
+        ("watchdogNudges", ctypes.c_uint64),
+        ("watchdogRcResets", ctypes.c_uint64),
+        ("watchdogDeviceResets", ctypes.c_uint64),
+        ("lastMttrNs", ctypes.c_uint64),
+        ("lastQuiesceNs", ctypes.c_uint64),
+        ("lastRestoreNs", ctypes.c_uint64),
+        ("mttrSumNs", ctypes.c_uint64),
+        ("staleCompletions", ctypes.c_uint64),
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResetStats:
+    """Snapshot of tpurm/reset.h TpuResetStats."""
+
+    generation: int
+    resets: int
+    failed_resets: int
+    injected_resets: int
+    watchdog_nudges: int
+    watchdog_rc_resets: int
+    watchdog_device_resets: int
+    last_mttr_ns: int
+    last_quiesce_ns: int
+    last_restore_ns: int
+    mttr_sum_ns: int
+    stale_completions: int
+
+    @property
+    def last_mttr_ms(self) -> float:
+        return self.last_mttr_ns / 1e6
+
+    @property
+    def mean_mttr_ms(self) -> float:
+        return (self.mttr_sum_ns / self.resets / 1e6) if self.resets \
+            else 0.0
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is not None:
+        return _bound
+    lib = native.load()
+    lib.tpurmDeviceGeneration.argtypes = []
+    lib.tpurmDeviceGeneration.restype = ctypes.c_uint64
+    lib.tpurmDeviceReset.argtypes = []
+    lib.tpurmDeviceReset.restype = ctypes.c_uint32
+    lib.tpurmResetStats.argtypes = [ctypes.POINTER(_Stats)]
+    lib.tpurmResetStats.restype = None
+    lib.tpurmResetWatchdogStart.argtypes = []
+    lib.tpurmResetWatchdogStart.restype = None
+    _bound = lib
+    return lib
+
+
+def generation() -> int:
+    """The device-wide generation (bumps once per completed reset)."""
+    return _lib().tpurmDeviceGeneration()
+
+
+def device_reset() -> None:
+    """Force a coordinated full-device reset (quiesce -> reset ->
+    restore); concurrent callers coalesce onto one reset.  RmError if
+    the reset could not run (e.g. the PM gate is held by an explicit
+    operator suspend)."""
+    st = _lib().tpurmDeviceReset()
+    if st != 0:
+        raise native.RmError(st, "tpurmDeviceReset")
+
+
+def stats() -> ResetStats:
+    """Reset + watchdog statistics (also /proc/driver/tpurm/reset)."""
+    raw = _Stats()
+    _lib().tpurmResetStats(ctypes.byref(raw))
+    return ResetStats(
+        generation=raw.generation,
+        resets=raw.resets,
+        failed_resets=raw.failedResets,
+        injected_resets=raw.injectedResets,
+        watchdog_nudges=raw.watchdogNudges,
+        watchdog_rc_resets=raw.watchdogRcResets,
+        watchdog_device_resets=raw.watchdogDeviceResets,
+        last_mttr_ns=raw.lastMttrNs,
+        last_quiesce_ns=raw.lastQuiesceNs,
+        last_restore_ns=raw.lastRestoreNs,
+        mttr_sum_ns=raw.mttrSumNs,
+        stale_completions=raw.staleCompletions,
+    )
+
+
+def watchdog_start() -> None:
+    """Start the hung-op watchdog (idempotent; also started by any
+    channel creation through tpuRcInit)."""
+    _lib().tpurmResetWatchdogStart()

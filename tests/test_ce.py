@@ -16,9 +16,16 @@ MB = 1 << 20
 def test_striping_stats_and_drain():
     """A block-granular migrate splits into stripes across >= 2
     channels; per-channel byte accounting covers the copy; drain
-    leaves nothing outstanding."""
-    before = ce.stats()
+    leaves nothing outstanding.
+
+    The default channel count is capped at the ONLINE CPUs (executor
+    threads thrash on starved boxes), which on a 1-CPU container would
+    leave a single channel and nothing to stripe across — pin 2
+    explicitly (the registry override the cap defers to; the native
+    ce_test pins 4 the same way)."""
+    ce.set_channels(max(2, ce.channels()))
     assert ce.channels() >= 2
+    before = ce.stats()      # AFTER the resize: equal channel lists
     with uvm.VaSpace() as vs:
         buf = vs.alloc(4 * MB)
         buf.view()[:] = 0x7E

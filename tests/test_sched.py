@@ -109,6 +109,46 @@ def test_preempt_restore_bit_identical(setup):
         assert np.array_equal(g, ref), f"stream {i} corrupted by preempt"
 
 
+def test_device_reset_token_exact(setup):
+    """Full-device resets forced MID-decode: the scheduler observes the
+    generation bump, preempts every running sequence (flush to the
+    fbsr-preserved backing) and restores — every stream's tokens stay
+    bit-identical to its solo, reset-free run, through >= 3 resets."""
+    from open_gpu_kernel_modules_tpu.uvm import reset
+
+    cfg, params = setup
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, 256, size=24) for _ in range(4)]
+
+    resets0 = reset.stats().resets
+    s = _mk(cfg, params, oversub=2, tokens_per_round=8)
+    reqs = [s.submit(p, max_new_tokens=48) for p in prompts]
+    forced = 0
+    rounds = 0
+    while not s.idle and rounds < 5000:
+        s.step()
+        rounds += 1
+        if rounds % 2 == 0 and forced < 3:
+            reset.device_reset()
+            forced += 1
+    assert forced >= 3
+    rep = s.report(1.0)
+    assert rep["finished"] == 4
+    assert rep["device_resets_observed"] >= 3, rep
+    # Every running sequence was parked at each observed reset and came
+    # back through the restore path.
+    assert rep["preempted"] >= rep["device_resets_observed"], rep
+    assert reset.stats().resets >= resets0 + 3
+    got = [r.tokens.copy() for r in reqs]
+    s.close()
+
+    for i, (p, g) in enumerate(zip(prompts, got)):
+        ref = _solo_tokens(cfg, params, p, 48, oversub=2,
+                           tokens_per_round=8)
+        assert np.array_equal(g, ref), \
+            f"stream {i} corrupted by device reset"
+
+
 def test_tenant_quota_preemption(setup):
     """Scheduler-level QoS: the over-quota low-priority tenant gets
     preempted/deferred under pressure; the compliant high-priority

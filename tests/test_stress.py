@@ -186,6 +186,8 @@ out["phase0_evals"] = {k: v[0] for k, v in inj.stats().items()}
 # corruption-free with every site emitting, every injected fault must
 # surface as an instant event, and every recovery-counter increment
 # must have a matching recovery trace event.
+from open_gpu_kernel_modules_tpu.uvm import reset as rst
+
 utils.trace_reset()
 utils.trace_start()
 inj.set_seed(42)
@@ -195,6 +197,13 @@ SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MEMRING_SUBMIT, inj.Site.CE_COPY]
 for s in SITES:
     inj.enable(s, inj.Mode.PPM, 10000)
+# The reset.device site fires on the watchdog tick (100 ms period, so
+# the 4 s window holds ~40 evaluations): every 13th forces a FULL
+# DEVICE RESET under the whole actor mix.  The watchdog must be up for
+# the evaluations to happen at all.
+rst.watchdog_start()
+resets_before = rst.stats().resets
+inj.enable(inj.Site.RESET_DEVICE, inj.Mode.NTH, 13)
 
 errors = []
 tolerated = {"n": 0}
@@ -354,6 +363,18 @@ for t in threads:
 stop.set()
 out["hung"] = sum(t.is_alive() for t in threads)
 inj.disable_all()
+# Full-device resets landed under the chaos: exact reconciliation —
+# every reset.device hit forced exactly one injected reset.
+rs = rst.stats()
+rd_evals, rd_hits = inj.counts(inj.Site.RESET_DEVICE)
+out["reset"] = {
+    "evals": rd_evals,
+    "hits": rd_hits,
+    "injected": rs.injected_resets,
+    "resets": rs.resets - resets_before,
+    "mttr_ms": rs.last_mttr_ms,
+    "stale_completions": rs.stale_completions,
+}
 ap.close()
 lib.uvmHbmChunkFree(0, h0)
 lib.uvmHbmChunkFree(1, h1)
@@ -469,6 +490,8 @@ from open_gpu_kernel_modules_tpu.models import llama
 from open_gpu_kernel_modules_tpu.runtime import sched
 from open_gpu_kernel_modules_tpu.uvm import inject as inj
 
+from open_gpu_kernel_modules_tpu.uvm import reset
+
 cfg = llama.LlamaConfig(
     vocab_size=256, hidden_size=64, intermediate_size=128,
     num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
@@ -479,7 +502,7 @@ prompts = [rng.integers(0, 256, size=16) for _ in range(8)]
 CANCEL = {5, 6}                 # rids cancelled mid-flight (1-based)
 
 
-def run_once():
+def run_once(force_resets=0):
     s = sched.Scheduler(cfg, params, max_seqs=4, max_len=64,
                         page_size=16, oversub=4, tokens_per_round=4)
     reqs = [s.submit(p, max_new_tokens=12) for p in prompts]
@@ -488,7 +511,19 @@ def run_once():
     for r in reqs:
         if r.rid in CANCEL:
             s.cancel(r.rid)
-    rep = s.run(max_rounds=5000)
+    forced = 0
+    rounds = 0
+    while not s.idle and rounds < 5000:
+        s.step()
+        rounds += 1
+        if force_resets and forced < force_resets and not s.idle:
+            # Forced full-device reset MID-decode: quiesce -> fbsr
+            # save -> generation bump -> restore, with the scheduler
+            # preempting + restoring every running stream.
+            reset.device_reset()
+            forced += 1
+    rep = s.report(1.0)
+    rep["forced_resets"] = forced
     toks = {r.rid: r.tokens.tolist() for r in reqs
             if r.state is sched.RequestState.FINISHED}
     states = {r.rid: r.state.value for r in reqs}
@@ -500,15 +535,24 @@ out = {}
 ref_toks, ref_states, ref_rep = run_once()
 out["ref_states"] = ref_states
 
-# Chaos across ALL TEN sites (fixed seed), scheduler included.  The
-# big engine soak runs at 1%%; this workload is orders of magnitude
+# Chaos across ALL ELEVEN sites (fixed seed), scheduler and the
+# full-device reset path included, plus >= 3 FORCED resets mid-decode.
+# The big engine soak runs at 1%%; this workload is orders of magnitude
 # smaller (a few thousand evaluations), so 5%% keeps several sites
-# firing without changing what is proven.
+# firing without changing what is proven.  (reset.device is evaluated
+# once per 100 ms watchdog tick, so its PPM hits are rare here — the
+# forced resets carry the acceptance load.)
+resets_before = reset.stats().resets
 inj.set_seed(42)
 for s_ in inj.Site:
     inj.enable(s_, inj.Mode.PPM, 50000)
-chaos_toks, chaos_states, rep = run_once()
+chaos_toks, chaos_states, rep = run_once(force_resets=3)
 inj.disable_all()
+rst = reset.stats()
+out["resets_during_chaos"] = rst.resets - resets_before
+out["reset_mttr_ms"] = rst.last_mttr_ms
+out["injected_resets"] = rst.injected_resets
+out["stale_completions"] = rst.stale_completions
 
 out["chaos_states"] = chaos_states
 out["finished_match"] = sorted(chaos_toks) == sorted(ref_toks)
@@ -517,7 +561,8 @@ out["tokens_identical"] = all(chaos_toks[r] == ref_toks[r]
 out["rep"] = {k: rep[k] for k in
               ("admitted", "retired", "preempted", "restored",
                "cancelled", "admit_retries", "admit_sheds",
-               "round_errors", "finished")}
+               "round_errors", "finished", "forced_resets",
+               "device_resets_observed")}
 out["live"] = {}
 out["hits"] = {k: v[1] for k, v in inj.stats().items()}
 out["sched_admit_evals"] = inj.counts(inj.Site.SCHED_ADMIT)[0]
@@ -527,12 +572,13 @@ print(json.dumps(out))
 
 def test_sched_soak_injection():
     """Chaos soak, scheduler actor: streams admitted AND cancelled
-    under injection across all 10 sites (~5% here — this workload is
+    under injection across ALL 11 sites (~5% here — this workload is
     orders of magnitude smaller than the engine soak's, so 1% would
-    barely fire).  Acceptance: zero token corruption (every stream
-    that finishes produces exactly its uninjected tokens) and balanced
-    admit/retire/preempt accounting (nothing leaks a sequence slot or
-    a page pin)."""
+    barely fire) WITH >= 3 forced full-device resets mid-decode.
+    Acceptance: zero token corruption (every stream that finishes
+    produces exactly its uninjected tokens — through the resets) and
+    balanced admit/retire/preempt/reset accounting (nothing leaks a
+    sequence slot or a page pin)."""
     env = dict(os.environ)
     env.setdefault("TPUMEM_FAKE_TPU_COUNT", "2")
     env.setdefault("TPUMEM_FAKE_HBM_MB", "128")
@@ -545,6 +591,15 @@ def test_sched_soak_injection():
     # Zero token corruption: same finished set, bit-identical streams.
     assert out["finished_match"], out
     assert out["tokens_identical"], out
+
+    # The reset path genuinely ran: >= 3 full-device resets landed
+    # mid-decode, the scheduler observed each one (preempt-all +
+    # restore), and the MTTR was measured.
+    rep_r = out["rep"]
+    assert rep_r["forced_resets"] >= 3, out
+    assert out["resets_during_chaos"] >= 3, out
+    assert rep_r["device_resets_observed"] >= 3, out
+    assert out["reset_mttr_ms"] > 0, out
 
     # Balanced accounting at idle: every submitted stream is either
     # retired or cancelled, every preemption was restored or its
@@ -561,6 +616,122 @@ def test_sched_soak_injection():
     assert out["sched_admit_evals"] > 0, out
     fired = [k for k, h in out["hits"].items() if h > 0]
     assert len(fired) >= 2, out["hits"]
+
+
+_CLIENT_KILL = r"""
+import ctypes
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+# Engine-host env BEFORE the library loads: fake CXL device + seeded
+# arena (the surviving walker verifies the seeded bytes every pass).
+os.environ["TPUMEM_FAKE_CXL_DEVICES"] = "1"
+os.environ["TPUMEM_FAKE_HBM_SEED"] = "0xAB"
+sys.path.insert(0, %(repo)r)
+
+from open_gpu_kernel_modules_tpu.runtime import native
+
+lib = native.load()
+lib.tpuCxlPinnedBytes.argtypes = []
+lib.tpuCxlPinnedBytes.restype = ctypes.c_uint64
+lib.tpuCxlRegisteredCount.argtypes = []
+lib.tpuCxlRegisteredCount.restype = ctypes.c_uint32
+lib.tpurmBrokerServe.argtypes = [ctypes.c_char_p]
+lib.tpurmBrokerServe.restype = ctypes.c_uint32
+
+def ctr(name):
+    return lib.tpurmCounterGet(name.encode())
+
+sock = "/tmp/tpurm_kill_%%d.sock" %% os.getpid()
+assert lib.tpurmBrokerServe(sock.encode()) == 0
+
+bst = os.path.join(%(repo)r, "native", "build", "broker_surface_test")
+env = dict(os.environ)
+
+base_pins = lib.tpuCxlPinnedBytes()
+base_regs = lib.tpuCxlRegisteredCount()
+out = {}
+
+# Victim: RM root + CXL pin + armed event + open fd, DMA loop forever.
+victim = subprocess.Popen([bst, "--victim", sock], env=env,
+                          stdout=subprocess.PIPE, text=True)
+line = victim.stdout.readline()
+assert "victim ready" in line, line
+
+# Survivor: the full remote surface repeated, re-verifying its bytes
+# every pass — its traffic rides THROUGH the victim's death.
+survivor = subprocess.Popen([bst, "--loop", sock, "6"], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+time.sleep(0.3)                       # victim mid-traffic
+out["pins_live_before_kill"] = lib.tpuCxlPinnedBytes() - base_pins
+assert out["pins_live_before_kill"] > 0
+
+deaths0 = ctr("broker_client_deaths")
+os.kill(victim.pid, signal.SIGKILL)
+victim.wait()
+
+# Reclamation: the fd-hangup path must return every pin/charge/page.
+deadline = time.monotonic() + 10
+while time.monotonic() < deadline:
+    if (ctr("broker_client_deaths") > deaths0 and
+            lib.tpuCxlPinnedBytes() == base_pins):
+        break
+    time.sleep(0.05)
+out["client_deaths"] = ctr("broker_client_deaths") - deaths0
+out["pins_after_kill"] = lib.tpuCxlPinnedBytes() - base_pins
+out["regs_after_kill"] = lib.tpuCxlRegisteredCount() - base_regs
+out["reclaimed_pins"] = ctr("broker_reclaimed_pins")
+out["reclaimed_pin_bytes"] = ctr("broker_reclaimed_pin_bytes")
+out["reclaimed_clients"] = ctr("broker_reclaimed_clients")
+out["reclaimed_fds"] = ctr("broker_reclaimed_fds")
+
+surv_out = survivor.communicate(timeout=120)[0]
+out["survivor_rc"] = survivor.returncode
+out["survivor_ok"] = "loop client OK" in surv_out
+out["survivor_tail"] = surv_out[-500:]
+os.unlink(sock)
+print(json.dumps(out))
+"""
+
+
+def test_client_death_reclamation():
+    """Client-death reclamation (broker.c): SIGKILL a broker client
+    mid-DMA-traffic.  The engine host must reclaim its CXL pin (back
+    to zero pinned bytes), RM client root, and pseudo fds — counted —
+    while a concurrent surviving client's repeated full-surface passes
+    (map windows, events, completion-ordered DMA, every byte
+    re-verified) complete bit-identical, undisturbed by the death."""
+    subprocess.run(["make", "-C", os.path.join(_REPO, "native"),
+                    "build/broker_surface_test", "build/libtpurm.so"],
+                   check=True, capture_output=True)
+    proc = subprocess.run([sys.executable, "-c",
+                           _CLIENT_KILL % {"repo": _REPO}],
+                          env=dict(os.environ), capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # The death was detected and fully reclaimed: pins back to zero,
+    # nothing left registered, every resource class counted.
+    assert out["client_deaths"] >= 1, out
+    assert out["pins_after_kill"] == 0, out
+    assert out["regs_after_kill"] == 0, out
+    assert out["reclaimed_pins"] >= 1, out
+    assert out["reclaimed_pin_bytes"] >= 1 << 20, out
+    assert out["reclaimed_clients"] >= 1, out
+    assert out["reclaimed_fds"] >= 1, out
+
+    # The surviving client's streams were bit-identical throughout
+    # (its every pass re-verifies the seeded arena + DMA bytes).
+    assert out["survivor_rc"] == 0, out
+    assert out["survivor_ok"], out
 
 
 def test_engine_soak_injection():
@@ -595,6 +766,15 @@ def test_engine_soak_injection():
     # The chaos genuinely fired across >= 5 distinct sites.
     fired = [k for k, h in out["hits"].items() if h > 0]
     assert len(fired) >= 5, out["hits"]
+
+    # Full-device resets rode the chaos window: every reset.device hit
+    # forced exactly one injected reset (the last may still be in
+    # flight at the snapshot; the counters stay exact).
+    rd = out["reset"]
+    assert rd["evals"] > 0 and rd["hits"] >= 1, rd
+    assert rd["injected"] == rd["hits"], rd
+    assert rd["resets"] >= rd["hits"] - 1 and rd["resets"] >= 1, rd
+    assert rd["mttr_ms"] > 0, rd
 
     # Memring rode the chaos: ops flowed through the ring, completion
     # accounting balanced, and the error-CQE reconciliation is EXACT —
