@@ -400,6 +400,118 @@ def measure_memring_async_vs_sync(spans: int = 256,
     return out
 
 
+def measure_memring_spine_vs_sync(oversub: int = 2,
+                                  span_bytes: int = 8 * 1024) -> dict:
+    """Submission-spine acceptance A/B on the OVERSUBSCRIPTION workload
+    shape (the bench of record's fault+evict pipeline, fake arena):
+    a working set `oversub`x the HBM arena is device-faulted in two
+    passes — pass 2 re-faults evicted spans under LRU pressure —
+    driven (a) as the historical loop of synchronous per-span
+    device_access calls and (b) as BATCHED ring submission of PREFETCH
+    SQEs (SQ-wave chunked, one doorbell per wave), where the worker
+    pool coalesces contiguous spans and overlaps service with
+    eviction.  Also records a SQPOLL on/off A/B over the batched leg
+    (registry memring_sqpoll flipped live; submits skip the doorbell
+    futex syscall while a poller is registered) and the fault
+    chain-length percentiles from the memring.chain histogram (the
+    chained-service evidence).  Native-only; best-of-3 per mode."""
+    import ctypes
+
+    from open_gpu_kernel_modules_tpu import uvm
+    from open_gpu_kernel_modules_tpu import utils as _utils
+    from open_gpu_kernel_modules_tpu.runtime import native
+    from open_gpu_kernel_modules_tpu.uvm import memring
+
+    lib = native.load()
+    lib.tpuRegistrySet.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tpuRegistrySet.restype = None
+
+    dev_handle = lib.tpurmDeviceGet(0)
+    arena = lib.tpurmDeviceHbmSize(dev_handle)
+    slice_bytes = 16 * MB
+    nbufs = max(2, (oversub * arena) // slice_bytes)
+    spans_per_buf = slice_bytes // span_bytes
+
+    with uvm.VaSpace() as vs:
+        bufs = [vs.alloc(slice_bytes) for _ in range(nbufs)]
+        for b in bufs:
+            b.view()[:] = 0x5E          # populate host tier
+
+        def sync_pass() -> float:
+            t0 = time.perf_counter()
+            for _ in range(2):
+                for b in bufs:
+                    for s in range(spans_per_buf):
+                        b.device_access(dev=0, offset=s * span_bytes,
+                                        length=span_bytes, write=False)
+            return time.perf_counter() - t0
+
+        # Raw producer: one preallocated SQE mutated per op + direct
+        # tpurmMemringPrep calls — the Python-object overhead of the
+        # wrapper would otherwise bound the producer side and measure
+        # the FFI, not the transport (native producers — the fault
+        # engine, the migrate ioctl — pay none of it).
+        sqe = memring._Sqe(opcode=memring.Op.PREFETCH, devInst=0,
+                           len=span_bytes)
+        sqe_ref = ctypes.byref(sqe)
+        prep = lib.tpurmMemringPrep
+        space = lib.tpurmMemringSqSpace
+
+        def spine_pass(ring) -> float:
+            h = ring._handle
+            t0 = time.perf_counter()
+            for _ in range(2):
+                for b in bufs:
+                    base = b.address
+                    for s in range(spans_per_buf):
+                        if not space(h):
+                            ring.submit_and_wait(None)
+                            ring.completions(max_cqes=8192)
+                        sqe.addr = base + s * span_bytes
+                        prep(h, sqe_ref)
+                ring.submit_and_wait(None)
+                ring.completions(max_cqes=8192)
+            return time.perf_counter() - t0
+
+        sync_pass()                      # warm (PMM + first-touch)
+        sync_dt = min(sync_pass() for _ in range(3))
+        with memring.MemRing(vs, entries=1024) as ring:
+            spine_pass(ring)
+            spine_dt = min(spine_pass(ring) for _ in range(3))
+            # SQPOLL leg: same batched workload with always-polling
+            # workers (live registry flip; workers re-read per idle).
+            polls0 = _utils.counter("memring_sqpoll_polls")
+            lib.tpuRegistrySet(b"TPUMEM_MEMRING_SQPOLL", b"1")
+            lib.tpuRegistrySet(b"TPUMEM_MEMRING_SQPOLL_IDLE_US", b"3000")
+            try:
+                spine_pass(ring)
+                sqpoll_dt = min(spine_pass(ring) for _ in range(3))
+            finally:
+                lib.tpuRegistrySet(b"TPUMEM_MEMRING_SQPOLL", None)
+                lib.tpuRegistrySet(b"TPUMEM_MEMRING_SQPOLL_IDLE_US",
+                                   None)
+            sqpoll_polls = _utils.counter("memring_sqpoll_polls") - polls0
+        ok = all(bool((b.view() == 0x5E).all()) for b in bufs)
+        for b in bufs:
+            b.free()
+
+    ops = 2 * nbufs * spans_per_buf
+    return {
+        "memring_spine_vs_sync": round(sync_dt / spine_dt, 2),
+        "memring_spine_sync_ops_per_s": round(ops / sync_dt, 1),
+        "memring_spine_ops_per_s": round(ops / spine_dt, 1),
+        "memring_sqpoll_vs_futex": round(spine_dt / sqpoll_dt, 2),
+        "memring_sqpoll_polls": sqpoll_polls,
+        "memring_spine_oversub": oversub,
+        "memring_spine_span_kb": span_bytes // 1024,
+        "memring_spine_data_intact": ok,
+        "fault_chain_len_p50": round(
+            _utils.trace_quantile_ns("memring.chain", 0.50), 1),
+        "fault_chain_len_p95": round(
+            _utils.trace_quantile_ns("memring.chain", 0.95), 1),
+    }
+
+
 def measure_tpuce_striping(total_mib: int = 128) -> dict:
     """tpuce acceptance microbench: the SAME block-granular migrate
     workload driven through one serial copy channel vs the striped
@@ -1450,6 +1562,10 @@ def main() -> None:
         extra.update(measure_memring_async_vs_sync())
     except Exception as exc:
         extra["memring_error"] = str(exc)[:200]
+    try:
+        extra.update(measure_memring_spine_vs_sync())
+    except Exception as exc:
+        extra["memring_spine_error"] = str(exc)[:200]
     extra.update(_prior_round_latencies())
     if "prev_fault_p95_us" in extra and extra["prev_fault_p95_us"]:
         extra["fault_p95_vs_prev"] = round(

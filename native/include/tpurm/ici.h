@@ -79,15 +79,27 @@ TpuStatus tpuIciPeerApertureCreate(uint32_t srcInst, uint32_t peerInst,
                                    TpuIciPeerAperture **out);
 void      tpuIciPeerApertureDestroy(TpuIciPeerAperture *ap);
 /* Copy between local HBM offset and peer HBM offset over the aperture
- * (direction: 0 = local->peer write, 1 = peer->local read). */
+ * (direction: 0 = local->peer write, 1 = peer->local read).
+ * SUBMISSION SPINE: publishes the copy as a PEER_COPY SQE on the
+ * process-global internal memring and waits — ICI transfers land in
+ * the same worker pool as every other memory op (single observable
+ * dispatch path; the multi-hop store-and-forward pipeline runs inside
+ * the op's execution). */
 TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
                          uint64_t peerOff, uint64_t size, int direction);
 /* Async variant: records the push in `tracker` instead of waiting, so ICI
  * peer copies synchronize with CE and CXL work through one dependency
- * object (reference: uvm_tracker.c).  tracker == NULL waits (sync). */
+ * object (reference: uvm_tracker.c).  tracker == NULL waits — via the
+ * memring spine, exactly tpuIciPeerCopy. */
 TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
                               uint64_t peerOff, uint64_t size, int direction,
                               TpuTracker *tracker);
+/* The synchronous copy ENGINE entry (direct single/multi-hop execution).
+ * Only the memring spine workers may call this (`make -C native
+ * check-spine`); everyone else goes through tpuIciPeerCopy. */
+TpuStatus tpuIciPeerCopyExec(TpuIciPeerAperture *ap, uint64_t localOff,
+                             uint64_t peerOff, uint64_t size,
+                             int direction);
 
 #ifdef __cplusplus
 }

@@ -62,6 +62,36 @@
  * posts TPU_ERR_DEVICE_RESET instead of its result and is counted
  * (memring_stale_completions) — a zombie's late completion can never
  * masquerade as valid post-reset state.
+ *
+ * THE SUBMISSION SPINE (kernel-internal submission): memring is the
+ * single dispatch path for ALL internal memory traffic, not just
+ * userspace rings.  In-process subsystems — the fault-service batches
+ * (uvm_fault.c), explicit migrations (uvmMigrate), the tier manager's
+ * fused evict+upload pairs, and ICI peer transfers (tpuIciPeerCopy) —
+ * prep SQE chains and publish them on one process-global INTERNAL ring
+ * via tpurmMemringSubmitInternal, with no memfd round-trip.  The
+ * internal ring defaults to ZERO dedicated workers: the submitter
+ * publishes, then HELPS DRAIN the ring (claiming batches like any
+ * worker) until its own group completes — on an idle ring this is the
+ * old synchronous call plus one claim/post, while under load the
+ * claims interleave with other submitters' chains and the worker-side
+ * coalescer merges cross-subsystem runs to the same destination.
+ * Accounting invariant (chaos-soak-checked): memring_internal_sqes ==
+ * sum over subsystems of memring_internal_sqes[<subsys>].
+ *
+ * SQPOLL (io_uring SQPOLL idiom): registry "memring_sqpoll" != 0 puts
+ * ring workers into an always-polling mode — an idle worker registers
+ * in hdr.sqPollers and spins on sqTail for "memring_sqpoll_idle_us"
+ * (default 500) before falling back to the futex sleep, so hot-path
+ * submitters publish with a single release store and ZERO doorbell
+ * futex syscalls (tpurmMemringSubmit skips the FUTEX_WAKE whenever a
+ * poller is registered; the poller's deregister-then-recheck protocol
+ * makes a lost wakeup impossible).  The idle timeout exists because an
+ * always-spinning worker on a 1-2 CPU container would starve the very
+ * engines it drains — memring_sqpoll_polls / memring_sqpoll_sleeps
+ * count the duty cycle.  With sqpoll armed the internal ring also gets
+ * dedicated polling workers (registry "memring_sqpoll_workers",
+ * default 1) so internal submitters need not help-drain at all.
  */
 #ifndef TPURM_MEMRING_H
 #define TPURM_MEMRING_H
@@ -88,8 +118,19 @@ enum {
     TPU_MEMRING_OP_ADVISE = 4,    /* policy op, subcode in arg0         */
     TPU_MEMRING_OP_PEER_COPY = 5, /* ICI peer copy local<->peer HBM     */
     TPU_MEMRING_OP_FENCE = 6,     /* completes after all prior CQEs     */
+    /* Internal-only opcodes (rejected by tpurmMemringPrep on userspace
+     * rings; reachable only through tpurmMemringSubmitInternal): */
+    TPU_MEMRING_OP_FAULT = 7,     /* service one UvmFaultEntry (addr =
+                                   * entry pointer; fault batches chain
+                                   * one of these per pending fault)    */
+    TPU_MEMRING_OP_TIER_EVICT = 8,/* free >= len bytes from the (dstTier,
+                                   * devInst) arena by LRU eviction —
+                                   * best-effort, the fused half of an
+                                   * EVICT->MIGRATE chain               */
     TPU_MEMRING_OP_COUNT
 };
+
+#define TPU_MEMRING_OP_INTERNAL_BASE TPU_MEMRING_OP_FAULT
 
 /* SQE flags.  LINK chains are capped at 64 entries (one worker claim,
  * so claimed-whole execution holds); a longer chain fails prep with
@@ -180,6 +221,12 @@ typedef struct {
     TPU_MEMRING_ATOMIC_U64 completed;    /* CQEs ever posted            */
     TPU_MEMRING_ATOMIC_U64 errorCqes;    /* CQEs with status != TPU_OK  */
     TPU_MEMRING_ATOMIC_U64 cqOverflows;  /* CQEs dropped, CQ full       */
+    /* SQPOLL: workers currently busy-polling sqTail.  Nonzero lets the
+     * submit path skip the doorbell FUTEX_WAKE syscall entirely (the
+     * inverse of io_uring's SQ_NEED_WAKEUP bit).  Appended after the
+     * original header fields so pre-SQPOLL external mappers keep their
+     * offsets. */
+    TPU_MEMRING_ATOMIC_U32 sqPollers;
 } TpuMemringHdr;
 
 #define TPU_MEMRING_SQ_OFFSET 4096
@@ -207,11 +254,13 @@ TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe);
 uint32_t  tpurmMemringSubmit(TpuMemring *r);
 
 /* Submit, then block until at least waitFor CQEs are reapable
- * (waitFor == 0: no wait).  Returns the number submitted.  NOTE: the
- * wait's status is discarded (a convenience for reap-everything
- * callers); when a timeout or the CQ-overflow bail must be observed,
- * call tpurmMemringSubmit + tpurmMemringWait/WaitDrain yourself. */
-uint32_t  tpurmMemringSubmitAndWait(TpuMemring *r, uint32_t waitFor);
+ * (waitFor == 0: no wait).  Returns the number submitted.  The wait's
+ * status lands in *waitStatus when non-NULL (TPU_OK, or the timeout /
+ * CQ-overflow bail from tpurmMemringWait — the Python surface raises
+ * on it); passing NULL keeps the old discard-the-status convenience
+ * for reap-everything callers. */
+uint32_t  tpurmMemringSubmitAndWait(TpuMemring *r, uint32_t waitFor,
+                                    TpuStatus *waitStatus);
 
 /* Reap up to max completions into out; returns the count reaped. */
 uint32_t  tpurmMemringReap(TpuMemring *r, TpuMemringCqe *out, uint32_t max);
@@ -242,6 +291,42 @@ void tpurmMemringCounts(TpuMemring *r, uint64_t *submitted,
 /* The memfd backing the ring region (header + SQ + CQ): map it for
  * external observation; dup before shipping cross-process. */
 int tpurmMemringShmFd(TpuMemring *r);
+
+/* ------------------------------------------------ kernel-internal spine */
+
+/* Per-subsystem accounting tags for internal submissions (scoped
+ * counters memring_internal_sqes[<tag>]). */
+enum {
+    TPU_MEMRING_SUBSYS_FAULT = 0,   /* fault-service chains           */
+    TPU_MEMRING_SUBSYS_TIER,        /* tier evict / fused evict half  */
+    TPU_MEMRING_SUBSYS_ICI,         /* ICI peer transfers             */
+    TPU_MEMRING_SUBSYS_MIGRATE,     /* explicit uvmMigrate traffic    */
+    TPU_MEMRING_SUBSYS_COUNT
+};
+
+/* Publish sqes[0..n) on the process-global internal ring as ONE batch
+ * (LINK flags inside the batch are honored; the final entry's LINK is
+ * cleared — the batch is the publication boundary) and block until all
+ * n ops complete.  `vs` is the VA space the batch's MIGRATE/PREFETCH/
+ * EVICT/ADVISE/TIER_EVICT ops execute against (rides a per-op side
+ * slot, so batches from different spaces interleave on the one ring);
+ * OP_FAULT carries its entry pointer in sqe.addr and ignores vs of
+ * other subsystems' runs when coalescing.  stOut, when non-NULL, takes
+ * n per-op statuses (chain-cancelled ops report
+ * TPU_ERR_INVALID_STATE).  Returns the first non-OK status in the
+ * batch, TPU_OK otherwise.
+ *
+ * Execution: with zero internal workers (default) the CALLER drains
+ * the ring until its group completes (submit-and-help); with SQPOLL or
+ * "memring_internal_workers" > 0 dedicated workers drain it.  Called
+ * from inside a memring worker (a dependent submission) or while the
+ * pools are reset-parked, the batch executes INLINE on the caller —
+ * still counted (memring_internal_inline) — so dependent work can
+ * never deadlock the pool and quiesce is never bypassed by a queued
+ * ghost. */
+TpuStatus tpurmMemringSubmitInternal(struct UvmVaSpace *vs,
+                                     const TpuMemringSqe *sqes, uint32_t n,
+                                     TpuStatus *stOut, uint32_t subsys);
 
 #ifdef __cplusplus
 }

@@ -69,6 +69,10 @@ typedef enum {
     TPU_TRACE_MSGQ_PUBLISH,      /* msgq submit                        */
     TPU_TRACE_MEMRING_SUBMIT,    /* memring batch publish + doorbell   */
     TPU_TRACE_MEMRING_OP,        /* one memring run (coalesced span)   */
+    TPU_TRACE_MEMRING_CHAIN,     /* internal-spine chain LENGTH (the
+                                  * histogram holds chain sizes, not
+                                  * ns — fault batches feed it one
+                                  * record per submitted chain)        */
     TPU_TRACE_CE_COPY,           /* tpuce batch copy (split + submit)  */
     TPU_TRACE_CE_STRIPE,         /* executor stripe run (obj = channel) */
     TPU_TRACE_SCHED_ROUND,       /* tpusched decode round (obj = round) */

@@ -331,9 +331,35 @@ TpuStatus uvmUnregisterDevice(UvmVaSpace *vs, uint32_t devInst);
 TpuStatus uvmMemAlloc(UvmVaSpace *vs, uint64_t size, void **outPtr);
 TpuStatus uvmMemFree(UvmVaSpace *vs, void *ptr);
 
-/* Explicit migration of [base, base+len) to dst (UvmMigrate analog). */
+/* Explicit migration of [base, base+len) to dst (UvmMigrate analog).
+ * SUBMISSION SPINE: this is a thin wrapper that publishes the span as
+ * a MIGRATE SQE on the process-global internal memring (prefixed by a
+ * fused TIER_EVICT when the destination arena is under pressure —
+ * registry "memring_fused_evict", default on) and waits for the
+ * completion, so every migration rides the one dispatch path where
+ * batching/coalescing happen.  Semantics are unchanged: synchronous,
+ * same status surface. */
 TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
                      UvmLocation dst, uint32_t flags);
+
+/* The synchronous migration ENGINE entry.  Only the memring spine
+ * workers may call this (enforced by `make -C native check-spine`);
+ * everyone else goes through uvmMigrate. */
+TpuStatus uvmMigrateExec(UvmVaSpace *vs, void *base, uint64_t len,
+                         UvmLocation dst, uint32_t flags);
+
+/* Spine hook: execute one pending fault entry (opaque UvmFaultEntry
+ * pointer from the fault engine's OP_FAULT chains).  Runs the bounded
+ * retry + cancel/quarantine pipeline and records the service
+ * histograms; returns the entry's final service status. */
+TpuStatus uvmFaultServiceExec(void *entry);
+
+/* Spine hook (OP_TIER_EVICT): best-effort LRU eviction from the
+ * (tier, devInst) arena until it can take `bytes` more, the fused
+ * evict half of an EVICT->MIGRATE chain.  Returns bytes' worth of
+ * arena space now free (0 when the tier has no arena). */
+uint64_t uvmTierEvictBytes(uint32_t tier, uint32_t devInst,
+                           uint64_t bytes);
 
 /* Policy (uvm_va_policy.c analogs). */
 TpuStatus uvmSetPreferredLocation(UvmVaSpace *vs, void *base, uint64_t len,

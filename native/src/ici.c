@@ -19,6 +19,7 @@
 #include "tpurm/ce.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
+#include "tpurm/memring.h"
 #include "tpurm/trace.h"
 #include "tpurm/uvm.h"
 
@@ -737,7 +738,10 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
                               uint64_t peerOff, uint64_t size, int direction,
                               TpuTracker *tracker)
 {
-    /* Span chokepoint: the inner function has many returns. */
+    /* Tracker handoff needs the direct path (a ring round-trip would
+     * defeat the async contract); the sync form rides the spine. */
+    if (!tracker)
+        return tpuIciPeerCopy(ap, localOff, peerOff, size, direction);
     uint64_t t0 = tpurmTraceBegin();
     TpuStatus st = ici_peer_copy_async(ap, localOff, peerOff, size,
                                        direction, tracker);
@@ -748,8 +752,42 @@ TpuStatus tpuIciPeerCopyAsync(TpuIciPeerAperture *ap, uint64_t localOff,
     return st;
 }
 
+/* Direct engine execution — the memring spine workers' entry
+ * (everything else submits through tpuIciPeerCopy). */
+TpuStatus tpuIciPeerCopyExec(TpuIciPeerAperture *ap, uint64_t localOff,
+                             uint64_t peerOff, uint64_t size, int direction)
+{
+    uint64_t t0 = tpurmTraceBegin();
+    TpuStatus st = ici_peer_copy_async(ap, localOff, peerOff, size,
+                                       direction, NULL);
+    if (t0)
+        tpurmTraceEnd(TPU_TRACE_ICI_COPY,
+                      t0, ap ? (((uint64_t)ap->srcInst << 32) |
+                                ap->peerInst) : 0, size);
+    return st;
+}
+
 TpuStatus tpuIciPeerCopy(TpuIciPeerAperture *ap, uint64_t localOff,
                          uint64_t peerOff, uint64_t size, int direction)
 {
-    return tpuIciPeerCopyAsync(ap, localOff, peerOff, size, direction, NULL);
+    if (!ap || size == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    /* Spine submission: one PEER_COPY SQE on the internal ring (the
+     * worker resolves its own cached aperture for the pair and runs
+     * the single/multi-hop pipeline via tpuIciPeerCopyExec).  All ICI
+     * transfers are thereby ring-accounted and share the pool's
+     * claim/coalesce machinery with fault and tier traffic. */
+    TpuMemringSqe s;
+    memset(&s, 0, sizeof(s));
+    s.opcode = TPU_MEMRING_OP_PEER_COPY;
+    s.devInst = ap->srcInst;
+    s.peerInst = ap->peerInst;
+    s.addr = localOff;
+    s.peerOff = peerOff;
+    s.len = size;
+    s.arg0 = direction ? TPU_MEMRING_PEER_READ : TPU_MEMRING_PEER_WRITE;
+    TpuStatus st = TPU_OK;
+    TpuStatus sub = tpurmMemringSubmitInternal(NULL, &s, 1, &st,
+                                               TPU_MEMRING_SUBSYS_ICI);
+    return st != TPU_OK ? st : sub;
 }

@@ -417,6 +417,8 @@ void tpurmChannelSetCeAcct(TpurmChannel *ch, _Atomic uint64_t *bytesCtr,
  * generation fencing rejects the zombie completion). */
 TpuStatus tpurmMemringParkAll(uint64_t timeoutNs);
 void      tpurmMemringUnparkAll(void);
+/* True while the park gate is held (reset quiesce window). */
+bool      tpurmMemringSpineParked(void);
 
 /* Hung-op watchdog scan: for every ring with in-flight work and no
  * completion progress for hangNs, take the next escalation-ladder rung

@@ -32,6 +32,7 @@
 #include <errno.h>
 #include <linux/futex.h>
 #include <pthread.h>
+#include <sched.h>
 #include <stdatomic.h>
 #include <stdbool.h>
 #include <stdlib.h>
@@ -50,7 +51,30 @@
 
 #define MEMRING_MAX_WORKERS 8
 #define MEMRING_POP_BATCH   64     /* max non-linked ops claimed per pop */
-#define MEMRING_APERTURES   8      /* cached ICI peer apertures per ring */
+#define MEMRING_APERTURES   64     /* cached ICI peer apertures per ring:
+                                    * every sync tpuIciPeerCopy resolves
+                                    * through this cache now, so it must
+                                    * hold a full mesh's directed pairs
+                                    * (16-device torus: 48ish) without
+                                    * per-copy create/destroy churn */
+
+/* Internal-spine completion group: one per tpurmMemringSubmitInternal
+ * call, living on the submitter's stack.  `remaining` is the futex the
+ * submitter parks on; the final post wakes it. */
+typedef struct {
+    _Atomic uint32_t remaining;
+    _Atomic uint32_t firstErr;        /* first non-OK TpuStatus, else 0 */
+} MrGroup;
+
+/* Per-SQE side slot (internal ring only — userspace rings keep the
+ * fixed 64-byte ABI): the op's VA space, its completion group, and an
+ * optional per-op status out.  Copied out under popLock at claim time,
+ * before sqHead advances and the producer may reuse the slot. */
+typedef struct {
+    UvmVaSpace *vs;
+    MrGroup *grp;
+    TpuStatus *stOut;
+} MrSlot;
 
 struct TpuMemring {
     UvmVaSpace *vs;
@@ -61,6 +85,14 @@ struct TpuMemring {
     TpuMemringSqe *sq;
     TpuMemringCqe *cq;
     uint32_t sqMask, cqMask;
+
+    /* Internal spine state: the process-global internal ring carries
+     * per-op side slots (vs/group/status) and serializes its MANY
+     * producers behind prodLock (userspace rings stay single-producer
+     * lock-free). */
+    bool internal;
+    MrSlot *slots;                /* sqEntries entries, internal only */
+    pthread_mutex_t prodLock;
 
     /* Producer-private staging cursor (slots filled but unpublished). */
     uint32_t pendTail;
@@ -113,6 +145,51 @@ static struct {
     _Atomic uint32_t parkWord;
 } g_mrings = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
+/* The process-global INTERNAL ring (the submission spine).  Created on
+ * first internal submission; never destroyed (process lifetime, like
+ * the fault engine). */
+static struct {
+    pthread_once_t once;
+    TpuMemring *ring;
+} g_int = { .once = PTHREAD_ONCE_INIT };
+
+/* Nonzero while this thread is executing claimed ring ops (worker or
+ * help-draining submitter).  A dependent internal submission from such
+ * a context executes INLINE instead of queueing behind itself. */
+static __thread int t_mrWorker;
+
+/* Pre-resolved internal-accounting counter cells (hot path: one per
+ * fault batch). */
+static _Atomic(_Atomic uint64_t *) g_intTotalRef;
+static _Atomic(_Atomic uint64_t *) g_intSubsysRef[TPU_MEMRING_SUBSYS_COUNT];
+static const char *const g_subsysName[TPU_MEMRING_SUBSYS_COUNT] = {
+    "memring_internal_sqes[fault]",
+    "memring_internal_sqes[tier]",
+    "memring_internal_sqes[ici]",
+    "memring_internal_sqes[migrate]",
+};
+
+/* One-shot-resolved counter cell (skips the name-hash lookup on every
+ * hot-path bump; the cpuRef pattern from uvm_fault.c). */
+static inline void mr_ctr_cached(_Atomic(_Atomic uint64_t *) *ref,
+                                 const char *name, uint64_t n)
+{
+    _Atomic uint64_t *c = atomic_load_explicit(ref, memory_order_relaxed);
+    if (!c) {
+        c = tpuCounterRef(name);
+        atomic_store_explicit(ref, c, memory_order_relaxed);
+    }
+    if (c)
+        atomic_fetch_add_explicit(c, n, memory_order_relaxed);
+}
+
+static void mr_internal_account(uint32_t subsys, uint32_t n)
+{
+    mr_ctr_cached(&g_intTotalRef, "memring_internal_sqes", n);
+    if (subsys < TPU_MEMRING_SUBSYS_COUNT)
+        mr_ctr_cached(&g_intSubsysRef[subsys], g_subsysName[subsys], n);
+}
+
 static long mr_futex(TPU_MEMRING_ATOMIC_U32 *uaddr, int op, uint32_t val,
                      const struct timespec *ts)
 {
@@ -131,9 +208,9 @@ static uint32_t pow2_at_least(uint32_t v, uint32_t floor)
 /* ------------------------------------------------------------ CQE post */
 
 static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
-                     TpuStatus st, uint64_t bytes, uint64_t seq,
-                     uint64_t t0, uint64_t t1, bool countInflight,
-                     uint64_t claimGen)
+                     const MrSlot *slot, TpuStatus st, uint64_t bytes,
+                     uint64_t seq, uint64_t t0, uint64_t t1,
+                     bool countInflight, uint64_t claimGen)
 {
     /* Generation fence: a completion whose claim predates a full-device
      * reset is STALE — quiesce waited for in-flight work, so the only
@@ -147,28 +224,37 @@ static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
         tpuCounterAdd("memring_stale_completions", 1);
     }
     atomic_store_explicit(&r->lastProgressNs, t1, memory_order_relaxed);
-    pthread_mutex_lock(&r->cqLock);
-    uint32_t head = atomic_load_explicit(&r->hdr->cqHead,
-                                         memory_order_acquire);
-    uint32_t tail = atomic_load_explicit(&r->hdr->cqTail,
-                                         memory_order_relaxed);
-    if (tail - head >= r->hdr->cqEntries) {
-        /* Consumer asleep at the wheel: drop + count, never block the
-         * pool (fences key off `completed`, not off CQ slots). */
-        atomic_fetch_add(&r->hdr->cqOverflows, 1);
-        tpuCounterAdd("memring_cq_overflows", 1);
-    } else {
-        TpuMemringCqe *c = &r->cq[tail & r->cqMask];
-        c->userData = sqe->userData;
-        c->status = (uint32_t)st;
-        c->opcode = sqe->opcode;
-        c->bytes = bytes;
-        c->seq = seq;
-        c->startNs = t0;
-        c->endNs = t1;
-        c->pad[0] = c->pad[1] = 0;
-        atomic_store_explicit(&r->hdr->cqTail, tail + 1,
-                              memory_order_release);
+    /* Slot-carrying internal ops complete through their MrGroup, and
+     * nothing ever reaps the internal ring's CQ — writing CQEs there
+     * would permanently overflow it after one CQ's worth of traffic,
+     * inflating the memring_cq_overflows pathology signal on healthy
+     * load (and paying cqLock per op for entries no one reads).  Their
+     * accounting (completed/errorCqes/counters) still advances. */
+    bool wantCqe = !(r->internal && slot);
+    if (wantCqe) {
+        pthread_mutex_lock(&r->cqLock);
+        uint32_t head = atomic_load_explicit(&r->hdr->cqHead,
+                                             memory_order_acquire);
+        uint32_t tail = atomic_load_explicit(&r->hdr->cqTail,
+                                             memory_order_relaxed);
+        if (tail - head >= r->hdr->cqEntries) {
+            /* Consumer asleep at the wheel: drop + count, never block
+             * the pool (fences key off `completed`, not CQ slots). */
+            atomic_fetch_add(&r->hdr->cqOverflows, 1);
+            tpuCounterAdd("memring_cq_overflows", 1);
+        } else {
+            TpuMemringCqe *c = &r->cq[tail & r->cqMask];
+            c->userData = sqe->userData;
+            c->status = (uint32_t)st;
+            c->opcode = sqe->opcode;
+            c->bytes = bytes;
+            c->seq = seq;
+            c->startNs = t0;
+            c->endNs = t1;
+            c->pad[0] = c->pad[1] = 0;
+            atomic_store_explicit(&r->hdr->cqTail, tail + 1,
+                                  memory_order_release);
+        }
     }
     atomic_fetch_add(&r->hdr->completed, 1);
     if (st != TPU_OK) {
@@ -176,16 +262,37 @@ static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
         tpuCounterAdd("memring_error_cqes", 1);
     }
     tpuCounterAdd("memring_cqes", 1);
-    atomic_fetch_add(&r->hdr->cqReady, 1);
-    pthread_mutex_unlock(&r->cqLock);
+    if (wantCqe) {
+        atomic_fetch_add(&r->hdr->cqReady, 1);
+        pthread_mutex_unlock(&r->cqLock);
+    }
     /* Wake only when a consumer is (about to be) parked: the waiter
      * registers in cqWaiters BEFORE its last availability re-check, so
      * a zero read here (seq_cst, after the cqReady bump) means any
      * concurrent waiter will see this CQE, or see cqReady changed and
      * fail its FUTEX_WAIT with EAGAIN — never a lost wakeup.  Saves a
      * syscall per CQE on the waiter-free fast path. */
-    if (atomic_load(&r->hdr->cqWaiters) != 0)
+    if (wantCqe && atomic_load(&r->hdr->cqWaiters) != 0)
         mr_futex(&r->hdr->cqReady, FUTEX_WAKE, INT32_MAX, NULL);
+
+    /* Internal-spine completion group: record the op's status and, on
+     * the group's LAST completion, wake the parked submitter.  The
+     * (possibly generation-fenced) st above is what lands in stOut —
+     * internal submitters see DEVICE_RESET exactly like ring reapers. */
+    if (slot) {
+        if (slot->stOut)
+            *slot->stOut = st;
+        if (slot->grp) {
+            if (st != TPU_OK) {
+                uint32_t zero = 0;
+                atomic_compare_exchange_strong(&slot->grp->firstErr, &zero,
+                                               (uint32_t)st);
+            }
+            if (atomic_fetch_sub(&slot->grp->remaining, 1) == 1)
+                mr_futex(&slot->grp->remaining, FUTEX_WAKE, INT32_MAX,
+                         NULL);
+        }
+    }
 
     if (countInflight) {
         atomic_fetch_sub(&r->inflight, 1);
@@ -234,9 +341,11 @@ static TpuIciPeerAperture *aperture_get(TpuMemring *r, uint32_t src,
 }
 
 /* One engine call for one SQE (runs are pre-merged by the caller, which
- * extends `len` over a coalesced span). */
+ * extends `len` over a coalesced span).  `vs` is the op's VA space —
+ * the ring's own binding for userspace rings, the per-op side slot for
+ * internal-spine submissions. */
 static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
-                          uint64_t len, uint64_t *bytesOut)
+                          UvmVaSpace *vs, uint64_t len, uint64_t *bytesOut)
 {
     *bytesOut = 0;
     switch (sqe->opcode) {
@@ -247,7 +356,7 @@ static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
         if (sqe->arg1) {
             uint64_t left = sqe->arg1 > 10000000000ull ? 10000000000ull
                                                        : sqe->arg1;
-            while (left && !atomic_load(&r->shutdown)) {
+            while (left && !(r && atomic_load(&r->shutdown))) {
                 uint64_t slice = left > 10000000ull ? 10000000ull : left;
                 struct timespec ts = { .tv_sec = 0,
                                        .tv_nsec = (long)slice };
@@ -257,19 +366,19 @@ static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
         }
         return TPU_OK;
     case TPU_MEMRING_OP_MIGRATE: {
-        if (!r->vs)
+        if (!vs)
             return TPU_ERR_INVALID_STATE;
         UvmLocation loc = { (UvmTier)sqe->dstTier, sqe->devInst };
-        TpuStatus st = uvmMigrate(r->vs, (void *)(uintptr_t)sqe->addr,
-                                  len, loc, 0);
+        TpuStatus st = uvmMigrateExec(vs, (void *)(uintptr_t)sqe->addr,
+                                      len, loc, 0);
         if (st == TPU_OK)
             *bytesOut = len;
         return st;
     }
     case TPU_MEMRING_OP_PREFETCH: {
-        if (!r->vs)
+        if (!vs)
             return TPU_ERR_INVALID_STATE;
-        TpuStatus st = uvmDeviceAccess(r->vs, sqe->devInst,
+        TpuStatus st = uvmDeviceAccess(vs, sqe->devInst,
                                        (void *)(uintptr_t)sqe->addr, len,
                                        (sqe->flags & TPU_MEMRING_SQE_WRITE)
                                            ? 1 : 0);
@@ -278,38 +387,38 @@ static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
         return st;
     }
     case TPU_MEMRING_OP_EVICT: {
-        if (!r->vs)
+        if (!vs)
             return TPU_ERR_INVALID_STATE;
         /* Tier DEMOTE only: HBM is a promotion, not an eviction. */
         if (sqe->dstTier != UVM_TIER_HOST && sqe->dstTier != UVM_TIER_CXL)
             return TPU_ERR_INVALID_ARGUMENT;
         UvmLocation loc = { (UvmTier)sqe->dstTier, 0 };
-        TpuStatus st = uvmMigrate(r->vs, (void *)(uintptr_t)sqe->addr,
-                                  len, loc, 0);
+        TpuStatus st = uvmMigrateExec(vs, (void *)(uintptr_t)sqe->addr,
+                                      len, loc, 0);
         if (st == TPU_OK)
             *bytesOut = len;
         return st;
     }
     case TPU_MEMRING_OP_ADVISE: {
-        if (!r->vs)
+        if (!vs)
             return TPU_ERR_INVALID_STATE;
         void *addr = (void *)(uintptr_t)sqe->addr;
         switch (sqe->arg0) {
         case TPU_MEMRING_ADVISE_PREFERRED: {
             UvmLocation loc = { (UvmTier)sqe->dstTier, sqe->devInst };
-            return uvmSetPreferredLocation(r->vs, addr, len, loc);
+            return uvmSetPreferredLocation(vs, addr, len, loc);
         }
         case TPU_MEMRING_ADVISE_UNSET_PREFERRED:
-            return uvmUnsetPreferredLocation(r->vs, addr, len);
+            return uvmUnsetPreferredLocation(vs, addr, len);
         case TPU_MEMRING_ADVISE_ACCESSED_BY:
-            return uvmSetAccessedBy(r->vs, addr, len, sqe->devInst);
+            return uvmSetAccessedBy(vs, addr, len, sqe->devInst);
         case TPU_MEMRING_ADVISE_UNSET_ACCESSED_BY:
-            return uvmUnsetAccessedBy(r->vs, addr, len, sqe->devInst);
+            return uvmUnsetAccessedBy(vs, addr, len, sqe->devInst);
         case TPU_MEMRING_ADVISE_READ_DUP:
-            return uvmSetReadDuplication(r->vs, addr, len,
+            return uvmSetReadDuplication(vs, addr, len,
                                          sqe->arg1 ? 1 : 0);
         case TPU_MEMRING_ADVISE_COMPRESSIBLE:
-            return uvmSetCompressible(r->vs, addr, len,
+            return uvmSetCompressible(vs, addr, len,
                                       (uint32_t)sqe->arg1);
         default:
             return TPU_ERR_INVALID_ARGUMENT;
@@ -317,19 +426,37 @@ static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
     }
     case TPU_MEMRING_OP_PEER_COPY: {
         bool temp = false;
-        TpuIciPeerAperture *ap = aperture_get(r, sqe->devInst,
-                                              sqe->peerInst, &temp);
+        TpuIciPeerAperture *ap = NULL;
+        if (r) {
+            ap = aperture_get(r, sqe->devInst, sqe->peerInst, &temp);
+        } else if (tpuIciPeerApertureCreate(sqe->devInst, sqe->peerInst,
+                                            &ap) == TPU_OK) {
+            temp = true;           /* ringless inline: no cache to use */
+        }
         if (!ap)
             return TPU_ERR_INVALID_DEVICE;
-        TpuStatus st = tpuIciPeerCopy(ap, sqe->addr, sqe->peerOff, len,
-                                      sqe->arg0 == TPU_MEMRING_PEER_READ
-                                          ? 1 : 0);
+        TpuStatus st = tpuIciPeerCopyExec(ap, sqe->addr, sqe->peerOff, len,
+                                          sqe->arg0 == TPU_MEMRING_PEER_READ
+                                              ? 1 : 0);
         if (temp)
             tpuIciPeerApertureDestroy(ap);
         if (st == TPU_OK)
             *bytesOut = len;
         return st;
     }
+    case TPU_MEMRING_OP_FAULT:
+        /* Internal spine: service one pending fault entry (pointer in
+         * addr; the entry lives on its faulting thread's stack until
+         * the fault worker replays it, strictly after this CQE). */
+        return uvmFaultServiceExec((void *)(uintptr_t)sqe->addr);
+    case TPU_MEMRING_OP_TIER_EVICT:
+        /* Fused-chain evict half: best-effort LRU eviction until the
+         * target arena can take `len` more bytes.  Always reports OK
+         * (an under-delivered evict just means the linked MIGRATE runs
+         * the engine's own pressure path) so LINK semantics never
+         * cancel the upload half. */
+        uvmTierEvictBytes(sqe->dstTier, sqe->devInst, len);
+        return TPU_OK;
     default:
         return TPU_ERR_INVALID_COMMAND;
     }
@@ -352,7 +479,7 @@ static bool status_permanent(TpuStatus st)
     }
 }
 
-static TpuRegCache g_retryCache;
+static TpuRegCache g_retryCache, g_copyRetryCache;
 
 /* Execute one RUN (one engine call over a possibly-coalesced span) with
  * injection + bounded-backoff retry.  The run is the failure domain:
@@ -364,12 +491,27 @@ static TpuRegCache g_retryCache;
  * error CQEs). */
 static TpuStatus exec_run_recovered(TpuMemring *r,
                                     const TpuMemringSqe *sqe,
+                                    UvmVaSpace *vs,
                                     uint64_t len, uint64_t *bytesOut,
                                     bool *injectedFail)
 {
+    /* Retry budget defaults to recover_copy_retries (tpuce doctrine:
+     * "retries disabled" must govern the WHOLE copy path — now that
+     * every uvmMigrate rides the spine, a private always-on budget
+     * here would resurrect retries the operator turned off). */
+    uint32_t copyDflt = (uint32_t)tpuRegCacheGet(&g_copyRetryCache,
+                                                 "recover_copy_retries", 3);
     uint32_t maxRetry = (uint32_t)tpuRegCacheGet(&g_retryCache,
-                                                 "memring_retry_max", 3);
+                                                 "memring_retry_max",
+                                                 copyDflt);
     *injectedFail = false;
+    /* Internal opcodes own their recovery: OP_FAULT wraps the fault
+     * engine's bounded retry + quarantine (a ring-level re-service of
+     * a cancelled entry would double-quarantine), OP_TIER_EVICT is
+     * best-effort by contract.  Neither evaluates memring.submit, so
+     * the inject invariant stays exact over the retryable opcodes. */
+    if (sqe->opcode >= TPU_MEMRING_OP_INTERNAL_BASE)
+        return exec_sqe(r, sqe, vs, len, bytesOut);
     for (uint32_t attempt = 0;; attempt++) {
         TpuStatus st;
         bool injected = tpurmInjectShouldFailScoped(
@@ -377,7 +519,7 @@ static TpuStatus exec_run_recovered(TpuMemring *r,
         if (injected)
             st = TPU_ERR_RETRY_EXHAUSTED;   /* transient by construction */
         else
-            st = exec_sqe(r, sqe, len, bytesOut);
+            st = exec_sqe(r, sqe, vs, len, bytesOut);
         if (st == TPU_OK)
             return TPU_OK;
         if (!injected && status_permanent(st))
@@ -400,9 +542,14 @@ static TpuStatus exec_run_recovered(TpuMemring *r,
 
 /* ------------------------------------------------------- worker drain */
 
-/* Can SQE b extend a run started by SQE a into one engine call? */
-static bool run_merges(const TpuMemringSqe *a, uint64_t runEnd,
-                       const TpuMemringSqe *b)
+/* Can SQE b extend a run started by SQE a into one engine call?  On
+ * the internal ring ops carry per-op VA spaces (aSlot/bSlot): a merge
+ * additionally requires the same space — this is where fault-driven
+ * and prefetch-driven runs from DIFFERENT subsystems coalesce when
+ * they target the same destination in the same space. */
+static bool run_merges(const TpuMemringSqe *a, const MrSlot *aSlot,
+                       uint64_t runEnd, const TpuMemringSqe *b,
+                       const MrSlot *bSlot)
 {
     if (b->opcode != a->opcode || b->flags != a->flags)
         return false;
@@ -411,6 +558,8 @@ static bool run_merges(const TpuMemringSqe *a, uint64_t runEnd,
         a->opcode != TPU_MEMRING_OP_EVICT)
         return false;
     if (b->dstTier != a->dstTier || b->devInst != a->devInst)
+        return false;
+    if ((aSlot ? aSlot->vs : NULL) != (bSlot ? bSlot->vs : NULL))
         return false;
     /* Deadlines stay per-run homogeneous so expiry applies whole-run. */
     if (b->deadlineNs != a->deadlineNs)
@@ -431,15 +580,19 @@ static bool sqe_deadline_expired(const TpuMemringSqe *sqe, uint64_t now)
 }
 
 /* Execute batch[0..n) (no links, no fences): coalesce contiguous
- * compatible spans, run each merged span once, post per-SQE CQEs. */
+ * compatible spans, run each merged span once, post per-SQE CQEs.
+ * `slots` is the parallel side-slot array (NULL on userspace rings). */
 static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
-                       uint32_t n, uint64_t firstSeq, uint64_t claimGen)
+                       const MrSlot *slots, uint32_t n, uint64_t firstSeq,
+                       uint64_t claimGen)
 {
     uint32_t i = 0;
     while (i < n) {
+        const MrSlot *slot = slots ? &slots[i] : NULL;
+        UvmVaSpace *vs = slot && slot->vs ? slot->vs : r->vs;
         if (sqe_deadline_expired(&batch[i], tpuNowNs())) {
             uint64_t now = tpuNowNs();
-            post_cqe(r, &batch[i], TPU_ERR_RETRY_EXHAUSTED, 0,
+            post_cqe(r, &batch[i], slot, TPU_ERR_RETRY_EXHAUSTED, 0,
                      firstSeq + i, now, now, true, claimGen);
             i++;
             continue;
@@ -447,8 +600,9 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
         uint32_t runLen = 1;
         uint64_t spanLen = batch[i].len;
         while (i + runLen < n &&
-               run_merges(&batch[i], batch[i].addr + spanLen,
-                          &batch[i + runLen])) {
+               run_merges(&batch[i], slot, batch[i].addr + spanLen,
+                          &batch[i + runLen],
+                          slots ? &slots[i + runLen] : NULL)) {
             spanLen += batch[i + runLen].len;
             runLen++;
         }
@@ -458,8 +612,8 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
         uint64_t moved = 0;
         bool injectedFail = false;
         uint64_t tSpan = tpurmTraceBegin();
-        TpuStatus st = exec_run_recovered(r, &batch[i], spanLen, &moved,
-                                          &injectedFail);
+        TpuStatus st = exec_run_recovered(r, &batch[i], vs, spanLen,
+                                          &moved, &injectedFail);
         if (tSpan)
             tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan,
                           batch[i].userData, spanLen);
@@ -472,7 +626,7 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
              * (always move ops) split the span by each SQE's len; a
              * lone op reports what exec_sqe actually moved, so ADVISE/
              * NOP post bytes == 0 here exactly as they do in chains. */
-            post_cqe(r, &batch[i + k], st,
+            post_cqe(r, &batch[i + k], slots ? &slots[i + k] : NULL, st,
                      st != TPU_OK ? 0
                                   : (runLen > 1 ? batch[i + k].len
                                                 : moved),
@@ -483,20 +637,23 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
 
 /* Execute a LINK chain sequentially; first failure cancels the rest. */
 static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
-                       uint32_t n, uint64_t firstSeq, uint64_t claimGen)
+                       const MrSlot *slots, uint32_t n, uint64_t firstSeq,
+                       uint64_t claimGen)
 {
     bool cancelled = false;
     for (uint32_t i = 0; i < n; i++) {
+        const MrSlot *slot = slots ? &slots[i] : NULL;
+        UvmVaSpace *vs = slot && slot->vs ? slot->vs : r->vs;
         if (cancelled) {
             uint64_t now = tpuNowNs();
             tpuCounterAdd("memring_links_cancelled", 1);
-            post_cqe(r, &chain[i], TPU_ERR_INVALID_STATE, 0,
+            post_cqe(r, &chain[i], slot, TPU_ERR_INVALID_STATE, 0,
                      firstSeq + i, now, now, true, claimGen);
             continue;
         }
         uint64_t t0 = tpuNowNs();
         if (sqe_deadline_expired(&chain[i], t0)) {
-            post_cqe(r, &chain[i], TPU_ERR_RETRY_EXHAUSTED, 0,
+            post_cqe(r, &chain[i], slot, TPU_ERR_RETRY_EXHAUSTED, 0,
                      firstSeq + i, t0, t0, true, claimGen);
             cancelled = true;      /* chain semantics: failure cancels */
             continue;
@@ -504,7 +661,7 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
         uint64_t moved = 0;
         bool injectedFail = false;
         uint64_t tSpan = tpurmTraceBegin();
-        TpuStatus st = exec_run_recovered(r, &chain[i], chain[i].len,
+        TpuStatus st = exec_run_recovered(r, &chain[i], vs, chain[i].len,
                                           &moved, &injectedFail);
         if (tSpan)
             tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan, chain[i].userData,
@@ -512,17 +669,114 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
         tpuCounterAdd("memring_ops", 1);
         if (injectedFail)
             tpuCounterAdd("memring_inject_error_cqes", 1);
-        post_cqe(r, &chain[i], st, moved, firstSeq + i, t0, tpuNowNs(),
-                 true, claimGen);
+        post_cqe(r, &chain[i], slot, st, moved, firstSeq + i, t0,
+                 tpuNowNs(), true, claimGen);
         if (st != TPU_OK)
             cancelled = true;
     }
 }
 
+/* Claim the next fence / chain / plain-op run and execute it.  The
+ * single drain body shared by pool workers and help-draining internal
+ * submitters.  Returns true when it made progress (claimed, executed,
+ * or consumed a fence — callers loop), false when the SQ was empty. */
+static bool mr_claim_and_exec(TpuMemring *r)
+{
+    TpuMemringSqe local[MEMRING_POP_BATCH];
+    MrSlot localSlots[MEMRING_POP_BATCH];
+
+    pthread_mutex_lock(&r->popLock);
+    uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
+                                         memory_order_relaxed);
+    uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
+                                         memory_order_acquire);
+    if (head == tail) {
+        pthread_mutex_unlock(&r->popLock);
+        return false;
+    }
+
+    const TpuMemringSqe *first = &r->sq[head & r->sqMask];
+    if (first->opcode == TPU_MEMRING_OP_FENCE) {
+        /* Drain: nothing later can be claimed until every in-flight op
+         * retires.  cond_wait RELEASES the pop lock, so another worker
+         * may consume this same fence while we sleep — after any
+         * wakeup, report progress and let the caller re-read head/tail
+         * fresh instead of trusting the stale claim. */
+        atomic_fetch_add(&r->drainWaiters, 1);
+        if (atomic_load(&r->inflight) > 0 &&
+            !atomic_load(&r->shutdown)) {
+            pthread_cond_wait(&r->drainCond, &r->popLock);
+            atomic_fetch_sub(&r->drainWaiters, 1);
+            pthread_mutex_unlock(&r->popLock);
+            return true;
+        }
+        atomic_fetch_sub(&r->drainWaiters, 1);
+        TpuMemringSqe fence = *first;
+        uint64_t seq = r->popSeq++;
+        atomic_store_explicit(&r->hdr->sqHead, head + 1,
+                              memory_order_release);
+        pthread_mutex_unlock(&r->popLock);
+        uint64_t now = tpuNowNs();
+        tpuCounterAdd("memring_fences", 1);
+        post_cqe(r, &fence, NULL, TPU_OK, 0, seq, now, now, false, 0);
+        return true;
+    }
+
+    uint32_t n = 0;
+    bool chain = (first->flags & TPU_MEMRING_SQE_LINK) != 0;
+    if (chain) {
+        /* Claim the whole chain (terminated by a no-LINK entry or
+         * the publication boundary). */
+        while (head + n != tail && n < MEMRING_POP_BATCH) {
+            local[n] = r->sq[(head + n) & r->sqMask];
+            if (r->slots)
+                localSlots[n] = r->slots[(head + n) & r->sqMask];
+            n++;
+            if (!(local[n - 1].flags & TPU_MEMRING_SQE_LINK))
+                break;
+        }
+    } else {
+        /* Claim a run of plain ops, stopping before any FENCE or
+         * chain start. */
+        while (head + n != tail && n < MEMRING_POP_BATCH) {
+            const TpuMemringSqe *s = &r->sq[(head + n) & r->sqMask];
+            if (s->opcode == TPU_MEMRING_OP_FENCE ||
+                (s->flags & TPU_MEMRING_SQE_LINK))
+                break;
+            if (r->slots)
+                localSlots[n] = r->slots[(head + n) & r->sqMask];
+            local[n++] = *s;
+        }
+    }
+    uint64_t firstSeq = r->popSeq;
+    r->popSeq += n;
+    atomic_fetch_add(&r->inflight, n);
+    atomic_store_explicit(&r->hdr->sqHead, head + n,
+                          memory_order_release);
+    /* Claim-time generation: post_cqe fences completions whose
+     * claim crossed a device reset.  Stamped under popLock so the
+     * park/drain in tpurmMemringParkAll orders against it. */
+    uint64_t claimGen = tpurmDeviceGeneration();
+    atomic_store_explicit(&r->lastProgressNs, tpuNowNs(),
+                          memory_order_relaxed);
+    pthread_mutex_unlock(&r->popLock);
+
+    /* Dependent internal submissions from the exec below run inline. */
+    t_mrWorker++;
+    if (chain)
+        exec_chain(r, local, r->slots ? localSlots : NULL, n, firstSeq,
+                   claimGen);
+    else
+        exec_batch(r, local, r->slots ? localSlots : NULL, n, firstSeq,
+                   claimGen);
+    t_mrWorker--;
+    return true;
+}
+
 static void *worker_main(void *arg)
 {
     TpuMemring *r = arg;
-    TpuMemringSqe local[MEMRING_POP_BATCH];
+    static TpuRegCache c_sqpoll, c_sqpollIdle;
 
     for (;;) {
         /* Reset park gate: while a full-device reset is quiescing or
@@ -541,108 +795,84 @@ static void *worker_main(void *arg)
                 mr_futex(&g_mrings.parkWord, FUTEX_WAIT, pw, &ts);
             }
         }
-        pthread_mutex_lock(&r->popLock);
-        uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
-                                             memory_order_relaxed);
-        uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
-                                             memory_order_acquire);
-        if (atomic_load(&r->shutdown) && head == tail) {
-            pthread_mutex_unlock(&r->popLock);
-            break;
-        }
-        if (head == tail) {
-            pthread_mutex_unlock(&r->popLock);
-            uint32_t d = atomic_load(&r->hdr->doorbell);
-            /* Re-check after snapshotting the doorbell so a submit
-             * between the check and the wait cannot be missed. */
-            if (atomic_load_explicit(&r->hdr->sqTail,
-                                     memory_order_acquire) ==
+        if (mr_claim_and_exec(r))
+            continue;
+        if (atomic_load(&r->shutdown))
+            break;                 /* SQ drained; exit */
+
+        /* SQPOLL (io_uring SQPOLL idiom): registered pollers spin on
+         * the SQ tail so submitters skip the doorbell FUTEX_WAKE — a
+         * hot-path submit is one release store, zero syscalls.  The
+         * idle timeout bounds the burn on a 1-2 CPU container; past it
+         * the worker falls through to the futex sleep (counted). */
+        if (tpuRegCacheGet(&c_sqpoll, "memring_sqpoll", 0)) {
+            uint64_t idleNs = tpuRegCacheGet(&c_sqpollIdle,
+                                             "memring_sqpoll_idle_us",
+                                             500) * 1000ull;
+            uint64_t t0 = tpuNowNs();
+            uint64_t polls = 0;
+            bool work = false;
+            atomic_fetch_add(&r->hdr->sqPollers, 1);
+            while (!atomic_load(&r->shutdown) &&
+                   !atomic_load_explicit(&g_mrings.parked,
+                                         memory_order_acquire)) {
+                if (atomic_load_explicit(&r->hdr->sqTail,
+                                         memory_order_acquire) !=
                     atomic_load_explicit(&r->hdr->sqHead,
-                                         memory_order_relaxed) &&
-                !atomic_load(&r->shutdown)) {
-                /* No timeout needed: the doorbell value re-check above
-                 * makes a missed wake impossible (a submit between the
-                 * check and the wait changes the word and WAIT returns
-                 * EAGAIN), and destroy bumps + wakes before each join. */
-                mr_futex(&r->hdr->doorbell, FUTEX_WAIT, d, NULL);
+                                         memory_order_relaxed)) {
+                    work = true;
+                    break;
+                }
+                polls++;
+                if (tpuNowNs() - t0 >= idleNs)
+                    break;
+#ifdef __x86_64__
+                __builtin_ia32_pause();
+#else
+                sched_yield();
+#endif
             }
-            continue;
-        }
-
-        const TpuMemringSqe *first = &r->sq[head & r->sqMask];
-        if (first->opcode == TPU_MEMRING_OP_FENCE) {
-            /* Drain: nothing later can be claimed until every
-             * in-flight op retires.  cond_wait RELEASES the pop lock,
-             * so another worker may consume this same fence while we
-             * sleep — after any wakeup, loop back and re-read
-             * head/tail fresh instead of trusting the stale claim. */
-            atomic_fetch_add(&r->drainWaiters, 1);
-            if (atomic_load(&r->inflight) > 0 &&
-                !atomic_load(&r->shutdown)) {
-                pthread_cond_wait(&r->drainCond, &r->popLock);
-                atomic_fetch_sub(&r->drainWaiters, 1);
-                pthread_mutex_unlock(&r->popLock);
+            atomic_fetch_sub(&r->hdr->sqPollers, 1);
+            if (polls)
+                tpuCounterAdd("memring_sqpoll_polls", polls);
+            if (work)
                 continue;
-            }
-            atomic_fetch_sub(&r->drainWaiters, 1);
-            TpuMemringSqe fence = *first;
-            uint64_t seq = r->popSeq++;
-            atomic_store_explicit(&r->hdr->sqHead, head + 1,
-                                  memory_order_release);
-            pthread_mutex_unlock(&r->popLock);
-            uint64_t now = tpuNowNs();
-            tpuCounterAdd("memring_fences", 1);
-            post_cqe(r, &fence, TPU_OK, 0, seq, now, now, false, 0);
-            continue;
+            if (!atomic_load(&r->shutdown) &&
+                !atomic_load_explicit(&g_mrings.parked,
+                                      memory_order_acquire))
+                tpuCounterAdd("memring_sqpoll_sleeps", 1);
         }
 
-        uint32_t n = 0;
-        bool chain = (first->flags & TPU_MEMRING_SQE_LINK) != 0;
-        if (chain) {
-            /* Claim the whole chain (terminated by a no-LINK entry or
-             * the publication boundary). */
-            while (head + n != tail && n < MEMRING_POP_BATCH) {
-                local[n] = r->sq[(head + n) & r->sqMask];
-                n++;
-                if (!(local[n - 1].flags & TPU_MEMRING_SQE_LINK))
-                    break;
-            }
-        } else {
-            /* Claim a run of plain ops, stopping before any FENCE or
-             * chain start. */
-            while (head + n != tail && n < MEMRING_POP_BATCH) {
-                const TpuMemringSqe *s = &r->sq[(head + n) & r->sqMask];
-                if (s->opcode == TPU_MEMRING_OP_FENCE ||
-                    (s->flags & TPU_MEMRING_SQE_LINK))
-                    break;
-                local[n++] = *s;
-            }
+        uint32_t d = atomic_load(&r->hdr->doorbell);
+        /* Re-check after snapshotting the doorbell so a submit
+         * between the check and the wait cannot be missed (a poller's
+         * deregister above is also covered: the doorbell word bumps on
+         * every submit even when the WAKE syscall is skipped). */
+        if (atomic_load_explicit(&r->hdr->sqTail,
+                                 memory_order_acquire) ==
+                atomic_load_explicit(&r->hdr->sqHead,
+                                     memory_order_relaxed) &&
+            !atomic_load(&r->shutdown) &&
+            !atomic_load_explicit(&g_mrings.parked,
+                                  memory_order_acquire)) {
+            /* No timeout needed: the doorbell value re-check above
+             * makes a missed wake impossible (a submit between the
+             * check and the wait changes the word and WAIT returns
+             * EAGAIN), and destroy bumps + wakes before each join. */
+            mr_futex(&r->hdr->doorbell, FUTEX_WAIT, d, NULL);
         }
-        uint64_t firstSeq = r->popSeq;
-        r->popSeq += n;
-        atomic_fetch_add(&r->inflight, n);
-        atomic_store_explicit(&r->hdr->sqHead, head + n,
-                              memory_order_release);
-        /* Claim-time generation: post_cqe fences completions whose
-         * claim crossed a device reset.  Stamped under popLock so the
-         * park/drain in tpurmMemringParkAll orders against it. */
-        uint64_t claimGen = tpurmDeviceGeneration();
-        atomic_store_explicit(&r->lastProgressNs, tpuNowNs(),
-                              memory_order_relaxed);
-        pthread_mutex_unlock(&r->popLock);
-
-        if (chain)
-            exec_chain(r, local, n, firstSeq, claimGen);
-        else
-            exec_batch(r, local, n, firstSeq, claimGen);
     }
     return NULL;
 }
 
 /* ------------------------------------------------------------ lifecycle */
 
-TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
-                             uint32_t workers, TpuMemring **out)
+/* Shared constructor.  `workers` is EXACT here (0 = no pool — the
+ * internal help-drain mode); the public tpurmMemringCreate resolves
+ * the registry default first. */
+static TpuStatus mr_create(UvmVaSpace *vs, uint32_t sqEntries,
+                           uint32_t workers, bool internal,
+                           TpuMemring **out)
 {
     if (!out)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -657,14 +887,20 @@ TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
         return TPU_ERR_INVALID_LIMIT;
     sqEntries = pow2_at_least(sqEntries, 8);
     uint32_t cqEntries = sqEntries * 2;
-    if (workers == 0)
-        workers = (uint32_t)tpuRegistryGet("memring_workers", 2);
     if (workers > MEMRING_MAX_WORKERS)
         workers = MEMRING_MAX_WORKERS;
 
     TpuMemring *r = calloc(1, sizeof(*r));
     if (!r)
         return TPU_ERR_NO_MEMORY;
+    r->internal = internal;
+    if (internal) {
+        r->slots = calloc(sqEntries, sizeof(*r->slots));
+        if (!r->slots) {
+            free(r);
+            return TPU_ERR_NO_MEMORY;
+        }
+    }
 
     size_t sqBytes = (size_t)sqEntries * sizeof(TpuMemringSqe);
     size_t cqBytes = (size_t)cqEntries * sizeof(TpuMemringCqe);
@@ -673,6 +909,7 @@ TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
     if (r->shmFd < 0 || ftruncate(r->shmFd, (off_t)r->shmSize) != 0) {
         if (r->shmFd >= 0)
             close(r->shmFd);
+        free(r->slots);
         free(r);
         return TPU_ERR_OPERATING_SYSTEM;
     }
@@ -680,6 +917,7 @@ TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
                   r->shmFd, 0);
     if (r->shm == MAP_FAILED) {
         close(r->shmFd);
+        free(r->slots);
         free(r);
         return TPU_ERR_NO_MEMORY;
     }
@@ -698,6 +936,7 @@ TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
     pthread_cond_init(&r->drainCond, NULL);
     pthread_mutex_init(&r->cqLock, NULL);
     pthread_mutex_init(&r->apLock, NULL);
+    pthread_mutex_init(&r->prodLock, NULL);
 
     r->workerCount = workers;
     for (uint32_t i = 0; i < workers; i++) {
@@ -715,10 +954,18 @@ TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
     pthread_mutex_unlock(&g_mrings.lock);
     tpuCounterAdd("memring_rings_created", 1);
     tpuLog(TPU_LOG_INFO, "memring",
-           "ring created: sq=%u cq=%u workers=%u", sqEntries, cqEntries,
-           workers);
+           "ring created: sq=%u cq=%u workers=%u%s", sqEntries, cqEntries,
+           workers, internal ? " (internal spine)" : "");
     *out = r;
     return TPU_OK;
+}
+
+TpuStatus tpurmMemringCreate(UvmVaSpace *vs, uint32_t sqEntries,
+                             uint32_t workers, TpuMemring **out)
+{
+    if (workers == 0)
+        workers = (uint32_t)tpuRegistryGet("memring_workers", 2);
+    return mr_create(vs, sqEntries, workers, false, out);
 }
 
 void tpurmMemringDestroy(TpuMemring *r)
@@ -761,6 +1008,8 @@ void tpurmMemringDestroy(TpuMemring *r)
     pthread_cond_destroy(&r->drainCond);
     pthread_mutex_destroy(&r->cqLock);
     pthread_mutex_destroy(&r->apLock);
+    pthread_mutex_destroy(&r->prodLock);
+    free(r->slots);
     free(r);
 }
 
@@ -771,6 +1020,10 @@ TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe)
     if (!r || !sqe)
         return TPU_ERR_INVALID_ARGUMENT;
     if (sqe->opcode >= TPU_MEMRING_OP_COUNT)
+        return TPU_ERR_INVALID_COMMAND;
+    /* Internal opcodes carry raw kernel pointers — never accepted from
+     * a userspace-facing ring. */
+    if (!r->internal && sqe->opcode >= TPU_MEMRING_OP_INTERNAL_BASE)
         return TPU_ERR_INVALID_COMMAND;
     /* Chains must fit one worker claim (claimed-whole semantics): a
      * longer chain would be split across workers, breaking ordering
@@ -815,8 +1068,16 @@ uint32_t tpurmMemringSubmit(TpuMemring *r)
     atomic_fetch_add(&r->hdr->submitted, n);
     tpuCounterAdd("memring_submits", 1);
     tpuCounterAdd("memring_sqes", n);
+    /* The doorbell WORD always bumps (the sleep path's value re-check
+     * keys off it), but the FUTEX_WAKE syscall is skipped when an
+     * SQPOLL poller is registered (it sees the sqTail release store)
+     * or the ring has no worker pool to wake (internal help-drain
+     * mode).  seq_cst: a poller deregisters BEFORE its final
+     * empty-recheck, so reading sqPollers != 0 here proves the
+     * poller's recheck observes this publish. */
     atomic_fetch_add(&r->hdr->doorbell, 1);
-    mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+    if (atomic_load(&r->hdr->sqPollers) == 0 && r->workerCount > 0)
+        mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
     if (tSpan)
         tpurmTraceEnd(TPU_TRACE_MEMRING_SUBMIT, tSpan, 0, n);
     return n;
@@ -905,11 +1166,15 @@ TpuStatus tpurmMemringWaitDrain(TpuMemring *r, uint64_t timeoutNs)
     return mr_wait(r, pred_drained, 0, timeoutNs);
 }
 
-uint32_t tpurmMemringSubmitAndWait(TpuMemring *r, uint32_t waitFor)
+uint32_t tpurmMemringSubmitAndWait(TpuMemring *r, uint32_t waitFor,
+                                   TpuStatus *waitStatus)
 {
     uint32_t n = tpurmMemringSubmit(r);
+    TpuStatus ws = TPU_OK;
     if (waitFor)
-        tpurmMemringWait(r, waitFor, 0);
+        ws = tpurmMemringWait(r, waitFor, 0);
+    if (waitStatus)
+        *waitStatus = ws;
     return n;
 }
 
@@ -960,6 +1225,233 @@ int tpurmMemringShmFd(TpuMemring *r)
     return r ? r->shmFd : -1;
 }
 
+/* ---------------------------------------------------- internal spine */
+
+static void mr_internal_init_once(void)
+{
+    uint32_t entries = (uint32_t)tpuRegistryGet("memring_internal_entries",
+                                                1024);
+    /* Floor: the SQ must hold several worst-case chains (fault chains
+     * reach MEMRING_POP_BATCH ops) or SubmitInternal's wait-for-space
+     * loop could never satisfy an oversized chain. */
+    if (entries < 4 * MEMRING_POP_BATCH)
+        entries = 4 * MEMRING_POP_BATCH;
+    uint32_t workers = (uint32_t)tpuRegistryGet("memring_internal_workers",
+                                                0);
+    /* SQPOLL armed at init: spawn dedicated pollers so internal
+     * submitters need not help-drain (syscall-free async offload). */
+    if (workers == 0 && tpuRegistryGet("memring_sqpoll", 0))
+        workers = (uint32_t)tpuRegistryGet("memring_sqpoll_workers", 1);
+    if (mr_create(NULL, entries, workers, true, &g_int.ring) != TPU_OK) {
+        g_int.ring = NULL;
+        tpuLog(TPU_LOG_ERROR, "memring",
+               "internal spine ring create failed — internal "
+               "submissions will execute inline");
+    }
+}
+
+/* Inline execution of an internal batch: same per-op recovery and
+ * LINK cancel-on-failure semantics as the ring path, no queue round
+ * trip.  Used for dependent submissions from inside a worker, while
+ * the pools are reset-parked (a queued ghost would bypass quiesce),
+ * and when the spine ring could not be created. */
+static TpuStatus mr_exec_inline(UvmVaSpace *vs, const TpuMemringSqe *sqes,
+                                uint32_t n, TpuStatus *stOut)
+{
+    TpuMemring *r = g_int.ring;        /* may be NULL (create failure) */
+    TpuStatus first = TPU_OK;
+    bool cancelled = false;
+    static _Atomic(_Atomic uint64_t *) c_inline, c_ops;
+    mr_ctr_cached(&c_inline, "memring_internal_inline", n);
+    for (uint32_t i = 0; i < n; i++) {
+        TpuStatus st;
+        if (cancelled) {
+            tpuCounterAdd("memring_links_cancelled", 1);
+            st = TPU_ERR_INVALID_STATE;
+        } else {
+            uint64_t moved = 0;
+            bool injectedFail = false;
+            uint64_t tSpan = tpurmTraceBegin();
+            st = exec_run_recovered(r, &sqes[i], vs, sqes[i].len, &moved,
+                                    &injectedFail);
+            if (tSpan)
+                tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan,
+                              sqes[i].userData, sqes[i].len);
+            mr_ctr_cached(&c_ops, "memring_ops", 1);
+            if (injectedFail)
+                tpuCounterAdd("memring_inject_error_cqes", 1);
+        }
+        if (stOut)
+            stOut[i] = st;
+        if (st != TPU_OK) {
+            if (first == TPU_OK)
+                first = st;
+            if (sqes[i].flags & TPU_MEMRING_SQE_LINK)
+                cancelled = true;
+        }
+        if (!(sqes[i].flags & TPU_MEMRING_SQE_LINK))
+            cancelled = false;         /* chain boundary */
+    }
+    return first;
+}
+
+TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
+                                     const TpuMemringSqe *sqes, uint32_t n,
+                                     TpuStatus *stOut, uint32_t subsys)
+{
+    if (!sqes || n == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_once(&g_int.once, mr_internal_init_once);
+    mr_internal_account(subsys, n);
+    static _Atomic(_Atomic uint64_t *) c_submits;
+    mr_ctr_cached(&c_submits, "memring_internal_submits", 1);
+
+    /* Chain-length histogram (memring.chain): one record per chain —
+     * the "chained service" evidence the fault path's batch-size
+     * acceptance keys off.  Recorded unconditionally like the fault
+     * histograms (quantiles must answer without tracing armed). */
+    {
+        TpuHist *h = tpurmTraceHistRef(TPU_TRACE_MEMRING_CHAIN);
+        uint32_t len = 1;
+        for (uint32_t i = 0; i < n; i++) {
+            if (i + 1 < n && (sqes[i].flags & TPU_MEMRING_SQE_LINK)) {
+                len++;
+                continue;
+            }
+            if (h)
+                tpuHistRecord(h, len);
+            len = 1;
+        }
+    }
+
+    TpuMemring *r = g_int.ring;
+    if (!r || t_mrWorker ||
+        atomic_load_explicit(&g_mrings.parked, memory_order_acquire))
+        return mr_exec_inline(vs, sqes, n, stOut);
+
+    /* Idle fast path (io_uring without SQPOLL executes submitted work
+     * inline in the submit syscall; same idea): with no dedicated
+     * workers the submitter would claim its own batch straight back —
+     * when the SQ is empty there is nothing to coalesce with, so skip
+     * the publish/claim/CQE round trip entirely.  This keeps the
+     * single-fault service path within its latency budget; contended
+     * submitters and SQPOLL configurations take the queue below. */
+    if (r->workerCount == 0 &&
+        atomic_load_explicit(&r->hdr->sqTail, memory_order_acquire) ==
+            atomic_load_explicit(&r->hdr->sqHead, memory_order_relaxed))
+        return mr_exec_inline(vs, sqes, n, stOut);
+
+    MrGroup grp;
+    atomic_store(&grp.remaining, n);
+    atomic_store(&grp.firstErr, 0);
+
+    /* Stage + publish under the producer lock (the internal ring has
+     * MANY producers, unlike userspace rings).  Chains are staged
+     * whole: splitting one across a publication boundary would let two
+     * workers run its halves concurrently, breaking the ordered-claim
+     * guarantee fault chains rely on. */
+    pthread_mutex_lock(&r->prodLock);
+    /* Re-check the park gate UNDER the lock: ParkAll stores `parked`
+     * and then passes through this lock as a publish barrier before
+     * draining the queue — so a submitter that still reads 0 here is
+     * guaranteed to publish before the barrier (drained by ParkAll),
+     * and one that reads 1 backs off to inline.  Without this, a
+     * publish racing the flag would sit queued through the whole
+     * reset. */
+    if (atomic_load_explicit(&g_mrings.parked, memory_order_acquire)) {
+        pthread_mutex_unlock(&r->prodLock);
+        return mr_exec_inline(vs, sqes, n, stOut);
+    }
+    uint32_t i = 0;
+    bool bailedInline = false;
+    while (i < n) {
+        uint32_t clen = 1;
+        while (i + clen <= n - 1 &&
+               (sqes[i + clen - 1].flags & TPU_MEMRING_SQE_LINK))
+            clen++;
+        while (tpurmMemringSqSpace(r) < clen) {
+            /* SQ full: publish what's staged, help drain, retry. */
+            tpurmMemringSubmit(r);
+            pthread_mutex_unlock(&r->prodLock);
+            if (atomic_load_explicit(&g_mrings.parked,
+                                     memory_order_acquire) ||
+                !mr_claim_and_exec(r))
+                sched_yield();
+            pthread_mutex_lock(&r->prodLock);
+            if (atomic_load_explicit(&g_mrings.parked,
+                                     memory_order_acquire)) {
+                /* Park flipped while the lock was dropped: whatever is
+                 * already published drains via ParkAll's queue sweep;
+                 * the REMAINDER runs inline here and settles its share
+                 * of the group, so the batch never sits queued through
+                 * a reset. */
+                pthread_mutex_unlock(&r->prodLock);
+                TpuStatus ist = mr_exec_inline(vs, sqes + i, n - i,
+                                               stOut ? stOut + i : NULL);
+                if (ist != TPU_OK) {
+                    uint32_t zero = 0;
+                    atomic_compare_exchange_strong(&grp.firstErr, &zero,
+                                                   (uint32_t)ist);
+                }
+                atomic_fetch_sub(&grp.remaining, n - i);
+                bailedInline = true;
+                break;
+            }
+        }
+        if (bailedInline)
+            break;
+        TpuStatus ps = TPU_OK;
+        uint32_t k = 0;
+        for (; k < clen; k++) {
+            ps = tpurmMemringPrep(r, &sqes[i + k]);
+            if (ps != TPU_OK)
+                break;
+            r->slots[(r->pendTail - 1) & r->sqMask] = (MrSlot){
+                .vs = vs,
+                .grp = &grp,
+                .stOut = stOut ? &stOut[i + k] : NULL,
+            };
+        }
+        if (ps != TPU_OK) {
+            /* Defensive (overlong chain / bad opcode): the staged ops
+             * will complete; settle the rest of the batch here so the
+             * group still converges. */
+            uint32_t staged = i + k;
+            atomic_fetch_sub(&grp.remaining, n - staged);
+            for (uint32_t m = staged; m < n && stOut; m++)
+                stOut[m] = ps;
+            uint32_t zero = 0;
+            atomic_compare_exchange_strong(&grp.firstErr, &zero,
+                                           (uint32_t)ps);
+            break;
+        }
+        i += clen;
+    }
+    if (!bailedInline) {
+        tpurmMemringSubmit(r);
+        pthread_mutex_unlock(&r->prodLock);
+    }
+
+    /* Submit-and-help: drain the ring (any subsystem's work — claims
+     * interleave, coalescing merges) until our group retires.  While
+     * reset-parked, no claims; the timed futex rides out the unpark. */
+    for (;;) {
+        uint32_t rem = atomic_load(&grp.remaining);
+        if (rem == 0)
+            break;
+        if (!atomic_load_explicit(&g_mrings.parked,
+                                  memory_order_acquire) &&
+            mr_claim_and_exec(r))
+            continue;
+        rem = atomic_load(&grp.remaining);
+        if (rem == 0)
+            break;
+        struct timespec ts = { .tv_sec = 0, .tv_nsec = 50 * 1000 * 1000 };
+        mr_futex(&grp.remaining, FUTEX_WAIT, rem, &ts);
+    }
+    return (TpuStatus)atomic_load(&grp.firstErr);
+}
+
 /* -------------------------------------------------- reset / watchdog */
 
 /* Park every worker pool (internal.h contract).  Claims that slipped
@@ -968,6 +1460,23 @@ int tpurmMemringShmFd(TpuMemring *r)
 TpuStatus tpurmMemringParkAll(uint64_t timeoutNs)
 {
     atomic_store_explicit(&g_mrings.parked, 1, memory_order_release);
+    /* Internal-spine drain: new internal submissions now execute
+     * inline (SubmitInternal's park check), but chains PUBLISHED just
+     * before the gate flipped would otherwise sit queued with their
+     * submitters parked on them — and a fault-chain submitter's
+     * waiters hold the PM gate's shared side, which would deadlock
+     * uvmSuspend right after us.  Take the producer lock once as a
+     * publish barrier (no one is left mid-publish), then drain the
+     * queued internal work HERE, on the reset thread — quiesce-time
+     * execution, exactly the old inline-service semantics (the PM
+     * gate has not closed yet). */
+    TpuMemring *ir = g_int.ring;
+    if (ir) {
+        pthread_mutex_lock(&ir->prodLock);
+        pthread_mutex_unlock(&ir->prodLock);
+        while (mr_claim_and_exec(ir))
+            ;
+    }
     uint64_t deadline = tpuNowNs() + timeoutNs;
     for (;;) {
         uint32_t busy = 0;
@@ -987,6 +1496,15 @@ TpuStatus tpurmMemringParkAll(uint64_t timeoutNs)
         struct timespec ts = { .tv_sec = 0, .tv_nsec = 200 * 1000 };
         nanosleep(&ts, NULL);
     }
+}
+
+/* True while a full-device reset holds the worker-pool park gate
+ * (internal submissions queue; uvmFaultRingDrain bounds its wait on
+ * this instead of deadlocking the quiesce). */
+bool tpurmMemringSpineParked(void)
+{
+    return atomic_load_explicit(&g_mrings.parked,
+                                memory_order_acquire) != 0;
 }
 
 void tpurmMemringUnparkAll(void)

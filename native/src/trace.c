@@ -78,6 +78,7 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "msgq.publish",           "msgq"    },
     { "memring.submit",         "memring" },
     { "memring.op",             "memring" },
+    { "memring.chain",          "memring" },
     { "ce.copy",                "ce"      },
     { "ce.stripe",              "ce"      },
     { "sched.round",            "sched"   },

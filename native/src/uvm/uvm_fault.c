@@ -32,6 +32,7 @@
 #define _GNU_SOURCE
 #include "uvm_internal.h"
 #include "tpurm/inject.h"
+#include "tpurm/memring.h"
 #include "tpurm/trace.h"
 
 #include <errno.h>
@@ -815,6 +816,35 @@ static TpuStatus service_with_retry(UvmFaultEntry *e)
     return st;
 }
 
+static void service_cancel(UvmFaultEntry *e);
+
+/* Spine execution of ONE pending fault entry (memring OP_FAULT): the
+ * bounded-retry service, the cancel/quarantine pipeline on failure,
+ * the per-service histogram and the cpu/device counters — everything
+ * the batch loop used to do inline per primary.  Returns the entry's
+ * FINAL status (service_cancel's precise mode may poison the page and
+ * resolve it to TPU_OK so the waiter proceeds — a chain therefore only
+ * cancels on the failures the old inline loop would also have
+ * propagated to the waiter). */
+TpuStatus uvmFaultServiceExec(void *entryPtr)
+{
+    UvmFaultEntry *e = entryPtr;
+    uint64_t tSvc = uvmMonotonicNs();
+    e->serviceStatus = service_with_retry(e);
+    uint64_t tSvcEnd = uvmMonotonicNs();
+    tpuHistRecord(tpurmTraceHistRef(TPU_TRACE_FAULT_SERVICE),
+                  tSvcEnd - tSvc);
+    tpurmTraceEventAt(TPU_TRACE_FAULT_SERVICE, tSvc, tSvcEnd, e->addr,
+                      e->len);
+    if (e->serviceStatus != TPU_OK)
+        service_cancel(e);
+    if (e->source == UVM_FAULT_SRC_CPU)
+        atomic_fetch_add(&g_fault.faultsCpu, 1);
+    else
+        atomic_fetch_add(&g_fault.faultsDevice, 1);
+    return e->serviceStatus;
+}
+
 static void replay_wake(UvmFaultEntry *e, uint64_t nowNs)
 {
     lat_record(nowNs - e->enqueueNs);
@@ -949,8 +979,16 @@ static void *fault_service_thread(void *arg)
     if (maxBatch == 0 || maxBatch > FAULT_RING_SIZE)
         maxBatch = 256;
     UvmFaultEntry **batch = malloc(maxBatch * sizeof(*batch));
-    if (!batch)
+    /* Spine staging: SQE scratch for the per-block fault chains, plus
+     * a taken-mark per batch slot (both worker-private). */
+    TpuMemringSqe *sqes = malloc(maxBatch * sizeof(*sqes));
+    uint8_t *taken = malloc(maxBatch);
+    if (!batch || !sqes || !taken) {
+        free(batch);
+        free(sqes);
+        free(taken);
         return NULL;
+    }
 
     static TpuRegCache c_sweep;
     for (;;) {
@@ -1065,6 +1103,97 @@ static void *fault_service_thread(void *arg)
         uint32_t policy =
             (uint32_t)tpuRegCacheGet(&c_policy, "uvm_fault_replay_policy",
                                      1);
+
+        /* SPINE SERVICE: the batch's primaries go down the internal
+         * memring as OP_FAULT LINK chains — one chain per faulting VA
+         * BLOCK (the chain's ordered, claimed-whole execution is what
+         * preserves the per-block single-writer discipline the perf
+         * state relies on, now that execution may land on any spine
+         * worker), all chains published with ONE submit.  Multi-block
+         * spans (single-worker config only) and same-block overflow
+         * past one claim submit in follow-up passes, after the prior
+         * group drained, so two chains for one block never run
+         * concurrently.  On an idle ring the submitter claims its own
+         * chains right back (submit-and-help), so the added cost over
+         * the old inline loop is one claim + CQE post per chain. */
+        {
+            memset(taken, 0, n);
+            for (;;) {
+                uint32_t ns = 0;
+                for (uint32_t i = 0; i < n; i++) {
+                    UvmFaultEntry *e = batch[i];
+                    if (!e || dupOf[i] >= 0 || taken[i] || ns >= maxBatch)
+                        continue;
+                    uint64_t blockIdx = e->addr / UVM_BLOCK_SIZE;
+                    bool multi = (e->addr + (e->len ? e->len : 1) - 1) /
+                                     UVM_BLOCK_SIZE != blockIdx;
+                    /* A block-crossing span submits ALONE (the sole
+                     * chain of its pass): staged beside other chains
+                     * it could alias their blocks from either side. */
+                    if (multi && ns > 0)
+                        continue;          /* leads the next pass */
+                    uint32_t chainStart = ns;
+                    bool capped = false;
+                    for (uint32_t j = i; j < n && ns < maxBatch; j++) {
+                        UvmFaultEntry *f = batch[j];
+                        if (!f || dupOf[j] >= 0 || taken[j])
+                            continue;
+                        if (multi) {
+                            /* Block-crossing span (single-worker
+                             * config): a one-op chain of its own — it
+                             * would alias other chains' blocks. */
+                        } else if (f->vs != e->vs ||
+                                   f->addr / UVM_BLOCK_SIZE != blockIdx ||
+                                   (f->addr + (f->len ? f->len : 1) - 1) /
+                                           UVM_BLOCK_SIZE != blockIdx) {
+                            continue;
+                        }
+                        if (ns - chainStart >= 64) {
+                            capped = true;  /* one worker claim max */
+                            break;
+                        }
+                        memset(&sqes[ns], 0, sizeof(sqes[ns]));
+                        sqes[ns].opcode = TPU_MEMRING_OP_FAULT;
+                        sqes[ns].flags = TPU_MEMRING_SQE_LINK;
+                        sqes[ns].addr = (uint64_t)(uintptr_t)f;
+                        sqes[ns].len = f->len ? f->len : 1;
+                        sqes[ns].userData = f->addr;
+                        taken[j] = 1;
+                        ns++;
+                        if (multi)
+                            break;
+                    }
+                    if (ns > chainStart)
+                        sqes[ns - 1].flags &=
+                            (uint8_t)~TPU_MEMRING_SQE_LINK;
+                    if (capped || multi)
+                        /* Stop scanning; later candidates wait for the
+                         * NEXT pass.  capped: this block's leftovers
+                         * must not become a second same-block chain in
+                         * THIS submission (another spine worker could
+                         * claim it concurrently).  multi: the chain's
+                         * span covers SEVERAL blocks, and any later
+                         * entry could alias one of them — same
+                         * single-writer argument, whole range. */
+                        break;
+                }
+                if (ns == 0)
+                    break;
+                tpurmMemringSubmitInternal(NULL, sqes, ns, NULL,
+                                           TPU_MEMRING_SUBSYS_FAULT);
+            }
+            /* Chain-cancel leftovers (an upstream entry's failure
+             * cancelled the rest of its block chain): service inline —
+             * the old loop serviced every primary independently, so
+             * these must not surface as never-serviced. */
+            for (uint32_t i = 0; i < n; i++) {
+                UvmFaultEntry *e = batch[i];
+                if (e && dupOf[i] < 0 &&
+                    e->serviceStatus == (TpuStatus)~0u)
+                    uvmFaultServiceExec(e);
+            }
+        }
+
         uint32_t dups = 0;
         for (uint32_t i = 0; i < n; i++) {
             UvmFaultEntry *e = batch[i];
@@ -1074,19 +1203,6 @@ static void *fault_service_thread(void *arg)
                 dups++;
                 continue;
             }
-            uint64_t tSvc = uvmMonotonicNs();
-            e->serviceStatus = service_with_retry(e);
-            uint64_t tSvcEnd = uvmMonotonicNs();
-            tpuHistRecord(tpurmTraceHistRef(TPU_TRACE_FAULT_SERVICE),
-                          tSvcEnd - tSvc);
-            tpurmTraceEventAt(TPU_TRACE_FAULT_SERVICE, tSvc, tSvcEnd,
-                              e->addr, e->len);
-            if (e->serviceStatus != TPU_OK)
-                service_cancel(e);
-            if (e->source == UVM_FAULT_SRC_CPU)
-                atomic_fetch_add(&g_fault.faultsCpu, 1);
-            else
-                atomic_fetch_add(&g_fault.faultsDevice, 1);
             if (policy == 0) {
                 /* BLOCK: replay this fault + its dups immediately.  The
                  * primary's entry lives on the waiter's stack and dies
@@ -1137,14 +1253,27 @@ static void *fault_service_thread(void *arg)
                     }
                 }
                 if (!inherited) {
-                    extra->serviceStatus = service_with_retry(extra);
-                    if (extra->serviceStatus != TPU_OK)
-                        service_cancel(extra);
+                    /* Spine-accounted like every other service: one
+                     * single-op FAULT chain (the prior group already
+                     * drained, so per-block ordering holds). */
+                    TpuMemringSqe fs;
+                    memset(&fs, 0, sizeof(fs));
+                    fs.opcode = TPU_MEMRING_OP_FAULT;
+                    fs.addr = (uint64_t)(uintptr_t)extra;
+                    fs.len = extra->len ? extra->len : 1;
+                    fs.userData = extra->addr;
+                    tpurmMemringSubmitInternal(NULL, &fs, 1, NULL,
+                                               TPU_MEMRING_SUBSYS_FAULT);
+                    if (extra->serviceStatus == (TpuStatus)~0u)
+                        uvmFaultServiceExec(extra);
+                } else {
+                    /* Inherited outcomes skip execution; count them
+                     * here as the exec path would have. */
+                    if (extra->source == UVM_FAULT_SRC_CPU)
+                        atomic_fetch_add(&g_fault.faultsCpu, 1);
+                    else
+                        atomic_fetch_add(&g_fault.faultsDevice, 1);
                 }
-                if (extra->source == UVM_FAULT_SRC_CPU)
-                    atomic_fetch_add(&g_fault.faultsCpu, 1);
-                else
-                    atomic_fetch_add(&g_fault.faultsDevice, 1);
                 dupOf[n] = -1;       /* extras are primaries, never dups */
                 batch[n++] = extra;
                 tpuCounterAdd("uvm_fault_flush_serviced", 1);
@@ -1237,6 +1366,7 @@ void uvmFaultRingDrain(void)
 {
     if (!g_fault.ready)
         return;
+    uint64_t parkedSinceNs = 0;
     for (;;) {
         bool anyBusy = false;
         for (uint32_t i = 0; i < g_fault.nWorkers; i++) {
@@ -1249,6 +1379,28 @@ void uvmFaultRingDrain(void)
         }
         if (!anyBusy)
             return;
+        /* Reset-park escape: a worker whose spine chains were
+         * published just before the pools parked cannot progress until
+         * unpark — and unpark needs THIS drain (inside uvmSuspend,
+         * inside the reset quiesce) to return.  Its chains execute
+         * after resume, to HOST or the restored arenas, which is the
+         * same safety argument as the quiesce's trickle faults; waiting
+         * here would deadlock the reset.  The plain operator-suspend
+         * path never parks, so its drain contract is untouched. */
+        if (tpurmMemringSpineParked()) {
+            uint64_t now = uvmMonotonicNs();
+            if (!parkedSinceNs)
+                parkedSinceNs = now;
+            else if (now - parkedSinceNs > 100ull * 1000 * 1000) {
+                tpuCounterAdd("uvm_fault_drain_park_bails", 1);
+                tpuLog(TPU_LOG_WARN, "uvm",
+                       "fault ring drain: bailing out under reset park "
+                       "(queued spine chains service after resume)");
+                return;
+            }
+        } else {
+            parkedSinceNs = 0;
+        }
         sched_yield();
     }
 }

@@ -12,10 +12,13 @@
  * uvm_migrate.c:735, fires on completion, which here is at return).
  */
 #include "uvm_internal.h"
+#include "tpurm/memring.h"
 #include "tpurm/trace.h"
 
-TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
-                     UvmLocation dst, uint32_t flags)
+#include <string.h>
+
+TpuStatus uvmMigrateExec(UvmVaSpace *vs, void *base, uint64_t len,
+                         UvmLocation dst, uint32_t flags)
 {
     (void)flags;
     if (!vs || !base || len == 0)
@@ -90,4 +93,118 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
     if (tSpan)
         tpurmTraceEnd(TPU_TRACE_MIGRATE, tSpan, (uintptr_t)base, len);
     return st;
+}
+
+/* Bytes of [start, end] NOT already resident at dst — the fused-evict
+ * trigger keys on the span's actual allocation NEED, not raw arena
+ * occupancy: re-migrating an already-resident span under a full arena
+ * must not demote LRU victims for a no-op.  Approximate by design
+ * (masks read under the vs lock only; concurrent per-block service can
+ * skew a snapshot) — this is a pressure heuristic, the engine's own
+ * pressure path stays the correctness backstop. */
+static uint64_t span_nonresident_bytes(UvmVaSpace *vs, uint64_t start,
+                                       uint64_t end, UvmLocation dst)
+{
+    uint64_t ps = uvmPageSize();
+    uint64_t need = 0;
+    pthread_mutex_lock(&vs->lock);
+    for (UvmRangeTreeNode *n = uvmRangeTreeIterFirst(&vs->ranges, start,
+                                                     end);
+         n; n = uvmRangeTreeIterNext(n, end)) {
+        UvmVaRange *range = (UvmVaRange *)n;
+        if (range->type != UVM_RANGE_TYPE_MANAGED)
+            continue;
+        uint64_t rStart = start > n->start ? start : n->start;
+        uint64_t rEnd = end < n->end ? end : n->end;
+        uint32_t firstBlock =
+            (uint32_t)((rStart - n->start) / UVM_BLOCK_SIZE);
+        uint32_t lastBlock =
+            (uint32_t)((rEnd - n->start) / UVM_BLOCK_SIZE);
+        for (uint32_t bi = firstBlock; bi <= lastBlock; bi++) {
+            UvmVaBlock *blk = range->blocks[bi];
+            if (!blk)
+                continue;
+            uint64_t bEnd = blk->start + (uint64_t)blk->npages * ps - 1;
+            uint64_t lo = rStart > blk->start ? rStart : blk->start;
+            uint64_t hi = rEnd < bEnd ? rEnd : bEnd;
+            if (lo > hi)
+                continue;
+            /* A block homed on a different HBM device re-migrates
+             * wholesale (single-device rule). */
+            bool wrongDev = dst.tier == UVM_TIER_HBM &&
+                            blk->hbmRuns && blk->hbmDevInst != dst.devInst;
+            uint32_t p0 = (uint32_t)((lo - blk->start) / ps);
+            uint32_t p1 = (uint32_t)((hi - blk->start) / ps);
+            for (uint32_t p = p0; p <= p1; p++)
+                if (wrongDev ||
+                    !uvmPageMaskTest(&blk->resident[dst.tier], p))
+                    need += ps;
+        }
+    }
+    pthread_mutex_unlock(&vs->lock);
+    return need;
+}
+
+/* The public entry is a SUBMISSION-SPINE wrapper: the span goes down
+ * as one MIGRATE SQE on the internal memring (the worker that claims
+ * it runs uvmMigrateExec, coalescing virtually-contiguous sibling
+ * submissions into one engine walk), prefixed — when the destination
+ * arena cannot take the span — by a LINKed TIER_EVICT so ONE worker
+ * claim drains the fused evict+upload pair back-to-back: the evicted
+ * space cannot be stolen by interleaved traffic before the upload
+ * lands.  Semantics match the old direct call: synchronous, same
+ * status; argument validation stays up front so obvious misuse fails
+ * without a ring round-trip. */
+TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
+                     UvmLocation dst, uint32_t flags)
+{
+    if (!vs || !base || len == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (dst.tier >= UVM_TIER_COUNT)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (dst.tier == UVM_TIER_HBM && !tpurmDeviceGet(dst.devInst))
+        return TPU_ERR_INVALID_DEVICE;
+
+    TpuMemringSqe sqes[2];
+    TpuStatus sts[2] = { TPU_OK, TPU_OK };
+    uint32_t n = 0;
+    memset(sqes, 0, sizeof(sqes));
+
+    static TpuRegCache c_fused;
+    if (tpuRegCacheGet(&c_fused, "memring_fused_evict", 1) &&
+        (dst.tier == UVM_TIER_HBM || dst.tier == UVM_TIER_CXL)) {
+        UvmTierArena *arena = dst.tier == UVM_TIER_HBM
+                                  ? uvmTierArenaHbm(dst.devInst)
+                                  : uvmTierArenaCxl();
+        if (arena) {
+            uint64_t ps = uvmPageSize();
+            uint64_t start = (uintptr_t)base & ~(ps - 1);
+            uint64_t end = ((uintptr_t)base + len - 1) | (ps - 1);
+            uint64_t need = span_nonresident_bytes(vs, start, end, dst);
+            if (need &&
+                arena->size - uvmPmmAllocatedBytes(&arena->pmm) < need) {
+                sqes[n].opcode = TPU_MEMRING_OP_TIER_EVICT;
+                sqes[n].flags = TPU_MEMRING_SQE_LINK;
+                sqes[n].dstTier = (uint16_t)dst.tier;
+                sqes[n].devInst = dst.devInst;
+                sqes[n].len = need;
+                n++;
+                tpuCounterAdd("memring_fused_evictions", 1);
+            }
+        }
+    }
+    sqes[n].opcode = TPU_MEMRING_OP_MIGRATE;
+    sqes[n].dstTier = (uint16_t)dst.tier;
+    sqes[n].devInst = dst.devInst;
+    sqes[n].addr = (uint64_t)(uintptr_t)base;
+    sqes[n].len = len;
+    sqes[n].arg1 = flags;
+    n++;
+
+    tpurmMemringSubmitInternal(vs, sqes, n, sts,
+                               TPU_MEMRING_SUBSYS_MIGRATE);
+    /* The MIGRATE's own status is the call's result (the fused evict
+     * half is best-effort by contract, and a cancelled chain already
+     * lands INVALID_STATE in the migrate's slot). */
+    return sts[n - 1];
 }

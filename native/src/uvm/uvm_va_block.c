@@ -597,6 +597,30 @@ static TpuStatus arena_evict_some(UvmTierArena *arena, UvmVaBlock *self)
     return TPU_ERR_NO_MEMORY;
 }
 
+/* Spine hook (memring OP_TIER_EVICT — the fused evict+upload pair's
+ * evict half): LRU-evict from the (tier, devInst) arena until it can
+ * take `bytes` more.  Best-effort by contract — under-delivery just
+ * means the linked upload runs the engine's own pressure path above.
+ * Ring-worker context: no block locks held. */
+uint64_t uvmTierEvictBytes(uint32_t tier, uint32_t devInst, uint64_t bytes)
+{
+    UvmTierArena *arena =
+        tier == UVM_TIER_HBM ? uvmTierArenaHbm(devInst)
+        : tier == UVM_TIER_CXL ? uvmTierArenaCxl() : NULL;
+    if (!arena)
+        return 0;
+    uint64_t want = bytes > arena->size ? arena->size : bytes;
+    tpuCounterAdd("memring_tier_evict_runs", 1);
+    for (int rounds = 0; rounds < 64; rounds++) {
+        uint64_t freeB = arena->size - uvmPmmAllocatedBytes(&arena->pmm);
+        if (freeB >= want)
+            return freeB;
+        if (arena_evict_some(arena, NULL) != TPU_OK)
+            break;
+    }
+    return arena->size - uvmPmmAllocatedBytes(&arena->pmm);
+}
+
 /* ------------------------------------------------------- make resident */
 
 TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,

@@ -9,6 +9,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
+#include <time.h>
 
 #include "tpurm/inject.h"
 #include "tpurm/memring.h"
@@ -61,7 +62,7 @@ static int test_wrap_and_backpressure(void)
     TpuMemringSqe extra = sqe_nop(9999);
     CHECK(tpurmMemringPrep(r, &extra) ==
           TPU_ERR_INSUFFICIENT_RESOURCES);
-    CHECK(tpurmMemringSubmitAndWait(r, 8) == 8);
+    CHECK(tpurmMemringSubmitAndWait(r, 8, NULL) == 8);
 
     uint64_t seen[64] = { 0 };
     TpuMemringCqe cq[16];
@@ -76,7 +77,7 @@ static int test_wrap_and_backpressure(void)
             TpuMemringSqe s = sqe_nop(1000 + w * 8 + i);
             CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
         }
-        CHECK(tpurmMemringSubmitAndWait(r, 8) == 8);
+        CHECK(tpurmMemringSubmitAndWait(r, 8, NULL) == 8);
         got = tpurmMemringReap(r, cq, 16);
         CHECK(got == 8);
         for (uint32_t i = 0; i < got; i++) {
@@ -116,7 +117,7 @@ static int test_batched_migrate(void)
                                       UVM_TIER_HBM, 0, i);
         CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
     }
-    CHECK(tpurmMemringSubmitAndWait(r, N) == N);
+    CHECK(tpurmMemringSubmitAndWait(r, N, NULL) == N);
     TpuMemringCqe cq[N];
     CHECK(tpurmMemringReap(r, cq, N) == N);
     for (int i = 0; i < N; i++) {
@@ -137,7 +138,7 @@ static int test_batched_migrate(void)
     TpuMemringSqe bad = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 78);
     bad.opcode = TPU_MEMRING_OP_EVICT;
     CHECK(tpurmMemringPrep(r, &bad) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 2) == 2);
+    CHECK(tpurmMemringSubmitAndWait(r, 2, NULL) == 2);
     CHECK(tpurmMemringReap(r, cq, 2) == 2);
     for (int i = 0; i < 2; i++) {
         if (cq[i].userData == 77)
@@ -181,7 +182,7 @@ static int test_link_chains(void)
     CHECK(tpurmMemringPrep(r, &a) == TPU_OK);
     CHECK(tpurmMemringPrep(r, &b) == TPU_OK);
     CHECK(tpurmMemringPrep(r, &c) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 3) == 3);
+    CHECK(tpurmMemringSubmitAndWait(r, 3, NULL) == 3);
     TpuMemringCqe cq[8];
     CHECK(tpurmMemringReap(r, cq, 8) == 3);
     for (int i = 0; i < 3; i++) {
@@ -207,7 +208,7 @@ static int test_link_chains(void)
     CHECK(tpurmMemringPrep(r, &x) == TPU_OK);
     CHECK(tpurmMemringPrep(r, &y) == TPU_OK);
     CHECK(tpurmMemringPrep(r, &z) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 3) == 3);
+    CHECK(tpurmMemringSubmitAndWait(r, 3, NULL) == 3);
     CHECK(tpurmMemringReap(r, cq, 8) == 3);
     CHECK(cq[0].userData == 11 && cq[0].status != TPU_OK);
     CHECK(cq[1].userData == 12 &&
@@ -258,7 +259,7 @@ static int test_open_chain_submit_boundary(void)
                                   21);
     a.flags |= TPU_MEMRING_SQE_LINK;
     CHECK(tpurmMemringPrep(r, &a) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);
     /* Submit terminated the chain IN the ring (slot 0 = first SQE). */
     CHECK((sq[0].flags & TPU_MEMRING_SQE_LINK) == 0);
 
@@ -317,7 +318,7 @@ static int test_fence(void)
     TpuMemringSqe after = sqe_migrate(p, SPAN, UVM_TIER_HOST, 0, 501);
     CHECK(tpurmMemringPrep(r, &after) == TPU_OK);
 
-    CHECK(tpurmMemringSubmitAndWait(r, N + 2) == N + 2);
+    CHECK(tpurmMemringSubmitAndWait(r, N + 2, NULL) == N + 2);
     TpuMemringCqe cq[N + 2];
     CHECK(tpurmMemringReap(r, cq, N + 2) == N + 2);
     uint64_t fenceStart = 0, fenceSeq = 0;
@@ -377,7 +378,7 @@ static int test_multiworker_accounting(void)
                 s.opcode = TPU_MEMRING_OP_NOP;
             CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
         }
-        CHECK(tpurmMemringSubmitAndWait(r, N) == N);
+        CHECK(tpurmMemringSubmitAndWait(r, N, NULL) == N);
         total += N;
         uint32_t got = tpurmMemringReap(r, cq, N);
         CHECK(got == N);
@@ -425,7 +426,7 @@ static int test_advise_and_peer_copy(void)
     TpuMemringSqe ev = sqe_migrate(p, 4 * SPAN, UVM_TIER_CXL, 0, 2);
     ev.opcode = TPU_MEMRING_OP_EVICT;
     CHECK(tpurmMemringPrep(r, &ev) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 2) == 2);
+    CHECK(tpurmMemringSubmitAndWait(r, 2, NULL) == 2);
     TpuMemringCqe cq[4];
     CHECK(tpurmMemringReap(r, cq, 4) == 2);
     CHECK(cq[0].status == TPU_OK && cq[1].status == TPU_OK);
@@ -454,7 +455,7 @@ static int test_advise_and_peer_copy(void)
     pc.arg0 = TPU_MEMRING_PEER_WRITE;
     pc.userData = 9;
     CHECK(tpurmMemringPrep(r, &pc) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);
     CHECK(tpurmMemringReap(r, cq, 4) == 1);
     CHECK(cq[0].status == TPU_OK && cq[0].bytes == SPAN);
     volatile uint8_t *peer =
@@ -495,7 +496,7 @@ static int test_inject_recovery(void)
                                TPU_INJECT_ONESHOT, 0, 1, 0) == TPU_OK);
     TpuMemringSqe s = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 1);
     CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);
     TpuMemringCqe cq[4];
     CHECK(tpurmMemringReap(r, cq, 4) == 1);
     CHECK(cq[0].status == TPU_OK);
@@ -506,7 +507,7 @@ static int test_inject_recovery(void)
                                TPU_INJECT_ONESHOT, 0, 4, 0) == TPU_OK);
     s = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 2);
     CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);
     CHECK(tpurmMemringReap(r, cq, 4) == 1);
     CHECK(cq[0].status == TPU_ERR_RETRY_EXHAUSTED);
     CHECK(tpurmCounterGet("memring_inject_error_runs") ==
@@ -535,6 +536,170 @@ static int test_inject_recovery(void)
     return 0;
 }
 
+/* Runtime knob flips must serialize against background registry
+ * pollers (reset_test doctrine). */
+void tpuRegistrySet(const char *key, const char *value);
+
+/* Kernel-internal submission spine: a mixed batch (LINK chain + a
+ * plain op) through tpurmMemringSubmitInternal lands per-op statuses,
+ * moves the data, and chain-cancel semantics hold for a failing head. */
+static int test_internal_submit(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    void *p;
+    CHECK(uvmMemAlloc(vs, 4 * SPAN, &p) == TPU_OK);
+    memset(p, 0x3C, 4 * SPAN);
+
+    /* Chain [MIGRATE s0 -> MIGRATE s1] + independent MIGRATE s2. */
+    TpuMemringSqe sqes[3];
+    TpuStatus sts[3] = { (TpuStatus)~0u, (TpuStatus)~0u, (TpuStatus)~0u };
+    sqes[0] = sqe_migrate(p, SPAN, UVM_TIER_HBM, 0, 1);
+    sqes[0].flags = TPU_MEMRING_SQE_LINK;
+    sqes[1] = sqe_migrate((char *)p + SPAN, SPAN, UVM_TIER_HBM, 0, 2);
+    sqes[2] = sqe_migrate((char *)p + 2 * SPAN, SPAN, UVM_TIER_CXL, 0, 3);
+    uint64_t sqesBefore = tpurmCounterGet("memring_internal_sqes");
+    uint64_t migBefore = tpurmCounterGet("memring_internal_sqes[migrate]");
+    CHECK(tpurmMemringSubmitInternal(vs, sqes, 3, sts,
+                                     TPU_MEMRING_SUBSYS_MIGRATE) ==
+          TPU_OK);
+    CHECK(sts[0] == TPU_OK && sts[1] == TPU_OK && sts[2] == TPU_OK);
+    CHECK(tpurmCounterGet("memring_internal_sqes") == sqesBefore + 3);
+    CHECK(tpurmCounterGet("memring_internal_sqes[migrate]") ==
+          migBefore + 3);
+
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+    CHECK(uvmResidencyInfo(vs, (char *)p + 2 * SPAN, &info) == TPU_OK);
+    CHECK(info.residentCxl);
+    volatile uint8_t *bytes = p;
+    CHECK(bytes[7] == 0x3C && bytes[3 * SPAN - 1] == 0x3C);
+
+    /* A failing chain head cancels the linked tail (per-op statuses
+     * tell the two failures apart). */
+    TpuMemringSqe bad[2];
+    TpuStatus bsts[2] = { TPU_OK, TPU_OK };
+    bad[0] = sqe_migrate((void *)(uintptr_t)0x1000, SPAN, UVM_TIER_HBM,
+                         0, 4);
+    bad[0].flags = TPU_MEMRING_SQE_LINK;
+    bad[1] = sqe_migrate((char *)p + 3 * SPAN, SPAN, UVM_TIER_HBM, 0, 5);
+    CHECK(tpurmMemringSubmitInternal(vs, bad, 2, bsts,
+                                     TPU_MEMRING_SUBSYS_MIGRATE) !=
+          TPU_OK);
+    CHECK(bsts[0] == TPU_ERR_OBJECT_NOT_FOUND);
+    CHECK(bsts[1] == TPU_ERR_INVALID_STATE);   /* chain-cancelled */
+
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* Fused EVICT->MIGRATE chain: a migrate into a full HBM arena goes
+ * down as [TIER_EVICT -> MIGRATE] in ONE submission — the evict half
+ * frees LRU space immediately ahead of the upload, the migrate
+ * succeeds, and the victim's data survives on host. */
+static int test_fused_evict_migrate(void)
+{
+    enum { BUF = 48u << 20 };          /* 3 x 48MB vs the 128MB arena */
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    void *a, *b, *c;
+    CHECK(uvmMemAlloc(vs, BUF, &a) == TPU_OK);
+    CHECK(uvmMemAlloc(vs, BUF, &b) == TPU_OK);
+    CHECK(uvmMemAlloc(vs, BUF, &c) == TPU_OK);
+    memset(a, 0xA1, BUF);
+    memset(b, 0xB2, BUF);
+    memset(c, 0xC3, BUF);
+
+    UvmLocation hbm = { UVM_TIER_HBM, 0 };
+    CHECK(uvmMigrate(vs, a, BUF, hbm, 0) == TPU_OK);
+    CHECK(uvmMigrate(vs, b, BUF, hbm, 0) == TPU_OK);
+
+    /* Arena now holds ~96MB: the third migrate must ride a fused
+     * chain (free 32MB < 48MB span). */
+    uint64_t fusedBefore = tpurmCounterGet("memring_fused_evictions");
+    uint64_t evictRunsBefore = tpurmCounterGet("memring_tier_evict_runs");
+    uint64_t evictionsBefore = tpurmCounterGet("uvm_block_evictions");
+    CHECK(uvmMigrate(vs, c, BUF, hbm, 0) == TPU_OK);
+    CHECK(tpurmCounterGet("memring_fused_evictions") == fusedBefore + 1);
+    CHECK(tpurmCounterGet("memring_tier_evict_runs") ==
+          evictRunsBefore + 1);
+    CHECK(tpurmCounterGet("uvm_block_evictions") > evictionsBefore);
+
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, c, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+    /* Victim data intact wherever it landed (reads fault if needed). */
+    volatile uint8_t *av = a;
+    volatile uint8_t *cv = c;
+    CHECK(av[5] == 0xA1 && av[BUF - 1] == 0xA1);
+    CHECK(cv[5] == 0xC3 && cv[BUF - 1] == 0xC3);
+
+    CHECK(uvmMemFree(vs, a) == TPU_OK);
+    CHECK(uvmMemFree(vs, b) == TPU_OK);
+    CHECK(uvmMemFree(vs, c) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* SQPOLL: pollers register in hdr.sqPollers and spin (counted); past
+ * the idle budget they fall back to the futex sleep (counted), and a
+ * submit after the fallback still wakes them (no lost doorbell). */
+static int test_sqpoll(void)
+{
+    tpuRegistrySet("TPUMEM_MEMRING_SQPOLL", "1");
+    tpuRegistrySet("TPUMEM_MEMRING_SQPOLL_IDLE_US", "2000");
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(NULL, 16, 2, &r) == TPU_OK);
+    uint64_t pollsBefore = tpurmCounterGet("memring_sqpoll_polls");
+    uint64_t sleepsBefore = tpurmCounterGet("memring_sqpoll_sleeps");
+
+    for (int i = 0; i < 4; i++) {
+        TpuMemringSqe s = sqe_nop(100 + i);
+        CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    }
+    CHECK(tpurmMemringSubmitAndWait(r, 4, NULL) == 4);
+    TpuMemringCqe cq[8];
+    CHECK(tpurmMemringReap(r, cq, 8) == 4);
+
+    /* Idle past the spin budget: workers poll (counted at spin exit),
+     * then futex-sleep instead of burning the core. */
+    struct timespec ts = { .tv_sec = 0, .tv_nsec = 30 * 1000 * 1000 };
+    nanosleep(&ts, NULL);
+    CHECK(tpurmCounterGet("memring_sqpoll_polls") > pollsBefore);
+    CHECK(tpurmCounterGet("memring_sqpoll_sleeps") > sleepsBefore);
+
+    /* Wake out of the fallback sleep: submit completes normally. */
+    TpuMemringSqe s = sqe_nop(999);
+    CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);
+    CHECK(tpurmMemringReap(r, cq, 8) == 1);
+    CHECK(cq[0].userData == 999 && cq[0].status == TPU_OK);
+
+    tpurmMemringDestroy(r);
+    tpuRegistrySet("TPUMEM_MEMRING_SQPOLL", NULL);
+    tpuRegistrySet("TPUMEM_MEMRING_SQPOLL_IDLE_US", NULL);
+    return 0;
+}
+
+/* The chaos-soak spine invariant, asserted over this whole run:
+ * every internal submission is subsystem-attributed. */
+static int check_spine_invariant(void)
+{
+    uint64_t total = tpurmCounterGet("memring_internal_sqes");
+    uint64_t parts = tpurmCounterGet("memring_internal_sqes[fault]") +
+                     tpurmCounterGet("memring_internal_sqes[tier]") +
+                     tpurmCounterGet("memring_internal_sqes[ici]") +
+                     tpurmCounterGet("memring_internal_sqes[migrate]");
+    CHECK(total > 0);
+    CHECK(total == parts);
+    return 0;
+}
+
 int main(void)
 {
     /* Two fake devices so PEER_COPY has a real peer (set before any
@@ -555,6 +720,14 @@ int main(void)
     if (test_advise_and_peer_copy())
         return 1;
     if (test_inject_recovery())
+        return 1;
+    if (test_internal_submit())
+        return 1;
+    if (test_fused_evict_migrate())
+        return 1;
+    if (test_sqpoll())
+        return 1;
+    if (check_spine_invariant())
         return 1;
     printf("memring_test OK\n");
     return 0;

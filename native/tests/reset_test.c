@@ -234,7 +234,7 @@ static int test_generation_fencing(void)
     /* Post-reset ops on the same ring complete normally (new gen). */
     TpuMemringSqe ok = sqe_nop_delay(778, 0);
     CHECK(tpurmMemringPrep(r, &ok) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);
     CHECK(tpurmMemringReap(r, &cqe, 1) == 1);
     CHECK(cqe.userData == 778 && cqe.status == TPU_OK);
 
@@ -333,7 +333,7 @@ static int test_deadlines(void)
     TpuMemringSqe s = sqe_nop_delay(31, 0);
     s.deadlineNs = now_ns() - 1;        /* already expired */
     CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
-    CHECK(tpurmMemringSubmitAndWait(r, 1) == 1);
+    CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);
     TpuMemringCqe cqe;
     CHECK(tpurmMemringReap(r, &cqe, 1) == 1);
     CHECK(cqe.status == TPU_ERR_RETRY_EXHAUSTED);

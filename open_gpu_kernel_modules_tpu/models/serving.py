@@ -387,17 +387,31 @@ class ManagedKVBacking:
         Reset integration: a CQE carrying DEVICE_RESET is a completion
         the generation fence rejected (a full-device reset ran under
         the batch).  The pages' truth is intact in the backing — the
-        whole fault pass simply re-issues ONCE against the new
-        generation; any other error still raises."""
+        idempotent fault pass re-issues against the new generation.
+        The retry is BOUNDED BY GENERATION, not by a fixed count: it
+        loops only while the device generation keeps advancing between
+        attempts (each retry is chasing a *different* reset, so
+        back-to-back resets cannot strand a read), with a hard cap as
+        the backstop; a DEVICE_RESET with NO generation movement means
+        something is re-fencing the same generation — that raises.
+        Any other error still raises."""
         if self.ring is not None and pages:
             from ..runtime import native as _native
+            from ..uvm import reset as _reset
 
-            for attempt in (0, 1):
+            max_retries = 8          # backstop: a reset storm this deep
+            #                          is a device problem, not a read's
+            gen = _reset.generation()
+            for attempt in range(max_retries + 1):
                 try:
                     self._ring_fault_pages(pages)
                     break
                 except _native.RmError as e:
-                    if attempt == 1 or e.status != _ERR_DEVICE_RESET:
+                    new_gen = _reset.generation()
+                    advanced = new_gen != gen
+                    gen = new_gen
+                    if (e.status != _ERR_DEVICE_RESET or
+                            not advanced or attempt == max_retries):
                         raise
                     # Quiesce leftovers, then replay the idempotent
                     # prefetch pass against the new generation.

@@ -217,6 +217,14 @@ class Scheduler:
         self.max_len = self.cache.pages_per_seq * self.cache.page_size
 
         self._free_seqs: List[int] = list(range(self.max_seqs))
+        # Fused evict+upload chains: _preempt stages the victim's
+        # backing spans here; the next _restore publishes them LINKed
+        # ahead of its PREFETCH chain on the dedicated tier ring (one
+        # worker claim drains demote-then-upload back-to-back), and
+        # step() flushes any leftovers at round end.
+        self._pending_evicts: List[tuple] = []
+        self._tier_ring = None
+        self._tier_ring_tried = False
         self._queue: List[Request] = []
         self._running: Dict[int, Request] = {}     # seq -> request
         self._preempted: List[Request] = []
@@ -350,15 +358,72 @@ class Scheduler:
                 best, best_key = req, key
         return best
 
+    def _tier_ring_get(self):
+        """Dedicated ring for the tier manager's fused EVICT->PREFETCH
+        chains (the shared backing ring must stay quiesced between
+        read_pages passes — mixing evict CQEs into its accounting
+        would break the read path's check contract)."""
+        if self._tier_ring is None and not self._tier_ring_tried:
+            self._tier_ring_tried = True
+            backing = self.cache.backing
+            vs = getattr(backing, "vs", None)
+            if vs is not None:
+                from ..uvm import memring
+                try:
+                    self._tier_ring = memring.MemRing(vs, entries=256)
+                except native.RmError:
+                    self._tier_ring = None
+        return self._tier_ring
+
+    def _stage_evicts(self, req: Request) -> None:
+        """Record the preempted victim's backing spans for a fused
+        demote: clearing their device-side residency (read-dup copies
+        from earlier fault service) frees arena pages exactly where the
+        next restore uploads."""
+        backing = self.cache.backing
+        if getattr(backing, "vs", None) is None:
+            return
+        first = req.seq * self.cache.pages_per_seq
+        npages = self._pages_for(int(self.cache.seq_lens[req.seq]))
+        if npages == 0:
+            return
+        span = npages * backing.rec_bytes
+        off = first * backing.rec_bytes
+        self._pending_evicts.append((backing.k_buf.address + off, span))
+        self._pending_evicts.append((backing.v_buf.address + off, span))
+
+    def _flush_evicts(self, ring) -> None:
+        """Publish leftover staged evicts (no restore fused them this
+        round).  Best-effort: a failed demote only costs the engine's
+        own pressure path its head start."""
+        evicts, self._pending_evicts = self._pending_evicts, []
+        if not evicts or ring is None:
+            return
+        from ..uvm.managed import Tier
+        try:
+            for addr, span in evicts:
+                if ring.sq_space < 1:
+                    ring.submit_and_wait(None)
+                    ring.completions(max_cqes=8192)
+                ring.evict(addr, span, Tier.CXL)
+            ring.submit_and_wait(None)
+            ring.completions(max_cqes=8192)
+        except native.RmError:
+            self._quiesce_ring(ring)
+            _counter_add("tpusched_evict_errors")
+
     def _preempt(self, req: Request) -> None:
         """Swap a sequence out: dirty pages flush to the backing (the
         seq keeps its slot index, i.e. its backing pages), device slots
-        free, the request parks until a restore fits."""
+        free, the request parks until a restore fits.  The victim's
+        backing spans are STAGED for a fused EVICT->PREFETCH chain:
+        the next restore publishes demote-then-upload as one claim."""
         with _span("sched.preempt", obj=req.rid):
             # The scheduler's _cur_tok is the stream's truth (updated
             # every round); only the KV pages need persisting.
             self.cache.flush_group([req.seq])
             self.cache.release_sequence(req.seq, keep_len=True)
+            self._stage_evicts(req)
         del self._running[req.seq]
         req.state = RequestState.PREEMPTED
         req.preempts += 1
@@ -366,31 +431,51 @@ class Scheduler:
         self.stats["preempted"] += 1
         _counter_add("tpusched_preempted")
 
+    @staticmethod
+    def _quiesce_ring(ring) -> None:
+        """Drain + reap everything on `ring` tolerantly: staged-but-
+        unsubmitted SQEs or unreaped CQEs left behind would skew later
+        passes' completion accounting on the shared ring."""
+        if ring is None:
+            return
+        try:
+            ring.submit_and_wait(None)
+        except native.RmError:
+            pass
+        ring.completions(max_cqes=8192)
+
+    @staticmethod
+    def _check_prefetch_cqes(cqes) -> None:
+        """Raise on a failed PREFETCH completion only: the evict half
+        of a fused submission is best-effort by contract (the C-side
+        OP_TIER_EVICT encodes the same doctrine), so a failed demote —
+        likeliest exactly under the memory pressure that makes fusing
+        matter — must not abort the restore warm-up."""
+        from ..uvm import memring as _memring
+
+        for c in cqes:
+            if not c.ok and c.opcode == _memring.Op.PREFETCH:
+                raise native.RmError(
+                    c.status, f"restore prefetch user_data={c.user_data}")
+
     def _restore(self, req: Request) -> None:
         """Re-admit a preempted sequence.  Its pages' truth sits in the
-        backing store; ONE batched memring submission of linked
-        PREFETCH ops (chained per claim-size segment, single doorbell)
-        warms them device-ward before the activation re-uploads — the
-        serving-level analog of the fault engine's batched service.
-        Falls back to plain activation faulting when the backing has no
-        ring."""
+        backing store; ONE batched memring submission of FUSED work —
+        any staged victim EVICTs published ahead of this sequence's
+        PREFETCH chains (single doorbell, FIFO claims drain the demotes
+        first) — frees the victims' device residency right where the
+        restore uploads.  Runs on the dedicated tier ring (the
+        backing's read ring stays quiesced); falls back to the backing
+        ring, then to plain activation faulting."""
         backing = self.cache.backing
-        ring = getattr(backing, "ring", None)
+        ring = self._tier_ring_get() or getattr(backing, "ring", None)
         try:
             self._restore_prefetch(backing, ring, req)
         except native.RmError:
             # The warm-up chain is an optimization: a failed PREFETCH
             # CQE (injected or real) just means the activation below
-            # faults the pages itself.  Leave the ring QUIESCED —
-            # staged-but-unsubmitted SQEs or unreaped CQEs left behind
-            # would skew the backing read path's own completion
-            # accounting on the shared ring.
-            if ring is not None:
-                try:
-                    ring.submit_and_wait(None)
-                except native.RmError:
-                    pass
-                ring.completions(max_cqes=8192)
+            # faults the pages itself.
+            self._quiesce_ring(ring)
             self.stats["round_errors"] = \
                 self.stats.get("round_errors", 0) + 1
             _counter_add("tpusched_round_errors")
@@ -402,9 +487,40 @@ class Scheduler:
 
     def _restore_prefetch(self, backing, ring, req: Request) -> None:
         if ring is not None:
+            from ..uvm.managed import Tier
+
             pages = range(req.seq * self.cache.pages_per_seq,
                           req.seq * self.cache.pages_per_seq +
                           self._pages_for(int(self.cache.seq_lens[req.seq])))
+            # Fused halves: staged victim demotes first, then this
+            # sequence's uploads.  The evicts form their OWN chain —
+            # never LINKed into the prefetches, so a failed demote
+            # cancels at most the remaining demotes, not the uploads.
+            # A restore of the SAME sequence that was just preempted
+            # (the slot-pressure ping-pong) drops its own staged spans
+            # instead of demoting data it is about to fault straight
+            # back: the prefetch re-establishes residency either way.
+            first_page = req.seq * self.cache.pages_per_seq
+            own_lo = first_page * backing.rec_bytes
+            own_hi = (req.seq + 1) * self.cache.pages_per_seq * \
+                backing.rec_bytes
+            own = {backing.k_buf.address, backing.v_buf.address}
+
+            def _own_span(addr, span):
+                return any(base + own_lo <= addr < base + own_hi
+                           for base in own)
+
+            evicts, self._pending_evicts = self._pending_evicts, []
+            kept = [(a, s) for a, s in evicts if not _own_span(a, s)]
+            if kept:
+                _counter_add("tpusched_fused_evict_chains")
+            for j, (addr, span) in enumerate(kept):
+                if ring.sq_space < 1:
+                    ring.submit_and_wait(None)
+                    self._check_prefetch_cqes(ring.completions(
+                        max_cqes=8192))
+                ring.evict(addr, span, Tier.CXL,
+                           link=(j % 64 != 63) and j != len(kept) - 1)
             ops = []
             for page in pages:
                 off = page * backing.rec_bytes
@@ -412,18 +528,16 @@ class Scheduler:
                 ops.append(backing.v_buf.address + off)
             # LINK chains are capped at one worker claim (64 entries);
             # chain per segment, publish everything with one doorbell.
-            n = 0
             for i, addr in enumerate(ops):
                 if ring.sq_space < 1:
                     ring.submit_and_wait(None)
-                    ring.completions(max_cqes=max(n, 64), check=True)
-                    n = 0
+                    self._check_prefetch_cqes(ring.completions(
+                        max_cqes=8192))
                 last_in_chain = (i % 64 == 63) or i == len(ops) - 1
                 ring.prefetch(addr, backing.rec_bytes, dev=backing.dev,
                               link=not last_in_chain)
-                n += 1
             ring.submit_and_wait(None)
-            ring.completions(max_cqes=max(n, 64), check=True)
+            self._check_prefetch_cqes(ring.completions(max_cqes=8192))
 
     # --------------------------------------------------------- admission
 
@@ -566,6 +680,13 @@ class Scheduler:
         with _span("sched.round", obj=self.stats["rounds"]):
             self._check_generation()
             self._try_admissions()
+            # Evicts staged by preempts fuse into the next restore's
+            # chain; once no restore can ever consume them, publish
+            # them on their own (tier ring only — never the backing's
+            # quiesced read ring).
+            if self._pending_evicts and not (self._queue or
+                                             self._preempted):
+                self._flush_evicts(self._tier_ring_get())
             # Decode growth can push the runnable set past the slot
             # pool: preempt until the round fits (never below one).
             while (self._running and
@@ -684,6 +805,11 @@ class Scheduler:
     # ---------------------------------------------------------- teardown
 
     def close(self) -> None:
+        # The scheduler-owned tier ring must go before the cache (it is
+        # bound to the backing's VA space).
+        if self._tier_ring is not None:
+            self._tier_ring.close()
+            self._tier_ring = None
         if self.cache is not None:
             self.cache.close()
             self.cache = None

@@ -141,7 +141,10 @@ def _lib() -> ctypes.CDLL:
     lib.tpurmMemringPrep.restype = u32
     lib.tpurmMemringSubmit.argtypes = [vp]
     lib.tpurmMemringSubmit.restype = u32
-    lib.tpurmMemringSubmitAndWait.argtypes = [vp, u32]
+    # Third arg: TpuStatus *waitStatus out-param (the C surface now
+    # returns the wait's status instead of discarding it).
+    lib.tpurmMemringSubmitAndWait.argtypes = [vp, u32,
+                                              ctypes.POINTER(u32)]
     lib.tpurmMemringSubmitAndWait.restype = u32
     lib.tpurmMemringReap.argtypes = [vp, ctypes.POINTER(_Cqe), u32]
     lib.tpurmMemringReap.restype = u32
@@ -283,8 +286,9 @@ class MemRing:
         so unreaped backlog can't satisfy it early.  An explicit
         ``wait_for`` parks until that many CQEs are reapable instead.
         Either way the wait status is checked (RmError on timeout or
-        the dropped-CQE bail), unlike the C convenience
-        ``tpurmMemringSubmitAndWait`` which discards it."""
+        the dropped-CQE bail) — matching the C surface, whose
+        ``tpurmMemringSubmitAndWait`` now reports the wait status
+        through an out-param."""
         n = self.submit()
         if wait_for is None:
             self.drain()

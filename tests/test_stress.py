@@ -429,6 +429,19 @@ out["memring"] = {
     "cq_overflows": mr_ring_counts.cq_overflows,
 }
 
+# Submission-spine invariant: EVERY internal memory op — fault-service
+# chains, tier evicts, ICI transfers, explicit migrates — is
+# ring-accounted, and the per-subsystem attribution sums exactly to the
+# spine total (no unattributed dispatch path exists).
+out["spine"] = {
+    "internal_sqes": utils.counter("memring_internal_sqes"),
+    "fault": utils.counter("memring_internal_sqes[fault]"),
+    "tier": utils.counter("memring_internal_sqes[tier]"),
+    "ici": utils.counter("memring_internal_sqes[ici]"),
+    "migrate": utils.counter("memring_internal_sqes[migrate]"),
+    "inline": utils.counter("memring_internal_inline"),
+}
+
 # Trace accounting for the armed chaos window (before phase 2 so the
 # counters snapshot matches exactly what the rings saw).
 utils.trace_stop()
@@ -566,6 +579,14 @@ out["rep"] = {k: rep[k] for k in
 out["live"] = {}
 out["hits"] = {k: v[1] for k, v in inj.stats().items()}
 out["sched_admit_evals"] = inj.counts(inj.Site.SCHED_ADMIT)[0]
+from open_gpu_kernel_modules_tpu import utils as _utils
+out["spine"] = {
+    "internal_sqes": _utils.counter("memring_internal_sqes"),
+    "fault": _utils.counter("memring_internal_sqes[fault]"),
+    "tier": _utils.counter("memring_internal_sqes[tier]"),
+    "ici": _utils.counter("memring_internal_sqes[ici]"),
+    "migrate": _utils.counter("memring_internal_sqes[migrate]"),
+}
 print(json.dumps(out))
 """
 
@@ -616,6 +637,15 @@ def test_sched_soak_injection():
     assert out["sched_admit_evals"] > 0, out
     fired = [k for k, h in out["hits"].items() if h > 0]
     assert len(fired) >= 2, out["hits"]
+
+    # Submission-spine invariant held through the scheduler's chaos:
+    # the serving stack's fault service and explicit migrates were all
+    # ring-accounted, with exact per-subsystem attribution.
+    sp = out["spine"]
+    assert sp["internal_sqes"] > 0, sp
+    assert sp["internal_sqes"] == (sp["fault"] + sp["tier"] +
+                                   sp["ici"] + sp["migrate"]), sp
+    assert sp["fault"] > 0, sp
 
 
 _CLIENT_KILL = r"""
@@ -789,6 +819,19 @@ def test_engine_soak_injection():
     assert mr["hits"] == mr["inject_retries"] + mr["inject_error_runs"], mr
     assert mr["observed_error_cqes"] == mr["error_cqes_counter"], mr
     assert mr["inject_error_cqes"] <= mr["error_cqes_counter"], mr
+
+    # SUBMISSION-SPINE invariant under full chaos: every internal
+    # memory op is ring-accounted and the per-subsystem attribution
+    # sums EXACTLY to the spine total — a bespoke dispatch path that
+    # bypassed the ring would break the equality.  The fault and
+    # migrate subsystems must both have flowed (the soak's actors
+    # fault constantly and migrate explicitly).
+    sp = out["spine"]
+    assert sp["internal_sqes"] > 0, sp
+    assert sp["internal_sqes"] == (sp["fault"] + sp["tier"] +
+                                   sp["ici"] + sp["migrate"]), sp
+    assert sp["fault"] > 0 and sp["migrate"] > 0, sp
+    assert sp["ici"] > 0, sp
 
     # tpuce rode the chaos: stripes flowed (splits grew), the ce.copy
     # site fired, and the reconciliation is EXACT — every hit became a
