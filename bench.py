@@ -446,16 +446,20 @@ def measure_memring_spine_vs_sync(oversub: int = 2,
                                         length=span_bytes, write=False)
             return time.perf_counter() - t0
 
-        # Raw producer: one preallocated SQE mutated per op + direct
-        # tpurmMemringPrep calls — the Python-object overhead of the
-        # wrapper would otherwise bound the producer side and measure
-        # the FFI, not the transport (native producers — the fault
-        # engine, the migrate ioctl — pay none of it).
+        # Raw producer AND raw reaper: one preallocated SQE mutated per
+        # op + direct tpurmMemringPrep calls, and a preallocated CQE
+        # array drained with direct tpurmMemringReap calls — the
+        # Python-object overhead of the wrapper (a Completion dataclass
+        # per CQE on the reap side) would otherwise bound both ends and
+        # measure the FFI, not the transport (native producers — the
+        # fault engine, the migrate ioctl — pay none of it).
         sqe = memring._Sqe(opcode=memring.Op.PREFETCH, devInst=0,
                            len=span_bytes)
         sqe_ref = ctypes.byref(sqe)
         prep = lib.tpurmMemringPrep
         space = lib.tpurmMemringSqSpace
+        reap_buf = (memring._Cqe * 8192)()
+        reap = lib.tpurmMemringReap
 
         def spine_pass(ring) -> float:
             h = ring._handle
@@ -466,11 +470,11 @@ def measure_memring_spine_vs_sync(oversub: int = 2,
                     for s in range(spans_per_buf):
                         if not space(h):
                             ring.submit_and_wait(None)
-                            ring.completions(max_cqes=8192)
+                            reap(h, reap_buf, 8192)
                         sqe.addr = base + s * span_bytes
                         prep(h, sqe_ref)
                 ring.submit_and_wait(None)
-                ring.completions(max_cqes=8192)
+                reap(h, reap_buf, 8192)
             return time.perf_counter() - t0
 
         sync_pass()                      # warm (PMM + first-touch)
@@ -1154,6 +1158,16 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
     p50 = {}
     preemptions = 0
     restores = 0
+    # Per-channel utilization under the sweep (PR 11 acceptance: the
+    # dep-join reap should EVEN OUT channel busy time vs the old
+    # submission-order barriers — record spread alongside throughput).
+    from open_gpu_kernel_modules_tpu.uvm import ce as _ce
+    sweep_wall0 = time.perf_counter()
+    ch0 = None
+    try:
+        ch0 = _ce.stats()
+    except Exception:
+        pass
     for n in levels:
         s = tpusched.Scheduler(cfg, params, max_seqs=16, max_len=256,
                                page_size=64, oversub=2,
@@ -1170,8 +1184,23 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
         restores += rep["restored"]
 
     lo, hi = str(levels[0]), str(levels[-1])
+    busy_frac = []
+    if ch0 is not None:
+        try:
+            wall = time.perf_counter() - sweep_wall0
+            ch1 = _ce.stats()
+            busy_frac = [
+                round((a.busy_ns - b.busy_ns) / (wall * 1e9), 4)
+                for a, b in zip(ch1.channels, ch0.channels)]
+        except Exception:
+            busy_frac = []
     return {
         "serve_streams": list(levels),
+        # max-min spread is the acceptance number: smaller = the
+        # dep-join interleaving kept the channel pool evenly loaded.
+        "per_channel_busy_frac": busy_frac,
+        "per_channel_busy_spread": round(max(busy_frac) - min(busy_frac),
+                                         4) if busy_frac else 0.0,
         "serve_agg_toks_per_s": agg,
         "serve_p99_token_ms": p99,
         "serve_p50_token_ms": p50,

@@ -131,8 +131,14 @@ typedef struct {
 } TpuCeStripe;
 
 /* A submission batch: stripes pipeline across the channel pool until
- * the batch is waited.  When the stripe table fills, the next copy
- * drains it first (bounded memory, slightly less overlap). */
+ * the batch is waited.  Completion is a DEP-JOIN over the stripes'
+ * (channel, value) tracker pairs, not a submission-order barrier:
+ * tpuCeBatchWait completes stripes in RETIREMENT order (ready ones
+ * reap without blocking, counted tpuce_ooo_completions), and when the
+ * stripe table fills mid-copy the staging path reaps what already
+ * retired — blocking on the OLDEST stripe only if nothing has
+ * (tpuce_dep_join_waits) — instead of draining the whole batch, so
+ * stripes from different copies keep interleaving on the channels. */
 typedef struct {
     TpuCeMgr *m;
     uint32_t n;
@@ -142,6 +148,7 @@ typedef struct {
                                    * retrying and fails fast (counted
                                    * tpuce_deadline_expired) — the hung-
                                    * op ladder's fail-fast floor        */
+    uint8_t done[TPUCE_BATCH_STRIPES];  /* reaped out of order         */
     TpuCeStripe stripes[TPUCE_BATCH_STRIPES];
 } TpuCeBatch;
 

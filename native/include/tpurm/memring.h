@@ -28,13 +28,33 @@
  * uvmMigrate loop (bench.py memring microbench), exactly the paper's
  * batched-offload claim.
  *
- * Ordering:
+ * Ordering (weakest to strongest):
+ *   DEPENDENCY SETS — the reference's uvm_tracker_t shape (a tracker
+ *        is a SET of (channel, value) pairs, not a linear chain): every
+ *        SQE carries up to TPU_MEMRING_SQE_NDEPS wait-on-(ring, seq)
+ *        dependencies (tpurmMemringSqeDep).  A worker claims an SQE
+ *        only once every dep has RETIRED; everything else in the ring
+ *        streams past it out of order.  Each ring keeps a retirement
+ *        frontier — hdr.seqRetired is the watermark below which every
+ *        seq has retired, and a sparse done-set covers the holes that
+ *        out-of-order retirement opens above it — which dep checks
+ *        read lock-free.  A dep whose target retired with an ERROR
+ *        cancels the dependent (TPU_ERR_INVALID_STATE CQE, counted
+ *        memring_dep_cancelled), mirroring chain-cancel semantics.
+ *        An ORDERED dep (TPU_MEMRING_DEP_ORDERED) waits for the
+ *        frontier itself — every seq <= target retired — the per-SQE
+ *        IO_DRAIN used as the wide-join fallback when 4 dep slots are
+ *        not enough.
  *   TPU_MEMRING_SQE_LINK — io_uring IOSQE_LINK analog: the next SQE
  *        starts only after this one completes; a failure cancels every
  *        remaining entry of the chain (their CQEs post
  *        TPU_ERR_INVALID_STATE with bytes = 0).  A chain must be
  *        published by a single tpurmMemringSubmit call; the publication
- *        boundary terminates a chain.
+ *        boundary terminates a chain.  Chains are claimed WHOLE by one
+ *        worker — everything queued behind a long chain waits for that
+ *        claim — so new code should prefer dep sets and reserve LINK
+ *        for spans that genuinely need single-claimant execution
+ *        (make -C native check-spine enforces the allowlist).
  *   TPU_MEMRING_OP_FENCE — completes only after every previously
  *        submitted SQE has posted its CQE (io_uring IOSQE_IO_DRAIN
  *        analog: later SQEs do not begin until the fence retires).
@@ -138,6 +158,45 @@ enum {
 #define TPU_MEMRING_SQE_LINK  0x1u  /* chain with the NEXT sqe          */
 #define TPU_MEMRING_SQE_WRITE 0x2u  /* PREFETCH faults for write        */
 
+/* --------------------------------------------------- dependency handles
+ *
+ * A dep is one u64: the target ring's id (tpurmMemringId) in the top
+ * 16 bits, the target SQE's submission seq (assigned by prep, read
+ * back from TpuMemringSqe.seq) in the low 47, and the ORDERED flag at
+ * bit 47.  Seqs count SQEs per ring from 0 and never wrap in practice
+ * (2^47 per ring).
+ *
+ *   plain dep    — satisfied when THAT seq has retired (holes in the
+ *                  retirement frontier count: out-of-order retirement
+ *                  satisfies it as early as possible);
+ *   ORDERED dep  — satisfied when EVERY seq <= target has retired
+ *                  (frontier watermark passed it): the per-SQE drain
+ *                  used to join a wide set with one dep slot;
+ *   BATCH ring id — the pseudo-target for intra-batch edges: seq is an
+ *                  INDEX into the current batch (must point backwards)
+ *                  and is rewritten to the absolute (ring, seq) pair at
+ *                  stage time, by tpurmMemringPrep for userspace rings
+ *                  (index relative to the first SQE prepped after the
+ *                  last submit) and by tpurmMemringSubmitInternal for
+ *                  spine batches.
+ *
+ * Deps must be written through tpurmMemringSqeDep BEFORE the SQE is
+ * prepped: prep copies the SQE into the shared SQ and submit's release
+ * store of sqTail is the publish barrier that makes the dep set
+ * visible to workers (check-spine lints direct .deps[] writes). */
+#define TPU_MEMRING_SQE_NDEPS 4
+#define TPU_MEMRING_DEP_SEQ_BITS 47
+#define TPU_MEMRING_DEP_SEQ_MASK ((1ull << TPU_MEMRING_DEP_SEQ_BITS) - 1)
+#define TPU_MEMRING_DEP_ORDERED  (1ull << TPU_MEMRING_DEP_SEQ_BITS)
+#define TPU_MEMRING_DEP_RING_SHIFT 48
+#define TPU_MEMRING_DEP_BATCH 0xFFFFu   /* intra-batch index pseudo-ring */
+
+#define TPU_MEMRING_DEP(ringId, seq)                                     \
+    (((uint64_t)(uint16_t)(ringId) << TPU_MEMRING_DEP_RING_SHIFT) |      \
+     ((uint64_t)(seq) & TPU_MEMRING_DEP_SEQ_MASK))
+#define TPU_MEMRING_DEP_RING(d) ((uint32_t)((d) >> TPU_MEMRING_DEP_RING_SHIFT))
+#define TPU_MEMRING_DEP_SEQ(d)  ((d) & TPU_MEMRING_DEP_SEQ_MASK)
+
 /* ADVISE subcodes (sqe.arg0). */
 enum {
     TPU_MEMRING_ADVISE_PREFERRED = 1,        /* dstTier / devInst       */
@@ -155,7 +214,9 @@ enum {
 
 /* --------------------------------------------------------- ring entries */
 
-/* Submission entry — exactly one cacheline. */
+/* Submission entry — exactly two cachelines (io_uring SQE128 shape:
+ * the dependency set did not fit the original 64; hdr.sqeSize carries
+ * the size for external mappers). */
 typedef struct {
     uint8_t  opcode;              /* TPU_MEMRING_OP_*                   */
     uint8_t  flags;               /* TPU_MEMRING_SQE_*                  */
@@ -179,6 +240,15 @@ typedef struct {
                                    * memring_deadline_expired).  The
                                    * hung-op watchdog (tpurm/reset.h)
                                    * escalates ops stuck in flight.    */
+    /* --- second cacheline: the dependency set (tracker semantics) --- */
+    uint64_t deps[TPU_MEMRING_SQE_NDEPS]; /* TPU_MEMRING_DEP handles;
+                                   * write via tpurmMemringSqeDep      */
+    uint32_t depCount;            /* valid deps[] entries (<= NDEPS)   */
+    uint32_t rsvd0;
+    uint64_t seq;                 /* OUT: submission seq assigned by
+                                   * prep (input ignored) — the handle
+                                   * later SQEs name this op by        */
+    uint64_t rsvd1[2];
 } TpuMemringSqe;
 
 /* Completion entry — exactly one cacheline. */
@@ -227,6 +297,14 @@ typedef struct {
      * original header fields so pre-SQPOLL external mappers keep their
      * offsets. */
     TPU_MEMRING_ATOMIC_U32 sqPollers;
+    /* Dependency-tracker fields (appended, same offset-stability
+     * argument).  seqRetired is the RETIREMENT FRONTIER: every
+     * submission seq < seqRetired has posted its completion.  Holes
+     * above it (out-of-order retirement) live in a ring-private
+     * done-set; dep checks read the watermark with one acquire load. */
+    uint32_t ringId;              /* this ring's dep-handle identity    */
+    uint32_t rsvdHdr;
+    TPU_MEMRING_ATOMIC_U64 seqRetired;
 } TpuMemringHdr;
 
 #define TPU_MEMRING_SQ_OFFSET 4096
@@ -245,9 +323,27 @@ TpuStatus tpurmMemringCreate(struct UvmVaSpace *vs, uint32_t sqEntries,
 void      tpurmMemringDestroy(TpuMemring *r);
 
 /* Stage one SQE into the next free SQ slot (NOT yet visible to the
- * workers).  TPU_ERR_INSUFFICIENT_RESOURCES when the SQ is full —
- * submit and reap first. */
-TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe);
+ * workers).  TPU_ERR_INSUFFICIENT_RESOURCES when the SQ is full — or
+ * when the retirement frontier lags too far behind the staged tail
+ * (the done-set window is finite) — submit and reap first either way.
+ * Writes the assigned submission seq into sqe->seq (and rewrites any
+ * BATCH-relative deps to absolute handles); a BATCH dep that points
+ * at or past this SQE fails with TPU_ERR_INVALID_ARGUMENT. */
+TpuStatus tpurmMemringPrep(TpuMemring *r, TpuMemringSqe *sqe);
+
+/* Append one dependency handle to a not-yet-prepped SQE.  The ONLY
+ * sanctioned writer of sqe->deps[] (check-spine lints raw writes):
+ * deps staged here are published by prep's copy into the SQ plus
+ * submit's sqTail release store.  TPU_ERR_INVALID_LIMIT once the
+ * fixed set is full — join wider through an ORDERED dep or a FENCE. */
+TpuStatus tpurmMemringSqeDep(TpuMemringSqe *sqe, uint64_t dep);
+
+/* This ring's dep-handle identity (TPU_MEMRING_DEP ring id). */
+uint32_t tpurmMemringId(TpuMemring *r);
+
+/* The submission seq the NEXT tpurmMemringPrep on this ring will
+ * assign (producer-side; producers are single-threaded per ring). */
+uint64_t tpurmMemringNextSeq(TpuMemring *r);
 
 /* Publish every staged SQE (one release store + doorbell futex wake);
  * returns the number newly submitted. */
@@ -306,7 +402,11 @@ enum {
 
 /* Publish sqes[0..n) on the process-global internal ring as ONE batch
  * (LINK flags inside the batch are honored; the final entry's LINK is
- * cleared — the batch is the publication boundary) and block until all
+ * cleared — the batch is the publication boundary.  BATCH-relative
+ * deps are rewritten to absolute handles against the seqs the batch's
+ * ops are assigned at stage time, so producers express intra-batch
+ * DAGs by index — TPU_MEMRING_DEP(TPU_MEMRING_DEP_BATCH, i) — without
+ * knowing the ring's seq counter) and block until all
  * n ops complete.  `vs` is the VA space the batch's MIGRATE/PREFETCH/
  * EVICT/ADVISE/TIER_EVICT ops execute against (rides a per-op side
  * slot, so batches from different spaces interleave on the one ring);

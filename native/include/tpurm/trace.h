@@ -73,6 +73,9 @@ typedef enum {
                                   * histogram holds chain sizes, not
                                   * ns — fault batches feed it one
                                   * record per submitted chain)        */
+    TPU_TRACE_MEMRING_DEPWAIT,   /* ns an SQE sat dep-blocked in the
+                                  * claim scan before its wait-on-
+                                  * (ring,seq) set retired             */
     TPU_TRACE_CE_COPY,           /* tpuce batch copy (split + submit)  */
     TPU_TRACE_CE_STRIPE,         /* executor stripe run (obj = channel) */
     TPU_TRACE_SCHED_ROUND,       /* tpusched decode round (obj = round) */

@@ -475,6 +475,81 @@ static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s,
 
 /* ---------------------------------------------------------------- batch */
 
+/* Is this stripe's completion already retired on its channel (no
+ * blocking)?  A never-submitted stripe (val == 0: injected/transient
+ * at submit) is "ready" too — its recovery ladder runs at completion
+ * and must not wait behind healthy stripes. */
+static bool ce_stripe_ready(const TpuCeStripe *s)
+{
+    return s->val == 0 || tpurmChannelCompletedValue(s->ch) >= s->val;
+}
+
+/* Dep-join reap: complete every LIVE stripe whose tracker value has
+ * retired (running recovery only where needed), marking it done in
+ * place.  Returns the number still in flight.  Stripes completing
+ * while an older sibling is still outstanding are the out-of-order
+ * win the tracker model buys (counted). */
+static uint32_t ce_batch_reap_ready(TpuCeBatch *b)
+{
+    uint32_t live = 0;
+    bool olderLive = false;
+    for (uint32_t i = 0; i < b->n; i++) {
+        if (b->done[i])
+            continue;
+        TpuCeStripe *s = &b->stripes[i];
+        if (!ce_stripe_ready(s)) {
+            live++;
+            olderLive = true;
+            continue;
+        }
+        TpuStatus st = ce_stripe_complete(b->m, s, b->deadlineNs);
+        if (st != TPU_OK && b->st == TPU_OK)
+            b->st = st;
+        b->done[i] = 1;
+        if (olderLive)
+            tpuCounterAdd("tpuce_ooo_completions", 1);
+    }
+    return live;
+}
+
+/* Drop done stripes so the table can take new staging (one compaction
+ * per table-full event, not one memmove per completion). */
+static void ce_batch_compact(TpuCeBatch *b)
+{
+    uint32_t kept = 0;
+    for (uint32_t i = 0; i < b->n; i++) {
+        if (b->done[i])
+            continue;
+        if (kept != i)
+            b->stripes[kept] = b->stripes[i];
+        b->done[kept] = 0;
+        kept++;
+    }
+    b->n = kept;
+}
+
+/* Table-full staging path: reap what retired; if nothing has, block on
+ * the OLDEST live stripe only (the dep-join replacing the old
+ * drain-the-world barrier), then compact. */
+static TpuStatus ce_batch_make_room(TpuCeBatch *b)
+{
+    if (ce_batch_reap_ready(b) == b->n && b->n > 0) {
+        tpuCounterAdd("tpuce_dep_join_waits", 1);
+        for (uint32_t i = 0; i < b->n; i++) {
+            if (b->done[i])
+                continue;
+            TpuStatus st = ce_stripe_complete(b->m, &b->stripes[i],
+                                              b->deadlineNs);
+            if (st != TPU_OK && b->st == TPU_OK)
+                b->st = st;
+            b->done[i] = 1;
+            break;
+        }
+    }
+    ce_batch_compact(b);
+    return b->st;
+}
+
 TpuStatus tpuCeBatchBegin(TpuCeMgr *m, TpuCeBatch *b)
 {
     if (!m || !b)
@@ -483,6 +558,7 @@ TpuStatus tpuCeBatchBegin(TpuCeMgr *m, TpuCeBatch *b)
     b->n = 0;
     b->st = TPU_OK;
     b->deadlineNs = 0;
+    memset(b->done, 0, sizeof(b->done));
     return TPU_OK;
 }
 
@@ -496,13 +572,28 @@ TpuStatus tpuCeBatchWait(TpuCeBatch *b)
 {
     if (!b || !b->m)
         return TPU_ERR_INVALID_ARGUMENT;
-    for (uint32_t i = 0; i < b->n; i++) {
-        TpuStatus st = ce_stripe_complete(b->m, &b->stripes[i],
-                                          b->deadlineNs);
-        if (st != TPU_OK && b->st == TPU_OK)
-            b->st = st;
+    /* Dep-join: keep reaping retirement-order-ready stripes; only when
+     * none are ready block on the oldest live one, then re-reap (its
+     * siblings usually retired meanwhile).  Every stripe completes
+     * before return — same contract, no submission-order
+     * serialization. */
+    for (;;) {
+        uint32_t live = ce_batch_reap_ready(b);
+        if (live == 0)
+            break;
+        for (uint32_t i = 0; i < b->n; i++) {
+            if (b->done[i])
+                continue;
+            TpuStatus st = ce_stripe_complete(b->m, &b->stripes[i],
+                                              b->deadlineNs);
+            if (st != TPU_OK && b->st == TPU_OK)
+                b->st = st;
+            b->done[i] = 1;
+            break;
+        }
     }
     b->n = 0;
+    memset(b->done, 0, sizeof(b->done));
     return b->st;
 }
 
@@ -534,9 +625,12 @@ TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
         if ((comp & TPU_CE_COMP_FMT_MASK) && piece < len - off)
             piece &= ~3ull;
         if (b->n == TPUCE_BATCH_STRIPES) {
-            /* Table full: drain before staging more (bounded memory;
-             * the sticky batch error is preserved). */
-            TpuStatus st = tpuCeBatchWait(b);
+            /* Table full: dep-join — reap retired stripes (blocking on
+             * the oldest only if none have) instead of draining the
+             * whole batch, so this copy's stripes interleave with the
+             * previous copies' still in flight (sticky batch error
+             * preserved). */
+            TpuStatus st = ce_batch_make_room(b);
             if (st != TPU_OK) {
                 if (tSpan)
                     tpurmTraceEnd(TPU_TRACE_CE_COPY, tSpan,
@@ -544,6 +638,7 @@ TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
                 return st;
             }
         }
+        b->done[b->n] = 0;
         TpuCeStripe *s = &b->stripes[b->n];
         memset(s, 0, sizeof(*s) - sizeof(s->segs));   /* nsegs = 0 */
         s->chIdx = ce_pick(m, active);
@@ -583,10 +678,11 @@ TpuStatus tpuCeBatchCopySegs(TpuCeBatch *b, const TpuCeSeg *segs,
         return TPU_OK;
     TpuCeMgr *m = b->m;
     if (b->n == TPUCE_BATCH_STRIPES) {
-        TpuStatus st = tpuCeBatchWait(b);
+        TpuStatus st = ce_batch_make_room(b);
         if (st != TPU_OK)
             return st;
     }
+    b->done[b->n] = 0;
     TpuCeStripe *s = &b->stripes[b->n];
     memset(s, 0, sizeof(*s) - sizeof(s->segs));
     s->chIdx = ce_pick(m, ce_active(m));
@@ -609,6 +705,8 @@ TpuStatus tpuCeBatchHandoff(TpuCeBatch *b, TpuTracker *t)
     TpuStatus st = b->st;
     for (uint32_t i = 0; i < b->n; i++) {
         TpuCeStripe *s = &b->stripes[i];
+        if (b->done[i])
+            continue;              /* reaped out of order already */
         if (s->val == 0) {
             /* Never submitted (injected/transient at submit): one
              * recovered completion now — a dependency that does not
@@ -635,6 +733,7 @@ TpuStatus tpuCeBatchHandoff(TpuCeBatch *b, TpuTracker *t)
     }
     b->n = 0;
     b->st = TPU_OK;
+    memset(b->done, 0, sizeof(b->done));
     return st;
 }
 

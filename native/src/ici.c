@@ -644,7 +644,11 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
      * segment s, which is exactly how wormhole-ish torus traffic keeps
      * every link busy.  tpuCeBatchWait is idempotent, so dependency
      * fences, slot-reuse fences and the tail drain can all hit the
-     * same batch. */
+     * same batch — and since PR 11 each of those waits is a DEP-JOIN
+     * over the batch's (channel, value) tracker pairs: a hop's stripes
+     * complete in retirement order across the channel pool, so one
+     * slow channel delays only its own stripes, not the whole hop
+     * fence (tpuce_ooo_completions counts the reordering). */
     {
         TpuCeMgr *hopMgr[MAX_HOPS + 1];
         for (uint32_t h = 0; h + 1 < n; h++) {

@@ -9,14 +9,27 @@
  *   - a worker pool pops under a mutex (chains and fences need an
  *     ordered, atomic claim), executes OUTSIDE the lock, and posts
  *     CQEs under a short CQ lock;
- *   - FENCE drains: the popper holds the pop lock while waiting for
- *     in-flight ops to retire, so nothing later can be claimed until
- *     the fence completes (IOSQE_IO_DRAIN semantics);
+ *   - DEPENDENCY TRACKERS (the reference's uvm_tracker_t, re-shaped
+ *     for rings): an SQE carries up to 4 wait-on-(ring, seq) handles;
+ *     the claim scan SKIPS dep-blocked entries and claims anything
+ *     later whose deps have retired, and completions retire OUT OF
+ *     ORDER against a per-ring retirement frontier (hdr.seqRetired
+ *     watermark + a windowed done-bitmap for the holes) that dep
+ *     checks read lock-free.  A dep whose target retired with an
+ *     error cancels the dependent (memring_dep_cancelled);
+ *   - FENCE completes only once the retirement frontier reaches it
+ *     (every prior seq retired) and nothing later is claimed past a
+ *     pending fence — IOSQE_IO_DRAIN semantics without holding the
+ *     pop lock while waiting;
  *   - LINK chains are claimed whole and executed sequentially by one
- *     worker; the first failure cancels the chain's remainder;
+ *     worker; the first failure cancels the chain's remainder.  A
+ *     chain claims only when every entry's deps are satisfied, so
+ *     execution never has to park mid-chain;
  *   - runs of compatible non-linked ops are COALESCED into single
  *     engine calls (one uvmMigrate over a merged span instead of one
- *     per 64 KB SQE) — the batching win the ring exists for.
+ *     per 64 KB SQE) — the batching win the ring exists for.  Claim
+ *     runs may be non-contiguous in the SQ (blocked entries skipped);
+ *     coalescing keys off virtual contiguity as before.
  *
  * Recovery: each run evaluates the memring.submit injection site and
  * retries transient failures with bounded backoff; exhaustion posts
@@ -51,6 +64,14 @@
 
 #define MEMRING_MAX_WORKERS 8
 #define MEMRING_POP_BATCH   64     /* max non-linked ops claimed per pop */
+#define MEMRING_DONE_MULT   4      /* done-bitmap window, in SQ sizes: a
+                                    * retired seq sits at most
+                                    * doneBits above the frontier (prep
+                                    * gates on the lag), so bits never
+                                    * alias */
+#define MEMRING_ERR_RING    256    /* recent error-retired seqs kept for
+                                    * dep-cancel checks after the
+                                    * frontier passed them */
 #define MEMRING_APERTURES   64     /* cached ICI peer apertures per ring:
                                     * every sync tpuIciPeerCopy resolves
                                     * through this cache now, so it must
@@ -100,18 +121,51 @@ struct TpuMemring {
      * staged — chains are capped at MEMRING_POP_BATCH so a worker can
      * always claim one whole (claimed-whole execution semantics). */
     uint32_t pendChain;
+    /* Producer-side submission seqs: prepSeq is the next seq prep will
+     * assign (numerically tracks pendTail, kept 64-bit so seqs never
+     * wrap); batchStartSeq is the seq of the first SQE staged after
+     * the last submit — the base BATCH-relative deps resolve against. */
+    uint64_t prepSeq;
+    uint64_t batchStartSeq;
 
-    /* Pop path: FIFO claim + fence drain + inflight accounting.
-     * inflight is atomic so the per-CQE retire never touches popLock;
-     * drainWaiters gates the drainCond broadcast the same way
-     * hdr->cqWaiters gates the CQ futex wake (register BEFORE the last
-     * predicate re-check — seq_cst total order rules out the lost
-     * wakeup). */
+    /* Pop path: dep-aware claim scan + inflight accounting.  The scan
+     * owns claimedMap (one bit per SQ slot: claimed but not yet below
+     * sqHead) and depBlockNs (first-observed-blocked stamp per slot,
+     * for the memring.depwait histogram); both live under popLock.
+     * inflight is atomic so the per-CQE retire never touches popLock. */
     pthread_mutex_t popLock;
-    pthread_cond_t drainCond;
     atomic_uint inflight;         /* claimed, CQE not yet posted */
-    atomic_uint drainWaiters;     /* fence workers parked on drainCond */
-    uint64_t popSeq;              /* total SQEs ever claimed      */
+    _Atomic uint64_t *claimedMap; /* sqEntries bits               */
+    uint64_t *depBlockNs;         /* per-slot blocked-since stamp */
+    /* Entries the last scan left dep/fence-blocked: retires wake the
+     * doorbell only while nonzero (no syscall on dep-free traffic).
+     * crossBlocked mirrors it globally for cross-ring targets. */
+    _Atomic uint32_t depBlocked;
+
+    /* Retirement frontier.  hdr->seqRetired is the watermark (every
+     * seq below it retired); doneMap holds the out-of-order holes
+     * above it (doneBits = MEMRING_DONE_MULT * sqEntries bits, indexed
+     * seq & (doneBits-1); prep gates staging so live seqs never alias).
+     * Bits are set and the watermark advanced under retireLock —
+     * amortized one acquisition per claim batch; dep checks read the
+     * watermark + bits lock-free.  errSeqs remembers recently
+     * error-retired seqs (value seq+1; 0 = empty) so a dependent can
+     * still be cancelled after the frontier passed its target. */
+    pthread_mutex_t retireLock;
+    _Atomic uint64_t *doneMap;
+    uint32_t doneBits;
+    _Atomic uint64_t errSeqs[MEMRING_ERR_RING];
+    _Atomic uint32_t errIdx;
+    _Atomic uint64_t errCount;    /* lifetime error retires (gate for
+                                   * the errSeqs scan on dep checks) */
+    _Atomic uint64_t errMinSeq;   /* (seq+1) bounds of recorded errors:
+                                   * dep checks scan errSeqs only when
+                                   * the target falls inside — one
+                                   * error ever must not tax every
+                                   * later dep check with a 256-slot
+                                   * walk */
+    _Atomic uint64_t errMaxSeq;
+    uint32_t id;                  /* dep-handle ring id (hdr->ringId) */
 
     pthread_mutex_t cqLock;
 
@@ -143,6 +197,11 @@ static struct {
     struct TpuMemring *head;
     _Atomic int parked;
     _Atomic uint32_t parkWord;
+    /* Rings with entries blocked on ANOTHER ring's retirement: a
+     * retire anywhere re-rings every doorbell while nonzero (rare —
+     * cross-ring deps are an explicit producer choice). */
+    _Atomic uint32_t crossBlocked;
+    _Atomic uint32_t nextId;      /* dep-handle ring ids, from 1 */
 } g_mrings = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
 /* The process-global INTERNAL ring (the submission spine).  Created on
@@ -205,80 +264,263 @@ static uint32_t pow2_at_least(uint32_t v, uint32_t floor)
     return p;
 }
 
+/* ------------------------------------------------- retirement frontier */
+
+static inline bool mr_bit_test(_Atomic uint64_t *map, uint32_t bit)
+{
+    return (atomic_load_explicit(&map[bit >> 6], memory_order_acquire) >>
+            (bit & 63)) & 1;
+}
+
+static inline void mr_bit_set(_Atomic uint64_t *map, uint32_t bit)
+{
+    atomic_fetch_or_explicit(&map[bit >> 6], 1ull << (bit & 63),
+                             memory_order_release);
+}
+
+static inline void mr_bit_clear(_Atomic uint64_t *map, uint32_t bit)
+{
+    atomic_fetch_and_explicit(&map[bit >> 6], ~(1ull << (bit & 63)),
+                              memory_order_release);
+}
+
+/* Retire a claim batch's seqs: mark done bits (+ error memory), then
+ * advance the frontier over whatever became contiguous.  One lock
+ * acquisition per batch; the doorbell re-ring wakes claim scans that
+ * reported dep/fence-blocked entries (gated — dep-free traffic pays
+ * one relaxed load). */
+static void mr_retire_seqs(TpuMemring *r, const uint64_t *seqs,
+                           const uint8_t *errs, uint32_t n)
+{
+    uint32_t mask = r->doneBits - 1;
+    static _Atomic(_Atomic uint64_t *) c_ooo;
+    pthread_mutex_lock(&r->retireLock);
+    uint64_t front = atomic_load_explicit(&r->hdr->seqRetired,
+                                          memory_order_relaxed);
+    uint32_t ooo = 0;
+    for (uint32_t i = 0; i < n; i++) {
+        /* Error memory FIRST, done bit second: a lock-free dep check
+         * reads the bit with acquire, so a reader that observes
+         * "retired" is guaranteed to also observe the error record —
+         * the other order would let a dependent slip through as
+         * satisfied-clean in the window between the two stores. */
+        if (errs && errs[i]) {
+            uint32_t k = atomic_fetch_add(&r->errIdx, 1) &
+                         (MEMRING_ERR_RING - 1);
+            atomic_store(&r->errSeqs[k], seqs[i] + 1);
+            /* Range bounds gate the dep-check scan (monotonic seqs:
+             * min is the first error ever, max the latest). */
+            uint64_t prevMax = atomic_load_explicit(
+                &r->errMaxSeq, memory_order_relaxed);
+            while (prevMax < seqs[i] + 1 &&
+                   !atomic_compare_exchange_weak(&r->errMaxSeq, &prevMax,
+                                                 seqs[i] + 1)) { }
+            uint64_t prevMin = atomic_load_explicit(
+                &r->errMinSeq, memory_order_relaxed);
+            while ((prevMin == 0 || prevMin > seqs[i] + 1) &&
+                   !atomic_compare_exchange_weak(&r->errMinSeq, &prevMin,
+                                                 seqs[i] + 1)) { }
+            atomic_fetch_add(&r->errCount, 1);
+        }
+        mr_bit_set(r->doneMap, (uint32_t)seqs[i] & mask);
+        if (seqs[i] > front)
+            ooo++;                 /* retired ahead of the watermark */
+    }
+    while (mr_bit_test(r->doneMap, (uint32_t)front & mask)) {
+        mr_bit_clear(r->doneMap, (uint32_t)front & mask);
+        front++;
+    }
+    atomic_store_explicit(&r->hdr->seqRetired, front,
+                          memory_order_release);
+    pthread_mutex_unlock(&r->retireLock);
+    if (ooo)
+        mr_ctr_cached(&c_ooo, "memring_ooo_retires", ooo);
+
+    /* Wake dep-blocked claim scans.  The doorbell WORD always bumps
+     * (the sleep protocol's value re-check keys off it); the syscall
+     * fires only when a scan registered a blocked entry.  Cross-ring
+     * dependents sleep on THEIR ring's doorbell — re-ring them all
+     * while any exist. */
+    atomic_fetch_add(&r->hdr->doorbell, 1);
+    if (atomic_load(&r->depBlocked) != 0)
+        mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+    if (atomic_load(&g_mrings.crossBlocked) != 0) {
+        pthread_mutex_lock(&g_mrings.lock);
+        for (TpuMemring *o = g_mrings.head; o; o = o->next) {
+            if (o == r)
+                continue;
+            atomic_fetch_add(&o->hdr->doorbell, 1);
+            mr_futex(&o->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+        }
+        pthread_mutex_unlock(&g_mrings.lock);
+        /* Also nudge parked internal submitters via their group futex?
+         * Not needed: help-drainers re-scan on a 50 ms bound. */
+    }
+}
+
+/* ------------------------------------------------------- dep resolution */
+
+TpuStatus tpurmMemringSqeDep(TpuMemringSqe *sqe, uint64_t dep)
+{
+    if (!sqe)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (sqe->depCount >= TPU_MEMRING_SQE_NDEPS)
+        return TPU_ERR_INVALID_LIMIT;   /* join wider via ORDERED/FENCE */
+    sqe->deps[sqe->depCount++] = dep;
+    return TPU_OK;
+}
+
+uint32_t tpurmMemringId(TpuMemring *r)
+{
+    return r ? r->id : 0;
+}
+
+uint64_t tpurmMemringNextSeq(TpuMemring *r)
+{
+    return r ? r->prepSeq : 0;
+}
+
+/* Has `seq` on ring `t` retired — and with what outcome?  Lock-free:
+ * watermark first (acquire), then the done-bitmap hole check.  The
+ * error memory is consulted only when the ring has ever error-retired
+ * (one relaxed load on the clean path). */
+static bool mr_seq_retired(TpuMemring *t, uint64_t seq, bool ordered,
+                           bool *errOut)
+{
+    uint64_t front = atomic_load_explicit(&t->hdr->seqRetired,
+                                          memory_order_acquire);
+    if (ordered)
+        return front > seq;        /* drain-join: errors don't cancel */
+    bool done = seq < front ||
+                mr_bit_test(t->doneMap,
+                            (uint32_t)seq & (t->doneBits - 1));
+    uint64_t nerr;
+    if (done && errOut &&
+        (nerr = atomic_load_explicit(&t->errCount,
+                                     memory_order_relaxed)) != 0 &&
+        seq + 1 >= atomic_load_explicit(&t->errMinSeq,
+                                        memory_order_relaxed) &&
+        seq + 1 <= atomic_load_explicit(&t->errMaxSeq,
+                                        memory_order_relaxed)) {
+        uint32_t limit = nerr < MEMRING_ERR_RING ? (uint32_t)nerr
+                                                 : MEMRING_ERR_RING;
+        for (uint32_t k = 0; k < limit; k++)
+            if (atomic_load_explicit(&t->errSeqs[k],
+                                     memory_order_relaxed) == seq + 1) {
+                *errOut = true;
+                break;
+            }
+    }
+    return done;
+}
+
+/* Evaluate one dep handle from a claim scan on ring r (popLock held).
+ * A target ring that no longer exists reads as satisfied — rings must
+ * outlive cross-ring dependents; destroy retires everything anyway.
+ * Sets *crossOut when the dep named another ring (steers the blocked-
+ * wake registration). */
+static bool mr_dep_satisfied(TpuMemring *r, uint64_t dep, bool *errOut,
+                             bool *crossOut)
+{
+    uint32_t ringId = TPU_MEMRING_DEP_RING(dep);
+    uint64_t seq = TPU_MEMRING_DEP_SEQ(dep);
+    bool ordered = (dep & TPU_MEMRING_DEP_ORDERED) != 0;
+    if (ringId == TPU_MEMRING_DEP_BATCH)
+        return true;               /* unrewritten batch dep: defensive */
+    if (ringId == r->id)
+        return mr_seq_retired(r, seq, ordered, errOut);
+    *crossOut = true;
+    /* Cross-ring: resolve under the registry lock so the target can't
+     * be torn down mid-read (cross-ring deps are rare by design). */
+    bool done = true;
+    pthread_mutex_lock(&g_mrings.lock);
+    for (TpuMemring *t = g_mrings.head; t; t = t->next)
+        if (t->id == ringId) {
+            done = mr_seq_retired(t, seq, ordered, errOut);
+            break;
+        }
+    pthread_mutex_unlock(&g_mrings.lock);
+    return done;
+}
+
+/* All deps of one SQE satisfied?  errOut accumulates "some dep retired
+ * with an error" (the dependent will be cancelled at exec). */
+static bool mr_deps_satisfied(TpuMemring *r, const TpuMemringSqe *s,
+                              bool *errOut, bool *crossOut)
+{
+    uint32_t nd = s->depCount;
+    if (nd == 0)
+        return true;
+    if (nd > TPU_MEMRING_SQE_NDEPS)
+        nd = TPU_MEMRING_SQE_NDEPS;    /* corrupt count: clamp */
+    for (uint32_t i = 0; i < nd; i++)
+        if (!mr_dep_satisfied(r, s->deps[i], errOut, crossOut))
+            return false;
+    return true;
+}
+
 /* ------------------------------------------------------------ CQE post */
 
-static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
-                     const MrSlot *slot, TpuStatus st, uint64_t bytes,
-                     uint64_t seq, uint64_t t0, uint64_t t1,
-                     bool countInflight, uint64_t claimGen)
+/* Generation fence: a completion whose claim predates a full-device
+ * reset is STALE — quiesce waited for in-flight work, so the only way
+ * here is an op quiesce timed out on (hung/wedged).  Its result must
+ * not read as valid post-reset state: surface DEVICE_RESET so the
+ * consumer re-issues against the new generation.  claimGen 0 is
+ * exempt (fence CQEs carry no engine result). */
+static inline TpuStatus mr_gen_fence(TpuStatus st, uint64_t *bytes,
+                                     uint64_t claimGen)
 {
-    /* Generation fence: a completion whose claim predates a full-device
-     * reset is STALE — quiesce waited for in-flight work, so the only
-     * way here is an op quiesce timed out on (hung/wedged).  Its result
-     * must not read as valid post-reset state: surface DEVICE_RESET so
-     * the consumer re-issues against the new generation.  claimGen 0 is
-     * exempt (fence CQEs carry no engine result). */
     if (claimGen && claimGen != tpurmDeviceGeneration()) {
-        st = TPU_ERR_DEVICE_RESET;
-        bytes = 0;
+        *bytes = 0;
         tpuCounterAdd("memring_stale_completions", 1);
+        return TPU_ERR_DEVICE_RESET;
     }
-    atomic_store_explicit(&r->lastProgressNs, t1, memory_order_relaxed);
-    /* Slot-carrying internal ops complete through their MrGroup, and
-     * nothing ever reaps the internal ring's CQ — writing CQEs there
-     * would permanently overflow it after one CQ's worth of traffic,
-     * inflating the memring_cq_overflows pathology signal on healthy
-     * load (and paying cqLock per op for entries no one reads).  Their
-     * accounting (completed/errorCqes/counters) still advances. */
-    bool wantCqe = !(r->internal && slot);
-    if (wantCqe) {
-        pthread_mutex_lock(&r->cqLock);
-        uint32_t head = atomic_load_explicit(&r->hdr->cqHead,
-                                             memory_order_acquire);
-        uint32_t tail = atomic_load_explicit(&r->hdr->cqTail,
-                                             memory_order_relaxed);
-        if (tail - head >= r->hdr->cqEntries) {
-            /* Consumer asleep at the wheel: drop + count, never block
-             * the pool (fences key off `completed`, not CQ slots). */
-            atomic_fetch_add(&r->hdr->cqOverflows, 1);
-            tpuCounterAdd("memring_cq_overflows", 1);
-        } else {
-            TpuMemringCqe *c = &r->cq[tail & r->cqMask];
-            c->userData = sqe->userData;
-            c->status = (uint32_t)st;
-            c->opcode = sqe->opcode;
-            c->bytes = bytes;
-            c->seq = seq;
-            c->startNs = t0;
-            c->endNs = t1;
-            c->pad[0] = c->pad[1] = 0;
-            atomic_store_explicit(&r->hdr->cqTail, tail + 1,
-                                  memory_order_release);
-        }
+    return st;
+}
+
+/* Write one CQE (cqLock held) or count the overflow drop. */
+static void cqe_write_locked(TpuMemring *r, const TpuMemringSqe *sqe,
+                             TpuStatus st, uint64_t bytes, uint64_t seq,
+                             uint64_t t0, uint64_t t1)
+{
+    uint32_t head = atomic_load_explicit(&r->hdr->cqHead,
+                                         memory_order_acquire);
+    uint32_t tail = atomic_load_explicit(&r->hdr->cqTail,
+                                         memory_order_relaxed);
+    if (tail - head >= r->hdr->cqEntries) {
+        /* Consumer asleep at the wheel: drop + count, never block
+         * the pool (fences key off `completed`, not CQ slots). */
+        atomic_fetch_add(&r->hdr->cqOverflows, 1);
+        tpuCounterAdd("memring_cq_overflows", 1);
+        return;
     }
+    TpuMemringCqe *c = &r->cq[tail & r->cqMask];
+    c->userData = sqe->userData;
+    c->status = (uint32_t)st;
+    c->opcode = sqe->opcode;
+    c->bytes = bytes;
+    c->seq = seq;
+    c->startNs = t0;
+    c->endNs = t1;
+    c->pad[0] = c->pad[1] = 0;
+    atomic_store_explicit(&r->hdr->cqTail, tail + 1, memory_order_release);
+    atomic_fetch_add(&r->hdr->cqReady, 1);
+}
+
+/* Lifetime accounting + internal-group settle for one completion (the
+ * lock-free half shared by the single and batched post paths).
+ * Internal-spine completion groups: record the op's status and, on the
+ * group's LAST completion, wake the parked submitter.  The (possibly
+ * generation-fenced) st is what lands in stOut — internal submitters
+ * see DEVICE_RESET exactly like ring reapers. */
+static void post_settle(TpuMemring *r, const MrSlot *slot, TpuStatus st)
+{
     atomic_fetch_add(&r->hdr->completed, 1);
     if (st != TPU_OK) {
         atomic_fetch_add(&r->hdr->errorCqes, 1);
         tpuCounterAdd("memring_error_cqes", 1);
     }
     tpuCounterAdd("memring_cqes", 1);
-    if (wantCqe) {
-        atomic_fetch_add(&r->hdr->cqReady, 1);
-        pthread_mutex_unlock(&r->cqLock);
-    }
-    /* Wake only when a consumer is (about to be) parked: the waiter
-     * registers in cqWaiters BEFORE its last availability re-check, so
-     * a zero read here (seq_cst, after the cqReady bump) means any
-     * concurrent waiter will see this CQE, or see cqReady changed and
-     * fail its FUTEX_WAIT with EAGAIN — never a lost wakeup.  Saves a
-     * syscall per CQE on the waiter-free fast path. */
-    if (wantCqe && atomic_load(&r->hdr->cqWaiters) != 0)
-        mr_futex(&r->hdr->cqReady, FUTEX_WAKE, INT32_MAX, NULL);
-
-    /* Internal-spine completion group: record the op's status and, on
-     * the group's LAST completion, wake the parked submitter.  The
-     * (possibly generation-fenced) st above is what lands in stOut —
-     * internal submitters see DEVICE_RESET exactly like ring reapers. */
     if (slot) {
         if (slot->stOut)
             *slot->stOut = st;
@@ -293,20 +535,42 @@ static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
                          NULL);
         }
     }
+}
 
-    if (countInflight) {
-        atomic_fetch_sub(&r->inflight, 1);
-        /* Broadcast only when a fence worker is (about to be) parked:
-         * the waiter registers in drainWaiters before its predicate
-         * re-check, and we must take popLock to broadcast, so the wake
-         * cannot slip between that check and the cond_wait.  The
-         * common fence-free retire stays off the pop mutex. */
-        if (atomic_load(&r->drainWaiters) != 0) {
-            pthread_mutex_lock(&r->popLock);
-            pthread_cond_broadcast(&r->drainCond);
-            pthread_mutex_unlock(&r->popLock);
-        }
+/* Post one completion.  NOTE: does NOT retire the seq — callers batch
+ * retirement through mr_retire_seqs (one frontier-lock acquisition per
+ * claim batch) after their CQEs are visible. */
+static void post_cqe(TpuMemring *r, const TpuMemringSqe *sqe,
+                     const MrSlot *slot, TpuStatus st, uint64_t bytes,
+                     uint64_t seq, uint64_t t0, uint64_t t1,
+                     bool countInflight, uint64_t claimGen)
+{
+    st = mr_gen_fence(st, &bytes, claimGen);
+    atomic_store_explicit(&r->lastProgressNs, t1, memory_order_relaxed);
+    /* Slot-carrying internal ops complete through their MrGroup, and
+     * nothing ever reaps the internal ring's CQ — writing CQEs there
+     * would permanently overflow it after one CQ's worth of traffic,
+     * inflating the memring_cq_overflows pathology signal on healthy
+     * load (and paying cqLock per op for entries no one reads).  Their
+     * accounting (completed/errorCqes/counters) still advances. */
+    bool wantCqe = !(r->internal && slot);
+    if (wantCqe) {
+        pthread_mutex_lock(&r->cqLock);
+        cqe_write_locked(r, sqe, st, bytes, seq, t0, t1);
+        pthread_mutex_unlock(&r->cqLock);
     }
+    post_settle(r, slot, st);
+    /* Wake only when a consumer is (about to be) parked: the waiter
+     * registers in cqWaiters BEFORE its last availability re-check, so
+     * a zero read here (seq_cst, after the cqReady bump) means any
+     * concurrent waiter will see this CQE, or see cqReady changed and
+     * fail its FUTEX_WAIT with EAGAIN — never a lost wakeup.  Saves a
+     * syscall per CQE on the waiter-free fast path. */
+    if (wantCqe && atomic_load(&r->hdr->cqWaiters) != 0)
+        mr_futex(&r->hdr->cqReady, FUTEX_WAKE, INT32_MAX, NULL);
+
+    if (countInflight)
+        atomic_fetch_sub(&r->inflight, 1);
 }
 
 /* ------------------------------------------------------- op execution */
@@ -495,6 +759,16 @@ static TpuStatus exec_run_recovered(TpuMemring *r,
                                     uint64_t len, uint64_t *bytesOut,
                                     bool *injectedFail)
 {
+    *injectedFail = false;
+    /* Internal opcodes own their recovery: OP_FAULT wraps the fault
+     * engine's bounded retry + quarantine (a ring-level re-service of
+     * a cancelled entry would double-quarantine), OP_TIER_EVICT is
+     * best-effort by contract.  Neither evaluates memring.submit, so
+     * the inject invariant stays exact over the retryable opcodes —
+     * and neither needs the retry-budget registry reads below (this
+     * is the single-fault hot path). */
+    if (sqe->opcode >= TPU_MEMRING_OP_INTERNAL_BASE)
+        return exec_sqe(r, sqe, vs, len, bytesOut);
     /* Retry budget defaults to recover_copy_retries (tpuce doctrine:
      * "retries disabled" must govern the WHOLE copy path — now that
      * every uvmMigrate rides the spine, a private always-on budget
@@ -504,14 +778,6 @@ static TpuStatus exec_run_recovered(TpuMemring *r,
     uint32_t maxRetry = (uint32_t)tpuRegCacheGet(&g_retryCache,
                                                  "memring_retry_max",
                                                  copyDflt);
-    *injectedFail = false;
-    /* Internal opcodes own their recovery: OP_FAULT wraps the fault
-     * engine's bounded retry + quarantine (a ring-level re-service of
-     * a cancelled entry would double-quarantine), OP_TIER_EVICT is
-     * best-effort by contract.  Neither evaluates memring.submit, so
-     * the inject invariant stays exact over the retryable opcodes. */
-    if (sqe->opcode >= TPU_MEMRING_OP_INTERNAL_BASE)
-        return exec_sqe(r, sqe, vs, len, bytesOut);
     for (uint32_t attempt = 0;; attempt++) {
         TpuStatus st;
         bool injected = tpurmInjectShouldFailScoped(
@@ -581,25 +847,46 @@ static bool sqe_deadline_expired(const TpuMemringSqe *sqe, uint64_t now)
 
 /* Execute batch[0..n) (no links, no fences): coalesce contiguous
  * compatible spans, run each merged span once, post per-SQE CQEs.
- * `slots` is the parallel side-slot array (NULL on userspace rings). */
+ * `slots` is the parallel side-slot array (NULL on userspace rings);
+ * `cancel[i]` marks entries whose dep target retired with an error —
+ * they post TPU_ERR_INVALID_STATE without executing (dep-cancel
+ * mirrors chain-cancel) and never merge into runs.  CQEs of a merged
+ * run post under ONE cqLock acquisition and the run retires with ONE
+ * frontier-lock acquisition — the per-op locking the old path paid
+ * per CQE is the batch's to amortize. */
 static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
-                       const MrSlot *slots, uint32_t n, uint64_t firstSeq,
-                       uint64_t claimGen)
+                       const MrSlot *slots, const uint8_t *cancel,
+                       uint32_t n, uint64_t claimGen)
 {
+    uint64_t seqs[MEMRING_POP_BATCH];
+    uint8_t errs[MEMRING_POP_BATCH];
     uint32_t i = 0;
     while (i < n) {
         const MrSlot *slot = slots ? &slots[i] : NULL;
         UvmVaSpace *vs = slot && slot->vs ? slot->vs : r->vs;
-        if (sqe_deadline_expired(&batch[i], tpuNowNs())) {
-            uint64_t now = tpuNowNs();
+        uint64_t now = tpuNowNs();
+        if (cancel && cancel[i]) {
+            tpuCounterAdd("memring_dep_cancelled", 1);
+            post_cqe(r, &batch[i], slot, TPU_ERR_INVALID_STATE, 0,
+                     batch[i].seq, now, now, true, claimGen);
+            seqs[0] = batch[i].seq;
+            errs[0] = 1;
+            mr_retire_seqs(r, seqs, errs, 1);
+            i++;
+            continue;
+        }
+        if (sqe_deadline_expired(&batch[i], now)) {
             post_cqe(r, &batch[i], slot, TPU_ERR_RETRY_EXHAUSTED, 0,
-                     firstSeq + i, now, now, true, claimGen);
+                     batch[i].seq, now, now, true, claimGen);
+            seqs[0] = batch[i].seq;
+            errs[0] = 1;
+            mr_retire_seqs(r, seqs, errs, 1);
             i++;
             continue;
         }
         uint32_t runLen = 1;
         uint64_t spanLen = batch[i].len;
-        while (i + runLen < n &&
+        while (i + runLen < n && !(cancel && cancel[i + runLen]) &&
                run_merges(&batch[i], slot, batch[i].addr + spanLen,
                           &batch[i + runLen],
                           slots ? &slots[i + runLen] : NULL)) {
@@ -621,40 +908,93 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
         tpuCounterAdd("memring_ops", runLen);
         if (injectedFail)
             tpuCounterAdd("memring_inject_error_cqes", runLen);
-        for (uint32_t k = 0; k < runLen; k++)
-            /* Shared status; bytes attributed per-SQE.  Merged runs
-             * (always move ops) split the span by each SQE's len; a
-             * lone op reports what exec_sqe actually moved, so ADVISE/
-             * NOP post bytes == 0 here exactly as they do in chains. */
-            post_cqe(r, &batch[i + k], slots ? &slots[i + k] : NULL, st,
-                     st != TPU_OK ? 0
-                                  : (runLen > 1 ? batch[i + k].len
-                                                : moved),
-                     firstSeq + i + k, t0, t1, true, claimGen);
+        atomic_store_explicit(&r->lastProgressNs, t1,
+                              memory_order_relaxed);
+        /* Shared status; bytes attributed per-SQE.  Merged runs
+         * (always move ops) split the span by each SQE's len; a
+         * lone op reports what exec_sqe actually moved, so ADVISE/
+         * NOP post bytes == 0 here exactly as they do in chains. */
+        uint64_t fencedBytes = moved;
+        TpuStatus fst = mr_gen_fence(st, &fencedBytes, claimGen);
+        bool wantCqe = !(r->internal && slots);
+        if (wantCqe) {
+            pthread_mutex_lock(&r->cqLock);
+            for (uint32_t k = 0; k < runLen; k++)
+                cqe_write_locked(r, &batch[i + k], fst,
+                                 fst != TPU_OK
+                                     ? 0
+                                     : (runLen > 1 ? batch[i + k].len
+                                                   : fencedBytes),
+                                 batch[i + k].seq, t0, t1);
+            pthread_mutex_unlock(&r->cqLock);
+        }
+        if (!slots) {
+            /* Slot-free (userspace) runs settle in bulk: one RMW per
+             * counter per RUN, not per op — at 128-op coalesced runs
+             * the per-op settle was a measurable slice of the spine
+             * leg. */
+            atomic_fetch_add(&r->hdr->completed, runLen);
+            if (fst != TPU_OK) {
+                atomic_fetch_add(&r->hdr->errorCqes, runLen);
+                tpuCounterAdd("memring_error_cqes", runLen);
+            }
+            tpuCounterAdd("memring_cqes", runLen);
+            for (uint32_t k = 0; k < runLen; k++) {
+                seqs[k] = batch[i + k].seq;
+                errs[k] = fst != TPU_OK;
+            }
+        } else {
+            for (uint32_t k = 0; k < runLen; k++) {
+                post_settle(r, &slots[i + k], fst);
+                seqs[k] = batch[i + k].seq;
+                errs[k] = fst != TPU_OK;
+            }
+        }
+        if (wantCqe && atomic_load(&r->hdr->cqWaiters) != 0)
+            mr_futex(&r->hdr->cqReady, FUTEX_WAKE, INT32_MAX, NULL);
+        atomic_fetch_sub(&r->inflight, runLen);
+        mr_retire_seqs(r, seqs, errs, runLen);
         i += runLen;
     }
 }
 
-/* Execute a LINK chain sequentially; first failure cancels the rest. */
+/* Execute a LINK chain sequentially; first failure cancels the rest.
+ * Entries retire one by one — a dep targeting a mid-chain entry
+ * unblocks as soon as that entry completes, not when the chain does. */
 static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
-                       const MrSlot *slots, uint32_t n, uint64_t firstSeq,
-                       uint64_t claimGen)
+                       const MrSlot *slots, const uint8_t *cancel,
+                       uint32_t n, uint64_t claimGen)
 {
     bool cancelled = false;
     for (uint32_t i = 0; i < n; i++) {
         const MrSlot *slot = slots ? &slots[i] : NULL;
         UvmVaSpace *vs = slot && slot->vs ? slot->vs : r->vs;
+        uint64_t seq = chain[i].seq;
+        uint8_t err = 1;
+        if (cancel && cancel[i] && !cancelled) {
+            /* Dep-cancel inside a chain: behaves as this entry failing
+             * (cancels the remainder, like any chain failure). */
+            tpuCounterAdd("memring_dep_cancelled", 1);
+            cancelled = true;
+            uint64_t now = tpuNowNs();
+            post_cqe(r, &chain[i], slot, TPU_ERR_INVALID_STATE, 0,
+                     seq, now, now, true, claimGen);
+            mr_retire_seqs(r, &seq, &err, 1);
+            continue;
+        }
         if (cancelled) {
             uint64_t now = tpuNowNs();
             tpuCounterAdd("memring_links_cancelled", 1);
             post_cqe(r, &chain[i], slot, TPU_ERR_INVALID_STATE, 0,
-                     firstSeq + i, now, now, true, claimGen);
+                     seq, now, now, true, claimGen);
+            mr_retire_seqs(r, &seq, &err, 1);
             continue;
         }
         uint64_t t0 = tpuNowNs();
         if (sqe_deadline_expired(&chain[i], t0)) {
             post_cqe(r, &chain[i], slot, TPU_ERR_RETRY_EXHAUSTED, 0,
-                     firstSeq + i, t0, t0, true, claimGen);
+                     seq, t0, t0, true, claimGen);
+            mr_retire_seqs(r, &seq, &err, 1);
             cancelled = true;      /* chain semantics: failure cancels */
             continue;
         }
@@ -669,108 +1009,239 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
         tpuCounterAdd("memring_ops", 1);
         if (injectedFail)
             tpuCounterAdd("memring_inject_error_cqes", 1);
-        post_cqe(r, &chain[i], slot, st, moved, firstSeq + i, t0,
+        post_cqe(r, &chain[i], slot, st, moved, seq, t0,
                  tpuNowNs(), true, claimGen);
+        err = st != TPU_OK;
+        mr_retire_seqs(r, &seq, &err, 1);
         if (st != TPU_OK)
             cancelled = true;
     }
 }
 
-/* Claim the next fence / chain / plain-op run and execute it.  The
- * single drain body shared by pool workers and help-draining internal
- * submitters.  Returns true when it made progress (claimed, executed,
- * or consumed a fence — callers loop), false when the SQ was empty. */
-static bool mr_claim_and_exec(TpuMemring *r)
+typedef enum {
+    MR_CLAIM_EMPTY = 0,       /* nothing published                     */
+    MR_CLAIM_PROGRESS,        /* claimed + executed (or consumed)      */
+    MR_CLAIM_BLOCKED,         /* published work exists but every entry
+                               * is dep/fence-blocked — sleep on the
+                               * doorbell; retires re-ring it          */
+} MrClaimResult;
+
+/* Claim the next fence / chain / run of claimable ops and execute it.
+ * The single drain body shared by pool workers and help-draining
+ * internal submitters.
+ *
+ * The scan walks [sqHead, sqTail) skipping already-claimed slots and
+ * DEP-BLOCKED entries (tracker semantics: anything whose deps have
+ * retired is fair game, so independent traffic streams past a blocked
+ * op instead of queueing behind it).  A pending FENCE stops the scan —
+ * nothing later may start until it retires — and the fence itself is
+ * consumed once the retirement frontier reaches it.  LINK chains claim
+ * whole, and only once every entry's deps are satisfied (execution
+ * then never parks mid-chain).  `force` (ring shutdown) ignores deps
+ * so destroy drains the queue exactly as the FIFO pop did. */
+static MrClaimResult mr_claim_and_exec(TpuMemring *r, bool force)
 {
     TpuMemringSqe local[MEMRING_POP_BATCH];
     MrSlot localSlots[MEMRING_POP_BATCH];
+    uint8_t cancel[MEMRING_POP_BATCH];
+    uint64_t waited[MEMRING_POP_BATCH];
+    uint32_t sqMask = r->sqMask;
 
     pthread_mutex_lock(&r->popLock);
     uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
                                          memory_order_relaxed);
     uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
                                          memory_order_acquire);
-    if (head == tail) {
-        pthread_mutex_unlock(&r->popLock);
-        return false;
+    /* Advance the head past the claimed prefix (slots freed for the
+     * producer the moment their claim copied them out). */
+    while (head != tail && mr_bit_test(r->claimedMap, head & sqMask)) {
+        mr_bit_clear(r->claimedMap, head & sqMask);
+        head++;
     }
-
-    const TpuMemringSqe *first = &r->sq[head & r->sqMask];
-    if (first->opcode == TPU_MEMRING_OP_FENCE) {
-        /* Drain: nothing later can be claimed until every in-flight op
-         * retires.  cond_wait RELEASES the pop lock, so another worker
-         * may consume this same fence while we sleep — after any
-         * wakeup, report progress and let the caller re-read head/tail
-         * fresh instead of trusting the stale claim. */
-        atomic_fetch_add(&r->drainWaiters, 1);
-        if (atomic_load(&r->inflight) > 0 &&
-            !atomic_load(&r->shutdown)) {
-            pthread_cond_wait(&r->drainCond, &r->popLock);
-            atomic_fetch_sub(&r->drainWaiters, 1);
-            pthread_mutex_unlock(&r->popLock);
-            return true;
-        }
-        atomic_fetch_sub(&r->drainWaiters, 1);
-        TpuMemringSqe fence = *first;
-        uint64_t seq = r->popSeq++;
-        atomic_store_explicit(&r->hdr->sqHead, head + 1,
-                              memory_order_release);
+    atomic_store_explicit(&r->hdr->sqHead, head, memory_order_release);
+    if (head == tail) {
+        atomic_store(&r->depBlocked, 0);
         pthread_mutex_unlock(&r->popLock);
-        uint64_t now = tpuNowNs();
-        tpuCounterAdd("memring_fences", 1);
-        post_cqe(r, &fence, NULL, TPU_OK, 0, seq, now, now, false, 0);
-        return true;
+        return MR_CLAIM_EMPTY;
     }
 
     uint32_t n = 0;
-    bool chain = (first->flags & TPU_MEMRING_SQE_LINK) != 0;
-    if (chain) {
-        /* Claim the whole chain (terminated by a no-LINK entry or
-         * the publication boundary). */
-        while (head + n != tail && n < MEMRING_POP_BATCH) {
-            local[n] = r->sq[(head + n) & r->sqMask];
-            if (r->slots)
-                localSlots[n] = r->slots[(head + n) & r->sqMask];
-            n++;
-            if (!(local[n - 1].flags & TPU_MEMRING_SQE_LINK))
-                break;
+    bool chain = false;
+    uint32_t blocked = 0;
+    bool crossBlocked = false;
+    bool fenceReady = false;
+    TpuMemringSqe fence;
+    uint64_t nowStamp = 0;
+    static _Atomic(_Atomic uint64_t *) c_stalls;
+
+    for (uint32_t i = head; i != tail; i++) {
+        uint32_t si = i & sqMask;
+        if (mr_bit_test(r->claimedMap, si))
+            continue;
+        TpuMemringSqe *s = &r->sq[si];
+
+        if (s->opcode == TPU_MEMRING_OP_FENCE) {
+            if (n > 0)
+                break;             /* run what we have; fence next round */
+            /* IO_DRAIN: claimable only once every prior seq retired
+             * (frontier == fence seq; prior-claimed is implied).
+             * Otherwise the scan STOPS — nothing later starts. */
+            if (i == head &&
+                (force ||
+                 atomic_load_explicit(&r->hdr->seqRetired,
+                                      memory_order_acquire) >= s->seq)) {
+                fence = *s;
+                fenceReady = true;
+                atomic_store_explicit(&r->hdr->sqHead, head + 1,
+                                      memory_order_release);
+            } else {
+                blocked++;
+            }
+            break;
         }
-    } else {
-        /* Claim a run of plain ops, stopping before any FENCE or
-         * chain start. */
-        while (head + n != tail && n < MEMRING_POP_BATCH) {
-            const TpuMemringSqe *s = &r->sq[(head + n) & r->sqMask];
-            if (s->opcode == TPU_MEMRING_OP_FENCE ||
-                (s->flags & TPU_MEMRING_SQE_LINK))
-                break;
-            if (r->slots)
-                localSlots[n] = r->slots[(head + n) & r->sqMask];
-            local[n++] = *s;
+
+        if (s->flags & TPU_MEMRING_SQE_LINK) {
+            if (n > 0)
+                break;             /* chains claim alone (claimed-whole) */
+            /* Walk the whole chain; claim only when every entry's deps
+             * are satisfied (no mid-chain parking).  Dep-errors mark
+             * cancel[] and surface as chain failure at that entry. */
+            uint32_t clen = 0;
+            bool ok = true;
+            for (uint32_t j = i; j != tail && clen < MEMRING_POP_BATCH;
+                 j++) {
+                TpuMemringSqe *e = &r->sq[j & sqMask];
+                bool depErr = false;
+                if (!force &&
+                    !mr_deps_satisfied(r, e, &depErr, &crossBlocked)) {
+                    ok = false;
+                    break;
+                }
+                local[clen] = *e;
+                if (r->slots)
+                    localSlots[clen] = r->slots[j & sqMask];
+                cancel[clen] = depErr;
+                clen++;
+                if (!(e->flags & TPU_MEMRING_SQE_LINK))
+                    break;
+            }
+            if (!ok) {
+                /* Blocked chain: stamp the head entry for the depwait
+                 * histogram and scan PAST the whole chain. */
+                if (!r->depBlockNs[si]) {
+                    if (!nowStamp)
+                        nowStamp = tpuNowNs();
+                    r->depBlockNs[si] = nowStamp;
+                    mr_ctr_cached(&c_stalls, "memring_dep_stalls", 1);
+                }
+                blocked++;
+                uint32_t j = i;
+                while (j != tail &&
+                       (r->sq[j & sqMask].flags & TPU_MEMRING_SQE_LINK))
+                    j++;
+                i = j;             /* loop ++ steps past the tail op */
+                continue;
+            }
+            for (uint32_t k = 0; k < clen; k++)
+                mr_bit_set(r->claimedMap, (i + k) & sqMask);
+            n = clen;
+            chain = true;
+            if (r->depBlockNs[si]) {
+                if (!nowStamp)
+                    nowStamp = tpuNowNs();
+                waited[0] = nowStamp - r->depBlockNs[si];
+                r->depBlockNs[si] = 0;
+            } else {
+                waited[0] = 0;
+            }
+            break;
         }
+
+        /* Plain op. */
+        bool depErr = false;
+        if (!force && !mr_deps_satisfied(r, s, &depErr, &crossBlocked)) {
+            if (!r->depBlockNs[si]) {
+                if (!nowStamp)
+                    nowStamp = tpuNowNs();
+                r->depBlockNs[si] = nowStamp;
+                mr_ctr_cached(&c_stalls, "memring_dep_stalls", 1);
+            }
+            blocked++;
+            continue;              /* stream past: the OOO win */
+        }
+        local[n] = *s;
+        if (r->slots)
+            localSlots[n] = r->slots[si];
+        cancel[n] = depErr;
+        if (r->depBlockNs[si]) {
+            if (!nowStamp)
+                nowStamp = tpuNowNs();
+            waited[n] = nowStamp - r->depBlockNs[si];
+            r->depBlockNs[si] = 0;
+        } else {
+            waited[n] = 0;
+        }
+        mr_bit_set(r->claimedMap, si);
+        n++;
+        if (n == MEMRING_POP_BATCH)
+            break;
     }
-    uint64_t firstSeq = r->popSeq;
-    r->popSeq += n;
+
+    /* Publish the blocked census for the retire-side doorbell gate
+     * (registered BEFORE the caller's doorbell-value sleep re-check:
+     * seq_cst rules out the lost wakeup). */
+    atomic_store(&r->depBlocked, blocked);
+    if (crossBlocked)
+        atomic_store(&g_mrings.crossBlocked, blocked ? 1 : 0);
+
+    if (fenceReady) {
+        pthread_mutex_unlock(&r->popLock);
+        uint64_t now = tpuNowNs();
+        tpuCounterAdd("memring_fences", 1);
+        post_cqe(r, &fence, NULL, TPU_OK, 0, fence.seq, now, now, false,
+                 0);
+        uint8_t err = 0;
+        mr_retire_seqs(r, &fence.seq, &err, 1);
+        return MR_CLAIM_PROGRESS;
+    }
+    if (n == 0) {
+        pthread_mutex_unlock(&r->popLock);
+        return blocked ? MR_CLAIM_BLOCKED : MR_CLAIM_EMPTY;
+    }
+
     atomic_fetch_add(&r->inflight, n);
-    atomic_store_explicit(&r->hdr->sqHead, head + n,
-                          memory_order_release);
-    /* Claim-time generation: post_cqe fences completions whose
-     * claim crossed a device reset.  Stamped under popLock so the
-     * park/drain in tpurmMemringParkAll orders against it. */
+    /* Claim-time generation: post paths fence completions whose claim
+     * crossed a device reset.  Stamped under popLock so the park/drain
+     * in tpurmMemringParkAll orders against it. */
     uint64_t claimGen = tpurmDeviceGeneration();
-    atomic_store_explicit(&r->lastProgressNs, tpuNowNs(),
+    atomic_store_explicit(&r->lastProgressNs,
+                          nowStamp ? nowStamp : tpuNowNs(),
                           memory_order_relaxed);
     pthread_mutex_unlock(&r->popLock);
+
+    /* Dep-wait evidence: how long each claimed SQE sat blocked before
+     * its deps retired (0 = never blocked, not recorded). */
+    {
+        TpuHist *h = NULL;
+        for (uint32_t k = 0; k < (chain ? 1u : n); k++)
+            if (waited[k]) {
+                if (!h)
+                    h = tpurmTraceHistRef(TPU_TRACE_MEMRING_DEPWAIT);
+                if (h)
+                    tpuHistRecord(h, waited[k]);
+            }
+    }
 
     /* Dependent internal submissions from the exec below run inline. */
     t_mrWorker++;
     if (chain)
-        exec_chain(r, local, r->slots ? localSlots : NULL, n, firstSeq,
+        exec_chain(r, local, r->slots ? localSlots : NULL, cancel, n,
                    claimGen);
     else
-        exec_batch(r, local, r->slots ? localSlots : NULL, n, firstSeq,
+        exec_batch(r, local, r->slots ? localSlots : NULL, cancel, n,
                    claimGen);
     t_mrWorker--;
-    return true;
+    return MR_CLAIM_PROGRESS;
 }
 
 static void *worker_main(void *arg)
@@ -795,13 +1266,23 @@ static void *worker_main(void *arg)
                 mr_futex(&g_mrings.parkWord, FUTEX_WAIT, pw, &ts);
             }
         }
-        if (mr_claim_and_exec(r))
+        /* Doorbell snapshot BEFORE the claim: submits AND retires bump
+         * the word, so a failed claim (empty or dep-blocked) can sleep
+         * on this value — anything that could change the verdict also
+         * changes the word and fails the FUTEX_WAIT with EAGAIN. */
+        uint32_t d = atomic_load(&r->hdr->doorbell);
+        bool shut = atomic_load(&r->shutdown);
+        MrClaimResult res = mr_claim_and_exec(r, shut);
+        if (res == MR_CLAIM_PROGRESS)
             continue;
-        if (atomic_load(&r->shutdown))
-            break;                 /* SQ drained; exit */
+        if (shut || atomic_load(&r->shutdown)) {
+            if (res == MR_CLAIM_EMPTY && atomic_load(&r->shutdown))
+                break;             /* SQ drained; exit */
+            continue;              /* re-claim with force under shutdown */
+        }
 
         /* SQPOLL (io_uring SQPOLL idiom): registered pollers spin on
-         * the SQ tail so submitters skip the doorbell FUTEX_WAKE — a
+         * the doorbell word so submitters skip the FUTEX_WAKE — a
          * hot-path submit is one release store, zero syscalls.  The
          * idle timeout bounds the burn on a 1-2 CPU container; past it
          * the worker falls through to the futex sleep (counted). */
@@ -816,10 +1297,9 @@ static void *worker_main(void *arg)
             while (!atomic_load(&r->shutdown) &&
                    !atomic_load_explicit(&g_mrings.parked,
                                          memory_order_acquire)) {
-                if (atomic_load_explicit(&r->hdr->sqTail,
-                                         memory_order_acquire) !=
-                    atomic_load_explicit(&r->hdr->sqHead,
-                                         memory_order_relaxed)) {
+                /* The doorbell moves on submit AND retire — either can
+                 * make a blocked queue claimable again. */
+                if (atomic_load(&r->hdr->doorbell) != d) {
                     work = true;
                     break;
                 }
@@ -843,23 +1323,20 @@ static void *worker_main(void *arg)
                 tpuCounterAdd("memring_sqpoll_sleeps", 1);
         }
 
-        uint32_t d = atomic_load(&r->hdr->doorbell);
-        /* Re-check after snapshotting the doorbell so a submit
-         * between the check and the wait cannot be missed (a poller's
-         * deregister above is also covered: the doorbell word bumps on
-         * every submit even when the WAKE syscall is skipped). */
-        if (atomic_load_explicit(&r->hdr->sqTail,
-                                 memory_order_acquire) ==
-                atomic_load_explicit(&r->hdr->sqHead,
-                                     memory_order_relaxed) &&
+        /* Sleep on the snapshot taken before the claim: a submit or a
+         * retire in between changed the word and the wait bails with
+         * EAGAIN (a poller's deregister above is also covered).  A
+         * dep-blocked queue sleeps TIMED: cross-ring retires have no
+         * synchronization point that orders the blocked census against
+         * their gated wake, so a bounded re-scan is the backstop. */
+        if (atomic_load(&r->hdr->doorbell) == d &&
             !atomic_load(&r->shutdown) &&
             !atomic_load_explicit(&g_mrings.parked,
                                   memory_order_acquire)) {
-            /* No timeout needed: the doorbell value re-check above
-             * makes a missed wake impossible (a submit between the
-             * check and the wait changes the word and WAIT returns
-             * EAGAIN), and destroy bumps + wakes before each join. */
-            mr_futex(&r->hdr->doorbell, FUTEX_WAIT, d, NULL);
+            struct timespec bl = { .tv_sec = 0,
+                                   .tv_nsec = 10 * 1000 * 1000 };
+            mr_futex(&r->hdr->doorbell, FUTEX_WAIT, d,
+                     res == MR_CLAIM_BLOCKED ? &bl : NULL);
         }
     }
     return NULL;
@@ -876,8 +1353,12 @@ static TpuStatus mr_create(UvmVaSpace *vs, uint32_t sqEntries,
 {
     if (!out)
         return TPU_ERR_INVALID_ARGUMENT;
-    _Static_assert(sizeof(TpuMemringSqe) == 64, "SQE must be 64 bytes");
+    _Static_assert(sizeof(TpuMemringSqe) == 128,
+                   "SQE must be 128 bytes (SQE128: dep set rides the "
+                   "second cacheline)");
     _Static_assert(sizeof(TpuMemringCqe) == 64, "CQE must be 64 bytes");
+    _Static_assert((MEMRING_DONE_MULT & (MEMRING_DONE_MULT - 1)) == 0,
+                   "done-window multiplier must keep doneBits pow2");
 
     if (sqEntries == 0)
         sqEntries = 256;
@@ -900,6 +1381,24 @@ static TpuStatus mr_create(UvmVaSpace *vs, uint32_t sqEntries,
             free(r);
             return TPU_ERR_NO_MEMORY;
         }
+    }
+    /* Dep-tracker state: claim bitmap (1 bit/slot), blocked-since
+     * stamps, and the retirement done-window (MEMRING_DONE_MULT SQ
+     * sizes of bits — prep gates the frontier lag so bits never
+     * alias). */
+    r->doneBits = MEMRING_DONE_MULT * sqEntries;
+    r->claimedMap = calloc(sqEntries >= 64 ? sqEntries / 64 : 1,
+                           sizeof(uint64_t));
+    r->depBlockNs = calloc(sqEntries, sizeof(uint64_t));
+    r->doneMap = calloc(r->doneBits >= 64 ? r->doneBits / 64 : 1,
+                        sizeof(uint64_t));
+    if (!r->claimedMap || !r->depBlockNs || !r->doneMap) {
+        free((void *)r->claimedMap);
+        free(r->depBlockNs);
+        free((void *)r->doneMap);
+        free(r->slots);
+        free(r);
+        return TPU_ERR_NO_MEMORY;
     }
 
     size_t sqBytes = (size_t)sqEntries * sizeof(TpuMemringSqe);
@@ -933,10 +1432,16 @@ static TpuStatus mr_create(UvmVaSpace *vs, uint32_t sqEntries,
     r->cqMask = cqEntries - 1;
     r->vs = vs;
     pthread_mutex_init(&r->popLock, NULL);
-    pthread_cond_init(&r->drainCond, NULL);
     pthread_mutex_init(&r->cqLock, NULL);
     pthread_mutex_init(&r->apLock, NULL);
     pthread_mutex_init(&r->prodLock, NULL);
+    pthread_mutex_init(&r->retireLock, NULL);
+    /* Dep handles carry 16-bit ring ids: allocate in [1, 0xFFFE]
+     * (0 = invalid, 0xFFFF = the BATCH pseudo-ring) and wrap — a
+     * collision needs two LIVE rings 65534 creations apart, and the
+     * registry walk resolves the first live match. */
+    r->id = (atomic_fetch_add(&g_mrings.nextId, 1) % 0xFFFEu) + 1;
+    r->hdr->ringId = r->id;
 
     r->workerCount = workers;
     for (uint32_t i = 0; i < workers; i++) {
@@ -987,15 +1492,14 @@ void tpurmMemringDestroy(TpuMemring *r)
      * shutdown is prompt even mid-reset. */
     atomic_fetch_add(&g_mrings.parkWord, 1);
     mr_futex(&g_mrings.parkWord, FUTEX_WAKE, INT32_MAX, NULL);
-    /* Wake sleepers: poppers on the doorbell, drain-waiters on cond. */
+    /* Wake doorbell sleepers (fence/dep-blocked waits ride the same
+     * futex now — no separate drain cond to broadcast). */
     atomic_fetch_add(&r->hdr->doorbell, 1);
     mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
-    pthread_mutex_lock(&r->popLock);
-    pthread_cond_broadcast(&r->drainCond);
-    pthread_mutex_unlock(&r->popLock);
     for (uint32_t i = 0; i < r->workerCount; i++) {
-        /* Workers drain the published SQ before exiting; keep waking
-         * in case one raced into a futex wait. */
+        /* Workers drain the published SQ before exiting (deps are
+         * ignored under shutdown, exactly the legacy FIFO drain); keep
+         * waking in case one raced into a futex wait. */
         atomic_fetch_add(&r->hdr->doorbell, 1);
         mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
         pthread_join(r->workers[i], NULL);
@@ -1005,17 +1509,20 @@ void tpurmMemringDestroy(TpuMemring *r)
     munmap(r->shm, r->shmSize);
     close(r->shmFd);
     pthread_mutex_destroy(&r->popLock);
-    pthread_cond_destroy(&r->drainCond);
     pthread_mutex_destroy(&r->cqLock);
     pthread_mutex_destroy(&r->apLock);
     pthread_mutex_destroy(&r->prodLock);
+    pthread_mutex_destroy(&r->retireLock);
+    free((void *)r->claimedMap);
+    free(r->depBlockNs);
+    free((void *)r->doneMap);
     free(r->slots);
     free(r);
 }
 
 /* ------------------------------------------------------- producer side */
 
-TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe)
+TpuStatus tpurmMemringPrep(TpuMemring *r, TpuMemringSqe *sqe)
 {
     if (!r || !sqe)
         return TPU_ERR_INVALID_ARGUMENT;
@@ -1025,6 +1532,8 @@ TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe)
      * a userspace-facing ring. */
     if (!r->internal && sqe->opcode >= TPU_MEMRING_OP_INTERNAL_BASE)
         return TPU_ERR_INVALID_COMMAND;
+    if (sqe->depCount > TPU_MEMRING_SQE_NDEPS)
+        return TPU_ERR_INVALID_ARGUMENT;
     /* Chains must fit one worker claim (claimed-whole semantics): a
      * longer chain would be split across workers, breaking ordering
      * and cancel-on-failure. */
@@ -1034,8 +1543,36 @@ TpuStatus tpurmMemringPrep(TpuMemring *r, const TpuMemringSqe *sqe)
                                          memory_order_acquire);
     if (r->pendTail - head >= r->hdr->sqEntries)
         return TPU_ERR_INSUFFICIENT_RESOURCES;
+    /* Frontier-lag gate: the done-window is finite, so a live seq may
+     * sit at most doneBits-1 above the retirement watermark (a hung op
+     * pins the watermark while later work retires into the window).
+     * Same remedy as SQ-full: submit and reap. */
+    if (r->prepSeq - atomic_load_explicit(&r->hdr->seqRetired,
+                                          memory_order_acquire) >=
+        (uint64_t)r->doneBits - 1)
+        return TPU_ERR_INSUFFICIENT_RESOURCES;
+    sqe->seq = r->prepSeq;
+    /* Rewrite BATCH-relative deps (index into the unpublished batch)
+     * to absolute handles; a dep must point BACKWARDS. */
+    for (uint32_t i = 0; i < sqe->depCount; i++) {
+        uint64_t d = sqe->deps[i];
+        if (TPU_MEMRING_DEP_RING(d) != TPU_MEMRING_DEP_BATCH)
+            continue;
+        uint64_t seq = r->batchStartSeq + TPU_MEMRING_DEP_SEQ(d);
+        if (seq >= sqe->seq)
+            return TPU_ERR_INVALID_ARGUMENT;
+        sqe->deps[i] = TPU_MEMRING_DEP(r->id, seq) |
+                       (d & TPU_MEMRING_DEP_ORDERED);
+    }
     r->sq[r->pendTail & r->sqMask] = *sqe;
+    /* The slot this seq's done-bit will use must be clean before the
+     * SQE publishes (a stale bit would falsely satisfy a dependent or
+     * stall the frontier advance).  Retirement clears bits as the
+     * watermark passes them, so this is belt-and-suspenders for the
+     * first wrap. */
+    r->depBlockNs[r->pendTail & r->sqMask] = 0;
     r->pendTail++;
+    r->prepSeq++;
     r->pendChain = (sqe->flags & TPU_MEMRING_SQE_LINK)
                        ? r->pendChain + 1 : 0;
     return TPU_OK;
@@ -1065,6 +1602,7 @@ uint32_t tpurmMemringSubmit(TpuMemring *r)
     }
     atomic_store_explicit(&r->hdr->sqTail, r->pendTail,
                           memory_order_release);
+    r->batchStartSeq = r->prepSeq;   /* BATCH deps resolve per batch */
     atomic_fetch_add(&r->hdr->submitted, n);
     tpuCounterAdd("memring_submits", 1);
     tpuCounterAdd("memring_sqes", n);
@@ -1201,7 +1739,15 @@ uint32_t tpurmMemringSqSpace(TpuMemring *r)
         return 0;
     uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
                                          memory_order_acquire);
-    return r->hdr->sqEntries - (r->pendTail - head);
+    uint32_t room = r->hdr->sqEntries - (r->pendTail - head);
+    /* The frontier-lag gate (see prep) can be the tighter bound when a
+     * hung op pins the retirement watermark. */
+    uint64_t lag = r->prepSeq -
+                   atomic_load_explicit(&r->hdr->seqRetired,
+                                        memory_order_acquire);
+    uint64_t winRoom = (uint64_t)r->doneBits - 1 > lag
+                           ? (uint64_t)r->doneBits - 1 - lag : 0;
+    return winRoom < room ? (uint32_t)winRoom : room;
 }
 
 void tpurmMemringCounts(TpuMemring *r, uint64_t *submitted,
@@ -1250,24 +1796,76 @@ static void mr_internal_init_once(void)
     }
 }
 
-/* Inline execution of an internal batch: same per-op recovery and
- * LINK cancel-on-failure semantics as the ring path, no queue round
- * trip.  Used for dependent submissions from inside a worker, while
- * the pools are reset-parked (a queued ghost would bypass quiesce),
- * and when the spine ring could not be created. */
+/* Inline execution of an internal batch: same per-op recovery, LINK
+ * cancel-on-failure, and intra-batch dep-cancel semantics as the ring
+ * path, no queue round trip.  Used for dependent submissions from
+ * inside a worker, while the pools are reset-parked (a queued ghost
+ * would bypass quiesce), and when the spine ring could not be created.
+ * Execution is in submission order, so a BATCH dep (index) is always
+ * already resolved when its dependent runs; `depBase` is sqes[0]'s
+ * index within the ORIGINAL batch (nonzero only on the park-race
+ * remainder path) — deps pointing below it resolve satisfied-OK (the
+ * published share completed before this call). */
 static TpuStatus mr_exec_inline(UvmVaSpace *vs, const TpuMemringSqe *sqes,
-                                uint32_t n, TpuStatus *stOut)
+                                uint32_t n, TpuStatus *stOut,
+                                uint32_t depBase,
+                                const TpuStatus *priorSt)
 {
     TpuMemring *r = g_int.ring;        /* may be NULL (create failure) */
     TpuStatus first = TPU_OK;
     bool cancelled = false;
+    /* Fail tracking feeds only intra-batch dep-cancel: skip the
+     * bookkeeping entirely for dep-free batches (the single-fault hot
+     * path). */
+    bool anyDeps = priorSt != NULL;
+    for (uint32_t i = 0; i < n && !anyDeps; i++)
+        anyDeps = sqes[i].depCount != 0;
+    uint8_t failStack[512];
+    uint8_t *failed = NULL;
+    if (anyDeps) {
+        failed = n <= sizeof(failStack) ? failStack : calloc(n, 1);
+        if (failed == failStack)
+            memset(failStack, 0, n);
+    }
     static _Atomic(_Atomic uint64_t *) c_inline, c_ops;
     mr_ctr_cached(&c_inline, "memring_internal_inline", n);
     for (uint32_t i = 0; i < n; i++) {
         TpuStatus st;
-        if (cancelled) {
+        bool depCancel = false;
+        if (failed && !cancelled) {
+            uint32_t nd = sqes[i].depCount <= TPU_MEMRING_SQE_NDEPS
+                              ? sqes[i].depCount : TPU_MEMRING_SQE_NDEPS;
+            for (uint32_t k = 0; k < nd; k++) {
+                uint64_t d = sqes[i].deps[k];
+                if (TPU_MEMRING_DEP_RING(d) != TPU_MEMRING_DEP_BATCH)
+                    continue;      /* absolute: resolved (ring idle) */
+                if (d & TPU_MEMRING_DEP_ORDERED)
+                    continue;      /* in-order exec: already drained */
+                uint64_t j = TPU_MEMRING_DEP_SEQ(d);
+                if (j >= depBase && j - depBase < i &&
+                    failed[j - depBase])
+                    depCancel = true;
+                /* Published-share targets (park-race remainder): their
+                 * statuses were settled before this call — an errored
+                 * upstream (incl. a generation-fenced DEVICE_RESET)
+                 * cancels here exactly like on the ring path. */
+                else if (j < depBase && priorSt &&
+                         priorSt[j] != TPU_OK)
+                    depCancel = true;
+            }
+        }
+        if (depCancel) {
+            tpuCounterAdd("memring_dep_cancelled", 1);
+            st = TPU_ERR_INVALID_STATE;
+            if (failed)
+                failed[i] = 1;
+            if (sqes[i].flags & TPU_MEMRING_SQE_LINK)
+                cancelled = true;
+        } else if (cancelled) {
             tpuCounterAdd("memring_links_cancelled", 1);
             st = TPU_ERR_INVALID_STATE;
+            if (failed)
+                failed[i] = 1;
         } else {
             uint64_t moved = 0;
             bool injectedFail = false;
@@ -1286,12 +1884,16 @@ static TpuStatus mr_exec_inline(UvmVaSpace *vs, const TpuMemringSqe *sqes,
         if (st != TPU_OK) {
             if (first == TPU_OK)
                 first = st;
+            if (failed)
+                failed[i] = 1;
             if (sqes[i].flags & TPU_MEMRING_SQE_LINK)
                 cancelled = true;
         }
         if (!(sqes[i].flags & TPU_MEMRING_SQE_LINK))
             cancelled = false;         /* chain boundary */
     }
+    if (failed && failed != failStack)
+        free(failed);
     return first;
 }
 
@@ -1311,7 +1913,10 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
      * acceptance keys off.  Recorded unconditionally like the fault
      * histograms (quantiles must answer without tracing armed). */
     {
-        TpuHist *h = tpurmTraceHistRef(TPU_TRACE_MEMRING_CHAIN);
+        static TpuHist *volatile g_chainHist;
+        TpuHist *h = g_chainHist;
+        if (!h)
+            g_chainHist = h = tpurmTraceHistRef(TPU_TRACE_MEMRING_CHAIN);
         uint32_t len = 1;
         for (uint32_t i = 0; i < n; i++) {
             if (i + 1 < n && (sqes[i].flags & TPU_MEMRING_SQE_LINK)) {
@@ -1327,7 +1932,7 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
     TpuMemring *r = g_int.ring;
     if (!r || t_mrWorker ||
         atomic_load_explicit(&g_mrings.parked, memory_order_acquire))
-        return mr_exec_inline(vs, sqes, n, stOut);
+        return mr_exec_inline(vs, sqes, n, stOut, 0, NULL);
 
     /* Idle fast path (io_uring without SQPOLL executes submitted work
      * inline in the submit syscall; same idea): with no dedicated
@@ -1339,7 +1944,7 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
     if (r->workerCount == 0 &&
         atomic_load_explicit(&r->hdr->sqTail, memory_order_acquire) ==
             atomic_load_explicit(&r->hdr->sqHead, memory_order_relaxed))
-        return mr_exec_inline(vs, sqes, n, stOut);
+        return mr_exec_inline(vs, sqes, n, stOut, 0, NULL);
 
     MrGroup grp;
     atomic_store(&grp.remaining, n);
@@ -1360,10 +1965,16 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
      * reset. */
     if (atomic_load_explicit(&g_mrings.parked, memory_order_acquire)) {
         pthread_mutex_unlock(&r->prodLock);
-        return mr_exec_inline(vs, sqes, n, stOut);
+        return mr_exec_inline(vs, sqes, n, stOut, 0, NULL);
     }
     uint32_t i = 0;
     bool bailedInline = false;
+    /* Seqs of already-staged batch members: BATCH-relative deps (index
+     * into the batch) rewrite against these at stage time, so intra-
+     * batch DAG edges survive SQ-full republish boundaries and other
+     * producers interleaving on the seq counter. */
+    uint64_t seqStack[256];
+    uint64_t *seqOf = n <= 256 ? seqStack : malloc(n * sizeof(*seqOf));
     while (i < n) {
         uint32_t clen = 1;
         while (i + clen <= n - 1 &&
@@ -1375,7 +1986,7 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
             pthread_mutex_unlock(&r->prodLock);
             if (atomic_load_explicit(&g_mrings.parked,
                                      memory_order_acquire) ||
-                !mr_claim_and_exec(r))
+                mr_claim_and_exec(r, false) != MR_CLAIM_PROGRESS)
                 sched_yield();
             pthread_mutex_lock(&r->prodLock);
             if (atomic_load_explicit(&g_mrings.parked,
@@ -1386,8 +1997,21 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
                  * of the group, so the batch never sits queued through
                  * a reset. */
                 pthread_mutex_unlock(&r->prodLock);
+                /* The published share of THIS group must complete
+                 * before the remainder runs inline: a remainder op may
+                 * dep on a published one (fused evict->migrate), and
+                 * ParkAll's queue sweep is draining them now. */
+                for (;;) {
+                    uint32_t rem = atomic_load(&grp.remaining);
+                    if (rem <= n - i)
+                        break;
+                    struct timespec bts = { .tv_sec = 0,
+                                            .tv_nsec = 1 * 1000 * 1000 };
+                    mr_futex(&grp.remaining, FUTEX_WAIT, rem, &bts);
+                }
                 TpuStatus ist = mr_exec_inline(vs, sqes + i, n - i,
-                                               stOut ? stOut + i : NULL);
+                                               stOut ? stOut + i : NULL,
+                                               i, stOut);
                 if (ist != TPU_OK) {
                     uint32_t zero = 0;
                     atomic_compare_exchange_strong(&grp.firstErr, &zero,
@@ -1403,9 +2027,26 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
         TpuStatus ps = TPU_OK;
         uint32_t k = 0;
         for (; k < clen; k++) {
-            ps = tpurmMemringPrep(r, &sqes[i + k]);
+            TpuMemringSqe tmp = sqes[i + k];
+            uint32_t nd = tmp.depCount <= TPU_MEMRING_SQE_NDEPS
+                              ? tmp.depCount : TPU_MEMRING_SQE_NDEPS;
+            for (uint32_t m = 0; m < nd && ps == TPU_OK; m++) {
+                uint64_t d = tmp.deps[m];
+                if (TPU_MEMRING_DEP_RING(d) != TPU_MEMRING_DEP_BATCH)
+                    continue;
+                uint64_t j = TPU_MEMRING_DEP_SEQ(d);
+                if (j >= i + k || !seqOf)
+                    ps = TPU_ERR_INVALID_ARGUMENT;  /* forward dep */
+                else
+                    tmp.deps[m] = TPU_MEMRING_DEP(r->id, seqOf[j]) |
+                                  (d & TPU_MEMRING_DEP_ORDERED);
+            }
+            if (ps == TPU_OK)
+                ps = tpurmMemringPrep(r, &tmp);
             if (ps != TPU_OK)
                 break;
+            if (seqOf)
+                seqOf[i + k] = tmp.seq;
             r->slots[(r->pendTail - 1) & r->sqMask] = (MrSlot){
                 .vs = vs,
                 .grp = &grp,
@@ -1431,6 +2072,8 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
         tpurmMemringSubmit(r);
         pthread_mutex_unlock(&r->prodLock);
     }
+    if (seqOf && seqOf != seqStack)
+        free(seqOf);
 
     /* Submit-and-help: drain the ring (any subsystem's work — claims
      * interleave, coalescing merges) until our group retires.  While
@@ -1441,7 +2084,7 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
             break;
         if (!atomic_load_explicit(&g_mrings.parked,
                                   memory_order_acquire) &&
-            mr_claim_and_exec(r))
+            mr_claim_and_exec(r, false) == MR_CLAIM_PROGRESS)
             continue;
         rem = atomic_load(&grp.remaining);
         if (rem == 0)
@@ -1471,13 +2114,23 @@ TpuStatus tpurmMemringParkAll(uint64_t timeoutNs)
      * execution, exactly the old inline-service semantics (the PM
      * gate has not closed yet). */
     TpuMemring *ir = g_int.ring;
+    uint64_t deadline = tpuNowNs() + timeoutNs;
     if (ir) {
         pthread_mutex_lock(&ir->prodLock);
         pthread_mutex_unlock(&ir->prodLock);
-        while (mr_claim_and_exec(ir))
-            ;
+        /* Dep-blocked queued work waits on claims that slipped past
+         * the gate: keep sweeping until the queue is empty (bounded by
+         * the park deadline; leftovers replay after resume). */
+        for (;;) {
+            MrClaimResult res = mr_claim_and_exec(ir, false);
+            if (res == MR_CLAIM_PROGRESS)
+                continue;
+            if (res == MR_CLAIM_EMPTY || tpuNowNs() >= deadline)
+                break;
+            struct timespec ts = { .tv_sec = 0, .tv_nsec = 200 * 1000 };
+            nanosleep(&ts, NULL);
+        }
     }
-    uint64_t deadline = tpuNowNs() + timeoutNs;
     for (;;) {
         uint32_t busy = 0;
         pthread_mutex_lock(&g_mrings.lock);
@@ -1535,7 +2188,10 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
         return 0;
     pthread_mutex_lock(&g_mrings.lock);
     for (TpuMemring *r = g_mrings.head; r; r = r->next) {
-        if (atomic_load(&r->inflight) == 0) {
+        uint32_t queued =
+            atomic_load_explicit(&r->hdr->sqTail, memory_order_acquire) -
+            atomic_load_explicit(&r->hdr->sqHead, memory_order_relaxed);
+        if (atomic_load(&r->inflight) == 0 && queued == 0) {
             atomic_store(&r->wdRung, 0);
             continue;
         }
@@ -1545,6 +2201,16 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
             atomic_store(&r->wdRung, 0);
             continue;
         }
+        if (atomic_load(&r->inflight) == 0) {
+            /* Queued but nothing in flight: every entry is dep-blocked
+             * (or a wake was lost).  Re-ring the doorbell — escalation
+             * cannot unstick a producer-side dependency cycle, and
+             * resetting the device for one would be a storm. */
+            tpuCounterAdd("tpurm_watchdog_nudges", 1);
+            atomic_fetch_add(&r->hdr->doorbell, 1);
+            mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+            continue;
+        }
         uint32_t rung = atomic_load(&r->wdRung) + 1;
         if (rung > 4)
             rung = 4;                      /* saturated: no storms */
@@ -1552,13 +2218,10 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
         switch (rung) {
         case 1:
             /* A lost wake is the cheapest wedge: re-ring the doorbell
-             * and the drain cond. */
+             * (fence and dep waits ride the same futex now). */
             tpuCounterAdd("tpurm_watchdog_nudges", 1);
             atomic_fetch_add(&r->hdr->doorbell, 1);
             mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
-            pthread_mutex_lock(&r->popLock);
-            pthread_cond_broadcast(&r->drainCond);
-            pthread_mutex_unlock(&r->popLock);
             break;
         case 2:
             tpuCounterAdd("tpurm_watchdog_rc_resets", 1);

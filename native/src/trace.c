@@ -79,6 +79,7 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "memring.submit",         "memring" },
     { "memring.op",             "memring" },
     { "memring.chain",          "memring" },
+    { "memring.depwait",        "memring" },
     { "ce.copy",                "ce"      },
     { "ce.stripe",              "ce"      },
     { "sched.round",            "sched"   },

@@ -97,6 +97,11 @@ typedef struct {
     _Atomic uint64_t widx;
     uint64_t ridx;                    /* owning worker only */
     uint32_t pending;                 /* futex word */
+    /* SQPOLL-style wake elision (PR 11): nonzero while the worker is
+     * yield-spinning on `pending` — producers then skip the FUTEX_WAKE
+     * syscall (the spin's deregister-then-recheck makes a lost wake
+     * impossible), taking two syscalls out of the fault wake path. */
+    uint32_t polling;
 
     pthread_t thread;
     /* Written once by the worker at startup, read by the SIGSEGV
@@ -358,7 +363,13 @@ static void ring_push(FaultWorker *w, UvmFaultEntry *e)
     slot->e = e;
     atomic_store_explicit(&slot->seq, t + 1, memory_order_release);
     __atomic_fetch_add(&w->pending, 1, __ATOMIC_SEQ_CST);
-    futex_call(&w->pending, FUTEX_WAKE, 1);
+    /* Wake elision: a poller sees the pending bump on its next spin
+     * check (it deregisters BEFORE its final re-check, so reading
+     * polling != 0 here proves the bump will be observed).  Saves the
+     * producer's syscall on the hot path — the fault wake was the
+     * largest single slice of fault latency. */
+    if (__atomic_load_n(&w->polling, __ATOMIC_SEQ_CST) == 0)
+        futex_call(&w->pending, FUTEX_WAKE, 1);
 }
 
 /* Consumer (owning worker only).  Returns NULL when the ring is empty. */
@@ -381,6 +392,36 @@ static UvmFaultEntry *ring_pop(FaultWorker *w)
 static bool ring_wait_nonempty(FaultWorker *w, uint64_t timeoutNs)
 {
     uint64_t deadline = uvmMonotonicNs() + timeoutNs;
+    /* Adaptive spin before the futex sleep (registry
+     * uvm_fault_spin_us, default 150): populate/storm patterns fault
+     * back-to-back, and catching the next entry in the spin window
+     * skips BOTH the producer's FUTEX_WAKE (see ring_push) and this
+     * side's futex wakeup — the two syscalls that dominated fault wake
+     * p50.  sched_yield in the loop keeps the producer runnable on a
+     * 1-CPU box; the idle duty cycle is spin/sweep ≈ 0.3%%. */
+    static TpuRegCache c_spin;
+    uint64_t spinNs = tpuRegCacheGet(&c_spin, "uvm_fault_spin_us", 150) *
+                      1000ull;
+    if (spinNs) {
+        uint64_t t0 = uvmMonotonicNs();
+        __atomic_store_n(&w->polling, 1, __ATOMIC_SEQ_CST);
+        while (uvmMonotonicNs() - t0 < spinNs) {
+            if (__atomic_load_n(&w->pending, __ATOMIC_SEQ_CST) > 0) {
+                __atomic_store_n(&w->polling, 0, __ATOMIC_SEQ_CST);
+                return true;
+            }
+            if (atomic_load_explicit(&g_fault.paused,
+                                     memory_order_acquire))
+                break;             /* reset quiesce: park promptly */
+            sched_yield();
+        }
+        __atomic_store_n(&w->polling, 0, __ATOMIC_SEQ_CST);
+        /* Deregister-then-recheck: a producer that skipped its wake
+         * because it read polling != 0 published `pending` before we
+         * stored 0 (seq_cst total order), so this re-check sees it. */
+        if (__atomic_load_n(&w->pending, __ATOMIC_SEQ_CST) > 0)
+            return true;
+    }
     for (;;) {
         uint32_t p = __atomic_load_n(&w->pending, __ATOMIC_SEQ_CST);
         if (p > 0)
@@ -554,9 +595,28 @@ static TpuStatus service_one(UvmFaultEntry *e)
                 !uvmPageMaskTest(&blk->cpuMapped, firstPage) &&
                 !(blk->hasCancelled &&
                   uvmPageMaskTest(&blk->cancelled, firstPage));
+            /* FIRST-TOUCH upgrade: a page resident NOWHERE has no copy
+             * to duplicate and no owner to invalidate — servicing the
+             * fault as a WRITE yields the exact same exclusive-host
+             * end state as a read service except the mapping opens RW,
+             * so a populate store doesn't pay a second fault + probe +
+             * mprotect round trip per page (the populate pattern
+             * double-faulted every page before this).  Genuine
+             * first-touch reads get the same correct mapping. */
+            bool fresh = !(blk->hasCancelled &&
+                           uvmPageMaskTest(&blk->cancelled, firstPage));
+            for (int t = 0; fresh && t < UVM_TIER_COUNT; t++)
+                if (uvmPageMaskTest(&blk->resident[t], firstPage))
+                    fresh = false;
             tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "write-infer");
             pthread_mutex_unlock(&blk->lock);
-            if (roMapped) {
+            static TpuRegCache c_ftw;
+            if (fresh && tpuRegCacheGet(&c_ftw, "uvm_first_touch_write",
+                                        1)) {
+                e->isWrite = 1;
+                tpuCounterAdd("uvm_write_faults_inferred", 1);
+                tpuCounterAdd("uvm_first_touch_writes", 1);
+            } else if (roMapped) {
                 /* Confirm the page is actually READABLE before
                  * upgrading: a host-resident page can also sit behind
                  * PROT_NONE (e.g. a surviving read-dup copy after an
@@ -979,13 +1039,18 @@ static void *fault_service_thread(void *arg)
     if (maxBatch == 0 || maxBatch > FAULT_RING_SIZE)
         maxBatch = 256;
     UvmFaultEntry **batch = malloc(maxBatch * sizeof(*batch));
-    /* Spine staging: SQE scratch for the per-block fault chains, plus
-     * a taken-mark per batch slot (both worker-private). */
+    /* Spine staging: SQE scratch for the dep-ordered fault DAG, the
+     * staged entries' block keys/spaces (dep-target search), and a
+     * taken-mark per batch slot (all worker-private). */
     TpuMemringSqe *sqes = malloc(maxBatch * sizeof(*sqes));
+    uint64_t *blockOf = malloc(maxBatch * sizeof(*blockOf));
+    UvmVaSpace **vsOf = malloc(maxBatch * sizeof(*vsOf));
     uint8_t *taken = malloc(maxBatch);
-    if (!batch || !sqes || !taken) {
+    if (!batch || !sqes || !blockOf || !vsOf || !taken) {
         free(batch);
         free(sqes);
+        free(blockOf);
+        free(vsOf);
         free(taken);
         return NULL;
     }
@@ -1105,87 +1170,71 @@ static void *fault_service_thread(void *arg)
                                      1);
 
         /* SPINE SERVICE: the batch's primaries go down the internal
-         * memring as OP_FAULT LINK chains — one chain per faulting VA
-         * BLOCK (the chain's ordered, claimed-whole execution is what
-         * preserves the per-block single-writer discipline the perf
-         * state relies on, now that execution may land on any spine
-         * worker), all chains published with ONE submit.  Multi-block
-         * spans (single-worker config only) and same-block overflow
-         * past one claim submit in follow-up passes, after the prior
-         * group drained, so two chains for one block never run
-         * concurrently.  On an idle ring the submitter claims its own
-         * chains right back (submit-and-help), so the added cost over
-         * the old inline loop is one claim + CQE post per chain. */
+         * memring as a dependency DAG of OP_FAULT SQEs — per-VA-block
+         * ordering is an intra-batch dep on the PREVIOUS same-block
+         * entry (tracker semantics), not a claimed-whole LINK chain,
+         * so different blocks' entries interleave freely across spine
+         * workers while a block still never has two entries in flight
+         * (the dependent claims only after its predecessor RETIRED —
+         * the single-writer perf-state discipline holds).  One
+         * submission per batch; only block-CROSSING spans still go
+         * down alone in follow-up passes (they could alias other
+         * entries' blocks from either side, and the group drain
+         * between passes is the ordering barrier).  On an idle ring
+         * the submitter claims its own work right back
+         * (submit-and-help), so the added cost over the old inline
+         * loop is one claim + CQE post per entry. */
         {
             memset(taken, 0, n);
-            for (;;) {
-                uint32_t ns = 0;
-                for (uint32_t i = 0; i < n; i++) {
-                    UvmFaultEntry *e = batch[i];
-                    if (!e || dupOf[i] >= 0 || taken[i] || ns >= maxBatch)
-                        continue;
-                    uint64_t blockIdx = e->addr / UVM_BLOCK_SIZE;
-                    bool multi = (e->addr + (e->len ? e->len : 1) - 1) /
-                                     UVM_BLOCK_SIZE != blockIdx;
-                    /* A block-crossing span submits ALONE (the sole
-                     * chain of its pass): staged beside other chains
-                     * it could alias their blocks from either side. */
-                    if (multi && ns > 0)
-                        continue;          /* leads the next pass */
-                    uint32_t chainStart = ns;
-                    bool capped = false;
-                    for (uint32_t j = i; j < n && ns < maxBatch; j++) {
-                        UvmFaultEntry *f = batch[j];
-                        if (!f || dupOf[j] >= 0 || taken[j])
-                            continue;
-                        if (multi) {
-                            /* Block-crossing span (single-worker
-                             * config): a one-op chain of its own — it
-                             * would alias other chains' blocks. */
-                        } else if (f->vs != e->vs ||
-                                   f->addr / UVM_BLOCK_SIZE != blockIdx ||
-                                   (f->addr + (f->len ? f->len : 1) - 1) /
-                                           UVM_BLOCK_SIZE != blockIdx) {
-                            continue;
-                        }
-                        if (ns - chainStart >= 64) {
-                            capped = true;  /* one worker claim max */
-                            break;
-                        }
-                        memset(&sqes[ns], 0, sizeof(sqes[ns]));
-                        sqes[ns].opcode = TPU_MEMRING_OP_FAULT;
-                        sqes[ns].flags = TPU_MEMRING_SQE_LINK;
-                        sqes[ns].addr = (uint64_t)(uintptr_t)f;
-                        sqes[ns].len = f->len ? f->len : 1;
-                        sqes[ns].userData = f->addr;
-                        taken[j] = 1;
-                        ns++;
-                        if (multi)
-                            break;
-                    }
-                    if (ns > chainStart)
-                        sqes[ns - 1].flags &=
-                            (uint8_t)~TPU_MEMRING_SQE_LINK;
-                    if (capped || multi)
-                        /* Stop scanning; later candidates wait for the
-                         * NEXT pass.  capped: this block's leftovers
-                         * must not become a second same-block chain in
-                         * THIS submission (another spine worker could
-                         * claim it concurrently).  multi: the chain's
-                         * span covers SEVERAL blocks, and any later
-                         * entry could alias one of them — same
-                         * single-writer argument, whole range. */
+            uint32_t ns = 0;
+            for (uint32_t i = 0; i < n; i++) {
+                UvmFaultEntry *e = batch[i];
+                if (!e || dupOf[i] >= 0 || ns >= maxBatch)
+                    continue;
+                uint64_t blockIdx = e->addr / UVM_BLOCK_SIZE;
+                if ((e->addr + (e->len ? e->len : 1) - 1) /
+                        UVM_BLOCK_SIZE != blockIdx)
+                    continue;          /* block-crossing: later pass */
+                memset(&sqes[ns], 0, sizeof(sqes[ns]));
+                sqes[ns].opcode = TPU_MEMRING_OP_FAULT;
+                sqes[ns].addr = (uint64_t)(uintptr_t)e;
+                sqes[ns].len = e->len ? e->len : 1;
+                sqes[ns].userData = e->addr;
+                for (uint32_t j = ns; j-- > 0;) {
+                    if (blockOf[j] == blockIdx && vsOf[j] == e->vs) {
+                        tpurmMemringSqeDep(
+                            &sqes[ns],
+                            TPU_MEMRING_DEP(TPU_MEMRING_DEP_BATCH, j));
                         break;
+                    }
                 }
-                if (ns == 0)
-                    break;
+                blockOf[ns] = blockIdx;
+                vsOf[ns] = e->vs;
+                taken[i] = 1;
+                ns++;
+            }
+            if (ns)
                 tpurmMemringSubmitInternal(NULL, sqes, ns, NULL,
                                            TPU_MEMRING_SUBSYS_FAULT);
+            /* Follow-up passes: each block-crossing span alone (the
+             * prior group drained, so nothing it could alias is in
+             * flight). */
+            for (uint32_t i = 0; i < n; i++) {
+                UvmFaultEntry *e = batch[i];
+                if (!e || dupOf[i] >= 0 || taken[i])
+                    continue;
+                memset(&sqes[0], 0, sizeof(sqes[0]));
+                sqes[0].opcode = TPU_MEMRING_OP_FAULT;
+                sqes[0].addr = (uint64_t)(uintptr_t)e;
+                sqes[0].len = e->len ? e->len : 1;
+                sqes[0].userData = e->addr;
+                tpurmMemringSubmitInternal(NULL, sqes, 1, NULL,
+                                           TPU_MEMRING_SUBSYS_FAULT);
             }
-            /* Chain-cancel leftovers (an upstream entry's failure
-             * cancelled the rest of its block chain): service inline —
-             * the old loop serviced every primary independently, so
-             * these must not surface as never-serviced. */
+            /* Dep-cancel leftovers (an upstream same-block entry's
+             * failure cancelled its dependents): service inline — the
+             * old loop serviced every primary independently, so these
+             * must not surface as never-serviced. */
             for (uint32_t i = 0; i < n; i++) {
                 UvmFaultEntry *e = batch[i];
                 if (e && dupOf[i] < 0 &&
@@ -1254,8 +1303,8 @@ static void *fault_service_thread(void *arg)
                 }
                 if (!inherited) {
                     /* Spine-accounted like every other service: one
-                     * single-op FAULT chain (the prior group already
-                     * drained, so per-block ordering holds). */
+                     * single-op FAULT submission (the prior group
+                     * already drained, so per-block ordering holds). */
                     TpuMemringSqe fs;
                     memset(&fs, 0, sizeof(fs));
                     fs.opcode = TPU_MEMRING_OP_FAULT;

@@ -149,10 +149,15 @@ static uint64_t span_nonresident_bytes(UvmVaSpace *vs, uint64_t start,
  * as one MIGRATE SQE on the internal memring (the worker that claims
  * it runs uvmMigrateExec, coalescing virtually-contiguous sibling
  * submissions into one engine walk), prefixed — when the destination
- * arena cannot take the span — by a LINKed TIER_EVICT so ONE worker
- * claim drains the fused evict+upload pair back-to-back: the evicted
- * space cannot be stolen by interleaved traffic before the upload
- * lands.  Semantics match the old direct call: synchronous, same
+ * arena cannot take the span — by a TIER_EVICT the MIGRATE carries a
+ * DEPENDENCY on (tracker semantics, not a claimed-whole LINK chain):
+ * the upload still starts only after the demote retired, but OTHER
+ * workers stream past the pair instead of queueing behind one
+ * worker's two-op claim.  The evict is best-effort and always retires
+ * OK, so the dep can never cancel the upload; interleaved traffic
+ * stealing the evicted space before the upload lands just re-enters
+ * the engine's own pressure path (same contract as PR 10's fused
+ * chain).  Semantics match the old direct call: synchronous, same
  * status; argument validation stays up front so obvious misuse fails
  * without a ring round-trip. */
 TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
@@ -184,7 +189,6 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
             if (need &&
                 arena->size - uvmPmmAllocatedBytes(&arena->pmm) < need) {
                 sqes[n].opcode = TPU_MEMRING_OP_TIER_EVICT;
-                sqes[n].flags = TPU_MEMRING_SQE_LINK;
                 sqes[n].dstTier = (uint16_t)dst.tier;
                 sqes[n].devInst = dst.devInst;
                 sqes[n].len = need;
@@ -199,6 +203,11 @@ TpuStatus uvmMigrate(UvmVaSpace *vs, void *base, uint64_t len,
     sqes[n].addr = (uint64_t)(uintptr_t)base;
     sqes[n].len = len;
     sqes[n].arg1 = flags;
+    if (n > 0)
+        /* Fused pair as a DAG edge: upload-after-demote, expressed as
+         * an intra-batch dep on the evict half (index 0). */
+        tpurmMemringSqeDep(&sqes[n],
+                           TPU_MEMRING_DEP(TPU_MEMRING_DEP_BATCH, 0));
     n++;
 
     tpurmMemringSubmitInternal(vs, sqes, n, sts,

@@ -4,8 +4,10 @@
  * compression round-trip error bounds (fp8 / int8) + idempotence +
  * non-finite passthrough, lossless-fallback on compressed-stripe
  * retry exhaustion, ce.copy inject reconciliation (exact: hits ==
- * tpuce_inject_retries + tpuce_inject_errors), and drain semantics
- * under concurrent submitters.
+ * tpuce_inject_retries + tpuce_inject_errors), drain semantics
+ * under concurrent submitters, and the PR-11 dep-join batch fence:
+ * stripes behind a STALLED channel complete out of order, and a full
+ * stripe table frees slots by reaping instead of draining the world.
  */
 #define _GNU_SOURCE
 #include <math.h>
@@ -326,6 +328,41 @@ static int test_gather(TpuCeMgr *m)
     return 0;
 }
 
+
+/* Dep-join reap (PR 11): stall channel 0's executor, stage stripes on
+ * it AND its siblings, then wait the batch — the siblings' stripes
+ * must complete OUT OF submission ORDER past the stalled one
+ * (tpuce_ooo_completions), and every byte still lands. */
+static int test_dep_join_reap(TpuCeMgr *m)
+{
+    CHECK(tpuCeMgrChannels(m) >= 2);
+    size_t n = 2 * MB;               /* 4 stripes at 512 KB */
+    uint8_t *src = malloc(n), *dst = malloc(n);
+    CHECK(src && dst);
+    for (size_t i = 0; i < n; i++)
+        src[i] = (uint8_t)(i * 131 + 7);
+    memset(dst, 0, n);
+
+    uint64_t ooo0 = ctr("tpuce_ooo_completions");
+    TpuCeBatch b;
+    CHECK(tpuCeBatchBegin(m, &b) == TPU_OK);
+    /* Stall whichever channel takes the FIRST stripe: everything that
+     * lands elsewhere retires while it sleeps. */
+    CHECK(tpuCeBatchCopy(&b, dst, src, n, TPU_CE_COMP_NONE) == TPU_OK);
+    CHECK(b.n >= 2);
+    tpurmChannelInjectStall(b.stripes[0].ch, 120);
+    /* A second copy keeps the pool busy while the stall holds. */
+    CHECK(tpuCeBatchCopy(&b, dst, src, n, TPU_CE_COMP_NONE) == TPU_OK);
+    CHECK(tpuCeBatchWait(&b) == TPU_OK);
+
+    for (size_t i = 0; i < n; i += 4097)
+        CHECK(dst[i] == src[i]);
+    CHECK(ctr("tpuce_ooo_completions") > ooo0);
+    free(src);
+    free(dst);
+    return 0;
+}
+
 int main(void)
 {
     /* The default channel count scales with online CPUs; the striping
@@ -346,6 +383,8 @@ int main(void)
     if (test_inject(m))
         return 1;
     if (test_concurrent_drain(m))
+        return 1;
+    if (test_dep_join_reap(m))
         return 1;
 
     printf("ce_test OK (%u channels)\n", tpuCeMgrChannels(m));

@@ -1,8 +1,12 @@
 /*
  * tpumemring test: SQ/CQ wrap + full-SQ backpressure, batched MIGRATE
  * coalescing, LINK-chain ordering + cancel-on-failure, FENCE drain
- * semantics, multi-worker completion accounting, and inject-driven
- * bounded-retry / error-CQE recovery with exact hit reconciliation.
+ * semantics, multi-worker completion accounting, inject-driven
+ * bounded-retry / error-CQE recovery with exact hit reconciliation,
+ * and the PR-11 dependency trackers: out-of-order retirement past a
+ * dep-blocked op, cross-ring (ring, seq) deps, retirement-frontier
+ * holes, dep+LINK mixing, the dep-join replacing a fence, and
+ * dep-cancel on an upstream error.
  */
 #define _GNU_SOURCE
 #include <stdio.h>
@@ -45,6 +49,279 @@ static TpuMemringSqe sqe_nop(uint64_t cookie)
     s.opcode = TPU_MEMRING_OP_NOP;
     s.userData = cookie;
     return s;
+}
+
+
+static TpuMemringSqe sqe_nop_delay(uint64_t cookie, uint64_t delayNs)
+{
+    TpuMemringSqe s;
+    memset(&s, 0, sizeof(s));
+    s.opcode = TPU_MEMRING_OP_NOP;
+    s.userData = cookie;
+    s.arg1 = delayNs;
+    return s;
+}
+
+/* ------------------------------------------------ dependency trackers */
+
+/* Out-of-order retirement: a dep-blocked op must not stop later
+ * INDEPENDENT traffic, and the retirement frontier must hold a hole
+ * open (seqRetired pinned at the sleeping head) while later seqs
+ * retire above it. */
+static int test_dep_ooo_retirement(void)
+{
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(NULL, 32, 2, &r) == TPU_OK);
+    TpuMemringHdr *hdr = mmap(NULL, 4096, PROT_READ, MAP_SHARED,
+                              tpurmMemringShmFd(r), 0);
+    CHECK(hdr != MAP_FAILED);
+    CHECK(hdr->ringId == tpurmMemringId(r));
+
+    uint64_t ooo0 = tpurmCounterGet("memring_ooo_retires");
+    uint64_t stalls0 = tpurmCounterGet("memring_dep_stalls");
+
+    /* A sleeps (submitted FIRST so one worker claims it alone);
+     * B waits on A; C/D/E are independent. */
+    TpuMemringSqe a = sqe_nop_delay(1, 600ull * 1000000ull);
+    CHECK(tpurmMemringPrep(r, &a) == TPU_OK);
+    uint64_t seqA = a.seq;
+    CHECK(tpurmMemringSubmit(r) == 1);
+    struct timespec cl = { .tv_sec = 0, .tv_nsec = 100 * 1000 * 1000 };
+    nanosleep(&cl, NULL);              /* worker claims + sleeps in A */
+    TpuMemringSqe b = sqe_nop_delay(2, 0);
+    CHECK(tpurmMemringSqeDep(&b, TPU_MEMRING_DEP(tpurmMemringId(r),
+                                                 seqA)) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &b) == TPU_OK);
+    for (uint64_t c = 3; c <= 5; c++) {
+        TpuMemringSqe s = sqe_nop_delay(c, 0);
+        CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    }
+    CHECK(tpurmMemringSubmit(r) == 4);
+
+    /* The three independents retire while A sleeps and B blocks. */
+    CHECK(tpurmMemringWait(r, 3, 5ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe cq[8];
+    uint32_t got = tpurmMemringReap(r, cq, 8);
+    CHECK(got >= 3);
+    for (uint32_t i = 0; i < got; i++)
+        CHECK(cq[i].userData >= 3 && cq[i].userData <= 5);
+    /* Frontier hole: seq 0 (A) unretired, later seqs retired above. */
+    CHECK(hdr->seqRetired == seqA);
+    CHECK(tpurmCounterGet("memring_ooo_retires") >= ooo0 + 3);
+    CHECK(tpurmCounterGet("memring_dep_stalls") > stalls0);
+
+    CHECK(tpurmMemringWaitDrain(r, 5ull * 1000000000ull) == TPU_OK);
+    got = tpurmMemringReap(r, cq, 8);
+    CHECK(got == 2);
+    uint64_t endA = 0, endB = 0;
+    for (uint32_t i = 0; i < got; i++) {
+        if (cq[i].userData == 1)
+            endA = cq[i].endNs;
+        if (cq[i].userData == 2)
+            endB = cq[i].endNs;
+        CHECK(cq[i].status == TPU_OK);
+    }
+    CHECK(endA && endB && endB >= endA);
+    /* Frontier caught up: every seq below it retired.  (The watermark
+     * store trails the completion count by an instant — the CQE is
+     * posted, THEN the batch retires — so poll briefly.) */
+    for (int spin = 0; hdr->seqRetired != 5 && spin < 1000; spin++) {
+        struct timespec ts = { .tv_sec = 0, .tv_nsec = 1000000 };
+        nanosleep(&ts, NULL);
+    }
+    CHECK(hdr->seqRetired == 5);
+    munmap(hdr, 4096);
+    tpurmMemringDestroy(r);
+    return 0;
+}
+
+/* Cross-ring deps: an op on ring2 waits on (ring1, seq); ring2's other
+ * traffic streams past it meanwhile. */
+static int test_dep_cross_ring(void)
+{
+    TpuMemring *r1, *r2;
+    CHECK(tpurmMemringCreate(NULL, 16, 1, &r1) == TPU_OK);
+    CHECK(tpurmMemringCreate(NULL, 16, 2, &r2) == TPU_OK);
+
+    TpuMemringSqe a = sqe_nop_delay(10, 400ull * 1000000ull);
+    CHECK(tpurmMemringPrep(r1, &a) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r1) == 1);
+
+    TpuMemringSqe b = sqe_nop_delay(20, 0);
+    CHECK(tpurmMemringSqeDep(&b, TPU_MEMRING_DEP(tpurmMemringId(r1),
+                                                 a.seq)) == TPU_OK);
+    CHECK(tpurmMemringPrep(r2, &b) == TPU_OK);
+    TpuMemringSqe c = sqe_nop_delay(21, 0);
+    CHECK(tpurmMemringPrep(r2, &c) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r2) == 2);
+
+    /* The independent op completes first on ring2. */
+    CHECK(tpurmMemringWait(r2, 1, 5ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe cqe;
+    CHECK(tpurmMemringReap(r2, &cqe, 1) == 1);
+    CHECK(cqe.userData == 21);
+
+    CHECK(tpurmMemringWaitDrain(r1, 5ull * 1000000000ull) == TPU_OK);
+    CHECK(tpurmMemringWaitDrain(r2, 5ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe ca, cb;
+    CHECK(tpurmMemringReap(r1, &ca, 1) == 1);
+    CHECK(tpurmMemringReap(r2, &cb, 1) == 1);
+    CHECK(ca.userData == 10 && cb.userData == 20);
+    CHECK(cb.status == TPU_OK && cb.endNs >= ca.endNs);
+
+    tpurmMemringDestroy(r2);
+    tpurmMemringDestroy(r1);
+    return 0;
+}
+
+/* Deps mixed with a LINK chain: the chain claims only once its head's
+ * deps retired (claimed-whole execution preserved), while independent
+ * traffic behind it streams past. */
+static int test_dep_link_mixed(void)
+{
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(NULL, 32, 2, &r) == TPU_OK);
+
+    TpuMemringSqe x = sqe_nop_delay(30, 400ull * 1000000ull);
+    CHECK(tpurmMemringPrep(r, &x) == TPU_OK);
+    TpuMemringSqe l1 = sqe_nop_delay(31, 0);
+    l1.flags |= TPU_MEMRING_SQE_LINK;
+    CHECK(tpurmMemringSqeDep(&l1, TPU_MEMRING_DEP(tpurmMemringId(r),
+                                                  x.seq)) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &l1) == TPU_OK);
+    TpuMemringSqe l2 = sqe_nop_delay(32, 0);
+    CHECK(tpurmMemringPrep(r, &l2) == TPU_OK);
+    TpuMemringSqe y = sqe_nop_delay(33, 0);
+    CHECK(tpurmMemringPrep(r, &y) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r) == 4);
+
+    /* Y streams past the dep-blocked chain. */
+    CHECK(tpurmMemringWait(r, 1, 5ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe cqe;
+    CHECK(tpurmMemringReap(r, &cqe, 1) == 1);
+    CHECK(cqe.userData == 33);
+
+    CHECK(tpurmMemringWaitDrain(r, 5ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe cq[4];
+    CHECK(tpurmMemringReap(r, cq, 4) == 3);
+    uint64_t endX = 0, start1 = 0, start2 = 0, end1 = 0;
+    for (int i = 0; i < 3; i++) {
+        CHECK(cq[i].status == TPU_OK);
+        if (cq[i].userData == 30)
+            endX = cq[i].endNs;
+        if (cq[i].userData == 31) {
+            start1 = cq[i].startNs;
+            end1 = cq[i].endNs;
+        }
+        if (cq[i].userData == 32)
+            start2 = cq[i].startNs;
+    }
+    CHECK(endX && start1 >= endX);     /* chain waited for its dep */
+    CHECK(start2 >= end1);             /* chain order preserved */
+    tpurmMemringDestroy(r);
+    return 0;
+}
+
+/* The dep-JOIN replacing a batch fence (the tpuce shape): a NOP with a
+ * dep set completes only after its targets — but unlike FENCE, later
+ * independent ops do NOT wait behind it. */
+static int test_dep_join_vs_fence(void)
+{
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(NULL, 32, 2, &r) == TPU_OK);
+
+    TpuMemringSqe a = sqe_nop_delay(40, 600ull * 1000000ull);
+    CHECK(tpurmMemringPrep(r, &a) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r) == 1);
+    struct timespec cl = { .tv_sec = 0, .tv_nsec = 100 * 1000 * 1000 };
+    nanosleep(&cl, NULL);              /* worker claims + sleeps in A */
+    TpuMemringSqe join = sqe_nop_delay(41, 0);
+    CHECK(tpurmMemringSqeDep(&join, TPU_MEMRING_DEP(tpurmMemringId(r),
+                                                    a.seq)) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &join) == TPU_OK);
+    TpuMemringSqe e = sqe_nop_delay(42, 0);
+    CHECK(tpurmMemringPrep(r, &e) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r) == 2);
+
+    /* With OP_FENCE in the join's place, 42 would be stuck behind it;
+     * with the dep join it completes while the join still blocks. */
+    CHECK(tpurmMemringWait(r, 1, 5ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe cqe;
+    CHECK(tpurmMemringReap(r, &cqe, 1) == 1);
+    CHECK(cqe.userData == 42);
+
+    CHECK(tpurmMemringWaitDrain(r, 5ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe cq[4];
+    CHECK(tpurmMemringReap(r, cq, 4) == 2);
+    uint64_t endA = 0, endJ = 0;
+    for (int i = 0; i < 2; i++) {
+        if (cq[i].userData == 40)
+            endA = cq[i].endNs;
+        if (cq[i].userData == 41)
+            endJ = cq[i].endNs;
+    }
+    CHECK(endA && endJ && endJ >= endA);
+    tpurmMemringDestroy(r);
+    return 0;
+}
+
+/* Dep-cancel: a dependent of an op that retired with an ERROR posts
+ * INVALID_STATE without executing, and the cancellation cascades to
+ * ITS dependents (mirroring chain cancel). */
+static int test_dep_cancel_on_error(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 16, 2, &r) == TPU_OK);
+    uint64_t dc0 = tpurmCounterGet("memring_dep_cancelled");
+
+    /* EVICT to HBM is a permanent INVALID_ARGUMENT (no retries). */
+    TpuMemringSqe bad;
+    memset(&bad, 0, sizeof(bad));
+    bad.opcode = TPU_MEMRING_OP_EVICT;
+    bad.dstTier = UVM_TIER_HBM;
+    bad.addr = 0x1000;
+    bad.len = 4096;
+    bad.userData = 50;
+    CHECK(tpurmMemringPrep(r, &bad) == TPU_OK);
+    TpuMemringSqe dep1 = sqe_nop_delay(51, 0);
+    CHECK(tpurmMemringSqeDep(&dep1, TPU_MEMRING_DEP(tpurmMemringId(r),
+                                                    bad.seq)) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &dep1) == TPU_OK);
+    TpuMemringSqe dep2 = sqe_nop_delay(52, 0);
+    CHECK(tpurmMemringSqeDep(&dep2, TPU_MEMRING_DEP(tpurmMemringId(r),
+                                                    dep1.seq)) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &dep2) == TPU_OK);
+    TpuMemringSqe ok = sqe_nop_delay(53, 0);
+    CHECK(tpurmMemringPrep(r, &ok) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r) == 4);
+    CHECK(tpurmMemringWaitDrain(r, 5ull * 1000000000ull) == TPU_OK);
+
+    TpuMemringCqe cq[4];
+    CHECK(tpurmMemringReap(r, cq, 4) == 4);
+    for (int i = 0; i < 4; i++) {
+        switch (cq[i].userData) {
+        case 50:
+            CHECK(cq[i].status == TPU_ERR_INVALID_ARGUMENT);
+            break;
+        case 51:
+        case 52:
+            CHECK(cq[i].status == TPU_ERR_INVALID_STATE);
+            CHECK(cq[i].bytes == 0);
+            break;
+        case 53:
+            CHECK(cq[i].status == TPU_OK);
+            break;
+        default:
+            CHECK(0);
+        }
+    }
+    CHECK(tpurmCounterGet("memring_dep_cancelled") == dc0 + 2);
+    tpurmMemringDestroy(r);
+    uvmVaSpaceDestroy(vs);
+    return 0;
 }
 
 /* SQ/CQ wrap: an 8-entry ring carries 64 ops in waves; every cookie
@@ -706,6 +983,16 @@ int main(void)
      * engine touch initializes the device table). */
     setenv("TPUMEM_FAKE_TPU_COUNT", "2", 0);
     if (test_wrap_and_backpressure())
+        return 1;
+    if (test_dep_ooo_retirement())
+        return 1;
+    if (test_dep_cross_ring())
+        return 1;
+    if (test_dep_link_mixed())
+        return 1;
+    if (test_dep_join_vs_fence())
+        return 1;
+    if (test_dep_cancel_on_error())
         return 1;
     if (test_batched_migrate())
         return 1;

@@ -209,29 +209,46 @@ static int test_generation_fencing(void)
     tpuRegistrySet("TPUMEM_RESET_QUIESCE_TIMEOUT_MS", "50");
 
     uint64_t stale0 = tpurmCounterGet("memring_stale_completions");
+    uint64_t depc0 = tpurmCounterGet("memring_dep_cancelled");
     TpuMemring *r;
     CHECK(tpurmMemringCreate(NULL, 8, 1, &r) == TPU_OK);
 
     /* A NOP that sleeps 600 ms: claimed immediately, hung across the
-     * reset below. */
+     * reset below.  A DEPENDENT of the hung op rides along: its dep
+     * target will retire generation-fenced (DEVICE_RESET = an error
+     * retirement), so the dependent must be dep-CANCELLED, never run
+     * as if its upstream had succeeded on the old generation. */
     TpuMemringSqe hung = sqe_nop_delay(777, 600ull * 1000000ull);
     CHECK(tpurmMemringPrep(r, &hung) == TPU_OK);
     CHECK(tpurmMemringSubmit(r) == 1);
     sleep_ms(50);                       /* ensure the worker claimed it */
+    TpuMemringSqe depd = sqe_nop_delay(779, 0);
+    CHECK(tpurmMemringSqeDep(&depd, TPU_MEMRING_DEP(tpurmMemringId(r),
+                                                    hung.seq)) == TPU_OK);
+    CHECK(tpurmMemringPrep(r, &depd) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r) == 1);
 
     uint64_t gen0 = tpurmDeviceGeneration();
     CHECK(tpurmDeviceReset() == TPU_OK);
     CHECK(tpurmDeviceGeneration() == gen0 + 1);
 
-    /* The zombie completion must surface DEVICE_RESET, not success. */
+    /* The zombie completion must surface DEVICE_RESET, not success —
+     * and its stale-dep dependent must cancel off the error retire. */
     CHECK(tpurmMemringWaitDrain(r, 10ull * 1000000000ull) == TPU_OK);
-    TpuMemringCqe cqe;
-    CHECK(tpurmMemringReap(r, &cqe, 1) == 1);
-    CHECK(cqe.userData == 777);
-    CHECK(cqe.status == TPU_ERR_DEVICE_RESET);
+    TpuMemringCqe cq2[2];
+    CHECK(tpurmMemringReap(r, cq2, 2) == 2);
+    for (int i = 0; i < 2; i++) {
+        if (cq2[i].userData == 777)
+            CHECK(cq2[i].status == TPU_ERR_DEVICE_RESET);
+        else
+            CHECK(cq2[i].userData == 779 &&
+                  cq2[i].status == TPU_ERR_INVALID_STATE);
+    }
     CHECK(tpurmCounterGet("memring_stale_completions") == stale0 + 1);
+    CHECK(tpurmCounterGet("memring_dep_cancelled") == depc0 + 1);
 
     /* Post-reset ops on the same ring complete normally (new gen). */
+    TpuMemringCqe cqe;
     TpuMemringSqe ok = sqe_nop_delay(778, 0);
     CHECK(tpurmMemringPrep(r, &ok) == TPU_OK);
     CHECK(tpurmMemringSubmitAndWait(r, 1, NULL) == 1);

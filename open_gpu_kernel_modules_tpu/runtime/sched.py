@@ -217,9 +217,9 @@ class Scheduler:
         self.max_len = self.cache.pages_per_seq * self.cache.page_size
 
         self._free_seqs: List[int] = list(range(self.max_seqs))
-        # Fused evict+upload chains: _preempt stages the victim's
-        # backing spans here; the next _restore publishes them LINKed
-        # ahead of its PREFETCH chain on the dedicated tier ring (one
+        # Fused evict+upload batches: _preempt stages the victim's
+        # backing spans here; the next _restore publishes them ahead
+        # of its dep-joined PREFETCHes on the dedicated tier ring (one
         # worker claim drains demote-then-upload back-to-back), and
         # step() flushes any leftovers at round end.
         self._pending_evicts: List[tuple] = []
@@ -462,11 +462,12 @@ class Scheduler:
         """Re-admit a preempted sequence.  Its pages' truth sits in the
         backing store; ONE batched memring submission of FUSED work —
         any staged victim EVICTs published ahead of this sequence's
-        PREFETCH chains (single doorbell, FIFO claims drain the demotes
-        first) — frees the victims' device residency right where the
-        restore uploads.  Runs on the dedicated tier ring (the
-        backing's read ring stays quiesced); falls back to the backing
-        ring, then to plain activation faulting."""
+        PREFETCHes, which each carry an ordered DEP on the last evict
+        (single doorbell; the dep join, not claim order, guarantees
+        demotes retire first) — frees the victims' device residency
+        right where the restore uploads.  Runs on the dedicated tier
+        ring (the backing's read ring stays quiesced); falls back to
+        the backing ring, then to plain activation faulting."""
         backing = self.cache.backing
         ring = self._tier_ring_get() or getattr(backing, "ring", None)
         try:
@@ -487,19 +488,26 @@ class Scheduler:
 
     def _restore_prefetch(self, backing, ring, req: Request) -> None:
         if ring is not None:
+            from ..uvm import memring as _mr
             from ..uvm.managed import Tier
 
             pages = range(req.seq * self.cache.pages_per_seq,
                           req.seq * self.cache.pages_per_seq +
                           self._pages_for(int(self.cache.seq_lens[req.seq])))
-            # Fused halves: staged victim demotes first, then this
-            # sequence's uploads.  The evicts form their OWN chain —
-            # never LINKed into the prefetches, so a failed demote
-            # cancels at most the remaining demotes, not the uploads.
-            # A restore of the SAME sequence that was just preempted
-            # (the slot-pressure ping-pong) drops its own staged spans
-            # instead of demoting data it is about to fault straight
-            # back: the prefetch re-establishes residency either way.
+            # Fused halves as a dependency DAG (tracker semantics, PR
+            # 11): staged victim demotes go down as INDEPENDENT evict
+            # ops, and every restore prefetch carries ONE ordered dep
+            # on the last demote's seq — satisfied once the retirement
+            # frontier passed it, i.e. after ALL demotes retired.  The
+            # uploads still start only after the space was freed, but
+            # nothing is claimed-whole: demotes spread across workers,
+            # retire out of order, and a failed demote cancels nothing
+            # (ordered deps never cancel — the engine's own pressure
+            # path stays the backstop, exactly the OP_TIER_EVICT
+            # doctrine).  A restore of the SAME sequence that was just
+            # preempted (the slot-pressure ping-pong) drops its own
+            # staged spans instead of demoting data it is about to
+            # fault straight back.
             first_page = req.seq * self.cache.pages_per_seq
             own_lo = first_page * backing.rec_bytes
             own_hi = (req.seq + 1) * self.cache.pages_per_seq * \
@@ -514,28 +522,32 @@ class Scheduler:
             kept = [(a, s) for a, s in evicts if not _own_span(a, s)]
             if kept:
                 _counter_add("tpusched_fused_evict_chains")
-            for j, (addr, span) in enumerate(kept):
+            evict_join = None
+            for addr, span in kept:
                 if ring.sq_space < 1:
                     ring.submit_and_wait(None)
                     self._check_prefetch_cqes(ring.completions(
                         max_cqes=8192))
-                ring.evict(addr, span, Tier.CXL,
-                           link=(j % 64 != 63) and j != len(kept) - 1)
+                ring.evict(addr, span, Tier.CXL)
+                evict_join = ring.last_seq
+            deps = ([_mr.dep(ring.ring_id, evict_join, ordered=True)]
+                    if evict_join is not None else None)
             ops = []
             for page in pages:
                 off = page * backing.rec_bytes
                 ops.append(backing.k_buf.address + off)
                 ops.append(backing.v_buf.address + off)
-            # LINK chains are capped at one worker claim (64 entries);
-            # chain per segment, publish everything with one doorbell.
-            for i, addr in enumerate(ops):
+            # No LINK chains: unordered prefetches coalesce into big
+            # block-granular runs at the claim side, and the single
+            # ordered dep replaces the demotes-drain-first FIFO
+            # assumption with a real ordering guarantee.
+            for addr in ops:
                 if ring.sq_space < 1:
                     ring.submit_and_wait(None)
                     self._check_prefetch_cqes(ring.completions(
                         max_cqes=8192))
-                last_in_chain = (i % 64 == 63) or i == len(ops) - 1
                 ring.prefetch(addr, backing.rec_bytes, dev=backing.dev,
-                              link=not last_in_chain)
+                              deps=deps)
             ring.submit_and_wait(None)
             self._check_prefetch_cqes(ring.completions(max_cqes=8192))
 

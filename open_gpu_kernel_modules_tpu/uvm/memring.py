@@ -10,10 +10,21 @@ synchronous ``uvmMigrate`` calls by avoiding one lock round trip and
 one page-granular walk per span (the bench.py memring microbench
 records the ratio).
 
-Ordering tools mirror io_uring: ``link=True`` chains an op to the next
-(failure cancels the chain's remainder with error CQEs), and
-``fence()`` completes only after every previously submitted op has
-posted its completion.
+Ordering tools mirror io_uring plus the reference driver's
+``uvm_tracker_t``: every staged op is assigned a submission ``seq``
+(readable as :attr:`MemRing.last_seq` right after the prep call), and
+any later op may carry a dependency SET of up to 4 ``deps=[...]``
+handles built with :func:`dep` — wait-on-(ring, seq) pairs.  Workers
+claim ops whose deps have retired and retire completions OUT OF ORDER
+against a per-ring retirement frontier, so independent traffic streams
+past a blocked op.  ``dep(ring, seq, ordered=True)`` waits for the
+frontier itself (every seq <= target retired) — the wide-join
+fallback when 4 dep slots are not enough.  A dep whose target retired
+with an error CANCELS the dependent (INVALID_STATE completion).
+``link=True`` chains an op to the next (failure cancels the chain's
+remainder with error CQEs; the chain is claimed whole by one worker —
+prefer deps), and ``fence()`` completes only after every previously
+submitted op has posted its completion.
 
 Typical batched use::
 
@@ -67,8 +78,37 @@ class Advise(enum.IntEnum):
 SQE_LINK = 0x1
 SQE_WRITE = 0x2
 
+NDEPS = 4                      # dep slots per SQE (memring.h)
+DEP_SEQ_BITS = 47
+DEP_ORDERED_FLAG = 1 << DEP_SEQ_BITS
+DEP_RING_SHIFT = 48
+DEP_BATCH = 0xFFFF             # intra-batch index pseudo-ring
+
+
+def dep(ring, seq: int, ordered: bool = False) -> int:
+    """Build a dependency handle on (``ring``, ``seq``).
+
+    ``ring`` is a :class:`MemRing` or a raw ring id (``MemRing.ring_id``);
+    ``seq`` is the target op's submission seq (``MemRing.last_seq`` after
+    its prep).  ``ordered=True`` waits for the retirement FRONTIER to
+    pass the target — every seq <= it retired — the wide-join form."""
+    rid = ring.ring_id if isinstance(ring, MemRing) else int(ring)
+    h = ((rid & 0xFFFF) << DEP_RING_SHIFT) | (seq & ((1 << DEP_SEQ_BITS) - 1))
+    if ordered:
+        h |= DEP_ORDERED_FLAG
+    return h
+
+
+def dep_batch(index: int, ordered: bool = False) -> int:
+    """Dependency on the ``index``-th op of the CURRENT unpublished
+    batch (rewritten to an absolute handle at prep time; must point
+    backwards)."""
+    return dep(DEP_BATCH, index, ordered)
+
 
 class _Sqe(ctypes.Structure):
+    # 128-byte SQE128 layout: dep set + assigned seq ride the second
+    # cacheline (memring.h).
     _fields_ = [
         ("opcode", ctypes.c_uint8),
         ("flags", ctypes.c_uint8),
@@ -82,6 +122,11 @@ class _Sqe(ctypes.Structure):
         ("peerOff", ctypes.c_uint64),
         ("arg1", ctypes.c_uint64),
         ("deadlineNs", ctypes.c_uint64),
+        ("deps", ctypes.c_uint64 * NDEPS),
+        ("depCount", ctypes.c_uint32),
+        ("rsvd0", ctypes.c_uint32),
+        ("seq", ctypes.c_uint64),
+        ("rsvd1", ctypes.c_uint64 * 2),
     ]
 
 
@@ -161,6 +206,10 @@ def _lib() -> ctypes.CDLL:
     lib.tpurmMemringCounts.restype = None
     lib.tpurmMemringShmFd.argtypes = [vp]
     lib.tpurmMemringShmFd.restype = ctypes.c_int
+    lib.tpurmMemringId.argtypes = [vp]
+    lib.tpurmMemringId.restype = u32
+    lib.tpurmMemringNextSeq.argtypes = [vp]
+    lib.tpurmMemringNextSeq.restype = u64
     _bound = lib
     return lib
 
@@ -190,48 +239,76 @@ class MemRing:
                "tpurmMemringCreate")
         self._handle = handle
         self._auto_cookie = 0
+        self._last_seq = None
 
     # ------------------------------------------------------------- preps
 
-    def _prep(self, sqe: _Sqe) -> int:
+    def _prep(self, sqe: _Sqe, deps=None) -> int:
         if sqe.userData == 0:
             self._auto_cookie += 1
             sqe.userData = self._auto_cookie
+        if deps:
+            if len(deps) > NDEPS:
+                raise ValueError(
+                    f"at most {NDEPS} deps per op (join wider with an "
+                    f"ordered dep or a fence)")
+            for i, d in enumerate(deps):
+                sqe.deps[i] = d
+            sqe.depCount = len(deps)
         _check(self._lib.tpurmMemringPrep(self._handle,
                                           ctypes.byref(sqe)),
                "tpurmMemringPrep")
+        self._last_seq = sqe.seq
         return sqe.userData
+
+    @property
+    def ring_id(self) -> int:
+        """This ring's dep-handle identity (for :func:`dep`)."""
+        return self._lib.tpurmMemringId(self._handle)
+
+    @property
+    def last_seq(self) -> Optional[int]:
+        """Submission seq assigned to the most recently prepped op —
+        the handle later deps name it by."""
+        return self._last_seq
+
+    @property
+    def next_seq(self) -> int:
+        """The seq the next prep will be assigned."""
+        return self._lib.tpurmMemringNextSeq(self._handle)
 
     def migrate(self, addr: int, length: int, tier: Tier, dev: int = 0,
                 user_data: int = 0, link: bool = False,
-                deadline_ns: int = 0) -> int:
+                deadline_ns: int = 0, deps=None) -> int:
         """Stage an async migrate of [addr, addr+length) to ``tier``.
         Returns the op's cookie (auto-assigned when 0).
         ``deadline_ns`` (absolute, utils clock) fails the op fast with
-        RETRY_EXHAUSTED if it is claimed past the deadline."""
+        RETRY_EXHAUSTED if it is claimed past the deadline; ``deps`` is
+        a list of up to 4 :func:`dep` handles the op waits on."""
         s = _Sqe(opcode=Op.MIGRATE, flags=SQE_LINK if link else 0,
                  dstTier=int(tier), devInst=dev, addr=addr, len=length,
                  userData=user_data, deadlineNs=deadline_ns)
-        return self._prep(s)
+        return self._prep(s, deps)
 
     def prefetch(self, addr: int, length: int, dev: int = 0,
                  write: bool = False, user_data: int = 0,
-                 link: bool = False, deadline_ns: int = 0) -> int:
+                 link: bool = False, deadline_ns: int = 0,
+                 deps=None) -> int:
         """Stage a device-access prefetch: fault the span onto
         ``dev``'s HBM through the batch service loop."""
         flags = (SQE_LINK if link else 0) | (SQE_WRITE if write else 0)
         s = _Sqe(opcode=Op.PREFETCH, flags=flags, devInst=dev, addr=addr,
                  len=length, userData=user_data, deadlineNs=deadline_ns)
-        return self._prep(s)
+        return self._prep(s, deps)
 
     def evict(self, addr: int, length: int, tier: Tier = Tier.HOST,
               user_data: int = 0, link: bool = False,
-              deadline_ns: int = 0) -> int:
+              deadline_ns: int = 0, deps=None) -> int:
         """Stage a tier demote (HOST or CXL destination only)."""
         s = _Sqe(opcode=Op.EVICT, flags=SQE_LINK if link else 0,
                  dstTier=int(tier), addr=addr, len=length,
                  userData=user_data, deadlineNs=deadline_ns)
-        return self._prep(s)
+        return self._prep(s, deps)
 
     def advise(self, addr: int, length: int, advice: Advise,
                tier: Tier = Tier.HOST, dev: int = 0, on: bool = True,
@@ -264,13 +341,16 @@ class MemRing:
         return self._prep(s)
 
     def nop(self, user_data: int = 0, delay_ns: int = 0,
-            deadline_ns: int = 0) -> int:
+            deadline_ns: int = 0, deps=None) -> int:
         """Stage a NOP.  ``delay_ns`` makes the worker sleep that long
         before completing — the deterministic hung-op the reset
-        watchdog/ladder tests use."""
+        watchdog/ladder tests use.  A NOP with ``deps`` is the
+        dep-JOIN idiom: it completes only after its targets retired,
+        without fencing unrelated later traffic the way ``fence()``
+        does."""
         s = _Sqe(opcode=Op.NOP, userData=user_data, arg1=delay_ns,
                  deadlineNs=deadline_ns)
-        return self._prep(s)
+        return self._prep(s, deps)
 
     # --------------------------------------------------- submit / reap
 

@@ -30,3 +30,72 @@ jax.config.update("jax_platforms", "cpu")
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
+
+
+def rerun_solo_under_load(body, settle_load_frac=0.5,
+                          settle_timeout_s=90.0):
+    """Shared load-flake guard for the two DOCUMENTED load-sensitive
+    tests (test_stress client-death reclamation, test_uvm fault-latency
+    bounds — see CHANGES.md forensics): run ``body`` once; if it fails
+    while the box's run queue exceeds ``settle_load_frac`` per CPU
+    (deliberately low: on this 1-2 CPU container the flakes fire at
+    modest contention and the 1-minute average lags), wait (bounded)
+    for the load to drain and rerun it ONCE solo.
+
+    A solo pass after a loaded failure is the documented flake
+    self-identifying — reported as a warning, not a failure.  A failure
+    on an unloaded box, or one that reproduces solo, re-raises: that is
+    a real regression, chase it.  One implementation, both callers —
+    do not grow private retry loops per test.
+    """
+    import time
+    import warnings
+
+    def _load1():
+        """Pressure estimate: the 1-minute average OR the instantaneous
+        run queue (/proc/loadavg 4th field, minus ourselves) — the
+        average lags a just-started co-runner by tens of seconds, and
+        the documented flakes fire on instantaneous contention."""
+        load = 0.0
+        try:
+            load = os.getloadavg()[0]
+        except OSError:                      # pragma: no cover
+            pass
+        try:
+            with open("/proc/loadavg") as f:
+                running = int(f.read().split()[3].split("/")[0]) - 1
+            load = max(load, float(running))
+        except (OSError, ValueError, IndexError):  # pragma: no cover
+            pass
+        return load
+
+    try:
+        return body()
+    except Exception as exc:
+        ncpu = os.cpu_count() or 1
+        load = _load1()
+        if load <= ncpu * settle_load_frac:
+            raise                            # quiet box: genuine failure
+        deadline = time.monotonic() + settle_timeout_s
+        while (time.monotonic() < deadline and
+               _load1() > ncpu * settle_load_frac):
+            time.sleep(2.0)
+        now = _load1()
+        if now > ncpu * settle_load_frac:
+            # The box never went quiet (mid-suite on a saturated 1-2
+            # CPU container): a solo verdict is unobtainable here.
+            # SKIP with the flake's name on it — failing would
+            # masquerade as a regression, passing would claim a verify
+            # that never ran.  Rerun the test solo to get a verdict.
+            import pytest
+            pytest.skip(
+                f"rerun-solo-under-load: failed at load {load:.1f} on "
+                f"{ncpu} cpu(s) ({exc!r}) and the box never settled "
+                f"(load still {now:.1f}) — documented load-flake; "
+                f"rerun this test solo for a real verdict")
+        warnings.warn(
+            f"rerun-solo-under-load: first attempt failed at load "
+            f"{load:.1f} on {ncpu} cpu(s) ({exc!r}); rerunning solo "
+            f"(load now {now:.1f}) — a solo pass marks the "
+            f"documented load-flake, not a regression")
+        return body()

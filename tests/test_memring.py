@@ -3,6 +3,8 @@ ordering (links/fences), error CQEs under injection, and the serving
 backing's ring-driven prefetch path.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -182,3 +184,65 @@ def test_serving_backing_uses_ring():
         assert backing.ring.counts.error_cqes == 0
     finally:
         backing.close()
+
+
+def test_dependency_trackers(vs):
+    """PR-11 dep sets from Python: out-of-order retirement past a
+    dep-blocked op, the ordered dep-join, dep-cancel off an upstream
+    error, and the observability surface (counters + depwait hist)."""
+    from open_gpu_kernel_modules_tpu import utils
+
+    stalls0 = utils.counter("memring_dep_stalls")
+    ooo0 = utils.counter("memring_ooo_retires")
+    with memring.MemRing(vs, entries=64, workers=2) as ring:
+        # A sleeping head op, claimed alone...
+        ring.nop(user_data=1, delay_ns=150_000_000)
+        seq_a = ring.last_seq
+        ring.submit()
+        time.sleep(0.03)
+        # ...then a dependent, a joiner, and independents behind it.
+        ring.nop(user_data=2, deps=[memring.dep(ring, seq_a)])
+        ring.nop(user_data=3)
+        ring.nop(user_data=4)
+        ring.nop(user_data=5,
+                 deps=[memring.dep(ring.ring_id, seq_a, ordered=True)])
+        ring.submit()
+        # Independents retire while the head sleeps and 2/5 block.
+        ring.wait(2, timeout_ns=5_000_000_000)
+        early = {c.user_data for c in ring.completions()}
+        assert early <= {3, 4}, early
+        ring.drain(timeout_ns=5_000_000_000)
+        rest = ring.completions(check=True)
+        ends = {c.user_data: c.end_ns for c in rest}
+        assert ends[2] >= ends[1] and ends[5] >= ends[1]
+    assert utils.counter("memring_dep_stalls") > stalls0
+    assert utils.counter("memring_ooo_retires") > ooo0
+    # The dep-wait histogram recorded the blocked spans.
+    assert utils.trace_quantile_ns("memring.depwait", 0.5) > 0
+
+    # Dep-cancel: dependent of an errored op posts INVALID_STATE.
+    cancelled0 = utils.counter("memring_dep_cancelled")
+    with memring.MemRing(vs, entries=16, workers=1) as ring:
+        # EVICT to HBM is a permanent INVALID_ARGUMENT.
+        ring.evict(0x1000, 4096, Tier.HBM, user_data=7)
+        bad_seq = ring.last_seq
+        ring.nop(user_data=8, deps=[memring.dep(ring, bad_seq)])
+        ring.submit_and_wait()
+        by_cookie = {c.user_data: c for c in ring.completions()}
+        assert not by_cookie[7].ok
+        assert by_cookie[8].status == native.TPU_ERR_INVALID_STATE
+    assert utils.counter("memring_dep_cancelled") == cancelled0 + 1
+
+
+def test_batch_dep_rewrite(vs):
+    """dep_batch(): intra-batch index deps rewrite to absolute handles
+    at prep time; a forward-pointing index is refused."""
+    with memring.MemRing(vs, entries=16, workers=1) as ring:
+        ring.nop(user_data=1, delay_ns=20_000_000)
+        ring.nop(user_data=2, deps=[memring.dep_batch(0)])
+        ring.submit_and_wait()
+        cq = {c.user_data: c for c in ring.completions(check=True)}
+        assert cq[2].end_ns >= cq[1].end_ns
+        # Forward (self-referential) index: prep refuses.
+        with pytest.raises(native.RmError):
+            ring.nop(user_data=9, deps=[memring.dep_batch(5)])

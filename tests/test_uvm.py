@@ -421,24 +421,36 @@ def test_fault_latency_bounds_and_parallel_service():
         except OSError:                      # pragma: no cover
             return 0.0
 
-    load_before = _load1()
-    res = subprocess.run([sys.executable, "-c", script], env=env,
-                         capture_output=True, text=True, timeout=180)
-    load_after = _load1()
-    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
-    line = [l for l in res.stdout.splitlines()
-            if l.startswith("latency ")][-1]
-    p50, p95 = (int(x) for x in line.split()[1:3])
+    def _body():
+        load_before = _load1()
+        res = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=180)
+        load_after = _load1()
+        assert res.returncode == 0, \
+            res.stdout[-2000:] + res.stderr[-2000:]
+        line = [l for l in res.stdout.splitlines()
+                if l.startswith("latency ")][-1]
+        p50, p95 = (int(x) for x in line.split()[1:3])
 
-    # Concurrency factor: 1-minute run queue per CPU around the run,
-    # floored at 1 (an idle box keeps the strict solo bounds).  The
-    # suite regularly drives this 2-CPU container to load 4-6.
-    ncpu = os.cpu_count() or 1
-    scale = max(1.0, max(load_before, load_after) / ncpu)
-    p50_bound = int(100_000 * scale)
-    p95_bound = int(20_000_000 * scale)
-    assert p50 < p50_bound, (p50, p50_bound, load_before, load_after)
-    assert p95 < p95_bound, (p95, p95_bound, load_before, load_after)
+        # Concurrency factor: 1-minute run queue per CPU around the
+        # run, floored at 1 (an idle box keeps the strict solo
+        # bounds).  The suite regularly drives this 2-CPU container to
+        # load 4-6.
+        ncpu = os.cpu_count() or 1
+        scale = max(1.0, max(load_before, load_after) / ncpu)
+        p50_bound = int(100_000 * scale)
+        p95_bound = int(20_000_000 * scale)
+        assert p50 < p50_bound, (p50, p50_bound, load_before,
+                                 load_after)
+        assert p95 < p95_bound, (p95, p95_bound, load_before,
+                                 load_after)
+
+    # DOCUMENTED load-flake (p95 bound on a saturated 1-2 CPU box):
+    # the shared rerun-solo-under-load helper (conftest) makes it
+    # self-identify — a failure that reproduces solo, or on a quiet
+    # box, is still a real latency regression.
+    from conftest import rerun_solo_under_load
+    rerun_solo_under_load(_body)
 
 
 def test_hmm_pageable_adopt_and_ats(vs):
