@@ -1316,6 +1316,102 @@ def measure_reset_mttr(streams: int = 32, resets: int = 5) -> dict:
     return out
 
 
+def measure_vac_migration(streams: int = 12, evacs: int = 3) -> dict:
+    """tpuvac live-migration series under the serving-sweep shape: a
+    multichip (4 fake chips) scheduler with a victim tenant and a
+    co-tenant, A/B'd — one evacuation-free pass against one pass with
+    ``evacs`` planned chip evacuations mid-decode.  Records the
+    blackout distribution (park -> manifest commit per evacuation,
+    ``vac_blackout_ms_p50/p95``) and the co-tenant throughput dip
+    (``vac_cotenant_dip_frac`` — the "co-tenants never notice" SLO is
+    <= 0.10).  Needs TPUMEM_FAKE_TPU_COUNT=4 before the native lib
+    loads, so main() always runs it through _measure_isolated."""
+    os.environ.setdefault("TPUMEM_FAKE_TPU_COUNT", "4")
+    os.environ.setdefault("TPUMEM_FAKE_HBM_MB", "64")
+    import numpy as np
+    import jax
+    from open_gpu_kernel_modules_tpu.models import llama, multichip
+    from open_gpu_kernel_modules_tpu.runtime import native as _native
+    from open_gpu_kernel_modules_tpu.runtime import sched as tpusched
+    from open_gpu_kernel_modules_tpu import utils
+
+    if _native.load().tpurmDeviceCount() < 4:
+        return {"vac_skipped": "needs TPUMEM_FAKE_TPU_COUNT=4 before "
+                               "lib load (run isolated)"}
+
+    cfg = llama.LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=8, num_kv_heads=8, head_dim=32,
+        max_seq_len=512)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt_len, max_new, tpr = 112, 48, 8
+    CO_TENANT = 2                   # tenant 2 streams must not notice
+
+    def one_pass(n_evacs):
+        rng = np.random.default_rng(7)      # identical workload per pass
+        cache = multichip.make_multichip_cache(
+            cfg, batch=16, max_len=256, page_size=64, oversub=2,
+            n_devices=4)
+        s = tpusched.Scheduler(cfg, params, max_seqs=16, max_len=256,
+                               page_size=64, oversub=2,
+                               tokens_per_round=tpr, cache=cache)
+        s.configure_tenant(1, priority=100)
+        s.configure_tenant(CO_TENANT, priority=120)
+        for i in range(streams):
+            s.submit(rng.integers(0, cfg.vocab_size, size=prompt_len),
+                     max_new_tokens=max_new,
+                     tenant=1 if i % 3 == 0 else CO_TENANT)
+        # Evacuation schedule: rotate records around the ring so every
+        # move has a distinct (src, dst) and the last chip ends warm.
+        moves = [(1, 2), (3, 0), (2, 3)]
+        done = 0
+        rounds = 0
+        wall0 = time.perf_counter()
+        while not s.idle and rounds < 20000:
+            s.step()
+            rounds += 1
+            if done < n_evacs and rounds % 2 == 0 and not s.idle:
+                src, dst = moves[done % len(moves)]
+                s.evacuate_device(src, dst)
+                done += 1
+        wall = time.perf_counter() - wall0
+        co_toks = sum(min(r.decoded, r.max_new_tokens)
+                      for r in s._by_rid.values()
+                      if r.tenant == CO_TENANT and
+                      r.state is tpusched.RequestState.FINISHED)
+        blackouts = list(s.evac_blackouts_s)
+        stats = dict(s.stats)
+        pool_stats = dict(cache.backing.stats)
+        s.close()
+        return co_toks / wall if wall else 0.0, blackouts, stats, \
+            pool_stats
+
+    one_pass(0)                                  # compile warmup
+    steady_tps, _, _, _ = one_pass(0)
+    evac_tps, blackouts, stats, pool = one_pass(evacs)
+
+    bl_ms = [1e3 * b for b in blackouts]
+    return {
+        "vac_evacuations": stats["evacuations"],
+        "vac_pages_moved": stats["evac_pages_moved"],
+        "vac_rehomed_records": pool["rehomed_records"],
+        "vac_blackout_ms_p50": round(
+            float(np.percentile(bl_ms, 50)), 3) if bl_ms else 0.0,
+        "vac_blackout_ms_p95": round(
+            float(np.percentile(bl_ms, 95)), 3) if bl_ms else 0.0,
+        "vac_cotenant_steady_toks_per_s": round(steady_tps, 2),
+        "vac_cotenant_evac_toks_per_s": round(evac_tps, 2),
+        # The SLO number: co-tenant throughput lost to the migrations
+        # (<= 0.10 = "co-tenants never notice").
+        "vac_cotenant_dip_frac": round(
+            max(0.0, 1.0 - evac_tps / steady_tps), 3)
+        if steady_tps else 0.0,
+        "vac_commits": utils.counter("vac_commits"),
+        "vac_aborts": utils.counter("vac_aborts"),
+        "vac_bytes_moved": utils.counter("vac_bytes_moved"),
+    }
+
+
 def _measure_isolated(fn_name: str, timeout_s: int, fallback,
                       tag: str) -> dict:
     """Run a measurement in a FRESH subprocess: the relay slows with
@@ -1578,6 +1674,16 @@ def main() -> None:
                 extra.update(measure_reset_mttr())
         except Exception as exc:
             extra["reset_error"] = str(exc)[:200]
+        # tpuvac live migration: ALWAYS isolated — the multichip pool
+        # needs TPUMEM_FAKE_TPU_COUNT=4 in the child's environment
+        # before the native library loads (this process booted with
+        # the default device table).
+        try:
+            extra.update(_measure_isolated(
+                "measure_vac_migration", 900,
+                measure_vac_migration, "vac"))
+        except Exception as exc:
+            extra["vac_error"] = str(exc)[:200]
 
     try:
         extra.update(measure_explicit_migrate_gbps())

@@ -1,0 +1,171 @@
+/*
+ * tpuvac health — per-device health scoring, evacuation rendezvous,
+ * and the transactional migration manifest.
+ *
+ * The fleet-operations layer above tpureset: where reset.c answers a
+ * sick chip with a full-device reset (every tenant blacks out), tpuvac
+ * lets the serving layer MOVE tenants off a degrading chip while
+ * co-tenants keep decoding.  Three pieces live here:
+ *
+ *   HEALTH SCORER — every error the engines already count (channel RC
+ *     resets, watchdog nudges, ICI link flaps and retrain failures,
+ *     page quarantines, generation-fenced stale completions, deadline
+ *     expiries, full device resets) is also REPORTED per device via
+ *     tpurmHealthNote().  Each event adds a weighted contribution to a
+ *     decaying score (half-life registry "vac_health_halflife_ms");
+ *     the score drives a hysteretic state machine
+ *
+ *         HEALTHY -> DEGRADED -> EVACUATING
+ *
+ *     Promotion is immediate at the threshold ("vac_degrade_score" /
+ *     "vac_evac_score"); demotion requires the decayed score to fall
+ *     below HALF the threshold AND "vac_health_hold_ms" of quiet since
+ *     the last event — so a flapping chip cannot oscillate its state
+ *     at event rate (reference analog: nvswitch/nvlink error-rate
+ *     thresholds latch a link DOWN rather than tracking instantaneous
+ *     errors).
+ *
+ *   EVACUATION RENDEZVOUS — the native engine cannot move KV pages
+ *     itself (sequence state lives in the serving layer), so the
+ *     watchdog posts an evacuation REQUEST (source device, suggested
+ *     target) that the scheduler polls between decode rounds
+ *     (uvm/vac.py).  The request carries a grace window
+ *     ("vac_grace_ms"): a hung-op ladder escalation that finds the
+ *     window expired un-acked falls through to the full-device reset
+ *     rung, so an absent/wedged serving layer never wedges recovery.
+ *     Targets are picked healthy-first with HBM headroom
+ *     ("vac_headroom_pct" of the arena must be free).
+ *
+ *   VAC TRANSACTIONS — a migration is transactional: the source's
+ *     pages and sequence slots are retained until the target COMMITS a
+ *     generation-stamped manifest.  tpurmVacBegin stamps the device
+ *     generation and the source/target pair; tpurmVacCommit re-checks
+ *     that the generation never moved (a reset under the migration
+ *     invalidates in-flight page state), the target is not lost, and
+ *     an ACTIVE route still exists — any failure means the caller
+ *     ABORTS back to the source with zero corruption (the source copy
+ *     was never released).  Reference analog: fbsr.c save/restore
+ *     under the PM quiesce lock, pointed at a remote tier instead of
+ *     sysmem.
+ *
+ * Observability: tpurm_device_health{dev=} / _score gauges in the
+ * Prometheus exposition, the /proc/driver/tpurm/health node, a
+ * health.transition trace instant per state change, and the
+ * tpurm_watchdog_evacuations / vac_* counters.
+ */
+#ifndef TPURM_HEALTH_H
+#define TPURM_HEALTH_H
+
+#include <stdbool.h>
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Health states (order matters: promotion walks upward). */
+enum {
+    TPU_HEALTH_HEALTHY = 0,
+    TPU_HEALTH_DEGRADED = 1,
+    TPU_HEALTH_EVACUATING = 2,
+};
+
+/* Reportable events (keep tpurmHealthEventName in sync). */
+typedef enum {
+    TPU_HEALTH_EV_RC_RESET = 0,     /* channel RC reset-and-replay     */
+    TPU_HEALTH_EV_WD_NUDGE,         /* memring watchdog rung 1 nudge   */
+    TPU_HEALTH_EV_LINK_FLAP,        /* ICI link flap / admin failure   */
+    TPU_HEALTH_EV_RETRAIN_FAIL,     /* ICI retrain attempt failed      */
+    TPU_HEALTH_EV_PAGE_QUARANTINE,  /* page retired onto poison map    */
+    TPU_HEALTH_EV_STALE_COMPLETION, /* generation-fenced completion    */
+    TPU_HEALTH_EV_DEADLINE_EXPIRED, /* SQE/batch deadline fail-fast    */
+    TPU_HEALTH_EV_DEVICE_RESET,     /* full-device reset ran           */
+    TPU_HEALTH_EV_COUNT
+} TpuHealthEvent;
+
+/* Snapshot of one device's health (tpurmHealthInfo). */
+typedef struct {
+    uint32_t state;                 /* TPU_HEALTH_*                    */
+    uint32_t evacPending;           /* nonzero: a request is posted    */
+    uint64_t score;                 /* decayed score, integer points   */
+    uint64_t transitions;           /* lifetime state changes          */
+    uint64_t lastEventNs;           /* tpuNowNs of the last note       */
+    uint64_t events[TPU_HEALTH_EV_COUNT];
+    uint32_t evacTarget;            /* valid while evacPending         */
+    uint64_t evacReqId;             /* rendezvous token for the ack    */
+} TpuHealthInfo;
+
+/* Report one event against a device (hot paths call this; the cost is
+ * one mutexless fast path when the device is quiet is NOT attempted —
+ * notes are rare by definition, a mutex is fine). */
+void tpurmHealthNote(uint32_t devInst, uint32_t event);
+
+uint32_t tpurmDeviceHealthState(uint32_t devInst);
+uint64_t tpurmDeviceHealthScore(uint32_t devInst);
+TpuStatus tpurmHealthInfo(uint32_t devInst, TpuHealthInfo *out);
+const char *tpurmHealthEventName(uint32_t event);
+const char *tpurmHealthStateName(uint32_t state);
+
+/* Zero a device's score/state/history (post-evacuation, post-reset
+ * recovery, tests).  Pending evacuation requests are cancelled. */
+void tpurmHealthClear(uint32_t devInst);
+
+/* ------------------------------------------------- evacuation rendezvous */
+
+/* Post an evacuation request for devInst (operator planned move or the
+ * watchdog).  target ~0u = pick one (healthy peer with headroom);
+ * OBJECT_NOT_FOUND when no viable target exists, INVALID_STATE when a
+ * request is already pending. */
+TpuStatus tpurmHealthEvacRequest(uint32_t devInst, uint32_t target);
+/* Broker-aware form: forwards over TPURM_BROKER when attached. */
+TpuStatus tpurmHealthEvacRequestClient(uint32_t devInst, uint32_t target);
+
+/* Poll: true when an evacuation of devInst is requested and inside its
+ * grace window.  Fills the suggested target and the request id the
+ * eventual ack must echo. */
+bool tpurmHealthEvacPending(uint32_t devInst, uint32_t *targetOut,
+                            uint64_t *reqIdOut);
+
+/* Serving-layer completion: success clears the device's health history
+ * (the tenant left; old errors no longer predict anything), failure
+ * re-arms the ladder (the request is consumed either way).
+ * INVALID_ARGUMENT when reqId does not match the pending request. */
+TpuStatus tpurmHealthEvacAck(uint32_t devInst, uint64_t reqId,
+                             bool success);
+
+/* Healthy peer with HBM headroom ("vac_headroom_pct" free), nearest
+ * first (fewest route hops).  OBJECT_NOT_FOUND when none. */
+TpuStatus tpurmHealthPickTarget(uint32_t srcInst, uint32_t *targetOut);
+
+/* Watchdog hooks (reset.c): Tick runs once per watchdog period (decay,
+ * health-driven evac posting, grace expiry); EvacLadderRung is
+ * consulted when the hung-op ladder reaches the device-reset rung and
+ * returns true when the EVACUATE rung absorbed the escalation (a
+ * request was posted, or one is pending inside its grace window) —
+ * false falls through to the full-device reset. */
+void tpurmHealthTick(void);
+bool tpurmHealthEvacLadderRung(void);
+
+/* ---------------------------------------------------- vac transactions */
+
+/* Begin a migration manifest src -> dst.  Stamps the current device
+ * generation; fails when either device is lost or no ACTIVE route
+ * exists.  Up to 16 concurrent transactions. */
+TpuStatus tpurmVacBegin(uint32_t srcInst, uint32_t dstInst,
+                        uint64_t *txnOut);
+/* Commit: re-validates generation / target liveness / route.  On any
+ * failure the transaction stays open — the caller MUST abort (its
+ * source copy is still the truth). */
+TpuStatus tpurmVacCommit(uint64_t txn);
+/* Abort: release the manifest; the source remains authoritative. */
+TpuStatus tpurmVacAbort(uint64_t txn);
+/* Open transactions (introspection / leak checks). */
+uint32_t tpurmVacActive(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_HEALTH_H */

@@ -59,6 +59,14 @@ typedef enum {
                                       * once per tick; a hit injects a
                                       * device-level fatal fault whose
                                       * recovery IS tpurmDeviceReset)   */
+    TPU_INJECT_SITE_VAC_MIGRATE,     /* tpuvac page-record shipping
+                                      * (one evaluation per record copy
+                                      * attempt; recovery is bounded
+                                      * retry, then transactional abort
+                                      * back to the source — exact
+                                      * invariant: hits ==
+                                      * vac_inject_retries +
+                                      * vac_inject_aborts)             */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

@@ -40,6 +40,16 @@
  *     rung 1  nudge     — re-ring the doorbells (a lost wake is the
  *                         cheapest wedge)            tpurm_watchdog_nudges
  *     rung 2  RC reset  — channel reset-and-replay   tpurm_watchdog_rc_resets
+ *     rung 2.5 EVACUATE — when a device's health state and the fleet
+ *                         allow it (a sick chip, a HEALTHY peer with
+ *                         HBM headroom — tpurm/health.h), post a live
+ *                         tenant evacuation request instead of
+ *                         resetting; the serving layer drains tenants
+ *                         off the chip inside a grace window
+ *                         ("vac_grace_ms").  An expired un-acked
+ *                         request falls through to rung 3, so recovery
+ *                         never waits on an absent scheduler.
+ *                                                    tpurm_watchdog_evacuations
  *     rung 3  device    — full-device reset          tpurm_watchdog_device_resets
  *
  *   The ladder saturates after rung 3 until the ring makes progress
@@ -91,6 +101,7 @@ typedef struct {
     uint64_t watchdogNudges;    /* ladder rung 1 */
     uint64_t watchdogRcResets;  /* ladder rung 2 */
     uint64_t watchdogDeviceResets; /* ladder rung 3 */
+    uint64_t watchdogEvacuations;  /* ladder rung 2.5 (EVACUATE) */
     uint64_t lastMttrNs;        /* last reset: quiesce -> resume        */
     uint64_t lastQuiesceNs;     /* last reset: quiesce phase alone      */
     uint64_t lastRestoreNs;     /* last reset: reset + resume phases    */

@@ -83,6 +83,9 @@ typedef enum {
     TPU_TRACE_SCHED_PREEMPT,     /* tpusched preempt + swap-out        */
     TPU_TRACE_RESET_DEVICE,      /* full-device reset (quiesce->resume) */
     TPU_TRACE_RESET_QUIESCE,     /* reset quiesce phase alone          */
+    TPU_TRACE_VAC_MIGRATE,       /* tpuvac tenant migration (whole
+                                  * drain->ship->commit window; obj =
+                                  * src<<32|dst, bytes = bytes moved)  */
     TPU_TRACE_APP,               /* application span (Python utils.span) */
     /* Instant-only sites. */
     TPU_TRACE_INJECT_HIT,        /* injection framework fired          */
@@ -91,6 +94,8 @@ typedef enum {
     TPU_TRACE_RECOVER_QUARANTINE,
     TPU_TRACE_RECOVER_RC_RESET,
     TPU_TRACE_RECOVER_RETRAIN,
+    TPU_TRACE_HEALTH_TRANSITION, /* device health state change (obj =
+                                  * dev, bytes = new TPU_HEALTH_*)     */
     TPU_TRACE_SITE_COUNT
 } TpuTraceSite;
 

@@ -505,6 +505,10 @@ TpuStatus uvmHbmChunkAllocSized(uint32_t devInst, uint64_t size,
 TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
                            uint64_t *outOffset, void **outHandle);
 TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle);
+/* Arena occupancy: free/total bytes of a device's HBM tier PMM (tpuvac
+ * evacuation-target headroom; capacity dashboards). */
+TpuStatus uvmHbmArenaUsage(uint32_t devInst, uint64_t *freeBytes,
+                           uint64_t *totalBytes);
 
 /* ------------------------------------------------------- tenant QoS API
  *
@@ -543,6 +547,17 @@ TpuStatus uvmTenantInfoGet(uint32_t tenantId, UvmTenantInfo *out);
 /* Bind vs (and the pages its blocks already hold) to tenantId; the
  * tenant must exist.  Re-binding moves the existing charge. */
 TpuStatus uvmVaSpaceBindTenant(UvmVaSpace *vs, uint32_t tenantId);
+/* Per-DEVICE HBM charge (tpuvac): pools that place a tenant's pages on
+ * a specific chip (the ICI KV pool) charge that chip's column here;
+ * a live migration REBINDS the charge from the source chip to the
+ * target in one move (per-tier totals untouched, counted
+ * tpurm_tenant_rebinds).  Rendered as tpurm_tenant_dev_pages{tenant,
+ * dev} gauges and in /proc/driver/tpurm/tenants. */
+void uvmTenantDevCharge(uint32_t tenantId, uint32_t devInst,
+                        int64_t pages);
+TpuStatus uvmTenantRebindDevicePages(uint32_t tenantId, uint32_t fromDev,
+                                     uint32_t toDev, uint64_t pages);
+uint64_t uvmTenantDevPages(uint32_t tenantId, uint32_t devInst);
 
 /* -------------------------------------------------------- suspend/resume */
 

@@ -62,6 +62,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/abi.h"
+#include "tpurm/health.h"
 #include "tpurm/uvm.h"
 
 #include <errno.h>
@@ -89,7 +90,7 @@
 
 enum { BR_OP_OPEN = 1, BR_OP_CLOSE = 2, BR_OP_IOCTL = 3,
        BR_OP_UVM_BACKING = 4, BR_OP_UVM_RFAULT = 5, BR_OP_TENANT = 6,
-       BR_OP_PING = 7 };
+       BR_OP_PING = 7, BR_OP_VAC = 8 };
 
 /* Payload of the UVM multi-process ops (rides where ioctl payloads
  * do).  BACKING resolves an owner VA to the range's host-backing memfd
@@ -117,6 +118,18 @@ typedef struct {
     uint32_t status;            /* out: TpuStatus */
     uint32_t pad;
 } BrokerTenantMsg;
+
+/* BR_OP_VAC payload: operator-triggered planned tenant move (tpuvac).
+ * Posts an evacuation request into the ENGINE HOST's health rendezvous
+ * (tpurm/health.h tpurmHealthEvacRequest) — the serving layer attached
+ * to the engine drains the source chip inside the grace window.
+ * target ~0u asks the engine to pick one. */
+typedef struct {
+    uint32_t devInst;
+    uint32_t target;
+    uint32_t status;            /* out: TpuStatus */
+    uint32_t pad;
+} BrokerVacMsg;
 
 /* Reply flag: an fd rides the rep via SCM_RIGHTS (arena memfd for a
  * map, signal-page memfd for the first event). */
@@ -1110,6 +1123,18 @@ static void *conn_thread(void *arg)
             rep.mainSize = sizeof(*m);
             break;
         }
+        case BR_OP_VAC: {
+            BrokerVacMsg *m = (BrokerVacMsg *)buf;
+            if (rq.mainSize != sizeof(*m)) {
+                rep.ret = -1;
+                rep.err = EINVAL;
+                break;
+            }
+            m->status = (uint32_t)tpurmHealthEvacRequest(m->devInst,
+                                                         m->target);
+            rep.mainSize = sizeof(*m);
+            break;
+        }
         case BR_OP_PING:
             /* Heartbeat: lastSeenNs was stamped above; the reply
              * doubles as the client's liveness probe of the engine. */
@@ -1499,6 +1524,23 @@ TpuStatus tpurmBrokerTenantConfigure(uint32_t tenantId, uint32_t priority,
                           .hbmQuotaPages = hbmQuotaPages,
                           .cxlQuotaPages = cxlQuotaPages };
     BrokerReq rq = { .op = BR_OP_TENANT, .mainSize = sizeof(m) };
+    BrokerRep rep;
+    if (cli_call(&rq, &m, &rep, &m, sizeof(m), NULL) != 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    if (rep.ret < 0)
+        return TPU_ERR_OPERATING_SYSTEM;
+    return (TpuStatus)m.status;
+}
+
+TpuStatus tpurmBrokerVacRequest(uint32_t devInst, uint32_t target)
+{
+    /* Engine-hosting processes post locally (health.c falls back on
+     * NOT_SUPPORTED); broker clients forward so the request lands in
+     * the rendezvous the engine's scheduler actually polls. */
+    if (!getenv("TPURM_BROKER"))
+        return TPU_ERR_NOT_SUPPORTED;
+    BrokerVacMsg m = { .devInst = devInst, .target = target };
+    BrokerReq rq = { .op = BR_OP_VAC, .mainSize = sizeof(m) };
     BrokerRep rep;
     if (cli_call(&rq, &m, &rep, &m, sizeof(m), NULL) != 0)
         return TPU_ERR_OPERATING_SYSTEM;

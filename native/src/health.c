@@ -1,0 +1,689 @@
+/*
+ * tpuvac health — per-device health scoring, the evacuation
+ * rendezvous, and transactional migration manifests (model and
+ * contracts in include/tpurm/health.h).
+ *
+ * Concurrency: per-device STATE is an atomic (hot readers — the
+ * Prometheus render, the scheduler poll, PickTarget — never take the
+ * lock); everything else (score mutation, rendezvous fields, the
+ * transaction table) sits under one mutex.  tpurmHealthNote is called
+ * from inside other subsystems' locks (g_ici.lock, memring popLock),
+ * so nothing here may call back into ici/memring while holding
+ * g_health.lock — the two places that need route queries
+ * (PickTarget, VacBegin/Commit) run them UNLOCKED and tolerate the
+ * benign races (the single watchdog thread is the only ladder/tick
+ * caller; operator requests race it at worst into an INVALID_STATE
+ * "already pending" result).
+ */
+#define _GNU_SOURCE
+#include "tpurm/health.h"
+
+#include <pthread.h>
+#include <stdatomic.h>
+#include <string.h>
+
+#include "internal.h"
+#include "tpurm/ici.h"
+#include "tpurm/reset.h"
+#include "tpurm/trace.h"
+#include "uvm/uvm_internal.h"
+
+#define HEALTH_MAX_DEVICES 16
+#define VAC_MAX_TXNS 16
+
+/* Event weights (score points added per note).  Chosen so a single
+ * transient (one flap, one nudge) never leaves HEALTHY at the default
+ * thresholds, while a burst of real trouble (quarantine + RC resets,
+ * repeated flaps) crosses DEGRADED fast and sustained trouble crosses
+ * EVACUATING. */
+static const uint32_t g_weights[TPU_HEALTH_EV_COUNT] = {
+    [TPU_HEALTH_EV_RC_RESET] = 300,
+    [TPU_HEALTH_EV_WD_NUDGE] = 60,
+    [TPU_HEALTH_EV_LINK_FLAP] = 200,
+    [TPU_HEALTH_EV_RETRAIN_FAIL] = 260,
+    [TPU_HEALTH_EV_PAGE_QUARANTINE] = 400,
+    [TPU_HEALTH_EV_STALE_COMPLETION] = 150,
+    [TPU_HEALTH_EV_DEADLINE_EXPIRED] = 120,
+    [TPU_HEALTH_EV_DEVICE_RESET] = 500,
+};
+
+static const char *const g_eventNames[TPU_HEALTH_EV_COUNT] = {
+    "rc_reset",
+    "wd_nudge",
+    "link_flap",
+    "retrain_fail",
+    "page_quarantine",
+    "stale_completion",
+    "deadline_expired",
+    "device_reset",
+};
+
+static const char *const g_stateNames[] = {
+    "HEALTHY", "DEGRADED", "EVACUATING"
+};
+
+typedef struct {
+    _Atomic uint32_t state;         /* TPU_HEALTH_* (lock-free readers) */
+    uint64_t score;                 /* decayed points; lock held        */
+    uint64_t lastDecayNs;
+    uint64_t lastEventNs;
+    uint64_t transitions;
+    uint64_t events[TPU_HEALTH_EV_COUNT];
+    /* Evacuation rendezvous. */
+    bool evacPending;
+    uint32_t evacTarget;
+    uint64_t evacReqId;
+    uint64_t evacPostedNs;
+    uint64_t evacCooldownNs;        /* no re-post before this           */
+} HealthDev;
+
+typedef struct {
+    uint64_t id;                    /* 0 = slot free                    */
+    uint32_t src, dst;
+    uint64_t gen;                   /* device generation at begin       */
+    uint64_t startNs;
+} VacTxn;
+
+static struct {
+    pthread_mutex_t lock;
+    HealthDev dev[HEALTH_MAX_DEVICES];
+    uint64_t nextReqId;
+    uint64_t nextTxnId;
+    VacTxn txns[VAC_MAX_TXNS];
+    _Atomic uint32_t txnsActive;
+} g_health = { .lock = PTHREAD_MUTEX_INITIALIZER,
+               .nextReqId = 1, .nextTxnId = 1 };
+
+const char *tpurmHealthEventName(uint32_t event)
+{
+    return event < TPU_HEALTH_EV_COUNT ? g_eventNames[event] : NULL;
+}
+
+const char *tpurmHealthStateName(uint32_t state)
+{
+    return state <= TPU_HEALTH_EVACUATING ? g_stateNames[state] : "?";
+}
+
+/* Lazy exponential decay: one halving per elapsed half-life, plus a
+ * linear interpolation of the partial half-life — integer-only and
+ * monotone, which is all the hysteresis needs. */
+static void health_decay_locked(HealthDev *d, uint64_t now)
+{
+    uint64_t halflifeNs =
+        tpuRegistryGet("vac_health_halflife_ms", 2000) * 1000000ull;
+    if (!halflifeNs || now <= d->lastDecayNs) {
+        d->lastDecayNs = now;
+        return;
+    }
+    uint64_t dt = now - d->lastDecayNs;
+    uint64_t halvings = dt / halflifeNs;
+    d->score = halvings >= 64 ? 0 : d->score >> halvings;
+    /* Partial half-life: score -= score * frac / 2 (frac in [0,1)). */
+    uint64_t rem = dt % halflifeNs;
+    d->score -= (d->score >> 1) / halflifeNs * rem +
+                (((d->score >> 1) % halflifeNs) * rem) / halflifeNs;
+    d->lastDecayNs = now;
+}
+
+static void health_set_state_locked(uint32_t devInst, HealthDev *d,
+                                    uint32_t newState)
+{
+    uint32_t old = atomic_load_explicit(&d->state, memory_order_relaxed);
+    if (old == newState)
+        return;
+    atomic_store_explicit(&d->state, newState, memory_order_release);
+    d->transitions++;
+    tpuCounterAdd("tpurm_health_transitions", 1);
+    tpurmTraceInstantLabel(TPU_TRACE_HEALTH_TRANSITION, devInst,
+                           newState, "health.transition");
+    tpuLog(newState > old ? TPU_LOG_WARN : TPU_LOG_INFO, "health",
+           "device %u health %s -> %s (score=%llu)", devInst,
+           g_stateNames[old], g_stateNames[newState],
+           (unsigned long long)d->score);
+}
+
+/* Promotion is immediate; demotion needs half-threshold score AND a
+ * quiet hold window — both evaluated here after a decay or a note. */
+static void health_update_state_locked(uint32_t devInst, HealthDev *d,
+                                       uint64_t now)
+{
+    uint64_t degrade = tpuRegistryGet("vac_degrade_score", 500);
+    uint64_t evac = tpuRegistryGet("vac_evac_score", 1000);
+    uint64_t holdNs = tpuRegistryGet("vac_health_hold_ms", 1000) *
+                      1000000ull;
+    uint32_t st = atomic_load_explicit(&d->state, memory_order_relaxed);
+    if (d->score >= evac) {
+        health_set_state_locked(devInst, d, TPU_HEALTH_EVACUATING);
+        return;
+    }
+    if (d->score >= degrade && st < TPU_HEALTH_DEGRADED) {
+        health_set_state_locked(devInst, d, TPU_HEALTH_DEGRADED);
+        return;
+    }
+    bool quiet = now - d->lastEventNs >= holdNs;
+    if (st == TPU_HEALTH_EVACUATING && quiet && d->score < evac / 2)
+        health_set_state_locked(devInst, d, TPU_HEALTH_DEGRADED);
+    else if (st == TPU_HEALTH_DEGRADED && quiet && d->score < degrade / 2)
+        health_set_state_locked(devInst, d, TPU_HEALTH_HEALTHY);
+}
+
+void tpurmHealthNote(uint32_t devInst, uint32_t event)
+{
+    if (devInst >= HEALTH_MAX_DEVICES || event >= TPU_HEALTH_EV_COUNT)
+        return;
+    uint64_t now = tpuNowNs();
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[devInst];
+    health_decay_locked(d, now);
+    d->score += g_weights[event];
+    d->events[event]++;
+    d->lastEventNs = now;
+    health_update_state_locked(devInst, d, now);
+    pthread_mutex_unlock(&g_health.lock);
+}
+
+uint32_t tpurmDeviceHealthState(uint32_t devInst)
+{
+    if (devInst >= HEALTH_MAX_DEVICES)
+        return TPU_HEALTH_HEALTHY;
+    return atomic_load_explicit(&g_health.dev[devInst].state,
+                                memory_order_acquire);
+}
+
+uint64_t tpurmDeviceHealthScore(uint32_t devInst)
+{
+    if (devInst >= HEALTH_MAX_DEVICES)
+        return 0;
+    uint64_t now = tpuNowNs();
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[devInst];
+    health_decay_locked(d, now);
+    uint64_t s = d->score;
+    pthread_mutex_unlock(&g_health.lock);
+    return s;
+}
+
+TpuStatus tpurmHealthInfo(uint32_t devInst, TpuHealthInfo *out)
+{
+    if (!out || devInst >= HEALTH_MAX_DEVICES)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t now = tpuNowNs();
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[devInst];
+    health_decay_locked(d, now);
+    health_update_state_locked(devInst, d, now);
+    memset(out, 0, sizeof(*out));
+    out->state = atomic_load_explicit(&d->state, memory_order_relaxed);
+    out->score = d->score;
+    out->transitions = d->transitions;
+    out->lastEventNs = d->lastEventNs;
+    memcpy(out->events, d->events, sizeof(out->events));
+    out->evacPending = d->evacPending ? 1 : 0;
+    out->evacTarget = d->evacTarget;
+    out->evacReqId = d->evacReqId;
+    pthread_mutex_unlock(&g_health.lock);
+    return TPU_OK;
+}
+
+void tpurmHealthClear(uint32_t devInst)
+{
+    if (devInst >= HEALTH_MAX_DEVICES)
+        return;
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[devInst];
+    uint64_t now = tpuNowNs();
+    d->score = 0;
+    d->lastDecayNs = now;
+    d->lastEventNs = 0;
+    memset(d->events, 0, sizeof(d->events));
+    d->evacPending = false;
+    d->evacCooldownNs = 0;
+    health_set_state_locked(devInst, d, TPU_HEALTH_HEALTHY);
+    pthread_mutex_unlock(&g_health.lock);
+}
+
+/* ------------------------------------------------- evacuation rendezvous */
+
+TpuStatus tpurmHealthPickTarget(uint32_t srcInst, uint32_t *targetOut)
+{
+    if (!targetOut)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint32_t n = tpurmDeviceCount();
+    if (n > HEALTH_MAX_DEVICES)
+        n = HEALTH_MAX_DEVICES;
+    uint64_t headroomPct = tpuRegistryGet("vac_headroom_pct", 10);
+    uint32_t best = ~0u, bestHops = ~0u;
+    for (uint32_t d = 0; d < n; d++) {
+        if (d == srcInst)
+            continue;
+        TpurmDevice *dev = tpurmDeviceGet(d);
+        if (!dev || dev->lost)
+            continue;
+        if (tpurmDeviceHealthState(d) != TPU_HEALTH_HEALTHY)
+            continue;
+        uint64_t freeB = 0, totalB = 0;
+        if (uvmHbmArenaUsage(d, &freeB, &totalB) != TPU_OK || !totalB)
+            continue;
+        if (freeB * 100 < totalB * headroomPct)
+            continue;               /* no quota headroom */
+        uint32_t hops;
+        if (tpuIciRouteHops(srcInst, d, &hops) != TPU_OK)
+            continue;               /* partitioned from the source */
+        if (hops < bestHops) {
+            best = d;
+            bestHops = hops;
+        }
+    }
+    if (best == ~0u)
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    *targetOut = best;
+    return TPU_OK;
+}
+
+/* Post a request (lock held, target already resolved).  The
+ * tpurm_watchdog_evacuations rung counter is NOT bumped here — only
+ * the watchdog call sites (tick, ladder) count it, so operator planned
+ * moves never read as phantom ladder escalations. */
+static void evac_post_locked(uint32_t devInst, HealthDev *d,
+                             uint32_t target, uint64_t now)
+{
+    d->evacPending = true;
+    d->evacTarget = target;
+    d->evacReqId = g_health.nextReqId++;
+    d->evacPostedNs = now;
+    tpuCounterAdd("vac_requests", 1);
+    tpuLog(TPU_LOG_WARN, "health",
+           "EVACUATE requested: device %u -> %u (req %llu, state %s)",
+           devInst, target, (unsigned long long)d->evacReqId,
+           g_stateNames[atomic_load_explicit(&d->state,
+                                             memory_order_relaxed)]);
+}
+
+TpuStatus tpurmHealthEvacRequest(uint32_t devInst, uint32_t target)
+{
+    if (devInst >= HEALTH_MAX_DEVICES || devInst >= tpurmDeviceCount())
+        return TPU_ERR_INVALID_DEVICE;
+    if (target == ~0u) {
+        TpuStatus st = tpurmHealthPickTarget(devInst, &target);
+        if (st != TPU_OK)
+            return st;
+    } else if (target >= tpurmDeviceCount() || target == devInst) {
+        return TPU_ERR_INVALID_ARGUMENT;
+    }
+    uint64_t now = tpuNowNs();
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[devInst];
+    if (d->evacPending) {
+        pthread_mutex_unlock(&g_health.lock);
+        return TPU_ERR_INVALID_STATE;
+    }
+    evac_post_locked(devInst, d, target, now);
+    tpuCounterAdd("vac_operator_requests", 1);
+    pthread_mutex_unlock(&g_health.lock);
+    return TPU_OK;
+}
+
+bool tpurmHealthEvacPending(uint32_t devInst, uint32_t *targetOut,
+                            uint64_t *reqIdOut)
+{
+    if (devInst >= HEALTH_MAX_DEVICES)
+        return false;
+    uint64_t graceNs = tpuRegistryGet("vac_grace_ms", 1500) * 1000000ull;
+    uint64_t now = tpuNowNs();
+    bool pending = false;
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[devInst];
+    if (d->evacPending && now - d->evacPostedNs <= graceNs) {
+        pending = true;
+        if (targetOut)
+            *targetOut = d->evacTarget;
+        if (reqIdOut)
+            *reqIdOut = d->evacReqId;
+    }
+    pthread_mutex_unlock(&g_health.lock);
+    return pending;
+}
+
+TpuStatus tpurmHealthEvacAck(uint32_t devInst, uint64_t reqId,
+                             bool success)
+{
+    if (devInst >= HEALTH_MAX_DEVICES)
+        return TPU_ERR_INVALID_ARGUMENT;
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[devInst];
+    if (!d->evacPending || d->evacReqId != reqId) {
+        pthread_mutex_unlock(&g_health.lock);
+        return TPU_ERR_INVALID_ARGUMENT;
+    }
+    d->evacPending = false;
+    if (!success) {
+        /* Failed evacuation: cool down so the watchdog does not storm
+         * re-posts at tick rate; the ladder may escalate meanwhile. */
+        d->evacCooldownNs = tpuNowNs() +
+            tpuRegistryGet("vac_grace_ms", 1500) * 1000000ull;
+        tpuCounterAdd("vac_failed_acks", 1);
+    }
+    pthread_mutex_unlock(&g_health.lock);
+    if (success) {
+        tpuCounterAdd("vac_acks", 1);
+        /* The tenant left the chip; its error history predicts nothing
+         * about the NEXT tenant — start the score clean (the state
+         * machine will re-degrade in one note burst if the chip is
+         * genuinely sick). */
+        tpurmHealthClear(devInst);
+    }
+    tpuLog(TPU_LOG_WARN, "health", "evacuation of device %u %s (req %llu)",
+           devInst, success ? "ACKED" : "FAILED",
+           (unsigned long long)reqId);
+    return TPU_OK;
+}
+
+/* Broker-aware operator entry (uvm/vac.py planned moves): forward to
+ * the engine host when this process is a broker client. */
+TpuStatus tpurmHealthEvacRequestClient(uint32_t devInst, uint32_t target)
+{
+    TpuStatus st = tpurmBrokerVacRequest(devInst, target);
+    if (st != TPU_ERR_NOT_SUPPORTED)
+        return st;                  /* brokered (or broker-side error) */
+    return tpurmHealthEvacRequest(devInst, target);
+}
+
+/* Consume requests whose grace expired (no serving layer picked them
+ * up).  Returns true when one expired THIS pass — the ladder treats
+ * that as "evacuation was offered and declined". */
+static bool evac_expire_locked(uint32_t devInst, HealthDev *d,
+                               uint64_t now, uint64_t graceNs)
+{
+    if (!d->evacPending || now - d->evacPostedNs <= graceNs)
+        return false;
+    d->evacPending = false;
+    d->evacCooldownNs = now + 4 * graceNs;
+    tpuCounterAdd("vac_grace_expired", 1);
+    tpuLog(TPU_LOG_WARN, "health",
+           "evacuation request for device %u expired un-acked (req %llu)",
+           devInst, (unsigned long long)d->evacReqId);
+    return true;
+}
+
+void tpurmHealthTick(void)
+{
+    if (!tpuRegistryGet("vac_enable", 1))
+        return;
+    uint32_t n = tpurmDeviceCount();
+    if (n > HEALTH_MAX_DEVICES)
+        n = HEALTH_MAX_DEVICES;
+    uint64_t graceNs = tpuRegistryGet("vac_grace_ms", 1500) * 1000000ull;
+    uint64_t now = tpuNowNs();
+
+    /* Decay + demotion + grace expiry under the lock... */
+    uint32_t wantEvac[HEALTH_MAX_DEVICES];
+    uint32_t nWant = 0;
+    pthread_mutex_lock(&g_health.lock);
+    for (uint32_t i = 0; i < n; i++) {
+        HealthDev *d = &g_health.dev[i];
+        health_decay_locked(d, now);
+        health_update_state_locked(i, d, now);
+        evac_expire_locked(i, d, now, graceNs);
+        if (atomic_load_explicit(&d->state, memory_order_relaxed) ==
+                TPU_HEALTH_EVACUATING &&
+            !d->evacPending && now >= d->evacCooldownNs)
+            wantEvac[nWant++] = i;
+    }
+    pthread_mutex_unlock(&g_health.lock);
+
+    /* ...then target picking (route queries) OUTSIDE it.  The posting
+     * re-checks pending under the lock, so an operator request racing
+     * this tick cannot be double-posted. */
+    for (uint32_t k = 0; k < nWant; k++) {
+        uint32_t dev = wantEvac[k], target;
+        if (tpurmHealthPickTarget(dev, &target) != TPU_OK)
+            continue;               /* nowhere to go: the ladder decides */
+        pthread_mutex_lock(&g_health.lock);
+        HealthDev *d = &g_health.dev[dev];
+        if (!d->evacPending && now >= d->evacCooldownNs) {
+            evac_post_locked(dev, d, target, now);
+            tpuCounterAddScoped("tpurm_watchdog_evacuations", dev, 1);
+        }
+        pthread_mutex_unlock(&g_health.lock);
+    }
+}
+
+bool tpurmHealthEvacLadderRung(void)
+{
+    if (!tpuRegistryGet("vac_enable", 1))
+        return false;
+    uint32_t n = tpurmDeviceCount();
+    if (n > HEALTH_MAX_DEVICES)
+        n = HEALTH_MAX_DEVICES;
+    uint64_t graceNs = tpuRegistryGet("vac_grace_ms", 1500) * 1000000ull;
+    uint64_t now = tpuNowNs();
+
+    /* A pending request inside its grace window absorbs the rung (the
+     * serving layer is being given its chance to drain).  An expired
+     * one is consumed here and the rung FALLS THROUGH to the device
+     * reset — recovery never waits on an absent scheduler. */
+    uint32_t sick = ~0u;
+    uint64_t sickScore = 0;
+    pthread_mutex_lock(&g_health.lock);
+    for (uint32_t i = 0; i < n; i++) {
+        HealthDev *d = &g_health.dev[i];
+        if (d->evacPending) {
+            if (now - d->evacPostedNs <= graceNs) {
+                pthread_mutex_unlock(&g_health.lock);
+                return true;
+            }
+            evac_expire_locked(i, d, now, graceNs);
+            pthread_mutex_unlock(&g_health.lock);
+            return false;
+        }
+        uint32_t st = atomic_load_explicit(&d->state,
+                                           memory_order_relaxed);
+        if (st >= TPU_HEALTH_DEGRADED && now >= d->evacCooldownNs &&
+            (sick == ~0u || d->score > sickScore)) {
+            sick = i;
+            sickScore = d->score;
+        }
+    }
+    pthread_mutex_unlock(&g_health.lock);
+    if (sick == ~0u)
+        return false;               /* nothing attributable: reset */
+
+    uint32_t target;
+    if (tpurmHealthPickTarget(sick, &target) != TPU_OK)
+        return false;               /* no healthy peer with headroom */
+    pthread_mutex_lock(&g_health.lock);
+    HealthDev *d = &g_health.dev[sick];
+    bool posted = false;
+    if (!d->evacPending && now >= d->evacCooldownNs) {
+        evac_post_locked(sick, d, target, now);
+        tpuCounterAddScoped("tpurm_watchdog_evacuations", sick, 1);
+        posted = true;
+    }
+    pthread_mutex_unlock(&g_health.lock);
+    return posted;
+}
+
+/* ---------------------------------------------------- vac transactions */
+
+TpuStatus tpurmVacBegin(uint32_t srcInst, uint32_t dstInst,
+                        uint64_t *txnOut)
+{
+    if (!txnOut || srcInst == dstInst)
+        return TPU_ERR_INVALID_ARGUMENT;
+    TpurmDevice *src = tpurmDeviceGet(srcInst);
+    TpurmDevice *dst = tpurmDeviceGet(dstInst);
+    if (!src || !dst)
+        return TPU_ERR_INVALID_DEVICE;
+    if (src->lost || dst->lost)
+        return TPU_ERR_GPU_IS_LOST;
+    uint32_t hops;
+    if (tpuIciRouteHops(srcInst, dstInst, &hops) != TPU_OK)
+        return TPU_ERR_RETRAIN_FAILED;      /* partitioned */
+    uint64_t gen = tpurmDeviceGeneration();
+
+    pthread_mutex_lock(&g_health.lock);
+    VacTxn *t = NULL;
+    for (int i = 0; i < VAC_MAX_TXNS; i++)
+        if (g_health.txns[i].id == 0) {
+            t = &g_health.txns[i];
+            break;
+        }
+    if (!t) {
+        pthread_mutex_unlock(&g_health.lock);
+        return TPU_ERR_INSUFFICIENT_RESOURCES;
+    }
+    t->id = g_health.nextTxnId++;
+    t->src = srcInst;
+    t->dst = dstInst;
+    t->gen = gen;
+    t->startNs = tpuNowNs();
+    *txnOut = t->id;
+    atomic_fetch_add(&g_health.txnsActive, 1);
+    pthread_mutex_unlock(&g_health.lock);
+    tpuCounterAdd("vac_txn_begins", 1);
+    return TPU_OK;
+}
+
+static VacTxn *vac_find_locked(uint64_t txn)
+{
+    for (int i = 0; i < VAC_MAX_TXNS; i++)
+        if (g_health.txns[i].id == txn)
+            return &g_health.txns[i];
+    return NULL;
+}
+
+TpuStatus tpurmVacCommit(uint64_t txn)
+{
+    pthread_mutex_lock(&g_health.lock);
+    VacTxn *t = vac_find_locked(txn);
+    if (!t) {
+        pthread_mutex_unlock(&g_health.lock);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    uint32_t src = t->src, dst = t->dst;
+    uint64_t gen = t->gen, startNs = t->startNs;
+    pthread_mutex_unlock(&g_health.lock);
+
+    /* Validation runs UNLOCKED (route query takes g_ici.lock): the
+     * transaction is single-owner by contract — only its creator
+     * commits/aborts it. */
+    TpuStatus st = TPU_OK;
+    if (tpurmDeviceGeneration() != gen) {
+        /* A full-device reset ran under the migration: in-flight page
+         * state on BOTH ends predates the reset's save/restore — the
+         * manifest is invalid by definition. */
+        st = TPU_ERR_DEVICE_RESET;
+    } else {
+        TpurmDevice *dstDev = tpurmDeviceGet(dst);
+        if (!dstDev || dstDev->lost)
+            st = TPU_ERR_GPU_IS_LOST;       /* target died mid-move */
+        else {
+            uint32_t hops;
+            if (tpuIciRouteHops(src, dst, &hops) != TPU_OK)
+                st = TPU_ERR_RETRAIN_FAILED; /* fabric partitioned */
+        }
+    }
+    if (st != TPU_OK) {
+        /* The transaction STAYS OPEN: the caller must abort — its
+         * source copy is still the only truth. */
+        tpuCounterAdd("vac_commit_rejected", 1);
+        tpuLog(TPU_LOG_WARN, "health",
+               "vac commit REJECTED (txn %llu %u->%u): %s",
+               (unsigned long long)txn, src, dst, tpuStatusToString(st));
+        return st;
+    }
+
+    pthread_mutex_lock(&g_health.lock);
+    t = vac_find_locked(txn);
+    if (t) {
+        t->id = 0;
+        atomic_fetch_sub(&g_health.txnsActive, 1);
+    }
+    pthread_mutex_unlock(&g_health.lock);
+    tpuCounterAdd("vac_commits", 1);
+    tpuCounterAdd("vac_commit_ns", tpuNowNs() - startNs);
+    return TPU_OK;
+}
+
+TpuStatus tpurmVacAbort(uint64_t txn)
+{
+    pthread_mutex_lock(&g_health.lock);
+    VacTxn *t = vac_find_locked(txn);
+    if (!t) {
+        pthread_mutex_unlock(&g_health.lock);
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    }
+    uint32_t src = t->src, dst = t->dst;
+    t->id = 0;
+    atomic_fetch_sub(&g_health.txnsActive, 1);
+    pthread_mutex_unlock(&g_health.lock);
+    tpuCounterAdd("vac_aborts", 1);
+    tpuLog(TPU_LOG_WARN, "health",
+           "vac ABORT (txn %llu %u->%u): source remains authoritative",
+           (unsigned long long)txn, src, dst);
+    return TPU_OK;
+}
+
+uint32_t tpurmVacActive(void)
+{
+    return atomic_load_explicit(&g_health.txnsActive,
+                                memory_order_acquire);
+}
+
+/* -------------------------------------------------------------- render */
+
+/* Prometheus gauges (procfs render_metrics appends this after the
+ * counter exposition).  States render numerically (0/1/2) so alerting
+ * thresholds are a plain comparison. */
+void tpurmHealthRenderProm(TpuCur *c)
+{
+    uint32_t n = tpurmDeviceCount();
+    if (n > HEALTH_MAX_DEVICES)
+        n = HEALTH_MAX_DEVICES;
+    tpuCurf(c, "# TYPE tpurm_device_health gauge\n");
+    for (uint32_t i = 0; i < n; i++)
+        tpuCurf(c, "tpurm_device_health{dev=\"%u\"} %u\n", i,
+                tpurmDeviceHealthState(i));
+    tpuCurf(c, "# TYPE tpurm_device_health_score gauge\n");
+    for (uint32_t i = 0; i < n; i++)
+        tpuCurf(c, "tpurm_device_health_score{dev=\"%u\"} %llu\n", i,
+                (unsigned long long)tpurmDeviceHealthScore(i));
+}
+
+/* /proc/driver/tpurm/health table. */
+void tpurmHealthRenderTable(TpuCur *c)
+{
+    uint32_t n = tpurmDeviceCount();
+    if (n > HEALTH_MAX_DEVICES)
+        n = HEALTH_MAX_DEVICES;
+    tpuCurf(c, "%-4s %-11s %-8s %-6s %-6s  %s\n", "dev", "state",
+            "score", "trans", "evac", "events");
+    for (uint32_t i = 0; i < n; i++) {
+        TpuHealthInfo hi;
+        if (tpurmHealthInfo(i, &hi) != TPU_OK)
+            continue;
+        tpuCurf(c, "%-4u %-11s %-8llu %-6llu ", i,
+                tpurmHealthStateName(hi.state),
+                (unsigned long long)hi.score,
+                (unsigned long long)hi.transitions);
+        if (hi.evacPending)
+            tpuCurf(c, "->%-4u ", hi.evacTarget);
+        else
+            tpuCurf(c, "%-6s ", "-");
+        for (uint32_t e = 0; e < TPU_HEALTH_EV_COUNT; e++)
+            if (hi.events[e])
+                tpuCurf(c, " %s=%llu", g_eventNames[e],
+                        (unsigned long long)hi.events[e]);
+        tpuCurf(c, "\n");
+    }
+    tpuCurf(c, "\nvac: txns_active=%u requests=%llu acks=%llu "
+            "grace_expired=%llu commits=%llu aborts=%llu "
+            "pages_moved=%llu\n",
+            tpurmVacActive(),
+            (unsigned long long)tpurmCounterGet("vac_requests"),
+            (unsigned long long)tpurmCounterGet("vac_acks"),
+            (unsigned long long)tpurmCounterGet("vac_grace_expired"),
+            (unsigned long long)tpurmCounterGet("vac_commits"),
+            (unsigned long long)tpurmCounterGet("vac_aborts"),
+            (unsigned long long)tpurmCounterGet("vac_pages_moved"));
+}

@@ -17,6 +17,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/ce.h"
+#include "tpurm/health.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
 #include "tpurm/memring.h"
@@ -199,10 +200,19 @@ uint32_t tpuIciRetrainAll(void)
     for (uint32_t d = 0; d < g_ici.count; d++) {
         /* Admin link failures are sticky "until reset" — this IS the
          * reset: FAILED drops to DOWN so the training pass below can
-         * bring the link back (matching tpuIciResetLink per link). */
-        for (uint32_t l = 0; l < g_ici.linkCount[d]; l++)
-            if (g_ici.links[d][l].state == TPU_ICI_LINK_FAILED)
-                g_ici.links[d][l].state = TPU_ICI_LINK_DOWN;
+         * bring the link back (matching tpuIciResetLink per link).
+         * Flap HISTORY clears too, on EVERY link: a post-reset link
+         * must not inherit pre-reset softFail hysteresis (the lazy-
+         * retrain backoff window, and the health scorer's flap
+         * attribution) into its fresh life — the reset is the clean
+         * slate the "sticky until reset" doctrine promises. */
+        for (uint32_t l = 0; l < g_ici.linkCount[d]; l++) {
+            IciLink *lk = &g_ici.links[d][l];
+            if (lk->state == TPU_ICI_LINK_FAILED)
+                lk->state = TPU_ICI_LINK_DOWN;
+            lk->softFail = false;
+            lk->failedAtNs = 0;
+        }
     }
     for (uint32_t d = 0; d < g_ici.count; d++) {
         train_links_locked(d);
@@ -234,6 +244,8 @@ TpuStatus tpuIciInjectLinkFailure(uint32_t devInst, uint32_t link)
         back->failedAtNs = l->failedAtNs;
         back->errorCount++;
     }
+    tpurmHealthNote(devInst, TPU_HEALTH_EV_LINK_FLAP);
+    tpurmHealthNote(l->peerInst, TPU_HEALTH_EV_LINK_FLAP);
     tpuLog(TPU_LOG_WARN, "ici", "link %u.%u -> %u FAILED (injected)",
            devInst, link, l->peerInst);
     pthread_mutex_unlock(&g_ici.lock);
@@ -264,6 +276,11 @@ static void ici_flap_route_locked(uint32_t src, uint32_t dst)
         back->errorCount++;
     }
     tpuCounterAdd("ici_link_flaps", 1);
+    /* Both endpoints of a flapped link take the health hit: the scorer
+     * cannot know which chip's SerDes is at fault, and evacuating
+     * either end routes around the link. */
+    tpurmHealthNote(src, TPU_HEALTH_EV_LINK_FLAP);
+    tpurmHealthNote(next, TPU_HEALTH_EV_LINK_FLAP);
     tpuLog(TPU_LOG_WARN, "ici", "link flap (injected): %u -> %u FAILED",
            src, next);
 }
@@ -292,6 +309,7 @@ static uint32_t ici_retrain_soft_locked(bool force)
                 /* Retrain itself failed: stay FAILED, re-arm backoff. */
                 l->failedAtNs = now;
                 tpuCounterAdd("ici_retrain_failures", 1);
+                tpurmHealthNote(d, TPU_HEALTH_EV_RETRAIN_FAIL);
                 tpuLog(TPU_LOG_WARN, "ici",
                        "retrain FAILED for link %u -> %u (%s)", d,
                        l->peerInst,

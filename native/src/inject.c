@@ -54,6 +54,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "ce.copy",
     "sched.admit",
     "reset.device",
+    "vac.migrate",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -69,6 +70,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "CE_COPY",
     "SCHED_ADMIT",
     "RESET_DEVICE",
+    "VAC_MIGRATE",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)

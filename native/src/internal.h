@@ -356,6 +356,18 @@ bool tpurmBrokerIsRemoteFd(int fd);
 /* Heartbeat round trip (stale-client reaper: registry
  * broker_heartbeat_timeout_ms). */
 int  tpurmBrokerPing(void);
+/* Forward an evacuation request (BR_OP_VAC) to the engine host.
+ * TPU_ERR_NOT_SUPPORTED when this process is not a broker client —
+ * the caller falls back to the in-process tpurmHealthEvacRequest. */
+TpuStatus tpurmBrokerVacRequest(uint32_t devInst, uint32_t target);
+
+/* ------------------------------------------------------------- tpuvac
+ *
+ * Render hooks for the health subsystem (health.c; public surface in
+ * tpurm/health.h). */
+
+void tpurmHealthRenderProm(TpuCur *c);
+void tpurmHealthRenderTable(TpuCur *c);
 
 /* ------------------------------------------------- robust channel RC */
 

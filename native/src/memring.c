@@ -56,6 +56,7 @@
 #include <unistd.h>
 
 #include "internal.h"
+#include "tpurm/health.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
 #include "tpurm/reset.h"
@@ -473,6 +474,9 @@ static inline TpuStatus mr_gen_fence(TpuStatus st, uint64_t *bytes,
     if (claimGen && claimGen != tpurmDeviceGeneration()) {
         *bytes = 0;
         tpuCounterAdd("memring_stale_completions", 1);
+        /* Health: a fenced zombie means an op HUNG across a reset on
+         * the compute device — attributable sickness, not chaos. */
+        tpurmHealthNote(0, TPU_HEALTH_EV_STALE_COMPLETION);
         return TPU_ERR_DEVICE_RESET;
     }
     return st;
@@ -840,6 +844,7 @@ static bool sqe_deadline_expired(const TpuMemringSqe *sqe, uint64_t now)
 {
     if (sqe->deadlineNs && now > sqe->deadlineNs) {
         tpuCounterAdd("memring_deadline_expired", 1);
+        tpurmHealthNote(sqe->devInst, TPU_HEALTH_EV_DEADLINE_EXPIRED);
         return true;
     }
     return false;
@@ -2218,8 +2223,12 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
         switch (rung) {
         case 1:
             /* A lost wake is the cheapest wedge: re-ring the doorbell
-             * (fence and dep waits ride the same futex now). */
+             * (fence and dep waits ride the same futex now).  Only
+             * THIS nudge feeds the health score — the queued-idle
+             * nudge above is a producer-side dependency stall, not
+             * device sickness. */
             tpuCounterAdd("tpurm_watchdog_nudges", 1);
+            tpurmHealthNote(0, TPU_HEALTH_EV_WD_NUDGE);
             atomic_fetch_add(&r->hdr->doorbell, 1);
             mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
             break;

@@ -177,6 +177,14 @@ static void render_metrics(TpuCur *c)
         return;
     c->off += tpurmTraceRenderProm(c->buf + c->off, c->cap - c->off);
     uvmTenantRenderProm(c);
+    tpurmHealthRenderProm(c);
+}
+
+/* Per-device health table (tpuvac): state machine, decayed score,
+ * event breakdown, pending evacuations, manifest counters. */
+static void render_health(TpuCur *c)
+{
+    tpurmHealthRenderTable(c);
 }
 
 /* Tenant QoS table: id, priority, per-tier usage vs quota. */
@@ -226,6 +234,8 @@ static void render_reset(TpuCur *c)
     tpuCurf(c, "reclaimed_clients:        %llu\n",
             (unsigned long long)
                 tpurmCounterGet("broker_reclaimed_clients"));
+    tpuCurf(c, "watchdog_evacuations:     %llu\n",
+            (unsigned long long)st.watchdogEvacuations);
 }
 
 /* ---------------------------------------------------------- node table */
@@ -248,6 +258,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm/metrics", render_metrics, false },
     { "driver/tpurm/tenants", render_tenants, false },
     { "driver/tpurm/reset", render_reset, false },
+    { "driver/tpurm/health", render_health, false },
 };
 
 #define N_NODES (sizeof(g_nodes) / sizeof(g_nodes[0]))

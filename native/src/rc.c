@@ -28,6 +28,7 @@
  */
 #define _GNU_SOURCE
 #include "internal.h"
+#include "tpurm/health.h"
 #include "tpurm/msgq.h"
 #include "uvm/uvm_internal.h"   /* uvmMonotonicNs */
 #include "tpurm/reset.h"
@@ -297,11 +298,19 @@ uint32_t tpuRcRecoverAll(void)
         TpurmDevice *dev = tpurmDeviceGet(i);
         if (!dev)
             continue;
+        uint32_t devCleared = 0;
         for (uint32_t c = 0; c < dev->cePoolSize; c++) {
             if (tpurmChannelErrorPending(dev->cePool[c])) {
                 tpurmChannelResetError(dev->cePool[c]);
-                cleared++;
+                devCleared++;
             }
+        }
+        if (devCleared) {
+            /* Health attribution: the latched errors happened on THIS
+             * device's CE pool — one note per recovery pass (not per
+             * latch: a burst of latches is one sickness episode). */
+            tpurmHealthNote(i, TPU_HEALTH_EV_RC_RESET);
+            cleared += devCleared;
         }
     }
     if (cleared) {

@@ -42,6 +42,7 @@
 #include <time.h>
 
 #include "internal.h"
+#include "tpurm/health.h"
 #include "tpurm/inject.h"
 #include "tpurm/rdma.h"
 #include "tpurm/trace.h"
@@ -126,6 +127,10 @@ static TpuStatus reset_locked(void)
     tpuCounterAdd("tpurm_reset_mttr_ns", t2 - t0);
     if (tSpan)
         tpurmTraceEnd(TPU_TRACE_RESET_DEVICE, tSpan, gen, t2 - t0);
+    /* Health scoring: a full reset is the strongest sickness signal a
+     * chip can emit.  The reset is process-global but the compute
+     * device (instance 0) is the one whose tenants blacked out. */
+    tpurmHealthNote(0, TPU_HEALTH_EV_DEVICE_RESET);
     tpuLog(TPU_LOG_WARN, "reset",
            "full-device reset complete: gen=%llu mttr=%llu us "
            "(quiesce %llu us%s, %u latch(es), %u link(s) active, "
@@ -179,6 +184,8 @@ void tpurmResetStats(TpuResetStats *out)
     out->watchdogNudges = tpurmCounterGet("tpurm_watchdog_nudges");
     out->watchdogRcResets = tpurmCounterGet("tpurm_watchdog_rc_resets");
     out->watchdogDeviceResets = atomic_load(&g_reset.wdDeviceResets);
+    out->watchdogEvacuations =
+        tpurmCounterGet("tpurm_watchdog_evacuations");
     out->lastMttrNs = atomic_load(&g_reset.lastMttrNs);
     out->lastQuiesceNs = atomic_load(&g_reset.lastQuiesceNs);
     out->lastRestoreNs = atomic_load(&g_reset.lastRestoreNs);
@@ -193,6 +200,14 @@ void tpurmResetStats(TpuResetStats *out)
 static void *reset_watchdog_thread(void *arg)
 {
     (void)arg;
+    /* Rung-3 deferral state: the memring scan reports rung 3 ONCE per
+     * hang episode (the rung then saturates so a still-hung op cannot
+     * storm resets).  When the EVACUATE rung absorbs that one report,
+     * the pending device reset is carried here across ticks until the
+     * evacuation resolves — acked, failed, or grace-expired — and then
+     * performed: the evacuation saves the tenants, the reset still
+     * recovers the wedge. */
+    bool evacDeferred = false;
     for (;;) {
         uint64_t periodMs = tpuRegistryGet("reset_watchdog_period_ms",
                                            100);
@@ -214,16 +229,38 @@ static void *reset_watchdog_thread(void *arg)
             tpurmDeviceReset();
         }
 
+        /* Health bookkeeping rides the same tick: score decay and
+         * hysteretic demotion, health-driven EVACUATE posting for
+         * chips that crossed the EVACUATING threshold, and grace
+         * expiry of un-acked requests (tpurm/health.h). */
+        tpurmHealthTick();
+
         /* Hung-op ladder over the memring pools.  Rung 3 lands here
-         * (the ring layer cannot call up into the reset engine). */
+         * (the ring layer cannot call up into the reset engine) — but
+         * the EVACUATE rung sits between RC reset and device reset:
+         * when a sick device can shed its tenants onto a healthy peer
+         * with headroom, the watchdog posts the evacuation and gives
+         * the serving layer the grace window instead of blacking out
+         * every tenant on the chip.  An expired un-acked request makes
+         * the next rung-3 scan fall through to the full reset. */
         uint64_t hangNs = tpuRegistryGet("reset_hang_timeout_ms",
                                          5000) * 1000000ull;
-        if (tpurmMemringWatchdogScan(hangNs) >= 3) {
-            atomic_fetch_add(&g_reset.wdDeviceResets, 1);
-            tpuCounterAdd("tpurm_watchdog_device_resets", 1);
-            tpuLog(TPU_LOG_ERROR, "reset",
-                   "watchdog escalation rung 3: full-device reset");
-            tpurmDeviceReset();
+        if (tpurmMemringWatchdogScan(hangNs) >= 3 || evacDeferred) {
+            if (tpurmHealthEvacLadderRung()) {
+                if (!evacDeferred)
+                    tpuLog(TPU_LOG_WARN, "reset",
+                           "watchdog escalation rung 2.5: EVACUATE "
+                           "(deferring device reset for the grace "
+                           "window)");
+                evacDeferred = true;
+            } else {
+                evacDeferred = false;
+                atomic_fetch_add(&g_reset.wdDeviceResets, 1);
+                tpuCounterAdd("tpurm_watchdog_device_resets", 1);
+                tpuLog(TPU_LOG_ERROR, "reset",
+                       "watchdog escalation rung 3: full-device reset");
+                tpurmDeviceReset();
+            }
         }
     }
     return NULL;
@@ -237,7 +274,7 @@ static void reset_wd_start_once(void)
         g_reset.wdReady = true;
         tpuLog(TPU_LOG_INFO, "reset",
                "hung-op watchdog ready (ladder: nudge -> RC reset -> "
-               "device reset)");
+               "evacuate -> device reset)");
     } else {
         tpuLog(TPU_LOG_ERROR, "reset", "watchdog thread create failed");
     }

@@ -87,6 +87,7 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "sched.preempt",          "sched"   },
     { "reset.device",           "reset"   },
     { "reset.quiesce",          "reset"   },
+    { "vac.migrate",            "vac"     },
     { "app.span",               "app"     },
     { "inject.hit",             "inject"  },
     { "recover.retry",          "recover" },
@@ -94,6 +95,7 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "recover.quarantine",     "recover" },
     { "recover.rc_reset",       "recover" },
     { "recover.retrain",        "recover" },
+    { "health.transition",      "health"  },
 };
 
 /* Per-site latency histograms (~60 KB each, BSS; pages materialize on

@@ -31,6 +31,7 @@
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
+#include "tpurm/health.h"
 #include "tpurm/inject.h"
 #include "tpurm/memring.h"
 #include "tpurm/trace.h"
@@ -979,6 +980,7 @@ static void service_cancel(UvmFaultEntry *e)
              * retry (service_with_retry) and is now quarantined on the
              * poison mapping. */
             tpuCounterAdd("recover_page_quarantines", 1);
+            tpurmHealthNote(0, TPU_HEALTH_EV_PAGE_QUARANTINE);
             tpurmTraceInstant(TPU_TRACE_RECOVER_QUARANTINE, pageAddr, ps);
             tpuLog(TPU_LOG_WARN, "uvm",
                    "page 0x%llx quarantined (%s)",

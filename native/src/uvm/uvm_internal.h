@@ -408,6 +408,8 @@ typedef struct UvmRangeGroup {
  * paths charge without taking the table lock. */
 #define UVM_MAX_TENANTS 64
 
+#define UVM_TENANT_MAX_DEVS 16
+
 typedef struct UvmTenant {
     uint32_t id;
     /* priority/quotas are _Atomic because reconfiguration is allowed
@@ -417,6 +419,13 @@ typedef struct UvmTenant {
     _Atomic uint32_t priority;        /* higher = keep longer */
     _Atomic uint64_t quotaPages[UVM_TIER_COUNT];   /* 0 = unlimited */
     _Atomic uint64_t usedPages[UVM_TIER_COUNT];
+    /* Per-DEVICE HBM page charge (tpuvac): which chip's arena holds
+     * this tenant's pages.  Charged explicitly by the pools that place
+     * pages on a specific device (the ICI KV pool via
+     * uvmTenantDevCharge / uvmTenantRebindDevicePages) — a live
+     * migration rebinds the charge from the source chip to the target
+     * without touching the per-tier totals. */
+    _Atomic uint64_t devPages[UVM_TENANT_MAX_DEVS];
     bool used;
 } UvmTenant;
 

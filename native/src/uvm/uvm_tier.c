@@ -157,6 +157,24 @@ TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
                                  outHandle);
 }
 
+/* Arena occupancy (tpuvac target headroom check: an evacuation target
+ * must have real free HBM before pages are pointed at it).  Reads the
+ * PMM's allocated-bytes ledger — no lock beyond the PMM's own. */
+TpuStatus uvmHbmArenaUsage(uint32_t devInst, uint64_t *freeBytes,
+                           uint64_t *totalBytes)
+{
+    UvmTierArena *a = uvmTierArenaHbm(devInst);
+    if (!a)
+        return TPU_ERR_INVALID_DEVICE;
+    uint64_t total = a->size;
+    uint64_t used = uvmPmmAllocatedBytes(&a->pmm);
+    if (freeBytes)
+        *freeBytes = used > total ? 0 : total - used;
+    if (totalBytes)
+        *totalBytes = total;
+    return TPU_OK;
+}
+
 TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle)
 {
     UvmTierArena *a = uvmTierArenaHbm(devInst);

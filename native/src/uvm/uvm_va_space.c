@@ -198,6 +198,54 @@ TpuStatus uvmVaSpaceBindTenant(UvmVaSpace *vs, uint32_t tenantId)
     return TPU_OK;
 }
 
+void uvmTenantDevCharge(uint32_t tenantId, uint32_t devInst,
+                        int64_t pages)
+{
+    if (devInst >= UVM_TENANT_MAX_DEVS || pages == 0)
+        return;
+    UvmTenant *t = uvmTenantGet(tenantId);
+    if (!t)
+        return;
+    atomic_fetch_add_explicit(&t->devPages[devInst], (uint64_t)pages,
+                              memory_order_relaxed);
+}
+
+TpuStatus uvmTenantRebindDevicePages(uint32_t tenantId, uint32_t fromDev,
+                                     uint32_t toDev, uint64_t pages)
+{
+    if (fromDev >= UVM_TENANT_MAX_DEVS || toDev >= UVM_TENANT_MAX_DEVS ||
+        fromDev == toDev)
+        return TPU_ERR_INVALID_ARGUMENT;
+    UvmTenant *t = uvmTenantGet(tenantId);
+    if (!t)
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    /* Clamp to what the source column actually charges: a rebind must
+     * never drive a gauge negative (racing releases are fine — the
+     * loser of the race just moves fewer pages). */
+    uint64_t have = atomic_load_explicit(&t->devPages[fromDev],
+                                         memory_order_relaxed);
+    if (pages > have)
+        pages = have;
+    if (pages) {
+        atomic_fetch_sub_explicit(&t->devPages[fromDev], pages,
+                                  memory_order_relaxed);
+        atomic_fetch_add_explicit(&t->devPages[toDev], pages,
+                                  memory_order_relaxed);
+    }
+    tpuCounterAdd("tpurm_tenant_rebinds", 1);
+    return TPU_OK;
+}
+
+uint64_t uvmTenantDevPages(uint32_t tenantId, uint32_t devInst)
+{
+    if (devInst >= UVM_TENANT_MAX_DEVS)
+        return 0;
+    UvmTenant *t = uvmTenantGet(tenantId);
+    return t ? atomic_load_explicit(&t->devPages[devInst],
+                                    memory_order_relaxed)
+             : 0;
+}
+
 void uvmTenantRenderProm(TpuCur *c)
 {
     static const char *tierName[UVM_TIER_COUNT] = { "host", "hbm",
@@ -217,6 +265,14 @@ void uvmTenantRenderProm(TpuCur *c)
                     "tier=\"%s\"} %llu\n", t->id, tierName[tier],
                     (unsigned long long)atomic_load_explicit(
                         &t->quotaPages[tier], memory_order_relaxed));
+        }
+        for (uint32_t d = 0; d < UVM_TENANT_MAX_DEVS; d++) {
+            uint64_t p = atomic_load_explicit(&t->devPages[d],
+                                              memory_order_relaxed);
+            if (p)
+                tpuCurf(c, "tpurm_tenant_dev_pages{tenant=\"%u\","
+                        "dev=\"%u\"} %llu\n", t->id, d,
+                        (unsigned long long)p);
         }
     }
 }

@@ -1,0 +1,327 @@
+/*
+ * tpuvac test: health-scorer hysteresis (promotion at threshold,
+ * demotion only after decay + quiet hold), evacuation-target picking
+ * (healthy peers with HBM headroom only), manifest commit/abort
+ * (generation fencing, target death, clean abort), and the watchdog
+ * ladder's EVACUATE rung ordering (evacuation offered BEFORE the
+ * full-device reset; grace expiry falls through to the reset).
+ *
+ * Run with TPUMEM_FAKE_TPU_COUNT=4 (the Makefile does): target picking
+ * and manifests need peers.
+ */
+#define _GNU_SOURCE
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "tpurm/health.h"
+#include "tpurm/memring.h"
+#include "tpurm/reset.h"
+#include "tpurm/status.h"
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+/* Internal registry surface (internal.h): runtime TPUMEM_* flips must
+ * go through tpuRegistrySet (serializes against watchdog polls). */
+void tpuRegistrySet(const char *key, const char *value);
+
+static uint64_t now_ns(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+static void sleep_ms(unsigned ms)
+{
+    struct timespec ts = { .tv_sec = ms / 1000,
+                           .tv_nsec = (long)(ms % 1000) * 1000000L };
+    nanosleep(&ts, NULL);
+}
+
+static void clear_all(void)
+{
+    for (uint32_t d = 0; d < tpurmDeviceCount(); d++)
+        tpurmHealthClear(d);
+}
+
+/* ---- 1. scorer hysteresis ----------------------------------------- */
+
+static int test_hysteresis(void)
+{
+    /* Fast decay so demotion is testable: 50 ms half-life, 60 ms quiet
+     * hold, default thresholds (500 / 1000). */
+    tpuRegistrySet("TPUMEM_VAC_HEALTH_HALFLIFE_MS", "50");
+    tpuRegistrySet("TPUMEM_VAC_HEALTH_HOLD_MS", "60");
+    clear_all();
+
+    CHECK(tpurmDeviceHealthState(1) == TPU_HEALTH_HEALTHY);
+    /* One transient (a link flap, 200 points) never leaves HEALTHY. */
+    tpurmHealthNote(1, TPU_HEALTH_EV_LINK_FLAP);
+    CHECK(tpurmDeviceHealthState(1) == TPU_HEALTH_HEALTHY);
+    tpurmHealthClear(1);        /* don't let the flap's 200 linger into
+                                 * the threshold arithmetic below */
+
+    /* A quarantine burst crosses DEGRADED (2x400 >= 500)... */
+    tpurmHealthNote(1, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    tpurmHealthNote(1, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    CHECK(tpurmDeviceHealthState(1) == TPU_HEALTH_DEGRADED);
+    /* ...and sustained trouble crosses EVACUATING (>= 1000). */
+    tpurmHealthNote(1, TPU_HEALTH_EV_RC_RESET);
+    tpurmHealthNote(1, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    CHECK(tpurmDeviceHealthState(1) == TPU_HEALTH_EVACUATING);
+
+    TpuHealthInfo hi;
+    CHECK(tpurmHealthInfo(1, &hi) == TPU_OK);
+    CHECK(hi.events[TPU_HEALTH_EV_PAGE_QUARANTINE] == 3);
+    CHECK(hi.events[TPU_HEALTH_EV_RC_RESET] == 1);
+    CHECK(hi.transitions >= 2);         /* H->D, D->E */
+    CHECK(hi.score >= 1000);
+
+    /* Hysteresis: the state holds while events are recent, then steps
+     * down one level at a time as the score decays through HALF the
+     * thresholds.  10 half-lives + the hold window is plenty. */
+    uint64_t deadline = now_ns() + 5ull * 1000000000ull;
+    while (tpurmDeviceHealthState(1) != TPU_HEALTH_HEALTHY &&
+           now_ns() < deadline) {
+        CHECK(tpurmHealthInfo(1, &hi) == TPU_OK);   /* drives decay */
+        sleep_ms(20);
+    }
+    CHECK(tpurmDeviceHealthState(1) == TPU_HEALTH_HEALTHY);
+    CHECK(tpurmHealthInfo(1, &hi) == TPU_OK);
+    CHECK(hi.transitions >= 4);         /* ...E->D, D->H */
+
+    /* Clear wipes score, history and state. */
+    tpurmHealthNote(1, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    tpurmHealthClear(1);
+    CHECK(tpurmDeviceHealthScore(1) == 0);
+    CHECK(tpurmHealthInfo(1, &hi) == TPU_OK);
+    CHECK(hi.events[TPU_HEALTH_EV_PAGE_QUARANTINE] == 0);
+
+    tpuRegistrySet("TPUMEM_VAC_HEALTH_HALFLIFE_MS", NULL);
+    tpuRegistrySet("TPUMEM_VAC_HEALTH_HOLD_MS", NULL);
+    printf("health hysteresis OK\n");
+    return 0;
+}
+
+/* ---- 2. target picking -------------------------------------------- */
+
+static int test_pick_target(void)
+{
+    clear_all();
+    uint32_t t = ~0u;
+    /* Healthy fleet: the nearest peer wins (ring: 0's neighbors). */
+    CHECK(tpurmHealthPickTarget(0, &t) == TPU_OK);
+    CHECK(t != 0 && t < tpurmDeviceCount());
+
+    /* A DEGRADED peer is never a target. */
+    tpurmHealthNote(t, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    tpurmHealthNote(t, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    CHECK(tpurmDeviceHealthState(t) == TPU_HEALTH_DEGRADED);
+    uint32_t t2 = ~0u;
+    CHECK(tpurmHealthPickTarget(0, &t2) == TPU_OK);
+    CHECK(t2 != t);
+
+    /* A LOST peer is never a target. */
+    tpurmDeviceSetLost(tpurmDeviceGet(t2), 1);
+    uint32_t t3 = ~0u;
+    CHECK(tpurmHealthPickTarget(0, &t3) == TPU_OK);
+    CHECK(t3 != t && t3 != t2);
+    tpurmDeviceSetLost(tpurmDeviceGet(t2), 0);
+
+    /* Headroom gate: demanding more free arena than can exist leaves
+     * no viable target. */
+    tpuRegistrySet("TPUMEM_VAC_HEADROOM_PCT", "101");
+    uint32_t t4 = ~0u;
+    CHECK(tpurmHealthPickTarget(0, &t4) == TPU_ERR_OBJECT_NOT_FOUND);
+    tpuRegistrySet("TPUMEM_VAC_HEADROOM_PCT", NULL);
+
+    /* The arena-usage probe itself reports sane numbers. */
+    uint64_t freeB = 0, totalB = 0;
+    CHECK(uvmHbmArenaUsage(0, &freeB, &totalB) == TPU_OK);
+    CHECK(totalB > 0 && freeB <= totalB);
+
+    clear_all();
+    printf("evacuation target picking OK\n");
+    return 0;
+}
+
+/* ---- 3. manifest commit / abort ----------------------------------- */
+
+static int test_manifest(void)
+{
+    clear_all();
+    uint64_t commits0 = tpurmCounterGet("vac_commits");
+    uint64_t aborts0 = tpurmCounterGet("vac_aborts");
+
+    /* Clean move: begin -> commit. */
+    uint64_t txn = 0;
+    CHECK(tpurmVacBegin(0, 1, &txn) == TPU_OK);
+    CHECK(tpurmVacActive() == 1);
+    CHECK(tpurmVacCommit(txn) == TPU_OK);
+    CHECK(tpurmVacActive() == 0);
+    CHECK(tpurmCounterGet("vac_commits") == commits0 + 1);
+
+    /* Generation fencing: a full-device reset under the migration
+     * rejects the commit — the caller must abort to the source. */
+    CHECK(tpurmVacBegin(0, 1, &txn) == TPU_OK);
+    CHECK(tpurmDeviceReset() == TPU_OK);
+    CHECK(tpurmVacCommit(txn) == TPU_ERR_DEVICE_RESET);
+    CHECK(tpurmVacActive() == 1);       /* rejected commit stays open */
+    CHECK(tpurmVacAbort(txn) == TPU_OK);
+    CHECK(tpurmVacActive() == 0);
+    CHECK(tpurmCounterGet("vac_aborts") == aborts0 + 1);
+
+    /* Target death mid-migration: commit rejects with GPU_IS_LOST. */
+    CHECK(tpurmVacBegin(0, 2, &txn) == TPU_OK);
+    tpurmDeviceSetLost(tpurmDeviceGet(2), 1);
+    CHECK(tpurmVacCommit(txn) == TPU_ERR_GPU_IS_LOST);
+    CHECK(tpurmVacAbort(txn) == TPU_OK);
+    tpurmDeviceSetLost(tpurmDeviceGet(2), 0);
+
+    /* Begin refuses a dead endpoint outright. */
+    tpurmDeviceSetLost(tpurmDeviceGet(3), 1);
+    CHECK(tpurmVacBegin(0, 3, &txn) == TPU_ERR_GPU_IS_LOST);
+    tpurmDeviceSetLost(tpurmDeviceGet(3), 0);
+    CHECK(tpurmVacBegin(0, 0, &txn) == TPU_ERR_INVALID_ARGUMENT);
+    CHECK(tpurmVacCommit(12345) == TPU_ERR_OBJECT_NOT_FOUND);
+
+    clear_all();                        /* the reset noted dev 0 */
+    printf("manifest commit/abort OK\n");
+    return 0;
+}
+
+/* ---- 4. rendezvous + ladder rung ordering ------------------------- */
+
+static TpuMemringSqe sqe_nop_delay(uint64_t cookie, uint64_t delayNs)
+{
+    TpuMemringSqe s;
+    memset(&s, 0, sizeof(s));
+    s.opcode = TPU_MEMRING_OP_NOP;
+    s.userData = cookie;
+    s.arg1 = delayNs;
+    return s;
+}
+
+static int test_ladder(void)
+{
+    /* Fast watchdog, short grace: rung cadence nudge ~60 ms, RC reset
+     * ~80 ms, EVACUATE ~100 ms, grace 150 ms, reset after expiry. */
+    tpuRegistrySet("TPUMEM_RESET_WATCHDOG_PERIOD_MS", "20");
+    tpuRegistrySet("TPUMEM_RESET_HANG_TIMEOUT_MS", "40");
+    tpuRegistrySet("TPUMEM_RESET_QUIESCE_TIMEOUT_MS", "50");
+    tpuRegistrySet("TPUMEM_VAC_GRACE_MS", "150");
+    clear_all();
+
+    /* The sick chip: dev 0 DEGRADED on real evidence, peers healthy —
+     * the EVACUATE rung has both a cause and a target. */
+    tpurmHealthNote(0, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    tpurmHealthNote(0, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    CHECK(tpurmDeviceHealthState(0) == TPU_HEALTH_DEGRADED);
+
+    TpuResetStats before, st;
+    tpurmResetStats(&before);
+    tpurmResetWatchdogStart();
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(NULL, 8, 1, &r) == TPU_OK);
+    TpuMemringSqe hung = sqe_nop_delay(901, 2500ull * 1000000ull);
+    CHECK(tpurmMemringPrep(r, &hung) == TPU_OK);
+    CHECK(tpurmMemringSubmit(r) == 1);
+
+    /* Rung ordering: the EVACUATE request must be posted BEFORE any
+     * watchdog device reset. */
+    uint64_t deadline = now_ns() + 10ull * 1000000000ull;
+    do {
+        sleep_ms(10);
+        tpurmResetStats(&st);
+    } while (st.watchdogEvacuations == before.watchdogEvacuations &&
+             st.watchdogDeviceResets == before.watchdogDeviceResets &&
+             now_ns() < deadline);
+    CHECK(st.watchdogEvacuations == before.watchdogEvacuations + 1);
+    CHECK(st.watchdogDeviceResets == before.watchdogDeviceResets);
+    CHECK(st.watchdogNudges > before.watchdogNudges);
+    CHECK(st.watchdogRcResets > before.watchdogRcResets);
+
+    /* The rendezvous carries a target and a token. */
+    uint32_t target = ~0u;
+    uint64_t reqId = 0;
+    CHECK(tpurmHealthEvacPending(0, &target, &reqId));
+    CHECK(target != 0 && target < tpurmDeviceCount());
+    CHECK(reqId != 0);
+
+    /* Nobody acks: the grace window expires and the NEXT rung-3 scan
+     * falls through to the full-device reset. */
+    deadline = now_ns() + 10ull * 1000000000ull;
+    do {
+        sleep_ms(10);
+        tpurmResetStats(&st);
+    } while (st.watchdogDeviceResets == before.watchdogDeviceResets &&
+             now_ns() < deadline);
+    /* >=: the op stays hung after the reset, so the saturated ladder
+     * may land another reset before this sample. */
+    CHECK(st.watchdogDeviceResets >= before.watchdogDeviceResets + 1);
+    CHECK(tpurmCounterGet("vac_grace_expired") >= 1);
+    CHECK(!tpurmHealthEvacPending(0, NULL, NULL));
+
+    CHECK(tpurmMemringWaitDrain(r, 10ull * 1000000000ull) == TPU_OK);
+    TpuMemringCqe cqe;
+    CHECK(tpurmMemringReap(r, &cqe, 1) == 1);
+    CHECK(cqe.status == TPU_ERR_DEVICE_RESET);   /* fenced zombie */
+    tpurmMemringDestroy(r);
+
+    /* Ack path: a fresh operator request, served and ACKED, clears the
+     * device's health history (the tenant left the chip). */
+    clear_all();
+    tpurmHealthNote(2, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    CHECK(tpurmHealthEvacRequest(2, 3) == TPU_OK);
+    CHECK(tpurmHealthEvacRequest(2, 3) == TPU_ERR_INVALID_STATE);
+    CHECK(tpurmHealthEvacPending(2, &target, &reqId));
+    CHECK(target == 3);
+    CHECK(tpurmHealthEvacAck(2, reqId + 1, true) ==
+          TPU_ERR_INVALID_ARGUMENT);             /* wrong token */
+    CHECK(tpurmHealthEvacAck(2, reqId, true) == TPU_OK);
+    CHECK(!tpurmHealthEvacPending(2, NULL, NULL));
+    CHECK(tpurmDeviceHealthScore(2) == 0);
+
+    tpuRegistrySet("TPUMEM_RESET_WATCHDOG_PERIOD_MS", NULL);
+    tpuRegistrySet("TPUMEM_RESET_HANG_TIMEOUT_MS", NULL);
+    tpuRegistrySet("TPUMEM_RESET_QUIESCE_TIMEOUT_MS", NULL);
+    tpuRegistrySet("TPUMEM_VAC_GRACE_MS", NULL);
+    clear_all();
+    printf("EVACUATE rung ordering + rendezvous OK\n");
+    return 0;
+}
+
+int main(void)
+{
+    /* Quiet watchdog during the deterministic phases (re-armed with
+     * fast knobs inside test_ladder). */
+    tpuRegistrySet("TPUMEM_RESET_HANG_TIMEOUT_MS", "60000");
+    if (tpurmDeviceCount() < 4) {
+        fprintf(stderr,
+                "vac_test needs TPUMEM_FAKE_TPU_COUNT=4 (have %u)\n",
+                tpurmDeviceCount());
+        return 1;
+    }
+    if (test_hysteresis())
+        return 1;
+    if (test_pick_target())
+        return 1;
+    if (test_manifest())
+        return 1;
+    if (test_ladder())
+        return 1;
+    printf("vac_test OK\n");
+    return 0;
+}

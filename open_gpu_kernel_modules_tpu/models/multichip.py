@@ -47,7 +47,8 @@ class IciPoolBacking:
     """
 
     def __init__(self, pool_shape: Tuple[int, ...], np_dtype: np.dtype,
-                 page_bytes: int, n_devices: int, staging_records: int = 8):
+                 page_bytes: int, n_devices: int, staging_records: int = 8,
+                 tenant_of_page=None):
         self.pool_shape = pool_shape
         self.np_dtype = np_dtype
         self.page_bytes = page_bytes
@@ -68,6 +69,10 @@ class IciPoolBacking:
         lib.uvmHbmChunkAlloc.restype = u32
         lib.uvmHbmChunkFree.argtypes = [u32, vp]
         lib.uvmHbmChunkFree.restype = u32
+        lib.uvmTenantDevCharge.argtypes = [u32, u32, ctypes.c_int64]
+        lib.uvmTenantDevCharge.restype = None
+        lib.uvmTenantRebindDevicePages.argtypes = [u32, u32, u32, u64]
+        lib.uvmTenantRebindDevicePages.restype = u32
 
         # Home assignment: round-robin so every group's working set
         # spreads across the pool (reference: fabric-wide striping).
@@ -82,27 +87,48 @@ class IciPoolBacking:
                 (ctypes.c_char * size).from_address(base), np.uint8))
 
         ici._lib()  # bind + lazy topology init
-        self._apertures: Dict[int, ici.PeerAperture] = {}
+        self._apertures: Dict[Tuple[int, int], ici.PeerAperture] = {}
+        # Optional page -> tenant map (tpuvac charge rebinds); None
+        # charges everything to the default tenant (0).
+        self.tenant_of_page = tenant_of_page
         self.stats = {"ici_fetch_records": 0, "ici_flush_records": 0,
-                      "ici_bytes": 0}
+                      "ici_bytes": 0, "rehomed_records": 0,
+                      "rehome_aborts": 0}
 
         # PMM-allocated record per page on its home device (+ zeroed:
-        # arena chunks may hold a previous tenant's bytes).
-        self._chunks: List[Tuple[int, ctypes.c_void_p]] = []
+        # arena chunks may hold a previous tenant's bytes).  Each
+        # page's chunk is tracked INDIVIDUALLY (page -> (dev, handle))
+        # so tpuvac can re-home a page — allocate on the target, flip
+        # the maps, free the source chunk — without disturbing its
+        # neighbors.  Per-device tenant charges mirror the placement
+        # (uvmTenantDevCharge; a re-home REBINDS the charge).
+        self._page_chunk: Dict[int, Tuple[int, ctypes.c_void_p]] = {}
+        self._staging_chunks: List[ctypes.c_void_p] = []
+        self._page_tenant: Dict[int, int] = {}
         self.home_offset = np.zeros(self.total_pages, np.int64)
         try:
             for p in range(self.total_pages):
                 d = int(self.home[p])
-                self.home_offset[p] = self._chunk_alloc(d)
-                self._rec_raw(d, int(self.home_offset[p]))[:] = 0
+                off, handle = self._chunk_alloc_raw(d)
+                self._page_chunk[p] = (d, handle)
+                self.home_offset[p] = off
+                self._rec_raw(d, off)[:] = 0
+                if tenant_of_page:
+                    self._page_tenant[p] = int(tenant_of_page(p))
+                lib.uvmTenantDevCharge(self._tenant_of(p), d, 1)
             self.staging_records = staging_records
-            self._staging = [self._chunk_alloc(0)
-                             for _ in range(staging_records)]
+            self._staging = []
+            for _ in range(staging_records):
+                off, handle = self._chunk_alloc_raw(0)
+                self._staging.append(off)
+                self._staging_chunks.append(handle)
         except Exception:
             self.close()
             raise
 
-    def _chunk_alloc(self, dev: int) -> int:
+    def _chunk_alloc_raw(self, dev: int) -> Tuple[int, ctypes.c_void_p]:
+        """One record-sized PMM chunk on ``dev`` — NOT tracked in
+        ``_chunks`` (tpuvac stages target records it may abort)."""
         off = ctypes.c_uint64()
         handle = ctypes.c_void_p()
         st = self._lib.uvmHbmChunkAlloc(dev, self.record_bytes,
@@ -113,17 +139,32 @@ class IciPoolBacking:
                 f"uvmHbmChunkAlloc(dev={dev}, {self.record_bytes}B) "
                 f"failed: 0x{st:x} (arena too small? raise "
                 f"TPUMEM_FAKE_HBM_MB)")
-        self._chunks.append((dev, handle))
-        return off.value
+        return off.value, handle
+
+    def _tenant_of(self, page: int) -> int:
+        return self._page_tenant.get(page, 0)
+
+    def set_page_tenant(self, page: int, tenant: int) -> None:
+        """Move the page's per-device charge to ``tenant`` (tpusched
+        calls this when a sequence slot changes hands between tenants;
+        charges always track what was actually charged, so a re-home
+        or close uncharges the right column)."""
+        old = self._page_tenant.get(page, 0)
+        if old == tenant:
+            return
+        dev = int(self.home[page])
+        self._lib.uvmTenantDevCharge(old, dev, -1)
+        self._lib.uvmTenantDevCharge(tenant, dev, 1)
+        self._page_tenant[page] = tenant
 
     def _rec_raw(self, dev: int, offset: int) -> np.ndarray:
         return self._arena[dev][offset:offset + self.record_bytes]
 
-    def _aperture(self, peer: int) -> ici.PeerAperture:
-        ap = self._apertures.get(peer)
+    def _aperture(self, peer: int, src: int = 0) -> ici.PeerAperture:
+        ap = self._apertures.get((src, peer))
         if ap is None:
-            ap = ici.PeerAperture(0, peer)
-            self._apertures[peer] = ap
+            ap = ici.PeerAperture(src, peer)
+            self._apertures[(src, peer)] = ap
         return ap
 
     def _rec_view(self, dev: int, offset: int) -> np.ndarray:
@@ -175,13 +216,64 @@ class IciPoolBacking:
         self.stats["ici_flush_records"] += 1
         self.stats["ici_bytes"] += self.record_bytes
 
+    # --------------------------------------------------- tpuvac re-homing
+    #
+    # The MECHANISM half of live migration: allocate a record on the
+    # target chip, flip the page's home maps, free the source chunk.
+    # The PROTOCOL half (manifest transaction, PEER_COPY shipping with
+    # dep joins, inject-site retry/abort, verification, charge rebind
+    # ordering) lives in uvm/vac.py — this class never ships bytes for
+    # a re-home itself.
+
+    def pages_homed(self, dev: int, pages=None) -> List[int]:
+        """Pages whose record lives on ``dev`` (optionally restricted
+        to a candidate list) — the evacuation working set."""
+        cand = range(self.total_pages) if pages is None else pages
+        return [int(p) for p in cand if int(self.home[p]) == dev]
+
+    def stage_rehome(self, page: int,
+                     dst: int) -> Tuple[int, ctypes.c_void_p]:
+        """Allocate the page's target-side record (untracked: the
+        caller commits or aborts it)."""
+        if int(self.home[page]) == dst:
+            raise ValueError(f"page {page} already homed on {dst}")
+        return self._chunk_alloc_raw(dst)
+
+    def commit_rehome(self, page: int, dst: int, off: int,
+                      handle: ctypes.c_void_p) -> None:
+        """Flip the page's home to the (already shipped) target record
+        and free the source chunk.  Called only AFTER the manifest
+        committed — from here on the target is the page's truth."""
+        src, old_handle = self._page_chunk[page]
+        self._page_chunk[page] = (dst, handle)
+        self.home[page] = dst
+        self.home_offset[page] = off
+        self._lib.uvmTenantRebindDevicePages(self._tenant_of(page),
+                                             src, dst, 1)
+        self._lib.uvmHbmChunkFree(src, old_handle)
+        self.stats["rehomed_records"] += 1
+
+    def abort_rehome(self, dst: int, handle: ctypes.c_void_p) -> None:
+        """Release a staged target record; the source stays the truth."""
+        self._lib.uvmHbmChunkFree(dst, handle)
+        self.stats["rehome_aborts"] += 1
+
+    def record_raw(self, dev: int, offset: int) -> np.ndarray:
+        """Raw record bytes at (dev, offset) — vac.py verifies shipped
+        records against the source through this."""
+        return self._rec_raw(dev, offset)
+
     def close(self) -> None:
         for ap in self._apertures.values():
             ap.close()
         self._apertures.clear()
-        for dev, handle in self._chunks:
+        for page, (dev, handle) in self._page_chunk.items():
             self._lib.uvmHbmChunkFree(dev, handle)
-        self._chunks.clear()
+            self._lib.uvmTenantDevCharge(self._tenant_of(page), dev, -1)
+        self._page_chunk.clear()
+        for handle in self._staging_chunks:
+            self._lib.uvmHbmChunkFree(0, handle)
+        self._staging_chunks.clear()
 
     # ------------------------------------------------- introspection
 
@@ -196,7 +288,8 @@ class IciPoolBacking:
 
 
 def make_multichip_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
-                         page_size: int, oversub: int, n_devices: int):
+                         page_size: int, oversub: int, n_devices: int,
+                         tenant_of_page=None):
     """TieredKVCache whose backing is the ICI peer-mapped HBM pool."""
     from .serving import TieredKVCache
 
@@ -206,6 +299,7 @@ def make_multichip_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
                   cfg.head_dim)
     page_bytes = (page_size * cfg.num_kv_heads * cfg.head_dim *
                   np_dtype.itemsize)
-    backing = IciPoolBacking(pool_shape, np_dtype, page_bytes, n_devices)
+    backing = IciPoolBacking(pool_shape, np_dtype, page_bytes, n_devices,
+                             tenant_of_page=tenant_of_page)
     return TieredKVCache(cfg, batch, max_len, page_size=page_size,
                          oversub=oversub, backing=backing)

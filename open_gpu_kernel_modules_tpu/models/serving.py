@@ -933,6 +933,20 @@ class TieredKVCache:
             page_table=jnp.asarray(table),
             seq_lens=jnp.asarray(self.seq_lens[np.array(seq_ids)]))
 
+    def pages_of(self, b: int, new_tokens: int = 0) -> List[int]:
+        """Logical pages sequence ``b`` covers at its current length
+        (plus ``new_tokens`` of projected growth) — the COVERED working
+        set the scheduler's slot projections count against (always at
+        least one page, the activation floor).  NOTE: a chip evacuation
+        ships something different — every record HOMED on the chip
+        (IciPoolBacking.pages_homed), including a sequence's
+        not-yet-written growth pages, which must move with it or later
+        decode would write them back onto the sick chip."""
+        P, m = self.page_size, self.pages_per_seq
+        n = min(m, max(1, (int(self.seq_lens[b]) + new_tokens + P - 1)
+                       // P))
+        return list(range(b * m, b * m + n))
+
     def set_last_tokens_dev(self, seq_ids: Sequence[int],
                             toks: jax.Array) -> None:
         """Park the group's last tokens ON DEVICE (no materialization;

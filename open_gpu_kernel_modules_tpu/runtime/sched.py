@@ -237,7 +237,12 @@ class Scheduler:
                       "restored": 0, "rounds": 0, "cancelled": 0,
                       "admit_retries": 0, "admit_sheds": 0,
                       "round_errors": 0, "decoded_tokens": 0,
-                      "device_resets_observed": 0}
+                      "device_resets_observed": 0,
+                      "evacuations": 0, "evac_aborts": 0,
+                      "evac_pages_moved": 0}
+        # Per-evacuation blackout windows (park -> manifest commit), in
+        # seconds — the bench's vac_blackout_ms_p50/p95 source.
+        self.evac_blackouts_s: List[float] = []
 
     # ------------------------------------------------------------ tenants
 
@@ -324,9 +329,10 @@ class Scheduler:
                           (min(length, self.max_len) + P - 1) // P))
 
     def _seq_pages(self, req: Request) -> int:
-        """Projected device pages req needs for ONE more round."""
-        return self._pages_for(int(self.cache.seq_lens[req.seq]) +
-                               self.tokens_per_round)
+        """Projected device pages req needs for ONE more round (the
+        cache's covered-working-set walker is the single source of
+        truth for the page arithmetic)."""
+        return len(self.cache.pages_of(req.seq, self.tokens_per_round))
 
     def _projected_pages(self, extra: int = 0) -> int:
         return sum(self._seq_pages(r) for r in self._running.values()) \
@@ -587,6 +593,13 @@ class Scheduler:
         seq = self._free_seqs.pop(0)
         req.seq = seq
         self.cache.seq_lens[seq] = 0
+        # Multichip pool: the slot's pages now charge to this tenant's
+        # per-device columns (tpuvac rebinds them on migration).
+        backing = self.cache.backing
+        if hasattr(backing, "set_page_tenant"):
+            m = self.cache.pages_per_seq
+            for pg in range(m):
+                backing.set_page_tenant(seq * m + pg, req.tenant)
         try:
             serving.prefill_group(self.cfg, self.params, self.cache,
                                   [seq], jnp.asarray(req.prompt[None, :]))
@@ -685,12 +698,124 @@ class Scheduler:
             if req is not None:
                 self._preempt(req)
 
+    # ------------------------------------------------------- evacuation
+
+    def _multichip_backing(self):
+        """The cache's backing when it is a multichip (per-device-homed)
+        pool — the only backing a chip evacuation applies to."""
+        b = self.cache.backing
+        return b if hasattr(b, "pages_homed") else None
+
+    def evacuate_device(self, src: int, dst: Optional[int] = None,
+                        tenant: Optional[int] = None):
+        """Drain-and-migrate: move KV page records homed on chip
+        ``src`` to ``dst`` while co-tenants keep decoding.
+
+        The DRAIN half: every RUNNING sequence owning an affected page
+        is preempted through the existing keep_len path (dirty slots
+        flush, victim-ring entries materialize — the backing becomes
+        authoritative for the moving pages).  The MIGRATE half is
+        vac.migrate_pages: a generation-stamped manifest brackets
+        PEER_COPY shipping on the spine (dep-joined windows, the
+        vac.migrate inject site, byte verification), and the home maps
+        flip only after the manifest COMMITS.  The parked sequences
+        then restore over the next rounds reading from the new home —
+        token-exact by the same preempt/restore bit-identity guarantee
+        the reset path rides.
+
+        On abort (target death, fabric partition, a reset under the
+        migration, inject exhaustion) the source was never touched:
+        this returns None and the parked sequences resume ON THE
+        SOURCE with zero corruption.  ``tenant`` restricts the move to
+        one tenant's sequences (planned tenant move); default
+        evacuates every page homed on the chip (fault evacuation).
+        Returns the vac.MigrationReport, or None when the move aborted
+        (or nothing was homed on ``src``)."""
+        from ..uvm import vac as _vac
+
+        backing = self._multichip_backing()
+        if backing is None:
+            raise ValueError("evacuation needs a multichip backing "
+                             "(models.multichip.IciPoolBacking)")
+        if dst is None:
+            dst = _vac.pick_target(src)
+            if dst is None:
+                raise RuntimeError(
+                    f"no viable evacuation target for device {src} "
+                    f"(no healthy peer with HBM headroom)")
+        m = self.cache.pages_per_seq
+        cand = None
+        if tenant is not None:
+            seqs = [r.seq for r in list(self._running.values()) +
+                    self._preempted
+                    if r.tenant == tenant and r.seq is not None]
+            cand = [s * m + pg for s in seqs for pg in range(m)]
+        pages = backing.pages_homed(src, cand)
+        if not pages:
+            return None
+
+        t0 = time.perf_counter()
+        affected = {p // m for p in pages}
+        for seq, req in list(self._running.items()):
+            if seq in affected:
+                self._preempt(req)
+        try:
+            rep = _vac.migrate_pages(backing, src, dst, pages)
+        except (_vac.VacAbort, native.RmError, RuntimeError):
+            # VacAbort is the protocol's own abort; RmError/RuntimeError
+            # cover failures migrate_pages turns into the same clean
+            # abort (target-side allocation exhaustion, a PEER_COPY
+            # error CQE).  Zero corruption by construction either way:
+            # the source mapping was never touched, so the parked
+            # sequences restore from it over the next rounds as if this
+            # were a plain preemption.
+            self.stats["evac_aborts"] += 1
+            _counter_add("tpusched_evac_aborts")
+            return None
+        blackout = time.perf_counter() - t0
+        self.evac_blackouts_s.append(blackout)
+        self.stats["evacuations"] += 1
+        self.stats["evac_pages_moved"] += rep.pages
+        _counter_add("tpusched_evacuations")
+        return rep
+
+    def _check_evacuation(self) -> None:
+        """Poll the native evacuation rendezvous (tpurm/health.h): the
+        watchdog's EVACUATE rung or an operator planned move posted a
+        request for some chip — serve it inside the grace window and
+        ack, or ack failure so the ladder can escalate.  Non-multichip
+        backings ignore requests (they hold no per-chip pages; the
+        request expires to the ladder)."""
+        backing = self._multichip_backing()
+        if backing is None:
+            return
+        from ..uvm import vac as _vac
+
+        for dev in range(backing.n_devices):
+            pending = _vac.evac_pending(dev)
+            if pending is None:
+                continue
+            target, req_id = pending
+            try:
+                rep = self.evacuate_device(
+                    dev, None if target == _vac.AUTO_TARGET else target)
+                ok = True        # rep None + no pages = nothing to move
+                if rep is None and backing.pages_homed(dev):
+                    ok = False   # aborted with pages still on the chip
+            except (native.RmError, RuntimeError, ValueError):
+                ok = False
+            try:
+                _vac.evac_ack(dev, req_id, ok)
+            except native.RmError:
+                pass             # request expired under us: ladder owns it
+
     def step(self) -> Dict[str, int]:
         """One scheduling round: admit/restore, fit-check (preempting
         SLO-ordered victims if decode growth outgrew the pool), ONE
         batched decode dispatch, retire.  Returns live counts."""
         with _span("sched.round", obj=self.stats["rounds"]):
             self._check_generation()
+            self._check_evacuation()
             self._try_admissions()
             # Evicts staged by preempts fuse into the next restore's
             # chain; once no restore can ever consume them, publish

@@ -38,6 +38,7 @@ class Site(enum.IntEnum):
     CE_COPY = 8          # tpuce stripe submission (per attempt)
     SCHED_ADMIT = 9      # tpusched admission decision (per pass)
     RESET_DEVICE = 10    # forced full-device reset (per watchdog tick)
+    VAC_MIGRATE = 11     # tpuvac record shipping (per copy attempt)
 
 
 class Mode(enum.IntEnum):
@@ -85,6 +86,11 @@ DETAIL_COUNTERS = (
     "tpurm_watchdog_nudges",
     "tpurm_watchdog_rc_resets",
     "tpurm_watchdog_device_resets",
+    "tpurm_watchdog_evacuations",
+    "vac_inject_retries",
+    "vac_inject_aborts",
+    "vac_commits",
+    "vac_aborts",
     "memring_stale_completions",
     "memring_deadline_expired",
     "tpuce_stale_completions",

@@ -325,14 +325,19 @@ class MemRing:
 
     def peer_copy(self, dev: int, peer: int, local_off: int,
                   peer_off: int, length: int, read: bool = False,
-                  user_data: int = 0, link: bool = False) -> int:
+                  user_data: int = 0, link: bool = False,
+                  deps=None) -> int:
         """Stage an ICI peer copy between HBM arena offsets
-        (write: local->peer; ``read=True``: peer->local)."""
+        (write: local->peer; ``read=True``: peer->local).  ``deps``
+        carries up to 4 :func:`dep` handles — the tpuvac migration
+        engine uses an ordered dep on the previous shipping window so
+        page records land in manifest order without claiming the whole
+        window as one LINK chain."""
         s = _Sqe(opcode=Op.PEER_COPY, flags=SQE_LINK if link else 0,
                  devInst=dev, peerInst=peer, addr=local_off,
                  peerOff=peer_off, len=length, userData=user_data,
                  arg0=1 if read else 0)
-        return self._prep(s)
+        return self._prep(s, deps)
 
     def fence(self, user_data: int = 0) -> int:
         """Stage a fence: completes only after every previously

@@ -38,6 +38,7 @@ class _Stats(ctypes.Structure):
         ("watchdogNudges", ctypes.c_uint64),
         ("watchdogRcResets", ctypes.c_uint64),
         ("watchdogDeviceResets", ctypes.c_uint64),
+        ("watchdogEvacuations", ctypes.c_uint64),
         ("lastMttrNs", ctypes.c_uint64),
         ("lastQuiesceNs", ctypes.c_uint64),
         ("lastRestoreNs", ctypes.c_uint64),
@@ -57,6 +58,7 @@ class ResetStats:
     watchdog_nudges: int
     watchdog_rc_resets: int
     watchdog_device_resets: int
+    watchdog_evacuations: int
     last_mttr_ns: int
     last_quiesce_ns: int
     last_restore_ns: int
@@ -117,6 +119,7 @@ def stats() -> ResetStats:
         watchdog_nudges=raw.watchdogNudges,
         watchdog_rc_resets=raw.watchdogRcResets,
         watchdog_device_resets=raw.watchdogDeviceResets,
+        watchdog_evacuations=raw.watchdogEvacuations,
         last_mttr_ns=raw.lastMttrNs,
         last_quiesce_ns=raw.lastQuiesceNs,
         last_restore_ns=raw.lastRestoreNs,

@@ -103,6 +103,194 @@ print(json.dumps(mc))
 """
 
 
+_EVAC_SCRIPT = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+import os
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+
+from open_gpu_kernel_modules_tpu.models import llama, multichip
+from open_gpu_kernel_modules_tpu.runtime import sched
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, reset, vac
+from open_gpu_kernel_modules_tpu import utils
+
+cfg = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=64)
+cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+params = llama.init_params(cfg, jax.random.key(0))
+rng = np.random.default_rng(11)
+prompts = [rng.integers(0, 128, size=12) for _ in range(6)]
+TENANT = [1, 1, 1, 2, 2, 2]          # tenant 1 = victim, 2 = co-tenant
+
+
+def build():
+    cache = multichip.make_multichip_cache(cfg, batch=6, max_len=64,
+                                           page_size=8, oversub=2,
+                                           n_devices=4)
+    s = sched.Scheduler(cfg, params, max_seqs=6, max_len=64, page_size=8,
+                        oversub=2, tokens_per_round=4, cache=cache)
+    s.configure_tenant(1, priority=100)
+    s.configure_tenant(2, priority=120)
+    reqs = [s.submit(p, max_new_tokens=24, tenant=t)
+            for p, t in zip(prompts, TENANT)]
+    return s, reqs
+
+
+def finish(s, reqs):
+    rounds = 0
+    while not s.idle and rounds < 5000:
+        s.step()
+        rounds += 1
+    toks = {r.rid: r.tokens.tolist() for r in reqs
+            if r.state is sched.RequestState.FINISHED}
+    states = {r.rid: r.state.value for r in reqs}
+    return toks, states
+
+
+# ---- solo reference: same workload, no evacuation, no injection ------
+s, reqs = build()
+ref_toks, ref_states = finish(s, reqs)
+s.close()
+
+out = {}
+
+# ---- evacuated run: ALL 12 sites armed, 3 evacuations + 1 abort ------
+inj.set_seed(1234)
+for site in inj.Site:
+    inj.enable(site, inj.Mode.PPM, 5000)     # 0.5%% chaos floor
+s, reqs = build()
+backing = s.cache.backing
+for _ in range(3):
+    s.step()
+
+# 1) PLANNED move mid-decode: everything homed on chip 1 -> chip 2.
+rep1 = s.evacuate_device(1, 2)
+assert rep1 is not None and rep1.pages > 0, rep1
+assert backing.pages_homed(1) == []
+out["planned_pages"] = rep1.pages
+s.step(); s.step()
+
+# 2) FORCED MID-MIGRATION ABORT: the vac.migrate site fires through the
+#    whole retry budget; the move 2->3 aborts back to the source with
+#    the source mapping untouched.
+homed2 = list(backing.pages_homed(2))
+inj.enable(inj.Site.VAC_MIGRATE, inj.Mode.PPM, 1000000, burst=64)
+rep2 = s.evacuate_device(2, 3)
+inj.enable(inj.Site.VAC_MIGRATE, inj.Mode.PPM, 5000)   # back to floor
+assert rep2 is None, rep2
+assert backing.pages_homed(2) == homed2    # zero movement on abort
+s.step(); s.step()
+
+# 2b) Second PLANNED move (chip 0's records onto the chip 1 arena the
+#     first move emptied) — three successful evacuations total.
+rep3 = s.evacuate_device(0, 1)
+assert rep3 is not None and rep3.pages > 0, rep3
+assert backing.pages_homed(0) == []
+s.step(); s.step()
+
+# 3) WATCHDOG-TRIGGERED: chip 3's health crosses EVACUATING on synthetic
+#    evidence; the reset watchdog's health tick posts the EVACUATE
+#    request and the scheduler serves it from its round poll.
+reset.watchdog_start()
+for dev in range(3):
+    vac.clear(dev)                 # chaos flap notes must not starve
+for _ in range(4):                 # the target pick of HEALTHY peers
+    vac.note(3, vac.Event.PAGE_QUARANTINE)
+assert vac.state(3) == vac.HealthState.EVACUATING
+evacs0 = s.stats["evacuations"]
+deadline = time.time() + 30.0
+while s.stats["evacuations"] == evacs0 and time.time() < deadline:
+    s.step()
+    time.sleep(0.02)
+assert s.stats["evacuations"] > evacs0, s.stats
+assert backing.pages_homed(3) == []
+out["watchdog_evacuations"] = reset.stats().watchdog_evacuations
+
+toks, states = finish(s, reqs)
+inj.disable_all()
+
+out["stats"] = {k: s.stats[k] for k in
+                ("evacuations", "evac_aborts", "evac_pages_moved",
+                 "device_resets_observed")}
+out["blackouts_ms"] = [round(1e3 * b, 3) for b in s.evac_blackouts_s]
+out["states"] = states
+out["ref_states"] = ref_states
+out["tokens_identical"] = (sorted(toks) == sorted(ref_toks) and
+                           all(toks[r] == ref_toks[r] for r in ref_toks))
+ev, hits = inj.counts(inj.Site.VAC_MIGRATE)
+out["vac_site"] = {"evals": ev, "hits": hits,
+                   "retries": utils.counter("vac_inject_retries"),
+                   "aborts": utils.counter("vac_inject_aborts")}
+out["vac_counters"] = {n: utils.counter(n) for n in
+                       ("vac_commits", "vac_aborts", "vac_pages_moved",
+                        "vac_txn_begins")}
+out["txns_open"] = vac.txns_active()
+# Tenant charges rebound with the pages: every chip's per-tenant charge
+# columns (uvmTenantDevPages) must sum to exactly the records homed
+# there — a charge-rebind ordering bug in commit_rehome would break
+# the equality on the evacuated chips.
+import ctypes
+lib = backing._lib
+lib.uvmTenantDevPages.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
+lib.uvmTenantDevPages.restype = ctypes.c_uint64
+out["charge_matches_homes"] = {
+    d: {"charged": sum(lib.uvmTenantDevPages(t, d) for t in (0, 1, 2)),
+        "homed": len(backing.pages_homed(d))}
+    for d in range(4)}
+s.close()
+print(json.dumps(out))
+"""
+
+
+def test_multichip_evacuation_token_exact():
+    """tpuvac acceptance: decode streams are token-exact through >= 3
+    evacuations (planned moves + a watchdog/health-triggered one) with
+    ALL 12 inject sites armed, including a forced mid-migration abort
+    that resumes on the source with zero corruption; the vac.migrate
+    site reconciles exactly (hits == vac_inject_retries +
+    vac_inject_aborts) and no manifest leaks open."""
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    env["TPUMEM_FAKE_HBM_MB"] = "64"
+    script = _EVAC_SCRIPT % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Zero token corruption through the whole choreography, and every
+    # stream reached a terminal state.
+    assert out["tokens_identical"], out
+    assert set(out["states"].values()) == {"finished"}, out["states"]
+
+    # >= 3 successful evacuations (2 planned + >= 1 watchdog-triggered)
+    # and exactly the one forced abort; every blackout was measured.
+    st = out["stats"]
+    assert st["evacuations"] >= 3, st
+    assert st["evac_aborts"] >= 1, st
+    assert out["watchdog_evacuations"] >= 1, out
+    assert len(out["blackouts_ms"]) == st["evacuations"]
+    assert all(b > 0 for b in out["blackouts_ms"])
+
+    # Exact inject reconciliation and transactional hygiene: every
+    # vac.migrate hit became a retry or an abort, every begin resolved
+    # (commit or abort), nothing left open.
+    vs = out["vac_site"]
+    assert vs["hits"] == vs["retries"] + vs["aborts"], vs
+    vc = out["vac_counters"]
+    assert vc["vac_txn_begins"] == vc["vac_commits"] + vc["vac_aborts"]
+    assert vc["vac_pages_moved"] > 0
+    assert out["txns_open"] == 0
+
+    # Per-device tenant charges rebound with every move: each chip's
+    # charged columns equal the records actually homed there.
+    for d, row in out["charge_matches_homes"].items():
+        assert row["charged"] == row["homed"], (d, row)
+
+
 def test_multichip_decode_with_link_failure():
     env = dict(os.environ)
     env["TPUMEM_FAKE_TPU_COUNT"] = "4"

@@ -181,7 +181,7 @@ for b in bufs:
 out["phase0_counters"] = inj.recovery_counters()
 out["phase0_evals"] = {k: v[0] for k, v in inj.stats().items()}
 
-# ---------------- phase 1: chaos at 1%% across 7 sites ---------------
+# -------------- phase 1: chaos at 1%% across 10 sites ----------------
 # Tracing ARMED for the whole chaos window: the soak must stay
 # corruption-free with every site emitting, every injected fault must
 # surface as an instant event, and every recovery-counter increment
@@ -194,7 +194,8 @@ inj.set_seed(42)
 SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MSGQ_PUBLISH, inj.Site.ICI_LINK,
          inj.Site.RDMA_COMPLETION, inj.Site.FENCE_TIMEOUT,
-         inj.Site.MEMRING_SUBMIT, inj.Site.CE_COPY]
+         inj.Site.MEMRING_SUBMIT, inj.Site.CE_COPY,
+         inj.Site.VAC_MIGRATE]
 for s in SITES:
     inj.enable(s, inj.Mode.PPM, 10000)
 # The reset.device site fires on the watchdog tick (100 ms period, so
@@ -396,6 +397,17 @@ out["reset"] = {
 ap.close()
 lib.uvmHbmChunkFree(0, h0)
 lib.uvmHbmChunkFree(1, h1)
+# vac.migrate reconciliation (12th site, armed for the whole window):
+# this actor mix runs no migrations, so the invariant must hold at
+# exactly zero on all three counts — an armed-but-unevaluated site
+# costs nothing and leaks nothing.
+vm_evals, vm_hits = inj.counts(inj.Site.VAC_MIGRATE)
+out["vac_migrate"] = {
+    "evals": vm_evals,
+    "hits": vm_hits,
+    "retries": utils.counter("vac_inject_retries"),
+    "aborts": utils.counter("vac_inject_aborts"),
+}
 out["errors"] = errors
 out["tolerated"] = tolerated["n"]
 
@@ -566,7 +578,7 @@ out = {}
 ref_toks, ref_states, ref_rep = run_once()
 out["ref_states"] = ref_states
 
-# Chaos across ALL ELEVEN sites (fixed seed), scheduler and the
+# Chaos across ALL TWELVE sites (fixed seed), scheduler and the
 # full-device reset path included, plus >= 3 FORCED resets mid-decode.
 # The big engine soak runs at 1%%; this workload is orders of magnitude
 # smaller (a few thousand evaluations), so 5%% keeps several sites
@@ -597,6 +609,10 @@ out["rep"] = {k: rep[k] for k in
 out["live"] = {}
 out["hits"] = {k: v[1] for k, v in inj.stats().items()}
 out["sched_admit_evals"] = inj.counts(inj.Site.SCHED_ADMIT)[0]
+# 12th site armed with the rest: a single-chip managed backing runs no
+# migrations, so the vac.migrate invariant holds at exactly zero.
+_vm_evals, _vm_hits = inj.counts(inj.Site.VAC_MIGRATE)
+out["vac_migrate"] = {"evals": _vm_evals, "hits": _vm_hits}
 from open_gpu_kernel_modules_tpu import utils as _utils
 out["spine"] = {
     "internal_sqes": _utils.counter("memring_internal_sqes"),
@@ -611,7 +627,7 @@ print(json.dumps(out))
 
 def test_sched_soak_injection():
     """Chaos soak, scheduler actor: streams admitted AND cancelled
-    under injection across ALL 11 sites (~5% here — this workload is
+    under injection across ALL 12 sites (~5% here — this workload is
     orders of magnitude smaller than the engine soak's, so 1% would
     barely fire) WITH >= 3 forced full-device resets mid-decode.
     Acceptance: zero token corruption (every stream that finishes
@@ -664,6 +680,12 @@ def test_sched_soak_injection():
     assert sp["internal_sqes"] == (sp["fault"] + sp["tier"] +
                                    sp["ici"] + sp["migrate"]), sp
     assert sp["fault"] > 0, sp
+
+    # 12th site (vac.migrate) was armed with the rest; the managed
+    # backing runs no chip migrations, so its exact reconciliation
+    # holds at zero (armed-but-unevaluated costs and leaks nothing).
+    vm = out["vac_migrate"]
+    assert vm["evals"] == 0 and vm["hits"] == 0, vm
 
 
 _CLIENT_KILL = r"""
@@ -861,6 +883,13 @@ def test_engine_soak_injection():
                                    sp["ici"] + sp["migrate"]), sp
     assert sp["fault"] > 0 and sp["migrate"] > 0, sp
     assert sp["ici"] > 0, sp
+
+    # vac.migrate (12th site) reconciliation: armed alongside the rest
+    # for the whole window, zero evaluations in this actor mix — the
+    # exact invariant (hits == retries + aborts) holds at zero.
+    vm = out["vac_migrate"]
+    assert vm["evals"] == 0 and vm["hits"] == 0, vm
+    assert vm["retries"] == 0 and vm["aborts"] == 0, vm
 
     # tpuce rode the chaos: stripes flowed (splits grew), the ce.copy
     # site fired, and the reconciliation is EXACT — every hit became a
