@@ -1168,14 +1168,44 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
         ch0 = _ce.stats()
     except Exception:
         pass
+    from open_gpu_kernel_modules_tpu import utils as _utils
+    slo_by_level = {}
+    p99_token_blame = {}
     for n in levels:
+        # tpuflow isolation per level: the per-tenant SLO histograms
+        # are process-global, so each level reads its own ledger.
+        _utils.flow_reset()
+        top = n == max(levels)
         s = tpusched.Scheduler(cfg, params, max_seqs=16, max_len=256,
                                page_size=64, oversub=2,
-                               tokens_per_round=tpr)
-        for _ in range(n):
+                               tokens_per_round=tpr,
+                               blame_tokens=top)
+        for i in range(n):
+            # Two tenants split the stream population: the sweep now
+            # reports TTFT/ITL percentiles and the blame decomposition
+            # PER TENANT (Orca/vLLM-style per-class latency lens).
             s.submit(rng.integers(0, cfg.vocab_size, size=prompt_len),
-                     max_new_tokens=max_new)
+                     max_new_tokens=max_new, tenant=i % 2)
         rep = s.run()
+        slo_by_level[str(n)] = rep.get("slo", {})
+        if top and s.token_blame:
+            # The p99 TOKEN's blame: take the token at the p99 of the
+            # stall-inclusive ITL samples and decompose its emission
+            # gap into the buckets charged inside it.  `coverage` is
+            # the accepted fraction of that token's wall the buckets
+            # explain (acceptance: >= 0.9).
+            recs = sorted(s.token_blame, key=lambda r: r["itl_ns"])
+            tok = recs[min(int(0.99 * len(recs)), len(recs) - 1)]
+            blamed = sum(tok["blame_ns"].values())
+            p99_token_blame = {
+                "itl_ms": round(tok["itl_ns"] / 1e6, 3),
+                "gap_ms": round(tok["gap_ns"] / 1e6, 3),
+                "tenant": tok["tenant"],
+                "blame_ms": {k: round(v / 1e6, 3)
+                             for k, v in tok["blame_ns"].items()},
+                "coverage": round(blamed / tok["gap_ns"], 3)
+                if tok["gap_ns"] else 0.0,
+            }
         s.close()
         agg[str(n)] = rep["agg_toks_per_s"]
         p99[str(n)] = rep["p99_token_ms"]
@@ -1211,6 +1241,12 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
         # sequential; the batch amortizes each dispatch).
         "serve_scaling_vs_sequential": round(agg[hi] / agg[lo], 2)
         if agg.get(lo) else 0.0,
+        # tpuflow: per-tenant TTFT / inter-token-latency percentiles
+        # and accumulated blame per level, plus the p99 token's blame
+        # decomposition at max concurrency (where did its milliseconds
+        # go: queued / preempted / fault / copy / ici / reset).
+        "serve_slo_by_tenant": slo_by_level,
+        "serve_p99_token_blame": p99_token_blame,
     }
 
 

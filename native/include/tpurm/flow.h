@@ -1,0 +1,165 @@
+/*
+ * tpuflow — request-flow causal tracing with per-tenant SLO
+ * attribution.
+ *
+ * A FLOW is one serving request's identity, minted at scheduler
+ * admission and carried through every layer the request's bytes
+ * touch: the 128-byte memring SQE (spare-byte flowId field), tpuce
+ * stripes (CopySeg flow stamp), ICI PEER_COPY hops (hop counter
+ * bumped per store-and-forward hop), fault-service entries
+ * (UvmFaultEntry.flow, captured from the faulting thread), and tpuvac
+ * migration windows.  Reference analog: the channel-tracked causal
+ * state uvm_tracker.c threads through every push — a (channel, value)
+ * pair IS a causal edge; tpuflow gives the same edge a serving-level
+ * identity so a p99 token stall can be attributed to queueing vs
+ * preemption vs fault service vs copy stripes vs an evacuation
+ * window.
+ *
+ * Flow-id ABI (one u64):
+ *
+ *      63            48 47                    16 15            0
+ *     +----------------+------------------------+---------------+
+ *     |   tenant (16)  |      request (32)      |    hop (16)   |
+ *     +----------------+------------------------+---------------+
+ *
+ * The hop field counts propagation hops (ICI store-and-forward legs,
+ * vac shipping windows); every table/SLO keying masks it off
+ * (TPU_FLOW_KEY), so hops of one request land on one ledger while
+ * staying distinguishable in the Perfetto export.
+ *
+ * Two ledgers hang off the flow:
+ *
+ *   blame buckets — wall time split into queued / preempted /
+ *       fault-service / copy / ici-ship / reset-blackout, accumulated
+ *       as spans close: the memring exec layer accounts copy/ici per
+ *       executed SQE (merged runs split by each SQE's len share), the
+ *       fault engine accounts CPU demand-fault service, and the
+ *       scheduler accounts the states only it can see (queued wait,
+ *       preemption parks, reset blackouts) through tpurmFlowAccount.
+ *       Invariant (chaos-soak-checked): a closed flow's bucket sum
+ *       never exceeds its wall time beyond executor concurrency (two
+ *       workers of one flow can overlap; the scheduler's flows are
+ *       seconds against milliseconds of buckets).
+ *
+ *   per-tenant SLO histograms — TTFT (submit -> first token) and ITL
+ *       (inter-token latency), fed from sched.py through the existing
+ *       trace-hist machinery (log-linear, <= ~0.8% relative error).
+ *       Exposed as tpurm_slo_ttft_ns{tenant=} /
+ *       tpurm_slo_itl_ns{tenant=} histogram families and
+ *       tpurm_slo_blame_ns{tenant=,bucket=} counters in the
+ *       Prometheus exposition, /proc/driver/tpurm/flows (live top-K
+ *       slow flows), and utils.flow_report() on the Python side.
+ *
+ * Fast-path discipline: a zero flow id costs one register test at
+ * every instrumented site (the SQE field is zero-initialized); only
+ * flow-carrying work pays the (relaxed-atomic) ledger adds.
+ */
+#ifndef TPURM_FLOW_H
+#define TPURM_FLOW_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ------------------------------------------------------------- flow id */
+
+#define TPU_FLOW_HOP_BITS 16
+#define TPU_FLOW_REQ_SHIFT 16
+#define TPU_FLOW_TENANT_SHIFT 48
+#define TPU_FLOW_KEY_MASK (~0xFFFFull)
+
+#define TPU_FLOW_MAKE(tenant, request)                                   \
+    ((((uint64_t)(tenant) & 0xFFFFull) << TPU_FLOW_TENANT_SHIFT) |       \
+     (((uint64_t)(request) & 0xFFFFFFFFull) << TPU_FLOW_REQ_SHIFT))
+#define TPU_FLOW_TENANT(f) ((uint32_t)((f) >> TPU_FLOW_TENANT_SHIFT))
+#define TPU_FLOW_REQUEST(f) ((uint32_t)(((f) >> TPU_FLOW_REQ_SHIFT) & \
+                                        0xFFFFFFFFull))
+#define TPU_FLOW_HOP(f) ((uint32_t)((f) & 0xFFFFull))
+#define TPU_FLOW_KEY(f) ((f) & TPU_FLOW_KEY_MASK)
+#define TPU_FLOW_WITH_HOP(f, h) (TPU_FLOW_KEY(f) | ((uint64_t)(h) & 0xFFFFull))
+
+/* Mint a hop-0 flow id (pure arithmetic; no table side effects). */
+uint64_t tpurmFlowMint(uint32_t tenant, uint32_t request);
+
+/* --------------------------------------------------------- blame buckets */
+
+enum {
+    TPU_FLOW_B_QUEUED = 0,    /* submit -> admission (scheduler)        */
+    TPU_FLOW_B_PREEMPTED,     /* swapped-out parks (scheduler)          */
+    TPU_FLOW_B_FAULT,         /* CPU demand-fault service (fault engine)*/
+    TPU_FLOW_B_COPY,          /* staged moves: PREFETCH/MIGRATE/EVICT/
+                               * TIER_EVICT exec on the spine           */
+    TPU_FLOW_B_ICI,           /* PEER_COPY shipping (incl. vac windows) */
+    TPU_FLOW_B_RESET,         /* full-device-reset blackout parks       */
+    TPU_FLOW_B_COUNT
+};
+
+const char *tpurmFlowBucketName(uint32_t bucket);
+
+/* ------------------------------------------------------------ flow table */
+
+/* Open a flow's ledger (idempotent for an already-open key; a table
+ * with no free or recyclable slot drops, counted tpurm_flow_drops). */
+TpuStatus tpurmFlowOpen(uint64_t flow);
+
+/* Accumulate ns into one blame bucket (and the per-tenant blame
+ * counter).  Unopened keys drop (counted tpurm_flow_unmatched) — the
+ * ledger never invents entries for stray ids. */
+void tpurmFlowAccount(uint64_t flow, uint32_t bucket, uint64_t ns);
+
+/* Bump the flow's emitted-token count (display/reconciliation). */
+void tpurmFlowTokens(uint64_t flow, uint64_t tokens);
+
+/* Close the ledger: stamps wall = now - open.  *wallNsOut optional. */
+TpuStatus tpurmFlowClose(uint64_t flow, uint64_t *wallNsOut);
+
+/* One report row (ctypes surface — keep field order in sync with
+ * utils.flow_report). */
+typedef struct {
+    uint64_t flow;                       /* hop-0 key                  */
+    uint32_t tenant;
+    uint32_t state;                      /* 1 = open, 2 = closed       */
+    uint64_t openNs;                     /* tpuNowNs clock             */
+    uint64_t wallNs;                     /* closed: final; open: so far */
+    uint64_t tokens;
+    uint64_t bucketNs[TPU_FLOW_B_COUNT];
+} TpuFlowRec;
+
+/* Fill out[] with up to max rows, most-blamed first (the "top-K slow
+ * flows" ordering /proc/driver/tpurm/flows renders).  Returns rows. */
+uint32_t tpurmFlowReport(TpuFlowRec *out, uint32_t max);
+
+/* Clear the table, the SLO histograms and the per-tenant blame
+ * counters (tests / bench isolation). */
+void tpurmFlowResetAll(void);
+
+/* --------------------------------------------------- per-tenant SLO hists */
+
+#define TPU_FLOW_TENANTS 64       /* == UVM_MAX_TENANTS */
+
+enum {
+    TPU_SLO_TTFT = 0,             /* submit -> first token             */
+    TPU_SLO_ITL = 1,              /* inter-token latency (per token)   */
+    TPU_SLO_KIND_COUNT
+};
+
+void tpurmSloRecord(uint32_t tenant, uint32_t kind, uint64_t ns);
+/* Batched feed: `count` samples of the same value (sched.py records a
+ * decode round's amortized per-token latency once per stream). */
+void tpurmSloRecordN(uint32_t tenant, uint32_t kind, uint64_t ns,
+                     uint64_t count);
+uint64_t tpurmSloQuantileNs(uint32_t tenant, uint32_t kind, double q);
+uint64_t tpurmSloCount(uint32_t tenant, uint32_t kind);
+/* Accumulated per-tenant blame (ns) for one bucket. */
+uint64_t tpurmSloBlameNs(uint32_t tenant, uint32_t bucket);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_FLOW_H */

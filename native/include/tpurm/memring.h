@@ -248,7 +248,20 @@ typedef struct {
     uint64_t seq;                 /* OUT: submission seq assigned by
                                    * prep (input ignored) — the handle
                                    * later SQEs name this op by        */
-    uint64_t rsvd1[2];
+    uint64_t flowId;              /* tpuflow request identity
+                                   * (tpurm/flow.h: tenant<<48 |
+                                   * request<<16 | hop; 0 = none).
+                                   * Workers set the thread flow
+                                   * context from it around execution,
+                                   * so nested engine spans (ce
+                                   * stripes, fault service, ICI hops)
+                                   * inherit the identity, and the
+                                   * exec layer accounts the op's
+                                   * wall into the flow's copy/ici
+                                   * blame bucket.  Lived in the
+                                   * reserved spare bytes: the 128-B
+                                   * SQE ABI is unchanged.            */
+    uint64_t rsvd1;
 } TpuMemringSqe;
 
 /* Completion entry — exactly one cacheline. */

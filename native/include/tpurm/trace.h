@@ -137,6 +137,20 @@ void tpurmTraceAppSpan(const char *name, uint64_t t0, uint64_t obj,
                        uint64_t bytes);
 uint64_t tpurmTraceNowNs(void);
 
+/* ------------------------------------------------------------ flow context
+ *
+ * tpuflow (tpurm/flow.h): the CURRENT thread's flow id.  Every ring
+ * record stamps it, so spans emitted while a flow is set carry the
+ * request identity into the Perfetto export (flow events "s"/"f" link
+ * a sched.admit span to the worker spans that executed its ops,
+ * across threads).  Memring workers set it from the claimed SQE's
+ * flowId around execution; the fault engine sets it from the entry's
+ * captured flow; 0 clears.  One relaxed TLS store — safe on every hot
+ * path (initial-exec TLS: no lazy allocation, so the CPU-fault signal
+ * handler may read it). */
+void tpurmTraceFlowSet(uint64_t flow);
+uint64_t tpurmTraceFlowGet(void);
+
 /* ----------------------------------------------------------------- export */
 
 /* Chrome trace-event JSON into buf; always a complete, parseable
@@ -159,6 +173,11 @@ uint64_t tpurmTraceHistQuantileNs(uint32_t site, double q);
 uint64_t tpurmTraceHistCountNs(uint32_t site);
 
 const char *tpurmTraceSiteName(uint32_t site);
+/* Perfetto category for a site (NULL past the table end) — exposed so
+ * the site-table self-check (trace_test.c) can assert every site id
+ * added by later subsystems is named AND categorized: an unnamed site
+ * would export anonymous spans. */
+const char *tpurmTraceSiteCat(uint32_t site);
 
 #ifdef __cplusplus
 }

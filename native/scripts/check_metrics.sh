@@ -1,0 +1,91 @@
+#!/bin/sh
+# check-metrics — the metrics-inventory lint (check-spine shape).
+#
+# Contract: every counter/gauge the tree registers must appear in the
+# Prometheus-exposition inventory asserted by
+# tests/test_trace_surface.py (METRICS_INVENTORY).  A counter added in
+# code but missing from the inventory fails this target, so the scrape
+# surface can never silently grow unasserted series — the same
+# can't-regress discipline check-spine applies to dispatch.
+#
+# Name sources scanned:
+#   - tpuCounterAdd / tpuCounterRef / tpuCounterAddScoped /
+#     mr_ctr_cached string literals in native/src (scoped "[...]"
+#     suffixes stripped: they render as labels);
+#   - "# TYPE <family> ..." literals in native/src (directly rendered
+#     gauge/counter/histogram families; families built with a %
+#     format are per-site/per-tenant expansions of an asserted base
+#     and are skipped);
+#   - _counter_add / tpuCounterAdd literals in the Python tree (the
+#     scheduler/vac counters land in the same exposition).
+#
+# Negative test hook: CHECK_METRICS_EXTRA=<name> injects a fake
+# registered name; the lint must then fail (test_trace_surface.py
+# asserts it does).
+set -eu
+
+src_dir=${1:-src}
+py_dir=${2:-../open_gpu_kernel_modules_tpu}
+inventory_py=${3:-../tests/test_trace_surface.py}
+
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+
+# --- registered names from the native tree ---------------------------------
+# -z/-P: NUL-joined multiline match, so a call wrapped across lines
+# (tpuCounterAdd(\n    "name", ...)) still resolves its literal.
+grep -rhozP '(tpuCounterAdd|tpuCounterRef|tpuCounterAddScoped)\(\s*"[A-Za-z_][A-Za-z0-9_.\[\]%]*"' \
+    "$src_dir" --include='*.c' --include='*.h' 2>/dev/null |
+    tr '\0' '\n' | sed -nE 's/.*"([^"]*)".*/\1/p' > "$tmp/raw" || true
+# mr_ctr_cached's counter name is the 2nd argument.
+grep -rhozP 'mr_ctr_cached\(\s*&[A-Za-z0-9_]+,\s*"[A-Za-z_][A-Za-z0-9_.\[\]%]*"' \
+    "$src_dir" --include='*.c' 2>/dev/null |
+    tr '\0' '\n' | sed -nE 's/.*"([^"]*)".*/\1/p' >> "$tmp/raw" || true
+# Scoped counter-name TABLES (g_subsysName) are plain string literals:
+# pick up any "<ident>[<ident>]" literal too.
+grep -rhoE '"[a-z][a-z0-9_]*\[[a-z0-9_]+\]"' "$src_dir" \
+    --include='*.c' 2>/dev/null | tr -d '"' >> "$tmp/raw" || true
+
+# --- directly rendered exposition families ---------------------------------
+grep -rhoE '# TYPE [a-zA-Z_%]+' "$src_dir" --include='*.c' 2>/dev/null |
+    sed -E 's/# TYPE //' >> "$tmp/raw" || true
+
+# --- Python-side counters ---------------------------------------------------
+grep -rhoE '(_counter_add|tpuCounterAdd)\((b?)"[a-z_][a-z0-9_]*"' \
+    "$py_dir" --include='*.py' 2>/dev/null |
+    sed -E 's/.*"([^"]*)".*/\1/' >> "$tmp/raw" || true
+
+{
+    # Normalize: strip scoped "[...]" suffixes (rendered as labels),
+    # drop %-format families (per-site/tenant expansions), drop printf
+    # fragments.
+    sed -E 's/\[[^]]*\]$//' "$tmp/raw" | grep -v '%' | grep -E '^[a-z]' || true
+    [ -n "${CHECK_METRICS_EXTRA:-}" ] && echo "$CHECK_METRICS_EXTRA"
+} | sort -u > "$tmp/registered"
+
+# --- the asserted inventory -------------------------------------------------
+python3 - "$inventory_py" > "$tmp/inventory" <<'EOF'
+import ast, sys
+tree = ast.parse(open(sys.argv[1]).read())
+for node in ast.walk(tree):
+    if (isinstance(node, ast.Assign) and node.targets and
+            isinstance(node.targets[0], ast.Name) and
+            node.targets[0].id == "METRICS_INVENTORY"):
+        for e in ast.literal_eval(node.value):
+            print(e)
+        break
+else:
+    sys.exit("METRICS_INVENTORY not found in " + sys.argv[1])
+EOF
+sort -u "$tmp/inventory" -o "$tmp/inventory"
+
+missing=$(comm -23 "$tmp/registered" "$tmp/inventory")
+if [ -n "$missing" ]; then
+    echo "check-metrics: counters registered in the tree but MISSING"
+    echo "from METRICS_INVENTORY (tests/test_trace_surface.py):"
+    echo "$missing" | sed 's/^/  /'
+    echo "(add them to the inventory so the exposition stays asserted)"
+    exit 1
+fi
+n=$(wc -l < "$tmp/registered")
+echo "check-metrics OK ($n registered names all inventoried)"

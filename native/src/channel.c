@@ -53,6 +53,12 @@ typedef struct {
     uint64_t bytes;
     uint32_t xform;
     uint32_t pad;
+    /* tpuflow identity stamped from the SUBMITTING thread's flow
+     * context at tpuPushCopySegEx time: the executor thread re-enters
+     * it around the memmove so ce.stripe spans carry the request the
+     * stripe moves bytes for (cross-thread propagation, same shape as
+     * the memring SQE flowId). */
+    uint64_t flow;
 } CopySeg;
 
 /* Outstanding pushbuffer chunk, in allocation order.  gpu_get advances
@@ -155,6 +161,11 @@ static void *channel_executor(void *arg)
         uint64_t tExec = ceBusy ? tpuNowNs() : 0;
         if (!failed && cmd.op == TPU_MSGQ_CE_PUSH) {
             const CopySeg *segs = (const CopySeg *)(uintptr_t)cmd.src;
+            /* tpuflow: a push is one stripe (one request): enter its
+             * identity for the exec window so the ce.stripe span below
+             * carries it across the executor-thread boundary. */
+            if (cmd.bytes > 0 && segs[0].flow)
+                tpurmTraceFlowSet(segs[0].flow);
             for (uint64_t i = 0; i < cmd.bytes; i++) {
                 if (segs[i].bytes > 0) {
                     /* Direction-agnostic device boundary (reference
@@ -200,6 +211,7 @@ static void *channel_executor(void *arg)
                 tpurmTraceSpanAt(TPU_TRACE_CE_STRIPE, tExec, tDone,
                                  ch->ceIdx, bytes);
         }
+        tpurmTraceFlowSet(0);          /* stripe flow scope ends */
 
         pthread_mutex_lock(&ch->lock);
         pb_release_locked(ch, cmd.pbEnd);
@@ -419,6 +431,7 @@ TpuStatus tpuPushCopySegEx(TpuPush *p, void *dst, const void *src,
     s->bytes = bytes;
     s->xform = xform;
     s->pad = 0;
+    s->flow = tpurmTraceFlowGet();
     return TPU_OK;
 }
 

@@ -1,0 +1,411 @@
+/*
+ * tpuflow — request-flow ledgers + per-tenant SLO attribution (see
+ * include/tpurm/flow.h for the model).
+ *
+ * Concurrency: the table is open-addressed over fixed slots; a slot is
+ * CLAIMED by CAS on its key (0 = free) and thereafter only ever
+ * accumulates with relaxed atomics, so the exec-layer account path
+ * (memring workers, fault engine) is lock-free.  Open/close/report
+ * race benignly: a report taken mid-traffic reads a consistent-enough
+ * snapshot (each field individually atomic), the same contract the
+ * trace exporter has.  Slot recycling (a full table reuses the oldest
+ * CLOSED slot) takes a small lock on the open path only.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+#include "tpurm/flow.h"
+
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <string.h>
+
+#define FLOW_SLOTS 1024            /* power of two */
+#define FLOW_PROBES 16             /* linear probe bound per open/lookup */
+
+typedef struct {
+    _Atomic uint64_t key;          /* hop-masked flow id; 0 = free      */
+    _Atomic uint32_t state;        /* 1 open, 2 closed                  */
+    uint32_t pad0;
+    _Atomic uint64_t openNs;
+    _Atomic uint64_t closeNs;      /* 0 while open                      */
+    _Atomic uint64_t tokens;
+    _Atomic uint64_t bucketNs[TPU_FLOW_B_COUNT];
+} FlowEnt;
+
+static struct {
+    FlowEnt slots[FLOW_SLOTS];
+    pthread_mutex_t openLock;      /* recycle path only                 */
+    _Atomic uint64_t opened;
+    _Atomic uint64_t closed;
+    _Atomic uint64_t drops;        /* open with no slot                 */
+    _Atomic uint64_t unmatched;    /* account on an unopened key        */
+} g_flow = { .openLock = PTHREAD_MUTEX_INITIALIZER };
+
+/* Per-tenant SLO histograms (BSS; pages materialize on first touch)
+ * and blame accumulators. */
+static TpuHist g_slo[TPU_FLOW_TENANTS][TPU_SLO_KIND_COUNT];
+static _Atomic uint64_t g_blame[TPU_FLOW_TENANTS][TPU_FLOW_B_COUNT];
+
+static const char *const g_bucketNames[TPU_FLOW_B_COUNT] = {
+    "queued", "preempted", "fault", "copy", "ici", "reset",
+};
+
+const char *tpurmFlowBucketName(uint32_t bucket)
+{
+    return bucket < TPU_FLOW_B_COUNT ? g_bucketNames[bucket] : NULL;
+}
+
+uint64_t tpurmFlowMint(uint32_t tenant, uint32_t request)
+{
+    return TPU_FLOW_MAKE(tenant, request);
+}
+
+/* ------------------------------------------------------------- table ops */
+
+static uint32_t flow_hash(uint64_t key)
+{
+    key ^= key >> 33;
+    key *= 0xff51afd7ed558ccdull;
+    key ^= key >> 33;
+    return (uint32_t)key & (FLOW_SLOTS - 1);
+}
+
+static FlowEnt *flow_find(uint64_t key)
+{
+    uint32_t h = flow_hash(key);
+    for (uint32_t p = 0; p < FLOW_PROBES; p++) {
+        FlowEnt *e = &g_flow.slots[(h + p) & (FLOW_SLOTS - 1)];
+        uint64_t k = atomic_load_explicit(&e->key, memory_order_acquire);
+        if (k == key)
+            return e;
+        if (k == 0)
+            return NULL;           /* linear-probe chain ends at a hole */
+    }
+    return NULL;
+}
+
+static void flow_slot_init(FlowEnt *e, uint64_t now)
+{
+    atomic_store_explicit(&e->state, 1, memory_order_relaxed);
+    atomic_store_explicit(&e->openNs, now, memory_order_relaxed);
+    atomic_store_explicit(&e->closeNs, 0, memory_order_relaxed);
+    atomic_store_explicit(&e->tokens, 0, memory_order_relaxed);
+    for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+        atomic_store_explicit(&e->bucketNs[b], 0, memory_order_relaxed);
+}
+
+TpuStatus tpurmFlowOpen(uint64_t flow)
+{
+    uint64_t key = TPU_FLOW_KEY(flow);
+    if (key == 0)
+        return TPU_ERR_INVALID_ARGUMENT;
+    uint64_t now = tpuNowNs();
+    uint32_t h = flow_hash(key);
+    for (uint32_t p = 0; p < FLOW_PROBES; p++) {
+        FlowEnt *e = &g_flow.slots[(h + p) & (FLOW_SLOTS - 1)];
+        uint64_t k = atomic_load_explicit(&e->key, memory_order_acquire);
+        if (k == key)
+            return TPU_OK;         /* idempotent re-open */
+        if (k == 0) {
+            uint64_t zero = 0;
+            if (atomic_compare_exchange_strong(&e->key, &zero, key)) {
+                flow_slot_init(e, now);
+                atomic_fetch_add(&g_flow.opened, 1);
+                tpuCounterAdd("tpurm_flows_opened", 1);
+                return TPU_OK;
+            }
+            if (atomic_load_explicit(&e->key,
+                                     memory_order_acquire) == key)
+                return TPU_OK;     /* lost the race to ourselves */
+        }
+    }
+    /* Probe window full: recycle the oldest CLOSED slot in it (under
+     * the open lock so two recyclers can't pick the same victim). */
+    pthread_mutex_lock(&g_flow.openLock);
+    FlowEnt *victim = NULL;
+    uint64_t oldest = ~0ull;
+    for (uint32_t p = 0; p < FLOW_PROBES; p++) {
+        FlowEnt *e = &g_flow.slots[(h + p) & (FLOW_SLOTS - 1)];
+        if (atomic_load_explicit(&e->key, memory_order_acquire) == key) {
+            pthread_mutex_unlock(&g_flow.openLock);
+            return TPU_OK;
+        }
+        if (atomic_load_explicit(&e->state, memory_order_relaxed) == 2) {
+            uint64_t c = atomic_load_explicit(&e->closeNs,
+                                              memory_order_relaxed);
+            if (c < oldest) {
+                oldest = c;
+                victim = e;
+            }
+        }
+    }
+    if (victim) {
+        flow_slot_init(victim, now);
+        atomic_store_explicit(&victim->key, key, memory_order_release);
+        atomic_fetch_add(&g_flow.opened, 1);
+        tpuCounterAdd("tpurm_flows_opened", 1);
+        pthread_mutex_unlock(&g_flow.openLock);
+        return TPU_OK;
+    }
+    pthread_mutex_unlock(&g_flow.openLock);
+    atomic_fetch_add(&g_flow.drops, 1);
+    tpuCounterAdd("tpurm_flow_drops", 1);
+    return TPU_ERR_INSUFFICIENT_RESOURCES;
+}
+
+void tpurmFlowAccount(uint64_t flow, uint32_t bucket, uint64_t ns)
+{
+    if (bucket >= TPU_FLOW_B_COUNT || ns == 0)
+        return;
+    FlowEnt *e = flow_find(TPU_FLOW_KEY(flow));
+    if (!e) {
+        atomic_fetch_add(&g_flow.unmatched, 1);
+        return;
+    }
+    atomic_fetch_add_explicit(&e->bucketNs[bucket], ns,
+                              memory_order_relaxed);
+    uint32_t tenant = TPU_FLOW_TENANT(flow);
+    if (tenant < TPU_FLOW_TENANTS)
+        atomic_fetch_add_explicit(&g_blame[tenant][bucket], ns,
+                                  memory_order_relaxed);
+}
+
+void tpurmFlowTokens(uint64_t flow, uint64_t tokens)
+{
+    FlowEnt *e = flow_find(TPU_FLOW_KEY(flow));
+    if (e)
+        atomic_fetch_add_explicit(&e->tokens, tokens,
+                                  memory_order_relaxed);
+}
+
+TpuStatus tpurmFlowClose(uint64_t flow, uint64_t *wallNsOut)
+{
+    FlowEnt *e = flow_find(TPU_FLOW_KEY(flow));
+    if (!e)
+        return TPU_ERR_OBJECT_NOT_FOUND;
+    uint64_t now = tpuNowNs();
+    uint32_t open = 1;
+    if (atomic_compare_exchange_strong(&e->state, &open, 2)) {
+        atomic_store_explicit(&e->closeNs, now, memory_order_relaxed);
+        atomic_fetch_add(&g_flow.closed, 1);
+        tpuCounterAdd("tpurm_flows_closed", 1);
+    }
+    if (wallNsOut)
+        *wallNsOut = atomic_load_explicit(&e->closeNs,
+                                          memory_order_relaxed) -
+                     atomic_load_explicit(&e->openNs,
+                                          memory_order_relaxed);
+    return TPU_OK;
+}
+
+/* -------------------------------------------------------------- reporting */
+
+static void flow_fill_rec(const FlowEnt *e, uint64_t key, TpuFlowRec *r,
+                          uint64_t now)
+{
+    r->flow = key;
+    r->tenant = TPU_FLOW_TENANT(key);
+    r->state = atomic_load_explicit(&e->state, memory_order_relaxed);
+    r->openNs = atomic_load_explicit(&e->openNs, memory_order_relaxed);
+    uint64_t closeNs = atomic_load_explicit(&e->closeNs,
+                                            memory_order_relaxed);
+    r->wallNs = (r->state == 2 && closeNs > r->openNs)
+                    ? closeNs - r->openNs
+                    : (now > r->openNs ? now - r->openNs : 0);
+    r->tokens = atomic_load_explicit(&e->tokens, memory_order_relaxed);
+    for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+        r->bucketNs[b] = atomic_load_explicit(&e->bucketNs[b],
+                                              memory_order_relaxed);
+}
+
+static uint64_t rec_blame(const TpuFlowRec *r)
+{
+    uint64_t s = 0;
+    for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+        s += r->bucketNs[b];
+    return s;
+}
+
+uint32_t tpurmFlowReport(TpuFlowRec *out, uint32_t max)
+{
+    if (!out || max == 0)
+        return 0;
+    uint64_t now = tpuNowNs();
+    uint32_t n = 0;
+    for (uint32_t i = 0; i < FLOW_SLOTS; i++) {
+        FlowEnt *e = &g_flow.slots[i];
+        uint64_t key = atomic_load_explicit(&e->key, memory_order_acquire);
+        if (key == 0)
+            continue;
+        TpuFlowRec r;
+        flow_fill_rec(e, key, &r, now);
+        /* Insertion sort by blame desc into out[0..n) (n <= max). */
+        uint32_t pos = n < max ? n : max;
+        while (pos > 0 && rec_blame(&out[pos - 1]) < rec_blame(&r))
+            pos--;
+        if (pos >= max)
+            continue;
+        uint32_t end = n < max ? n : max - 1;
+        memmove(&out[pos + 1], &out[pos], (end - pos) * sizeof(r));
+        out[pos] = r;
+        if (n < max)
+            n++;
+    }
+    return n;
+}
+
+void tpurmFlowResetAll(void)
+{
+    pthread_mutex_lock(&g_flow.openLock);
+    for (uint32_t i = 0; i < FLOW_SLOTS; i++) {
+        atomic_store_explicit(&g_flow.slots[i].key, 0,
+                              memory_order_release);
+        atomic_store_explicit(&g_flow.slots[i].state, 0,
+                              memory_order_relaxed);
+    }
+    atomic_store(&g_flow.opened, 0);
+    atomic_store(&g_flow.closed, 0);
+    atomic_store(&g_flow.drops, 0);
+    atomic_store(&g_flow.unmatched, 0);
+    for (uint32_t t = 0; t < TPU_FLOW_TENANTS; t++) {
+        for (uint32_t k = 0; k < TPU_SLO_KIND_COUNT; k++)
+            tpuHistReset(&g_slo[t][k]);
+        for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+            atomic_store_explicit(&g_blame[t][b], 0, memory_order_relaxed);
+    }
+    pthread_mutex_unlock(&g_flow.openLock);
+}
+
+/* ------------------------------------------------------------- SLO hists */
+
+void tpurmSloRecordN(uint32_t tenant, uint32_t kind, uint64_t ns,
+                     uint64_t count)
+{
+    if (tenant >= TPU_FLOW_TENANTS || kind >= TPU_SLO_KIND_COUNT ||
+        count == 0)
+        return;
+    tpuHistRecordN(&g_slo[tenant][kind], ns, count);
+}
+
+void tpurmSloRecord(uint32_t tenant, uint32_t kind, uint64_t ns)
+{
+    tpurmSloRecordN(tenant, kind, ns, 1);
+}
+
+uint64_t tpurmSloQuantileNs(uint32_t tenant, uint32_t kind, double q)
+{
+    if (tenant >= TPU_FLOW_TENANTS || kind >= TPU_SLO_KIND_COUNT)
+        return 0;
+    return tpuHistQuantile(&g_slo[tenant][kind], q);
+}
+
+uint64_t tpurmSloCount(uint32_t tenant, uint32_t kind)
+{
+    if (tenant >= TPU_FLOW_TENANTS || kind >= TPU_SLO_KIND_COUNT)
+        return 0;
+    return atomic_load_explicit(&g_slo[tenant][kind].count,
+                                memory_order_relaxed);
+}
+
+uint64_t tpurmSloBlameNs(uint32_t tenant, uint32_t bucket)
+{
+    if (tenant >= TPU_FLOW_TENANTS || bucket >= TPU_FLOW_B_COUNT)
+        return 0;
+    return atomic_load_explicit(&g_blame[tenant][bucket],
+                                memory_order_relaxed);
+}
+
+/* -------------------------------------------------------------- renderers */
+
+/* Per-tenant rows through THE shared histogram renderer
+ * (tpuPromHistRows, trace.c): one boundary table for every tpurm_*_ns
+ * family in the scrape. */
+static void slo_hist_rows(TpuCur *c, const char *family, uint32_t kind)
+{
+    bool typed = false;
+    for (uint32_t t = 0; t < TPU_FLOW_TENANTS; t++) {
+        TpuHist *h = &g_slo[t][kind];
+        if (atomic_load_explicit(&h->count, memory_order_relaxed) == 0)
+            continue;
+        if (!typed) {
+            tpuCurf(c, "# TYPE %s histogram\n", family);
+            typed = true;
+        }
+        char labels[24];
+        snprintf(labels, sizeof(labels), "tenant=\"%u\"", t);
+        tpuPromHistRows(c, h, family, labels);
+    }
+}
+
+/* Appended to the /proc/driver/tpurm/metrics exposition (procfs.c
+ * render_metrics). */
+void tpurmFlowRenderProm(TpuCur *c)
+{
+    slo_hist_rows(c, "tpurm_slo_ttft_ns", TPU_SLO_TTFT);
+    slo_hist_rows(c, "tpurm_slo_itl_ns", TPU_SLO_ITL);
+
+    bool typed = false;
+    for (uint32_t t = 0; t < TPU_FLOW_TENANTS; t++) {
+        for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++) {
+            uint64_t v = atomic_load_explicit(&g_blame[t][b],
+                                              memory_order_relaxed);
+            if (v == 0)
+                continue;
+            if (!typed) {
+                tpuCurf(c, "# TYPE tpurm_slo_blame_ns counter\n");
+                typed = true;
+            }
+            tpuCurf(c,
+                    "tpurm_slo_blame_ns{tenant=\"%u\",bucket=\"%s\"} "
+                    "%llu\n",
+                    t, g_bucketNames[b], (unsigned long long)v);
+        }
+    }
+
+    uint64_t opened = atomic_load(&g_flow.opened);
+    uint64_t closed = atomic_load(&g_flow.closed);
+    tpuCurf(c, "# TYPE tpurm_flows_open gauge\n");
+    tpuCurf(c, "tpurm_flows_open %llu\n",
+            (unsigned long long)(opened > closed ? opened - closed : 0));
+    tpuCurf(c, "# TYPE tpurm_flows_closed_total counter\n");
+    tpuCurf(c, "tpurm_flows_closed_total %llu\n",
+            (unsigned long long)closed);
+    tpuCurf(c, "# TYPE tpurm_flow_drops_total counter\n");
+    tpuCurf(c, "tpurm_flow_drops_total %llu\n",
+            (unsigned long long)atomic_load(&g_flow.drops));
+    tpuCurf(c, "# TYPE tpurm_flow_unmatched_total counter\n");
+    tpuCurf(c, "tpurm_flow_unmatched_total %llu\n",
+            (unsigned long long)atomic_load(&g_flow.unmatched));
+}
+
+/* /proc/driver/tpurm/flows: live top-K slow flows by blame. */
+void tpurmFlowRenderTable(TpuCur *c)
+{
+    enum { TOPK = 32 };
+    static TpuFlowRec recs[TOPK];    /* render path is procfs-serial */
+    uint32_t n = tpurmFlowReport(recs, TOPK);
+    tpuCurf(c,
+            "open: %llu  closed: %llu  drops: %llu  unmatched: %llu\n",
+            (unsigned long long)(atomic_load(&g_flow.opened) -
+                                 atomic_load(&g_flow.closed)),
+            (unsigned long long)atomic_load(&g_flow.closed),
+            (unsigned long long)atomic_load(&g_flow.drops),
+            (unsigned long long)atomic_load(&g_flow.unmatched));
+    tpuCurf(c, "%-18s %-6s %-6s %-8s %-9s", "flow", "tenant", "state",
+            "tokens", "wall_ms");
+    for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+        tpuCurf(c, " %9s", g_bucketNames[b]);
+    tpuCurf(c, "\n");
+    for (uint32_t i = 0; i < n; i++) {
+        TpuFlowRec *r = &recs[i];
+        tpuCurf(c, "0x%016llx %-6u %-6s %-8llu %-9.3f",
+                (unsigned long long)r->flow, r->tenant,
+                r->state == 2 ? "closed" : "open",
+                (unsigned long long)r->tokens,
+                (double)r->wallNs / 1e6);
+        for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+            tpuCurf(c, " %9.3f", (double)r->bucketNs[b] / 1e6);
+        tpuCurf(c, "\n");
+    }
+}

@@ -17,6 +17,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/ce.h"
+#include "tpurm/flow.h"
 #include "tpurm/health.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
@@ -717,8 +718,18 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
                                    ? (char *)dst + off
                                    : (char *)tpurmDeviceHbmBase(
                                          chainDev[h + 1]) + stageOff[h];
+                /* tpuflow: each store-and-forward leg bumps the flow
+                 * id's HOP field, so the per-hop ce.stripe spans of
+                 * one transfer stay one arrow chain in the Perfetto
+                 * export while remaining distinguishable per leg. */
+                uint64_t baseFlow = tpurmTraceFlowGet();
+                if (baseFlow)
+                    tpurmTraceFlowSet(TPU_FLOW_WITH_HOP(
+                        baseFlow, TPU_FLOW_HOP(baseFlow) + h));
                 st = tpuCeBatchCopy(&curB[h], hopDst, hopSrc, len,
                                     TPU_CE_COMP_NONE);
+                if (baseFlow)
+                    tpurmTraceFlowSet(baseFlow);
                 if (st != TPU_OK)
                     break;
                 tpuCounterAdd("ici_hop_bytes", len);

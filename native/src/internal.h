@@ -56,6 +56,8 @@ typedef struct {
 } TpuHist;
 
 void     tpuHistRecord(TpuHist *h, uint64_t v);
+/* Batched: n samples of the same value (per-tenant SLO feed). */
+void     tpuHistRecordN(TpuHist *h, uint64_t v, uint64_t n);
 uint64_t tpuHistQuantile(const TpuHist *h, double q);
 uint64_t tpuHistBucketLow(uint32_t idx);   /* bucket lower bound value */
 void     tpuHistReset(TpuHist *h);
@@ -74,6 +76,12 @@ typedef struct {
 
 void tpuCurf(TpuCur *c, const char *fmt, ...)
     __attribute__((format(printf, 2, 3)));
+
+/* Prometheus histogram rows (bucket/sum/count; caller owns # TYPE):
+ * one export-boundary table for every tpurm_*_ns family (trace.c);
+ * `labels` ("tenant=\"3\"") prefixes the le label, NULL = unlabeled. */
+void tpuPromHistRows(TpuCur *c, const TpuHist *h, const char *family,
+                     const char *labels);
 
 /* ------------------------------------------------------------- lock order */
 
@@ -368,6 +376,14 @@ TpuStatus tpurmBrokerVacRequest(uint32_t devInst, uint32_t target);
 
 void tpurmHealthRenderProm(TpuCur *c);
 void tpurmHealthRenderTable(TpuCur *c);
+
+/* ------------------------------------------------------------- tpuflow
+ *
+ * Render hooks for the request-flow / SLO subsystem (flow.c; public
+ * surface in tpurm/flow.h). */
+
+void tpurmFlowRenderProm(TpuCur *c);
+void tpurmFlowRenderTable(TpuCur *c);
 
 /* ------------------------------------------------- robust channel RC */
 

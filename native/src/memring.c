@@ -56,6 +56,7 @@
 #include <unistd.h>
 
 #include "internal.h"
+#include "tpurm/flow.h"
 #include "tpurm/health.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
@@ -730,6 +731,24 @@ static TpuStatus exec_sqe(TpuMemring *r, const TpuMemringSqe *sqe,
     }
 }
 
+/* tpuflow blame bucket for an executed opcode (-1: not attributed here
+ * — OP_FAULT accounts inside uvmFaultServiceExec, everything else has
+ * no wall worth charging). */
+static inline int mr_flow_bucket(uint8_t opcode)
+{
+    switch (opcode) {
+    case TPU_MEMRING_OP_MIGRATE:
+    case TPU_MEMRING_OP_PREFETCH:
+    case TPU_MEMRING_OP_EVICT:
+    case TPU_MEMRING_OP_TIER_EVICT:
+        return TPU_FLOW_B_COPY;
+    case TPU_MEMRING_OP_PEER_COPY:
+        return TPU_FLOW_B_ICI;
+    default:
+        return -1;
+    }
+}
+
 /* Fail-fast statuses: argument/state validation that a retry can never
  * change (bounded retry is for transients). */
 static bool status_permanent(TpuStatus st)
@@ -903,13 +922,32 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
         uint64_t t0 = tpuNowNs();
         uint64_t moved = 0;
         bool injectedFail = false;
+        /* tpuflow: thread-flow context scoped to the run, so nested
+         * engine spans (ce stripes, fault entries a PREFETCH spawns,
+         * ICI hops) carry the request identity.  Merged runs use the
+         * head op's flow for span decoration; blame below splits by
+         * each SQE's len share. */
+        uint64_t runFlow = batch[i].flowId;
+        if (runFlow)
+            tpurmTraceFlowSet(runFlow);
         uint64_t tSpan = tpurmTraceBegin();
         TpuStatus st = exec_run_recovered(r, &batch[i], vs, spanLen,
                                           &moved, &injectedFail);
         if (tSpan)
             tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan,
                           batch[i].userData, spanLen);
+        if (runFlow)
+            tpurmTraceFlowSet(0);
         uint64_t t1 = tpuNowNs();
+        {
+            int bkt = mr_flow_bucket(batch[i].opcode);
+            if (bkt >= 0 && spanLen)
+                for (uint32_t k = 0; k < runLen; k++)
+                    if (batch[i + k].flowId)
+                        tpurmFlowAccount(
+                            batch[i + k].flowId, (uint32_t)bkt,
+                            (t1 - t0) * batch[i + k].len / spanLen);
+        }
         tpuCounterAdd("memring_ops", runLen);
         if (injectedFail)
             tpuCounterAdd("memring_inject_error_cqes", runLen);
@@ -1005,12 +1043,22 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
         }
         uint64_t moved = 0;
         bool injectedFail = false;
+        uint64_t opFlow = chain[i].flowId;
+        if (opFlow)
+            tpurmTraceFlowSet(opFlow);
         uint64_t tSpan = tpurmTraceBegin();
         TpuStatus st = exec_run_recovered(r, &chain[i], vs, chain[i].len,
                                           &moved, &injectedFail);
         if (tSpan)
             tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan, chain[i].userData,
                           chain[i].len);
+        if (opFlow) {
+            tpurmTraceFlowSet(0);
+            int bkt = mr_flow_bucket(chain[i].opcode);
+            if (bkt >= 0)
+                tpurmFlowAccount(opFlow, (uint32_t)bkt,
+                                 tpuNowNs() - t0);
+        }
         tpuCounterAdd("memring_ops", 1);
         if (injectedFail)
             tpuCounterAdd("memring_inject_error_cqes", 1);
@@ -1819,6 +1867,12 @@ static TpuStatus mr_exec_inline(UvmVaSpace *vs, const TpuMemringSqe *sqes,
     TpuMemring *r = g_int.ring;        /* may be NULL (create failure) */
     TpuStatus first = TPU_OK;
     bool cancelled = false;
+    /* Ambient flow: an internal batch submitted from a flow-scoped
+     * thread (sched prefill, a Python migrate under flow_set) inherits
+     * the submitter's identity when the producer left flowId zero —
+     * the fault chain builder stamps explicitly and is never
+     * overridden. */
+    uint64_t ambient = tpurmTraceFlowGet();
     /* Fail tracking feeds only intra-batch dep-cancel: skip the
      * bookkeeping entirely for dep-free batches (the single-fault hot
      * path). */
@@ -1874,12 +1928,31 @@ static TpuStatus mr_exec_inline(UvmVaSpace *vs, const TpuMemringSqe *sqes,
         } else {
             uint64_t moved = 0;
             bool injectedFail = false;
+            /* tpuflow: inline exec runs on the submitter, whose thread
+             * flow may already be set (dependent submission from a
+             * flow-scoped worker) — scope to this op's id and restore.
+             * Blame timestamps only when attribution will happen (the
+             * dep-free fault hot path stays timestamp-free here). */
+            uint64_t opFlow = sqes[i].flowId ? sqes[i].flowId : ambient;
+            int bkt = opFlow ? mr_flow_bucket(sqes[i].opcode) : -1;
+            uint64_t prevFlow = 0;
+            if (opFlow) {
+                prevFlow = tpurmTraceFlowGet();
+                tpurmTraceFlowSet(opFlow);
+            }
+            uint64_t tb = bkt >= 0 ? tpuNowNs() : 0;
             uint64_t tSpan = tpurmTraceBegin();
             st = exec_run_recovered(r, &sqes[i], vs, sqes[i].len, &moved,
                                     &injectedFail);
             if (tSpan)
                 tpurmTraceEnd(TPU_TRACE_MEMRING_OP, tSpan,
                               sqes[i].userData, sqes[i].len);
+            if (opFlow) {
+                tpurmTraceFlowSet(prevFlow);
+                if (bkt >= 0)
+                    tpurmFlowAccount(opFlow, (uint32_t)bkt,
+                                     tpuNowNs() - tb);
+            }
             mr_ctr_cached(&c_ops, "memring_ops", 1);
             if (injectedFail)
                 tpuCounterAdd("memring_inject_error_cqes", 1);
@@ -2033,6 +2106,8 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
         uint32_t k = 0;
         for (; k < clen; k++) {
             TpuMemringSqe tmp = sqes[i + k];
+            if (!tmp.flowId)
+                tmp.flowId = tpurmTraceFlowGet();  /* ambient identity */
             uint32_t nd = tmp.depCount <= TPU_MEMRING_SQE_NDEPS
                               ? tmp.depCount : TPU_MEMRING_SQE_NDEPS;
             for (uint32_t m = 0; m < nd && ps == TPU_OK; m++) {

@@ -178,6 +178,13 @@ static void render_metrics(TpuCur *c)
     c->off += tpurmTraceRenderProm(c->buf + c->off, c->cap - c->off);
     uvmTenantRenderProm(c);
     tpurmHealthRenderProm(c);
+    tpurmFlowRenderProm(c);
+}
+
+/* Live top-K slow flows by blame (tpuflow), with per-bucket ms. */
+static void render_flows(TpuCur *c)
+{
+    tpurmFlowRenderTable(c);
 }
 
 /* Per-device health table (tpuvac): state machine, decayed score,
@@ -259,6 +266,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm/tenants", render_tenants, false },
     { "driver/tpurm/reset", render_reset, false },
     { "driver/tpurm/health", render_health, false },
+    { "driver/tpurm/flows", render_flows, false },
 };
 
 #define N_NODES (sizeof(g_nodes) / sizeof(g_nodes[0]))

@@ -32,12 +32,16 @@
 #define TRACE_LABEL_MAX 24
 #define TRACE_RING_DEFAULT 8192
 
-/* One 64-byte record; durNs == 0 renders as an instant ("i"). */
+/* One 72-byte record; durNs == 0 renders as an instant ("i").  The
+ * flow field (tpuflow request identity, tpurm/flow.h) grew the record
+ * past the original cacheline — rings are private heap, so only the
+ * per-record ring cost changes. */
 typedef struct {
     uint64_t tsNs;
     uint64_t durNs;
     uint64_t obj;
     uint64_t bytes;
+    uint64_t flow;                     /* 0 = no flow context */
     uint32_t site;
     uint32_t flags;                    /* reserved */
     char label[TRACE_LABEL_MAX];       /* "" -> site name */
@@ -59,6 +63,21 @@ static struct {
 } g_trace = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
 static __thread TraceRing *t_ring;
+
+/* The current thread's flow context (tpuflow).  initial-exec TLS: the
+ * CPU-fault signal handler reads it to stamp the fault entry, and a
+ * lazy (global-dynamic) TLS access could allocate inside the handler. */
+static __thread uint64_t t_flow __attribute__((tls_model("initial-exec")));
+
+void tpurmTraceFlowSet(uint64_t flow)
+{
+    t_flow = flow;
+}
+
+uint64_t tpurmTraceFlowGet(void)
+{
+    return t_flow;
+}
 
 /* Site table: name + Perfetto category.  Order == TpuTraceSite. */
 static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = {
@@ -107,6 +126,11 @@ const char *tpurmTraceSiteName(uint32_t site)
     return site < TPU_TRACE_SITE_COUNT ? g_sites[site].name : NULL;
 }
 
+const char *tpurmTraceSiteCat(uint32_t site)
+{
+    return site < TPU_TRACE_SITE_COUNT ? g_sites[site].cat : NULL;
+}
+
 TpuHist *tpurmTraceHistRef(uint32_t site)
 {
     return site < TPU_TRACE_SITE_COUNT ? &g_hist[site] : NULL;
@@ -142,6 +166,17 @@ void tpuHistRecord(TpuHist *h, uint64_t v)
                               memory_order_relaxed);
     atomic_fetch_add_explicit(&h->sum, v, memory_order_relaxed);
     atomic_fetch_add_explicit(&h->count, 1, memory_order_relaxed);
+}
+
+/* Batched record: n samples of the same value in three atomic adds
+ * (the per-tenant SLO feed records a decode round's amortized
+ * per-token latency once per stream, not once per token). */
+void tpuHistRecordN(TpuHist *h, uint64_t v, uint64_t n)
+{
+    atomic_fetch_add_explicit(&h->buckets[hist_index(v)], n,
+                              memory_order_relaxed);
+    atomic_fetch_add_explicit(&h->sum, v * n, memory_order_relaxed);
+    atomic_fetch_add_explicit(&h->count, n, memory_order_relaxed);
 }
 
 uint64_t tpuHistQuantile(const TpuHist *h, double q)
@@ -289,6 +324,7 @@ static void trace_emit(uint32_t site, uint64_t t0, uint64_t t1,
     rec->durNs = t1 > t0 ? t1 - t0 : 0;
     rec->obj = obj;
     rec->bytes = bytes;
+    rec->flow = t_flow;
     rec->site = site;
     rec->flags = 0;
     if (label)
@@ -437,10 +473,12 @@ size_t tpurmTraceExportJson(char *buf, size_t bufSize)
                                        memory_order_acquire);
     /* Worst-case sizes: a span event is ~110 B of fixed JSON + a
      * 46-char escaped label + two %.3f timestamps + full-width
-     * obj/bytes (~300 B total); the closing metadata event carries
-     * three 20-digit counters (~260 B).  Reserving both keeps the
-     * document parseable under any truncation. */
-    const size_t EVENT_MAX = 320;
+     * obj/bytes + an optional flow arg (~340 B total), and a
+     * flow-carrying span additionally emits one Perfetto flow event
+     * (~160 B) — reserve for the pair; the closing metadata event
+     * carries three 20-digit counters (~260 B).  Reserving both keeps
+     * the document parseable under any truncation. */
+    const size_t EVENT_MAX = 512;
     const size_t TAIL = 280;
     for (uint32_t i = 0; i < nr; i++) {
         TraceRing *r = g_trace.rings[i];
@@ -461,15 +499,22 @@ size_t tpurmTraceExportJson(char *buf, size_t bufSize)
                 snprintf(name, sizeof(name), "%s",
                          g_sites[rec->site].name);
             double tsUs = (double)rec->tsNs / 1000.0;
+            char flowArg[40];
+            flowArg[0] = '\0';
+            if (rec->flow)
+                snprintf(flowArg, sizeof(flowArg),
+                         ",\"flow\":\"0x%llx\"",
+                         (unsigned long long)rec->flow);
             if (rec->durNs > 0)
                 tpuCurf(&c,
                          "%s{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"X\","
                          "\"ts\":%.3f,\"dur\":%.3f,\"pid\":%d,\"tid\":%u,"
-                         "\"args\":{\"obj\":\"0x%llx\",\"bytes\":%llu}}",
+                         "\"args\":{\"obj\":\"0x%llx\",\"bytes\":%llu"
+                         "%s}}",
                          first ? "" : ",", name, g_sites[rec->site].cat,
                          tsUs, (double)rec->durNs / 1000.0, pid, r->tid,
                          (unsigned long long)rec->obj,
-                         (unsigned long long)rec->bytes);
+                         (unsigned long long)rec->bytes, flowArg);
             else
                 tpuCurf(&c,
                          "%s{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"i\","
@@ -480,6 +525,31 @@ size_t tpurmTraceExportJson(char *buf, size_t bufSize)
                          (unsigned long long)rec->obj,
                          (unsigned long long)rec->bytes);
             first = false;
+            /* Perfetto flow events link a request's spans across
+             * threads: the sched.admit span emits the flow START
+             * ("s") at its BEGINNING — the admission window's own
+             * byte movement (prefill faults, worker spans) emits
+             * finishes later than the start, so those arrows bind
+             * too, not just post-admit restores; every other
+             * flow-carrying span emits a flow FINISH ("f",
+             * bind-enclosing) at its start — each hop re-terminates
+             * the arrow, so the admit span connects to every worker
+             * that executed the request's ops.  The id is the
+             * hop-masked flow KEY so ICI/vac hop bumps stay one
+             * arrow chain; one shared name/cat, as Chrome matches
+             * flows by (id, cat, name). */
+            if (rec->flow && rec->durNs > 0) {
+                bool start = rec->site == TPU_TRACE_SCHED_ADMIT;
+                tpuCurf(&c,
+                         ",{\"name\":\"tpuflow\",\"cat\":\"flow\","
+                         "\"ph\":\"%s\"%s,\"id\":\"0x%llx\","
+                         "\"ts\":%.3f,\"pid\":%d,\"tid\":%u}",
+                         start ? "s" : "f",
+                         start ? "" : ",\"bp\":\"e\"",
+                         (unsigned long long)(rec->flow &
+                                              ~0xFFFFull),
+                         tsUs, pid, r->tid);
+            }
         }
     }
     /* Trailing metadata instant: process identity + export accounting
@@ -538,6 +608,50 @@ static const uint64_t g_promLe[] = {
 };
 #define PROM_LE_COUNT (sizeof(g_promLe) / sizeof(g_promLe[0]))
 
+/* THE histogram-exposition renderer (bucket/sum/count rows; the caller
+ * owns the TYPE line): one boundary table and one cumulative-merge
+ * loop for every tpurm_*_ns family — the per-tenant SLO histograms
+ * (flow.c) render through this too, so the scrape's boundaries can
+ * never drift between families.  `labels` ("tenant=\"3\"") prefixes
+ * the le label; NULL renders unlabeled. */
+void tpuPromHistRows(TpuCur *c, const TpuHist *h, const char *family,
+                     const char *labels)
+{
+    uint64_t count = atomic_load_explicit(&h->count,
+                                          memory_order_relaxed);
+    const char *sep = labels ? "," : "";
+    if (!labels)
+        labels = "";
+    uint64_t cum = 0;
+    uint32_t bi = 0;
+    for (size_t li = 0; li < PROM_LE_COUNT; li++) {
+        while (bi < TPU_HIST_BUCKETS &&
+               tpuHistBucketLow(bi) <= g_promLe[li]) {
+            cum += atomic_load_explicit(&h->buckets[bi],
+                                        memory_order_relaxed);
+            bi++;
+        }
+        tpuCurf(c, "%s_bucket{%s%sle=\"%llu\"} %llu\n", family, labels,
+                sep, (unsigned long long)g_promLe[li],
+                (unsigned long long)cum);
+    }
+    tpuCurf(c, "%s_bucket{%s%sle=\"+Inf\"} %llu\n", family, labels, sep,
+            (unsigned long long)count);
+    if (labels[0]) {
+        tpuCurf(c, "%s_sum{%s} %llu\n", family, labels,
+                (unsigned long long)atomic_load_explicit(
+                    &h->sum, memory_order_relaxed));
+        tpuCurf(c, "%s_count{%s} %llu\n", family, labels,
+                (unsigned long long)count);
+    } else {
+        tpuCurf(c, "%s_sum %llu\n", family,
+                (unsigned long long)atomic_load_explicit(
+                    &h->sum, memory_order_relaxed));
+        tpuCurf(c, "%s_count %llu\n", family,
+                (unsigned long long)count);
+    }
+}
+
 static void prom_site_name(uint32_t site, char *out, size_t outSize)
 {
     const char *n = g_sites[site].name;
@@ -580,28 +694,11 @@ size_t tpurmTraceRenderProm(char *buf, size_t bufSize)
         if (count == 0)
             continue;
         char metric[64];
+        char family[80];
         prom_site_name(s, metric, sizeof(metric));
-        tpuCurf(&c, "# TYPE tpurm_%s_ns histogram\n", metric);
-        uint64_t cum = 0;
-        uint32_t bi = 0;
-        for (size_t li = 0; li < PROM_LE_COUNT; li++) {
-            while (bi < TPU_HIST_BUCKETS &&
-                   tpuHistBucketLow(bi) <= g_promLe[li]) {
-                cum += atomic_load_explicit(&h->buckets[bi],
-                                            memory_order_relaxed);
-                bi++;
-            }
-            tpuCurf(&c, "tpurm_%s_ns_bucket{le=\"%llu\"} %llu\n", metric,
-                     (unsigned long long)g_promLe[li],
-                     (unsigned long long)cum);
-        }
-        tpuCurf(&c, "tpurm_%s_ns_bucket{le=\"+Inf\"} %llu\n", metric,
-                 (unsigned long long)count);
-        tpuCurf(&c, "tpurm_%s_ns_sum %llu\n", metric,
-                 (unsigned long long)atomic_load_explicit(
-                     &h->sum, memory_order_relaxed));
-        tpuCurf(&c, "tpurm_%s_ns_count %llu\n", metric,
-                 (unsigned long long)count);
+        snprintf(family, sizeof(family), "tpurm_%s_ns", metric);
+        tpuCurf(&c, "# TYPE %s histogram\n", family);
+        tpuPromHistRows(&c, h, family, NULL);
     }
     return c.off;
 }

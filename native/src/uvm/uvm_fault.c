@@ -31,6 +31,7 @@
  */
 #define _GNU_SOURCE
 #include "uvm_internal.h"
+#include "tpurm/flow.h"
 #include "tpurm/health.h"
 #include "tpurm/inject.h"
 #include "tpurm/memring.h"
@@ -890,6 +891,16 @@ static void service_cancel(UvmFaultEntry *e);
 TpuStatus uvmFaultServiceExec(void *entryPtr)
 {
     UvmFaultEntry *e = entryPtr;
+    /* tpuflow: service under the entry's request identity, so nested
+     * engine spans (migrate copies, ce stripes) carry it.  Blame: CPU
+     * demand faults charge the fault-service bucket; device faults
+     * are the body of a staged PREFETCH whose exec layer already
+     * charges the copy bucket — charging both would double-count. */
+    uint64_t prevFlow = 0;
+    if (e->flow) {
+        prevFlow = tpurmTraceFlowGet();
+        tpurmTraceFlowSet(e->flow);
+    }
     uint64_t tSvc = uvmMonotonicNs();
     e->serviceStatus = service_with_retry(e);
     uint64_t tSvcEnd = uvmMonotonicNs();
@@ -897,6 +908,11 @@ TpuStatus uvmFaultServiceExec(void *entryPtr)
                   tSvcEnd - tSvc);
     tpurmTraceEventAt(TPU_TRACE_FAULT_SERVICE, tSvc, tSvcEnd, e->addr,
                       e->len);
+    if (e->flow) {
+        tpurmTraceFlowSet(prevFlow);
+        if (e->source == UVM_FAULT_SRC_CPU)
+            tpurmFlowAccount(e->flow, TPU_FLOW_B_FAULT, tSvcEnd - tSvc);
+    }
     if (e->serviceStatus != TPU_OK)
         service_cancel(e);
     if (e->source == UVM_FAULT_SRC_CPU)
@@ -1202,6 +1218,8 @@ static void *fault_service_thread(void *arg)
                 sqes[ns].addr = (uint64_t)(uintptr_t)e;
                 sqes[ns].len = e->len ? e->len : 1;
                 sqes[ns].userData = e->addr;
+                sqes[ns].flowId = e->flow;   /* request identity rides
+                                              * the spine SQE */
                 for (uint32_t j = ns; j-- > 0;) {
                     if (blockOf[j] == blockIdx && vsOf[j] == e->vs) {
                         tpurmMemringSqeDep(
@@ -1230,6 +1248,7 @@ static void *fault_service_thread(void *arg)
                 sqes[0].addr = (uint64_t)(uintptr_t)e;
                 sqes[0].len = e->len ? e->len : 1;
                 sqes[0].userData = e->addr;
+                sqes[0].flowId = e->flow;
                 tpurmMemringSubmitInternal(NULL, sqes, 1, NULL,
                                            TPU_MEMRING_SUBSYS_FAULT);
             }
@@ -1313,6 +1332,7 @@ static void *fault_service_thread(void *arg)
                     fs.addr = (uint64_t)(uintptr_t)extra;
                     fs.len = extra->len ? extra->len : 1;
                     fs.userData = extra->addr;
+                    fs.flowId = extra->flow;
                     tpurmMemringSubmitInternal(NULL, &fs, 1, NULL,
                                                TPU_MEMRING_SUBSYS_FAULT);
                     if (extra->serviceStatus == (TpuStatus)~0u)
@@ -1582,6 +1602,9 @@ static void segv_handler(int sig, siginfo_t *si, void *uctx)
         .devInst = 0,
         .vs = vs,
         .enqueueNs = uvmMonotonicNs(),
+        /* Faulting thread's request identity (initial-exec TLS: no
+         * lazy allocation inside the signal handler). */
+        .flow = tpurmTraceFlowGet(),
         .serviceStatus = (TpuStatus)~0u,
         .doneWord = &done,
     };
@@ -1672,6 +1695,11 @@ static TpuStatus sync_push_and_wait(UvmFaultEntry *e)
     uint32_t done = 0;
     e->doneWord = &done;
     e->enqueueNs = uvmMonotonicNs();
+    /* tpuflow: callers that built the entry without an identity
+     * inherit the submitting thread's flow context (device accesses
+     * issued under a flow-scoped PREFETCH exec, sched-side touches). */
+    if (!e->flow)
+        e->flow = tpurmTraceFlowGet();
     e->serviceStatus = (TpuStatus)~0u;
     ring_push(worker_for(e->addr), e);
     return sync_wait_entry(e, &done);
@@ -1682,6 +1710,10 @@ TpuStatus uvmFaultServiceSync(UvmFaultEntry *e)
     uvmFaultEngineInit();
     if (!g_fault.ready)
         return TPU_ERR_INVALID_STATE;
+    /* tpuflow: stamp the submitting thread's identity HERE so the
+     * multi-block split below inherits it too (subs copy *e). */
+    if (!e->flow)
+        e->flow = tpurmTraceFlowGet();
 
     /* Worker assignment is per 2 MB block; a span crossing blocks that
      * hash to different workers is SPLIT into per-block sub-entries so

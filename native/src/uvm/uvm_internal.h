@@ -541,6 +541,13 @@ typedef struct UvmFaultEntry {
     uint32_t devInst;                 /* device faults */
     UvmVaSpace *vs;                   /* NULL: resolved via snapshot */
     uint64_t enqueueNs;
+    /* tpuflow identity captured from the FAULTING thread
+     * (tpurmTraceFlowGet; initial-exec TLS, so the signal handler may
+     * read it).  Carried into the OP_FAULT SQE's flowId, set as the
+     * service worker's thread flow around execution, and — for CPU
+     * demand faults — accounted into the flow's fault-service blame
+     * bucket. */
+    uint64_t flow;
     TpuStatus serviceStatus;
     /* Waiter futex word (0 pending, 1 done, 2 failed). */
     uint32_t *doneWord;

@@ -1,0 +1,200 @@
+/*
+ * tpuflow test: flow-id ABI arithmetic, open/account/close ledger
+ * semantics (hop masking, unmatched drops, bucket-sum <= wall),
+ * per-tenant SLO histograms (batched feed, quantiles, counts), the
+ * blame-ordered report, and the Prometheus/proc render shapes.
+ */
+#define _GNU_SOURCE
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "tpurm/flow.h"
+
+extern uint64_t tpurmCounterGet(const char *name);
+extern size_t tpurmProcfsRead(const char *path, char *buf, size_t n);
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+static int test_flow_id_abi(void)
+{
+    uint64_t f = tpurmFlowMint(7, 0xABCD1234u);
+    CHECK(TPU_FLOW_TENANT(f) == 7);
+    CHECK(TPU_FLOW_REQUEST(f) == 0xABCD1234u);
+    CHECK(TPU_FLOW_HOP(f) == 0);
+    uint64_t h3 = TPU_FLOW_WITH_HOP(f, 3);
+    CHECK(TPU_FLOW_HOP(h3) == 3);
+    CHECK(TPU_FLOW_KEY(h3) == TPU_FLOW_KEY(f));
+    CHECK(TPU_FLOW_TENANT(h3) == 7);
+    /* Tenant/request saturate into their fields, never bleed. */
+    uint64_t g = tpurmFlowMint(0x1FFFF, 0);
+    CHECK(TPU_FLOW_TENANT(g) == 0xFFFF);
+    CHECK(TPU_FLOW_REQUEST(g) == 0);
+    return 0;
+}
+
+static int test_ledger_and_blame(void)
+{
+    tpurmFlowResetAll();
+    uint64_t f = tpurmFlowMint(3, 42);
+    CHECK(tpurmFlowOpen(f) == TPU_OK);
+    CHECK(tpurmFlowOpen(f) == TPU_OK);            /* idempotent */
+
+    /* Accounting via a HOPPED id lands on the same ledger. */
+    tpurmFlowAccount(f, TPU_FLOW_B_QUEUED, 1000000);
+    tpurmFlowAccount(TPU_FLOW_WITH_HOP(f, 2), TPU_FLOW_B_ICI, 500000);
+    tpurmFlowAccount(f, TPU_FLOW_B_COPY, 250000);
+    tpurmFlowTokens(f, 16);
+
+    /* Unmatched keys drop, never invent ledger entries. */
+    uint64_t before = tpurmCounterGet("tpurm_flows_opened");
+    tpurmFlowAccount(tpurmFlowMint(9, 999), TPU_FLOW_B_COPY, 777);
+    CHECK(tpurmCounterGet("tpurm_flows_opened") == before);
+
+    struct timespec ts = { 0, 2000000 };          /* ensure wall > 0 */
+    nanosleep(&ts, NULL);
+    uint64_t wall = 0;
+    CHECK(tpurmFlowClose(f, &wall) == TPU_OK);
+    CHECK(wall > 0);
+
+    TpuFlowRec recs[8];
+    uint32_t n = tpurmFlowReport(recs, 8);
+    CHECK(n == 1);
+    CHECK(recs[0].flow == TPU_FLOW_KEY(f));
+    CHECK(recs[0].tenant == 3);
+    CHECK(recs[0].state == 2);
+    CHECK(recs[0].tokens == 16);
+    CHECK(recs[0].bucketNs[TPU_FLOW_B_QUEUED] == 1000000);
+    CHECK(recs[0].bucketNs[TPU_FLOW_B_ICI] == 500000);
+    CHECK(recs[0].bucketNs[TPU_FLOW_B_COPY] == 250000);
+    CHECK(recs[0].wallNs == wall);
+    /* Soundness: what this test accounted fits inside the wall. */
+    uint64_t bucketSum = 0;
+    for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+        bucketSum += recs[0].bucketNs[b];
+    CHECK(bucketSum <= recs[0].wallNs);
+
+    /* Per-tenant blame mirrors the bucket adds. */
+    CHECK(tpurmSloBlameNs(3, TPU_FLOW_B_QUEUED) == 1000000);
+    CHECK(tpurmSloBlameNs(3, TPU_FLOW_B_ICI) == 500000);
+    return 0;
+}
+
+static int test_report_ordering(void)
+{
+    tpurmFlowResetAll();
+    for (uint32_t i = 0; i < 5; i++) {
+        uint64_t f = tpurmFlowMint(1, 100 + i);
+        CHECK(tpurmFlowOpen(f) == TPU_OK);
+        /* Blame grows with i: the report must come back descending. */
+        tpurmFlowAccount(f, TPU_FLOW_B_PREEMPTED, (i + 1) * 10000ull);
+    }
+    TpuFlowRec recs[8];
+    uint32_t n = tpurmFlowReport(recs, 8);
+    CHECK(n == 5);
+    for (uint32_t i = 1; i < n; i++) {
+        uint64_t prev = recs[i - 1].bucketNs[TPU_FLOW_B_PREEMPTED];
+        uint64_t cur = recs[i].bucketNs[TPU_FLOW_B_PREEMPTED];
+        CHECK(prev >= cur);
+    }
+    CHECK(recs[0].bucketNs[TPU_FLOW_B_PREEMPTED] == 50000);
+    /* max smaller than the population truncates, keeping the top. */
+    TpuFlowRec top2[2];
+    CHECK(tpurmFlowReport(top2, 2) == 2);
+    CHECK(top2[0].bucketNs[TPU_FLOW_B_PREEMPTED] == 50000);
+    CHECK(top2[1].bucketNs[TPU_FLOW_B_PREEMPTED] == 40000);
+    return 0;
+}
+
+static int test_slo_hists(void)
+{
+    tpurmFlowResetAll();
+    /* Batched feed: 100 samples at 2ms + a 5-sample tail at 100ms. */
+    tpurmSloRecordN(5, TPU_SLO_ITL, 2000000, 100);
+    tpurmSloRecordN(5, TPU_SLO_ITL, 100000000, 5);
+    tpurmSloRecord(5, TPU_SLO_TTFT, 30000000);
+    CHECK(tpurmSloCount(5, TPU_SLO_ITL) == 105);
+    CHECK(tpurmSloCount(5, TPU_SLO_TTFT) == 1);
+    uint64_t p50 = tpurmSloQuantileNs(5, TPU_SLO_ITL, 0.50);
+    CHECK(p50 > 1900000 && p50 < 2100000);
+    uint64_t p99 = tpurmSloQuantileNs(5, TPU_SLO_ITL, 0.99);
+    CHECK(p99 > 90000000);
+    /* Other tenants stay empty (per-tenant isolation). */
+    CHECK(tpurmSloCount(6, TPU_SLO_ITL) == 0);
+    return 0;
+}
+
+static int test_renders(void)
+{
+    tpurmFlowResetAll();
+    uint64_t f = tpurmFlowMint(2, 7);
+    CHECK(tpurmFlowOpen(f) == TPU_OK);
+    tpurmFlowAccount(f, TPU_FLOW_B_FAULT, 123456);
+    tpurmFlowTokens(f, 4);
+    tpurmSloRecordN(2, TPU_SLO_ITL, 3000000, 4);
+    tpurmSloRecord(2, TPU_SLO_TTFT, 8000000);
+
+    enum { CAP = 1 << 20 };
+    char *buf = malloc(CAP);
+    CHECK(buf);
+
+    size_t n = tpurmProcfsRead("/proc/driver/tpurm/metrics", buf, CAP);
+    CHECK(n > 0);
+    buf[n] = '\0';
+    CHECK(strstr(buf, "# TYPE tpurm_slo_ttft_ns histogram"));
+    CHECK(strstr(buf, "# TYPE tpurm_slo_itl_ns histogram"));
+    CHECK(strstr(buf, "tpurm_slo_itl_ns_count{tenant=\"2\"} 4"));
+    CHECK(strstr(buf, "tpurm_slo_ttft_ns_count{tenant=\"2\"} 1"));
+    CHECK(strstr(buf, "tpurm_slo_itl_ns_bucket{tenant=\"2\",le=\"+Inf\"} 4"));
+    CHECK(strstr(buf,
+                 "tpurm_slo_blame_ns{tenant=\"2\",bucket=\"fault\"} 123456"));
+    CHECK(strstr(buf, "tpurm_flows_open 1"));
+
+    n = tpurmProcfsRead("/proc/driver/tpurm/flows", buf, CAP);
+    CHECK(n > 0);
+    buf[n] = '\0';
+    CHECK(strstr(buf, "tenant"));
+    CHECK(strstr(buf, "queued"));
+    CHECK(strstr(buf, "0x"));                     /* the flow row */
+    free(buf);
+    return 0;
+}
+
+static int test_bucket_names(void)
+{
+    const char *seen[TPU_FLOW_B_COUNT];
+    for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++) {
+        const char *nm = tpurmFlowBucketName(b);
+        CHECK(nm && nm[0]);
+        for (uint32_t j = 0; j < b; j++)
+            CHECK(strcmp(seen[j], nm) != 0);
+        seen[b] = nm;
+    }
+    CHECK(tpurmFlowBucketName(TPU_FLOW_B_COUNT) == NULL);
+    return 0;
+}
+
+int main(void)
+{
+    if (test_flow_id_abi())
+        return 1;
+    if (test_ledger_and_blame())
+        return 1;
+    if (test_report_ordering())
+        return 1;
+    if (test_slo_hists())
+        return 1;
+    if (test_renders())
+        return 1;
+    if (test_bucket_names())
+        return 1;
+    tpurmFlowResetAll();
+    printf("flow_test OK\n");
+    return 0;
+}

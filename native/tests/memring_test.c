@@ -15,6 +15,7 @@
 #include <sys/mman.h>
 #include <time.h>
 
+#include "tpurm/flow.h"
 #include "tpurm/inject.h"
 #include "tpurm/memring.h"
 #include "tpurm/tpurm.h"
@@ -432,6 +433,76 @@ static int test_batched_migrate(void)
     tpurmMemringDestroy(r);
     CHECK(uvmMemFree(vs, p) == TPU_OK);
     uvmVaSpaceDestroy(vs);
+    return 0;
+}
+
+/* tpuflow propagation: SQEs carrying a flowId charge the flow's COPY
+ * blame bucket at the exec layer (merged runs split by len share),
+ * worker threads execute under the flow context, and the closed
+ * ledger's bucket sum stays within its wall. */
+static int test_flow_propagation(void)
+{
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+    enum { N = 8 };
+    void *p;
+    CHECK(uvmMemAlloc(vs, N * SPAN, &p) == TPU_OK);
+    memset(p, 0x33, N * SPAN);
+
+    tpurmFlowResetAll();
+    uint64_t fa = tpurmFlowMint(1, 1001);
+    uint64_t fb = tpurmFlowMint(2, 1002);
+    CHECK(tpurmFlowOpen(fa) == TPU_OK);
+    CHECK(tpurmFlowOpen(fb) == TPU_OK);
+
+    TpuMemring *r;
+    CHECK(tpurmMemringCreate(vs, 64, 2, &r) == TPU_OK);
+    /* Interleave two flows over one contiguous span: the coalescer
+     * may merge across flows — attribution must still split. */
+    for (int i = 0; i < N; i++) {
+        TpuMemringSqe s = sqe_migrate((char *)p + i * SPAN, SPAN,
+                                      UVM_TIER_HBM, 0, 100 + i);
+        s.flowId = (i % 2) ? fb : fa;
+        CHECK(tpurmMemringPrep(r, &s) == TPU_OK);
+    }
+    CHECK(tpurmMemringSubmitAndWait(r, N, NULL) == N);
+    TpuMemringCqe cq[N];
+    CHECK(tpurmMemringReap(r, cq, N) == N);
+    for (int i = 0; i < N; i++)
+        CHECK(cq[i].status == TPU_OK);
+
+    uint64_t wallA = 0, wallB = 0;
+    CHECK(tpurmFlowClose(fa, &wallA) == TPU_OK);
+    CHECK(tpurmFlowClose(fb, &wallB) == TPU_OK);
+
+    TpuFlowRec recs[4];
+    uint32_t n = tpurmFlowReport(recs, 4);
+    CHECK(n == 2);
+    uint64_t copyA = 0, copyB = 0;
+    for (uint32_t i = 0; i < n; i++) {
+        uint64_t sum = 0;
+        for (uint32_t b = 0; b < TPU_FLOW_B_COUNT; b++)
+            sum += recs[i].bucketNs[b];
+        /* Both flows moved bytes: copy blame accrued, inside wall.
+         * (One claim batch executes runs serially on <= 2 workers;
+         * each flow's exec share cannot exceed its open window.) */
+        CHECK(recs[i].bucketNs[TPU_FLOW_B_COPY] > 0);
+        CHECK(sum <= recs[i].wallNs);
+        if (recs[i].flow == TPU_FLOW_KEY(fa))
+            copyA = recs[i].bucketNs[TPU_FLOW_B_COPY];
+        if (recs[i].flow == TPU_FLOW_KEY(fb))
+            copyB = recs[i].bucketNs[TPU_FLOW_B_COPY];
+    }
+    CHECK(copyA > 0 && copyB > 0);
+    /* Per-tenant blame mirrors (tenants 1 and 2). */
+    CHECK(tpurmSloBlameNs(1, TPU_FLOW_B_COPY) == copyA);
+    CHECK(tpurmSloBlameNs(2, TPU_FLOW_B_COPY) == copyB);
+
+    tpurmMemringDestroy(r);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    uvmVaSpaceDestroy(vs);
+    tpurmFlowResetAll();
     return 0;
 }
 
@@ -995,6 +1066,8 @@ int main(void)
     if (test_dep_cancel_on_error())
         return 1;
     if (test_batched_migrate())
+        return 1;
+    if (test_flow_propagation())
         return 1;
     if (test_link_chains())
         return 1;

@@ -163,6 +163,123 @@ static int test_prom_render(void)
     return 0;
 }
 
+/* Site-name table self-check: EVERY site id below TPU_TRACE_SITE_COUNT
+ * must be named and categorized, names must be unique and dotted
+ * (subsystem.event).  A site added without a table row would export
+ * anonymous spans — this is the audit that keeps the table in sync
+ * with every site added since the tracing subsystem landed
+ * (memring.chain/depwait, sched.*, health.transition, vac.*, ...). */
+static int test_site_table_complete(void)
+{
+    const char *names[TPU_TRACE_SITE_COUNT];
+    for (uint32_t s = 0; s < TPU_TRACE_SITE_COUNT; s++) {
+        const char *name = tpurmTraceSiteName(s);
+        const char *cat = tpurmTraceSiteCat(s);
+        if (!name || !name[0]) {
+            fprintf(stderr, "FAIL: trace site %u is UNNAMED (add it to "
+                            "the g_sites table in trace.c)\n", s);
+            return 1;
+        }
+        if (!cat || !cat[0]) {
+            fprintf(stderr, "FAIL: trace site %u (%s) has no Perfetto "
+                            "category\n", s, name);
+            return 1;
+        }
+        CHECK(strchr(name, '.') != NULL);
+        for (uint32_t j = 0; j < s; j++) {
+            if (strcmp(names[j], name) == 0) {
+                fprintf(stderr, "FAIL: trace sites %u and %u share the "
+                                "name %s\n", j, s, name);
+                return 1;
+            }
+        }
+        names[s] = name;
+    }
+    /* Past the table: NULL, never garbage. */
+    CHECK(tpurmTraceSiteName(TPU_TRACE_SITE_COUNT) == NULL);
+    CHECK(tpurmTraceSiteCat(TPU_TRACE_SITE_COUNT) == NULL);
+    /* Sites the serving stack added after the original table — the
+     * exact regression this check exists for. */
+    int found = 0;
+    static const char *want[] = { "memring.chain", "memring.depwait",
+                                  "sched.round", "sched.admit",
+                                  "sched.preempt", "health.transition",
+                                  "vac.migrate" };
+    for (unsigned w = 0; w < sizeof(want) / sizeof(want[0]); w++)
+        for (uint32_t s = 0; s < TPU_TRACE_SITE_COUNT; s++)
+            if (strcmp(names[s], want[w]) == 0) {
+                found++;
+                break;
+            }
+    CHECK(found == (int)(sizeof(want) / sizeof(want[0])));
+    return 0;
+}
+
+/* Flow context: spans emitted under a thread flow stamp it into the
+ * record; the export renders a "flow" arg plus Perfetto flow events
+ * ("s" at a sched.admit span's end, "f" bind-enclosing at every other
+ * flow-carrying span's start) with the hop-masked key as the id. */
+static int test_flow_events_in_export(void)
+{
+    tpurmTraceStart();
+    tpurmTraceReset();
+
+    uint64_t flow = (7ull << 48) | (42ull << 16);     /* tenant 7, req 42 */
+    tpurmTraceFlowSet(flow);
+    CHECK(tpurmTraceFlowGet() == flow);
+    /* An admit span (flow start) and a worker-shaped span (flow end). */
+    uint64_t t0 = tpurmTraceNowNs();
+    tpurmTraceSpanAt(TPU_TRACE_SCHED_ADMIT, t0, t0 + 1000, 42, 0);
+    tpurmTraceSpanAt(TPU_TRACE_MEMRING_OP, t0 + 2000, t0 + 3000, 1, 64);
+    /* A hopped id must render the SAME flow-event id. */
+    tpurmTraceFlowSet(flow | 3);
+    tpurmTraceSpanAt(TPU_TRACE_ICI_COPY, t0 + 4000, t0 + 5000, 2, 64);
+    tpurmTraceFlowSet(0);
+    /* Flow-free span: no flow arg, no flow event. */
+    tpurmTraceSpanAt(TPU_TRACE_RDMA_PIN, t0 + 6000, t0 + 7000, 3, 0);
+
+    size_t cap = 1u << 20;
+    char *buf = malloc(cap);
+    CHECK(buf);
+    size_t n = tpurmTraceExportJson(buf, cap);
+    CHECK(n > 0);
+    buf[n] = '\0';
+
+    char idStr[64];
+    snprintf(idStr, sizeof(idStr), "\"id\":\"0x%llx\"",
+             (unsigned long long)flow);
+    /* One "s" (admit) + two "f" (memring.op, hopped ici.copy), all
+     * with the hop-masked id. */
+    int s_events = 0, f_events = 0, ids = 0;
+    for (char *p = buf; (p = strstr(p, "\"ph\":\"s\"")) != NULL; p++)
+        s_events++;
+    for (char *p = buf; (p = strstr(p, "\"ph\":\"f\"")) != NULL; p++)
+        f_events++;
+    for (char *p = buf; (p = strstr(p, idStr)) != NULL; p++)
+        ids++;
+    CHECK(s_events == 1);
+    CHECK(f_events == 2);
+    CHECK(ids == 3);
+    /* Spans carry the flow arg; the hopped span keeps its hop there. */
+    char flowArg[64];
+    snprintf(flowArg, sizeof(flowArg), "\"flow\":\"0x%llx\"",
+             (unsigned long long)flow);
+    CHECK(strstr(buf, flowArg));
+    char hopArg[64];
+    snprintf(hopArg, sizeof(hopArg), "\"flow\":\"0x%llx\"",
+             (unsigned long long)(flow | 3));
+    CHECK(strstr(buf, hopArg));
+    /* The flow-free span has no flow arg on its line. */
+    char *pin = strstr(buf, "rdma.pin");
+    CHECK(pin);
+    char *end = strchr(pin, '}');
+    CHECK(end && !memmem(pin, (size_t)(end - pin), "flow", 4));
+    free(buf);
+    tpurmTraceStop();
+    tpurmTraceReset();
+    return 0;
+}
+
 /* The O(1) hash index must resolve every name to the same cell the
  * insertion-order scan (tpurmCounterGet) finds. */
 static int test_counter_hash_agrees_with_scan(void)
@@ -190,6 +307,10 @@ int main(void)
      * before the first emission creates this thread's ring. */
     setenv("TPUMEM_TRACE_RING", "1024", 1);
 
+    if (test_site_table_complete())
+        return 1;
+    if (test_flow_events_in_export())
+        return 1;
     if (test_hist_quantile_error())
         return 1;
     if (test_ring_wrap_and_drops())

@@ -338,21 +338,28 @@ class ManagedKVBacking:
             self.ring = memring.MemRing(self.vs, entries=512)
         except Exception:
             self.ring = None        # fall back to the sync loop
+        # tpuflow page->flow resolver (optional): when set (the
+        # scheduler installs Scheduler._flow_of_page), every page's
+        # prefetch SQEs carry the owning request's flow id — the
+        # worker that faults the page executes under that identity
+        # (Perfetto flow linking + copy-bucket blame).
+        self.flow_of_page = None
 
     def _ring_fault_pages(self, pages: List[int]) -> None:
         """One batched prefetch pass over ``pages`` (both pools)."""
         n = 0
         for page in pages:
             off = page * self.rec_bytes
+            fl = self.flow_of_page(page) if self.flow_of_page else 0
             if self.ring.sq_space < 2:
                 # Giant group: flush a full SQ wave and keep going.
                 self.ring.submit_and_wait(n)
                 self.ring.completions(max_cqes=max(n, 64), check=True)
                 n = 0
             self.ring.prefetch(self.k_buf.address + off,
-                               self.rec_bytes, dev=self.dev)
+                               self.rec_bytes, dev=self.dev, flow=fl)
             self.ring.prefetch(self.v_buf.address + off,
-                               self.rec_bytes, dev=self.dev)
+                               self.rec_bytes, dev=self.dev, flow=fl)
             n += 2
         self.ring.submit_and_wait(n)
         self.ring.completions(max_cqes=max(n, 64), check=True)

@@ -45,6 +45,19 @@ Observability: ``sched.round`` / ``sched.admit`` / ``sched.preempt``
 tputrace spans (arm with ``utils.trace_start()``) and ``tpusched_*``
 counters in the Prometheus exposition (/proc/driver/tpurm/metrics).
 
+Request-flow tracing (tpuflow, native/src/flow.c): every admitted
+request mints a FLOW ID (tenant << 48 | rid << 16) that rides the
+memring SQEs its pages travel on (restore prefetches, read_pages
+faults), the CPU faults its prefill takes (thread flow context), and
+every trace span those emit — so the Perfetto export links the
+admission to the exact worker threads that moved the request's bytes.
+The scheduler accounts the blame buckets only it can see — queued
+(submit -> admit), preempted parks, reset blackouts — while the native
+exec layers account fault/copy/ici time per flow; per-tenant TTFT and
+inter-token-latency histograms feed ``tpurm_slo_*{tenant=}`` series,
+and ``utils.flow_report()`` / /proc/driver/tpurm/flows rank the
+slowest live flows with their per-bucket millisecond split.
+
 The streams are SIMULATED (prompts in, greedy tokens out) — the point
 is the scheduling policy and its interaction with the memory stack,
 not an RPC front end.
@@ -155,8 +168,14 @@ class Request:
                                     # to round granularity internally)
     tokens: Optional[np.ndarray] = None   # [max_new_tokens] on finish
     preempts: int = 0
+    flow: int = 0                   # tpuflow id, minted at admission
     _chunks: List[np.ndarray] = dataclasses.field(default_factory=list)
     _token_lat_s: List[float] = dataclasses.field(default_factory=list)
+    _submit_ns: int = 0             # queue entry (monotonic ns)
+    _last_emit_ns: int = 0          # last token emission
+    _park_ns: int = 0               # preempt park start (0 = running)
+    _park_reset: bool = False       # park caused by a device reset
+    _queued_charged: bool = False   # queued wait accounted (1st admit)
 
     @property
     def token_latencies_s(self) -> List[float]:
@@ -195,9 +214,11 @@ class Scheduler:
                  page_size: int = 64, oversub: int = 1,
                  tokens_per_round: int = 8,
                  admit_retries: int = 3,
-                 cache: Optional[serving.TieredKVCache] = None):
+                 cache: Optional[serving.TieredKVCache] = None,
+                 blame_tokens: bool = False):
         from ..uvm import inject as _inject
         from ..uvm import reset as _reset
+        from .. import utils as _utils
 
         self.cfg = cfg
         self.params = params
@@ -243,6 +264,22 @@ class Scheduler:
         # Per-evacuation blackout windows (park -> manifest commit), in
         # seconds — the bench's vac_blackout_ms_p50/p95 source.
         self.evac_blackouts_s: List[float] = []
+        # tpuflow: the utils surface (flow mint/open/account, SLO
+        # feed) plus per-page flow resolution for the backing's
+        # batched fault pass (ManagedKVBacking.read_pages stamps each
+        # page's prefetch SQE with its owning request's flow).
+        self._utils = _utils
+        backing = self.cache.backing
+        if hasattr(backing, "flow_of_page"):
+            backing.flow_of_page = self._flow_of_page
+        # Optional per-token blame capture (bench): records, for every
+        # emitted token gap, the stall-inclusive ITL and the blame
+        # deltas that landed in it — the source of the "where did the
+        # p99 token's milliseconds go" breakdown.  Off by default: one
+        # flow_report + dict diff per round.
+        self._blame_tokens = blame_tokens
+        self.token_blame: List[Dict] = []
+        self._blame_snap: Dict[int, Dict[str, int]] = {}
 
     # ------------------------------------------------------------ tenants
 
@@ -272,6 +309,33 @@ class Scheduler:
     def _tenant(self, tid: int) -> SchedTenant:
         return self.tenants.get(tid) or self.tenants[0]
 
+    # ---------------------------------------------------------- tpuflow
+
+    def _flow_of_page(self, page: int) -> int:
+        """Flow id owning a backing page (slot-pool layout: seq-major),
+        0 when the page's slot has no running request.  Installed as
+        the ManagedKVBacking.flow_of_page hook so read_pages stamps
+        each page's prefetch SQEs with the request they fault for."""
+        req = self._running.get(page // self.cache.pages_per_seq)
+        return req.flow if req is not None else 0
+
+    def _park_account(self, req: Request) -> None:
+        """Close a preemption park window: charge preempted (or
+        reset-blackout when the park came from a device reset).  The
+        window runs from the preempt to the stream's NEXT TOKEN — the
+        latency the preemption actually cost the stream, restore
+        warm-up and re-dispatch wait included (the restore's copy time
+        is also charged to the copy bucket: a few ms of overlap inside
+        a window of hundreds, bounded by the wall invariant)."""
+        if not req._park_ns:
+            return
+        ns = time.monotonic_ns() - req._park_ns
+        bucket = "reset" if req._park_reset else "preempted"
+        if req.flow:
+            self._utils.flow_account(req.flow, bucket, ns)
+        req._park_ns = 0
+        req._park_reset = False
+
     # ------------------------------------------------------------ intake
 
     def submit(self, prompt, max_new_tokens: int,
@@ -288,6 +352,12 @@ class Scheduler:
                 f"({self.max_len})")
         req = Request(rid=self._next_rid, prompt=prompt,
                       max_new_tokens=max_new_tokens, tenant=tenant)
+        req._submit_ns = time.monotonic_ns()
+        # tpuflow: the ledger opens at SUBMIT — its wall covers the
+        # queued wait, so a closed flow's bucket sum (which includes
+        # queued) stays within wall by construction.
+        req.flow = self._utils.flow_mint(tenant, self._next_rid)
+        self._utils.flow_open(req.flow)
         self._next_rid += 1
         self._by_rid[req.rid] = req
         self._queue.append(req)
@@ -304,15 +374,20 @@ class Scheduler:
             self._queue.remove(req)
         elif req.state is RequestState.RUNNING:
             del self._running[req.seq]
+            # Restored but cancelled before emitting: close the park.
+            self._park_account(req)
             self.cache.release_sequence(req.seq)
             self._free_seqs.append(req.seq)
             req.seq = None
         elif req.state is RequestState.PREEMPTED:
             self._preempted.remove(req)
+            self._park_account(req)
             self.cache.release_sequence(req.seq)
             self._free_seqs.append(req.seq)
             req.seq = None
         req.state = RequestState.CANCELLED
+        if req.flow:
+            self._utils.flow_close(req.flow)
         self.stats["cancelled"] += 1
         _counter_add("tpusched_cancelled")
         return True
@@ -395,8 +470,10 @@ class Scheduler:
             return
         span = npages * backing.rec_bytes
         off = first * backing.rec_bytes
-        self._pending_evicts.append((backing.k_buf.address + off, span))
-        self._pending_evicts.append((backing.v_buf.address + off, span))
+        self._pending_evicts.append((backing.k_buf.address + off, span,
+                                     req.flow))
+        self._pending_evicts.append((backing.v_buf.address + off, span,
+                                     req.flow))
 
     def _flush_evicts(self, ring) -> None:
         """Publish leftover staged evicts (no restore fused them this
@@ -407,32 +484,45 @@ class Scheduler:
             return
         from ..uvm.managed import Tier
         try:
-            for addr, span in evicts:
+            for addr, span, fl in evicts:
                 if ring.sq_space < 1:
                     ring.submit_and_wait(None)
                     ring.completions(max_cqes=8192)
-                ring.evict(addr, span, Tier.CXL)
+                ring.evict(addr, span, Tier.CXL, flow=fl)
             ring.submit_and_wait(None)
             ring.completions(max_cqes=8192)
         except native.RmError:
             self._quiesce_ring(ring)
             _counter_add("tpusched_evict_errors")
 
-    def _preempt(self, req: Request) -> None:
+    def _preempt(self, req: Request, reset: bool = False) -> None:
         """Swap a sequence out: dirty pages flush to the backing (the
         seq keeps its slot index, i.e. its backing pages), device slots
         free, the request parks until a restore fits.  The victim's
         backing spans are STAGED for a fused EVICT->PREFETCH chain:
-        the next restore publishes demote-then-upload as one claim."""
-        with _span("sched.preempt", obj=req.rid):
-            # The scheduler's _cur_tok is the stream's truth (updated
-            # every round); only the KV pages need persisting.
-            self.cache.flush_group([req.seq])
-            self.cache.release_sequence(req.seq, keep_len=True)
-            self._stage_evicts(req)
+        the next restore publishes demote-then-upload as one claim.
+        ``reset=True`` marks the park as a device-reset blackout, so
+        the wait charges the flow's reset bucket, not preempted."""
+        self._utils.flow_set(req.flow)
+        try:
+            with _span("sched.preempt", obj=req.rid):
+                # The scheduler's _cur_tok is the stream's truth
+                # (updated every round); only the KV pages need
+                # persisting.
+                self.cache.flush_group([req.seq])
+                self.cache.release_sequence(req.seq, keep_len=True)
+                self._stage_evicts(req)
+        finally:
+            self._utils.flow_set(0)
         del self._running[req.seq]
         req.state = RequestState.PREEMPTED
         req.preempts += 1
+        # Keep the EARLIEST park start across restore->re-preempt
+        # ping-pong (the stream emitted nothing in between, so it is
+        # one blackout from its point of view); reset taint is sticky.
+        if req._park_ns == 0:
+            req._park_ns = time.monotonic_ns()
+        req._park_reset = req._park_reset or reset
         self._preempted.append(req)
         self.stats["preempted"] += 1
         _counter_add("tpusched_preempted")
@@ -476,6 +566,9 @@ class Scheduler:
         the backing ring, then to plain activation faulting."""
         backing = self.cache.backing
         ring = self._tier_ring_get() or getattr(backing, "ring", None)
+        # The park window stays OPEN through the restore: it closes at
+        # the stream's next token emission (step) or cancel — the full
+        # latency the preemption cost the stream.
         try:
             self._restore_prefetch(backing, ring, req)
         except native.RmError:
@@ -525,16 +618,19 @@ class Scheduler:
                            for base in own)
 
             evicts, self._pending_evicts = self._pending_evicts, []
-            kept = [(a, s) for a, s in evicts if not _own_span(a, s)]
+            kept = [(a, s, f) for a, s, f in evicts
+                    if not _own_span(a, s)]
             if kept:
                 _counter_add("tpusched_fused_evict_chains")
             evict_join = None
-            for addr, span in kept:
+            for addr, span, fl in kept:
                 if ring.sq_space < 1:
                     ring.submit_and_wait(None)
                     self._check_prefetch_cqes(ring.completions(
                         max_cqes=8192))
-                ring.evict(addr, span, Tier.CXL)
+                # Demotes charge the VICTIM's flow (its bytes moving),
+                # not the restored request's.
+                ring.evict(addr, span, Tier.CXL, flow=fl)
                 evict_join = ring.last_seq
             deps = ([_mr.dep(ring.ring_id, evict_join, ordered=True)]
                     if evict_join is not None else None)
@@ -553,7 +649,7 @@ class Scheduler:
                     self._check_prefetch_cqes(ring.completions(
                         max_cqes=8192))
                 ring.prefetch(addr, backing.rec_bytes, dev=backing.dev,
-                              deps=deps)
+                              deps=deps, flow=req.flow)
             ring.submit_and_wait(None)
             self._check_prefetch_cqes(ring.completions(max_cqes=8192))
 
@@ -593,6 +689,15 @@ class Scheduler:
         seq = self._free_seqs.pop(0)
         req.seq = seq
         self.cache.seq_lens[seq] = 0
+        # tpuflow: charge the queued wait at FIRST admission (the flow
+        # itself opened at submit).  The per-request sched.admit span
+        # is emitted under the flow context — it is the Perfetto flow
+        # START ("s") the worker-side spans terminate.
+        now_ns = time.monotonic_ns()
+        if not req._queued_charged:
+            req._queued_charged = True
+            queued = now_ns - req._submit_ns if req._submit_ns else 0
+            self._utils.flow_account(req.flow, "queued", queued)
         # Multichip pool: the slot's pages now charge to this tenant's
         # per-device columns (tpuvac rebinds them on migration).
         backing = self.cache.backing
@@ -601,8 +706,14 @@ class Scheduler:
             for pg in range(m):
                 backing.set_page_tenant(seq * m + pg, req.tenant)
         try:
-            serving.prefill_group(self.cfg, self.params, self.cache,
-                                  [seq], jnp.asarray(req.prompt[None, :]))
+            # Thread flow context: prefill's CPU faults + engine spans
+            # carry the request identity; the admit span below is the
+            # flow's "s" anchor in the export.
+            self._utils.flow_set(req.flow)
+            with _span("sched.admit", obj=req.rid):
+                serving.prefill_group(self.cfg, self.params, self.cache,
+                                      [seq],
+                                      jnp.asarray(req.prompt[None, :]))
         except native.RmError:
             # Transient backing fault that outlived the engine's own
             # bounded retries (chaos soak territory): the failed
@@ -615,6 +726,8 @@ class Scheduler:
                 self.stats.get("round_errors", 0) + 1
             _counter_add("tpusched_round_errors")
             return False
+        finally:
+            self._utils.flow_set(0)
         self._cur_tok[seq] = self.cache.last_token[seq]
         self._running[seq] = req
         req.state = RequestState.RUNNING
@@ -668,6 +781,8 @@ class Scheduler:
                 else np.zeros((0,), np.int32))
         req.tokens = toks[:req.max_new_tokens]
         req.state = RequestState.FINISHED
+        if req.flow:
+            self._utils.flow_close(req.flow)
         # Finished sequences free their pages IMMEDIATELY: cold-end LRU
         # reinsert means the next activation reclaims them first.
         self.cache.release_sequence(req.seq)
@@ -696,7 +811,7 @@ class Scheduler:
         for seq in list(self._running):
             req = self._running.get(seq)
             if req is not None:
-                self._preempt(req)
+                self._preempt(req, reset=True)
 
     # ------------------------------------------------------- evacuation
 
@@ -875,10 +990,77 @@ class Scheduler:
             dt = time.perf_counter() - t0
 
             per_tok = dt / tpr
+            emit_ns = time.monotonic_ns()
+            per_tok_ns = max(int(per_tok * 1e9), 1)
+            # Close park windows BEFORE snapshotting the ledgers: a
+            # restored stream's preempted/reset charge must land in
+            # THIS emission's blame delta, not the next one's.
+            for seq in ids:
+                self._park_account(self._running[seq])
+            blame_now = None
+            if self._blame_tokens:
+                blame_now = {f["flow"]: f["blame_ns"]
+                             for f in self._utils.flow_report(256)}
             for i, seq in enumerate(ids):
                 req = self._running[seq]
                 req._chunks.append(toks[:, i])
                 req._token_lat_s.extend([per_tok] * tpr)
+                # Per-tenant SLO feed (tpuflow): TTFT once, on the
+                # stream's first emitted token; ITL once per token —
+                # the round's tokens at the amortized per-token
+                # latency, except the FIRST token of the round, whose
+                # sample is the stall-inclusive gap since the stream's
+                # previous emission (queueing/preemption/reset waits
+                # between a stream's rounds surface in the ITL tail
+                # instead of hiding in aggregate wall time).  Counts
+                # reconcile exactly: itl_count(tenant) == tokens
+                # decoded for that tenant.
+                if req.decoded == 0 and req._submit_ns:
+                    self._utils.slo_record(
+                        req.tenant, "ttft", emit_ns - req._submit_ns)
+                # The blame record's gap is stall-INCLUSIVE back to the
+                # previous emission (or submit, for the first round):
+                # every bucket charged in between falls inside it.  The
+                # ITL sample for the round's first token carries the
+                # inter-round stall; the first round's tokens stay at
+                # the amortized rate (their wait is TTFT's, not ITL's).
+                base_ns = req._last_emit_ns or req._submit_ns or emit_ns
+                gap_ns = max(emit_ns - base_ns, tpr * per_tok_ns)
+                if req._last_emit_ns:
+                    stall_itl = max(gap_ns - (tpr - 1) * per_tok_ns,
+                                    per_tok_ns)
+                else:
+                    stall_itl = per_tok_ns
+                self._utils.slo_record(req.tenant, "itl", stall_itl)
+                if tpr > 1:
+                    self._utils.slo_record(req.tenant, "itl",
+                                           per_tok_ns, tpr - 1)
+                req._last_emit_ns = emit_ns
+                if req.flow:
+                    self._utils.flow_tokens(req.flow, tpr)
+                if blame_now is not None:
+                    key = req.flow & ~0xFFFF
+                    cur = blame_now.get(key, {})
+                    prev = self._blame_snap.get(key, {})
+                    # The native ledger is the SINGLE blame source
+                    # (the scheduler's own queued/park accounting
+                    # lands there through flow_account): the per-gap
+                    # breakdown is the ledger's delta since this
+                    # stream's previous emission.
+                    gap = {b: cur.get(b, 0) - prev.get(b, 0)
+                           for b in cur
+                           if cur.get(b, 0) > prev.get(b, 0)}
+                    self._blame_snap[key] = dict(cur)
+                    if len(self.token_blame) < 100000:
+                        # Coverage contract: blame_ns sums over buckets
+                        # charged inside [base_ns, emit_ns] — compare
+                        # against gap_ns, the stall-inclusive window.
+                        self.token_blame.append({
+                            "rid": req.rid, "tenant": req.tenant,
+                            "round": self.stats["rounds"],
+                            "itl_ns": stall_itl, "gap_ns": gap_ns,
+                            "blame_ns": gap,
+                        })
                 req.decoded += tpr
                 self._cur_tok[seq] = toks[-1, i]
             self.stats["rounds"] += 1
@@ -937,11 +1119,39 @@ class Scheduler:
                 1e3 * float(np.percentile(lats, 99)), 3) if lats else 0.0,
         }
         out.update({k: v for k, v in self.stats.items()})
+        # Per-tenant SLO summary from the native tpuflow histograms
+        # (process-global: bench isolates levels with utils.flow_reset).
+        slo = {}
+        for t in sorted({r.tenant for r in self._by_rid.values()}):
+            n_itl = self._utils.slo_count(t, "itl")
+            if n_itl == 0 and self._utils.slo_count(t, "ttft") == 0:
+                continue
+            q = self._utils.slo_quantile_ns
+            slo[str(t)] = {
+                "ttft_ms_p50": round(q(t, "ttft", 0.50) / 1e6, 3),
+                "ttft_ms_p99": round(q(t, "ttft", 0.99) / 1e6, 3),
+                "itl_ms_p50": round(q(t, "itl", 0.50) / 1e6, 3),
+                "itl_ms_p99": round(q(t, "itl", 0.99) / 1e6, 3),
+                "tokens": int(n_itl),
+                "blame_ms": {b: round(
+                    self._utils.slo_blame_ns(t, b) / 1e6, 3)
+                    for b in self._utils.FLOW_BUCKETS},
+            }
+        out["slo"] = slo
         return out
 
     # ---------------------------------------------------------- teardown
 
     def close(self) -> None:
+        # Close the ledgers of non-terminal streams: the flow table's
+        # slot recycler reclaims CLOSED slots only, so an abandoned
+        # open flow would pin its slot (and the tpurm_flows_open
+        # gauge) for the process lifetime.
+        for req in self._by_rid.values():
+            if req.flow and req.state not in (RequestState.FINISHED,
+                                              RequestState.CANCELLED):
+                self._park_account(req)
+                self._utils.flow_close(req.flow)
         # The scheduler-owned tier ring must go before the cache (it is
         # bound to the backing's VA space).
         if self._tier_ring is not None:

@@ -196,9 +196,187 @@ def metrics_text(max_bytes: int = 1 << 20) -> str:
     return procfs_read("/proc/driver/tpurm/metrics", max_bytes)
 
 
+# ------------------------------------------------------------------ tpuflow
+#
+# Python face of the request-flow / SLO subsystem (native/src/flow.c):
+# mint flow ids (tenant << 48 | request << 16 | hop), open/close per-
+# request blame ledgers, feed the per-tenant TTFT/ITL histograms, and
+# read the top-K slow-flow report the /proc/driver/tpurm/flows node
+# renders.  The scheduler (runtime/sched.py) is the primary producer;
+# these wrappers are the operator/test surface.
+
+#: Blame buckets, in native TPU_FLOW_B_* order (tpurm/flow.h).
+FLOW_BUCKETS = ("queued", "preempted", "fault", "copy", "ici", "reset")
+
+#: SLO histogram kinds, in native TPU_SLO_* order.
+SLO_KINDS = ("ttft", "itl")
+
+_flow_bound = None
+
+
+def _flow_lib():
+    global _flow_bound
+    if _flow_bound is not None:
+        return _flow_bound
+    import ctypes
+
+    lib = native.load()
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    lib.tpurmFlowMint.argtypes = [u32, u32]
+    lib.tpurmFlowMint.restype = u64
+    lib.tpurmFlowOpen.argtypes = [u64]
+    lib.tpurmFlowOpen.restype = u32
+    lib.tpurmFlowAccount.argtypes = [u64, u32, u64]
+    lib.tpurmFlowAccount.restype = None
+    lib.tpurmFlowTokens.argtypes = [u64, u64]
+    lib.tpurmFlowTokens.restype = None
+    lib.tpurmFlowClose.argtypes = [u64, ctypes.POINTER(u64)]
+    lib.tpurmFlowClose.restype = u32
+    lib.tpurmFlowResetAll.argtypes = []
+    lib.tpurmFlowResetAll.restype = None
+    lib.tpurmFlowReport.argtypes = [ctypes.c_void_p, u32]
+    lib.tpurmFlowReport.restype = u32
+    lib.tpurmSloRecordN.argtypes = [u32, u32, u64, u64]
+    lib.tpurmSloRecordN.restype = None
+    lib.tpurmSloQuantileNs.argtypes = [u32, u32, ctypes.c_double]
+    lib.tpurmSloQuantileNs.restype = u64
+    lib.tpurmSloCount.argtypes = [u32, u32]
+    lib.tpurmSloCount.restype = u64
+    lib.tpurmSloBlameNs.argtypes = [u32, u32]
+    lib.tpurmSloBlameNs.restype = u64
+    lib.tpurmTraceFlowSet.argtypes = [u64]
+    lib.tpurmTraceFlowSet.restype = None
+    lib.tpurmTraceFlowGet.argtypes = []
+    lib.tpurmTraceFlowGet.restype = u64
+    _flow_bound = lib
+    return lib
+
+
+def _bucket_idx(bucket) -> int:
+    return FLOW_BUCKETS.index(bucket) if isinstance(bucket, str) \
+        else int(bucket)
+
+
+def _kind_idx(kind) -> int:
+    return SLO_KINDS.index(kind) if isinstance(kind, str) else int(kind)
+
+
+def flow_mint(tenant: int, request: int) -> int:
+    """Mint a hop-0 flow id (tenant << 48 | request << 16)."""
+    return _flow_lib().tpurmFlowMint(tenant, request)
+
+
+def flow_open(flow: int) -> None:
+    _flow_lib().tpurmFlowOpen(flow)
+
+
+def flow_set(flow: int) -> None:
+    """Set the CURRENT thread's flow context: spans emitted (and CPU
+    faults taken) on this thread now carry the request identity."""
+    _flow_lib().tpurmTraceFlowSet(flow)
+
+
+def flow_get() -> int:
+    return _flow_lib().tpurmTraceFlowGet()
+
+
+def flow_account(flow: int, bucket, ns: int) -> None:
+    """Accumulate ``ns`` into a blame bucket (name or index)."""
+    if ns > 0:
+        _flow_lib().tpurmFlowAccount(flow, _bucket_idx(bucket), ns)
+
+
+def flow_tokens(flow: int, tokens: int = 1) -> None:
+    _flow_lib().tpurmFlowTokens(flow, tokens)
+
+
+def flow_close(flow: int) -> int:
+    """Close the flow's ledger; returns its wall time in ns."""
+    import ctypes
+
+    lib = _flow_lib()
+    wall = ctypes.c_uint64()
+    lib.tpurmFlowClose(flow, ctypes.byref(wall))
+    return wall.value
+
+
+def flow_reset() -> None:
+    """Clear the flow table, SLO histograms and blame counters."""
+    _flow_lib().tpurmFlowResetAll()
+
+
+_FLOW_REC_CLS = None
+
+
+def _flow_rec_cls():
+    """ctypes mirror of TpuFlowRec, built once (blame_tokens callers
+    hit flow_report per decode round)."""
+    global _FLOW_REC_CLS
+    if _FLOW_REC_CLS is None:
+        import ctypes
+
+        class Rec(ctypes.Structure):
+            _fields_ = [("flow", ctypes.c_uint64),
+                        ("tenant", ctypes.c_uint32),
+                        ("state", ctypes.c_uint32),
+                        ("openNs", ctypes.c_uint64),
+                        ("wallNs", ctypes.c_uint64),
+                        ("tokens", ctypes.c_uint64),
+                        ("bucketNs",
+                         ctypes.c_uint64 * len(FLOW_BUCKETS))]
+
+        _FLOW_REC_CLS = Rec
+    return _FLOW_REC_CLS
+
+
+def flow_report(max_flows: int = 64) -> List[Dict]:
+    """Top-K slow flows, most-blamed first: one dict per flow with the
+    ledger fields and a per-bucket blame map (ns)."""
+    import ctypes
+
+    lib = _flow_lib()
+    Rec = _flow_rec_cls()
+    buf = (Rec * max_flows)()
+    n = lib.tpurmFlowReport(ctypes.cast(buf, ctypes.c_void_p), max_flows)
+    out = []
+    for r in buf[:n]:
+        out.append({
+            "flow": r.flow,
+            "tenant": r.tenant,
+            "request": (r.flow >> 16) & 0xFFFFFFFF,
+            "state": "closed" if r.state == 2 else "open",
+            "wall_ns": r.wallNs,
+            "tokens": r.tokens,
+            "blame_ns": {FLOW_BUCKETS[i]: r.bucketNs[i]
+                         for i in range(len(FLOW_BUCKETS))},
+        })
+    return out
+
+
+def slo_record(tenant: int, kind, ns: int, count: int = 1) -> None:
+    """Feed the per-tenant SLO histogram ("ttft" / "itl")."""
+    _flow_lib().tpurmSloRecordN(tenant, _kind_idx(kind), ns, count)
+
+
+def slo_quantile_ns(tenant: int, kind, q: float) -> int:
+    return _flow_lib().tpurmSloQuantileNs(tenant, _kind_idx(kind),
+                                          float(q))
+
+
+def slo_count(tenant: int, kind) -> int:
+    return _flow_lib().tpurmSloCount(tenant, _kind_idx(kind))
+
+
+def slo_blame_ns(tenant: int, bucket) -> int:
+    return _flow_lib().tpurmSloBlameNs(tenant, _bucket_idx(bucket))
+
+
 __all__ = ["journal_dump", "counter", "counters", "registry_get",
            "procfs_read", "procfs_list", "trace_start", "trace_stop",
            "trace_reset", "trace_armed", "trace_export",
            "trace_export_json", "trace_save", "trace_stats",
            "trace_quantile_ns", "trace_hist_count", "span",
-           "metrics_text"]
+           "metrics_text", "FLOW_BUCKETS", "SLO_KINDS", "flow_mint",
+           "flow_open", "flow_set", "flow_get", "flow_account",
+           "flow_tokens", "flow_close", "flow_reset", "flow_report",
+           "slo_record", "slo_quantile_ns", "slo_count", "slo_blame_ns"]

@@ -126,7 +126,12 @@ class _Sqe(ctypes.Structure):
         ("depCount", ctypes.c_uint32),
         ("rsvd0", ctypes.c_uint32),
         ("seq", ctypes.c_uint64),
-        ("rsvd1", ctypes.c_uint64 * 2),
+        # tpuflow request identity (tenant << 48 | request << 16 | hop;
+        # 0 = none): workers execute under it, nested engine spans
+        # carry it, and the exec layer charges the flow's copy/ici
+        # blame bucket.  Build ids with utils.flow_mint().
+        ("flowId", ctypes.c_uint64),
+        ("rsvd1", ctypes.c_uint64),
     ]
 
 
@@ -279,35 +284,39 @@ class MemRing:
 
     def migrate(self, addr: int, length: int, tier: Tier, dev: int = 0,
                 user_data: int = 0, link: bool = False,
-                deadline_ns: int = 0, deps=None) -> int:
+                deadline_ns: int = 0, deps=None, flow: int = 0) -> int:
         """Stage an async migrate of [addr, addr+length) to ``tier``.
         Returns the op's cookie (auto-assigned when 0).
         ``deadline_ns`` (absolute, utils clock) fails the op fast with
         RETRY_EXHAUSTED if it is claimed past the deadline; ``deps`` is
-        a list of up to 4 :func:`dep` handles the op waits on."""
+        a list of up to 4 :func:`dep` handles the op waits on; ``flow``
+        is a tpuflow id (utils.flow_mint) the op executes under."""
         s = _Sqe(opcode=Op.MIGRATE, flags=SQE_LINK if link else 0,
                  dstTier=int(tier), devInst=dev, addr=addr, len=length,
-                 userData=user_data, deadlineNs=deadline_ns)
+                 userData=user_data, deadlineNs=deadline_ns, flowId=flow)
         return self._prep(s, deps)
 
     def prefetch(self, addr: int, length: int, dev: int = 0,
                  write: bool = False, user_data: int = 0,
                  link: bool = False, deadline_ns: int = 0,
-                 deps=None) -> int:
+                 deps=None, flow: int = 0) -> int:
         """Stage a device-access prefetch: fault the span onto
-        ``dev``'s HBM through the batch service loop."""
+        ``dev``'s HBM through the batch service loop.  ``flow`` tags
+        the op with a tpuflow request identity (copy-bucket blame +
+        Perfetto flow linking)."""
         flags = (SQE_LINK if link else 0) | (SQE_WRITE if write else 0)
         s = _Sqe(opcode=Op.PREFETCH, flags=flags, devInst=dev, addr=addr,
-                 len=length, userData=user_data, deadlineNs=deadline_ns)
+                 len=length, userData=user_data, deadlineNs=deadline_ns,
+                 flowId=flow)
         return self._prep(s, deps)
 
     def evict(self, addr: int, length: int, tier: Tier = Tier.HOST,
               user_data: int = 0, link: bool = False,
-              deadline_ns: int = 0, deps=None) -> int:
+              deadline_ns: int = 0, deps=None, flow: int = 0) -> int:
         """Stage a tier demote (HOST or CXL destination only)."""
         s = _Sqe(opcode=Op.EVICT, flags=SQE_LINK if link else 0,
                  dstTier=int(tier), addr=addr, len=length,
-                 userData=user_data, deadlineNs=deadline_ns)
+                 userData=user_data, deadlineNs=deadline_ns, flowId=flow)
         return self._prep(s, deps)
 
     def advise(self, addr: int, length: int, advice: Advise,
@@ -326,7 +335,7 @@ class MemRing:
     def peer_copy(self, dev: int, peer: int, local_off: int,
                   peer_off: int, length: int, read: bool = False,
                   user_data: int = 0, link: bool = False,
-                  deps=None) -> int:
+                  deps=None, flow: int = 0) -> int:
         """Stage an ICI peer copy between HBM arena offsets
         (write: local->peer; ``read=True``: peer->local).  ``deps``
         carries up to 4 :func:`dep` handles — the tpuvac migration
@@ -336,7 +345,7 @@ class MemRing:
         s = _Sqe(opcode=Op.PEER_COPY, flags=SQE_LINK if link else 0,
                  devInst=dev, peerInst=peer, addr=local_off,
                  peerOff=peer_off, len=length, userData=user_data,
-                 arg0=1 if read else 0)
+                 arg0=1 if read else 0, flowId=flow)
         return self._prep(s, deps)
 
     def fence(self, user_data: int = 0) -> int:
@@ -346,7 +355,7 @@ class MemRing:
         return self._prep(s)
 
     def nop(self, user_data: int = 0, delay_ns: int = 0,
-            deadline_ns: int = 0, deps=None) -> int:
+            deadline_ns: int = 0, deps=None, flow: int = 0) -> int:
         """Stage a NOP.  ``delay_ns`` makes the worker sleep that long
         before completing — the deterministic hung-op the reset
         watchdog/ladder tests use.  A NOP with ``deps`` is the
@@ -354,7 +363,7 @@ class MemRing:
         without fencing unrelated later traffic the way ``fence()``
         does."""
         s = _Sqe(opcode=Op.NOP, userData=user_data, arg1=delay_ns,
-                 deadlineNs=deadline_ns)
+                 deadlineNs=deadline_ns, flowId=flow)
         return self._prep(s, deps)
 
     # --------------------------------------------------- submit / reap

@@ -343,6 +343,15 @@ def migrate_pages(backing, src: int, dst: int,
     except BaseException:
         ring.close()
         raise
+    # tpuflow: the migration window is one flow (sentinel tenant
+    # 0xFFFF — vac is infrastructure, not a serving tenant; request id
+    # = the manifest token).  Each dep-joined shipping window bumps the
+    # flow's HOP field, so the windows chain as one arrow in the
+    # Perfetto export and the PEER_COPY exec time lands in the flow's
+    # ici blame bucket.
+    from .. import utils as _flowutils
+    flow = _flowutils.flow_mint(0xFFFF, txn._txn & 0xFFFFFFFF)
+    _flowutils.flow_open(flow)
     staged: List[Tuple[int, int, ctypes.c_void_p]] = []  # (page, off, h)
     total_retries = 0
     try:
@@ -379,7 +388,8 @@ def migrate_pages(backing, src: int, dst: int,
                                       ordered=True)]
                         if prev_join is not None else None)
                 ring.peer_copy(src, dst, src_off, off, rec_bytes,
-                               deps=deps)
+                               deps=deps,
+                               flow=flow | ((i // window) & 0xFFFF))
                 in_flight += 1
                 if in_flight >= window or i + 1 == len(staged):
                     prev_join = ring.last_seq
@@ -419,6 +429,7 @@ def migrate_pages(backing, src: int, dst: int,
         txn.abort()
         raise
     finally:
+        _flowutils.flow_close(flow)
         ring.close()
     return MigrationReport(src, dst, len(pages), len(pages) * rec_bytes,
                            time.perf_counter() - t0, total_retries, True)

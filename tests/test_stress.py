@@ -532,6 +532,7 @@ import jax.numpy as jnp
 from open_gpu_kernel_modules_tpu.models import llama
 from open_gpu_kernel_modules_tpu.runtime import sched
 from open_gpu_kernel_modules_tpu.uvm import inject as inj
+from open_gpu_kernel_modules_tpu import utils as _utils
 
 from open_gpu_kernel_modules_tpu.uvm import reset
 
@@ -546,9 +547,13 @@ CANCEL = {5, 6}                 # rids cancelled mid-flight (1-based)
 
 
 def run_once(force_resets=0):
+    # tpuflow isolation per run: the blame-soundness and SLO
+    # reconciliation below are asserted over THIS run's ledgers.
+    _utils.flow_reset()
     s = sched.Scheduler(cfg, params, max_seqs=4, max_len=64,
                         page_size=16, oversub=4, tokens_per_round=4)
-    reqs = [s.submit(p, max_new_tokens=12) for p in prompts]
+    reqs = [s.submit(p, max_new_tokens=12, tenant=i %% 2)
+            for i, p in enumerate(prompts)]
     for _ in range(3):
         s.step()
     for r in reqs:
@@ -570,6 +575,22 @@ def run_once(force_resets=0):
     toks = {r.rid: r.tokens.tolist() for r in reqs
             if r.state is sched.RequestState.FINISHED}
     states = {r.rid: r.state.value for r in reqs}
+    # tpuflow blame-soundness evidence for THIS run (all terminal
+    # streams close their ledgers): closed flows with bucket sums vs
+    # walls, plus the per-tenant SLO-vs-decoded reconciliation inputs.
+    flows = _utils.flow_report(max_flows=64)
+    rep["flow_evidence"] = {
+        "closed": sum(1 for f in flows if f["state"] == "closed"),
+        "violations": [f for f in flows if f["state"] == "closed" and
+                       sum(f["blame_ns"].values()) > f["wall_ns"]],
+        "any_reset_blame": any(f["blame_ns"]["reset"] > 0
+                               for f in flows),
+        "any_preempt_blame": any(f["blame_ns"]["preempted"] > 0
+                                 for f in flows),
+        "itl_counts": {t: _utils.slo_count(t, "itl") for t in (0, 1)},
+        "decoded": {t: sum(r.decoded for r in reqs if r.tenant == t)
+                    for t in (0, 1)},
+    }
     s.close()
     return toks, states, rep
 
@@ -605,7 +626,8 @@ out["rep"] = {k: rep[k] for k in
               ("admitted", "retired", "preempted", "restored",
                "cancelled", "admit_retries", "admit_sheds",
                "round_errors", "finished", "forced_resets",
-               "device_resets_observed")}
+               "device_resets_observed", "flow_evidence")}
+out["ref_flow_evidence"] = ref_rep["flow_evidence"]
 out["live"] = {}
 out["hits"] = {k: v[1] for k, v in inj.stats().items()}
 out["sched_admit_evals"] = inj.counts(inj.Site.SCHED_ADMIT)[0]
@@ -686,6 +708,21 @@ def test_sched_soak_injection():
     # holds at zero (armed-but-unevaluated costs and leaks nothing).
     vm = out["vac_migrate"]
     assert vm["evals"] == 0 and vm["hits"] == 0, vm
+
+    # tpuflow blame-decomposition soundness UNDER CHAOS (all 12 sites
+    # armed, >= 3 forced resets): every terminal stream closed its
+    # ledger, no closed flow's bucket sum exceeds its wall time, the
+    # reset blackouts landed in the reset bucket, and the per-tenant
+    # SLO histogram counts reconcile EXACTLY with tokens decoded.
+    for tag in ("ref_flow_evidence",):
+        fe = out[tag]
+        assert fe["violations"] == [], fe
+        assert fe["itl_counts"] == fe["decoded"], fe
+    fe = out["rep"]["flow_evidence"]
+    assert fe["closed"] == 8, fe                  # all 8 streams terminal
+    assert fe["violations"] == [], fe
+    assert fe["itl_counts"] == fe["decoded"], fe
+    assert fe["any_reset_blame"], fe              # >=3 resets mid-decode
 
 
 _CLIENT_KILL = r"""

@@ -2,15 +2,113 @@
 -> free cycle export as valid Chrome trace-event JSON, application
 spans ride the same rings, and /proc/driver/tpurm/metrics renders
 valid Prometheus text exposition with cumulative histogram buckets.
+
+Also home of METRICS_INVENTORY — the asserted exposition inventory the
+``make -C native check-metrics`` lint validates every registered
+counter/gauge against (a counter added in code but missing here fails
+the lint, so the scrape surface can never grow unasserted series).
 """
 
 import json
+import os
+import subprocess
 
 import pytest
 
 from open_gpu_kernel_modules_tpu import utils, uvm
 
 MB = 1 << 20
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Every counter/gauge/exposition family the tree registers, by name
+#: (scoped "[...]" suffixes stripped — they render as labels; per-site
+#: and per-tenant %-format families are expansions of the asserted
+#: histogram machinery).  Kept sorted; check-metrics fails when code
+#: registers a name missing here.
+METRICS_INVENTORY = [
+    "broker_client_deaths", "broker_heartbeat_reaps",
+    "broker_reclaimed_clients", "broker_reclaimed_fds",
+    "broker_reclaimed_pin_bytes", "broker_reclaimed_pins",
+    "broker_zombie_doorbells", "channel_bytes_copied",
+    "channel_copies_completed", "channel_pushes", "channel_rc_resets",
+    "cxl_buffers_registered", "cxl_buffers_unregistered",
+    "cxl_dma_bytes", "cxl_dma_requests", "dmabuf_exports",
+    "hbm_mirror_bytes", "hbm_mirror_overflows", "hbm_readback_requests",
+    "ib_mr_invalidations", "ib_mr_registrations", "ici_degraded_routes",
+    "ici_hop_bytes", "ici_link_flaps", "ici_links_trained",
+    "ici_multihop_copies", "ici_peer_apertures", "ici_peer_copy_bytes",
+    "ici_reset_retrains", "ici_retrain_failures",
+    "memring_coalesced_sqes", "memring_cq_overflows", "memring_cqes",
+    "memring_deadline_expired", "memring_dep_cancelled",
+    "memring_dep_stalls", "memring_error_cqes", "memring_fences",
+    "memring_fused_evictions", "memring_inject_error_cqes",
+    "memring_inject_error_runs", "memring_inject_retries",
+    "memring_internal_inline", "memring_internal_sqes",
+    "memring_internal_submits", "memring_links_cancelled",
+    "memring_ooo_retires", "memring_ops", "memring_park_timeouts",
+    "memring_retries", "memring_rings_created", "memring_sqes",
+    "memring_sqpoll_polls", "memring_sqpoll_sleeps",
+    "memring_stale_completions", "memring_submits",
+    "memring_tier_evict_runs", "peermem_dma_maps", "peermem_get_pages",
+    "peermem_put_pages", "peermem_revocations", "pmm_chunk_allocs",
+    "pmm_chunk_frees", "rc_auto_resets", "rc_device_escalations",
+    "rc_nonreplayable_faults", "rc_shadow_overflows",
+    "rc_watchdog_timeouts", "rdma_mrs_revalidated",
+    "rdma_reset_revocations", "recover_copy_retries",
+    "recover_fault_retries", "recover_link_retrains",
+    "recover_msgq_retries", "recover_page_quarantines",
+    "recover_rc_resets", "recover_rdma_retries", "recover_retries",
+    "recover_tier_fallbacks", "rm_events_allocated",
+    "rm_events_delivered", "rm_memory_maps", "tier_tenant_binds",
+    "tier_tenant_configs", "tier_tenant_evictions",
+    "tier_tenant_over_quota_evictions", "tier_tenant_slo_reorders",
+    "tpuce_compressed_bytes_in", "tpuce_compressed_bytes_out",
+    "tpuce_compressed_bytes_raw", "tpuce_deadline_expired",
+    "tpuce_dep_join_waits", "tpuce_inject_errors",
+    "tpuce_inject_retries", "tpuce_lossless_fallbacks",
+    "tpuce_ooo_completions", "tpuce_retries", "tpuce_stale_completions",
+    "tpuce_stripe_errors", "tpuce_stripe_splits", "tpurm_counter",
+    "tpurm_device_generation", "tpurm_device_health",
+    "tpurm_device_health_score", "tpurm_flow_drops",
+    "tpurm_flow_drops_total", "tpurm_flow_unmatched_total",
+    "tpurm_flows_closed", "tpurm_flows_closed_total",
+    "tpurm_flows_open", "tpurm_flows_opened",
+    "tpurm_health_transitions", "tpurm_reset_failed",
+    "tpurm_reset_injected", "tpurm_reset_mttr_ns", "tpurm_reset_total",
+    "tpurm_slo_blame_ns", "tpurm_tenant_pages",
+    "tpurm_tenant_quota_pages", "tpurm_tenant_rebinds",
+    "tpurm_trace_dropped_total", "tpurm_trace_records_total",
+    "tpurm_trace_rings", "tpurm_watchdog_device_resets",
+    "tpurm_watchdog_evacuations", "tpurm_watchdog_nudges",
+    "tpurm_watchdog_rc_resets", "tpusched_admit_retries",
+    "tpusched_admit_sheds", "tpusched_admitted", "tpusched_cancelled",
+    "tpusched_decoded_tokens", "tpusched_device_resets",
+    "tpusched_evac_aborts", "tpusched_evacuations",
+    "tpusched_evict_errors", "tpusched_fused_evict_chains",
+    "tpusched_preempted", "tpusched_restored", "tpusched_retired",
+    "tpusched_round_errors", "tpusched_rounds", "tpusched_submitted",
+    "uvm_access_counter_demotions", "uvm_access_counter_promotions",
+    "uvm_accessed_by_mappings", "uvm_ats_accesses", "uvm_ats_bytes",
+    "uvm_block_evictions", "uvm_bytes_xfer_dth", "uvm_bytes_xfer_htd",
+    "uvm_compressible_advises", "uvm_cpu_fault_count",
+    "uvm_device_wrote_invalidations", "uvm_external_maps",
+    "uvm_fault_batches", "uvm_fault_cancels",
+    "uvm_fault_drain_park_bails", "uvm_fault_flush_serviced",
+    "uvm_first_touch_writes", "uvm_gpu_fault_count",
+    "uvm_hmm_adoptions", "uvm_managed_bytes_allocated",
+    "uvm_migrate_calls", "uvm_mmu_pte_batches",
+    "uvm_mmu_tlb_invalidates", "uvm_mmu_tlb_pages",
+    "uvm_prefetch_hits", "uvm_prefetch_pages", "uvm_prefetch_useless",
+    "uvm_range_splits", "uvm_resumes", "uvm_suspends",
+    "uvm_thrash_pins", "uvm_tools_events_dropped",
+    "uvm_va_spaces_created", "uvm_write_faults_inferred", "vac_aborts",
+    "vac_acks", "vac_bytes_moved", "vac_commit_ns",
+    "vac_commit_rejected", "vac_commits", "vac_failed_acks",
+    "vac_grace_expired", "vac_inject_aborts", "vac_inject_retries",
+    "vac_operator_requests", "vac_pages_moved", "vac_requests",
+    "vac_txn_begins",
+]
 
 
 @pytest.fixture
@@ -170,3 +268,103 @@ def test_prometheus_metrics_node(traced):
 
     # The node also serves under the procfs listing.
     assert "driver/tpurm/metrics" in utils.procfs_list()
+
+    # Inventory contract: every family/name this scrape surfaced is
+    # covered by METRICS_INVENTORY (the same set check-metrics lints
+    # the source tree against), modulo the per-site/per-tenant
+    # histogram expansions of asserted machinery.
+    inv = set(METRICS_INVENTORY)
+    import re
+    for fam in types:
+        if fam in inv:
+            continue
+        # Site histograms: tpurm_<site>_ns from the asserted trace
+        # machinery; SLO histograms: tpurm_slo_{ttft,itl}_ns.
+        assert re.fullmatch(r"tpurm_[a-z0-9_]+_ns", fam), \
+            f"family {fam} not in METRICS_INVENTORY"
+    for (_, metric, _) in samples:
+        m = re.match(r'tpurm_counter\{name="([^"]+)"', metric)
+        if not m:
+            continue
+        # Scoped "name[scope]" counters normalize to their base (the
+        # lint strips the same suffix; [dN] scopes already render as
+        # a dev label upstream).
+        name = re.sub(r"\[[^\]]*\]$", "", m.group(1))
+        assert name in inv or re.fullmatch(r"tpuce_ch\d+_(bytes|busy_ns)",
+                                           name), \
+            f"counter {name} not in METRICS_INVENTORY"
+
+
+# ------------------------------------------------------- check-metrics lint
+
+
+def _run_check_metrics(extra_env=None):
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        ["make", "-C", os.path.join(_REPO, "native"), "check-metrics"],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_check_metrics_lint_passes():
+    """The committed tree's registered names are all inventoried."""
+    proc = _run_check_metrics()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check-metrics OK" in proc.stdout
+
+
+def test_check_metrics_lint_negative():
+    """A counter registered in code but missing from the inventory
+    MUST fail the lint (CHECK_METRICS_EXTRA injects one)."""
+    proc = _run_check_metrics(
+        {"CHECK_METRICS_EXTRA": "bogus_unasserted_counter_xyz"})
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "bogus_unasserted_counter_xyz" in proc.stdout + proc.stderr
+
+
+# -------------------------------------------------------- tpuflow surface
+
+
+def test_flow_slo_series_in_scrape():
+    """A flow workload surfaces tpurm_slo_*{tenant=} histogram series,
+    the blame counter family, and the /proc flows node."""
+    utils.flow_reset()
+    try:
+        flow = utils.flow_mint(3, 77)
+        utils.flow_open(flow)
+        utils.flow_account(flow, "copy", 2_000_000)
+        utils.flow_account(flow, "queued", 5_000_000)
+        utils.flow_tokens(flow, 8)
+        utils.slo_record(3, "ttft", 40_000_000)
+        utils.slo_record(3, "itl", 3_000_000, count=8)
+        utils.flow_close(flow)
+
+        text = utils.metrics_text()
+        types, samples = _parse_prometheus(text)
+        assert types.get("tpurm_slo_ttft_ns") == "histogram"
+        assert types.get("tpurm_slo_itl_ns") == "histogram"
+        assert types.get("tpurm_slo_blame_ns") == "counter"
+        names = {m for (_, m, _) in samples}
+        assert 'tpurm_slo_itl_ns_count{tenant="3"}' in names
+        assert any('tpurm_slo_blame_ns{tenant="3",bucket="copy"}' in m
+                   for m in names)
+
+        # The SLO quantile surface answers from the same histograms.
+        assert utils.slo_count(3, "itl") == 8
+        p50 = utils.slo_quantile_ns(3, "itl", 0.5)
+        assert 2_800_000 < p50 < 3_200_000
+
+        # Live flows node renders the ledger.
+        flows = utils.procfs_read("/proc/driver/tpurm/flows")
+        assert "closed" in flows and "queued" in flows
+        assert "driver/tpurm/flows" in utils.procfs_list()
+
+        # flow_report: our flow, blame-ranked, buckets intact.
+        rep = utils.flow_report()
+        assert rep and rep[0]["tenant"] == 3
+        assert rep[0]["blame_ns"]["queued"] == 5_000_000
+        assert rep[0]["tokens"] == 8
+        assert rep[0]["state"] == "closed"
+    finally:
+        utils.flow_reset()
