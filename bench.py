@@ -69,6 +69,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 BASELINE_CXL_LINK_BYTES_PER_S = 3900e6
@@ -1171,6 +1173,11 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
     from open_gpu_kernel_modules_tpu import utils as _utils
     slo_by_level = {}
     p99_token_blame = {}
+    # tpuhot acceptance: measured prefetch precision across the whole
+    # sweep (hits/(hits+useless) from the effectiveness counters, with
+    # the precision governor steering the speculation cap) — >= 0.8.
+    pf_hits0 = _utils.counter("uvm_prefetch_hits")
+    pf_useless0 = _utils.counter("uvm_prefetch_useless")
     for n in levels:
         # tpuflow isolation per level: the per-tenant SLO histograms
         # are process-global, so each level reads its own ledger.
@@ -1214,6 +1221,8 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
         restores += rep["restored"]
 
     lo, hi = str(levels[0]), str(levels[-1])
+    pf_hits = _utils.counter("uvm_prefetch_hits") - pf_hits0
+    pf_useless = _utils.counter("uvm_prefetch_useless") - pf_useless0
     busy_frac = []
     if ch0 is not None:
         try:
@@ -1236,6 +1245,14 @@ def measure_serving_sweep(levels=(1, 8, 32, 128)) -> dict:
         "serve_p50_token_ms": p50,
         "serve_preemptions": preemptions,
         "serve_restores": restores,
+        # tpuhot: governed prefetch precision over the sweep (the
+        # effectiveness counters' delta; 1.0 = nothing speculated was
+        # ever evicted untouched).  Acceptance: >= 0.8 governed.
+        "prefetch_precision": round(
+            pf_hits / (pf_hits + pf_useless), 4)
+        if (pf_hits + pf_useless) else 1.0,
+        "prefetch_hits": int(pf_hits),
+        "prefetch_useless": int(pf_useless),
         # Continuous batching's win: throughput at max concurrency vs
         # the same streams run one at a time (>1 = super-linear vs
         # sequential; the batch amortizes each dispatch).
@@ -1445,6 +1462,111 @@ def measure_vac_migration(streams: int = 12, evacs: int = 3) -> dict:
         "vac_commits": utils.counter("vac_commits"),
         "vac_aborts": utils.counter("vac_aborts"),
         "vac_bytes_moved": utils.counter("vac_bytes_moved"),
+    }
+
+
+_THRASH_STORM = r"""
+import json
+import sys
+import time
+
+sys.path.insert(0, %(repo)r)
+
+from open_gpu_kernel_modules_tpu import uvm, utils
+from open_gpu_kernel_modules_tpu.uvm import hot
+
+MB = 1 << 20
+SET = %(set_mb)d * MB
+ITERS = %(iters)d
+
+with uvm.VaSpace() as vs:
+    a = vs.alloc(SET)
+    b = vs.alloc(SET)
+    a.view()[:] = 0x5A
+    b.view()[:] = 0xB5
+    base = {"dth": utils.counter("uvm_bytes_xfer_dth"),
+            "htd": utils.counter("uvm_bytes_xfer_htd"),
+            "evict": utils.counter("uvm_block_evictions")}
+    t0 = time.monotonic()
+    for i in range(ITERS):
+        a.device_access(dev=0, write=True)
+        b.device_access(dev=0, write=True)
+    wall = time.monotonic() - t0
+    st = hot.stats()
+    out = {
+        "moved": (utils.counter("uvm_bytes_xfer_dth") - base["dth"] +
+                  utils.counter("uvm_bytes_xfer_htd") - base["htd"]),
+        "evictions": utils.counter("uvm_block_evictions") - base["evict"],
+        "pins": st.pins, "throttles": st.throttles,
+        "thrash_pages": st.thrash_pages,
+        "fallbacks": utils.counter("recover_tier_fallbacks"),
+        "ops_per_s": 2 * ITERS / wall if wall else 0.0,
+        "intact": bool((a.view() == 0x5A).all() and
+                       (b.view() == 0xB5).all()),
+    }
+    a.free()
+    b.free()
+print(json.dumps(out))
+"""
+
+
+def measure_thrash_storm(iters: int = 12, set_mb: int = 12,
+                         hbm_mb: int = 16) -> dict:
+    """tpuhot acceptance: two device streams ping-ponging a shared
+    working set at oversubscription (2 x ``set_mb`` over an
+    ``hbm_mb``-MB arena) — the LRU's worst case, every block alternates
+    HBM<->host per round.  A/B: detector ON (PIN hints keep the
+    resident side's working set; the loser degrades to host placement
+    through the engine's tier fallback) vs OFF (``hot_enable=0``,
+    which also covers the ISSUE's ``hot_pin=0`` arm — with the whole
+    tracker off nothing pins OR throttles).  Records the migration
+    flattening factor (acceptance >= 2x) and the throughput dip
+    (ops/s proxy for tokens/s; acceptance: no worse => dip <= 0).
+    Jax-free; each arm is its own subprocess so the tiny fake arena
+    never leaks into other measurements."""
+    script = _THRASH_STORM % {
+        "repo": os.path.dirname(os.path.abspath(__file__)),
+        "set_mb": set_mb, "iters": iters}
+
+    def run(extra_env):
+        env = dict(os.environ)
+        env["TPUMEM_FAKE_HBM_MB"] = str(hbm_mb)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(extra_env)
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-500:])
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Both arms pin their knobs EXPLICITLY: an ambient TPUMEM_HOT_*
+    # left in the operator's shell (the verify recipe suggests
+    # exporting HOT_ENABLE=0 for manual A/Bs) must not silently turn
+    # the ON arm off and report ~1.0x as a quiet acceptance failure.
+    off = run({"TPUMEM_HOT_ENABLE": "0", "TPUMEM_HOT_PIN": "0"})
+    on = run({"TPUMEM_HOT_ENABLE": "1", "TPUMEM_HOT_PIN": "1",
+              "TPUMEM_HOT_THRASH_COUNT": "2",
+              "TPUMEM_HOT_PIN_MS": "60000"})
+    if not (on["intact"] and off["intact"]):
+        return {"thrash_error": "data integrity failed",
+                "thrash_on": on, "thrash_off": off}
+    return {
+        # Acceptance: detector reduces HBM<->host migrations >= 2x.
+        "thrash_migrations_flattened_x": round(
+            off["moved"] / max(on["moved"], 1), 2),
+        # Acceptance: aggregate throughput no worse (dip <= 0 means the
+        # detector arm was FASTER — less copying per round).
+        "thrash_toks_dip_frac": round(
+            1.0 - on["ops_per_s"] / off["ops_per_s"], 3)
+        if off["ops_per_s"] else 0.0,
+        "thrash_moved_off_mb": round(off["moved"] / 1e6, 1),
+        "thrash_moved_on_mb": round(on["moved"] / 1e6, 1),
+        "thrash_evictions_off": off["evictions"],
+        "thrash_evictions_on": on["evictions"],
+        "thrash_pins": on["pins"],
+        "thrash_throttles": on["throttles"],
+        "thrash_tier_fallbacks": on["fallbacks"],
     }
 
 
@@ -1720,6 +1842,14 @@ def main() -> None:
                 measure_vac_migration, "vac"))
         except Exception as exc:
             extra["vac_error"] = str(exc)[:200]
+
+    # tpuhot thrash storm: jax-free and self-isolating (each A/B arm
+    # is its own subprocess with a small fake arena), so it runs
+    # everywhere.
+    try:
+        extra.update(measure_thrash_storm())
+    except Exception as exc:
+        extra["thrash_error"] = str(exc)[:200]
 
     try:
         extra.update(measure_explicit_migrate_gbps())

@@ -1,0 +1,113 @@
+/*
+ * tpuhot — hotness-driven placement: access counters, tree-density
+ * prefetch governance, and thrashing PIN/THROTTLE hints.
+ *
+ * The perf-policy subsystem the reference ships as three cooperating
+ * modules (uvm_gpu_access_counters.c — "whether access counters will
+ * trigger migrations"; uvm_perf_thrashing.h:33-46 — PIN/THROTTLE
+ * hints; uvm_perf_prefetch.c — tree-based prefetch region growth),
+ * rebuilt over this engine's fault service path.  Three policies hang
+ * off one per-VA-block tracker:
+ *
+ *   TRACKER — every fault service (CPU demand faults and device-access
+ *     spans both land in service_one) feeds the faulted block's access
+ *     counter with ONE relaxed atomic add; recency and a decaying
+ *     score (half-life registry "hot_decay_ms") are folded lazily at
+ *     policy evaluation points, so the fault hot path pays a single
+ *     uncontended RMW and nothing else.
+ *
+ *   PREFETCH GOVERNOR — speculative region growth around a fault is
+ *     governed twice: bottom-up TREE DENSITY (the candidate region
+ *     doubles only while the enclosing aligned region's recently-
+ *     accessed page density stays above "hot_prefetch_density_pct" —
+ *     the reference's bitmap-tree shape) and MEASURED PRECISION (the
+ *     per-block speculation cap grows where hits/(hits+useless) from
+ *     the PR-7 effectiveness counters stays above
+ *     "hot_prefetch_min_precision" percent, and shrinks where it
+ *     decays).  This replaces the fixed fault-count lookahead: a
+ *     block whose speculation keeps getting evicted untouched stops
+ *     speculating; a streaming block escalates to whole-block staging.
+ *
+ *   THRASH DETECTOR — a block whose pages migrate HBM<->host in
+ *     alternating directions more than "hot_thrash_count" times inside
+ *     "hot_thrash_window_ms" gets a PIN hint (resident device-side,
+ *     exempt from uvmLruPopVictim and therefore uvmTierEvictBytes
+ *     until the pin lapses after "hot_pin_ms"; CPU reads duplicate
+ *     against the pinned copy) — or, when the device arena has less
+ *     than "hot_pin_headroom_pct" free, a THROTTLE hint: the faulting
+ *     stream's services on that block are each delayed
+ *     "hot_throttle_us" for "hot_throttle_ms", so the resident side
+ *     keeps its working set instead of losing a pin fight it cannot
+ *     win.
+ *
+ *   VICTIM SCORER — eviction consumes the same coldness signal:
+ *     uvmLruPopVictim's SLO walk breaks (over-quota, priority) ties by
+ *     decayed hotness instead of raw list position, and the plain LRU
+ *     path runs a bounded coldness scan ("hot_victim_scan" candidates)
+ *     so a released-but-hot block near the cold end is not the next
+ *     victim merely because of its list position.  tpusched's
+ *     preempt-victim choice reads the same scores over the candidate
+ *     sequence's backing span (tpurmHotSpanScore).
+ *
+ * Every policy decision (pin-or-throttle, governor cap adjust, victim
+ * reorder) is evaluated under the hot.decide inject site with bounded
+ * degrade-to-no-op: an injected hit skips exactly that decision and
+ * counts hot_inject_skips — the EXACT reconciliation invariant is
+ * hits == hot_inject_skips.  PINs always lapse (pinExpiryNs), so an
+ * armed site can delay placement policy but never wedge forward
+ * progress.
+ *
+ * Observability: tpurm_hot_* counters and per-device
+ * tpurm_hot_device_score gauges in the Prometheus exposition,
+ * /proc/driver/tpurm/hotness (top-K hot blocks with pin/throttle
+ * state), hot.pin / hot.throttle trace instants.
+ */
+#ifndef TPURM_HOT_H
+#define TPURM_HOT_H
+
+#include <stdbool.h>
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Lifetime policy/engine statistics (process-global). */
+typedef struct TpuHotStats {
+    uint64_t pins;              /* PIN decisions taken                 */
+    uint64_t throttles;         /* THROTTLE decisions taken            */
+    uint64_t throttleDelays;    /* services actually delayed           */
+    uint64_t thrashPages;       /* pages crossing the thrash threshold */
+    uint64_t prefetchGrown;     /* governor cap doublings              */
+    uint64_t prefetchShrunk;    /* governor cap halvings               */
+    uint64_t victimReorders;    /* coldness-scan victim swaps          */
+    uint64_t injectSkips;       /* hot.decide hits degraded to no-op   */
+    uint64_t decisions;         /* policy decisions evaluated          */
+} TpuHotStats;
+
+void tpurmHotStatsGet(TpuHotStats *out);
+
+/* Decayed per-device hotness gauge: access pressure recently fed to
+ * blocks homed on devInst (integer fixed-point, 1024 per page touch;
+ * half-life "hot_decay_ms").  The Prometheus exposition renders the
+ * same value as tpurm_hot_device_score{dev=}. */
+uint64_t tpurmHotDeviceScore(uint32_t devInst);
+
+/* Decayed hotness of the managed blocks covering [addr, addr+len):
+ * the mean per-block score, 0 when the span resolves to no managed
+ * range.  This is the coldness signal tpusched's preempt-victim
+ * choice consumes (uvm/hot.py span_score). */
+uint64_t tpurmHotSpanScore(uint64_t addr, uint64_t len);
+
+/* Zero the process-global policy stats and per-device gauges (tests;
+ * per-block tracker state lives with the blocks and decays on its
+ * own). */
+void tpurmHotStatsReset(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_HOT_H */

@@ -67,6 +67,15 @@ typedef enum {
                                       * invariant: hits ==
                                       * vac_inject_retries +
                                       * vac_inject_aborts)             */
+    TPU_INJECT_SITE_HOT_DECIDE,      /* tpuhot policy decision (one
+                                      * evaluation per pin-or-throttle
+                                      * choice, prefetch-cap adjust, or
+                                      * victim reorder; recovery is
+                                      * bounded degrade-to-no-op — the
+                                      * decision is skipped, placement
+                                      * keeps the undecided default —
+                                      * exact invariant: hits ==
+                                      * hot_inject_skips)             */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

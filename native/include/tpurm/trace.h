@@ -94,6 +94,10 @@ typedef enum {
     TPU_TRACE_RECOVER_QUARANTINE,
     TPU_TRACE_RECOVER_RC_RESET,
     TPU_TRACE_RECOVER_RETRAIN,
+    TPU_TRACE_HOT_PIN,           /* tpuhot thrash PIN decision (obj =
+                                  * block VA, aux = pinned tier)       */
+    TPU_TRACE_HOT_THROTTLE,      /* tpuhot THROTTLE decision (aux 0) or
+                                  * applied service delay (aux 1)      */
     TPU_TRACE_HEALTH_TRANSITION, /* device health state change (obj =
                                   * dev, bytes = new TPU_HEALTH_*)     */
     TPU_TRACE_SITE_COUNT

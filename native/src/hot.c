@@ -1,0 +1,585 @@
+/*
+ * tpuhot — hotness-driven placement (see tpurm/hot.h for the contract).
+ *
+ * One decaying per-block tracker drives three policies:
+ *
+ *   prefetch governor — tree-density region growth clamped by a
+ *     measured-precision speculation cap (uvm_perf_prefetch.c analog);
+ *   thrash detector   — HBM<->host migration ping-pong trips PIN (with
+ *     arena headroom) or THROTTLE (without) hints
+ *     (uvm_perf_thrashing.h:33-46 analog);
+ *   victim scorer     — eviction and tpusched preemption consume the
+ *     decayed coldness signal (uvm_gpu_access_counters.c:81 analog:
+ *     sampled hotness steering placement).
+ *
+ * Concurrency: the feed is one relaxed fetch_add (uvmHotTouch, inlined
+ * in uvm_internal.h).  Score folds are lock-free relaxed atomics where
+ * racing folds can lose at most one delta (heuristic state).  The
+ * thrash detector and precision feedback run under blk->lock; the
+ * density bitmap is single-writer by the spine's per-block fault
+ * ordering.  Lock order: callers hold at most blk->lock (order 3) or
+ * the arena lock (order 4); this file only takes the PMM lock below
+ * them (headroom probe) and the counter table (order 8).
+ *
+ * Every policy decision routes through uvmHotDecideAllowed(): the
+ * hot.decide inject site with degrade-to-no-op recovery, reconciled
+ * EXACTLY as hits == hot_inject_skips.
+ */
+#define _GNU_SOURCE
+#include "uvm/uvm_internal.h"
+
+#include "tpurm/hot.h"
+#include "tpurm/inject.h"
+#include "tpurm/trace.h"
+
+#include <stdio.h>
+
+#define HOT_SCORE_SHIFT 10          /* fixed point: 1024 per page touch */
+#define HOT_MAX_DEVS 16
+#define HOT_TOPK 16
+
+static struct {
+    _Atomic uint64_t pins, throttles, throttleDelays, thrashPages;
+    _Atomic uint64_t prefetchGrown, prefetchShrunk, victimReorders;
+    _Atomic uint64_t injectSkips, decisions;
+    struct {
+        _Atomic uint64_t score;
+        _Atomic uint64_t scoreNs;
+    } dev[HOT_MAX_DEVS];
+} g_hot;
+
+bool uvmHotEnabled(void)
+{
+    static TpuRegCache c_en;
+    return tpuRegCacheGet(&c_en, "hot_enable", 1) != 0;
+}
+
+static uint64_t hot_halflife_ns(void)
+{
+    static TpuRegCache c_hl;
+    uint64_t ms = tpuRegCacheGet(&c_hl, "hot_decay_ms", 250);
+    return ms ? ms * 1000000ull : 1;
+}
+
+/* ------------------------------------------------------ inject gating */
+
+bool uvmHotDecideAllowed(void)
+{
+    atomic_fetch_add_explicit(&g_hot.decisions, 1, memory_order_relaxed);
+    if (tpurmInjectShouldFail(TPU_INJECT_SITE_HOT_DECIDE)) {
+        /* Degrade-to-no-op IS the recovery: the decision is skipped,
+         * placement falls back to the undecided default, and nothing
+         * retries — counted for the exact hits == skips invariant. */
+        atomic_fetch_add_explicit(&g_hot.injectSkips, 1,
+                                  memory_order_relaxed);
+        tpuCounterAdd("hot_inject_skips", 1);
+        return false;
+    }
+    return true;
+}
+
+/* ----------------------------------------------------- score tracking */
+
+/* Decay helper over a (score, scoreNs) atomic pair: halve per elapsed
+ * half-life.  Relaxed racing folds are benign (one delta may apply to
+ * an already-decayed value). */
+static uint64_t decay_fold(_Atomic uint64_t *score, _Atomic uint64_t *ns,
+                           uint64_t now, uint64_t add)
+{
+    uint64_t half = hot_halflife_ns();
+    uint64_t sNs = atomic_load_explicit(ns, memory_order_relaxed);
+    uint64_t s = atomic_load_explicit(score, memory_order_relaxed);
+    if (!sNs) {
+        atomic_store_explicit(ns, now, memory_order_relaxed);
+        sNs = now;
+    }
+    if (now > sNs) {
+        uint64_t steps = (now - sNs) / half;
+        if (steps) {
+            s = steps >= 64 ? 0 : s >> steps;
+            atomic_store_explicit(ns, sNs + steps * half,
+                                  memory_order_relaxed);
+        }
+    }
+    if (add)
+        s += add;
+    atomic_store_explicit(score, s, memory_order_relaxed);
+    return s;
+}
+
+uint64_t uvmHotBlockScore(UvmVaBlock *blk, uint64_t now)
+{
+    uint64_t t = atomic_load_explicit(&blk->hot.touches,
+                                      memory_order_relaxed);
+    uint64_t seen = atomic_load_explicit(&blk->hot.seen,
+                                         memory_order_relaxed);
+    uint64_t delta = 0;
+    /* Claim the unseen delta with a CAS: concurrent folds (victim walk
+     * under the arena lock vs a span probe under vs->lock) must not
+     * BOTH add it — a racing loser simply folds zero and the winner's
+     * add lands once in the block score and the device gauge. */
+    if (t > seen &&
+        atomic_compare_exchange_strong_explicit(
+            &blk->hot.seen, &seen, t, memory_order_relaxed,
+            memory_order_relaxed)) {
+        delta = t - seen;
+        atomic_store_explicit(&blk->hot.lastTouchNs, now,
+                              memory_order_relaxed);
+        uint32_t dev = blk->hbmDevInst;
+        if (dev < HOT_MAX_DEVS)
+            decay_fold(&g_hot.dev[dev].score, &g_hot.dev[dev].scoreNs,
+                       now, delta << HOT_SCORE_SHIFT);
+    }
+    return decay_fold(&blk->hot.score, &blk->hot.scoreNs, now,
+                      delta << HOT_SCORE_SHIFT);
+}
+
+uint64_t tpurmHotDeviceScore(uint32_t devInst)
+{
+    if (devInst >= HOT_MAX_DEVS)
+        return 0;
+    return decay_fold(&g_hot.dev[devInst].score,
+                      &g_hot.dev[devInst].scoreNs, tpuNowNs(), 0);
+}
+
+/* Mean block score over a managed span (tpusched's victim-coldness
+ * probe).  Resolves the owning space via the fault engine's snapshot
+ * path and walks whole blocks under the space lock. */
+uint64_t tpurmHotSpanScore(uint64_t addr, uint64_t len)
+{
+    UvmVaSpace *vs = uvmFaultSpaceForAddr(addr);
+    if (!vs || !len)
+        return 0;
+    uint64_t now = tpuNowNs();
+    uint64_t sum = 0;
+    uint32_t n = 0;
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "hot-span");
+    uint64_t a = addr & ~(UVM_BLOCK_SIZE - 1);
+    for (; a < addr + len; a += UVM_BLOCK_SIZE) {
+        UvmVaBlock *blk = NULL;
+        if (!uvmRangeFind(vs, a, &blk) || !blk)
+            continue;
+        sum += uvmHotBlockScore(blk, now);
+        n++;
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "hot-span");
+    pthread_mutex_unlock(&vs->lock);
+    return n ? sum / n : 0;
+}
+
+/* -------------------------------------------------- prefetch governor */
+
+static uint32_t mask_weight_range(const UvmPageMask *m, uint32_t first,
+                                  uint32_t count)
+{
+    uint32_t n = 0;
+    UVM_MASK_RANGE_WORDS(first, count, w, bm,
+                         n += (uint32_t)__builtin_popcountll(m->bits[w] &
+                                                             bm));
+    return n;
+}
+
+void uvmHotDensityMark(UvmVaBlock *blk, uint32_t first, uint32_t count)
+{
+    uvmPageMaskSetRange(&blk->hot.accessed, first, count);
+}
+
+void uvmHotDensityReset(UvmVaBlock *blk)
+{
+    uvmPageMaskZero(&blk->hot.accessed);
+}
+
+static uint32_t pf_cap_init(uint32_t maxPages)
+{
+    static TpuRegCache c_start;
+    uint32_t start = (uint32_t)tpuRegCacheGet(&c_start,
+                                              "hot_prefetch_start", 8);
+    if (start < 1)
+        start = 1;
+    return start < maxPages ? start : maxPages;
+}
+
+uint32_t uvmHotPrefetchGovern(UvmVaBlock *blk, uint32_t page,
+                              bool deviceFault, uint32_t maxPages)
+{
+    uint32_t cap = atomic_load_explicit(&blk->hot.pfCap,
+                                        memory_order_relaxed);
+    if (!cap) {
+        cap = pf_cap_init(maxPages);
+        atomic_store_explicit(&blk->hot.pfCap, cap, memory_order_relaxed);
+    }
+    if (cap > maxPages)
+        cap = maxPages;
+
+    /* Bottom-up tree growth (uvm_perf_prefetch.c region shape): the
+     * candidate region doubles only while the ENCLOSING aligned region
+     * keeps enough recently-accessed density — a lone fault in a cold
+     * block stays one page; a streaming pattern escalates level by
+     * level as its leaves fill in. */
+    static TpuRegCache c_dens;
+    uint32_t densPct = (uint32_t)tpuRegCacheGet(
+        &c_dens, "hot_prefetch_density_pct", 25);
+    uint32_t ppb = blk->npages;
+    uint32_t want = 1;
+    while (want < cap && want < ppb) {
+        uint32_t next = want << 1;
+        uint32_t first = (page / next) * next;
+        uint32_t cnt = next;
+        if (first + cnt > ppb)
+            cnt = ppb - first;
+        /* +1 for the demanded page itself (not yet marked). */
+        uint32_t w = mask_weight_range(&blk->hot.accessed, first, cnt) + 1;
+        if (w * 100 < cnt * densPct)
+            break;
+        want = next;
+    }
+    /* Device faults stream sequentially; one extra doubling (kept from
+     * the previous heuristic) — still inside the precision cap. */
+    if (deviceFault && want < cap && want < ppb)
+        want <<= 1;
+    if (want > cap)
+        want = cap;
+    return want;
+}
+
+void uvmHotPrefetchFeedback(UvmVaBlock *blk, uint32_t hits,
+                            uint32_t useless)
+{
+    if (!uvmHotEnabled())
+        return;
+    blk->hot.pfHits += hits;
+    blk->hot.pfUseless += useless;
+    uint32_t samples = blk->hot.pfHits + blk->hot.pfUseless;
+    static TpuRegCache c_minS;
+    if (samples < (uint32_t)tpuRegCacheGet(&c_minS,
+                                           "hot_prefetch_min_samples", 8))
+        return;
+    static TpuRegCache c_minP;
+    uint32_t minPrec = (uint32_t)tpuRegCacheGet(
+        &c_minP, "hot_prefetch_min_precision", 80);
+    static TpuRegCache c_pfMax;
+    uint32_t maxPages = (uint32_t)tpuRegCacheGet(
+        &c_pfMax, "uvm_prefetch_max_pages", 32);
+    uint32_t cap = atomic_load_explicit(&blk->hot.pfCap,
+                                        memory_order_relaxed);
+    if (!cap)
+        cap = pf_cap_init(maxPages);
+    bool good = (uint64_t)blk->hot.pfHits * 100 >=
+                (uint64_t)samples * minPrec;
+    if (good && cap < maxPages) {
+        if (uvmHotDecideAllowed()) {
+            atomic_store_explicit(&blk->hot.pfCap, cap << 1,
+                                  memory_order_relaxed);
+            atomic_fetch_add_explicit(&g_hot.prefetchGrown, 1,
+                                      memory_order_relaxed);
+            tpuCounterAdd("tpurm_hot_prefetch_grown", 1);
+        }
+    } else if (!good && cap > 1) {
+        if (uvmHotDecideAllowed()) {
+            atomic_store_explicit(&blk->hot.pfCap, cap >> 1,
+                                  memory_order_relaxed);
+            atomic_fetch_add_explicit(&g_hot.prefetchShrunk, 1,
+                                      memory_order_relaxed);
+            tpuCounterAdd("tpurm_hot_prefetch_shrunk", 1);
+        }
+    }
+    /* Halve the window so precision tracks the recent regime, not the
+     * block's whole history. */
+    blk->hot.pfHits >>= 1;
+    blk->hot.pfUseless >>= 1;
+}
+
+/* ----------------------------------------------------- thrash detector */
+
+/* blk->lock held (migration/eviction commit paths). */
+void uvmHotMigrationNote(UvmVaBlock *blk, UvmTier dstTier, uint32_t devInst)
+{
+    if (!uvmHotEnabled())
+        return;
+    int8_t dir = dstTier == UVM_TIER_HOST ? -1 : 1;
+    uint64_t now = uvmMonotonicNs();
+    static TpuRegCache c_win;
+    uint64_t windowNs = tpuRegCacheGet(&c_win, "hot_thrash_window_ms",
+                                       100) * 1000000ull;
+    if (now - blk->hot.thrashWinNs > windowNs) {
+        blk->hot.thrashWinNs = now;
+        blk->hot.thrashMoves = 0;
+    }
+    if (blk->hot.lastDir && dir != blk->hot.lastDir)
+        blk->hot.thrashMoves++;
+    blk->hot.lastDir = dir;
+
+    static TpuRegCache c_cnt;
+    uint32_t threshold = (uint32_t)tpuRegCacheGet(&c_cnt,
+                                                  "hot_thrash_count", 3);
+    if (blk->hot.thrashMoves < threshold)
+        return;
+    /* Already mitigated?  Let the active hint run its course. */
+    if (atomic_load_explicit(&blk->pinExpiryNs, memory_order_relaxed) >
+            now ||
+        atomic_load_explicit(&blk->hot.throttleUntilNs,
+                             memory_order_relaxed) > now)
+        return;
+    blk->hot.thrashMoves = 0;
+    atomic_fetch_add_explicit(&g_hot.thrashPages, blk->npages,
+                              memory_order_relaxed);
+    tpuCounterAdd("tpurm_hot_thrash_pages", blk->npages);
+    if (!uvmHotDecideAllowed())
+        return;                 /* injected: degrade to no-op */
+
+    /* PIN when the device arena has headroom (or the block already
+     * holds aperture runs — pinning in place costs nothing); THROTTLE
+     * otherwise, so the resident side keeps its working set instead of
+     * pinning into an arena that would have to evict someone else. */
+    UvmTier pinTo = dir > 0 ? dstTier : UVM_TIER_HBM;
+    if (pinTo == UVM_TIER_HOST)
+        pinTo = UVM_TIER_HBM;
+    static TpuRegCache c_pinOk;
+    bool pinEnabled = tpuRegCacheGet(&c_pinOk, "hot_pin", 1) != 0;
+    bool headroom = false;
+    if (pinEnabled) {
+        if (pinTo == UVM_TIER_HBM ? blk->hbmRuns != NULL
+                                  : blk->cxlRuns != NULL) {
+            headroom = true;
+        } else {
+            uint64_t freeB = 0, total = 0;
+            uint32_t dev = pinTo == UVM_TIER_HBM ? devInst : 0;
+            if (pinTo == UVM_TIER_HBM &&
+                uvmHbmArenaUsage(dev, &freeB, &total) == TPU_OK &&
+                total) {
+                static TpuRegCache c_hr;
+                uint64_t pct = tpuRegCacheGet(&c_hr,
+                                              "hot_pin_headroom_pct", 5);
+                headroom = freeB * 100 >= total * pct &&
+                           freeB >= UVM_BLOCK_SIZE;
+            }
+        }
+    }
+    if (pinEnabled && headroom) {
+        static TpuRegCache c_pinMs;
+        atomic_store_explicit(&blk->pinnedTier, (int32_t)pinTo,
+                              memory_order_relaxed);
+        atomic_store_explicit(
+            &blk->pinExpiryNs,
+            now + tpuRegCacheGet(&c_pinMs, "hot_pin_ms", 300) * 1000000ull,
+            memory_order_relaxed);
+        atomic_fetch_add_explicit(&g_hot.pins, 1, memory_order_relaxed);
+        tpuCounterAdd("tpurm_hot_pins", 1);
+        tpurmTraceInstant(TPU_TRACE_HOT_PIN, blk->start, pinTo);
+        uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_THRASHING,
+                     UVM_TIER_COUNT, pinTo, blk->hbmDevInst, blk->start,
+                     (uint64_t)blk->npages * uvmPageSize());
+    } else {
+        static TpuRegCache c_thMs;
+        atomic_store_explicit(
+            &blk->hot.throttleUntilNs,
+            now + tpuRegCacheGet(&c_thMs, "hot_throttle_ms", 100) *
+                      1000000ull,
+            memory_order_relaxed);
+        atomic_fetch_add_explicit(&g_hot.throttles, 1,
+                                  memory_order_relaxed);
+        tpuCounterAdd("tpurm_hot_throttles", 1);
+        tpurmTraceInstant(TPU_TRACE_HOT_THROTTLE, blk->start, 0);
+        uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_THRASHING,
+                     UVM_TIER_COUNT, UVM_TIER_COUNT, blk->hbmDevInst,
+                     blk->start, (uint64_t)blk->npages * uvmPageSize());
+    }
+}
+
+uint32_t uvmHotThrottleDelayUs(UvmVaBlock *blk)
+{
+    uint64_t until = atomic_load_explicit(&blk->hot.throttleUntilNs,
+                                          memory_order_relaxed);
+    if (!until)
+        return 0;                       /* fast path: never throttled */
+    if (uvmMonotonicNs() >= until)
+        return 0;
+    atomic_fetch_add_explicit(&g_hot.throttleDelays, 1,
+                              memory_order_relaxed);
+    tpuCounterAdd("tpurm_hot_throttle_delays", 1);
+    tpurmTraceInstant(TPU_TRACE_HOT_THROTTLE, blk->start, 1);
+    static TpuRegCache c_us;
+    return (uint32_t)tpuRegCacheGet(&c_us, "hot_throttle_us", 200);
+}
+
+/* ------------------------------------------------------- victim scorer */
+
+uint64_t uvmHotVictimScanDepth(void)
+{
+    if (!uvmHotEnabled())
+        return 0;
+    static TpuRegCache c_scan;
+    return tpuRegCacheGet(&c_scan, "hot_victim_scan", 8);
+}
+
+void uvmHotVictimReorderNote(void)
+{
+    atomic_fetch_add_explicit(&g_hot.victimReorders, 1,
+                              memory_order_relaxed);
+    tpuCounterAdd("tier_hot_victim_reorders", 1);
+}
+
+/* -------------------------------------------------------------- stats */
+
+void tpurmHotStatsGet(TpuHotStats *out)
+{
+    if (!out)
+        return;
+    out->pins = atomic_load_explicit(&g_hot.pins, memory_order_relaxed);
+    out->throttles = atomic_load_explicit(&g_hot.throttles,
+                                          memory_order_relaxed);
+    out->throttleDelays = atomic_load_explicit(&g_hot.throttleDelays,
+                                               memory_order_relaxed);
+    out->thrashPages = atomic_load_explicit(&g_hot.thrashPages,
+                                            memory_order_relaxed);
+    out->prefetchGrown = atomic_load_explicit(&g_hot.prefetchGrown,
+                                              memory_order_relaxed);
+    out->prefetchShrunk = atomic_load_explicit(&g_hot.prefetchShrunk,
+                                               memory_order_relaxed);
+    out->victimReorders = atomic_load_explicit(&g_hot.victimReorders,
+                                               memory_order_relaxed);
+    out->injectSkips = atomic_load_explicit(&g_hot.injectSkips,
+                                            memory_order_relaxed);
+    out->decisions = atomic_load_explicit(&g_hot.decisions,
+                                          memory_order_relaxed);
+}
+
+void tpurmHotStatsReset(void)
+{
+    atomic_store_explicit(&g_hot.pins, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.throttles, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.throttleDelays, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.thrashPages, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.prefetchGrown, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.prefetchShrunk, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.victimReorders, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.injectSkips, 0, memory_order_relaxed);
+    atomic_store_explicit(&g_hot.decisions, 0, memory_order_relaxed);
+    for (uint32_t i = 0; i < HOT_MAX_DEVS; i++) {
+        atomic_store_explicit(&g_hot.dev[i].score, 0,
+                              memory_order_relaxed);
+        atomic_store_explicit(&g_hot.dev[i].scoreNs, 0,
+                              memory_order_relaxed);
+    }
+}
+
+/* ------------------------------------------------------------- render */
+
+void tpurmHotRenderProm(TpuCur *c)
+{
+    tpuCurf(c, "# TYPE tpurm_hot_device_score gauge\n");
+    uint32_t n = tpurmDeviceCount();
+    if (n > HOT_MAX_DEVS)
+        n = HOT_MAX_DEVS;
+    for (uint32_t i = 0; i < n; i++)
+        tpuCurf(c, "tpurm_hot_device_score{dev=\"%u\"} %llu\n", i,
+                (unsigned long long)tpurmHotDeviceScore(i));
+}
+
+/* Top-K table context for the block walk. */
+typedef struct {
+    uint64_t start, score, touches;
+    uint64_t ageMs;                 /* since the last fold saw a touch */
+    int32_t pinnedTier;
+    uint64_t pinLeftMs;
+    bool throttled;
+    uint32_t pfCap;
+} HotTopEntry;
+
+typedef struct {
+    uint64_t now;
+    HotTopEntry top[HOT_TOPK];
+    uint32_t n;
+    uint64_t blocks;
+} HotTopCtx;
+
+static void hot_top_visit(UvmVaSpace *vs, UvmVaBlock *blk, void *ctxp)
+{
+    (void)vs;
+    HotTopCtx *ctx = ctxp;
+    ctx->blocks++;
+    uint64_t s = uvmHotBlockScore(blk, ctx->now);
+    uint32_t i = ctx->n < HOT_TOPK ? ctx->n : HOT_TOPK - 1;
+    if (i == HOT_TOPK - 1 && ctx->n >= HOT_TOPK &&
+        s <= ctx->top[i].score)
+        return;
+    ctx->top[i].start = blk->start;
+    ctx->top[i].score = s;
+    ctx->top[i].touches = atomic_load_explicit(&blk->hot.touches,
+                                               memory_order_relaxed);
+    uint64_t lt = atomic_load_explicit(&blk->hot.lastTouchNs,
+                                       memory_order_relaxed);
+    ctx->top[i].ageMs = lt && ctx->now > lt ? (ctx->now - lt) / 1000000
+                                            : 0;
+    ctx->top[i].pinnedTier = atomic_load_explicit(&blk->pinnedTier,
+                                                  memory_order_relaxed);
+    uint64_t exp = atomic_load_explicit(&blk->pinExpiryNs,
+                                        memory_order_relaxed);
+    ctx->top[i].pinLeftMs = exp > ctx->now ? (exp - ctx->now) / 1000000
+                                           : 0;
+    ctx->top[i].throttled =
+        atomic_load_explicit(&blk->hot.throttleUntilNs,
+                             memory_order_relaxed) > ctx->now;
+    ctx->top[i].pfCap = atomic_load_explicit(&blk->hot.pfCap,
+                                             memory_order_relaxed);
+    if (ctx->n < HOT_TOPK)
+        ctx->n++;
+    /* Bubble up into score order (tiny K). */
+    while (i > 0 && ctx->top[i].score > ctx->top[i - 1].score) {
+        HotTopEntry tmp = ctx->top[i - 1];
+        ctx->top[i - 1] = ctx->top[i];
+        ctx->top[i] = tmp;
+        i--;
+    }
+}
+
+void tpurmHotRenderTable(TpuCur *c)
+{
+    static const char *const tierNames[] = { "HOST", "HBM", "CXL" };
+    HotTopCtx ctx = { .now = tpuNowNs() };
+    uvmFaultForEachSpaceCtx(hot_top_visit, &ctx);
+    TpuHotStats st;
+    tpurmHotStatsGet(&st);
+    tpuCurf(c, "enabled:            %d\n", uvmHotEnabled() ? 1 : 0);
+    tpuCurf(c, "tracked_blocks:     %llu\n",
+            (unsigned long long)ctx.blocks);
+    tpuCurf(c, "pins:               %llu\n", (unsigned long long)st.pins);
+    tpuCurf(c, "throttles:          %llu\n",
+            (unsigned long long)st.throttles);
+    tpuCurf(c, "throttle_delays:    %llu\n",
+            (unsigned long long)st.throttleDelays);
+    tpuCurf(c, "thrash_pages:       %llu\n",
+            (unsigned long long)st.thrashPages);
+    tpuCurf(c, "prefetch_grown:     %llu\n",
+            (unsigned long long)st.prefetchGrown);
+    tpuCurf(c, "prefetch_shrunk:    %llu\n",
+            (unsigned long long)st.prefetchShrunk);
+    tpuCurf(c, "victim_reorders:    %llu\n",
+            (unsigned long long)st.victimReorders);
+    tpuCurf(c, "inject_skips:       %llu\n",
+            (unsigned long long)st.injectSkips);
+    uint32_t ndev = tpurmDeviceCount();
+    if (ndev > HOT_MAX_DEVS)
+        ndev = HOT_MAX_DEVS;
+    for (uint32_t i = 0; i < ndev; i++)
+        tpuCurf(c, "dev%u_score:         %llu\n", i,
+                (unsigned long long)tpurmHotDeviceScore(i));
+    tpuCurf(c, "\n%-18s %-10s %-10s %-8s %-6s %-8s %-5s %s\n", "block",
+            "score", "touches", "age_ms", "pin", "pin_ms", "thr",
+            "pf_cap");
+    for (uint32_t i = 0; i < ctx.n; i++) {
+        int32_t pt = ctx.top[i].pinnedTier;
+        tpuCurf(c,
+                "0x%-16llx %-10llu %-10llu %-8llu %-6s %-8llu %-5s %u\n",
+                (unsigned long long)ctx.top[i].start,
+                (unsigned long long)ctx.top[i].score,
+                (unsigned long long)ctx.top[i].touches,
+                (unsigned long long)ctx.top[i].ageMs,
+                pt >= 0 && pt < 3 && ctx.top[i].pinLeftMs
+                    ? tierNames[pt] : "-",
+                (unsigned long long)ctx.top[i].pinLeftMs,
+                ctx.top[i].throttled ? "yes" : "-",
+                ctx.top[i].pfCap);
+    }
+}

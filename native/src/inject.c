@@ -55,6 +55,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "sched.admit",
     "reset.device",
     "vac.migrate",
+    "hot.decide",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -71,6 +72,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "SCHED_ADMIT",
     "RESET_DEVICE",
     "VAC_MIGRATE",
+    "HOT_DECIDE",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)

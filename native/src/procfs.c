@@ -119,7 +119,7 @@ static void render_tools_events(TpuCur *c)
         { "ReadDuplicateInvalidate", "READ_DUP_INVALIDATE", "" },
         { "PageSizeChange",       "-", "one page size per run (registry)" },
         { "ThrashingDetected",    "THRASHING", "" },
-        { "ThrottlingStart/End",  "-", "throttling folded into thrash pins" },
+        { "ThrottlingStart/End",  "THRASHING", "tpuhot THROTTLE hint (hot.throttle)" },
         { "MapRemote",            "MAP_REMOTE", "" },
         { "Eviction",             "EVICTION", "" },
         { "(counters)Prefetch",   "PREFETCH", "" },
@@ -178,7 +178,15 @@ static void render_metrics(TpuCur *c)
     c->off += tpurmTraceRenderProm(c->buf + c->off, c->cap - c->off);
     uvmTenantRenderProm(c);
     tpurmHealthRenderProm(c);
+    tpurmHotRenderProm(c);
     tpurmFlowRenderProm(c);
+}
+
+/* Hotness-driven placement (tpuhot): policy stats, per-device hotness
+ * gauges, and the top-K hot blocks with their PIN/THROTTLE state. */
+static void render_hotness(TpuCur *c)
+{
+    tpurmHotRenderTable(c);
 }
 
 /* Live top-K slow flows by blame (tpuflow), with per-bucket ms. */
@@ -266,6 +274,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm/tenants", render_tenants, false },
     { "driver/tpurm/reset", render_reset, false },
     { "driver/tpurm/health", render_health, false },
+    { "driver/tpurm/hotness", render_hotness, false },
     { "driver/tpurm/flows", render_flows, false },
 };
 

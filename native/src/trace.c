@@ -114,6 +114,8 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "recover.quarantine",     "recover" },
     { "recover.rc_reset",       "recover" },
     { "recover.retrain",        "recover" },
+    { "hot.pin",                "hot"     },
+    { "hot.throttle",           "hot"     },
     { "health.transition",      "health"  },
 };
 

@@ -705,6 +705,20 @@ static TpuStatus service_one(UvmFaultEntry *e)
                 forceDup = true;
         }
 
+        /* tpuhot tracker feed: ONE relaxed RMW per service (CPU demand
+         * faults and device-access spans both land here) — recency and
+         * decay fold lazily at the policy points. */
+        uvmHotTouch(blk, count);
+        /* THROTTLE hint (thrash mitigation without HBM headroom): delay
+         * this stream's service so the resident side keeps its working
+         * set.  Bounded by hot_throttle_us per service and the hint's
+         * own hot_throttle_ms expiry — never a wedge. */
+        {
+            uint32_t tUs = uvmHotThrottleDelayUs(blk);
+            if (tUs)
+                usleep(tUs);
+        }
+
         /* Prefetch effectiveness: this access DEMANDED [firstPage,
          * count) — pages there that an earlier expansion staged
          * speculatively count as prefetch hits (and unmark). */
@@ -716,8 +730,10 @@ static TpuStatus service_one(UvmFaultEntry *e)
         if (e->len <= ps)
             uvmPerfPrefetchExpand(blk, firstPage, e->source ==
                                   UVM_FAULT_SRC_DEVICE, &firstPage, &count);
-
-        uvmPerfThrashingRecord(blk, dst.tier);
+        else
+            /* Multi-page device spans still feed the density tree the
+             * expansion consults (they bypass the expand path). */
+            uvmHotDensityMark(blk, firstPage, count);
 
         /* Accessed-by devices get a MAPPING to the data where it lives,
          * not a migration (reference: service_fault_batch services

@@ -199,7 +199,6 @@ TpuStatus uvmPageableAdopt(UvmVaSpace *vs, void *base, uint64_t len)
         blk->start = (uintptr_t)base + (uint64_t)i * UVM_BLOCK_SIZE;
         blk->npages = ppb;
         blk->pinnedTier = -1;
-        blk->lastTargetTier = -1;
         /* Adopted pages are live host data with valid RW PTEs. */
         uvmPageMaskSetRange(&blk->resident[UVM_TIER_HOST], 0, ppb);
         uvmPageMaskSetRange(&blk->cpuMapped, 0, ppb);

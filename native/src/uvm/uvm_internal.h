@@ -277,16 +277,45 @@ typedef struct UvmVaBlock {
      * uvm_prefetch_useless (the feedback signal the ROADMAP prefetch
      * item needs).  Mutated under blk->lock. */
     UvmPageMask prefetched;
-    /* Perf state (thrashing/prefetch, uvm_perf_thrashing.h:33-46). */
+    /* Perf state (prefetch window, uvm_perf_prefetch.c analog).
+     * Single-writer: the spine's per-block fault ordering (OP_FAULT
+     * dep DAG) serializes services of one block, so these are plain. */
     uint32_t faultCount;
     uint64_t lastFaultNs;
     uint64_t windowStartNs;
     uint32_t windowFaults;
-    uint32_t windowSwitches;          /* tier alternations in the window */
-    uint64_t thrashWindowStartNs;     /* thrash detector's own window */
-    int32_t lastTargetTier;           /* -1 = none yet */
-    int32_t pinnedTier;               /* -1 = not pinned */
-    uint64_t pinExpiryNs;
+    /* Thrashing PIN hint (tpuhot, uvm_perf_thrashing.h:33-46 analog):
+     * while pinExpiryNs is in the future the block is exempt from
+     * uvmLruPopVictim (and therefore uvmTierEvictBytes) for the pinned
+     * tier, and CPU read faults duplicate against the pinned copy
+     * instead of invalidating it.  Atomics: written by the thrash
+     * detector under blk->lock but read lock-free by the victim walk
+     * (arena lock only) and the fault target selection. */
+    _Atomic int32_t pinnedTier;       /* -1 = not pinned */
+    _Atomic uint64_t pinExpiryNs;
+    /* tpuhot per-block tracker (native/src/hot.c).  `touches` is the
+     * fault-service feed: ONE relaxed fetch_add per service; the
+     * decayed score/recency fold happens lazily at policy points.
+     * Atomics are read/folded lock-free from the victim walks;
+     * the plain fields are serialized by blk->lock (thrash detector,
+     * precision feedback) or by the per-block fault ordering
+     * (density mask, mutated only from prefetch expansion). */
+    struct {
+        _Atomic uint64_t touches;     /* pages accessed (lifetime)      */
+        _Atomic uint64_t seen;        /* touches already folded         */
+        _Atomic uint64_t score;       /* decayed hotness, <<10 fixpoint */
+        _Atomic uint64_t scoreNs;     /* last decay fold                */
+        _Atomic uint64_t lastTouchNs; /* recency (stamped at fold)      */
+        _Atomic uint64_t throttleUntilNs; /* THROTTLE hint expiry       */
+        /* Thrash detector (under blk->lock: migration commit paths). */
+        uint64_t thrashWinNs;
+        uint32_t thrashMoves;         /* direction alternations         */
+        int8_t lastDir;               /* +1 deviceward, -1 hostward     */
+        /* Prefetch governor. */
+        _Atomic uint32_t pfCap;       /* speculation cap, 0 = uninit    */
+        uint32_t pfHits, pfUseless;   /* decaying precision window      */
+        UvmPageMask accessed;         /* density bitmap (20ms window)   */
+    } hot;
     /* P2P pins: while >0 the block's device residency is locked in place
      * (no eviction, no migration away) — RDMA consumers hold bus
      * addresses into it (reference: vidmem pinned by p2p get_pages). */
@@ -639,10 +668,67 @@ void uvmPerfPrefetchMark(UvmVaBlock *blk, uint32_t reqFirst,
                          uint32_t count);
 void uvmPerfPrefetchEvictLocked(UvmVaBlock *blk, uint32_t first,
                                 uint32_t count);
-/* Record a fault on blk; may pin the block to its current tier for a
- * window (thrashing mitigation, uvm_perf_thrashing.h:33-46). */
-void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier);
 bool uvmPerfBlockPinnedAgainst(UvmVaBlock *blk, UvmTier targetTier);
+
+/* --------------------------------------------------------------- tpuhot
+ *
+ * Hotness-driven placement (native/src/hot.c; see tpurm/hot.h for the
+ * subsystem contract).  Everything here is engine-internal: the feed,
+ * the three policies, and the render hooks. */
+
+#include <stdatomic.h>
+
+/* Tracker feed: ONE relaxed RMW — the only cost on the fault-service
+ * critical path (recency/decay fold happens lazily at policy points). */
+static inline void uvmHotTouch(UvmVaBlock *blk, uint32_t pages)
+{
+    atomic_fetch_add_explicit(&blk->hot.touches, pages,
+                              memory_order_relaxed);
+}
+
+/* Decayed hotness score (lazy fold of touches + decay; safe lock-free,
+ * racing folds lose at most a touch delta). */
+uint64_t uvmHotBlockScore(UvmVaBlock *blk, uint64_t now);
+
+/* Prefetch governor: the governed region size (pages) for a fault at
+ * `page` — tree-density bottom-up growth clamped by the block's
+ * precision-driven speculation cap.  maxPages already folds the
+ * registry cap and block geometry. */
+uint32_t uvmHotPrefetchGovern(UvmVaBlock *blk, uint32_t page,
+                              bool deviceFault, uint32_t maxPages);
+/* Mark [first,count) recently-accessed in the density bitmap (called
+ * from the expansion with the final serviced region). */
+void uvmHotDensityMark(UvmVaBlock *blk, uint32_t first, uint32_t count);
+void uvmHotDensityReset(UvmVaBlock *blk);
+/* Precision feedback (blk->lock held): hits/useless deltas from the
+ * PR-7 effectiveness counters grow/shrink the speculation cap. */
+void uvmHotPrefetchFeedback(UvmVaBlock *blk, uint32_t hits,
+                            uint32_t useless);
+
+/* Thrash detector: note one committed migration of blk's pages toward
+ * `dstTier` (blk->lock held — called from the make-resident and
+ * eviction commit points).  Direction alternations inside the window
+ * trip PIN or THROTTLE. */
+void uvmHotMigrationNote(UvmVaBlock *blk, UvmTier dstTier,
+                         uint32_t devInst);
+/* THROTTLE hint: microseconds to delay this service (0 = none);
+ * counts and emits the hot.throttle instant when nonzero. */
+uint32_t uvmHotThrottleDelayUs(UvmVaBlock *blk);
+
+/* Victim scorer: bounded coldness scan over the plain-LRU path
+ * (returns the colder candidate to evict, possibly `head` itself;
+ * caller holds the arena lock, candidates are walked via lru links).
+ * Registry "hot_victim_scan" bounds the scan (0 disables). */
+uint64_t uvmHotVictimScanDepth(void);
+void uvmHotVictimReorderNote(void);
+/* One hot.decide inject evaluation wrapping a policy decision: false
+ * means an injected hit degraded this decision to a no-op (counted
+ * hot_inject_skips — EXACT: hits == skips). */
+bool uvmHotDecideAllowed(void);
+bool uvmHotEnabled(void);
+
+void tpurmHotRenderProm(TpuCur *c);
+void tpurmHotRenderTable(TpuCur *c);
 
 /* Access counters (uvm_gpu_access_counters.c:81 analog).  Record returns
  * true when the block crossed the hotness threshold and should be

@@ -1,25 +1,27 @@
 /*
- * Perf heuristics: prefetch region growth + thrashing detection.
+ * Perf heuristics: prefetch region growth + access-counter promotion.
  *
- * Prefetch — re-design of the reference's tree-based region growth
- * (uvm_perf_prefetch.c: faults within a va_block grow power-of-two
- * aligned prefetch regions when the fault density crosses a threshold).
- * Here: the serviced region around a faulting page doubles with the
- * block's fault count inside a time window — 1 page on a cold block, up
- * to the whole block when faults are streaming.  Registry knobs:
+ * Prefetch — the region a fault service expands to is picked by the
+ * tpuhot governor (native/src/hot.c, uvm_perf_prefetch.c analog):
+ * bottom-up TREE-DENSITY growth (the candidate region doubles only
+ * while the enclosing aligned region's recently-accessed density stays
+ * above hot_prefetch_density_pct) clamped by a per-block speculation
+ * cap that MEASURED PRECISION — uvm_prefetch_hits/(hits+useless) from
+ * the effectiveness counters below — grows and shrinks around
+ * hot_prefetch_min_precision.  With tpuhot disabled (hot_enable=0) the
+ * pre-governor heuristic remains: the region doubles with the block's
+ * fault count inside a time window.  Registry knobs:
  *   uvm_prefetch_enable   (default 1)
  *   uvm_prefetch_max_pages(default 32 = whole 2 MB block at 64 KB pages)
  *
- * Thrashing — re-design of uvm_perf_thrashing.c's detection + PIN/THROTTLE
- * hints (uvm_perf_thrashing.h:33-46): when a block's migration target
- * alternates tiers more than uvm_thrash_threshold times within
- * uvm_thrash_window_ms, the block is PINNED to the last device-side tier
- * for uvm_thrash_pin_ms; CPU read faults then duplicate instead of
- * invalidating (uvmBlockMakeResidentEx forceDup) and the eviction LRU
- * skips pinned blocks.  THROTTLE is implicit in batching.
+ * Thrashing detection + PIN/THROTTLE hints live in tpuhot
+ * (uvmHotMigrationNote, fed from the migration commit points);
+ * uvmPerfBlockPinnedAgainst below is the placement-side reader of the
+ * PIN hint (uvm_perf_thrashing.h:33-46).
  *
- * These run from the single fault-service thread without the block lock;
- * the counters are heuristic state and tolerate benign races (the
+ * These run from the fault-service workers without the block lock; the
+ * spine's per-block ordering makes them single-writer per block, and
+ * the counters are heuristic state tolerating benign races (the
  * reference's perf modules are similarly advisory).
  */
 #include "uvm_internal.h"
@@ -41,26 +43,36 @@ void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
     if (now - blk->windowStartNs > windowNs) {
         blk->windowStartNs = now;
         blk->windowFaults = 0;
+        /* The density tree observes one window at a time: a stale
+         * bitmap would let last epoch's pattern keep inflating
+         * regions the current access pattern no longer earns. */
+        uvmHotDensityReset(blk);
     }
     blk->windowFaults++;
     blk->faultCount++;
     blk->lastFaultNs = now;
 
-    /* Region doubles with fault pressure: 2^(faults-1) pages, aligned. */
     static TpuRegCache c_pfMax;
     uint32_t maxPages = (uint32_t)tpuRegCacheGet(&c_pfMax,
                                                  "uvm_prefetch_max_pages",
                                                  32);
     uint32_t ppb = blk->npages;
-    uint32_t want = 1;
-    uint32_t f = blk->windowFaults;
-    while (f > 1 && want < maxPages && want < ppb) {
-        want <<= 1;
-        f >>= 1;
+    if (maxPages > ppb)
+        maxPages = ppb;
+    uint32_t want;
+    if (uvmHotEnabled()) {
+        want = uvmHotPrefetchGovern(blk, page, deviceFault, maxPages);
+    } else {
+        /* Legacy lookahead: 2^(faults-1) pages, aligned. */
+        want = 1;
+        uint32_t f = blk->windowFaults;
+        while (f > 1 && want < maxPages) {
+            want <<= 1;
+            f >>= 1;
+        }
+        if (deviceFault && want < maxPages)
+            want <<= 1;
     }
-    /* Device faults stream sequentially; give them one extra doubling. */
-    if (deviceFault && want < maxPages && want < ppb)
-        want <<= 1;
     if (want > ppb)
         want = ppb;
 
@@ -70,6 +82,10 @@ void uvmPerfPrefetchExpand(UvmVaBlock *blk, uint32_t page, bool deviceFault,
         cnt = ppb - first;
     *firstPage = first;
     *count = cnt;
+    /* Feed the density tree with the whole serviced region: prefetched
+     * pages do not re-fault, so counting only demanded pages would
+     * starve the bottom-up growth the moment speculation works. */
+    uvmHotDensityMark(blk, first, cnt);
     if (cnt > 1) {
         tpuCounterAdd("uvm_prefetch_pages", cnt - 1);
         uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_PREFETCH, UVM_TIER_COUNT,
@@ -106,6 +122,8 @@ void uvmPerfPrefetchTouch(UvmVaBlock *blk, uint32_t first, uint32_t count)
     pthread_mutex_lock(&blk->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "prefetch-touch");
     uint32_t n = prefetch_count_and_clear(blk, first, count);
+    if (n)
+        uvmHotPrefetchFeedback(blk, n, 0);   /* precision: hits */
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "prefetch-touch");
     pthread_mutex_unlock(&blk->lock);
     if (n)
@@ -128,68 +146,26 @@ void uvmPerfPrefetchEvictLocked(UvmVaBlock *blk, uint32_t first,
                                 uint32_t count)
 {
     uint32_t n = prefetch_count_and_clear(blk, first, count);
-    if (n)
+    if (n) {
+        uvmHotPrefetchFeedback(blk, 0, n);   /* precision: useless */
         tpuCounterAdd("uvm_prefetch_useless", n);
+    }
 }
 
-void uvmPerfThrashingRecord(UvmVaBlock *blk, UvmTier targetTier)
-{
-    static TpuRegCache c_thEnable;
-    if (!tpuRegCacheGet(&c_thEnable, "uvm_thrash_enable", 1))
-        return;
-    uint64_t now = uvmMonotonicNs();
-    static TpuRegCache c_thWindow;
-    uint64_t windowNs = tpuRegCacheGet(&c_thWindow,
-                                       "uvm_thrash_window_ms", 100) *
-                        1000000ull;
-
-    if (blk->pinnedTier >= 0 && blk->pinExpiryNs <= now) {
-        blk->pinnedTier = -1;
-        blk->windowSwitches = 0;
-    }
-
-    if (blk->lastTargetTier >= 0 &&
-        blk->lastTargetTier != (int32_t)targetTier) {
-        /* Dedicated window (prefetch owns windowStartNs on its own 20 ms
-         * cadence; sharing it would keep this window forever fresh). */
-        if (now - blk->thrashWindowStartNs > windowNs) {
-            blk->thrashWindowStartNs = now;
-            blk->windowSwitches = 0;
-        }
-        blk->windowSwitches++;
-        static TpuRegCache c_thThresh;
-        uint32_t threshold =
-            (uint32_t)tpuRegCacheGet(&c_thThresh, "uvm_thrash_threshold", 3);
-        if (blk->windowSwitches >= threshold && blk->pinnedTier < 0) {
-            /* Pin to the device-side tier of the ping-pong pair so the
-             * device copy survives; CPU reads duplicate against it. */
-            UvmTier pinTo = targetTier != UVM_TIER_HOST
-                                ? targetTier
-                                : (UvmTier)blk->lastTargetTier;
-            if (pinTo == UVM_TIER_HOST)
-                pinTo = UVM_TIER_HBM;
-            blk->pinnedTier = (int32_t)pinTo;
-            static TpuRegCache c_thPin;
-            blk->pinExpiryNs = now + tpuRegCacheGet(&c_thPin,
-                                                    "uvm_thrash_pin_ms",
-                                                    300) * 1000000ull;
-            blk->windowSwitches = 0;
-            tpuCounterAdd("uvm_thrash_pins", 1);
-            uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_THRASHING,
-                         UVM_TIER_COUNT, pinTo, blk->hbmDevInst, blk->start,
-                         (uint64_t)blk->npages * uvmPageSize());
-        }
-    }
-    blk->lastTargetTier = (int32_t)targetTier;
-}
-
+/* PIN-hint reader (target selection + victim exemption).  The hint is
+ * written by tpuhot's thrash detector under blk->lock but read
+ * lock-free here — the fields are relaxed atomics; a racing lapse or
+ * re-pin lands on the next decision, never as a torn value. */
 bool uvmPerfBlockPinnedAgainst(UvmVaBlock *blk, UvmTier targetTier)
 {
-    if (blk->pinnedTier < 0)
+    int32_t pinned = atomic_load_explicit(&blk->pinnedTier,
+                                          memory_order_relaxed);
+    if (pinned < 0)
         return false;
-    if (blk->pinExpiryNs <= uvmMonotonicNs())
+    if (atomic_load_explicit(&blk->pinExpiryNs, memory_order_relaxed) <=
+        uvmMonotonicNs())
         return false;
-    return blk->pinnedTier != (int32_t)targetTier;
+    return pinned != (int32_t)targetTier;
 }
 
 /* ------------------------------------------------------ access counters */

@@ -258,6 +258,44 @@ UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
             break;
         blk = blk->lru[ix].next;
     }
+    /* Hotness-fed victim scoring, plain-LRU path (tpuhot): the list
+     * head is the oldest INSERTION, not necessarily the coldest data —
+     * a released-but-hot block reinserted at the cold end would be the
+     * next victim on position alone.  A bounded scan picks the
+     * genuinely-coldest candidate by decayed score; ties (cold
+     * tracker, uniform scores) keep the historical head-first order
+     * byte-for-byte.  The reorder is a tpuhot policy decision: it runs
+     * under the hot.decide inject site and degrades to the positional
+     * pick. */
+    if (blk && !uvmTenantsActive()) {
+        uint64_t depth = uvmHotVictimScanDepth();
+        if (depth) {
+            UvmVaBlock *best = blk;
+            uint64_t bestScore = uvmHotBlockScore(blk, now);
+            uint64_t seen = 0;
+            /* Every TRAVERSED candidate counts toward the depth bound
+             * (not just eligible ones): a pin storm must not turn this
+             * into an O(list) walk under the arena lock. */
+            for (UvmVaBlock *cand = blk->lru[ix].next;
+                 cand && seen < depth; cand = cand->lru[ix].next) {
+                seen++;
+                bool pinned = (cand->pinnedTier == (int32_t)a->tier &&
+                               cand->pinExpiryNs > now) ||
+                              cand->p2pPinCount > 0;
+                if (cand == exclude || pinned)
+                    continue;
+                uint64_t s = uvmHotBlockScore(cand, now);
+                if (s < bestScore) {
+                    best = cand;
+                    bestScore = s;
+                }
+            }
+            if (best != blk && uvmHotDecideAllowed()) {
+                blk = best;
+                uvmHotVictimReorderNote();
+            }
+        }
+    }
     /* SLO-aware victim selection (multi-tenant QoS): once tenants are
      * configured, the plain LRU-head pop becomes a scored walk — cold
      * blocks of OVER-QUOTA tenants victimize first, then lower-priority
@@ -273,6 +311,16 @@ UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
         bool bestOver = uvmTenantOverQuota(bt, a->tier);
         uint32_t bestPrio = atomic_load_explicit(&bt->priority,
                                                  memory_order_relaxed);
+        bool hotScored = uvmHotVictimScanDepth() != 0;
+        uint64_t bestScore = hotScored ? uvmHotBlockScore(blk, now) : 0;
+        /* The score-less lexicographic pick runs alongside: if the
+         * hotness tie-break ends up CHANGING the victim, that is a
+         * tpuhot policy decision — gated on hot.decide (degrade =
+         * keep the positional pick) and counted like the plain-path
+         * reorder. */
+        UvmVaBlock *baseBest = blk;
+        bool baseOver = bestOver;
+        uint32_t basePrio = bestPrio;
         for (UvmVaBlock *cand = blk->lru[ix].next; cand;
              cand = cand->lru[ix].next) {
             bool pinned = (cand->pinnedTier == (int32_t)a->tier &&
@@ -284,13 +332,35 @@ UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
             bool over = uvmTenantOverQuota(ct, a->tier);
             uint32_t prio = atomic_load_explicit(&ct->priority,
                                                  memory_order_relaxed);
-            /* Lexicographic (overQuota desc, priority asc); earlier
-             * list position (colder) wins ties by never replacing. */
+            if ((over && !baseOver) ||
+                (over == baseOver && prio < basePrio)) {
+                baseBest = cand;
+                baseOver = over;
+                basePrio = prio;
+            }
+            /* Lexicographic (overQuota desc, priority asc, decayed
+             * hotness asc — the tpuhot coldness signal replaces raw
+             * list position as the in-class tie-break, so eviction
+             * takes genuinely-cold blocks); with the scorer disabled
+             * (hot_victim_scan=0) earlier list position wins ties by
+             * never replacing, the historical order. */
+            uint64_t score = hotScored ? uvmHotBlockScore(cand, now) : 0;
             if ((over && !bestOver) ||
-                (over == bestOver && prio < bestPrio)) {
+                (over == bestOver && prio < bestPrio) ||
+                (hotScored && over == bestOver && prio == bestPrio &&
+                 score < bestScore)) {
                 best = cand;
                 bestOver = over;
                 bestPrio = prio;
+                bestScore = score;
+            }
+        }
+        if (best != baseBest) {
+            if (uvmHotDecideAllowed()) {
+                uvmHotVictimReorderNote();
+            } else {
+                best = baseBest;      /* injected: positional pick */
+                bestOver = baseOver;
             }
         }
         if (best != blk)

@@ -552,9 +552,14 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                 p += span - 1;
             }
             uvmFaultStatsRecordMigration(bytes);
-            if (bytes)
+            if (bytes) {
                 tpuCounterAddScoped("uvm_bytes_xfer_dth", blk->hbmDevInst,
                                     bytes);
+                /* tpuhot: an eviction copy-back is a hostward migration
+                 * — half of the HBM<->host ping-pong the thrash
+                 * detector watches for. */
+                uvmHotMigrationNote(blk, UVM_TIER_HOST, blk->hbmDevInst);
+            }
             uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_EVICTION, tier,
                          UVM_TIER_HOST, blk->hbmDevInst, blk->start, bytes);
         }
@@ -887,6 +892,10 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             uvmFaultStatsRecordMigration(bytes);
             tpuCounterAddScoped("uvm_bytes_xfer_dth", blk->hbmDevInst,
                                 bytes);
+            /* tpuhot thrash detector: one committed migration toward
+             * dst — direction alternations inside the window trip the
+             * PIN/THROTTLE decision (blk->lock held here). */
+            uvmHotMigrationNote(blk, dst.tier, dst.devInst);
             if (readDup)
                 /* Source copies survived: this copy created duplicates
                  * (reference emits UvmEventTypeReadDuplicate from the

@@ -614,7 +614,6 @@ static TpuStatus mem_alloc_gated(UvmVaSpace *vs, uint64_t size,
                           ? ppb
                           : (uint32_t)(remaining / ps);
         blk->pinnedTier = -1;
-        blk->lastTargetTier = -1;
         range->blocks[i] = blk;
     }
 
@@ -1408,7 +1407,10 @@ TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out)
     out->cpuMapped = uvmPageMaskTest(&blk->cpuMapped, page);
     out->devMapped = uvmPageMaskTest(&blk->devMapped, page);
     out->cancelled = uvmPageMaskTest(&blk->cancelled, page);
-    out->pinnedTier = blk->pinnedTier;
+    /* Report a LAPSED thrash pin as unpinned: the hint readers all
+     * check expiry, so the raw field alone would overstate the pin. */
+    out->pinnedTier = blk->pinExpiryNs > uvmMonotonicNs()
+                          ? blk->pinnedTier : -1;
     if (out->residentHbm)
         uvmBlockHbmArenaOffset(blk, page, &out->hbmOffset);
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");

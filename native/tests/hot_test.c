@@ -1,0 +1,402 @@
+/*
+ * tpuhot test: tracker decay, thrash PIN exemption from BOTH eviction
+ * paths (allocation-pressure uvmLruPopVictim and the spine's
+ * byte-target uvmTierEvictBytes), pin lapse, THROTTLE boundedness,
+ * precision-gated prefetch growth/shrink, hotness-fed victim
+ * reordering, and the hot.decide inject site's EXACT reconciliation
+ * (hits == hot_inject_skips).
+ *
+ * Single fake device with a 16 MB arena (set below before the engine
+ * initializes) so eviction pressure is cheap to create.
+ */
+#define _GNU_SOURCE
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "tpurm/hot.h"
+#include "tpurm/inject.h"
+#include "tpurm/status.h"
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+#define MB (1024ull * 1024)
+#define BLOCK (2 * MB)
+
+/* Internal surfaces the test drives directly (exported symbols;
+ * declared by hand like the other native tests do). */
+void tpuRegistrySet(const char *key, const char *value);
+uint64_t uvmTierEvictBytes(uint32_t tier, uint32_t devInst,
+                           uint64_t bytes);
+
+/* Byte target that evicts roughly ONE block: current free + one block
+ * (uvmTierEvictBytes stops as soon as the arena can take the target). */
+static uint64_t one_block_target(void)
+{
+    uint64_t freeB = 0, total = 0;
+    if (uvmHbmArenaUsage(0, &freeB, &total) != TPU_OK)
+        return BLOCK;
+    return freeB + BLOCK;
+}
+
+static void sleep_ms(unsigned ms)
+{
+    struct timespec ts = { .tv_sec = ms / 1000,
+                           .tv_nsec = (long)(ms % 1000) * 1000000L };
+    nanosleep(&ts, NULL);
+}
+
+static uint64_t now_ns(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+static const UvmLocation HBM0 = { UVM_TIER_HBM, 0 };
+static const UvmLocation HOSTLOC = { UVM_TIER_HOST, 0 };
+
+/* Trip the thrash detector on [p, p+len): deviceward, hostward,
+ * deviceward — two direction alternations (hot_thrash_count=2 below). */
+static int thrash(UvmVaSpace *vs, void *p, uint64_t len)
+{
+    CHECK(uvmMigrate(vs, p, len, HBM0, 0) == TPU_OK);
+    CHECK(uvmMigrate(vs, p, len, HOSTLOC, 0) == TPU_OK);
+    CHECK(uvmMigrate(vs, p, len, HBM0, 0) == TPU_OK);
+    return 0;
+}
+
+/* ---- 1. tracker feed + decay -------------------------------------- */
+
+static int test_tracker_decay(UvmVaSpace *vs)
+{
+    tpuRegistrySet("TPUMEM_HOT_DECAY_MS", "50");
+    void *p;
+    CHECK(uvmMemAlloc(vs, BLOCK, &p) == TPU_OK);
+    memset(p, 0xA1, BLOCK);                       /* CPU-fault feed */
+    CHECK(uvmDeviceAccess(vs, 0, p, BLOCK, 0) == TPU_OK);
+    uint64_t hot = tpurmHotSpanScore((uint64_t)(uintptr_t)p, BLOCK);
+    CHECK(hot > 0);
+    CHECK(tpurmHotDeviceScore(0) > 0);
+    /* Four half-lives: the decayed score must drop to <= 1/8. */
+    sleep_ms(210);
+    uint64_t cold = tpurmHotSpanScore((uint64_t)(uintptr_t)p, BLOCK);
+    CHECK(cold <= hot / 8);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    tpuRegistrySet("TPUMEM_HOT_DECAY_MS", "250");
+    return 0;
+}
+
+/* ---- 2. thrash PIN + exemption from both eviction paths ----------- */
+
+static int test_pin_exemption(UvmVaSpace *vs)
+{
+    tpuRegistrySet("TPUMEM_HOT_THRASH_COUNT", "2");
+    tpuRegistrySet("TPUMEM_HOT_THRASH_WINDOW_MS", "10000");
+    tpuRegistrySet("TPUMEM_HOT_PIN_MS", "60000");
+
+    uint64_t pins0 = tpurmCounterGet("tpurm_hot_pins");
+    void *a;
+    CHECK(uvmMemAlloc(vs, BLOCK, &a) == TPU_OK);
+    memset(a, 0x5A, BLOCK);
+    CHECK(thrash(vs, a, BLOCK) == 0);
+    CHECK(tpurmCounterGet("tpurm_hot_pins") == pins0 + 1);
+    CHECK(tpurmCounterGet("tpurm_hot_thrash_pages") > 0);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, a, &info) == TPU_OK);
+    CHECK(info.pinnedTier == (int32_t)UVM_TIER_HBM);
+    CHECK(info.residentHbm);
+
+    /* Path 1 — allocation-pressure eviction (uvmLruPopVictim via the
+     * arena walk): flood the 16 MB arena; the pinned block must keep
+     * its residency while the flood evicts itself. */
+    void *flood;
+    CHECK(uvmMemAlloc(vs, 16 * MB, &flood) == TPU_OK);
+    for (uint64_t off = 0; off < 16 * MB; off += BLOCK)
+        CHECK(uvmMigrate(vs, (char *)flood + off, BLOCK, HBM0, 0) ==
+              TPU_OK);
+    CHECK(uvmResidencyInfo(vs, a, &info) == TPU_OK);
+    CHECK(info.residentHbm);          /* pinned: never evicted */
+    CHECK(info.pinnedTier == (int32_t)UVM_TIER_HBM);
+
+    /* Path 2 — the spine's byte-target evictor (OP_TIER_EVICT body):
+     * ask for the whole arena; everything unpinned goes, the pinned
+     * block stays. */
+    uvmTierEvictBytes(UVM_TIER_HBM, 0, 16 * MB);
+    CHECK(uvmResidencyInfo(vs, a, &info) == TPU_OK);
+    CHECK(info.residentHbm);
+    UvmResidencyInfo finfo;
+    CHECK(uvmResidencyInfo(vs, flood, &finfo) == TPU_OK);
+    CHECK(!finfo.residentHbm);        /* unpinned flood was evictable */
+
+    CHECK(uvmMemFree(vs, flood) == TPU_OK);
+    CHECK(uvmMemFree(vs, a) == TPU_OK);
+    return 0;
+}
+
+/* ---- 3. pin lapse -------------------------------------------------- */
+
+static int test_pin_lapse(UvmVaSpace *vs)
+{
+    tpuRegistrySet("TPUMEM_HOT_PIN_MS", "80");
+    void *c;
+    CHECK(uvmMemAlloc(vs, BLOCK, &c) == TPU_OK);
+    memset(c, 0xC3, BLOCK);
+    CHECK(thrash(vs, c, BLOCK) == 0);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, c, &info) == TPU_OK);
+    CHECK(info.pinnedTier == (int32_t)UVM_TIER_HBM);
+
+    sleep_ms(120);                    /* pin lapses: no wedge possible */
+    CHECK(uvmResidencyInfo(vs, c, &info) == TPU_OK);
+    CHECK(info.pinnedTier == -1);
+    /* And the block is evictable again. */
+    uvmTierEvictBytes(UVM_TIER_HBM, 0, 16 * MB);
+    CHECK(uvmResidencyInfo(vs, c, &info) == TPU_OK);
+    CHECK(!info.residentHbm);
+    /* Data integrity across pin + eviction. */
+    CHECK(((volatile unsigned char *)c)[123] == 0xC3);
+    CHECK(uvmMemFree(vs, c) == TPU_OK);
+    tpuRegistrySet("TPUMEM_HOT_PIN_MS", "300");
+    return 0;
+}
+
+/* ---- 4. THROTTLE: decided without headroom, bounded, expires ------ */
+
+static int test_throttle(UvmVaSpace *vs)
+{
+    tpuRegistrySet("TPUMEM_HOT_PIN", "0");      /* force THROTTLE arm */
+    tpuRegistrySet("TPUMEM_HOT_THROTTLE_US", "20000");
+    tpuRegistrySet("TPUMEM_HOT_THROTTLE_MS", "400");
+
+    uint64_t th0 = tpurmCounterGet("tpurm_hot_throttles");
+    void *d;
+    CHECK(uvmMemAlloc(vs, BLOCK, &d) == TPU_OK);
+    memset(d, 0xD4, BLOCK);
+    CHECK(thrash(vs, d, BLOCK) == 0);
+    CHECK(tpurmCounterGet("tpurm_hot_throttles") == th0 + 1);
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, d, &info) == TPU_OK);
+    CHECK(info.pinnedTier == -1);     /* throttle, not pin */
+
+    /* A fault service on the throttled block is delayed (counted) but
+     * BOUNDED: it completes, and well under a second. */
+    uint64_t delays0 = tpurmCounterGet("tpurm_hot_throttle_delays");
+    uint64_t t0 = now_ns();
+    ((volatile char *)d)[0] = 1;      /* CPU write fault (block on HBM) */
+    uint64_t dt = now_ns() - t0;
+    CHECK(tpurmCounterGet("tpurm_hot_throttle_delays") > delays0);
+    CHECK(dt < 2000000000ull);        /* bounded: no wedge */
+
+    /* The hint expires on its own: past hot_throttle_ms no further
+     * service is delayed.  Raise the detector threshold first — the
+     * CPU fault above plus the re-migration below are themselves
+     * direction alternations and would legitimately re-trip it. */
+    tpuRegistrySet("TPUMEM_HOT_THRASH_COUNT", "100");
+    sleep_ms(450);
+    CHECK(uvmMigrate(vs, d, BLOCK, HBM0, 0) == TPU_OK);
+    uint64_t delays1 = tpurmCounterGet("tpurm_hot_throttle_delays");
+    ((volatile char *)d)[4096] = 2;
+    CHECK(tpurmCounterGet("tpurm_hot_throttle_delays") == delays1);
+
+    CHECK(uvmMemFree(vs, d) == TPU_OK);
+    tpuRegistrySet("TPUMEM_HOT_PIN", "1");
+    tpuRegistrySet("TPUMEM_HOT_THRASH_COUNT", "2");
+    return 0;
+}
+
+/* ---- 5. precision-gated prefetch growth and shrink ----------------- */
+
+static int test_prefetch_governor(UvmVaSpace *vs)
+{
+    tpuRegistrySet("TPUMEM_HOT_PREFETCH_MIN_SAMPLES", "4");
+    tpuRegistrySet("TPUMEM_HOT_PREFETCH_START", "4");
+    uint64_t ps = 64 * 1024;          /* uvm_page_size default */
+
+    /* GROW: sequential single-page device accesses — speculation lands
+     * just ahead of the stream, the next access hits it, precision
+     * stays high, the cap doubles. */
+    uint64_t grown0 = tpurmCounterGet("tpurm_hot_prefetch_grown");
+    void *g;
+    CHECK(uvmMemAlloc(vs, BLOCK, &g) == TPU_OK);
+    memset(g, 0x11, BLOCK);
+    for (uint64_t off = 0; off < BLOCK; off += ps)
+        CHECK(uvmDeviceAccess(vs, 0, (char *)g + off, ps, 0) == TPU_OK);
+    CHECK(tpurmCounterGet("uvm_prefetch_hits") > 0);
+    CHECK(tpurmCounterGet("tpurm_hot_prefetch_grown") > grown0);
+    CHECK(uvmMemFree(vs, g) == TPU_OK);
+
+    /* SHRINK: strided accesses speculate pages nothing ever touches;
+     * evicting them untouched counts useless, precision collapses, the
+     * cap halves. */
+    uint64_t shrunk0 = tpurmCounterGet("tpurm_hot_prefetch_shrunk");
+    void *s;
+    CHECK(uvmMemAlloc(vs, 4 * BLOCK, &s) == TPU_OK);
+    memset(s, 0x22, 4 * BLOCK);
+    for (int round = 0; round < 4; round++) {
+        for (uint64_t off = 0; off < 4 * BLOCK; off += 8 * ps)
+            CHECK(uvmDeviceAccess(vs, 0, (char *)s + off, ps, 0) ==
+                  TPU_OK);
+        uvmTierEvictBytes(UVM_TIER_HBM, 0, 16 * MB);
+    }
+    CHECK(tpurmCounterGet("uvm_prefetch_useless") > 0);
+    CHECK(tpurmCounterGet("tpurm_hot_prefetch_shrunk") > shrunk0);
+    CHECK(uvmMemFree(vs, s) == TPU_OK);
+    return 0;
+}
+
+/* ---- 6. hotness-fed victim reordering ------------------------------ */
+
+static int test_victim_coldness(UvmVaSpace *vs)
+{
+    uvmTierEvictBytes(UVM_TIER_HBM, 0, 16 * MB);   /* clean slate */
+    void *hot, *cold;
+    CHECK(uvmMemAlloc(vs, BLOCK, &hot) == TPU_OK);
+    CHECK(uvmMemAlloc(vs, BLOCK, &cold) == TPU_OK);
+    memset(hot, 0x33, BLOCK);
+    memset(cold, 0x44, BLOCK);
+    CHECK(uvmMigrate(vs, hot, BLOCK, HBM0, 0) == TPU_OK);
+    CHECK(uvmMigrate(vs, cold, BLOCK, HBM0, 0) == TPU_OK);
+    /* Heat the OLDER block hard, then give the newer one a single
+     * light touch so it sits at the LRU's WARM end: positionally the
+     * hot block is now the next victim — only the coldness scan saves
+     * it. */
+    for (int i = 0; i < 16; i++)
+        CHECK(uvmDeviceAccess(vs, 0, hot, BLOCK, 0) == TPU_OK);
+    CHECK(uvmDeviceAccess(vs, 0, cold, 64 * 1024, 0) == TPU_OK);
+
+    uint64_t reorders0 = tpurmCounterGet("tier_hot_victim_reorders");
+    uvmTierEvictBytes(UVM_TIER_HBM, 0, one_block_target());
+    UvmResidencyInfo hi, ci;
+    CHECK(uvmResidencyInfo(vs, hot, &hi) == TPU_OK);
+    CHECK(uvmResidencyInfo(vs, cold, &ci) == TPU_OK);
+    CHECK(hi.residentHbm);            /* hot survived its position */
+    CHECK(!ci.residentHbm);           /* genuinely-cold block evicted */
+    CHECK(tpurmCounterGet("tier_hot_victim_reorders") > reorders0);
+
+    /* Scorer off (hot_victim_scan=0): byte-for-byte positional LRU —
+     * the same shape (hot block at the LRU head by position, cold at
+     * the tail) now evicts the HOT block first. */
+    tpuRegistrySet("TPUMEM_HOT_VICTIM_SCAN", "0");
+    CHECK(uvmMigrate(vs, cold, BLOCK, HBM0, 0) == TPU_OK);
+    for (int i = 0; i < 16; i++)
+        CHECK(uvmDeviceAccess(vs, 0, hot, BLOCK, 0) == TPU_OK);
+    CHECK(uvmDeviceAccess(vs, 0, cold, 64 * 1024, 0) == TPU_OK);
+    uvmTierEvictBytes(UVM_TIER_HBM, 0, one_block_target());
+    CHECK(uvmResidencyInfo(vs, hot, &hi) == TPU_OK);
+    CHECK(!hi.residentHbm);           /* positional order honored */
+    tpuRegistrySet("TPUMEM_HOT_VICTIM_SCAN", "8");
+
+    CHECK(uvmMemFree(vs, hot) == TPU_OK);
+    CHECK(uvmMemFree(vs, cold) == TPU_OK);
+    return 0;
+}
+
+/* ---- 7. hot.decide inject: degrade-to-no-op + EXACT invariant ------ */
+
+static int test_inject_decide(UvmVaSpace *vs)
+{
+    tpuRegistrySet("TPUMEM_HOT_THRASH_COUNT", "2");
+    uint64_t pins0 = tpurmCounterGet("tpurm_hot_pins");
+    uint64_t th0 = tpurmCounterGet("tpurm_hot_throttles");
+
+    CHECK(tpurmInjectConfigure(TPU_INJECT_SITE_HOT_DECIDE,
+                               TPU_INJECT_NTH, 1, 1, 0) == TPU_OK);
+    void *p;
+    CHECK(uvmMemAlloc(vs, BLOCK, &p) == TPU_OK);
+    memset(p, 0x77, BLOCK);
+    CHECK(thrash(vs, p, BLOCK) == 0); /* decision skipped: no hint */
+    UvmResidencyInfo info;
+    CHECK(uvmResidencyInfo(vs, p, &info) == TPU_OK);
+    CHECK(info.pinnedTier == -1);
+    CHECK(tpurmCounterGet("tpurm_hot_pins") == pins0);
+    CHECK(tpurmCounterGet("tpurm_hot_throttles") == th0);
+    /* Forward progress under a 100%-hit site: services still complete
+     * (degrade-to-no-op, nothing retries, nothing wedges). */
+    ((volatile char *)p)[0] = 1;
+    tpurmInjectDisable(TPU_INJECT_SITE_HOT_DECIDE);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    return 0;
+}
+
+int main(void)
+{
+    /* Small arena BEFORE the engine initializes: eviction pressure is
+     * the whole test.  Policies under test get fast windows. */
+    setenv("TPUMEM_FAKE_HBM_MB", "16", 1);
+    setenv("TPUMEM_HOT_THRASH_COUNT", "2", 1);
+    setenv("TPUMEM_HOT_THRASH_WINDOW_MS", "10000", 1);
+
+    UvmVaSpace *vs;
+    if (uvmVaSpaceCreate(&vs) != TPU_OK) {
+        fprintf(stderr, "vaspace create failed\n");
+        return 1;
+    }
+    if (uvmRegisterDevice(vs, 0) != TPU_OK) {
+        fprintf(stderr, "no fake device 0\n");
+        return 1;
+    }
+
+    struct { const char *name; int (*fn)(UvmVaSpace *); } tests[] = {
+        { "tracker_decay", test_tracker_decay },
+        { "pin_exemption", test_pin_exemption },
+        { "pin_lapse", test_pin_lapse },
+        { "throttle_bounded", test_throttle },
+        { "prefetch_governor", test_prefetch_governor },
+        { "victim_coldness", test_victim_coldness },
+        { "inject_decide", test_inject_decide },
+    };
+    for (size_t i = 0; i < sizeof(tests) / sizeof(tests[0]); i++) {
+        if (tests[i].fn(vs) != 0) {
+            fprintf(stderr, "hot_test: %s FAILED\n", tests[i].name);
+            return 1;
+        }
+        printf("  hot test %-24s ok\n", tests[i].name);
+    }
+
+    /* EXACT reconciliation: every hot.decide hit degraded exactly one
+     * decision to a no-op — across the WHOLE run. */
+    uint64_t evals = 0, hits = 0;
+    tpurmInjectCounts(TPU_INJECT_SITE_HOT_DECIDE, &evals, &hits);
+    TpuHotStats st;
+    tpurmHotStatsGet(&st);
+    if (hits != st.injectSkips ||
+        hits != tpurmCounterGet("hot_inject_skips")) {
+        fprintf(stderr,
+                "hot.decide reconciliation: hits=%llu skips=%llu "
+                "counter=%llu\n",
+                (unsigned long long)hits,
+                (unsigned long long)st.injectSkips,
+                (unsigned long long)tpurmCounterGet("hot_inject_skips"));
+        return 1;
+    }
+    if (hits == 0) {
+        fprintf(stderr, "hot.decide never hit (armed window inert)\n");
+        return 1;
+    }
+    printf("  hot test %-24s ok (hits=%llu == skips)\n",
+           "inject_reconciliation", (unsigned long long)hits);
+
+    /* Render smoke: the hotness node serves and carries the stats. */
+    char buf[16384];
+    size_t n = tpurmProcfsRead("driver/tpurm/hotness", buf,
+                               sizeof(buf) - 1);
+    buf[n] = 0;
+    if (n == 0 || !strstr(buf, "pins:") || !strstr(buf, "dev0_score:")) {
+        fprintf(stderr, "hotness node render broken:\n%s\n", buf);
+        return 1;
+    }
+
+    uvmVaSpaceDestroy(vs);
+    printf("hot_test: all ok\n");
+    return 0;
+}

@@ -553,7 +553,17 @@ class TieredKVCache:
         self.stats = {"uploads": 0, "flushes": 0, "clean_drops": 0,
                       "upload_bytes": 0, "activations": 0,
                       "prefetched_uploads": 0, "victim_restores": 0,
-                      "sync_flushes": 0, "drains": 0}
+                      "sync_flushes": 0, "drains": 0,
+                      "warm_reinserts": 0}
+        # tpuhot, scheduler-level face: decayed per-page activation
+        # heat (each activation bumps the covered pages after an
+        # exponential decay pass).  release_sequence consults it — a
+        # released-but-hot page's slot reinserts WARM instead of
+        # becoming the next eviction victim — and the scheduler's
+        # victim choice folds seq_heat() into its coldness key.
+        self._page_heat = np.zeros((self.total_pages,), np.float32)
+        self.heat_decay = 0.95
+        self.release_warm_heat = 1.5
 
     # ------------------------------------------------------------ views
     # (available only on backings that expose a host view — the managed
@@ -693,13 +703,23 @@ class TieredKVCache:
     def _evict_for(self, need: int) -> List[int]:
         """Free `need` slots, returning them.  CLEAN slots go first (a
         clean drop is free; evicting a dirty slot parks a delta), each
-        class in LRU order, always skipping pinned slots."""
+        class ordered COLDEST-FIRST by the tpuhot page-heat tracker
+        (stable on the LRU order, so uniform heat keeps the historical
+        LRU behavior byte-for-byte — the native arena walk applies the
+        same coldness tie-break), always skipping pinned slots."""
         clean: List[int] = []
         dirty: List[int] = []
         for s in self._lru:
             if s in self._active_slots:
                 continue
             (dirty if s in self._dirty_slots else clean).append(s)
+
+        def _heat(s: int) -> float:
+            page = int(self.slot_owner[s])
+            return float(self._page_heat[page]) if page >= 0 else 0.0
+
+        clean.sort(key=_heat)
+        dirty.sort(key=_heat)
         freed = (clean + dirty)[:need]
         if len(freed) < need:
             raise RuntimeError(
@@ -818,6 +838,10 @@ class TieredKVCache:
                        ) -> PagedKVCache:
         self.stats["activations"] += 1
         m, P = self.pages_per_seq, self.page_size
+        # Heat decays once per activation wave; the covered pages are
+        # bumped below, so steady re-activation converges to
+        # 1/(1-decay) while an idle page cools geometrically.
+        self._page_heat *= self.heat_decay
         # Ring pressure valve runs FIRST, before anything reads
         # _victim_map: a drain clears the map, so firing it between the
         # miss-list computation and the victim-restore below would leave
@@ -842,6 +866,7 @@ class TieredKVCache:
             base = b * m
             for pg in range(npages):
                 page = base + pg
+                self._page_heat[page] += 1.0
                 if page in needed_set:
                     continue
                 s = self.slot_of[page]
@@ -953,6 +978,17 @@ class TieredKVCache:
         n = min(m, max(1, (int(self.seq_lens[b]) + new_tokens + P - 1)
                        // P))
         return list(range(b * m, b * m + n))
+
+    def seq_heat(self, b: int, new_tokens: int = 0) -> float:
+        """Decayed activation heat summed over sequence ``b``'s covered
+        pages — the scheduler-level coldness signal (tpuhot): lower
+        means the sequence's pages were activated less recently/often,
+        so preempting it evicts genuinely-cold data."""
+        return float(sum(self._page_heat[p]
+                         for p in self.pages_of(b, new_tokens)))
+
+    def page_heat(self, page: int) -> float:
+        return float(self._page_heat[page])
 
     def set_last_tokens_dev(self, seq_ids: Sequence[int],
                             toks: jax.Array) -> None:
@@ -1099,7 +1135,8 @@ class TieredKVCache:
             # read stale bytes.  Retire (keep_len=False) skips this —
             # the KV is garbage once the request finished.
             self.drain_flushes()
-        freed: List[int] = []
+        freed_cold: List[int] = []
+        freed_warm: List[int] = []
         for pg in range(m):
             page = b * m + pg
             s = int(self.slot_of[page])
@@ -1110,16 +1147,35 @@ class TieredKVCache:
                 self._active_slots.discard(s)
                 if s in self._lru:
                     del self._lru[s]
-                freed.append(s)
+                # tpuhot: the cold-end reinsert consults the heat
+                # tracker — a released-but-HOT page of a still-live
+                # sequence (keep_len preempt: the restore will fault
+                # these pages right back) reinserts at the WARM end
+                # instead of becoming the next eviction victim on list
+                # position alone.  Retire (keep_len=False) always goes
+                # cold: the KV is garbage, fast reclaim is the point.
+                if keep_len and \
+                        self._page_heat[page] >= self.release_warm_heat:
+                    freed_warm.append(s)
+                else:
+                    freed_cold.append(s)
             e = self._victim_map.pop(page, None)
             if e is not None:
                 self._victim_free.append(e)
-        if freed:
+        if freed_cold:
             # Cold end = FRONT of the insertion-ordered dict.
-            self._lru = dict.fromkeys(freed) | self._lru
+            self._lru = dict.fromkeys(freed_cold) | self._lru
+        for s in freed_warm:
+            self._lru[s] = None            # warm end (tail)
+        if freed_warm:
+            self.stats["warm_reinserts"] += len(freed_warm)
         if not keep_len:
             self.seq_lens[b] = 0
             self.last_token[b] = 0
+            # Retired KV is garbage the moment the request finishes:
+            # its pages are definitionally cold (and must not keep the
+            # recycled seq slot's next tenant warm by inheritance).
+            self._page_heat[b * m:(b + 1) * m] = 0.0
         self.stats["releases"] = self.stats.get("releases", 0) + 1
 
     def close(self) -> None:

@@ -423,10 +423,30 @@ class Scheduler:
 
     # -------------------------------------------------------- preemption
 
+    def _seq_coldness(self, req: Request) -> float:
+        """tpuhot coldness for the victim choice: the cache-level
+        activation heat of the sequence's covered pages PLUS the native
+        tracker's decayed score over its backing span (uvm/hot.py
+        span_score — the same signal uvmLruPopVictim's walk consumes).
+        Lower = colder = preempting it evicts genuinely-cold pages."""
+        heat = self.cache.seq_heat(req.seq)
+        backing = self.cache.backing
+        if getattr(backing, "vs", None) is not None and \
+                hasattr(backing, "k_buf"):
+            from ..uvm import hot as _hot
+            off = req.seq * self.cache.pages_per_seq * backing.rec_bytes
+            span = self._seq_pages(req) * backing.rec_bytes
+            # >>10: the native score is <<10 fixed-point per page touch.
+            heat += _hot.span_score(backing.k_buf.address + off,
+                                    span) / 1024.0
+        return heat
+
     def _pick_victim(self) -> Optional[Request]:
         """SLO ordering, mirroring the native arena walk: over-quota
-        tenants first, then lowest priority, then largest footprint
-        (frees the most pages per preempt)."""
+        tenants first, then lowest priority, then COLDEST by the tpuhot
+        hotness signal (eviction takes genuinely-cold pages, not merely
+        the largest footprint), then largest footprint as the final
+        tie-break (frees the most pages per preempt)."""
         best = None
         best_key = None
         for req in self._running.values():
@@ -434,7 +454,9 @@ class Scheduler:
             over = bool(t.device_page_quota and
                         self._tenant_pages(req.tenant) >
                         t.device_page_quota)
-            key = (0 if over else 1, t.priority, -self._seq_pages(req))
+            key = (0 if over else 1, t.priority,
+                   round(self._seq_coldness(req), 3),
+                   -self._seq_pages(req))
             if best is None or key < best_key:
                 best, best_key = req, key
         return best

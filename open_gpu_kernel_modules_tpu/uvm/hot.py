@@ -1,0 +1,144 @@
+"""tpuhot — hotness-driven placement: stats and coldness probes.
+
+Python face of native/src/hot.c (public header tpurm/hot.h): the
+per-VA-block access tracker that drives the precision-governed
+prefetcher, the thrashing PIN/THROTTLE detector, and the hotness-fed
+victim scorer.  This module reads the subsystem's policy stats, the
+per-device hotness gauges, and the span-coldness probe the serving
+scheduler's preempt-victim choice consumes
+(:meth:`..runtime.sched.Scheduler._pick_victim`).
+
+Knobs (registry, ``TPUMEM_<KEY>`` env or ``tpuRegistrySet``):
+
+======================================  =======  ======================
+``hot_enable``                          1        master policy gate
+``hot_decay_ms``                        250      score half-life
+``hot_thrash_count``                    3        alternations to trip
+``hot_thrash_window_ms``                100      detector window
+``hot_pin``                             1        allow PIN decisions
+``hot_pin_ms``                          300      pin duration
+``hot_pin_headroom_pct``                5        min free HBM for PIN
+``hot_throttle_us``                     200      per-service delay
+``hot_throttle_ms``                     100      throttle hint duration
+``hot_prefetch_min_precision``          80       governor floor (%)
+``hot_prefetch_min_samples``            8        precision window gate
+``hot_prefetch_density_pct``            25       tree-growth density
+``hot_prefetch_start``                  8        initial speculation cap
+``hot_victim_scan``                     8        coldness scan depth
+======================================  =======  ======================
+
+Chaos: the ``hot.decide`` injection site (``TPUMEM_INJECT_HOT_DECIDE``,
+``inject.Site.HOT_DECIDE``) is evaluated once per policy decision; a
+hit degrades exactly that decision to a no-op, reconciled EXACTLY as
+site hits == ``hot_inject_skips``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+from typing import Dict
+
+from ..runtime import native
+
+_bound = None
+
+
+class _Stats(ctypes.Structure):
+    _fields_ = [
+        ("pins", ctypes.c_uint64),
+        ("throttles", ctypes.c_uint64),
+        ("throttleDelays", ctypes.c_uint64),
+        ("thrashPages", ctypes.c_uint64),
+        ("prefetchGrown", ctypes.c_uint64),
+        ("prefetchShrunk", ctypes.c_uint64),
+        ("victimReorders", ctypes.c_uint64),
+        ("injectSkips", ctypes.c_uint64),
+        ("decisions", ctypes.c_uint64),
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class HotStats:
+    """Snapshot of tpurm/hot.h TpuHotStats."""
+
+    pins: int
+    throttles: int
+    throttle_delays: int
+    thrash_pages: int
+    prefetch_grown: int
+    prefetch_shrunk: int
+    victim_reorders: int
+    inject_skips: int
+    decisions: int
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is not None:
+        return _bound
+    lib = native.load()
+    lib.tpurmHotStatsGet.argtypes = [ctypes.POINTER(_Stats)]
+    lib.tpurmHotStatsGet.restype = None
+    lib.tpurmHotStatsReset.argtypes = []
+    lib.tpurmHotStatsReset.restype = None
+    lib.tpurmHotDeviceScore.argtypes = [ctypes.c_uint32]
+    lib.tpurmHotDeviceScore.restype = ctypes.c_uint64
+    lib.tpurmHotSpanScore.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.tpurmHotSpanScore.restype = ctypes.c_uint64
+    _bound = lib
+    return lib
+
+
+def stats() -> HotStats:
+    """Lifetime policy stats (pins, throttles, governor adjustments,
+    victim reorders, inject skips)."""
+    raw = _Stats()
+    _lib().tpurmHotStatsGet(ctypes.byref(raw))
+    return HotStats(
+        pins=raw.pins, throttles=raw.throttles,
+        throttle_delays=raw.throttleDelays,
+        thrash_pages=raw.thrashPages,
+        prefetch_grown=raw.prefetchGrown,
+        prefetch_shrunk=raw.prefetchShrunk,
+        victim_reorders=raw.victimReorders,
+        inject_skips=raw.injectSkips, decisions=raw.decisions)
+
+
+def stats_reset() -> None:
+    """Zero the process-global policy stats and device gauges (tests;
+    per-block tracker state decays on its own)."""
+    _lib().tpurmHotStatsReset()
+
+
+def device_score(dev: int = 0) -> int:
+    """Decayed per-device hotness gauge (tpurm_hot_device_score)."""
+    return int(_lib().tpurmHotDeviceScore(dev))
+
+
+def span_score(addr: int, length: int) -> int:
+    """Mean decayed hotness of the managed blocks covering
+    ``[addr, addr+length)`` — 0 for non-managed spans.  The coldness
+    signal tpusched victim choice consumes: lower = colder."""
+    return int(_lib().tpurmHotSpanScore(addr, length))
+
+
+def prefetch_precision() -> float:
+    """Measured prefetch precision hits/(hits+useless) from the PR-7
+    effectiveness counters — the signal the governor steers by.
+    1.0 when nothing speculative was ever measured."""
+    lib = _lib()
+    hits = lib.tpurmCounterGet(b"uvm_prefetch_hits")
+    useless = lib.tpurmCounterGet(b"uvm_prefetch_useless")
+    total = hits + useless
+    return (hits / total) if total else 1.0
+
+
+def counters() -> Dict[str, int]:
+    """The tpuhot counter family as scraped names."""
+    lib = _lib()
+    names = ("tpurm_hot_pins", "tpurm_hot_throttles",
+             "tpurm_hot_throttle_delays", "tpurm_hot_thrash_pages",
+             "tpurm_hot_prefetch_grown", "tpurm_hot_prefetch_shrunk",
+             "tier_hot_victim_reorders", "hot_inject_skips")
+    return {n: lib.tpurmCounterGet(n.encode()) for n in names}

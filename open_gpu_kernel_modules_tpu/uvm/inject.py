@@ -39,6 +39,7 @@ class Site(enum.IntEnum):
     SCHED_ADMIT = 9      # tpusched admission decision (per pass)
     RESET_DEVICE = 10    # forced full-device reset (per watchdog tick)
     VAC_MIGRATE = 11     # tpuvac record shipping (per copy attempt)
+    HOT_DECIDE = 12      # tpuhot policy decision (degrade-to-no-op)
 
 
 class Mode(enum.IntEnum):
@@ -97,6 +98,9 @@ DETAIL_COUNTERS = (
     "tpuce_deadline_expired",
     "broker_client_deaths",
     "broker_reclaimed_pins",
+    "hot_inject_skips",
+    "tpurm_hot_pins",
+    "tpurm_hot_throttles",
 )
 
 

@@ -1,0 +1,310 @@
+"""tpuhot: hotness-driven placement.
+
+Four layers under test:
+  - thrash detector (native/src/hot.c): a CPU<->device ping-pong over a
+    shared working set migrates HALF as much once the PIN hint lands
+    (jax-free subprocess with a small fake HBM arena), with pinned-page
+    data integrity through eviction pressure;
+  - scheduler victim choice (runtime/sched.py): preemption among
+    same-tenant/same-priority streams takes the genuinely-COLD one by
+    the tpuhot coldness signal, not the largest footprint;
+  - the TieredKVCache heat tracker: release_sequence's cold-end LRU
+    reinsert consults it (a released-but-hot preempted sequence's slots
+    reinsert warm; retired slots always go cold — the PR's small-fix
+    regression test), and _evict_for orders within a class coldest
+    first;
+  - the Python stats surface (uvm/hot.py) and Prometheus exposition.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- native ping-pong A/B
+
+_PINGPONG = r"""
+import json
+import sys
+import time
+
+sys.path.insert(0, %(repo)r)
+
+from open_gpu_kernel_modules_tpu import uvm, utils
+from open_gpu_kernel_modules_tpu.uvm import hot
+
+MB = 1 << 20
+SET = 12 * MB       # per-stream working set; 24 MB combined > 16 MB HBM
+ITERS = 10
+
+with uvm.VaSpace() as vs:
+    a = vs.alloc(SET)
+    b = vs.alloc(SET)
+    a.view()[:] = 0x5A
+    b.view()[:] = 0xB5
+    base = {"dth": utils.counter("uvm_bytes_xfer_dth"),
+            "htd": utils.counter("uvm_bytes_xfer_htd"),
+            "evict": utils.counter("uvm_block_evictions")}
+    # Two device streams ping-ponging a shared oversubscribed working
+    # set: each full scan of one stream evicts the other's blocks, so
+    # every block alternates HBM<->host each round (LRU's worst case).
+    # With the detector on, the resident side's blocks PIN (in-place
+    # pins cost nothing), the loser degrades to host placement via the
+    # engine's tier fallback — and the churn collapses: the resident
+    # side keeps its working set.
+    t0 = time.monotonic()
+    for i in range(ITERS):
+        a.device_access(dev=0, write=True)
+        b.device_access(dev=0, write=True)
+    wall = time.monotonic() - t0
+    stats = hot.stats()
+    out = {
+        "dth": utils.counter("uvm_bytes_xfer_dth") - base["dth"],
+        "htd": utils.counter("uvm_bytes_xfer_htd") - base["htd"],
+        "evictions": utils.counter("uvm_block_evictions") - base["evict"],
+        "pins": stats.pins,
+        "throttles": stats.throttles,
+        "thrash_pages": stats.thrash_pages,
+        "fallbacks": utils.counter("recover_tier_fallbacks"),
+        "wall_s": wall,
+        "ops_per_s": 2 * ITERS / wall if wall else 0.0,
+        "intact": bool((a.view() == 0x5A).all() and
+                       (b.view() == 0xB5).all()),
+    }
+    a.free()
+    b.free()
+print(json.dumps(out))
+"""
+
+
+def _run_pingpong(extra_env):
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_HBM_MB"] = "16"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, "-c", _PINGPONG % {"repo": _REPO}],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_thrash_detector_flattens_pingpong():
+    """Detector-on vs detector-off over the same ping-pong workload:
+    migrated bytes drop >= 2x (the PIN kills the HtD re-upload half of
+    every iteration and exempts the set from eviction), and the data
+    stays bit-exact under the pin."""
+    off = _run_pingpong({"TPUMEM_HOT_ENABLE": "0",
+                         "TPUMEM_HOT_PIN": "0"})
+    on = _run_pingpong({"TPUMEM_HOT_ENABLE": "1", "TPUMEM_HOT_PIN": "1",
+                        "TPUMEM_HOT_THRASH_COUNT": "2",
+                        "TPUMEM_HOT_PIN_MS": "60000"})
+    assert off["pins"] == 0 and off["throttles"] == 0, off
+    assert on["pins"] >= 1, on
+    assert on["intact"] and off["intact"]
+    moved_off = off["dth"] + off["htd"]
+    moved_on = on["dth"] + on["htd"]
+    assert moved_on >= 0
+    assert moved_off >= 2 * max(moved_on, 1), (moved_off, moved_on, off,
+                                               on)
+
+
+def test_pinned_page_integrity_under_pressure():
+    """A pinned block's bytes survive an eviction storm that takes
+    everything else (the PIN exemption is load-bearing, not advisory)."""
+    script = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import uvm, utils
+MB = 1 << 20
+with uvm.VaSpace() as vs:
+    hotb = vs.alloc(2 * MB)
+    hotb.view()[:] = 0xC7
+    # Trip the detector: deviceward, hostward, deviceward.
+    hotb.device_access(dev=0, write=True)
+    assert (hotb.view() == 0xC7).all()
+    hotb.device_access(dev=0, write=True)
+    pinned = hotb.residency().pinned_tier is not None
+    # Eviction storm: flood the 16 MB arena.
+    flood = vs.alloc(16 * MB)
+    flood.view()[:] = 1
+    flood.device_access(dev=0, write=False)
+    ok = bool((hotb.view() == 0xC7).all())
+    flood.free()
+    hotb.free()
+print(json.dumps({"pinned": pinned, "intact": ok,
+                  "pins": utils.counter("tpurm_hot_pins")}))
+"""
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_HBM_MB"] = "16"
+    env["TPUMEM_HOT_THRASH_COUNT"] = "2"
+    env["TPUMEM_HOT_PIN_MS"] = "60000"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", script % {"repo": _REPO}],
+                          env=env, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["pins"] >= 1, out
+    assert out["intact"], out
+
+
+# -------------------------------------------- scheduler victim coldness
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+    import jax.numpy as jnp
+    from open_gpu_kernel_modules_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
+        max_seq_len=256, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def test_sched_victim_hot_vs_cold(setup):
+    """Same tenant, same priority: the preempt victim is the COLDEST
+    stream by the tpuhot signal — not the largest footprint — and with
+    uniform heat the footprint tie-break still holds."""
+    from open_gpu_kernel_modules_tpu.runtime import sched
+
+    cfg, params = setup
+    s = sched.Scheduler(cfg, params, max_seqs=4, max_len=128,
+                        page_size=16, oversub=1, tokens_per_round=4)
+    try:
+        rng = np.random.default_rng(7)
+        ra = s.submit(rng.integers(1, 200, 48), max_new_tokens=64)
+        rb = s.submit(rng.integers(1, 200, 24), max_new_tokens=64)
+        s.step()
+        assert ra.seq is not None and rb.seq is not None
+        m = s.cache.pages_per_seq
+        # Asymmetric heat: ra's pages hot, rb's stone cold.
+        s.cache._page_heat[:] = 0.0
+        s.cache._page_heat[ra.seq * m:(ra.seq + 1) * m] = 50.0
+        victim = s._pick_victim()
+        assert victim is rb, (victim.rid, rb.rid)
+        # Uniform heat: the larger footprint (ra: longer prompt) wins.
+        s.cache._page_heat[:] = 0.0
+        victim = s._pick_victim()
+        assert victim is ra, (victim.rid, ra.rid)
+    finally:
+        s.close()
+
+
+# ------------------------------------- cache heat tracker + release fix
+
+
+def test_release_sequence_consults_heat(setup):
+    """The small-fix regression: a preempted (keep_len) sequence whose
+    pages are HOT reinserts its slots at the WARM end of the slot LRU;
+    a retired sequence's slots always go cold-front (fast reclaim), and
+    retire zeroes the pages' heat."""
+    import jax.numpy as jnp
+    from open_gpu_kernel_modules_tpu.models import serving
+
+    cfg, _ = setup
+    cache = serving.TieredKVCache(cfg, batch=4, max_len=64, page_size=16,
+                                  oversub=1)
+    try:
+        m = cache.pages_per_seq
+        for b in (0, 1):
+            cache.seq_lens[b] = 60
+            v = cache.activate([b], new_tokens=1)
+            cache.sync_from(v, [b])
+        slots0 = [int(cache.slot_of[0 * m + pg]) for pg in range(m)]
+        slots1 = [int(cache.slot_of[1 * m + pg]) for pg in range(m)]
+
+        # Seq 0 HOT (preempted mid-flight), seq 1 cold.
+        cache._page_heat[:] = 0.0
+        cache._page_heat[0:m] = 10.0
+        cache.release_sequence(0, keep_len=True)
+        cache.release_sequence(1, keep_len=True)
+        lru = list(cache._lru)
+        # Hot seq 0's slots sit WARMER (later) than cold seq 1's.
+        max_hot = max(lru.index(s) for s in slots0)
+        min_cold = min(lru.index(s) for s in slots1)
+        assert min_cold < lru.index(slots0[0]), (lru, slots0, slots1)
+        assert all(lru.index(s0) > lru.index(s1)
+                   for s0 in slots0 for s1 in slots1), (lru, slots0,
+                                                       slots1)
+        assert cache.stats["warm_reinserts"] >= m
+        assert max_hot == len(lru) - 1
+
+        # Retire path: hot or not, slots go cold-front and heat zeroes.
+        cache.seq_lens[2] = 60
+        v = cache.activate([2], new_tokens=1)
+        cache.sync_from(v, [2])
+        slots2 = [int(cache.slot_of[2 * m + pg]) for pg in range(m)]
+        cache._page_heat[2 * m:3 * m] = 10.0
+        cache.release_sequence(2)                  # retire
+        lru = list(cache._lru)
+        assert max(lru.index(s) for s in slots2) < len(lru) - 1
+        assert lru.index(slots2[0]) < min(lru.index(s) for s in slots0)
+        assert float(cache._page_heat[2 * m:3 * m].sum()) == 0.0
+    finally:
+        cache.close()
+
+
+def test_evict_for_prefers_cold_pages(setup):
+    """_evict_for takes the coldest clean slot first (heat-keyed,
+    stable on LRU order), so a hot resident page survives pressure a
+    cold one does not."""
+    from open_gpu_kernel_modules_tpu.models import serving
+
+    cfg, _ = setup
+    cache = serving.TieredKVCache(cfg, batch=2, max_len=64, page_size=16,
+                                  oversub=2)      # 8 pages, 4 slots
+    try:
+        cache.seq_lens[0] = 60                    # needs all 4 slots
+        v = cache.activate([0], new_tokens=1)
+        cache.sync_from(v, [0])
+        # Page 0 scorching, pages 1..3 cold; nothing pinned now.
+        cache._page_heat[:] = 0.0
+        cache._page_heat[0] = 99.0
+        # One-slot demand: the evictor must pick a COLD page's slot,
+        # not page 0's (which sits at the LRU head position-wise).
+        cache.seq_lens[1] = 10
+        v = cache.activate([1], new_tokens=1)
+        cache.sync_from(v, [1])
+        assert int(cache.slot_of[0]) >= 0, "hot page 0 was evicted"
+    finally:
+        cache.close()
+
+
+# ----------------------------------------------------- stats surface
+
+
+def test_hot_py_surface():
+    """uvm/hot.py: stats dataclass, device/span scores, counters, the
+    Prometheus gauges and the hotness procfs node."""
+    from open_gpu_kernel_modules_tpu import uvm, utils
+    from open_gpu_kernel_modules_tpu.uvm import hot
+
+    MB = 1 << 20
+    with uvm.VaSpace() as vs:
+        buf = vs.alloc(2 * MB)
+        buf.view()[:] = 3
+        buf.device_access(dev=0, write=False)
+        assert hot.span_score(buf.address, 2 * MB) > 0
+        assert hot.device_score(0) > 0
+        st = hot.stats()
+        assert st.decisions >= 0 and st.inject_skips == 0
+        c = hot.counters()
+        assert set(c) >= {"tpurm_hot_pins", "hot_inject_skips"}
+        assert 0.0 <= hot.prefetch_precision() <= 1.0
+        buf.free()
+
+    text = utils.metrics_text()
+    assert "# TYPE tpurm_hot_device_score gauge" in text
+    assert 'tpurm_hot_device_score{dev="0"}' in text
+    assert "driver/tpurm/hotness" in utils.procfs_list()

@@ -195,7 +195,7 @@ SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MSGQ_PUBLISH, inj.Site.ICI_LINK,
          inj.Site.RDMA_COMPLETION, inj.Site.FENCE_TIMEOUT,
          inj.Site.MEMRING_SUBMIT, inj.Site.CE_COPY,
-         inj.Site.VAC_MIGRATE]
+         inj.Site.VAC_MIGRATE, inj.Site.HOT_DECIDE]
 for s in SITES:
     inj.enable(s, inj.Mode.PPM, 10000)
 # The reset.device site fires on the watchdog tick (100 ms period, so
@@ -408,6 +408,18 @@ out["vac_migrate"] = {
     "retries": utils.counter("vac_inject_retries"),
     "aborts": utils.counter("vac_inject_aborts"),
 }
+# hot.decide reconciliation (13th site, armed for the whole window):
+# every hit degraded exactly one tpuhot policy decision to a no-op —
+# and any PIN a non-hit decision took lapses on its own (hot_pin_ms),
+# so the actor mix above could never wedge on an unevictable block.
+hd_evals, hd_hits = inj.counts(inj.Site.HOT_DECIDE)
+out["hot_decide"] = {
+    "evals": hd_evals,
+    "hits": hd_hits,
+    "skips": utils.counter("hot_inject_skips"),
+    "pins": utils.counter("tpurm_hot_pins"),
+    "throttles": utils.counter("tpurm_hot_throttles"),
+}
 out["errors"] = errors
 out["tolerated"] = tolerated["n"]
 
@@ -599,7 +611,7 @@ out = {}
 ref_toks, ref_states, ref_rep = run_once()
 out["ref_states"] = ref_states
 
-# Chaos across ALL TWELVE sites (fixed seed), scheduler and the
+# Chaos across ALL THIRTEEN sites (fixed seed), scheduler and the
 # full-device reset path included, plus >= 3 FORCED resets mid-decode.
 # The big engine soak runs at 1%%; this workload is orders of magnitude
 # smaller (a few thousand evaluations), so 5%% keeps several sites
@@ -636,6 +648,10 @@ out["sched_admit_evals"] = inj.counts(inj.Site.SCHED_ADMIT)[0]
 _vm_evals, _vm_hits = inj.counts(inj.Site.VAC_MIGRATE)
 out["vac_migrate"] = {"evals": _vm_evals, "hits": _vm_hits}
 from open_gpu_kernel_modules_tpu import utils as _utils
+# 13th site (hot.decide), EXACT: hits == decisions degraded to no-op.
+_hd_evals, _hd_hits = inj.counts(inj.Site.HOT_DECIDE)
+out["hot_decide"] = {"evals": _hd_evals, "hits": _hd_hits,
+                     "skips": _utils.counter("hot_inject_skips")}
 out["spine"] = {
     "internal_sqes": _utils.counter("memring_internal_sqes"),
     "fault": _utils.counter("memring_internal_sqes[fault]"),
@@ -649,7 +665,7 @@ print(json.dumps(out))
 
 def test_sched_soak_injection():
     """Chaos soak, scheduler actor: streams admitted AND cancelled
-    under injection across ALL 12 sites (~5% here — this workload is
+    under injection across ALL 13 sites (~5% here — this workload is
     orders of magnitude smaller than the engine soak's, so 1% would
     barely fire) WITH >= 3 forced full-device resets mid-decode.
     Acceptance: zero token corruption (every stream that finishes
@@ -708,6 +724,14 @@ def test_sched_soak_injection():
     # holds at zero (armed-but-unevaluated costs and leaks nothing).
     vm = out["vac_migrate"]
     assert vm["evals"] == 0 and vm["hits"] == 0, vm
+
+    # 13th site (hot.decide): EXACT — every hit degraded exactly one
+    # tpuhot policy decision to a no-op, and the chaos run still
+    # produced bit-identical tokens (placement hints are never allowed
+    # to change data).  PINs taken by non-hit decisions lapse on their
+    # own, so the soak cannot wedge on an unevictable block.
+    hd = out["hot_decide"]
+    assert hd["hits"] == hd["skips"], hd
 
     # tpuflow blame-decomposition soundness UNDER CHAOS (all 12 sites
     # armed, >= 3 forced resets): every terminal stream closed its
@@ -853,8 +877,8 @@ def test_client_death_reclamation():
 
 
 def test_engine_soak_injection():
-    """Chaos soak (acceptance): ~1% injection across 7 sites at a fixed
-    seed, now with tracing ARMED for the whole chaos window; the soak
+    """Chaos soak (acceptance): ~1% injection across ALL 13 sites at a
+    fixed seed, with tracing ARMED for the whole chaos window; the soak
     completes with zero corruption, every recovery counter is nonzero,
     every injected fault surfaces as an instant trace event, each
     recovery-counter increment has a matching recovery trace event, and
@@ -927,6 +951,16 @@ def test_engine_soak_injection():
     vm = out["vac_migrate"]
     assert vm["evals"] == 0 and vm["hits"] == 0, vm
     assert vm["retries"] == 0 and vm["aborts"] == 0, vm
+
+    # hot.decide (13th site) reconciliation, EXACT: every hit degraded
+    # exactly one tpuhot policy decision to a no-op.  The fault/migrate
+    # churn above evaluates the thrash detector and prefetch governor
+    # constantly, so the site genuinely fired — and the soak completing
+    # at all is the no-wedge proof (PINs taken by non-hit decisions
+    # lapse on their own).
+    hd = out["hot_decide"]
+    assert hd["evals"] > 0, hd
+    assert hd["hits"] == hd["skips"], hd
 
     # tpuce rode the chaos: stripes flowed (splits grew), the ce.copy
     # site fired, and the reconciliation is EXACT — every hit became a

@@ -35,6 +35,7 @@ METRICS_INVENTORY = [
     "cxl_buffers_registered", "cxl_buffers_unregistered",
     "cxl_dma_bytes", "cxl_dma_requests", "dmabuf_exports",
     "hbm_mirror_bytes", "hbm_mirror_overflows", "hbm_readback_requests",
+    "hot_inject_skips",
     "ib_mr_invalidations", "ib_mr_registrations", "ici_degraded_routes",
     "ici_hop_bytes", "ici_link_flaps", "ici_links_trained",
     "ici_multihop_copies", "ici_peer_apertures", "ici_peer_copy_bytes",
@@ -55,6 +56,7 @@ METRICS_INVENTORY = [
     "pmm_chunk_frees", "rc_auto_resets", "rc_device_escalations",
     "rc_nonreplayable_faults", "rc_shadow_overflows",
     "rc_watchdog_timeouts", "rdma_mrs_revalidated",
+    "tier_hot_victim_reorders",
     "rdma_reset_revocations", "recover_copy_retries",
     "recover_fault_retries", "recover_link_retrains",
     "recover_msgq_retries", "recover_page_quarantines",
@@ -74,7 +76,11 @@ METRICS_INVENTORY = [
     "tpurm_flow_drops_total", "tpurm_flow_unmatched_total",
     "tpurm_flows_closed", "tpurm_flows_closed_total",
     "tpurm_flows_open", "tpurm_flows_opened",
-    "tpurm_health_transitions", "tpurm_reset_failed",
+    "tpurm_health_transitions",
+    "tpurm_hot_device_score", "tpurm_hot_pins",
+    "tpurm_hot_prefetch_grown", "tpurm_hot_prefetch_shrunk",
+    "tpurm_hot_thrash_pages", "tpurm_hot_throttle_delays",
+    "tpurm_hot_throttles", "tpurm_reset_failed",
     "tpurm_reset_injected", "tpurm_reset_mttr_ns", "tpurm_reset_total",
     "tpurm_slo_blame_ns", "tpurm_tenant_pages",
     "tpurm_tenant_quota_pages", "tpurm_tenant_rebinds",
@@ -101,7 +107,7 @@ METRICS_INVENTORY = [
     "uvm_mmu_tlb_invalidates", "uvm_mmu_tlb_pages",
     "uvm_prefetch_hits", "uvm_prefetch_pages", "uvm_prefetch_useless",
     "uvm_range_splits", "uvm_resumes", "uvm_suspends",
-    "uvm_thrash_pins", "uvm_tools_events_dropped",
+    "uvm_tools_events_dropped",
     "uvm_va_spaces_created", "uvm_write_faults_inferred", "vac_aborts",
     "vac_acks", "vac_bytes_moved", "vac_commit_ns",
     "vac_commit_rejected", "vac_commits", "vac_failed_acks",
