@@ -126,6 +126,12 @@ typedef struct {
     void *dst;
     const void *src;
     uint64_t len;                 /* contiguous span / gather total    */
+    /* tpushield seal stage: per-crcStride CRC32C of the destination,
+     * computed on the executor thread (channel.c CopySeg contract);
+     * survives stripe retry / lossless fallback so a re-sent stripe
+     * reseals what it actually stored. */
+    uint32_t *crcOut;
+    uint64_t crcStride;
     uint32_t nsegs;               /* 0: contiguous; else gather count  */
     TpuCeSeg segs[TPUCE_GATHER_SEGS];
 } TpuCeStripe;
@@ -163,6 +169,17 @@ void tpuCeBatchSetDeadline(TpuCeBatch *b, uint64_t deadlineNs);
  * (unaligned / tiny) silently degrade to lossless. */
 TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
                          uint64_t len, uint32_t comp);
+
+/* Copy with the tpushield seal stage: the executor threads compute one
+ * CRC32C per crcStride bytes of the destination into consecutive
+ * crcOut cells (cell k covers dst[k*crcStride, (k+1)*crcStride)) while
+ * the stripes retire — sealing overlaps the copy.  len must be a
+ * multiple of crcStride; crcOut must stay valid until the batch
+ * fences.  Compression composes: the CRC covers the DEQUANTIZED bytes
+ * the destination actually holds. */
+TpuStatus tpuCeBatchCopyCrc(TpuCeBatch *b, void *dst, const void *src,
+                            uint64_t len, uint32_t comp,
+                            uint32_t *crcOut, uint64_t crcStride);
 
 /* Gather submission: n (<= TPUCE_GATHER_SEGS) discontiguous segments
  * as ONE stripe on the least-loaded channel — one push, one recovery

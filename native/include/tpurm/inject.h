@@ -76,6 +76,20 @@ typedef enum {
                                       * keeps the undecided default —
                                       * exact invariant: hits ==
                                       * hot_inject_skips)             */
+    TPU_INJECT_SITE_MEM_CORRUPT,     /* tpushield silent-corruption
+                                      * injection — the first site that
+                                      * CORRUPTS instead of failing: a
+                                      * hit flips one bit in a freshly
+                                      * sealed page (one evaluation per
+                                      * page seal, scope = page VA) or
+                                      * a shipped ICI/vac wire buffer
+                                      * (one per hop/record); recovery
+                                      * is the shield verify + re-fetch
+                                      * ladder — exact invariant:
+                                      * hits == shield_detected +
+                                      * shield_inject_misses, and
+                                      * misses stay 0 while the hooks
+                                      * cover every consumption path  */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

@@ -1,0 +1,143 @@
+/*
+ * tpushield — end-to-end page integrity: CRC sealing, wire checksums,
+ * background scrub, and poison containment with page retirement.
+ *
+ * Every robustness layer before this one reacts to REPORTED errors;
+ * nothing in the engine detected silent data corruption — every tier
+ * demotion, ICI hop and vac shipping window trusted the bytes.  The
+ * reference driver treats integrity as a first-class subsystem (ECC
+ * interrupt handling and dynamic page retirement / row remapping in
+ * the PMM blacklist path, SURVEY §2.2/§2.6); at serving scale a
+ * flipped bit in a cold CXL-parked KV page is a silently-wrong token
+ * stream no retry ladder can catch after the fact.
+ *
+ * Model — per-page integrity metadata (CRC32C seal + seal generation +
+ * poison state) stored beside the residency masks in UvmVaBlock:
+ *
+ *   SEAL    — pages going COLD or crossing a WIRE are sealed: the tier
+ *             demote / eviction copy-back path (CRC computed on the
+ *             tpuce executor threads as a stripe transform stage, so
+ *             sealing overlaps the copy), the fbsr save (rides the
+ *             same eviction), ICI PEER_COPY and the multi-hop
+ *             store-and-forward pipeline (per-hop CRC so a corrupting
+ *             middle hop is attributed to the LINK and feeds
+ *             tpurmHealthNote), and tpuvac shipping windows (per-record
+ *             CRC verified before tpurmVacCommit).
+ *   VERIFY  — sealed pages are verified on the way back hot (promote /
+ *             make-resident / restore / first CPU touch) and by the
+ *             background scrubber before a demand fault ever sees them.
+ *   LADDER  — a verify mismatch runs a bounded re-fetch ladder:
+ *             (1) recompute against the sealing source (transient /
+ *             in-flight corruption), (2) re-fetch from any
+ *             read-duplicated sibling copy (counted refetch_saves),
+ *             (3) declare the page POISONED.
+ *   POISON  — containment, never a device reset: the OWNING sequence
+ *             gets TPU_ERR_PAGE_POISONED (the scheduler retires that
+ *             stream with an error status; co-tenants are untouched)
+ *             and the backing page is RETIRED into the quarantine list
+ *             — its PMM chunk is never freed, so the physical span can
+ *             never be re-allocated (tpurm_pages_retired{dev=}).
+ *   SCRUB   — a background thread (cadence "shield_scrub_ms", bounded
+ *             "shield_scrub_pages" per tick so the fault p50 budget
+ *             holds) walks sealed cold pages and catches corruption
+ *             before a demand fault does (tpurm_scrub_pages/_hits).
+ *
+ * Injection: the mem.corrupt site (TPUMEM_INJECT_MEM_CORRUPT) is the
+ * first site that CORRUPTS rather than fails — a hit flips one bit in
+ * a freshly sealed page / shipped wire buffer.  Exact reconciliation:
+ * site hits == shield_detected + shield_inject_misses, and misses stay
+ * zero while the seal/verify hooks cover every consumption path.
+ *
+ * Fast-path discipline: with no sealed pages a block costs ONE pointer
+ * load on the fault path (blk->shield == NULL); with the registry
+ * knob "shield_enable" 0 nothing seals at all.
+ */
+#ifndef TPURM_SHIELD_H
+#define TPURM_SHIELD_H
+
+#include <stdbool.h>
+#include <stdint.h>
+
+#include "status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Lifetime subsystem statistics (process-global). */
+typedef struct TpuShieldStats {
+    uint64_t seals;             /* pages sealed (incl. reseals)        */
+    uint64_t verifies;          /* page verifications run              */
+    uint64_t mismatches;        /* CRC mismatches observed (any cause) */
+    uint64_t refetchSaves;      /* ladder recoveries from a sibling /
+                                 * the sealing source                  */
+    uint64_t pagesPoisoned;     /* pages declared POISONED             */
+    uint64_t pagesRetired;      /* backing pages on the retire list    */
+    uint64_t scrubTicks;        /* scrubber passes                     */
+    uint64_t scrubPages;        /* pages scrubbed                      */
+    uint64_t scrubHits;         /* corruption caught by the scrubber   */
+    uint64_t injectCorrupts;    /* mem.corrupt flips performed         */
+    uint64_t injectDetected;    /* flips caught by a verify            */
+    uint64_t injectMisses;      /* flips that escaped every verify
+                                 * hook (coverage hole — must be 0)    */
+    uint64_t wireVerifies;      /* ICI/vac wire-buffer verifications   */
+    uint64_t wireMismatches;    /* wire CRC mismatches                 */
+} TpuShieldStats;
+
+/* Registry "shield_enable" (default 1). */
+bool tpurmShieldEnabled(void);
+
+/* CRC32C (Castagnoli).  Hardware SSE4.2 path when the CPU has it,
+ * slice-by-8 software fallback.  Extend form chains partial buffers
+ * (seed crc 0 == tpurmShieldCrc32c). */
+uint32_t tpurmShieldCrc32c(const void *data, uint64_t len);
+uint32_t tpurmShieldCrc32cExtend(uint32_t crc, const void *data,
+                                 uint64_t len);
+
+void tpurmShieldStatsGet(TpuShieldStats *out);
+void tpurmShieldStatsReset(void);   /* tests */
+
+/* ---- wire-side helpers (ici.c, vac.py over ctypes) ----
+ *
+ * InjectWire: one mem.corrupt evaluation carrying `scope`; a hit flips
+ * one deterministic bit inside [buf, buf+len) and counts the flip.
+ * Returns true when it flipped (the caller's verify MUST run either
+ * way — that verify is what keeps the reconciliation exact).
+ *
+ * VerifyWire: CRC-check a shipped buffer against the seal computed at
+ * the source.  Counts wire verifies/mismatches and resolves the
+ * inject bookkeeping (a flip this verify catches counts detected).
+ * Returns TPU_OK or TPU_ERR_INVALID_STATE on mismatch — the caller
+ * re-fetches from its intact source (its rung of the ladder). */
+bool tpurmShieldInjectWire(void *buf, uint64_t len, uint64_t scope);
+TpuStatus tpurmShieldVerifyWire(const void *buf, uint64_t len,
+                                uint32_t expectCrc, uint64_t scope);
+
+/* Poisoned pages inside the managed span [addr, addr+len) (0 when the
+ * span resolves to no managed range).  The scheduler uses this to
+ * attribute a TPU_ERR_PAGE_POISONED round failure to the OWNING
+ * sequence (containment: only that stream retires). */
+uint32_t tpurmShieldSpanPoisoned(uint64_t addr, uint64_t len);
+
+/* ---- retirement list ---- */
+
+/* Pages currently retired, total or for one device's HBM arena. */
+uint64_t tpurmShieldRetiredPages(uint32_t devInst);
+uint64_t tpurmShieldRetiredTotal(void);
+/* True when [offset, offset+bytes) of the (tier, devInst) arena
+ * overlaps a retired span (tests: retired spans never re-allocate). */
+bool tpurmShieldSpanRetired(uint32_t tier, uint32_t devInst,
+                            uint64_t offset, uint64_t bytes);
+
+/* ---- scrubber ---- */
+
+/* One synchronous scrub pass over at most maxPages sealed pages
+ * (tests / bench detection-latency probes; the background thread uses
+ * the same walk).  Returns pages scrubbed. */
+uint32_t tpurmShieldScrubNow(uint32_t maxPages);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_SHIELD_H */

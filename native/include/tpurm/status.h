@@ -45,11 +45,18 @@ typedef uint32_t TpuStatus;
  *   DEVICE_RESET     — the op's result is fenced by a full-device
  *     reset generation bump (a stale tracker/completion crossed a
  *     tpurmDeviceReset; the caller must re-issue against the new
- *     generation). */
+ *     generation);
+ *   PAGE_POISONED    — tpushield verified a sealed page against its
+ *     CRC, the re-fetch ladder found no recovery source, and the page
+ *     was poisoned + its backing retired.  Containment: only the
+ *     OWNING sequence sees this status (the scheduler retires that
+ *     stream with an error); co-tenants are untouched and no device
+ *     reset runs. */
 #define TPU_ERR_PAGE_QUARANTINED          0x00000070u
 #define TPU_ERR_RETRAIN_FAILED            0x00000071u
 #define TPU_ERR_RETRY_EXHAUSTED           0x00000072u
 #define TPU_ERR_DEVICE_RESET              0x00000073u
+#define TPU_ERR_PAGE_POISONED             0x00000074u
 
 const char *tpuStatusToString(TpuStatus status);
 

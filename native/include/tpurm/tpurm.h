@@ -248,6 +248,15 @@ TpuStatus tpuPushCopySeg(TpuPush *p, void *dst, const void *src,
  * executor quantizes+dequantizes the payload in place of memmove. */
 TpuStatus tpuPushCopySegEx(TpuPush *p, void *dst, const void *src,
                            uint64_t bytes, uint32_t xform);
+/* Segment with executor-side CRC32C sealing (tpushield): after the
+ * copy (and any xform) the executor computes one CRC32C per crcStride
+ * bytes of the DESTINATION into consecutive crcOut cells — sealing
+ * overlaps the copy on the executor thread instead of serializing
+ * after it.  bytes must be a multiple of crcStride; crcOut must stay
+ * valid until the push's tracker value completes. */
+TpuStatus tpuPushCopySegCrc(TpuPush *p, void *dst, const void *src,
+                            uint64_t bytes, uint32_t xform,
+                            uint32_t *crcOut, uint64_t crcStride);
 /* Submit; returns the tracker value (0 on failure).  If t is non-NULL the
  * (channel, value) pair is recorded there.  An empty push (no segments)
  * is submitted as a no-op marker — useful as a completion fence. */

@@ -86,6 +86,12 @@ typedef enum {
     TPU_TRACE_VAC_MIGRATE,       /* tpuvac tenant migration (whole
                                   * drain->ship->commit window; obj =
                                   * src<<32|dst, bytes = bytes moved)  */
+    TPU_TRACE_SHIELD_VERIFY,     /* tpushield seal verification span
+                                  * (obj = VA, bytes = span); mismatch/
+                                  * poison/wire events ride it as
+                                  * labeled instants                   */
+    TPU_TRACE_SHIELD_SCRUB,      /* one background scrub pass (obj =
+                                  * hits, bytes = bytes scrubbed)      */
     TPU_TRACE_APP,               /* application span (Python utils.span) */
     /* Instant-only sites. */
     TPU_TRACE_INJECT_HIT,        /* injection framework fired          */

@@ -1,0 +1,53 @@
+#!/bin/sh
+# check-inject — the injection-site coverage lint (check-metrics shape).
+#
+# Contract: every site in inject.c's site table must be
+#   (a) ARMED in at least one chaos soak in tests/test_stress.py
+#       (as an explicit Site.<NAME> reference — blanket for-loops do
+#       not count: an explicit mention is what keeps the soak honest
+#       when a site's semantics need bespoke assertions), and
+#   (b) DOCUMENTED with a row in the README inject table (the dotted
+#       site name must appear in README.md).
+# A site added in code but never armed in a soak (or never documented)
+# fails this target — the same can't-regress discipline check-spine
+# applies to dispatch and check-metrics to the scrape surface.
+#
+# Negative test hook: CHECK_INJECT_EXTRA=<dotted.name> injects a fake
+# site; the lint must then fail (asserted by tests/test_stress.py).
+set -eu
+
+src_inject=${1:-src/inject.c}
+stress_py=${2:-../tests/test_stress.py}
+readme=${3:-../README.md}
+
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+
+# Site table: the dotted literals between g_siteNames[...] = { and };
+awk '/g_siteNames\[/{grab=1; next} grab && /};/{exit} grab' \
+    "$src_inject" | sed -nE 's/.*"([a-z0-9_.]+)".*/\1/p' > "$tmp/sites"
+[ -s "$tmp/sites" ] || { echo "check-inject: no site table found"; exit 1; }
+[ -n "${CHECK_INJECT_EXTRA:-}" ] && echo "$CHECK_INJECT_EXTRA" >> "$tmp/sites"
+
+st=0
+while read -r site; do
+    # Enum spelling: mem.corrupt -> MEM_CORRUPT (matches g_siteEnv and
+    # the Python Site enum).
+    enum=$(echo "$site" | tr 'a-z.' 'A-Z_')
+    if ! grep -q "Site\.$enum" "$stress_py"; then
+        echo "check-inject: site $site ($enum) is never armed in a"
+        echo "  chaos soak (tests/test_stress.py must reference"
+        echo "  Site.$enum explicitly)"
+        st=1
+    fi
+    if ! grep -qF "$site" "$readme"; then
+        echo "check-inject: site $site has no row in the README inject"
+        echo "  table (document the site, its recovery path and its"
+        echo "  reconciliation invariant)"
+        st=1
+    fi
+done < "$tmp/sites"
+
+[ $st = 0 ] || exit 1
+n=$(wc -l < "$tmp/sites")
+echo "check-inject OK ($n sites armed in a soak and documented)"

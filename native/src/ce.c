@@ -321,8 +321,9 @@ static TpuStatus ce_stripe_push(TpuCeMgr *m, TpuCeStripe *s)
             st = tpuPushCopySegEx(&p, s->segs[i].dst, s->segs[i].src,
                                   s->segs[i].len, 0);
     } else {
-        st = tpuPushCopySegEx(&p, s->dst, s->src, s->len,
-                              s->comp & TPU_CE_COMP_FMT_MASK);
+        st = tpuPushCopySegCrc(&p, s->dst, s->src, s->len,
+                               s->comp & TPU_CE_COMP_FMT_MASK,
+                               s->crcOut, s->crcStride);
     }
     if (st != TPU_OK) {
         tpuPushAbort(&p);
@@ -597,10 +598,13 @@ TpuStatus tpuCeBatchWait(TpuCeBatch *b)
     return b->st;
 }
 
-TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
-                         uint64_t len, uint32_t comp)
+TpuStatus tpuCeBatchCopyCrc(TpuCeBatch *b, void *dst, const void *src,
+                            uint64_t len, uint32_t comp,
+                            uint32_t *crcOut, uint64_t crcStride)
 {
     if (!b || !b->m || (len && (!dst || !src)))
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (crcOut && (crcStride == 0 || len % crcStride))
         return TPU_ERR_INVALID_ARGUMENT;
     if (len == 0)
         return TPU_OK;
@@ -614,6 +618,14 @@ TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
 
     uint32_t active = ce_active(m);
     uint64_t stripe = ce_stripe_bytes();
+    /* Sealed copies split on crcStride boundaries so every stripe
+     * covers whole CRC cells (the executor writes cell k from
+     * dst[k*stride) — a cell split across stripes would tear). */
+    if (crcOut) {
+        if (stripe < crcStride)
+            stripe = crcStride;
+        stripe -= stripe % crcStride;
+    }
     uint32_t nstripes = 0;
     uint64_t off = 0;
     while (off < len) {
@@ -622,7 +634,8 @@ TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
             piece = stripe;
         /* Compressed stripes must stay 4-aligned so every piece is a
          * whole float array. */
-        if ((comp & TPU_CE_COMP_FMT_MASK) && piece < len - off)
+        if ((comp & TPU_CE_COMP_FMT_MASK) && !crcOut &&
+            piece < len - off)
             piece &= ~3ull;
         if (b->n == TPUCE_BATCH_STRIPES) {
             /* Table full: dep-join — reap retired stripes (blocking on
@@ -647,6 +660,10 @@ TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
         s->src = (const char *)src + off;
         s->len = piece;
         s->comp = comp;
+        if (crcOut) {
+            s->crcOut = crcOut + off / crcStride;
+            s->crcStride = crcStride;
+        }
         /* Submission failures are not terminal here: the stripe is
          * recorded and ce_stripe_complete re-drives it with the full
          * recovery ladder at wait time. */
@@ -661,6 +678,12 @@ TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
         tpurmTraceEnd(TPU_TRACE_CE_COPY, tSpan, (uint64_t)(uintptr_t)dst,
                       len);
     return TPU_OK;
+}
+
+TpuStatus tpuCeBatchCopy(TpuCeBatch *b, void *dst, const void *src,
+                         uint64_t len, uint32_t comp)
+{
+    return tpuCeBatchCopyCrc(b, dst, src, len, comp, NULL, 0);
 }
 
 TpuStatus tpuCeBatchCopySegs(TpuCeBatch *b, const TpuCeSeg *segs,

@@ -34,6 +34,7 @@
 #include "tpurm/ce.h"
 #include "tpurm/inject.h"
 #include "tpurm/msgq.h"
+#include "tpurm/shield.h"
 #include "tpurm/trace.h"
 
 #include <stdatomic.h>
@@ -59,6 +60,13 @@ typedef struct {
      * stripe moves bytes for (cross-thread propagation, same shape as
      * the memring SQE flowId). */
     uint64_t flow;
+    /* tpushield seal stage: when crcOut != NULL the executor computes
+     * one CRC32C per crcStride bytes of the DESTINATION (post-xform —
+     * the seal covers what is actually stored) into consecutive cells
+     * — the sealing work rides the executor thread, overlapped with
+     * the copy pipeline instead of serialized after the fence. */
+    uint32_t *crcOut;
+    uint64_t crcStride;
 } CopySeg;
 
 /* Outstanding pushbuffer chunk, in allocation order.  gpu_get advances
@@ -193,6 +201,17 @@ static void *channel_executor(void *arg)
                     else
                         memmove(segs[i].dst, segs[i].src, segs[i].bytes);
                     tpuHbmMirrorNotify(segs[i].dst, segs[i].bytes);
+                    if (segs[i].crcOut && segs[i].crcStride) {
+                        /* Seal stage: CRC the just-written destination
+                         * while it is cache-hot.  The caller's fence
+                         * (tracker-value wait) publishes the cells. */
+                        uint64_t st = segs[i].crcStride;
+                        uint32_t *out = segs[i].crcOut;
+                        const uint8_t *d = segs[i].dst;
+                        for (uint64_t off = 0; off + st <= segs[i].bytes;
+                             off += st)
+                            *out++ = tpurmShieldCrc32c(d + off, st);
+                    }
                 }
                 bytes += segs[i].bytes;
             }
@@ -421,9 +440,18 @@ TpuStatus tpuPushBegin(TpurmChannel *ch, uint32_t maxSegs, TpuPush *p)
 TpuStatus tpuPushCopySegEx(TpuPush *p, void *dst, const void *src,
                            uint64_t bytes, uint32_t xform)
 {
+    return tpuPushCopySegCrc(p, dst, src, bytes, xform, NULL, 0);
+}
+
+TpuStatus tpuPushCopySegCrc(TpuPush *p, void *dst, const void *src,
+                            uint64_t bytes, uint32_t xform,
+                            uint32_t *crcOut, uint64_t crcStride)
+{
     if (!p || !p->ch || p->nsegs >= p->maxSegs)
         return TPU_ERR_INVALID_ARGUMENT;
     if (bytes && (!dst || !src))
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (crcOut && (crcStride == 0 || bytes % crcStride))
         return TPU_ERR_INVALID_ARGUMENT;
     CopySeg *s = &((CopySeg *)p->segs)[p->nsegs++];
     s->dst = dst;
@@ -432,6 +460,8 @@ TpuStatus tpuPushCopySegEx(TpuPush *p, void *dst, const void *src,
     s->xform = xform;
     s->pad = 0;
     s->flow = tpurmTraceFlowGet();
+    s->crcOut = crcOut;
+    s->crcStride = crcStride;
     return TPU_OK;
 }
 

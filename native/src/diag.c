@@ -364,6 +364,7 @@ const char *tpuStatusToString(TpuStatus status)
     case TPU_ERR_RETRAIN_FAILED:         return "RETRAIN_FAILED";
     case TPU_ERR_RETRY_EXHAUSTED:        return "RETRY_EXHAUSTED";
     case TPU_ERR_DEVICE_RESET:           return "DEVICE_RESET";
+    case TPU_ERR_PAGE_POISONED:          return "PAGE_POISONED";
     default:                             return "UNKNOWN";
     }
 }

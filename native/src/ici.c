@@ -22,6 +22,7 @@
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
 #include "tpurm/memring.h"
+#include "tpurm/shield.h"
 #include "tpurm/trace.h"
 #include "tpurm/uvm.h"
 
@@ -576,19 +577,58 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
          * retry loop this replaces).  With a tracker, the stripes'
          * dependencies hand off to the caller (failures surface at its
          * range-checked wait); without one, completion is synchronous
-         * with per-stripe recovery. */
+         * with per-stripe recovery.
+         *
+         * tpushield wire checksum (sync path only — a tracker handoff
+         * completes at the caller, where no verify hook exists): the
+         * payload CRC computed at the SOURCE travels with the push and
+         * is verified against the DESTINATION after the fence; a
+         * mismatch is attributed to the link (both endpoints take the
+         * health hit) and the copy retries once from the still-intact
+         * source. */
         TpuCeMgr *mgr = tpuCeMgrGet(from);
         if (!mgr)
             return TPU_ERR_INVALID_STATE;
-        TpuCeBatch b;
-        tpuCeBatchBegin(mgr, &b);
-        st = tpuCeBatchCopy(&b, dst, src, size, TPU_CE_COMP_NONE);
-        if (tracker && st == TPU_OK) {
-            st = tpuCeBatchHandoff(&b, tracker);
-        } else {
-            TpuStatus ws = tpuCeBatchWait(&b);
-            if (st == TPU_OK)
-                st = ws;
+        bool sealed = tracker == NULL && tpurmShieldEnabled();
+        /* Real-arena coherence BEFORE the seal CRC: a chip-dirty source
+         * span would otherwise seal the stale host shadow while the CE
+         * copy downloads + moves the fresh bytes — a deterministic
+         * false mismatch (and two spurious link-flap health notes) per
+         * healthy copy.  If coherence fails, skip the seal: the copy's
+         * own coherence path still decides the transfer's fate. */
+        if (sealed && tpuHbmCoherentForRead(src, size) != TPU_OK)
+            sealed = false;
+        uint32_t srcCrc = sealed ? tpurmShieldCrc32c(src, size) : 0;
+        for (int attempt = 0; ; attempt++) {
+            TpuCeBatch b;
+            tpuCeBatchBegin(mgr, &b);
+            st = tpuCeBatchCopy(&b, dst, src, size, TPU_CE_COMP_NONE);
+            if (tracker && st == TPU_OK) {
+                st = tpuCeBatchHandoff(&b, tracker);
+            } else {
+                TpuStatus ws = tpuCeBatchWait(&b);
+                if (st == TPU_OK)
+                    st = ws;
+            }
+            if (st != TPU_OK || !sealed)
+                break;
+            uint64_t linkScope = ((uint64_t)from << 32) | to;
+            tpurmShieldInjectWire(dst, size, linkScope);
+            if (tpurmShieldVerifyWire(dst, size, srcCrc, linkScope) ==
+                TPU_OK)
+                break;
+            tpuCounterAdd("ici_wire_crc_errors", 1);
+            tpurmHealthNote(from, TPU_HEALTH_EV_LINK_FLAP);
+            tpurmHealthNote(to, TPU_HEALTH_EV_LINK_FLAP);
+            tpuLog(TPU_LOG_WARN, "ici",
+                   "wire CRC mismatch on link %u -> %u (%llu bytes), "
+                   "%s", from, to, (unsigned long long)size,
+                   attempt == 0 ? "re-fetching from source"
+                                : "retry exhausted");
+            if (attempt >= 1) {
+                st = TPU_ERR_INVALID_STATE;
+                break;
+            }
         }
         if (st == TPU_OK)
             tpuCounterAdd("ici_peer_copy_bytes", size);
@@ -692,16 +732,78 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
                 tpuCeBatchBegin(hopMgr[h], &curB[h]);
             }
         uint32_t lastHop = n - 2;
+        /* tpushield per-hop CRC: the segment's CRC is carried with the
+         * push down the store-and-forward chain and checked at every
+         * hop boundary (the input of hop h is the fenced output of hop
+         * h-1), so a corrupting MIDDLE hop is attributed to the exact
+         * LINK that damaged the bytes — and repaired by re-running
+         * just that hop from its still-intact input. */
+        bool hopSeal = tpurmShieldEnabled();
+        /* Real-arena coherence before any source CRC (single readback
+         * covers every per-segment seal and the fallback verify). */
+        if (hopSeal && tpuHbmCoherentForRead(src, size) != TPU_OK)
+            hopSeal = false;
+        const char *hopIn[MAX_HOPS + 1];
+        /* Per-segment source CRCs are kept for the final-hop verify:
+         * the destination is checked segment-by-segment against the
+         * seals computed once here — no second full source pass, and
+         * a final-link mismatch is attributed to the exact segment. */
+        uint32_t nSegs = (uint32_t)((size + seg - 1) / seg);
+        uint32_t *segCrcs = hopSeal
+                                ? malloc((size_t)nSegs * sizeof(*segCrcs))
+                                : NULL;
         for (uint64_t off = 0; off < size && st == TPU_OK; off += seg) {
             uint64_t len = size - off < seg ? size - off : seg;
             const char *hopSrc = (const char *)src + off;
+            uint32_t segCrc = hopSeal
+                                  ? tpurmShieldCrc32c(hopSrc, len) : 0;
+            if (segCrcs)
+                segCrcs[off / seg] = segCrc;
             for (uint32_t h = 0; h + 1 < n && st == TPU_OK; h++) {
                 /* Data dependency: previous hop of THIS segment. */
                 if (h > 0) {
                     st = tpuCeBatchWait(&curB[h - 1]);
                     if (st != TPU_OK)
                         break;
+                    if (hopSeal) {
+                        /* hopSrc is now the FENCED output of hop h-1:
+                         * check it against the segment CRC before hop
+                         * h forwards it.  One mem.corrupt evaluation
+                         * per hop models the corrupting middle hop. */
+                        uint64_t lk = ((uint64_t)chain[h - 1] << 32) |
+                                      chain[h];
+                        tpurmShieldInjectWire((void *)(uintptr_t)hopSrc,
+                                              len, lk);
+                        if (tpurmShieldVerifyWire(hopSrc, len, segCrc,
+                                                  lk) != TPU_OK) {
+                            tpuCounterAdd("ici_wire_crc_errors", 1);
+                            tpurmHealthNote(chain[h - 1],
+                                            TPU_HEALTH_EV_LINK_FLAP);
+                            tpurmHealthNote(chain[h],
+                                            TPU_HEALTH_EV_LINK_FLAP);
+                            tpuLog(TPU_LOG_WARN, "ici",
+                                   "hop CRC mismatch on link %u -> %u "
+                                   "(detour seg @%llu): re-running hop",
+                                   chain[h - 1], chain[h],
+                                   (unsigned long long)off);
+                            /* Repair: re-run hop h-1 from its intact
+                             * input (verified when IT was the hop
+                             * boundary), synchronously. */
+                            st = tpuCeCopySync(hopMgr[h - 1],
+                                               (void *)(uintptr_t)hopSrc,
+                                               hopIn[h - 1], len,
+                                               TPU_CE_COMP_NONE);
+                            if (st == TPU_OK &&
+                                tpurmShieldVerifyWire(hopSrc, len,
+                                                      segCrc, lk) !=
+                                    TPU_OK)
+                                st = TPU_ERR_INVALID_STATE;
+                            if (st != TPU_OK)
+                                break;
+                        }
+                    }
                 }
+                hopIn[h] = hopSrc;
                 /* Staging reuse: the PREVIOUS segment must have been
                  * read out of the slot this copy overwrites. */
                 if (h < lastHop) {
@@ -753,6 +855,41 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
             }
             free(rows);
         }
+        /* Final-hop verify: the payload at the destination against the
+         * per-segment source CRCs computed once above (the last link's
+         * per-hop check) — no second full source pass.  A mismatch
+         * cannot be repaired in place — its staging inputs are already
+         * recycled — so it fails the copy; the spine's bounded retry
+         * re-runs the transfer from the intact source.  (segCrcs NULL
+         * = malloc failed: recompute the whole-payload CRC instead.) */
+        if (st == TPU_OK && hopSeal) {
+            uint64_t lk = ((uint64_t)chain[n - 2] << 32) | chain[n - 1];
+            tpurmShieldInjectWire(dst, size, lk);
+            bool ok = true;
+            if (segCrcs) {
+                for (uint64_t off = 0; off < size && ok; off += seg) {
+                    uint64_t len = size - off < seg ? size - off : seg;
+                    ok = tpurmShieldVerifyWire((char *)dst + off, len,
+                                               segCrcs[off / seg],
+                                               lk) == TPU_OK;
+                }
+            } else {
+                ok = tpurmShieldVerifyWire(
+                         dst, size, tpurmShieldCrc32c(src, size),
+                         lk) == TPU_OK;
+            }
+            if (!ok) {
+                tpuCounterAdd("ici_wire_crc_errors", 1);
+                tpurmHealthNote(chain[n - 2], TPU_HEALTH_EV_LINK_FLAP);
+                tpurmHealthNote(chain[n - 1], TPU_HEALTH_EV_LINK_FLAP);
+                tpuLog(TPU_LOG_WARN, "ici",
+                       "final-hop CRC mismatch on link %u -> %u: "
+                       "failing the detour copy for retry",
+                       chain[n - 2], chain[n - 1]);
+                st = TPU_ERR_INVALID_STATE;
+            }
+        }
+        free(segCrcs);
     }
     if (st == TPU_OK) {
         tpuCounterAdd("ici_peer_copy_bytes", size);

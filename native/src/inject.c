@@ -56,6 +56,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "reset.device",
     "vac.migrate",
     "hot.decide",
+    "mem.corrupt",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -73,6 +74,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "RESET_DEVICE",
     "VAC_MIGRATE",
     "HOT_DECIDE",
+    "MEM_CORRUPT",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)

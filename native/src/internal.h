@@ -385,6 +385,14 @@ void tpurmHealthRenderTable(TpuCur *c);
 void tpurmFlowRenderProm(TpuCur *c);
 void tpurmFlowRenderTable(TpuCur *c);
 
+/* ----------------------------------------------------------- tpushield
+ *
+ * Render hooks for the page-integrity subsystem (shield.c; public
+ * surface in tpurm/shield.h). */
+
+void tpurmShieldRenderProm(TpuCur *c);
+void tpurmShieldRenderTable(TpuCur *c);
+
 /* ------------------------------------------------- robust channel RC */
 
 /* (Fault kinds TPU_RC_* live in tpurm.h beside the public notifier.) */

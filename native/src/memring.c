@@ -891,20 +891,26 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
         uint64_t now = tpuNowNs();
         if (cancel && cancel[i]) {
             tpuCounterAdd("memring_dep_cancelled", 1);
-            post_cqe(r, &batch[i], slot, TPU_ERR_INVALID_STATE, 0,
-                     batch[i].seq, now, now, true, claimGen);
+            /* Retire BEFORE posting (here and at every completion
+             * site): a producer that observes the CQE and preps again
+             * must find the frontier already advanced — the old
+             * post-then-retire order left a window where prep's
+             * frontier-lag gate was transiently strict right after a
+             * full reap (the PR-14 test_wrap_and_backpressure flake). */
             seqs[0] = batch[i].seq;
             errs[0] = 1;
             mr_retire_seqs(r, seqs, errs, 1);
+            post_cqe(r, &batch[i], slot, TPU_ERR_INVALID_STATE, 0,
+                     batch[i].seq, now, now, true, claimGen);
             i++;
             continue;
         }
         if (sqe_deadline_expired(&batch[i], now)) {
-            post_cqe(r, &batch[i], slot, TPU_ERR_RETRY_EXHAUSTED, 0,
-                     batch[i].seq, now, now, true, claimGen);
             seqs[0] = batch[i].seq;
             errs[0] = 1;
             mr_retire_seqs(r, seqs, errs, 1);
+            post_cqe(r, &batch[i], slot, TPU_ERR_RETRY_EXHAUSTED, 0,
+                     batch[i].seq, now, now, true, claimGen);
             i++;
             continue;
         }
@@ -960,6 +966,18 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
         uint64_t fencedBytes = moved;
         TpuStatus fst = mr_gen_fence(st, &fencedBytes, claimGen);
         bool wantCqe = !(r->internal && slots);
+        /* Retire FIRST (one frontier-lock acquisition per run), THEN
+         * make the completion observable (CQE / group settle): a
+         * producer that reaps the CQE and immediately preps must see
+         * the frontier already past these seqs, or the frontier-lag
+         * gate reports transient INSUFFICIENT_RESOURCES right after a
+         * full reap (the PR-14 wrap/backpressure flake — the window
+         * was this worker being descheduled between the two). */
+        for (uint32_t k = 0; k < runLen; k++) {
+            seqs[k] = batch[i + k].seq;
+            errs[k] = fst != TPU_OK;
+        }
+        mr_retire_seqs(r, seqs, errs, runLen);
         if (wantCqe) {
             pthread_mutex_lock(&r->cqLock);
             for (uint32_t k = 0; k < runLen; k++)
@@ -982,21 +1000,13 @@ static void exec_batch(TpuMemring *r, const TpuMemringSqe *batch,
                 tpuCounterAdd("memring_error_cqes", runLen);
             }
             tpuCounterAdd("memring_cqes", runLen);
-            for (uint32_t k = 0; k < runLen; k++) {
-                seqs[k] = batch[i + k].seq;
-                errs[k] = fst != TPU_OK;
-            }
         } else {
-            for (uint32_t k = 0; k < runLen; k++) {
+            for (uint32_t k = 0; k < runLen; k++)
                 post_settle(r, &slots[i + k], fst);
-                seqs[k] = batch[i + k].seq;
-                errs[k] = fst != TPU_OK;
-            }
         }
         if (wantCqe && atomic_load(&r->hdr->cqWaiters) != 0)
             mr_futex(&r->hdr->cqReady, FUTEX_WAKE, INT32_MAX, NULL);
         atomic_fetch_sub(&r->inflight, runLen);
-        mr_retire_seqs(r, seqs, errs, runLen);
         i += runLen;
     }
 }
@@ -1016,28 +1026,30 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
         uint8_t err = 1;
         if (cancel && cancel[i] && !cancelled) {
             /* Dep-cancel inside a chain: behaves as this entry failing
-             * (cancels the remainder, like any chain failure). */
+             * (cancels the remainder, like any chain failure).  Retire
+             * before post, like every completion site (prep's
+             * frontier-lag gate must never lag a reaped CQE). */
             tpuCounterAdd("memring_dep_cancelled", 1);
             cancelled = true;
             uint64_t now = tpuNowNs();
+            mr_retire_seqs(r, &seq, &err, 1);
             post_cqe(r, &chain[i], slot, TPU_ERR_INVALID_STATE, 0,
                      seq, now, now, true, claimGen);
-            mr_retire_seqs(r, &seq, &err, 1);
             continue;
         }
         if (cancelled) {
             uint64_t now = tpuNowNs();
             tpuCounterAdd("memring_links_cancelled", 1);
+            mr_retire_seqs(r, &seq, &err, 1);
             post_cqe(r, &chain[i], slot, TPU_ERR_INVALID_STATE, 0,
                      seq, now, now, true, claimGen);
-            mr_retire_seqs(r, &seq, &err, 1);
             continue;
         }
         uint64_t t0 = tpuNowNs();
         if (sqe_deadline_expired(&chain[i], t0)) {
+            mr_retire_seqs(r, &seq, &err, 1);
             post_cqe(r, &chain[i], slot, TPU_ERR_RETRY_EXHAUSTED, 0,
                      seq, t0, t0, true, claimGen);
-            mr_retire_seqs(r, &seq, &err, 1);
             cancelled = true;      /* chain semantics: failure cancels */
             continue;
         }
@@ -1062,10 +1074,14 @@ static void exec_chain(TpuMemring *r, const TpuMemringSqe *chain,
         tpuCounterAdd("memring_ops", 1);
         if (injectedFail)
             tpuCounterAdd("memring_inject_error_cqes", 1);
-        post_cqe(r, &chain[i], slot, st, moved, seq, t0,
-                 tpuNowNs(), true, claimGen);
         err = st != TPU_OK;
+        /* Stamp end BEFORE the retire: retiring releases ordered
+         * waiters (a FENCE), and a fence that starts on another
+         * worker must observe end_ns <= its start_ns. */
+        uint64_t t1 = tpuNowNs();
         mr_retire_seqs(r, &seq, &err, 1);
+        post_cqe(r, &chain[i], slot, st, moved, seq, t0, t1, true,
+                 claimGen);
         if (st != TPU_OK)
             cancelled = true;
     }
@@ -1092,6 +1108,25 @@ typedef enum {
  * whole, and only once every entry's deps are satisfied (execution
  * then never parks mid-chain).  `force` (ring shutdown) ignores deps
  * so destroy drains the queue exactly as the FIFO pop did. */
+/* Advance sqHead past the claimed prefix — slots are free for the
+ * producer the moment their claim copied the SQE out.  popLock held.
+ * The ONE implementation of the claim-bit/sqHead invariant (the claim
+ * scan and prep's help-the-head both go through it).  Returns the new
+ * head. */
+static uint32_t mr_advance_claimed_head(TpuMemring *r)
+{
+    uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
+                                         memory_order_relaxed);
+    uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
+                                         memory_order_acquire);
+    while (head != tail && mr_bit_test(r->claimedMap, head & r->sqMask)) {
+        mr_bit_clear(r->claimedMap, head & r->sqMask);
+        head++;
+    }
+    atomic_store_explicit(&r->hdr->sqHead, head, memory_order_release);
+    return head;
+}
+
 static MrClaimResult mr_claim_and_exec(TpuMemring *r, bool force)
 {
     TpuMemringSqe local[MEMRING_POP_BATCH];
@@ -1101,17 +1136,9 @@ static MrClaimResult mr_claim_and_exec(TpuMemring *r, bool force)
     uint32_t sqMask = r->sqMask;
 
     pthread_mutex_lock(&r->popLock);
-    uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
-                                         memory_order_relaxed);
+    uint32_t head = mr_advance_claimed_head(r);
     uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
                                          memory_order_acquire);
-    /* Advance the head past the claimed prefix (slots freed for the
-     * producer the moment their claim copied them out). */
-    while (head != tail && mr_bit_test(r->claimedMap, head & sqMask)) {
-        mr_bit_clear(r->claimedMap, head & sqMask);
-        head++;
-    }
-    atomic_store_explicit(&r->hdr->sqHead, head, memory_order_release);
     if (head == tail) {
         atomic_store(&r->depBlocked, 0);
         pthread_mutex_unlock(&r->popLock);
@@ -1251,10 +1278,10 @@ static MrClaimResult mr_claim_and_exec(TpuMemring *r, bool force)
         pthread_mutex_unlock(&r->popLock);
         uint64_t now = tpuNowNs();
         tpuCounterAdd("memring_fences", 1);
-        post_cqe(r, &fence, NULL, TPU_OK, 0, fence.seq, now, now, false,
-                 0);
         uint8_t err = 0;
         mr_retire_seqs(r, &fence.seq, &err, 1);
+        post_cqe(r, &fence, NULL, TPU_OK, 0, fence.seq, now, now, false,
+                 0);
         return MR_CLAIM_PROGRESS;
     }
     if (n == 0) {
@@ -1594,16 +1621,33 @@ TpuStatus tpurmMemringPrep(TpuMemring *r, TpuMemringSqe *sqe)
         return TPU_ERR_INVALID_LIMIT;
     uint32_t head = atomic_load_explicit(&r->hdr->sqHead,
                                          memory_order_acquire);
-    if (r->pendTail - head >= r->hdr->sqEntries)
-        return TPU_ERR_INSUFFICIENT_RESOURCES;
     /* Frontier-lag gate: the done-window is finite, so a live seq may
      * sit at most doneBits-1 above the retirement watermark (a hung op
      * pins the watermark while later work retires into the window).
-     * Same remedy as SQ-full: submit and reap. */
-    if (r->prepSeq - atomic_load_explicit(&r->hdr->seqRetired,
+     * Same remedy as SQ-full: submit and reap.
+     *
+     * Both gates RE-SAMPLE once after a failure, with prep helping the
+     * claimed-prefix head forward itself (the PR-14 forensics flake):
+     * a worker that posted its CQEs but was descheduled before its
+     * NEXT claim scan leaves sqHead lagging behind slots whose claim
+     * bits are long set — a producer that just reaped those CQEs
+     * would spuriously see a full SQ.  (The companion window — CQEs
+     * posted before the retirement frontier advanced — is closed at
+     * the source: every completion site retires BEFORE it posts.) */
+    if (r->pendTail - head >= r->hdr->sqEntries ||
+        r->prepSeq - atomic_load_explicit(&r->hdr->seqRetired,
                                           memory_order_acquire) >=
-        (uint64_t)r->doneBits - 1)
-        return TPU_ERR_INSUFFICIENT_RESOURCES;
+            (uint64_t)r->doneBits - 1) {
+        pthread_mutex_lock(&r->popLock);
+        uint32_t h = mr_advance_claimed_head(r);
+        pthread_mutex_unlock(&r->popLock);
+        if (r->pendTail - h >= r->hdr->sqEntries)
+            return TPU_ERR_INSUFFICIENT_RESOURCES;
+        if (r->prepSeq - atomic_load_explicit(&r->hdr->seqRetired,
+                                              memory_order_acquire) >=
+            (uint64_t)r->doneBits - 1)
+            return TPU_ERR_INSUFFICIENT_RESOURCES;
+    }
     sqe->seq = r->prepSeq;
     /* Rewrite BATCH-relative deps (index into the unpublished batch)
      * to absolute handles; a dep must point BACKWARDS. */

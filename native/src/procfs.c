@@ -180,6 +180,7 @@ static void render_metrics(TpuCur *c)
     tpurmHealthRenderProm(c);
     tpurmHotRenderProm(c);
     tpurmFlowRenderProm(c);
+    tpurmShieldRenderProm(c);
 }
 
 /* Hotness-driven placement (tpuhot): policy stats, per-device hotness
@@ -200,6 +201,13 @@ static void render_flows(TpuCur *c)
 static void render_health(TpuCur *c)
 {
     tpurmHealthRenderTable(c);
+}
+
+/* Page integrity (tpushield): seal/verify/scrub stats, the inject
+ * reconciliation, and the retired-span quarantine list. */
+static void render_shield(TpuCur *c)
+{
+    tpurmShieldRenderTable(c);
 }
 
 /* Tenant QoS table: id, priority, per-tier usage vs quota. */
@@ -276,6 +284,7 @@ static const ProcNode g_nodes[] = {
     { "driver/tpurm/health", render_health, false },
     { "driver/tpurm/hotness", render_hotness, false },
     { "driver/tpurm/flows", render_flows, false },
+    { "driver/tpurm/shield", render_shield, false },
 };
 
 #define N_NODES (sizeof(g_nodes) / sizeof(g_nodes[0]))

@@ -1,0 +1,891 @@
+/*
+ * tpushield — page integrity engine (see include/tpurm/shield.h for
+ * the model; uvm_internal.h for the per-page metadata contract).
+ *
+ * Layering: uvm_va_block.c / uvm_fault.c own WHERE seal/verify happen
+ * (the demote commit, the promote copy, the first CPU touch); this
+ * file owns the metadata, the CRC, the re-fetch ladder, the poison /
+ * retirement machinery, the background scrubber, and the mem.corrupt
+ * bookkeeping that keeps the reconciliation invariant exact:
+ *
+ *     mem.corrupt hits == shield_detected + shield_inject_misses
+ *
+ * Every flip is tagged where it lands (per-page `pending` count, or
+ * the process-global wire-pending count for ICI/vac buffers); every
+ * verify that catches one converts it to shield_detected; a flip that
+ * escapes every verify hook surfaces as shield_inject_misses — the
+ * coverage-hole detector both chaos soaks assert to zero.
+ */
+#define _GNU_SOURCE
+#include "tpurm/shield.h"
+
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <time.h>
+
+#include "internal.h"
+#include "tpurm/health.h"
+#include "tpurm/inject.h"
+#include "tpurm/trace.h"
+#include "uvm/uvm_internal.h"
+
+/* ------------------------------------------------------------- CRC32C */
+
+static uint32_t g_crcTable[8][256];
+static pthread_once_t g_crcOnce = PTHREAD_ONCE_INIT;
+static bool g_crcHw;
+
+static void crc_init_once(void)
+{
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;   /* CRC32C */
+        g_crcTable[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+        for (int t = 1; t < 8; t++)
+            g_crcTable[t][i] =
+                (g_crcTable[t - 1][i] >> 8) ^
+                g_crcTable[0][g_crcTable[t - 1][i] & 0xFF];
+#if defined(__x86_64__) || defined(__i386__)
+    g_crcHw = __builtin_cpu_supports("sse4.2");
+#endif
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t state, const uint8_t *p, uint64_t len)
+{
+    uint64_t c = state;
+    while (len >= 8) {
+        uint64_t v;
+        memcpy(&v, p, 8);
+        c = __builtin_ia32_crc32di(c, v);
+        p += 8;
+        len -= 8;
+    }
+    uint32_t c32 = (uint32_t)c;
+    while (len--)
+        c32 = __builtin_ia32_crc32qi(c32, *p++);
+    return c32;
+}
+#endif
+
+static uint32_t crc32c_sw(uint32_t state, const uint8_t *p, uint64_t len)
+{
+    uint32_t c = state;
+    while (len >= 8) {
+        uint32_t lo, hi;
+        memcpy(&lo, p, 4);
+        memcpy(&hi, p + 4, 4);
+        lo ^= c;
+        c = g_crcTable[7][lo & 0xFF] ^ g_crcTable[6][(lo >> 8) & 0xFF] ^
+            g_crcTable[5][(lo >> 16) & 0xFF] ^ g_crcTable[4][lo >> 24] ^
+            g_crcTable[3][hi & 0xFF] ^ g_crcTable[2][(hi >> 8) & 0xFF] ^
+            g_crcTable[1][(hi >> 16) & 0xFF] ^ g_crcTable[0][hi >> 24];
+        p += 8;
+        len -= 8;
+    }
+    while (len--)
+        c = g_crcTable[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+    return c;
+}
+
+uint32_t tpurmShieldCrc32cExtend(uint32_t crc, const void *data,
+                                 uint64_t len)
+{
+    pthread_once(&g_crcOnce, crc_init_once);
+    uint32_t state = ~crc;
+#if defined(__x86_64__)
+    if (g_crcHw)
+        return ~crc32c_hw(state, data, len);
+#endif
+    return ~crc32c_sw(state, data, len);
+}
+
+uint32_t tpurmShieldCrc32c(const void *data, uint64_t len)
+{
+    return tpurmShieldCrc32cExtend(0, data, len);
+}
+
+/* -------------------------------------------------------------- knobs */
+
+bool tpurmShieldEnabled(void)
+{
+    static TpuRegCache c_en;
+    return tpuRegCacheGet(&c_en, "shield_enable", 1) != 0;
+}
+
+bool uvmShieldActive(void)
+{
+    return tpurmShieldEnabled();
+}
+
+/* ------------------------------------------------------- reconciliation
+ *
+ * Wire flips (ICI hop buffers, vac records) are always paired with an
+ * immediate verify in the same code path; the pending count bridges
+ * the two so concurrent wires reconcile globally. */
+
+static _Atomic uint64_t g_wirePending;
+
+/* --------------------------------------------------------- retire list */
+
+#define SHIELD_RETIRE_MAX 4096
+#define SHIELD_MAX_DEVS 16
+
+static struct {
+    pthread_mutex_t lock;
+    struct {
+        uint8_t tier;
+        uint8_t dev;
+        uint64_t off, bytes;
+    } s[SHIELD_RETIRE_MAX];
+    _Atomic uint32_t n;             /* entries published (release)     */
+    _Atomic uint32_t dropped;       /* retirements past the table cap  */
+    _Atomic uint64_t perDev[SHIELD_MAX_DEVS];
+    _Atomic uint64_t total;
+} g_retire = { .lock = PTHREAD_MUTEX_INITIALIZER };
+
+static void retire_add(uint32_t tier, uint32_t dev, uint64_t off,
+                       uint64_t bytes)
+{
+    pthread_mutex_lock(&g_retire.lock);
+    uint32_t n = atomic_load_explicit(&g_retire.n, memory_order_relaxed);
+    if (n < SHIELD_RETIRE_MAX) {
+        g_retire.s[n].tier = (uint8_t)tier;
+        g_retire.s[n].dev = (uint8_t)dev;
+        g_retire.s[n].off = off;
+        g_retire.s[n].bytes = bytes;
+        /* Entries are immutable once published: lock-free readers scan
+         * up to the release-stored count. */
+        atomic_store_explicit(&g_retire.n, n + 1, memory_order_release);
+    } else {
+        /* Table saturated: the span cannot be recorded, so the free
+         * gate below FAILS CLOSED (uvmShieldRunRetired returns true
+         * for everything — no chunk returns to the freelist once the
+         * table can no longer prove a span clean).  Counted + logged:
+         * 4096 retired spans means the device is dying, not the
+         * quarantine. */
+        atomic_fetch_add(&g_retire.dropped, 1);
+        tpuCounterAdd("shield_retire_overflow", 1);
+        tpuLog(TPU_LOG_ERROR, "shield",
+               "retire table FULL (%u spans): tier %u dev %u off 0x%llx "
+               "unrecorded — chunk frees now fail closed",
+               SHIELD_RETIRE_MAX, tier, dev, (unsigned long long)off);
+    }
+    atomic_fetch_add(&g_retire.total, 1);
+    if (dev < SHIELD_MAX_DEVS)
+        atomic_fetch_add(&g_retire.perDev[dev], 1);
+    pthread_mutex_unlock(&g_retire.lock);
+}
+
+bool tpurmShieldSpanRetired(uint32_t tier, uint32_t devInst,
+                            uint64_t offset, uint64_t bytes)
+{
+    uint32_t n = atomic_load_explicit(&g_retire.n, memory_order_acquire);
+    for (uint32_t i = 0; i < n; i++) {
+        if (g_retire.s[i].tier != tier)
+            continue;
+        if (tier == UVM_TIER_HBM && g_retire.s[i].dev != devInst)
+            continue;
+        if (offset < g_retire.s[i].off + g_retire.s[i].bytes &&
+            g_retire.s[i].off < offset + bytes)
+            return true;
+    }
+    return false;
+}
+
+uint64_t tpurmShieldRetiredPages(uint32_t devInst)
+{
+    if (devInst >= SHIELD_MAX_DEVS)
+        return 0;
+    return atomic_load(&g_retire.perDev[devInst]);
+}
+
+uint64_t tpurmShieldRetiredTotal(void)
+{
+    return atomic_load(&g_retire.total);
+}
+
+/* Chunk-free gate (block_gc_runs / uvmBlockFreeBacking): a run whose
+ * span overlaps a retired page must NOT return to the PMM freelist —
+ * the deliberate leak IS the retirement (reference: PMM blacklist,
+ * dynamic page retirement). */
+bool uvmShieldRunRetired(UvmTierArena *arena, uint64_t chunkOff,
+                         uint64_t bytes)
+{
+    /* Saturated table = fail closed: unrecorded retired spans exist,
+     * so no chunk can be proven clean — nothing returns to the
+     * freelist (the deliberate leak IS the retirement). */
+    if (atomic_load_explicit(&g_retire.dropped, memory_order_acquire))
+        return true;
+    if (atomic_load_explicit(&g_retire.n, memory_order_acquire) == 0)
+        return false;
+    return tpurmShieldSpanRetired(arena->tier, arena->devInst, chunkOff,
+                                  bytes);
+}
+
+/* Allocation-side invariant detector: a fresh chunk overlapping a
+ * retired span means retirement leaked back into circulation.  Counted
+ * (must stay 0), never fails the alloc — the counter is the alarm. */
+void uvmShieldCheckAlloc(UvmTierArena *arena, uint64_t off, uint64_t bytes)
+{
+    if (atomic_load_explicit(&g_retire.n, memory_order_acquire) == 0)
+        return;
+    if (tpurmShieldSpanRetired(arena->tier, arena->devInst, off, bytes)) {
+        tpuCounterAdd("shield_retired_realloc", 1);
+        tpuLog(TPU_LOG_ERROR, "shield",
+               "retired span re-allocated: tier %u dev %u off 0x%llx",
+               arena->tier, arena->devInst, (unsigned long long)off);
+    }
+}
+
+/* ------------------------------------------------------ page metadata */
+
+/* meta.state: 0 unsealed, 1 + tier sealed, 0xFF poisoned. */
+#define SHIELD_POISONED 0xFF
+
+static inline bool meta_sealed(const UvmShieldPage *m)
+{
+    return m->state != 0 && m->state != SHIELD_POISONED;
+}
+
+static inline UvmTier meta_tier(const UvmShieldPage *m)
+{
+    return (UvmTier)(m->state - 1);
+}
+
+static void shield_scrub_start(void);
+
+static UvmShieldPage *shield_meta(UvmVaBlock *blk)
+{
+    if (!blk->shield)
+        blk->shield = calloc(blk->npages, sizeof(UvmShieldPage));
+    return blk->shield;
+}
+
+void uvmShieldBlockFree(UvmVaBlock *blk)
+{
+    free(blk->shield);
+    blk->shield = NULL;
+}
+
+bool uvmShieldPagePoisoned(UvmVaBlock *blk, uint32_t page)
+{
+    return blk->shield && blk->shield[page].state == SHIELD_POISONED;
+}
+
+int uvmShieldPageSealedTier(UvmVaBlock *blk, uint32_t page)
+{
+    if (!blk->shield || !meta_sealed(&blk->shield[page]))
+        return -1;
+    return (int)meta_tier(&blk->shield[page]);
+}
+
+bool uvmShieldRangePoisoned(UvmVaBlock *blk, uint32_t first, uint32_t count)
+{
+    if (!blk->shield)
+        return false;
+    for (uint32_t p = first; p < first + count && p < blk->npages; p++)
+        if (blk->shield[p].state == SHIELD_POISONED)
+            return true;
+    return false;
+}
+
+bool uvmShieldRangeSealed(UvmVaBlock *blk, uint32_t first, uint32_t count)
+{
+    if (!blk->shield)
+        return false;
+    for (uint32_t p = first; p < first + count && p < blk->npages; p++)
+        if (meta_sealed(&blk->shield[p]))
+            return true;
+    return false;
+}
+
+/* Seal one page's `tier` copy with the CRC the copy path computed
+ * (executor-side stripe transform).  blk->lock held.  Evaluates the
+ * mem.corrupt site once per sealed page (scope = the page's VA) — a
+ * hit flips one bit in the freshly sealed copy, which is exactly what
+ * a cold-storage bit flip looks like to every consumer. */
+void uvmShieldSealPage(UvmVaBlock *blk, uint32_t page, UvmTier tier,
+                       uint32_t crc)
+{
+    if (!uvmShieldActive())
+        return;
+    UvmShieldPage *m = shield_meta(blk);
+    if (!m)
+        return;
+    m += page;
+    if (m->state == SHIELD_POISONED)
+        return;                     /* poison is sticky */
+    if (m->pending) {
+        /* A pending flip survived to a reseal: some overwrite path
+         * skipped its unseal-verify hook — a coverage hole, surfaced
+         * rather than silently re-zeroed. */
+        tpuCounterAdd("shield_inject_misses", m->pending);
+        m->pending = 0;
+    }
+    m->crc = crc;
+    m->gen++;
+    m->state = (uint8_t)(1 + tier);
+    tpuCounterAdd("tpurm_shield_seals", 1);
+
+    uint64_t ps = uvmPageSize();
+    uint64_t va = blk->start + (uint64_t)page * ps;
+    if (tpurmInjectShouldFailScoped(TPU_INJECT_SITE_MEM_CORRUPT, va)) {
+        uint8_t *ptr = uvmBlockPagePtr(blk, tier, page);
+        if (ptr) {
+            /* One deterministic bit, mid-page: CRC32C detects every
+             * single-bit error, so the verify side is exact. */
+            ptr[ps / 2] ^= 0x20;
+            if (m->pending < 0xFF)
+                m->pending++;
+            tpuCounterAdd("shield_inject_corrupts", 1);
+        }
+    }
+    shield_scrub_start();
+}
+
+/* Drop the seal of every matching page in [first, first+count)
+ * (tier < 0 matches any sealed tier).  Called wherever a sealed copy
+ * is about to be overwritten or its residency dropped — the LAST
+ * verify hook a pending injected flip can be caught by, which is what
+ * keeps hits == detected + misses exact.  blk->lock held. */
+void uvmShieldUnsealRange(UvmVaBlock *blk, uint32_t first, uint32_t count,
+                          int tier)
+{
+    if (!blk->shield)
+        return;
+    uint64_t ps = uvmPageSize();
+    for (uint32_t p = first; p < first + count && p < blk->npages; p++) {
+        UvmShieldPage *m = &blk->shield[p];
+        if (!meta_sealed(m))
+            continue;
+        if (tier >= 0 && meta_tier(m) != (UvmTier)tier)
+            continue;
+        if (m->pending) {
+            uint8_t *ptr = uvmBlockPagePtr(blk, meta_tier(m), p);
+            if (ptr && tpurmShieldCrc32c(ptr, ps) != m->crc) {
+                tpuCounterAdd("tpurm_shield_mismatches", 1);
+                tpuCounterAdd("shield_detected", m->pending);
+            } else {
+                tpuCounterAdd("shield_inject_misses", m->pending);
+            }
+            m->pending = 0;
+        }
+        m->state = 0;
+    }
+}
+
+/* --------------------------------------------------------- poisoning */
+
+static void shield_poison_page(UvmVaBlock *blk, uint32_t page,
+                               UvmTier tier)
+{
+    UvmShieldPage *m = &blk->shield[page];
+    uint64_t ps = uvmPageSize();
+    uint64_t va = blk->start + (uint64_t)page * ps;
+
+    m->state = SHIELD_POISONED;
+    m->pending = 0;
+    tpuCounterAdd("tpurm_shield_pages_poisoned", 1);
+
+    /* Retire the backing page: arena-backed pages enter the quarantine
+     * list (their PMM chunk is never freed, so the physical span can
+     * never be handed to another tenant); host pages retire onto the
+     * poison mapping below.  Either way the gauge moves. */
+    if (tier != UVM_TIER_HOST) {
+        uint64_t off;
+        if (uvmBlockTierOffset(blk, tier, page, &off))
+            retire_add(tier, tier == UVM_TIER_HBM ? blk->hbmDevInst : 0,
+                       off, ps);
+        else
+            retire_add(tier, blk->hbmDevInst, 0, 0);
+    } else {
+        atomic_fetch_add(&g_retire.total, 1);
+        if (blk->hbmDevInst < SHIELD_MAX_DEVS)
+            atomic_fetch_add(&g_retire.perDev[blk->hbmDevInst], 1);
+    }
+    /* Aggregate + per-device [dN] line (renders as a dev label). */
+    tpuCounterAddScoped("tpurm_shield_pages_retired", blk->hbmDevInst, 1);
+
+    /* Containment: the page leaves the residency state machine (no
+     * tier holds a trusted copy), its device PTEs are revoked, and the
+     * user VA detaches onto an anonymous poison mapping exactly like
+     * the fatal-fault cancel path — the process survives; only the
+     * owning sequence sees TPU_ERR_PAGE_POISONED.  Never a device
+     * reset. */
+    for (int t = 0; t < UVM_TIER_COUNT; t++)
+        uvmPageMaskClear(&blk->resident[t], page);
+    uvmPageMaskClear(&blk->cpuMapped, page);
+    uvmPageMaskClear(&blk->devMapped, page);
+    uvmBlockPteRevoke(blk, page, 1);
+    uvmPageMaskSet(&blk->cancelled, page);
+    blk->hasCancelled = true;
+    void *pm = mmap((void *)(uintptr_t)va, ps, PROT_READ | PROT_WRITE,
+                    MAP_FIXED | MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    (void)pm;
+
+    tpurmHealthNote(blk->hbmDevInst, TPU_HEALTH_EV_PAGE_QUARANTINE);
+    tpurmTraceInstantLabel(TPU_TRACE_SHIELD_VERIFY, va, ps,
+                           "shield.poison");
+    tpuLog(TPU_LOG_ERROR, "shield",
+           "page 0x%llx POISONED (tier %u seal mismatch, no recovery "
+           "source) — backing retired, owning sequence gets %s",
+           (unsigned long long)va, tier,
+           tpuStatusToString(TPU_ERR_PAGE_POISONED));
+}
+
+/* Verify one sealed page, running the re-fetch ladder on mismatch.
+ * blk->lock held.  Returns 0 clean, 1 mismatch-recovered (refetch
+ * save), 2 poisoned. */
+static int shield_verify_page(UvmVaBlock *blk, uint32_t page)
+{
+    UvmShieldPage *m = &blk->shield[page];
+    if (!meta_sealed(m))
+        return m->state == SHIELD_POISONED ? 2 : 0;
+    UvmTier tier = meta_tier(m);
+    uint64_t ps = uvmPageSize();
+
+    if (!uvmPageMaskTest(&blk->resident[tier], page)) {
+        /* Orphaned seal: residency dropped without the unseal hook —
+         * defensive (the hooks should cover every clear path). */
+        if (m->pending)
+            tpuCounterAdd("shield_inject_misses", m->pending);
+        m->pending = 0;
+        m->state = 0;
+        return 0;
+    }
+    uint8_t *ptr = uvmBlockPagePtr(blk, tier, page);
+    if (!ptr) {
+        if (m->pending)
+            tpuCounterAdd("shield_inject_misses", m->pending);
+        m->pending = 0;
+        m->state = 0;
+        return 0;
+    }
+    tpuCounterAdd("tpurm_shield_verifies", 1);
+    if (tpurmShieldCrc32c(ptr, ps) == m->crc) {
+        if (m->pending) {
+            /* Flip recorded but CRC matches — cannot happen for a real
+             * single-bit flip; surface rather than hide. */
+            tpuCounterAdd("shield_inject_misses", m->pending);
+            m->pending = 0;
+        }
+        return 0;
+    }
+
+    /* Mismatch: the cold copy does not match its seal. */
+    tpuCounterAdd("tpurm_shield_mismatches", 1);
+    if (m->pending) {
+        tpuCounterAdd("shield_detected", m->pending);
+        m->pending = 0;
+    }
+    uint64_t va = blk->start + (uint64_t)page * ps;
+    tpurmTraceInstantLabel(TPU_TRACE_SHIELD_VERIFY, va, ps,
+                           "shield.mismatch");
+
+    /* Ladder rung 1 — retry from the sealing source: recompute once
+     * (a transiently torn read, not rotted storage, passes here). */
+    if (tpurmShieldCrc32c(ptr, ps) == m->crc) {
+        tpuCounterAdd("tpurm_shield_refetch_saves", 1);
+        return 1;
+    }
+
+    /* Ladder rung 2 — re-fetch from a read-duplicated sibling copy. */
+    for (int t = 0; t < UVM_TIER_COUNT; t++) {
+        if (t == (int)tier ||
+            !uvmPageMaskTest(&blk->resident[t], page))
+            continue;
+        uint8_t *src = uvmBlockPagePtr(blk, (UvmTier)t, page);
+        if (!src)
+            continue;
+        if (t == UVM_TIER_HBM &&
+            tpuHbmCoherentForRead(src, ps) != TPU_OK)
+            continue;
+        memcpy(ptr, src, ps);
+        if (tier == UVM_TIER_HBM)
+            tpuHbmMirrorNotify(ptr, ps);
+        m->crc = tpurmShieldCrc32c(ptr, ps);
+        m->gen++;
+        tpuCounterAdd("tpurm_shield_seals", 1);        /* reseal */
+        tpuCounterAdd("tpurm_shield_refetch_saves", 1);
+        tpuLog(TPU_LOG_WARN, "shield",
+               "page 0x%llx: tier %u seal mismatch re-fetched from "
+               "tier %d sibling", (unsigned long long)va, tier, t);
+        return 1;
+    }
+
+    /* Ladder rung 3 — no recovery source: poison + retire. */
+    shield_poison_page(blk, page, tier);
+    return 2;
+}
+
+/* Resolve an OVERLAPPED verify-on-promote: `crc` is the CRC32C of the
+ * bytes the copy actually delivered, computed by the tpuce executor
+ * threads riding the copy — the promote-side twin of the seal's
+ * stripe-transform stage, so the sealed fast path pays no separate
+ * serialized source read.  A match proves the whole chain seal ->
+ * source -> copied bytes end-to-end (it even covers corruption in
+ * flight, which a pre-copy source verify cannot see).  On mismatch,
+ * fall back to the authoritative source-side verify:
+ * shield_verify_page re-reads the sealing source and runs the full
+ * re-fetch ladder (transient re-read, sibling re-fetch, poison).
+ * *recopy is set when the source is now proven or recovered and the
+ * caller must copy the page again before anything commits.
+ * blk->lock held. */
+TpuStatus uvmShieldVerifyCopied(UvmVaBlock *blk, uint32_t page,
+                                uint32_t crc, bool *recopy)
+{
+    *recopy = false;
+    if (!blk->shield)
+        return TPU_OK;
+    UvmShieldPage *m = &blk->shield[page];
+    if (m->state == SHIELD_POISONED)
+        return TPU_ERR_PAGE_POISONED;
+    if (!meta_sealed(m))
+        return TPU_OK;
+    tpuCounterAdd("tpurm_shield_verifies", 1);
+    if (crc == m->crc) {
+        if (m->pending) {
+            /* A recorded flip whose copied bytes still match the seal
+             * cannot happen for a real single-bit flip; surface the
+             * coverage hole rather than hide it. */
+            tpuCounterAdd("shield_inject_misses", m->pending);
+            m->pending = 0;
+        }
+        return TPU_OK;
+    }
+    int rc = shield_verify_page(blk, page);
+    if (rc == 2)
+        return TPU_ERR_PAGE_POISONED;
+    *recopy = true;
+    return TPU_OK;
+}
+
+TpuStatus uvmShieldVerifyRange(UvmVaBlock *blk, uint32_t first,
+                               uint32_t count)
+{
+    if (!blk->shield)
+        return TPU_OK;
+    uint64_t tSpan = tpurmTraceBegin();
+    TpuStatus st = TPU_OK;
+    uint64_t bytes = 0;
+    for (uint32_t p = first; p < first + count && p < blk->npages; p++) {
+        if (blk->shield[p].state == SHIELD_POISONED) {
+            st = TPU_ERR_PAGE_POISONED;
+            continue;
+        }
+        if (!meta_sealed(&blk->shield[p]))
+            continue;
+        bytes += uvmPageSize();
+        if (shield_verify_page(blk, p) == 2)
+            st = TPU_ERR_PAGE_POISONED;
+    }
+    if (tSpan && bytes)
+        tpurmTraceEnd(TPU_TRACE_SHIELD_VERIFY, tSpan,
+                      blk->start + (uint64_t)first * uvmPageSize(), bytes);
+    return st;
+}
+
+/* --------------------------------------------------------------- wire */
+
+bool tpurmShieldInjectWire(void *buf, uint64_t len, uint64_t scope)
+{
+    if (!tpurmShieldEnabled() || !buf || !len)
+        return false;
+    if (!tpurmInjectShouldFailScoped(TPU_INJECT_SITE_MEM_CORRUPT, scope))
+        return false;
+    ((uint8_t *)buf)[len / 2] ^= 0x20;
+    atomic_fetch_add(&g_wirePending, 1);
+    tpuCounterAdd("shield_inject_corrupts", 1);
+    return true;
+}
+
+TpuStatus tpurmShieldVerifyWire(const void *buf, uint64_t len,
+                                uint32_t expectCrc, uint64_t scope)
+{
+    if (!buf || !len)
+        return TPU_ERR_INVALID_ARGUMENT;
+    tpuCounterAdd("tpurm_shield_verifies", 1);
+    tpuCounterAdd("shield_wire_verifies", 1);
+    if (tpurmShieldCrc32c(buf, len) == expectCrc)
+        return TPU_OK;
+    tpuCounterAdd("tpurm_shield_mismatches", 1);
+    tpuCounterAdd("shield_wire_mismatches", 1);
+    /* Resolve the inject bookkeeping: an outstanding wire flip this
+     * verify caught converts to a detection. */
+    uint64_t pend = atomic_load(&g_wirePending);
+    while (pend > 0 &&
+           !atomic_compare_exchange_weak(&g_wirePending, &pend, pend - 1))
+        ;
+    if (pend > 0)
+        tpuCounterAdd("shield_detected", 1);
+    tpurmTraceInstantLabel(TPU_TRACE_SHIELD_VERIFY, scope, len,
+                           "shield.wire_mismatch");
+    return TPU_ERR_INVALID_STATE;
+}
+
+/* ------------------------------------------------------ span poisoned */
+
+uint32_t tpurmShieldSpanPoisoned(uint64_t addr, uint64_t len)
+{
+    UvmVaSpace *vs = uvmFaultSpaceForAddr(addr);
+    if (!vs || !len)
+        return 0;
+    uint64_t ps = uvmPageSize();
+    uint32_t n = 0;
+    pthread_mutex_lock(&vs->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "shield-span");
+    uint64_t a = addr & ~(UVM_BLOCK_SIZE - 1);
+    for (; a < addr + len; a += UVM_BLOCK_SIZE) {
+        UvmVaBlock *blk = NULL;
+        if (!uvmRangeFind(vs, a, &blk) || !blk || !blk->shield)
+            continue;
+        uint64_t lo = addr > blk->start ? addr : blk->start;
+        uint64_t blkEnd = blk->start + (uint64_t)blk->npages * ps;
+        uint64_t hi = addr + len < blkEnd ? addr + len : blkEnd;
+        pthread_mutex_lock(&blk->lock);
+        tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "shield-span");
+        for (uint64_t v = lo & ~(ps - 1); v < hi; v += ps) {
+            uint32_t page = (uint32_t)((v - blk->start) / ps);
+            if (blk->shield[page].state == SHIELD_POISONED)
+                n++;
+        }
+        tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "shield-span");
+        pthread_mutex_unlock(&blk->lock);
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_VASPACE, "shield-span");
+    pthread_mutex_unlock(&vs->lock);
+    return n;
+}
+
+/* ------------------------------------------------------------ scrubber */
+
+/* One bounded pass: walk sealed cold pages (round-robin cursor across
+ * passes) and verify up to `budget` of them, catching corruption
+ * BEFORE a demand fault does.  Block locks are TRYLOCKED — the
+ * scrubber never contends with the fault path, which is half of how
+ * the fault p50 budget holds (the other half is the bounded budget). */
+typedef struct {
+    uint32_t budget;
+    uint32_t scrubbed, hits;
+    uint64_t cursor;                /* resume after this block VA */
+    uint64_t nextCursor;
+    bool resumed;                   /* passed the cursor yet */
+} ScrubCtx;
+
+static _Atomic uint64_t g_scrubCursor;
+
+static void scrub_visit(UvmVaSpace *vs, UvmVaBlock *blk, void *ctxp)
+{
+    (void)vs;
+    ScrubCtx *ctx = ctxp;
+    if (ctx->budget == 0 || !blk->shield)
+        return;
+    uint64_t ps = uvmPageSize();
+    uint64_t blkEnd = blk->start + (uint64_t)blk->npages * ps;
+    uint32_t startPage = 0;
+    if (!ctx->resumed) {
+        /* PAGE-granular resume (the cursor is the next VA to scan):
+         * blocks wholly below it are done this wrap; the cursor's own
+         * block resumes at the cursor page.  A block holding more
+         * sealed pages than one tick's budget would otherwise restart
+         * at page 0 every visit and its tail would NEVER scrub. */
+        if (ctx->cursor && blkEnd <= ctx->cursor)
+            return;
+        if (ctx->cursor && blk->start < ctx->cursor)
+            startPage = (uint32_t)((ctx->cursor - blk->start) / ps);
+        ctx->resumed = true;
+    }
+    if (pthread_mutex_trylock(&blk->lock) != 0)
+        return;
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "shield-scrub");
+    uint32_t p = startPage;
+    for (; p < blk->npages && ctx->budget; p++) {
+        if (!meta_sealed(&blk->shield[p]))
+            continue;
+        ctx->budget--;
+        ctx->scrubbed++;
+        if (shield_verify_page(blk, p) != 0)
+            ctx->hits++;
+    }
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "shield-scrub");
+    pthread_mutex_unlock(&blk->lock);
+    /* Resume point: the first page NOT scanned — mid-block when the
+     * budget ran out, the block end otherwise. */
+    ctx->nextCursor = p < blk->npages ? blk->start + (uint64_t)p * ps
+                                      : blkEnd;
+}
+
+static uint32_t scrub_pass(uint32_t budget)
+{
+    ScrubCtx ctx = { .budget = budget, .scrubbed = 0, .hits = 0,
+                     .cursor = atomic_load(&g_scrubCursor),
+                     .nextCursor = 0, .resumed = false };
+    uint64_t tSpan = tpurmTraceBegin();
+    uvmFaultForEachSpaceCtx(scrub_visit, &ctx);
+    if (ctx.budget > 0 && ctx.cursor) {
+        /* Budget left after the cursor: wrap to the start this pass so
+         * a single hot block at the end cannot starve the rest. */
+        ctx.cursor = 0;
+        ctx.resumed = false;
+        uint32_t before = ctx.scrubbed;
+        uvmFaultForEachSpaceCtx(scrub_visit, &ctx);
+        if (ctx.scrubbed == before)
+            ctx.nextCursor = 0;
+    }
+    atomic_store(&g_scrubCursor, ctx.budget > 0 ? 0 : ctx.nextCursor);
+    tpuCounterAdd("tpurm_scrub_ticks", 1);
+    if (ctx.scrubbed)
+        tpuCounterAdd("tpurm_scrub_pages", ctx.scrubbed);
+    if (ctx.hits)
+        tpuCounterAdd("tpurm_scrub_hits", ctx.hits);
+    if (tSpan && ctx.scrubbed)
+        tpurmTraceEnd(TPU_TRACE_SHIELD_SCRUB, tSpan, ctx.hits,
+                      (uint64_t)ctx.scrubbed * uvmPageSize());
+    return ctx.scrubbed;
+}
+
+uint32_t tpurmShieldScrubNow(uint32_t maxPages)
+{
+    return scrub_pass(maxPages ? maxPages : 1);
+}
+
+static void *shield_scrub_thread(void *arg)
+{
+    (void)arg;
+    static TpuRegCache c_ms, c_pages;
+    for (;;) {
+        uint64_t ms = tpuRegCacheGet(&c_ms, "shield_scrub_ms", 50);
+        /* 0 disables scrubbing (README knob contract) — keep polling
+         * at the default cadence so a runtime re-enable via
+         * tpuRegistrySet takes effect without a new thread. */
+        bool off = ms == 0;
+        if (off)
+            ms = 50;
+        struct timespec ts = { .tv_sec = (time_t)(ms / 1000),
+                               .tv_nsec = (long)(ms % 1000) * 1000000L };
+        nanosleep(&ts, NULL);
+        if (off || !tpurmShieldEnabled())
+            continue;
+        uint32_t budget = (uint32_t)tpuRegCacheGet(&c_pages,
+                                                   "shield_scrub_pages",
+                                                   32);
+        if (budget)
+            scrub_pass(budget);
+    }
+    return NULL;
+}
+
+static pthread_once_t g_scrubOnce = PTHREAD_ONCE_INIT;
+
+static void scrub_start_once(void)
+{
+    pthread_t t;
+    if (pthread_create(&t, NULL, shield_scrub_thread, NULL) == 0) {
+        pthread_detach(t);
+        tpuLog(TPU_LOG_INFO, "shield",
+               "background scrubber ready (shield_scrub_ms cadence, "
+               "shield_scrub_pages pages/tick)");
+    }
+}
+
+static void shield_scrub_start(void)
+{
+    pthread_once(&g_scrubOnce, scrub_start_once);
+}
+
+/* ---------------------------------------------------------- stats/obs */
+
+void tpurmShieldStatsGet(TpuShieldStats *out)
+{
+    if (!out)
+        return;
+    out->seals = tpurmCounterGet("tpurm_shield_seals");
+    out->verifies = tpurmCounterGet("tpurm_shield_verifies");
+    out->mismatches = tpurmCounterGet("tpurm_shield_mismatches");
+    out->refetchSaves = tpurmCounterGet("tpurm_shield_refetch_saves");
+    out->pagesPoisoned = tpurmCounterGet("tpurm_shield_pages_poisoned");
+    out->pagesRetired = tpurmCounterGet("tpurm_shield_pages_retired");
+    out->scrubTicks = tpurmCounterGet("tpurm_scrub_ticks");
+    out->scrubPages = tpurmCounterGet("tpurm_scrub_pages");
+    out->scrubHits = tpurmCounterGet("tpurm_scrub_hits");
+    out->injectCorrupts = tpurmCounterGet("shield_inject_corrupts");
+    out->injectDetected = tpurmCounterGet("shield_detected");
+    /* In-flight wire flips read as misses only once traffic drains —
+     * the soaks reconcile at quiescence. */
+    out->injectMisses = tpurmCounterGet("shield_inject_misses") +
+                        atomic_load(&g_wirePending);
+    out->wireVerifies = tpurmCounterGet("shield_wire_verifies");
+    out->wireMismatches = tpurmCounterGet("shield_wire_mismatches");
+}
+
+void tpurmShieldStatsReset(void)
+{
+    /* Counters are monotonic (tests snapshot deltas); only the
+     * in-flight wire bookkeeping resets. */
+    atomic_store(&g_wirePending, 0);
+}
+
+void tpurmShieldRenderProm(TpuCur *c)
+{
+    tpuCurf(c, "# TYPE tpurm_pages_retired gauge\n");
+    uint32_t n = tpurmDeviceCount();
+    if (n > SHIELD_MAX_DEVS)
+        n = SHIELD_MAX_DEVS;
+    for (uint32_t d = 0; d < n; d++)
+        tpuCurf(c, "tpurm_pages_retired{dev=\"%u\"} %llu\n", d,
+                (unsigned long long)atomic_load(&g_retire.perDev[d]));
+}
+
+void tpurmShieldRenderTable(TpuCur *c)
+{
+    TpuShieldStats st;
+    tpurmShieldStatsGet(&st);
+    tpuCurf(c, "enabled:            %u\n", tpurmShieldEnabled());
+    tpuCurf(c, "scrub_ms:           %llu\n",
+            (unsigned long long)tpuRegistryGet("shield_scrub_ms", 50));
+    tpuCurf(c, "scrub_pages:        %llu\n",
+            (unsigned long long)tpuRegistryGet("shield_scrub_pages", 32));
+    tpuCurf(c, "seals:              %llu\n", (unsigned long long)st.seals);
+    tpuCurf(c, "verifies:           %llu\n",
+            (unsigned long long)st.verifies);
+    tpuCurf(c, "mismatches:         %llu\n",
+            (unsigned long long)st.mismatches);
+    tpuCurf(c, "refetch_saves:      %llu\n",
+            (unsigned long long)st.refetchSaves);
+    tpuCurf(c, "pages_poisoned:     %llu\n",
+            (unsigned long long)st.pagesPoisoned);
+    tpuCurf(c, "pages_retired:      %llu\n",
+            (unsigned long long)st.pagesRetired);
+    tpuCurf(c, "scrub_ticks:        %llu\n",
+            (unsigned long long)st.scrubTicks);
+    tpuCurf(c, "scrub_pages_done:   %llu\n",
+            (unsigned long long)st.scrubPages);
+    tpuCurf(c, "scrub_hits:         %llu\n",
+            (unsigned long long)st.scrubHits);
+    tpuCurf(c, "wire_verifies:      %llu\n",
+            (unsigned long long)st.wireVerifies);
+    tpuCurf(c, "wire_mismatches:    %llu\n",
+            (unsigned long long)st.wireMismatches);
+    tpuCurf(c, "inject_corrupts:    %llu\n",
+            (unsigned long long)st.injectCorrupts);
+    tpuCurf(c, "inject_detected:    %llu\n",
+            (unsigned long long)st.injectDetected);
+    tpuCurf(c, "inject_misses:      %llu\n",
+            (unsigned long long)st.injectMisses);
+    uint32_t nret = atomic_load_explicit(&g_retire.n,
+                                         memory_order_acquire);
+    tpuCurf(c, "retired spans (%u):\n", nret);
+    for (uint32_t i = 0; i < nret && i < 32; i++)
+        tpuCurf(c, "  tier=%u dev=%u off=0x%llx bytes=%llu\n",
+                g_retire.s[i].tier, g_retire.s[i].dev,
+                (unsigned long long)g_retire.s[i].off,
+                (unsigned long long)g_retire.s[i].bytes);
+}

@@ -107,6 +107,8 @@ static const struct { const char *name, *cat; } g_sites[TPU_TRACE_SITE_COUNT] = 
     { "reset.device",           "reset"   },
     { "reset.quiesce",          "reset"   },
     { "vac.migrate",            "vac"     },
+    { "shield.verify",          "shield"  },
+    { "shield.scrub",           "shield"  },
     { "app.span",               "app"     },
     { "inject.hit",             "inject"  },
     { "recover.retry",          "recover" },

@@ -301,11 +301,16 @@ void uvmFaultSnapshotRebuild(void)
     qsort(ns->entries, i, sizeof(SnapEntry), snap_cmp);
 
     Snapshot *old = atomic_exchange(&g_fault.snap, ns);
-    /* Grace period: wait for in-flight handler lookups to drain. */
+    pthread_mutex_unlock(&g_fault.spacesLock);
+    /* Grace period: wait for in-flight handler lookups to drain — with
+     * spacesLock DROPPED.  A reader is held across the whole fault
+     * (park included), and fault service can itself want spacesLock
+     * (access-counter sweep, device-wrote invalidation, shield scrub
+     * walk); spinning here with the lock held deadlocks rebuild ->
+     * parked faulter -> blocked service thread in a 3-way cycle. */
     while (atomic_load(&g_fault.snapReaders) != 0)
         sched_yield();
     free(old);
-    pthread_mutex_unlock(&g_fault.spacesLock);
 }
 
 /* Address -> owning VA space (registered spaces walk; NULL when no
@@ -465,6 +470,17 @@ static void service_promote(UvmVaSpace *vs, UvmVaBlock *blk,
  * uvmBlockMakeResidentEx — so fault service no longer serializes
  * against every migrate/alloc in the space (reference: per-block
  * service locking, service_fault_batch_block_locked :1375). */
+/* Read-duplication probe for the CPU seal-reopen path: resident on any
+ * tier besides HOST.  blk->lock held. */
+static bool page_read_dup(UvmVaBlock *blk, uint32_t page)
+{
+    for (int t = 0; t < UVM_TIER_COUNT; t++)
+        if (t != (int)UVM_TIER_HOST &&
+            uvmPageMaskTest(&blk->resident[t], page))
+            return true;
+    return false;
+}
+
 static TpuStatus service_one(UvmFaultEntry *e)
 {
     UvmVaSpace *vs = e->vs;
@@ -659,6 +675,90 @@ static TpuStatus service_one(UvmFaultEntry *e)
                 atomic_fetch_sub_explicit(&blk->serviceRefs, 1,
                                           memory_order_acq_rel);
                 st = TPU_ERR_PAGE_QUARANTINED;
+                break;
+            }
+        }
+
+        /* tpushield: a span with sealed or poisoned pages crossing
+         * back into service.  Poisoned pages fail the access with the
+         * DISTINCT poison status — ANY poisoned page in a device span
+         * fails the whole access (a partially-serviced span would
+         * silently read the poison mapping's zeros); sealed pages
+         * VERIFY (re-fetch ladder on mismatch) before anything trusts
+         * the bytes.  CPU touches of a verified HOST-sealed page come
+         * back hot: unseal + the RW mapping the eviction deferred.
+         * Gate is one pointer load — unsealed traffic pays nothing. */
+        if (blk->shield) {
+            pthread_mutex_lock(&blk->lock);
+            tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "shield-verify");
+            TpuStatus vst = TPU_OK;
+            if (uvmShieldRangePoisoned(blk, firstPage, count) ||
+                uvmShieldRangeSealed(blk, firstPage, count))
+                /* ALWAYS the full range verify — it walks past
+                 * already-poisoned pages and still runs the ladder on
+                 * every other sealed page of the span.  Short-
+                 * circuiting on existing poison would let the CPU
+                 * precision override below unseal + open RW sealed
+                 * pages that were never verified (corrupt sealed
+                 * bytes served as trusted data). */
+                vst = uvmShieldVerifyRange(blk, firstPage, count);
+            /* CPU containment precision: a poisoned page is already
+             * parked behind its own zero mapping (cancelled mask set),
+             * so a CPU access whose FAULTING page is healthy can still
+             * be serviced — needed-mask construction skips cancelled
+             * pages, and the reader sees zeros exactly on the poisoned
+             * page.  Failing the whole span here would quarantine the
+             * innocent faulting page too (data-loss amplification).
+             * Device spans keep any-poison-fails: a partially-serviced
+             * device access would silently read the zeros. */
+            if (vst == TPU_ERR_PAGE_POISONED &&
+                e->source == UVM_FAULT_SRC_CPU &&
+                !(e->addr >= blk->start &&
+                  uvmShieldRangePoisoned(
+                      blk, (uint32_t)((e->addr - blk->start) / ps), 1)))
+                vst = TPU_OK;
+            if (vst == TPU_OK && e->source == UVM_FAULT_SRC_CPU) {
+                uint32_t q = firstPage;
+                while (q < firstPage + count) {
+                    if (uvmShieldPageSealedTier(blk, q) !=
+                            (int)UVM_TIER_HOST ||
+                        !uvmPageMaskTest(&blk->resident[UVM_TIER_HOST],
+                                         q)) {
+                        q++;
+                        continue;
+                    }
+                    /* Read-duplicated pages reopen READ-ONLY (the
+                     * make-resident convention: a CPU write must
+                     * fault so the device duplicates invalidate —
+                     * reopening RW here would let stores land without
+                     * a fault and silently diverge the copies). */
+                    bool dup = page_read_dup(blk, q);
+                    uint32_t span = 1;
+                    while (q + span < firstPage + count &&
+                           uvmShieldPageSealedTier(blk, q + span) ==
+                               (int)UVM_TIER_HOST &&
+                           uvmPageMaskTest(&blk->resident[UVM_TIER_HOST],
+                                           q + span) &&
+                           page_read_dup(blk, q + span) == dup)
+                        span++;
+                    uvmShieldUnsealRange(blk, q, span,
+                                         (int)UVM_TIER_HOST);
+                    if (dup) {
+                        uvmBlockSetCpuAccess(blk, q, span, PROT_READ);
+                    } else {
+                        uvmBlockSetCpuAccess(blk, q, span,
+                                             PROT_READ | PROT_WRITE);
+                        uvmPageMaskSetRange(&blk->cpuMapped, q, span);
+                    }
+                    q += span;
+                }
+            }
+            tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "shield-verify");
+            pthread_mutex_unlock(&blk->lock);
+            if (vst != TPU_OK) {
+                atomic_fetch_sub_explicit(&blk->serviceRefs, 1,
+                                          memory_order_acq_rel);
+                st = vst;
                 break;
             }
         }
@@ -1043,9 +1143,14 @@ static void access_counter_sweep(FaultWorker *w)
                         1000000ull;
     if (now - w->lastSweepNs < interval)
         return;
-    w->lastSweepNs = now;
 
-    pthread_mutex_lock(&g_fault.spacesLock);
+    /* TRYLOCK: the sweep runs on the fault-service thread, and a fault
+     * may land the instant the idle wait times out.  Blocking here
+     * behind a snapshot rebuild (or any spaces walk) stalls fault
+     * service; skip and retry next idle tick instead. */
+    if (pthread_mutex_trylock(&g_fault.spacesLock) != 0)
+        return;
+    w->lastSweepNs = now;
     for (UvmVaSpace *vs = g_fault.spacesHead; vs; vs = vs->nextSpace) {
         pthread_mutex_lock(&vs->lock);
         tpuLockTrackAcquire(TPU_LOCK_UVM_VASPACE, "ac-sweep");

@@ -332,6 +332,14 @@ typedef struct UvmVaBlock {
      * onto a poison mapping; excluded from residency/migration. */
     UvmPageMask cancelled;
     bool hasCancelled;
+    /* tpushield per-page integrity metadata (native/src/shield.c),
+     * stored beside the residency masks: CRC32C seal + generation +
+     * poison state of the page's COLD copy.  NULL until the first
+     * seal — the fault path's shield gate is this one pointer load.
+     * The POINTER is atomic (lazy publish under blk->lock races the
+     * scrubber's lock-free pre-check; a plain x86 mov either way);
+     * the metadata it points to is mutated under blk->lock only. */
+    struct UvmShieldPage *_Atomic shield;
     /* True once uvmBlockPtePopulate wrote any device PTE for this block;
      * lets uvmBlockPteRevoke skip the per-device table walks on blocks
      * no device ever mapped (the CPU-fault-only hot path).  Cleared only
@@ -729,6 +737,65 @@ bool uvmHotEnabled(void);
 
 void tpurmHotRenderProm(TpuCur *c);
 void tpurmHotRenderTable(TpuCur *c);
+
+/* -------------------------------------------------------------- tpushield
+ *
+ * Page-integrity engine (native/src/shield.c; tpurm/shield.h for the
+ * subsystem contract).  Everything here is engine-internal: the
+ * per-page seal metadata and the hooks the block/fault paths call.
+ * All page-granular entry points expect blk->lock HELD. */
+
+typedef struct UvmShieldPage {
+    uint32_t crc;               /* CRC32C of the sealed copy           */
+    uint16_t gen;               /* seal generation (reseals bump it)   */
+    uint8_t state;              /* 0 unsealed; 1+tier sealed; 0xFF
+                                 * poisoned (sticky)                   */
+    uint8_t pending;            /* injected flips awaiting detection   */
+} UvmShieldPage;
+
+bool uvmShieldActive(void);     /* registry shield_enable */
+/* Seal `page`'s copy in `tier` with the CRC the copy path computed
+ * (tpuce executor stripe transform); evaluates mem.corrupt once. */
+void uvmShieldSealPage(UvmVaBlock *blk, uint32_t page, UvmTier tier,
+                       uint32_t crc);
+/* Drop seals in [first,first+count) (tier < 0: any) — the last verify
+ * hook before a sealed copy is overwritten or dropped. */
+void uvmShieldUnsealRange(UvmVaBlock *blk, uint32_t first, uint32_t count,
+                          int tier);
+/* Verify every sealed page of the span, running the re-fetch ladder
+ * on mismatch (recompute -> sibling copy -> poison+retire).  TPU_OK or
+ * TPU_ERR_PAGE_POISONED when any page of the span is/became poisoned. */
+TpuStatus uvmShieldVerifyRange(UvmVaBlock *blk, uint32_t first,
+                               uint32_t count);
+/* Overlapped verify-on-promote: compare the copied bytes' CRC (tpuce
+ * stripe-transform stage, computed during the copy) against the seal;
+ * mismatch falls back to the source-side ladder.  *recopy set when the
+ * caller must redo the page's copy from the now-proven source. */
+TpuStatus uvmShieldVerifyCopied(UvmVaBlock *blk, uint32_t page,
+                                uint32_t crc, bool *recopy);
+bool uvmShieldRangeSealed(UvmVaBlock *blk, uint32_t first, uint32_t count);
+bool uvmShieldRangePoisoned(UvmVaBlock *blk, uint32_t first,
+                            uint32_t count);
+bool uvmShieldPagePoisoned(UvmVaBlock *blk, uint32_t page);
+/* Sealed tier of `page` (-1 when unsealed/poisoned).  blk->lock held. */
+int uvmShieldPageSealedTier(UvmVaBlock *blk, uint32_t page);
+void uvmShieldBlockFree(UvmVaBlock *blk);
+/* Retirement gates for the PMM paths: RunRetired true => the chunk
+ * must NOT return to the freelist (the leak IS the retirement);
+ * CheckAlloc counts shield_retired_realloc if a fresh chunk overlaps a
+ * retired span (invariant detector, must stay 0). */
+bool uvmShieldRunRetired(UvmTierArena *arena, uint64_t chunkOff,
+                         uint64_t bytes);
+void uvmShieldCheckAlloc(UvmTierArena *arena, uint64_t off,
+                         uint64_t bytes);
+
+/* Host-addressable pointer for `page`'s copy in `tier` (NULL when the
+ * tier holds no backing for it); arena byte offset of an aperture
+ * page.  blk->lock held.  (uvm_va_block.c internals, exported for the
+ * shield engine.) */
+void *uvmBlockPagePtr(UvmVaBlock *blk, UvmTier tier, uint32_t page);
+bool uvmBlockTierOffset(UvmVaBlock *blk, UvmTier tier, uint32_t page,
+                        uint64_t *outOffset);
 
 /* Access counters (uvm_gpu_access_counters.c:81 analog).  Record returns
  * true when the block crossed the hotness threshold and should be

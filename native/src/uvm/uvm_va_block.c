@@ -23,6 +23,7 @@
 #define _GNU_SOURCE
 #include "uvm_internal.h"
 #include "tpurm/ce.h"
+#include "tpurm/shield.h"
 #include "tpurm/trace.h"
 #include "tpurm/inject.h"
 
@@ -77,6 +78,23 @@ bool uvmBlockHbmArenaOffset(UvmVaBlock *blk, uint32_t page,
     return true;
 }
 
+/* tpushield exports (shield.c runs the CRC ladder over these). */
+void *uvmBlockPagePtr(UvmVaBlock *blk, UvmTier tier, uint32_t page)
+{
+    return tier_page_ptr(blk, tier, page);
+}
+
+bool uvmBlockTierOffset(UvmVaBlock *blk, UvmTier tier, uint32_t page,
+                        uint64_t *outOffset)
+{
+    UvmChunkRun *r = run_find(blk, tier, page);
+    if (!r)
+        return false;
+    *outOffset = r->chunk->offset +
+                 (uint64_t)(page - r->firstPage) * uvmPageSize();
+    return true;
+}
+
 /* ------------------------------------------------- device MMU wiring */
 
 /* Arena offset of `page` in `tier` (HBM/CXL only; blk->lock held). */
@@ -112,6 +130,11 @@ void uvmBlockPtePopulate(UvmVaBlock *blk, uint32_t firstPage,
     }
     uvmPteBatchEnd(&pb);
     blk->devPtesLive = true;
+    /* tpushield: a WRITABLE device PTE means the device may mutate the
+     * span behind the engine's back — every seal under it is stale the
+     * moment the translation lands. */
+    if (writable && blk->shield)
+        uvmShieldUnsealRange(blk, firstPage, count, -1);
 }
 
 /* Revoke device PTEs for the span on EVERY device and issue one TLB
@@ -177,6 +200,10 @@ static TpuStatus block_alloc_backing(UvmVaBlock *blk, UvmTierArena *arena,
             TpuStatus st = uvmPmmAlloc(&arena->pmm, want, &chunk);
             if (st != TPU_OK)
                 return st;
+            /* tpushield invariant detector: a fresh chunk must never
+             * overlap a retired span (the retire path leaks the chunk
+             * precisely so this cannot happen). */
+            uvmShieldCheckAlloc(arena, chunk->offset, want);
             UvmChunkRun *run = calloc(1, sizeof(*run));
             if (!run) {
                 uvmPmmFree(&arena->pmm, chunk);
@@ -217,7 +244,12 @@ static void block_gc_runs(UvmVaBlock *blk, UvmTier tier)
         }
         if (!live) {
             *prev = r->next;
-            uvmPmmFree(&r->arena->pmm, r->chunk);
+            /* Retired chunks never return to the freelist: the
+             * deliberate leak IS the page retirement (PMM blacklist
+             * analog) — the physical span can never be re-allocated. */
+            if (!uvmShieldRunRetired(r->arena, r->chunk->offset,
+                                     (uint64_t)r->numPages * uvmPageSize()))
+                uvmPmmFree(&r->arena->pmm, r->chunk);
             uvmTenantCharge(blk->range->vaSpace, tier,
                             -(int64_t)r->numPages);
             UvmChunkRun *dead = r;
@@ -330,10 +362,24 @@ static int page_src_tier(UvmVaBlock *blk, uint32_t page)
  * contiguous page spans into single channel pushes (the contiguity-split
  * loop, reference ce_utils.c:646-661).  Pages resident nowhere are
  * zero-filled.  Pushes are pipelined; one wait at the end (reference
- * pipelines block copies the same way, uvm_migrate.c:555). */
+ * pipelines block copies the same way, uvm_migrate.c:555).
+ *
+ * tpushield: sealed SOURCE pages (a cold HOST/CXL copy coming back
+ * hot) are verified against their CRC before any mask or PTE commits —
+ * and the verify is OVERLAPPED, not serialized: the copy rides the
+ * executor-side CRC stage (crcOut[p] / the local capture receives the
+ * CRC32C of page p's destination bytes, computed on the tpuce executor
+ * threads during the copy), and the compare runs after the single
+ * batch wait.  A match proves seal -> source -> copied bytes end to
+ * end; a mismatch falls back to the source-side re-fetch ladder and,
+ * unrecovered, fails the pass with TPU_ERR_PAGE_POISONED before
+ * anything commits.  Sealed DESTINATION pages unseal before the
+ * overwrite (the last verify hook a pending injected flip can be
+ * caught by). */
 static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                                const UvmPageMask *pages, uint32_t first,
-                               uint32_t count, uint64_t *bytesOut)
+                               uint32_t count, uint64_t *bytesOut,
+                               uint32_t *crcOut)
 {
     /* Injected migration-copy fault: fail BEFORE any byte moves or any
      * mask commits, so the retry in make-resident re-runs the whole
@@ -348,6 +394,13 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
      * manager lookup. */
     bool haveCe = false, triedCe = false;
     uint64_t bytes = 0;
+    /* Overlapped verify-on-promote capture: spans whose SOURCE pages
+     * are sealed get per-page CRCs of the delivered bytes even when
+     * the caller is not sealing the destination. */
+    uint32_t localCrc[UVM_MAX_PAGES_PER_BLOCK];
+    UvmPageMask verifyMask;
+    uvmPageMaskZero(&verifyMask);
+    bool anyVerify = false;
 
     /* On any failure, drain already-issued stripes before unwinding —
      * the caller may free the backing the workers are still writing. */
@@ -385,6 +438,8 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                  * blocks keep the chunk's previous tenant's bytes). */
                 tpuHbmMirrorNotify(dstPtr, ps);
             }
+            if (crcOut)
+                crcOut[p] = tpurmShieldCrc32c(dstPtr, ps);
             p++;
             continue;
         }
@@ -405,6 +460,40 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
                tier_page_ptr(blk, (UvmTier)src, p + span) ==
                    (char *)srcPtr + (uint64_t)span * ps)
             span++;
+        /* tpushield verify-on-promote, OVERLAPPED: a sealed cold
+         * source must prove its CRC before any consumer trusts the
+         * bytes — over the WHOLE grown span (verifying only its head
+         * page lets a flip further in ride the copy and get
+         * unseal-"detected" at commit, after the corruption already
+         * moved hot).  Rather than a serialized source read up front,
+         * capture per-page CRCs of the DELIVERED bytes on the
+         * executor threads during the copy; the compare (and, on
+         * mismatch, the ladder) runs after the batch wait, before
+         * anything commits. */
+        uint32_t comp = block_comp_for(blk, dstTier, src);
+        uint32_t *cap = crcOut;
+        if (blk->shield && (src == UVM_TIER_HOST || src == UVM_TIER_CXL) &&
+            uvmShieldRangeSealed(blk, p, span)) {
+            if (comp & TPU_CE_COMP_FMT_MASK) {
+                /* Lossy-compressed copy: the stripe CRC covers the
+                 * xform's OUTPUT, which can never reconcile with the
+                 * raw-byte seal — every promote would false-mismatch
+                 * and the ladder's recovery copy would bypass the
+                 * xform.  Compressible spans keep the serialized
+                 * source-side verify instead. */
+                TpuStatus vst = uvmShieldVerifyRange(blk, p, span);
+                if (vst != TPU_OK) {
+                    if (haveCe)
+                        tpuCeBatchWait(&batch);
+                    return vst;
+                }
+            } else {
+                if (!cap)
+                    cap = localCrc;
+                uvmPageMaskSetRange(&verifyMask, p, span);
+                anyVerify = true;
+            }
+        }
         if (!triedCe) {
             triedCe = true;
             TpuCeMgr *m = block_ce_mgr(blk);
@@ -412,9 +501,15 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         }
         if (!haveCe)
             return TPU_ERR_INVALID_STATE;
-        TpuStatus st = tpuCeBatchCopy(&batch, dstPtr, srcPtr,
-                                      (uint64_t)span * ps,
-                                      block_comp_for(blk, dstTier, src));
+        /* Overwriting a sealed destination copy: unseal first (with
+         * the pending-flip verify) so the seal bookkeeping never goes
+         * stale under the copy. */
+        if (blk->shield)
+            uvmShieldUnsealRange(blk, p, span, (int)dstTier);
+        TpuStatus st = tpuCeBatchCopyCrc(&batch, dstPtr, srcPtr,
+                                         (uint64_t)span * ps, comp,
+                                         cap ? cap + p : NULL,
+                                         cap ? ps : 0);
         if (st != TPU_OK) {
             tpuCeBatchWait(&batch);
             return st;
@@ -422,9 +517,48 @@ static TpuStatus block_copy_in(UvmVaBlock *blk, UvmTier dstTier,
         bytes += (uint64_t)span * ps;
         p += span;
     }
+    if (haveCe) {
+        TpuStatus wst = tpuCeBatchWait(&batch);
+        if (wst != TPU_OK)
+            return wst;
+    }
+    if (anyVerify) {
+        /* The overlapped compare: sealed sources must reconcile with
+         * the bytes the copy delivered.  A mismatching page runs the
+         * source-side ladder; a recovered source is copied again (the
+         * rare path — one synchronous page copy), an unrecovered one
+         * poisons and fails the pass with nothing committed. */
+        for (uint32_t q = first; q < first + count && q < blk->npages;
+             q++) {
+            if (!uvmPageMaskTest(&verifyMask, q))
+                continue;
+            uint32_t *cap = crcOut ? crcOut : localCrc;
+            bool recopy = false;
+            TpuStatus vst = uvmShieldVerifyCopied(blk, q, cap[q],
+                                                  &recopy);
+            if (vst != TPU_OK)
+                return vst;
+            if (!recopy)
+                continue;
+            int src = page_src_tier(blk, q);
+            void *srcPtr = src >= 0
+                               ? tier_page_ptr(blk, (UvmTier)src, q)
+                               : NULL;
+            void *dstPtr = tier_page_ptr(blk, dstTier, q);
+            if (!srcPtr || !dstPtr)
+                return TPU_ERR_INVALID_STATE;
+            if (src == UVM_TIER_HBM &&
+                tpuHbmCoherentForRead(srcPtr, ps) != TPU_OK)
+                return TPU_ERR_INVALID_STATE;
+            memcpy(dstPtr, srcPtr, ps);
+            if (dstTier == UVM_TIER_HBM)
+                tpuHbmMirrorNotify(dstPtr, ps);
+            cap[q] = tpurmShieldCrc32c(dstPtr, ps);
+        }
+    }
     if (bytesOut)
         *bytesOut = bytes;
-    return haveCe ? tpuCeBatchWait(&batch) : TPU_OK;
+    return TPU_OK;
 }
 
 /* ---------------------------------------------------------- eviction */
@@ -466,6 +600,31 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
     uvmPageMaskZero(&toHost);
     uint64_t ps = uvmPageSize();
 
+    /* tpushield verify-on-evict: pages sealed on the evicting tier must
+     * prove their CRC BEFORE the copy-back — otherwise a rotted CXL
+     * park is copied host-ward and RESEALED over the corrupt bytes (the
+     * new HOST CRC matches the garbage, so every later verify passes),
+     * and the source unseal below "detects" the flip only after it
+     * became the trusted truth.  A ladder-unrecovered page poisons
+     * here, dropping its residency, so the copy-back set built next
+     * skips it. */
+    if (blk->shield)
+        for (uint32_t p = 0; p < np; p++) {
+            if (uvmShieldPageSealedTier(blk, p) != (int)tier ||
+                !uvmPageMaskTest(&blk->resident[tier], p))
+                continue;
+            /* One VerifyRange per contiguous sealed run, not per page
+             * — one shield.verify span each instead of flooding the
+             * trace ring with per-page records. */
+            uint32_t run = 1;
+            while (p + run < np &&
+                   uvmShieldPageSealedTier(blk, p + run) == (int)tier &&
+                   uvmPageMaskTest(&blk->resident[tier], p + run))
+                run++;
+            (void)uvmShieldVerifyRange(blk, p, run);
+            p += run - 1;
+        }
+
     /* Pages resident ONLY in this tier must be copied back to host;
      * read-duplicated pages just drop the copy. */
     uint32_t first = np, last = 0;
@@ -489,6 +648,23 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
             TpuCeMgr *mgr = block_ce_mgr(blk);
             bool haveCe = mgr && tpuCeBatchBegin(mgr, &batch) == TPU_OK;
             uint64_t bytes = 0;
+            /* tpushield: the demoted pages SEAL — CRC32C per page,
+             * computed by the tpuce executor threads as the stripe
+             * transform stage (overlapped with the copy, not a second
+             * pass after the fence). */
+            bool sealing = uvmShieldActive();
+            uint32_t crcs[UVM_MAX_PAGES_PER_BLOCK];
+            if (sealing)
+                /* Stale HOST seals die before the overwrite (pending
+                 * flips verified there) — but ONLY on the toHost pages
+                 * the copy-back actually rewrites.  A read-dup page
+                 * resident elsewhere keeps its HOST copy untouched;
+                 * blanket-unsealing it would drop a seal whose bytes
+                 * stay live (a detected-but-unrepaired flip would
+                 * become the trusted copy). */
+                for (uint32_t q = first; q <= last; q++)
+                    if (uvmPageMaskTest(&toHost, q))
+                        uvmShieldUnsealRange(blk, q, 1, UVM_TIER_HOST);
             for (uint32_t p = first; p <= last; p++) {
                 if (!uvmPageMaskTest(&toHost, p))
                     continue;
@@ -508,11 +684,14 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                  * accesses fault and queue behind this eviction rather
                  * than reading stale bytes or losing stores. */
                 TpuStatus st = haveCe
-                                   ? tpuCeBatchCopy(&batch, dst, src,
+                                   ? tpuCeBatchCopyCrc(&batch, dst, src,
                                                     (uint64_t)span * ps,
                                                     block_comp_for(
                                                         blk, UVM_TIER_HOST,
-                                                        (int)tier))
+                                                        (int)tier),
+                                                    sealing ? &crcs[p]
+                                                            : NULL,
+                                                    sealing ? ps : 0)
                                    : TPU_ERR_INVALID_STATE;
                 if (st != TPU_OK) {
                     if (haveCe)
@@ -536,19 +715,32 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
                     return st;
                 }
             }
-            /* Commit: masks first, then user PTEs (RW only toHost spans). */
+            /* Commit: masks first, then user PTEs.  Sealed pages park
+             * behind PROT_NONE — the first CPU touch faults, VERIFIES
+             * the seal and only then reopens RW (one extra fault per
+             * evicted-then-touched span buys read-side detection);
+             * with the shield off the historical RW mapping returns. */
             for (uint32_t p = 0; p < np; p++) {
                 if (!uvmPageMaskTest(&toHost, p))
                     continue;
                 uvmPageMaskSet(&blk->resident[UVM_TIER_HOST], p);
-                uvmPageMaskSet(&blk->cpuMapped, p);
+                if (!sealing)
+                    uvmPageMaskSet(&blk->cpuMapped, p);
                 uint32_t span = 1;
                 while (p + span < np && uvmPageMaskTest(&toHost, p + span)) {
                     uvmPageMaskSet(&blk->resident[UVM_TIER_HOST], p + span);
-                    uvmPageMaskSet(&blk->cpuMapped, p + span);
+                    if (!sealing)
+                        uvmPageMaskSet(&blk->cpuMapped, p + span);
                     span++;
                 }
-                uvmBlockSetCpuAccess(blk, p, span, PROT_READ | PROT_WRITE);
+                if (sealing) {
+                    for (uint32_t q = p; q < p + span; q++)
+                        uvmShieldSealPage(blk, q, UVM_TIER_HOST, crcs[q]);
+                    uvmBlockSetCpuAccess(blk, p, span, PROT_NONE);
+                } else {
+                    uvmBlockSetCpuAccess(blk, p, span,
+                                         PROT_READ | PROT_WRITE);
+                }
                 p += span - 1;
             }
             uvmFaultStatsRecordMigration(bytes);
@@ -566,6 +758,10 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
         /* Still-marked speculative pages leaving the aperture untouched
          * are USELESS prefetches (blk->lock held here). */
         uvmPerfPrefetchEvictLocked(blk, first, last - first + 1);
+        /* Seals of the copies this clear drops (read-dup CXL parks
+         * losing their aperture copy) die with the residency. */
+        if (blk->shield)
+            uvmShieldUnsealRange(blk, first, last - first + 1, (int)tier);
         uvmPageMaskClearRange(&blk->resident[tier], first, last - first + 1);
         /* Evicted pages lose any accessed-by device mapping into them,
          * and their device PTEs (one TLB invalidate per device). */
@@ -816,7 +1012,16 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
 
         uint64_t bytes = 0;
         uint64_t tCopy = tpurmTraceBegin();
-        st = block_copy_in(blk, dst.tier, &needed, firstPage, count, &bytes);
+        /* tpushield: a demotion to the far CXL tier seals the new cold
+         * copy — CRCs ride the executor threads through the copy.
+         * forWrite does not exempt it: the CPU side of a CXL page is
+         * PROT_NONE either way, and a device that later WRITES it
+         * unseals at the writable-PTE install — until then the parked
+         * copy is exactly the cold data the scrubber must cover. */
+        bool sealCxl = dst.tier == UVM_TIER_CXL && uvmShieldActive();
+        uint32_t sealCrcs[UVM_MAX_PAGES_PER_BLOCK];
+        st = block_copy_in(blk, dst.tier, &needed, firstPage, count, &bytes,
+                           sealCxl ? sealCrcs : NULL);
         if (tCopy && bytes)
             tpurmTraceEnd(TPU_TRACE_MIGRATE_COPY, tCopy, blk->start, bytes);
         if (st != TPU_OK) {
@@ -864,10 +1069,23 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         uvmPageMaskAndNot(&blk->devMapped, &needed);
         if (!readDup) {
             for (int t = 0; t < UVM_TIER_COUNT; t++) {
-                if (t != (int)dst.tier)
-                    uvmPageMaskAndNot(&blk->resident[t], &needed);
+                if (t == (int)dst.tier)
+                    continue;
+                /* Seals of source copies this exclusivity drops die
+                 * with their residency (pending flips verified in the
+                 * unseal hook — bytes still addressable here). */
+                if (blk->shield)
+                    for (uint32_t q = firstPage; q < firstPage + count;
+                         q++)
+                        if (uvmPageMaskTest(&needed, q))
+                            uvmShieldUnsealRange(blk, q, 1, t);
+                uvmPageMaskAndNot(&blk->resident[t], &needed);
             }
         }
+        if (sealCxl)
+            for (uint32_t q = firstPage; q < firstPage + count; q++)
+                if (uvmPageMaskTest(&needed, q))
+                    uvmShieldSealPage(blk, q, UVM_TIER_CXL, sealCrcs[q]);
         if (dst.tier == UVM_TIER_HOST) {
             if (readDup) {
                 /* Read-duplicated pages map read-only so a CPU write
@@ -931,6 +1149,16 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
          * second mprotect syscall over the same span. */
         if (hostRwCommitted && !hadDup && !devMappedAny)
             goto fixup_done;
+        /* Exclusive write: duplicate copies drop, so their seals die;
+         * a HOST destination also opens CPU-writable, killing its own
+         * seal.  A CXL destination keeps the seal the commit just laid
+         * — its CPU side stays PROT_NONE, and a device write unseals
+         * at the writable-PTE install (uvmBlockPtePopulate). */
+        if (blk->shield) {
+            for (int t = 0; t < UVM_TIER_COUNT; t++)
+                if (t != (int)dst.tier || dst.tier == UVM_TIER_HOST)
+                    uvmShieldUnsealRange(blk, firstPage, count, t);
+        }
         for (int t = 0; t < UVM_TIER_COUNT; t++) {
             if (t != (int)dst.tier)
                 uvmPageMaskClearRange(&blk->resident[t], firstPage, count);
@@ -1007,6 +1235,10 @@ TpuStatus uvmBlockMapDevice(UvmVaBlock *blk, uint32_t firstPage,
          * duplicates so the remote write cannot diverge from a stale
          * duplicate; host pages the device may now write become
          * PROT_READ so CPU stores re-fault and serialize. */
+        /* tpushield: the device may now WRITE the mapped copy — every
+         * seal under the span is stale the moment the PTE opens. */
+        if (blk->shield)
+            uvmShieldUnsealRange(blk, firstPage, count, -1);
         for (uint32_t p = firstPage; p < firstPage + count; p++) {
             int keep = -1;
             const int prio[] = { UVM_TIER_HBM, UVM_TIER_CXL, UVM_TIER_HOST };
@@ -1069,7 +1301,9 @@ void uvmBlockFreeBacking(UvmVaBlock *blk)
         UvmChunkRun *r = *runs_head(blk, (UvmTier)tier);
         while (r) {
             UvmChunkRun *next = r->next;
-            uvmPmmFree(&r->arena->pmm, r->chunk);
+            if (!uvmShieldRunRetired(r->arena, r->chunk->offset,
+                                     (uint64_t)r->numPages * uvmPageSize()))
+                uvmPmmFree(&r->arena->pmm, r->chunk);
             uvmTenantCharge(blk->range->vaSpace, (UvmTier)tier,
                             -(int64_t)r->numPages);
             free(r);
@@ -1077,6 +1311,7 @@ void uvmBlockFreeBacking(UvmVaBlock *blk)
         }
         *runs_head(blk, (UvmTier)tier) = NULL;
     }
+    uvmShieldBlockFree(blk);
 }
 
 /* -------------------------------------------- device-wrote invalidation
@@ -1133,6 +1368,10 @@ static void device_wrote_visit(UvmVaSpace *vs, UvmVaBlock *blk, void *ctxv)
                 if (t == (int)UVM_TIER_HBM)
                     continue;
                 if (uvmPageMaskTest(&blk->resident[t], p)) {
+                    /* The chip overwrote the authoritative copy: the
+                     * stale duplicate's seal dies with it. */
+                    if (blk->shield)
+                        uvmShieldUnsealRange(blk, p, 1, t);
                     uvmPageMaskClear(&blk->resident[t], p);
                     hadOther = true;
                 }

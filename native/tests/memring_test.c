@@ -370,6 +370,32 @@ static int test_wrap_and_backpressure(void)
     uint64_t sub, comp, err, ovf;
     tpurmMemringCounts(r, &sub, &comp, &err, &ovf);
     CHECK(sub == 64 && comp == 64 && err == 0 && ovf == 0);
+
+    /* Reap-then-prep loop (the PR-14 forensics flake, promoted to a
+     * regression): after a FULL reap of a wave's CQEs, the very next
+     * prep must always succeed.  Before the retire-before-post fix a
+     * worker descheduled between posting the CQEs and advancing the
+     * retirement frontier left prep's frontier-lag gate transiently
+     * strict — reaped CQEs with INSUFFICIENT_RESOURCES from prep.
+     * Hundreds of tight waves on a tiny ring hit that window reliably
+     * under load; with the fix a reaped CQE PROVES its seq retired. */
+    for (int w = 0; w < 400; w++) {
+        for (int i = 0; i < 8; i++) {
+            TpuMemringSqe s = sqe_nop(2000 + i);
+            TpuStatus pst = tpurmMemringPrep(r, &s);
+            if (pst != TPU_OK) {
+                fprintf(stderr,
+                        "FAIL: prep refused (%u) after a full reap "
+                        "(wave %d op %d) — CQE-post/frontier window\n",
+                        pst, w, i);
+                return 1;
+            }
+        }
+        CHECK(tpurmMemringSubmitAndWait(r, 8, NULL) == 8);
+        CHECK(tpurmMemringReap(r, cq, 16) == 8);
+    }
+    tpurmMemringCounts(r, &sub, &comp, &err, &ovf);
+    CHECK(sub == 64 + 400 * 8 && comp == sub && err == 0 && ovf == 0);
     tpurmMemringDestroy(r);
     return 0;
 }

@@ -1,0 +1,328 @@
+/*
+ * tpushield test: CRC32C known answers, seal-on-demote + verify-on-
+ * promote roundtrips, the mem.corrupt flip -> detect -> re-fetch
+ * ladder (sibling save and poison+retire rungs), the background
+ * scrubber catching corruption before a demand fault, retired spans
+ * never re-allocating, the wire helpers, and the EXACT reconciliation
+ * invariant: mem.corrupt hits == shield_detected + shield_inject_misses
+ * with misses == 0.
+ */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "tpurm/inject.h"
+#include "tpurm/shield.h"
+#include "tpurm/status.h"
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+#define MB (1024ull * 1024)
+#define BLOCK (2 * MB)
+
+void tpuRegistrySet(const char *key, const char *value);
+uint64_t tpurmCounterGet(const char *name);
+uint64_t uvmTierEvictBytes(uint32_t tier, uint32_t devInst,
+                           uint64_t bytes);
+
+static const UvmLocation HBM0 = { UVM_TIER_HBM, 0 };
+static const UvmLocation CXL0 = { UVM_TIER_CXL, 0 };
+
+/* Evict EVERYTHING from dev 0's HBM arena (the seal-on-demote path). */
+static void evict_all_hbm(void)
+{
+    uint64_t total = 0, freeB = 0;
+    uvmHbmArenaUsage(0, &freeB, &total);
+    uvmTierEvictBytes(UVM_TIER_HBM, 0, total);
+}
+
+static int corrupt_hits(void)
+{
+    uint64_t evals, hits;
+    tpurmInjectCounts(TPU_INJECT_SITE_MEM_CORRUPT, &evals, &hits);
+    return (int)hits;
+}
+
+/* Exactness: every mem.corrupt hit so far is either detected or a
+ * (defensive, must-be-zero) miss. */
+static int check_invariant(void)
+{
+    TpuShieldStats st;
+    tpurmShieldStatsGet(&st);
+    CHECK((uint64_t)corrupt_hits() == st.injectCorrupts);
+    CHECK(st.injectCorrupts == st.injectDetected + st.injectMisses);
+    CHECK(st.injectMisses == 0);
+    return 0;
+}
+
+/* --------------------------------------------------------------- CRC */
+
+static int test_crc32c(void)
+{
+    /* RFC 3720 known answer. */
+    CHECK(tpurmShieldCrc32c("123456789", 9) == 0xE3069283u);
+    /* Extend chaining == one-shot. */
+    uint8_t buf[1031];
+    for (size_t i = 0; i < sizeof(buf); i++)
+        buf[i] = (uint8_t)(i * 7 + 1);
+    uint32_t whole = tpurmShieldCrc32c(buf, sizeof(buf));
+    uint32_t part = tpurmShieldCrc32c(buf, 500);
+    part = tpurmShieldCrc32cExtend(part, buf + 500, sizeof(buf) - 500);
+    CHECK(part == whole);
+    /* One flipped bit always detected. */
+    buf[sizeof(buf) / 2] ^= 0x20;
+    CHECK(tpurmShieldCrc32c(buf, sizeof(buf)) != whole);
+    return 0;
+}
+
+/* ---------------------------------------------- seal/verify roundtrip */
+
+static int test_seal_verify_roundtrip(UvmVaSpace *vs)
+{
+    TpuShieldStats s0, s1;
+    tpurmShieldStatsGet(&s0);
+
+    void *p;
+    CHECK(uvmMemAlloc(vs, BLOCK, &p) == TPU_OK);
+    memset(p, 0x5C, BLOCK);
+    CHECK(uvmMigrate(vs, p, BLOCK, HBM0, 0) == TPU_OK);
+    evict_all_hbm();                    /* demote: seal to HOST */
+
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.seals > s0.seals);
+
+    /* CPU touch of the sealed cold span: fault -> verify -> unseal ->
+     * RW restored; every byte intact, zero mismatches. */
+    volatile uint8_t *v = p;
+    for (uint64_t i = 0; i < BLOCK; i += 4096)
+        CHECK(v[i] == 0x5C);
+    v[BLOCK - 1] = 0x5D;                /* writes work again too */
+    CHECK(v[BLOCK - 1] == 0x5D);
+
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.verifies > s0.verifies);
+    CHECK(s1.mismatches == s0.mismatches);
+    CHECK(s1.pagesPoisoned == s0.pagesPoisoned);
+
+    /* Device promote of a sealed span verifies too. */
+    CHECK(uvmMigrate(vs, p, BLOCK, HBM0, 0) == TPU_OK);
+    evict_all_hbm();
+    CHECK(uvmDeviceAccess(vs, 0, p, BLOCK, 0) == TPU_OK);
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.mismatches == s0.mismatches);
+
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    return check_invariant();
+}
+
+/* ------------------------------------------ flip -> poison -> retire */
+
+static int test_corrupt_poison_retire(UvmVaSpace *vs)
+{
+    TpuShieldStats s0, s1;
+    tpurmShieldStatsGet(&s0);
+
+    void *p;
+    CHECK(uvmMemAlloc(vs, BLOCK, &p) == TPU_OK);
+    memset(p, 0xA7, BLOCK);
+    /* Demote to CXL: seals the far-tier copy; the armed one-shot flips
+     * one bit in the FIRST page sealed. */
+    CHECK(tpurmInjectArmOneShot(TPU_INJECT_SITE_MEM_CORRUPT, 0) == TPU_OK);
+    CHECK(uvmMigrate(vs, p, BLOCK, CXL0, 0) == TPU_OK);
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.injectCorrupts == s0.injectCorrupts + 1);
+
+    /* Promote: the verify catches the flip; no sibling copy exists
+     * (the CXL demote was exclusive), so the ladder poisons the page
+     * and the OWNING access gets the distinct status — never a device
+     * reset, co-located pages untouched. */
+    TpuStatus st = uvmDeviceAccess(vs, 0, p, BLOCK, 0);
+    CHECK(st == TPU_ERR_PAGE_POISONED);
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.mismatches == s0.mismatches + 1);
+    CHECK(s1.injectDetected == s0.injectDetected + 1);
+    CHECK(s1.pagesPoisoned == s0.pagesPoisoned + 1);
+    CHECK(s1.pagesRetired == s0.pagesRetired + 1);
+    CHECK(tpurmShieldRetiredTotal() >= 1);
+
+    /* Sticky: the poisoned page keeps failing precisely. */
+    CHECK(uvmDeviceAccess(vs, 0, p, BLOCK, 0) == TPU_ERR_PAGE_POISONED);
+
+    /* Containment granularity: pages past the first are still intact
+     * and serviceable (the CPU read verifies them). */
+    uint64_t ps = 64 * 1024;
+    volatile uint8_t *v = p;
+    for (uint64_t i = ps; i < BLOCK; i += 4096)
+        CHECK(v[i] == 0xA7);
+    /* The poisoned page itself reads the poison mapping (zeros), and
+     * the process survives — precise cancel, not a crash. */
+    CHECK(v[16] == 0);
+
+    UvmResidencyInfo ri;
+    CHECK(uvmResidencyInfo(vs, p, &ri) == TPU_OK);
+    CHECK(ri.cancelled);
+
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+
+    /* Retirement holds across the free: grind the CXL tier with fresh
+     * demotes — no fresh chunk may overlap the retired span. */
+    for (int i = 0; i < 8; i++) {
+        void *q;
+        CHECK(uvmMemAlloc(vs, BLOCK, &q) == TPU_OK);
+        memset(q, i + 1, BLOCK);
+        CHECK(uvmMigrate(vs, q, BLOCK, CXL0, 0) == TPU_OK);
+        CHECK(uvmMigrate(vs, q, BLOCK, HBM0, 0) == TPU_OK);
+        CHECK(uvmMemFree(vs, q) == TPU_OK);
+    }
+    CHECK(tpurmCounterGet("shield_retired_realloc") == 0);
+    evict_all_hbm();
+    return check_invariant();
+}
+
+/* -------------------------------------------- sibling re-fetch save */
+
+static int test_refetch_sibling(UvmVaSpace *vs)
+{
+    TpuShieldStats s0, s1;
+    tpurmShieldStatsGet(&s0);
+
+    void *p;
+    CHECK(uvmMemAlloc(vs, BLOCK, &p) == TPU_OK);
+    memset(p, 0x33, BLOCK);
+    /* Preferred location CXL: a device READ fault services into the
+     * far tier — and device reads DUPLICATE (the host copy survives),
+     * so the sealed CXL pages carry a live sibling. */
+    CHECK(uvmSetReadDuplication(vs, p, BLOCK, true) == TPU_OK);
+    CHECK(uvmSetPreferredLocation(vs, p, BLOCK, CXL0) == TPU_OK);
+    CHECK(tpurmInjectArmOneShot(TPU_INJECT_SITE_MEM_CORRUPT, 0) == TPU_OK);
+    CHECK(uvmDeviceAccess(vs, 0, p, BLOCK, 0) == TPU_OK);
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.injectCorrupts == s0.injectCorrupts + 1);
+
+    /* The flip landed in a sealed CXL page with a host sibling: the
+     * next service verifies, catches it, and the ladder re-fetches
+     * from the sibling instead of poisoning — data fully intact. */
+    CHECK(uvmDeviceAccess(vs, 0, p, BLOCK, 0) == TPU_OK);
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.mismatches == s0.mismatches + 1);
+    CHECK(s1.injectDetected == s0.injectDetected + 1);
+    CHECK(s1.refetchSaves == s0.refetchSaves + 1);
+    CHECK(s1.pagesPoisoned == s0.pagesPoisoned);
+    volatile uint8_t *v = p;
+    for (uint64_t i = 0; i < BLOCK; i += 4096)
+        CHECK(v[i] == 0x33);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    return check_invariant();
+}
+
+/* ------------------------------------------------------------- scrub */
+
+static int test_scrub_catches_before_fault(UvmVaSpace *vs)
+{
+    TpuShieldStats s0, s1;
+    tpurmShieldStatsGet(&s0);
+
+    void *p;
+    CHECK(uvmMemAlloc(vs, BLOCK, &p) == TPU_OK);
+    memset(p, 0x66, BLOCK);
+    CHECK(uvmMigrate(vs, p, BLOCK, HBM0, 0) == TPU_OK);
+    CHECK(tpurmInjectArmOneShot(TPU_INJECT_SITE_MEM_CORRUPT, 0) == TPU_OK);
+    evict_all_hbm();                    /* seal + one flip */
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.injectCorrupts == s0.injectCorrupts + 1);
+
+    /* The scrubber walks the sealed cold pages and catches the flip
+     * BEFORE any demand fault touches the span. */
+    uint32_t scrubbed = tpurmShieldScrubNow(4096);
+    CHECK(scrubbed > 0);
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.scrubPages > s0.scrubPages);
+    CHECK(s1.scrubHits == s0.scrubHits + 1);
+    CHECK(s1.injectDetected == s0.injectDetected + 1);
+    /* Sole copy: the scrub poisons (containment without a demand
+     * fault in sight). */
+    CHECK(s1.pagesPoisoned == s0.pagesPoisoned + 1);
+    CHECK(uvmMemFree(vs, p) == TPU_OK);
+    return check_invariant();
+}
+
+/* -------------------------------------------------------------- wire */
+
+static int test_wire_helpers(void)
+{
+    TpuShieldStats s0, s1;
+    tpurmShieldStatsGet(&s0);
+    uint8_t buf[8192];
+    for (size_t i = 0; i < sizeof(buf); i++)
+        buf[i] = (uint8_t)(i ^ 0x5A);
+    uint32_t crc = tpurmShieldCrc32c(buf, sizeof(buf));
+    CHECK(tpurmShieldVerifyWire(buf, sizeof(buf), crc, 1) == TPU_OK);
+
+    CHECK(tpurmInjectArmOneShot(TPU_INJECT_SITE_MEM_CORRUPT, 0) == TPU_OK);
+    CHECK(tpurmShieldInjectWire(buf, sizeof(buf), 7));
+    CHECK(tpurmShieldVerifyWire(buf, sizeof(buf), crc, 7) ==
+          TPU_ERR_INVALID_STATE);
+    tpurmShieldStatsGet(&s1);
+    CHECK(s1.wireVerifies == s0.wireVerifies + 2);
+    CHECK(s1.wireMismatches == s0.wireMismatches + 1);
+    CHECK(s1.injectDetected == s0.injectDetected + 1);
+    /* Re-fetch rung: restore from the intact source and re-verify. */
+    buf[sizeof(buf) / 2] ^= 0x20;
+    CHECK(tpurmShieldVerifyWire(buf, sizeof(buf), crc, 7) == TPU_OK);
+    return check_invariant();
+}
+
+int main(void)
+{
+    /* Small arena + fast knobs BEFORE the engine initializes. */
+    setenv("TPUMEM_FAKE_TPU_COUNT", "1", 0);
+    tpuRegistrySet("shield_enable", "1");
+    tpuRegistrySet("shield_scrub_ms", "1000000");  /* manual scrubs only */
+    tpuRegistrySet("uvm_access_counter_enable", "0");
+    tpuRegistrySet("hot_enable", "0");
+
+    UvmVaSpace *vs;
+    CHECK(uvmVaSpaceCreate(&vs) == TPU_OK);
+    CHECK(uvmRegisterDevice(vs, 0) == TPU_OK);
+
+    if (test_crc32c())
+        return 1;
+    if (test_seal_verify_roundtrip(vs))
+        return 1;
+    if (test_corrupt_poison_retire(vs))
+        return 1;
+    if (test_refetch_sibling(vs))
+        return 1;
+    if (test_scrub_catches_before_fault(vs))
+        return 1;
+    if (test_wire_helpers())
+        return 1;
+
+    /* Final exactness over the whole run. */
+    if (check_invariant())
+        return 1;
+    TpuShieldStats st;
+    tpurmShieldStatsGet(&st);
+    printf("shield_test OK (seals=%llu verifies=%llu mismatches=%llu "
+           "saves=%llu poisoned=%llu retired=%llu scrub_hits=%llu "
+           "hits=%llu detected=%llu misses=%llu)\n",
+           (unsigned long long)st.seals, (unsigned long long)st.verifies,
+           (unsigned long long)st.mismatches,
+           (unsigned long long)st.refetchSaves,
+           (unsigned long long)st.pagesPoisoned,
+           (unsigned long long)st.pagesRetired,
+           (unsigned long long)st.scrubHits,
+           (unsigned long long)st.injectCorrupts,
+           (unsigned long long)st.injectDetected,
+           (unsigned long long)st.injectMisses);
+    uvmVaSpaceDestroy(vs);
+    return 0;
+}

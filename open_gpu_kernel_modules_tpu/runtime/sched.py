@@ -152,6 +152,13 @@ class RequestState(enum.Enum):
     PREEMPTED = "preempted"
     FINISHED = "finished"
     CANCELLED = "cancelled"
+    #: tpushield containment: a KV page of THIS stream was poisoned
+    #: (silent corruption detected with no recovery source) — the
+    #: stream retires terminal-with-error; co-tenants are untouched
+    #: and no device reset runs.  Its sequence slot is retired with it
+    #: (the poisoned backing pages must never be handed to a new
+    #: stream).
+    ERROR = "error"
 
 
 @dataclasses.dataclass
@@ -365,10 +372,14 @@ class Scheduler:
         return req
 
     def cancel(self, rid: int) -> bool:
-        """Cancel a stream in any live state; frees its pages at once."""
+        """Cancel a stream in any live state; frees its pages at once.
+        ERROR is terminal too: a poison-retired stream already gave up
+        its (retired) slot and closed its ledger — cancelling it would
+        overwrite the error status and double-count the stream."""
         req = self._by_rid.get(rid)
         if req is None or req.state in (RequestState.FINISHED,
-                                        RequestState.CANCELLED):
+                                        RequestState.CANCELLED,
+                                        RequestState.ERROR):
             return False
         if req.state is RequestState.QUEUED:
             self._queue.remove(req)
@@ -596,8 +607,14 @@ class Scheduler:
         except native.RmError:
             # The warm-up chain is an optimization: a failed PREFETCH
             # CQE (injected or real) just means the activation below
-            # faults the pages itself.
+            # faults the pages itself — UNLESS the failure is a
+            # poisoned page of THIS stream, in which case the stream
+            # retires here (terminal-with-error) instead of faulting
+            # into the same poison forever.
             self._quiesce_ring(ring)
+            if self._seq_poisoned(req):
+                self._retire_poisoned(req)
+                return
             self.stats["round_errors"] = \
                 self.stats.get("round_errors", 0) + 1
             _counter_add("tpusched_round_errors")
@@ -814,6 +831,64 @@ class Scheduler:
         self.stats["retired"] += 1
         _counter_add("tpusched_retired")
 
+    # ------------------------------------------------- tpushield poison
+
+    def _seq_poisoned(self, req: Request) -> bool:
+        """Containment probe: does this stream's backing span hold a
+        poisoned page (tpushield verify mismatch with no recovery
+        source)?"""
+        if req.seq is None:
+            return False
+        from ..uvm import shield as _shield
+        backing = self.cache.backing
+        k_buf = getattr(backing, "k_buf", None)
+        if k_buf is None:
+            return False
+        off = req.seq * self.cache.pages_per_seq * backing.rec_bytes
+        span = self.cache.pages_per_seq * backing.rec_bytes
+        for base in (k_buf.address, backing.v_buf.address):
+            if _shield.span_poisoned(base + off, span):
+                return True
+        return False
+
+    def _retire_poisoned(self, req: Request) -> None:
+        """Retire ONE stream on a poisoned page: terminal-with-error,
+        sequence slot retired with it (its backing pages never serve a
+        new stream — the serving-layer face of page retirement), flow
+        ledger closed.  Everything else keeps decoding; no reset."""
+        req.state = RequestState.ERROR
+        if req.flow:
+            self._utils.flow_close(req.flow)
+            req.flow = None         # close() must not re-close the ledger
+        seq = req.seq
+        if seq is not None:
+            try:
+                self.cache.release_sequence(seq)
+            except native.RmError:
+                pass             # the poison itself may trip the drain
+            self._running.pop(seq, None)
+            if req in self._preempted:
+                self._preempted.remove(req)
+            # The slot is RETIRED, not freed: _free_seqs never sees it
+            # again, so the poisoned backing span cannot be recycled
+            # into a fresh stream's KV (which would silently decode
+            # wrong tokens — exactly what containment must prevent).
+            req.seq = None
+        self.stats["poisoned"] = self.stats.get("poisoned", 0) + 1
+        _counter_add("tpusched_poisoned_retired")
+        _counter_add("tpusched_seq_slots_retired")
+
+    def _handle_poisoned_round(self) -> bool:
+        """A round failed with TPU_ERR_PAGE_POISONED: attribute it to
+        the owning stream(s) via the span probe and retire exactly
+        those.  True when at least one stream was identified (the
+        round simply continues without it)."""
+        victims = [r for r in list(self._running.values()) +
+                   list(self._preempted) if self._seq_poisoned(r)]
+        for r in victims:
+            self._retire_poisoned(r)
+        return bool(victims)
+
     def _check_generation(self) -> None:
         """Full-device reset detection (tpurm/reset.h): the native
         engine saved device residency to the host backing (fbsr),
@@ -978,7 +1053,16 @@ class Scheduler:
             t0 = time.perf_counter()
             try:
                 view = self.cache.activate(ids, new_tokens=tpr)
-            except native.RmError:
+            except native.RmError as e:
+                # tpushield containment: a poisoned KV page fails the
+                # activation with the DISTINCT poison status — retire
+                # exactly the owning stream(s) (terminal-with-error,
+                # slot retired) and keep decoding everyone else.  No
+                # reset, no round-retry storm.
+                from ..uvm import shield as _shield
+                if (e.status == _shield.PAGE_POISONED and
+                        self._handle_poisoned_round()):
+                    return self.live_counts()
                 # Backing fault past the engine's bounded retries: the
                 # activation rolled back (no pins survive), so the
                 # round simply retries — chaos sheds a round, never the

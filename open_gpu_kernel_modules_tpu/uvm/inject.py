@@ -40,6 +40,9 @@ class Site(enum.IntEnum):
     RESET_DEVICE = 10    # forced full-device reset (per watchdog tick)
     VAC_MIGRATE = 11     # tpuvac record shipping (per copy attempt)
     HOT_DECIDE = 12      # tpuhot policy decision (degrade-to-no-op)
+    MEM_CORRUPT = 13     # tpushield bit flip in a sealed page / wire
+                         # buffer (detection, not failure — recovery is
+                         # the verify + re-fetch ladder)
 
 
 class Mode(enum.IntEnum):

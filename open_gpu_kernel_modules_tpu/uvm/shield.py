@@ -1,0 +1,198 @@
+"""tpushield — end-to-end page integrity (native/src/shield.c).
+
+Python face of the page-integrity engine: per-page CRC32C seals laid
+when pages go cold (tier demote / eviction copy-back / fbsr save) or
+cross a wire (ICI hops, vac shipping records), verified on the way back
+hot, with a bounded re-fetch ladder on mismatch (recompute -> sibling
+copy -> POISON + page retirement) and a background scrubber that
+catches corruption before a demand fault does.
+
+Surface:
+
+``stats`` / ``enabled``
+    Lifetime counters (seals, verifies, mismatches, refetch saves,
+    poisons, retirements, scrub activity) and the mem.corrupt
+    reconciliation triple — the chaos soaks assert
+    ``inject_corrupts == inject_detected + inject_misses`` with
+    ``inject_misses == 0``.
+
+``crc32c`` / ``inject_wire`` / ``verify_wire``
+    The wire-checksum helpers vac.py uses for per-record verification
+    before ``tpurmVacCommit`` (CRC compare instead of a raw byte
+    compare, sharing the native counters with the ICI hop checks).
+
+``span_poisoned``
+    Poisoned pages inside a managed span — the scheduler's containment
+    probe: a TPU_ERR_PAGE_POISONED round failure is attributed to the
+    OWNING sequence (only that stream retires; co-tenants continue and
+    no device reset runs).
+
+``scrub_now`` / ``retired_pages`` / ``span_retired``
+    Scrubber and quarantine-list introspection (tests, bench
+    detection-latency probes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..runtime import native
+
+#: TpuStatus of a poisoned-page access (status.h TPU_ERR_PAGE_POISONED).
+PAGE_POISONED = 0x74
+
+
+class _Stats(ctypes.Structure):
+    _fields_ = [
+        ("seals", ctypes.c_uint64),
+        ("verifies", ctypes.c_uint64),
+        ("mismatches", ctypes.c_uint64),
+        ("refetchSaves", ctypes.c_uint64),
+        ("pagesPoisoned", ctypes.c_uint64),
+        ("pagesRetired", ctypes.c_uint64),
+        ("scrubTicks", ctypes.c_uint64),
+        ("scrubPages", ctypes.c_uint64),
+        ("scrubHits", ctypes.c_uint64),
+        ("injectCorrupts", ctypes.c_uint64),
+        ("injectDetected", ctypes.c_uint64),
+        ("injectMisses", ctypes.c_uint64),
+        ("wireVerifies", ctypes.c_uint64),
+        ("wireMismatches", ctypes.c_uint64),
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShieldStats:
+    """Snapshot of the integrity engine (shield.h TpuShieldStats)."""
+
+    seals: int
+    verifies: int
+    mismatches: int
+    refetch_saves: int
+    pages_poisoned: int
+    pages_retired: int
+    scrub_ticks: int
+    scrub_pages: int
+    scrub_hits: int
+    inject_corrupts: int
+    inject_detected: int
+    inject_misses: int
+    wire_verifies: int
+    wire_mismatches: int
+
+
+_bound = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is not None:
+        return _bound
+    lib = native.load()
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    lib.tpurmShieldEnabled.argtypes = []
+    lib.tpurmShieldEnabled.restype = ctypes.c_bool
+    lib.tpurmShieldCrc32c.argtypes = [ctypes.c_void_p, u64]
+    lib.tpurmShieldCrc32c.restype = u32
+    lib.tpurmShieldStatsGet.argtypes = [ctypes.POINTER(_Stats)]
+    lib.tpurmShieldStatsGet.restype = None
+    lib.tpurmShieldInjectWire.argtypes = [ctypes.c_void_p, u64, u64]
+    lib.tpurmShieldInjectWire.restype = ctypes.c_bool
+    lib.tpurmShieldVerifyWire.argtypes = [ctypes.c_void_p, u64, u32, u64]
+    lib.tpurmShieldVerifyWire.restype = u32
+    lib.tpurmShieldSpanPoisoned.argtypes = [u64, u64]
+    lib.tpurmShieldSpanPoisoned.restype = u32
+    lib.tpurmShieldScrubNow.argtypes = [u32]
+    lib.tpurmShieldScrubNow.restype = u32
+    lib.tpurmShieldRetiredPages.argtypes = [u32]
+    lib.tpurmShieldRetiredPages.restype = u64
+    lib.tpurmShieldRetiredTotal.argtypes = []
+    lib.tpurmShieldRetiredTotal.restype = u64
+    lib.tpurmShieldSpanRetired.argtypes = [u32, u32, u64, u64]
+    lib.tpurmShieldSpanRetired.restype = ctypes.c_bool
+    _bound = lib
+    return lib
+
+
+def enabled() -> bool:
+    return bool(_lib().tpurmShieldEnabled())
+
+
+def stats() -> ShieldStats:
+    raw = _Stats()
+    _lib().tpurmShieldStatsGet(ctypes.byref(raw))
+    return ShieldStats(
+        seals=raw.seals, verifies=raw.verifies, mismatches=raw.mismatches,
+        refetch_saves=raw.refetchSaves, pages_poisoned=raw.pagesPoisoned,
+        pages_retired=raw.pagesRetired, scrub_ticks=raw.scrubTicks,
+        scrub_pages=raw.scrubPages, scrub_hits=raw.scrubHits,
+        inject_corrupts=raw.injectCorrupts,
+        inject_detected=raw.injectDetected,
+        inject_misses=raw.injectMisses,
+        wire_verifies=raw.wireVerifies,
+        wire_mismatches=raw.wireMismatches)
+
+
+def _buf_ptr_len(buf) -> tuple[int, int]:
+    a = np.asarray(buf)
+    if not a.flags.c_contiguous:
+        # ascontiguousarray would silently hand the C side a TEMPORARY
+        # copy: an injected flip would land in (and a verify would
+        # check) bytes the caller does not hold, permanently skewing
+        # the corrupts/detected reconciliation.
+        raise ValueError("shield wire ops need a C-contiguous buffer")
+    a = a.view(np.uint8)
+    return int(a.ctypes.data), int(a.nbytes)
+
+
+def crc32c(buf) -> int:
+    """CRC32C of a numpy array / buffer (hardware path when the CPU
+    has SSE4.2)."""
+    ptr, n = _buf_ptr_len(buf)
+    return int(_lib().tpurmShieldCrc32c(ptr, n))
+
+
+def crc32c_at(addr: int, length: int) -> int:
+    """CRC32C over raw process memory (engine windows)."""
+    return int(_lib().tpurmShieldCrc32c(addr, length))
+
+
+def inject_wire(buf, scope: int = 0) -> bool:
+    """One mem.corrupt evaluation over a wire buffer: a hit flips one
+    bit in place (the caller's verify MUST follow — that pairing keeps
+    the reconciliation invariant exact)."""
+    ptr, n = _buf_ptr_len(buf)
+    return bool(_lib().tpurmShieldInjectWire(ptr, n, scope))
+
+
+def verify_wire(buf, expect_crc: int, scope: int = 0) -> bool:
+    """CRC-verify a shipped buffer; False on mismatch (counted — the
+    caller re-fetches from its intact source)."""
+    ptr, n = _buf_ptr_len(buf)
+    return _lib().tpurmShieldVerifyWire(ptr, n, expect_crc & 0xFFFFFFFF,
+                                        scope) == 0
+
+
+def span_poisoned(addr: int, length: int) -> int:
+    """Poisoned pages inside the managed span (containment probe)."""
+    return int(_lib().tpurmShieldSpanPoisoned(addr, length))
+
+
+def scrub_now(max_pages: int = 4096) -> int:
+    """One synchronous scrub pass; returns pages scrubbed."""
+    return int(_lib().tpurmShieldScrubNow(max_pages))
+
+
+def retired_pages(dev: Optional[int] = None) -> int:
+    if dev is None:
+        return int(_lib().tpurmShieldRetiredTotal())
+    return int(_lib().tpurmShieldRetiredPages(dev))
+
+
+def span_retired(tier: int, dev: int, offset: int, length: int) -> bool:
+    """True when the arena span overlaps a retired (quarantined) page."""
+    return bool(_lib().tpurmShieldSpanRetired(tier, dev, offset, length))

@@ -398,15 +398,39 @@ def migrate_pages(backing, src: int, dst: int,
                     in_flight = 0
 
             if verify:
-                import numpy as np
+                # tpushield wire verification: per-record CRC32C sealed
+                # at the SOURCE and checked on the SHIPPED bytes (the
+                # raw byte-compare this replaces measured equality; the
+                # CRC is the same seal every other cold path carries,
+                # counted in the shared shield counters).  The
+                # mem.corrupt site gets one evaluation per record on
+                # the shipped copy; a mismatch re-ships the record from
+                # the intact source (the re-fetch ladder's wire rung),
+                # bounded — then transactional abort.
+                from . import shield as _shield
                 for page, off, _handle in staged:
                     src_off = int(backing.home_offset[page])
                     a = backing.record_raw(src, src_off)
                     b = backing.record_raw(dst, off)
-                    if not np.array_equal(a, b):
-                        raise VacAbort(
-                            f"page {page} verification mismatch after "
-                            f"ship (src {src} -> dst {dst})")
+                    seal = _shield.crc32c(a)
+                    scope = (src << 32) | dst
+                    _counter_add("vac_crc_verifies")
+                    _shield.inject_wire(b, scope)
+                    reshipped = 0
+                    while not _shield.verify_wire(b, seal, scope):
+                        _counter_add("vac_crc_mismatches")
+                        if reshipped >= 2:
+                            raise VacAbort(
+                                f"page {page} CRC mismatch persisted "
+                                f"after {reshipped} re-ships "
+                                f"(src {src} -> dst {dst})")
+                        reshipped += 1
+                        _counter_add("vac_crc_reships")
+                        ring.peer_copy(src, dst, src_off, off, rec_bytes,
+                                       flow=flow)
+                        ring.submit_and_wait(None)
+                        ring.completions(max_cqes=8, check=True)
+                        b = backing.record_raw(dst, off)
 
             # The manifest decides: generation moved / target lost /
             # route gone all reject here, and the source remains the

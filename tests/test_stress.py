@@ -181,7 +181,7 @@ for b in bufs:
 out["phase0_counters"] = inj.recovery_counters()
 out["phase0_evals"] = {k: v[0] for k, v in inj.stats().items()}
 
-# -------------- phase 1: chaos at 1%% across 10 sites ----------------
+# -------------- phase 1: chaos at 1%% across the site table ----------
 # Tracing ARMED for the whole chaos window: the soak must stay
 # corruption-free with every site emitting, every injected fault must
 # surface as an instant event, and every recovery-counter increment
@@ -195,7 +195,8 @@ SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MSGQ_PUBLISH, inj.Site.ICI_LINK,
          inj.Site.RDMA_COMPLETION, inj.Site.FENCE_TIMEOUT,
          inj.Site.MEMRING_SUBMIT, inj.Site.CE_COPY,
-         inj.Site.VAC_MIGRATE, inj.Site.HOT_DECIDE]
+         inj.Site.VAC_MIGRATE, inj.Site.HOT_DECIDE,
+         inj.Site.MEM_CORRUPT]
 for s in SITES:
     inj.enable(s, inj.Mode.PPM, 10000)
 # The reset.device site fires on the watchdog tick (100 ms period, so
@@ -225,13 +226,45 @@ def guard(fn):
     return run
 
 
+poisoned_reads = {"n": 0}
+
+
+def bad_pages_cancelled(b, bad_offs):
+    # residency() reports the PAGE containing the probed address, so
+    # the cancel probe must hit the bad bytes' OWN pages — sampling
+    # each 2 MB block's first page misses a quarantined/poisoned page
+    # deeper in the block (exactly where a real quarantine lands under
+    # this soak; probing at 4 KB granularity covers any uvm_page_size).
+    pages = np.unique(np.asarray(bad_offs, np.int64) >> 12)
+    return all(bool(b.residency(int(pg) << 12).cancelled) for pg in pages)
+
+
+def check_pattern(b, arr, val):
+    # A completed read must carry its pattern — UNLESS the page was
+    # quarantined (fatal fault) or tpushield-poisoned (an unrecoverable
+    # mem.corrupt flip): then the read lands on the zero poison mapping
+    # WITH the per-page cancel recorded.  Detected-and-contained
+    # corruption is tolerated; SILENT corruption (garbage bytes, or
+    # zeros without the cancel) never is.
+    bad = np.where(arr != val)[0]
+    if bad.size == 0:
+        return
+    assert bool((arr[bad] == 0).all()), \
+        "corrupt bytes reached a completed read"
+    # ELEMENT indices -> BYTE offsets (cbuf's float32 view is 4x).
+    assert bad_pages_cancelled(b, bad * arr.itemsize), \
+        "silent corruption: no cancel"
+    poisoned_reads["n"] += 1
+
+
 def hammer(idx):
     b, val = bufs[idx], idx + 1
 
     def body():
         b.device_access(dev=0, write=False)
         v = b.view()
-        assert int(v[0]) == val and int(v[4 * MB - 1]) == val
+        if int(v[0]) != val or int(v[4 * MB - 1]) != val:
+            check_pattern(b, v, val)
         b.migrate(Tier.HOST)
     return body
 
@@ -334,7 +367,8 @@ def memring_hammer():
     mr_stats["reaped"] += len(cqes)
     mr_stats["error_cqes"] += sum(1 for c in cqes if not c.ok)
     v = mbuf.view()
-    assert int(v[0]) == 0x4D and int(v[4 * MB - 1]) == 0x4D
+    if int(v[0]) != 0x4D or int(v[4 * MB - 1]) != 0x4D:
+        check_pattern(mbuf, v, 0x4D)
 
 
 # Compressed-range actor: a COMPRESSIBLE (fp8) buffer filled with a
@@ -352,7 +386,8 @@ def compress_cycle():
     cbuf.migrate(Tier.HBM)
     cbuf.migrate(Tier.HOST)
     v = cbuf.view(np.float32)
-    assert float(v[0]) == 64.0 and float(v[-1]) == 64.0
+    if float(v[0]) != 64.0 or float(v[-1]) != 64.0:
+        check_pattern(cbuf, v, np.float32(64.0))
 
 
 rbuf = vs.alloc(2 * MB)
@@ -423,18 +458,34 @@ out["hot_decide"] = {
 out["errors"] = errors
 out["tolerated"] = tolerated["n"]
 
-# Zero corruption: every checksummed byte of every managed buffer still
-# carries its pattern after the chaos — including the COMPRESSED range
-# (fp8-exact fill, so lossy transport must reproduce it bit-exact).
+# Zero SILENT corruption: every byte of every managed buffer either
+# carries its pattern or belongs to a tpushield-poisoned page (zeros +
+# the recorded cancel — detected and contained, never silently wrong).
+# The compressed range included (fp8-exact fill, so lossy transport
+# must reproduce it bit-exact).
 intact = True
-for i, b in enumerate(bufs):
-    if not (b.view() == i + 1).all():
+final_poisoned = 0
+for b, val in ([(b_, i + 1) for i, b_ in enumerate(bufs)] +
+               [(rbuf, 0xA5), (mbuf, 0x4D)]):
+    v = b.view()
+    bad = np.where(v != val)[0]
+    if bad.size == 0:
+        continue
+    if bool((v[bad] == 0).all()) and bad_pages_cancelled(b, bad):
+        final_poisoned += 1
+    else:
         intact = False
-intact = intact and bool((rbuf.view() == 0xA5).all())
-intact = intact and bool((mbuf.view() == 0x4D).all())
-intact = intact and bool(
-    (cbuf.view(np.float32) == np.float32(64.0)).all())
+cv = cbuf.view(np.float32)
+cbad = np.where(cv != np.float32(64.0))[0]
+if cbad.size:
+    if bool((cv[cbad] == 0).all()) and \
+            bad_pages_cancelled(cbuf, cbad * 4):
+        final_poisoned += 1
+    else:
+        intact = False
 out["data_intact"] = intact
+out["poisoned_buffers"] = final_poisoned
+out["poisoned_reads"] = poisoned_reads["n"]
 
 # tpuce reconciliation: exact invariant — every ce.copy inject hit
 # either became a bounded stripe retry or a terminal stripe error —
@@ -482,6 +533,36 @@ out["spine"] = {
     "ici": utils.counter("memring_internal_sqes[ici]"),
     "migrate": utils.counter("memring_internal_sqes[migrate]"),
     "inline": utils.counter("memring_internal_inline"),
+}
+
+# tpushield reconciliation (14th site, mem.corrupt — the first site
+# that CORRUPTS rather than fails).  Freeing the buffers first drains
+# every still-sealed page through its unseal-verify hook, so the
+# invariant is EXACT at this quiescent point: every flip the chaos
+# landed was either caught by a verify (detected) or poisoned its page
+# (also detected) — zero escaped (misses), zero retired spans ever
+# re-allocated.
+for b in bufs:
+    b.free()
+mbuf.free()
+cbuf.free()
+rbuf.free()
+from open_gpu_kernel_modules_tpu.uvm import shield as shd
+
+sh = shd.stats()
+mc_evals, mc_hits = inj.counts(inj.Site.MEM_CORRUPT)
+out["shield"] = {
+    "evals": mc_evals,
+    "hits": mc_hits,
+    "corrupts": sh.inject_corrupts,
+    "detected": sh.inject_detected,
+    "misses": sh.inject_misses,
+    "saves": sh.refetch_saves,
+    "pages_poisoned": sh.pages_poisoned,
+    "pages_retired": sh.pages_retired,
+    "wire_verifies": sh.wire_verifies,
+    "wire_mismatches": sh.wire_mismatches,
+    "realloc": utils.counter("shield_retired_realloc"),
 }
 
 # Trace accounting for the armed chaos window (before phase 2 so the
@@ -631,9 +712,23 @@ out["injected_resets"] = rst.injected_resets
 out["stale_completions"] = rst.stale_completions
 
 out["chaos_states"] = chaos_states
-out["finished_match"] = sorted(chaos_toks) == sorted(ref_toks)
+# tpushield containment under the 14-site chaos: a mem.corrupt flip
+# that survives the re-fetch ladder poisons a KV page, and the OWNING
+# stream retires terminal-with-error — so the chaos run's finished set
+# is the reference's minus exactly the poisoned streams, and every
+# stream that DID finish is bit-identical (co-tenants untouched).
+err_rids = sorted(r for r, st_ in chaos_states.items() if st_ == "error")
+out["error_rids"] = err_rids
+# A poison can land on a stream the run was ABOUT to cancel (rids in
+# CANCEL): it is then terminal-with-error instead of cancelled (ERROR
+# is terminal — the later cancel() no-ops), so the finished set is the
+# reference's minus the NON-cancel poisons only.
+err_noncancel = [r for r in err_rids if r not in CANCEL]
+out["err_noncancel"] = len(err_noncancel)
+out["finished_match"] = \
+    sorted(list(chaos_toks) + err_noncancel) == sorted(ref_toks)
 out["tokens_identical"] = all(chaos_toks[r] == ref_toks[r]
-                              for r in ref_toks)
+                              for r in chaos_toks)
 out["rep"] = {k: rep[k] for k in
               ("admitted", "retired", "preempted", "restored",
                "cancelled", "admit_retries", "admit_sheds",
@@ -659,6 +754,26 @@ out["spine"] = {
     "ici": _utils.counter("memring_internal_sqes[ici]"),
     "migrate": _utils.counter("memring_internal_sqes[migrate]"),
 }
+# tpushield reconciliation (14th site): run_once closed the scheduler,
+# which freed the KV backing and drained every still-sealed page
+# through its unseal-verify hook — the invariant is exact here.
+from open_gpu_kernel_modules_tpu.uvm import shield as shd
+
+sh = shd.stats()
+mc_evals, mc_hits = inj.counts(inj.Site.MEM_CORRUPT)
+out["shield"] = {
+    "evals": mc_evals,
+    "hits": mc_hits,
+    "corrupts": sh.inject_corrupts,
+    "detected": sh.inject_detected,
+    "misses": sh.inject_misses,
+    "pages_poisoned": sh.pages_poisoned,
+    "pages_retired": sh.pages_retired,
+    "poisoned_streams": rep.get("poisoned", 0),
+    "poisoned_retired": _utils.counter("tpusched_poisoned_retired"),
+    "slots_retired": _utils.counter("tpusched_seq_slots_retired"),
+    "realloc": _utils.counter("shield_retired_realloc"),
+}
 print(json.dumps(out))
 """
 
@@ -681,7 +796,10 @@ def test_sched_soak_injection():
     assert proc.returncode == 0, proc.stderr[-4000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
 
-    # Zero token corruption: same finished set, bit-identical streams.
+    # Zero token corruption: every stream that finished is
+    # bit-identical to its uninjected run, and the finished set is the
+    # reference's minus exactly the poison-retired streams (tpushield
+    # containment: a corrupted KV page costs only its owning stream).
     assert out["finished_match"], out
     assert out["tokens_identical"], out
 
@@ -694,15 +812,36 @@ def test_sched_soak_injection():
     assert rep_r["device_resets_observed"] >= 3, out
     assert out["reset_mttr_ms"] > 0, out
 
-    # Balanced accounting at idle: every submitted stream is either
-    # retired or cancelled, every preemption was restored or its
-    # stream cancelled, and nothing is left queued/running.
+    # Balanced accounting at idle: every submitted stream is retired,
+    # cancelled, or poison-retired (terminal-with-error), every
+    # preemption was restored or its stream terminal, and nothing is
+    # left queued/running.
     rep = out["rep"]
-    assert rep["retired"] + rep["cancelled"] == 8, rep
-    assert rep["finished"] == rep["retired"] == 6, rep
+    po = out["shield"]["poisoned_streams"]
+    pn = out["err_noncancel"]      # poisons NOT on a to-be-cancelled rid
+    assert rep["retired"] + rep["cancelled"] + po == 8, (rep, po)
+    assert rep["finished"] == rep["retired"] == 6 - pn, (rep, po, pn)
     assert rep["restored"] <= rep["preempted"], rep
     states = set(out["chaos_states"].values())
-    assert states <= {"finished", "cancelled"}, out["chaos_states"]
+    assert states <= {"finished", "cancelled", "error"}, \
+        out["chaos_states"]
+    assert len(out["error_rids"]) == po, out
+
+    # tpushield reconciliation, EXACT at quiescence: every mem.corrupt
+    # hit flipped a byte, every flip was detected, zero escaped.  A
+    # poisoned stream retired its sequence SLOT with it (the backing
+    # span never serves a new stream) and never cost a device reset —
+    # the resets observed are exactly the forced + injected ones.
+    shd = out["shield"]
+    assert shd["hits"] == shd["corrupts"], shd
+    assert shd["corrupts"] == shd["detected"] + shd["misses"], shd
+    assert shd["misses"] == 0, shd
+    assert shd["realloc"] == 0, shd
+    assert shd["poisoned_retired"] == shd["slots_retired"] == po, shd
+    if po:
+        assert shd["pages_poisoned"] > 0 and shd["pages_retired"] > 0, shd
+    assert out["resets_during_chaos"] == \
+        rep["forced_resets"] + out["injected_resets"], out
 
     # The admission gate was really evaluated under chaos, and the
     # injection fired across several sites.
@@ -877,7 +1016,7 @@ def test_client_death_reclamation():
 
 
 def test_engine_soak_injection():
-    """Chaos soak (acceptance): ~1% injection across ALL 13 sites at a
+    """Chaos soak (acceptance): ~1% injection across ALL 14 sites at a
     fixed seed, with tracing ARMED for the whole chaos window; the soak
     completes with zero corruption, every recovery counter is nonzero,
     every injected fault surfaces as an instant trace event, each
@@ -903,7 +1042,25 @@ def test_engine_soak_injection():
     # Chaos completed: no hung actors, no data-integrity errors.
     assert out["hung"] == 0
     assert out["errors"] == [], out["errors"][:3]
-    assert out["data_intact"], "managed data corrupted under chaos"
+    assert out["data_intact"], "SILENT corruption reached a read"
+
+    # tpushield reconciliation (mem.corrupt, the 14th site): the site
+    # evaluated under the chaos, every hit flipped a real byte, and
+    # after the quiescing drain EVERY flip was detected — misses are
+    # the coverage-hole detector and must be exactly zero.  Retired
+    # (quarantined) spans never re-entered circulation.
+    shd = out["shield"]
+    assert shd["evals"] > 0, shd
+    assert shd["hits"] == shd["corrupts"], shd
+    assert shd["corrupts"] == shd["detected"] + shd["misses"], shd
+    assert shd["misses"] == 0, shd
+    assert shd["realloc"] == 0, shd
+    # Containment accounting: every poisoned read the actors tolerated
+    # is backed by a poisoned page (never the other way around — a
+    # zeroed read without a poison would be silent loss).
+    if out["poisoned_reads"] or out["poisoned_buffers"]:
+        assert shd["pages_poisoned"] > 0, (out["poisoned_reads"], shd)
+        assert shd["pages_retired"] > 0, shd
 
     # The chaos genuinely fired across >= 5 distinct sites.
     fired = [k for k, h in out["hits"].items() if h > 0]
@@ -1020,3 +1177,671 @@ def test_engine_soak_injection():
     # the residency surface reports the cancellation.
     assert out["poisoned_read"] == 0
     assert out["sac_cancelled"]
+
+
+# ------------------------------------------------- tpushield corruption soak
+
+_CORRUPT_SOAK = r"""
+import ctypes
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, %(repo)r)
+
+import numpy as np
+
+from open_gpu_kernel_modules_tpu import utils, uvm
+from open_gpu_kernel_modules_tpu.runtime import ici, native
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, shield
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+KB = 1 << 10
+MB = 1 << 20
+lib = native.load()
+out = {}
+vs = uvm.VaSpace()
+
+errors = []
+silent = []
+poisoned_reads = {"n": 0}
+stop = threading.Event()
+deadline = time.monotonic() + 3.5
+
+
+def guard(fn):
+    def run():
+        while not stop.is_set() and time.monotonic() < deadline:
+            try:
+                fn()
+            except native.RmError:
+                pass                    # bounded-retry exhaustion
+            except Exception as e:      # pragma: no cover
+                errors.append(repr(e))
+                stop.set()
+    return run
+
+
+PAGE = 4096
+
+
+def checked_read(b, val):
+    # A completed read either carries its pattern or hit a poisoned /
+    # quarantined page: zeros WITH the cancel recorded ON EXACTLY the
+    # zeroed pages (the probe is per-page — a buffer-offset-0 check
+    # would miss a poison deeper in the span).  Anything else is
+    # silent corruption — the one thing this soak exists to rule out.
+    v = b.view()
+    badix = np.nonzero(v != val)[0]
+    if badix.size == 0:
+        return
+    bad = v[badix]
+    if bool((bad == 0).all()):
+        pages = {int(ix) // PAGE for ix in (badix[0], badix[-1])}
+        pages.update(int(ix) // PAGE for ix in badix[::PAGE])
+        if all(b.residency(p * PAGE).cancelled for p in pages):
+            poisoned_reads["n"] += 1
+            return
+    nz = bad[bad != 0]
+    silent.append((val, int(nz[0]) if nz.size else 0, int(bad.size)))
+    raise AssertionError("corrupt bytes reached a completed read")
+
+
+# ALL 14 sites armed (0.2%% chaos floor) with mem.corrupt riding at
+# PPM 4096 — one single-bit flip per ~256 sealed 4 KiB pages, i.e.
+# ~1 ppm of sealed BYTES, across tier demotes, ICI wires and scrub.
+inj.set_seed(77)
+for s_ in inj.Site:
+    inj.enable(s_, inj.Mode.PPM, 2000)
+inj.enable(inj.Site.MEM_CORRUPT, inj.Mode.PPM, 4096)
+
+# Actor SAVE: read-duplicated pages parked on CXL — a flipped seal is
+# caught on the next service and re-fetched from the host sibling
+# (ladder rung 2), so this buffer's reads stay pattern-perfect.
+bufA = vs.alloc(1 * MB)
+bufA.view()[:] = 0x33
+bufA.set_read_duplication(True)
+bufA.set_preferred(Tier.CXL)
+
+
+def save_cycler():
+    bufA.device_access(dev=0, write=False)
+    checked_read(bufA, 0x33)
+
+
+def poison_cycler():
+    # Exclusive CXL demotes: no sibling, so an unlucky flip POISONS —
+    # the read then shows zeros + the cancel, never silent garbage.
+    q = vs.alloc(256 * KB)
+    try:
+        q.view()[:] = 0xA7
+        q.migrate(Tier.CXL)
+        checked_read(q, 0xA7)
+    finally:
+        q.free()
+
+
+def churn_cycler():
+    # Allocation churn across the quarantine list: retired spans must
+    # never re-enter circulation (shield_retired_realloc stays 0).
+    r = vs.alloc(256 * KB)
+    try:
+        r.view()[:] = 0x5E
+        r.migrate(Tier.CXL)
+        r.migrate(Tier.HBM)
+        checked_read(r, 0x5E)
+    finally:
+        r.free()
+
+
+def scrub_prober():
+    shield.scrub_now(256)
+    time.sleep(0.005)
+
+
+# Actor ICI: peer writes dev0 -> dev1 (single hop) and dev0 -> dev3
+# (multi-hop store-and-forward: per-hop CRC, corrupting hop attributed
+# to the LINK) with the wire flips caught + re-fetched in-path.
+lib.uvmHbmChunkAlloc.argtypes = [ctypes.c_uint32, ctypes.c_uint64,
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.POINTER(ctypes.c_void_p)]
+lib.uvmHbmChunkAlloc.restype = ctypes.c_uint32
+lib.uvmHbmChunkFree.argtypes = [ctypes.c_uint32, ctypes.c_void_p]
+lib.uvmHbmChunkFree.restype = ctypes.c_uint32
+offs, handles = [], []
+for d in range(4):
+    off = ctypes.c_uint64()
+    h = ctypes.c_void_p()
+    assert lib.uvmHbmChunkAlloc(d, 64 * KB, ctypes.byref(off),
+                                ctypes.byref(h)) == 0
+    offs.append(off.value)
+    handles.append(h)
+base0 = lib.tpurmDeviceHbmBase(lib.tpurmDeviceGet(0))
+ctypes.memset(base0 + offs[0], 0x3B, 64 * KB)
+ap01 = ici.PeerAperture(0, 1)
+ap03 = ici.PeerAperture(0, 3)
+
+
+def ici_cycler():
+    ap01.write(offs[0], offs[1], 64 * KB)
+    ap03.write(offs[0], offs[3], 64 * KB)
+
+
+threads = [threading.Thread(target=guard(f)) for f in
+           [save_cycler, poison_cycler, churn_cycler, scrub_prober,
+            ici_cycler]]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=180)
+stop.set()
+out["hung"] = sum(t.is_alive() for t in threads)
+inj.disable_all()
+
+# Drain the save buffer (unseal-verify resolves any pending flip).
+bufA.free()
+
+# Wire epilogue: one CLEAN final write per route, then byte-compare the
+# destinations — the in-path CRC verify + re-fetch must have kept every
+# landed byte exact (chaos-window flips were caught before completion).
+ap01.write(offs[0], offs[1], 64 * KB)
+ap03.write(offs[0], offs[3], 64 * KB)
+wire_ok = True
+for d in (1, 3):
+    bd = lib.tpurmDeviceHbmBase(lib.tpurmDeviceGet(d))
+    got = np.frombuffer((ctypes.c_char * (64 * KB)).from_address(
+        bd + offs[d]), np.uint8)
+    wire_ok = wire_ok and bool((got == 0x3B).all())
+out["wire_ok"] = wire_ok
+ap01.close()
+ap03.close()
+for d in range(4):
+    lib.uvmHbmChunkFree(d, handles[d])
+
+soak = shield.stats()
+out["soak"] = {"corrupts": soak.inject_corrupts,
+               "detected": soak.inject_detected,
+               "misses": soak.inject_misses,
+               "saves": soak.refetch_saves,
+               "poisoned": soak.pages_poisoned,
+               "wire_verifies": soak.wire_verifies,
+               "wire_mismatches": soak.wire_mismatches,
+               "scrub_ticks": soak.scrub_ticks,
+               "scrub_pages": soak.scrub_pages,
+               "seals": soak.seals}
+out["poisoned_reads"] = poisoned_reads["n"]
+
+# ---- deterministic anchors (the native shield_test recipes, driven
+# ---- end-to-end from Python so each ladder rung is PROVEN, not lucky)
+
+# (a) sibling save: read-duplicated CXL park, every sealed page
+# flipped, every one re-fetched from the host sibling — data perfect.
+s0 = shield.stats()
+bs = vs.alloc(64 * KB)
+bs.view()[:] = 0x44
+bs.set_read_duplication(True)
+bs.set_preferred(Tier.CXL)
+inj.enable(inj.Site.MEM_CORRUPT, inj.Mode.NTH, 1)
+bs.device_access(dev=0, write=False)        # seal + flip every page
+inj.disable_all()
+bs.device_access(dev=0, write=False)        # verify -> sibling save
+s1 = shield.stats()
+out["anchor_save"] = {
+    "flips": s1.inject_corrupts - s0.inject_corrupts,
+    "saves": s1.refetch_saves - s0.refetch_saves,
+    "poisoned": s1.pages_poisoned - s0.pages_poisoned,
+    "intact": bool((bs.view() == 0x44).all()),
+}
+bs.free()
+
+# (b) poison + retire: exclusive CXL demote with every page flipped —
+# no recovery source, so every page poisons, reads zeros with the
+# cancel, and the backing spans land on the quarantine list.
+s0 = shield.stats()
+bp = vs.alloc(64 * KB)
+bp.view()[:] = 0x77
+inj.enable(inj.Site.MEM_CORRUPT, inj.Mode.NTH, 1)
+bp.migrate(Tier.CXL)
+inj.disable_all()
+vbp = bp.view()                             # lazy: faults on the READ
+# Consume the bytes FIRST: the numpy view faults page by page as it is
+# read (fault -> verify -> ladder -> poison), so the stats snapshot
+# must come after the read or the deltas miss every poison.
+zeros = bool((vbp == 0).all())
+s1 = shield.stats()
+out["anchor_poison"] = {
+    "flips": s1.inject_corrupts - s0.inject_corrupts,
+    "poisoned": s1.pages_poisoned - s0.pages_poisoned,
+    "retired": s1.pages_retired - s0.pages_retired,
+    "zeros": zeros,
+    "cancelled": all(bp.residency(p * PAGE).cancelled
+                     for p in range(16)),
+    "retired_gauge": shield.retired_pages(),
+}
+bp.free()
+
+# (c) scrub-first detection: seal a flipped page by evicting the HBM
+# arena, then let the scrubber catch it BEFORE any demand fault.
+s0 = shield.stats()
+bq = vs.alloc(64 * KB)
+bq.view()[:] = 0x66
+bq.migrate(Tier.HBM)
+lib.uvmTierEvictBytes.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
+                                  ctypes.c_uint64]
+lib.uvmTierEvictBytes.restype = ctypes.c_uint64
+inj.enable(inj.Site.MEM_CORRUPT, inj.Mode.NTH, 1)
+lib.uvmTierEvictBytes(int(Tier.HBM), 0, 1 << 30)   # demote: seal + flip
+inj.disable_all()
+scrubbed = 0
+for _ in range(8):
+    scrubbed += shield.scrub_now(4096)
+s1 = shield.stats()
+out["anchor_scrub"] = {
+    "flips": s1.inject_corrupts - s0.inject_corrupts,
+    "scrubbed": scrubbed,
+    "scrub_hits": s1.scrub_hits - s0.scrub_hits,
+    "detected": s1.inject_detected - s0.inject_detected,
+}
+bq.free()
+
+# (d) retirement holds: grind fresh allocations through the tiers the
+# poisons landed in — no fresh chunk may overlap a retired span.
+for i in range(8):
+    g = vs.alloc(64 * KB)
+    g.view()[:] = i + 1
+    g.migrate(Tier.CXL)
+    g.migrate(Tier.HBM)
+    assert bool((g.view() == i + 1).all())
+    g.free()
+out["realloc"] = utils.counter("shield_retired_realloc")
+
+# ---- final EXACT reconciliation at quiescence --------------------------
+fin = shield.stats()
+mc_evals, mc_hits = inj.counts(inj.Site.MEM_CORRUPT)
+out["final"] = {
+    "evals": mc_evals,
+    "hits": mc_hits,
+    "corrupts": fin.inject_corrupts,
+    "detected": fin.inject_detected,
+    "misses": fin.inject_misses,
+    "saves": fin.refetch_saves,
+    "poisoned": fin.pages_poisoned,
+    "retired": fin.pages_retired,
+    "retired_gauge": shield.retired_pages(),
+    "scrub_hits": fin.scrub_hits,
+    "wire_verifies": fin.wire_verifies,
+}
+out["errors"] = errors
+out["silent"] = silent
+print(json.dumps(out))
+"""
+
+
+def test_corruption_soak():
+    """tpushield acceptance soak: mem.corrupt flips bits at ~1 ppm of
+    sealed bytes across tier demotes, ICI wires (single- and
+    multi-hop) and the scrubber window, with ALL 14 sites armed.
+    Zero corrupt bytes ever reach a completed read — every flip is
+    DETECTED (verify mismatch -> re-fetch ladder -> poison+retire as a
+    last resort), exactly reconciled (hits == detected + misses with
+    misses == 0), and retired spans never re-allocate.  Deterministic
+    anchors then prove each ladder rung individually: sibling save,
+    poison + retire + zeros-with-cancel, and scrub-before-fault."""
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    env["TPUMEM_FAKE_HBM_MB"] = "64"
+    env["TPUMEM_UVM_PAGE_SIZE"] = "4096"
+    script = _CORRUPT_SOAK % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # The soak ran clean: no hung actors, no tolerated-but-unexplained
+    # failures, and NOT ONE silently corrupt byte in a completed read.
+    assert out["hung"] == 0
+    assert out["errors"] == [], out["errors"][:3]
+    assert out["silent"] == [], out["silent"]
+    assert out["wire_ok"], "ICI destination bytes corrupted"
+
+    # The corruption genuinely flowed (detection, not absence): flips
+    # landed during the chaos window and wire verifies ran.
+    soak = out["soak"]
+    assert soak["seals"] > 0 and soak["wire_verifies"] > 0, soak
+    assert soak["corrupts"] > 0, soak
+
+    # Anchor (a): every flip on the read-duplicated park was saved
+    # from the sibling; nothing poisoned; bytes perfect.
+    a = out["anchor_save"]
+    assert a["flips"] > 0, a
+    assert a["saves"] >= a["flips"], a
+    assert a["poisoned"] == 0 and a["intact"], a
+
+    # Anchor (b): every flip on the exclusive park poisoned + retired;
+    # reads are zeros WITH the cancel; the per-device gauge moved.
+    b = out["anchor_poison"]
+    assert b["flips"] > 0, b
+    assert b["poisoned"] == b["flips"], b
+    assert b["retired"] == b["flips"], b
+    assert b["zeros"] and b["cancelled"], b
+    assert b["retired_gauge"] > 0, b
+
+    # Anchor (c): the scrubber caught the sealed flip BEFORE any
+    # demand fault touched the span.
+    c = out["anchor_scrub"]
+    assert c["flips"] > 0 and c["scrubbed"] > 0, c
+    assert c["scrub_hits"] >= c["flips"], c
+    assert c["detected"] >= c["flips"], c
+
+    # Retired spans never re-entered circulation.
+    assert out["realloc"] == 0, out
+
+    # EXACT reconciliation at quiescence: every hit flipped a byte,
+    # every flip was detected, zero escaped every verify hook.
+    f = out["final"]
+    assert f["hits"] == f["corrupts"], f
+    assert f["corrupts"] == f["detected"] + f["misses"], f
+    assert f["misses"] == 0, f
+    assert f["retired_gauge"] == f["retired"], f
+    assert f["saves"] > 0 and f["poisoned"] > 0, f
+
+
+_CORRUPT_SCHED = r"""
+import json
+import os
+import sys
+
+sys.path.insert(0, %(repo)r)
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=4")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from open_gpu_kernel_modules_tpu import utils
+from open_gpu_kernel_modules_tpu.models import llama, multichip
+from open_gpu_kernel_modules_tpu.runtime import sched
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, reset, shield
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+cfg = llama.LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
+    max_seq_len=128, dtype=jnp.float32)
+params = llama.init_params(cfg, jax.random.key(0))
+rng = np.random.default_rng(23)
+prompts = [rng.integers(0, 256, size=16) for _ in range(8)]
+out = {}
+
+
+def build():
+    s = sched.Scheduler(cfg, params, max_seqs=4, max_len=64,
+                        page_size=16, oversub=4, tokens_per_round=4)
+    reqs = [s.submit(p, max_new_tokens=12, tenant=i %% 2)
+            for i, p in enumerate(prompts)]
+    return s, reqs
+
+
+def finish(s, reqs, hook=None):
+    rounds = 0
+    while not s.idle and rounds < 5000:
+        if hook:
+            hook()
+        s.step()
+        rounds += 1
+    toks = {r.rid: r.tokens.tolist() for r in reqs
+            if r.state is sched.RequestState.FINISHED}
+    states = {r.rid: r.state.value for r in reqs}
+    return toks, states, rounds
+
+
+# ---- reference: same 8 streams, no injection -------------------------
+s, reqs = build()
+ref_toks, ref_states, _ = finish(s, reqs)
+s.close()
+assert len(ref_toks) == 8, ref_states
+
+# ---- poisoned run: one-shot mem.corrupt flips under oversub churn ----
+# The one-shots are VA-SCOPED to KV-arena pages: the seal evaluation
+# carries scope = page VA, while wire CRC evaluations carry a link
+# scope — so the shots can only fire on a KV eviction copy-back seal
+# (an EXCLUSIVE demoted page with no sibling copy), where the ladder
+# has no recovery source and the read-back must POISON.  An unscoped
+# shot would get eaten by the first wire eval, which recovers by
+# design and never errors a stream.  The hook re-arms only while no
+# stream has errored and the previous flips have fully resolved, so
+# containment is proven on a BOUNDED, attributable corruption.
+resets0 = reset.stats().resets
+s, reqs = build()
+inj.set_seed(5)
+shots = {"n": 0}
+PAGE = 4096
+
+# The managed KV backing read-DUPLICATES its pool: every CXL park
+# keeps a host sibling, so the ladder refetch-SAVES every seal flip
+# (the soak's anchor (a) proves that rung).  Containment needs the
+# no-sibling serving config — duplication off, demotes exclusive —
+# where an unrecovered flip MUST poison and error its owning stream.
+for _buf in (s.cache.backing.k_buf, s.cache.backing.v_buf):
+    _buf.set_read_duplication(False)
+    _buf.migrate(Tier.CXL)      # collapse existing duplicates: exclusive
+
+
+def errored():
+    return [r.rid for r in reqs
+            if r.state is sched.RequestState.ERROR]
+
+
+def hook():
+    # Bounded, deterministic corruption with a GUARANTEED re-read:
+    # force-park one RUNNING stream first (its clean device slots just
+    # drop, so the backing copy becomes the ONLY copy), then arm a
+    # VA-scoped one-shot on its first backing KV page and seal the
+    # pool with a pressure park (the same CXL demote memory pressure
+    # or an evacuation would do).  The stream's own restore prefetch
+    # MUST re-read the flipped seal — no device-slot copy survives the
+    # park to quietly serve decode — and with no sibling the ladder
+    # has no recovery source: POISON, and the owning stream retires
+    # terminal-with-error.
+    if errored() or shots["n"] >= 3:
+        return
+    st = shield.stats()
+    if st.inject_corrupts != st.inject_detected + st.inject_misses:
+        return
+    targets = [r for r in s._running.values()
+               if r.seq is not None and int(s.cache.seq_lens[r.seq]) > 0]
+    if not targets:
+        return
+    t = targets[0]
+    kb = s.cache.backing.k_buf
+    rec = s.cache.backing.rec_bytes
+    off = (t.seq * s.cache.pages_per_seq * rec) & ~(PAGE - 1)
+    s._preempt(t)                   # park: backing is the only copy
+    try:
+        inj.arm_oneshot(inj.Site.MEM_CORRUPT, scope=kb.address + off)
+    except Exception:
+        return                      # arm slots full: enough in flight
+    kb.migrate(Tier.CXL)            # pressure park: seal + fire the shot
+    shots["n"] += 1
+
+
+chaos_toks, chaos_states, rounds = finish(s, reqs, hook=hook)
+inj.disable_all()
+err_rids = errored()
+out["rounds"] = rounds
+out["shots"] = shots["n"]
+out["chaos_states"] = chaos_states
+out["error_rids"] = err_rids
+out["tokens_identical"] = all(chaos_toks[r] == ref_toks[r]
+                              for r in chaos_toks)
+out["finished_plus_poisoned"] = \
+    sorted(list(chaos_toks) + err_rids) == sorted(ref_toks)
+out["resets_delta"] = reset.stats().resets - resets0
+out["poisoned_retired"] = utils.counter("tpusched_poisoned_retired")
+out["slots_retired"] = utils.counter("tpusched_seq_slots_retired")
+rep = s.report(1.0)
+out["rep"] = {k: rep.get(k, 0) for k in
+              ("retired", "cancelled", "finished", "poisoned")}
+s.close()
+
+# ---- retirement holds across a FRESH scheduler -----------------------
+# The poisoned backing spans are quarantined; a brand-new scheduler on
+# the same arena must decode all 8 streams clean and bit-identical.
+s, reqs = build()
+clean_toks, clean_states, _ = finish(s, reqs)
+s.close()
+out["clean_identical"] = (sorted(clean_toks) == sorted(ref_toks) and
+                          all(clean_toks[r] == ref_toks[r]
+                              for r in ref_toks))
+out["realloc"] = utils.counter("shield_retired_realloc")
+
+# ---- vac shipping window: per-record wire CRC under mem.corrupt ------
+cfg2 = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=64)
+cfg2 = type(cfg2)(**{**cfg2.__dict__, "dtype": jnp.float32})
+params2 = llama.init_params(cfg2, jax.random.key(1))
+prompts2 = [rng.integers(0, 128, size=12) for _ in range(6)]
+
+
+def build_mc():
+    cache = multichip.make_multichip_cache(cfg2, batch=6, max_len=64,
+                                           page_size=8, oversub=2,
+                                           n_devices=4)
+    s2 = sched.Scheduler(cfg2, params2, max_seqs=6, max_len=64,
+                         page_size=8, oversub=2, tokens_per_round=4,
+                         cache=cache)
+    reqs2 = [s2.submit(p, max_new_tokens=16, tenant=i %% 2)
+             for i, p in enumerate(prompts2)]
+    return s2, reqs2
+
+
+s2, reqs2 = build_mc()
+ref2_toks, ref2_states, _ = finish(s2, reqs2)
+s2.close()
+
+s2, reqs2 = build_mc()
+for _ in range(3):
+    s2.step()
+v0 = {n: utils.counter(n) for n in
+      ("vac_crc_verifies", "vac_crc_mismatches", "vac_crc_reships",
+       "vac_aborts")}
+inj.enable(inj.Site.MEM_CORRUPT, inj.Mode.NTH, 2)
+rep1 = s2.evacuate_device(1, 2)
+inj.disable_all()
+out["evac_pages"] = rep1.pages if rep1 is not None else 0
+out["vac"] = {n: utils.counter(n) - v0[n] for n in v0}
+evac_toks, evac_states, _ = finish(s2, reqs2)
+s2.close()
+out["evac_identical"] = (sorted(evac_toks) == sorted(ref2_toks) and
+                         all(evac_toks[r] == ref2_toks[r]
+                             for r in ref2_toks))
+
+# ---- final EXACT reconciliation --------------------------------------
+fin = shield.stats()
+mc_evals, mc_hits = inj.counts(inj.Site.MEM_CORRUPT)
+out["final"] = {
+    "evals": mc_evals, "hits": mc_hits,
+    "corrupts": fin.inject_corrupts, "detected": fin.inject_detected,
+    "misses": fin.inject_misses, "poisoned": fin.pages_poisoned,
+    "retired": fin.pages_retired,
+    "wire_mismatches": fin.wire_mismatches,
+}
+print(json.dumps(out))
+"""
+
+
+def test_corruption_sched_containment():
+    """tpushield serving containment: a mem.corrupt flip that survives
+    the ladder poisons a KV page and the OWNING stream alone retires
+    terminal-with-error — its sequence slot retired with it, no device
+    reset, co-tenant streams bit-identical — while a fresh scheduler
+    on the same (quarantined) arena then decodes everything clean, and
+    a vac shipping window under the same site re-ships flipped records
+    from the intact source (zero corrupt bytes into any decode)."""
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    env["TPUMEM_FAKE_HBM_MB"] = "128"
+    script = _CORRUPT_SCHED % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Containment: >= 1 stream poisoned terminal-with-error, but never
+    # the whole fleet — decode survived and the rest finished.
+    nerr = len(out["error_rids"])
+    assert nerr >= 1, out
+    assert nerr < 8, out
+    states = set(out["chaos_states"].values())
+    assert states <= {"finished", "error"}, out["chaos_states"]
+
+    # Every finished stream is bit-identical to its uninjected run,
+    # and the finished set is the reference's minus exactly the
+    # poisoned streams (a poisoned page costs ONLY its owner).
+    assert out["tokens_identical"], out
+    assert out["finished_plus_poisoned"], out
+
+    # The poison cost: stream retired with an ERROR status, its
+    # sequence slot retired with it — and NEVER a device reset.
+    assert out["resets_delta"] == 0, out
+    assert out["poisoned_retired"] == nerr, out
+    assert out["slots_retired"] == nerr, out
+    assert out["rep"]["poisoned"] == nerr, out
+    assert out["rep"]["retired"] + nerr == 8, out
+
+    # Retirement holds: the fresh scheduler decoded all 8 streams
+    # bit-identical on the same arena, and no retired span was ever
+    # handed back out.
+    assert out["clean_identical"], out
+    assert out["realloc"] == 0, out
+
+    # vac shipping window: records flipped on the wire were caught by
+    # the per-record CRC and re-shipped from the intact source — the
+    # evacuation completed, nothing aborted, and the evacuated decode
+    # stayed bit-identical.
+    assert out["evac_pages"] > 0, out
+    vac = out["vac"]
+    assert vac["vac_crc_verifies"] > 0, vac
+    assert vac["vac_crc_mismatches"] > 0, vac
+    assert vac["vac_crc_reships"] == vac["vac_crc_mismatches"], vac
+    assert vac["vac_aborts"] == 0, vac
+    assert out["evac_identical"], out
+
+    # EXACT reconciliation over the whole choreography.
+    f = out["final"]
+    assert f["hits"] == f["corrupts"], f
+    assert f["corrupts"] == f["detected"] + f["misses"], f
+    assert f["misses"] == 0, f
+    assert f["poisoned"] >= nerr, f
+
+
+# --------------------------------------------------- check-inject lint
+
+
+def _run_check_inject(extra_env=None):
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        ["make", "-C", os.path.join(_REPO, "native"), "check-inject"],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_check_inject_lint_passes():
+    """Every site in the inject table is armed in a chaos soak here
+    AND documented in the README inject table."""
+    proc = _run_check_inject()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check-inject OK" in proc.stdout
+
+
+def test_check_inject_lint_negative():
+    """A site present in code but never armed in a soak (or never
+    documented) MUST fail the lint (CHECK_INJECT_EXTRA injects one)."""
+    proc = _run_check_inject(
+        {"CHECK_INJECT_EXTRA": "bogus.unarmed_site_xyz"})
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "bogus.unarmed_site_xyz" in proc.stdout + proc.stderr

@@ -39,7 +39,7 @@ METRICS_INVENTORY = [
     "ib_mr_invalidations", "ib_mr_registrations", "ici_degraded_routes",
     "ici_hop_bytes", "ici_link_flaps", "ici_links_trained",
     "ici_multihop_copies", "ici_peer_apertures", "ici_peer_copy_bytes",
-    "ici_reset_retrains", "ici_retrain_failures",
+    "ici_reset_retrains", "ici_retrain_failures", "ici_wire_crc_errors",
     "memring_coalesced_sqes", "memring_cq_overflows", "memring_cqes",
     "memring_deadline_expired", "memring_dep_cancelled",
     "memring_dep_stalls", "memring_error_cqes", "memring_fences",
@@ -62,7 +62,11 @@ METRICS_INVENTORY = [
     "recover_msgq_retries", "recover_page_quarantines",
     "recover_rc_resets", "recover_rdma_retries", "recover_retries",
     "recover_tier_fallbacks", "rm_events_allocated",
-    "rm_events_delivered", "rm_memory_maps", "tier_tenant_binds",
+    "rm_events_delivered", "rm_memory_maps",
+    "shield_detected", "shield_inject_corrupts", "shield_inject_misses",
+    "shield_retire_overflow", "shield_retired_realloc",
+    "shield_wire_mismatches",
+    "shield_wire_verifies", "tier_tenant_binds",
     "tier_tenant_configs", "tier_tenant_evictions",
     "tier_tenant_over_quota_evictions", "tier_tenant_slo_reorders",
     "tpuce_compressed_bytes_in", "tpuce_compressed_bytes_out",
@@ -80,8 +84,12 @@ METRICS_INVENTORY = [
     "tpurm_hot_device_score", "tpurm_hot_pins",
     "tpurm_hot_prefetch_grown", "tpurm_hot_prefetch_shrunk",
     "tpurm_hot_thrash_pages", "tpurm_hot_throttle_delays",
-    "tpurm_hot_throttles", "tpurm_reset_failed",
+    "tpurm_hot_throttles", "tpurm_pages_retired", "tpurm_reset_failed",
     "tpurm_reset_injected", "tpurm_reset_mttr_ns", "tpurm_reset_total",
+    "tpurm_scrub_hits", "tpurm_scrub_pages", "tpurm_scrub_ticks",
+    "tpurm_shield_mismatches", "tpurm_shield_pages_poisoned",
+    "tpurm_shield_pages_retired", "tpurm_shield_refetch_saves",
+    "tpurm_shield_seals", "tpurm_shield_verifies",
     "tpurm_slo_blame_ns", "tpurm_tenant_pages",
     "tpurm_tenant_quota_pages", "tpurm_tenant_rebinds",
     "tpurm_trace_dropped_total", "tpurm_trace_records_total",
@@ -92,7 +100,9 @@ METRICS_INVENTORY = [
     "tpusched_decoded_tokens", "tpusched_device_resets",
     "tpusched_evac_aborts", "tpusched_evacuations",
     "tpusched_evict_errors", "tpusched_fused_evict_chains",
-    "tpusched_preempted", "tpusched_restored", "tpusched_retired",
+    "tpusched_poisoned_retired", "tpusched_preempted",
+    "tpusched_restored", "tpusched_retired",
+    "tpusched_seq_slots_retired",
     "tpusched_round_errors", "tpusched_rounds", "tpusched_submitted",
     "uvm_access_counter_demotions", "uvm_access_counter_promotions",
     "uvm_accessed_by_mappings", "uvm_ats_accesses", "uvm_ats_bytes",
@@ -110,7 +120,9 @@ METRICS_INVENTORY = [
     "uvm_tools_events_dropped",
     "uvm_va_spaces_created", "uvm_write_faults_inferred", "vac_aborts",
     "vac_acks", "vac_bytes_moved", "vac_commit_ns",
-    "vac_commit_rejected", "vac_commits", "vac_failed_acks",
+    "vac_commit_rejected", "vac_commits",
+    "vac_crc_mismatches", "vac_crc_reships", "vac_crc_verifies",
+    "vac_failed_acks",
     "vac_grace_expired", "vac_inject_aborts", "vac_inject_retries",
     "vac_operator_requests", "vac_pages_moved", "vac_requests",
     "vac_txn_begins",
