@@ -1787,6 +1787,167 @@ def measure_shield_overhead(set_mb: int = 24, iters: int = 6,
     return out
 
 
+_JOURNAL_AB = r"""
+import json
+import sys
+import time
+
+sys.path.insert(0, %(repo)r)
+
+from open_gpu_kernel_modules_tpu import uvm
+from open_gpu_kernel_modules_tpu.uvm import journal
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+MB = 1 << 20
+SET = %(set_mb)d * MB
+ITERS = %(iters)d
+
+out = {}
+# Fault service latency, measured EXACTLY like the headline
+# measure_fault_latency probe (populate-pattern first-touch writes,
+# best-p95 trial of three) so the on-arm p50 is comparable against
+# the 4.2 us acceptance line.  The journal's lock-free emit sits
+# adjacent to this path (health notes, ring completions), so a
+# journal tax would show here first.
+trials = []
+for _ in range(3):
+    with uvm.VaSpace() as vs:
+        bufs = [vs.alloc(SET) for _ in range(8)]
+        uvm.fault_stats_reset_windows()
+        for b in bufs:
+            b.view()[:] = 0xA5
+        st = uvm.fault_stats()
+        trials.append((round(st.service_ns_p50 / 1e3, 2),
+                       round(st.service_ns_p95 / 1e3, 2)))
+        for b in bufs:
+            b.free()
+best = min(trials, key=lambda t: t[1])
+out["fault_p50_us"], out["fault_p95_us"] = best
+# Promote bandwidth through the bulk fault-back path: demote the set,
+# fault it all back hot page by page.
+with uvm.VaSpace() as vs:
+    buf = vs.alloc(SET)
+    buf.view()[:] = 0x5A
+    t_total = 0.0
+    for _ in range(ITERS):
+        buf.migrate(Tier.CXL)
+        t0 = time.monotonic()
+        intact = bool((buf.view() == 0x5A).all())
+        t_total += time.monotonic() - t0
+        assert intact, "corruption without injection"
+    out["promote_gbps"] = round(SET * ITERS / t_total / 1e9, 3)
+    buf.free()
+emitted, dropped, cap = journal.stats()
+out["journal_emitted"] = emitted
+out["journal_dropped"] = dropped
+print(json.dumps(out))
+"""
+
+
+def measure_journal_overhead(set_mb: int = 24, iters: int = 6,
+                             include_serving: bool = True) -> dict:
+    """tpubox acceptance: the always-on black box must be free enough
+    to never turn off.
+
+    A/B (journal on vs ``TPUMEM_JOURNAL_ENABLE=0``, each arm its own
+    subprocess — the knob is latched when the native library loads):
+    fault p50/p95 straight from the always-on latency histograms
+    (acceptance: p50 <= 4.2 us with the journal ON) and promote GB/s
+    through the software fault loop.  Serving acceptance: aggregate
+    tokens/s through the full tpusched stack —
+    ``journal_serve_toks_dip_frac`` <= 1%%."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def run_ab(extra_env):
+        script = _JOURNAL_AB % {"repo": repo, "set_mb": set_mb,
+                                "iters": iters}
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(extra_env)
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-500:])
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Both arms pin the knob explicitly: an ambient
+    # TPUMEM_JOURNAL_ENABLE in the operator's shell must not silently
+    # equalize the arms.
+    off = run_ab({"TPUMEM_JOURNAL_ENABLE": "0"})
+    on = run_ab({"TPUMEM_JOURNAL_ENABLE": "1"})
+    out = {
+        "journal_fault_p50_us_off": off["fault_p50_us"],
+        "journal_fault_p50_us_on": on["fault_p50_us"],
+        "journal_fault_p95_us_off": off["fault_p95_us"],
+        "journal_fault_p95_us_on": on["fault_p95_us"],
+        "journal_promote_gbps_off": off["promote_gbps"],
+        "journal_promote_gbps_on": on["promote_gbps"],
+        "journal_ab_emitted": on["journal_emitted"],
+        "journal_ab_dropped": on["journal_dropped"],
+    }
+
+    if include_serving:
+        # The serving workload is knob-agnostic — reuse the shield
+        # serving script verbatim; only the pinned env differs.
+        serve_script = _SHIELD_SERVE % {"repo": repo}
+
+        def run_serve(enable):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["TPUMEM_JOURNAL_ENABLE"] = enable
+            proc = subprocess.run([sys.executable, "-c", serve_script],
+                                  env=env, capture_output=True,
+                                  text=True, timeout=900)
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr[-500:])
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        # Interleaved best-of-3 per arm, same discipline as the shield
+        # serving A/B: scheduler noise between identical runs (±10%%)
+        # dwarfs a sub-1%% dip, and alternating arms keeps load drift
+        # from biasing one phase.
+        s_off, s_on = [], []
+        for _ in range(3):
+            s_off.append(run_serve("0"))
+            s_on.append(run_serve("1"))
+        best_off = max(r["toks"] for r in s_off)
+        best_on = max(r["toks"] for r in s_on)
+        out["journal_serve_toks_off"] = round(best_off, 1)
+        out["journal_serve_toks_on"] = round(best_on, 1)
+        out["journal_serve_toks_dip_frac"] = round(
+            1.0 - best_on / best_off, 3) if best_off else 0.0
+    return out
+
+
+def _provenance() -> dict:
+    """Stamp the bench JSON with WHICH tree and box produced it: a
+    number without its git sha, knob snapshot, and CPU budget is not
+    comparable across rounds.  Never fails the bench — every probe
+    degrades to omission."""
+    prov = {}
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(["git", "rev-parse", "HEAD"], cwd=here,
+                             capture_output=True, text=True, timeout=10)
+        if sha.returncode == 0:
+            prov["git_sha"] = sha.stdout.strip()
+        dirty = subprocess.run(["git", "status", "--porcelain"],
+                               cwd=here, capture_output=True,
+                               text=True, timeout=10)
+        if dirty.returncode == 0:
+            prov["git_dirty"] = bool(dirty.stdout.strip())
+    except Exception:
+        pass
+    prov["knobs"] = {k: os.environ[k] for k in sorted(os.environ)
+                     if k.startswith("TPUMEM_")}
+    try:
+        prov["cpus_online"] = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        prov["cpus_online"] = os.cpu_count()
+    return prov
+
+
 def _measure_isolated(fn_name: str, timeout_s: int, fallback,
                       tag: str) -> dict:
     """Run a measurement in a FRESH subprocess: the relay slows with
@@ -2077,6 +2238,15 @@ def main() -> None:
     except Exception as exc:
         extra["shield_error"] = str(exc)[:200]
 
+    # tpubox overhead: subprocess A/B arms (the journal_enable knob is
+    # latched when the native library loads), serving tokens/s
+    # acceptance only when jax is allowed.
+    try:
+        extra.update(measure_journal_overhead(
+            include_serving=not skip_jax))
+    except Exception as exc:
+        extra["journal_error"] = str(exc)[:200]
+
     try:
         extra.update(measure_explicit_migrate_gbps())
     except Exception:
@@ -2109,6 +2279,7 @@ def main() -> None:
         "value": round(bps / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(bps / BASELINE_CXL_LINK_BYTES_PER_S, 3),
+        "provenance": _provenance(),
         **extra,
     }
     # Artifact of record: the FULL result JSON goes to a file (the

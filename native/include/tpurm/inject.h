@@ -90,6 +90,17 @@ typedef enum {
                                       * shield_inject_misses, and
                                       * misses stay 0 while the hooks
                                       * cover every consumption path  */
+    TPU_INJECT_SITE_DUMP_WRITE,      /* tpubox crash-bundle serialization
+                                      * (one evaluation per bundle
+                                      * SECTION boundary; a hit chops
+                                      * the bundle there — recovery is
+                                      * graceful degrade: remaining
+                                      * sections are skipped, the
+                                      * trailer still marks the bundle
+                                      * `truncated` so it parses, never
+                                      * a hang or recursive fatal —
+                                      * exact invariant: hits ==
+                                      * journal_dump_errors)           */
     TPU_INJECT_SITE_COUNT
 } TpuInjectSite;
 

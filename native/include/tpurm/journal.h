@@ -1,0 +1,197 @@
+/*
+ * tpubox — black-box error journal + crash-dump bundles.
+ *
+ * An always-on, lock-free, fixed-size binary journal of structured
+ * error/recovery records (reference: the RCDB error-journal ring in
+ * src/nvidia/src/kernel/diagnostics/journal.c, the NvLog binary logger
+ * in diagnostics/nvlog.c, and the mmap'd per-client event queues of
+ * nvidia-uvm/uvm_tools.c).  Every engine that reports an error today —
+ * health notes, RC resets, watchdog rungs, generation bumps, stale /
+ * deadline completions, ICI flaps / retrains / per-hop CRC errors,
+ * page quarantine / poison verdicts, vac manifest lifecycle, inject
+ * hits, scheduler shed/preempt/retire — appends one 64-byte record.
+ *
+ * The journal lives in a single memfd-backed mapping:
+ *
+ *   offset 0                    TpuJournalHdr  (one 4 KiB page)
+ *   offset TPU_JOURNAL_HDR_BYTES  TpuJournalRec[cap]   (cap power of two)
+ *
+ * Producers claim a slot with one fetch_add on hdr->widx and commit it
+ * by release-storing rec->seq = claim + 1 LAST (seqlock discipline: a
+ * reader that sees rec->seq == claim + 1 before AND after copying the
+ * record got a consistent snapshot; anything else is torn or lapped).
+ * Wrap overwrites the oldest record (flight-recorder semantics) and is
+ * accounted in hdr->dropped, exactly like the tputrace span rings.
+ * Emission is async-signal-safe by construction: atomics and plain
+ * stores only, a futex *wake* (never a wait) on the doorbell when
+ * subscribers exist, no locks, no malloc, no stdio.
+ *
+ * External agents tail the journal uvm_tools-style: dup the region fd
+ * (tpurmJournalRegionFd), mmap it SHARED, keep a private consumer
+ * cursor, and FUTEX_WAIT on hdr->doorbell (the low 32 bits of the
+ * commit count) instead of polling procfs — the memring wakeup
+ * discipline applied to diagnostics.
+ *
+ * On any fatal path (watchdog device reset, poison containment, vac
+ * abort, broker client death, the last-gasp SIGSEGV handler) an
+ * async-signal-safe dumper serializes a self-contained crash bundle —
+ * journal tail + per-type emit counts + counter snapshot + health
+ * table + per-ring frontier/claimed state + open vac manifests +
+ * shield retirement list — atomically (write temp, rename) into
+ * $TPUMEM_DUMP_DIR.  tools/tpubox.py turns a bundle (or a live
+ * /proc/driver/tpurm/journal scrape) back into the ordered causal
+ * timeline and cross-checks record counts against the counter
+ * snapshot.
+ */
+#ifndef TPURM_JOURNAL_H
+#define TPURM_JOURNAL_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "tpurm/status.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPU_JOURNAL_MAGIC     0x31424a54u   /* "TJB1" little-endian */
+#define TPU_JOURNAL_VERSION   1u
+#define TPU_JOURNAL_HDR_BYTES 4096u
+#define TPU_JOURNAL_REC_BYTES 64u
+
+/* Record types.  The dotted names (tpurmJournalTypeName) are the
+ * stable spelling used by the bundle format, the procfs scrape, the
+ * JOURNAL_INVENTORY lint and the analyzer's reconciliation map.  Each
+ * type's emit site sits adjacent to the counter(s) it reconciles
+ * against (see tools/tpubox.py RECONCILE). */
+typedef enum {
+    TPU_JREC_NONE = 0,             /* empty slot marker, never emitted  */
+    TPU_JREC_HEALTH_NOTE = 1,      /* a0 = TpuHealthEvent, a1 = score   */
+    TPU_JREC_HEALTH_TRANSITION = 2,/* a0 = old state, a1 = new state    */
+    TPU_JREC_HEALTH_EVAC = 3,      /* evac posted: a0 = reqId, a1 = tgt */
+    TPU_JREC_WD_RUNG = 4,          /* a0 = rung (1/2/25/3), a1 = detail */
+    TPU_JREC_RESET_GEN = 5,        /* generation bump: a0 = new gen     */
+    TPU_JREC_RESET_DEVICE = 6,     /* reset done: a0 = gen, a1 = mttrNs */
+    TPU_JREC_RING_STALE = 7,       /* a0 = ring/chan id, a1 = seq       */
+    TPU_JREC_RING_DEADLINE = 8,    /* a0 = opcode, a1 = deadline ns     */
+    TPU_JREC_ICI_FLAP = 9,         /* a0 = src chip, a1 = dst chip      */
+    TPU_JREC_ICI_RETRAIN = 10,     /* retrain FAILED: a0=src, a1=dst    */
+    TPU_JREC_ICI_CRC = 11,         /* per-hop wire CRC: a0=src, a1=dst  */
+    TPU_JREC_PAGE_QUARANTINE = 12, /* a0 = va                           */
+    TPU_JREC_PAGE_POISON = 13,     /* a0 = va, a1 = tier                */
+    TPU_JREC_SHIELD_VERDICT = 14,  /* re-fetch ladder: a0=va, a1=verdict*/
+    TPU_JREC_VAC_BEGIN = 15,       /* a0 = txn id, a1 = src<<32 | dst   */
+    TPU_JREC_VAC_COMMIT = 16,      /* a0 = txn id, a1 = pages           */
+    TPU_JREC_VAC_ABORT = 17,       /* a0 = txn id, a1 = src<<32 | dst   */
+    TPU_JREC_INJECT_HIT = 18,      /* a0 = site, a1 = scope             */
+    TPU_JREC_SCHED_SHED = 19,      /* a0 = tenant, a1 = queued (python) */
+    TPU_JREC_SCHED_PREEMPT = 20,   /* a0 = seq slot, a1 = pages (python)*/
+    TPU_JREC_SCHED_RETIRE = 21,    /* poison retire: a0 = seq (python)  */
+    TPU_JREC_CLIENT_DEATH = 22,    /* a0 = pid, a1 = reclaimed pins     */
+    TPU_JREC_LOG = 23,             /* WARN+ tpuLog mirror: a0 = level,
+                                    * a1 = subsys packed as <=8 chars   */
+    TPU_JREC_DUMP = 24,            /* bundle written: a0 = reason packed
+                                    * <=8 chars, a1 = 1 ok / 0 truncated*/
+    TPU_JREC_TYPE_COUNT = 25
+} TpuJournalRecType;
+
+/* One journal record — 64 bytes, the stable on-disk/in-mmap ABI.
+ * `seq` is the commit stamp (claim index + 1; 0 = slot never written
+ * or mid-write); producers release-store it last, readers
+ * acquire-load it before and after copying. */
+typedef struct {
+    uint64_t seq;        /* commit stamp (claim + 1), stored LAST      */
+    uint64_t tsNs;       /* tpuNowNs() at emit                         */
+    uint64_t flow;       /* tpuflow id from thread context (0 = none)  */
+    uint64_t a0;         /* site-specific payload                      */
+    uint64_t a1;         /* site-specific payload                      */
+    uint32_t status;     /* TpuStatus at the site (TPU_OK = info)      */
+    uint16_t type;       /* TpuJournalRecType                          */
+    uint16_t dev;        /* device instance (0 when global)            */
+    uint64_t pad[2];     /* reserved, zero                             */
+} TpuJournalRec;
+
+/* Region header (one page).  Fixed field offsets — uvm/journal.py
+ * parses the mmap with these:
+ *   magic @0  version @4  cap @8  recSize @12
+ *   widx @16  dropped @24  doorbell @32  nsubs @36  emitted @40 */
+typedef struct {
+    uint32_t magic;
+    uint32_t version;
+    uint32_t cap;        /* record slots, power of two                 */
+    uint32_t recSize;    /* == TPU_JOURNAL_REC_BYTES                   */
+    uint64_t widx;       /* claim counter == records ever emitted      */
+    uint64_t dropped;    /* records overwritten by wrap (flight rec)   */
+    uint32_t doorbell;   /* futex word: low 32 bits of commit count    */
+    uint32_t nsubs;      /* live subscribers (gates the futex wake)    */
+    uint64_t emitted[TPU_JREC_TYPE_COUNT];  /* per-type emit counts    */
+} TpuJournalHdr;
+
+/* ------------------------------------------------------------- emission */
+
+/* Append one record (async-signal-safe; flow id is read from the
+ * tpuflow thread context).  No-op counting a drop when the journal is
+ * disabled (TPUMEM_JOURNAL_ENABLE=0) or failed to initialize. */
+void tpurmJournalEmit(uint32_t type, uint32_t dev, TpuStatus status,
+                      uint64_t a0, uint64_t a1);
+/* Same with an explicit flow id (python-side emitters carry their own). */
+void tpurmJournalEmitFlow(uint32_t type, uint32_t dev, TpuStatus status,
+                          uint64_t a0, uint64_t a1, uint64_t flow);
+
+/* Canonical dotted record-type name ("ici.flap"); NULL for out of
+ * range. */
+const char *tpurmJournalTypeName(uint32_t type);
+
+/* ------------------------------------------------------------ inspection */
+
+/* emitted = records ever claimed, dropped = overwritten by wrap (plus
+ * emits refused while disabled), cap = ring slots. */
+void tpurmJournalStats(uint64_t *emitted, uint64_t *dropped,
+                       uint32_t *cap);
+uint64_t tpurmJournalTypeCount(uint32_t type);
+
+/* ----------------------------------------------------------- subscription */
+
+/* Dup of the journal region memfd for external mmap'd tailing (caller
+ * owns the fd; -1 when the region is not fd-backed). */
+int tpurmJournalRegionFd(void);
+/* Current claim counter (a consumer cursor's upper bound). */
+uint64_t tpurmJournalHead(void);
+/* Register/unregister a live subscriber: while nsubs > 0 every commit
+ * FUTEX_WAKEs the doorbell. */
+void tpurmJournalSubscribe(void);
+void tpurmJournalUnsubscribe(void);
+/* Copy committed records from *cursor forward (at most max).  Advances
+ * *cursor; adds records lost to wrap (cursor lapped) into *lost.
+ * Returns records copied. */
+size_t tpurmJournalConsume(uint64_t *cursor, TpuJournalRec *out,
+                           size_t max, uint64_t *lost);
+/* Block on the doorbell futex until the journal advances past cursor
+ * (1) or timeoutNs elapses (0). */
+int tpurmJournalWait(uint64_t cursor, uint64_t timeoutNs);
+
+/* ------------------------------------------------------------ crash dumps */
+
+/* Async-signal-safe bundle dump into $TPUMEM_DUMP_DIR (cached at
+ * init).  Returns TPU_ERR_NOT_SUPPORTED when no dump dir is
+ * configured, TPU_ERR_STATE_IN_USE when a dump is already in flight on
+ * this or another thread (the recursion guard — a crash inside the
+ * dumper must fall back to the plain backtrace path, not recurse),
+ * TPU_ERR_OPERATING_SYSTEM on write errors, TPU_OK otherwise (also
+ * when the bundle was truncated by the dump.write inject site — the
+ * bundle says so in its trailer). */
+TpuStatus tpurmJournalCrashDump(const char *reason);
+/* Path of the most recently completed bundle ("" when none). */
+size_t tpurmJournalLastBundle(char *buf, size_t cap);
+
+/* Render the structured journal as text (the same "R ..." / "E ..."
+ * line format the bundle's [journal]/[emitted] sections use; the
+ * procfs node and the python live scrape both come through here). */
+size_t tpurmJournalRenderTextBuf(char *buf, size_t cap);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURM_JOURNAL_H */

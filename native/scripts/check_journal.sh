@@ -1,0 +1,79 @@
+#!/bin/sh
+# check-journal — the tpubox record-inventory lint (check-inject shape).
+#
+# Contract: every record type in journal.c's name table must be
+#   (a) LISTED in JOURNAL_INVENTORY (tests/test_journal.py) — the
+#       inventory is what the analyzer round-trip test asserts against,
+#       so an unlisted record is a record the post-mortem tooling
+#       silently drops, and
+#   (b) DOCUMENTED in the README journal chapter (the dotted record
+#       name must appear in README.md).
+# Additionally the black box must stay ahead of the failure surface:
+#   (c) every health event name in health.c's g_eventNames table must
+#       appear in tests/test_journal.py's EVENT_RECORD_MAP (so a new
+#       sickness signal cannot ship without a journal story), and
+#   (d) every fatal-path TpuStatus (the 0x70.. block in status.h) must
+#       appear in JOURNAL_FATAL_STATUSES — a terminal status no record
+#       can carry is a crash the bundle cannot explain.
+#
+# Negative test hook: CHECK_JOURNAL_EXTRA=<dotted.name> injects a fake
+# record name; the lint must then fail (asserted by
+# tests/test_journal.py).
+set -eu
+
+src_journal=${1:-src/journal.c}
+journal_py=${2:-../tests/test_journal.py}
+readme=${3:-../README.md}
+
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+
+# Record table: the dotted literals between g_jrecNames[...] = { and };
+awk '/g_jrecNames\[/{grab=1; next} grab && /};/{exit} grab' \
+    "$src_journal" | sed -nE 's/.*"([a-z0-9_.]+)".*/\1/p' > "$tmp/recs"
+[ -s "$tmp/recs" ] || { echo "check-journal: no record table found"; exit 1; }
+[ -n "${CHECK_JOURNAL_EXTRA:-}" ] && echo "$CHECK_JOURNAL_EXTRA" >> "$tmp/recs"
+
+st=0
+while read -r rec; do
+    [ "$rec" = "none" ] && continue
+    if ! grep -qF "\"$rec\"" "$journal_py"; then
+        echo "check-journal: record $rec is not in JOURNAL_INVENTORY"
+        echo "  (tests/test_journal.py must list every record type the"
+        echo "  engine can emit — the analyzer round-trip asserts it)"
+        st=1
+    fi
+    if ! grep -qF "$rec" "$readme"; then
+        echo "check-journal: record $rec has no row in the README"
+        echo "  journal chapter (document the record, its payload and"
+        echo "  its counter reconciliation)"
+        st=1
+    fi
+done < "$tmp/recs"
+
+# (c) health events: each g_eventNames literal needs an entry in the
+# EVENT_RECORD_MAP so the timeline can attribute it.
+awk '/g_eventNames\[/{grab=1; next} grab && /};/{exit} grab' \
+    src/health.c | sed -nE 's/.*"([a-z0-9_]+)".*/\1/p' > "$tmp/events"
+while read -r ev; do
+    if ! grep -qF "\"$ev\"" "$journal_py"; then
+        echo "check-journal: health event $ev missing from"
+        echo "  EVENT_RECORD_MAP in tests/test_journal.py"
+        st=1
+    fi
+done < "$tmp/events"
+
+# (d) fatal-path statuses (the 0x000000 7x block).
+sed -nE 's/^#define (TPU_ERR_[A-Z_]+) +0x0000007[0-9a-fu]+.*/\1/p' \
+    include/tpurm/status.h > "$tmp/fatals"
+while read -r fs; do
+    if ! grep -qF "\"$fs\"" "$journal_py"; then
+        echo "check-journal: fatal status $fs missing from"
+        echo "  JOURNAL_FATAL_STATUSES in tests/test_journal.py"
+        st=1
+    fi
+done < "$tmp/fatals"
+
+[ $st = 0 ] || exit 1
+n=$(grep -cv '^none$' "$tmp/recs")
+echo "check-journal OK ($n record types inventoried and documented)"

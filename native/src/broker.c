@@ -63,6 +63,7 @@
 #include "internal.h"
 #include "tpurm/abi.h"
 #include "tpurm/health.h"
+#include "tpurm/journal.h"
 #include "tpurm/uvm.h"
 
 #include <errno.h>
@@ -377,7 +378,7 @@ static void *conn_reaper_thread(void *arg)
             uint64_t last = atomic_load(&c->lastSeenNs);
             if (now - last > timeoutMs * 1000000ull) {
                 tpuCounterAdd("broker_heartbeat_reaps", 1);
-                tpuLog(TPU_LOG_WARN, "broker",
+                TPU_LOG(TPU_LOG_WARN, "broker",
                        "reaping stale client pid %d (silent %llu ms)",
                        c->peer,
                        (unsigned long long)((now - last) / 1000000ull));
@@ -464,7 +465,7 @@ static void conn_dma_copyback(BrokerConn *c, uint64_t onlyBuf)
             continue;
         if (peer_copy(c->peer, s->shadow, s->clientVa, s->size,
                       true) != 0)
-            tpuLog(TPU_LOG_WARN, "broker",
+            TPU_LOG(TPU_LOG_WARN, "broker",
                    "async DMA copy-back to pid %d failed", c->peer);
         if (onlyBuf)
             s->used = false;    /* unregister: span retires */
@@ -494,7 +495,7 @@ static bool conn_dma_record(BrokerConn *c, uint64_t bufHandle,
         /* Table full: the dropped span's copy-back then only happens
          * at unregister — a documented degradation, never corruption:
          * the shadow stays authoritative. */
-        tpuLog(TPU_LOG_WARN, "broker", "async DMA span table full");
+        TPU_LOG(TPU_LOG_WARN, "broker", "async DMA span table full");
         pthread_mutex_unlock(&c->dmaLock);
         return false;
     }
@@ -1210,9 +1211,14 @@ out:
         /* Died with live resources: a crash/kill/wedge, not a clean
          * teardown. */
         tpuCounterAdd("broker_client_deaths", 1);
-        tpuLog(TPU_LOG_WARN, "broker",
+        tpurmJournalEmit(TPU_JREC_CLIENT_DEATH, 0, TPU_OK,
+                         (uint64_t)c->peer, 0);
+        TPU_LOG(TPU_LOG_WARN, "broker",
                "client pid %d died with live resources: reclaimed",
                c->peer);
+        /* The dead client's last moments (pins, faults, vac traffic)
+         * are still in the ring: bundle them before they wrap. */
+        tpurmJournalCrashDump("broker.client_death");
     }
     if (c->evFd >= 0) {
         munmap(c->evShared, BROKER_EV_SLOTS * sizeof(TpuOsEvent));
@@ -1294,7 +1300,7 @@ TpuStatus tpurmBrokerServe(const char *path)
         return TPU_ERR_OPERATING_SYSTEM;
     }
     pthread_detach(tid);
-    tpuLog(TPU_LOG_INFO, "broker", "serving on %s", path);
+    TPU_LOG(TPU_LOG_INFO, "broker", "serving on %s", path);
     return TPU_OK;
 }
 

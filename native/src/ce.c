@@ -28,6 +28,7 @@
 
 #include "internal.h"
 #include "tpurm/inject.h"
+#include "tpurm/journal.h"
 #include "tpurm/reset.h"
 #include "tpurm/trace.h"
 
@@ -249,7 +250,7 @@ TpuCeMgr *tpuCeMgrGet(uint32_t devInst)
                 free(m);
                 m = NULL;
             } else {
-                tpuLog(TPU_LOG_INFO, "tpuce",
+                TPU_LOG(TPU_LOG_INFO, "tpuce",
                        "dev %u: %u copy channel(s), stripe %llu KB",
                        devInst, ce_created(m),
                        (unsigned long long)(ce_stripe_bytes() >> 10));
@@ -396,6 +397,9 @@ static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s,
                 /* Stale completion across a reset: replay the stripe
                  * (idempotent copy) rather than trusting it. */
                 tpuCounterAdd("tpuce_stale_completions", 1);
+                tpurmJournalEmit(TPU_JREC_RING_STALE, 0,
+                                 TPU_ERR_DEVICE_RESET, s->gen,
+                                 tpurmDeviceGeneration());
                 st = TPU_ERR_DEVICE_RESET;
             }
         } else {
@@ -407,6 +411,9 @@ static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s,
             /* Deadline expired mid-recovery: stop retrying (the hung-op
              * ladder owns anything still wedged in the engine). */
             tpuCounterAdd("tpuce_deadline_expired", 1);
+            tpurmJournalEmit(TPU_JREC_RING_DEADLINE, 0,
+                             TPU_OK, deadlineNs,
+                             tpuNowNs());
             s->attempts = lim;
         }
         if (s->attempts < lim) {
@@ -433,7 +440,7 @@ static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s,
              * pass.  No ce.copy evaluation here (the fallback must be
              * able to land; channel-level faults still apply). */
             tpuCounterAdd("tpuce_lossless_fallbacks", 1);
-            tpuLog(TPU_LOG_WARN, "tpuce",
+            TPU_LOG(TPU_LOG_WARN, "tpuce",
                    "stripe %p+%llu: compressed path exhausted, lossless "
                    "fallback", s->dst, (unsigned long long)s->len);
             s->comp = TPU_CE_COMP_NONE;
@@ -451,6 +458,9 @@ static TpuStatus ce_stripe_complete(TpuCeMgr *m, TpuCeStripe *s,
                     if (st == TPU_OK &&
                         s->gen != tpurmDeviceGeneration()) {
                         tpuCounterAdd("tpuce_stale_completions", 1);
+                        tpurmJournalEmit(TPU_JREC_RING_STALE, 0,
+                                         TPU_ERR_DEVICE_RESET, s->gen,
+                                         tpurmDeviceGeneration());
                         st = TPU_ERR_DEVICE_RESET;
                     }
                     if (st == TPU_OK)

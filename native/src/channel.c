@@ -261,7 +261,7 @@ static void *channel_executor(void *arg)
              * non-replayable shadow buffer for attribution/recovery
              * (rc.c — the reference's CE-fault delivery split). */
             atomic_store_explicit(&ch->error, 1, memory_order_release);
-            tpuLog(TPU_LOG_ERROR, "channel",
+            TPU_LOG(TPU_LOG_ERROR, "channel",
                    readbackFailed
                        ? "CE fault: chip readback unavailable at tracker "
                          "value %llu"
@@ -698,7 +698,7 @@ void tpurmChannelResetError(TpurmChannel *ch)
         return;
     if (atomic_exchange_explicit(&ch->error, 0, memory_order_acq_rel)) {
         tpuCounterAdd("channel_rc_resets", 1);
-        tpuLog(TPU_LOG_WARN, "channel", "RC reset: error cleared at value %llu",
+        TPU_LOG(TPU_LOG_WARN, "channel", "RC reset: error cleared at value %llu",
                (unsigned long long)tpuMsgqCompletedSeq(ch->fifo));
     }
 }

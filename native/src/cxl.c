@@ -165,7 +165,7 @@ TpuStatus tpuCxlRegister(uint64_t baseAddress, uint64_t size,
     if (g_cxl.pinnedBytes + size > pinLimit) {
         tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
         pthread_mutex_unlock(&g_cxl.lock);
-        tpuLog(TPU_LOG_ERROR, "cxl",
+        TPU_LOG(TPU_LOG_ERROR, "cxl",
                "pin limit exceeded: %llu + %llu > %llu",
                (unsigned long long)g_cxl.pinnedBytes,
                (unsigned long long)size, (unsigned long long)pinLimit);
@@ -192,14 +192,14 @@ TpuStatus tpuCxlRegister(uint64_t baseAddress, uint64_t size,
      * mlock handling, while kernel-grade pinning stays a deploy concern. */
     buf->mlocked = mlock((void *)(uintptr_t)baseAddress, size) == 0;
     if (!buf->mlocked)
-        tpuLog(TPU_LOG_WARN, "cxl", "mlock failed for %llu bytes (RLIMIT?)",
+        TPU_LOG(TPU_LOG_WARN, "cxl", "mlock failed for %llu bytes (RLIMIT?)",
                (unsigned long long)size);
     g_cxl.count++;
     g_cxl.pinnedBytes += size;
     tpuCounterAdd("cxl_buffers_registered", 1);
 
     *outHandle = handle_make(slot, buf->generation);
-    tpuLog(TPU_LOG_INFO, "cxl",
+    TPU_LOG(TPU_LOG_INFO, "cxl",
            "registered buffer slot=%u base=0x%llx size=0x%llx pages=%s",
            slot, (unsigned long long)baseAddress, (unsigned long long)size,
            buf->hugePages ? "2M" : "4K");
@@ -242,7 +242,7 @@ TpuStatus tpuCxlUnregister(uint64_t handle)
     g_cxl.count--;
     g_cxl.pinnedBytes -= buf->size;
     tpuCounterAdd("cxl_buffers_unregistered", 1);
-    tpuLog(TPU_LOG_INFO, "cxl", "unregistered buffer handle=0x%llx",
+    TPU_LOG(TPU_LOG_INFO, "cxl", "unregistered buffer handle=0x%llx",
            (unsigned long long)handle);
     tpuLockTrackRelease(TPU_LOCK_CXL, "cxl");
     pthread_mutex_unlock(&g_cxl.lock);
@@ -382,7 +382,7 @@ TpuStatus tpuCxlDmaRequest(TpurmDevice *dev, uint64_t handle,
     tpuTrackerDeinit(&dmaTracker);
 
     if (st != TPU_OK) {
-        tpuLog(TPU_LOG_ERROR, "cxl", "DMA %s failed: %s",
+        TPU_LOG(TPU_LOG_ERROR, "cxl", "DMA %s failed: %s",
                cxlToDev ? "CXL->DEV" : "DEV->CXL", tpuStatusToString(st));
         return st;
     }

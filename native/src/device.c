@@ -73,7 +73,7 @@ static void device_init_once(void)
                                 MAP_PRIVATE | MAP_ANONYMOUS | populate,
                                 -1, 0);
         if (dev->hbmBase == MAP_FAILED) {
-            tpuLog(TPU_LOG_ERROR, "device",
+            TPU_LOG(TPU_LOG_ERROR, "device",
                    "HBM arena mmap failed for dev %u (%llu bytes)", i,
                    (unsigned long long)hbmBytes);
             dev->hbmBase = NULL;
@@ -112,10 +112,10 @@ static void device_init_once(void)
         }
         dev->ce = dev->cePoolSize ? dev->cePool[0] : NULL;
         if (!dev->ce)
-            tpuLog(TPU_LOG_ERROR, "device", "CE channel create failed dev %u", i);
+            TPU_LOG(TPU_LOG_ERROR, "device", "CE channel create failed dev %u", i);
     }
     g_devices.count = count;
-    tpuLog(TPU_LOG_INFO, "device", "enumerated %u TPU device(s), %llu MB arena",
+    TPU_LOG(TPU_LOG_INFO, "device", "enumerated %u TPU device(s), %llu MB arena",
            count, (unsigned long long)(hbmBytes >> 20));
 }
 
@@ -161,7 +161,7 @@ void tpurmDeviceSetLost(TpurmDevice *dev, int lost)
 {
     if (dev) {
         dev->lost = (lost != 0);
-        tpuLog(lost ? TPU_LOG_WARN : TPU_LOG_INFO, "device",
+        TPU_LOG(lost ? TPU_LOG_WARN : TPU_LOG_INFO, "device",
                "device %u marked %s", dev->inst, lost ? "LOST" : "present");
     }
 }

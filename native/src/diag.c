@@ -11,6 +11,8 @@
 #define _GNU_SOURCE
 #include "internal.h"
 
+#include "tpurm/journal.h"
+
 #include <stdatomic.h>
 
 #include <errno.h>
@@ -39,6 +41,15 @@ static struct {
     uint64_t seq;
 } g_journal = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
+/* TPU_LOG gate: minimum level that gets formatted at all
+ * (TPUMEM_LOG_LEVEL; default DEBUG keeps historic behavior). */
+TpuLogLevel tpuLogGate(void)
+{
+    static TpuRegCache cache;
+    uint64_t v = tpuRegCacheGet(&cache, "log_level", TPU_LOG_DEBUG);
+    return v > TPU_LOG_ERROR ? TPU_LOG_ERROR : (TpuLogLevel)v;
+}
+
 void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
 {
     va_list ap;
@@ -66,6 +77,17 @@ void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
         tpuRegistryGet("native_log_stderr", 0) != 0) {
         static const char *names[] = { "DEBUG", "INFO", "WARN", "ERROR" };
         fprintf(stderr, "tpurm[%s] %s: %s\n", names[level], subsys, msg);
+    }
+
+    /* Mirror WARN+ into the tpubox binary journal (a1 carries the
+     * subsystem tag packed as up-to-8 chars) so the black box and the
+     * text log can never disagree about an error's existence. */
+    if (level >= TPU_LOG_WARN) {
+        uint64_t packed = 0;
+        size_t n = strnlen(subsys, 8);
+        memcpy(&packed, subsys, n);
+        tpurmJournalEmit(TPU_JREC_LOG, 0, TPU_OK, (uint64_t)level, packed);
+        tpuCounterAdd("journal_log_mirrors", 1);
     }
 }
 

@@ -95,7 +95,7 @@ TpuStatus tpurmDeviceRegisterHbm(uint32_t inst)
     atomic_store_explicit(&dev->mirrorOverflow, 0, memory_order_release);
     atomic_store_explicit(&dev->arenaReal, 1, memory_order_release);
     pthread_mutex_unlock(&dev->hbmLock);
-    tpuLog(TPU_LOG_INFO, "hbm", "device %u arena registered as REAL "
+    TPU_LOG(TPU_LOG_INFO, "hbm", "device %u arena registered as REAL "
            "(mirror stream open)", inst);
     return TPU_OK;
 }
@@ -113,7 +113,7 @@ void tpurmDeviceUnregisterHbm(uint32_t inst)
                                          * notifies fail fast instead of
                                          * touching freed memory */
     pthread_mutex_unlock(&dev->hbmLock);
-    tpuLog(TPU_LOG_INFO, "hbm", "device %u arena back to FAKE", inst);
+    TPU_LOG(TPU_LOG_INFO, "hbm", "device %u arena back to FAKE", inst);
 }
 
 int tpurmDeviceArenaIsReal(uint32_t inst)
@@ -417,7 +417,7 @@ TpuStatus tpuHbmCoherentForRead(const void *src, uint64_t bytes)
             /* The caller must FAIL the copy rather than proceed with a
              * stale shadow — an eviction that committed it would free
              * the only copy of chip-computed data. */
-            tpuLog(TPU_LOG_WARN, "hbm",
+            TPU_LOG(TPU_LOG_WARN, "hbm",
                    "chip readback failed (status %d): refusing to "
                    "serve the stale shadow", st);
             worst = st;

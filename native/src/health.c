@@ -24,6 +24,7 @@
 
 #include "internal.h"
 #include "tpurm/ici.h"
+#include "tpurm/journal.h"
 #include "tpurm/reset.h"
 #include "tpurm/trace.h"
 #include "uvm/uvm_internal.h"
@@ -134,9 +135,11 @@ static void health_set_state_locked(uint32_t devInst, HealthDev *d,
     atomic_store_explicit(&d->state, newState, memory_order_release);
     d->transitions++;
     tpuCounterAdd("tpurm_health_transitions", 1);
+    tpurmJournalEmit(TPU_JREC_HEALTH_TRANSITION, devInst, TPU_OK,
+                     old, newState);
     tpurmTraceInstantLabel(TPU_TRACE_HEALTH_TRANSITION, devInst,
                            newState, "health.transition");
-    tpuLog(newState > old ? TPU_LOG_WARN : TPU_LOG_INFO, "health",
+    TPU_LOG(newState > old ? TPU_LOG_WARN : TPU_LOG_INFO, "health",
            "device %u health %s -> %s (score=%llu)", devInst,
            g_stateNames[old], g_stateNames[newState],
            (unsigned long long)d->score);
@@ -178,6 +181,11 @@ void tpurmHealthNote(uint32_t devInst, uint32_t event)
     d->score += g_weights[event];
     d->events[event]++;
     d->lastEventNs = now;
+    /* Black box: one health.note record per note, carrying the event
+     * kind and the post-decay score (emit is lock-free: safe under
+     * g_health.lock AND under whatever engine lock the caller holds). */
+    tpurmJournalEmit(TPU_JREC_HEALTH_NOTE, devInst, TPU_OK, event,
+                     d->score);
     health_update_state_locked(devInst, d, now);
     pthread_mutex_unlock(&g_health.lock);
 }
@@ -292,7 +300,9 @@ static void evac_post_locked(uint32_t devInst, HealthDev *d,
     d->evacReqId = g_health.nextReqId++;
     d->evacPostedNs = now;
     tpuCounterAdd("vac_requests", 1);
-    tpuLog(TPU_LOG_WARN, "health",
+    tpurmJournalEmit(TPU_JREC_HEALTH_EVAC, devInst, TPU_OK,
+                     d->evacReqId, target);
+    TPU_LOG(TPU_LOG_WARN, "health",
            "EVACUATE requested: device %u -> %u (req %llu, state %s)",
            devInst, target, (unsigned long long)d->evacReqId,
            g_stateNames[atomic_load_explicit(&d->state,
@@ -372,7 +382,7 @@ TpuStatus tpurmHealthEvacAck(uint32_t devInst, uint64_t reqId,
          * genuinely sick). */
         tpurmHealthClear(devInst);
     }
-    tpuLog(TPU_LOG_WARN, "health", "evacuation of device %u %s (req %llu)",
+    TPU_LOG(TPU_LOG_WARN, "health", "evacuation of device %u %s (req %llu)",
            devInst, success ? "ACKED" : "FAILED",
            (unsigned long long)reqId);
     return TPU_OK;
@@ -399,7 +409,7 @@ static bool evac_expire_locked(uint32_t devInst, HealthDev *d,
     d->evacPending = false;
     d->evacCooldownNs = now + 4 * graceNs;
     tpuCounterAdd("vac_grace_expired", 1);
-    tpuLog(TPU_LOG_WARN, "health",
+    TPU_LOG(TPU_LOG_WARN, "health",
            "evacuation request for device %u expired un-acked (req %llu)",
            devInst, (unsigned long long)d->evacReqId);
     return true;
@@ -443,6 +453,8 @@ void tpurmHealthTick(void)
         if (!d->evacPending && now >= d->evacCooldownNs) {
             evac_post_locked(dev, d, target, now);
             tpuCounterAddScoped("tpurm_watchdog_evacuations", dev, 1);
+            tpurmJournalEmit(TPU_JREC_WD_RUNG, dev, TPU_OK, 25,
+                             d->evacReqId);
         }
         pthread_mutex_unlock(&g_health.lock);
     }
@@ -497,6 +509,8 @@ bool tpurmHealthEvacLadderRung(void)
     if (!d->evacPending && now >= d->evacCooldownNs) {
         evac_post_locked(sick, d, target, now);
         tpuCounterAddScoped("tpurm_watchdog_evacuations", sick, 1);
+        tpurmJournalEmit(TPU_JREC_WD_RUNG, sick, TPU_OK, 25,
+                         d->evacReqId);
         posted = true;
     }
     pthread_mutex_unlock(&g_health.lock);
@@ -541,6 +555,8 @@ TpuStatus tpurmVacBegin(uint32_t srcInst, uint32_t dstInst,
     atomic_fetch_add(&g_health.txnsActive, 1);
     pthread_mutex_unlock(&g_health.lock);
     tpuCounterAdd("vac_txn_begins", 1);
+    tpurmJournalEmit(TPU_JREC_VAC_BEGIN, srcInst, TPU_OK, *txnOut,
+                     ((uint64_t)srcInst << 32) | dstInst);
     return TPU_OK;
 }
 
@@ -587,7 +603,7 @@ TpuStatus tpurmVacCommit(uint64_t txn)
         /* The transaction STAYS OPEN: the caller must abort — its
          * source copy is still the only truth. */
         tpuCounterAdd("vac_commit_rejected", 1);
-        tpuLog(TPU_LOG_WARN, "health",
+        TPU_LOG(TPU_LOG_WARN, "health",
                "vac commit REJECTED (txn %llu %u->%u): %s",
                (unsigned long long)txn, src, dst, tpuStatusToString(st));
         return st;
@@ -602,6 +618,8 @@ TpuStatus tpurmVacCommit(uint64_t txn)
     pthread_mutex_unlock(&g_health.lock);
     tpuCounterAdd("vac_commits", 1);
     tpuCounterAdd("vac_commit_ns", tpuNowNs() - startNs);
+    tpurmJournalEmit(TPU_JREC_VAC_COMMIT, src, TPU_OK, txn,
+                     ((uint64_t)src << 32) | dst);
     return TPU_OK;
 }
 
@@ -618,9 +636,14 @@ TpuStatus tpurmVacAbort(uint64_t txn)
     atomic_fetch_sub(&g_health.txnsActive, 1);
     pthread_mutex_unlock(&g_health.lock);
     tpuCounterAdd("vac_aborts", 1);
-    tpuLog(TPU_LOG_WARN, "health",
+    tpurmJournalEmit(TPU_JREC_VAC_ABORT, src, TPU_OK, txn,
+                     ((uint64_t)src << 32) | dst);
+    TPU_LOG(TPU_LOG_WARN, "health",
            "vac ABORT (txn %llu %u->%u): source remains authoritative",
            (unsigned long long)txn, src, dst);
+    /* Fatal-path black box: an aborted manifest means a migration's
+     * work was thrown away — capture the why while it is still hot. */
+    tpurmJournalCrashDump("vac.abort");
     return TPU_OK;
 }
 
@@ -628,6 +651,57 @@ uint32_t tpurmVacActive(void)
 {
     return atomic_load_explicit(&g_health.txnsActive,
                                 memory_order_acquire);
+}
+
+/* ------------------------------------------------------------ raw dump
+ *
+ * Crash-bundle section (journal.c dumper): LOCK-FREE snapshot of the
+ * health table and the open vac transactions.  The dumper may run
+ * from a signal handler while the interrupted thread holds
+ * g_health.lock, so this reads the fields directly — torn values are
+ * possible and benign (the bundle is diagnostic, not transactional). */
+TPU_NO_TSAN void tpurmHealthDumpRaw(TpuDumpCur *c)
+{
+    uint32_t n = tpurmDeviceCount();
+    if (n > HEALTH_MAX_DEVICES)
+        n = HEALTH_MAX_DEVICES;
+    for (uint32_t i = 0; i < n; i++) {
+        HealthDev *d = &g_health.dev[i];
+        tpuDumpStr(c, "H dev ");
+        tpuDumpU64(c, i);
+        tpuDumpStr(c, " state ");
+        tpuDumpU64(c, atomic_load_explicit(&d->state,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, " score ");
+        tpuDumpU64(c, d->score);
+        tpuDumpStr(c, " trans ");
+        tpuDumpU64(c, d->transitions);
+        tpuDumpStr(c, " evac ");
+        tpuDumpU64(c, d->evacPending ? d->evacTarget + 1 : 0);
+        tpuDumpStr(c, " ev");
+        for (uint32_t e = 0; e < TPU_HEALTH_EV_COUNT; e++) {
+            tpuDumpStr(c, " ");
+            tpuDumpU64(c, d->events[e]);
+        }
+        tpuDumpStr(c, "\n");
+    }
+    for (int i = 0; i < VAC_MAX_TXNS; i++) {
+        VacTxn *t = &g_health.txns[i];
+        uint64_t id = t->id;
+        if (!id)
+            continue;
+        tpuDumpStr(c, "V txn ");
+        tpuDumpU64(c, id);
+        tpuDumpStr(c, " src ");
+        tpuDumpU64(c, t->src);
+        tpuDumpStr(c, " dst ");
+        tpuDumpU64(c, t->dst);
+        tpuDumpStr(c, " gen ");
+        tpuDumpU64(c, t->gen);
+        tpuDumpStr(c, " start_ns ");
+        tpuDumpU64(c, t->startNs);
+        tpuDumpStr(c, "\n");
+    }
 }
 
 /* -------------------------------------------------------------- render */

@@ -21,6 +21,7 @@
 #include "tpurm/health.h"
 #include "tpurm/ici.h"
 #include "tpurm/inject.h"
+#include "tpurm/journal.h"
 #include "tpurm/memring.h"
 #include "tpurm/shield.h"
 #include "tpurm/trace.h"
@@ -117,7 +118,7 @@ void tpuIciInit(void)
         for (uint32_t d = 0; d < n; d++)
             train_links_locked(d);
     g_ici.ready = true;
-    tpuLog(TPU_LOG_INFO, "ici", "topology: %ux%u torus, %u device(s)",
+    TPU_LOG(TPU_LOG_INFO, "ici", "topology: %ux%u torus, %u device(s)",
            dimX, dimY, n);
     pthread_mutex_unlock(&g_ici.lock);
 }
@@ -248,7 +249,7 @@ TpuStatus tpuIciInjectLinkFailure(uint32_t devInst, uint32_t link)
     }
     tpurmHealthNote(devInst, TPU_HEALTH_EV_LINK_FLAP);
     tpurmHealthNote(l->peerInst, TPU_HEALTH_EV_LINK_FLAP);
-    tpuLog(TPU_LOG_WARN, "ici", "link %u.%u -> %u FAILED (injected)",
+    TPU_LOG(TPU_LOG_WARN, "ici", "link %u.%u -> %u FAILED (injected)",
            devInst, link, l->peerInst);
     pthread_mutex_unlock(&g_ici.lock);
     return TPU_OK;
@@ -278,12 +279,13 @@ static void ici_flap_route_locked(uint32_t src, uint32_t dst)
         back->errorCount++;
     }
     tpuCounterAdd("ici_link_flaps", 1);
+    tpurmJournalEmit(TPU_JREC_ICI_FLAP, src, TPU_OK, src, next);
     /* Both endpoints of a flapped link take the health hit: the scorer
      * cannot know which chip's SerDes is at fault, and evacuating
      * either end routes around the link. */
     tpurmHealthNote(src, TPU_HEALTH_EV_LINK_FLAP);
     tpurmHealthNote(next, TPU_HEALTH_EV_LINK_FLAP);
-    tpuLog(TPU_LOG_WARN, "ici", "link flap (injected): %u -> %u FAILED",
+    TPU_LOG(TPU_LOG_WARN, "ici", "link flap (injected): %u -> %u FAILED",
            src, next);
 }
 
@@ -311,8 +313,10 @@ static uint32_t ici_retrain_soft_locked(bool force)
                 /* Retrain itself failed: stay FAILED, re-arm backoff. */
                 l->failedAtNs = now;
                 tpuCounterAdd("ici_retrain_failures", 1);
+                tpurmJournalEmit(TPU_JREC_ICI_RETRAIN, d,
+                                 TPU_ERR_RETRAIN_FAILED, d, l->peerInst);
                 tpurmHealthNote(d, TPU_HEALTH_EV_RETRAIN_FAIL);
-                tpuLog(TPU_LOG_WARN, "ici",
+                TPU_LOG(TPU_LOG_WARN, "ici",
                        "retrain FAILED for link %u -> %u (%s)", d,
                        l->peerInst,
                        tpuStatusToString(TPU_ERR_RETRAIN_FAILED));
@@ -333,7 +337,7 @@ static uint32_t ici_retrain_soft_locked(bool force)
             tpurmTraceInstant(TPU_TRACE_RECOVER_RETRAIN,
                               ((uint64_t)d << 32) | l->peerInst, 0);
             tpuCounterAdd("ici_links_trained", 1);
-            tpuLog(TPU_LOG_WARN, "ici", "link %u -> %u retrained ACTIVE",
+            TPU_LOG(TPU_LOG_WARN, "ici", "link %u -> %u retrained ACTIVE",
                    d, l->peerInst);
         }
     }
@@ -618,9 +622,10 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
                 TPU_OK)
                 break;
             tpuCounterAdd("ici_wire_crc_errors", 1);
+            tpurmJournalEmit(TPU_JREC_ICI_CRC, from, TPU_OK, from, to);
             tpurmHealthNote(from, TPU_HEALTH_EV_LINK_FLAP);
             tpurmHealthNote(to, TPU_HEALTH_EV_LINK_FLAP);
-            tpuLog(TPU_LOG_WARN, "ici",
+            TPU_LOG(TPU_LOG_WARN, "ici",
                    "wire CRC mismatch on link %u -> %u (%llu bytes), "
                    "%s", from, to, (unsigned long long)size,
                    attempt == 0 ? "re-fetching from source"
@@ -777,11 +782,14 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
                         if (tpurmShieldVerifyWire(hopSrc, len, segCrc,
                                                   lk) != TPU_OK) {
                             tpuCounterAdd("ici_wire_crc_errors", 1);
+                            tpurmJournalEmit(TPU_JREC_ICI_CRC,
+                                             chain[h - 1], TPU_OK,
+                                             chain[h - 1], chain[h]);
                             tpurmHealthNote(chain[h - 1],
                                             TPU_HEALTH_EV_LINK_FLAP);
                             tpurmHealthNote(chain[h],
                                             TPU_HEALTH_EV_LINK_FLAP);
-                            tpuLog(TPU_LOG_WARN, "ici",
+                            TPU_LOG(TPU_LOG_WARN, "ici",
                                    "hop CRC mismatch on link %u -> %u "
                                    "(detour seg @%llu): re-running hop",
                                    chain[h - 1], chain[h],
@@ -880,9 +888,11 @@ static TpuStatus ici_peer_copy_async(TpuIciPeerAperture *ap,
             }
             if (!ok) {
                 tpuCounterAdd("ici_wire_crc_errors", 1);
+                tpurmJournalEmit(TPU_JREC_ICI_CRC, chain[n - 2], TPU_OK,
+                                 chain[n - 2], chain[n - 1]);
                 tpurmHealthNote(chain[n - 2], TPU_HEALTH_EV_LINK_FLAP);
                 tpurmHealthNote(chain[n - 1], TPU_HEALTH_EV_LINK_FLAP);
-                tpuLog(TPU_LOG_WARN, "ici",
+                TPU_LOG(TPU_LOG_WARN, "ici",
                        "final-hop CRC mismatch on link %u -> %u: "
                        "failing the detour copy for retry",
                        chain[n - 2], chain[n - 1]);

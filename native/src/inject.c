@@ -11,6 +11,7 @@
 #define _GNU_SOURCE
 #include "internal.h"
 #include "tpurm/inject.h"
+#include "tpurm/journal.h"
 #include "tpurm/trace.h"
 
 #include <stdatomic.h>
@@ -57,6 +58,7 @@ static const char *const g_siteNames[TPU_INJECT_SITE_COUNT] = {
     "vac.migrate",
     "hot.decide",
     "mem.corrupt",
+    "dump.write",
 };
 
 /* Env key suffix per site (TPUMEM_INJECT_<suffix>). */
@@ -75,6 +77,7 @@ static const char *const g_siteEnv[TPU_INJECT_SITE_COUNT] = {
     "VAC_MIGRATE",
     "HOT_DECIDE",
     "MEM_CORRUPT",
+    "DUMP_WRITE",
 };
 
 const char *tpurmInjectSiteName(uint32_t site)
@@ -141,7 +144,7 @@ TpuStatus tpurmInjectConfigure(uint32_t site, uint32_t mode, uint64_t arg,
             mask_clear(site);
     } else {
         mask_set(site);
-        tpuLog(TPU_LOG_INFO, "inject", "site %s armed: mode=%u arg=%llu "
+        TPU_LOG(TPU_LOG_INFO, "inject", "site %s armed: mode=%u arg=%llu "
                "burst=%u scope=%llu", g_siteNames[site], mode,
                (unsigned long long)arg, burst ? burst : 1,
                (unsigned long long)scope);
@@ -203,6 +206,19 @@ void tpurmInjectCounts(uint32_t site, uint64_t *evals, uint64_t *hits)
 
 /* ----------------------------------------------------------- evaluation */
 
+/* A hit lands in the tpubox journal and (except for dump.write, which
+ * is evaluated from the async-signal-safe dumper — no trace ring
+ * acquisition, no logging allowed there) in the trace stream. */
+static void inject_hit_note(uint32_t site, uint64_t scopeKey)
+{
+    atomic_fetch_add_explicit(&g_inject.sites[site].hits, 1,
+                              memory_order_relaxed);
+    tpurmJournalEmit(TPU_JREC_INJECT_HIT, 0, TPU_OK, site, scopeKey);
+    if (site != TPU_INJECT_SITE_DUMP_WRITE)
+        tpurmTraceInstantLabel(TPU_TRACE_INJECT_HIT, scopeKey, site,
+                               g_siteNames[site]);
+}
+
 static bool inject_eval(uint32_t site, uint64_t scopeKey)
 {
     InjectSiteState *st = &g_inject.sites[site];
@@ -218,9 +234,7 @@ static bool inject_eval(uint32_t site, uint64_t scopeKey)
         if (arm != ARM_ANY && scopeKey != arm)
             continue;
         if (atomic_compare_exchange_strong(&st->arms[i], &arm, 0)) {
-            atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
-            tpurmTraceInstantLabel(TPU_TRACE_INJECT_HIT, scopeKey, site,
-                                   g_siteNames[site]);
+            inject_hit_note(site, scopeKey);
             return true;
         }
     }
@@ -228,9 +242,7 @@ static bool inject_eval(uint32_t site, uint64_t scopeKey)
     /* Burst tail of a previous hit fails regardless of mode. */
     if (atomic_load_explicit(&st->burstLeft, memory_order_acquire) > 0 &&
         atomic_fetch_sub(&st->burstLeft, 1) > 0) {
-        atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
-        tpurmTraceInstantLabel(TPU_TRACE_INJECT_HIT, scopeKey, site,
-                               g_siteNames[site]);
+        inject_hit_note(site, scopeKey);
         return true;
     }
 
@@ -282,14 +294,13 @@ static bool inject_eval(uint32_t site, uint64_t scopeKey)
         break;
     }
     if (hit) {
-        atomic_fetch_add_explicit(&st->hits, 1, memory_order_relaxed);
-        tpurmTraceInstantLabel(TPU_TRACE_INJECT_HIT, scopeKey, site,
-                               g_siteNames[site]);
+        inject_hit_note(site, scopeKey);
         uint32_t burst = atomic_load(&st->burst);
         if (burst > 1)
             atomic_store(&st->burstLeft, (int32_t)burst - 1);
-        tpuLog(TPU_LOG_DEBUG, "inject", "site %s fired (scope=%llu)",
-               g_siteNames[site], (unsigned long long)scopeKey);
+        if (site != TPU_INJECT_SITE_DUMP_WRITE)
+            TPU_LOG(TPU_LOG_DEBUG, "inject", "site %s fired (scope=%llu)",
+                   g_siteNames[site], (unsigned long long)scopeKey);
     }
     return hit;
 }
@@ -330,7 +341,7 @@ static void inject_parse_spec(uint32_t site, const char *spec)
         mode = TPU_INJECT_PPM;
         arg = strtoull(spec + 4, NULL, 0);
     } else {
-        tpuLog(TPU_LOG_WARN, "inject", "bad spec for site %s: '%s'",
+        TPU_LOG(TPU_LOG_WARN, "inject", "bad spec for site %s: '%s'",
                g_siteNames[site], spec);
         return;
     }
@@ -345,7 +356,7 @@ static void inject_parse_spec(uint32_t site, const char *spec)
     }
     if ((mode == TPU_INJECT_NTH && arg == 0) ||
         tpurmInjectConfigure(site, mode, arg, burst, scope) != TPU_OK)
-        tpuLog(TPU_LOG_WARN, "inject", "bad spec for site %s: '%s'",
+        TPU_LOG(TPU_LOG_WARN, "inject", "bad spec for site %s: '%s'",
                g_siteNames[site], spec);
 }
 

@@ -38,6 +38,23 @@ static inline uint64_t tpuNowNs(void)
     return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
 }
 
+/* Crash-dump raw hooks (journal.c) read mutex-guarded fields WITHOUT
+ * the lock — a signal handler cannot take it, and torn values are
+ * benign by the bundle's best-effort contract.  Annotate those
+ * readers so TSan doesn't demand every writer become an atomic. */
+#if defined(__has_feature)
+#  if __has_feature(thread_sanitizer)
+#    define TPU_NO_TSAN __attribute__((no_sanitize("thread")))
+#  endif
+#endif
+#ifndef TPU_NO_TSAN
+#  if defined(__SANITIZE_THREAD__)
+#    define TPU_NO_TSAN __attribute__((no_sanitize_thread))
+#  else
+#    define TPU_NO_TSAN
+#  endif
+#endif
+
 /* ------------------------------------------------------------- histogram
  *
  * Log-linear HDR-style latency histogram (trace.c): values below
@@ -111,6 +128,21 @@ typedef enum {
 
 void tpuLog(TpuLogLevel level, const char *subsys, const char *fmt, ...)
     __attribute__((format(printf, 3, 4)));
+
+/* Minimum level tpuLog processes (TPUMEM_LOG_LEVEL, default DEBUG so
+ * everything flows as before; registry-generation cached). */
+TpuLogLevel tpuLogGate(void);
+
+/* Leveled logging front end (NvLog/NV_PRINTF analog): the ONE spelling
+ * for engine diagnostics.  Gated at the call site so a raised
+ * TPUMEM_LOG_LEVEL skips the formatting entirely; the tpuLog sink
+ * mirrors WARN+ into the tpubox binary journal, so printf debugging
+ * and the black box can never disagree. */
+#define TPU_LOG(level, subsys, ...)                                     \
+    do {                                                                \
+        if ((int)(level) >= (int)tpuLogGate())                          \
+            tpuLog((level), (subsys), __VA_ARGS__);                     \
+    } while (0)
 void tpuCounterAdd(const char *name, uint64_t delta);
 _Atomic uint64_t *tpuCounterRef(const char *name);
 void tpuCounterAddScoped(const char *name, uint32_t devInst,
@@ -161,6 +193,39 @@ static inline uint64_t tpuRegCacheGet(TpuRegCache *c, const char *key,
     atomic_store_explicit(&c->gen, g, memory_order_release);
     return v;
 }
+
+/* ----------------------------------------------------------- tpubox
+ *
+ * Cross-module plumbing for the black-box journal + crash dumper
+ * (journal.c; public surface in tpurm/journal.h). */
+
+/* Async-signal-safe fd-backed formatting cursor: the crash dumper and
+ * the last-gasp SIGSEGV handler format through these instead of stdio
+ * (no malloc, no locks; write(2) only). */
+typedef struct TpuDumpCur {
+    int fd;
+    size_t off;
+    int err;                     /* real write(2) failure             */
+    int trunc;                   /* dump.write inject hit: bundle cut */
+    char buf[512];
+} TpuDumpCur;
+
+void tpuDumpFlush(TpuDumpCur *c);
+void tpuDumpStr(TpuDumpCur *c, const char *s);
+void tpuDumpU64(TpuDumpCur *c, uint64_t v);
+void tpuDumpHex(TpuDumpCur *c, uint64_t v);
+
+/* Raw bundle sections: LOCK-FREE snapshots (atomic/plain loads only —
+ * the dumper may run from a signal handler while the subsystem's own
+ * mutex is held by the interrupted thread).  Benign races read torn
+ * but never fault. */
+void tpurmHealthDumpRaw(TpuDumpCur *c);    /* health table + open vac txns */
+void tpurmMemringDumpRaw(TpuDumpCur *c);   /* per-ring frontier/claimed    */
+void tpurmShieldDumpRaw(TpuDumpCur *c);    /* retirement list              */
+
+/* Render hooks (procfs.c). */
+void tpurmJournalRenderText(TpuCur *c);
+void tpurmJournalRenderProm(TpuCur *c);
 
 /* ------------------------------------------------------ broker UVM server */
 

@@ -1,0 +1,701 @@
+/*
+ * tpubox — black-box error journal + async-signal-safe crash dumper.
+ *
+ * Reference lineage:
+ *   - record ring + wrap accounting:  diagnostics/journal.c (RCDB)
+ *   - binary always-on logger:        diagnostics/nvlog.c
+ *   - mmap'd client event tailing:    nvidia-uvm/uvm_tools.c
+ *
+ * See include/tpurm/journal.h for the region/record ABI and the
+ * seqlock commit discipline.  Everything on the emit path is
+ * async-signal-safe: atomic RMWs, plain stores, clock_gettime and an
+ * optional futex WAKE.  The dumper additionally restricts itself to
+ * open/write/rename/close plus the hand-rolled formatters below — no
+ * stdio, no malloc, no locks — because its most important caller is
+ * the last-gasp SIGSEGV handler.
+ */
+#define _GNU_SOURCE
+#include "internal.h"
+
+#include "tpurm/inject.h"
+#include "tpurm/journal.h"
+#include "tpurm/trace.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#ifndef MFD_CLOEXEC
+#define MFD_CLOEXEC 0x0001u
+#endif
+
+_Static_assert(sizeof(TpuJournalRec) == TPU_JOURNAL_REC_BYTES,
+               "journal record ABI is 64 bytes");
+_Static_assert(sizeof(TpuJournalHdr) <= TPU_JOURNAL_HDR_BYTES,
+               "journal header fits its page");
+
+/* Canonical dotted record-type names — the bundle / scrape / inventory
+ * spelling.  scripts/check_journal.sh parses this table: keep one name
+ * per line between the open brace and the closing `};`. */
+static const char *const g_jrecNames[] = {
+    "none",
+    "health.note",
+    "health.transition",
+    "health.evac",
+    "wd.rung",
+    "reset.gen",
+    "reset.device",
+    "ring.stale",
+    "ring.deadline",
+    "ici.flap",
+    "ici.retrain",
+    "ici.crc",
+    "page.quarantine",
+    "page.poison",
+    "shield.verdict",
+    "vac.begin",
+    "vac.commit",
+    "vac.abort",
+    "inject.hit",
+    "sched.shed",
+    "sched.preempt",
+    "sched.retire",
+    "client.death",
+    "log",
+    "dump",
+};
+_Static_assert(sizeof(g_jrecNames) / sizeof(g_jrecNames[0]) ==
+               TPU_JREC_TYPE_COUNT, "name per record type");
+
+static struct {
+    TpuJournalHdr *hdr;          /* NULL until init succeeds          */
+    TpuJournalRec *recs;
+    uint32_t cap;                /* power of two                      */
+    int fd;                      /* memfd (-1: anonymous fallback)    */
+    int enabled;                 /* TPUMEM_JOURNAL_ENABLE (load time) */
+    char dumpDir[224];           /* TPUMEM_DUMP_DIR cached at init    */
+    char lastBundle[288];
+    _Atomic uint32_t dumpSeq;
+    _Atomic int inDump;          /* recursion / reentry guard         */
+    _Atomic uint64_t offDrops;   /* emits refused (disabled / no init)*/
+    /* Counter cells resolved at init so signal-context bumps never
+     * take the registration mutex. */
+    _Atomic uint64_t *ctrDumps;
+    _Atomic uint64_t *ctrDumpErrors;
+    _Atomic uint64_t *ctrDumpIoErrors;
+    _Atomic uint64_t *ctrLogMirrors;
+} g_j = { .fd = -1 };
+
+/* ------------------------------------------------------------------- init */
+
+static void journal_init(void)
+{
+    uint64_t cap = tpuRegistryGet("journal_ring", 16384);
+    if (cap < 64)
+        cap = 64;
+    if (cap > (1u << 22))
+        cap = 1u << 22;
+    while (cap & (cap - 1))
+        cap &= cap - 1;          /* round down to a power of two */
+
+    g_j.enabled = tpuRegistryGet("journal_enable", 1) != 0;
+
+    const char *dir = getenv("TPUMEM_DUMP_DIR");
+    if (dir && dir[0]) {
+        strncpy(g_j.dumpDir, dir, sizeof(g_j.dumpDir) - 1);
+        g_j.dumpDir[sizeof(g_j.dumpDir) - 1] = '\0';
+    }
+
+    size_t size = TPU_JOURNAL_HDR_BYTES + (size_t)cap * TPU_JOURNAL_REC_BYTES;
+    void *map = MAP_FAILED;
+    int fd = (int)syscall(SYS_memfd_create, "tpubox-journal", MFD_CLOEXEC);
+    if (fd >= 0) {
+        if (ftruncate(fd, (off_t)size) == 0)
+            map = mmap(NULL, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+        if (map == MAP_FAILED) {
+            close(fd);
+            fd = -1;
+        }
+    }
+    if (map == MAP_FAILED)
+        map = mmap(NULL, size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_ANONYMOUS, -1, 0);
+    if (map == MAP_FAILED) {
+        TPU_LOG(TPU_LOG_ERROR, "journal", "region mmap failed: %d", errno);
+        return;                  /* journal stays disabled; emits drop */
+    }
+
+    TpuJournalHdr *h = (TpuJournalHdr *)map;
+    h->magic = TPU_JOURNAL_MAGIC;
+    h->version = TPU_JOURNAL_VERSION;
+    h->cap = (uint32_t)cap;
+    h->recSize = TPU_JOURNAL_REC_BYTES;
+
+    g_j.ctrDumps = tpuCounterRef("journal_dumps");
+    g_j.ctrDumpErrors = tpuCounterRef("journal_dump_errors");
+    g_j.ctrDumpIoErrors = tpuCounterRef("journal_dump_io_errors");
+    g_j.ctrLogMirrors = tpuCounterRef("journal_log_mirrors");
+
+    g_j.fd = fd;
+    g_j.cap = (uint32_t)cap;
+    g_j.recs = (TpuJournalRec *)((char *)map + TPU_JOURNAL_HDR_BYTES);
+    __atomic_store_n(&g_j.hdr, h, __ATOMIC_RELEASE);   /* publish last */
+}
+
+__attribute__((constructor)) static void journal_ctor(void)
+{
+    journal_init();
+}
+
+/* --------------------------------------------------------------- emission */
+
+void tpurmJournalEmitFlow(uint32_t type, uint32_t dev, TpuStatus status,
+                          uint64_t a0, uint64_t a1, uint64_t flow)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    if (!h || !g_j.enabled || type == 0 || type >= TPU_JREC_TYPE_COUNT) {
+        atomic_fetch_add_explicit(&g_j.offDrops, 1, memory_order_relaxed);
+        return;
+    }
+    uint32_t cap = g_j.cap;
+    uint64_t w = __atomic_fetch_add(&h->widx, 1, __ATOMIC_RELAXED);
+    if (w >= cap)                /* flight-recorder overwrite */
+        __atomic_fetch_add(&h->dropped, 1, __ATOMIC_RELAXED);
+
+    TpuJournalRec *r = &g_j.recs[w & (cap - 1)];
+    __atomic_store_n(&r->seq, 0, __ATOMIC_RELEASE);    /* invalidate */
+    r->tsNs = tpuNowNs();
+    r->flow = flow;
+    r->a0 = a0;
+    r->a1 = a1;
+    r->status = status;
+    r->type = (uint16_t)type;
+    r->dev = (uint16_t)dev;
+    r->pad[0] = 0;
+    r->pad[1] = 0;
+    __atomic_store_n(&r->seq, w + 1, __ATOMIC_RELEASE); /* commit */
+
+    __atomic_fetch_add(&h->emitted[type], 1, __ATOMIC_RELAXED);
+    __atomic_store_n(&h->doorbell, (uint32_t)(w + 1), __ATOMIC_RELEASE);
+    if (__atomic_load_n(&h->nsubs, __ATOMIC_ACQUIRE) > 0)
+        syscall(SYS_futex, &h->doorbell, FUTEX_WAKE, INT32_MAX,
+                NULL, NULL, 0);
+}
+
+void tpurmJournalEmit(uint32_t type, uint32_t dev, TpuStatus status,
+                      uint64_t a0, uint64_t a1)
+{
+    tpurmJournalEmitFlow(type, dev, status, a0, a1, tpurmTraceFlowGet());
+}
+
+const char *tpurmJournalTypeName(uint32_t type)
+{
+    return type < TPU_JREC_TYPE_COUNT ? g_jrecNames[type] : NULL;
+}
+
+/* ------------------------------------------------------------- inspection */
+
+void tpurmJournalStats(uint64_t *emitted, uint64_t *dropped, uint32_t *cap)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    uint64_t off = atomic_load_explicit(&g_j.offDrops, memory_order_relaxed);
+    if (emitted)
+        *emitted = h ? __atomic_load_n(&h->widx, __ATOMIC_ACQUIRE) : 0;
+    if (dropped)
+        *dropped = off + (h ? __atomic_load_n(&h->dropped,
+                                              __ATOMIC_RELAXED) : 0);
+    if (cap)
+        *cap = h ? g_j.cap : 0;
+}
+
+uint64_t tpurmJournalTypeCount(uint32_t type)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    if (!h || type >= TPU_JREC_TYPE_COUNT)
+        return 0;
+    return __atomic_load_n(&h->emitted[type], __ATOMIC_RELAXED);
+}
+
+/* ----------------------------------------------------------- subscription */
+
+int tpurmJournalRegionFd(void)
+{
+    return g_j.fd >= 0 ? dup(g_j.fd) : -1;
+}
+
+uint64_t tpurmJournalHead(void)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    return h ? __atomic_load_n(&h->widx, __ATOMIC_ACQUIRE) : 0;
+}
+
+void tpurmJournalSubscribe(void)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    if (h)
+        __atomic_fetch_add(&h->nsubs, 1, __ATOMIC_ACQ_REL);
+}
+
+void tpurmJournalUnsubscribe(void)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    if (h && __atomic_load_n(&h->nsubs, __ATOMIC_ACQUIRE) > 0)
+        __atomic_fetch_sub(&h->nsubs, 1, __ATOMIC_ACQ_REL);
+}
+
+size_t tpurmJournalConsume(uint64_t *cursor, TpuJournalRec *out,
+                           size_t max, uint64_t *lost)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    if (!h || !cursor || !out)
+        return 0;
+    uint32_t cap = g_j.cap;
+    uint64_t w = __atomic_load_n(&h->widx, __ATOMIC_ACQUIRE);
+    uint64_t c = *cursor;
+    if (c + cap < w) {           /* lapped: oldest survivor is w - cap */
+        if (lost)
+            *lost += (w - cap) - c;
+        c = w - cap;
+    }
+    size_t n = 0;
+    while (c < w && n < max) {
+        TpuJournalRec *r = &g_j.recs[c & (cap - 1)];
+        uint64_t s1 = __atomic_load_n(&r->seq, __ATOMIC_ACQUIRE);
+        if (s1 != c + 1) {
+            if (s1 > c + 1) {    /* overwritten while we read */
+                if (lost)
+                    (*lost)++;
+                c++;
+                continue;
+            }
+            break;               /* producer mid-write: retry later */
+        }
+        out[n] = *r;
+        if (__atomic_load_n(&r->seq, __ATOMIC_ACQUIRE) != c + 1) {
+            if (lost)
+                (*lost)++;       /* torn: lapped during the copy */
+            c++;
+            continue;
+        }
+        n++;
+        c++;
+    }
+    *cursor = c;
+    return n;
+}
+
+int tpurmJournalWait(uint64_t cursor, uint64_t timeoutNs)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    if (!h)
+        return 0;
+    uint64_t deadline = tpuNowNs() + timeoutNs;
+    for (;;) {
+        if (__atomic_load_n(&h->widx, __ATOMIC_ACQUIRE) > cursor)
+            return 1;
+        uint32_t db = __atomic_load_n(&h->doorbell, __ATOMIC_ACQUIRE);
+        if (__atomic_load_n(&h->widx, __ATOMIC_ACQUIRE) > cursor)
+            return 1;            /* re-check: no missed wake */
+        uint64_t now = tpuNowNs();
+        if (now >= deadline)
+            return 0;
+        uint64_t rem = deadline - now;
+        struct timespec ts = {
+            .tv_sec = (time_t)(rem / 1000000000ull),
+            .tv_nsec = (long)(rem % 1000000000ull),
+        };
+        syscall(SYS_futex, &h->doorbell, FUTEX_WAIT, db, &ts, NULL, 0);
+    }
+}
+
+/* ----------------------------------------------- signal-safe formatting
+ *
+ * The dumper cannot use stdio (malloc, locks), so it formats through a
+ * tiny fd-backed cursor.  Exported (internal.h) for the last-gasp
+ * SIGSEGV handler, which shares the same constraint. */
+
+void tpuDumpFlush(TpuDumpCur *c)
+{
+    size_t done = 0;
+    if (c->err || c->trunc) {
+        c->off = 0;
+        return;
+    }
+    while (done < c->off) {
+        ssize_t n = write(c->fd, c->buf + done, c->off - done);
+        if (n < 0) {
+            if (errno == EINTR)
+                continue;
+            c->err = 1;
+            break;
+        }
+        done += (size_t)n;
+    }
+    c->off = 0;
+}
+
+void tpuDumpStr(TpuDumpCur *c, const char *s)
+{
+    while (s && *s) {
+        if (c->off == sizeof(c->buf))
+            tpuDumpFlush(c);
+        if (c->err || c->trunc)
+            return;
+        c->buf[c->off++] = *s++;
+    }
+}
+
+void tpuDumpU64(TpuDumpCur *c, uint64_t v)
+{
+    char tmp[24];
+    size_t n = 0;
+    do {
+        tmp[n++] = (char)('0' + v % 10);
+        v /= 10;
+    } while (v);
+    char out[24];
+    for (size_t i = 0; i < n; i++)
+        out[i] = tmp[n - 1 - i];
+    out[n] = '\0';
+    tpuDumpStr(c, out);
+}
+
+void tpuDumpHex(TpuDumpCur *c, uint64_t v)
+{
+    static const char digits[] = "0123456789abcdef";
+    char tmp[20];
+    size_t n = 0;
+    do {
+        tmp[n++] = digits[v & 0xf];
+        v >>= 4;
+    } while (v);
+    char out[24] = "0x";
+    for (size_t i = 0; i < n; i++)
+        out[2 + i] = tmp[n - 1 - i];
+    out[2 + n] = '\0';
+    tpuDumpStr(c, out);
+}
+
+/* ------------------------------------------------------------ crash dumps */
+
+/* Section boundary: one dump.write inject evaluation per section; a
+ * hit truncates the bundle here (remaining sections skipped, trailer
+ * still written so the result stays parseable).  Exact invariant:
+ * dump.write hits == journal_dump_errors. */
+static void dump_section(TpuDumpCur *c, const char *name)
+{
+    if (c->err || c->trunc)
+        return;
+    if (tpurmInjectShouldFail(TPU_INJECT_SITE_DUMP_WRITE)) {
+        tpuDumpFlush(c);
+        c->trunc = 1;
+        if (g_j.ctrDumpErrors)
+            atomic_fetch_add_explicit(g_j.ctrDumpErrors, 1,
+                                      memory_order_relaxed);
+        return;
+    }
+    tpuDumpStr(c, "[");
+    tpuDumpStr(c, name);
+    tpuDumpStr(c, "]\n");
+}
+
+static void dump_record(TpuDumpCur *c, const TpuJournalRec *r)
+{
+    tpuDumpStr(c, "R ");
+    tpuDumpU64(c, r->seq);
+    tpuDumpStr(c, " ");
+    tpuDumpU64(c, r->tsNs);
+    tpuDumpStr(c, " ");
+    tpuDumpStr(c, g_jrecNames[r->type < TPU_JREC_TYPE_COUNT ? r->type : 0]);
+    tpuDumpStr(c, " ");
+    tpuDumpU64(c, r->dev);
+    tpuDumpStr(c, " ");
+    tpuDumpHex(c, r->status);
+    tpuDumpStr(c, " ");
+    tpuDumpU64(c, r->flow);
+    tpuDumpStr(c, " ");
+    tpuDumpHex(c, r->a0);
+    tpuDumpStr(c, " ");
+    tpuDumpHex(c, r->a1);
+    tpuDumpStr(c, "\n");
+}
+
+static void dump_counter_cb(const char *name, uint64_t value, void *ctx)
+{
+    TpuDumpCur *c = (TpuDumpCur *)ctx;
+    tpuDumpStr(c, "C ");
+    tpuDumpStr(c, name);
+    tpuDumpStr(c, " ");
+    tpuDumpU64(c, value);
+    tpuDumpStr(c, "\n");
+}
+
+/* Build "<dir>/tpubox-<pid>-<n>-<reason>" + suffix without snprintf. */
+static size_t dump_path(char *out, size_t cap, const char *reason,
+                        uint32_t n, const char *suffix)
+{
+    size_t off = 0;
+    const char *parts[2] = { g_j.dumpDir, "/tpubox-" };
+    for (int p = 0; p < 2; p++)
+        for (const char *s = parts[p]; *s && off + 1 < cap; s++)
+            out[off++] = *s;
+    char num[24];
+    size_t k = 0;
+    uint64_t pid = (uint64_t)getpid();
+    do {
+        num[k++] = (char)('0' + pid % 10);
+        pid /= 10;
+    } while (pid);
+    while (k && off + 1 < cap)
+        out[off++] = num[--k];
+    if (off + 1 < cap)
+        out[off++] = '-';
+    uint64_t v = n;
+    k = 0;
+    do {
+        num[k++] = (char)('0' + v % 10);
+        v /= 10;
+    } while (v);
+    while (k && off + 1 < cap)
+        out[off++] = num[--k];
+    if (off + 1 < cap)
+        out[off++] = '-';
+    for (size_t i = 0; reason && reason[i] && i < 24 && off + 1 < cap; i++) {
+        char ch = reason[i];
+        int ok = (ch >= 'a' && ch <= 'z') || (ch >= 'A' && ch <= 'Z') ||
+                 (ch >= '0' && ch <= '9') || ch == '.' || ch == '_';
+        out[off++] = ok ? ch : '-';
+    }
+    for (const char *s = suffix; *s && off + 1 < cap; s++)
+        out[off++] = *s;
+    out[off] = '\0';
+    return off;
+}
+
+TpuStatus tpurmJournalCrashDump(const char *reason)
+{
+    if (!g_j.dumpDir[0])
+        return TPU_ERR_NOT_SUPPORTED;
+    int expect = 0;
+    if (!atomic_compare_exchange_strong(&g_j.inDump, &expect, 1))
+        return TPU_ERR_STATE_IN_USE;   /* recursion/concurrency guard */
+
+    uint32_t n = atomic_fetch_add_explicit(&g_j.dumpSeq, 1,
+                                           memory_order_relaxed);
+    char tmp[320], fin[320];
+    dump_path(tmp, sizeof(tmp), reason, n, ".tmp");
+    dump_path(fin, sizeof(fin), reason, n, ".dump");
+
+    int fd = open(tmp, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+    if (fd < 0) {
+        if (g_j.ctrDumpIoErrors)
+            atomic_fetch_add_explicit(g_j.ctrDumpIoErrors, 1,
+                                      memory_order_relaxed);
+        atomic_store(&g_j.inDump, 0);
+        return TPU_ERR_OPERATING_SYSTEM;
+    }
+
+    TpuDumpCur cur = { .fd = fd };
+    TpuDumpCur *c = &cur;
+    tpuDumpStr(c, "TPUBOX BUNDLE v1\nreason: ");
+    tpuDumpStr(c, reason ? reason : "manual");
+    tpuDumpStr(c, "\npid: ");
+    tpuDumpU64(c, (uint64_t)getpid());
+    tpuDumpStr(c, "\ntime_ns: ");
+    tpuDumpU64(c, tpuNowNs());
+    tpuDumpStr(c, "\n");
+
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+
+    dump_section(c, "journal");
+    if (h && !c->trunc) {
+        uint32_t cap = g_j.cap;
+        uint64_t w = __atomic_load_n(&h->widx, __ATOMIC_ACQUIRE);
+        uint64_t dropped = __atomic_load_n(&h->dropped, __ATOMIC_RELAXED);
+        tpuDumpStr(c, "cap ");
+        tpuDumpU64(c, cap);
+        tpuDumpStr(c, " emitted ");
+        tpuDumpU64(c, w);
+        tpuDumpStr(c, " dropped ");
+        tpuDumpU64(c, dropped);
+        tpuDumpStr(c, "\n");
+        uint64_t start = w > cap ? w - cap : 0;
+        for (uint64_t s = start; s < w && !c->err && !c->trunc; s++) {
+            TpuJournalRec *r = &g_j.recs[s & (cap - 1)];
+            TpuJournalRec copy;
+            uint64_t s1 = __atomic_load_n(&r->seq, __ATOMIC_ACQUIRE);
+            if (s1 != s + 1)
+                continue;        /* mid-write or lapped: skip */
+            copy = *r;
+            if (__atomic_load_n(&r->seq, __ATOMIC_ACQUIRE) != s + 1)
+                continue;
+            dump_record(c, &copy);
+        }
+    }
+
+    dump_section(c, "emitted");
+    if (h && !c->trunc) {
+        for (uint32_t t = 1; t < TPU_JREC_TYPE_COUNT; t++) {
+            tpuDumpStr(c, "E ");
+            tpuDumpStr(c, g_jrecNames[t]);
+            tpuDumpStr(c, " ");
+            tpuDumpU64(c, __atomic_load_n(&h->emitted[t], __ATOMIC_RELAXED));
+            tpuDumpStr(c, "\n");
+        }
+    }
+
+    dump_section(c, "counters");
+    if (!c->trunc)
+        tpuCountersForEach(dump_counter_cb, c);   /* lock-free walk */
+
+    dump_section(c, "health");
+    if (!c->trunc)
+        tpurmHealthDumpRaw(c);
+
+    dump_section(c, "rings");
+    if (!c->trunc)
+        tpurmMemringDumpRaw(c);
+
+    dump_section(c, "shield");
+    if (!c->trunc)
+        tpurmShieldDumpRaw(c);
+
+    dump_section(c, "inject");
+    if (!c->trunc) {
+        for (uint32_t s = 0; s < TPU_INJECT_SITE_COUNT; s++) {
+            uint64_t evals = 0, hits = 0;
+            tpurmInjectCounts(s, &evals, &hits);
+            tpuDumpStr(c, "I ");
+            tpuDumpStr(c, tpurmInjectSiteName(s));
+            tpuDumpStr(c, " evals ");
+            tpuDumpU64(c, evals);
+            tpuDumpStr(c, " hits ");
+            tpuDumpU64(c, hits);
+            tpuDumpStr(c, "\n");
+        }
+    }
+
+    /* Trailer: always written, even after truncation, so a chopped
+     * bundle stays parseable and says so. */
+    int wasTrunc = c->trunc;
+    c->trunc = 0;
+    tpuDumpStr(c, "[end]\nstatus: ");
+    tpuDumpStr(c, wasTrunc ? "truncated" : (c->err ? "error" : "complete"));
+    tpuDumpStr(c, "\n");
+    tpuDumpFlush(c);
+    int ioErr = c->err;
+    close(fd);
+
+    TpuStatus st = TPU_OK;
+    if (rename(tmp, fin) != 0) {
+        unlink(tmp);
+        ioErr = 1;
+        st = TPU_ERR_OPERATING_SYSTEM;
+    } else {
+        size_t i = 0;
+        for (; fin[i] && i + 1 < sizeof(g_j.lastBundle); i++)
+            g_j.lastBundle[i] = fin[i];
+        g_j.lastBundle[i] = '\0';
+    }
+    if (ioErr && g_j.ctrDumpIoErrors)
+        atomic_fetch_add_explicit(g_j.ctrDumpIoErrors, 1,
+                                  memory_order_relaxed);
+    if (g_j.ctrDumps)
+        atomic_fetch_add_explicit(g_j.ctrDumps, 1, memory_order_relaxed);
+
+    uint64_t packed = 0;
+    if (reason) {
+        size_t len = 0;
+        while (reason[len] && len < 8)
+            len++;
+        memcpy(&packed, reason, len);
+    }
+    tpurmJournalEmit(TPU_JREC_DUMP, 0, st, packed,
+                     (wasTrunc || ioErr) ? 0 : 1);
+
+    atomic_store(&g_j.inDump, 0);
+    return st;
+}
+
+size_t tpurmJournalLastBundle(char *buf, size_t cap)
+{
+    if (!buf || !cap)
+        return 0;
+    size_t i = 0;
+    for (; g_j.lastBundle[i] && i + 1 < cap; i++)
+        buf[i] = g_j.lastBundle[i];
+    buf[i] = '\0';
+    return i;
+}
+
+/* ------------------------------------------------------------- rendering */
+
+/* Same R/E line shapes as the bundle, for the procfs node and the
+ * python live scrape (normal context: TpuCur/snprintf is fine). */
+void tpurmJournalRenderText(TpuCur *c)
+{
+    TpuJournalHdr *h = __atomic_load_n(&g_j.hdr, __ATOMIC_ACQUIRE);
+    if (!h) {
+        tpuCurf(c, "# tpubox disabled\n");
+        return;
+    }
+    uint32_t cap = g_j.cap;
+    uint64_t w = __atomic_load_n(&h->widx, __ATOMIC_ACQUIRE);
+    tpuCurf(c, "# tpubox cap=%u emitted=%llu dropped=%llu\n", cap,
+            (unsigned long long)w,
+            (unsigned long long)__atomic_load_n(&h->dropped,
+                                                __ATOMIC_RELAXED));
+    uint64_t start = w > cap ? w - cap : 0;
+    for (uint64_t s = start; s < w; s++) {
+        TpuJournalRec *r = &g_j.recs[s & (cap - 1)];
+        TpuJournalRec copy;
+        if (__atomic_load_n(&r->seq, __ATOMIC_ACQUIRE) != s + 1)
+            continue;
+        copy = *r;
+        if (__atomic_load_n(&r->seq, __ATOMIC_ACQUIRE) != s + 1)
+            continue;
+        tpuCurf(c, "R %llu %llu %s %u 0x%x %llu 0x%llx 0x%llx\n",
+                (unsigned long long)copy.seq,
+                (unsigned long long)copy.tsNs,
+                g_jrecNames[copy.type < TPU_JREC_TYPE_COUNT ? copy.type : 0],
+                (unsigned)copy.dev, (unsigned)copy.status,
+                (unsigned long long)copy.flow,
+                (unsigned long long)copy.a0, (unsigned long long)copy.a1);
+    }
+    for (uint32_t t = 1; t < TPU_JREC_TYPE_COUNT; t++)
+        tpuCurf(c, "E %s %llu\n", g_jrecNames[t],
+                (unsigned long long)__atomic_load_n(&h->emitted[t],
+                                                    __ATOMIC_RELAXED));
+}
+
+size_t tpurmJournalRenderTextBuf(char *buf, size_t cap)
+{
+    TpuCur c = { .buf = buf, .cap = cap };
+    if (!buf || !cap)
+        return 0;
+    tpurmJournalRenderText(&c);
+    return c.off;
+}
+
+/* Prometheus rows for the metrics exposition (journal health at a
+ * glance; the per-type counts ride in the counters section of dumps). */
+void tpurmJournalRenderProm(TpuCur *c)
+{
+    uint64_t emitted = 0, dropped = 0;
+    uint32_t cap = 0;
+    tpurmJournalStats(&emitted, &dropped, &cap);
+    tpuCurf(c, "# TYPE tpurm_journal_records counter\n"
+               "tpurm_journal_records %llu\n",
+            (unsigned long long)emitted);
+    tpuCurf(c, "# TYPE tpurm_journal_dropped counter\n"
+               "tpurm_journal_dropped %llu\n",
+            (unsigned long long)dropped);
+    tpuCurf(c, "# TYPE tpurm_journal_capacity gauge\n"
+               "tpurm_journal_capacity %u\n", cap);
+}

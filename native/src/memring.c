@@ -42,6 +42,8 @@
 #define _GNU_SOURCE
 #include "tpurm/memring.h"
 
+#include "tpurm/journal.h"
+
 #include <errno.h>
 #include <linux/futex.h>
 #include <pthread.h>
@@ -475,6 +477,8 @@ static inline TpuStatus mr_gen_fence(TpuStatus st, uint64_t *bytes,
     if (claimGen && claimGen != tpurmDeviceGeneration()) {
         *bytes = 0;
         tpuCounterAdd("memring_stale_completions", 1);
+        tpurmJournalEmit(TPU_JREC_RING_STALE, 0, TPU_ERR_DEVICE_RESET,
+                         claimGen, tpurmDeviceGeneration());
         /* Health: a fenced zombie means an op HUNG across a reset on
          * the compute device — attributable sickness, not chaos. */
         tpurmHealthNote(0, TPU_HEALTH_EV_STALE_COMPLETION);
@@ -863,6 +867,8 @@ static bool sqe_deadline_expired(const TpuMemringSqe *sqe, uint64_t now)
 {
     if (sqe->deadlineNs && now > sqe->deadlineNs) {
         tpuCounterAdd("memring_deadline_expired", 1);
+        tpurmJournalEmit(TPU_JREC_RING_DEADLINE, sqe->devInst, TPU_OK,
+                         sqe->deadlineNs, now);
         tpurmHealthNote(sqe->devInst, TPU_HEALTH_EV_DEADLINE_EXPIRED);
         return true;
     }
@@ -1538,7 +1544,7 @@ static TpuStatus mr_create(UvmVaSpace *vs, uint32_t sqEntries,
     g_mrings.head = r;
     pthread_mutex_unlock(&g_mrings.lock);
     tpuCounterAdd("memring_rings_created", 1);
-    tpuLog(TPU_LOG_INFO, "memring",
+    TPU_LOG(TPU_LOG_INFO, "memring",
            "ring created: sq=%u cq=%u workers=%u%s", sqEntries, cqEntries,
            workers, internal ? " (internal spine)" : "");
     *out = r;
@@ -1887,7 +1893,7 @@ static void mr_internal_init_once(void)
         workers = (uint32_t)tpuRegistryGet("memring_sqpoll_workers", 1);
     if (mr_create(NULL, entries, workers, true, &g_int.ring) != TPU_OK) {
         g_int.ring = NULL;
-        tpuLog(TPU_LOG_ERROR, "memring",
+        TPU_LOG(TPU_LOG_ERROR, "memring",
                "internal spine ring create failed — internal "
                "submissions will execute inline");
     }
@@ -2265,7 +2271,7 @@ TpuStatus tpurmMemringParkAll(uint64_t timeoutNs)
             return TPU_OK;
         if (tpuNowNs() >= deadline) {
             tpuCounterAdd("memring_park_timeouts", 1);
-            tpuLog(TPU_LOG_WARN, "memring",
+            TPU_LOG(TPU_LOG_WARN, "memring",
                    "park: %u op(s) still in flight at timeout (hung — "
                    "their completions will be generation-fenced)", busy);
             return TPU_ERR_RETRY_EXHAUSTED;
@@ -2331,6 +2337,7 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
              * cannot unstick a producer-side dependency cycle, and
              * resetting the device for one would be a storm. */
             tpuCounterAdd("tpurm_watchdog_nudges", 1);
+            tpurmJournalEmit(TPU_JREC_WD_RUNG, 0, TPU_OK, 1, r->id);
             atomic_fetch_add(&r->hdr->doorbell, 1);
             mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
             continue;
@@ -2347,13 +2354,15 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
              * nudge above is a producer-side dependency stall, not
              * device sickness. */
             tpuCounterAdd("tpurm_watchdog_nudges", 1);
+            tpurmJournalEmit(TPU_JREC_WD_RUNG, 0, TPU_OK, 1, r->id);
             tpurmHealthNote(0, TPU_HEALTH_EV_WD_NUDGE);
             atomic_fetch_add(&r->hdr->doorbell, 1);
             mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
             break;
         case 2:
             tpuCounterAdd("tpurm_watchdog_rc_resets", 1);
-            tpuLog(TPU_LOG_WARN, "memring",
+            tpurmJournalEmit(TPU_JREC_WD_RUNG, 0, TPU_OK, 2, r->id);
+            TPU_LOG(TPU_LOG_WARN, "memring",
                    "watchdog: ring %p stalled %llu ms — channel RC "
                    "reset-and-replay", (void *)r,
                    (unsigned long long)((now - last) / 1000000ull));
@@ -2371,4 +2380,54 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
     }
     pthread_mutex_unlock(&g_mrings.lock);
     return maxRung;
+}
+
+/* ------------------------------------------------------------ raw dump
+ *
+ * Crash-bundle section (journal.c dumper): per-ring frontier/claimed
+ * state, read WITHOUT g_mrings.lock — the dumper may run from a
+ * signal handler while the interrupted thread holds it.  The walk is
+ * bounded and tolerates torn reads; the only hazard is a ring being
+ * destroyed concurrently with the crash dump, which the process's
+ * fatal state makes vanishingly rare (and the bundle is best-effort
+ * by contract). */
+void tpurmMemringDumpRaw(TpuDumpCur *c)
+{
+    int guard = 0;
+    for (TpuMemring *r = g_mrings.head; r && guard < 64;
+         r = r->next, guard++) {
+        if (!r->hdr)
+            continue;
+        tpuDumpStr(c, "G ring ");
+        tpuDumpU64(c, r->id);
+        tpuDumpStr(c, " sq ");
+        tpuDumpU64(c, atomic_load_explicit(&r->hdr->sqHead,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, "/");
+        tpuDumpU64(c, atomic_load_explicit(&r->hdr->sqTail,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, " cq ");
+        tpuDumpU64(c, atomic_load_explicit(&r->hdr->cqHead,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, "/");
+        tpuDumpU64(c, atomic_load_explicit(&r->hdr->cqTail,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, " frontier ");
+        tpuDumpU64(c, atomic_load_explicit(&r->hdr->seqRetired,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, " inflight ");
+        tpuDumpU64(c, atomic_load_explicit(&r->inflight,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, " rung ");
+        tpuDumpU64(c, atomic_load_explicit(&r->wdRung,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, " last_progress_ns ");
+        tpuDumpU64(c, atomic_load_explicit(&r->lastProgressNs,
+                                           memory_order_relaxed));
+        tpuDumpStr(c, "\n");
+    }
+    tpuDumpStr(c, "G parked ");
+    tpuDumpU64(c, (uint64_t)atomic_load_explicit(&g_mrings.parked,
+                                                 memory_order_relaxed));
+    tpuDumpStr(c, "\n");
 }

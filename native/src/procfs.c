@@ -162,6 +162,11 @@ static void render_rdma(TpuCur *c)
 
 static void render_journal(TpuCur *c)
 {
+    /* tpubox structured records first (the machine-parsed surface —
+     * tools/tpubox.py scrapes this node live), then the legacy text
+     * log ring under a marker for human eyes. */
+    tpurmJournalRenderText(c);
+    tpuCurf(c, "# textlog\n");
     if (c->off + 1 >= c->cap)
         return;
     c->off += tpurmJournalDump(c->buf + c->off, c->cap - c->off);
@@ -181,6 +186,7 @@ static void render_metrics(TpuCur *c)
     tpurmHotRenderProm(c);
     tpurmFlowRenderProm(c);
     tpurmShieldRenderProm(c);
+    tpurmJournalRenderProm(c);
 }
 
 /* Hotness-driven placement (tpuhot): policy stats, per-device hotness

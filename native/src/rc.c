@@ -76,7 +76,7 @@ static void *rc_service_thread(void *arg)
         uint64_t value = cmd.src;
         uint32_t kind = (uint32_t)cmd.bytes;
         uint64_t rcId = cmd.pbEnd;
-        tpuLog(TPU_LOG_ERROR, "rc",
+        TPU_LOG(TPU_LOG_ERROR, "rc",
                "non-replayable %s on channel %p at value %llu",
                kind == TPU_RC_WATCHDOG_TIMEOUT ? "watchdog timeout"
                                                : "CE fault",
@@ -166,7 +166,7 @@ static void *rc_watchdog_thread(void *arg)
         if (escalate) {
             /* Outside chLock: the reset's RC recovery walks channels. */
             tpuCounterAdd("rc_device_escalations", 1);
-            tpuLog(TPU_LOG_ERROR, "rc",
+            TPU_LOG(TPU_LOG_ERROR, "rc",
                    "channel stall outlived its watchdog fault: "
                    "escalating to full-device reset");
             tpurmDeviceReset();
@@ -184,7 +184,7 @@ static void rc_init_once(void)
     if (!g_rc.shadow)
         return;
     if (pthread_create(&g_rc.service, NULL, rc_service_thread, NULL) != 0) {
-        tpuLog(TPU_LOG_ERROR, "rc", "RC service thread create failed");
+        TPU_LOG(TPU_LOG_ERROR, "rc", "RC service thread create failed");
         tpuMsgqDestroy(g_rc.shadow);
         g_rc.shadow = NULL;
         return;
@@ -193,7 +193,7 @@ static void rc_init_once(void)
                        NULL) != 0) {
         /* Tear down cleanly: shutdown wakes the service thread out of
          * its Receive loop, then the queue can be freed. */
-        tpuLog(TPU_LOG_ERROR, "rc", "RC watchdog thread create failed");
+        TPU_LOG(TPU_LOG_ERROR, "rc", "RC watchdog thread create failed");
         tpuMsgqShutdown(g_rc.shadow);
         pthread_join(g_rc.service, NULL);
         tpuMsgqDestroy(g_rc.shadow);
@@ -204,7 +204,7 @@ static void rc_init_once(void)
     /* The hung-op/reset watchdog rides the same lifecycle: any process
      * that creates a channel is covered by the full ladder. */
     tpurmResetWatchdogStart();
-    tpuLog(TPU_LOG_INFO, "rc", "robust-channel recovery ready "
+    TPU_LOG(TPU_LOG_INFO, "rc", "robust-channel recovery ready "
            "(shadow buffer + watchdog)");
 }
 
@@ -318,7 +318,7 @@ uint32_t tpuRcRecoverAll(void)
         /* bytes carries the per-call latch count so trace-side
          * accounting can reconcile exactly with the counter delta. */
         tpurmTraceInstant(TPU_TRACE_RECOVER_RC_RESET, 0, cleared);
-        tpuLog(TPU_LOG_WARN, "rc",
+        TPU_LOG(TPU_LOG_WARN, "rc",
                "reset-and-replay: cleared %u latched CE-pool error(s)",
                cleared);
     }

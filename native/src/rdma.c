@@ -118,7 +118,7 @@ static void ib_invalidate(void *coreContext)
     }
     pthread_mutex_unlock(&g_mrLock);
     tpuCounterAdd("ib_mr_invalidations", 1);
-    tpuLog(TPU_LOG_WARN, "rdma", "MR revoked mid-registration "
+    TPU_LOG(TPU_LOG_WARN, "rdma", "MR revoked mid-registration "
            "(backing freed); consumer notified");
 }
 
@@ -134,7 +134,7 @@ TpuIbPeerReg *tpuIbRegisterPeerMemoryClient(
             g_ib.regs[i].client = c;
             pthread_mutex_unlock(&g_ib.lock);
             *outInvalidate = ib_invalidate;
-            tpuLog(TPU_LOG_INFO, "rdma", "peer memory client '%s' "
+            TPU_LOG(TPU_LOG_INFO, "rdma", "peer memory client '%s' "
                    "registered", c->name);
             return &g_ib.regs[i];
         }
@@ -452,7 +452,7 @@ uint32_t tpuIbMrRevalidateAll(void)
             }
             tpuCounterAdd("rdma_reset_revocations", 1);
             tpuCounterAdd("ib_mr_invalidations", 1);
-            tpuLog(TPU_LOG_WARN, "rdma",
+            TPU_LOG(TPU_LOG_WARN, "rdma",
                    "MR revoked at device reset (re-pin failed: %s)",
                    tpuStatusToString(st));
         }

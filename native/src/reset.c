@@ -44,6 +44,7 @@
 #include "internal.h"
 #include "tpurm/health.h"
 #include "tpurm/inject.h"
+#include "tpurm/journal.h"
 #include "tpurm/rdma.h"
 #include "tpurm/trace.h"
 #include "tpurm/uvm.h"
@@ -92,7 +93,7 @@ static TpuStatus reset_locked(void)
         tpurmMemringUnparkAll();
         atomic_fetch_add(&g_reset.failed, 1);
         tpuCounterAdd("tpurm_reset_failed", 1);
-        tpuLog(TPU_LOG_WARN, "reset",
+        TPU_LOG(TPU_LOG_WARN, "reset",
                "device reset refused: PM gate held by an explicit "
                "suspend");
         return TPU_ERR_INVALID_STATE;
@@ -108,6 +109,7 @@ static TpuStatus reset_locked(void)
     uint64_t gen = atomic_fetch_add_explicit(&g_reset.generation, 1,
                                              memory_order_acq_rel) + 1;
     tpuCounterAdd("tpurm_device_generation", 1);   /* gauge-as-counter */
+    tpurmJournalEmit(TPU_JREC_RESET_GEN, 0, TPU_OK, gen, 0);
     uint32_t latches = tpuRcRecoverAll();
     uint32_t links = tpuIciRetrainAll();
     uint32_t mrs = tpuIbMrRevalidateAll();
@@ -125,13 +127,15 @@ static TpuStatus reset_locked(void)
     atomic_fetch_add(&g_reset.resets, 1);
     tpuCounterAdd("tpurm_reset_total", 1);
     tpuCounterAdd("tpurm_reset_mttr_ns", t2 - t0);
+    tpurmJournalEmit(TPU_JREC_RESET_DEVICE, 0, TPU_ERR_DEVICE_RESET,
+                     gen, t2 - t0);
     if (tSpan)
         tpurmTraceEnd(TPU_TRACE_RESET_DEVICE, tSpan, gen, t2 - t0);
     /* Health scoring: a full reset is the strongest sickness signal a
      * chip can emit.  The reset is process-global but the compute
      * device (instance 0) is the one whose tenants blacked out. */
     tpurmHealthNote(0, TPU_HEALTH_EV_DEVICE_RESET);
-    tpuLog(TPU_LOG_WARN, "reset",
+    TPU_LOG(TPU_LOG_WARN, "reset",
            "full-device reset complete: gen=%llu mttr=%llu us "
            "(quiesce %llu us%s, %u latch(es), %u link(s) active, "
            "%u MR(s) revalidated, resume %s)",
@@ -223,9 +227,13 @@ static void *reset_watchdog_thread(void *arg)
         if (tpurmInjectShouldFail(TPU_INJECT_SITE_RESET_DEVICE)) {
             atomic_fetch_add(&g_reset.injected, 1);
             tpuCounterAdd("tpurm_reset_injected", 1);
-            tpuLog(TPU_LOG_WARN, "reset",
+            TPU_LOG(TPU_LOG_WARN, "reset",
                    "reset.device injection fired: forcing full-device "
                    "reset");
+            /* Fatal-path black box: same bundle the rung-3 path
+             * writes — the injected fault IS a watchdog-forced
+             * device reset, snapshot before the reset scrubs it. */
+            tpurmJournalCrashDump("watchdog.device_reset");
             tpurmDeviceReset();
         }
 
@@ -247,18 +255,24 @@ static void *reset_watchdog_thread(void *arg)
                                          5000) * 1000000ull;
         if (tpurmMemringWatchdogScan(hangNs) >= 3 || evacDeferred) {
             if (tpurmHealthEvacLadderRung()) {
-                if (!evacDeferred)
-                    tpuLog(TPU_LOG_WARN, "reset",
+                if (!evacDeferred) {
+                    TPU_LOG(TPU_LOG_WARN, "reset",
                            "watchdog escalation rung 2.5: EVACUATE "
                            "(deferring device reset for the grace "
                            "window)");
+                }
                 evacDeferred = true;
             } else {
                 evacDeferred = false;
                 atomic_fetch_add(&g_reset.wdDeviceResets, 1);
                 tpuCounterAdd("tpurm_watchdog_device_resets", 1);
-                tpuLog(TPU_LOG_ERROR, "reset",
+                tpurmJournalEmit(TPU_JREC_WD_RUNG, 0,
+                                 TPU_ERR_DEVICE_RESET, 3, 0);
+                TPU_LOG(TPU_LOG_ERROR, "reset",
                        "watchdog escalation rung 3: full-device reset");
+                /* Fatal-path black box: snapshot the journal + engine
+                 * state BEFORE the reset scrubs the evidence. */
+                tpurmJournalCrashDump("watchdog.device_reset");
                 tpurmDeviceReset();
             }
         }
@@ -272,11 +286,11 @@ static void reset_wd_start_once(void)
     if (pthread_create(&t, NULL, reset_watchdog_thread, NULL) == 0) {
         pthread_detach(t);
         g_reset.wdReady = true;
-        tpuLog(TPU_LOG_INFO, "reset",
+        TPU_LOG(TPU_LOG_INFO, "reset",
                "hung-op watchdog ready (ladder: nudge -> RC reset -> "
                "evacuate -> device reset)");
     } else {
-        tpuLog(TPU_LOG_ERROR, "reset", "watchdog thread create failed");
+        TPU_LOG(TPU_LOG_ERROR, "reset", "watchdog thread create failed");
     }
 }
 

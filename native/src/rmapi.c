@@ -277,7 +277,7 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
                 g_rm.clients[i].used = true;
                 g_rm.clients[i].hClient = h;
                 g_rm.clients[i].objects = NULL;
-                tpuLog(TPU_LOG_INFO, "rmapi", "client 0x%x allocated", h);
+                TPU_LOG(TPU_LOG_INFO, "rmapi", "client 0x%x allocated", h);
                 return TPU_OK;
             }
         }
@@ -390,7 +390,7 @@ static TpuStatus rm_alloc_locked(TpuRmAllocParams *p)
     obj->dev = dev;
     obj->next = client->objects;
     client->objects = obj;
-    tpuLog(TPU_LOG_INFO, "rmapi", "object 0x%x class 0x%x under 0x%x",
+    TPU_LOG(TPU_LOG_INFO, "rmapi", "object 0x%x class 0x%x under 0x%x",
            obj->handle, obj->hClass, obj->hParent);
     return TPU_OK;
 }
@@ -443,7 +443,7 @@ TpuStatus tpurmFree(TpuRmFreeParams *p)
         }
         tpurmEventDestroyClient(client->hClient);
         client->used = false;
-        tpuLog(TPU_LOG_INFO, "rmapi", "client 0x%x freed", p->hRoot);
+        TPU_LOG(TPU_LOG_INFO, "rmapi", "client 0x%x freed", p->hRoot);
     } else if (!object_find(client, p->hObjectOld)) {
         st = TPU_ERR_OBJECT_NOT_FOUND;
     } else {

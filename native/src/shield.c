@@ -29,6 +29,7 @@
 #include "internal.h"
 #include "tpurm/health.h"
 #include "tpurm/inject.h"
+#include "tpurm/journal.h"
 #include "tpurm/trace.h"
 #include "uvm/uvm_internal.h"
 
@@ -173,7 +174,7 @@ static void retire_add(uint32_t tier, uint32_t dev, uint64_t off,
          * quarantine. */
         atomic_fetch_add(&g_retire.dropped, 1);
         tpuCounterAdd("shield_retire_overflow", 1);
-        tpuLog(TPU_LOG_ERROR, "shield",
+        TPU_LOG(TPU_LOG_ERROR, "shield",
                "retire table FULL (%u spans): tier %u dev %u off 0x%llx "
                "unrecorded — chunk frees now fail closed",
                SHIELD_RETIRE_MAX, tier, dev, (unsigned long long)off);
@@ -239,7 +240,7 @@ void uvmShieldCheckAlloc(UvmTierArena *arena, uint64_t off, uint64_t bytes)
         return;
     if (tpurmShieldSpanRetired(arena->tier, arena->devInst, off, bytes)) {
         tpuCounterAdd("shield_retired_realloc", 1);
-        tpuLog(TPU_LOG_ERROR, "shield",
+        TPU_LOG(TPU_LOG_ERROR, "shield",
                "retired span re-allocated: tier %u dev %u off 0x%llx",
                arena->tier, arena->devInst, (unsigned long long)off);
     }
@@ -372,6 +373,9 @@ void uvmShieldUnsealRange(UvmVaBlock *blk, uint32_t first, uint32_t count,
             uint8_t *ptr = uvmBlockPagePtr(blk, meta_tier(m), p);
             if (ptr && tpurmShieldCrc32c(ptr, ps) != m->crc) {
                 tpuCounterAdd("tpurm_shield_mismatches", 1);
+                tpurmJournalEmit(TPU_JREC_SHIELD_VERDICT,
+                                 blk->hbmDevInst, TPU_OK,
+                                 blk->start + (uint64_t)p * ps, 1);
                 tpuCounterAdd("shield_detected", m->pending);
             } else {
                 tpuCounterAdd("shield_inject_misses", m->pending);
@@ -394,6 +398,8 @@ static void shield_poison_page(UvmVaBlock *blk, uint32_t page,
     m->state = SHIELD_POISONED;
     m->pending = 0;
     tpuCounterAdd("tpurm_shield_pages_poisoned", 1);
+    tpurmJournalEmit(TPU_JREC_PAGE_POISON, blk->hbmDevInst,
+                     TPU_ERR_PAGE_POISONED, va, tier);
 
     /* Retire the backing page: arena-backed pages enter the quarantine
      * list (their PMM chunk is never freed, so the physical span can
@@ -434,11 +440,16 @@ static void shield_poison_page(UvmVaBlock *blk, uint32_t page,
     tpurmHealthNote(blk->hbmDevInst, TPU_HEALTH_EV_PAGE_QUARANTINE);
     tpurmTraceInstantLabel(TPU_TRACE_SHIELD_VERIFY, va, ps,
                            "shield.poison");
-    tpuLog(TPU_LOG_ERROR, "shield",
+    TPU_LOG(TPU_LOG_ERROR, "shield",
            "page 0x%llx POISONED (tier %u seal mismatch, no recovery "
            "source) — backing retired, owning sequence gets %s",
            (unsigned long long)va, tier,
            tpuStatusToString(TPU_ERR_PAGE_POISONED));
+    /* Containment is the tpubox black-box moment: snapshot the journal
+     * and engine state while the poisoned page's story is still in the
+     * ring.  blk->lock is held — the dumper only calls the lock-free
+     * raw hooks, so this cannot deadlock. */
+    tpurmJournalCrashDump("shield.poison");
 }
 
 /* Verify one sealed page, running the re-fetch ladder on mismatch.
@@ -482,6 +493,8 @@ static int shield_verify_page(UvmVaBlock *blk, uint32_t page)
 
     /* Mismatch: the cold copy does not match its seal. */
     tpuCounterAdd("tpurm_shield_mismatches", 1);
+    tpurmJournalEmit(TPU_JREC_SHIELD_VERDICT, blk->hbmDevInst, TPU_OK,
+                     blk->start + (uint64_t)page * ps, 2);
     if (m->pending) {
         tpuCounterAdd("shield_detected", m->pending);
         m->pending = 0;
@@ -515,7 +528,7 @@ static int shield_verify_page(UvmVaBlock *blk, uint32_t page)
         m->gen++;
         tpuCounterAdd("tpurm_shield_seals", 1);        /* reseal */
         tpuCounterAdd("tpurm_shield_refetch_saves", 1);
-        tpuLog(TPU_LOG_WARN, "shield",
+        TPU_LOG(TPU_LOG_WARN, "shield",
                "page 0x%llx: tier %u seal mismatch re-fetched from "
                "tier %d sibling", (unsigned long long)va, tier, t);
         return 1;
@@ -617,6 +630,7 @@ TpuStatus tpurmShieldVerifyWire(const void *buf, uint64_t len,
     if (tpurmShieldCrc32c(buf, len) == expectCrc)
         return TPU_OK;
     tpuCounterAdd("tpurm_shield_mismatches", 1);
+    tpurmJournalEmit(TPU_JREC_SHIELD_VERDICT, 0, TPU_OK, scope, 3);
     tpuCounterAdd("shield_wire_mismatches", 1);
     /* Resolve the inject bookkeeping: an outstanding wire flip this
      * verify caught converts to a detection. */
@@ -790,7 +804,7 @@ static void scrub_start_once(void)
     pthread_t t;
     if (pthread_create(&t, NULL, shield_scrub_thread, NULL) == 0) {
         pthread_detach(t);
-        tpuLog(TPU_LOG_INFO, "shield",
+        TPU_LOG(TPU_LOG_INFO, "shield",
                "background scrubber ready (shield_scrub_ms cadence, "
                "shield_scrub_pages pages/tick)");
     }
@@ -888,4 +902,35 @@ void tpurmShieldRenderTable(TpuCur *c)
                 g_retire.s[i].tier, g_retire.s[i].dev,
                 (unsigned long long)g_retire.s[i].off,
                 (unsigned long long)g_retire.s[i].bytes);
+}
+
+/* ------------------------------------------------------ tpubox dump */
+
+/* Crash-bundle section: the retirement list, scanned lock-free up to
+ * the release-stored count (entries are immutable once published).
+ * Async-signal-safe by the raw-hook contract — no locks, no
+ * allocation, bounded work. */
+void tpurmShieldDumpRaw(TpuDumpCur *c)
+{
+    uint32_t n = atomic_load_explicit(&g_retire.n, memory_order_acquire);
+    tpuDumpStr(c, "S total ");
+    tpuDumpU64(c, atomic_load_explicit(&g_retire.total,
+                                       memory_order_relaxed));
+    tpuDumpStr(c, " listed ");
+    tpuDumpU64(c, n);
+    tpuDumpStr(c, " overflow ");
+    tpuDumpU64(c, atomic_load_explicit(&g_retire.dropped,
+                                       memory_order_relaxed));
+    tpuDumpStr(c, "\n");
+    for (uint32_t i = 0; i < n && i < SHIELD_RETIRE_MAX; i++) {
+        tpuDumpStr(c, "S retire tier ");
+        tpuDumpU64(c, g_retire.s[i].tier);
+        tpuDumpStr(c, " dev ");
+        tpuDumpU64(c, g_retire.s[i].dev);
+        tpuDumpStr(c, " off ");
+        tpuDumpHex(c, g_retire.s[i].off);
+        tpuDumpStr(c, " bytes ");
+        tpuDumpHex(c, g_retire.s[i].bytes);
+        tpuDumpStr(c, "\n");
+    }
 }

@@ -233,7 +233,7 @@ void tpuHistReset(TpuHist *h)
 void tpurmTraceStart(void)
 {
     atomic_store_explicit(&g_trace.armed, 1, memory_order_release);
-    tpuLog(TPU_LOG_INFO, "trace", "tracing armed");
+    TPU_LOG(TPU_LOG_INFO, "trace", "tracing armed");
 }
 
 void tpurmTraceStop(void)

@@ -34,6 +34,7 @@
 #include "tpurm/flow.h"
 #include "tpurm/health.h"
 #include "tpurm/inject.h"
+#include "tpurm/journal.h"
 #include "tpurm/memring.h"
 #include "tpurm/trace.h"
 
@@ -1078,7 +1079,7 @@ static void service_cancel(UvmFaultEntry *e)
     UvmVaSpace *vs = e->vs;
     uvmToolsEmit(vs, UVM_EVENT_FATAL_FAULT, UVM_TIER_COUNT, UVM_TIER_COUNT,
                  e->devInst, e->addr, e->len ? e->len : 1);
-    tpuLog(TPU_LOG_ERROR, "uvm",
+    TPU_LOG(TPU_LOG_ERROR, "uvm",
            "fault cancel: addr=0x%llx src=%s status=%s",
            (unsigned long long)e->addr,
            e->source == UVM_FAULT_SRC_CPU ? "cpu" : "device",
@@ -1112,9 +1113,11 @@ static void service_cancel(UvmFaultEntry *e)
              * retry (service_with_retry) and is now quarantined on the
              * poison mapping. */
             tpuCounterAdd("recover_page_quarantines", 1);
+            tpurmJournalEmit(TPU_JREC_PAGE_QUARANTINE, 0,
+                             TPU_ERR_PAGE_QUARANTINED, pageAddr, ps);
             tpurmHealthNote(0, TPU_HEALTH_EV_PAGE_QUARANTINE);
             tpurmTraceInstant(TPU_TRACE_RECOVER_QUARANTINE, pageAddr, ps);
-            tpuLog(TPU_LOG_WARN, "uvm",
+            TPU_LOG(TPU_LOG_WARN, "uvm",
                    "page 0x%llx quarantined (%s)",
                    (unsigned long long)pageAddr,
                    tpuStatusToString(TPU_ERR_PAGE_QUARANTINED));
@@ -1585,7 +1588,7 @@ void uvmFaultRingDrain(void)
                 parkedSinceNs = now;
             else if (now - parkedSinceNs > 100ull * 1000 * 1000) {
                 tpuCounterAdd("uvm_fault_drain_park_bails", 1);
-                tpuLog(TPU_LOG_WARN, "uvm",
+                TPU_LOG(TPU_LOG_WARN, "uvm",
                        "fault ring drain: bailing out under reset park "
                        "(queued spine chains service after resume)");
                 return;
@@ -1656,17 +1659,29 @@ static void fault_fallback(int sig, siginfo_t *si, void *uctx)
             old->sa_handler(sig);
         return;
     }
-    /* Last gasp before the process dies on the re-fault: emit the
-     * faulting address and a native backtrace to stderr (technically
-     * async-signal-unsafe, but the alternative is dying silently —
-     * invaluable when a chaos run hits a real engine bug). */
+    /* Last gasp before the process dies on the re-fault.  Order
+     * matters and every step degrades independently:
+     *
+     *   1. tpubox crash bundle — the whole point of the black box.
+     *      Emit + dump are async-signal-safe by construction (atomics,
+     *      write/rename, pre-resolved counter cells).  If the fault
+     *      happened INSIDE the dumper, its recursion guard returns
+     *      TPU_ERR_STATE_IN_USE instead of re-entering — we fall
+     *      through to the legacy stderr path rather than recurse.
+     *   2. One stderr line via the signal-safe tpuDump formatters
+     *      (no snprintf: glibc's printf family takes locks and can
+     *      malloc for wide output).
+     *   3. A native backtrace — backtrace_symbols_fd is technically
+     *      async-signal-unsafe (first call can dlopen libgcc), so
+     *      fault_engine_init_once warms it at startup; by here the
+     *      alternative is dying silently. */
     {
-        char msg[96];
-        int n = snprintf(msg, sizeof(msg),
-                         "tpurm FATAL: unhandled SIGSEGV at %p\n",
-                         si ? si->si_addr : NULL);
-        if (n > 0)
-            (void)!write(2, msg, (size_t)n);
+        tpurmJournalCrashDump("sigsegv");
+        TpuDumpCur c = { .fd = 2 };
+        tpuDumpStr(&c, "tpurm FATAL: unhandled SIGSEGV at ");
+        tpuDumpHex(&c, (uint64_t)(uintptr_t)(si ? si->si_addr : NULL));
+        tpuDumpStr(&c, "\n");
+        tpuDumpFlush(&c);
         void *frames[32];
         int nf = backtrace(frames, 32);
         backtrace_symbols_fd(frames, nf, 2);
@@ -1769,7 +1784,7 @@ static void fault_engine_init_once(void)
         for (uint64_t i = 0; i < FAULT_RING_SIZE; i++)
             atomic_store(&w->ring[i].seq, i);
         if (pthread_create(&w->thread, NULL, fault_service_thread, w) != 0) {
-            tpuLog(TPU_LOG_ERROR, "uvm",
+            TPU_LOG(TPU_LOG_ERROR, "uvm",
                    "fault service worker %u create failed", wi);
             if (wi == 0)
                 return;          /* no engine without at least one */
@@ -1777,17 +1792,25 @@ static void fault_engine_init_once(void)
             break;
         }
     }
+    /* Warm libgcc's unwinder outside signal context: the FIRST
+     * backtrace() call may dlopen/malloc, which the last-gasp handler
+     * must never do.  After this, in-signal backtrace only walks
+     * frames. */
+    {
+        void *warm[4];
+        (void)backtrace(warm, 4);
+    }
     struct sigaction sa;
     memset(&sa, 0, sizeof(sa));
     sa.sa_sigaction = segv_handler;
     sa.sa_flags = SA_SIGINFO;
     sigemptyset(&sa.sa_mask);
     if (sigaction(SIGSEGV, &sa, &g_fault.oldSegv) != 0) {
-        tpuLog(TPU_LOG_ERROR, "uvm", "SIGSEGV handler install failed");
+        TPU_LOG(TPU_LOG_ERROR, "uvm", "SIGSEGV handler install failed");
         return;
     }
     g_fault.ready = true;
-    tpuLog(TPU_LOG_INFO, "uvm",
+    TPU_LOG(TPU_LOG_INFO, "uvm",
            "fault engine ready (software replayable faults, ring=%d, "
            "workers=%u)", FAULT_RING_SIZE, g_fault.nWorkers);
 }

@@ -250,7 +250,7 @@ TpuStatus uvmPageableAdopt(UvmVaSpace *vs, void *base, uint64_t len)
     tpuCounterAdd("uvm_hmm_adoptions", 1);
     uvmToolsEmit(vs, UVM_EVENT_HMM_ADOPT, UVM_TIER_HOST, UVM_TIER_HOST,
                  0, (uintptr_t)base, len);
-    tpuLog(TPU_LOG_INFO, "uvm", "adopted pageable span %p + %llu MB",
+    TPU_LOG(TPU_LOG_INFO, "uvm", "adopted pageable span %p + %llu MB",
            base, (unsigned long long)(len >> 20));
     return TPU_OK;
 }

@@ -115,7 +115,7 @@ static void pm_save_block(UvmVaSpace *vs, UvmVaBlock *blk)
                     sched_yield();
             }
             if (st != TPU_OK)
-                tpuLog(TPU_LOG_ERROR, "uvm_pm",
+                TPU_LOG(TPU_LOG_ERROR, "uvm_pm",
                        "suspend: block 0x%llx tier %d save failed: %s",
                        (unsigned long long)blk->start, tier,
                        tpuStatusToString(st));
@@ -146,7 +146,7 @@ TpuStatus uvmSuspend(void)
     tpuCounterAdd("uvm_suspends", 1);
     uvmToolsEmit(NULL, UVM_EVENT_PM_SUSPEND, UVM_TIER_COUNT,
                  UVM_TIER_COUNT, 0, 0, 0);
-    tpuLog(TPU_LOG_INFO, "uvm_pm", "suspended (arenas saved to host)");
+    TPU_LOG(TPU_LOG_INFO, "uvm_pm", "suspended (arenas saved to host)");
     /* Gate stays closed (g_suspended) until uvmResume — from any thread. */
     return TPU_OK;
 }
@@ -175,7 +175,7 @@ TpuStatus uvmResume(void)
             TpuStatus st = uvmBlockMakeResident(s->blk, dst, s->firstPage,
                                                 s->count, false);
             if (st != TPU_OK)
-                tpuLog(TPU_LOG_WARN, "uvm_pm",
+                TPU_LOG(TPU_LOG_WARN, "uvm_pm",
                        "resume: restore 0x%llx +%u failed: %s (lazy fault "
                        "will recover)",
                        (unsigned long long)s->blk->start, s->count,
@@ -193,6 +193,6 @@ TpuStatus uvmResume(void)
     tpuCounterAdd("uvm_resumes", 1);
     uvmToolsEmit(NULL, UVM_EVENT_PM_RESUME, UVM_TIER_COUNT,
                  UVM_TIER_COUNT, 0, 0, 0);
-    tpuLog(TPU_LOG_INFO, "uvm_pm", "resumed");
+    TPU_LOG(TPU_LOG_INFO, "uvm_pm", "resumed");
     return TPU_OK;
 }

@@ -22,7 +22,7 @@
 #define CHECK(cond)                                                      \
     do {                                                                 \
         if (!(cond)) {                                                   \
-            tpuLog(TPU_LOG_ERROR, "uvm_test", "CHECK failed %s:%d: %s",  \
+            TPU_LOG(TPU_LOG_ERROR, "uvm_test", "CHECK failed %s:%d: %s",  \
                    __FILE__, __LINE__, #cond);                           \
             return TPU_ERR_INVALID_STATE;                                \
         }                                                                \
@@ -175,14 +175,14 @@ static TpuStatus test_pmm_eviction(UvmVaSpace *vs)
     for (int i = 0; i < ALLOCS; i++) {
         TpuStatus st = uvmMemAlloc(vs, allocBytes, &ptrs[i]);
         if (st != TPU_OK)
-            tpuLog(TPU_LOG_ERROR, "uvm_test", "eviction alloc[%d]: 0x%x",
+            TPU_LOG(TPU_LOG_ERROR, "uvm_test", "eviction alloc[%d]: 0x%x",
                    i, st);
         CHECK(st == TPU_OK);
         /* Touch to populate host, with a recognizable pattern. */
         memset(ptrs[i], 0x40 + i, allocBytes);
         st = uvmMigrate(vs, ptrs[i], allocBytes, hbm, 0);
         if (st != TPU_OK)
-            tpuLog(TPU_LOG_ERROR, "uvm_test", "eviction migrate[%d]: 0x%x",
+            TPU_LOG(TPU_LOG_ERROR, "uvm_test", "eviction migrate[%d]: 0x%x",
                    i, st);
         CHECK(st == TPU_OK);
     }
@@ -935,7 +935,7 @@ static TpuStatus test_multi_worker(UvmVaSpace *vs)
 {
     long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
     if (uvmFaultWorkerCount() < 2 || ncpu < 2) {
-        tpuLog(TPU_LOG_INFO, "uvm-test",
+        TPU_LOG(TPU_LOG_INFO, "uvm-test",
                "multi_worker: skipped (%u workers, %ld cpus)",
                uvmFaultWorkerCount(), ncpu);
         return TPU_OK;

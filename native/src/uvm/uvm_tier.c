@@ -94,10 +94,10 @@ static void tiers_init_once(void)
         arena_init(&g_tiers.cxl, UVM_TIER_CXL, 0, cxlBase, cxlBytes) ==
             TPU_OK) {
         g_tiers.cxlOk = true;
-        tpuLog(TPU_LOG_INFO, "uvm", "CXL tier arena: %llu MB",
+        TPU_LOG(TPU_LOG_INFO, "uvm", "CXL tier arena: %llu MB",
                (unsigned long long)(cxlBytes >> 20));
     } else {
-        tpuLog(TPU_LOG_ERROR, "uvm", "CXL tier arena init failed");
+        TPU_LOG(TPU_LOG_ERROR, "uvm", "CXL tier arena init failed");
     }
 }
 

@@ -276,7 +276,7 @@ void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
         void *addr = (char *)(uintptr_t)blk->start +
                      (uint64_t)firstPage * ps;
         if (mprotect(addr, (uint64_t)count * ps, prot) != 0)
-            tpuLog(TPU_LOG_ERROR, "uvm", "mprotect(%p, %u pages, %d) failed",
+            TPU_LOG(TPU_LOG_ERROR, "uvm", "mprotect(%p, %u pages, %d) failed",
                    addr, count, prot);
     } else {
         /* Cancelled pages sit on poison mappings that must stay RW;
@@ -293,7 +293,7 @@ void uvmBlockSetCpuAccess(UvmVaBlock *blk, uint32_t firstPage,
                 span++;
             void *addr = (char *)(uintptr_t)blk->start + (uint64_t)p * ps;
             if (mprotect(addr, (uint64_t)span * ps, prot) != 0)
-                tpuLog(TPU_LOG_ERROR, "uvm",
+                TPU_LOG(TPU_LOG_ERROR, "uvm",
                        "mprotect(%p, %u pages, %d) failed", addr, span,
                        prot);
             p += span;
@@ -981,7 +981,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             tpuCounterAdd("recover_tier_fallbacks", 1);
             tpurmTraceInstant(TPU_TRACE_RECOVER_TIER_FALLBACK, blk->start,
                               dst.tier);
-            tpuLog(TPU_LOG_WARN, "uvm",
+            TPU_LOG(TPU_LOG_WARN, "uvm",
                    "tier fallback: block %llx pages [%u,+%u) %s -> HOST "
                    "(aperture allocation failed)",
                    (unsigned long long)blk->start, firstPage, count,

@@ -105,7 +105,7 @@ TpuStatus uvmTenantConfigure(uint32_t tenantId, uint32_t priority,
     atomic_store_explicit(&g_tenants.active, 1, memory_order_release);
     pthread_mutex_unlock(&g_tenants.lock);
     tpuCounterAdd("tier_tenant_configs", 1);
-    tpuLog(TPU_LOG_INFO, "uvm",
+    TPU_LOG(TPU_LOG_INFO, "uvm",
            "tenant %u: prio=%u quota hbm=%llu cxl=%llu pages", tenantId,
            priority, (unsigned long long)hbmQuotaPages,
            (unsigned long long)cxlQuotaPages);
@@ -412,7 +412,7 @@ void uvmVaSpaceDestroy(UvmVaSpace *vs)
         TpuStatus ms = uvmMigrate(vs, (void *)(uintptr_t)adopted[i].start,
                                   adopted[i].size, home, 0);
         if (ms != TPU_OK)
-            tpuLog(TPU_LOG_ERROR, "uvm",
+            TPU_LOG(TPU_LOG_ERROR, "uvm",
                    "adopted range %#llx migrate-home failed (0x%x): "
                    "restored contents will be STALE",
                    (unsigned long long)adopted[i].start, ms);

@@ -1,0 +1,384 @@
+/*
+ * tpubox journal test: record/header ABI (the mmap contract
+ * uvm/journal.py parses by offset), wrap-and-drop flight-recorder
+ * accounting, concurrent emitters committing under the seqlock
+ * discipline, the consumer cursor (consume + futex wait), the mmap'd
+ * region through tpurmJournalRegionFd, and crash-bundle atomicity —
+ * complete bundles reconcile record counts against their own counter
+ * snapshot, dump.write-truncated bundles stay parseable and uphold
+ * hits == journal_dump_errors.
+ */
+#define _GNU_SOURCE
+#include <pthread.h>
+#include <stddef.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "tpurm/inject.h"
+#include "tpurm/journal.h"
+#include "tpurm/tpurm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+/* The ABI the python parser and external tailers hardcode. */
+static int test_abi(void)
+{
+    CHECK(sizeof(TpuJournalRec) == 64);
+    CHECK(offsetof(TpuJournalRec, seq) == 0);
+    CHECK(offsetof(TpuJournalRec, tsNs) == 8);
+    CHECK(offsetof(TpuJournalRec, flow) == 16);
+    CHECK(offsetof(TpuJournalRec, a0) == 24);
+    CHECK(offsetof(TpuJournalRec, a1) == 32);
+    CHECK(offsetof(TpuJournalRec, status) == 40);
+    CHECK(offsetof(TpuJournalRec, type) == 44);
+    CHECK(offsetof(TpuJournalRec, dev) == 46);
+    CHECK(offsetof(TpuJournalHdr, magic) == 0);
+    CHECK(offsetof(TpuJournalHdr, version) == 4);
+    CHECK(offsetof(TpuJournalHdr, cap) == 8);
+    CHECK(offsetof(TpuJournalHdr, recSize) == 12);
+    CHECK(offsetof(TpuJournalHdr, widx) == 16);
+    CHECK(offsetof(TpuJournalHdr, dropped) == 24);
+    CHECK(offsetof(TpuJournalHdr, doorbell) == 32);
+    CHECK(offsetof(TpuJournalHdr, nsubs) == 36);
+    CHECK(offsetof(TpuJournalHdr, emitted) == 40);
+    CHECK(sizeof(TpuJournalHdr) <= TPU_JOURNAL_HDR_BYTES);
+    /* Every type has a dotted name; out of range has none. */
+    for (uint32_t t = 0; t < TPU_JREC_TYPE_COUNT; t++)
+        CHECK(tpurmJournalTypeName(t) != NULL);
+    CHECK(tpurmJournalTypeName(TPU_JREC_TYPE_COUNT) == NULL);
+    CHECK(strcmp(tpurmJournalTypeName(TPU_JREC_ICI_FLAP), "ici.flap") == 0);
+    CHECK(strcmp(tpurmJournalTypeName(TPU_JREC_DUMP), "dump") == 0);
+    return 0;
+}
+
+static int test_emit_consume(void)
+{
+    uint64_t cursor = tpurmJournalHead();
+    uint64_t c0 = tpurmJournalTypeCount(TPU_JREC_ICI_FLAP);
+    tpurmJournalEmitFlow(TPU_JREC_ICI_FLAP, 3, TPU_OK, 0x11, 0x22, 77);
+    CHECK(tpurmJournalTypeCount(TPU_JREC_ICI_FLAP) == c0 + 1);
+
+    TpuJournalRec rec[4];
+    uint64_t lost = 0;
+    size_t n = tpurmJournalConsume(&cursor, rec, 4, &lost);
+    CHECK(n == 1);
+    CHECK(lost == 0);
+    CHECK(rec[0].type == TPU_JREC_ICI_FLAP);
+    CHECK(rec[0].dev == 3);
+    CHECK(rec[0].a0 == 0x11 && rec[0].a1 == 0x22);
+    CHECK(rec[0].flow == 77);
+    CHECK(rec[0].status == TPU_OK);
+    CHECK(rec[0].tsNs != 0);
+    CHECK(cursor == tpurmJournalHead());
+
+    /* Type 0 / out-of-range types are refused (counted, not stored). */
+    uint64_t head = tpurmJournalHead();
+    tpurmJournalEmit(0, 0, TPU_OK, 0, 0);
+    tpurmJournalEmit(TPU_JREC_TYPE_COUNT, 0, TPU_OK, 0, 0);
+    CHECK(tpurmJournalHead() == head);
+    return 0;
+}
+
+static int test_wrap_drop(void)
+{
+    uint64_t em0, dr0, em1, dr1;
+    uint32_t cap = 0;
+    tpurmJournalStats(&em0, &dr0, &cap);
+    CHECK(cap >= 64);
+
+    /* Emit 2*cap records: every claim past slot `cap` overwrites the
+     * oldest survivor (flight-recorder), accounted in dropped. */
+    for (uint64_t i = 0; i < 2ull * cap; i++)
+        tpurmJournalEmit(TPU_JREC_RING_STALE, 0, TPU_ERR_DEVICE_RESET,
+                         i, 0);
+    tpurmJournalStats(&em1, &dr1, NULL);
+    CHECK(em1 == em0 + 2ull * cap);
+    CHECK(dr1 >= dr0 + cap);         /* >= : earlier tests also fill  */
+
+    /* A stale cursor is lapped: consume reports the loss and resyncs
+     * to the oldest survivor. */
+    uint64_t cursor = 0, lost = 0;
+    TpuJournalRec rec[8];
+    size_t n = tpurmJournalConsume(&cursor, rec, 8, &lost);
+    CHECK(n == 8);
+    CHECK(lost == em1 - cap);
+    CHECK(cursor == em1 - cap + 8);
+    CHECK(rec[0].seq == em1 - cap + 1);  /* oldest survivor, committed */
+    return 0;
+}
+
+#define EMITTERS 4
+#define PER_EMITTER 4000
+
+static void *emitter_thread(void *arg)
+{
+    uint64_t id = (uint64_t)(uintptr_t)arg;
+    for (uint64_t i = 0; i < PER_EMITTER; i++)
+        tpurmJournalEmitFlow(TPU_JREC_INJECT_HIT, (uint32_t)id, TPU_OK,
+                             id, i, id + 1);
+    return NULL;
+}
+
+static int test_concurrent_emitters(void)
+{
+    uint64_t em0, em1;
+    uint64_t t0 = tpurmJournalTypeCount(TPU_JREC_INJECT_HIT);
+    tpurmJournalStats(&em0, NULL, NULL);
+    pthread_t th[EMITTERS];
+    for (uintptr_t i = 0; i < EMITTERS; i++)
+        pthread_create(&th[i], NULL, emitter_thread, (void *)i);
+    for (int i = 0; i < EMITTERS; i++)
+        pthread_join(th[i], NULL);
+    tpurmJournalStats(&em1, NULL, NULL);
+    CHECK(em1 == em0 + (uint64_t)EMITTERS * PER_EMITTER);
+    CHECK(tpurmJournalTypeCount(TPU_JREC_INJECT_HIT) ==
+          t0 + (uint64_t)EMITTERS * PER_EMITTER);
+
+    /* Every surviving slot must hold a committed, untorn record: its
+     * seq equals its ring index + 1 and its payload is self-consistent
+     * (a1 < PER_EMITTER stamped by the a0/dev emitter). */
+    uint64_t cursor = em1 > 64 ? em1 - 64 : 0, lost = 0;
+    TpuJournalRec rec[64];
+    size_t n = tpurmJournalConsume(&cursor, rec, 64, &lost);
+    CHECK(n == 64);
+    for (size_t i = 0; i < n; i++) {
+        CHECK(rec[i].type == TPU_JREC_INJECT_HIT);
+        CHECK(rec[i].dev == rec[i].a0);
+        CHECK(rec[i].flow == rec[i].a0 + 1);
+        CHECK(rec[i].a1 < PER_EMITTER);
+    }
+    return 0;
+}
+
+static void *wait_emitter(void *arg)
+{
+    (void)arg;
+    struct timespec ts = { 0, 50 * 1000 * 1000 };
+    nanosleep(&ts, NULL);
+    tpurmJournalEmit(TPU_JREC_HEALTH_NOTE, 0, TPU_OK, 1, 2);
+    return NULL;
+}
+
+static int test_wait_doorbell(void)
+{
+    /* Timeout path: nothing arrives past head. */
+    CHECK(tpurmJournalWait(tpurmJournalHead(), 20ull * 1000 * 1000) == 0);
+
+    /* Wake path: a subscriber blocked on the doorbell sees the emit. */
+    tpurmJournalSubscribe();
+    uint64_t head = tpurmJournalHead();
+    pthread_t th;
+    pthread_create(&th, NULL, wait_emitter, NULL);
+    CHECK(tpurmJournalWait(head, 5ull * 1000 * 1000 * 1000) == 1);
+    pthread_join(th, NULL);
+    tpurmJournalUnsubscribe();
+    CHECK(tpurmJournalHead() > head);
+    return 0;
+}
+
+static int test_mmap_region(void)
+{
+    int fd = tpurmJournalRegionFd();
+    CHECK(fd >= 0);
+    struct stat st;
+    CHECK(fstat(fd, &st) == 0);
+    char *map = mmap(NULL, (size_t)st.st_size, PROT_READ, MAP_SHARED,
+                     fd, 0);
+    CHECK(map != MAP_FAILED);
+
+    /* Fixed header offsets — the contract uvm/journal.py parses by. */
+    CHECK(*(uint32_t *)(map + 0) == TPU_JOURNAL_MAGIC);
+    CHECK(*(uint32_t *)(map + 4) == TPU_JOURNAL_VERSION);
+    uint32_t cap = *(uint32_t *)(map + 8);
+    CHECK(cap >= 64 && (cap & (cap - 1)) == 0);
+    CHECK(*(uint32_t *)(map + 12) == TPU_JOURNAL_REC_BYTES);
+    CHECK((size_t)st.st_size ==
+          TPU_JOURNAL_HDR_BYTES + (size_t)cap * TPU_JOURNAL_REC_BYTES);
+
+    /* An emit lands in the external mapping: widx advances and the
+     * claimed slot commits seq == claim + 1. */
+    uint64_t w0 = *(volatile uint64_t *)(map + 16);
+    tpurmJournalEmit(TPU_JREC_WD_RUNG, 1, TPU_OK, 2, 42);
+    uint64_t w1 = *(volatile uint64_t *)(map + 16);
+    CHECK(w1 == w0 + 1);
+    TpuJournalRec *slot = (TpuJournalRec *)
+        (map + TPU_JOURNAL_HDR_BYTES +
+         (size_t)((w1 - 1) & (cap - 1)) * TPU_JOURNAL_REC_BYTES);
+    CHECK(slot->seq == w1);
+    CHECK(slot->type == TPU_JREC_WD_RUNG);
+    CHECK(slot->a1 == 42);
+
+    munmap(map, (size_t)st.st_size);
+    close(fd);
+    return 0;
+}
+
+/* Parse one bundle: count R lines, read the E line and C line for
+ * wd.rung / journal_dumps, and return the trailer status string. */
+static int bundle_scan(const char *path, uint64_t *rLines,
+                       uint64_t *eWdRung, uint64_t *cDumps,
+                       char *status, size_t statusCap)
+{
+    FILE *f = fopen(path, "r");
+    if (!f)
+        return -1;
+    char line[512];
+    *rLines = 0;
+    *eWdRung = (uint64_t)-1;
+    *cDumps = (uint64_t)-1;
+    status[0] = '\0';
+    while (fgets(line, sizeof(line), f)) {
+        if (line[0] == 'R' && line[1] == ' ')
+            (*rLines)++;
+        else if (strncmp(line, "E wd.rung ", 10) == 0)
+            *eWdRung = strtoull(line + 10, NULL, 10);
+        else if (strncmp(line, "C journal_dumps ", 16) == 0)
+            *cDumps = strtoull(line + 16, NULL, 10);
+        else if (strncmp(line, "status: ", 8) == 0) {
+            size_t n = strcspn(line + 8, "\n");
+            if (n > statusCap - 1)
+                n = statusCap - 1;
+            memcpy(status, line + 8, n);
+            status[n] = '\0';
+        }
+    }
+    fclose(f);
+    return 0;
+}
+
+static int test_crash_dump(void)
+{
+    /* main() re-execs with TPUMEM_DUMP_DIR set before library load. */
+    CHECK(getenv("TPUMEM_DUMP_DIR") != NULL);
+
+    uint64_t d0 = tpurmCounterGet("journal_dumps");
+    tpurmJournalEmit(TPU_JREC_WD_RUNG, 0, TPU_ERR_DEVICE_RESET, 3, 0);
+    CHECK(tpurmJournalCrashDump("journal_test") == TPU_OK);
+    CHECK(tpurmCounterGet("journal_dumps") == d0 + 1);
+
+    char path[512];
+    CHECK(tpurmJournalLastBundle(path, sizeof(path)) > 0);
+    CHECK(strstr(path, "tpubox-") != NULL);
+    CHECK(strstr(path, "journal_test") != NULL);
+    CHECK(strstr(path, ".tmp") == NULL);   /* atomically renamed */
+
+    uint64_t rLines, eWdRung, cDumps;
+    char status[32];
+    CHECK(bundle_scan(path, &rLines, &eWdRung, &cDumps, status,
+                      sizeof(status)) == 0);
+    CHECK(strcmp(status, "complete") == 0);
+    CHECK(rLines > 0);
+    /* Internal reconciliation: the bundle's own [emitted] section
+     * matches the live per-type count at scan time (no wd.rung emits
+     * race this single-threaded moment). */
+    CHECK(eWdRung == tpurmJournalTypeCount(TPU_JREC_WD_RUNG));
+    /* The counter snapshot rode along (journal_dumps counts bundles
+     * BEFORE this one finished: the cell is bumped after the body). */
+    CHECK(cDumps == d0);
+
+    /* The dump emitted its own DUMP record (a1 = 1: complete). */
+    uint64_t cursor = tpurmJournalHead() - 1, lost = 0;
+    TpuJournalRec rec;
+    CHECK(tpurmJournalConsume(&cursor, &rec, 1, &lost) == 1);
+    CHECK(rec.type == TPU_JREC_DUMP);
+    CHECK(rec.a1 == 1);
+    return 0;
+}
+
+static int test_dump_truncation(void)
+{
+    /* Arm dump.write: the NEXT section boundary chops the bundle.
+     * Invariant: hits == journal_dump_errors, and the chopped bundle
+     * still carries the [end] trailer saying `truncated`. */
+    uint64_t hits0, evals0, hits1;
+    tpurmInjectCounts(TPU_INJECT_SITE_DUMP_WRITE, &evals0, &hits0);
+    uint64_t errs0 = tpurmCounterGet("journal_dump_errors");
+    CHECK(hits0 == errs0);
+
+    CHECK(tpurmInjectArmOneShot(TPU_INJECT_SITE_DUMP_WRITE, 0) == TPU_OK);
+    CHECK(tpurmJournalCrashDump("truncme") == TPU_OK);
+
+    tpurmInjectCounts(TPU_INJECT_SITE_DUMP_WRITE, NULL, &hits1);
+    CHECK(hits1 == hits0 + 1);
+    CHECK(tpurmCounterGet("journal_dump_errors") == errs0 + 1);
+
+    char path[512];
+    CHECK(tpurmJournalLastBundle(path, sizeof(path)) > 0);
+    CHECK(strstr(path, "truncme") != NULL);
+
+    uint64_t rLines, eWdRung, cDumps;
+    char status[32];
+    CHECK(bundle_scan(path, &rLines, &eWdRung, &cDumps, status,
+                      sizeof(status)) == 0);
+    CHECK(strcmp(status, "truncated") == 0);
+    CHECK(rLines == 0);              /* oneshot hit the FIRST section */
+
+    /* Its DUMP record says truncated too (a1 = 0). */
+    uint64_t cursor = tpurmJournalHead() - 1, lost = 0;
+    TpuJournalRec rec;
+    CHECK(tpurmJournalConsume(&cursor, &rec, 1, &lost) == 1);
+    CHECK(rec.type == TPU_JREC_DUMP);
+    CHECK(rec.a1 == 0);
+
+    /* A later un-armed dump is complete again: degrade, not latch. */
+    CHECK(tpurmJournalCrashDump("after") == TPU_OK);
+    CHECK(bundle_scan(path, &rLines, &eWdRung, &cDumps, status,
+                      sizeof(status)) == 0);
+    return 0;
+}
+
+static int test_render_text(void)
+{
+    static char buf[1 << 20];
+    size_t n = tpurmJournalRenderTextBuf(buf, sizeof(buf));
+    CHECK(n > 0);
+    CHECK(strncmp(buf, "# tpubox cap=", 13) == 0);
+    CHECK(strstr(buf, "\nR ") != NULL);
+    CHECK(strstr(buf, "\nE wd.rung ") != NULL);
+    return 0;
+}
+
+int main(int argc, char **argv)
+{
+    (void)argc;
+    /* The dump dir must be in the environment BEFORE the library
+     * constructor caches it (getenv is not async-signal-safe later):
+     * re-exec once with a fresh temp dir. */
+    if (!getenv("TPUMEM_DUMP_DIR")) {
+        char dir[] = "/tmp/tpubox_test_XXXXXX";
+        if (!mkdtemp(dir))
+            return 1;
+        setenv("TPUMEM_DUMP_DIR", dir, 1);
+        execv("/proc/self/exe", argv);
+        return 1;                    /* exec failed */
+    }
+
+    if (test_abi())
+        return 1;
+    if (test_emit_consume())
+        return 1;
+    if (test_wrap_drop())
+        return 1;
+    if (test_concurrent_emitters())
+        return 1;
+    if (test_wait_doorbell())
+        return 1;
+    if (test_mmap_region())
+        return 1;
+    if (test_crash_dump())
+        return 1;
+    if (test_dump_truncation())
+        return 1;
+    if (test_render_text())
+        return 1;
+    printf("journal tests OK\n");
+    return 0;
+}

@@ -76,6 +76,7 @@ import jax.numpy as jnp
 
 from ..models import llama, serving
 from . import native
+from ..uvm import journal as _journal
 
 
 # --------------------------------------------------------------- plumbing
@@ -559,6 +560,8 @@ class Scheduler:
         self._preempted.append(req)
         self.stats["preempted"] += 1
         _counter_add("tpusched_preempted")
+        _journal.emit(_journal.RecType.SCHED_PREEMPT, a0=req.seq or 0,
+                      a1=req.preempts, flow=req.flow or 0)
 
     @staticmethod
     def _quiesce_ring(ring) -> None:
@@ -708,6 +711,8 @@ class Scheduler:
                 time.sleep(0.0005 * (1 << attempt))
         self.stats["admit_sheds"] += 1
         _counter_add("tpusched_admit_sheds")
+        _journal.emit(_journal.RecType.SCHED_SHED,
+                      a0=len(self._preempted) + len(self._queue))
         # Degrade-to-preempt only under REAL pressure: someone is
         # waiting AND the pool cannot fit them.  With headroom, skipping
         # this round's admissions already shed the load — swapping out a
@@ -857,6 +862,7 @@ class Scheduler:
         new stream — the serving-layer face of page retirement), flow
         ledger closed.  Everything else keeps decoding; no reset."""
         req.state = RequestState.ERROR
+        flow0 = req.flow or 0
         if req.flow:
             self._utils.flow_close(req.flow)
             req.flow = None         # close() must not re-close the ledger
@@ -876,6 +882,9 @@ class Scheduler:
             req.seq = None
         self.stats["poisoned"] = self.stats.get("poisoned", 0) + 1
         _counter_add("tpusched_poisoned_retired")
+        _journal.emit(_journal.RecType.SCHED_RETIRE,
+                      status=0x74,  # TPU_ERR_PAGE_POISONED
+                      a0=seq if seq is not None else 0, flow=flow0)
         _counter_add("tpusched_seq_slots_retired")
 
     def _handle_poisoned_round(self) -> bool:

@@ -14,6 +14,7 @@ devices are driven from userspace).
 
 from . import ce  # noqa: F401  (tpuce copy-engine stats surface)
 from . import inject  # noqa: F401  (fault injection + recovery counters)
+from . import journal  # noqa: F401  (tpubox black-box journal + crash dumps)
 from . import memring  # noqa: F401  (async memory-op rings, tpumemring)
 from . import reset  # noqa: F401  (full-device reset + hung-op watchdog)
 from .managed import (  # noqa: F401

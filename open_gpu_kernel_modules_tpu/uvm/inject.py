@@ -43,6 +43,10 @@ class Site(enum.IntEnum):
     MEM_CORRUPT = 13     # tpushield bit flip in a sealed page / wire
                          # buffer (detection, not failure — recovery is
                          # the verify + re-fetch ladder)
+    DUMP_WRITE = 14      # tpubox crash-bundle serialization (per bundle
+                         # section; recovery is graceful degrade to a
+                         # truncated-but-parseable bundle — exact
+                         # invariant: hits == journal_dump_errors)
 
 
 class Mode(enum.IntEnum):
@@ -104,6 +108,10 @@ DETAIL_COUNTERS = (
     "hot_inject_skips",
     "tpurm_hot_pins",
     "tpurm_hot_throttles",
+    "journal_dumps",
+    "journal_dump_errors",
+    "journal_dump_io_errors",
+    "journal_log_mirrors",
 )
 
 

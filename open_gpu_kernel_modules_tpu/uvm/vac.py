@@ -352,6 +352,13 @@ def migrate_pages(backing, src: int, dst: int,
     from .. import utils as _flowutils
     flow = _flowutils.flow_mint(0xFFFF, txn._txn & 0xFFFFFFFF)
     _flowutils.flow_open(flow)
+    # Stamp the migration's flow id on THIS thread: the native vac
+    # engine journals the manifest lifecycle (vac.begin / vac.commit /
+    # vac.abort) off thread-local flow context, so without the stamp a
+    # tpubox timeline could not attribute an abort to the move that
+    # died.  (begin already happened flowless above — the txn id in a0
+    # joins the two.)
+    _flowutils.flow_set(flow)
     staged: List[Tuple[int, int, ctypes.c_void_p]] = []  # (page, off, h)
     total_retries = 0
     try:
@@ -453,6 +460,7 @@ def migrate_pages(backing, src: int, dst: int,
         txn.abort()
         raise
     finally:
+        _flowutils.flow_set(0)
         _flowutils.flow_close(flow)
         ring.close()
     return MigrationReport(src, dst, len(pages), len(pages) * rec_bytes,

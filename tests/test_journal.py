@@ -1,0 +1,408 @@
+"""tpubox — black-box journal, crash bundles, post-mortem analyzer.
+
+The journal's promise is the flight recorder's: after any failure —
+including ones that kill the process — the bundle on disk tells the
+whole causal story, and its books BALANCE (every record count
+reconciles exactly against the counter snapshot riding in the same
+bundle).  These tests force the three fatal-path classes end-to-end in
+subprocesses (watchdog device reset, mem.corrupt poison containment,
+injected vac abort) plus an actual SIGSEGV death, then feed each
+resulting bundle to tools/tpubox.py and require exit 0 from its
+reconciliation pass.
+
+The inventories below are the lint surface ``make -C native
+check-journal`` enforces: every record type the engine can emit must be
+listed here AND documented in the README, every health event must map
+to a journal record, and every fatal-path TpuStatus must be one a
+record can carry.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from open_gpu_kernel_modules_tpu.uvm import journal, vac
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TPUBOX = os.path.join(_REPO, "tools", "tpubox.py")
+
+# ---------------------------------------------------------------------
+# JOURNAL_INVENTORY: every dotted record name journal.c can emit
+# (native/src/journal.c g_jrecNames, minus the "none" sentinel).
+# check-journal fails the build if the engine grows a record type that
+# is not listed here — an unlisted record is one the post-mortem
+# tooling silently drops.
+# ---------------------------------------------------------------------
+JOURNAL_INVENTORY = [
+    "health.note", "health.transition", "health.evac",
+    "wd.rung",
+    "reset.gen", "reset.device",
+    "ring.stale", "ring.deadline",
+    "ici.flap", "ici.retrain", "ici.crc",
+    "page.quarantine", "page.poison",
+    "shield.verdict",
+    "vac.begin", "vac.commit", "vac.abort",
+    "inject.hit",
+    "sched.shed", "sched.preempt", "sched.retire",
+    "client.death",
+    "log", "dump",
+]
+
+# ---------------------------------------------------------------------
+# EVENT_RECORD_MAP: every health event (health.c g_eventNames) -> the
+# journal record(s) that carry it into the black box.  Every event
+# lands as a "health.note" with the event index in a0; the second
+# column is the origin record the same failure ALSO writes from its
+# own engine, so the timeline can stitch cause (engine record) to
+# effect (health note -> transition -> ladder).
+# ---------------------------------------------------------------------
+EVENT_RECORD_MAP = {
+    "rc_reset": ("health.note", "wd.rung"),
+    "wd_nudge": ("health.note", "wd.rung"),
+    "link_flap": ("health.note", "ici.flap"),
+    "retrain_fail": ("health.note", "ici.retrain"),
+    "page_quarantine": ("health.note", "page.quarantine"),
+    "stale_completion": ("health.note", "ring.stale"),
+    "deadline_expired": ("health.note", "ring.deadline"),
+    "device_reset": ("health.note", "reset.device"),
+}
+
+# ---------------------------------------------------------------------
+# JOURNAL_FATAL_STATUSES: the terminal-outcome TpuStatus block (0x70..
+# in status.h).  A fatal status a journal record cannot carry is a
+# crash the bundle cannot explain, so check-journal pins the set here.
+# ---------------------------------------------------------------------
+JOURNAL_FATAL_STATUSES = {
+    "TPU_ERR_PAGE_QUARANTINED": 0x70,
+    "TPU_ERR_RETRAIN_FAILED": 0x71,
+    "TPU_ERR_RETRY_EXHAUSTED": 0x72,
+    "TPU_ERR_DEVICE_RESET": 0x73,
+    "TPU_ERR_PAGE_POISONED": 0x74,
+}
+
+
+# ------------------------------------------------------- inventory lint
+
+def test_inventory_matches_native():
+    """JOURNAL_INVENTORY is exactly the native name table: every
+    RecType has a dotted name, every name is listed, nothing extra."""
+    native_names = {journal.type_name(t) for t in journal.RecType}
+    assert native_names == set(JOURNAL_INVENTORY)
+    assert len(JOURNAL_INVENTORY) == len(journal.RecType)
+    # Out-of-range types render as the sentinel, never crash.
+    assert journal.type_name(0) == "none"
+    assert journal.type_name(9999) in ("none", "?")
+
+
+def test_event_record_map_covers_health_events():
+    assert set(EVENT_RECORD_MAP) == {e.name.lower() for e in vac.Event}
+    for note_rec, origin_rec in EVENT_RECORD_MAP.values():
+        assert note_rec in JOURNAL_INVENTORY
+        assert origin_rec in JOURNAL_INVENTORY
+
+
+def test_fatal_statuses_match_header():
+    hdr = open(os.path.join(_REPO, "native", "include", "tpurm",
+                            "status.h")).read()
+    import re
+    block = dict(
+        (m.group(1), int(m.group(2), 16)) for m in re.finditer(
+            r"#define (TPU_ERR_[A-Z_]+) +(0x0000007[0-9a-f]+)u", hdr))
+    assert block == JOURNAL_FATAL_STATUSES
+
+
+def test_check_journal_lint():
+    """The lint passes on the tree as-is and FAILS when a record type
+    exists that the inventory does not list (negative hook)."""
+    ok = subprocess.run(["make", "-C", os.path.join(_REPO, "native"),
+                         "check-journal"], capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "check-journal OK" in ok.stdout
+
+    env = dict(os.environ, CHECK_JOURNAL_EXTRA="fake.record")
+    bad = subprocess.run(["make", "-C", os.path.join(_REPO, "native"),
+                          "check-journal"], env=env,
+                         capture_output=True, text=True)
+    assert bad.returncode != 0, bad.stdout
+    assert "fake.record" in bad.stdout + bad.stderr
+
+
+# --------------------------------------------------- live journal paths
+
+def test_emit_note_lands_in_journal():
+    """A health note both bumps the per-device tally and writes a
+    health.note record — the adjacency reconciliation depends on."""
+    before = journal.type_counts()["health.note"]
+    vac.note(0, vac.Event.WD_NUDGE)
+    vac.clear(0)
+    assert journal.type_counts()["health.note"] == before + 1
+
+
+def test_subscriber_tail():
+    """The mmap'd live subscription: a subscriber opened at head sees
+    exactly the records emitted after it, seqlock-validated, with the
+    futex doorbell waking the wait."""
+    with journal.Subscriber() as sub:
+        assert sub.cap >= 64 and sub.cap & (sub.cap - 1) == 0
+        journal.emit(journal.RecType.INJECT_HIT, dev=3, a0=14, a1=0xABC,
+                     flow=42)
+        assert sub.wait(timeout_ns=2 * 10**9)
+        recs = [r for r in sub.consume()
+                if r.type == journal.RecType.INJECT_HIT and r.flow == 42]
+        assert len(recs) == 1
+        r = recs[0]
+        assert (r.dev, r.a0, r.a1) == (3, 14, 0xABC)
+        assert r.type_name == "inject.hit"
+        assert r.seq > 0 and r.ts_ns > 0
+
+
+def test_render_text_roundtrips_through_analyzer(tmp_path):
+    """journal.text() is the same R/E grammar the bundles use; the
+    analyzer must parse it and place every live record on the
+    timeline."""
+    journal.emit(journal.RecType.ICI_FLAP, dev=1, a0=1, a1=2)
+    txt = journal.text()
+    assert txt.startswith("# tpubox cap=")
+    f = tmp_path / "scrape.txt"
+    f.write_text(txt)
+    proc = subprocess.run([sys.executable, _TPUBOX, str(f)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "ici.flap" in proc.stdout
+
+
+def test_crash_dump_requires_dump_dir():
+    if os.environ.get("TPUMEM_DUMP_DIR"):
+        pytest.skip("TPUMEM_DUMP_DIR set in this environment")
+    assert journal.crash_dump("unit") == 0x56  # TPU_ERR_NOT_SUPPORTED
+
+
+# ------------------------------------------------ fatal-path subprocesses
+
+def _analyze(bundle, *extra):
+    """Run tools/tpubox.py --check on a bundle; return (exit, stdout)."""
+    proc = subprocess.run(
+        [sys.executable, _TPUBOX, bundle, "--check", *extra],
+        capture_output=True, text=True)
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def _run_script(script, tmp_path, timeout=180, **env_extra):
+    env = dict(os.environ)
+    env["TPUMEM_DUMP_DIR"] = str(tmp_path)
+    env.setdefault("TPUMEM_FAKE_TPU_COUNT", "2")
+    env.setdefault("TPUMEM_FAKE_HBM_MB", "64")
+    env.setdefault("TPUMEM_UVM_PAGE_SIZE", "4096")
+    env.update({k: str(v) for k, v in env_extra.items()})
+    return subprocess.run([sys.executable, "-c",
+                           script % {"repo": _REPO}], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+_SIGSEGV_SCRIPT = r"""
+import ctypes, sys
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import uvm
+vs = uvm.VaSpace()                       # installs the SIGSEGV handler
+b = vs.alloc(8192)
+b.view()[:] = 7                          # managed faults still work
+ctypes.string_at(0xDEAD0000, 1)          # NOT ours -> last-gasp path
+"""
+
+
+def test_sigsegv_crash_bundle_roundtrip(tmp_path):
+    """A real unhandled SIGSEGV dies AND leaves a complete bundle: the
+    last-gasp handler runs the async-signal-safe dumper, prints one
+    signal-safe stderr line, and re-faults to the default disposition.
+    The analyzer reconciles the bundle exactly."""
+    proc = _run_script(_SIGSEGV_SCRIPT, tmp_path)
+    assert proc.returncode == -signal.SIGSEGV, (proc.returncode,
+                                                proc.stderr[-2000:])
+    assert "tpurm FATAL: unhandled SIGSEGV at 0xdead0000" in proc.stderr
+
+    bundles = [f for f in os.listdir(tmp_path) if "sigsegv" in f]
+    assert len(bundles) == 1, os.listdir(tmp_path)
+    path = os.path.join(tmp_path, bundles[0])
+    text = open(path).read()
+    assert text.startswith("TPUBOX BUNDLE v1")
+    assert "status: complete" in text
+
+    rc, out = _analyze(path)
+    assert rc == 0, out
+    assert "books balance" in out
+    assert "reason=sigsegv" in out
+
+
+_WATCHDOG_SCRIPT = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import utils
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, journal, reset
+reset.watchdog_start()
+inj.arm_oneshot(inj.Site.RESET_DEVICE)
+deadline = time.time() + 30
+while utils.counter("tpurm_reset_total") == 0 and time.time() < deadline:
+    time.sleep(0.05)
+time.sleep(0.3)                          # let the reset fully settle
+assert utils.counter("tpurm_reset_total") >= 1
+print(json.dumps({"bundle": journal.last_bundle(),
+                  "resets": utils.counter("tpurm_reset_total")}))
+"""
+
+
+def test_watchdog_device_reset_bundle(tmp_path):
+    """Forced failure class 1: a watchdog-forced full-device reset
+    writes its bundle BEFORE the reset scrubs the evidence, and the
+    bundle reconciles exactly."""
+    proc = _run_script(_WATCHDOG_SCRIPT, tmp_path,
+                       TPUMEM_RESET_WATCHDOG_PERIOD_MS=20)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["resets"] >= 1
+    assert out["bundle"] and "watchdog.device_reset" in out["bundle"]
+
+    rc, txt = _analyze(out["bundle"])
+    assert rc == 0, txt
+    assert "books balance" in txt
+    # The injection's WARN log line was mirrored into the journal and
+    # the inject site's hit record rode along — the bundle is never
+    # empty even when the failure is the first event of the process.
+    assert "inject.hit" in txt
+
+
+_POISON_SCRIPT = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import uvm
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, journal, shield
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+vs = uvm.VaSpace()
+b = vs.alloc(16 * 4096)
+b.view()[:] = 0x77
+s0 = shield.stats()
+inj.enable(inj.Site.MEM_CORRUPT, inj.Mode.NTH, 1)
+b.migrate(Tier.CXL)                      # demote: seal + flip each page
+inj.disable_all()
+zeros = bool((b.view() == 0).all())      # fault -> verify -> poison
+s1 = shield.stats()
+b.free()
+print(json.dumps({"bundle": journal.last_bundle(),
+                  "poisoned": s1.pages_poisoned - s0.pages_poisoned,
+                  "zeros": zeros}))
+"""
+
+
+def test_poison_containment_bundle(tmp_path):
+    """Forced failure class 2: mem.corrupt flips every sealed page of
+    an exclusive CXL park; no recovery source exists, so each page
+    poisons — and each poison snapshots a bundle that reconciles."""
+    proc = _run_script(_POISON_SCRIPT, tmp_path)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["poisoned"] >= 1, out
+    assert out["zeros"], out
+    assert out["bundle"] and "shield.poison" in out["bundle"]
+
+    rc, txt = _analyze(out["bundle"])
+    assert rc == 0, txt
+    assert "books balance" in txt
+    assert "page.poison" in txt
+    assert "shield.verdict" in txt
+
+
+_VAC_ABORT_SCRIPT = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+from open_gpu_kernel_modules_tpu.models.multichip import IciPoolBacking
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, journal, vac
+backing = IciPoolBacking((1, 4, 8, 1, 4), np.dtype(np.float32), 128, 2)
+aborted = False
+inj.enable(inj.Site.VAC_MIGRATE, inj.Mode.PPM, 1000000, burst=64)
+try:
+    vac.migrate_pages(backing, 0, 1)
+except vac.VacAbort:
+    aborted = True
+inj.disable_all()
+backing.close()
+from open_gpu_kernel_modules_tpu import utils
+print(json.dumps({"bundle": journal.last_bundle(), "aborted": aborted,
+                  "aborts": utils.counter("vac_aborts"),
+                  "open_txns": vac.txns_active()}))
+"""
+
+
+def test_vac_abort_bundle(tmp_path):
+    """Forced failure class 3: the vac.migrate inject site exhausts the
+    retry budget mid-evacuation; the manifest aborts back to the source
+    and the abort path snapshots a bundle that reconciles."""
+    proc = _run_script(_VAC_ABORT_SCRIPT, tmp_path)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["aborted"], out
+    assert out["aborts"] >= 1, out
+    assert out["open_txns"] == 0, out    # no manifest leaked open
+    assert out["bundle"] and "vac.abort" in out["bundle"]
+
+    rc, txt = _analyze(out["bundle"])
+    assert rc == 0, txt
+    assert "books balance" in txt
+    assert "vac.begin" in txt and "vac.abort" in txt
+    # TLS flow stamping: the native vac engine journaled the manifest
+    # lifecycle with the migration's tpuflow id attached.
+    assert any(("vac.begin" in ln or "vac.abort" in ln) and "flow" in ln
+               for ln in txt.splitlines()), txt
+
+
+_TRUNCATION_SCRIPT = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+from open_gpu_kernel_modules_tpu import utils
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, journal
+inj.arm_oneshot(inj.Site.DUMP_WRITE)
+st1 = journal.crash_dump("chopped")
+trunc = journal.last_bundle()
+st2 = journal.crash_dump("clean")
+out = {"st1": st1, "st2": st2, "trunc_bundle": trunc,
+       "clean_bundle": journal.last_bundle(),
+       "hits": inj.counts(inj.Site.DUMP_WRITE)[1],
+       "dump_errors": utils.counter("journal_dump_errors"),
+       "dumps": utils.counter("journal_dumps")}
+print(json.dumps(out))
+"""
+
+
+def test_dump_write_truncation(tmp_path):
+    """The 15th inject site (dump.write) chops a bundle mid-write: the
+    result is truncated-but-parseable (trailer always lands), the
+    invariant hits == journal_dump_errors holds, and the NEXT dump is
+    complete again."""
+    proc = _run_script(_TRUNCATION_SCRIPT, tmp_path)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["st1"] == 0 and out["st2"] == 0, out
+    assert out["hits"] == 1 and out["dump_errors"] == 1, out
+    assert out["dumps"] == 2, out
+    assert out["trunc_bundle"] != out["clean_bundle"]
+
+    ttext = open(out["trunc_bundle"]).read()
+    assert "status: truncated" in ttext
+    # Truncated bundles parse: the analyzer degrades missing sections
+    # to SKIP instead of inventing a verdict, and says so.
+    proc = subprocess.run([sys.executable, _TPUBOX, out["trunc_bundle"],
+                          "--check"], capture_output=True, text=True)
+    assert "truncated" in proc.stdout
+    assert "SKIP" in proc.stdout
+
+    rc, txt = _analyze(out["clean_bundle"])
+    assert rc == 0, txt
+    assert "books balance" in txt
+    # The chopped attempt is itself on the record: the clean bundle's
+    # timeline carries a dump record with the truncated verdict.  (A
+    # bundle never contains its OWN dump record — that one is emitted
+    # only after the rename lands, so the counts inside stay exact.)
+    assert "dump" in txt and "(truncated)" in txt

@@ -199,6 +199,12 @@ SITES = [inj.Site.CHANNEL_CE, inj.Site.PMM_ALLOC, inj.Site.MIGRATE_COPY,
          inj.Site.MEM_CORRUPT]
 for s in SITES:
     inj.enable(s, inj.Mode.PPM, 10000)
+# 15th site: dump.write chops crash-bundle sections.  Every injected
+# device reset and every poison containment below snapshots a bundle
+# with the site armed (12.5%% per section so truncations genuinely
+# happen), proving the dumper degrades instead of dying mid-soak.
+from open_gpu_kernel_modules_tpu.uvm import journal as _journal
+inj.enable(inj.Site.DUMP_WRITE, inj.Mode.PPM, 125000)
 # The reset.device site fires on the watchdog tick (100 ms period, so
 # the 4 s window holds ~40 evaluations): every 13th forces a FULL
 # DEVICE RESET under the whole actor mix.  The watchdog must be up for
@@ -416,6 +422,18 @@ for t in threads:
     t.join(timeout=180)
 stop.set()
 out["hung"] = sum(t.is_alive() for t in threads)
+# One explicit dump while dump.write is still armed, so the site's
+# invariant is exercised even if no fatal path fired this seed.
+_journal.crash_dump("soak.epilogue")
+# tpubox accounting for the chaos window: the black box must not have
+# dropped a single record at the default ring size, and every
+# dump.write hit must be a truncated-but-parseable bundle on disk.
+_dw_evals, _dw_hits = inj.counts(inj.Site.DUMP_WRITE)
+_jem, _jdr, _jcap = _journal.stats()
+out["dump_write"] = {"evals": _dw_evals, "hits": _dw_hits,
+                     "errors": utils.counter("journal_dump_errors"),
+                     "dumps": utils.counter("journal_dumps")}
+out["journal"] = {"emitted": _jem, "dropped": _jdr, "cap": _jcap}
 inj.disable_all()
 # Full-device resets landed under the chaos: exact reconciliation —
 # every reset.device hit forced exactly one injected reset.
@@ -703,7 +721,15 @@ resets_before = reset.stats().resets
 inj.set_seed(42)
 for s_ in inj.Site:
     inj.enable(s_, inj.Mode.PPM, 50000)
+# 15th site explicit (the loop armed it too): dump.write chops the
+# crash bundles the chaos writes (poison containments, vac aborts,
+# the explicit epilogue dump) at a rate that genuinely truncates.
+from open_gpu_kernel_modules_tpu.uvm import journal as _journal
+inj.enable(inj.Site.DUMP_WRITE, inj.Mode.PPM, 125000)
 chaos_toks, chaos_states, rep = run_once(force_resets=3)
+# Explicit dump with the site still armed (invariant never vacuous).
+_journal.crash_dump("sched.soak")
+_dw_evals, _dw_hits = inj.counts(inj.Site.DUMP_WRITE)
 inj.disable_all()
 rst = reset.stats()
 out["resets_during_chaos"] = rst.resets - resets_before
@@ -747,6 +773,13 @@ from open_gpu_kernel_modules_tpu import utils as _utils
 _hd_evals, _hd_hits = inj.counts(inj.Site.HOT_DECIDE)
 out["hot_decide"] = {"evals": _hd_evals, "hits": _hd_hits,
                      "skips": _utils.counter("hot_inject_skips")}
+# 15th site (dump.write), EXACT: hits == truncated bundles, and the
+# black box dropped nothing at the default ring size.
+_jem, _jdr, _jcap = _journal.stats()
+out["dump_write"] = {"evals": _dw_evals, "hits": _dw_hits,
+                     "errors": _utils.counter("journal_dump_errors"),
+                     "dumps": _utils.counter("journal_dumps")}
+out["journal"] = {"emitted": _jem, "dropped": _jdr, "cap": _jcap}
 out["spine"] = {
     "internal_sqes": _utils.counter("memring_internal_sqes"),
     "fault": _utils.counter("memring_internal_sqes[fault]"),
@@ -778,18 +811,21 @@ print(json.dumps(out))
 """
 
 
-def test_sched_soak_injection():
+def test_sched_soak_injection(tmp_path):
     """Chaos soak, scheduler actor: streams admitted AND cancelled
-    under injection across ALL 13 sites (~5% here — this workload is
+    under injection across ALL 15 sites (~5% here — this workload is
     orders of magnitude smaller than the engine soak's, so 1% would
     barely fire) WITH >= 3 forced full-device resets mid-decode.
     Acceptance: zero token corruption (every stream that finishes
-    produces exactly its uninjected tokens — through the resets) and
+    produces exactly its uninjected tokens — through the resets),
     balanced admit/retire/preempt/reset accounting (nothing leaks a
-    sequence slot or a page pin)."""
+    sequence slot or a page pin), and the tpubox invariants: zero
+    journal drops at the default ring size, hits == truncated
+    bundles on dump.write."""
     env = dict(os.environ)
     env.setdefault("TPUMEM_FAKE_TPU_COUNT", "2")
     env.setdefault("TPUMEM_FAKE_HBM_MB", "128")
+    env["TPUMEM_DUMP_DIR"] = str(tmp_path)
     script = _SCHED_SOAK % {"repo": _REPO}
     proc = subprocess.run([sys.executable, "-c", script], env=env,
                           capture_output=True, text=True, timeout=600)
@@ -871,6 +907,21 @@ def test_sched_soak_injection():
     # own, so the soak cannot wedge on an unevictable block.
     hd = out["hot_decide"]
     assert hd["hits"] == hd["skips"], hd
+
+    # 15th site (dump.write) + tpubox acceptance: crash bundles were
+    # genuinely written under the chaos (the explicit epilogue dump
+    # guarantees >= 1 even on a quiet seed), every hit produced a
+    # truncated-but-parseable bundle (EXACT: hits ==
+    # journal_dump_errors), and the black box dropped ZERO records at
+    # the default ring size with all 15 sites armed.
+    dw = out["dump_write"]
+    assert dw["evals"] > 0, dw
+    assert dw["hits"] == dw["errors"], dw
+    assert dw["dumps"] >= 1, dw
+    jn = out["journal"]
+    assert jn["cap"] == 16384, jn          # default ring size
+    assert jn["dropped"] == 0, jn
+    assert jn["emitted"] > 0, jn
 
     # tpuflow blame-decomposition soundness UNDER CHAOS (all 12 sites
     # armed, >= 3 forced resets): every terminal stream closed its
@@ -1015,17 +1066,20 @@ def test_client_death_reclamation():
     rerun_solo_under_load(_body)
 
 
-def test_engine_soak_injection():
-    """Chaos soak (acceptance): ~1% injection across ALL 14 sites at a
+def test_engine_soak_injection(tmp_path):
+    """Chaos soak (acceptance): ~1% injection across ALL 15 sites at a
     fixed seed, with tracing ARMED for the whole chaos window; the soak
     completes with zero corruption, every recovery counter is nonzero,
     every injected fault surfaces as an instant trace event, each
     recovery-counter increment has a matching recovery trace event, and
     with injection disabled all counters are zero and the disarmed fast
-    path never even counts an evaluation."""
+    path never even counts an evaluation.  tpubox rides the whole
+    window: zero journal drops at the default ring size and hits ==
+    truncated bundles on dump.write."""
     env = dict(os.environ)
     env["TPUMEM_FAKE_TPU_COUNT"] = "4"
     env["TPUMEM_FAKE_HBM_MB"] = "64"
+    env["TPUMEM_DUMP_DIR"] = str(tmp_path)
     # Rings sized so the 4-second chaos window fits without wrap: the
     # exact hit<->event reconciliation below needs a lossless record.
     env.setdefault("TPUMEM_TRACE_RING", str(1 << 17))
@@ -1074,6 +1128,21 @@ def test_engine_soak_injection():
     assert rd["injected"] == rd["hits"], rd
     assert rd["resets"] >= rd["hits"] - 1 and rd["resets"] >= 1, rd
     assert rd["mttr_ms"] > 0, rd
+
+    # 15th site (dump.write) + tpubox acceptance: every injected
+    # device reset above snapshotted a crash bundle with the site
+    # armed (plus the explicit epilogue dump), every hit produced a
+    # truncated-but-parseable bundle (EXACT: hits ==
+    # journal_dump_errors), and the journal dropped ZERO records at
+    # the default ring size under the full 15-site chaos.
+    dw = out["dump_write"]
+    assert dw["evals"] > 0, dw
+    assert dw["hits"] == dw["errors"], dw
+    assert dw["dumps"] >= rd["hits"], dw       # one bundle per reset
+    jn = out["journal"]
+    assert jn["cap"] == 16384, jn              # default ring size
+    assert jn["dropped"] == 0, jn
+    assert jn["emitted"] > 0, jn
 
     # Memring rode the chaos: ops flowed through the ring, completion
     # accounting balanced, and the error-CQE reconciliation is EXACT —

@@ -37,6 +37,8 @@ METRICS_INVENTORY = [
     "hbm_mirror_bytes", "hbm_mirror_overflows", "hbm_readback_requests",
     "hot_inject_skips",
     "ib_mr_invalidations", "ib_mr_registrations", "ici_degraded_routes",
+    "journal_dump_errors", "journal_dump_io_errors", "journal_dumps",
+    "journal_log_mirrors",
     "ici_hop_bytes", "ici_link_flaps", "ici_links_trained",
     "ici_multihop_copies", "ici_peer_apertures", "ici_peer_copy_bytes",
     "ici_reset_retrains", "ici_retrain_failures", "ici_wire_crc_errors",
@@ -85,6 +87,8 @@ METRICS_INVENTORY = [
     "tpurm_hot_prefetch_grown", "tpurm_hot_prefetch_shrunk",
     "tpurm_hot_thrash_pages", "tpurm_hot_throttle_delays",
     "tpurm_hot_throttles", "tpurm_pages_retired", "tpurm_reset_failed",
+    "tpurm_journal_capacity", "tpurm_journal_dropped",
+    "tpurm_journal_records",
     "tpurm_reset_injected", "tpurm_reset_mttr_ns", "tpurm_reset_total",
     "tpurm_scrub_hits", "tpurm_scrub_pages", "tpurm_scrub_ticks",
     "tpurm_shield_mismatches", "tpurm_shield_pages_poisoned",
@@ -283,6 +287,12 @@ def test_prometheus_metrics_node(traced):
     from open_gpu_kernel_modules_tpu.uvm import ce as _ce
     if _ce.channels() >= 2:
         assert any('name="tpuce_ch1_bytes"' in m for m in names)
+
+    # tpubox journal health rides the same scrape: records/dropped/
+    # capacity as their own families (dashboards alarm on dropped).
+    assert types.get("tpurm_journal_records") == "counter"
+    assert types.get("tpurm_journal_dropped") == "counter"
+    assert types.get("tpurm_journal_capacity") == "gauge"
 
     # The node also serves under the procfs listing.
     assert "driver/tpurm/metrics" in utils.procfs_list()

@@ -1,0 +1,429 @@
+#!/usr/bin/env python3
+"""tpubox — post-mortem timeline analyzer for the black-box journal.
+
+Input is either a crash bundle written by the async-signal-safe dumper
+(``TPUBOX BUNDLE v1`` files in ``$TPUMEM_DUMP_DIR``) or a live scrape of
+the structured journal (``/proc/driver/tpurm/journal`` under the
+LD_PRELOAD shim, or ``--live`` straight off the in-process library).
+Output is the ordered causal timeline the record stream encodes::
+
+    [t+0.000000] dev2          ici.flap           2 -> 3
+    [t+0.000214] dev2 flow 71  health.note        link_flap score=612
+    [t+0.000215] dev2 flow 71  health.transition  HEALTHY -> DEGRADED
+    [t+0.004180] dev2          wd.rung            rung 25 (evacuate)
+    [t+0.009001] dev2          vac.abort          txn 9
+    [t+0.012044] dev2          reset.device       gen 7 mttr 2.9ms
+
+grouped globally, by device, or by flow (``--group``), with a
+reconciliation pass (``--check``) that cross-checks the journal's own
+record counts against the counter snapshot riding in the same bundle —
+the analyzer refuses to trust a story whose books do not balance.
+
+Bundle grammar (one record or key/value per line; sections in order,
+possibly chopped by the dump.write inject site, trailer always last)::
+
+    TPUBOX BUNDLE v1
+    reason: ... / pid: ... / time_ns: ...
+    [journal]   cap/emitted/dropped header + R lines
+    [emitted]   E <dotted.type> <count>
+    [counters]  C <name> <value>
+    [health]    H <dev> ... / V <txn> ...
+    [rings]     G ...
+    [shield]    S ...
+    [inject]    I <site> evals <n> hits <n>
+    [end]       status: complete | truncated | error
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# ------------------------------------------------------------ vocabulary
+
+#: Health event index -> name (health.c g_eventNames order).
+HEALTH_EVENTS = (
+    "rc_reset", "wd_nudge", "link_flap", "retrain_fail",
+    "page_quarantine", "stale_completion", "deadline_expired",
+    "device_reset",
+)
+
+#: Health state index -> name (health.h TpuHealthState order).
+HEALTH_STATES = ("HEALTHY", "DEGRADED", "EVACUATING", "QUARANTINED")
+
+WD_RUNGS = {1: "nudge", 2: "rc_reset", 25: "evacuate", 3: "device_reset"}
+
+STATUS_NAMES = {
+    0x70: "PAGE_QUARANTINED", 0x71: "RETRAIN_FAILED",
+    0x72: "RETRY_EXHAUSTED", 0x73: "DEVICE_RESET", 0x74: "PAGE_POISONED",
+}
+
+#: Reconciliation map: dotted record type -> counters whose SUM must
+#: equal the journal's per-type emit count in the same snapshot.  Every
+#: emit site sits adjacent to its counter bump, so a complete bundle
+#: balances EXACTLY; imbalance means records were emitted off the books
+#: (or a counter bumped without its record) — either way the black box
+#: is lying and the verdict is FAIL.
+RECONCILE: Dict[str, Tuple[str, ...]] = {
+    "health.transition": ("tpurm_health_transitions",),
+    "health.evac": ("vac_requests",),
+    "reset.gen": ("tpurm_reset_total",),
+    "reset.device": ("tpurm_reset_total",),
+    "ring.stale": ("memring_stale_completions", "tpuce_stale_completions"),
+    "ring.deadline": ("memring_deadline_expired", "tpuce_deadline_expired"),
+    "ici.flap": ("ici_link_flaps",),
+    "ici.retrain": ("ici_retrain_failures",),
+    "ici.crc": ("ici_wire_crc_errors",),
+    "page.quarantine": ("recover_page_quarantines",),
+    "page.poison": ("tpurm_shield_pages_poisoned",),
+    "shield.verdict": ("tpurm_shield_mismatches",),
+    "vac.begin": ("vac_txn_begins",),
+    "vac.commit": ("vac_commits",),
+    "vac.abort": ("vac_aborts",),
+    "sched.shed": ("tpusched_admit_sheds",),
+    "sched.preempt": ("tpusched_preempted",),
+    "sched.retire": ("tpusched_poisoned_retired",),
+    "client.death": ("broker_client_deaths",),
+    "log": ("journal_log_mirrors",),
+}
+
+#: Watchdog rung payloads (wd.rung a0) -> the counter for that rung.
+RECONCILE_WD = {
+    1: "tpurm_watchdog_nudges",
+    2: "tpurm_watchdog_rc_resets",
+    25: "tpurm_watchdog_evacuations",
+    3: "tpurm_watchdog_device_resets",
+}
+
+
+@dataclasses.dataclass
+class Rec:
+    seq: int
+    ts_ns: int
+    type: str
+    dev: int
+    status: int
+    flow: int
+    a0: int
+    a1: int
+
+
+@dataclasses.dataclass
+class Bundle:
+    reason: str = ""
+    pid: int = 0
+    time_ns: int = 0
+    status: str = ""
+    cap: int = 0
+    emitted: int = 0
+    dropped: int = 0
+    records: List[Rec] = dataclasses.field(default_factory=list)
+    type_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    counters: Dict[str, int] = dataclasses.field(default_factory=dict)
+    health: List[str] = dataclasses.field(default_factory=list)
+    manifests: List[str] = dataclasses.field(default_factory=list)
+    rings: List[str] = dataclasses.field(default_factory=list)
+    shield: List[str] = dataclasses.field(default_factory=list)
+    inject: Dict[str, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict)
+
+
+# --------------------------------------------------------------- parsing
+
+def _int(tok: str) -> int:
+    return int(tok, 16) if tok.startswith("0x") else int(tok)
+
+
+def parse(text: str) -> Bundle:
+    """Parse a bundle or a live journal scrape (the scrape is just the
+    [journal]+[emitted] line shapes with a ``# tpubox`` header)."""
+    b = Bundle()
+    for line in text.splitlines():
+        line = line.rstrip("\n")
+        if not line or line.startswith("["):
+            continue
+        if line.startswith("# tpubox "):     # live-scrape header
+            for kv in line[9:].split():
+                k, _, v = kv.partition("=")
+                if k == "cap":
+                    b.cap = int(v)
+                elif k == "emitted":
+                    b.emitted = int(v)
+                elif k == "dropped":
+                    b.dropped = int(v)
+            continue
+        if line.startswith("# textlog"):     # procfs node: legacy tail
+            break
+        if line.startswith("#"):
+            continue
+        tag, _, rest = line.partition(" ")
+        toks = rest.split()
+        if tag == "R" and len(toks) >= 7:
+            b.records.append(Rec(int(toks[0]), int(toks[1]), toks[2],
+                                 int(toks[3]), _int(toks[4]),
+                                 int(toks[5]), _int(toks[6]),
+                                 _int(toks[7])))
+        elif tag == "E" and len(toks) == 2:
+            b.type_counts[toks[0]] = int(toks[1])
+        elif tag == "C" and len(toks) == 2:
+            b.counters[toks[0]] = int(toks[1])
+        elif tag == "H":
+            b.health.append(rest)
+        elif tag == "V":
+            b.manifests.append(rest)
+        elif tag == "G":
+            b.rings.append(rest)
+        elif tag == "S":
+            b.shield.append(rest)
+        elif tag == "I" and len(toks) >= 5:
+            b.inject[toks[0]] = (int(toks[2]), int(toks[4]))
+        elif tag == "cap" and len(toks) >= 5:
+            b.cap = int(toks[0])
+            b.emitted = int(toks[2])
+            b.dropped = int(toks[4])
+        elif tag.endswith(":"):
+            key, val = tag[:-1], rest
+            if key == "reason":
+                b.reason = val
+            elif key == "pid":
+                b.pid = int(val)
+            elif key == "time_ns":
+                b.time_ns = int(val)
+            elif key == "status":
+                b.status = val
+    return b
+
+
+# ------------------------------------------------------------- timeline
+
+def _fmt_payload(r: Rec) -> str:
+    t = r.type
+    if t == "health.note":
+        ev = (HEALTH_EVENTS[r.a0] if r.a0 < len(HEALTH_EVENTS)
+              else str(r.a0))
+        return f"{ev} score={r.a1}"
+    if t == "health.transition":
+        def st(v: int) -> str:
+            return (HEALTH_STATES[v] if v < len(HEALTH_STATES)
+                    else str(v))
+        return f"{st(r.a0)} -> {st(r.a1)}"
+    if t == "health.evac":
+        return f"req {r.a0} -> dev{r.a1}"
+    if t == "wd.rung":
+        return f"rung {r.a0} ({WD_RUNGS.get(r.a0, '?')})"
+    if t == "reset.gen":
+        return f"gen {r.a0}"
+    if t == "reset.device":
+        return f"gen {r.a0} mttr {r.a1 / 1e6:.1f}ms"
+    if t in ("ici.flap", "ici.retrain", "ici.crc"):
+        return f"{r.a0} -> {r.a1}"
+    if t in ("page.quarantine", "page.poison"):
+        return f"va 0x{r.a0:x}" + (f" tier {r.a1}"
+                                   if t == "page.poison" else "")
+    if t == "shield.verdict":
+        how = {1: "unseal", 2: "verify", 3: "wire"}.get(r.a1, "?")
+        return f"0x{r.a0:x} ({how} mismatch)"
+    if t in ("vac.begin", "vac.abort"):
+        return f"txn {r.a0} dev{r.a1 >> 32} -> dev{r.a1 & 0xffffffff}"
+    if t == "vac.commit":
+        return f"txn {r.a0}"
+    if t == "inject.hit":
+        return f"site {r.a0} scope 0x{r.a1:x}"
+    if t == "sched.shed":
+        return f"waiting {r.a0}"
+    if t == "sched.preempt":
+        return f"seq {r.a0} preempts {r.a1}"
+    if t == "sched.retire":
+        return f"seq {r.a0}"
+    if t == "client.death":
+        return f"pid {r.a0}"
+    if t == "log":
+        subsys = r.a1.to_bytes(8, "little").rstrip(b"\0")
+        return f"level {r.a0} [{subsys.decode(errors='replace')}]"
+    if t == "dump":
+        reason = r.a0.to_bytes(8, "little").rstrip(b"\0")
+        return (f"{reason.decode(errors='replace')} "
+                f"({'complete' if r.a1 else 'truncated'})")
+    return f"a0=0x{r.a0:x} a1=0x{r.a1:x}"
+
+
+def timeline(b: Bundle, group: str = "time") -> List[str]:
+    """Render the ordered causal timeline; ``group`` is time (one
+    stream), dev, or flow."""
+    recs = sorted(b.records, key=lambda r: r.seq)
+    if not recs:
+        return ["(no records)"]
+    t0 = min(r.ts_ns for r in recs)
+    out: List[str] = []
+
+    def line(r: Rec) -> str:
+        who = f"dev{r.dev}"
+        if r.flow:
+            who += f" flow {r.flow}"
+        st = ""
+        if r.status:
+            st = " !" + STATUS_NAMES.get(r.status, f"0x{r.status:x}")
+        return (f"[t+{(r.ts_ns - t0) / 1e9:.6f}] {who:<16} "
+                f"{r.type:<18} {_fmt_payload(r)}{st}")
+
+    if group == "time":
+        out.extend(line(r) for r in recs)
+    else:
+        keyf = ((lambda r: r.dev) if group == "dev"
+                else (lambda r: r.flow))
+        keys = sorted({keyf(r) for r in recs})
+        for k in keys:
+            out.append(f"-- {group} {k} --")
+            out.extend(line(r) for r in recs if keyf(r) == k)
+    if b.dropped:
+        out.append(f"({b.dropped} older records dropped by wrap; "
+                   f"timeline starts at seq {recs[0].seq})")
+    return out
+
+
+# --------------------------------------------------------- reconciliation
+
+def check(b: Bundle) -> Tuple[List[str], bool]:
+    """Cross-check the journal's per-type emit counts against the
+    counter snapshot riding in the same bundle.  Exact by design: every
+    emit site is adjacent to its counter bump and the dumper snapshots
+    [journal]/[emitted] before [counters], so on quiesced fatal paths
+    the books balance to the record.  A truncated bundle downgrades
+    missing sections to SKIP, never PASS."""
+    lines: List[str] = []
+    ok = True
+    have_counters = bool(b.counters)
+    for rtype, ctrs in sorted(RECONCILE.items()):
+        emitted = b.type_counts.get(rtype)
+        if emitted is None:
+            lines.append(f"SKIP  {rtype}: no [emitted] section")
+            continue
+        if not have_counters:
+            lines.append(f"SKIP  {rtype}: no [counters] section "
+                         f"(truncated bundle)")
+            continue
+        total = sum(b.counters.get(c, 0) for c in ctrs)
+        tag = "PASS " if emitted == total else "FAIL "
+        ok &= emitted == total
+        lines.append(f"{tag} {rtype}: journal {emitted} == "
+                     f"{' + '.join(ctrs)} {total}")
+
+    # wd.rung reconciles per-rung against four counters, using the
+    # records themselves (payload a0 picks the counter).
+    if have_counters and "wd.rung" in b.type_counts:
+        per_rung: Dict[int, int] = {}
+        for r in b.records:
+            if r.type == "wd.rung":
+                per_rung[r.a0] = per_rung.get(r.a0, 0) + 1
+        if sum(per_rung.values()) == b.type_counts["wd.rung"]:
+            for rung, ctr in sorted(RECONCILE_WD.items()):
+                got, want = per_rung.get(rung, 0), b.counters.get(ctr, 0)
+                tag = "PASS " if got == want else "FAIL "
+                ok &= got == want
+                lines.append(f"{tag} wd.rung[{rung}]: journal {got} == "
+                             f"{ctr} {want}")
+        else:
+            lines.append("SKIP  wd.rung per-rung: records wrapped out "
+                         "of the ring")
+
+    # health.note has no global counter — it reconciles against the
+    # per-device event tallies in the [health] section (the "ev ..."
+    # tail of each H line is d->events[], bumped under the same lock
+    # that emits the record).
+    if b.health and "health.note" in b.type_counts:
+        total = 0
+        parsed = False
+        for h in b.health:
+            toks = h.split()
+            if "ev" in toks:
+                total += sum(int(t) for t in toks[toks.index("ev") + 1:])
+                parsed = True
+        if parsed:
+            emitted = b.type_counts["health.note"]
+            tag = "PASS " if emitted == total else "FAIL "
+            ok &= emitted == total
+            lines.append(f"{tag} health.note: journal {emitted} == "
+                         f"per-dev event tallies {total}")
+
+    # dump.write invariant: inject hits == journal_dump_errors.
+    if have_counters and "dump.write" in b.inject:
+        hits = b.inject["dump.write"][1]
+        errs = b.counters.get("journal_dump_errors", 0)
+        tag = "PASS " if hits == errs else "FAIL "
+        ok &= hits == errs
+        lines.append(f"{tag} dump.write: hits {hits} == "
+                     f"journal_dump_errors {errs}")
+
+    # inject.hit == sum of per-site hit counts ([inject] section).
+    if b.inject and "inject.hit" in b.type_counts:
+        total = sum(h for _, h in b.inject.values())
+        emitted = b.type_counts["inject.hit"]
+        tag = "PASS " if emitted == total else "FAIL "
+        ok &= emitted == total
+        lines.append(f"{tag} inject.hit: journal {emitted} == "
+                     f"site hits {total}")
+    return lines, ok
+
+
+# ------------------------------------------------------------------ main
+
+def load_live() -> str:
+    """Scrape the in-process journal (requires the native library —
+    used by tests; external agents read the procfs node instead)."""
+    from open_gpu_kernel_modules_tpu.uvm import journal
+    return journal.text()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpubox", description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", nargs="?",
+                    help="crash bundle or journal scrape file "
+                         "(- for stdin)")
+    ap.add_argument("--live", action="store_true",
+                    help="scrape the in-process journal instead of a "
+                         "file")
+    ap.add_argument("--group", choices=("time", "dev", "flow"),
+                    default="time", help="timeline grouping")
+    ap.add_argument("--check", action="store_true",
+                    help="reconcile record counts against the counter "
+                         "snapshot; exit 1 on imbalance")
+    ap.add_argument("--no-timeline", action="store_true",
+                    help="suppress the timeline (with --check)")
+    args = ap.parse_args(argv)
+
+    if args.live:
+        text = load_live()
+    elif args.bundle == "-" or args.bundle is None:
+        text = sys.stdin.read()
+    else:
+        with open(args.bundle, "r", errors="replace") as f:
+            text = f.read()
+
+    b = parse(text)
+    if b.reason:
+        print(f"bundle: reason={b.reason} pid={b.pid} "
+              f"status={b.status or '?'}")
+    if b.status == "truncated":
+        print("NOTE: bundle truncated mid-write (dump.write fault or "
+              "death inside the dumper) — sections below the chop are "
+              "missing; reconciliation degrades to SKIP")
+    if not args.no_timeline:
+        for line in timeline(b, args.group):
+            print(line)
+        for v in b.manifests:
+            print(f"open manifest: {v}")
+    if args.check:
+        lines, ok = check(b)
+        print("-- reconcile --")
+        for line in lines:
+            print(line)
+        print("books balance" if ok else "BOOKS DO NOT BALANCE")
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
