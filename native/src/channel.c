@@ -150,6 +150,10 @@ static void *channel_executor(void *arg)
     TpurmChannel *ch = arg;
     TpuMsgqCmd cmd;
 
+    /* Executors spread over distinct CPUs alongside the spine workers
+     * (no-op on <=2 CPU hosts — see tpuCpuPinThread). */
+    tpuCpuPinThread("ce-executor");
+
     while (tpuMsgqReceive(ch->fifo, &cmd, 1) == 1) {
         uint32_t stall = atomic_exchange_explicit(&ch->stallMs, 0,
                                                   memory_order_acq_rel);

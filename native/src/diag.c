@@ -16,6 +16,8 @@
 #include <stdatomic.h>
 
 #include <errno.h>
+#include <pthread.h>
+#include <sched.h>
 #include <stdarg.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -217,6 +219,49 @@ void tpuCounterAddScoped(const char *name, uint32_t devInst, uint64_t delta)
     tpuCounterAdd(name, delta);
     snprintf(scoped, sizeof(scoped), "%s[d%u]", name, devInst);
     tpuCounterAdd(scoped, delta);
+}
+
+/* --------------------------------------------------------- CPU placement
+ *
+ * NUMA/CPU-aware worker placement: spine workers and tpuce channel
+ * executors each claim the next CPU, round-robin over the process
+ * affinity mask, so they stop time-slicing one core under the sharded
+ * spine.  Deliberately a no-op when sched_getaffinity shows <= 2 CPUs
+ * (this container): with nothing to spread over, forced placement only
+ * fights the kernel balancer. */
+void tpuCpuPinThread(const char *role)
+{
+    static TpuRegCache c_pin;
+    static _Atomic uint32_t slot;
+    if (!tpuRegCacheGet(&c_pin, "cpu_pin", 1))
+        return;
+    cpu_set_t set;
+    if (sched_getaffinity(0, sizeof(set), &set) != 0)
+        return;
+    int avail = CPU_COUNT(&set);
+    if (avail <= 2)
+        return;
+    uint32_t idx = atomic_fetch_add_explicit(&slot, 1,
+                                             memory_order_relaxed) %
+                   (uint32_t)avail;
+    int cpu = -1;
+    for (int c = 0, seen = 0; c < CPU_SETSIZE; c++) {
+        if (!CPU_ISSET(c, &set))
+            continue;
+        if ((uint32_t)seen++ == idx) {
+            cpu = c;
+            break;
+        }
+    }
+    if (cpu < 0)
+        return;
+    cpu_set_t one;
+    CPU_ZERO(&one);
+    CPU_SET(cpu, &one);
+    if (pthread_setaffinity_np(pthread_self(), sizeof(one), &one) == 0) {
+        tpuCounterAdd("tpurm_cpu_pins", 1);
+        TPU_LOG(TPU_LOG_DEBUG, "diag", "%s pinned to CPU %d", role, cpu);
+    }
 }
 
 size_t tpuCountersDump(char *buf, size_t bufSize)

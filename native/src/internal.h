@@ -528,6 +528,24 @@ bool      tpurmMemringSpineParked(void);
  * until the ring progresses).  Returns the highest rung taken. */
 uint32_t  tpurmMemringWatchdogScan(uint64_t hangNs);
 
+/* Sharded-spine introspection (tests/bench): the live internal shard
+ * count, and a shard's ring (NULL past count or when that shard failed
+ * to create).  Both force spine init. */
+uint32_t tpurmMemringInternalShards(void);
+struct TpuMemring *tpurmMemringInternalShardRing(uint32_t shard);
+
+/* Pin the calling thread to a distinct CPU, round-robin over the
+ * process affinity mask (NUMA/CPU-aware worker placement for spine
+ * workers and tpuce channel executors).  Deliberately a no-op when
+ * sched_getaffinity shows <= 2 CPUs (nothing to spread over — forced
+ * placement only hurts there) or registry cpu_pin=0. */
+void tpuCpuPinThread(const char *role);
+
+/* One-time CRC table + hardware-feature probe for the tpushield CRC32C
+ * (idempotent; a library constructor and tpuRcInit both call it so the
+ * per-seal hot path carries no once-check). */
+void tpurmShieldCrcInit(void);
+
 /* Drain every device's tpuce manager (fence semantics per manager). */
 void tpuCeDrainAll(void);
 

@@ -50,6 +50,7 @@
 #include <sched.h>
 #include <stdatomic.h>
 #include <stdbool.h>
+#include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
@@ -170,6 +171,7 @@ struct TpuMemring {
                                    * walk */
     _Atomic uint64_t errMaxSeq;
     uint32_t id;                  /* dep-handle ring id (hdr->ringId) */
+    uint32_t intShard;            /* spine shard index (internal only) */
 
     pthread_mutex_t cqLock;
 
@@ -208,18 +210,51 @@ static struct {
     _Atomic uint32_t nextId;      /* dep-handle ring ids, from 1 */
 } g_mrings = { .lock = PTHREAD_MUTEX_INITIALIZER };
 
-/* The process-global INTERNAL ring (the submission spine).  Created on
- * first internal submission; never destroyed (process lifetime, like
- * the fault engine). */
+/* The process-global INTERNAL rings (the submission spine), SHARDED
+ * per CPU: memring_internal_shards rings (default min(online CPUs, 8)),
+ * each with its own prodLock/SQ/CQ/retirement frontier.  Producers
+ * hash to a home shard by (VA block | flow id | submitting thread) so
+ * related traffic stays adjacent (run coalescing needs it); idle
+ * workers and help-draining submitters WORK-STEAL claims from sibling
+ * shards.  Cross-shard dependencies need no new machinery — dep
+ * handles already encode the ring id (TPU_MEMRING_DEP: ring<<48|seq),
+ * so a shard-B dependent of a shard-A op resolves through the existing
+ * cross-ring path.  Created on first internal submission; never
+ * destroyed (process lifetime, like the fault engine). */
+#define MEMRING_MAX_SHARDS 8
+
 static struct {
     pthread_once_t once;
-    TpuMemring *ring;
+    /* Live shards: 0 until init PUBLISHES the directory.  Workers of
+     * early shards start while later shards are still being created,
+     * so the release-store of count (after every shard[] pointer and
+     * intShard) is what licenses them to walk shard[] — always load
+     * with acquire and treat 0 as "directory not ready". */
+    _Atomic uint32_t count;
+    TpuMemring *shard[MEMRING_MAX_SHARDS];
+    /* Spine-wide doorbell: every internal publish and retire bumps it;
+     * internal workers sleep on THIS word (not their own ring's), so a
+     * stealable backlog or a cross-shard retire on any sibling wakes
+     * them.  sleepers gates the FUTEX_WAKE syscall. */
+    _Atomic uint32_t doorbell;
+    _Atomic uint32_t sleepers;
+    /* Shards whose last claim scan left dep-blocked entries — the
+     * cross-SHARD blocked census (g_mrings.crossBlocked stays the
+     * cross-RING one): retires anywhere in the spine wake the
+     * sleepers while nonzero. */
+    _Atomic uint32_t blockedShards;
+    _Atomic uint32_t stealCursor;      /* rotates the steal scan start */
+    _Atomic uint32_t homeCursor;       /* round-robin thread homes */
 } g_int = { .once = PTHREAD_ONCE_INIT };
 
 /* Nonzero while this thread is executing claimed ring ops (worker or
  * help-draining submitter).  A dependent internal submission from such
  * a context executes INLINE instead of queueing behind itself. */
 static __thread int t_mrWorker;
+
+/* This thread's home shard (lazily assigned): used when a batch
+ * carries neither a VA nor a flow id to hash. */
+static __thread uint32_t t_homeShard = UINT32_MAX;
 
 /* Pre-resolved internal-accounting counter cells (hot path: one per
  * fault batch). */
@@ -286,6 +321,24 @@ static inline void mr_bit_clear(_Atomic uint64_t *map, uint32_t bit)
 {
     atomic_fetch_and_explicit(&map[bit >> 6], ~(1ull << (bit & 63)),
                               memory_order_release);
+}
+
+/* Publish a ring's blocked census (claim scan end, popLock held): the
+ * per-ring depBlocked word, plus — for internal rings — the spine-wide
+ * blocked-shards count that gates the cross-SHARD retire wake (sleeping
+ * spine workers park on g_int.doorbell, not their own ring's). */
+static void mr_publish_blocked(TpuMemring *r, uint32_t blocked)
+{
+    if (r->internal) {
+        uint32_t prev = atomic_load(&r->depBlocked);
+        if ((prev == 0) != (blocked == 0)) {
+            if (blocked)
+                atomic_fetch_add(&g_int.blockedShards, 1);
+            else
+                atomic_fetch_sub(&g_int.blockedShards, 1);
+        }
+    }
+    atomic_store(&r->depBlocked, blocked);
 }
 
 /* Retire a claim batch's seqs: mark done bits (+ error memory), then
@@ -359,6 +412,17 @@ static void mr_retire_seqs(TpuMemring *r, const uint64_t *seqs,
         pthread_mutex_unlock(&g_mrings.lock);
         /* Also nudge parked internal submitters via their group futex?
          * Not needed: help-drainers re-scan on a 50 ms bound. */
+    }
+    /* Spine-wide doorbell: sleeping internal workers park on
+     * g_int.doorbell (so a sibling shard's backlog can wake them to
+     * steal).  Bump always; the syscall fires only when some shard's
+     * scan registered a dep-blocked entry — this retire may be the
+     * cross-shard dependency it is waiting on. */
+    if (r->internal) {
+        atomic_fetch_add(&g_int.doorbell, 1);
+        if (atomic_load(&g_int.sleepers) != 0 &&
+            atomic_load(&g_int.blockedShards) != 0)
+            mr_futex(&g_int.doorbell, FUTEX_WAKE, INT32_MAX, NULL);
     }
 }
 
@@ -1146,7 +1210,7 @@ static MrClaimResult mr_claim_and_exec(TpuMemring *r, bool force)
     uint32_t tail = atomic_load_explicit(&r->hdr->sqTail,
                                          memory_order_acquire);
     if (head == tail) {
-        atomic_store(&r->depBlocked, 0);
+        mr_publish_blocked(r, 0);
         pthread_mutex_unlock(&r->popLock);
         return MR_CLAIM_EMPTY;
     }
@@ -1276,7 +1340,7 @@ static MrClaimResult mr_claim_and_exec(TpuMemring *r, bool force)
     /* Publish the blocked census for the retire-side doorbell gate
      * (registered BEFORE the caller's doorbell-value sleep re-check:
      * seq_cst rules out the lost wakeup). */
-    atomic_store(&r->depBlocked, blocked);
+    mr_publish_blocked(r, blocked);
     if (crossBlocked)
         atomic_store(&g_mrings.crossBlocked, blocked ? 1 : 0);
 
@@ -1330,10 +1394,73 @@ static MrClaimResult mr_claim_and_exec(TpuMemring *r, bool force)
     return MR_CLAIM_PROGRESS;
 }
 
+/* ------------------------------------------------------- spine sharding */
+
+/* Shard pick for one internal batch: hash (VA block | flow id) so
+ * related traffic lands on one shard — run coalescing and ORDERED
+ * chains need adjacency — else fall back to the ambient trace flow,
+ * else the submitting thread's home shard.  The whole batch stays on
+ * ONE shard: BATCH-relative deps rewrite against that ring's seqs. */
+static TpuMemring *mr_int_pick(const TpuMemringSqe *sqes, uint32_t n)
+{
+    uint32_t cnt = atomic_load_explicit(&g_int.count,
+                                         memory_order_acquire);
+    if (cnt == 0)
+        return NULL;
+    if (cnt == 1)
+        return g_int.shard[0];
+    uint64_t key;
+    if (n && sqes[0].addr)
+        key = sqes[0].addr >> 21;      /* VA block (2 MB) */
+    else if (n && sqes[0].flowId)
+        key = sqes[0].flowId;
+    else if ((key = tpurmTraceFlowGet()) == 0) {
+        if (t_homeShard == UINT32_MAX) {
+            int cpu = sched_getcpu();
+            t_homeShard = cpu >= 0
+                              ? (uint32_t)cpu
+                              : atomic_fetch_add(&g_int.homeCursor, 1);
+        }
+        return g_int.shard[t_homeShard % cnt];
+    }
+    key *= 0x9E3779B97F4A7C15ull;      /* Fibonacci hash: top bits mix */
+    return g_int.shard[(key >> 56) % cnt];
+}
+
+/* Work-steal one claim batch from a sibling shard.  The claim
+ * machinery is already shard-agnostic — mr_claim_and_exec on the
+ * victim ring IS the steal (claimedMap keeps thieves and owners
+ * disjoint); the rotating start spreads concurrent thieves. */
+static bool mr_int_steal(TpuMemring *self)
+{
+    static _Atomic(_Atomic uint64_t *) c_steals;
+    uint32_t cnt = atomic_load_explicit(&g_int.count,
+                                         memory_order_acquire);
+    if (cnt <= 1)
+        return false;
+    uint32_t start = atomic_fetch_add(&g_int.stealCursor, 1);
+    for (uint32_t k = 0; k < cnt; k++) {
+        TpuMemring *o = g_int.shard[(start + k) % cnt];
+        if (!o || o == self)
+            continue;
+        if (mr_claim_and_exec(o, false) == MR_CLAIM_PROGRESS) {
+            mr_ctr_cached(&c_steals, "memring_steals", 1);
+            return true;
+        }
+    }
+    return false;
+}
+
 static void *worker_main(void *arg)
 {
     TpuMemring *r = arg;
     static TpuRegCache c_sqpoll, c_sqpollIdle;
+
+    /* NUMA/CPU-aware placement: spine workers spread over distinct
+     * CPUs so shards stop time-slicing one core (no-op on <=2 CPU
+     * hosts — see tpuCpuPinThread). */
+    if (r->internal)
+        tpuCpuPinThread("memring-worker");
 
     for (;;) {
         /* Reset park gate: while a full-device reset is quiescing or
@@ -1356,6 +1483,7 @@ static void *worker_main(void *arg)
          * the word, so a failed claim (empty or dep-blocked) can sleep
          * on this value — anything that could change the verdict also
          * changes the word and fails the FUTEX_WAIT with EAGAIN. */
+        uint32_t gd = r->internal ? atomic_load(&g_int.doorbell) : 0;
         uint32_t d = atomic_load(&r->hdr->doorbell);
         bool shut = atomic_load(&r->shutdown);
         MrClaimResult res = mr_claim_and_exec(r, shut);
@@ -1366,6 +1494,12 @@ static void *worker_main(void *arg)
                 break;             /* SQ drained; exit */
             continue;              /* re-claim with force under shutdown */
         }
+
+        /* Idle spine worker: WORK-STEAL a claim from a sibling shard
+         * before sleeping — a backlogged shard drains at the spine's
+         * full worker count, not its own. */
+        if (r->internal && mr_int_steal(r))
+            continue;
 
         /* SQPOLL (io_uring SQPOLL idiom): registered pollers spin on
          * the doorbell word so submitters skip the FUTEX_WAKE — a
@@ -1384,8 +1518,12 @@ static void *worker_main(void *arg)
                    !atomic_load_explicit(&g_mrings.parked,
                                          memory_order_acquire)) {
                 /* The doorbell moves on submit AND retire — either can
-                 * make a blocked queue claimable again. */
-                if (atomic_load(&r->hdr->doorbell) != d) {
+                 * make a blocked queue claimable again.  Internal
+                 * pollers also watch the spine word: a sibling shard's
+                 * backlog is stealable work. */
+                if (atomic_load(&r->hdr->doorbell) != d ||
+                    (r->internal &&
+                     atomic_load(&g_int.doorbell) != gd)) {
                     work = true;
                     break;
                 }
@@ -1415,10 +1553,29 @@ static void *worker_main(void *arg)
          * dep-blocked queue sleeps TIMED: cross-ring retires have no
          * synchronization point that orders the blocked census against
          * their gated wake, so a bounded re-scan is the backstop. */
-        if (atomic_load(&r->hdr->doorbell) == d &&
-            !atomic_load(&r->shutdown) &&
-            !atomic_load_explicit(&g_mrings.parked,
-                                  memory_order_acquire)) {
+        if (r->internal) {
+            /* Spine workers sleep on the SPINE doorbell, so a publish
+             * or retire on ANY shard (stealable work, or the retire a
+             * sibling's dep-blocked queue waits on) wakes them.  Both
+             * words are re-checked under the sleepers registration —
+             * watchdog nudges that bump only the ring word also bump
+             * the spine word for internal rings. */
+            atomic_fetch_add(&g_int.sleepers, 1);
+            if (atomic_load(&g_int.doorbell) == gd &&
+                atomic_load(&r->hdr->doorbell) == d &&
+                !atomic_load(&r->shutdown) &&
+                !atomic_load_explicit(&g_mrings.parked,
+                                      memory_order_acquire)) {
+                struct timespec bl = { .tv_sec = 0,
+                                       .tv_nsec = 10 * 1000 * 1000 };
+                mr_futex(&g_int.doorbell, FUTEX_WAIT, gd,
+                         res == MR_CLAIM_BLOCKED ? &bl : NULL);
+            }
+            atomic_fetch_sub(&g_int.sleepers, 1);
+        } else if (atomic_load(&r->hdr->doorbell) == d &&
+                   !atomic_load(&r->shutdown) &&
+                   !atomic_load_explicit(&g_mrings.parked,
+                                         memory_order_acquire)) {
             struct timespec bl = { .tv_sec = 0,
                                    .tv_nsec = 10 * 1000 * 1000 };
             mr_futex(&r->hdr->doorbell, FUTEX_WAIT, d,
@@ -1585,9 +1742,14 @@ void tpurmMemringDestroy(TpuMemring *r)
     for (uint32_t i = 0; i < r->workerCount; i++) {
         /* Workers drain the published SQ before exiting (deps are
          * ignored under shutdown, exactly the legacy FIFO drain); keep
-         * waking in case one raced into a futex wait. */
+         * waking in case one raced into a futex wait.  Internal
+         * workers sleep on the spine doorbell — ring that too. */
         atomic_fetch_add(&r->hdr->doorbell, 1);
         mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+        if (r->internal) {
+            atomic_fetch_add(&g_int.doorbell, 1);
+            mr_futex(&g_int.doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+        }
         pthread_join(r->workers[i], NULL);
     }
     for (uint32_t i = 0; i < r->apCount; i++)
@@ -1674,6 +1836,13 @@ TpuStatus tpurmMemringPrep(TpuMemring *r, TpuMemringSqe *sqe)
      * watermark passes them, so this is belt-and-suspenders for the
      * first wrap. */
     r->depBlockNs[r->pendTail & r->sqMask] = 0;
+    /* Same hygiene for the internal side-slot: a raw producer (tests,
+     * NOP probes) that preps without going through SubmitInternal must
+     * not leave the claim path a stale group pointer from a prior
+     * occupant of this slot.  SubmitInternal overwrites it right after
+     * this prep returns, still under prodLock. */
+    if (r->slots)
+        r->slots[r->pendTail & r->sqMask] = (MrSlot){ 0 };
     r->pendTail++;
     r->prepSeq++;
     r->pendChain = (sqe->flags & TPU_MEMRING_SQE_LINK)
@@ -1719,6 +1888,20 @@ uint32_t tpurmMemringSubmit(TpuMemring *r)
     atomic_fetch_add(&r->hdr->doorbell, 1);
     if (atomic_load(&r->hdr->sqPollers) == 0 && r->workerCount > 0)
         mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+    /* Internal publishes also ring the SPINE doorbell: workers on
+     * sibling shards sleep there, and this backlog is stealable even
+     * when this shard has no worker of its own.  Wake ONE sleeper —
+     * one publish is one batch, and any spine worker can claim or
+     * steal it; a broadcast here is a thundering herd that costs real
+     * throughput once worker counts grow (every other woken worker
+     * races the steal, loses, and goes back to sleep).  The broadcast
+     * stays on the retire/park/destroy paths, where ANY shard's
+     * blocked worker may be the one the event unblocks. */
+    if (r->internal) {
+        atomic_fetch_add(&g_int.doorbell, 1);
+        if (atomic_load(&g_int.sleepers) != 0)
+            mr_futex(&g_int.doorbell, FUTEX_WAKE, 1, NULL);
+    }
     if (tSpan)
         tpurmTraceEnd(TPU_TRACE_MEMRING_SUBMIT, tSpan, 0, n);
     return n;
@@ -1885,18 +2068,57 @@ static void mr_internal_init_once(void)
      * loop could never satisfy an oversized chain. */
     if (entries < 4 * MEMRING_POP_BATCH)
         entries = 4 * MEMRING_POP_BATCH;
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    if (ncpu < 1)
+        ncpu = 1;
+    uint32_t dflt = ncpu < MEMRING_MAX_SHARDS ? (uint32_t)ncpu
+                                              : MEMRING_MAX_SHARDS;
+    uint32_t shards = (uint32_t)tpuRegistryGet("memring_internal_shards",
+                                               dflt);
+    if (shards < 1)
+        shards = 1;
+    if (shards > MEMRING_MAX_SHARDS)
+        shards = MEMRING_MAX_SHARDS;
     uint32_t workers = (uint32_t)tpuRegistryGet("memring_internal_workers",
                                                 0);
     /* SQPOLL armed at init: spawn dedicated pollers so internal
      * submitters need not help-drain (syscall-free async offload). */
     if (workers == 0 && tpuRegistryGet("memring_sqpoll", 0))
         workers = (uint32_t)tpuRegistryGet("memring_sqpoll_workers", 1);
-    if (mr_create(NULL, entries, workers, true, &g_int.ring) != TPU_OK) {
-        g_int.ring = NULL;
-        TPU_LOG(TPU_LOG_ERROR, "memring",
-               "internal spine ring create failed — internal "
-               "submissions will execute inline");
+    /* Workers are a SPINE total distributed across shards (remainder
+     * to the low shards) — "memring_internal_workers=4" means four
+     * spine workers regardless of shard count; work stealing covers
+     * the worker-less shards. */
+    for (uint32_t s = 0; s < shards; s++) {
+        uint32_t w = workers / shards + (s < workers % shards ? 1 : 0);
+        if (mr_create(NULL, entries, w, true, &g_int.shard[s]) !=
+            TPU_OK) {
+            g_int.shard[s] = NULL;
+            TPU_LOG(TPU_LOG_ERROR, "memring",
+                   "internal spine shard %u create failed — its "
+                   "submissions will execute inline", s);
+        } else {
+            g_int.shard[s]->intShard = s;
+        }
     }
+    /* Release-publish: workers' acquire load of count orders every
+     * shard[] pointer and intShard write above. */
+    atomic_store_explicit(&g_int.count, shards, memory_order_release);
+}
+
+uint32_t tpurmMemringInternalShards(void)
+{
+    pthread_once(&g_int.once, mr_internal_init_once);
+    return atomic_load_explicit(&g_int.count, memory_order_acquire);
+}
+
+struct TpuMemring *tpurmMemringInternalShardRing(uint32_t shard)
+{
+    pthread_once(&g_int.once, mr_internal_init_once);
+    return shard < atomic_load_explicit(&g_int.count,
+                                        memory_order_acquire)
+               ? g_int.shard[shard]
+               : NULL;
 }
 
 /* Inline execution of an internal batch: same per-op recovery, LINK
@@ -1914,7 +2136,13 @@ static TpuStatus mr_exec_inline(UvmVaSpace *vs, const TpuMemringSqe *sqes,
                                 uint32_t depBase,
                                 const TpuStatus *priorSt)
 {
-    TpuMemring *r = g_int.ring;        /* may be NULL (create failure) */
+    /* Shard 0 lends its ICI aperture cache to inline exec (any shard
+     * would do — the cache is keyed by device pair); may be NULL when
+     * spine creation failed. */
+    TpuMemring *r = atomic_load_explicit(&g_int.count,
+                                         memory_order_acquire)
+                        ? g_int.shard[0]
+                        : NULL;
     TpuStatus first = TPU_OK;
     bool cancelled = false;
     /* Ambient flow: an internal batch submitted from a flow-scoped
@@ -2057,7 +2285,7 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
         }
     }
 
-    TpuMemring *r = g_int.ring;
+    TpuMemring *r = mr_int_pick(sqes, n);
     if (!r || t_mrWorker ||
         atomic_load_explicit(&g_mrings.parked, memory_order_acquire))
         return mr_exec_inline(vs, sqes, n, stOut, 0, NULL);
@@ -2083,7 +2311,13 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
      * whole: splitting one across a publication boundary would let two
      * workers run its halves concurrently, breaking the ordered-claim
      * guarantee fault chains rely on. */
-    pthread_mutex_lock(&r->prodLock);
+    static _Atomic(_Atomic uint64_t *) c_contended;
+    if (pthread_mutex_trylock(&r->prodLock) != 0) {
+        /* The shard hash is doing its job when this stays ~0 even at
+         * 8 producers — the whole point of the sharded spine. */
+        mr_ctr_cached(&c_contended, "memring_prod_contended", 1);
+        pthread_mutex_lock(&r->prodLock);
+    }
     /* Re-check the park gate UNDER the lock: ParkAll stores `parked`
      * and then passes through this lock as a publish barrier before
      * draining the queue — so a submitter that still reads 0 here is
@@ -2096,6 +2330,7 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
         return mr_exec_inline(vs, sqes, n, stOut, 0, NULL);
     }
     uint32_t i = 0;
+    uint32_t stagedTotal = 0;
     bool bailedInline = false;
     /* Seqs of already-staged batch members: BATCH-relative deps (index
      * into the batch) rewrite against these at stage time, so intra-
@@ -2182,6 +2417,7 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
                 .grp = &grp,
                 .stOut = stOut ? &stOut[i + k] : NULL,
             };
+            stagedTotal++;
         }
         if (ps != TPU_OK) {
             /* Defensive (overlong chain / bad opcode): the staged ops
@@ -2204,6 +2440,16 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
     }
     if (seqOf && seqOf != seqStack)
         free(seqOf);
+    if (stagedTotal) {
+        /* Per-shard staged census: Σ_s memring_shard_sqes[sN] plus
+         * memring_internal_inline equals memring_internal_sqes (the
+         * aggregate invariant, now verifiable per shard). */
+        char scoped[48];
+        snprintf(scoped, sizeof(scoped), "memring_shard_sqes[s%u]",
+                 r->intShard);
+        tpuCounterAdd(scoped, stagedTotal);
+        tpuCounterAdd("memring_shard_sqes", stagedTotal);
+    }
 
     /* Submit-and-help: drain the ring (any subsystem's work — claims
      * interleave, coalescing merges) until our group retires.  While
@@ -2212,13 +2458,18 @@ TpuStatus tpurmMemringSubmitInternal(UvmVaSpace *vs,
         uint32_t rem = atomic_load(&grp.remaining);
         if (rem == 0)
             break;
-        if (!atomic_load_explicit(&g_mrings.parked,
-                                  memory_order_acquire) &&
-            mr_claim_and_exec(r, false) == MR_CLAIM_PROGRESS)
+        bool parked = atomic_load_explicit(&g_mrings.parked,
+                                           memory_order_acquire);
+        if (!parked && mr_claim_and_exec(r, false) == MR_CLAIM_PROGRESS)
             continue;
         rem = atomic_load(&grp.remaining);
         if (rem == 0)
             break;
+        /* Our shard is drained but the group is not: the missing ops
+         * (or the cross-shard deps gating them) live on a sibling —
+         * steal instead of idling on the futex. */
+        if (!parked && mr_int_steal(r))
+            continue;
         struct timespec ts = { .tv_sec = 0, .tv_nsec = 50 * 1000 * 1000 };
         mr_futex(&grp.remaining, FUTEX_WAIT, rem, &ts);
     }
@@ -2243,19 +2494,39 @@ TpuStatus tpurmMemringParkAll(uint64_t timeoutNs)
      * queued internal work HERE, on the reset thread — quiesce-time
      * execution, exactly the old inline-service semantics (the PM
      * gate has not closed yet). */
-    TpuMemring *ir = g_int.ring;
     uint64_t deadline = tpuNowNs() + timeoutNs;
-    if (ir) {
+    uint32_t nShards = atomic_load_explicit(&g_int.count,
+                                             memory_order_acquire);
+    /* Barrier EVERY shard's producer lock first (no submitter is left
+     * mid-publish on any shard), then sweep the shards round-robin:
+     * a shard-B entry may dep on a shard-A one, so the sweep must
+     * interleave rather than drain one shard to EMPTY at a time. */
+    for (uint32_t s = 0; s < nShards; s++) {
+        TpuMemring *ir = g_int.shard[s];
+        if (!ir)
+            continue;
         pthread_mutex_lock(&ir->prodLock);
         pthread_mutex_unlock(&ir->prodLock);
+    }
+    if (nShards) {
         /* Dep-blocked queued work waits on claims that slipped past
-         * the gate: keep sweeping until the queue is empty (bounded by
-         * the park deadline; leftovers replay after resume). */
+         * the gate: keep sweeping until every queue is empty (bounded
+         * by the park deadline; leftovers replay after resume). */
         for (;;) {
-            MrClaimResult res = mr_claim_and_exec(ir, false);
-            if (res == MR_CLAIM_PROGRESS)
+            bool progress = false, empty = true;
+            for (uint32_t s = 0; s < nShards; s++) {
+                TpuMemring *ir = g_int.shard[s];
+                if (!ir)
+                    continue;
+                MrClaimResult res = mr_claim_and_exec(ir, false);
+                if (res == MR_CLAIM_PROGRESS)
+                    progress = true;
+                if (res != MR_CLAIM_EMPTY)
+                    empty = false;
+            }
+            if (progress)
                 continue;
-            if (res == MR_CLAIM_EMPTY || tpuNowNs() >= deadline)
+            if (empty || tpuNowNs() >= deadline)
                 break;
             struct timespec ts = { .tv_sec = 0, .tv_nsec = 200 * 1000 };
             nanosleep(&ts, NULL);
@@ -2303,6 +2574,9 @@ void tpurmMemringUnparkAll(void)
         mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
     }
     pthread_mutex_unlock(&g_mrings.lock);
+    /* Spine workers sleep on the spine doorbell, not their ring's. */
+    atomic_fetch_add(&g_int.doorbell, 1);
+    mr_futex(&g_int.doorbell, FUTEX_WAKE, INT32_MAX, NULL);
 }
 
 /* Hung-op watchdog scan (internal.h contract): escalation ladder per
@@ -2340,6 +2614,10 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
             tpurmJournalEmit(TPU_JREC_WD_RUNG, 0, TPU_OK, 1, r->id);
             atomic_fetch_add(&r->hdr->doorbell, 1);
             mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+            if (r->internal) {
+                atomic_fetch_add(&g_int.doorbell, 1);
+                mr_futex(&g_int.doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+            }
             continue;
         }
         uint32_t rung = atomic_load(&r->wdRung) + 1;
@@ -2358,6 +2636,10 @@ uint32_t tpurmMemringWatchdogScan(uint64_t hangNs)
             tpurmHealthNote(0, TPU_HEALTH_EV_WD_NUDGE);
             atomic_fetch_add(&r->hdr->doorbell, 1);
             mr_futex(&r->hdr->doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+            if (r->internal) {
+                atomic_fetch_add(&g_int.doorbell, 1);
+                mr_futex(&g_int.doorbell, FUTEX_WAKE, INT32_MAX, NULL);
+            }
             break;
         case 2:
             tpuCounterAdd("tpurm_watchdog_rc_resets", 1);

@@ -179,6 +179,10 @@ static void *rc_watchdog_thread(void *arg)
 
 static void rc_init_once(void)
 {
+    /* Shield CRC tables: normally the library constructor already ran
+     * this; repeating it here (idempotent) covers exotic static-init
+     * orders before any channel executor can seal a page. */
+    tpurmShieldCrcInit();
     g_rc.shadow = tpuMsgqCreate(
         (uint32_t)tpuRegistryGet("rc_shadow_entries", 256), TPU_MSGQ_MPSC);
     if (!g_rc.shadow)

@@ -193,14 +193,29 @@ typedef struct UvmPmmChunk {
     struct UvmPmmChunk *next, *prev;  /* freelist links */
 } UvmPmmChunk;
 
-typedef struct UvmPmm {
+/* Free-list lock striping: each 2 MB root (and every chunk split from
+ * it) is owned by shard (rootIndex % shardCount) — buddies never cross
+ * a root, so merges stay intra-shard and a chunk's shard is stable for
+ * life.  Allocation tries the caller's home shard first (trylock;
+ * tier_lock_contended on a miss), then walks the siblings before
+ * reporting exhaustion.  Shard count: registry "tier_lock_shards",
+ * default min(online CPUs, 8), clamped to the root count. */
+#define UVM_PMM_MAX_SHARDS 8
+
+typedef struct UvmPmmShard {
     pthread_mutex_t lock;             /* order TPU_LOCK_UVM_PMM */
+    UvmPmmChunk *freelist[UVM_PMM_MAX_LEVELS];
+} UvmPmmShard;
+
+typedef struct UvmPmm {
+    uint32_t shardCount;
+    UvmPmmShard shards[UVM_PMM_MAX_SHARDS];
     uint64_t arenaSize;
     uint64_t chunkMin;                /* leaf chunk size */
     uint32_t levels;                  /* root..leaf inclusive */
-    uint64_t allocatedBytes;
-    UvmPmmChunk *freelist[UVM_PMM_MAX_LEVELS];
-    struct UvmPmmChunk **rootChunks;  /* lazily created roots */
+    _Atomic uint64_t allocatedBytes;
+    struct UvmPmmChunk **rootChunks;  /* lazily created roots (slot i
+                                       * written under shard i%count) */
     uint64_t rootCount;
 } UvmPmm;
 
@@ -221,17 +236,31 @@ uint64_t  uvmPmmAllocatedBytes(UvmPmm *pmm);
  * "cxl_tier_bytes"). */
 struct UvmVaBlock;
 
-typedef struct UvmTierArena {
+/* LRU lock striping: a block's shard is (blk->start / UVM_BLOCK_SIZE)
+ * % shardCount — stable for the block's life, so Touch/Remove and the
+ * evicting-flag handshake always meet on the same lock.  Victim scans
+ * walk the shards round-robin from a rotating cursor; global LRU order
+ * is per-shard only (approximate across shards, like the reference's
+ * per-GPU root-chunk lists). */
+#define UVM_TIER_LRU_SHARDS 8
+
+typedef struct UvmTierLruShard {
     pthread_mutex_t lock;             /* order TPU_LOCK_UVM_PMM */
     pthread_cond_t evictCond;         /* evicting-flag handshake */
+    /* Eviction LRU: blocks with residency in this arena, oldest first
+     * (reference: root-chunk LRU, uvm_pmm_gpu.c). */
+    struct UvmVaBlock *lruHead, *lruTail;
+} UvmTierLruShard;
+
+typedef struct UvmTierArena {
     UvmTier tier;
     uint32_t devInst;                 /* HBM only */
     void *base;
     uint64_t size;
     UvmPmm pmm;
-    /* Eviction LRU: blocks with residency in this arena, oldest first
-     * (reference: root-chunk LRU, uvm_pmm_gpu.c). */
-    struct UvmVaBlock *lruHead, *lruTail;
+    uint32_t lruShardCount;
+    _Atomic uint32_t victimCursor;    /* rotating scan start */
+    UvmTierLruShard lru[UVM_TIER_LRU_SHARDS];
 } UvmTierArena;
 
 /* --------------------------------------------------------------- blocks */

@@ -12,12 +12,22 @@
  *
  * Roots are materialized lazily so a 96 GB HBM arena costs no metadata
  * until used.
+ *
+ * LOCK STRIPING (the sharded-spine companion): the freelists are split
+ * across UvmPmmShard stripes, each root chunk owned by shard
+ * (rootIndex % shardCount).  Buddies never cross a 2 MB root
+ * (buddyOff = offset ^ size stays inside the root), so every merge is
+ * intra-shard and a chunk's shard is stable for its whole life.
+ * Allocation tries the caller's home stripe with a trylock (a miss
+ * counts tier_lock_contended) and walks the siblings before reporting
+ * exhaustion, so striping never manufactures NO_MEMORY.
  */
 #include "uvm_internal.h"
 #include "tpurm/trace.h"
 #include "tpurm/inject.h"
 
 #include <stdlib.h>
+#include <unistd.h>
 
 static uint64_t level_size(const UvmPmm *pmm, uint8_t level)
 {
@@ -36,22 +46,39 @@ static uint8_t size_to_level(const UvmPmm *pmm, uint64_t size)
     return level;
 }
 
-static void freelist_push(UvmPmm *pmm, UvmPmmChunk *c)
+static inline UvmPmmShard *pmm_shard_of(UvmPmm *pmm, uint64_t offset)
+{
+    return &pmm->shards[(offset / UVM_BLOCK_SIZE) % pmm->shardCount];
+}
+
+/* The caller's home stripe: sticky per thread, dealt round-robin — a
+ * stable home keeps one fault worker's splits and merges on one lock. */
+static uint32_t pmm_home_shard(const UvmPmm *pmm)
+{
+    static _Atomic uint32_t cursor;
+    static __thread uint32_t home = UINT32_MAX;
+    if (home == UINT32_MAX)
+        home = atomic_fetch_add_explicit(&cursor, 1,
+                                         memory_order_relaxed);
+    return home % pmm->shardCount;
+}
+
+static void freelist_push(UvmPmmShard *sh, UvmPmmChunk *c)
 {
     c->allocated = false;
     c->prev = NULL;
-    c->next = pmm->freelist[c->level];
+    c->next = sh->freelist[c->level];
     if (c->next)
         c->next->prev = c;
-    pmm->freelist[c->level] = c;
+    sh->freelist[c->level] = c;
 }
 
-static void freelist_unlink(UvmPmm *pmm, UvmPmmChunk *c)
+static void freelist_unlink(UvmPmmShard *sh, UvmPmmChunk *c)
 {
     if (c->prev)
         c->prev->next = c->next;
     else
-        pmm->freelist[c->level] = c->next;
+        sh->freelist[c->level] = c->next;
     if (c->next)
         c->next->prev = c->prev;
     c->prev = c->next = NULL;
@@ -63,7 +90,6 @@ TpuStatus uvmPmmInit(UvmPmm *pmm, uint64_t arenaSize, uint64_t chunkMin)
         (chunkMin & (chunkMin - 1)) != 0 || chunkMin > UVM_BLOCK_SIZE)
         return TPU_ERR_INVALID_ARGUMENT;
 
-    pthread_mutex_init(&pmm->lock, NULL);
     pmm->arenaSize = arenaSize & ~(UVM_BLOCK_SIZE - 1);
     pmm->chunkMin = chunkMin;
     pmm->levels = 1;
@@ -71,10 +97,26 @@ TpuStatus uvmPmmInit(UvmPmm *pmm, uint64_t arenaSize, uint64_t chunkMin)
         pmm->levels++;
     if (pmm->levels > UVM_PMM_MAX_LEVELS)
         return TPU_ERR_INVALID_ARGUMENT;
-    pmm->allocatedBytes = 0;
-    for (uint32_t i = 0; i < UVM_PMM_MAX_LEVELS; i++)
-        pmm->freelist[i] = NULL;
+    atomic_store_explicit(&pmm->allocatedBytes, 0, memory_order_relaxed);
     pmm->rootCount = pmm->arenaSize / UVM_BLOCK_SIZE;
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    if (ncpu < 1)
+        ncpu = 1;
+    uint64_t dflt = (uint64_t)ncpu < UVM_PMM_MAX_SHARDS ? (uint64_t)ncpu
+                                                        : UVM_PMM_MAX_SHARDS;
+    uint64_t shards = tpuRegistryGet("tier_lock_shards", dflt);
+    if (shards < 1)
+        shards = 1;
+    if (shards > UVM_PMM_MAX_SHARDS)
+        shards = UVM_PMM_MAX_SHARDS;
+    if (shards > pmm->rootCount)
+        shards = pmm->rootCount;      /* a stripe needs a root to own */
+    pmm->shardCount = (uint32_t)shards;
+    for (uint32_t s = 0; s < pmm->shardCount; s++) {
+        pthread_mutex_init(&pmm->shards[s].lock, NULL);
+        for (uint32_t i = 0; i < UVM_PMM_MAX_LEVELS; i++)
+            pmm->shards[s].freelist[i] = NULL;
+    }
     pmm->rootChunks = calloc(pmm->rootCount, sizeof(UvmPmmChunk *));
     if (!pmm->rootChunks)
         return TPU_ERR_NO_MEMORY;
@@ -85,26 +127,29 @@ void uvmPmmDeinit(UvmPmm *pmm)
 {
     /* Frees all chunk metadata; the caller guarantees no chunks are in
      * use.  Child chunks are reachable from freelists only. */
-    for (uint32_t lvl = 1; lvl < pmm->levels; lvl++) {
-        UvmPmmChunk *c = pmm->freelist[lvl];
-        while (c) {
-            UvmPmmChunk *next = c->next;
-            free(c);
-            c = next;
+    for (uint32_t s = 0; s < pmm->shardCount; s++) {
+        for (uint32_t lvl = 1; lvl < pmm->levels; lvl++) {
+            UvmPmmChunk *c = pmm->shards[s].freelist[lvl];
+            while (c) {
+                UvmPmmChunk *next = c->next;
+                free(c);
+                c = next;
+            }
+            pmm->shards[s].freelist[lvl] = NULL;
         }
-        pmm->freelist[lvl] = NULL;
+        pthread_mutex_destroy(&pmm->shards[s].lock);
     }
     for (uint64_t i = 0; i < pmm->rootCount; i++)
         free(pmm->rootChunks[i]);
     free(pmm->rootChunks);
     pmm->rootChunks = NULL;
-    pthread_mutex_destroy(&pmm->lock);
 }
 
-/* Materialize the next unused root chunk, if any. */
-static UvmPmmChunk *pmm_new_root(UvmPmm *pmm)
+/* Materialize the next unused root chunk OWNED BY `shard`, if any
+ * (that shard's lock held: root slot i belongs to shard i % count). */
+static UvmPmmChunk *pmm_new_root(UvmPmm *pmm, uint32_t shard)
 {
-    for (uint64_t i = 0; i < pmm->rootCount; i++) {
+    for (uint64_t i = shard; i < pmm->rootCount; i += pmm->shardCount) {
         if (!pmm->rootChunks[i]) {
             UvmPmmChunk *c = calloc(1, sizeof(*c));
             if (!c)
@@ -132,66 +177,88 @@ TpuStatus uvmPmmAlloc(UvmPmm *pmm, uint64_t size, UvmPmmChunk **out)
         return TPU_ERR_INSUFFICIENT_RESOURCES;
 
     uint64_t tSpan = tpurmTraceBegin();
-    pthread_mutex_lock(&pmm->lock);
-    tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "pmm");
     uint8_t want = size_to_level(pmm, size);
+    uint32_t home = pmm_home_shard(pmm);
 
-    /* Find the deepest level <= want with a free chunk, splitting down. */
-    int lvl = want;
-    UvmPmmChunk *c = NULL;
-    while (lvl >= 0) {
-        if (pmm->freelist[lvl]) {
-            c = pmm->freelist[lvl];
-            freelist_unlink(pmm, c);
-            break;
+    /* Home stripe first, then the siblings: striping must never turn a
+     * non-empty arena into NO_MEMORY. */
+    for (uint32_t k = 0; k < pmm->shardCount; k++) {
+        uint32_t si = (home + k) % pmm->shardCount;
+        UvmPmmShard *sh = &pmm->shards[si];
+        if (k == 0 && pthread_mutex_trylock(&sh->lock) != 0) {
+            tpuCounterAdd("tier_lock_contended", 1);
+            pthread_mutex_lock(&sh->lock);
+        } else if (k > 0) {
+            pthread_mutex_lock(&sh->lock);
         }
-        lvl--;
-    }
-    if (!c) {
-        c = pmm_new_root(pmm);
-        lvl = 0;
-    }
-    if (!c) {
-        tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "pmm");
-        pthread_mutex_unlock(&pmm->lock);
-        return TPU_ERR_NO_MEMORY;
-    }
+        tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "pmm");
 
-    /* Split down to the wanted level, pushing right buddies free. */
-    while ((uint8_t)lvl < want) {
-        UvmPmmChunk *right = calloc(1, sizeof(*right));
-        if (!right) {
-            freelist_push(pmm, c);
+        /* Find the deepest level <= want with a free chunk, splitting
+         * down. */
+        int lvl = want;
+        UvmPmmChunk *c = NULL;
+        while (lvl >= 0) {
+            if (sh->freelist[lvl]) {
+                c = sh->freelist[lvl];
+                freelist_unlink(sh, c);
+                break;
+            }
+            lvl--;
+        }
+        if (!c) {
+            c = pmm_new_root(pmm, si);
+            lvl = 0;
+        }
+        if (!c) {
+            /* This stripe is exhausted; try the next one. */
             tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "pmm");
-            pthread_mutex_unlock(&pmm->lock);
-            return TPU_ERR_NO_MEMORY;
+            pthread_mutex_unlock(&sh->lock);
+            continue;
         }
-        lvl++;
-        c->level = (uint8_t)lvl;
-        right->level = (uint8_t)lvl;
-        right->offset = c->offset + level_size(pmm, (uint8_t)lvl);
-        right->buddyParent = c->buddyParent;  /* same root lineage */
-        freelist_push(pmm, right);
-    }
 
-    c->allocated = true;
-    pmm->allocatedBytes += size;
-    tpuCounterAdd("pmm_chunk_allocs", 1);
-    tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "pmm");
-    pthread_mutex_unlock(&pmm->lock);
-    if (tSpan)
-        tpurmTraceEnd(TPU_TRACE_PMM_ALLOC, tSpan, c->offset, size);
-    *out = c;
-    return TPU_OK;
+        /* Split down to the wanted level, pushing right buddies free. */
+        while ((uint8_t)lvl < want) {
+            UvmPmmChunk *right = calloc(1, sizeof(*right));
+            if (!right) {
+                freelist_push(sh, c);
+                tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "pmm");
+                pthread_mutex_unlock(&sh->lock);
+                return TPU_ERR_NO_MEMORY;
+            }
+            lvl++;
+            c->level = (uint8_t)lvl;
+            right->level = (uint8_t)lvl;
+            right->offset = c->offset + level_size(pmm, (uint8_t)lvl);
+            right->buddyParent = c->buddyParent;  /* same root lineage */
+            freelist_push(sh, right);
+        }
+
+        c->allocated = true;
+        atomic_fetch_add_explicit(&pmm->allocatedBytes, size,
+                                  memory_order_relaxed);
+        tpuCounterAdd("pmm_chunk_allocs", 1);
+        tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "pmm");
+        pthread_mutex_unlock(&sh->lock);
+        if (tSpan)
+            tpurmTraceEnd(TPU_TRACE_PMM_ALLOC, tSpan, c->offset, size);
+        *out = c;
+        return TPU_OK;
+    }
+    return TPU_ERR_NO_MEMORY;
 }
 
 void uvmPmmFree(UvmPmm *pmm, UvmPmmChunk *chunk)
 {
     if (!chunk)
         return;
-    pthread_mutex_lock(&pmm->lock);
+    /* The chunk's stripe is derived from its offset — the same shard
+     * that allocated it, so merge candidates are all here. */
+    UvmPmmShard *sh = pmm_shard_of(pmm, chunk->offset);
+    pthread_mutex_lock(&sh->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "pmm");
-    pmm->allocatedBytes -= level_size(pmm, chunk->level);
+    atomic_fetch_sub_explicit(&pmm->allocatedBytes,
+                              level_size(pmm, chunk->level),
+                              memory_order_relaxed);
     tpuCounterAdd("pmm_chunk_frees", 1);
 
     /* Buddy merge: coalesce while the sibling chunk is free at the same
@@ -201,7 +268,7 @@ void uvmPmmFree(UvmPmm *pmm, UvmPmmChunk *chunk)
         uint64_t sz = level_size(pmm, c->level);
         uint64_t buddyOff = c->offset ^ sz;
         UvmPmmChunk *buddy = NULL;
-        for (UvmPmmChunk *f = pmm->freelist[c->level]; f; f = f->next) {
+        for (UvmPmmChunk *f = sh->freelist[c->level]; f; f = f->next) {
             if (f->offset == buddyOff) {
                 buddy = f;
                 break;
@@ -209,7 +276,7 @@ void uvmPmmFree(UvmPmm *pmm, UvmPmmChunk *chunk)
         }
         if (!buddy)
             break;
-        freelist_unlink(pmm, buddy);
+        freelist_unlink(sh, buddy);
         /* Keep the lower-offset chunk as the merged parent. */
         UvmPmmChunk *keep = c->offset < buddy->offset ? c : buddy;
         UvmPmmChunk *drop = keep == c ? buddy : c;
@@ -223,7 +290,8 @@ void uvmPmmFree(UvmPmm *pmm, UvmPmmChunk *chunk)
         c = keep;
     }
     if (c->level == 0) {
-        /* Fully merged root: return its slot so metadata stays bounded. */
+        /* Fully merged root: return its slot so metadata stays bounded
+         * (slot i is owned by this stripe: i % shardCount == stripe). */
         uint64_t slot = c->offset / UVM_BLOCK_SIZE;
         if (pmm->rootChunks[slot] == c) {
             pmm->rootChunks[slot] = NULL;
@@ -236,10 +304,10 @@ void uvmPmmFree(UvmPmm *pmm, UvmPmmChunk *chunk)
             free(c);
         }
     } else {
-        freelist_push(pmm, c);
+        freelist_push(sh, c);
     }
     tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "pmm");
-    pthread_mutex_unlock(&pmm->lock);
+    pthread_mutex_unlock(&sh->lock);
 }
 
 uint64_t uvmPmmChunkSize(const UvmPmm *pmm, const UvmPmmChunk *c)
@@ -249,8 +317,6 @@ uint64_t uvmPmmChunkSize(const UvmPmm *pmm, const UvmPmmChunk *c)
 
 uint64_t uvmPmmAllocatedBytes(UvmPmm *pmm)
 {
-    pthread_mutex_lock(&pmm->lock);
-    uint64_t b = pmm->allocatedBytes;
-    pthread_mutex_unlock(&pmm->lock);
-    return b;
+    return atomic_load_explicit(&pmm->allocatedBytes,
+                                memory_order_relaxed);
 }

@@ -21,6 +21,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <sys/mman.h>
+#include <unistd.h>
 
 #include <time.h>
 
@@ -61,13 +62,29 @@ uint32_t uvmPagesPerBlock(void)
 static TpuStatus arena_init(UvmTierArena *a, UvmTier tier, uint32_t devInst,
                             void *base, uint64_t size)
 {
-    pthread_mutex_init(&a->lock, NULL);
-    pthread_cond_init(&a->evictCond, NULL);
     a->tier = tier;
     a->devInst = devInst;
     a->base = base;
     a->size = size;
-    a->lruHead = a->lruTail = NULL;
+    /* LRU lock stripes share the PMM's knob: one "tier_lock_shards"
+     * governs both halves of the tier locking. */
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    if (ncpu < 1)
+        ncpu = 1;
+    uint64_t dflt = (uint64_t)ncpu < UVM_TIER_LRU_SHARDS
+                        ? (uint64_t)ncpu : UVM_TIER_LRU_SHARDS;
+    uint64_t shards = tpuRegistryGet("tier_lock_shards", dflt);
+    if (shards < 1)
+        shards = 1;
+    if (shards > UVM_TIER_LRU_SHARDS)
+        shards = UVM_TIER_LRU_SHARDS;
+    a->lruShardCount = (uint32_t)shards;
+    atomic_store_explicit(&a->victimCursor, 0, memory_order_relaxed);
+    for (uint32_t s = 0; s < a->lruShardCount; s++) {
+        pthread_mutex_init(&a->lru[s].lock, NULL);
+        pthread_cond_init(&a->lru[s].evictCond, NULL);
+        a->lru[s].lruHead = a->lru[s].lruTail = NULL;
+    }
     return uvmPmmInit(&a->pmm, size, uvmPageSize());
 }
 
@@ -191,63 +208,91 @@ static int lru_index(const UvmTierArena *a)
     return a->tier == UVM_TIER_CXL ? 1 : 0;
 }
 
+/* A block's LRU stripe is keyed by its VA block index — stable for the
+ * block's life, so Touch/Remove/EvictDone/AwaitEvictors always meet on
+ * the same lock and cond. */
+static inline UvmTierLruShard *lru_shard_of(UvmTierArena *a,
+                                            const UvmVaBlock *blk)
+{
+    return &a->lru[(blk->start / UVM_BLOCK_SIZE) % a->lruShardCount];
+}
+
 void uvmLruTouch(UvmTierArena *a, UvmVaBlock *blk)
 {
     int ix = lru_index(a);
-    pthread_mutex_lock(&a->lock);
+    UvmTierLruShard *sh = lru_shard_of(a, blk);
+    /* The fault-path hot producer: trylock first so stripe contention
+     * is measurable (the shards exist to keep this ~0). */
+    if (pthread_mutex_trylock(&sh->lock) != 0) {
+        tpuCounterAdd("tier_lock_contended", 1);
+        pthread_mutex_lock(&sh->lock);
+    }
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
     if (blk->lru[ix].on) {
         /* unlink */
         if (blk->lru[ix].prev)
             blk->lru[ix].prev->lru[ix].next = blk->lru[ix].next;
         else
-            a->lruHead = blk->lru[ix].next;
+            sh->lruHead = blk->lru[ix].next;
         if (blk->lru[ix].next)
             blk->lru[ix].next->lru[ix].prev = blk->lru[ix].prev;
         else
-            a->lruTail = blk->lru[ix].prev;
+            sh->lruTail = blk->lru[ix].prev;
     }
     /* append at tail (most recently used) */
-    blk->lru[ix].prev = a->lruTail;
+    blk->lru[ix].prev = sh->lruTail;
     blk->lru[ix].next = NULL;
-    if (a->lruTail)
-        a->lruTail->lru[ix].next = blk;
+    if (sh->lruTail)
+        sh->lruTail->lru[ix].next = blk;
     else
-        a->lruHead = blk;
-    a->lruTail = blk;
+        sh->lruHead = blk;
+    sh->lruTail = blk;
     blk->lru[ix].on = true;
     tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
-    pthread_mutex_unlock(&a->lock);
+    pthread_mutex_unlock(&sh->lock);
 }
 
 void uvmLruRemove(UvmTierArena *a, UvmVaBlock *blk)
 {
     int ix = lru_index(a);
-    pthread_mutex_lock(&a->lock);
+    UvmTierLruShard *sh = lru_shard_of(a, blk);
+    pthread_mutex_lock(&sh->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
     if (blk->lru[ix].on) {
         if (blk->lru[ix].prev)
             blk->lru[ix].prev->lru[ix].next = blk->lru[ix].next;
         else
-            a->lruHead = blk->lru[ix].next;
+            sh->lruHead = blk->lru[ix].next;
         if (blk->lru[ix].next)
             blk->lru[ix].next->lru[ix].prev = blk->lru[ix].prev;
         else
-            a->lruTail = blk->lru[ix].prev;
+            sh->lruTail = blk->lru[ix].prev;
         blk->lru[ix].on = false;
         blk->lru[ix].prev = blk->lru[ix].next = NULL;
     }
     tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
-    pthread_mutex_unlock(&a->lock);
+    pthread_mutex_unlock(&sh->lock);
 }
 
 UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
 {
     int ix = lru_index(a);
     uint64_t now = uvmMonotonicNs();
-    pthread_mutex_lock(&a->lock);
+    /* Victim scans walk the stripes round-robin from a rotating cursor
+     * (concurrent evictors fan out instead of piling on one stripe).
+     * Victim ORDER is per-stripe: the selection policy below — pin
+     * skip, tpuhot coldness, tenant SLO classes — runs within one
+     * stripe's list at a time, so cross-stripe ordering is approximate
+     * (the reference's per-GPU root-chunk lists have the same shape).
+     * With tier_lock_shards=1 the historical global order is exact. */
+    uint32_t start = atomic_fetch_add_explicit(&a->victimCursor, 1,
+                                               memory_order_relaxed);
+    UvmVaBlock *blk = NULL;
+    for (uint32_t k = 0; k < a->lruShardCount && !blk; k++) {
+    UvmTierLruShard *sh = &a->lru[(start + k) % a->lruShardCount];
+    pthread_mutex_lock(&sh->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
-    UvmVaBlock *blk = a->lruHead;
+    blk = sh->lruHead;
     while (blk) {
         /* Skip the allocating block itself, blocks pinned to this tier
          * by thrashing mitigation (uvm_perf_thrashing.h PIN hint), and
@@ -378,38 +423,43 @@ UvmVaBlock *uvmLruPopVictim(UvmTierArena *a, UvmVaBlock *exclude)
         if (blk->lru[ix].prev)
             blk->lru[ix].prev->lru[ix].next = blk->lru[ix].next;
         else
-            a->lruHead = blk->lru[ix].next;
+            sh->lruHead = blk->lru[ix].next;
         if (blk->lru[ix].next)
             blk->lru[ix].next->lru[ix].prev = blk->lru[ix].prev;
         else
-            a->lruTail = blk->lru[ix].prev;
+            sh->lruTail = blk->lru[ix].prev;
         blk->lru[ix].on = false;
         blk->lru[ix].prev = blk->lru[ix].next = NULL;
         blk->lru[ix].evicting = true;   /* lifetime guard for the caller */
     }
     tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
-    pthread_mutex_unlock(&a->lock);
+    pthread_mutex_unlock(&sh->lock);
+    }
     return blk;
 }
 
 void uvmLruEvictDone(UvmTierArena *a, UvmVaBlock *blk)
 {
     int ix = lru_index(a);
-    pthread_mutex_lock(&a->lock);
+    /* blk->start is immutable, so the evicting flag and its waiters
+     * always meet on the same stripe's lock + condvar. */
+    UvmTierLruShard *sh = lru_shard_of(a, blk);
+    pthread_mutex_lock(&sh->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
     blk->lru[ix].evicting = false;
-    pthread_cond_broadcast(&a->evictCond);
+    pthread_cond_broadcast(&sh->evictCond);
     tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
-    pthread_mutex_unlock(&a->lock);
+    pthread_mutex_unlock(&sh->lock);
 }
 
 void uvmLruAwaitEvictors(UvmTierArena *a, UvmVaBlock *blk)
 {
     int ix = lru_index(a);
-    pthread_mutex_lock(&a->lock);
+    UvmTierLruShard *sh = lru_shard_of(a, blk);
+    pthread_mutex_lock(&sh->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_PMM, "arena-lru");
     while (blk->lru[ix].evicting)
-        pthread_cond_wait(&a->evictCond, &a->lock);
+        pthread_cond_wait(&sh->evictCond, &sh->lock);
     tpuLockTrackRelease(TPU_LOCK_UVM_PMM, "arena-lru");
-    pthread_mutex_unlock(&a->lock);
+    pthread_mutex_unlock(&sh->lock);
 }

@@ -1060,8 +1060,185 @@ static int test_sqpoll(void)
     return 0;
 }
 
+/* ------------------------------------------------ sharded spine */
+
+/* Shard directory accessors (internal.h; tests/bench only — raw ring
+ * access from subsystems is a check-spine violation). */
+uint32_t tpurmMemringInternalShards(void);
+TpuMemring *tpurmMemringInternalShardRing(uint32_t shard);
+TpuStatus tpurmMemringParkAll(uint64_t timeoutNs);
+void tpurmMemringUnparkAll(void);
+
+static int poll_completed(TpuMemring *r, uint64_t want)
+{
+    for (int i = 0; i < 5000; i++) {
+        uint64_t sub, comp, errs, ovf;
+        tpurmMemringCounts(r, &sub, &comp, &errs, &ovf);
+        if (comp >= want)
+            return 0;
+        struct timespec ts = { .tv_sec = 0, .tv_nsec = 1000 * 1000 };
+        nanosleep(&ts, NULL);
+    }
+    return 1;
+}
+
+/* Producer batches hash to shards by VA block; the per-shard scoped
+ * counters sum EXACTLY to the aggregate, and the sharded accounting
+ * invariant (internal == shard-routed + inline-degraded) holds. */
+static int test_shard_spread_and_invariant(void)
+{
+    uint32_t shards = tpurmMemringInternalShards();
+    CHECK(shards == 4);   /* main() pinned TPUMEM_MEMRING_INTERNAL_SHARDS */
+    for (uint32_t s = 0; s < shards; s++)
+        CHECK(tpurmMemringInternalShardRing(s) != NULL);
+
+    uint64_t before[8] = { 0 };
+    char scoped[48];
+    for (uint32_t s = 0; s < shards; s++) {
+        snprintf(scoped, sizeof(scoped), "memring_shard_sqes[s%u]", s);
+        before[s] = tpurmCounterGet(scoped);
+    }
+    uint64_t aggBefore = tpurmCounterGet("memring_shard_sqes");
+
+    /* 32 distinct 2MB VA blocks: the Fibonacci shard hash must spread
+     * them (NOP exec ignores addr; only routing reads it). */
+    for (uint64_t i = 0; i < 32; i++) {
+        TpuMemringSqe s = sqe_nop(7000 + i);
+        s.addr = (i + 1) << 21;
+        TpuStatus st = (TpuStatus)~0u;
+        CHECK(tpurmMemringSubmitInternal(NULL, &s, 1, &st,
+                                         TPU_MEMRING_SUBSYS_MIGRATE) ==
+              TPU_OK);
+        CHECK(st == TPU_OK);
+    }
+
+    uint64_t perShardSum = 0;
+    uint32_t shardsHit = 0;
+    for (uint32_t s = 0; s < shards; s++) {
+        snprintf(scoped, sizeof(scoped), "memring_shard_sqes[s%u]", s);
+        uint64_t delta = tpurmCounterGet(scoped) - before[s];
+        perShardSum += delta;
+        if (delta)
+            shardsHit++;
+    }
+    CHECK(perShardSum == tpurmCounterGet("memring_shard_sqes") - aggBefore);
+    CHECK(shardsHit >= 2);   /* distinct VA blocks spread across shards */
+
+    /* Aggregate accounting invariant, exact over the whole run. */
+    CHECK(tpurmCounterGet("memring_internal_sqes") ==
+          tpurmCounterGet("memring_shard_sqes") +
+          tpurmCounterGet("memring_internal_inline"));
+    return 0;
+}
+
+/* Cross-SHARD deps are just PR-11 cross-ring deps: a dep handle
+ * encodes (ring id, seq), so an op on shard A waiting on shard B's
+ * retirement frontier blocks until B's worker retires, then runs —
+ * no shard-local knowledge needed. */
+static int test_shard_cross_dep(void)
+{
+    TpuMemring *ra = tpurmMemringInternalShardRing(0);
+    TpuMemring *rb = tpurmMemringInternalShardRing(1);
+    CHECK(ra && rb && ra != rb);
+    uint64_t subA, compA0, errs, ovf;
+    tpurmMemringCounts(ra, &subA, &compA0, &errs, &ovf);
+
+    /* Slow op on shard B; dependent op on shard A. */
+    uint64_t seqB = tpurmMemringNextSeq(rb);
+    TpuMemringSqe slow = sqe_nop_delay(8001, 300ull * 1000000ull);
+    CHECK(tpurmMemringPrep(rb, &slow) == TPU_OK);
+    TpuMemringSqe dep = sqe_nop_delay(8002, 0);
+    CHECK(tpurmMemringSqeDep(&dep, TPU_MEMRING_DEP(tpurmMemringId(rb),
+                                                   seqB)) == TPU_OK);
+    CHECK(tpurmMemringPrep(ra, &dep) == TPU_OK);
+    CHECK(tpurmMemringSubmit(ra) == 1);
+
+    /* Not runnable while B's delay holds the frontier... */
+    struct timespec ts = { .tv_sec = 0, .tv_nsec = 50 * 1000 * 1000 };
+    nanosleep(&ts, NULL);
+    uint64_t compA;
+    tpurmMemringCounts(ra, &subA, &compA, &errs, &ovf);
+    CHECK(compA == compA0);
+
+    /* ...and retires promptly once B publishes retirement (the
+     * cross-shard doorbell wakes A's blocked worker). */
+    CHECK(tpurmMemringSubmit(rb) == 1);
+    CHECK(poll_completed(ra, compA0 + 1) == 0);
+    CHECK(poll_completed(rb, 1) == 0);
+    return 0;
+}
+
+/* Work stealing: ops published to a worker-LESS shard (2 workers over
+ * 4 shards leave shards 2 and 3 bare) still execute — an idle sibling
+ * worker claims them cross-shard, and the steal counter proves the
+ * path taken. */
+static int test_shard_steal(void)
+{
+    TpuMemring *rc = tpurmMemringInternalShardRing(2);
+    CHECK(rc != NULL);
+    uint64_t sub, comp0, errs, ovf;
+    tpurmMemringCounts(rc, &sub, &comp0, &errs, &ovf);
+    uint64_t stealsBefore = tpurmCounterGet("memring_steals");
+
+    for (int i = 0; i < 8; i++) {
+        TpuMemringSqe s = sqe_nop_delay(8100 + i, 2ull * 1000000ull);
+        CHECK(tpurmMemringPrep(rc, &s) == TPU_OK);
+    }
+    CHECK(tpurmMemringSubmit(rc) == 8);
+    CHECK(poll_completed(rc, comp0 + 8) == 0);
+    /* One steal may drain several claims; >= 1 proves the path. */
+    CHECK(tpurmCounterGet("memring_steals") > stealsBefore);
+    return 0;
+}
+
+/* Park/reset with every shard mid-claim: ParkAll must barrier ALL
+ * shard producer locks, sweep ALL shards' queued work inline, and
+ * resume cleanly after unpark — then the accounting invariant still
+ * holds exactly. */
+static int test_shard_park_reset(void)
+{
+    uint32_t shards = tpurmMemringInternalShards();
+    uint64_t comp0[8] = { 0 };
+    uint64_t subs[8] = { 0 };
+    for (uint32_t s = 0; s < shards; s++) {
+        TpuMemring *r = tpurmMemringInternalShardRing(s);
+        uint64_t errs, ovf;
+        tpurmMemringCounts(r, &subs[s], &comp0[s], &errs, &ovf);
+        for (int i = 0; i < 3; i++) {
+            TpuMemringSqe q = sqe_nop_delay(8200 + s * 8 + i,
+                                            20ull * 1000000ull);
+            CHECK(tpurmMemringPrep(r, &q) == TPU_OK);
+        }
+        CHECK(tpurmMemringSubmit(r) == 3);
+    }
+
+    /* Park sweeps the queued delays on every shard to the retirement
+     * frontier (workers quiesce, the sweeper claims the rest). */
+    CHECK(tpurmMemringParkAll(5ull * 1000000000ull) == TPU_OK);
+    for (uint32_t s = 0; s < shards; s++) {
+        TpuMemring *r = tpurmMemringInternalShardRing(s);
+        uint64_t sub, comp, errs, ovf;
+        tpurmMemringCounts(r, &sub, &comp, &errs, &ovf);
+        CHECK(comp == comp0[s] + 3);
+    }
+    tpurmMemringUnparkAll();
+
+    /* Spine resumes: routed traffic flows and accounting stays exact. */
+    TpuMemringSqe s = sqe_nop(8300);
+    s.addr = 99ull << 21;
+    TpuStatus st = (TpuStatus)~0u;
+    CHECK(tpurmMemringSubmitInternal(NULL, &s, 1, &st,
+                                     TPU_MEMRING_SUBSYS_MIGRATE) == TPU_OK);
+    CHECK(st == TPU_OK);
+    CHECK(tpurmCounterGet("memring_internal_sqes") ==
+          tpurmCounterGet("memring_shard_sqes") +
+          tpurmCounterGet("memring_internal_inline"));
+    return 0;
+}
+
 /* The chaos-soak spine invariant, asserted over this whole run:
- * every internal submission is subsystem-attributed. */
+ * every internal submission is subsystem-attributed, and every one
+ * either rode a shard ring or took the inline degrade path. */
 static int check_spine_invariant(void)
 {
     uint64_t total = tpurmCounterGet("memring_internal_sqes");
@@ -1071,6 +1248,8 @@ static int check_spine_invariant(void)
                      tpurmCounterGet("memring_internal_sqes[migrate]");
     CHECK(total > 0);
     CHECK(total == parts);
+    CHECK(total == tpurmCounterGet("memring_shard_sqes") +
+                   tpurmCounterGet("memring_internal_inline"));
     return 0;
 }
 
@@ -1079,6 +1258,12 @@ int main(void)
     /* Two fake devices so PEER_COPY has a real peer (set before any
      * engine touch initializes the device table). */
     setenv("TPUMEM_FAKE_TPU_COUNT", "2", 0);
+    /* Sharded spine under test: 4 internal shards, 2 workers — shards
+     * 0/1 get a worker each, shards 2/3 are bare so queued work there
+     * is reachable ONLY by stealing (set before the pthread_once that
+     * builds the shard directory fires). */
+    setenv("TPUMEM_MEMRING_INTERNAL_SHARDS", "4", 0);
+    setenv("TPUMEM_MEMRING_INTERNAL_WORKERS", "2", 0);
     if (test_wrap_and_backpressure())
         return 1;
     if (test_dep_ooo_retirement())
@@ -1110,6 +1295,14 @@ int main(void)
     if (test_internal_submit())
         return 1;
     if (test_fused_evict_migrate())
+        return 1;
+    if (test_shard_spread_and_invariant())
+        return 1;
+    if (test_shard_cross_dep())
+        return 1;
+    if (test_shard_steal())
+        return 1;
+    if (test_shard_park_reset())
         return 1;
     if (test_sqpoll())
         return 1;

@@ -551,6 +551,12 @@ out["spine"] = {
     "ici": utils.counter("memring_internal_sqes[ici]"),
     "migrate": utils.counter("memring_internal_sqes[migrate]"),
     "inline": utils.counter("memring_internal_inline"),
+    "shard": utils.counter("memring_shard_sqes"),
+    "per_shard": [utils.counter("memring_shard_sqes[s%%d]" %% s)
+                  for s in range(2)],
+    "steals": utils.counter("memring_steals"),
+    "prod_contended": utils.counter("memring_prod_contended"),
+    "tier_lock_contended": utils.counter("tier_lock_contended"),
 }
 
 # tpushield reconciliation (14th site, mem.corrupt — the first site
@@ -786,6 +792,12 @@ out["spine"] = {
     "tier": _utils.counter("memring_internal_sqes[tier]"),
     "ici": _utils.counter("memring_internal_sqes[ici]"),
     "migrate": _utils.counter("memring_internal_sqes[migrate]"),
+    "inline": _utils.counter("memring_internal_inline"),
+    "shard": _utils.counter("memring_shard_sqes"),
+    "per_shard": [_utils.counter("memring_shard_sqes[s%%d]" %% s)
+                  for s in range(2)],
+    "steals": _utils.counter("memring_steals"),
+    "prod_contended": _utils.counter("memring_prod_contended"),
 }
 # tpushield reconciliation (14th site): run_once closed the scheduler,
 # which freed the KV backing and drained every still-sealed page
@@ -826,6 +838,9 @@ def test_sched_soak_injection(tmp_path):
     env.setdefault("TPUMEM_FAKE_TPU_COUNT", "2")
     env.setdefault("TPUMEM_FAKE_HBM_MB", "128")
     env["TPUMEM_DUMP_DIR"] = str(tmp_path)
+    # Chaos rides the SHARDED spine: >= 2 internal rings so cross-shard
+    # deps, stealing, and the per-shard accounting run under injection.
+    env["TPUMEM_MEMRING_INTERNAL_SHARDS"] = "2"
     script = _SCHED_SOAK % {"repo": _REPO}
     proc = subprocess.run([sys.executable, "-c", script], env=env,
                           capture_output=True, text=True, timeout=600)
@@ -893,6 +908,10 @@ def test_sched_soak_injection(tmp_path):
     assert sp["internal_sqes"] == (sp["fault"] + sp["tier"] +
                                    sp["ici"] + sp["migrate"]), sp
     assert sp["fault"] > 0, sp
+    # Sharded-spine accounting held through the scheduler's chaos too:
+    # per-shard sums exact, and shard-routed + inline == total.
+    assert sum(sp["per_shard"]) == sp["shard"], sp
+    assert sp["internal_sqes"] == sp["shard"] + sp["inline"], sp
 
     # 12th site (vac.migrate) was armed with the rest; the managed
     # backing runs no chip migrations, so its exact reconciliation
@@ -1083,6 +1102,9 @@ def test_engine_soak_injection(tmp_path):
     # Rings sized so the 4-second chaos window fits without wrap: the
     # exact hit<->event reconciliation below needs a lossless record.
     env.setdefault("TPUMEM_TRACE_RING", str(1 << 17))
+    # Chaos rides the SHARDED spine: >= 2 internal rings so cross-shard
+    # deps, stealing, and the per-shard accounting run under injection.
+    env["TPUMEM_MEMRING_INTERNAL_SHARDS"] = "2"
     script = _INJECT_SOAK % {"repo": _REPO}
     proc = subprocess.run([sys.executable, "-c", script], env=env,
                           capture_output=True, text=True, timeout=300)
@@ -1170,6 +1192,13 @@ def test_engine_soak_injection(tmp_path):
                                    sp["ici"] + sp["migrate"]), sp
     assert sp["fault"] > 0 and sp["migrate"] > 0, sp
     assert sp["ici"] > 0, sp
+    # Sharded-spine accounting, EXACT per shard AND in aggregate: the
+    # per-shard scoped counters sum to the shard total, and every
+    # internal SQE either rode a shard ring or took the inline degrade
+    # path — chaos across all 15 sites must not leak an SQE between
+    # shards.
+    assert sum(sp["per_shard"]) == sp["shard"], sp
+    assert sp["internal_sqes"] == sp["shard"] + sp["inline"], sp
 
     # vac.migrate (12th site) reconciliation: armed alongside the rest
     # for the whole window, zero evaluations in this actor mix — the

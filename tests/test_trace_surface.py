@@ -50,15 +50,17 @@ METRICS_INVENTORY = [
     "memring_internal_inline", "memring_internal_sqes",
     "memring_internal_submits", "memring_links_cancelled",
     "memring_ooo_retires", "memring_ops", "memring_park_timeouts",
-    "memring_retries", "memring_rings_created", "memring_sqes",
+    "memring_prod_contended",
+    "memring_retries", "memring_rings_created", "memring_shard_sqes",
+    "memring_sqes",
     "memring_sqpoll_polls", "memring_sqpoll_sleeps",
-    "memring_stale_completions", "memring_submits",
+    "memring_stale_completions", "memring_steals", "memring_submits",
     "memring_tier_evict_runs", "peermem_dma_maps", "peermem_get_pages",
     "peermem_put_pages", "peermem_revocations", "pmm_chunk_allocs",
     "pmm_chunk_frees", "rc_auto_resets", "rc_device_escalations",
     "rc_nonreplayable_faults", "rc_shadow_overflows",
     "rc_watchdog_timeouts", "rdma_mrs_revalidated",
-    "tier_hot_victim_reorders",
+    "tier_hot_victim_reorders", "tier_lock_contended",
     "rdma_reset_revocations", "recover_copy_retries",
     "recover_fault_retries", "recover_link_retrains",
     "recover_msgq_retries", "recover_page_quarantines",
@@ -77,6 +79,7 @@ METRICS_INVENTORY = [
     "tpuce_inject_retries", "tpuce_lossless_fallbacks",
     "tpuce_ooo_completions", "tpuce_retries", "tpuce_stale_completions",
     "tpuce_stripe_errors", "tpuce_stripe_splits", "tpurm_counter",
+    "tpurm_cpu_pins",
     "tpurm_device_generation", "tpurm_device_health",
     "tpurm_device_health_score", "tpurm_flow_drops",
     "tpurm_flow_drops_total", "tpurm_flow_unmatched_total",
