@@ -1631,6 +1631,102 @@ def measure_vac_migration(streams: int = 12, evacs: int = 3) -> dict:
     }
 
 
+def measure_disagg(streams: int = 12) -> dict:
+    """tpusplit disaggregation series: the same workload A/B'd between
+    a co-located layout (prefill and decode share every chip's HBM)
+    and a prefill/decode split (prefill on chip 0, KV shipped to
+    decode homes 1-3 as vac manifest transactions).  Records the
+    throughput ratio, the KV-ship latency distribution, and — because
+    each ship rides the REQUEST's tpuflow id — the per-tenant `ici`
+    blame that makes disaggregation's tax attributable per token.
+    Needs TPUMEM_FAKE_TPU_COUNT=4 before the native lib loads, so
+    main() always runs it through _measure_isolated."""
+    os.environ.setdefault("TPUMEM_FAKE_TPU_COUNT", "4")
+    os.environ.setdefault("TPUMEM_FAKE_HBM_MB", "64")
+    import numpy as np
+    import jax
+    from open_gpu_kernel_modules_tpu.models import llama, multichip
+    from open_gpu_kernel_modules_tpu.runtime import native as _native
+    from open_gpu_kernel_modules_tpu.runtime import sched as tpusched
+    from open_gpu_kernel_modules_tpu.runtime import tpusplit
+    from open_gpu_kernel_modules_tpu import utils
+
+    if _native.load().tpurmDeviceCount() < 4:
+        return {"disagg_skipped": "needs TPUMEM_FAKE_TPU_COUNT=4 "
+                                  "before lib load (run isolated)"}
+
+    cfg = llama.LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=8, num_kv_heads=8, head_dim=32,
+        max_seq_len=512)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt_len, max_new, tpr = 112, 48, 8
+
+    def one_pass(disagg):
+        # tpuflow isolation per pass: the per-tenant SLO/blame
+        # histograms are process-global, so each pass reads its own
+        # ici ledger.
+        utils.flow_reset()
+        rng = np.random.default_rng(11)     # identical workload per pass
+        cache = multichip.make_multichip_cache(
+            cfg, batch=16, max_len=256, page_size=64, oversub=2,
+            n_devices=4)
+        s = tpusched.Scheduler(cfg, params, max_seqs=16, max_len=256,
+                               page_size=64, oversub=2,
+                               tokens_per_round=tpr, cache=cache,
+                               disagg=disagg)
+        for i in range(streams):
+            s.submit(rng.integers(0, cfg.vocab_size, size=prompt_len),
+                     max_new_tokens=max_new, tenant=1 + (i % 2))
+        rounds = 0
+        wall0 = time.perf_counter()
+        while not s.idle and rounds < 20000:
+            s.step()
+            rounds += 1
+        wall = time.perf_counter() - wall0
+        toks = sum(min(r.decoded, r.max_new_tokens)
+                   for r in s._by_rid.values()
+                   if r.state is tpusched.RequestState.FINISHED)
+        stats = dict(s.stats)
+        rep = s.report(wall)
+        ship_ms = [1e3 * x for x in s.disagg_ship_s]
+        s.close()
+        return toks / wall if wall else 0.0, stats, rep, ship_ms
+
+    d = tpusplit.DisaggConfig(decode_devs=(1, 2, 3))
+    one_pass(None)                               # compile warmup
+    co_tps, _, co_rep, _ = one_pass(None)
+    dis_tps, stats, rep, ship_ms = one_pass(d)
+
+    def ici_by_tenant(report):
+        return {t: v["blame_ms"]["ici"]
+                for t, v in report.get("slo", {}).items()}
+
+    return {
+        "disagg_colocated_toks_per_s": round(co_tps, 2),
+        "disagg_toks_per_s": round(dis_tps, 2),
+        # The headline ratio: what the split costs (or buys) against
+        # co-location on this 4-fake-chip rig.
+        "disagg_vs_colocated_frac": round(
+            dis_tps / co_tps, 3) if co_tps else 0.0,
+        "disagg_ships": stats["disagg_ships"],
+        "disagg_ship_aborts": stats["disagg_ship_aborts"],
+        "disagg_reclaims": stats["disagg_reclaims"],
+        "disagg_pages_shipped": stats["disagg_pages_shipped"],
+        "disagg_ship_ms_p50": round(float(
+            np.percentile(ship_ms, 50)), 3) if ship_ms else 0.0,
+        "disagg_ship_ms_p99": round(float(
+            np.percentile(ship_ms, 99)), 3) if ship_ms else 0.0,
+        # Ship cost lands in the owning request's flow, so the ici
+        # bucket decomposes per tenant — co-located baseline alongside
+        # for the delta.
+        "disagg_ici_blame_ms": ici_by_tenant(rep),
+        "disagg_colocated_ici_blame_ms": ici_by_tenant(co_rep),
+        "disagg_vac_commits": utils.counter("vac_commits"),
+        "disagg_vac_aborts": utils.counter("vac_aborts"),
+    }
+
+
 _THRASH_STORM = r"""
 import json
 import sys
@@ -2386,6 +2482,14 @@ def main() -> None:
                 measure_vac_migration, "vac"))
         except Exception as exc:
             extra["vac_error"] = str(exc)[:200]
+        # tpusplit disaggregation A/B: same isolation story as vac —
+        # the 4-fake-chip pool must exist before the native lib loads.
+        try:
+            extra.update(_measure_isolated(
+                "measure_disagg", 900,
+                measure_disagg, "disagg"))
+        except Exception as exc:
+            extra["disagg_error"] = str(exc)[:200]
 
     # tpuhot thrash storm: jax-free and self-isolating (each A/B arm
     # is its own subprocess with a small fake arena), so it runs

@@ -93,7 +93,13 @@ typedef enum {
                                     * a1 = subsys packed as <=8 chars   */
     TPU_JREC_DUMP = 24,            /* bundle written: a0 = reason packed
                                     * <=8 chars, a1 = 1 ok / 0 truncated*/
-    TPU_JREC_TYPE_COUNT = 25
+    TPU_JREC_CRC_SELFTEST = 25,    /* HW CRC32C mismatch vs table at
+                                    * dispatch: a0 = hw crc, a1 = want  */
+    TPU_JREC_TIER_REMOTE = 26,     /* REMOTE-tier lease event: a0=pages
+                                    * (or leases), a1 = op (0 demote,
+                                    * 1 demote-fail, 2 revoke, 3 fence
+                                    * abort); dev = lender              */
+    TPU_JREC_TYPE_COUNT = 27
 } TpuJournalRecType;
 
 /* One journal record — 64 bytes, the stable on-disk/in-mmap ABI.

@@ -93,6 +93,13 @@ bool tpurmShieldEnabled(void);
 uint32_t tpurmShieldCrc32c(const void *data, uint64_t len);
 uint32_t tpurmShieldCrc32cExtend(uint32_t crc, const void *data,
                                  uint64_t len);
+/* At-load self-test of the CRC dispatch: SW table and (when present)
+ * the HW instruction path are verified against the canonical
+ * CRC32C("123456789") vector; a HW mismatch journals (shield.selftest)
+ * and falls the dispatch back to the table.  Runs automatically in the
+ * library constructor; re-callable from tests.  Returns whether the
+ * dispatched path verified. */
+bool tpurmShieldCrcSelftest(void);
 
 void tpurmShieldStatsGet(TpuShieldStats *out);
 void tpurmShieldStatsReset(void);   /* tests */

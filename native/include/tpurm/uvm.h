@@ -200,6 +200,8 @@ typedef struct {
     uint32_t residentHost;
     uint32_t residentHbm;
     uint32_t residentCxl;
+    uint32_t residentRemote;  /* replica leased on a lender chip's HBM */
+    uint32_t remoteLenderInst;
     uint32_t hbmDeviceInst;
     uint32_t cpuMapped;       /* host PTE currently valid (RW) */
     uint32_t pinnedTier;      /* thrashing pin, (uint32_t)-1 if none */
@@ -307,13 +309,19 @@ typedef struct {
 
 typedef struct UvmVaSpace UvmVaSpace;
 
-/* Memory tiers.  Mirrors TpuAperture order (internal.h) so values convert
- * 1:1; HBM is per-device, HOST/CXL are global. */
+/* Memory tiers.  HOST/HBM/CXL mirror TpuAperture order (internal.h) so
+ * those values convert 1:1; HBM is per-device, HOST/CXL are global.
+ * REMOTE is the far rung BELOW local HBM: a lease on a healthy lender
+ * chip's HBM arena holding a write-through REPLICA of the HOST copy
+ * (tpusplit).  It has no aperture of its own — all data movement is
+ * PEER_COPY SQEs on the submission spine — and never converts to a
+ * TpuAperture. */
 typedef enum {
     UVM_TIER_HOST = 0,
     UVM_TIER_HBM  = 1,
     UVM_TIER_CXL  = 2,
-    UVM_TIER_COUNT = 3,
+    UVM_TIER_REMOTE = 3,
+    UVM_TIER_COUNT = 4,
 } UvmTier;
 
 typedef struct {
@@ -414,6 +422,9 @@ typedef struct {
     /* Arena offset of the page's HBM backing (valid when residentHbm):
      * lets real-arena clients address the same bytes on-chip. */
     uint64_t hbmOffset;
+    /* REMOTE tier: page has a leased replica in a lender chip's HBM. */
+    uint8_t residentRemote;
+    uint32_t remoteLenderInst;    /* valid when residentRemote */
 } UvmResidencyInfo;
 TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out);
 
@@ -506,9 +517,31 @@ TpuStatus uvmHbmChunkAlloc(uint32_t devInst, uint64_t size,
                            uint64_t *outOffset, void **outHandle);
 TpuStatus uvmHbmChunkFree(uint32_t devInst, void *handle);
 /* Arena occupancy: free/total bytes of a device's HBM tier PMM (tpuvac
- * evacuation-target headroom; capacity dashboards). */
+ * evacuation-target headroom; capacity dashboards).  Bytes the device
+ * has LENT to peers' REMOTE tiers are excluded from `used` — borrowed
+ * pages are reclaimable on demand (lease drop falls back to HOST), so
+ * counting them would double-charge the lender in vac target picking. */
 TpuStatus uvmHbmArenaUsage(uint32_t devInst, uint64_t *freeBytes,
                            uint64_t *totalBytes);
+
+/* ------------------------------------------------- REMOTE tier (tpusplit)
+ *
+ * A neighbor chip's HBM as another chip's far memory.  Gated by the
+ * registry knob "remote_tier" (default off); lenders are picked by the
+ * tpuvac health/headroom scorer and must keep "remote_headroom_pct"
+ * free HBM after the lease.  Replicas are write-through (HOST keeps
+ * the durable copy) and generation-fenced: any device reset or an
+ * unhealthy lender invalidates the lease and the span falls back to
+ * HOST.  Data moves ONLY as PEER_COPY SQEs on the submission spine. */
+
+/* Borrower/lender accounting for one device: pages it has parked
+ * remotely (borrower side) and bytes of its own HBM lent out. */
+TpuStatus uvmTierRemoteStats(uint32_t devInst, uint64_t *borrowedPages,
+                             uint64_t *lentBytes);
+/* Drop every lease on `lenderInst` (evacuation/teardown): borrowers
+ * fall back to their HOST copies lazily; the gauge drains as blocks
+ * are touched.  Returns leases marked for revocation. */
+uint64_t uvmTierRemoteRevokeLender(uint32_t lenderInst);
 
 /* ------------------------------------------------------- tenant QoS API
  *

@@ -381,6 +381,10 @@ TpuStatus tpurmHealthEvacAck(uint32_t devInst, uint64_t reqId,
          * machine will re-degrade in one note burst if the chip is
          * genuinely sick). */
         tpurmHealthClear(devInst);
+        /* An evacuated chip is leaving service: REMOTE-tier leases it
+         * was lending become invalid NOW, not at the next health-state
+         * read — borrowers fall back to their HOST copies lazily. */
+        uvmTierRemoteRevokeLender(devInst);
     }
     TPU_LOG(TPU_LOG_WARN, "health", "evacuation of device %u %s (req %llu)",
            devInst, success ? "ACKED" : "FAILED",

@@ -69,6 +69,8 @@ static const char *const g_jrecNames[] = {
     "client.death",
     "log",
     "dump",
+    "shield.selftest",
+    "tier.remote",
 };
 _Static_assert(sizeof(g_jrecNames) / sizeof(g_jrecNames[0]) ==
                TPU_JREC_TYPE_COUNT, "name per record type");

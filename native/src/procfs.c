@@ -187,6 +187,7 @@ static void render_metrics(TpuCur *c)
     tpurmFlowRenderProm(c);
     tpurmShieldRenderProm(c);
     tpurmJournalRenderProm(c);
+    uvmTierRemoteRenderProm(c);
 }
 
 /* Hotness-driven placement (tpuhot): policy stats, per-device hotness

@@ -43,6 +43,8 @@ static uint32_t g_crcTable[8][256];
 static pthread_once_t g_crcOnce = PTHREAD_ONCE_INIT;
 static bool g_crcHw;
 
+bool tpurmShieldCrcSelftest(void);   /* runs inside crc_init_once */
+
 static void crc_init_once(void)
 {
     for (uint32_t i = 0; i < 256; i++) {
@@ -63,6 +65,7 @@ static void crc_init_once(void)
      * hwcap, not just the compile-time feature macro. */
     g_crcHw = (getauxval(AT_HWCAP) & HWCAP_CRC32) != 0;
 #endif
+    tpurmShieldCrcSelftest();
 }
 
 #if defined(__x86_64__)
@@ -124,6 +127,45 @@ static uint32_t crc32c_sw(uint32_t state, const uint8_t *p, uint64_t len)
     while (len--)
         c = g_crcTable[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
     return c;
+}
+
+/* At-load CRC32C self-test: both dispatch paths must produce the
+ * canonical CRC32C("123456789") = 0xE3069283 before the first seal is
+ * trusted.  The SW table is checked first (a miscomputed table would
+ * corrupt every seal AND mask a bad HW path); then the HW instruction
+ * path, which until now had only ever been exercised on the silicon it
+ * was compiled for — a hwcap that lies, a qemu/TCG gap, or a
+ * miscompiled +crc pragma all surface here as a journaled fallback to
+ * the table instead of a fleet of false CRC faults.  Returns true when
+ * the dispatched path is trustworthy.  Idempotent; runs in the library
+ * constructor (counters and the journal are ctor-safe: lazy init). */
+bool tpurmShieldCrcSelftest(void)
+{
+    static const uint8_t vec[] = "123456789";
+    const uint32_t want = 0xE3069283u;
+
+    tpuCounterAdd("shield_crc_selftests", 1);
+    uint32_t sw = ~crc32c_sw(~0u, vec, 9);
+    if (sw != want) {
+        /* Table construction is broken: nothing to fall back to.  Keep
+         * whatever dispatch we have but make the failure loud. */
+        tpurmJournalEmit(TPU_JREC_CRC_SELFTEST, 0, TPU_ERR_INVALID_STATE,
+                         sw, want);
+        tpuCounterAdd("shield_crc_selftest_fallbacks", 1);
+        return false;
+    }
+#if defined(__x86_64__) || defined(__aarch64__)
+    if (g_crcHw) {
+        uint32_t hw = ~crc32c_hw(~0u, vec, 9);
+        if (hw != want) {
+            g_crcHw = false;    /* dispatch the table from now on */
+            tpurmJournalEmit(TPU_JREC_CRC_SELFTEST, 0,
+                             TPU_ERR_INVALID_STATE, hw, want);
+            tpuCounterAdd("shield_crc_selftest_fallbacks", 1);
+        }
+    }
+#endif
+    return true;
 }
 
 /* One-time init, HOISTED off the per-seal hot path: the old per-call

@@ -272,6 +272,25 @@ typedef struct UvmChunkRun {
     struct UvmChunkRun *next;
 } UvmChunkRun;
 
+/* REMOTE-tier lease: a chunk of a LENDER chip's HBM arena holding a
+ * replica of this block's pages (tpusplit).  The lease is valid only
+ * while (a) the process-wide device generation still equals leaseGen —
+ * ANY device reset fences every lease, conservative by design — and
+ * (b) the lender is healthy and not marked revoked.  An invalid lease
+ * is never read: the promote path drops it and HOST serves. */
+typedef struct UvmRemoteRun {
+    uint32_t firstPage, numPages;
+    uint32_t lenderInst;
+    uint64_t lenderOff;               /* offset in the lender HBM arena */
+    uint64_t chunkBytes;              /* granted (pow2-rounded) size —
+                                       * the lender's lent-bytes ledger
+                                       * uses this, not pages*ps        */
+    void *chunkHandle;                /* uvmHbmChunkAlloc handle        */
+    uint64_t leaseGen;                /* tpurmDeviceGeneration at lease */
+    uint64_t revokeEpoch;             /* lender revoke epoch at lease   */
+    struct UvmRemoteRun *next;
+} UvmRemoteRun;
+
 struct UvmVaRange;
 
 typedef struct UvmVaBlock {
@@ -349,6 +368,14 @@ typedef struct UvmVaBlock {
      * (no eviction, no migration away) — RDMA consumers hold bus
      * addresses into it (reference: vidmem pinned by p2p get_pages). */
     uint32_t p2pPinCount;
+    /* REMOTE-tier backing (tpusplit): leases on lender chips' HBM.
+     * remoteBusy > 0 while a PEER_COPY window is in flight with
+     * blk->lock DROPPED (the spine wait cannot hold it): make-resident
+     * and eviction refuse with STATE_IN_USE, and remote-run gc defers,
+     * so neither the local source/dest runs nor the lender chunks can
+     * move or free under an in-flight transfer. */
+    UvmRemoteRun *remoteRuns;
+    uint32_t remoteBusy;
     /* Access-counter state (reference: uvm_gpu_access_counters.c:81 —
      * sampled hotness that triggers migrations).  acCount counts device
      * accesses serviced WITHOUT HBM placement inside the window; crossing
@@ -584,6 +611,42 @@ bool uvmRangeGroupMigratable(UvmVaSpace *vs, uint64_t groupId);
 /* P2P pin management (peermem substrate). */
 void uvmBlockP2pPin(UvmVaBlock *blk);
 void uvmBlockP2pUnpin(UvmVaBlock *blk);
+
+/* ------------------------------------------------ REMOTE tier (tpusplit)
+ *
+ * uvm_tier_remote.c: leases on lender chips' HBM as this chip's far
+ * memory.  All data movement is dep-chained PEER_COPY windows on the
+ * submission spine; both entry points take blk->lock HELD, drop it
+ * around the spine wait (remoteBusy + p2pPin guard the window) and
+ * re-acquire before returning. */
+
+/* True when the "remote_tier" knob is on and >= 2 devices exist. */
+bool uvmTierRemoteEnabled(void);
+/* Demote hook (uvmBlockEvictFrom, after the host copy-back commits and
+ * BEFORE residency clears): replicate the toHost pages of [first,last]
+ * to a lender picked by the health scorer.  Best-effort — on any
+ * failure the eviction proceeds as a plain HOST demote. */
+void uvmTierRemoteReplicate(UvmVaBlock *blk, const UvmPageMask *toHost,
+                            uint32_t first, uint32_t last);
+/* Promote fast path (uvmBlockMakeResidentEx, dst == HBM, after
+ * block_alloc_backing): fetch needed & resident[REMOTE] pages from
+ * their lenders straight into the local HBM runs.  Pages fetched are
+ * set in *fetched (caller excludes them from the HOST copy_in); an
+ * invalid lease (generation fence, sick lender, revocation) is dropped
+ * and its pages fall back to HOST. */
+void uvmTierRemoteFetch(UvmVaBlock *blk, uint32_t devInst,
+                        const UvmPageMask *needed, UvmPageMask *fetched);
+/* Free remote runs whose pages no longer have resident[REMOTE] bits
+ * (blk->lock held).  Defers while remoteBusy — an in-flight window may
+ * still read the lender chunks; later gc calls collect. */
+void uvmTierRemoteGc(UvmVaBlock *blk);
+/* Teardown: drop ALL remote runs unconditionally (blk->lock held,
+ * remoteBusy must be 0 — uvmBlockFreeBacking drains it first). */
+void uvmTierRemoteFreeAll(UvmVaBlock *blk);
+/* Prometheus render (procfs metrics): tpurm_tier_remote_pages{dev=}. */
+void uvmTierRemoteRenderProm(TpuCur *c);
+/* Lender-side lent-bytes ledger (uvmHbmArenaUsage subtracts this). */
+uint64_t uvmTierRemoteLentBytes(uint32_t lenderInst);
 
 /* Range-destroy notification: peermem registers one hook; it fires for
  * every managed range torn down (uvmMemFree / VaSpaceDestroy) BEFORE the

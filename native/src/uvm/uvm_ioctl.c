@@ -496,6 +496,8 @@ static int uvm_fd_dispatch(UvmFdState *fd, UvmVaSpace *vs,
             p->residentHost = info.residentHost;
             p->residentHbm = info.residentHbm;
             p->residentCxl = info.residentCxl;
+            p->residentRemote = info.residentRemote;
+            p->remoteLenderInst = info.remoteLenderInst;
             p->hbmDeviceInst = info.hbmDeviceInst;
             p->cpuMapped = info.cpuMapped;
             p->pinnedTier = (uint32_t)info.pinnedTier;

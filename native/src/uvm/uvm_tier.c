@@ -185,6 +185,12 @@ TpuStatus uvmHbmArenaUsage(uint32_t devInst, uint64_t *freeBytes,
         return TPU_ERR_INVALID_DEVICE;
     uint64_t total = a->size;
     uint64_t used = uvmPmmAllocatedBytes(&a->pmm);
+    /* Bytes this device LENT to peers' REMOTE tiers don't count as
+     * used: a lease is reclaimable on demand (revoke -> borrowers fall
+     * back to HOST), so charging the lender would double-count borrowed
+     * pages in vac target picking (tpusplit satellite fix). */
+    uint64_t lent = uvmTierRemoteLentBytes(devInst);
+    used = lent > used ? 0 : used - lent;
     if (freeBytes)
         *freeBytes = used > total ? 0 : total - used;
     if (totalBytes)

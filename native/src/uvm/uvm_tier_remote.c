@@ -1,0 +1,535 @@
+/*
+ * REMOTE tier (tpusplit): a healthy neighbor chip's HBM as another
+ * chip's far memory.
+ *
+ * The tier ladder below local HBM gains a rung that is not a local
+ * medium at all: pages evicted from a device's HBM are REPLICATED into
+ * a chunk leased from a lender chip's arena (lender picked by the
+ * tpuvac health/headroom scorer), and a later promote fetches them back
+ * over ICI instead of re-reading host memory.  Three invariants keep
+ * this safe without a coherence protocol:
+ *
+ *   WRITE-THROUGH — REMOTE is strictly a replica of HOST.  The demote
+ *     hook runs only after eviction's host copy-back has committed, so
+ *     resident[REMOTE] implies resident[HOST] and dropping a lease
+ *     never loses data: the span just falls back to the durable copy.
+ *
+ *   GENERATION FENCE — every lease records the process-wide device
+ *     generation (tpurmDeviceGeneration) and the lender's revoke epoch.
+ *     ANY device reset, an unhealthy lender (EVACUATING or worse), or
+ *     an explicit uvmTierRemoteRevokeLender invalidates the lease
+ *     lazily on next touch; the promote path drops it and HOST serves.
+ *     An invalid lease is never read.
+ *
+ *   SPINE-ONLY DATA PATH — bytes move exclusively as PEER_COPY SQEs
+ *     through tpurmMemringSubmitInternal (SUBSYS_TIER), dep-chained
+ *     into windows of REMOTE_WINDOW in-flight copies, so they inherit
+ *     the spine's per-hop wire CRCs (tpushield), claim-generation
+ *     fencing and inject sites.  check-spine forbids any other route.
+ *
+ * Concurrency: both entry points are called with blk->lock HELD but
+ * must not hold it across the spine wait (TIER/FAULT exec runs on
+ * spine workers that take blk->lock).  They pin the block
+ * (p2pPinCount) and raise blk->remoteBusy, drop the lock, run the
+ * windows, re-lock and commit.  While remoteBusy > 0, make-resident
+ * and eviction refuse with STATE_IN_USE and remote-run gc defers, so
+ * neither the local runs nor the lender chunks can move or free under
+ * an in-flight transfer.
+ *
+ * Reference analog: NVLink peer-mapped vidmem used as a migration
+ * target (uvm_pmm_gpu.c indirect peers), with the fork's CXL far-tier
+ * plumbing supplying the eviction-ladder shape.
+ */
+#define _GNU_SOURCE
+#include "uvm_internal.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpurm/health.h"
+#include "tpurm/journal.h"
+#include "tpurm/memring.h"
+#include "tpurm/reset.h"
+
+#define REMOTE_MAX_DEVS 16
+#define REMOTE_WINDOW 4           /* in-flight PEER_COPYs per window  */
+#define REMOTE_BATCH_MAX 32       /* SQEs per internal submit         */
+
+/* Per-device ledgers (atomics: touched from block paths of many
+ * devices concurrently).  borrowedPages is the borrower-side gauge
+ * (tpurm_tier_remote_pages); lentBytes is subtracted from the lender's
+ * uvmHbmArenaUsage so vac target picking never double-counts borrowed
+ * pages; leases counts live leases against a lender so RevokeLender
+ * can report how many it fenced; revokeEpoch invalidates them. */
+static struct {
+    _Atomic uint64_t borrowedPages;
+    _Atomic uint64_t lentBytes;
+    _Atomic uint64_t leases;
+    _Atomic uint64_t revokeEpoch;
+} g_remote[REMOTE_MAX_DEVS];
+
+bool uvmTierRemoteEnabled(void)
+{
+    static TpuRegCache c_en;
+    if (!tpuRegCacheGet(&c_en, "remote_tier", 0))
+        return false;
+    return tpurmDeviceCount() >= 2;
+}
+
+static uint64_t remote_headroom_pct(void)
+{
+    static TpuRegCache c_pct;
+    return tpuRegCacheGet(&c_pct, "remote_headroom_pct", 20);
+}
+
+uint64_t uvmTierRemoteLentBytes(uint32_t lenderInst)
+{
+    if (lenderInst >= REMOTE_MAX_DEVS)
+        return 0;
+    return atomic_load_explicit(&g_remote[lenderInst].lentBytes,
+                                memory_order_relaxed);
+}
+
+TpuStatus uvmTierRemoteStats(uint32_t devInst, uint64_t *borrowedPages,
+                             uint64_t *lentBytes)
+{
+    if (devInst >= tpurmDeviceCount() || devInst >= REMOTE_MAX_DEVS)
+        return TPU_ERR_INVALID_ARGUMENT;
+    if (borrowedPages)
+        *borrowedPages = atomic_load(&g_remote[devInst].borrowedPages);
+    if (lentBytes)
+        *lentBytes = atomic_load(&g_remote[devInst].lentBytes);
+    return TPU_OK;
+}
+
+uint64_t uvmTierRemoteRevokeLender(uint32_t lenderInst)
+{
+    if (lenderInst >= REMOTE_MAX_DEVS)
+        return 0;
+    atomic_fetch_add(&g_remote[lenderInst].revokeEpoch, 1);
+    uint64_t n = atomic_load(&g_remote[lenderInst].leases);
+    if (n) {
+        tpuCounterAdd("tier_remote_revokes", n);
+        tpurmJournalEmit(TPU_JREC_TIER_REMOTE, lenderInst, TPU_OK,
+                         /*a0=revoked leases*/ n, /*a1=op*/ 2);
+    }
+    return n;
+}
+
+void uvmTierRemoteRenderProm(TpuCur *c)
+{
+    uint32_t n = tpurmDeviceCount();
+    if (n > REMOTE_MAX_DEVS)
+        n = REMOTE_MAX_DEVS;
+    tpuCurf(c, "# TYPE tpurm_tier_remote_pages gauge\n");
+    for (uint32_t i = 0; i < n; i++)
+        tpuCurf(c, "tpurm_tier_remote_pages{dev=\"%u\"} %llu\n", i,
+                (unsigned long long)atomic_load(&g_remote[i].borrowedPages));
+}
+
+/* ------------------------------------------------------------- leases */
+
+static bool remote_lease_valid(const UvmRemoteRun *run)
+{
+    if (run->leaseGen != tpurmDeviceGeneration())
+        return false;
+    if (run->lenderInst < REMOTE_MAX_DEVS &&
+        run->revokeEpoch !=
+            atomic_load(&g_remote[run->lenderInst].revokeEpoch))
+        return false;
+    if (tpurmDeviceHealthState(run->lenderInst) >= TPU_HEALTH_EVACUATING)
+        return false;
+    return true;
+}
+
+/* Unlink + free one lease (blk->lock held, !remoteBusy).  Clears the
+ * REMOTE residency bits and returns the lender chunk; chunk free after
+ * a lender reset is harmless (the arena was rebuilt).  `prevp` is the
+ * link that points at `run`. */
+static void remote_run_free(UvmVaBlock *blk, UvmRemoteRun **prevp,
+                            UvmRemoteRun *run, bool aborted)
+{
+    uvmPageMaskClearRange(&blk->resident[UVM_TIER_REMOTE], run->firstPage,
+                          run->numPages);
+    *prevp = run->next;
+    if (run->lenderInst < REMOTE_MAX_DEVS) {
+        atomic_fetch_sub(&g_remote[run->lenderInst].lentBytes,
+                         run->chunkBytes);
+        atomic_fetch_sub(&g_remote[run->lenderInst].leases, 1);
+    }
+    if (blk->hbmDevInst < REMOTE_MAX_DEVS)
+        atomic_fetch_sub(&g_remote[blk->hbmDevInst].borrowedPages,
+                         run->numPages);
+    uvmHbmChunkFree(run->lenderInst, run->chunkHandle);
+    if (aborted) {
+        tpuCounterAdd("tier_remote_fence_aborts", 1);
+        tpurmJournalEmit(TPU_JREC_TIER_REMOTE, run->lenderInst,
+                         TPU_ERR_DEVICE_RESET, run->numPages, /*a1=op*/ 3);
+    }
+    free(run);
+}
+
+void uvmTierRemoteGc(UvmVaBlock *blk)
+{
+    if (blk->remoteBusy)
+        return;                   /* window in flight: defer, chunks live */
+    UvmRemoteRun **pp = &blk->remoteRuns;
+    while (*pp) {
+        UvmRemoteRun *run = *pp;
+        bool live = false;
+        for (uint32_t p = run->firstPage;
+             p < run->firstPage + run->numPages; p++)
+            if (uvmPageMaskTest(&blk->resident[UVM_TIER_REMOTE], p)) {
+                live = true;
+                break;
+            }
+        if (live)
+            pp = &run->next;
+        else
+            remote_run_free(blk, pp, run, false);
+    }
+}
+
+void uvmTierRemoteFreeAll(UvmVaBlock *blk)
+{
+    UvmRemoteRun **pp = &blk->remoteRuns;
+    while (*pp)
+        remote_run_free(blk, pp, *pp, false);
+}
+
+/* ---------------------------------------------------- PEER_COPY spans */
+
+typedef struct {
+    uint64_t localOff;            /* borrower HBM arena offset  */
+    uint64_t peerOff;             /* lender HBM arena offset    */
+    uint64_t len;
+    uint64_t granted;             /* lender chunk size (>= len) */
+    uint32_t firstPage, numPages;
+    void *chunkHandle;            /* demote plan only           */
+} RemoteSpan;
+
+/* Submit one dep-chained window batch per REMOTE_BATCH_MAX spans and
+ * wait (SubmitInternal is synchronous; nested submits from spine
+ * workers run inline).  SQE i deps on i-REMOTE_WINDOW of the same
+ * batch, capping copies in flight per batch at REMOTE_WINDOW while a
+ * single failed hop dep-cancels its whole tail — the abort unit the
+ * generation fence needs.  direction: TPU_MEMRING_PEER_WRITE pushes
+ * local->lender (demote), TPU_MEMRING_PEER_READ pulls lender->local
+ * (promote). */
+static TpuStatus remote_copy_windows(uint32_t devInst, uint32_t lenderInst,
+                                     const RemoteSpan *spans, uint32_t n,
+                                     uint32_t direction)
+{
+    TpuStatus first = TPU_OK;
+    for (uint32_t base = 0; base < n && first == TPU_OK;
+         base += REMOTE_BATCH_MAX) {
+        TpuMemringSqe sqes[REMOTE_BATCH_MAX];
+        TpuStatus sts[REMOTE_BATCH_MAX];
+        uint32_t cnt = n - base;
+        if (cnt > REMOTE_BATCH_MAX)
+            cnt = REMOTE_BATCH_MAX;
+        memset(sqes, 0, sizeof(sqes[0]) * cnt);
+        for (uint32_t i = 0; i < cnt; i++) {
+            TpuMemringSqe *s = &sqes[i];
+            s->opcode = TPU_MEMRING_OP_PEER_COPY;
+            s->devInst = devInst;
+            s->peerInst = lenderInst;
+            s->addr = spans[base + i].localOff;
+            s->peerOff = spans[base + i].peerOff;
+            s->len = spans[base + i].len;
+            s->arg0 = direction;
+            if (i >= REMOTE_WINDOW)
+                tpurmMemringSqeDep(s, TPU_MEMRING_DEP(TPU_MEMRING_DEP_BATCH,
+                                                      i - REMOTE_WINDOW));
+        }
+        TpuStatus sub =
+            tpurmMemringSubmitInternal(NULL, sqes, cnt, sts,
+                                       TPU_MEMRING_SUBSYS_TIER);
+        for (uint32_t i = 0; i < cnt && first == TPU_OK; i++)
+            if (sts[i] != TPU_OK)
+                first = sts[i];
+        if (first == TPU_OK && sub != TPU_OK)
+            first = sub;
+    }
+    return first;
+}
+
+/* Drop/re-take blk->lock around the spine wait.  `tag` must match the
+ * caller's tpuLockTrack tag so the tracker's pairing stays coherent. */
+static void remote_unlock(UvmVaBlock *blk, const char *tag)
+{
+    blk->p2pPinCount++;
+    blk->remoteBusy++;
+    tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, tag);
+    pthread_mutex_unlock(&blk->lock);
+}
+
+static void remote_relock(UvmVaBlock *blk, const char *tag)
+{
+    pthread_mutex_lock(&blk->lock);
+    tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, tag);
+    blk->p2pPinCount--;
+    blk->remoteBusy--;
+}
+
+/* ------------------------------------------------------------- demote */
+
+/* Replicate [first,last] ∩ toHost into a lease on a lender chip.
+ * Called from the block-eviction path — blk->lock held, tag
+ * "block-evict" —
+ * AFTER the host copy-back committed and BEFORE resident[HBM] is
+ * cleared — the local HBM runs are still the PEER_COPY source, and the
+ * write-through invariant (REMOTE ⊆ HOST) holds by construction.
+ * Best-effort: any refusal (no healthy lender, headroom, lender arena
+ * full, spine error) just skips replication; eviction proceeds to HOST
+ * exactly as before. */
+void uvmTierRemoteReplicate(UvmVaBlock *blk, const UvmPageMask *toHost,
+                            uint32_t first, uint32_t last)
+{
+    if (!uvmTierRemoteEnabled() || blk->hbmDevInst >= REMOTE_MAX_DEVS)
+        return;
+
+    uint32_t lender;
+    if (tpurmHealthPickTarget(blk->hbmDevInst, &lender) != TPU_OK ||
+        lender >= REMOTE_MAX_DEVS || lender == blk->hbmDevInst)
+        return;
+
+    uint64_t ps = uvmPageSize();
+
+    /* Headroom gate: the lender must keep remote_headroom_pct of its
+     * arena free AFTER the lease (uvmHbmArenaUsage already nets out
+     * bytes it lent, which are reclaimable on demand). */
+    uint64_t freeB = 0, totalB = 0, wantB = 0;
+    for (uint32_t p = first; p <= last; p++)
+        if (uvmPageMaskTest(toHost, p))
+            wantB += ps;
+    if (!wantB)
+        return;
+    if (uvmHbmArenaUsage(lender, &freeB, &totalB) != TPU_OK ||
+        freeB < wantB || freeB - wantB < totalB * remote_headroom_pct() / 100) {
+        tpuCounterAdd("tier_remote_headroom_refusals", 1);
+        return;
+    }
+
+    /* Plan: coalesce contiguous (page, HBM offset) runs, one lender
+     * chunk per span.  Offsets are stable while we later drop the lock:
+     * pin + remoteBusy block every mover. */
+    RemoteSpan *spans = calloc(last - first + 1, sizeof(*spans));
+    if (!spans)
+        return;
+    uint32_t nspans = 0;
+    uint64_t prevOff = 0;
+    for (uint32_t p = first; p <= last; p++) {
+        uint64_t off;
+        if (!uvmPageMaskTest(toHost, p) || !uvmBlockHbmArenaOffset(blk, p, &off))
+            continue;
+        if (nspans && spans[nspans - 1].firstPage + spans[nspans - 1].numPages
+                == p && prevOff + ps == off) {
+            spans[nspans - 1].numPages++;
+            spans[nspans - 1].len += ps;
+        } else {
+            spans[nspans].localOff = off;
+            spans[nspans].len = ps;
+            spans[nspans].firstPage = p;
+            spans[nspans].numPages = 1;
+            nspans++;
+        }
+        prevOff = off;
+    }
+    if (!nspans) {
+        free(spans);
+        return;
+    }
+
+    /* Lease one lender chunk per span (plain alloc, no evict ladder:
+     * a full lender is a refusal, never recursive eviction). */
+    uint32_t ok = 0;
+    for (; ok < nspans; ok++)
+        if (uvmHbmChunkAllocSized(lender, spans[ok].len, &spans[ok].peerOff,
+                                  &spans[ok].granted,
+                                  &spans[ok].chunkHandle) != TPU_OK)
+            break;
+    if (ok < nspans) {
+        for (uint32_t i = 0; i < ok; i++)
+            uvmHbmChunkFree(lender, spans[i].chunkHandle);
+        free(spans);
+        tpuCounterAdd("tier_remote_headroom_refusals", 1);
+        return;
+    }
+
+    uint64_t gen = tpurmDeviceGeneration();
+    uint64_t epoch = atomic_load(&g_remote[lender].revokeEpoch);
+
+    remote_unlock(blk, "block-evict");
+    TpuStatus st = remote_copy_windows(blk->hbmDevInst, lender, spans, nspans,
+                                       TPU_MEMRING_PEER_WRITE);
+    remote_relock(blk, "block-evict");
+
+    if (st != TPU_OK || gen != tpurmDeviceGeneration()) {
+        for (uint32_t i = 0; i < nspans; i++)
+            uvmHbmChunkFree(lender, spans[i].chunkHandle);
+        free(spans);
+        tpuCounterAdd("tier_remote_demote_fails", 1);
+        tpurmJournalEmit(TPU_JREC_TIER_REMOTE, lender,
+                         st != TPU_OK ? st : TPU_ERR_DEVICE_RESET,
+                         /*a0*/ 0, /*a1=op*/ 1);
+        return;
+    }
+
+    uint64_t pages = 0;
+    for (uint32_t i = 0; i < nspans; i++) {
+        UvmRemoteRun *run = calloc(1, sizeof(*run));
+        if (!run) {
+            uvmHbmChunkFree(lender, spans[i].chunkHandle);
+            continue;
+        }
+        run->firstPage = spans[i].firstPage;
+        run->numPages = spans[i].numPages;
+        run->lenderInst = lender;
+        run->lenderOff = spans[i].peerOff;
+        run->chunkBytes = spans[i].granted;
+        run->chunkHandle = spans[i].chunkHandle;
+        run->leaseGen = gen;
+        run->revokeEpoch = epoch;
+        run->next = blk->remoteRuns;
+        blk->remoteRuns = run;
+        uvmPageMaskSetRange(&blk->resident[UVM_TIER_REMOTE], run->firstPage,
+                            run->numPages);
+        atomic_fetch_add(&g_remote[lender].lentBytes, run->chunkBytes);
+        atomic_fetch_add(&g_remote[lender].leases, 1);
+        atomic_fetch_add(&g_remote[blk->hbmDevInst].borrowedPages,
+                         run->numPages);
+        pages += run->numPages;
+    }
+    free(spans);
+    if (pages) {
+        tpuCounterAdd("tier_remote_demotes", 1);
+        tpuCounterAdd("tier_remote_demote_bytes", pages * ps);
+        tpurmJournalEmit(TPU_JREC_TIER_REMOTE, lender, TPU_OK, pages,
+                         /*a1=op*/ 0);
+    }
+}
+
+/* ------------------------------------------------------------ promote */
+
+/* Fetch `needed` pages whose REMOTE lease is still valid into the
+ * block's freshly allocated HBM runs (uvmBlockMakeResidentEx, blk->lock
+ * held, tag "block", called after backing alloc and before the HOST
+ * copy-in; fetched pages are masked out of the copy).  Invalid or
+ * failed leases are dropped — the caller's HOST copy-in serves those
+ * pages, so an aborted PEER_COPY can never leave garbage behind a
+ * completed read. */
+void uvmTierRemoteFetch(UvmVaBlock *blk, uint32_t devInst,
+                        const UvmPageMask *needed, UvmPageMask *fetched)
+{
+    uvmPageMaskZero(fetched);
+    if (!blk->remoteRuns || devInst != blk->hbmDevInst)
+        return;
+
+    uint64_t ps = uvmPageSize();
+
+    /* Validate every intersecting lease first; drop the dead ones so
+     * the plan below only reads live leases. */
+    UvmRemoteRun **pp = &blk->remoteRuns;
+    while (*pp) {
+        UvmRemoteRun *run = *pp;
+        bool wanted = false;
+        for (uint32_t p = run->firstPage;
+             p < run->firstPage + run->numPages && !wanted; p++)
+            wanted = uvmPageMaskTest(needed, p) &&
+                     uvmPageMaskTest(&blk->resident[UVM_TIER_REMOTE], p);
+        if (wanted && !remote_lease_valid(run)) {
+            remote_run_free(blk, pp, run, true);
+            continue;
+        }
+        pp = &run->next;
+    }
+
+    RemoteSpan *spans = calloc(blk->npages, sizeof(*spans));
+    if (!spans)
+        return;
+
+    /* One lender at a time (multi-lender blocks submit per lender). */
+    for (;;) {
+        uint32_t lender = UINT32_MAX, nspans = 0;
+        uint64_t gen = tpurmDeviceGeneration();
+        /* Pick the first lender that still has a wanted, unfetched page. */
+        for (UvmRemoteRun *run = blk->remoteRuns;
+             run && lender == UINT32_MAX; run = run->next)
+            for (uint32_t p = run->firstPage;
+                 p < run->firstPage + run->numPages; p++)
+                if (uvmPageMaskTest(needed, p) &&
+                    uvmPageMaskTest(&blk->resident[UVM_TIER_REMOTE], p) &&
+                    !uvmPageMaskTest(fetched, p)) {
+                    lender = run->lenderInst;
+                    break;
+                }
+        if (lender == UINT32_MAX)
+            break;
+        for (UvmRemoteRun *run = blk->remoteRuns; run; run = run->next) {
+            if (run->lenderInst != lender)
+                continue;
+            for (uint32_t p = run->firstPage;
+                 p < run->firstPage + run->numPages; p++) {
+                uint64_t off;
+                if (!uvmPageMaskTest(needed, p) ||
+                    !uvmPageMaskTest(&blk->resident[UVM_TIER_REMOTE], p) ||
+                    uvmPageMaskTest(fetched, p) ||
+                    !uvmBlockHbmArenaOffset(blk, p, &off))
+                    continue;
+                spans[nspans].localOff = off;
+                spans[nspans].peerOff =
+                    run->lenderOff + (uint64_t)(p - run->firstPage) * ps;
+                spans[nspans].len = ps;
+                spans[nspans].firstPage = p;
+                spans[nspans].numPages = 1;
+                /* Merge with previous span when both sides extend. */
+                if (nspans &&
+                    spans[nspans - 1].firstPage + spans[nspans - 1].numPages
+                        == p &&
+                    spans[nspans - 1].localOff + spans[nspans - 1].len
+                        == spans[nspans].localOff &&
+                    spans[nspans - 1].peerOff + spans[nspans - 1].len
+                        == spans[nspans].peerOff) {
+                    spans[nspans - 1].numPages++;
+                    spans[nspans - 1].len += ps;
+                } else {
+                    nspans++;
+                }
+            }
+        }
+        if (!nspans)
+            break;
+
+        remote_unlock(blk, "block");
+        TpuStatus st = remote_copy_windows(devInst, lender, spans, nspans,
+                                           TPU_MEMRING_PEER_READ);
+        remote_relock(blk, "block");
+
+        if (st == TPU_OK && gen == tpurmDeviceGeneration()) {
+            uint64_t pages = 0;
+            for (uint32_t i = 0; i < nspans; i++) {
+                uvmPageMaskSetRange(fetched, spans[i].firstPage,
+                                    spans[i].numPages);
+                pages += spans[i].numPages;
+            }
+            tpuCounterAdd("tier_remote_promotes", 1);
+            tpuCounterAdd("tier_remote_promote_bytes", pages * ps);
+        } else {
+            /* Fence abort: the window dep-cancelled (or the generation
+             * moved under us).  Drop every lease on this lender — the
+             * destination pages stay masked out of `fetched`, so the
+             * caller's HOST copy-in overwrites any partial bytes. */
+            UvmRemoteRun **dp = &blk->remoteRuns;
+            while (*dp) {
+                if ((*dp)->lenderInst == lender)
+                    remote_run_free(blk, dp, *dp, true);
+                else
+                    dp = &(*dp)->next;
+            }
+        }
+        /* Loop: the pick above finds the next lender with unfetched
+         * pages; fetched or dropped leases cannot be re-picked. */
+    }
+    free(spans);
+}

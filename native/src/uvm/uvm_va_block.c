@@ -587,8 +587,10 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
     if (pthread_mutex_trylock(&blk->lock) != 0)
         return TPU_ERR_STATE_IN_USE;
     tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block-evict");
-    if (blk->p2pPinCount) {
-        /* RDMA consumers hold bus addresses into this block. */
+    if (blk->p2pPinCount || blk->remoteBusy) {
+        /* RDMA consumers hold bus addresses into this block, or a
+         * REMOTE-tier PEER_COPY window is in flight with the lock
+         * dropped (its source/dest runs must not move). */
         tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-evict");
         pthread_mutex_unlock(&blk->lock);
         return TPU_ERR_STATE_IN_USE;
@@ -754,6 +756,14 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
             }
             uvmToolsEmit(blk->range->vaSpace, UVM_EVENT_EVICTION, tier,
                          UVM_TIER_HOST, blk->hbmDevInst, blk->start, bytes);
+            /* REMOTE tier (tpusplit): the host copy is committed, the
+             * HBM source runs still exist — replicate the demoted span
+             * onto a lender chip's HBM so a later promote rides ICI
+             * instead of re-reading host memory.  Write-through: HOST
+             * keeps the durable copy, so every failure mode inside is
+             * just "no replica".  Drops/re-takes blk->lock. */
+            if (tier == UVM_TIER_HBM)
+                uvmTierRemoteReplicate(blk, &toHost, first, last);
         }
         /* Still-marked speculative pages leaving the aperture untouched
          * are USELESS prefetches (blk->lock held here). */
@@ -769,6 +779,7 @@ TpuStatus uvmBlockEvictFrom(UvmVaBlock *blk, UvmTierArena *arena)
         uvmBlockPteRevoke(blk, first, last - first + 1);
     }
     block_gc_runs(blk, tier);
+    uvmTierRemoteGc(blk);
     uvmFaultStatsRecordEviction();
     tpuCounterAdd("uvm_block_evictions", 1);
     tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block-evict");
@@ -840,6 +851,10 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         arena = uvmTierArenaHbm(dst.devInst);
         if (!arena)
             return TPU_ERR_INVALID_DEVICE;
+    } else if (dst.tier == UVM_TIER_REMOTE) {
+        /* REMOTE is an eviction-side replica of HOST, never a
+         * make-resident destination (tpusplit). */
+        return TPU_ERR_NOT_SUPPORTED;
     } else if (dst.tier == UVM_TIER_CXL) {
         arena = uvmTierArenaCxl();
         if (!arena)
@@ -848,6 +863,15 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
 
     pthread_mutex_lock(&blk->lock);
     tpuLockTrackAcquire(TPU_LOCK_UVM_BLOCK, "block");
+
+    if (blk->remoteBusy) {
+        /* A REMOTE-tier PEER_COPY window is in flight with blk->lock
+         * dropped: residency masks and backing runs must not move
+         * under it (the fault path retries on STATE_IN_USE). */
+        tpuLockTrackRelease(TPU_LOCK_UVM_BLOCK, "block");
+        pthread_mutex_unlock(&blk->lock);
+        return TPU_ERR_STATE_IN_USE;
+    }
 
     /* P2P-pinned blocks keep their device residency in place: CPU reads
      * are served by duplication (device copy survives), anything that
@@ -1020,7 +1044,21 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
          * copy is exactly the cold data the scrubber must cover. */
         bool sealCxl = dst.tier == UVM_TIER_CXL && uvmShieldActive();
         uint32_t sealCrcs[UVM_MAX_PAGES_PER_BLOCK];
-        st = block_copy_in(blk, dst.tier, &needed, firstPage, count, &bytes,
+        /* REMOTE tier (tpusplit): pages with a live lease on a lender
+         * chip promote over ICI into the just-allocated HBM runs
+         * instead of re-reading the HOST copy.  Fetched pages are
+         * masked out of the copy-in; a fence abort (lender reset,
+         * revocation, unhealthy lender) leaves them UNfetched, so the
+         * HOST copy-in below overwrites any partial bytes — an aborted
+         * window can never leak garbage into a completed service.
+         * Drops/re-takes blk->lock (remoteBusy guards the window). */
+        UvmPageMask copyIn = needed;
+        if (dst.tier == UVM_TIER_HBM && blk->remoteRuns) {
+            UvmPageMask remoteFetched;
+            uvmTierRemoteFetch(blk, dst.devInst, &needed, &remoteFetched);
+            uvmPageMaskAndNot(&copyIn, &remoteFetched);
+        }
+        st = block_copy_in(blk, dst.tier, &copyIn, firstPage, count, &bytes,
                            sealCxl ? sealCrcs : NULL);
         if (tCopy && bytes)
             tpurmTraceEnd(TPU_TRACE_MIGRATE_COPY, tCopy, blk->start, bytes);
@@ -1098,6 +1136,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
                 block_set_cpu_mapped(blk, firstPage, count);
                 block_gc_runs(blk, UVM_TIER_HBM);
                 block_gc_runs(blk, UVM_TIER_CXL);
+                uvmTierRemoteGc(blk);
                 hostRwCommitted = true;
             }
         } else if (!readDup) {
@@ -1105,6 +1144,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
             uvmBlockSetCpuAccess(blk, firstPage, count, PROT_NONE);
             block_gc_runs(blk, dst.tier == UVM_TIER_HBM ? UVM_TIER_CXL
                                                         : UVM_TIER_HBM);
+            uvmTierRemoteGc(blk);
         }
         if (bytes) {
             uvmFaultStatsRecordMigration(bytes);
@@ -1184,6 +1224,7 @@ TpuStatus uvmBlockMakeResidentEx(UvmVaBlock *blk, UvmLocation dst,
         }
         block_gc_runs(blk, UVM_TIER_HBM);
         block_gc_runs(blk, UVM_TIER_CXL);
+        uvmTierRemoteGc(blk);
     }
 
 fixup_done:
@@ -1295,8 +1336,15 @@ void uvmBlockFreeBacking(UvmVaBlock *blk)
         uvmLruAwaitEvictors(cxl, blk);
         uvmLruRemove(cxl, blk);
     }
+    /* REMOTE leases: wait out any in-flight PEER_COPY window (its
+     * submitter holds a serviceRef or the migrate call; it re-locks and
+     * drops remoteBusy when the spine wait returns), then give every
+     * lender its chunks back. */
+    while (__atomic_load_n(&blk->remoteBusy, __ATOMIC_ACQUIRE))
+        sched_yield();
+    uvmTierRemoteFreeAll(blk);
     for (int tier = 0; tier < UVM_TIER_COUNT; tier++) {
-        if (tier == UVM_TIER_HOST)
+        if (tier == UVM_TIER_HOST || tier == UVM_TIER_REMOTE)
             continue;
         UvmChunkRun *r = *runs_head(blk, (UvmTier)tier);
         while (r) {

@@ -249,7 +249,7 @@ uint64_t uvmTenantDevPages(uint32_t tenantId, uint32_t devInst)
 void uvmTenantRenderProm(TpuCur *c)
 {
     static const char *tierName[UVM_TIER_COUNT] = { "host", "hbm",
-                                                    "cxl" };
+                                                    "cxl", "remote" };
     tpuCurf(c, "# TYPE tpurm_tenant_pages gauge\n");
     tpuCurf(c, "# TYPE tpurm_tenant_quota_pages gauge\n");
     for (int i = 0; i < UVM_MAX_TENANTS; i++) {
@@ -1403,6 +1403,13 @@ TpuStatus uvmResidencyInfo(UvmVaSpace *vs, void *addr, UvmResidencyInfo *out)
     out->residentHost = uvmPageMaskTest(&blk->resident[UVM_TIER_HOST], page);
     out->residentHbm = uvmPageMaskTest(&blk->resident[UVM_TIER_HBM], page);
     out->residentCxl = uvmPageMaskTest(&blk->resident[UVM_TIER_CXL], page);
+    out->residentRemote = uvmPageMaskTest(&blk->resident[UVM_TIER_REMOTE],
+                                          page);
+    if (out->residentRemote)
+        for (UvmRemoteRun *run = blk->remoteRuns; run; run = run->next)
+            if (page >= run->firstPage &&
+                page < run->firstPage + run->numPages)
+                out->remoteLenderInst = run->lenderInst;
     out->hbmDeviceInst = blk->hbmDevInst;
     out->cpuMapped = uvmPageMaskTest(&blk->cpuMapped, page);
     out->devMapped = uvmPageMaskTest(&blk->devMapped, page);

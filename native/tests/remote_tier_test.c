@@ -1,0 +1,299 @@
+/*
+ * REMOTE tier (tpusplit) test: a neighbor chip's HBM as far memory.
+ *
+ *   1. demote/promote round trip — eviction replicates the span onto a
+ *      lender chip, the promote fetches it back over ICI, the pattern
+ *      survives, and every ledger (borrowed pages, lent bytes, gauge)
+ *      returns to zero when the lease dies.
+ *   2. lender-side arena accounting — bytes lent to a borrower are
+ *      EXCLUDED from the lender's uvmHbmArenaUsage (vac target picking
+ *      must not double-count reclaimable leases).
+ *   3. generation fence — a full-device reset between demote and
+ *      promote invalidates the lease; the span falls back to HOST with
+ *      the pattern intact.
+ *   4. peer death mid-read — the lender dies while a borrower promote
+ *      is in flight: the dep-chained window cancels, the lease drops,
+ *      HOST serves, and zero corrupt bytes reach the completed read.
+ *
+ * Run with TPUMEM_FAKE_TPU_COUNT=4 (the Makefile does): lender picking
+ * needs peers.
+ */
+#define _GNU_SOURCE
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include "tpurm/health.h"
+#include "tpurm/reset.h"
+#include "tpurm/status.h"
+#include "tpurm/tpurm.h"
+#include "tpurm/uvm.h"
+
+#define CHECK(cond) do { \
+    if (!(cond)) { \
+        fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+        return 1; \
+    } } while (0)
+
+/* Internal surfaces (internal.h): registry flips + counter cells. */
+void tpuRegistrySet(const char *key, const char *value);
+_Atomic uint64_t *tpuCounterRef(const char *name);
+
+#define BUF_BYTES (1u << 20)
+
+static void fill_pattern(uint8_t *p, uint64_t n, uint32_t seed)
+{
+    for (uint64_t i = 0; i < n; i++)
+        p[i] = (uint8_t)((i * 2654435761u + seed) >> 16);
+}
+
+static int check_pattern(const uint8_t *p, uint64_t n, uint32_t seed)
+{
+    for (uint64_t i = 0; i < n; i++)
+        if (p[i] != (uint8_t)((i * 2654435761u + seed) >> 16))
+            return 0;
+    return 1;
+}
+
+static uint64_t ctr(const char *name)
+{
+    return atomic_load(tpuCounterRef(name));
+}
+
+/* Migrate to HBM dev 0 and evict the arena so the span demotes through
+ * the REMOTE replicate hook.  Returns nonzero on CHECK failure. */
+static int demote(UvmVaSpace *vs, uint8_t *buf)
+{
+    UvmLocation hbm = { .tier = UVM_TIER_HBM, .devInst = 0 };
+    CHECK(uvmMigrate(vs, buf, BUF_BYTES, hbm, 0) == TPU_OK);
+    UvmResidencyInfo ri;
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(ri.residentHbm);
+    uvmTierEvictBytes(UVM_TIER_HBM, 0, ~0ull >> 1);
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(!ri.residentHbm);
+    CHECK(ri.residentHost);
+    return 0;
+}
+
+/* ---- 1 + 2: round trip and lender accounting ----------------------- */
+
+static int test_roundtrip(UvmVaSpace *vs)
+{
+    uint8_t *buf = NULL;
+    CHECK(uvmMemAlloc(vs, BUF_BYTES, (void **)&buf) == TPU_OK);
+    fill_pattern(buf, BUF_BYTES, 0x5EED);
+
+    uint64_t demotes0 = ctr("tier_remote_demotes");
+    uint64_t promotes0 = ctr("tier_remote_promotes");
+    CHECK(demote(vs, buf) == 0);
+
+    UvmResidencyInfo ri;
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(ri.residentRemote);
+    uint32_t lender = ri.remoteLenderInst;
+    CHECK(lender != 0 && lender < tpurmDeviceCount());
+    CHECK(ctr("tier_remote_demotes") > demotes0);
+    CHECK(ctr("tier_remote_demote_bytes") >= BUF_BYTES);
+
+    uint64_t borrowed = 0, lent = 0;
+    CHECK(uvmTierRemoteStats(0, &borrowed, NULL) == TPU_OK);
+    CHECK(borrowed > 0);
+    CHECK(uvmTierRemoteStats(lender, NULL, &lent) == TPU_OK);
+    CHECK(lent >= BUF_BYTES);
+
+    /* Lender accounting: the lease must NOT shrink the lender's
+     * reported free HBM (leases are reclaimable on demand, so vac
+     * target picking sees through them). */
+    uint64_t freeB = 0, totalB = 0;
+    CHECK(uvmHbmArenaUsage(lender, &freeB, &totalB) == TPU_OK);
+    CHECK(totalB - freeB < BUF_BYTES);  /* lease alone would exceed it */
+
+    /* Promote: the fetch rides ICI; exclusivity then drops the lease
+     * and every ledger drains. */
+    UvmLocation hbm = { .tier = UVM_TIER_HBM, .devInst = 0 };
+    CHECK(uvmMigrate(vs, buf, BUF_BYTES, hbm, 0) == TPU_OK);
+    CHECK(ctr("tier_remote_promotes") > promotes0);
+    CHECK(ctr("tier_remote_promote_bytes") >= BUF_BYTES);
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(ri.residentHbm && !ri.residentRemote);
+    CHECK(uvmTierRemoteStats(0, &borrowed, NULL) == TPU_OK);
+    CHECK(borrowed == 0);
+    CHECK(uvmTierRemoteStats(lender, NULL, &lent) == TPU_OK);
+    CHECK(lent == 0);
+
+    UvmLocation host = { .tier = UVM_TIER_HOST, .devInst = 0 };
+    CHECK(uvmMigrate(vs, buf, BUF_BYTES, host, 0) == TPU_OK);
+    CHECK(check_pattern(buf, BUF_BYTES, 0x5EED));
+
+    CHECK(uvmMemFree(vs, buf) == TPU_OK);
+    printf("  roundtrip + lender accounting          ok\n");
+    return 0;
+}
+
+/* ---- 3: generation fence ------------------------------------------- */
+
+static int test_generation_fence(UvmVaSpace *vs)
+{
+    uint8_t *buf = NULL;
+    CHECK(uvmMemAlloc(vs, BUF_BYTES, (void **)&buf) == TPU_OK);
+    fill_pattern(buf, BUF_BYTES, 0xFE4CE);
+    CHECK(demote(vs, buf) == 0);
+    UvmResidencyInfo ri;
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(ri.residentRemote);
+
+    /* Reset bumps the process-wide generation: every lease is stale. */
+    uint64_t aborts0 = ctr("tier_remote_fence_aborts");
+    CHECK(tpurmDeviceReset() == TPU_OK);
+
+    UvmLocation hbm = { .tier = UVM_TIER_HBM, .devInst = 0 };
+    CHECK(uvmMigrate(vs, buf, BUF_BYTES, hbm, 0) == TPU_OK);
+    CHECK(ctr("tier_remote_fence_aborts") > aborts0);
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(ri.residentHbm && !ri.residentRemote);
+
+    uint64_t borrowed = ~0ull;
+    CHECK(uvmTierRemoteStats(0, &borrowed, NULL) == TPU_OK);
+    CHECK(borrowed == 0);
+
+    UvmLocation host = { .tier = UVM_TIER_HOST, .devInst = 0 };
+    CHECK(uvmMigrate(vs, buf, BUF_BYTES, host, 0) == TPU_OK);
+    CHECK(check_pattern(buf, BUF_BYTES, 0xFE4CE));
+    CHECK(uvmMemFree(vs, buf) == TPU_OK);
+    printf("  generation fence -> HOST fallback      ok\n");
+    return 0;
+}
+
+/* ---- 4: peer death mid-read ----------------------------------------
+
+ * The lender chip dies while the borrower's promote is being serviced.
+ * Two shapes:
+ *   (a) deterministic — mark the lender LOST before the promote: every
+ *       PEER_COPY in the window fails/cancels, the fetch aborts, the
+ *       HOST copy serves, the read completes with zero corrupt bytes.
+ *   (b) racing — a faulting thread hammers demote/promote cycles while
+ *       the main thread fires a full-device reset mid-stream; the
+ *       pattern must survive every cycle. */
+
+static int test_peer_death(UvmVaSpace *vs)
+{
+    uint8_t *buf = NULL;
+    CHECK(uvmMemAlloc(vs, BUF_BYTES, (void **)&buf) == TPU_OK);
+    fill_pattern(buf, BUF_BYTES, 0xDEAD);
+    CHECK(demote(vs, buf) == 0);
+    UvmResidencyInfo ri;
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(ri.residentRemote);
+    uint32_t lender = ri.remoteLenderInst;
+
+    uint64_t aborts0 = ctr("tier_remote_fence_aborts");
+    TpurmDevice *ldev = tpurmDeviceGet(lender);
+    CHECK(ldev != NULL);
+    tpurmDeviceSetLost(ldev, 1);
+
+    /* Borrower fault in flight against a dead lender: the dep-chained
+     * window cancels, the lease drops, HOST serves. */
+    UvmLocation hbm = { .tier = UVM_TIER_HBM, .devInst = 0 };
+    CHECK(uvmMigrate(vs, buf, BUF_BYTES, hbm, 0) == TPU_OK);
+    CHECK(ctr("tier_remote_fence_aborts") > aborts0);
+    CHECK(uvmResidencyInfo(vs, buf, &ri) == TPU_OK);
+    CHECK(ri.residentHbm && !ri.residentRemote);
+
+    UvmLocation host = { .tier = UVM_TIER_HOST, .devInst = 0 };
+    CHECK(uvmMigrate(vs, buf, BUF_BYTES, host, 0) == TPU_OK);
+    CHECK(check_pattern(buf, BUF_BYTES, 0xDEAD));   /* zero corrupt bytes */
+
+    tpurmDeviceSetLost(ldev, 0);
+    CHECK(uvmMemFree(vs, buf) == TPU_OK);
+    printf("  lender lost mid-read -> HOST fallback  ok\n");
+    return 0;
+}
+
+struct churn_arg {
+    UvmVaSpace *vs;
+    uint8_t *buf;
+    _Atomic int stop;
+    _Atomic int failures;
+    _Atomic int cycles;
+};
+
+static void *churn_thread(void *opaque)
+{
+    struct churn_arg *a = opaque;
+    UvmLocation hbm = { .tier = UVM_TIER_HBM, .devInst = 0 };
+    UvmLocation host = { .tier = UVM_TIER_HOST, .devInst = 0 };
+    while (!atomic_load(&a->stop)) {
+        /* Reset windows can refuse services transiently; only the data
+         * integrity check is load-bearing. */
+        (void)uvmMigrate(a->vs, a->buf, BUF_BYTES, hbm, 0);
+        uvmTierEvictBytes(UVM_TIER_HBM, 0, ~0ull >> 1);
+        (void)uvmMigrate(a->vs, a->buf, BUF_BYTES, hbm, 0);
+        if (uvmMigrate(a->vs, a->buf, BUF_BYTES, host, 0) == TPU_OK &&
+            !check_pattern(a->buf, BUF_BYTES, 0xC0FFEE))
+            atomic_fetch_add(&a->failures, 1);
+        atomic_fetch_add(&a->cycles, 1);
+    }
+    return NULL;
+}
+
+static int test_reset_race(UvmVaSpace *vs)
+{
+    struct churn_arg a = { .vs = vs };
+    CHECK(uvmMemAlloc(vs, BUF_BYTES, (void **)&a.buf) == TPU_OK);
+    fill_pattern(a.buf, BUF_BYTES, 0xC0FFEE);
+
+    pthread_t th;
+    CHECK(pthread_create(&th, NULL, churn_thread, &a) == 0);
+    /* Two mid-stream full-device resets while the churn is faulting
+     * through demote/promote windows. */
+    for (int i = 0; i < 2; i++) {
+        while (atomic_load(&a.cycles) < (i + 1) * 2)
+            usleep(1000);
+        (void)tpurmDeviceReset();
+    }
+    atomic_store(&a.stop, 1);
+    pthread_join(th, NULL);
+    CHECK(atomic_load(&a.failures) == 0);
+
+    UvmLocation host = { .tier = UVM_TIER_HOST, .devInst = 0 };
+    CHECK(uvmMigrate(vs, a.buf, BUF_BYTES, host, 0) == TPU_OK);
+    CHECK(check_pattern(a.buf, BUF_BYTES, 0xC0FFEE));
+    CHECK(uvmMemFree(vs, a.buf) == TPU_OK);
+    printf("  reset race under churn (%d cycles)      ok\n",
+           atomic_load(&a.cycles));
+    return 0;
+}
+
+int main(void)
+{
+    if (tpurmDeviceCount() < 2) {
+        fprintf(stderr, "remote_tier_test: needs TPUMEM_FAKE_TPU_COUNT>=2\n");
+        return 1;
+    }
+    tpuRegistrySet("TPUMEM_REMOTE_TIER", "1");
+    /* The fake arenas are small and equally sized: no headroom refusals
+     * in the way of the deterministic assertions. */
+    tpuRegistrySet("TPUMEM_REMOTE_HEADROOM_PCT", "0");
+
+    UvmVaSpace *vs = NULL;
+    if (uvmVaSpaceCreate(&vs) != TPU_OK) {
+        fprintf(stderr, "va space create failed\n");
+        return 1;
+    }
+    for (uint32_t d = 0; d < tpurmDeviceCount(); d++)
+        uvmRegisterDevice(vs, d);
+
+    int rc = 0;
+    rc |= test_roundtrip(vs);
+    rc |= test_generation_fence(vs);
+    rc |= test_peer_death(vs);
+    rc |= test_reset_race(vs);
+
+    uvmVaSpaceDestroy(vs);
+    printf(rc ? "remote_tier_test: FAIL\n" : "remote_tier_test: ok\n");
+    return rc;
+}

@@ -80,6 +80,11 @@ static int test_crc32c(void)
     /* One flipped bit always detected. */
     buf[sizeof(buf) / 2] ^= 0x20;
     CHECK(tpurmShieldCrc32c(buf, sizeof(buf)) != whole);
+    /* The at-load dispatch self-test verified on this host (it already
+     * ran in the constructor; re-running is idempotent).  A false here
+     * means the HW CRC32C path disagreed with the table and the
+     * dispatch fell back — never expected on a healthy machine. */
+    CHECK(tpurmShieldCrcSelftest());
     return 0;
 }
 

@@ -223,7 +223,8 @@ class Scheduler:
                  tokens_per_round: int = 8,
                  admit_retries: int = 3,
                  cache: Optional[serving.TieredKVCache] = None,
-                 blame_tokens: bool = False):
+                 blame_tokens: bool = False,
+                 disagg=None):
         from ..uvm import inject as _inject
         from ..uvm import reset as _reset
         from .. import utils as _utils
@@ -288,6 +289,32 @@ class Scheduler:
         self._blame_tokens = blame_tokens
         self.token_blame: List[Dict] = []
         self._blame_snap: Dict[int, Dict[str, int]] = {}
+        # tpusplit prefill/decode disaggregation (DisaggConfig): each
+        # admitted stream prefills against disagg.prefill_dev, then its
+        # slot's KV records SHIP (vac manifest transaction riding the
+        # request's flow) to the stream's decode home.  Requires the
+        # multichip backing — home maps are what shipping flips.
+        self._disagg = disagg
+        if disagg is not None:
+            if self._multichip_backing() is None:
+                raise ValueError(
+                    "disagg needs a multichip backing "
+                    "(models.multichip.IciPoolBacking)")
+            n = self.cache.backing.n_devices
+            bad = [d for d in (disagg.prefill_dev,) +
+                   tuple(disagg.decode_devs) if d >= n]
+            if bad:
+                raise ValueError(f"disagg devices {bad} out of range "
+                                 f"(pool has {n})")
+            for k in ("disagg_ships", "disagg_ship_aborts",
+                      "disagg_reclaims", "disagg_pages_shipped"):
+                self.stats[k] = 0
+        # Per-ship wall times (vac MigrationReport.ship_s) — the
+        # bench's disagg_ship_ms_p50/p99 source — and the slot -> decode
+        # home map (assignment is deterministic; an EVACUATION of a
+        # decode chip rewrites the entries it moved).
+        self.disagg_ship_s: List[float] = []
+        self._disagg_home: Dict[int, int] = {}
 
     # ------------------------------------------------------------ tenants
 
@@ -695,6 +722,66 @@ class Scheduler:
             ring.submit_and_wait(None)
             self._check_prefetch_cqes(ring.completions(max_cqes=8192))
 
+    # ------------------------------------------------ tpusplit disagg
+
+    def _slot_pages(self, seq: int) -> List[int]:
+        m = self.cache.pages_per_seq
+        return [seq * m + pg for pg in range(m)]
+
+    def _disagg_reclaim(self, req: Request) -> None:
+        """Bring the slot's records back to the prefill chip before the
+        new stream prefills into it (the previous tenant of the slot
+        left them parked on a decode chip).  Best-effort: on abort the
+        prefill's KV writes still reach a remote home over ICI — the
+        reclaim buys locality, never correctness."""
+        if self._disagg is None:
+            return
+        from ..uvm import vac as _vac
+        from . import tpusplit as _tpusplit
+
+        backing = self.cache.backing
+        d = self._disagg
+        pages = [p for p in self._slot_pages(req.seq)
+                 if int(backing.home[p]) != d.prefill_dev]
+        if not pages:
+            return
+        try:
+            _tpusplit.reclaim_kv(backing, pages, d.prefill_dev,
+                                 flow=req.flow, window=d.window)
+            self.stats["disagg_reclaims"] += 1
+        except (_vac.VacAbort, native.RmError, RuntimeError):
+            pass
+
+    def _disagg_ship(self, req: Request) -> None:
+        """Ship the freshly prefilled slot to the stream's decode home
+        (flush first: the ship must move the KV truth, not the pool
+        records prefill bypassed via the device slot pool).  The vac
+        transaction rides the REQUEST's flow, so the shipping cost
+        lands in its `ici` blame bucket.  On abort the stream decodes
+        CO-LOCATED from wherever its pages are — token-exact, only the
+        placement degrades (vac's abort-to-source doctrine)."""
+        if self._disagg is None:
+            return
+        from ..uvm import vac as _vac
+        from . import tpusplit as _tpusplit
+
+        d = self._disagg
+        home = self._disagg_home.get(req.seq, d.home_of(req.seq))
+        self.cache.flush_group([req.seq])
+        try:
+            reps = _tpusplit.ship_kv(self.cache.backing,
+                                     self._slot_pages(req.seq), home,
+                                     flow=req.flow, window=d.window)
+        except (_vac.VacAbort, native.RmError, RuntimeError):
+            self.stats["disagg_ship_aborts"] += 1
+            _counter_add("tpusplit_ship_aborts")
+            return
+        self._disagg_home[req.seq] = home
+        self.stats["disagg_ships"] += 1
+        self.stats["disagg_pages_shipped"] += sum(r.pages for r in reps)
+        self.disagg_ship_s.extend(
+            _tpusplit.ship_latencies_s(reps))
+
     # --------------------------------------------------------- admission
 
     def _admit_gate(self) -> bool:
@@ -749,6 +836,9 @@ class Scheduler:
             m = self.cache.pages_per_seq
             for pg in range(m):
                 backing.set_page_tenant(seq * m + pg, req.tenant)
+        # tpusplit: records the slot's PREVIOUS stream parked on a
+        # decode chip come home before prefill writes KV into them.
+        self._disagg_reclaim(req)
         try:
             # Thread flow context: prefill's CPU faults + engine spans
             # carry the request identity; the admit span below is the
@@ -772,6 +862,9 @@ class Scheduler:
             return False
         finally:
             self._utils.flow_set(0)
+        # tpusplit: prefill done on the prefill chip — ship the slot's
+        # KV to its decode home (or decode co-located on abort).
+        self._disagg_ship(req)
         self._cur_tok[seq] = self.cache.last_token[seq]
         self._running[seq] = req
         req.state = RequestState.RUNNING
@@ -998,6 +1091,13 @@ class Scheduler:
         self.stats["evacuations"] += 1
         self.stats["evac_pages_moved"] += rep.pages
         _counter_add("tpusched_evacuations")
+        # tpusplit: an evacuated decode chip's streams now live on the
+        # evacuation target — rewrite their home entries so later
+        # ships/reclaims follow the pages, not the stale assignment.
+        if self._disagg is not None:
+            for s in affected:
+                if self._disagg_home.get(s) == src:
+                    self._disagg_home[s] = dst
         return rep
 
     def _check_evacuation(self) -> None:
@@ -1234,6 +1334,20 @@ class Scheduler:
                 1e3 * float(np.percentile(lats, 99)), 3) if lats else 0.0,
         }
         out.update({k: v for k, v in self.stats.items()})
+        if self._disagg is not None:
+            ship_ms = [1e3 * s for s in self.disagg_ship_s]
+            out["disagg"] = {
+                "decode_devs": list(self._disagg.decode_devs),
+                "prefill_dev": self._disagg.prefill_dev,
+                "ships": self.stats["disagg_ships"],
+                "ship_aborts": self.stats["disagg_ship_aborts"],
+                "reclaims": self.stats["disagg_reclaims"],
+                "pages_shipped": self.stats["disagg_pages_shipped"],
+                "ship_ms_p50": round(float(
+                    np.percentile(ship_ms, 50)), 3) if ship_ms else 0.0,
+                "ship_ms_p99": round(float(
+                    np.percentile(ship_ms, 99)), 3) if ship_ms else 0.0,
+            }
         # Per-tenant SLO summary from the native tpuflow histograms
         # (process-global: bench isolates levels with utils.flow_reset).
         slo = {}

@@ -1,0 +1,124 @@
+"""tpusplit — prefill/decode disaggregation over the multichip KV pool.
+
+Serving splits into two phases with opposite resource shapes: PREFILL
+is compute-bound (one big attention pass builds the KV for the whole
+prompt) and DECODE is memory-bound (every token re-reads the KV).  At
+pool scale the two phases fight for the same HBM when co-located; the
+disaggregated layout runs prefill on one chip and parks each stream's
+KV on an assigned DECODE chip, so decode-side HBM scales with the
+number of decode chips instead of competing with prefill scratch.
+
+This module is the MECHANISM: KV shipping between the prefill chip and
+a stream's decode home as tpuvac manifest transactions —
+
+  ship     — after prefill, the stream's slot records move
+             prefill -> decode home as ONE vac.migrate_pages call:
+             generation-stamped manifest, dep-joined PEER_COPY windows
+             on the submission spine, per-record tpushield wire CRC,
+             abort-to-source.  The ship rides the REQUEST's tpuflow id
+             (not vac's 0xFFFF infrastructure sentinel), so the
+             shipping cost lands in that request's `ici` blame bucket
+             — disaggregation's tax is attributable per token.
+  reclaim  — before a NEW stream prefills into a slot, records the
+             previous stream left on a decode chip come back to the
+             prefill chip, so prefill's KV writes are chip-local.
+
+Both directions inherit vac's failure doctrine wholesale: on ANY abort
+(lender/target death, a device reset under the ship, inject-site
+exhaustion, wire CRC persisting) the source mapping was never touched
+— the stream decodes CO-LOCATED from wherever its pages already are,
+token-exact, and only `tpusplit_ship_aborts` records the downgrade.
+
+The POLICY half (which streams ship where, reset/evacuation recovery,
+blame surfaces) lives in :class:`~.sched.Scheduler` via
+``DisaggConfig``.  The native far-memory rung this pairs with
+(UVM_TIER_REMOTE: a neighbor chip's HBM as spill target for the
+borrower's own arena pressure) lives in native/src/uvm/uvm_tier_remote.c
+— tpusplit places WORKING KV on purpose, the REMOTE tier catches
+overflow by accident; both move bytes only as spine PEER_COPYs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+
+def _counter_add(name: str, delta: int = 1) -> None:
+    from . import sched as _sched
+    _sched._counter_add(name, delta)
+
+
+@dataclasses.dataclass(frozen=True)
+class DisaggConfig:
+    """Prefill/decode split for a multichip scheduler.
+
+    ``decode_devs``: chips that hold decoding streams' KV (a stream's
+    home is ``decode_devs[seq % len(decode_devs)]`` — deterministic, so
+    a restore after reset lands on the same home).  ``prefill_dev``:
+    the chip prefill (and the JAX compute) runs against.  ``window``:
+    in-flight PEER_COPY records per shipping window (vac dep-join
+    throttle)."""
+
+    decode_devs: Tuple[int, ...]
+    prefill_dev: int = 0
+    window: int = 4
+
+    def __post_init__(self):
+        if not self.decode_devs:
+            raise ValueError("disagg needs at least one decode chip")
+        if self.prefill_dev in self.decode_devs:
+            raise ValueError(
+                f"prefill chip {self.prefill_dev} cannot also be a "
+                f"decode home (the split is the point)")
+
+    def home_of(self, seq: int) -> int:
+        return self.decode_devs[seq % len(self.decode_devs)]
+
+
+def _move(backing, pages: Sequence[int], dst: int,
+          flow: int, window: int):
+    """One logical move = one vac transaction per source chip the
+    pages currently sit on (normally just one, but after an aborted
+    leg a slot can be split across chips).  Legs already committed
+    stay committed on a later leg's failure — each page has exactly
+    one home at all times, so a partial move is co-location for the
+    unmoved remainder, never corruption."""
+    from ..uvm import vac as _vac
+
+    reports = []
+    srcs = sorted({int(backing.home[p]) for p in pages} - {dst})
+    for src in srcs:
+        sub = [p for p in pages if int(backing.home[p]) == src]
+        reports.append(_vac.migrate_pages(backing, src, dst, sub,
+                                          window=window,
+                                          flow=flow or None))
+    return reports
+
+
+def ship_kv(backing, pages: Sequence[int], dst: int,
+            flow: int = 0, window: int = 4):
+    """Ship ``pages`` to decode home ``dst``.  Returns the committed
+    :class:`vac.MigrationReport` list; raises :class:`vac.VacAbort`
+    (or RmError) on the first failed leg."""
+    reports = _move(backing, pages, dst, flow, window)
+    _counter_add("tpusplit_ships")
+    _counter_add("tpusplit_pages_shipped",
+                 sum(r.pages for r in reports))
+    return reports
+
+
+def reclaim_kv(backing, pages: Sequence[int], prefill_dev: int,
+               flow: int = 0, window: int = 4):
+    """Bring ``pages`` back to the prefill chip before a new stream
+    reuses their slot.  Same transaction semantics as :func:`ship_kv`;
+    counted separately (``tpusplit_reclaims``) because reclaim traffic
+    is the DISAGGREGATION overhead a co-located layout never pays."""
+    reports = _move(backing, pages, prefill_dev, flow, window)
+    _counter_add("tpusplit_reclaims")
+    return reports
+
+
+def ship_latencies_s(reports) -> List[float]:
+    """Per-leg ship wall times from a list of MigrationReports."""
+    return [r.ship_s for r in reports]

@@ -63,6 +63,10 @@ class RecType(enum.IntEnum):
     CLIENT_DEATH = 22      # a0 = pid
     LOG = 23               # WARN+ tpuLog mirror: a0 = level
     DUMP = 24              # bundle written: a1 = 1 complete / 0 truncated
+    CRC_SELFTEST = 25      # HW CRC32C mismatch: a0 = hw crc, a1 = want
+    TIER_REMOTE = 26       # a0 = pages/leases, a1 = op (0 demote /
+                           # 1 demote-fail / 2 revoke / 3 fence abort);
+                           # dev = lender
 
 
 #: Header struct offsets (journal.h TpuJournalHdr — fixed ABI).

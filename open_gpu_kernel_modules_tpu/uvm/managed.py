@@ -24,6 +24,7 @@ class Tier(enum.IntEnum):
     HOST = 0
     HBM = 1
     CXL = 2
+    REMOTE = 3   # leased span of a lender chip's HBM (native UVM_TIER_REMOTE)
 
 
 class Compress(enum.IntEnum):
@@ -84,6 +85,8 @@ class _ResidencyInfo(ctypes.Structure):
         ("cancelled", ctypes.c_uint8),
         ("pinnedTier", ctypes.c_int32),
         ("hbmOffset", ctypes.c_uint64),
+        ("residentRemote", ctypes.c_uint8),
+        ("remoteLenderInst", ctypes.c_uint32),
     ]
 
 
@@ -136,6 +139,8 @@ class ResidencyInfo:
     dev_mapped: bool = False
     cancelled: bool = False
     hbm_offset: int = 0       # arena offset of the HBM backing (when hbm)
+    remote: bool = False      # leased replica on a lender chip's HBM
+    remote_lender: int = 0    # lender devInst (when remote)
 
 
 @dataclass(frozen=True)
@@ -518,7 +523,8 @@ class ManagedBuffer:
                              bool(raw.cpuMapped),
                              _tier_or_none(raw.pinnedTier),
                              bool(raw.devMapped), bool(raw.cancelled),
-                             raw.hbmOffset)
+                             raw.hbmOffset, bool(raw.residentRemote),
+                             raw.remoteLenderInst)
 
     def free(self) -> None:
         if self.address:

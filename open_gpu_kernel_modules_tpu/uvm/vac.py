@@ -310,7 +310,8 @@ class MigrationReport:
 def migrate_pages(backing, src: int, dst: int,
                   pages: Optional[Sequence[int]] = None,
                   window: int = 4, retries: int = 3,
-                  verify: bool = True) -> MigrationReport:
+                  verify: bool = True,
+                  flow: Optional[int] = None) -> MigrationReport:
     """Transactionally re-home ``pages`` (default: everything homed on
     ``src``) from ``src`` to ``dst`` over an ``IciPoolBacking``.
 
@@ -321,6 +322,12 @@ def migrate_pages(backing, src: int, dst: int,
     (generation moved / target lost / fabric partitioned) — every
     staged target record is freed, the native transaction aborts, and
     :class:`VacAbort` raises; the source mapping was never touched.
+
+    ``flow``: attribute the shipping windows to an EXISTING flow (a
+    serving request's) instead of minting the 0xFFFF infrastructure
+    sentinel — tpusplit KV shipping charges the ici blame bucket of
+    the request that caused the ship.  The caller owns the flow's
+    open/close lifecycle; this function only stamps it.
     """
     from . import inject as _inject
     from . import memring as _memring
@@ -350,8 +357,10 @@ def migrate_pages(backing, src: int, dst: int,
     # Perfetto export and the PEER_COPY exec time lands in the flow's
     # ici blame bucket.
     from .. import utils as _flowutils
-    flow = _flowutils.flow_mint(0xFFFF, txn._txn & 0xFFFFFFFF)
-    _flowutils.flow_open(flow)
+    owns_flow = flow is None
+    if owns_flow:
+        flow = _flowutils.flow_mint(0xFFFF, txn._txn & 0xFFFFFFFF)
+        _flowutils.flow_open(flow)
     # Stamp the migration's flow id on THIS thread: the native vac
     # engine journals the manifest lifecycle (vac.begin / vac.commit /
     # vac.abort) off thread-local flow context, so without the stamp a
@@ -461,7 +470,8 @@ def migrate_pages(backing, src: int, dst: int,
         raise
     finally:
         _flowutils.flow_set(0)
-        _flowutils.flow_close(flow)
+        if owns_flow:
+            _flowutils.flow_close(flow)
         ring.close()
     return MigrationReport(src, dst, len(pages), len(pages) * rec_bytes,
                            time.perf_counter() - t0, total_retries, True)

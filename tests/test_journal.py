@@ -44,7 +44,8 @@ JOURNAL_INVENTORY = [
     "ring.stale", "ring.deadline",
     "ici.flap", "ici.retrain", "ici.crc",
     "page.quarantine", "page.poison",
-    "shield.verdict",
+    "shield.verdict", "shield.selftest",
+    "tier.remote",
     "vac.begin", "vac.commit", "vac.abort",
     "inject.hit",
     "sched.shed", "sched.preempt", "sched.retire",

@@ -291,6 +291,153 @@ def test_multichip_evacuation_token_exact():
         assert row["charged"] == row["homed"], (d, row)
 
 
+_DISAGG_SCRIPT = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+import os
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+
+from open_gpu_kernel_modules_tpu.models import llama, multichip
+from open_gpu_kernel_modules_tpu.runtime import sched, tpusplit
+from open_gpu_kernel_modules_tpu.uvm import inject as inj, reset, vac
+from open_gpu_kernel_modules_tpu import utils
+
+cfg = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=64)
+cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+params = llama.init_params(cfg, jax.random.key(0))
+rng = np.random.default_rng(23)
+prompts = [rng.integers(0, 128, size=15) for _ in range(6)]
+
+
+def build(disagg):
+    cache = multichip.make_multichip_cache(cfg, batch=6, max_len=64,
+                                           page_size=8, oversub=2,
+                                           n_devices=4)
+    s = sched.Scheduler(cfg, params, max_seqs=6, max_len=64, page_size=8,
+                        oversub=2, tokens_per_round=4, cache=cache,
+                        disagg=disagg)
+    return s, [s.submit(p, max_new_tokens=24) for p in prompts]
+
+
+def finish(s, reqs):
+    rounds = 0
+    while not s.idle and rounds < 5000:
+        s.step()
+        rounds += 1
+    toks = {r.rid: r.tokens.tolist() for r in reqs
+            if r.state is sched.RequestState.FINISHED}
+    states = {r.rid: r.state.value for r in reqs}
+    return toks, states
+
+
+# ---- co-located clean reference: no split, no chaos ------------------
+s, reqs = build(None)
+ref_toks, ref_states = finish(s, reqs)
+s.close()
+
+# ---- disaggregated chaos arm: ALL sites, a mid-stream full-device
+#      reset, and an evacuation of a decode home ----------------------
+inj.set_seed(4321)
+for site in inj.Site:
+    inj.enable(site, inj.Mode.PPM, 5000)     # 0.5%% chaos floor
+d = tpusplit.DisaggConfig(decode_devs=(1, 2, 3))
+s, reqs = build(d)
+for _ in range(2):
+    s.step()
+
+# 1) Forced FULL-DEVICE reset mid-decode: every shipped page's lease
+#    generation goes stale at once; decode must restore token-exact.
+gen0 = reset.generation()
+reset.device_reset()
+assert reset.generation() > gen0
+s.step(); s.step()
+
+# 2) Evacuate decode home 1 -> 2: the vac move rehomes the KV and the
+#    scheduler's disagg home map must follow (later resets restore the
+#    stream onto chip 2, not the emptied chip 1).
+homes_before = dict(s._disagg_home)
+rep = s.evacuate_device(1, 2)
+assert rep is not None and rep.pages > 0, rep
+assert s.cache.backing.pages_homed(1) == []
+rewritten = {sq: s._disagg_home[sq] for sq, h in homes_before.items()
+             if h == 1}
+assert rewritten and all(h == 2 for h in rewritten.values()), \
+    (homes_before, dict(s._disagg_home))
+out = {"evac_pages": rep.pages, "homes_rewritten": len(rewritten)}
+
+toks, states = finish(s, reqs)
+inj.disable_all()
+
+out["stats"] = {k: s.stats[k] for k in
+                ("disagg_ships", "disagg_ship_aborts", "disagg_reclaims",
+                 "disagg_pages_shipped", "evacuations",
+                 "device_resets_observed")}
+out["ship_legs"] = len(s.disagg_ship_s)
+out["states"] = states
+out["ref_states"] = ref_states
+out["tokens_identical"] = (sorted(toks) == sorted(ref_toks) and
+                           all(toks[r] == ref_toks[r] for r in ref_toks))
+out["txns_open"] = vac.txns_active()
+out["ctr"] = {n: utils.counter(n) for n in
+              ("tpusplit_ships", "tpusplit_ship_aborts",
+               "tpusplit_reclaims", "tpusplit_pages_shipped")}
+s.close()
+print(json.dumps(out))
+"""
+
+
+def test_disagg_token_exact():
+    """tpusplit acceptance: prefill/decode disaggregation (prefill on
+    chip 0, KV shipped to decode homes 1-3) decodes BIT-IDENTICAL to
+    the co-located reference through a forced mid-stream full-device
+    reset and an evacuation of a decode home, with ALL inject sites
+    armed at the 0.5%% chaos floor.  The evacuation must also rewrite
+    the scheduler's disagg home map so later restores chase the KV."""
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    env["TPUMEM_FAKE_HBM_MB"] = "64"
+    script = _DISAGG_SCRIPT % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Zero token divergence through reset + evacuation + chaos, and
+    # every stream reached FINISHED in both arms.
+    assert out["tokens_identical"], out
+    assert set(out["states"].values()) == {"finished"}, out["states"]
+    assert out["states"] == out["ref_states"]
+
+    # The split actually happened: every admitted stream shipped (or
+    # recorded its abort downgrade), pages moved, reclaims ran for the
+    # slots' prior leftovers, and latencies were captured per leg.
+    st = out["stats"]
+    assert st["disagg_ships"] + st["disagg_ship_aborts"] >= len(
+        out["states"]), st
+    assert st["disagg_pages_shipped"] > 0, st
+    assert st["disagg_reclaims"] > 0, st
+    assert out["ship_legs"] >= st["disagg_ships"], out
+
+    # The choreography fired: one observed reset, one evacuation, and
+    # at least one stream's decode home rewritten 1 -> 2.
+    assert st["device_resets_observed"] >= 1, st
+    assert st["evacuations"] >= 1, st
+    assert out["homes_rewritten"] >= 1, out
+    assert out["evac_pages"] > 0
+
+    # Process-global metric surface matches the scheduler's ledger and
+    # no manifest leaked open.
+    assert out["ctr"]["tpusplit_ships"] == st["disagg_ships"]
+    assert out["ctr"]["tpusplit_pages_shipped"] == \
+        st["disagg_pages_shipped"]
+    assert out["ctr"]["tpusplit_reclaims"] == st["disagg_reclaims"]
+    assert out["txns_open"] == 0
+
+
 def test_multichip_decode_with_link_failure():
     env = dict(os.environ)
     env["TPUMEM_FAKE_TPU_COUNT"] = "4"

@@ -1,0 +1,148 @@
+"""REMOTE tier (UVM_TIER_REMOTE), Python surface: a neighbor chip's
+HBM as far memory below local HBM.
+
+The native engine invariants (spine-only PEER_COPY, generation
+fencing, lender-death fallback, reset races) are covered by
+native/tests/remote_tier_test.c; this file covers what Python can
+see — residency exposition (``ResidencyInfo.remote``/``remote_lender``),
+the borrower/lender counters, and the ``tpurm_tier_remote_pages``
+Prometheus gauge.
+
+Runs in a subprocess because the native device table is process-global
+(the tier needs >= 2 fake chips and the ``TPUMEM_REMOTE_TIER`` knob
+must be set before the library loads).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import ctypes, json, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+
+from open_gpu_kernel_modules_tpu import uvm, utils
+from open_gpu_kernel_modules_tpu.runtime import native
+from open_gpu_kernel_modules_tpu.uvm.managed import Tier
+
+MB = 1 << 20
+lib = native.load()
+lib.uvmTierEvictBytes.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
+                                  ctypes.c_uint64]
+lib.uvmTierEvictBytes.restype = ctypes.c_uint64
+lib.uvmTierRemoteStats.argtypes = [ctypes.c_uint32,
+                                   ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.POINTER(ctypes.c_uint64)]
+
+def remote_stats(dev):
+    borrowed, lent = ctypes.c_uint64(), ctypes.c_uint64()
+    lib.uvmTierRemoteStats(dev, ctypes.byref(borrowed),
+                           ctypes.byref(lent))
+    return borrowed.value, lent.value
+
+out = {}
+pattern = np.arange(MB, dtype=np.uint8) * 37 + 11
+with uvm.VaSpace(register_devices=range(4)) as vs:
+    a = vs.alloc(MB)
+    a.view()[:] = pattern
+
+    # Park on local HBM, then squeeze dev 0's arena: with the remote
+    # tier on, the demotion lands a leased replica on a lender chip
+    # instead of falling straight to HOST.
+    a.device_access(dev=0, write=True)
+    r = a.residency()
+    assert r.hbm and not r.remote, r
+    d0 = utils.counter("tier_remote_demotes")
+    lib.uvmTierEvictBytes(int(Tier.HBM), 0, (1 << 62))
+    r = a.residency()
+    out["remote_after_evict"] = r.remote
+    out["lender"] = r.remote_lender
+    out["host_after_evict"] = r.host        # write-through: HOST keeps a copy
+    out["hbm_after_evict"] = r.hbm
+    out["demotes"] = utils.counter("tier_remote_demotes") - d0
+    out["demote_bytes"] = utils.counter("tier_remote_demote_bytes")
+
+    borrowed, _ = remote_stats(0)
+    _, lent = remote_stats(r.remote_lender)
+    out["borrowed_pages_dev0"] = borrowed
+    out["lent_bytes_lender"] = lent
+
+    # Gauge exposition while the lease is live.
+    text = utils.metrics_text()
+    out["gauge_typed"] = "# TYPE tpurm_tier_remote_pages gauge" in text
+    out["gauge_sample"] = next(
+        (l for l in text.splitlines()
+         if l.startswith('tpurm_tier_remote_pages{dev="0"}')), "")
+
+    # A device READ faults the span back into local HBM; the promote
+    # fetches the replica over ICI from the lender (counted) and read
+    # duplication keeps the lease alive alongside the new HBM copy.
+    p0 = utils.counter("tier_remote_promotes")
+    a.device_access(dev=0)
+    r = a.residency()
+    out["hbm_after_read"] = r.hbm
+    out["remote_after_read"] = r.remote
+    out["promotes"] = utils.counter("tier_remote_promotes") - p0
+
+    # An exclusive migration to HBM revokes the duplicate: the lease
+    # and both borrower/lender ledgers drain.
+    a.migrate(Tier.HBM, dev=0)
+    r = a.residency()
+    out["remote_after_promote"] = r.remote
+    out["hbm_after_promote"] = r.hbm
+    borrowed, _ = remote_stats(0)
+    out["borrowed_after_promote"] = borrowed
+
+    out["intact"] = bool((a.view() == pattern).all())
+    a.free()
+print(json.dumps(out))
+"""
+
+
+def test_remote_tier_python_surface():
+    env = dict(os.environ)
+    env["TPUMEM_FAKE_TPU_COUNT"] = "4"
+    env["TPUMEM_FAKE_HBM_MB"] = "64"
+    env["TPUMEM_REMOTE_TIER"] = "1"
+    env["TPUMEM_REMOTE_HEADROOM_PCT"] = "0"
+    script = _SCRIPT % {"repo": _REPO}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Demotion under arena pressure parked the span REMOTE (replica on
+    # a lender chip), write-through kept the HOST copy, and the
+    # residency ioctl exposes both the flag and the lender id.
+    assert out["remote_after_evict"], out
+    assert out["host_after_evict"], out
+    assert not out["hbm_after_evict"], out
+    assert out["lender"] != 0, out
+    assert out["demotes"] >= 1, out
+    assert out["demote_bytes"] > 0, out
+
+    # Borrower/lender ledgers agree with the lease: dev 0 borrowed
+    # pages, the lender carries lent bytes (excluded from its own
+    # headroom math — the satellite fix).
+    assert out["borrowed_pages_dev0"] > 0, out
+    assert out["lent_bytes_lender"] > 0, out
+
+    # Prometheus gauge renders per borrower device.
+    assert out["gauge_typed"], out
+    assert out["gauge_sample"], out
+    assert float(out["gauge_sample"].split()[-1]) > 0, out
+
+    # The device read promoted over ICI (counted) with the lease kept
+    # as a read duplicate; the exclusive migrate then drained it, and
+    # the data survived the full round trip.
+    assert out["hbm_after_read"], out
+    assert out["remote_after_read"], out
+    assert out["promotes"] >= 1, out
+    assert not out["remote_after_promote"], out
+    assert out["hbm_after_promote"], out
+    assert out["borrowed_after_promote"] == 0, out
+    assert out["intact"], out

@@ -67,10 +67,16 @@ METRICS_INVENTORY = [
     "recover_rc_resets", "recover_rdma_retries", "recover_retries",
     "recover_tier_fallbacks", "rm_events_allocated",
     "rm_events_delivered", "rm_memory_maps",
+    "shield_crc_selftest_fallbacks", "shield_crc_selftests",
     "shield_detected", "shield_inject_corrupts", "shield_inject_misses",
     "shield_retire_overflow", "shield_retired_realloc",
     "shield_wire_mismatches",
-    "shield_wire_verifies", "tier_tenant_binds",
+    "shield_wire_verifies",
+    "tier_remote_demote_bytes", "tier_remote_demote_fails",
+    "tier_remote_demotes", "tier_remote_fence_aborts",
+    "tier_remote_headroom_refusals", "tier_remote_promote_bytes",
+    "tier_remote_promotes", "tier_remote_revokes",
+    "tier_tenant_binds",
     "tier_tenant_configs", "tier_tenant_evictions",
     "tier_tenant_over_quota_evictions", "tier_tenant_slo_reorders",
     "tpuce_compressed_bytes_in", "tpuce_compressed_bytes_out",
@@ -98,6 +104,7 @@ METRICS_INVENTORY = [
     "tpurm_shield_pages_retired", "tpurm_shield_refetch_saves",
     "tpurm_shield_seals", "tpurm_shield_verifies",
     "tpurm_slo_blame_ns", "tpurm_tenant_pages",
+    "tpurm_tier_remote_pages",
     "tpurm_tenant_quota_pages", "tpurm_tenant_rebinds",
     "tpurm_trace_dropped_total", "tpurm_trace_records_total",
     "tpurm_trace_rings", "tpurm_watchdog_device_resets",
@@ -110,6 +117,8 @@ METRICS_INVENTORY = [
     "tpusched_poisoned_retired", "tpusched_preempted",
     "tpusched_restored", "tpusched_retired",
     "tpusched_seq_slots_retired",
+    "tpusplit_pages_shipped", "tpusplit_reclaims",
+    "tpusplit_ship_aborts", "tpusplit_ships",
     "tpusched_round_errors", "tpusched_rounds", "tpusched_submitted",
     "uvm_access_counter_demotions", "uvm_access_counter_promotions",
     "uvm_accessed_by_mappings", "uvm_ats_accesses", "uvm_ats_bytes",
